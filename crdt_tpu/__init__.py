@@ -1,0 +1,49 @@
+"""crdt_tpu — a TPU-native CRDT framework.
+
+A brand-new implementation of the capability surface of the reference
+(`FintanH/rust-crdt`, the `crdts` crate — see SURVEY.md; reference mount was
+empty, citations are `src/<file>.rs` + symbol per SURVEY.md §0): the full CRDT
+family (VClock, GCounter, PNCounter, GSet, LWWReg, MVReg, Orswot, Map, List,
+GList, MerkleReg) behind the reference's trait contracts (CvRDT / CmRDT /
+ResetRemove) and causal-context protocol (ReadCtx / AddCtx / RmCtx), executed
+two ways:
+
+- ``crdt_tpu.pure``   — sequential oracle with reference semantics (the
+  equivalent of the Rust crate's L0–L4; correctness ground truth).
+- ``crdt_tpu.models`` / ``crdt_tpu.ops`` / ``crdt_tpu.parallel`` — batched,
+  device-resident lattice states whose merge / apply paths are jit+vmap XLA
+  kernels and whose anti-entropy runs as lattice-join collectives over a
+  device mesh (built out per SURVEY.md §7.2; import ``crdt_tpu.pure`` types
+  from the package root either way).
+
+Layer map mirrors SURVEY.md §2: traits (L0) → vclock/dot (L1) → ctx (L2) →
+type family (L3) → Map composition (L4) → this re-export surface (L5,
+reference: src/lib.rs).
+"""
+
+from .traits import CvRDT, CmRDT, ResetRemove, Causal, ValidationError, DotRange
+from .dot import Dot, OrdDot
+from .vclock import VClock
+from .ctx import ReadCtx, AddCtx, RmCtx
+
+# Sequential oracle types (reference semantics).
+from .pure.gcounter import GCounter
+from .pure.pncounter import PNCounter, Dir
+from .pure.gset import GSet
+from .pure.lwwreg import LWWReg
+from .pure.mvreg import MVReg
+from .pure.orswot import Orswot
+from .pure.map import Map
+from .pure.identifier import Identifier
+from .pure.list import List
+from .pure.glist import GList
+from .pure.merkle_reg import MerkleReg
+
+__all__ = [
+    "CvRDT", "CmRDT", "ResetRemove", "Causal", "ValidationError", "DotRange",
+    "Dot", "OrdDot", "VClock", "ReadCtx", "AddCtx", "RmCtx",
+    "GCounter", "PNCounter", "Dir", "GSet", "LWWReg", "MVReg", "Orswot",
+    "Map", "Identifier", "List", "GList", "MerkleReg",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,66 @@
+"""Causal contexts — the read-your-context mutation protocol.
+
+Reference: src/ctx.rs ``ReadCtx<V, A>`` / ``AddCtx<A>`` / ``RmCtx<A>`` with
+``ReadCtx::derive_add_ctx`` / ``derive_rm_ctx`` (SURVEY.md §2 L2). Every
+mutation of a causal type must be derived from a prior read, so removes only
+cover observed adds — no lost updates, no anomalous resurrection.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Generic, TypeVar
+
+from .dot import Dot
+from .vclock import VClock
+
+V = TypeVar("V")
+
+
+@dataclass
+class AddCtx:
+    """Context for an additive mutation: the deriving read's clock plus the
+    fresh dot that identifies this mutation.
+
+    Reference: src/ctx.rs ``AddCtx { clock, dot }``.
+    """
+
+    clock: VClock
+    dot: Dot
+
+
+@dataclass
+class RmCtx:
+    """Context for a removal: the clock of observed adds being removed.
+
+    Reference: src/ctx.rs ``RmCtx { clock }``.
+    """
+
+    clock: VClock
+
+
+@dataclass
+class ReadCtx(Generic[V]):
+    """A read result carrying the causal context it was taken under.
+
+    Reference: src/ctx.rs ``ReadCtx { add_clock, rm_clock, val }``.
+    ``add_clock`` is the state's full clock (what an add must advance);
+    ``rm_clock`` covers the dots supporting the read value (what a remove
+    may cover).
+    """
+
+    add_clock: VClock
+    rm_clock: VClock
+    val: V
+
+    def derive_add_ctx(self, actor: Any) -> AddCtx:
+        """Reference: src/ctx.rs ``ReadCtx::derive_add_ctx`` — clone the
+        add clock, mint the actor's next dot, and advance the clone by it."""
+        dot = self.add_clock.inc(actor)
+        clock = self.add_clock.clone()
+        clock.apply(dot)
+        return AddCtx(clock=clock, dot=dot)
+
+    def derive_rm_ctx(self) -> RmCtx:
+        """Reference: src/ctx.rs ``ReadCtx::derive_rm_ctx``."""
+        return RmCtx(clock=self.rm_clock.clone())

@@ -1,0 +1,70 @@
+"""Dot — a single (actor, counter) event identifier.
+
+Reference: src/dot.rs ``Dot<A> { actor: A, counter: u64 }`` plus the v7
+``OrdDot`` total-order wrapper used by List (SURVEY.md §3 rows 3, 13).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass(frozen=True)
+class Dot:
+    """The unit of causal history: the ``counter``-th event by ``actor``.
+
+    Reference: src/dot.rs ``Dot``. Dots are only partially ordered across
+    actors — comparison operators are defined per-actor only; use ``OrdDot``
+    when a total order is required (List identifiers).
+    """
+
+    actor: Any
+    counter: int
+
+    def inc(self) -> "Dot":
+        """The next dot by the same actor (reference: src/dot.rs Dot::inc)."""
+        return Dot(self.actor, self.counter + 1)
+
+    # Partial order: only comparable for the same actor. Python's dataclass
+    # ordering would order across actors, which is wrong — so we define it
+    # explicitly and return NotImplemented for cross-actor comparisons.
+    def __lt__(self, other: "Dot"):
+        if not isinstance(other, Dot) or self.actor != other.actor:
+            return NotImplemented
+        return self.counter < other.counter
+
+    def __le__(self, other: "Dot"):
+        if not isinstance(other, Dot) or self.actor != other.actor:
+            return NotImplemented
+        return self.counter <= other.counter
+
+    def __gt__(self, other: "Dot"):
+        if not isinstance(other, Dot) or self.actor != other.actor:
+            return NotImplemented
+        return self.counter > other.counter
+
+    def __ge__(self, other: "Dot"):
+        if not isinstance(other, Dot) or self.actor != other.actor:
+            return NotImplemented
+        return self.counter >= other.counter
+
+
+@dataclass(frozen=True, order=True)
+class OrdDot:
+    """Totally-ordered dot: (actor, counter) lexicographic.
+
+    Reference: src/dot.rs ``OrdDot`` (v7) [LOW-CONF per SURVEY.md §3 row 3];
+    List keys its identifiers by this to break ties between concurrent
+    inserts deterministically.
+    """
+
+    actor: Any
+    counter: int
+
+    @staticmethod
+    def from_dot(dot: Dot) -> "OrdDot":
+        return OrdDot(dot.actor, dot.counter)
+
+    def to_dot(self) -> Dot:
+        return Dot(self.actor, self.counter)

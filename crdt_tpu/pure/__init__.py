@@ -1,0 +1,6 @@
+"""Sequential oracle implementations with reference semantics.
+
+These are the correctness ground truth (the reference's L0–L4 behavior,
+SURVEY.md §7.2 step 1): plain-Python data structures whose merge/apply paths
+the batched backends in ``crdt_tpu.models`` must match bit-for-bit.
+"""

@@ -1,0 +1,313 @@
+"""Map — CRDT of CRDTs; the composition layer.
+
+Reference: src/map.rs ``Map<K, V: Val<A>, A> { clock, entries: BTreeMap<K,
+Entry { clock, val }>, deferred }`` with ``Op::{Nop, Up { dot, key, op },
+Rm { clock, keyset }}`` (SURVEY.md §3 row 11, §4.3). Values must satisfy
+the ``Val`` contract: cloneable, default-constructible, ``CmRDT`` +
+``CvRDT`` + supporting witness-pruning — removal of a key prunes the child
+to the surviving update witnesses, and merge prunes child state whose
+witnessing update dots one side observed and deleted (the hardest
+correctness surface in the reference).
+
+In Python the ``trait Val<A>`` bound becomes a constructor argument: the
+Map holds ``val_default`` (a zero-arg factory, e.g. ``MVReg`` / ``Orswot``
+/ a nested ``Map`` factory) playing the role of ``V::default()``.
+
+Composition rule (the causal-composition law from the delta-CRDT
+literature — Almeida et al., PAPERS.md; chosen per SURVEY.md §0 since the
+mount was empty): each entry tracks its *witness dot set* ``W`` (every
+update dot routed to the key that has not been removed), and
+
+    child state is alive iff its witness dot is in ``W``.
+
+``W`` is a true dot set, not a per-actor-max clock — so removing the state
+witnessed by (A,1) while (A,2) lives is representable exactly, and every
+path maintains the single invariant: key removal filters ``W`` under the
+rm clock and prunes the child to ``W``; merge joins ``W`` with the orswot
+dot rule (a dot survives iff the other side also has it or never saw it),
+plain-merges the children, and prunes to the joined ``W``. Because the
+child prune is a pure pointwise function of the joined witness set —
+never of top clocks or merge order — ``merge`` is a true lattice join
+(commutative, associative, idempotent, bit-for-bit), which the property
+suite asserts and the TPU reduction-tree anti-entropy path requires
+(SURVEY.md §7.3 "deterministic reduction").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, FrozenSet, Iterable, Set, Tuple
+
+from ..ctx import AddCtx, ReadCtx, RmCtx
+from ..dot import Dot
+from ..traits import CmRDT, CvRDT, ResetRemove
+from ..vclock import VClock
+
+
+@dataclass(frozen=True)
+class Nop:
+    """Reference: src/map.rs ``Op::Nop``."""
+
+
+@dataclass(frozen=True)
+class Up:
+    """Reference: src/map.rs ``Op::Up { dot, key, op }`` — route a child op
+    to the entry at ``key``, witnessed by ``dot``."""
+
+    dot: Dot
+    key: Any
+    op: Any
+
+
+@dataclass(frozen=True)
+class MapRm:
+    """Reference: src/map.rs ``Op::Rm { clock, keyset }``."""
+
+    clock: VClock
+    keyset: Tuple[Any, ...]
+
+
+def _witness_clock(dots: Set[Dot]) -> VClock:
+    """Per-actor-max view of a witness set (the RmCtx wire form —
+    reference: src/map.rs ``Entry.clock``)."""
+    out = VClock()
+    for d in dots:
+        out.apply(d)
+    return out
+
+
+class _Entry:
+    """Reference: src/map.rs ``Entry { clock, val }`` — here the birth
+    witnesses are a dot set (see module docstring for why)."""
+
+    __slots__ = ("dots", "val")
+
+    def __init__(self, dots: Set[Dot], val: Any):
+        self.dots = dots
+        self.val = val
+
+    def clone(self) -> "_Entry":
+        return _Entry(set(self.dots), self.val.clone())
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, _Entry)
+            and self.dots == other.dots
+            and self.val == other.val
+        )
+
+    def __repr__(self):
+        return f"Entry(dots={sorted((repr(d.actor), d.counter) for d in self.dots)}, val={self.val!r})"
+
+
+class Map(CvRDT, CmRDT, ResetRemove):
+    __slots__ = ("val_default", "clock", "entries", "deferred")
+
+    def __init__(self, val_default: Callable[[], Any]):
+        self.val_default = val_default
+        self.clock = VClock()
+        self.entries: Dict[Any, _Entry] = {}
+        self.deferred: Dict[VClock, set] = {}
+
+    # ---- reads ---------------------------------------------------------
+    def len(self) -> ReadCtx:
+        """Reference: src/map.rs ``Map::len``."""
+        return ReadCtx(
+            add_clock=self.clock.clone(),
+            rm_clock=self.clock.clone(),
+            val=len(self.entries),
+        )
+
+    def is_empty(self) -> ReadCtx:
+        ctx = self.len()
+        ctx.val = ctx.val == 0
+        return ctx
+
+    def get(self, key: Any) -> ReadCtx:
+        """Reference: src/map.rs ``Map::get`` — rm_clock covers the entry's
+        observed witnesses so a derived rm removes exactly the observed
+        updates."""
+        entry = self.entries.get(key)
+        return ReadCtx(
+            add_clock=self.clock.clone(),
+            rm_clock=_witness_clock(entry.dots) if entry is not None else VClock(),
+            val=entry.val.clone() if entry is not None else None,
+        )
+
+    def keys(self) -> FrozenSet[Any]:
+        return frozenset(self.entries)
+
+    # ---- op minting ----------------------------------------------------
+    def update(
+        self,
+        key: Any,
+        ctx: AddCtx,
+        f: Callable[[Any, AddCtx], Any],
+    ) -> Up:
+        """Mint an op applying ``f(current_or_default_child, ctx) ->
+        child_op`` at ``key``. Reference: src/map.rs ``Map::update``."""
+        entry = self.entries.get(key)
+        val = entry.val.clone() if entry is not None else self.val_default()
+        child_op = f(val, ctx)
+        return Up(dot=ctx.dot, key=key, op=child_op)
+
+    def rm(self, key: Any, ctx: RmCtx) -> MapRm:
+        """Reference: src/map.rs ``Map::rm``."""
+        return MapRm(clock=ctx.clock.clone(), keyset=(key,))
+
+    def rm_all(self, keys: Iterable[Any], ctx: RmCtx) -> MapRm:
+        return MapRm(clock=ctx.clock.clone(), keyset=tuple(keys))
+
+    # ---- CmRDT ---------------------------------------------------------
+    def apply(self, op) -> None:
+        if isinstance(op, Nop):
+            return
+        if isinstance(op, Up):
+            if self.clock.get(op.dot.actor) >= op.dot.counter:
+                return  # already observed this update
+            entry = self.entries.get(op.key)
+            if entry is None:
+                entry = _Entry(set(), self.val_default())
+                self.entries[op.key] = entry
+            entry.dots.add(op.dot)
+            entry.val.apply(op.op)
+            self.clock.apply(op.dot)
+            self._apply_deferred()
+        elif isinstance(op, MapRm):
+            self._apply_keyset_rm(op.keyset, op.clock)
+        else:
+            raise TypeError(f"not a Map op: {op!r}")
+
+    def _apply_keyset_rm(self, keyset: Iterable[Any], clock: VClock) -> None:
+        """Reference: src/map.rs ``apply_keyset_rm`` — drop the witnesses
+        the rm clock covers and prune the child to the survivors; defer if
+        the rm clock is ahead of our view."""
+        for key in keyset:
+            entry = self.entries.get(key)
+            if entry is not None:
+                entry.dots = {
+                    d for d in entry.dots if d.counter > clock.get(d.actor)
+                }
+                if not entry.dots:
+                    del self.entries[key]
+                else:
+                    entry.val.retain_witnesses(entry.dots)
+        if not clock <= self.clock:
+            self._defer_remove(clock, keyset)
+
+    def _defer_remove(self, clock: VClock, keys: Iterable[Any]) -> None:
+        self.deferred.setdefault(clock.clone(), set()).update(keys)
+
+    def _apply_deferred(self) -> None:
+        deferred = self.deferred
+        self.deferred = {}
+        for clock, keys in deferred.items():
+            self._apply_keyset_rm(keys, clock)
+
+    # ---- CvRDT ---------------------------------------------------------
+    def merge(self, other: "Map") -> None:
+        # Witness survival is the orswot dot rule: a dot survives iff the
+        # other side also witnesses it, or has never seen it at all.
+        for key in list(self.entries):
+            if key not in other.entries:
+                entry = self.entries[key]
+                entry.dots = {
+                    d
+                    for d in entry.dots
+                    if d.counter > other.clock.get(d.actor)
+                }
+                if not entry.dots:
+                    del self.entries[key]
+                else:
+                    entry.val.retain_witnesses(entry.dots)
+
+        for key, their_entry in other.entries.items():
+            our_entry = self.entries.get(key)
+            if our_entry is not None:
+                ours, theirs = our_entry.dots, their_entry.dots
+                survivors = (
+                    {
+                        d
+                        for d in ours
+                        if d in theirs or d.counter > other.clock.get(d.actor)
+                    }
+                    | {
+                        d
+                        for d in theirs
+                        if d in ours or d.counter > self.clock.get(d.actor)
+                    }
+                )
+                if not survivors:
+                    del self.entries[key]
+                else:
+                    our_entry.val.merge(their_entry.val)
+                    our_entry.dots = survivors
+                    our_entry.val.retain_witnesses(survivors)
+            else:
+                survivors = {
+                    d
+                    for d in their_entry.dots
+                    if d.counter > self.clock.get(d.actor)
+                }
+                if survivors:
+                    entry = _Entry(survivors, their_entry.val.clone())
+                    entry.val.retain_witnesses(survivors)
+                    self.entries[key] = entry
+
+        for clock, keys in other.deferred.items():
+            self._defer_remove(clock, keys)
+
+        self.clock.merge(other.clock)
+        self._apply_deferred()
+
+    # ---- ResetRemove (nested removal, SURVEY §4.3) ---------------------
+    def reset_remove(self, clock: VClock) -> None:
+        for key in list(self.entries):
+            entry = self.entries[key]
+            entry.dots = {
+                d for d in entry.dots if d.counter > clock.get(d.actor)
+            }
+            if not entry.dots:
+                del self.entries[key]
+            else:
+                entry.val.retain_witnesses(entry.dots)
+        deferred = self.deferred
+        self.deferred = {}
+        for rm_clock, keys in deferred.items():
+            rm_clock = rm_clock.clone()
+            rm_clock.reset_remove(clock)
+            if not rm_clock.is_empty():
+                self._defer_remove(rm_clock, keys)
+        self.clock.reset_remove(clock)
+
+    def retain_witnesses(self, alive: Set[Dot]) -> None:
+        """Causal-composition hook for a containing ``Map``: keep only
+        entries whose witness dots survive in ``alive``, recursing into
+        children."""
+        for key in list(self.entries):
+            entry = self.entries[key]
+            entry.dots &= alive
+            if not entry.dots:
+                del self.entries[key]
+            else:
+                entry.val.retain_witnesses(entry.dots)
+
+    # ---- plumbing ------------------------------------------------------
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Map)
+            and self.clock == other.clock
+            and self.entries == other.entries
+            and {k: frozenset(v) for k, v in self.deferred.items()}
+            == {k: frozenset(v) for k, v in other.deferred.items()}
+        )
+
+    def clone(self) -> "Map":
+        out = Map(self.val_default)
+        out.clock = self.clock.clone()
+        out.entries = {k: e.clone() for k, e in self.entries.items()}
+        out.deferred = {c.clone(): set(ks) for c, ks in self.deferred.items()}
+        return out
+
+    def __repr__(self) -> str:
+        return f"Map({dict(sorted(self.entries.items(), key=lambda kv: repr(kv[0])))!r})"

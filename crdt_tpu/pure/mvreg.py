@@ -1,0 +1,126 @@
+"""MV register — concurrent writes survive as siblings until resolved.
+
+Reference: src/mvreg.rs ``MVReg<V, A> { vals: Vec<Content { clock, val }>
+}``; ``write(val, AddCtx) -> Op::Put``; ``read() -> ReadCtx<Vec<V>>``;
+merge/apply discard dominated values, keep concurrent siblings (SURVEY.md
+§3 row 9, §4.4).
+
+Representation deviation (documented per SURVEY.md §0): contents are keyed
+by their *witness dot* (the AddCtx dot that minted the write) alongside
+the full write clock — the DotFun form from the delta-CRDT literature
+(Almeida et al., "Delta State Replicated Data Types", PAPERS.md). The
+observable semantics (dominance filtering, sibling survival) are the
+reference's; the witness dot is what lets a containing ``Map`` prune child
+state exactly against surviving birth dots (``retain_witnesses``), which
+keeps the composed merge a true lattice join.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+from ..ctx import AddCtx, ReadCtx
+from ..dot import Dot
+from ..traits import CmRDT, CvRDT, ResetRemove
+from ..vclock import VClock
+
+
+@dataclass(frozen=True)
+class Put:
+    """Reference: src/mvreg.rs ``Op::Put { clock, val }`` (+ witness dot)."""
+
+    dot: Dot
+    clock: VClock
+    val: Any
+
+
+class MVReg(CvRDT, CmRDT, ResetRemove):
+    __slots__ = ("vals",)
+
+    def __init__(self, vals: Dict[Dot, Tuple[VClock, Any]] = None):
+        # witness dot -> (write clock, value)
+        self.vals: Dict[Dot, Tuple[VClock, Any]] = dict(vals) if vals else {}
+
+    # ---- reads ---------------------------------------------------------
+    def read(self) -> ReadCtx:
+        """All concurrent values + the joined clock of their writes.
+
+        Reference: src/mvreg.rs ``MVReg::read``.
+        """
+        clock = self.clock()
+        return ReadCtx(
+            add_clock=clock,
+            rm_clock=clock.clone(),
+            val=[v for _, v in self.vals.values()],
+        )
+
+    def clock(self) -> VClock:
+        """Join of all content clocks. Reference: src/mvreg.rs clock."""
+        out = VClock()
+        for c, _ in self.vals.values():
+            out.merge(c)
+        return out
+
+    # ---- writes --------------------------------------------------------
+    def write(self, val: Any, ctx: AddCtx) -> Put:
+        """Mint the op writing ``val`` under the read context's clock.
+
+        Reference: src/mvreg.rs ``MVReg::write`` — the AddCtx clock already
+        contains the fresh dot, so the put dominates everything read.
+        """
+        return Put(dot=ctx.dot, clock=ctx.clock.clone(), val=val)
+
+    def apply(self, op: Put) -> None:
+        if op.clock.is_empty():
+            return
+        if any(c >= op.clock for c, _ in self.vals.values()):
+            return  # dominated or duplicate
+        self.vals = {
+            d: (c, v) for d, (c, v) in self.vals.items() if not c < op.clock
+        }
+        self.vals[op.dot] = (op.clock, op.val)
+
+    def merge(self, other: "MVReg") -> None:
+        keep_self = {
+            d: (c, v)
+            for d, (c, v) in self.vals.items()
+            if not any(c < oc for oc, _ in other.vals.values())
+        }
+        keep_other = {
+            d: (oc, ov)
+            for d, (oc, ov) in other.vals.items()
+            if not any(oc < c for c, _ in self.vals.values())
+        }
+        keep_self.update(keep_other)  # same dot => same content
+        self.vals = keep_self
+
+    def reset_remove(self, clock: VClock) -> None:
+        """Reference: src/mvreg.rs ``ResetRemove`` — forget contents whose
+        write is fully dominated by ``clock``."""
+        self.vals = {
+            d: (c, v) for d, (c, v) in self.vals.items() if not c <= clock
+        }
+
+    def retain_witnesses(self, alive) -> None:
+        """Causal-composition hook for ``Map``: keep only contents whose
+        witness dot is in the entry's surviving witness set."""
+        self.vals = {
+            d: (c, v) for d, (c, v) in self.vals.items() if d in alive
+        }
+
+    # ---- plumbing ------------------------------------------------------
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, MVReg):
+            return NotImplemented
+        return self.vals == other.vals
+
+    def __hash__(self):
+        return hash(frozenset((d, c) for d, (c, _) in self.vals.items()))
+
+    def clone(self) -> "MVReg":
+        return MVReg({d: (c.clone(), v) for d, (c, v) in self.vals.items()})
+
+    def __repr__(self) -> str:
+        inner = {d: v for d, (_, v) in self.vals.items()}
+        return f"MVReg({inner!r})"

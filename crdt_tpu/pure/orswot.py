@@ -1,0 +1,233 @@
+"""ORSWOT — Observed-Remove Set WithOut Tombstones. The flagship type.
+
+Reference: src/orswot.rs ``Orswot<M, A> { clock: VClock<A>, entries:
+BTreeMap<M, VClock<A>>, deferred: HashMap<VClock<A>, BTreeSet<M>> }``
+(SURVEY.md §3 row 10, §4.1–4.2). Merge rule: an entry survives iff its
+birth clock has dots unseen by the other replica's top clock, or it is
+present on both sides (then the birth clocks join the orswot way);
+tombstone-free because the top clock subsumes removal history. Removal ops
+whose clock is ahead of the local view are parked in ``deferred`` and
+replayed when the clock catches up.
+
+``crdt_tpu.models.orswot`` / ``crdt_tpu.ops.orswot`` carry the batched
+device form of this exact lattice.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, FrozenSet, Iterable, Tuple
+
+from ..ctx import AddCtx, ReadCtx, RmCtx
+from ..dot import Dot
+from ..traits import CmRDT, CvRDT, DotRange, ResetRemove
+from ..vclock import VClock
+
+
+@dataclass(frozen=True)
+class Add:
+    """Reference: src/orswot.rs ``Op::Add { dot, members }``."""
+
+    dot: Dot
+    members: Tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class Rm:
+    """Reference: src/orswot.rs ``Op::Rm { clock, members }``."""
+
+    clock: VClock
+    members: Tuple[Any, ...]
+
+
+class Orswot(CvRDT, CmRDT, ResetRemove):
+    __slots__ = ("clock", "entries", "deferred")
+
+    def __init__(self):
+        self.clock = VClock()
+        # member -> birth clock (the dots that added it, minus removed ones)
+        self.entries: Dict[Any, VClock] = {}
+        # rm clock -> members, for removes ahead of our causal view
+        self.deferred: Dict[VClock, set] = {}
+
+    # ---- reads ---------------------------------------------------------
+    def read(self) -> ReadCtx:
+        """Reference: src/orswot.rs ``Orswot::read``."""
+        return ReadCtx(
+            add_clock=self.clock.clone(),
+            rm_clock=self.clock.clone(),
+            val=frozenset(self.entries),
+        )
+
+    def contains(self, member: Any) -> ReadCtx:
+        """Reference: src/orswot.rs ``Orswot::contains`` — rm_clock is the
+        member's birth clock so a derived rm covers exactly the observed
+        adds."""
+        entry = self.entries.get(member)
+        return ReadCtx(
+            add_clock=self.clock.clone(),
+            rm_clock=entry.clone() if entry is not None else VClock(),
+            val=member in self.entries,
+        )
+
+    # ---- op minting (pure; reference returns the Op, caller applies) ---
+    def add(self, member: Any, ctx: AddCtx) -> Add:
+        """Reference: src/orswot.rs ``Orswot::add``."""
+        return Add(dot=ctx.dot, members=(member,))
+
+    def add_all(self, members: Iterable[Any], ctx: AddCtx) -> Add:
+        return Add(dot=ctx.dot, members=tuple(members))
+
+    def rm(self, member: Any, ctx: RmCtx) -> Rm:
+        """Reference: src/orswot.rs ``Orswot::rm``."""
+        return Rm(clock=ctx.clock.clone(), members=(member,))
+
+    def rm_all(self, members: Iterable[Any], ctx: RmCtx) -> Rm:
+        return Rm(clock=ctx.clock.clone(), members=tuple(members))
+
+    # ---- CmRDT ---------------------------------------------------------
+    def validate_op(self, op) -> None:
+        """Adds must carry the actor's next contiguous dot.
+
+        Reference: src/orswot.rs ``validate_op`` → DotRange (SURVEY §4.1).
+        """
+        if isinstance(op, Add):
+            seen = self.clock.get(op.dot.actor)
+            if op.dot.counter != seen + 1:
+                raise DotRange(op.dot.actor, op.dot.counter, seen + 1)
+
+    def apply(self, op) -> None:
+        if isinstance(op, Add):
+            if self.clock.get(op.dot.actor) >= op.dot.counter:
+                return  # already observed this dot
+            for member in op.members:
+                entry = self.entries.setdefault(member, VClock())
+                entry.apply(op.dot)
+            self.clock.apply(op.dot)
+            self._apply_deferred()
+        elif isinstance(op, Rm):
+            self._apply_rm(op.members, op.clock)
+        else:
+            raise TypeError(f"not an Orswot op: {op!r}")
+
+    def _apply_rm(self, members: Iterable[Any], clock: VClock) -> None:
+        """Reference: src/orswot.rs ``apply_rm`` — defer if the rm clock is
+        ahead of our view (covers adds we haven't seen), and remove the
+        dominated part of what we do have now."""
+        if not clock <= self.clock:
+            self._defer_remove(clock, members)
+        for member in members:
+            entry = self.entries.get(member)
+            if entry is not None:
+                entry.reset_remove(clock)
+                if entry.is_empty():
+                    del self.entries[member]
+
+    def _defer_remove(self, clock: VClock, members: Iterable[Any]) -> None:
+        key = clock.clone()
+        self.deferred.setdefault(key, set()).update(members)
+
+    def _apply_deferred(self) -> None:
+        """Reference: src/orswot.rs ``apply_deferred`` — replay parked
+        removes; still-ahead ones re-defer themselves."""
+        deferred = self.deferred
+        self.deferred = {}
+        for clock, members in deferred.items():
+            self._apply_rm(members, clock)
+
+    # ---- CvRDT (THE hot loop — SURVEY §4.2) ----------------------------
+    def merge(self, other: "Orswot") -> None:
+        # Entries we have and they don't: they either removed them (birth
+        # clock dominated by their top) or never saw them (keep the unseen
+        # dots only).
+        for member in list(self.entries):
+            if member not in other.entries:
+                clock = self.entries[member]
+                if clock <= other.clock:
+                    del self.entries[member]
+                else:
+                    clock.reset_remove(other.clock)
+
+        for member, their_clock in other.entries.items():
+            our_clock = self.entries.get(member)
+            if our_clock is not None:
+                # Present on both sides: keep common dots plus each side's
+                # dots the other side has never seen.
+                common = their_clock.glb(our_clock)
+                common.merge(their_clock.clone_without(self.clock))
+                common.merge(our_clock.clone_without(other.clock))
+                if common.is_empty():
+                    del self.entries[member]
+                else:
+                    self.entries[member] = common
+            else:
+                if their_clock <= self.clock:
+                    pass  # we observed those adds and removed the member
+                else:
+                    kept = their_clock.clone_without(self.clock)
+                    self.entries[member] = kept
+
+        for clock, members in other.deferred.items():
+            self._defer_remove(clock, members)
+
+        self.clock.merge(other.clock)
+        self._apply_deferred()
+
+    # ---- ResetRemove ---------------------------------------------------
+    def reset_remove(self, clock: VClock) -> None:
+        """Reference: src/orswot.rs ``ResetRemove`` impl."""
+        for member in list(self.entries):
+            entry = self.entries[member]
+            entry.reset_remove(clock)
+            if entry.is_empty():
+                del self.entries[member]
+        deferred = self.deferred
+        self.deferred = {}
+        for rm_clock, members in deferred.items():
+            rm_clock = rm_clock.clone()
+            rm_clock.reset_remove(clock)
+            if not rm_clock.is_empty():
+                self._defer_remove(rm_clock, members)
+        self.clock.reset_remove(clock)
+
+    def retain_witnesses(self, alive) -> None:
+        """Causal-composition hook for a containing ``Map``: keep only
+        member birth dots present in the ``alive`` witness set. Observed
+        knowledge (the top clock) is retained — every dot it covers was
+        genuinely routed through the containing map."""
+        for member in list(self.entries):
+            entry = self.entries[member]
+            entry.dots = {
+                a: c for a, c in entry.dots.items() if Dot(a, c) in alive
+            }
+            if entry.is_empty():
+                del self.entries[member]
+
+    # ---- plumbing ------------------------------------------------------
+    def members(self) -> FrozenSet[Any]:
+        return frozenset(self.entries)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Orswot)
+            and self.clock == other.clock
+            and self.entries == other.entries
+            and {k: frozenset(v) for k, v in self.deferred.items()}
+            == {k: frozenset(v) for k, v in other.deferred.items()}
+        )
+
+    def __hash__(self):
+        return hash((self.clock, frozenset(self.entries)))
+
+    def clone(self) -> "Orswot":
+        out = Orswot()
+        out.clock = self.clock.clone()
+        out.entries = {m: c.clone() for m, c in self.entries.items()}
+        out.deferred = {c.clone(): set(ms) for c, ms in self.deferred.items()}
+        return out
+
+    def __repr__(self) -> str:
+        return f"Orswot({sorted(map(repr, self.entries))}, clock={self.clock!r})"

@@ -1,0 +1,112 @@
+"""Trait contracts — the two replication disciplines + causal removal.
+
+Reference: src/traits.rs — ``CvRDT``, ``CmRDT``, ``Causal``/``ResetRemove``,
+and the v7-era ``Validation`` associated types with ``validate_merge`` /
+``validate_op`` (SURVEY.md §2 L0; mount empty, symbols per SURVEY.md §0).
+
+Both trait-name vintages are provided (``Causal`` is an alias of
+``ResetRemove``; ``forget`` is an alias of ``reset_remove``) because the
+fork's exact era is unknown — SURVEY.md §0 says to implement the union.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Generic, TypeVar
+
+Op = TypeVar("Op")
+
+
+class ValidationError(Exception):
+    """Base class for pre-merge / pre-apply validation failures.
+
+    Reference: src/traits.rs associated ``type Validation`` error carriers.
+    """
+
+
+class DotRange(ValidationError):
+    """A dot is non-contiguous with the clock it is applied against.
+
+    Reference: src/dot.rs ``DotRange`` — raised by ``validate_op`` when an
+    op's dot duplicates (counter <= seen) or gaps (counter > seen + 1) the
+    local per-actor counter.
+    """
+
+    def __init__(self, actor: Any, counter: int, next_counter: int):
+        self.actor = actor
+        self.counter = counter
+        self.next_counter = next_counter
+        super().__init__(
+            f"dot ({actor!r}, {counter}) is out of range: next expected "
+            f"counter for this actor is {next_counter}"
+        )
+
+
+class ConflictingMarker(ValidationError):
+    """LWW merge saw equal markers guarding different values.
+
+    Reference: src/lwwreg.rs ``validate_merge`` conflicting-marker error
+    [LOW-CONF name per SURVEY.md §3 row 8].
+    """
+
+
+class CvRDT(abc.ABC):
+    """State-based (convergent) CRDT: ``merge`` is a join-semilattice op.
+
+    Reference: src/traits.rs ``trait CvRDT { fn merge(&mut self, Self) }``.
+    ``merge`` must be commutative, associative, and idempotent — property
+    tests in tests/ assert all three for every type.
+    """
+
+    @abc.abstractmethod
+    def merge(self, other: "CvRDT") -> None:
+        """Join ``other``'s state into ``self`` (in place)."""
+
+    def validate_merge(self, other: "CvRDT") -> None:
+        """Raise ``ValidationError`` if merging ``other`` would be unsound.
+
+        Default: always valid. Reference: src/traits.rs ``validate_merge``
+        (v7).
+        """
+
+
+class CmRDT(abc.ABC, Generic[Op]):
+    """Op-based (commutative) CRDT: ``apply`` commutes for concurrent ops.
+
+    Reference: src/traits.rs ``trait CmRDT { type Op; fn apply(&mut self,
+    Self::Op) }``. Causal delivery is assumed for dependent ops; ``apply``
+    must be idempotent for duplicated ops wherever the reference's is
+    (e.g. Orswot drops already-seen dots).
+    """
+
+    @abc.abstractmethod
+    def apply(self, op: Op) -> None:
+        """Apply a (possibly remote) op to local state (in place)."""
+
+    def validate_op(self, op: Op) -> None:
+        """Raise ``ValidationError`` if ``op`` cannot be applied soundly.
+
+        Default: always valid. Reference: src/traits.rs ``validate_op`` (v7).
+        """
+
+
+class ResetRemove(abc.ABC):
+    """Causal removal: forget all dots dominated by ``clock``.
+
+    Reference: src/traits.rs — v7 ``trait ResetRemove<A> { fn
+    reset_remove(&mut self, &VClock<A>) }``; v4–v6 spelled ``Causal`` /
+    ``forget``. Used by Map removal to reset children under the removed
+    clock (SURVEY.md §4.3).
+    """
+
+    @abc.abstractmethod
+    def reset_remove(self, clock) -> None:
+        """Remove any state dominated by ``clock`` (in place)."""
+
+    def forget(self, clock) -> None:
+        """v4–v6 era alias of ``reset_remove``."""
+        self.reset_remove(clock)
+
+
+# v4–v6 era name for the same contract.
+Causal = ResetRemove

@@ -1,0 +1,168 @@
+"""VClock — the causality engine (actor → counter map, partial order).
+
+Reference: src/vclock.rs ``VClock<A: Ord> { dots: BTreeMap<A, u64> }`` with
+``inc`` / ``get`` / ``apply(Dot)`` / ``merge`` / ``partial_cmp`` (None =
+concurrent) / ``glb``/``intersection`` / ``forget``/``reset_remove`` /
+``clone_without`` (SURVEY.md §3 row 2; mount empty, symbols per §0).
+
+This is the sequential oracle form (a dict). The batched device form of the
+same lattice (``crdt_tpu.ops.vclock``) makes merge an element-wise max and
+compare a sign analysis of the difference, bit-identical to this
+implementation under the property suite in tests/.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+from .dot import Dot
+from .traits import CmRDT, CvRDT, ResetRemove
+
+
+class VClock(CvRDT, CmRDT, ResetRemove):
+    """Vector clock: a map of actor → max counter observed for that actor.
+
+    An absent actor is equivalent to counter 0 (never stored — the invariant
+    matches the reference, which never stores zero counters, so equality is
+    plain dict equality).
+    """
+
+    __slots__ = ("dots",)
+
+    def __init__(self, dots: Optional[Dict[Any, int]] = None):
+        self.dots: Dict[Any, int] = {}
+        if dots:
+            for actor, counter in dots.items():
+                if counter < 0:
+                    raise ValueError(f"negative counter for {actor!r}")
+                if counter > 0:
+                    self.dots[actor] = counter
+
+    # ---- reads ---------------------------------------------------------
+    def get(self, actor: Any) -> int:
+        """Max counter observed for ``actor`` (0 if never seen).
+
+        Reference: src/vclock.rs ``VClock::get``.
+        """
+        return self.dots.get(actor, 0)
+
+    def dot(self, actor: Any) -> Dot:
+        """The latest dot observed for ``actor``.
+
+        Reference: src/vclock.rs ``VClock::dot``.
+        """
+        return Dot(actor, self.get(actor))
+
+    def is_empty(self) -> bool:
+        return not self.dots
+
+    def __iter__(self) -> Iterator[Dot]:
+        """Iterate observed dots. Reference: src/vclock.rs ``VClock::iter``."""
+        return (Dot(a, c) for a, c in self.dots.items())
+
+    def __len__(self) -> int:
+        return len(self.dots)
+
+    # ---- mutation ------------------------------------------------------
+    def inc(self, actor: Any) -> Dot:
+        """Return (without applying) the next dot for ``actor``.
+
+        Reference: src/vclock.rs ``VClock::inc`` — pure; the caller applies
+        the returned dot (the op) via ``apply``.
+        """
+        return self.dot(actor).inc()
+
+    def apply(self, op: Dot) -> None:
+        """Observe a dot; monotone (ignores stale counters).
+
+        Reference: src/vclock.rs ``impl CmRDT for VClock`` (Op = Dot).
+        """
+        if op.counter > self.get(op.actor):
+            self.dots[op.actor] = op.counter
+
+    def merge(self, other: "VClock") -> None:
+        """Join: element-wise max. Reference: src/vclock.rs CvRDT::merge."""
+        for actor, counter in other.dots.items():
+            if counter > self.get(actor):
+                self.dots[actor] = counter
+
+    def reset_remove(self, clock: "VClock") -> None:
+        """Forget dots dominated by ``clock``: drop actor a iff
+        self[a] <= clock[a].
+
+        Reference: src/vclock.rs ``ResetRemove``/``forget``.
+        """
+        for actor in list(self.dots):
+            if clock.get(actor) >= self.dots[actor]:
+                del self.dots[actor]
+
+    # ---- lattice / order ----------------------------------------------
+    def partial_cmp(self, other: "VClock") -> Optional[int]:
+        """-1 if self < other, 0 if equal, 1 if self > other, None if
+        concurrent. Reference: src/vclock.rs ``PartialOrd::partial_cmp``.
+        """
+        if self.dots == other.dots:
+            return 0
+        le = all(c <= other.get(a) for a, c in self.dots.items())
+        ge = all(c <= self.get(a) for a, c in other.dots.items())
+        if le and not ge:
+            return -1
+        if ge and not le:
+            return 1
+        if le and ge:
+            return 0
+        return None
+
+    def __le__(self, other: "VClock") -> bool:
+        return all(c <= other.get(a) for a, c in self.dots.items())
+
+    def __lt__(self, other: "VClock") -> bool:
+        return self <= other and self.dots != other.dots
+
+    def __ge__(self, other: "VClock") -> bool:
+        return other <= self
+
+    def __gt__(self, other: "VClock") -> bool:
+        return other < self
+
+    def concurrent(self, other: "VClock") -> bool:
+        return self.partial_cmp(other) is None
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, VClock) and self.dots == other.dots
+
+    def __hash__(self) -> int:
+        # VClocks key the deferred-removal maps (Orswot/Map), mirroring the
+        # reference's HashMap<VClock, _>; dots never mutate while used as a
+        # key there because we hash a frozen snapshot.
+        return hash(frozenset(self.dots.items()))
+
+    def glb(self, other: "VClock") -> "VClock":
+        """Greatest lower bound: element-wise min (absent = 0 drops out).
+
+        Reference: src/vclock.rs ``VClock::glb``/``intersection``.
+        """
+        out = {}
+        for actor, counter in self.dots.items():
+            m = min(counter, other.get(actor))
+            if m > 0:
+                out[actor] = m
+        return VClock(out)
+
+    intersection = glb
+
+    def clone_without(self, base: "VClock") -> "VClock":
+        """Clone keeping only dots NOT dominated by ``base``
+        (self[a] > base[a]). Reference: src/vclock.rs ``clone_without``
+        [LOW-CONF name per SURVEY §3 row 2].
+        """
+        return VClock(
+            {a: c for a, c in self.dots.items() if c > base.get(a)}
+        )
+
+    def clone(self) -> "VClock":
+        return VClock(dict(self.dots))
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{a!r}:{c}" for a, c in sorted(self.dots.items(), key=lambda kv: repr(kv[0])))
+        return f"VClock<{inner}>"

@@ -1,0 +1,20 @@
+"""Test config: force JAX onto CPU with 8 virtual devices so the multi-chip
+sharding paths (crdt_tpu.parallel) compile and run without TPU hardware.
+
+Must run before the first `import jax` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+from hypothesis import settings
+
+# One CPU core in CI: keep example counts modest by default.
+settings.register_profile("ci", max_examples=40, deadline=None)
+settings.load_profile("ci")

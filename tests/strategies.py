@@ -1,0 +1,86 @@
+"""Hypothesis strategies + multi-replica simulation helpers.
+
+Plays the role of the reference's quickcheck ``Arbitrary`` instances and
+in-process replica simulation (SURVEY.md §5): replicas are N values in a
+list, "the network" is a shuffled op list; per-actor op order is preserved
+(causal delivery of each actor's own ops), cross-actor interleaving is
+random.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, List, Sequence, Tuple
+
+from hypothesis import strategies as st
+
+ACTORS = [0, 1, 2, 3]
+
+actors = st.sampled_from(ACTORS)
+members = st.integers(min_value=0, max_value=7)
+seeds = st.integers(min_value=0, max_value=2**32 - 1)
+
+
+def interleave(rng: random.Random, queues: Sequence[Sequence[Any]]) -> List[Any]:
+    """Random merge of sequences, preserving each sequence's inner order."""
+    queues = [list(q) for q in queues if q]
+    out = []
+    while queues:
+        i = rng.randrange(len(queues))
+        out.append(queues[i].pop(0))
+        if not queues[i]:
+            queues.pop(i)
+    return out
+
+
+def converge_cmrdt(
+    fresh: Callable[[], Any],
+    per_actor_ops: Sequence[Sequence[Any]],
+    seed: int,
+    n_replicas: int = 3,
+) -> List[Any]:
+    """Deliver every actor's op stream to every replica, each with its own
+    random cross-actor interleaving (per-actor order preserved). Returns
+    the replicas; the caller asserts they are all equal."""
+    rng = random.Random(seed)
+    replicas = [fresh() for _ in range(n_replicas)]
+    for replica in replicas:
+        for op in interleave(rng, per_actor_ops):
+            replica.apply(op)
+    return replicas
+
+
+def converge_cvrdt(states: Sequence[Any], seed: int) -> List[Any]:
+    """Full state exchange: every replica merges every state (including a
+    self-merge) in its own random order. Returns the merged replicas."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(len(states)):
+        mine = states[i].clone()
+        order = list(range(len(states)))
+        rng.shuffle(order)
+        for j in order:
+            mine.merge(states[j].clone())
+        out.append(mine)
+    return out
+
+
+def assert_all_equal(replicas: Sequence[Any]) -> None:
+    first = replicas[0]
+    for other in replicas[1:]:
+        assert other == first, f"diverged:\n  {first!r}\n  {other!r}"
+
+
+def assert_cvrdt_laws(a: Any, b: Any, c: Any) -> None:
+    """Commutativity, associativity, idempotence of merge."""
+    ab = a.clone(); ab.merge(b.clone())
+    ba = b.clone(); ba.merge(a.clone())
+    assert ab == ba, f"merge not commutative:\n  {ab!r}\n  {ba!r}"
+
+    ab_c = ab.clone(); ab_c.merge(c.clone())
+    bc = b.clone(); bc.merge(c.clone())
+    a_bc = a.clone(); a_bc.merge(bc)
+    assert ab_c == a_bc, f"merge not associative:\n  {ab_c!r}\n  {a_bc!r}"
+
+    aa = a.clone(); aa.merge(a.clone())
+    assert aa == a, f"merge not idempotent:\n  {aa!r}\n  {a!r}"

@@ -1,0 +1,80 @@
+"""GCounter / PNCounter tests (reference: src/gcounter.rs, src/pncounter.rs)."""
+
+import random
+
+from hypothesis import given
+from hypothesis import strategies as st
+
+from crdt_tpu import Dir, GCounter, PNCounter
+
+from strategies import ACTORS, assert_all_equal, assert_cvrdt_laws, seeds
+
+
+def test_gcounter_basic():
+    c = GCounter()
+    op = c.inc("a")
+    c.apply(op)
+    c.apply(c.inc("a"))
+    c.apply(c.inc("b"))
+    assert c.read() == 3
+
+
+def test_gcounter_apply_idempotent():
+    c = GCounter()
+    op = c.inc("a")
+    c.apply(op)
+    c.apply(op)  # duplicate delivery
+    assert c.read() == 1
+
+
+def test_gcounter_inc_many():
+    c = GCounter()
+    c.apply(c.inc_many("a", 10_000))
+    assert c.read() == 10_000
+
+
+def test_pncounter_basic():
+    c = PNCounter()
+    c.apply(c.inc("a"))
+    c.apply(c.inc("a"))
+    c.apply(c.dec("b"))
+    assert c.read() == 1
+    op = c.dec("a")
+    assert op.dir is Dir.NEG
+    c.apply(op)
+    assert c.read() == 0
+
+
+def _random_counter(rng, cls):
+    c = cls()
+    for _ in range(rng.randrange(8)):
+        actor = rng.choice(ACTORS)
+        if cls is PNCounter and rng.random() < 0.4:
+            c.apply(c.dec(actor))
+        else:
+            c.apply(c.inc(actor))
+    return c
+
+
+@given(seeds)
+def test_counter_merge_laws(seed):
+    rng = random.Random(seed)
+    for cls in (GCounter, PNCounter):
+        a, b, c = (_random_counter(rng, cls) for _ in range(3))
+        assert_cvrdt_laws(a, b, c)
+
+
+@given(seeds, st.integers(1, 4))
+def test_counter_convergence(seed, n):
+    rng = random.Random(seed)
+    replicas = [_random_counter(rng, PNCounter) for _ in range(n)]
+    total = sum(r.read() for r in replicas)  # actor-disjointness not assumed
+    merged = []
+    for i in range(n):
+        m = replicas[i].clone()
+        order = list(range(n))
+        rng.shuffle(order)
+        for j in order:
+            m.merge(replicas[j])
+        merged.append(m)
+    assert_all_equal(merged)

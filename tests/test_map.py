@@ -1,0 +1,194 @@
+"""Map (CRDT of CRDTs) tests (reference: src/map.rs + tests/map.rs,
+SURVEY.md §4.3) — nested-op routing, deferred removes, reset_remove."""
+
+import random
+
+from hypothesis import given
+
+from crdt_tpu import Map, MVReg, Orswot, VClock
+
+from strategies import ACTORS, assert_all_equal, assert_cvrdt_laws, seeds
+
+
+def mv_map():
+    return Map(val_default=MVReg)
+
+
+def set_map():
+    return Map(val_default=Orswot)
+
+
+def nested_map():
+    return Map(val_default=lambda: Map(val_default=MVReg))
+
+
+def put(m, actor, key, val):
+    ctx = m.len().derive_add_ctx(actor)
+    op = m.update(key, ctx, lambda reg, c: reg.write(val, c))
+    m.apply(op)
+    return op
+
+
+def sadd(m, actor, key, member):
+    ctx = m.len().derive_add_ctx(actor)
+    op = m.update(key, ctx, lambda s, c: s.add(member, c))
+    m.apply(op)
+    return op
+
+
+def drop(m, key):
+    op = m.rm(key, m.get(key).derive_rm_ctx())
+    m.apply(op)
+    return op
+
+
+def test_update_and_get():
+    m = mv_map()
+    put(m, "a", "k", 1)
+    assert m.get("k").val.read().val == [1]
+    assert m.len().val == 1
+    assert m.get("missing").val is None
+
+
+def test_rm_removes_key():
+    m = mv_map()
+    put(m, "a", "k", 1)
+    drop(m, "k")
+    assert m.len().val == 0 and m.get("k").val is None
+    # top clock retains history (tombstone-free removal)
+    assert m.clock == VClock({"a": 1})
+
+
+def test_concurrent_update_wins_over_remove():
+    a, b = mv_map(), mv_map()
+    op = put(a, "A", "k", 1)
+    b.apply(op)
+    drop(a, "k")            # A removes the key
+    put(b, "B", "k", 2)     # B concurrently updates it
+    a.merge(b.clone())
+    b.merge(a.clone())
+    assert a.get("k").val is not None
+    assert a.get("k").val.read().val == [2]  # only B's unseen write survives
+    assert a == b
+
+
+def test_remove_resets_child_under_removed_clock():
+    # Key removed on one side, re-added with new child state on the other:
+    # merged child must not resurrect the deleted portion (SURVEY §7.3
+    # "Map's reset_remove recursion").
+    a, b = set_map(), set_map()
+    op = sadd(a, "A", "k", "old")
+    b.apply(op)
+    drop(b, "k")                 # b saw the add and removed the key
+    sadd(b, "B", "k", "new")     # then re-created it
+    a.merge(b.clone())
+    b.merge(a.clone())
+    assert a == b
+    child = a.get("k").val
+    assert child.members() == frozenset({"new"})
+
+
+def test_same_actor_partial_remove_no_resurrection():
+    # Witness (A,1) removed while (A,2) lives: per-actor-max clocks cannot
+    # express this — the dot-set witness representation must. The child
+    # state born at (A,1) has to stay dead even though actor A later
+    # updated the same key.
+    m = set_map()
+    sadd(m, "A", "k", "old")                       # witness (A,1)
+    rm_op = m.rm("k", m.get("k").derive_rm_ctx())  # observes only (A,1)
+    sadd(m, "A", "k", "new")                       # witness (A,2)
+    m.apply(rm_op)
+    assert m.get("k").val.members() == frozenset({"new"})
+    # and via merge with a replica that saw only the first add:
+    stale = set_map()
+    # replay: stale replica got the (A,1) add op only
+    m2 = set_map()
+    op1 = sadd(m2, "A", "k", "old")
+    stale.apply(op1)
+    m.merge(stale)
+    assert m.get("k").val.members() == frozenset({"new"})
+
+
+def test_deferred_keyset_rm():
+    a, b = mv_map(), mv_map()
+    up = put(a, "A", "k", 1)
+    rm_op = a.rm("k", a.get("k").derive_rm_ctx())
+    a.apply(rm_op)
+    b.apply(rm_op)  # remove arrives before the update: deferred
+    assert b.deferred
+    b.apply(up)     # update lands; deferred remove replays
+    assert b.get("k").val is None
+    assert not b.deferred
+    assert a == b
+
+
+def test_nested_map_of_map():
+    m = nested_map()
+    ctx = m.len().derive_add_ctx("a")
+    op = m.update(
+        "outer",
+        ctx,
+        lambda inner, c: inner.update("inner", c, lambda reg, c2: reg.write(7, c2)),
+    )
+    m.apply(op)
+    inner = m.get("outer").val
+    assert inner.get("inner").val.read().val == [7]
+
+
+def _site_run(rng, factory, n_cmds=10):
+    sites = {a: factory() for a in ACTORS[:3]}
+    for _ in range(n_cmds):
+        actor = rng.choice(list(sites))
+        site = sites[actor]
+        roll = rng.random()
+        key = rng.choice("pq")
+        if roll < 0.45:
+            put(site, actor, key, rng.randrange(5))
+        elif roll < 0.7:
+            drop(site, key)
+        else:
+            site.merge(sites[rng.choice(list(sites))].clone())
+    return list(sites.values())
+
+
+@given(seeds)
+def test_map_merge_laws_and_convergence(seed):
+    rng = random.Random(seed)
+    states = _site_run(rng, mv_map)
+    assert_cvrdt_laws(states[0], states[1], states[2])
+    merged = []
+    for i in range(3):
+        m = states[i].clone()
+        order = list(range(3))
+        rng.shuffle(order)
+        for j in order:
+            m.merge(states[j].clone())
+        merged.append(m)
+    assert_all_equal(merged)
+
+
+@given(seeds)
+def test_orswot_map_convergence(seed):
+    rng = random.Random(seed)
+    sites = {a: set_map() for a in ACTORS[:3]}
+    for _ in range(10):
+        actor = rng.choice(list(sites))
+        site = sites[actor]
+        roll = rng.random()
+        key = rng.choice("pq")
+        if roll < 0.5:
+            sadd(site, actor, key, rng.randrange(4))
+        elif roll < 0.7:
+            drop(site, key)
+        else:
+            site.merge(sites[rng.choice(list(sites))].clone())
+    states = list(sites.values())
+    merged = []
+    for i in range(3):
+        m = states[i].clone()
+        order = list(range(3))
+        rng.shuffle(order)
+        for j in order:
+            m.merge(states[j].clone())
+        merged.append(m)
+    assert_all_equal(merged)

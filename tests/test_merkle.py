@@ -1,0 +1,83 @@
+"""MerkleReg tests (reference: src/merkle_reg.rs)."""
+
+import random
+
+from hypothesis import given
+
+from crdt_tpu import MerkleReg
+
+from strategies import assert_all_equal, assert_cvrdt_laws, seeds
+
+
+def test_write_read():
+    r = MerkleReg()
+    n1 = r.write("v1")
+    r.apply(n1)
+    assert r.read().values() == ["v1"]
+    n2 = r.write("v2", parents=r.read().hashes())
+    r.apply(n2)
+    assert r.read().values() == ["v2"]
+    assert r.num_nodes() == 2
+
+
+def test_concurrent_writes_are_siblings():
+    a, b = MerkleReg(), MerkleReg()
+    na = a.write("a")
+    nb = b.write("b")
+    a.apply(na)
+    b.apply(nb)
+    a.merge(b)
+    assert sorted(a.read().values()) == ["a", "b"]
+    # A child of both leaves resolves the fork.
+    nc = a.write("c", parents=a.read().hashes())
+    a.apply(nc)
+    assert a.read().values() == ["c"]
+
+
+def test_orphans_wait_for_parents():
+    a = MerkleReg()
+    n1 = a.write("v1")
+    a.apply(n1)
+    n2 = a.write("v2", parents={n1.hash()})
+    b = MerkleReg()
+    b.apply(n2)  # parent missing: orphaned
+    assert b.read().is_empty()
+    assert b.num_orphans() == 1
+    b.apply(n1)  # parent arrives: orphan spliced in
+    assert b.read().values() == ["v2"]
+    assert b.num_orphans() == 0
+
+
+def test_parents_children():
+    r = MerkleReg()
+    n1 = r.write("v1")
+    r.apply(n1)
+    n2 = r.write("v2", parents={n1.hash()})
+    r.apply(n2)
+    assert r.parents(n2.hash()).values() == ["v1"]
+    assert r.children(n1.hash()).values() == ["v2"]
+
+
+def _random_reg(rng):
+    r = MerkleReg()
+    for i in range(rng.randrange(1, 6)):
+        if rng.random() < 0.6:
+            node = r.write(rng.randrange(20), parents=r.read().hashes())
+        else:
+            node = r.write(rng.randrange(20))
+        r.apply(node)
+    return r
+
+
+@given(seeds)
+def test_merkle_laws_and_convergence(seed):
+    rng = random.Random(seed)
+    a, b, c = _random_reg(rng), _random_reg(rng), _random_reg(rng)
+    assert_cvrdt_laws(a, b, c)
+    merged = []
+    for base in (a, b, c):
+        m = base.clone()
+        for other in (c, a, b):
+            m.merge(other)
+        merged.append(m)
+    assert_all_equal(merged)

@@ -1,0 +1,182 @@
+"""ORSWOT unit + property tests (reference: src/orswot.rs tests +
+tests/orswot.rs quickcheck suite, SURVEY.md §5)."""
+
+import random
+
+import pytest
+from hypothesis import given
+from hypothesis import strategies as st
+
+from crdt_tpu import Dot, Orswot, VClock
+from crdt_tpu.pure.orswot import Add, Rm
+from crdt_tpu.traits import DotRange
+
+from strategies import (
+    ACTORS,
+    assert_all_equal,
+    assert_cvrdt_laws,
+    converge_cmrdt,
+    interleave,
+    seeds,
+)
+
+
+def add(s, actor, member):
+    op = s.add(member, s.read().derive_add_ctx(actor))
+    s.apply(op)
+    return op
+
+
+def rm(s, actor, member):
+    op = s.rm(member, s.contains(member).derive_rm_ctx())
+    s.apply(op)
+    return op
+
+
+def test_add_then_contains():
+    s = Orswot()
+    add(s, "a", "apple")
+    assert s.contains("apple").val
+    assert s.members() == frozenset({"apple"})
+
+
+def test_rm_removes():
+    s = Orswot()
+    add(s, "a", "apple")
+    rm(s, "a", "apple")
+    assert s.members() == frozenset()
+    assert not s.entries and s.clock == VClock({"a": 1})
+
+
+def test_add_wins_over_concurrent_remove():
+    # The canonical ORSWOT scenario (SURVEY.md §5): replica A removes while
+    # replica B concurrently re-adds; the add survives the merge.
+    a, b = Orswot(), Orswot()
+    op = add(a, "A", "x")
+    b.apply(op)  # both see the add
+    rm(a, "A", "x")          # A removes observed add
+    add(b, "B", "x")         # B concurrently adds again
+    a_, b_ = a.clone(), b.clone()
+    a_.merge(b_)
+    b2 = b.clone()
+    b2.merge(a.clone())
+    assert a_.members() == frozenset({"x"})
+    assert b2.members() == frozenset({"x"})
+    assert a_ == b2
+
+
+def test_remove_covers_only_observed_adds():
+    # A remove derived before seeing a concurrent add must not kill it.
+    a, b = Orswot(), Orswot()
+    add(b, "B", "x")
+    rm_op = a.rm("x", a.contains("x").derive_rm_ctx())  # x not observed: empty clock
+    a.apply(rm_op)
+    b.apply(rm_op)
+    assert b.members() == frozenset({"x"})
+
+
+def test_deferred_remove_replays_when_clock_catches_up():
+    a, b = Orswot(), Orswot()
+    add_op = add(a, "A", "x")
+    # b receives the REMOVE (derived from a's observed add) before the add.
+    rm_op = a.rm("x", a.contains("x").derive_rm_ctx())
+    a.apply(rm_op)
+    b.apply(rm_op)  # clock ahead of b's view → deferred
+    assert b.deferred
+    b.apply(add_op)  # add arrives; deferred remove replays
+    assert b.members() == frozenset()
+    assert not b.deferred
+    assert a.clock == b.clock
+
+
+def test_duplicate_add_op_is_idempotent():
+    s = Orswot()
+    op = add(s, "a", "x")
+    s.apply(op)
+    s.apply(op)
+    assert s.entries["x"] == VClock({"a": 1})
+
+
+def test_validate_op_dotrange():
+    s = Orswot()
+    add(s, "a", "x")
+    with pytest.raises(DotRange):
+        s.validate_op(Add(dot=Dot("a", 3), members=("y",)))  # gap
+    with pytest.raises(DotRange):
+        s.validate_op(Add(dot=Dot("a", 1), members=("y",)))  # dup
+    s.validate_op(Add(dot=Dot("a", 2), members=("y",)))  # contiguous: ok
+
+
+def test_reset_remove_forgets_dominated_state():
+    s = Orswot()
+    add(s, "a", "x")
+    add(s, "b", "y")
+    s.reset_remove(VClock({"a": 1}))
+    assert s.members() == frozenset({"y"})
+    assert s.clock == VClock({"b": 1})
+    # forget() is the v4–v6 era alias
+    s.forget(VClock({"b": 1}))
+    assert s.members() == frozenset() and s.clock == VClock()
+
+
+# ---- property tests ----------------------------------------------------
+def _site_run(rng, n_actors=3, n_cmds=12):
+    """Each actor mints ops at its own site; sites occasionally sync via
+    state merge so later rm-clocks cover other actors' dots (exercising the
+    deferred path on op delivery)."""
+    sites = {a: Orswot() for a in ACTORS[:n_actors]}
+    streams = {a: [] for a in sites}
+    for _ in range(n_cmds):
+        actor = rng.choice(list(sites))
+        site = sites[actor]
+        roll = rng.random()
+        if roll < 0.5:
+            streams[actor].append(add(site, actor, rng.randrange(6)))
+        elif roll < 0.8:
+            streams[actor].append(rm(site, actor, rng.randrange(6)))
+        else:
+            other = rng.choice(list(sites))
+            site.merge(sites[other].clone())
+    return sites, list(streams.values())
+
+
+@given(seeds)
+def test_op_convergence_random_interleavings(seed):
+    rng = random.Random(seed)
+    _, streams = _site_run(rng)
+    replicas = converge_cmrdt(Orswot, streams, rng.randrange(2**31), n_replicas=3)
+    assert_all_equal(replicas)
+
+
+@given(seeds)
+def test_state_convergence_and_laws(seed):
+    rng = random.Random(seed)
+    sites, _ = _site_run(rng)
+    states = list(sites.values())
+    assert_cvrdt_laws(states[0], states[1], states[2])
+    merged = []
+    for i in range(len(states)):
+        m = states[i].clone()
+        order = list(range(len(states)))
+        rng.shuffle(order)
+        for j in order:
+            m.merge(states[j].clone())
+        merged.append(m)
+    assert_all_equal(merged)
+
+
+@given(seeds)
+def test_ops_and_state_merge_agree(seed):
+    # Delivering every op and merging every state must agree on membership.
+    rng = random.Random(seed)
+    sites, streams = _site_run(rng)
+    op_replica = Orswot()
+    for op in interleave(rng, streams):
+        op_replica.apply(op)
+    state_replica = Orswot()
+    for site in sites.values():
+        state_replica.merge(site.clone())
+    op_replica.merge(state_replica.clone())
+    state2 = state_replica.clone()
+    state2.merge(op_replica.clone())
+    assert op_replica == state2

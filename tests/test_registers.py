@@ -1,0 +1,130 @@
+"""GSet / LWWReg / MVReg tests (reference: src/gset.rs, src/lwwreg.rs,
+src/mvreg.rs)."""
+
+import random
+
+import pytest
+from hypothesis import given
+from hypothesis import strategies as st
+
+from crdt_tpu import GSet, LWWReg, MVReg
+from crdt_tpu.traits import ConflictingMarker
+
+from strategies import ACTORS, assert_all_equal, assert_cvrdt_laws, seeds
+
+
+# ---- GSet --------------------------------------------------------------
+def test_gset_basic():
+    s = GSet()
+    op = s.insert(1)
+    assert s.contains(1)
+    other = GSet()
+    other.apply(op)
+    assert other.contains(1)
+    other.insert(2)
+    s.merge(other)
+    assert s.read() == frozenset({1, 2})
+
+
+gsets = st.sets(st.integers(0, 9)).map(GSet)
+
+
+@given(gsets, gsets, gsets)
+def test_gset_laws(a, b, c):
+    assert_cvrdt_laws(a, b, c)
+
+
+# ---- LWWReg ------------------------------------------------------------
+def test_lww_update_keeps_max_marker():
+    r = LWWReg("x", 1)
+    r.update("y", 3)
+    assert r.read() == "y" and r.marker == 3
+    r.update("stale", 2)
+    assert r.read() == "y"
+
+
+def test_lww_conflicting_marker_validation():
+    r = LWWReg("x", 3)
+    with pytest.raises(ConflictingMarker):
+        r.validate_merge(LWWReg("y", 3))
+    r.validate_merge(LWWReg("x", 3))  # same value: fine
+    r.validate_merge(LWWReg("y", 4))  # newer marker: fine
+
+
+lwws = st.integers(1, 9).map(lambda m: LWWReg(val=f"v{m}", marker=m))
+
+
+@given(lwws, lwws, lwws)
+def test_lww_laws(a, b, c):
+    # Markers uniquely determine values here (val embeds marker), so the
+    # equal-marker conflict case cannot arise.
+    assert_cvrdt_laws(a, b, c)
+
+
+# ---- MVReg -------------------------------------------------------------
+def test_mvreg_sequential_write_overwrites():
+    r = MVReg()
+    op1 = r.write("a", r.read().derive_add_ctx(1))
+    r.apply(op1)
+    op2 = r.write("b", r.read().derive_add_ctx(1))
+    r.apply(op2)
+    assert r.read().val == ["b"]
+
+
+def test_mvreg_concurrent_writes_both_survive():
+    r1, r2 = MVReg(), MVReg()
+    op1 = r1.write("a", r1.read().derive_add_ctx(1))
+    op2 = r2.write("b", r2.read().derive_add_ctx(2))
+    r1.apply(op1)
+    r2.apply(op2)
+    r1.merge(r2)
+    assert sorted(r1.read().val) == ["a", "b"]
+    # A causally-later write dominates both siblings.
+    op3 = r1.write("c", r1.read().derive_add_ctx(1))
+    r1.apply(op3)
+    assert r1.read().val == ["c"]
+    r2.apply(op3)
+    assert r2.read().val == ["c"]
+
+
+def test_mvreg_apply_idempotent_and_stale():
+    r = MVReg()
+    op1 = r.write("a", r.read().derive_add_ctx(1))
+    op2 = r.write("b", r.read().derive_add_ctx(1))  # concurrent mint, same actor? no — derive from same read
+    r.apply(op1)
+    r.apply(op1)
+    assert r.read().val == ["a"]
+
+
+def _random_mvreg(rng, actor_pool=ACTORS):
+    r = MVReg()
+    for _ in range(rng.randrange(1, 5)):
+        actor = rng.choice(actor_pool)
+        op = r.write(rng.randrange(10), r.read().derive_add_ctx(actor))
+        r.apply(op)
+    return r
+
+
+@given(seeds)
+def test_mvreg_laws(seed):
+    rng = random.Random(seed)
+    # Disjoint actor pools give genuinely concurrent registers.
+    a = _random_mvreg(rng, [0, 1])
+    b = _random_mvreg(rng, [2])
+    c = _random_mvreg(rng, [3])
+    assert_cvrdt_laws(a, b, c)
+
+
+@given(seeds)
+def test_mvreg_convergence(seed):
+    rng = random.Random(seed)
+    states = [_random_mvreg(rng, [i]) for i in range(3)]
+    merged = []
+    for i in range(3):
+        m = states[i].clone()
+        order = list(range(3))
+        rng.shuffle(order)
+        for j in order:
+            m.merge(states[j])
+        merged.append(m)
+    assert_all_equal(merged)

@@ -1,0 +1,82 @@
+"""VClock + Dot unit and property tests (reference: src/vclock.rs tests)."""
+
+from hypothesis import given
+from hypothesis import strategies as st
+
+from crdt_tpu import Dot, VClock
+
+from strategies import ACTORS, assert_cvrdt_laws
+
+clocks = st.dictionaries(
+    st.sampled_from(ACTORS), st.integers(min_value=1, max_value=5)
+).map(VClock)
+
+
+def test_inc_apply_get():
+    v = VClock()
+    assert v.get("a") == 0
+    dot = v.inc("a")
+    assert dot == Dot("a", 1)
+    assert v.get("a") == 0  # inc is pure
+    v.apply(dot)
+    assert v.get("a") == 1
+    v.apply(Dot("a", 5))
+    assert v.get("a") == 5
+    v.apply(Dot("a", 3))  # stale: ignored
+    assert v.get("a") == 5
+
+
+def test_partial_order():
+    a = VClock({"a": 2, "b": 1})
+    b = VClock({"a": 2, "b": 1})
+    assert a.partial_cmp(b) == 0
+    b.apply(Dot("c", 1))
+    assert a.partial_cmp(b) == -1 and a < b and b > a
+    a.apply(Dot("d", 9))
+    assert a.partial_cmp(b) is None and a.concurrent(b)
+    assert not a <= b and not b <= a
+
+
+def test_empty_clock_is_bottom():
+    assert VClock() <= VClock({"a": 1})
+    assert VClock().partial_cmp(VClock()) == 0
+
+
+def test_glb_and_clone_without():
+    a = VClock({"a": 3, "b": 1})
+    b = VClock({"a": 1, "c": 2})
+    assert a.glb(b) == VClock({"a": 1})
+    assert a.clone_without(b) == VClock({"a": 3, "b": 1})
+    assert a.clone_without(VClock({"a": 3})) == VClock({"b": 1})
+
+
+def test_reset_remove():
+    a = VClock({"a": 3, "b": 1})
+    a.reset_remove(VClock({"a": 3, "b": 5, "c": 7}))
+    assert a == VClock()
+    b = VClock({"a": 3, "b": 1})
+    b.reset_remove(VClock({"a": 2}))
+    assert b == VClock({"a": 3, "b": 1})
+
+
+@given(clocks, clocks, clocks)
+def test_merge_laws(a, b, c):
+    assert_cvrdt_laws(a, b, c)
+
+
+@given(clocks, clocks)
+def test_merge_is_lub(a, b):
+    joined = a.clone()
+    joined.merge(b)
+    assert a <= joined and b <= joined
+    # Least: any other upper bound dominates the join.
+    for actor in ACTORS:
+        assert joined.get(actor) == max(a.get(actor), b.get(actor))
+
+
+@given(clocks, clocks)
+def test_glb_is_glb(a, b):
+    met = a.glb(b)
+    assert met <= a and met <= b
+    for actor in ACTORS:
+        assert met.get(actor) == min(a.get(actor), b.get(actor))
