@@ -1,0 +1,17 @@
+"""Batched, device-resident CRDT replica containers.
+
+Each model holds N replicas of one CRDT type as struct-of-arrays device
+state (SURVEY.md §7.1) and exposes:
+
+- the op path (``apply_*``) and the state path (``merge`` / ``fold``)
+  running as ``crdt_tpu.ops`` kernels,
+- lossless conversion to/from the ``crdt_tpu.pure`` oracle types
+  (``to_pure`` / ``from_pure``), which is how the bit-identical A/B gate
+  in tests/ is enforced.
+"""
+
+from .vclock import BatchedVClock
+from .counters import BatchedGCounter, BatchedPNCounter
+from .orswot import BatchedOrswot
+
+__all__ = ["BatchedVClock", "BatchedGCounter", "BatchedPNCounter", "BatchedOrswot"]

@@ -1,0 +1,95 @@
+"""Batched G/PN counters — thin wrappers over the clock kernels.
+
+Oracle: ``crdt_tpu.pure.gcounter`` / ``pncounter`` (reference:
+src/gcounter.rs, src/pncounter.rs). A G-Counter IS a clock, so the
+batched form delegates storage and conversion to ``BatchedVClock`` —
+``counters[R, A]`` — and a fold + exact host-side lane sum reads the
+converged total (BASELINE config 1). PN composes two clock batches.
+
+Reads are exact Python ints (the reference's BigInt read, SURVEY.md
+§7.3): lane sums happen host-side because device accumulators are u32
+under JAX's default x64-disabled mode.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..ops import vclock as ops
+from ..pure.gcounter import GCounter
+from ..pure.pncounter import PNCounter
+from ..utils import Interner
+from .vclock import BatchedVClock
+
+
+def _exact_sum(row) -> int:
+    return sum(int(c) for c in np.asarray(row))
+
+
+class BatchedGCounter:
+    def __init__(self, n_replicas: int, actors: Optional[Interner] = None, n_actors: int = 1):
+        self.inner = BatchedVClock(n_replicas, actors=actors, n_actors=n_actors)
+
+    @property
+    def actors(self) -> Interner:
+        return self.inner.actors
+
+    @classmethod
+    def from_pure(cls, pures: Sequence[GCounter], actors: Optional[Interner] = None) -> "BatchedGCounter":
+        out = cls(0)
+        out.inner = BatchedVClock.from_pure([p.inner for p in pures], actors=actors)
+        return out
+
+    def to_pure(self, i: int) -> GCounter:
+        return GCounter(self.inner.to_pure(i))
+
+    def inc(self, replica: int, actor, steps: int = 1) -> None:
+        aid = self.inner.bounded_id(actor)
+        self.inner.clocks = self.inner.clocks.at[replica, aid].add(np.uint32(steps))
+
+    def fold_read(self) -> int:
+        """Converged total: one join + one lane sum (config 1's kernel)."""
+        return _exact_sum(ops.fold(self.inner.clocks))
+
+    def read(self, i: int) -> int:
+        return _exact_sum(self.inner.clocks[i])
+
+
+class BatchedPNCounter:
+    def __init__(self, n_replicas: int, actors: Optional[Interner] = None, n_actors: int = 1):
+        actors = actors if actors is not None else Interner()
+        self.p = BatchedVClock(n_replicas, actors=actors, n_actors=n_actors)
+        self.n = BatchedVClock(n_replicas, actors=actors, n_actors=n_actors)
+
+    @property
+    def actors(self) -> Interner:
+        return self.p.actors
+
+    @classmethod
+    def from_pure(cls, pures: Sequence[PNCounter], actors: Optional[Interner] = None) -> "BatchedPNCounter":
+        actors = actors if actors is not None else Interner()
+        for pure in pures:
+            for actor in (*pure.p.inner.dots, *pure.n.inner.dots):
+                actors.intern(actor)
+        out = cls(0)
+        out.p = BatchedVClock.from_pure([x.p.inner for x in pures], actors=actors)
+        out.n = BatchedVClock.from_pure([x.n.inner for x in pures], actors=actors)
+        return out
+
+    def to_pure(self, i: int) -> PNCounter:
+        return PNCounter(GCounter(self.p.to_pure(i)), GCounter(self.n.to_pure(i)))
+
+    def inc(self, replica: int, actor) -> None:
+        aid = self.p.bounded_id(actor)
+        self.p.clocks = self.p.clocks.at[replica, aid].add(np.uint32(1))
+
+    def dec(self, replica: int, actor) -> None:
+        aid = self.n.bounded_id(actor)
+        self.n.clocks = self.n.clocks.at[replica, aid].add(np.uint32(1))
+
+    def fold_read(self) -> int:
+        """Converged p − n (exact Python int at the API edge, preserving
+        the reference's BigInt read — SURVEY.md §7.3)."""
+        return _exact_sum(ops.fold(self.p.clocks)) - _exact_sum(ops.fold(self.n.clocks))

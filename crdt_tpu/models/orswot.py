@@ -1,0 +1,207 @@
+"""BatchedOrswot — N dense ORSWOT replicas on device.
+
+Oracle: ``crdt_tpu.pure.orswot.Orswot`` (reference: src/orswot.rs). The
+replica batch is an ``ops.orswot.OrswotState`` with leading axis R over a
+fixed interned member universe E and actor universe A (dense mode,
+SURVEY.md §7.1). Conversion to/from the oracle is lossless — including
+the deferred-removal buffer — which is what the bit-identical A/B gate in
+tests/test_models_orswot.py exercises.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dot import Dot
+from ..ops import orswot as ops
+from ..pure.orswot import Add, Orswot, Rm
+from ..utils import Interner
+from ..vclock import VClock
+
+
+class DeferredOverflow(RuntimeError):
+    """A parked remove could not be held: the deferred buffer exceeded its
+    static capacity. Raise rather than silently dropping removal history —
+    rebuild the model with a larger ``deferred_cap``."""
+
+
+class BatchedOrswot:
+    def __init__(
+        self,
+        n_replicas: int,
+        n_members: int,
+        n_actors: int,
+        deferred_cap: int = 8,
+        members: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+    ):
+        self.members = members if members is not None else Interner()
+        self.actors = actors if actors is not None else Interner()
+        self.state = ops.empty(n_members, n_actors, deferred_cap, batch=(n_replicas,))
+
+    @property
+    def n_replicas(self) -> int:
+        return self.state.top.shape[0]
+
+    # ---- conversion (the A/B gate boundary) ---------------------------
+    @classmethod
+    def from_pure(
+        cls,
+        pures: Sequence[Orswot],
+        members: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+        deferred_cap: int = 8,
+    ) -> "BatchedOrswot":
+        members = members if members is not None else Interner()
+        actors = actors if actors is not None else Interner()
+        for p in pures:
+            for actor in p.clock.dots:
+                actors.intern(actor)
+            for m, entry in p.entries.items():
+                members.intern(m)
+                for actor in entry.dots:
+                    actors.intern(actor)
+            for clock, ms in p.deferred.items():
+                for actor in clock.dots:
+                    actors.intern(actor)
+                for m in ms:
+                    members.intern(m)
+
+        r, e, a = len(pures), max(len(members), 1), max(len(actors), 1)
+        top = np.zeros((r, a), np.uint32)
+        ctr = np.zeros((r, e, a), np.uint32)
+        dcl = np.zeros((r, deferred_cap, a), np.uint32)
+        dmask = np.zeros((r, deferred_cap, e), bool)
+        dvalid = np.zeros((r, deferred_cap), bool)
+        for i, p in enumerate(pures):
+            for actor, c in p.clock.dots.items():
+                top[i, actors.id_of(actor)] = c
+            for m, entry in p.entries.items():
+                for actor, c in entry.dots.items():
+                    ctr[i, members.id_of(m), actors.id_of(actor)] = c
+            if len(p.deferred) > deferred_cap:
+                raise ValueError(
+                    f"replica {i} has {len(p.deferred)} deferred removes; "
+                    f"capacity is {deferred_cap}"
+                )
+            for d, (clock, ms) in enumerate(p.deferred.items()):
+                for actor, c in clock.dots.items():
+                    dcl[i, d, actors.id_of(actor)] = c
+                for m in ms:
+                    dmask[i, d, members.id_of(m)] = True
+                dvalid[i, d] = True
+
+        out = cls(r, e, a, deferred_cap, members=members, actors=actors)
+        out.state = ops.OrswotState(
+            top=jnp.asarray(top),
+            ctr=jnp.asarray(ctr),
+            dcl=jnp.asarray(dcl),
+            dmask=jnp.asarray(dmask),
+            dvalid=jnp.asarray(dvalid),
+        )
+        return out
+
+    def _row(self, arrs, i: int):
+        return jax.tree.map(lambda x: x[i], arrs)
+
+    def to_pure(self, i: int) -> Orswot:
+        st = jax.device_get(self._row(self.state, i))
+        out = Orswot()
+        out.clock = VClock(
+            {self.actors[a]: int(c) for a, c in enumerate(st.top) if c > 0}
+        )
+        present = st.ctr.any(axis=-1)
+        for e in np.nonzero(present)[0]:
+            out.entries[self.members[int(e)]] = VClock(
+                {
+                    self.actors[a]: int(c)
+                    for a, c in enumerate(st.ctr[e])
+                    if c > 0
+                }
+            )
+        for d in np.nonzero(st.dvalid)[0]:
+            clock = VClock(
+                {self.actors[a]: int(c) for a, c in enumerate(st.dcl[d]) if c > 0}
+            )
+            ms = {self.members[int(e)] for e in np.nonzero(st.dmask[d])[0]}
+            if ms:
+                out.deferred[clock] = ms
+        return out
+
+    # ---- op path (CmRDT) ----------------------------------------------
+    def apply(self, replica: int, op) -> None:
+        """Apply an oracle-shaped op to one replica (reference:
+        src/orswot.rs ``CmRDT::apply``)."""
+        row = self._row(self.state, replica)
+        if isinstance(op, Add):
+            aid = self.actors.id_of(op.dot.actor)
+            if aid >= self.state.top.shape[-1]:
+                raise IndexError(
+                    f"actor id {aid} outside the {self.state.top.shape[-1]}-lane universe"
+                )
+            mask = np.zeros((self.state.ctr.shape[-2],), bool)
+            for m in op.members:
+                mask[self.members.id_of(m)] = True
+            row = ops.apply_add(
+                row, jnp.asarray(aid), jnp.asarray(op.dot.counter), jnp.asarray(mask)
+            )
+        elif isinstance(op, Rm):
+            a = self.state.top.shape[-1]
+            cl = np.zeros((a,), np.uint32)
+            for actor, c in op.clock.dots.items():
+                cl[self.actors.id_of(actor)] = c
+            mask = np.zeros((self.state.ctr.shape[-2],), bool)
+            for m in op.members:
+                mask[self.members.id_of(m)] = True
+            row, overflow = ops.apply_rm(row, jnp.asarray(cl), jnp.asarray(mask))
+            if bool(overflow):
+                raise DeferredOverflow(
+                    f"replica {replica}: deferred buffer full "
+                    f"(cap {self.state.dvalid.shape[-1]})"
+                )
+        else:
+            raise TypeError(f"not an Orswot op: {op!r}")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[replica].set(r), self.state, row
+        )
+
+    # ---- state path (CvRDT — the benchmark path) ----------------------
+    def merge_from(self, dst: int, src: int) -> None:
+        joined, overflow = ops.join(
+            self._row(self.state, dst), self._row(self.state, src)
+        )
+        if bool(overflow):
+            raise DeferredOverflow(
+                f"merge {src}->{dst}: deferred buffer full "
+                f"(cap {self.state.dvalid.shape[-1]})"
+            )
+        self.state = jax.tree.map(
+            lambda full, r: full.at[dst].set(r), self.state, joined
+        )
+
+    def fold(self) -> Orswot:
+        """Full-mesh anti-entropy: join all R replicas in a log2 reduction
+        tree and return the converged oracle-form state."""
+        folded, overflow = ops.fold(self.state)
+        if bool(overflow):
+            raise DeferredOverflow(
+                f"fold: deferred buffer full (cap {self.state.dvalid.shape[-1]})"
+            )
+        tmp = BatchedOrswot(
+            1,
+            self.state.ctr.shape[-2],
+            self.state.ctr.shape[-1],
+            self.state.dcl.shape[-2],
+            members=self.members,
+            actors=self.actors,
+        )
+        tmp.state = jax.tree.map(lambda x: x[None], folded)
+        return tmp.to_pure(0)
+
+    def members_of(self, i: int) -> frozenset:
+        present = np.asarray(self.state.ctr[i].any(axis=-1))
+        return frozenset(self.members[int(e)] for e in np.nonzero(present)[0])

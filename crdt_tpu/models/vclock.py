@@ -1,0 +1,93 @@
+"""BatchedVClock — N replica clocks as one device array.
+
+Oracle: ``crdt_tpu.vclock.VClock`` (reference: src/vclock.rs). The batch
+is ``clocks[R, A]``; every lattice operation is a ``crdt_tpu.ops.vclock``
+kernel.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import vclock as ops
+from ..utils import Interner
+from ..vclock import VClock
+from ..dot import Dot
+
+
+class BatchedVClock:
+    def __init__(self, n_replicas: int, actors: Optional[Interner] = None, n_actors: Optional[int] = None):
+        self.actors = actors if actors is not None else Interner()
+        n = n_actors if n_actors is not None else max(len(self.actors), 1)
+        self.clocks = ops.zeros(n, batch=(n_replicas,))
+
+    @property
+    def n_replicas(self) -> int:
+        return self.clocks.shape[0]
+
+    @property
+    def n_actors(self) -> int:
+        return self.clocks.shape[-1]
+
+    # ---- conversion (the A/B gate boundary) ---------------------------
+    @classmethod
+    def from_pure(cls, pures: Sequence[VClock], actors: Optional[Interner] = None) -> "BatchedVClock":
+        actors = actors if actors is not None else Interner()
+        for p in pures:
+            for actor in p.dots:
+                actors.intern(actor)
+        out = cls(len(pures), actors=actors, n_actors=max(len(actors), 1))
+        mat = np.zeros((len(pures), max(len(actors), 1)), dtype=np.uint32)
+        for i, p in enumerate(pures):
+            for actor, counter in p.dots.items():
+                mat[i, actors.id_of(actor)] = counter
+        out.clocks = jnp.asarray(mat)
+        return out
+
+    def to_pure(self, i: int) -> VClock:
+        row = np.asarray(self.clocks[i])
+        return VClock(
+            {self.actors[a]: int(c) for a, c in enumerate(row) if c > 0}
+        )
+
+    # ---- ops ----------------------------------------------------------
+    def bounded_id(self, actor) -> int:
+        """Actor id, guaranteed inside the lane universe (JAX scatter
+        silently drops out-of-bounds indices — never rely on it)."""
+        aid = self.actors.id_of(actor)
+        if aid >= self.n_actors:
+            raise IndexError(
+                f"actor {actor!r} (id {aid}) outside the "
+                f"{self.n_actors}-lane universe; rebuild with more lanes"
+            )
+        return aid
+
+    def apply(self, replica: int, dot: Dot) -> None:
+        aid = self.bounded_id(dot.actor)
+        self.clocks = self.clocks.at[replica].set(
+            ops.apply_dot(self.clocks[replica], jnp.asarray(aid), jnp.asarray(dot.counter))
+        )
+
+    def inc(self, replica: int, actor) -> None:
+        aid = self.bounded_id(actor)
+        self.clocks = self.clocks.at[replica].set(
+            ops.inc(self.clocks[replica], jnp.asarray(aid))
+        )
+
+    def merge_from(self, dst: int, src: int) -> None:
+        self.clocks = self.clocks.at[dst].set(
+            ops.merge(self.clocks[dst], self.clocks[src])
+        )
+
+    def fold(self) -> VClock:
+        """Join all replicas (full-mesh anti-entropy in one reduction)."""
+        joined = ops.fold(self.clocks)
+        row = np.asarray(joined)
+        return VClock({self.actors[a]: int(c) for a, c in enumerate(row) if c > 0})
+
+    def compare(self, i: int, j: int) -> Optional[int]:
+        code = int(ops.compare(self.clocks[i], self.clocks[j]))
+        return None if code == ops.CONCURRENT else code

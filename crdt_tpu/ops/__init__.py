@@ -1,0 +1,12 @@
+"""Batched lattice kernels — the XLA data plane.
+
+Every kernel here is a pure function on dense arrays, jit/vmap-friendly
+(static shapes, no data-dependent Python control flow), and bit-identical
+to the corresponding ``crdt_tpu.pure`` oracle operation under the A/B
+property suite in tests/. These are the "native" components of the
+framework in the sense of SURVEY.md §3: the compiled code XLA generates
+from them is the TPU equivalent of the reference's compiled Rust.
+"""
+
+from . import vclock  # noqa: F401
+from . import orswot  # noqa: F401

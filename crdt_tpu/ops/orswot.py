@@ -1,0 +1,238 @@
+"""Dense batched ORSWOT kernels — THE hot loop (SURVEY.md §4.2).
+
+State layout (dense mode, SURVEY.md §7.1): for an element universe of E
+interned members and A interned actors,
+
+- ``top［..., A]``      — the replica's top clock,
+- ``ctr［..., E, A]``   — per-element birth clocks (0 = no dot; membership
+  mask is ``any(ctr > 0, -1)``),
+- ``dcl［..., D, A]`` / ``dmask［..., D, E]`` / ``dvalid［..., D]`` — the
+  deferred-removal buffer as masked epochs (SURVEY.md §7.3): D parked rm
+  clocks + member masks, re-evaluated after every state change.
+
+``join`` implements exactly the reference merge rule (src/orswot.rs
+``CvRDT::merge``): an entry survives iff its birth clock has dots unseen
+by the other side's top clock, or it is present on both sides (then the
+birth clocks join as common-dots ∪ each side's unseen dots). Everything is
+element-wise max/min + boolean masks → pure MXU/VPU work, no gather
+dependence on data, so XLA tiles it and vmap/pjit batch it freely.
+
+The join is a true lattice join (bit-identical to the oracle under
+tests/test_models_orswot.py), so N-replica full-mesh anti-entropy folds
+into a log2(N) reduction tree (``fold``) — the device analog of
+``lax.all_reduce`` with the lattice-join monoid.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DTYPE = jnp.uint32
+
+
+class OrswotState(NamedTuple):
+    """A (possibly batched) dense ORSWOT replica state (pytree)."""
+
+    top: jax.Array    # [..., A]
+    ctr: jax.Array    # [..., E, A]
+    dcl: jax.Array    # [..., D, A]
+    dmask: jax.Array  # [..., D, E]
+    dvalid: jax.Array # [..., D]
+
+
+def empty(n_elems: int, n_actors: int, deferred_cap: int = 8, batch: tuple = ()) -> OrswotState:
+    """The join identity: no dots, no members, no parked removes."""
+    return OrswotState(
+        top=jnp.zeros((*batch, n_actors), DTYPE),
+        ctr=jnp.zeros((*batch, n_elems, n_actors), DTYPE),
+        dcl=jnp.zeros((*batch, deferred_cap, n_actors), DTYPE),
+        dmask=jnp.zeros((*batch, deferred_cap, n_elems), bool),
+        dvalid=jnp.zeros((*batch, deferred_cap), bool),
+    )
+
+
+def _without(ctr: jax.Array, top: jax.Array) -> jax.Array:
+    """Per-element clocks shorn of dots the top clock has seen."""
+    return jnp.where(ctr > top[..., None, :], ctr, jnp.zeros_like(ctr))
+
+
+def _present(ctr: jax.Array) -> jax.Array:
+    return jnp.any(ctr > 0, axis=-1)
+
+
+def _apply_parked(ctr: jax.Array, dcl: jax.Array, dmask: jax.Array, dvalid: jax.Array) -> jax.Array:
+    """Replay every parked remove against the entry matrix (the oracle's
+    ``_apply_rm`` partial application: zero dots the rm clock dominates,
+    for masked members only). Removes commute — scan order is free."""
+
+    def step(ctr, slot):
+        cl, mask, valid = slot
+        dominated = mask[..., :, None] & (ctr <= cl[..., None, :]) & valid[..., None, None]
+        return jnp.where(dominated, jnp.zeros_like(ctr), ctr), None
+
+    # Move the D axis to the front for scan (batch axes stay in place).
+    d_axis = dcl.ndim - 2
+    ctr, _ = lax.scan(
+        step,
+        ctr,
+        (
+            jnp.moveaxis(dcl, d_axis, 0),
+            jnp.moveaxis(dmask, d_axis, 0),
+            jnp.moveaxis(dvalid, d_axis, 0),
+        ),
+    )
+    return ctr
+
+
+def _dedupe_deferred(dcl, dmask, dvalid):
+    """Union member masks of slots holding equal rm clocks (the oracle's
+    ``defer_remove`` dict-union), keeping the first slot of each group."""
+    d = dcl.shape[-2]
+    idx = jnp.arange(d)
+    eq = (
+        dvalid[..., :, None]
+        & dvalid[..., None, :]
+        & jnp.all(dcl[..., :, None, :] == dcl[..., None, :, :], axis=-1)
+    )  # [..., D, D]
+    rep = jnp.argmax(eq, axis=-2)  # first valid slot with an equal clock
+    keep = dvalid & (rep == idx)
+    sel = (rep[..., :, None] == idx[..., None, :]) & dvalid[..., :, None]
+    merged = jnp.any(sel[..., None] & dmask[..., :, None, :], axis=-3)
+    return dcl, merged & keep[..., None], keep
+
+
+def _compact_deferred(dcl, dmask, dvalid, cap: int):
+    """Stable-sort valid slots to the front and truncate to capacity.
+    Returns the compacted buffer plus an overflow flag."""
+    order = jnp.argsort(~dvalid, axis=-1, stable=True)
+    dcl = jnp.take_along_axis(dcl, order[..., None], axis=-2)
+    dmask = jnp.take_along_axis(dmask, order[..., None], axis=-2)
+    dvalid = jnp.take_along_axis(dvalid, order, axis=-1)
+    overflow = jnp.sum(dvalid, axis=-1) > cap
+    dcl, dmask, dvalid = dcl[..., :cap, :], dmask[..., :cap, :], dvalid[..., :cap]
+    # Canonical form: invalid slots carry no stale payload, so raw arrays
+    # of converged replicas compare equal and later unions cannot leak.
+    dcl = jnp.where(dvalid[..., None], dcl, jnp.zeros_like(dcl))
+    dmask = dmask & dvalid[..., None]
+    return dcl, dmask, dvalid, overflow
+
+
+@jax.jit
+def join(a: OrswotState, b: OrswotState):
+    """Pairwise lattice join — the reference's ``Orswot::merge`` as pure
+    element-wise arithmetic. Reference: src/orswot.rs CvRDT::merge.
+
+    Returns ``(state, overflow)``: ``overflow`` is True where the combined
+    deferred buffers exceeded capacity (parked removes would be lost) —
+    callers must surface it (models raise ``DeferredOverflow``)."""
+    wa = _without(a.ctr, b.top)  # our dots they never saw
+    wb = _without(b.ctr, a.top)  # their dots we never saw
+    pa, pb = _present(a.ctr), _present(b.ctr)
+    common = jnp.maximum(jnp.minimum(a.ctr, b.ctr), jnp.maximum(wa, wb))
+    ctr = jnp.where(
+        (pa & pb)[..., None],
+        common,
+        jnp.where((pa & ~pb)[..., None], wa, jnp.where((pb & ~pa)[..., None], wb, 0)),
+    ).astype(a.ctr.dtype)
+    top = jnp.maximum(a.top, b.top)
+
+    # Deferred buffers: union (dict-union on equal clocks), replay every
+    # parked remove against the joined entries, keep only still-ahead ones.
+    dcl = jnp.concatenate([a.dcl, b.dcl], axis=-2)
+    dmask = jnp.concatenate([a.dmask, b.dmask], axis=-2)
+    dvalid = jnp.concatenate([a.dvalid, b.dvalid], axis=-1)
+    dcl, dmask, dvalid = _dedupe_deferred(dcl, dmask, dvalid)
+    ctr = _apply_parked(ctr, dcl, dmask, dvalid)
+    still_ahead = ~jnp.all(dcl <= top[..., None, :], axis=-1)
+    dvalid = dvalid & still_ahead
+    cap = a.dcl.shape[-2]
+    dcl, dmask, dvalid, overflow = _compact_deferred(dcl, dmask, dvalid, cap)
+    return (
+        OrswotState(top=top, ctr=ctr, dcl=dcl, dmask=dmask, dvalid=dvalid),
+        overflow,
+    )
+
+
+def fold(states: OrswotState):
+    """Join a whole replica batch (leading axis) in a log2 reduction tree.
+    Sound because ``join`` is associative/commutative/idempotent — the
+    N-replica full mesh collapses to one reduction (the north star).
+
+    Returns ``(state, overflow)`` like ``join``."""
+    overflowed = jnp.zeros((), bool)
+    r = states.top.shape[0]
+    # Pad to a power of two with join identities.
+    pow2 = 1
+    while pow2 < r:
+        pow2 *= 2
+    if pow2 != r:
+        pad = jax.tree.map(
+            lambda e, s: jnp.broadcast_to(e, (pow2 - r, *e.shape)).astype(s.dtype),
+            empty(states.ctr.shape[-2], states.ctr.shape[-1], states.dcl.shape[-2]),
+            states,
+        )
+        states = jax.tree.map(lambda s, p: jnp.concatenate([s, p], axis=0), states, pad)
+        r = pow2
+    while r > 1:
+        half = r // 2
+        left = jax.tree.map(lambda x: x[:half], states)
+        right = jax.tree.map(lambda x: x[half:], states)
+        states, overflow = jax.vmap(join)(left, right)
+        overflowed = overflowed | jnp.any(overflow)
+        r = half
+    return jax.tree.map(lambda x: x[0], states), overflowed
+
+
+@jax.jit
+def apply_add(state: OrswotState, actor: jax.Array, counter: jax.Array, member_mask: jax.Array) -> OrswotState:
+    """CmRDT add-op application (reference: src/orswot.rs apply, Op::Add):
+    drop already-seen dots, else record the birth dot on every member in
+    ``member_mask`` and advance the top; then replay parked removes (the
+    oracle's ``apply_deferred``)."""
+    counter = counter.astype(state.top.dtype)
+    seen = state.top[..., actor] >= counter
+    stamp = jnp.where(member_mask, counter, 0).astype(state.ctr.dtype)
+    new_ctr = state.ctr.at[..., actor].max(stamp)
+    ctr = jnp.where(seen[..., None, None], state.ctr, new_ctr)
+    top = jnp.where(seen[..., None], state.top, state.top.at[..., actor].max(counter))
+    ctr = _apply_parked(ctr, state.dcl, state.dmask, state.dvalid)
+    still_ahead = ~jnp.all(state.dcl <= top[..., None, :], axis=-1)
+    return state._replace(top=top, ctr=ctr, dvalid=state.dvalid & still_ahead)
+
+
+@jax.jit
+def apply_rm(state: OrswotState, rm_clock: jax.Array, member_mask: jax.Array):
+    """CmRDT rm-op application (reference: src/orswot.rs apply_rm): always
+    apply the covered part now; if the rm clock is ahead of the top, park
+    it in the deferred buffer (union on an equal-clock slot, else claim the
+    first free slot). Returns ``(state, overflow)``; overflow is True where
+    an ahead remove could not be parked (buffer full) — callers must
+    surface it."""
+    dominated = member_mask[..., :, None] & (state.ctr <= rm_clock[..., None, :])
+    ctr = jnp.where(dominated, jnp.zeros_like(state.ctr), state.ctr)
+
+    ahead = ~jnp.all(rm_clock <= state.top, axis=-1)
+    same = state.dvalid & jnp.all(state.dcl == rm_clock[..., None, :], axis=-1)
+    has_same = jnp.any(same, axis=-1)
+    free = ~state.dvalid
+    first_free = jnp.argmax(free, axis=-1)
+    has_free = jnp.any(free, axis=-1)
+    slot = jnp.where(has_same, jnp.argmax(same, axis=-1), first_free)
+    park = ahead & (has_same | has_free)
+    overflow = ahead & ~has_same & ~has_free
+
+    d = state.dvalid.shape[-1]
+    onehot = jax.nn.one_hot(slot, d, dtype=bool) & park[..., None]
+    dcl = jnp.where(onehot[..., None], rm_clock[..., None, :], state.dcl)
+    # Union only live payload (a free slot may hold a stale mask).
+    live = state.dmask & state.dvalid[..., None]
+    dmask = jnp.where(onehot[..., None], member_mask[..., None, :] | live, state.dmask)
+    dvalid = state.dvalid | onehot
+    return (
+        OrswotState(top=state.top, ctr=ctr, dcl=dcl, dmask=dmask, dvalid=dvalid),
+        overflow,
+    )

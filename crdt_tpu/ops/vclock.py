@@ -1,0 +1,115 @@
+"""Dense vector-clock kernels.
+
+A clock is ``counters[..., A]`` (uint32, one lane per interned actor,
+0 = never seen). Leading axes batch replicas — every kernel broadcasts, so
+``vmap``/sharding fall out for free. Oracle: ``crdt_tpu.vclock.VClock``
+(reference: src/vclock.rs); bit-identity is asserted in
+tests/test_ops_vclock.py.
+
+The two hot kernels of the whole framework (SURVEY.md §3 row 2): ``merge``
+(element-wise max — the lattice join the north star collapses anti-entropy
+into) and ``compare`` (sign analysis of the pairwise difference).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+DTYPE = jnp.uint32
+
+# compare() result codes. None (concurrent) has no scalar analog, so the
+# device encoding is: -1 less, 0 equal, 1 greater, 2 concurrent.
+LESS, EQUAL, GREATER, CONCURRENT = -1, 0, 1, 2
+
+
+def zeros(n_actors: int, batch: tuple = ()) -> jax.Array:
+    return jnp.zeros((*batch, n_actors), dtype=DTYPE)
+
+
+@jax.jit
+def merge(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Join: element-wise max. Reference: src/vclock.rs CvRDT::merge."""
+    return jnp.maximum(a, b)
+
+
+@jax.jit
+def fold(clocks: jax.Array) -> jax.Array:
+    """N-way join over the leading replica axis: one reduction, valid
+    because the join is associative/commutative/idempotent."""
+    return jnp.max(clocks, axis=0)
+
+
+@jax.jit
+def dominates(a: jax.Array, b: jax.Array) -> jax.Array:
+    """``b <= a`` in the partial order (all counters)."""
+    return jnp.all(a >= b, axis=-1)
+
+
+@jax.jit
+def compare(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Partial-order compare: -1/0/1/2(concurrent).
+
+    Reference: src/vclock.rs ``PartialOrd::partial_cmp`` (None =
+    concurrent).
+    """
+    le = jnp.all(a <= b, axis=-1)
+    ge = jnp.all(a >= b, axis=-1)
+    return jnp.where(
+        le & ge,
+        EQUAL,
+        jnp.where(le, LESS, jnp.where(ge, GREATER, CONCURRENT)),
+    ).astype(jnp.int8)
+
+
+@jax.jit
+def apply_dot(clock: jax.Array, actor: jax.Array, counter: jax.Array) -> jax.Array:
+    """Observe a dot (monotone max at the actor lane).
+
+    Reference: src/vclock.rs ``CmRDT::apply`` (Op = Dot).
+    """
+    return clock.at[..., actor].max(counter.astype(clock.dtype))
+
+
+@jax.jit
+def inc(clock: jax.Array, actor: jax.Array) -> jax.Array:
+    """Advance the actor's lane by one (mint-and-apply fused — the device
+    form of ``inc`` + ``apply``)."""
+    return clock.at[..., actor].add(jnp.asarray(1, clock.dtype))
+
+
+@jax.jit
+def reset_remove(clock: jax.Array, other: jax.Array) -> jax.Array:
+    """Forget dots dominated by ``other``: zero lanes where
+    clock[a] <= other[a]. Reference: src/vclock.rs ResetRemove/forget."""
+    return jnp.where(clock <= other, jnp.zeros_like(clock), clock)
+
+
+@jax.jit
+def glb(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Greatest lower bound: element-wise min. Reference: src/vclock.rs
+    ``glb``/``intersection``."""
+    return jnp.minimum(a, b)
+
+
+@jax.jit
+def clone_without(c: jax.Array, base: jax.Array) -> jax.Array:
+    """Keep only dots not dominated by ``base`` (c[a] > base[a]).
+
+    Reference: src/vclock.rs ``clone_without``.
+    """
+    return jnp.where(c > base, c, jnp.zeros_like(c))
+
+
+@jax.jit
+def is_empty(clock: jax.Array) -> jax.Array:
+    return jnp.all(clock == 0, axis=-1)
+
+
+@jax.jit
+def pairwise_merge_matrix(clocks: jax.Array) -> jax.Array:
+    """All-pairs join of ``clocks[R, A]`` → ``[R, R, A]`` (BASELINE
+    config 2's kernel): vmap over both replica axes."""
+    return jax.vmap(lambda a: jax.vmap(lambda b: jnp.maximum(a, b))(clocks))(
+        clocks
+    )

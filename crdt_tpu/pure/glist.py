@@ -56,8 +56,22 @@ class GList(CvRDT, CmRDT):
             self.list.insert(ix, op.id)
 
     def merge(self, other: "GList") -> None:
-        for ident in other.list:
-            self.apply(Insert(id=ident))
+        # Both sides are sorted and unique: linear two-pointer union.
+        if not other.list:
+            return
+        out = []
+        i = j = 0
+        mine, theirs = self.list, other.list
+        while i < len(mine) and j < len(theirs):
+            if mine[i] < theirs[j]:
+                out.append(mine[i]); i += 1
+            elif theirs[j] < mine[i]:
+                out.append(theirs[j]); j += 1
+            else:
+                out.append(mine[i]); i += 1; j += 1
+        out.extend(mine[i:])
+        out.extend(theirs[j:])
+        self.list = out
 
     # ---- reads ---------------------------------------------------------
     def read(self) -> List[Any]:

@@ -34,10 +34,42 @@ class _Unset:
 UNSET = _Unset()
 
 
+class _Bottom:
+    """Marker that sorts strictly below every other marker, preserving the
+    reference's ``M: Ord`` genericity (string/tuple/float markers all
+    work against a fresh register). Comparisons rely on Python's
+    reflected-operator fallback: ``marker > BOTTOM`` resolves via
+    ``BOTTOM.__lt__``."""
+
+    def __lt__(self, other):
+        return not isinstance(other, _Bottom)
+
+    def __le__(self, other):
+        return True
+
+    def __gt__(self, other):
+        return False
+
+    def __ge__(self, other):
+        return isinstance(other, _Bottom)
+
+    def __eq__(self, other):
+        return isinstance(other, _Bottom)
+
+    def __hash__(self):
+        return hash("_Bottom")
+
+    def __repr__(self):
+        return "<bottom>"
+
+
+BOTTOM = _Bottom()
+
+
 class LWWReg(CvRDT, CmRDT):
     __slots__ = ("val", "marker")
 
-    def __init__(self, val: Any = UNSET, marker: Any = 0):
+    def __init__(self, val: Any = UNSET, marker: Any = BOTTOM):
         self.val = val
         self.marker = marker
 

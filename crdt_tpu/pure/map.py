@@ -173,6 +173,7 @@ class Map(CvRDT, CmRDT, ResetRemove):
             entry.val.apply(op.op)
             self.clock.apply(op.dot)
             self._apply_deferred()
+            self._cover_children(dot=op.dot)
         elif isinstance(op, MapRm):
             self._apply_keyset_rm(op.keyset, op.clock)
         else:
@@ -259,6 +260,36 @@ class Map(CvRDT, CmRDT, ResetRemove):
 
         self.clock.merge(other.clock)
         self._apply_deferred()
+        self._cover_children()
+
+    def _cover_children(self, dot: Dot = None) -> None:
+        """Maintain the shared-causal-context invariant: every child's top
+        clock equals this map's clock after every top-advancing mutation.
+        This is what makes child tops a canonical function of the merged
+        state (bit-identical across merge orders) — and it is exact: a dot
+        the map has seen either reached this child or proves that absent
+        child state born at it was removed (map dots belong to exactly one
+        key). The op path advances the clock by exactly one dot, so it
+        takes the O(1)-per-child ``covered_dot`` fast path."""
+        if dot is not None:
+            for entry in self.entries.values():
+                entry.val.covered_dot(dot)
+        else:
+            for entry in self.entries.values():
+                entry.val.covered(self.clock)
+
+    def covered(self, ctx: VClock) -> None:
+        """Causal-composition hook for a containing ``Map`` (nested
+        maps): absorb the outer context, replay parked removes, recurse."""
+        self.clock.merge(ctx)
+        self._apply_deferred()
+        self._cover_children()
+
+    def covered_dot(self, dot: Dot) -> None:
+        """One-dot fast path of ``covered``."""
+        self.clock.apply(dot)
+        self._apply_deferred()
+        self._cover_children(dot=dot)
 
     # ---- ResetRemove (nested removal, SURVEY §4.3) ---------------------
     def reset_remove(self, clock: VClock) -> None:

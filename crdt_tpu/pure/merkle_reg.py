@@ -37,7 +37,20 @@ def _canonical_bytes(value: Any) -> bytes:
         return frame(b"i", str(value).encode())
     if isinstance(value, (tuple, list)):
         return frame(b"l", b"".join(_canonical_bytes(v) for v in value))
-    return frame(b"r", repr(value).encode("utf-8"))
+    if isinstance(value, (set, frozenset)):
+        return frame(b"e", b"".join(sorted(_canonical_bytes(v) for v in value)))
+    if isinstance(value, dict):
+        items = sorted(
+            (_canonical_bytes(k), _canonical_bytes(v)) for k, v in value.items()
+        )
+        return frame(b"d", b"".join(k + v for k, v in items))
+    if value is None:
+        return frame(b"n", b"")
+    raise TypeError(
+        f"cannot canonically hash {type(value).__name__}: repr() is not "
+        "stable across processes; use bytes/str/int/bool/None or "
+        "list/tuple/set/dict compositions of them"
+    )
 
 
 @dataclass(frozen=True)

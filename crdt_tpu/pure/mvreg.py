@@ -102,6 +102,13 @@ class MVReg(CvRDT, CmRDT, ResetRemove):
             d: (c, v) for d, (c, v) in self.vals.items() if not c <= clock
         }
 
+    def covered(self, ctx: VClock) -> None:
+        """Causal-composition hook for ``Map``: MVReg holds no top clock,
+        so absorbing the shared causal context is a no-op."""
+
+    def covered_dot(self, dot) -> None:
+        """One-dot fast path of ``covered`` — also a no-op."""
+
     def retain_witnesses(self, alive) -> None:
         """Causal-composition hook for ``Map``: keep only contents whose
         witness dot is in the entry's surviving witness set."""

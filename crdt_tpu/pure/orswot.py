@@ -190,6 +190,20 @@ class Orswot(CvRDT, CmRDT, ResetRemove):
                 self._defer_remove(rm_clock, members)
         self.clock.reset_remove(clock)
 
+    def covered(self, ctx: VClock) -> None:
+        """Causal-composition hook for a containing ``Map``: absorb the
+        map's causal context into the top clock (the composed document has
+        ONE context — every dot the map has seen was either routed to this
+        child or proves absence-means-removed for it), then replay parked
+        removes the wider context may have enabled."""
+        self.clock.merge(ctx)
+        self._apply_deferred()
+
+    def covered_dot(self, dot: Dot) -> None:
+        """One-dot fast path of ``covered``."""
+        self.clock.apply(dot)
+        self._apply_deferred()
+
     def retain_witnesses(self, alive) -> None:
         """Causal-composition hook for a containing ``Map``: keep only
         member birth dots present in the ``alive`` witness set. Observed

@@ -1,0 +1,5 @@
+"""Host-side utilities: interning, serialization, checkpoint, metrics."""
+
+from .interner import Interner
+
+__all__ = ["Interner"]

@@ -1,0 +1,49 @@
+"""Interner — dense integer ids for actors and members.
+
+The reference is generic over ``A: Ord`` (SURVEY.md §3.2 "actor
+genericity"); the device sees only dense int lanes, so the host keeps the
+bidirectional actor/member ↔ id table. Ids are allocated in first-intern
+order and never reused.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List
+
+
+class Interner:
+    __slots__ = ("_ids", "_items")
+
+    def __init__(self, items: Iterable[Any] = ()):
+        self._ids: Dict[Any, int] = {}
+        self._items: List[Any] = []
+        for item in items:
+            self.intern(item)
+
+    def intern(self, item: Any) -> int:
+        """Id for ``item``, allocating one on first sight."""
+        ix = self._ids.get(item)
+        if ix is None:
+            ix = len(self._items)
+            self._ids[item] = ix
+            self._items.append(item)
+        return ix
+
+    def id_of(self, item: Any) -> int:
+        """Id for ``item``; KeyError if never interned."""
+        return self._ids[item]
+
+    def __getitem__(self, ix: int) -> Any:
+        return self._items[ix]
+
+    def __contains__(self, item: Any) -> bool:
+        return item in self._ids
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def items(self) -> List[Any]:
+        return list(self._items)
+
+    def clone(self) -> "Interner":
+        return Interner(self._items)

@@ -9,6 +9,23 @@ from crdt_tpu import MerkleReg
 from strategies import assert_all_equal, assert_cvrdt_laws, seeds
 
 
+def test_canonical_hash_for_dicts_and_sets():
+    # Review regression: dict/set values must hash identically regardless
+    # of insertion order (repr order is process-dependent).
+    from crdt_tpu.pure.merkle_reg import Node
+
+    n1 = Node(value={"b": 2, "a": {1, 2, 3}})
+    n2 = Node(value={"a": {3, 2, 1}, "b": 2})
+    assert n1.hash() == n2.hash()
+    import pytest
+
+    class Opaque:
+        pass
+
+    with pytest.raises(TypeError):
+        Node(value=Opaque()).hash()
+
+
 def test_write_read():
     r = MerkleReg()
     n1 = r.write("v1")

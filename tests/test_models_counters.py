@@ -1,0 +1,75 @@
+"""Batched counters/clock models — A/B vs oracle + review regressions."""
+
+import random
+
+import pytest
+from hypothesis import given
+
+from crdt_tpu import Dot, GCounter, PNCounter, VClock
+from crdt_tpu.models import BatchedGCounter, BatchedPNCounter, BatchedVClock
+from crdt_tpu.utils import Interner
+
+from strategies import ACTORS, seeds
+
+
+@given(seeds)
+def test_gcounter_fold_read_matches_oracle(seed):
+    rng = random.Random(seed)
+    pures = []
+    for _ in range(4):
+        c = GCounter()
+        for _ in range(rng.randrange(6)):
+            c.apply(c.inc(rng.choice(ACTORS)))
+        pures.append(c)
+    b = BatchedGCounter.from_pure(pures, actors=Interner(ACTORS))
+    expect = GCounter()
+    for p in pures:
+        expect.merge(p)
+    assert b.fold_read() == expect.read()
+    for i, p in enumerate(pures):
+        assert b.to_pure(i) == p
+        assert b.read(i) == p.read()
+
+
+@given(seeds)
+def test_pncounter_fold_read_matches_oracle(seed):
+    rng = random.Random(seed)
+    pures = []
+    for _ in range(4):
+        c = PNCounter()
+        for _ in range(rng.randrange(6)):
+            if rng.random() < 0.4:
+                c.apply(c.dec(rng.choice(ACTORS)))
+            else:
+                c.apply(c.inc(rng.choice(ACTORS)))
+        pures.append(c)
+    b = BatchedPNCounter.from_pure(pures, actors=Interner(ACTORS))
+    expect = PNCounter()
+    for p in pures:
+        expect.merge(p)
+    assert b.fold_read() == expect.read()
+    for i, p in enumerate(pures):
+        assert b.to_pure(i) == p
+
+
+def test_fold_read_exact_beyond_u32():
+    # Lane values near 2^31 must sum exactly (no u32 wrap): review finding.
+    a = GCounter()
+    a.apply(a.inc_many(ACTORS[0], 2**31))
+    b = GCounter()
+    b.apply(b.inc_many(ACTORS[1], 2**31))
+    batched = BatchedGCounter.from_pure([a, b], actors=Interner(ACTORS))
+    assert batched.fold_read() == 2**32
+
+
+def test_interner_growth_raises_not_silently_drops():
+    # JAX drops out-of-bounds scatters; the model must raise instead.
+    it = Interner(["A"])
+    b = BatchedVClock.from_pure([VClock({"A": 1})], actors=it)
+    it.intern("B")
+    with pytest.raises(IndexError):
+        b.apply(0, Dot("B", 5))
+    g = BatchedGCounter.from_pure([GCounter()], actors=Interner(["A"]))
+    g.actors.intern("B")
+    with pytest.raises(IndexError):
+        g.inc(0, "B")

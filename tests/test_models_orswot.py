@@ -1,0 +1,166 @@
+"""Batched ORSWOT vs the oracle — the bit-identical A/B acceptance gate
+(SURVEY.md §7.2 step 3: the minimum end-to-end slice)."""
+
+import random
+
+import pytest
+from hypothesis import given, settings
+
+from crdt_tpu import Orswot, VClock
+from crdt_tpu.models import BatchedOrswot
+from crdt_tpu.utils import Interner
+
+from strategies import ACTORS, seeds
+from test_orswot import _site_run, add, rm
+
+MEMBERS = list(range(6))
+
+
+def _interners():
+    return Interner(MEMBERS), Interner(ACTORS)
+
+
+@given(seeds)
+@settings(max_examples=20)
+def test_join_bit_identical_to_oracle_merge(seed):
+    rng = random.Random(seed)
+    sites, _ = _site_run(rng)
+    states = list(sites.values())
+    members, actors = _interners()
+    batched = BatchedOrswot.from_pure(states, members=members, actors=actors)
+
+    # pairwise join on device == oracle merge, bit for bit
+    expect = states[0].clone()
+    expect.merge(states[1].clone())
+    batched.merge_from(0, 1)
+    assert batched.to_pure(0) == expect
+
+    # round-trip of untouched replicas is lossless
+    assert batched.to_pure(2) == states[2]
+
+
+@given(seeds)
+@settings(max_examples=20)
+def test_fold_bit_identical_to_oracle_fold(seed):
+    rng = random.Random(seed)
+    sites, _ = _site_run(rng, n_cmds=14)
+    states = list(sites.values())
+    members, actors = _interners()
+    batched = BatchedOrswot.from_pure(states, members=members, actors=actors)
+
+    expect = Orswot()
+    for s in states:
+        expect.merge(s.clone())
+    assert batched.fold() == expect
+
+
+@given(seeds)
+@settings(max_examples=15)
+def test_op_path_bit_identical(seed):
+    rng = random.Random(seed)
+    # Mint ops on an oracle site, apply the SAME ops to both an oracle
+    # replica and a device replica in the same order.
+    site = Orswot()
+    ops_stream = []
+    for _ in range(10):
+        if rng.random() < 0.6:
+            ops_stream.append(add(site, rng.choice(ACTORS), rng.choice(MEMBERS)))
+        else:
+            ops_stream.append(rm(site, rng.choice(ACTORS), rng.choice(MEMBERS)))
+    oracle = Orswot()
+    members, actors = _interners()
+    device = BatchedOrswot.from_pure([Orswot()], members=members, actors=actors)
+    for op in ops_stream:
+        oracle.apply(op)
+        device.apply(0, op)
+    assert device.to_pure(0) == oracle
+
+
+def test_multi_member_add_applies_to_all_members():
+    # Review regression: a single dot witnessing several members must land
+    # on every member, not just the first.
+    oracle = Orswot()
+    ctx = oracle.read().derive_add_ctx("A")
+    op = oracle.add_all([0, 1, 2], ctx)
+    oracle.apply(op)
+    members, actors = Interner(MEMBERS), Interner(["A"])
+    device = BatchedOrswot.from_pure([Orswot()], members=members, actors=actors)
+    device.apply(0, op)
+    assert device.to_pure(0) == oracle
+    assert device.members_of(0) == frozenset({0, 1, 2})
+
+
+def test_deferred_overflow_raises():
+    # Review regression: an ahead remove that cannot be parked must raise,
+    # not silently drop removal history.
+    from crdt_tpu.models.orswot import DeferredOverflow
+
+    minter = Orswot()
+    rm_ops = []
+    for i in range(3):
+        add_op = add(minter, "A", i)
+        rm_ops.append(minter.rm(i, minter.contains(i).derive_rm_ctx()))
+        minter.apply(rm_ops[-1])
+    members, actors = Interner(MEMBERS), Interner(["A"])
+    device = BatchedOrswot.from_pure(
+        [Orswot()], members=members, actors=actors, deferred_cap=2
+    )
+    device.apply(0, rm_ops[0])  # parks (clock ahead)
+    device.apply(0, rm_ops[1])  # parks
+    with pytest.raises(DeferredOverflow):
+        device.apply(0, rm_ops[2])
+
+
+def test_deferred_remove_parks_and_replays_on_device():
+    # The op-based deferred scenario from test_orswot, on device.
+    a = Orswot()
+    add_op = add(a, "A", 3)
+    rm_op = a.rm(3, a.contains(3).derive_rm_ctx())
+    a.apply(rm_op)
+
+    members, actors = Interner(MEMBERS), Interner(["A"])
+    device = BatchedOrswot.from_pure([Orswot()], members=members, actors=actors)
+    oracle = Orswot()
+    for op in (rm_op, add_op):  # remove first: must park, then replay
+        oracle.apply(op)
+        device.apply(0, op)
+    assert oracle.deferred == {} and oracle.members() == frozenset()
+    assert device.to_pure(0) == oracle
+
+
+def test_deferred_survives_conversion_round_trip():
+    a = Orswot()
+    add(a, "A", 1)
+    b = Orswot()
+    rm_op = a.rm(1, a.contains(1).derive_rm_ctx())
+    b.apply(rm_op)  # parked: clock ahead of b's view
+    assert b.deferred
+    members, actors = _interners()
+    device = BatchedOrswot.from_pure([b], members=members, actors=actors)
+    assert device.to_pure(0) == b
+
+
+@given(seeds)
+@settings(max_examples=10)
+def test_device_join_laws(seed):
+    # Lattice laws on the device join itself (reduction-tree safety,
+    # SURVEY §7.3 "deterministic reduction").
+    rng = random.Random(seed)
+    sites, _ = _site_run(rng)
+    states = list(sites.values())
+    members, actors = _interners()
+
+    def dev(*pures):
+        return BatchedOrswot.from_pure(list(pures), members=members.clone(), actors=actors.clone())
+
+    a, b, c = states
+    ab = dev(a, b); ab.merge_from(0, 1)
+    ba = dev(b, a); ba.merge_from(0, 1)
+    assert ab.to_pure(0) == ba.to_pure(0), "device join not commutative"
+
+    abc1 = dev(a, b, c); abc1.merge_from(0, 1); abc1.merge_from(0, 2)
+    abc2 = dev(b, c, a); abc2.merge_from(0, 1); abc2.merge_from(0, 2)
+    assert abc1.to_pure(0) == abc2.to_pure(0), "device join not associative"
+
+    aa = dev(a, a); aa.merge_from(0, 1)
+    assert aa.to_pure(0) == a, "device join not idempotent"

@@ -1,0 +1,104 @@
+"""Batched VClock kernels vs the oracle — bit-identical A/B gate
+(SURVEY.md §7.2 step 2)."""
+
+import random
+
+import numpy as np
+from hypothesis import given
+from hypothesis import strategies as st
+
+from crdt_tpu import VClock
+from crdt_tpu.models import BatchedVClock
+from crdt_tpu.ops import vclock as ops
+from crdt_tpu.utils import Interner
+
+from strategies import ACTORS, seeds
+
+clock_dicts = st.dictionaries(
+    st.sampled_from(ACTORS), st.integers(min_value=1, max_value=5)
+)
+
+
+def batch(*dicts):
+    return BatchedVClock.from_pure([VClock(d) for d in dicts], actors=Interner(ACTORS))
+
+
+@given(clock_dicts, clock_dicts)
+def test_merge_bit_identical(da, db):
+    b = batch(da, db)
+    a_pure, b_pure = VClock(da), VClock(db)
+    a_pure.merge(b_pure)
+    b.merge_from(0, 1)
+    assert b.to_pure(0) == a_pure
+
+
+@given(clock_dicts, clock_dicts)
+def test_compare_matches_partial_cmp(da, db):
+    b = batch(da, db)
+    assert b.compare(0, 1) == VClock(da).partial_cmp(VClock(db))
+
+
+@given(clock_dicts, clock_dicts)
+def test_reset_remove_and_glb_and_without(da, db):
+    import jax.numpy as jnp
+
+    b = batch(da, db)
+    a_pure, b_pure = VClock(da), VClock(db)
+
+    reset = ops.reset_remove(b.clocks[0], b.clocks[1])
+    expect = a_pure.clone()
+    expect.reset_remove(b_pure)
+    got = BatchedVClock.from_pure([VClock()], actors=b.actors)
+    got.clocks = reset[None]
+    assert got.to_pure(0) == expect
+
+    met = ops.glb(b.clocks[0], b.clocks[1])
+    got.clocks = met[None]
+    assert got.to_pure(0) == a_pure.glb(b_pure)
+
+    without = ops.clone_without(b.clocks[0], b.clocks[1])
+    got.clocks = without[None]
+    assert got.to_pure(0) == a_pure.clone_without(b_pure)
+
+
+@given(seeds, st.integers(2, 8))
+def test_fold_matches_sequential_merge(seed, n):
+    rng = random.Random(seed)
+    pures = [
+        VClock({a: rng.randint(1, 9) for a in rng.sample(ACTORS, rng.randint(0, 4))})
+        for _ in range(n)
+    ]
+    b = BatchedVClock.from_pure(pures, actors=Interner(ACTORS))
+    expect = VClock()
+    for p in pures:
+        expect.merge(p)
+    assert b.fold() == expect
+
+
+def test_apply_and_inc_paths():
+    from crdt_tpu import Dot
+
+    b = BatchedVClock.from_pure([VClock(), VClock()], actors=Interner(ACTORS))
+    b.apply(0, Dot(ACTORS[0], 3))
+    b.apply(0, Dot(ACTORS[0], 2))  # stale
+    b.inc(1, ACTORS[1])
+    assert b.to_pure(0) == VClock({ACTORS[0]: 3})
+    assert b.to_pure(1) == VClock({ACTORS[1]: 1})
+
+
+@given(seeds)
+def test_pairwise_merge_matrix(seed):
+    rng = random.Random(seed)
+    pures = [
+        VClock({a: rng.randint(1, 9) for a in rng.sample(ACTORS, 2)})
+        for _ in range(4)
+    ]
+    b = BatchedVClock.from_pure(pures, actors=Interner(ACTORS))
+    mat = np.asarray(ops.pairwise_merge_matrix(b.clocks))
+    for i in range(4):
+        for j in range(4):
+            expect = pures[i].clone()
+            expect.merge(pures[j])
+            got = BatchedVClock.from_pure([VClock()], actors=b.actors)
+            got.clocks = mat[i, j][None]
+            assert got.to_pure(0) == expect

@@ -43,6 +43,20 @@ def test_lww_update_keeps_max_marker():
     assert r.read() == "y"
 
 
+def test_lww_fresh_register_is_bottom():
+    # Review regressions: a fresh register must lose to ANY marker type
+    # and merging a fresh register into a written one must be a no-op.
+    a = LWWReg("x", -1)
+    a.merge(LWWReg())
+    assert a.read() == "x" and a.marker == -1
+    b = LWWReg()
+    b.update("v", "string-marker")  # M: Ord genericity — str markers work
+    assert b.read() == "v"
+    c = LWWReg()
+    c.merge(LWWReg("y", ("tuple", 2)))
+    assert c.read() == "y"
+
+
 def test_lww_conflicting_marker_validation():
     r = LWWReg("x", 3)
     with pytest.raises(ConflictingMarker):
