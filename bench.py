@@ -1,0 +1,112 @@
+"""Benchmark of record: ORSWOT merges/sec, batched TPU fold vs the
+sequential CPU oracle (BASELINE.md metric of record, config 3 shape
+scaled to one chip).
+
+Prints exactly ONE JSON line on stdout:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``
+(all progress/diagnostics go to stderr).
+
+Method: R replicas over an E-member universe with A actors, dense dot
+matrices. TPU side times ``ops.fold`` (a log-tree of R-1 pairwise lattice
+joins — the reference's ``Orswot::merge`` per SURVEY.md §4.2). CPU
+baseline times the same serial merge fold through the pure oracle on a
+smaller replica count (per-merge cost is replica-count independent:
+every merge walks the same E-entry universe), reported as merges/sec.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+# Scaled config-3 shape; override via env for full-size runs.
+R = int(os.environ.get("BENCH_REPLICAS", 512))
+E = int(os.environ.get("BENCH_ELEMS", 4096))
+A = int(os.environ.get("BENCH_ACTORS", 8))
+R_CPU = int(os.environ.get("BENCH_CPU_REPLICAS", 8))
+ITERS = int(os.environ.get("BENCH_ITERS", 5))
+
+
+def make_arrays(r):
+    rng = np.random.default_rng(42)
+    # ~70% of (element, actor) dots present — a well-mixed replica set.
+    ctr = rng.integers(0, 100, (r, E, A)).astype(np.uint32)
+    ctr[rng.random((r, E, A)) < 0.3] = 0
+    top = np.maximum(ctr.max(axis=1), rng.integers(0, 100, (r, A)).astype(np.uint32))
+    return top, ctr
+
+
+def bench_tpu() -> float:
+    import jax
+
+    from crdt_tpu.ops import orswot as ops
+
+    log(f"jax backend: {jax.default_backend()}, devices: {jax.devices()}")
+    top, ctr = make_arrays(R)
+    state = ops.empty(E, A, deferred_cap=4, batch=(R,))
+    state = state._replace(
+        top=jax.device_put(jax.numpy.asarray(top)),
+        ctr=jax.device_put(jax.numpy.asarray(ctr)),
+    )
+    folded, _ = ops.fold(state)  # compile + warm
+    jax.block_until_ready(folded)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        folded, _ = ops.fold(state)
+        jax.block_until_ready(folded)
+    dt = (time.perf_counter() - t0) / ITERS
+    mps = (R - 1) / dt
+    log(f"TPU fold: {R} replicas x {E} elems x {A} actors: {dt*1e3:.1f} ms/fold -> {mps:,.0f} merges/s")
+    return mps
+
+
+def bench_cpu() -> float:
+    from crdt_tpu.pure.orswot import Orswot
+    from crdt_tpu.vclock import VClock
+
+    top, ctr = make_arrays(R_CPU)
+    reps = []
+    for i in range(R_CPU):
+        o = Orswot()
+        o.clock = VClock({a: int(c) for a, c in enumerate(top[i]) if c})
+        for e in range(E):
+            dots = {a: int(c) for a, c in enumerate(ctr[i, e]) if c}
+            if dots:
+                o.entries[e] = VClock(dots)
+        reps.append(o)
+    acc = Orswot()
+    t0 = time.perf_counter()
+    for r in reps:
+        acc.merge(r)
+    dt = time.perf_counter() - t0
+    mps = R_CPU / dt
+    log(f"CPU oracle fold: {R_CPU} merges over {E} elems: {dt*1e3:.1f} ms -> {mps:,.1f} merges/s")
+    return mps
+
+
+def main():
+    cpu_mps = bench_cpu()
+    tpu_mps = bench_tpu()
+    print(
+        json.dumps(
+            {
+                "metric": "orswot_merges_per_sec",
+                "value": round(tpu_mps, 1),
+                "unit": "merges/s",
+                "vs_baseline": round(tpu_mps / cpu_mps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -29,7 +29,7 @@ def _exact_sum(row) -> int:
 
 
 class BatchedGCounter:
-    def __init__(self, n_replicas: int, actors: Optional[Interner] = None, n_actors: int = 1):
+    def __init__(self, n_replicas: int, actors: Optional[Interner] = None, n_actors: Optional[int] = None):
         self.inner = BatchedVClock(n_replicas, actors=actors, n_actors=n_actors)
 
     @property
@@ -58,7 +58,7 @@ class BatchedGCounter:
 
 
 class BatchedPNCounter:
-    def __init__(self, n_replicas: int, actors: Optional[Interner] = None, n_actors: int = 1):
+    def __init__(self, n_replicas: int, actors: Optional[Interner] = None, n_actors: Optional[int] = None):
         actors = actors if actors is not None else Interner()
         self.p = BatchedVClock(n_replicas, actors=actors, n_actors=n_actors)
         self.n = BatchedVClock(n_replicas, actors=actors, n_actors=n_actors)

@@ -127,9 +127,12 @@ class BatchedOrswot:
             clock = VClock(
                 {self.actors[a]: int(c) for a, c in enumerate(st.dcl[d]) if c > 0}
             )
-            ms = {self.members[int(e)] for e in np.nonzero(st.dmask[d])[0]}
-            if ms:
-                out.deferred[clock] = ms
+            # Empty member sets are kept: the oracle's _defer_remove
+            # stores deferred[clock] = set() too, and losslessness of
+            # to_pure(from_pure(p)) is the A/B-gate contract.
+            out.deferred[clock] = {
+                self.members[int(e)] for e in np.nonzero(st.dmask[d])[0]
+            }
         return out
 
     # ---- op path (CmRDT) ----------------------------------------------

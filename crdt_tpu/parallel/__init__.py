@@ -1,0 +1,54 @@
+"""crdt_tpu.parallel — the distributed anti-entropy layer.
+
+The reference has no communication backend at all: every type derives
+serde and the *caller* ships bytes (SURVEY.md §3 row 17, §3.1). This
+package is the TPU-native replacement — the single biggest new piece vs
+the reference (SURVEY.md §6.8): replica state lives sharded over a
+``jax.sharding.Mesh`` and anti-entropy runs as XLA collectives over
+ICI/DCN instead of caller-transported bytes.
+
+Mesh axes (SURVEY.md §3.1 mapping):
+
+- ``replica`` — data-parallel analog: one lane per CRDT replica.
+- ``element`` — tensor/sequence-parallel analog: the member universe of
+  an ORSWOT (or key space of a Map) sharded across devices.
+
+Collectives provided (all usable inside ``jax.shard_map``):
+
+- :func:`collectives.all_reduce_join` — full-mesh anti-entropy collapsed
+  into one all-reduce with the ORSWOT lattice-join monoid (recursive
+  doubling over ICI; the north star's ``lax.all_reduce``).
+- :func:`collectives.all_reduce_clock` — the same for plain vector
+  clocks / counters (``lax.pmax``).
+- :func:`collectives.ring_round` — one ``ppermute`` gossip round
+  (pairwise anti-entropy; the ring-attention-shaped component).
+
+Top-level entry points (:mod:`.anti_entropy`) wrap these in
+``jax.shard_map`` over a mesh and are what models/bench/driver call.
+"""
+
+from .mesh import (
+    REPLICA_AXIS,
+    ELEMENT_AXIS,
+    make_mesh,
+    orswot_specs,
+    orswot_out_specs,
+    shard_orswot,
+)
+from .collectives import all_reduce_join, all_reduce_clock, ring_round
+from .anti_entropy import mesh_fold, mesh_fold_clocks, mesh_gossip
+
+__all__ = [
+    "REPLICA_AXIS",
+    "ELEMENT_AXIS",
+    "make_mesh",
+    "orswot_specs",
+    "orswot_out_specs",
+    "shard_orswot",
+    "all_reduce_join",
+    "all_reduce_clock",
+    "ring_round",
+    "mesh_fold",
+    "mesh_fold_clocks",
+    "mesh_gossip",
+]

@@ -1,0 +1,105 @@
+"""Lattice-join collectives — usable only inside ``jax.shard_map``.
+
+The reference ships serde bytes and lets the caller transport them
+(SURVEY.md §3.1); here replica exchange is an XLA collective over the
+mesh's ICI links. Because the ORSWOT join is associative, commutative
+and idempotent (a true lattice join — property-tested bit-identical to
+the oracle), a full mesh of N pairwise anti-entropy sessions collapses
+into ONE all-reduce with the join as the monoid:
+
+- power-of-two axis: **recursive doubling** — log2(P) rounds of
+  ``ppermute`` with partner ``rank ^ 2^k`` + local join; every device
+  ends with the global join (idempotence makes the overlap harmless,
+  which is exactly why this is sound for joins and unsound for sums).
+- any axis size: ``all_gather`` + local reduction tree.
+
+``ring_round`` is the incremental alternative: one neighbor exchange per
+call (gossip). P-1 rounds converge the whole ring — use when per-round
+bandwidth must stay at one state, e.g. across DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops import orswot as ops
+from ..ops.orswot import OrswotState
+
+
+def _axis_size(axis_name: str) -> int:
+    return lax.axis_size(axis_name)
+
+
+def all_reduce_clock(clock: jax.Array, axis_name: str) -> jax.Array:
+    """All-reduce with the VClock join monoid (element-wise max): this is
+    just ``lax.pmax`` — XLA's native max-allreduce rides ICI directly.
+    Covers VClock / GCounter / PNCounter anti-entropy (BASELINE configs
+    1–2). Reference: src/vclock.rs ``CvRDT::merge`` folded over replicas.
+    """
+    return lax.pmax(clock, axis_name)
+
+
+def all_reduce_join(
+    local: OrswotState, axis_name: str
+) -> Tuple[OrswotState, jax.Array]:
+    """All-reduce with the ORSWOT lattice-join monoid over a mesh axis.
+
+    ``local`` is one (unbatched) state per device. Returns the global
+    join (replicated across the axis) and a replicated overflow flag
+    (True if any deferred buffer overflowed anywhere — callers surface
+    it as ``DeferredOverflow``).
+
+    Reference semantics: src/orswot.rs ``CvRDT::merge`` applied along
+    every edge of the full replica mesh (SURVEY.md §4.2) — collapsed to
+    one collective per the north star.
+    """
+    size = _axis_size(axis_name)
+    overflow = jnp.zeros((), bool)
+    if size & (size - 1) == 0 and size > 1:
+        k = 1
+        while k < size:
+            perm = [(i, i ^ k) for i in range(size)]
+            other = jax.tree.map(
+                lambda x: lax.ppermute(x, axis_name, perm), local
+            )
+            local, of = ops.join(local, other)
+            overflow = overflow | of
+            k *= 2
+    elif size > 1:
+        gathered = jax.tree.map(
+            lambda x: lax.all_gather(x, axis_name, axis=0), local
+        )
+        local, overflow = ops.fold(gathered)
+    # Reduce the per-device overflow flags so the output is truly
+    # replicated (recursive-doubling pairings differ per device).
+    overflow = lax.psum(overflow.astype(jnp.int32), axis_name) > 0
+    return local, overflow
+
+
+def ring_round(
+    local: OrswotState,
+    axis_name: str,
+    shift: int = 1,
+    reduce_overflow: bool = True,
+) -> Tuple[OrswotState, jax.Array]:
+    """One gossip round: receive the state of the neighbor ``shift``
+    positions up-ring and join it in. P-1 unit-shift rounds converge all
+    devices (each accumulates every other's history transitively).
+    Per-round traffic: exactly one state per link — the bounded-bandwidth
+    anti-entropy mode (vs the log-round burst of ``all_reduce_join``).
+
+    With ``reduce_overflow=False`` the overflow flag is the raw
+    device-local one (callers looping rounds should accumulate raw flags
+    and reduce once at the end instead of paying a collective per round).
+    """
+    size = _axis_size(axis_name)
+    perm = [(i, (i + shift) % size) for i in range(size)]
+    other = jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), local)
+    joined, of = ops.join(local, other)
+    if reduce_overflow:
+        of = lax.psum(of.astype(jnp.int32), axis_name) > 0
+    return joined, of

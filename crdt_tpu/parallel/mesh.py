@@ -1,0 +1,110 @@
+"""Mesh construction and sharding layouts for batched CRDT state.
+
+The layouts put the *replica* axis and the *element* axis on the mesh and
+keep the (small) actor axis and deferred-buffer axis replicated — exactly
+the layout under which the ORSWOT join (ops/orswot.py) is element-wise
+per shard: entry survival depends only on that entry's birth clock and
+the two top clocks, so sharding E needs no communication at all, and the
+only collective anti-entropy needs is over the replica axis
+(SURVEY.md §6.7–6.8).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.orswot import OrswotState
+
+REPLICA_AXIS = "replica"
+ELEMENT_AXIS = "element"
+
+
+def make_mesh(n_replica_shards: int, n_element_shards: int = 1, devices: Sequence = None) -> Mesh:
+    """A ``(replica, element)`` device mesh.
+
+    Within one slice both axes ride ICI; multi-slice/multi-host
+    deployments should put ``replica`` on the DCN-facing (outer) axis —
+    replica-join traffic is one state per round, while element shards
+    never communicate.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = n_replica_shards * n_element_shards
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    grid = np.asarray(devices[:n]).reshape(n_replica_shards, n_element_shards)
+    return Mesh(grid, (REPLICA_AXIS, ELEMENT_AXIS))
+
+
+def orswot_specs() -> OrswotState:
+    """PartitionSpecs for a batched ``OrswotState`` [R, ...]: replicas and
+    elements on the mesh, actor lanes and deferred slots replicated."""
+    return OrswotState(
+        top=P(REPLICA_AXIS, None),
+        ctr=P(REPLICA_AXIS, ELEMENT_AXIS, None),
+        dcl=P(REPLICA_AXIS, None, None),
+        dmask=P(REPLICA_AXIS, None, ELEMENT_AXIS),
+        dvalid=P(REPLICA_AXIS, None),
+    )
+
+
+def orswot_out_specs() -> OrswotState:
+    """Specs for the *converged* (replica-reduced) state: replicated over
+    the replica axis, still element-sharded."""
+    return OrswotState(
+        top=P(None),
+        ctr=P(ELEMENT_AXIS, None),
+        dcl=P(None, None),
+        dmask=P(None, ELEMENT_AXIS),
+        dvalid=P(None),
+    )
+
+
+def pad_replicas(state: OrswotState, multiple: int) -> OrswotState:
+    """Pad the replica axis up to a multiple with join identities (the
+    empty state) so it divides the mesh's replica axis. Identity rows are
+    absorbed by the join without affecting the result."""
+    import jax.numpy as jnp
+
+    from ..ops.orswot import empty
+
+    pad = (-state.top.shape[0]) % multiple
+    if pad == 0:
+        return state
+    ident = empty(
+        state.ctr.shape[-2], state.ctr.shape[-1], state.dcl.shape[-2], batch=(pad,)
+    )
+    return jax.tree.map(
+        lambda x, p: jnp.concatenate([x, p.astype(x.dtype)], axis=0), state, ident
+    )
+
+
+def pad_elements(state: OrswotState, multiple: int) -> OrswotState:
+    """Pad the element axis with never-present slots so it divides the
+    mesh's element axis. Padded slots hold no dots and are never read."""
+    import jax.numpy as jnp
+
+    pad = (-state.ctr.shape[-2]) % multiple
+    if pad == 0:
+        return state
+    return state._replace(
+        ctr=jnp.pad(state.ctr, ((0, 0), (0, pad), (0, 0))),
+        dmask=jnp.pad(state.dmask, ((0, 0), (0, 0), (0, pad))),
+    )
+
+
+def shard_orswot(state: OrswotState, mesh: Mesh) -> OrswotState:
+    """Place a batched state onto the mesh with the canonical layout,
+    padding both batch axes to divisibility (see pad_replicas /
+    pad_elements — padding is absorbed by the join)."""
+    state = pad_replicas(state, mesh.shape[REPLICA_AXIS])
+    state = pad_elements(state, mesh.shape[ELEMENT_AXIS])
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        state,
+        orswot_specs(),
+    )

@@ -35,6 +35,10 @@ def _canonical_bytes(value: Any) -> bytes:
         return frame(b"s", value.encode("utf-8"))
     if isinstance(value, int):
         return frame(b"i", str(value).encode())
+    if isinstance(value, float):
+        # CPython float repr is the shortest round-tripping IEEE-754
+        # decimal — stable across processes and platforms.
+        return frame(b"f", repr(value).encode())
     if isinstance(value, (tuple, list)):
         return frame(b"l", b"".join(_canonical_bytes(v) for v in value))
     if isinstance(value, (set, frozenset)):
@@ -48,7 +52,7 @@ def _canonical_bytes(value: Any) -> bytes:
         return frame(b"n", b"")
     raise TypeError(
         f"cannot canonically hash {type(value).__name__}: repr() is not "
-        "stable across processes; use bytes/str/int/bool/None or "
+        "stable across processes; use bytes/str/int/float/bool/None or "
         "list/tuple/set/dict compositions of them"
     )
 

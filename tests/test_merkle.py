@@ -98,3 +98,12 @@ def test_merkle_laws_and_convergence(seed):
             m.merge(other)
         merged.append(m)
     assert_all_equal(merged)
+
+
+def test_float_values_hash_stably():
+    r = MerkleReg()
+    n = r.write(1.5, parents=frozenset())
+    r.apply(n)
+    assert set(r.read().values()) == {1.5}
+    # same value, same parents -> same content hash
+    assert r.write(1.5, parents=frozenset()).hash() == n.hash()

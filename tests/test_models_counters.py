@@ -73,3 +73,17 @@ def test_interner_growth_raises_not_silently_drops():
     g.actors.intern("B")
     with pytest.raises(IndexError):
         g.inc(0, "B")
+
+
+def test_interner_sizes_actor_lanes_by_default():
+    # n_actors default must size from the interner (was hardcoded to 1).
+    from crdt_tpu.utils import Interner
+
+    actors = Interner()
+    actors.intern("a"); actors.intern("b")
+    g = BatchedGCounter(2, actors=actors)
+    g.inc(0, "b")
+    assert g.read(0) == 1
+    pn = BatchedPNCounter(2, actors=actors)
+    pn.inc(0, "b"); pn.dec(1, "a")
+    assert pn.fold_read() == 0

@@ -164,3 +164,19 @@ def test_device_join_laws(seed):
 
     aa = dev(a, a); aa.merge_from(0, 1)
     assert aa.to_pure(0) == a, "device join not idempotent"
+
+
+def test_to_pure_keeps_empty_deferred_slot():
+    # Rm of an empty member set with an ahead clock: the oracle parks
+    # deferred[clock] = set() (the reference's or_default().extend), so
+    # to_pure(from_pure(p)) must round-trip it losslessly.
+    from crdt_tpu.pure.orswot import Rm
+    from crdt_tpu.vclock import VClock
+
+    p = Orswot()
+    p.apply(p.add("m", p.read().derive_add_ctx("a")))
+    ahead = VClock({"a": 5, "b": 3})
+    p.apply(Rm(clock=ahead, members=frozenset()))
+    assert ahead in p.deferred and p.deferred[ahead] == set()
+    dev = BatchedOrswot.from_pure([p])
+    assert dev.to_pure(0) == p

@@ -1,0 +1,144 @@
+"""Mesh anti-entropy vs the sequential oracle — the distributed half of
+the bit-identical A/B gate (SURVEY.md §5, §6.2: reduction-order
+invariance is the race-detector analog for this framework).
+
+Runs on the 8-virtual-CPU-device mesh from conftest.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from crdt_tpu.models.orswot import BatchedOrswot
+from crdt_tpu.parallel import (
+    make_mesh,
+    mesh_fold,
+    mesh_fold_clocks,
+    mesh_gossip,
+    shard_orswot,
+)
+from crdt_tpu.pure.orswot import Orswot
+from crdt_tpu.vclock import VClock
+
+
+def _random_replicas(rng_data, n_replicas, members, actors):
+    """Build n oracle replicas from a shared op history with random
+    delivery (every op applied to a random subset, always its origin)."""
+    reps = [Orswot() for _ in range(n_replicas)]
+    n_ops = rng_data.draw(st.integers(5, 25))
+    for _ in range(n_ops):
+        origin = rng_data.draw(st.integers(0, n_replicas - 1))
+        m = rng_data.draw(st.sampled_from(members))
+        actor = rng_data.draw(st.sampled_from(actors))
+        if rng_data.draw(st.booleans()) or not reps[origin].read().val:
+            op = reps[origin].add(m, reps[origin].read().derive_add_ctx(actor))
+        else:
+            victim = rng_data.draw(st.sampled_from(sorted(reps[origin].read().val)))
+            op = reps[origin].rm(
+                victim, reps[origin].contains(victim).derive_rm_ctx()
+            )
+        for i in range(n_replicas):
+            if i == origin or rng_data.draw(st.booleans()):
+                reps[i].apply(op)
+    return reps
+
+
+def _oracle_fold(reps):
+    acc = Orswot()
+    for r in reps:
+        acc.merge(r)
+    return acc
+
+
+# (3, 1) and (6, 1) exercise the non-power-of-two all_gather fallback in
+# all_reduce_join; the pow2 shapes exercise recursive doubling.
+@pytest.mark.parametrize(
+    "mesh_shape", [(8, 1), (4, 2), (2, 4), (1, 8), (3, 1), (6, 1)]
+)
+@given(data=st.data())
+@settings(max_examples=10, deadline=None)
+def test_mesh_fold_bit_identical(mesh_shape, data):
+    members = ["a", "b", "c", "d"]
+    actors = ["p", "q", "r"]
+    n_replicas = data.draw(st.integers(2, 12))
+    reps = _random_replicas(data, n_replicas, members, actors)
+
+    batched = BatchedOrswot.from_pure(reps)
+    mesh = make_mesh(*mesh_shape)
+    sharded = shard_orswot(batched.state, mesh)
+    folded, overflow = mesh_fold(sharded, mesh)
+    assert not bool(overflow)
+
+    out = BatchedOrswot(
+        1,
+        folded.ctr.shape[-2],
+        folded.ctr.shape[-1],
+        folded.dcl.shape[-2],
+        members=batched.members,
+        actors=batched.actors,
+    )
+    out.state = jax.tree.map(lambda x: x[None], folded)
+    assert out.to_pure(0) == _oracle_fold(reps)
+
+
+@given(data=st.data())
+@settings(max_examples=8, deadline=None)
+def test_mesh_gossip_converges_to_fold(data):
+    members = ["x", "y", "z"]
+    actors = ["p", "q"]
+    n_replicas = data.draw(st.integers(2, 10))
+    reps = _random_replicas(data, n_replicas, members, actors)
+    batched = BatchedOrswot.from_pure(reps)
+    mesh = make_mesh(4, 2)
+    sharded = shard_orswot(batched.state, mesh)
+    gossiped, overflow = mesh_gossip(sharded, mesh)  # default P-1 rounds
+    assert not bool(overflow)
+
+    oracle = _oracle_fold(reps)
+    for i in range(gossiped.top.shape[0]):
+        out = BatchedOrswot(
+            1,
+            gossiped.ctr.shape[-2],
+            gossiped.ctr.shape[-1],
+            gossiped.dcl.shape[-2],
+            members=batched.members,
+            actors=batched.actors,
+        )
+        out.state = jax.tree.map(lambda x: x[i][None], gossiped)
+        assert out.to_pure(0) == oracle
+
+
+@given(data=st.data())
+@settings(max_examples=10, deadline=None)
+def test_mesh_fold_clocks_bit_identical(data):
+    n_replicas = data.draw(st.integers(1, 20))
+    n_actors = data.draw(st.integers(1, 6))
+    rows = [
+        [data.draw(st.integers(0, 50)) for _ in range(n_actors)]
+        for _ in range(n_replicas)
+    ]
+    clocks = jnp.asarray(rows, jnp.uint32)
+    mesh = make_mesh(8, 1)
+    folded = mesh_fold_clocks(clocks, mesh)
+
+    oracle = VClock()
+    for row in rows:
+        oracle.merge(VClock({a: c for a, c in enumerate(row) if c}))
+    got = {a: int(c) for a, c in enumerate(jax.device_get(folded)) if c}
+    assert got == oracle.dots
+
+
+def test_mesh_fold_single_replica_identity():
+    mesh = make_mesh(8, 1)
+    p = Orswot()
+    p.apply(p.add("m", p.read().derive_add_ctx("a")))
+    batched = BatchedOrswot.from_pure([p])
+    folded, overflow = mesh_fold(shard_orswot(batched.state, mesh), mesh)
+    assert not bool(overflow)
+    out = BatchedOrswot(1, folded.ctr.shape[-2], folded.ctr.shape[-1],
+                        folded.dcl.shape[-2], members=batched.members,
+                        actors=batched.actors)
+    out.state = jax.tree.map(lambda x: x[None], folded)
+    assert out.to_pure(0) == p
