@@ -13,5 +13,16 @@ state (SURVEY.md §7.1) and exposes:
 from .vclock import BatchedVClock
 from .counters import BatchedGCounter, BatchedPNCounter
 from .orswot import BatchedOrswot
+from .gset import BatchedGSet
+from .registers import BatchedLWWReg, BatchedMVReg, SlotOverflow
 
-__all__ = ["BatchedVClock", "BatchedGCounter", "BatchedPNCounter", "BatchedOrswot"]
+__all__ = [
+    "BatchedVClock",
+    "BatchedGCounter",
+    "BatchedPNCounter",
+    "BatchedOrswot",
+    "BatchedGSet",
+    "BatchedLWWReg",
+    "BatchedMVReg",
+    "SlotOverflow",
+]

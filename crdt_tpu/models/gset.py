@@ -1,0 +1,67 @@
+"""BatchedGSet — N G-Set replicas as a device membership bitmask.
+
+Oracle: ``crdt_tpu.pure.gset.GSet`` (reference: src/gset.rs). The replica
+batch is ``present[R, E]`` over a fixed interned member universe; merge is
+logical OR and full-mesh anti-entropy is one ``any`` reduction.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import gset as ops
+from ..pure.gset import GSet
+from ..utils import Interner
+
+
+class BatchedGSet:
+    def __init__(self, n_replicas: int, n_members: int, members: Optional[Interner] = None):
+        self.members = members if members is not None else Interner()
+        self.present = ops.zeros(n_members, batch=(n_replicas,))
+
+    @property
+    def n_replicas(self) -> int:
+        return self.present.shape[0]
+
+    @classmethod
+    def from_pure(cls, pures: Sequence[GSet], members: Optional[Interner] = None) -> "BatchedGSet":
+        members = members if members is not None else Interner()
+        for p in pures:
+            for m in sorted(p.value, key=repr):
+                members.intern(m)
+        arr = np.zeros((len(pures), max(len(members), 1)), bool)
+        for i, p in enumerate(pures):
+            for m in p.value:
+                arr[i, members.id_of(m)] = True
+        out = cls(len(pures), arr.shape[1], members=members)
+        out.present = jnp.asarray(arr)
+        return out
+
+    def to_pure(self, i: int) -> GSet:
+        row = np.asarray(self.present[i])
+        return GSet(self.members[int(e)] for e in np.nonzero(row)[0])
+
+    def insert(self, replica: int, member) -> None:
+        mid = self.members.intern(member)
+        if mid >= self.present.shape[-1]:
+            raise IndexError(
+                f"member id {mid} outside the {self.present.shape[-1]}-lane universe"
+            )
+        self.present = self.present.at[replica, mid].set(True)
+
+    def contains(self, replica: int, member) -> bool:
+        if member not in self.members:
+            return False
+        return bool(self.present[replica, self.members.id_of(member)])
+
+    def merge_from(self, dst: int, src: int) -> None:
+        self.present = self.present.at[dst].set(
+            ops.join(self.present[dst], self.present[src])
+        )
+
+    def fold(self) -> GSet:
+        row = np.asarray(ops.fold(self.present))
+        return GSet(self.members[int(e)] for e in np.nonzero(row)[0])

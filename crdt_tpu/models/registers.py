@@ -1,0 +1,235 @@
+"""Batched register models — LWWReg (max-marker select) and MVReg
+(sibling slots) on device.
+
+Oracles: ``crdt_tpu.pure.lwwreg.LWWReg`` (reference: src/lwwreg.rs) and
+``crdt_tpu.pure.mvreg.MVReg`` (reference: src/mvreg.rs). Device constraint
+(documented deviation): LWW markers must be integers in [0, 2^64) —
+the two-u32-lane device encoding; the pure oracle keeps the reference's
+full ``M: Ord`` genericity. Values of both registers are interned to
+dense ids (host table, like actors/members everywhere else).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dot import Dot
+from ..ops import lwwreg as lww_ops
+from ..ops import mvreg as mv_ops
+from ..pure.lwwreg import UNSET, LWWReg
+from ..pure.mvreg import MVReg, Put
+from ..traits import ConflictingMarker
+from ..utils import Interner
+from ..vclock import VClock
+
+
+class SlotOverflow(RuntimeError):
+    """A sibling could not be held: the slot buffer exceeded its static
+    capacity. Raise rather than silently dropping concurrent writes —
+    rebuild the model with a larger ``n_slots``."""
+
+
+def _split_marker(marker: int):
+    if not isinstance(marker, int) or not (0 <= marker < 2**64):
+        raise TypeError(
+            f"device LWW markers must be ints in [0, 2**64), got {marker!r}"
+        )
+    return marker >> 32, marker & 0xFFFFFFFF
+
+
+class BatchedLWWReg:
+    def __init__(self, n_replicas: int, values: Optional[Interner] = None):
+        self.values = values if values is not None else Interner()
+        self.state = lww_ops.empty(batch=(n_replicas,))
+
+    @property
+    def n_replicas(self) -> int:
+        return self.state.hi.shape[0]
+
+    @classmethod
+    def from_pure(cls, pures: Sequence[LWWReg], values: Optional[Interner] = None) -> "BatchedLWWReg":
+        values = values if values is not None else Interner()
+        hi = np.zeros(len(pures), np.uint32)
+        lo = np.zeros(len(pures), np.uint32)
+        val = np.zeros(len(pures), np.int32)
+        has = np.zeros(len(pures), bool)
+        for i, p in enumerate(pures):
+            if p.val is UNSET:
+                continue
+            h, l = _split_marker(p.marker)
+            hi[i], lo[i] = h, l
+            val[i] = values.intern(p.val)
+            has[i] = True
+        out = cls(len(pures), values=values)
+        out.state = lww_ops.LWWState(
+            hi=jnp.asarray(hi), lo=jnp.asarray(lo), val=jnp.asarray(val), has=jnp.asarray(has)
+        )
+        return out
+
+    def to_pure(self, i: int) -> LWWReg:
+        if not bool(self.state.has[i]):
+            return LWWReg()
+        marker = (int(self.state.hi[i]) << 32) | int(self.state.lo[i])
+        return LWWReg(self.values[int(self.state.val[i])], marker)
+
+    def update(self, replica: int, val, marker: int) -> None:
+        """Reference: src/lwwreg.rs ``update`` + validation."""
+        h, l = _split_marker(marker)
+        row = jax.tree.map(lambda x: x[replica], self.state)
+        row, conflict = lww_ops.apply_update(
+            row, jnp.asarray(h, jnp.uint32), jnp.asarray(l, jnp.uint32),
+            jnp.asarray(self.values.intern(val), jnp.int32),
+        )
+        if bool(conflict):
+            raise ConflictingMarker(
+                f"replica {replica}: marker {marker!r} already guards a different value"
+            )
+        self.state = jax.tree.map(
+            lambda full, r: full.at[replica].set(r), self.state, row
+        )
+
+    def merge_from(self, dst: int, src: int) -> None:
+        row, conflict = lww_ops.join(
+            jax.tree.map(lambda x: x[dst], self.state),
+            jax.tree.map(lambda x: x[src], self.state),
+        )
+        if bool(conflict):
+            raise ConflictingMarker(f"merge {src}->{dst}: equal markers, different values")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[dst].set(r), self.state, row
+        )
+
+    def fold(self) -> LWWReg:
+        folded, conflict = lww_ops.fold(self.state)
+        if bool(conflict):
+            raise ConflictingMarker("fold: equal markers guard different values")
+        tmp = BatchedLWWReg(1, values=self.values)
+        tmp.state = jax.tree.map(lambda x: x[None], folded)
+        return tmp.to_pure(0)
+
+
+class BatchedMVReg:
+    def __init__(
+        self,
+        n_replicas: int,
+        n_actors: int,
+        n_slots: int = 8,
+        actors: Optional[Interner] = None,
+        values: Optional[Interner] = None,
+    ):
+        self.actors = actors if actors is not None else Interner()
+        self.values = values if values is not None else Interner()
+        self.state = mv_ops.empty(n_slots, n_actors, batch=(n_replicas,))
+
+    @property
+    def n_replicas(self) -> int:
+        return self.state.wact.shape[0]
+
+    @classmethod
+    def from_pure(
+        cls,
+        pures: Sequence[MVReg],
+        actors: Optional[Interner] = None,
+        values: Optional[Interner] = None,
+        n_slots: int = 8,
+    ) -> "BatchedMVReg":
+        actors = actors if actors is not None else Interner()
+        values = values if values is not None else Interner()
+        for p in pures:
+            for dot, (clock, v) in p.vals.items():
+                actors.intern(dot.actor)
+                for a in clock.dots:
+                    actors.intern(a)
+                values.intern(v)
+
+        r, a = len(pures), max(len(actors), 1)
+        out = cls(r, a, n_slots=n_slots, actors=actors, values=values)
+        wact = np.zeros((r, n_slots), np.int32)
+        wctr = np.zeros((r, n_slots), np.uint32)
+        clk = np.zeros((r, n_slots, a), np.uint32)
+        val = np.zeros((r, n_slots), np.int32)
+        valid = np.zeros((r, n_slots), bool)
+        for i, p in enumerate(pures):
+            if len(p.vals) > n_slots:
+                raise ValueError(
+                    f"replica {i} has {len(p.vals)} siblings; capacity is {n_slots}"
+                )
+            for s, (dot, (clock, v)) in enumerate(p.vals.items()):
+                wact[i, s] = actors.id_of(dot.actor)
+                wctr[i, s] = dot.counter
+                for actor, c in clock.dots.items():
+                    clk[i, s, actors.id_of(actor)] = c
+                val[i, s] = values.id_of(v)
+                valid[i, s] = True
+        out.state = mv_ops.MVRegState(
+            wact=jnp.asarray(wact), wctr=jnp.asarray(wctr), clk=jnp.asarray(clk),
+            val=jnp.asarray(val), valid=jnp.asarray(valid),
+        )
+        return out
+
+    def to_pure(self, i: int) -> MVReg:
+        st = jax.device_get(jax.tree.map(lambda x: x[i], self.state))
+        out = MVReg()
+        for s in np.nonzero(st.valid)[0]:
+            dot = Dot(self.actors[int(st.wact[s])], int(st.wctr[s]))
+            clock = VClock(
+                {self.actors[a]: int(c) for a, c in enumerate(st.clk[s]) if c > 0}
+            )
+            out.vals[dot] = (clock, self.values[int(st.val[s])])
+        return out
+
+    def apply(self, replica: int, op: Put) -> None:
+        """Apply an oracle-shaped Put to one replica (reference:
+        src/mvreg.rs ``CmRDT::apply``)."""
+        a = self.state.clk.shape[-1]
+        aid = self.actors.id_of(op.dot.actor)
+        if aid >= a:
+            raise IndexError(f"actor id {aid} outside the {a}-lane universe")
+        cl = np.zeros((a,), np.uint32)
+        for actor, c in op.clock.dots.items():
+            cl[self.actors.id_of(actor)] = c
+        row = jax.tree.map(lambda x: x[replica], self.state)
+        row, overflow = mv_ops.apply_put(
+            row,
+            jnp.asarray(aid, jnp.int32),
+            jnp.asarray(op.dot.counter, jnp.uint32),
+            jnp.asarray(cl),
+            jnp.asarray(self.values.intern(op.val), jnp.int32),
+        )
+        if bool(overflow):
+            raise SlotOverflow(
+                f"replica {replica}: sibling slots full (cap {self.state.valid.shape[-1]})"
+            )
+        self.state = jax.tree.map(
+            lambda full, r: full.at[replica].set(r), self.state, row
+        )
+
+    def merge_from(self, dst: int, src: int) -> None:
+        row, overflow = mv_ops.join(
+            jax.tree.map(lambda x: x[dst], self.state),
+            jax.tree.map(lambda x: x[src], self.state),
+        )
+        if bool(overflow):
+            raise SlotOverflow(
+                f"merge {src}->{dst}: sibling slots full (cap {self.state.valid.shape[-1]})"
+            )
+        self.state = jax.tree.map(
+            lambda full, r: full.at[dst].set(r), self.state, row
+        )
+
+    def fold(self) -> MVReg:
+        folded, overflow = mv_ops.fold(self.state)
+        if bool(overflow):
+            raise SlotOverflow(
+                f"fold: sibling slots full (cap {self.state.valid.shape[-1]})"
+            )
+        tmp = BatchedMVReg(
+            1, self.state.clk.shape[-1], self.state.valid.shape[-1],
+            actors=self.actors, values=self.values,
+        )
+        tmp.state = jax.tree.map(lambda x: x[None], folded)
+        return tmp.to_pure(0)

@@ -10,3 +10,6 @@ from them is the TPU equivalent of the reference's compiled Rust.
 
 from . import vclock  # noqa: F401
 from . import orswot  # noqa: F401
+from . import gset  # noqa: F401
+from . import lwwreg  # noqa: F401
+from . import mvreg  # noqa: F401

@@ -1,0 +1,40 @@
+"""Dense G-Set kernels — the simplest lattice (union as logical OR).
+
+State is a membership bitmask ``present[..., E]`` over an interned member
+universe of E elements; leading axes batch replicas. Oracle:
+``crdt_tpu.pure.gset.GSet`` (reference: src/gset.rs — merge = set union,
+Op = M). Union over a replica batch is one ``any`` reduction, so full-mesh
+anti-entropy of R replicas is a single VPU pass.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def zeros(n_members: int, batch: tuple = ()) -> jax.Array:
+    return jnp.zeros((*batch, n_members), dtype=bool)
+
+
+@jax.jit
+def join(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Lattice join = set union. Reference: src/gset.rs CvRDT::merge."""
+    return a | b
+
+
+@jax.jit
+def fold(present: jax.Array) -> jax.Array:
+    """N-way union over the leading replica axis."""
+    return jnp.any(present, axis=0)
+
+
+@jax.jit
+def insert(present: jax.Array, member: jax.Array) -> jax.Array:
+    """CmRDT apply (Op = the member id). Reference: src/gset.rs insert."""
+    return present.at[..., member].set(True)
+
+
+@jax.jit
+def contains(present: jax.Array, member: jax.Array) -> jax.Array:
+    return present[..., member]

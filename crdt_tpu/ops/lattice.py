@@ -1,0 +1,48 @@
+"""Shared lattice-reduction machinery.
+
+``tree_fold`` collapses a replica batch (leading axis) with any pairwise
+lattice join in a log2 reduction tree — sound because every join in this
+package is associative, commutative, and idempotent (the property suite
+asserts this on device shapes, SURVEY.md §7.3 "deterministic reduction").
+The batch is padded to a power of two with join identities, which the
+join absorbs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_fold(
+    states: Any,
+    identity: Any,
+    join: Callable[[Any, Any], Tuple[Any, jax.Array]],
+) -> Tuple[Any, jax.Array]:
+    """Fold ``states`` (a pytree batched on the leading axis) with
+    ``join(a, b) -> (joined, flag)``; ``identity`` is one unbatched join
+    identity. Returns ``(folded, any_flag)`` — flags (overflow/conflict)
+    are OR-accumulated across every pairwise join."""
+    flagged = jnp.zeros((), bool)
+    r = jax.tree.leaves(states)[0].shape[0]
+    pow2 = 1
+    while pow2 < r:
+        pow2 *= 2
+    if pow2 != r:
+        pad = jax.tree.map(
+            lambda e, s: jnp.broadcast_to(e, (pow2 - r, *e.shape)).astype(s.dtype),
+            identity,
+            states,
+        )
+        states = jax.tree.map(lambda s, p: jnp.concatenate([s, p], axis=0), states, pad)
+        r = pow2
+    while r > 1:
+        half = r // 2
+        left = jax.tree.map(lambda x: x[:half], states)
+        right = jax.tree.map(lambda x: x[half:], states)
+        states, flag = jax.vmap(join)(left, right)
+        flagged = flagged | jnp.any(flag)
+        r = half
+    return jax.tree.map(lambda x: x[0], states), flagged

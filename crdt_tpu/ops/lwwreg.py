@@ -1,0 +1,94 @@
+"""Dense LWW-register kernels — max-marker select.
+
+State (``LWWState``, leading axes batch replicas):
+
+- ``hi``/``lo [...]`` — the marker as two uint32 lanes compared
+  lexicographically (so 64-bit timestamps survive JAX's x64-disabled
+  default),
+- ``val [...]``      — interned value id (int32),
+- ``has [...]``      — written-at-least-once mask (a fresh register's
+  marker is the reference's implicit bottom).
+
+``join`` keeps the strictly-newer write; an equal marker guarding a
+different value raises the reference's conflicting-marker validation error
+at the model layer via the returned ``conflict`` mask. Oracle:
+``crdt_tpu.pure.lwwreg.LWWReg`` (reference: src/lwwreg.rs — update keeps
+max marker; validate_merge rejects equal-marker/different-val).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+MARKER_DTYPE = jnp.uint32
+VAL_DTYPE = jnp.int32
+
+
+class LWWState(NamedTuple):
+    hi: jax.Array   # [...]
+    lo: jax.Array   # [...]
+    val: jax.Array  # [...]
+    has: jax.Array  # [...]
+
+
+def empty(batch: tuple = ()) -> LWWState:
+    return LWWState(
+        hi=jnp.zeros(batch, MARKER_DTYPE),
+        lo=jnp.zeros(batch, MARKER_DTYPE),
+        val=jnp.zeros(batch, VAL_DTYPE),
+        has=jnp.zeros(batch, bool),
+    )
+
+
+def _newer(a: LWWState, b: LWWState) -> jax.Array:
+    """b's marker strictly above a's (lexicographic on (hi, lo)),
+    or a never written."""
+    gt = (b.hi > a.hi) | ((b.hi == a.hi) & (b.lo > a.lo))
+    return b.has & (~a.has | gt)
+
+
+@jax.jit
+def join(a: LWWState, b: LWWState):
+    """Keep the max-marker write. Returns ``(state, conflict)`` where
+    ``conflict`` marks lanes with equal markers guarding different values
+    (reference: src/lwwreg.rs validate_merge) — callers must surface it."""
+    take_b = _newer(a, b)
+    out = LWWState(
+        hi=jnp.where(take_b, b.hi, a.hi),
+        lo=jnp.where(take_b, b.lo, a.lo),
+        val=jnp.where(take_b, b.val, a.val),
+        has=a.has | b.has,
+    )
+    conflict = (
+        a.has
+        & b.has
+        & (a.hi == b.hi)
+        & (a.lo == b.lo)
+        & (a.val != b.val)
+    )
+    return out, conflict
+
+
+def fold(states: LWWState):
+    """Join over the leading replica axis via a log2 reduction tree.
+    Returns ``(state, conflict)``; conflict is any-reduced."""
+    from .lattice import tree_fold
+
+    return tree_fold(states, empty(), join)
+
+
+@jax.jit
+def apply_update(state: LWWState, hi, lo, val):
+    """CmRDT apply: take (val, marker) iff strictly newer (equal markers
+    keep the incumbent — idempotent replay). Returns ``(state, conflict)``.
+    Reference: src/lwwreg.rs LWWReg::update."""
+    put = LWWState(
+        hi=jnp.asarray(hi, MARKER_DTYPE),
+        lo=jnp.asarray(lo, MARKER_DTYPE),
+        val=jnp.asarray(val, VAL_DTYPE),
+        has=jnp.ones(jnp.shape(hi), bool),
+    )
+    return join(state, put)

@@ -1,0 +1,151 @@
+"""Dense MV-register kernels — sibling slots under domination filtering.
+
+State (``MVRegState``): S sibling slots over an A-actor universe, leading
+axes batch replicas:
+
+- ``wact``/``wctr [..., S]`` — each sibling's witness dot (the AddCtx dot
+  that minted the write; the DotFun key, see pure/mvreg.py),
+- ``clk [..., S, A]``       — each sibling's full write clock,
+- ``val [..., S]``          — interned value id,
+- ``valid [..., S]``        — live-slot mask.
+
+``join`` is the reference's merge (src/mvreg.rs): a sibling survives iff no
+sibling on the other side strictly dominates its write clock; surviving
+slots are unioned, deduped by witness dot (same dot ⇒ same content), and
+compacted to capacity with an overflow flag (like the ORSWOT deferred
+buffer — models raise rather than drop siblings). Oracle:
+``crdt_tpu.pure.mvreg.MVReg``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+DTYPE = jnp.uint32
+
+
+class MVRegState(NamedTuple):
+    wact: jax.Array   # [..., S] int32
+    wctr: jax.Array   # [..., S] uint32
+    clk: jax.Array    # [..., S, A] uint32
+    val: jax.Array    # [..., S] int32
+    valid: jax.Array  # [..., S] bool
+
+
+def empty(n_slots: int, n_actors: int, batch: tuple = ()) -> MVRegState:
+    return MVRegState(
+        wact=jnp.zeros((*batch, n_slots), jnp.int32),
+        wctr=jnp.zeros((*batch, n_slots), DTYPE),
+        clk=jnp.zeros((*batch, n_slots, n_actors), DTYPE),
+        val=jnp.zeros((*batch, n_slots), jnp.int32),
+        valid=jnp.zeros((*batch, n_slots), bool),
+    )
+
+
+def _strictly_dominated(clk_a, valid_a, clk_b, valid_b) -> jax.Array:
+    """For each slot i of a: ∃ valid j in b with clk_a[i] < clk_b[j]
+    (partial-order strict less: all lanes ≤ and some lane <)."""
+    le = jnp.all(clk_a[..., :, None, :] <= clk_b[..., None, :, :], axis=-1)
+    lt = jnp.any(clk_a[..., :, None, :] < clk_b[..., None, :, :], axis=-1)
+    strict = le & lt & valid_a[..., :, None] & valid_b[..., None, :]
+    return jnp.any(strict, axis=-1)
+
+
+def _dedupe_by_witness(state: MVRegState) -> MVRegState:
+    """Drop later slots whose witness dot equals an earlier valid slot's
+    (same dot ⇒ same content, the oracle's dict-key union)."""
+    s = state.wact.shape[-1]
+    idx = jnp.arange(s)
+    eq = (
+        state.valid[..., :, None]
+        & state.valid[..., None, :]
+        & (state.wact[..., :, None] == state.wact[..., None, :])
+        & (state.wctr[..., :, None] == state.wctr[..., None, :])
+    )
+    rep = jnp.argmax(eq, axis=-2)  # first valid slot with the same dot
+    keep = state.valid & (rep == idx)
+    return state._replace(valid=keep)
+
+
+def _compact(state: MVRegState, cap: int):
+    """Stable-sort valid slots to the front, truncate to capacity, zero
+    dead payload (canonical form so converged replicas compare equal)."""
+    order = jnp.argsort(~state.valid, axis=-1, stable=True)
+    wact = jnp.take_along_axis(state.wact, order, axis=-1)
+    wctr = jnp.take_along_axis(state.wctr, order, axis=-1)
+    clk = jnp.take_along_axis(state.clk, order[..., None], axis=-2)
+    val = jnp.take_along_axis(state.val, order, axis=-1)
+    valid = jnp.take_along_axis(state.valid, order, axis=-1)
+    overflow = jnp.sum(valid, axis=-1) > cap
+    wact, wctr, clk = wact[..., :cap], wctr[..., :cap], clk[..., :cap, :]
+    val, valid = val[..., :cap], valid[..., :cap]
+    return (
+        MVRegState(
+            wact=jnp.where(valid, wact, 0),
+            wctr=jnp.where(valid, wctr, 0),
+            clk=jnp.where(valid[..., None], clk, 0),
+            val=jnp.where(valid, val, 0),
+            valid=valid,
+        ),
+        overflow,
+    )
+
+
+@jax.jit
+def join(a: MVRegState, b: MVRegState):
+    """Pairwise merge: drop strictly-dominated siblings, union the rest.
+    Returns ``(state, overflow)``. Reference: src/mvreg.rs CvRDT::merge."""
+    keep_a = a.valid & ~_strictly_dominated(a.clk, a.valid, b.clk, b.valid)
+    keep_b = b.valid & ~_strictly_dominated(b.clk, b.valid, a.clk, a.valid)
+    both = MVRegState(
+        wact=jnp.concatenate([a.wact, b.wact], axis=-1),
+        wctr=jnp.concatenate([a.wctr, b.wctr], axis=-1),
+        clk=jnp.concatenate([a.clk, b.clk], axis=-2),
+        val=jnp.concatenate([a.val, b.val], axis=-1),
+        valid=jnp.concatenate([keep_a, keep_b], axis=-1),
+    )
+    return _compact(_dedupe_by_witness(both), a.wact.shape[-1])
+
+
+def fold(states: MVRegState):
+    """Join over the leading replica axis in a log2 reduction tree.
+    Returns ``(state, overflow)``."""
+    from .lattice import tree_fold
+
+    return tree_fold(states, empty(states.wact.shape[-1], states.clk.shape[-1]), join)
+
+
+@jax.jit
+def apply_put(state: MVRegState, wact, wctr, clock, val):
+    """CmRDT apply of ``Op::Put { dot, clock, val }``: a dominated or
+    duplicate put is a no-op; otherwise dominated siblings are evicted and
+    the put claims a free slot. Returns ``(state, overflow)``.
+    Reference: src/mvreg.rs CmRDT::apply."""
+    clock = jnp.asarray(clock, state.clk.dtype)
+    noop = jnp.all(clock == 0, axis=-1) | jnp.any(
+        state.valid & jnp.all(state.clk >= clock[..., None, :], axis=-1), axis=-1
+    )
+    evict = state.valid & jnp.all(state.clk <= clock[..., None, :], axis=-1) & jnp.any(
+        state.clk < clock[..., None, :], axis=-1
+    )
+    valid = state.valid & ~(evict & ~noop[..., None])
+
+    free = ~valid
+    has_free = jnp.any(free, axis=-1)
+    slot = jnp.argmax(free, axis=-1)
+    write = ~noop & has_free
+    overflow = ~noop & ~has_free
+    onehot = jax.nn.one_hot(slot, state.valid.shape[-1], dtype=bool) & write[..., None]
+    return (
+        MVRegState(
+            wact=jnp.where(onehot, jnp.asarray(wact, jnp.int32)[..., None], state.wact),
+            wctr=jnp.where(onehot, jnp.asarray(wctr, DTYPE)[..., None], state.wctr),
+            clk=jnp.where(onehot[..., None], clock[..., None, :], state.clk),
+            val=jnp.where(onehot, jnp.asarray(val, jnp.int32)[..., None], state.val),
+            valid=valid | onehot,
+        ),
+        overflow,
+    )

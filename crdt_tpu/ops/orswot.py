@@ -163,28 +163,10 @@ def fold(states: OrswotState):
     N-replica full mesh collapses to one reduction (the north star).
 
     Returns ``(state, overflow)`` like ``join``."""
-    overflowed = jnp.zeros((), bool)
-    r = states.top.shape[0]
-    # Pad to a power of two with join identities.
-    pow2 = 1
-    while pow2 < r:
-        pow2 *= 2
-    if pow2 != r:
-        pad = jax.tree.map(
-            lambda e, s: jnp.broadcast_to(e, (pow2 - r, *e.shape)).astype(s.dtype),
-            empty(states.ctr.shape[-2], states.ctr.shape[-1], states.dcl.shape[-2]),
-            states,
-        )
-        states = jax.tree.map(lambda s, p: jnp.concatenate([s, p], axis=0), states, pad)
-        r = pow2
-    while r > 1:
-        half = r // 2
-        left = jax.tree.map(lambda x: x[:half], states)
-        right = jax.tree.map(lambda x: x[half:], states)
-        states, overflow = jax.vmap(join)(left, right)
-        overflowed = overflowed | jnp.any(overflow)
-        r = half
-    return jax.tree.map(lambda x: x[0], states), overflowed
+    from .lattice import tree_fold
+
+    identity = empty(states.ctr.shape[-2], states.ctr.shape[-1], states.dcl.shape[-2])
+    return tree_fold(states, identity, join)
 
 
 @jax.jit

@@ -25,7 +25,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops import orswot as ops
 from ..ops.orswot import OrswotState
 from .collectives import all_reduce_clock, all_reduce_join, ring_round
-from .mesh import REPLICA_AXIS, orswot_out_specs, orswot_specs, pad_replicas
+from .mesh import (
+    ELEMENT_AXIS,
+    REPLICA_AXIS,
+    orswot_out_specs,
+    orswot_specs,
+    pad_elements,
+    pad_replicas,
+)
 
 
 def mesh_fold(state: OrswotState, mesh: Mesh) -> Tuple[OrswotState, jax.Array]:
@@ -39,6 +46,7 @@ def mesh_fold(state: OrswotState, mesh: Mesh) -> Tuple[OrswotState, jax.Array]:
     axis, element-sharded], overflow flag).
     """
     state = pad_replicas(state, mesh.shape[REPLICA_AXIS])
+    state = pad_elements(state, mesh.shape[ELEMENT_AXIS])
 
     @partial(
         jax.shard_map,
@@ -71,6 +79,7 @@ def mesh_gossip(
     if rounds is None:
         rounds = rsize - 1
     state = pad_replicas(state, rsize)
+    state = pad_elements(state, mesh.shape[ELEMENT_AXIS])
 
     @partial(
         jax.shard_map,

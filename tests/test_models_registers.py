@@ -1,0 +1,222 @@
+"""Batched GSet / LWWReg / MVReg vs their oracles — the bit-identical
+A/B gate for the remaining type-family parity (SURVEY.md §7.2 step 7)."""
+
+import random
+
+import pytest
+from hypothesis import given, settings
+
+from crdt_tpu import GSet, LWWReg, MVReg
+from crdt_tpu.models import BatchedGSet, BatchedLWWReg, BatchedMVReg, SlotOverflow
+from crdt_tpu.traits import ConflictingMarker
+from crdt_tpu.utils import Interner
+
+from strategies import ACTORS, seeds
+
+MEMBERS = list(range(6))
+
+
+# ---- GSet ---------------------------------------------------------------
+
+@given(seeds)
+@settings(max_examples=15)
+def test_gset_join_and_fold_match_oracle(seed):
+    rng = random.Random(seed)
+    pures = []
+    for _ in range(4):
+        g = GSet()
+        for _ in range(rng.randrange(6)):
+            g.insert(rng.choice(MEMBERS))
+        pures.append(g)
+    b = BatchedGSet.from_pure(pures, members=Interner(MEMBERS))
+
+    expect = pures[0].clone()
+    expect.merge(pures[1])
+    b.merge_from(0, 1)
+    assert b.to_pure(0) == expect
+    assert b.to_pure(2) == pures[2]
+
+    fold_expect = GSet()
+    for p in pures:
+        fold_expect.merge(p)
+    assert b.fold() == fold_expect
+
+
+def test_gset_insert_and_contains():
+    b = BatchedGSet(2, len(MEMBERS), members=Interner(MEMBERS))
+    b.insert(0, 3)
+    assert b.contains(0, 3) and not b.contains(1, 3)
+    assert b.to_pure(0) == GSet([3])
+
+
+# ---- LWWReg -------------------------------------------------------------
+
+@given(seeds)
+@settings(max_examples=15)
+def test_lww_updates_and_fold_match_oracle(seed):
+    rng = random.Random(seed)
+    pures = []
+    for _ in range(4):
+        reg = LWWReg()
+        for _ in range(rng.randrange(5)):
+            reg.update(rng.randrange(10), rng.randrange(1, 100))
+        pures.append(reg)
+    # Distinct-marker discipline across replicas for conflict-freedom is the
+    # caller's job in the reference too; here equal markers may collide on
+    # equal values only — regenerate values deterministically from marker.
+    pures = []
+    for _ in range(4):
+        reg = LWWReg()
+        for _ in range(rng.randrange(5)):
+            m = rng.randrange(1, 100)
+            reg.update(m * 7 % 13, m)  # value is a function of marker
+        pures.append(reg)
+    b = BatchedLWWReg.from_pure(pures)
+
+    expect = pures[0].clone()
+    expect.merge(pures[1])
+    b.merge_from(0, 1)
+    assert b.to_pure(0) == expect
+    assert b.to_pure(2) == pures[2]
+
+    fold_expect = LWWReg()
+    for p in pures:
+        fold_expect.merge(p)
+    assert b.fold() == fold_expect
+
+
+def test_lww_64bit_marker_round_trip():
+    ts = 1_722_300_000_000_000_000  # unix nanos > 2^32
+    p = LWWReg("x", ts)
+    b = BatchedLWWReg.from_pure([p])
+    assert b.to_pure(0) == p
+    b.update(0, "y", ts + 1)
+    assert b.to_pure(0) == LWWReg("y", ts + 1)
+
+
+def test_lww_conflicting_marker_raises():
+    a = LWWReg("x", 5)
+    b = LWWReg("y", 5)
+    dev = BatchedLWWReg.from_pure([a, b])
+    with pytest.raises(ConflictingMarker):
+        dev.merge_from(0, 1)
+    with pytest.raises(ConflictingMarker):
+        dev.fold()
+    dev2 = BatchedLWWReg.from_pure([LWWReg("x", 5)])
+    with pytest.raises(ConflictingMarker):
+        dev2.update(0, "z", 5)
+
+
+def test_lww_equal_marker_same_value_is_fine():
+    dev = BatchedLWWReg.from_pure([LWWReg("x", 5), LWWReg("x", 5)])
+    dev.merge_from(0, 1)
+    assert dev.to_pure(0) == LWWReg("x", 5)
+
+
+# ---- MVReg --------------------------------------------------------------
+
+def _mv_site_run(rng, n_sites=3, n_writes=8):
+    """Per-site writes through the ctx protocol, then full op exchange."""
+    sites = [MVReg() for _ in range(n_sites)]
+    ops = []
+    for _ in range(n_writes):
+        i = rng.randrange(n_sites)
+        actor = ACTORS[i % len(ACTORS)]
+        ctx = sites[i].read().derive_add_ctx(actor)
+        op = sites[i].write(rng.randrange(10), ctx)
+        sites[i].apply(op)
+        ops.append(op)
+    return sites, ops
+
+
+def _interners():
+    return Interner(ACTORS), Interner()
+
+
+@given(seeds)
+@settings(max_examples=15)
+def test_mvreg_join_and_fold_match_oracle(seed):
+    rng = random.Random(seed)
+    sites, _ = _mv_site_run(rng)
+    actors, values = _interners()
+    b = BatchedMVReg.from_pure(sites, actors=actors, values=values)
+
+    expect = sites[0].clone()
+    expect.merge(sites[1].clone())
+    b.merge_from(0, 1)
+    assert b.to_pure(0) == expect
+    assert b.to_pure(2) == sites[2]
+
+    fold_expect = MVReg()
+    for s in sites:
+        fold_expect.merge(s.clone())
+    assert b.fold() == fold_expect
+
+
+@given(seeds)
+@settings(max_examples=15)
+def test_mvreg_op_path_bit_identical(seed):
+    rng = random.Random(seed)
+    _, ops = _mv_site_run(rng)
+    rng.shuffle(ops)
+    oracle = MVReg()
+    actors, values = _interners()
+    device = BatchedMVReg.from_pure([MVReg()], actors=actors, values=values)
+    for op in ops:
+        oracle.apply(op)
+        device.apply(0, op)
+    assert device.to_pure(0) == oracle
+
+
+@given(seeds)
+@settings(max_examples=10)
+def test_mvreg_device_join_laws(seed):
+    rng = random.Random(seed)
+    sites, _ = _mv_site_run(rng)
+    a, b, c = sites
+    actors, values = _interners()
+
+    def dev(*pures):
+        return BatchedMVReg.from_pure(
+            list(pures), actors=actors.clone(), values=values.clone()
+        )
+
+    ab = dev(a, b); ab.merge_from(0, 1)
+    ba = dev(b, a); ba.merge_from(0, 1)
+    assert ab.to_pure(0) == ba.to_pure(0), "device join not commutative"
+
+    abc1 = dev(a, b, c); abc1.merge_from(0, 1); abc1.merge_from(0, 2)
+    abc2 = dev(b, c, a); abc2.merge_from(0, 1); abc2.merge_from(0, 2)
+    assert abc1.to_pure(0) == abc2.to_pure(0), "device join not associative"
+
+    aa = dev(a, a); aa.merge_from(0, 1)
+    assert aa.to_pure(0) == a, "device join not idempotent"
+
+
+def test_mvreg_concurrent_writes_survive_as_siblings():
+    a, b = MVReg(), MVReg()
+    op_a = a.write("left", a.read().derive_add_ctx("A"))
+    a.apply(op_a)
+    op_b = b.write("right", b.read().derive_add_ctx("B"))
+    b.apply(op_b)
+    dev = BatchedMVReg.from_pure([a, b])
+    dev.merge_from(0, 1)
+    assert sorted(dev.to_pure(0).read().val) == ["left", "right"]
+
+    # A causally-later write collapses the siblings.
+    merged = dev.to_pure(0)
+    op = merged.write("final", merged.read().derive_add_ctx("A"))
+    dev.apply(0, op)
+    assert dev.to_pure(0).read().val == ["final"]
+
+
+def test_mvreg_slot_overflow_raises():
+    writes = []
+    for i, actor in enumerate(["A", "B", "C"]):
+        site = MVReg()
+        writes.append(site.write(i, site.read().derive_add_ctx(actor)))
+    dev = BatchedMVReg.from_pure([MVReg()], actors=Interner(["A", "B", "C"]), n_slots=2)
+    dev.apply(0, writes[0])
+    dev.apply(0, writes[1])
+    with pytest.raises(SlotOverflow):
+        dev.apply(0, writes[2])
