@@ -15,6 +15,7 @@ from .counters import BatchedGCounter, BatchedPNCounter
 from .orswot import BatchedOrswot
 from .gset import BatchedGSet
 from .registers import BatchedLWWReg, BatchedMVReg, SlotOverflow
+from .map import BatchedMap
 
 __all__ = [
     "BatchedVClock",
@@ -24,5 +25,6 @@ __all__ = [
     "BatchedGSet",
     "BatchedLWWReg",
     "BatchedMVReg",
+    "BatchedMap",
     "SlotOverflow",
 ]

@@ -1,0 +1,309 @@
+"""BatchedMap — N dense Map<K, MVReg<V>> replicas on device.
+
+Oracle: ``crdt_tpu.pure.map.Map`` with ``MVReg`` children (reference:
+src/map.rs specialised to the BASELINE config-4 shape ``Map<String,
+MVReg<_>>``). The replica batch is an ``ops.map.MapState`` with leading
+axis R over fixed interned key / actor / value universes. Conversion
+to/from the oracle is lossless — witness dot sets, sibling write clocks,
+and the deferred-removal buffer included — which the bit-identical A/B
+gate in tests/test_models_map.py exercises.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dot import Dot
+from ..ops import map as ops
+from ..ops import mvreg as mv_ops
+from ..pure.map import Map, MapRm, Nop, Up, _Entry
+from ..pure.mvreg import MVReg, Put
+from ..utils import Interner
+from ..vclock import VClock
+from .orswot import DeferredOverflow
+from .registers import SlotOverflow
+
+
+class BatchedMap:
+    def __init__(
+        self,
+        n_replicas: int,
+        n_keys: int,
+        n_actors: int,
+        witness_cap: int = 4,
+        sibling_cap: int = 4,
+        deferred_cap: int = 4,
+        keys: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+        values: Optional[Interner] = None,
+    ):
+        self.keys = keys if keys is not None else Interner()
+        self.actors = actors if actors is not None else Interner()
+        self.values = values if values is not None else Interner()
+        self.state = ops.empty(
+            n_keys, n_actors, witness_cap, sibling_cap, deferred_cap,
+            batch=(n_replicas,),
+        )
+
+    @property
+    def n_replicas(self) -> int:
+        return self.state.top.shape[0]
+
+    # ---- conversion (the A/B gate boundary) ---------------------------
+    @classmethod
+    def from_pure(
+        cls,
+        pures: Sequence[Map],
+        keys: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+        values: Optional[Interner] = None,
+        witness_cap: int = 4,
+        sibling_cap: int = 4,
+        deferred_cap: int = 4,
+    ) -> "BatchedMap":
+        keys = keys if keys is not None else Interner()
+        actors = actors if actors is not None else Interner()
+        values = values if values is not None else Interner()
+        for p in pures:
+            for actor in p.clock.dots:
+                actors.intern(actor)
+            for k, entry in p.entries.items():
+                keys.intern(k)
+                for d in entry.dots:
+                    actors.intern(d.actor)
+                if not isinstance(entry.val, MVReg):
+                    raise TypeError(
+                        f"BatchedMap children must be MVReg, got {type(entry.val)}"
+                    )
+                for d, (clock, v) in entry.val.vals.items():
+                    actors.intern(d.actor)
+                    for actor in clock.dots:
+                        actors.intern(actor)
+                    values.intern(v)
+            for clock, ks in p.deferred.items():
+                for actor in clock.dots:
+                    actors.intern(actor)
+                for k in ks:
+                    keys.intern(k)
+
+        r = len(pures)
+        nk, na = max(len(keys), 1), max(len(actors), 1)
+        out = cls(
+            r, nk, na, witness_cap, sibling_cap, deferred_cap,
+            keys=keys, actors=actors, values=values,
+        )
+        top = np.zeros((r, na), np.uint32)
+        wact = np.zeros((r, nk, witness_cap), np.int32)
+        wctr = np.zeros((r, nk, witness_cap), np.uint32)
+        wvalid = np.zeros((r, nk, witness_cap), bool)
+        cact = np.zeros((r, nk, sibling_cap), np.int32)
+        cctr = np.zeros((r, nk, sibling_cap), np.uint32)
+        cclk = np.zeros((r, nk, sibling_cap, na), np.uint32)
+        cval = np.zeros((r, nk, sibling_cap), np.int32)
+        cvalid = np.zeros((r, nk, sibling_cap), bool)
+        dcl = np.zeros((r, deferred_cap, na), np.uint32)
+        dkeys = np.zeros((r, deferred_cap, nk), bool)
+        dvalid = np.zeros((r, deferred_cap), bool)
+        for i, p in enumerate(pures):
+            for actor, c in p.clock.dots.items():
+                top[i, actors.id_of(actor)] = c
+            for k, entry in p.entries.items():
+                ki = keys.id_of(k)
+                if len(entry.dots) > witness_cap:
+                    raise ValueError(
+                        f"replica {i} key {k!r}: {len(entry.dots)} witness "
+                        f"dots; capacity is {witness_cap}"
+                    )
+                # Canonical slot order (actor id, counter) — matches the
+                # kernels' _canon_witnesses, so raw arrays are comparable.
+                for w, d in enumerate(
+                    sorted(entry.dots, key=lambda d: (actors.id_of(d.actor), d.counter))
+                ):
+                    wact[i, ki, w] = actors.id_of(d.actor)
+                    wctr[i, ki, w] = d.counter
+                    wvalid[i, ki, w] = True
+                if len(entry.val.vals) > sibling_cap:
+                    raise ValueError(
+                        f"replica {i} key {k!r}: {len(entry.val.vals)} "
+                        f"siblings; capacity is {sibling_cap}"
+                    )
+                for s, (d, (clock, v)) in enumerate(
+                    sorted(
+                        entry.val.vals.items(),
+                        key=lambda kv: (actors.id_of(kv[0].actor), kv[0].counter),
+                    )
+                ):
+                    cact[i, ki, s] = actors.id_of(d.actor)
+                    cctr[i, ki, s] = d.counter
+                    for actor, c in clock.dots.items():
+                        cclk[i, ki, s, actors.id_of(actor)] = c
+                    cval[i, ki, s] = values.id_of(v)
+                    cvalid[i, ki, s] = True
+            if len(p.deferred) > deferred_cap:
+                raise ValueError(
+                    f"replica {i} has {len(p.deferred)} deferred removes; "
+                    f"capacity is {deferred_cap}"
+                )
+            for d, (clock, ks) in enumerate(p.deferred.items()):
+                for actor, c in clock.dots.items():
+                    dcl[i, d, actors.id_of(actor)] = c
+                for k in ks:
+                    dkeys[i, d, keys.id_of(k)] = True
+                dvalid[i, d] = True
+
+        out.state = ops.MapState(
+            top=jnp.asarray(top),
+            wact=jnp.asarray(wact),
+            wctr=jnp.asarray(wctr),
+            wvalid=jnp.asarray(wvalid),
+            child=mv_ops.MVRegState(
+                wact=jnp.asarray(cact),
+                wctr=jnp.asarray(cctr),
+                clk=jnp.asarray(cclk),
+                val=jnp.asarray(cval),
+                valid=jnp.asarray(cvalid),
+            ),
+            dcl=jnp.asarray(dcl),
+            dkeys=jnp.asarray(dkeys),
+            dvalid=jnp.asarray(dvalid),
+        )
+        return out
+
+    def _row(self, arrs, i: int):
+        return jax.tree.map(lambda x: x[i], arrs)
+
+    def to_pure(self, i: int) -> Map:
+        st = jax.device_get(self._row(self.state, i))
+        out = Map(MVReg)
+        out.clock = VClock(
+            {self.actors[a]: int(c) for a, c in enumerate(st.top) if c > 0}
+        )
+        present = st.wvalid.any(axis=-1)
+        for ki in np.nonzero(present)[0]:
+            dots = {
+                Dot(self.actors[int(st.wact[ki, w])], int(st.wctr[ki, w]))
+                for w in np.nonzero(st.wvalid[ki])[0]
+            }
+            vals = {}
+            for s in np.nonzero(st.child.valid[ki])[0]:
+                d = Dot(
+                    self.actors[int(st.child.wact[ki, s])],
+                    int(st.child.wctr[ki, s]),
+                )
+                clock = VClock(
+                    {
+                        self.actors[a]: int(c)
+                        for a, c in enumerate(st.child.clk[ki, s])
+                        if c > 0
+                    }
+                )
+                vals[d] = (clock, self.values[int(st.child.val[ki, s])])
+            out.entries[self.keys[int(ki)]] = _Entry(dots, MVReg(vals))
+        for d in np.nonzero(st.dvalid)[0]:
+            clock = VClock(
+                {self.actors[a]: int(c) for a, c in enumerate(st.dcl[d]) if c > 0}
+            )
+            out.deferred[clock] = {
+                self.keys[int(k)] for k in np.nonzero(st.dkeys[d])[0]
+            }
+        return out
+
+    # ---- op path (CmRDT) ----------------------------------------------
+    def apply(self, replica: int, op) -> None:
+        """Apply an oracle-shaped op to one replica (reference:
+        src/map.rs ``CmRDT::apply``)."""
+        if isinstance(op, Nop):
+            return
+        row = self._row(self.state, replica)
+        if isinstance(op, Up):
+            if not isinstance(op.op, Put):
+                raise TypeError(
+                    f"BatchedMap routes MVReg ops only, got {op.op!r}"
+                )
+            aid = self.actors.id_of(op.dot.actor)
+            kid = self.keys.id_of(op.key)
+            na = self.state.top.shape[-1]
+            if aid >= na:
+                raise IndexError(
+                    f"actor id {aid} outside the {na}-lane universe"
+                )
+            if kid >= self.state.wact.shape[-2]:
+                raise IndexError(
+                    f"key id {kid} outside the "
+                    f"{self.state.wact.shape[-2]}-slot universe"
+                )
+            clock = np.zeros((na,), np.uint32)
+            for actor, c in op.op.clock.dots.items():
+                clock[self.actors.id_of(actor)] = c
+            row, overflow = ops.apply_up(
+                row,
+                jnp.asarray(aid),
+                jnp.asarray(np.uint32(op.dot.counter)),
+                jnp.asarray(kid),
+                jnp.asarray(clock),
+                jnp.asarray(self.values.intern(op.op.val)),
+            )
+            if bool(overflow):
+                raise SlotOverflow(
+                    f"replica {replica}: witness/sibling slab full on Up at "
+                    f"key {op.key!r} — rebuild with a larger witness_cap/"
+                    f"sibling_cap"
+                )
+        elif isinstance(op, MapRm):
+            na = self.state.top.shape[-1]
+            cl = np.zeros((na,), np.uint32)
+            for actor, c in op.clock.dots.items():
+                cl[self.actors.id_of(actor)] = c
+            mask = np.zeros((self.state.wact.shape[-2],), bool)
+            for k in op.keyset:
+                mask[self.keys.id_of(k)] = True
+            row, overflow = ops.apply_rm(row, jnp.asarray(cl), jnp.asarray(mask))
+            if bool(overflow):
+                raise DeferredOverflow(
+                    f"replica {replica}: deferred buffer full "
+                    f"(cap {self.state.dvalid.shape[-1]})"
+                )
+        else:
+            raise TypeError(f"not a Map op: {op!r}")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[replica].set(r), self.state, row
+        )
+
+    # ---- state path (CvRDT — the config-4 benchmark path) -------------
+    def merge_from(self, dst: int, src: int) -> None:
+        joined, overflow = ops.join(
+            self._row(self.state, dst), self._row(self.state, src)
+        )
+        if bool(overflow):
+            raise DeferredOverflow(f"merge {src}->{dst}: slab capacity exceeded")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[dst].set(r), self.state, joined
+        )
+
+    def fold(self) -> Map:
+        """Full-mesh anti-entropy: join all R replicas in a log2 reduction
+        tree and return the converged oracle-form state."""
+        folded, overflow = ops.fold(self.state)
+        if bool(overflow):
+            raise DeferredOverflow("fold: slab capacity exceeded")
+        tmp = BatchedMap(
+            1,
+            self.state.wact.shape[-2],
+            self.state.top.shape[-1],
+            self.state.wact.shape[-1],
+            self.state.child.wact.shape[-1],
+            self.state.dcl.shape[-2],
+            keys=self.keys,
+            actors=self.actors,
+            values=self.values,
+        )
+        tmp.state = jax.tree.map(lambda x: x[None], folded)
+        return tmp.to_pure(0)
+
+    def keys_of(self, i: int) -> frozenset:
+        present = np.asarray(self.state.wvalid[i].any(axis=-1))
+        return frozenset(self.keys[int(k)] for k in np.nonzero(present)[0])

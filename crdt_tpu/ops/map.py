@@ -1,0 +1,371 @@
+"""Dense batched Map kernels — the composition layer on device.
+
+Oracle: ``crdt_tpu.pure.map.Map`` (reference: src/map.rs ``Map<K, V, A>``,
+SURVEY.md §3 row 11, §4.3) specialised to MVReg children — the
+``Map<String, MVReg<_>>`` shape of BASELINE config 4. State layout for K
+interned key slots, A actors, W witness slots per key, S sibling slots
+per child register, D deferred slots (leading axes batch replicas):
+
+- ``top [..., A]``                     — the map's top clock,
+- ``wact/wctr/wvalid [..., K, W]``     — per-key witness dot sets (the
+  oracle's ``_Entry.dots``: true dot sets, not per-actor-max clocks, so
+  removing the state witnessed by (A,1) while (A,2) lives is exact),
+- ``child`` (``MVRegState [..., K, S…]``) — the per-key MVReg slab; a
+  content is alive iff its witness dot is in the key's witness set,
+- ``dcl [..., D, A]`` / ``dkeys [..., D, K]`` / ``dvalid [..., D]`` —
+  parked key removes whose clock ran ahead of the top (masked epochs,
+  SURVEY.md §7.3), replayed after every state change.
+
+A key is present iff any witness slot is valid. ``join`` is the oracle's
+merge: witness dots survive by the orswot dot rule (kept iff the other
+side also witnesses them or never saw them), children merge by the MVReg
+domination rule and are then pruned to the surviving witnesses — a pure
+pointwise function of the joined witness set, which is what makes the
+join a true lattice (safe under any reduction-tree order). Everything is
+element-wise compares + masks; no data-dependent gathers, so vmap/pjit
+batch it freely and XLA tiles it.
+
+All slot tables are kept in canonical form (valid-first, sorted by
+(actor, counter), dead payload zeroed) so converged replicas compare
+equal as raw arrays.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import mvreg
+from .mvreg import MVRegState
+from .orswot import _compact_deferred, _dedupe_deferred
+
+DTYPE = jnp.uint32
+
+
+class MapState(NamedTuple):
+    """A (possibly batched) dense Map<K, MVReg> replica state (pytree)."""
+
+    top: jax.Array     # [..., A]
+    wact: jax.Array    # [..., K, W] int32
+    wctr: jax.Array    # [..., K, W] uint32
+    wvalid: jax.Array  # [..., K, W] bool
+    child: MVRegState  # arrays [..., K, S(, A)]
+    dcl: jax.Array     # [..., D, A]
+    dkeys: jax.Array   # [..., D, K] bool
+    dvalid: jax.Array  # [..., D]
+
+
+def empty(
+    n_keys: int,
+    n_actors: int,
+    witness_cap: int = 4,
+    sibling_cap: int = 4,
+    deferred_cap: int = 4,
+    batch: tuple = (),
+) -> MapState:
+    """The join identity: no dots, no keys, no parked removes."""
+    return MapState(
+        top=jnp.zeros((*batch, n_actors), DTYPE),
+        wact=jnp.zeros((*batch, n_keys, witness_cap), jnp.int32),
+        wctr=jnp.zeros((*batch, n_keys, witness_cap), DTYPE),
+        wvalid=jnp.zeros((*batch, n_keys, witness_cap), bool),
+        child=mvreg.empty(sibling_cap, n_actors, batch=(*batch, n_keys)),
+        dcl=jnp.zeros((*batch, deferred_cap, n_actors), DTYPE),
+        dkeys=jnp.zeros((*batch, deferred_cap, n_keys), bool),
+        dvalid=jnp.zeros((*batch, deferred_cap), bool),
+    )
+
+
+# ---- witness-set helpers -------------------------------------------------
+
+def _top_at(top: jax.Array, act: jax.Array) -> jax.Array:
+    """``top[act]`` for an actor-id table ``act [..., K, W]`` against a
+    clock ``top [..., A]`` (broadcast gather over the key axis)."""
+    return jnp.take_along_axis(
+        jnp.broadcast_to(top[..., None, :], (*act.shape[:-1], top.shape[-1])),
+        act,
+        axis=-1,
+    )
+
+
+def _witness_in(wact, wctr, wvalid, oact, octr, ovalid) -> jax.Array:
+    """For each witness slot on our side: is the same dot witnessed (in
+    any slot) on the other side? [..., K, W]"""
+    eq = (
+        (wact[..., :, None] == oact[..., None, :])
+        & (wctr[..., :, None] == octr[..., None, :])
+        & ovalid[..., None, :]
+    )
+    return wvalid & jnp.any(eq, axis=-1)
+
+
+def _retain_witnesses(child: MVRegState, wact, wctr, wvalid) -> MVRegState:
+    """The oracle's ``retain_witnesses``: a child content survives iff its
+    witness dot is in the key's (surviving) witness set."""
+    alive = (
+        (child.wact[..., :, None] == wact[..., None, :])
+        & (child.wctr[..., :, None] == wctr[..., None, :])
+        & wvalid[..., None, :]
+    )
+    return child._replace(valid=child.valid & jnp.any(alive, axis=-1))
+
+
+def _canon_witnesses(wact, wctr, wvalid):
+    """Canonical slot order: valid first, then by (actor, counter); dead
+    payload zeroed — converged replicas compare equal as raw arrays."""
+    order = jnp.lexsort((wctr, wact, ~wvalid), axis=-1)
+    wact = jnp.take_along_axis(wact, order, axis=-1)
+    wctr = jnp.take_along_axis(wctr, order, axis=-1)
+    wvalid = jnp.take_along_axis(wvalid, order, axis=-1)
+    return (
+        jnp.where(wvalid, wact, 0),
+        jnp.where(wvalid, wctr, 0),
+        wvalid,
+    )
+
+
+def _canon_child(child: MVRegState) -> MVRegState:
+    """Same canonicalisation for the sibling slab (keyed by witness dot)."""
+    order = jnp.lexsort((child.wctr, child.wact, ~child.valid), axis=-1)
+    valid = jnp.take_along_axis(child.valid, order, axis=-1)
+    return MVRegState(
+        wact=jnp.where(valid, jnp.take_along_axis(child.wact, order, axis=-1), 0),
+        wctr=jnp.where(valid, jnp.take_along_axis(child.wctr, order, axis=-1), 0),
+        clk=jnp.where(
+            valid[..., None],
+            jnp.take_along_axis(child.clk, order[..., None], axis=-2),
+            0,
+        ),
+        val=jnp.where(valid, jnp.take_along_axis(child.val, order, axis=-1), 0),
+        valid=valid,
+    )
+
+
+# ---- removes -------------------------------------------------------------
+
+def _rm_covered(wact, wctr, wvalid, rm_clock, key_mask) -> jax.Array:
+    """Witness survival under one keyset-remove (the oracle's
+    ``_apply_keyset_rm`` filter): masked keys drop dots the rm clock
+    covers. Returns the new wvalid."""
+    covered = wctr <= _top_at(rm_clock, wact)
+    return wvalid & ~(key_mask[..., :, None] & covered)
+
+
+def _apply_parked(state: MapState) -> MapState:
+    """Replay every parked keyset-remove against the witness table (the
+    removes commute, so scan order is free), then prune children once."""
+
+    def step(wvalid, slot):
+        cl, keys, valid = slot
+        new = _rm_covered(state.wact, state.wctr, wvalid, cl, keys)
+        return jnp.where(valid[..., None, None], new, wvalid), None
+
+    d_axis = state.dcl.ndim - 2
+    wvalid, _ = lax.scan(
+        step,
+        state.wvalid,
+        (
+            jnp.moveaxis(state.dcl, d_axis, 0),
+            jnp.moveaxis(state.dkeys, d_axis, 0),
+            jnp.moveaxis(state.dvalid, d_axis, 0),
+        ),
+    )
+    child = _retain_witnesses(state.child, state.wact, state.wctr, wvalid)
+    return state._replace(wvalid=wvalid, child=child)
+
+
+def _drop_stale_deferred(state: MapState) -> MapState:
+    """Forget parked removes the top clock has caught up to (the oracle
+    re-defers only clocks still ahead of ``self.clock``)."""
+    still_ahead = ~jnp.all(state.dcl <= state.top[..., None, :], axis=-1)
+    dvalid = state.dvalid & still_ahead
+    return state._replace(
+        dcl=jnp.where(dvalid[..., None], state.dcl, 0),
+        dkeys=state.dkeys & dvalid[..., None],
+        dvalid=dvalid,
+    )
+
+
+# ---- CvRDT join (the config-4 hot loop) ----------------------------------
+
+@jax.jit
+def join(a: MapState, b: MapState):
+    """Pairwise lattice join — the oracle's ``Map::merge`` as element-wise
+    arithmetic. Reference: src/map.rs ``CvRDT::merge`` (witness-dot-set
+    semantics per pure/map.py). Returns ``(state, overflow)``."""
+    # Witness survival: the orswot dot rule, uniform over present/absent
+    # keys (an absent key is an empty witness set).
+    keep_a = a.wvalid & (
+        _witness_in(a.wact, a.wctr, a.wvalid, b.wact, b.wctr, b.wvalid)
+        | (a.wctr > _top_at(b.top, a.wact))
+    )
+    keep_b = b.wvalid & (
+        _witness_in(b.wact, b.wctr, b.wvalid, a.wact, a.wctr, a.wvalid)
+        | (b.wctr > _top_at(a.top, b.wact))
+    )
+
+    # Union the surviving witness slots; dedupe dots witnessed by both.
+    wact = jnp.concatenate([a.wact, b.wact], axis=-1)
+    wctr = jnp.concatenate([a.wctr, b.wctr], axis=-1)
+    wvalid = jnp.concatenate([keep_a, keep_b], axis=-1)
+    dup = (
+        (wact[..., :, None] == wact[..., None, :])
+        & (wctr[..., :, None] == wctr[..., None, :])
+        & wvalid[..., :, None]
+        & wvalid[..., None, :]
+    )
+    w = wact.shape[-1]
+    first = jnp.argmax(dup, axis=-1)  # first valid slot holding this dot
+    wvalid = wvalid & (first == jnp.arange(w))
+    wact, wctr, wvalid = _canon_witnesses(wact, wctr, wvalid)
+    wcap = a.wact.shape[-1]
+    w_overflow = jnp.any(jnp.sum(wvalid, axis=-1) > wcap)
+    wact, wctr, wvalid = wact[..., :wcap], wctr[..., :wcap], wvalid[..., :wcap]
+
+    # Children: MVReg domination merge per key, then prune to the joined
+    # witness set (pure pointwise function of the join — lattice-safe).
+    child, c_overflow = mvreg.join(a.child, b.child)
+    child = _retain_witnesses(child, wact, wctr, wvalid)
+
+    top = jnp.maximum(a.top, b.top)
+
+    # Deferred: dict-union on equal clocks, replay, drop caught-up slots.
+    dcl = jnp.concatenate([a.dcl, b.dcl], axis=-2)
+    dkeys = jnp.concatenate([a.dkeys, b.dkeys], axis=-2)
+    dvalid = jnp.concatenate([a.dvalid, b.dvalid], axis=-1)
+    dcl, dkeys, dvalid = _dedupe_deferred(dcl, dkeys, dvalid)
+    state = MapState(
+        top=top, wact=wact, wctr=wctr, wvalid=wvalid, child=child,
+        dcl=dcl, dkeys=dkeys, dvalid=dvalid,
+    )
+    state = _apply_parked(state)
+    state = _drop_stale_deferred(state)
+    dcl, dkeys, dvalid, d_overflow = _compact_deferred(
+        state.dcl, state.dkeys, state.dvalid, a.dcl.shape[-2]
+    )
+    state = state._replace(
+        child=_canon_child(state.child), dcl=dcl, dkeys=dkeys, dvalid=dvalid
+    )
+    overflow = w_overflow | jnp.any(c_overflow) | jnp.any(d_overflow)
+    return state, overflow
+
+
+def fold(states: MapState):
+    """Join a whole replica batch (leading axis) in a log2 reduction tree
+    — sound because ``join`` is a true lattice join (tests assert this on
+    device shapes). Returns ``(state, overflow)``."""
+    from .lattice import tree_fold
+
+    identity = empty(
+        states.wact.shape[-2],
+        states.top.shape[-1],
+        states.wact.shape[-1],
+        states.child.wact.shape[-1],
+        states.dcl.shape[-2],
+    )
+    return tree_fold(states, identity, join)
+
+
+# ---- CmRDT op application ------------------------------------------------
+
+@jax.jit
+def apply_up(
+    state: MapState,
+    actor: jax.Array,
+    counter: jax.Array,
+    key: jax.Array,
+    put_clock: jax.Array,
+    val: jax.Array,
+):
+    """Apply ``Op::Up { dot, key, op: Put { clock, val } }`` (reference:
+    src/map.rs CmRDT::apply): drop already-seen dots; else witness the key
+    with the dot, route the put into the key's MVReg, advance the top, and
+    replay parked removes. Returns ``(state, overflow)``."""
+    counter = counter.astype(state.top.dtype)
+    seen = state.top[..., actor] >= counter
+    k = state.wact.shape[-2]
+    key_onehot = jax.nn.one_hot(key, k, dtype=bool)
+
+    # Witness the key: claim the first free slot on the key's row. The dot
+    # is fresh (unseen ⇒ in no witness set), so no dedupe is needed.
+    free = ~state.wvalid & key_onehot[..., :, None]
+    has_free = jnp.any(free, axis=(-2, -1))
+    flat = free.reshape(*free.shape[:-2], -1)
+    slot = jnp.argmax(flat, axis=-1)
+    claim = (
+        jax.nn.one_hot(slot, flat.shape[-1], dtype=bool).reshape(free.shape)
+        & (has_free & ~seen)[..., None, None]
+    )
+    wact = jnp.where(claim, jnp.asarray(actor, jnp.int32)[..., None, None], state.wact)
+    wctr = jnp.where(claim, counter[..., None, None], state.wctr)
+    wvalid = state.wvalid | claim
+    w_overflow = ~seen & ~has_free
+
+    # Route the put into the key's child register (computed for every key
+    # row, selected at the target — dense-mode style, no dynamic gather).
+    put_clock = jnp.asarray(put_clock, state.child.clk.dtype)
+    bc = lambda x: jnp.broadcast_to(x[..., None], (*x.shape, k))
+    new_child, c_of = mvreg.apply_put(
+        state.child,
+        bc(jnp.asarray(actor, jnp.int32)),
+        bc(counter),
+        jnp.broadcast_to(put_clock[..., None, :], (*put_clock.shape[:-1], k, put_clock.shape[-1])),
+        bc(jnp.asarray(val, jnp.int32)),
+    )
+    sel = (key_onehot & ~seen[..., None])[..., None]  # [..., K, 1]
+    child = jax.tree.map(
+        lambda new, old: jnp.where(
+            sel[..., None] if old.ndim > sel.ndim else sel, new, old
+        ),
+        new_child,
+        state.child,
+    )
+    c_overflow = jnp.any(c_of & key_onehot & ~seen[..., None], axis=-1)
+
+    top = jnp.where(
+        seen[..., None], state.top, state.top.at[..., actor].max(counter)
+    )
+    state = state._replace(
+        top=top, wact=wact, wctr=wctr, wvalid=wvalid, child=child
+    )
+    state = _drop_stale_deferred(_apply_parked(state))
+    state = state._replace(child=_canon_child(state.child))
+    return state, w_overflow | c_overflow
+
+
+@jax.jit
+def apply_rm(state: MapState, rm_clock: jax.Array, key_mask: jax.Array):
+    """Apply ``Op::Rm { clock, keyset }`` (reference: src/map.rs
+    ``apply_keyset_rm``): always strip the covered witnesses now; if the
+    rm clock is ahead of the top, park it (union on an equal-clock slot,
+    else claim a free one). Returns ``(state, overflow)``."""
+    rm_clock = jnp.asarray(rm_clock, state.top.dtype)
+    wvalid = _rm_covered(state.wact, state.wctr, state.wvalid, rm_clock, key_mask)
+    wact, wctr, wvalid = _canon_witnesses(state.wact, state.wctr, wvalid)
+    child = _retain_witnesses(state.child, wact, wctr, wvalid)
+    child = _canon_child(child)
+
+    ahead = ~jnp.all(rm_clock <= state.top, axis=-1)
+    same = state.dvalid & jnp.all(state.dcl == rm_clock[..., None, :], axis=-1)
+    has_same = jnp.any(same, axis=-1)
+    free = ~state.dvalid
+    has_free = jnp.any(free, axis=-1)
+    slot = jnp.where(has_same, jnp.argmax(same, axis=-1), jnp.argmax(free, axis=-1))
+    park = ahead & (has_same | has_free)
+    overflow = ahead & ~has_same & ~has_free
+
+    d = state.dvalid.shape[-1]
+    onehot = jax.nn.one_hot(slot, d, dtype=bool) & park[..., None]
+    dcl = jnp.where(onehot[..., None], rm_clock[..., None, :], state.dcl)
+    live = state.dkeys & state.dvalid[..., None]
+    dkeys = jnp.where(onehot[..., None], key_mask[..., None, :] | live, state.dkeys)
+    return (
+        MapState(
+            top=state.top, wact=wact, wctr=wctr, wvalid=wvalid, child=child,
+            dcl=dcl, dkeys=dkeys, dvalid=state.dvalid | onehot,
+        ),
+        overflow,
+    )

@@ -72,7 +72,10 @@ def _dedupe_by_witness(state: MVRegState) -> MVRegState:
 
 def _compact(state: MVRegState, cap: int):
     """Stable-sort valid slots to the front, truncate to capacity, zero
-    dead payload (canonical form so converged replicas compare equal)."""
+    dead payload. Slot order still depends on join operand order — raw
+    arrays of converged replicas are equal as sets, not bit-for-bit;
+    compare via to_pure (ops/map.py adds its own (actor, counter)
+    canonical sort on top where raw-array comparability is wanted)."""
     order = jnp.argsort(~state.valid, axis=-1, stable=True)
     wact = jnp.take_along_axis(state.wact, order, axis=-1)
     wctr = jnp.take_along_axis(state.wctr, order, axis=-1)

@@ -1,0 +1,186 @@
+"""Batched Map<K, MVReg> vs the oracle — the bit-identical A/B gate for
+the composition layer (SURVEY.md §7.2 step 5, BASELINE config 4)."""
+
+import random
+
+import pytest
+from hypothesis import given, settings
+
+from crdt_tpu import Map, MVReg, VClock
+from crdt_tpu.models import BatchedMap
+from crdt_tpu.utils import Interner
+
+from strategies import ACTORS, seeds
+from test_map import _site_run, drop, mv_map, put
+
+KEYS = list("pq")
+CAPS = dict(witness_cap=12, sibling_cap=12, deferred_cap=12)
+
+
+def _interners():
+    return Interner(KEYS), Interner(ACTORS + ["A", "B"])
+
+
+def _batched(states):
+    keys, actors = _interners()
+    return BatchedMap.from_pure(states, keys=keys, actors=actors, **CAPS)
+
+
+@given(seeds)
+@settings(max_examples=15)
+def test_join_bit_identical_to_oracle_merge(seed):
+    rng = random.Random(seed)
+    states = _site_run(rng, mv_map)
+    batched = _batched(states)
+
+    expect = states[0].clone()
+    expect.merge(states[1].clone())
+    batched.merge_from(0, 1)
+    assert batched.to_pure(0) == expect
+
+    # round-trip of untouched replicas is lossless
+    assert batched.to_pure(2) == states[2]
+
+
+@given(seeds)
+@settings(max_examples=15)
+def test_fold_bit_identical_to_oracle_fold(seed):
+    rng = random.Random(seed)
+    states = _site_run(rng, mv_map, n_cmds=14)
+    batched = _batched(states)
+
+    expect = states[0].clone()
+    for s in states[1:]:
+        expect.merge(s.clone())
+    assert batched.fold() == expect
+
+
+@given(seeds)
+@settings(max_examples=10)
+def test_op_path_bit_identical(seed):
+    rng = random.Random(seed)
+    # Mint ops on an oracle site, apply the SAME ops to both an oracle
+    # replica and a device replica in the same order (including removes
+    # arriving ahead of the updates they cover — the deferred path).
+    site = mv_map()
+    stream = []
+    for _ in range(12):
+        key = rng.choice(KEYS)
+        if rng.random() < 0.6:
+            stream.append(put(site, rng.choice(ACTORS), key, rng.randrange(5)))
+        else:
+            stream.append(drop(site, key))
+    oracle = mv_map()
+    keys, actors = _interners()
+    device = BatchedMap.from_pure([mv_map()], keys=keys, actors=actors, **CAPS)
+    for op in stream:
+        oracle.apply(op)
+        device.apply(0, op)
+        assert device.to_pure(0) == oracle
+
+
+@given(seeds)
+@settings(max_examples=8)
+def test_device_join_laws(seed):
+    # Lattice laws on the device join itself (reduction-tree safety,
+    # SURVEY §7.3 "deterministic reduction").
+    rng = random.Random(seed)
+    a, b, c = _site_run(rng, mv_map)
+
+    def dev(*pures):
+        return _batched(list(pures))
+
+    ab = dev(a, b); ab.merge_from(0, 1)
+    ba = dev(b, a); ba.merge_from(0, 1)
+    assert ab.to_pure(0) == ba.to_pure(0), "device join not commutative"
+
+    abc1 = dev(a, b, c); abc1.merge_from(0, 1); abc1.merge_from(0, 2)
+    abc2 = dev(b, c, a); abc2.merge_from(0, 1); abc2.merge_from(0, 2)
+    assert abc1.to_pure(0) == abc2.to_pure(0), "device join not associative"
+
+    aa = dev(a, a); aa.merge_from(0, 1)
+    assert aa.to_pure(0) == a, "device join not idempotent"
+
+
+def test_concurrent_update_wins_over_remove_on_device():
+    # The add-wins scenario of test_map.test_concurrent_update_wins_over_remove,
+    # replayed on device replicas via the op path + join.
+    a, b = mv_map(), mv_map()
+    op = put(a, "A", "p", 1)
+    b.apply(op)
+    rm_op = drop(a, "p")
+    up_op = put(b, "B", "p", 2)
+
+    keys, actors = _interners()
+    device = BatchedMap.from_pure([mv_map(), mv_map()], keys=keys, actors=actors, **CAPS)
+    device.apply(0, op)
+    device.apply(1, op)
+    device.apply(0, rm_op)
+    device.apply(1, up_op)
+    device.merge_from(0, 1)
+
+    a.merge(b.clone())
+    assert device.to_pure(0) == a
+    got = device.to_pure(0).get("p").val
+    assert got is not None and got.read().val == [2]
+
+
+def test_deferred_keyset_rm_parks_and_replays_on_device():
+    a = mv_map()
+    up = put(a, "A", "p", 1)
+    rm_op = a.rm("p", a.get("p").derive_rm_ctx())
+
+    oracle = mv_map()
+    keys, actors = _interners()
+    device = BatchedMap.from_pure([mv_map()], keys=keys, actors=actors, **CAPS)
+    for op in (rm_op, up):  # remove first: must park, then replay
+        oracle.apply(op)
+        device.apply(0, op)
+    assert oracle.deferred == {} and oracle.get("p").val is None
+    assert device.to_pure(0) == oracle
+
+
+def test_same_actor_partial_remove_no_resurrection_on_device():
+    # Witness (A,1) removed while (A,2) lives — the dot-set witness table
+    # must express it (the reason wact/wctr are dot pairs, not clocks).
+    site = mv_map()
+    op1 = put(site, "A", "p", 10)
+    rm_op = site.rm("p", site.get("p").derive_rm_ctx())
+    op2 = put(site, "A", "p", 20)
+
+    oracle = mv_map()
+    keys, actors = _interners()
+    device = BatchedMap.from_pure([mv_map()], keys=keys, actors=actors, **CAPS)
+    for op in (op1, op2, rm_op):
+        oracle.apply(op)
+        device.apply(0, op)
+    assert device.to_pure(0) == oracle
+    assert oracle.get("p").val.read().val == [20]
+
+
+def test_witness_overflow_raises():
+    from crdt_tpu.models import SlotOverflow
+
+    site = mv_map()
+    stream = [put(site, "A", "p", i) for i in range(4)]
+    keys, actors = _interners()
+    device = BatchedMap.from_pure(
+        [mv_map()], keys=keys, actors=actors,
+        witness_cap=2, sibling_cap=2, deferred_cap=2,
+    )
+    device.apply(0, stream[0])
+    with pytest.raises(SlotOverflow):
+        for op in stream[1:]:
+            device.apply(0, op)
+
+
+def test_deferred_survives_conversion_round_trip():
+    a = mv_map()
+    put(a, "A", "p", 1)
+    b = mv_map()
+    rm_op = a.rm("p", a.get("p").derive_rm_ctx())
+    b.apply(rm_op)  # parked: clock ahead of b's view
+    assert b.deferred
+    keys, actors = _interners()
+    device = BatchedMap.from_pure([b], keys=keys, actors=actors, **CAPS)
+    assert device.to_pure(0) == b
