@@ -4,9 +4,9 @@ Oracle: ``crdt_tpu.pure.map.Map`` with ``MVReg`` children (reference:
 src/map.rs specialised to the BASELINE config-4 shape ``Map<String,
 MVReg<_>>``). The replica batch is an ``ops.map.MapState`` with leading
 axis R over fixed interned key / actor / value universes. Conversion
-to/from the oracle is lossless — witness dot sets, sibling write clocks,
-and the deferred-removal buffer included — which the bit-identical A/B
-gate in tests/test_models_map.py exercises.
+to/from the oracle is lossless — content witness dots, sibling write
+clocks, and the deferred-removal buffer included — which the
+bit-identical A/B gate in tests/test_models_map.py exercises.
 """
 
 from __future__ import annotations
@@ -20,7 +20,7 @@ import numpy as np
 from ..dot import Dot
 from ..ops import map as ops
 from ..ops import mvreg as mv_ops
-from ..pure.map import Map, MapRm, Nop, Up, _Entry
+from ..pure.map import Map, MapRm, Nop, Up
 from ..pure.mvreg import MVReg, Put
 from ..utils import Interner
 from ..vclock import VClock
@@ -34,7 +34,6 @@ class BatchedMap:
         n_replicas: int,
         n_keys: int,
         n_actors: int,
-        witness_cap: int = 4,
         sibling_cap: int = 4,
         deferred_cap: int = 4,
         keys: Optional[Interner] = None,
@@ -45,8 +44,7 @@ class BatchedMap:
         self.actors = actors if actors is not None else Interner()
         self.values = values if values is not None else Interner()
         self.state = ops.empty(
-            n_keys, n_actors, witness_cap, sibling_cap, deferred_cap,
-            batch=(n_replicas,),
+            n_keys, n_actors, sibling_cap, deferred_cap, batch=(n_replicas,)
         )
 
     @property
@@ -61,7 +59,6 @@ class BatchedMap:
         keys: Optional[Interner] = None,
         actors: Optional[Interner] = None,
         values: Optional[Interner] = None,
-        witness_cap: int = 4,
         sibling_cap: int = 4,
         deferred_cap: int = 4,
     ) -> "BatchedMap":
@@ -71,15 +68,13 @@ class BatchedMap:
         for p in pures:
             for actor in p.clock.dots:
                 actors.intern(actor)
-            for k, entry in p.entries.items():
+            for k, child in p.entries.items():
                 keys.intern(k)
-                for d in entry.dots:
-                    actors.intern(d.actor)
-                if not isinstance(entry.val, MVReg):
+                if not isinstance(child, MVReg):
                     raise TypeError(
-                        f"BatchedMap children must be MVReg, got {type(entry.val)}"
+                        f"BatchedMap children must be MVReg, got {type(child)}"
                     )
-                for d, (clock, v) in entry.val.vals.items():
+                for d, (clock, v) in child.vals.items():
                     actors.intern(d.actor)
                     for actor in clock.dots:
                         actors.intern(actor)
@@ -93,13 +88,10 @@ class BatchedMap:
         r = len(pures)
         nk, na = max(len(keys), 1), max(len(actors), 1)
         out = cls(
-            r, nk, na, witness_cap, sibling_cap, deferred_cap,
+            r, nk, na, sibling_cap, deferred_cap,
             keys=keys, actors=actors, values=values,
         )
         top = np.zeros((r, na), np.uint32)
-        wact = np.zeros((r, nk, witness_cap), np.int32)
-        wctr = np.zeros((r, nk, witness_cap), np.uint32)
-        wvalid = np.zeros((r, nk, witness_cap), bool)
         cact = np.zeros((r, nk, sibling_cap), np.int32)
         cctr = np.zeros((r, nk, sibling_cap), np.uint32)
         cclk = np.zeros((r, nk, sibling_cap, na), np.uint32)
@@ -111,29 +103,18 @@ class BatchedMap:
         for i, p in enumerate(pures):
             for actor, c in p.clock.dots.items():
                 top[i, actors.id_of(actor)] = c
-            for k, entry in p.entries.items():
+            for k, child in p.entries.items():
                 ki = keys.id_of(k)
-                if len(entry.dots) > witness_cap:
+                if len(child.vals) > sibling_cap:
                     raise ValueError(
-                        f"replica {i} key {k!r}: {len(entry.dots)} witness "
-                        f"dots; capacity is {witness_cap}"
-                    )
-                # Canonical slot order (actor id, counter) — matches the
-                # kernels' _canon_witnesses, so raw arrays are comparable.
-                for w, d in enumerate(
-                    sorted(entry.dots, key=lambda d: (actors.id_of(d.actor), d.counter))
-                ):
-                    wact[i, ki, w] = actors.id_of(d.actor)
-                    wctr[i, ki, w] = d.counter
-                    wvalid[i, ki, w] = True
-                if len(entry.val.vals) > sibling_cap:
-                    raise ValueError(
-                        f"replica {i} key {k!r}: {len(entry.val.vals)} "
+                        f"replica {i} key {k!r}: {len(child.vals)} "
                         f"siblings; capacity is {sibling_cap}"
                     )
+                # Canonical slot order (actor id, counter) — matches the
+                # kernels' _canon_child, so raw arrays are comparable.
                 for s, (d, (clock, v)) in enumerate(
                     sorted(
-                        entry.val.vals.items(),
+                        child.vals.items(),
                         key=lambda kv: (actors.id_of(kv[0].actor), kv[0].counter),
                     )
                 ):
@@ -157,9 +138,6 @@ class BatchedMap:
 
         out.state = ops.MapState(
             top=jnp.asarray(top),
-            wact=jnp.asarray(wact),
-            wctr=jnp.asarray(wctr),
-            wvalid=jnp.asarray(wvalid),
             child=mv_ops.MVRegState(
                 wact=jnp.asarray(cact),
                 wctr=jnp.asarray(cctr),
@@ -182,12 +160,8 @@ class BatchedMap:
         out.clock = VClock(
             {self.actors[a]: int(c) for a, c in enumerate(st.top) if c > 0}
         )
-        present = st.wvalid.any(axis=-1)
+        present = st.child.valid.any(axis=-1)
         for ki in np.nonzero(present)[0]:
-            dots = {
-                Dot(self.actors[int(st.wact[ki, w])], int(st.wctr[ki, w]))
-                for w in np.nonzero(st.wvalid[ki])[0]
-            }
             vals = {}
             for s in np.nonzero(st.child.valid[ki])[0]:
                 d = Dot(
@@ -202,7 +176,7 @@ class BatchedMap:
                     }
                 )
                 vals[d] = (clock, self.values[int(st.child.val[ki, s])])
-            out.entries[self.keys[int(ki)]] = _Entry(dots, MVReg(vals))
+            out.entries[self.keys[int(ki)]] = MVReg(vals)
         for d in np.nonzero(st.dvalid)[0]:
             clock = VClock(
                 {self.actors[a]: int(c) for a, c in enumerate(st.dcl[d]) if c > 0}
@@ -231,10 +205,10 @@ class BatchedMap:
                 raise IndexError(
                     f"actor id {aid} outside the {na}-lane universe"
                 )
-            if kid >= self.state.wact.shape[-2]:
+            if kid >= self.state.dkeys.shape[-1]:
                 raise IndexError(
                     f"key id {kid} outside the "
-                    f"{self.state.wact.shape[-2]}-slot universe"
+                    f"{self.state.dkeys.shape[-1]}-slot universe"
                 )
             clock = np.zeros((na,), np.uint32)
             for actor, c in op.op.clock.dots.items():
@@ -249,16 +223,15 @@ class BatchedMap:
             )
             if bool(overflow):
                 raise SlotOverflow(
-                    f"replica {replica}: witness/sibling slab full on Up at "
-                    f"key {op.key!r} — rebuild with a larger witness_cap/"
-                    f"sibling_cap"
+                    f"replica {replica}: sibling slab full on Up at key "
+                    f"{op.key!r} — rebuild with a larger sibling_cap"
                 )
         elif isinstance(op, MapRm):
             na = self.state.top.shape[-1]
             cl = np.zeros((na,), np.uint32)
             for actor, c in op.clock.dots.items():
                 cl[self.actors.id_of(actor)] = c
-            mask = np.zeros((self.state.wact.shape[-2],), bool)
+            mask = np.zeros((self.state.dkeys.shape[-1],), bool)
             for k in op.keyset:
                 mask[self.keys.id_of(k)] = True
             row, overflow = ops.apply_rm(row, jnp.asarray(cl), jnp.asarray(mask))
@@ -274,12 +247,24 @@ class BatchedMap:
         )
 
     # ---- state path (CvRDT — the config-4 benchmark path) -------------
+    @staticmethod
+    def _check_join_flags(flags, what: str) -> None:
+        """The join's flag lanes: [sibling-slab, deferred-buffer]."""
+        sibling, deferred = (bool(x) for x in flags)
+        if sibling:
+            raise SlotOverflow(
+                f"{what}: sibling slab full — rebuild with a larger sibling_cap"
+            )
+        if deferred:
+            raise DeferredOverflow(
+                f"{what}: deferred buffer full — rebuild with a larger deferred_cap"
+            )
+
     def merge_from(self, dst: int, src: int) -> None:
-        joined, overflow = ops.join(
+        joined, flags = ops.join(
             self._row(self.state, dst), self._row(self.state, src)
         )
-        if bool(overflow):
-            raise DeferredOverflow(f"merge {src}->{dst}: slab capacity exceeded")
+        self._check_join_flags(flags, f"merge {src}->{dst}")
         self.state = jax.tree.map(
             lambda full, r: full.at[dst].set(r), self.state, joined
         )
@@ -287,14 +272,12 @@ class BatchedMap:
     def fold(self) -> Map:
         """Full-mesh anti-entropy: join all R replicas in a log2 reduction
         tree and return the converged oracle-form state."""
-        folded, overflow = ops.fold(self.state)
-        if bool(overflow):
-            raise DeferredOverflow("fold: slab capacity exceeded")
+        folded, flags = ops.fold(self.state)
+        self._check_join_flags(flags, "fold")
         tmp = BatchedMap(
             1,
-            self.state.wact.shape[-2],
+            self.state.dkeys.shape[-1],
             self.state.top.shape[-1],
-            self.state.wact.shape[-1],
             self.state.child.wact.shape[-1],
             self.state.dcl.shape[-2],
             keys=self.keys,
@@ -305,5 +288,5 @@ class BatchedMap:
         return tmp.to_pure(0)
 
     def keys_of(self, i: int) -> frozenset:
-        present = np.asarray(self.state.wvalid[i].any(axis=-1))
+        present = np.asarray(self.state.child.valid[i].any(axis=-1))
         return frozenset(self.keys[int(k)] for k in np.nonzero(present)[0])

@@ -24,7 +24,9 @@ def tree_fold(
     """Fold ``states`` (a pytree batched on the leading axis) with
     ``join(a, b) -> (joined, flag)``; ``identity`` is one unbatched join
     identity. Returns ``(folded, any_flag)`` — flags (overflow/conflict)
-    are OR-accumulated across every pairwise join."""
+    are OR-accumulated across every pairwise join, reducing only the
+    batch axis so multi-lane flags (e.g. the map join's [sibling,
+    deferred] pair) keep their shape."""
     flagged = jnp.zeros((), bool)
     r = jax.tree.leaves(states)[0].shape[0]
     pow2 = 1
@@ -43,6 +45,6 @@ def tree_fold(
         left = jax.tree.map(lambda x: x[:half], states)
         right = jax.tree.map(lambda x: x[half:], states)
         states, flag = jax.vmap(join)(left, right)
-        flagged = flagged | jnp.any(flag)
+        flagged = flagged | jnp.any(flag, axis=0)
         r = half
     return jax.tree.map(lambda x: x[0], states), flagged

@@ -3,31 +3,30 @@
 Oracle: ``crdt_tpu.pure.map.Map`` (reference: src/map.rs ``Map<K, V, A>``,
 SURVEY.md §3 row 11, §4.3) specialised to MVReg children — the
 ``Map<String, MVReg<_>>`` shape of BASELINE config 4. State layout for K
-interned key slots, A actors, W witness slots per key, S sibling slots
-per child register, D deferred slots (leading axes batch replicas):
+interned key slots, A actors, S sibling slots per child register, D
+deferred slots (leading axes batch replicas):
 
-- ``top [..., A]``                     — the map's top clock,
-- ``wact/wctr/wvalid [..., K, W]``     — per-key witness dot sets (the
-  oracle's ``_Entry.dots``: true dot sets, not per-actor-max clocks, so
-  removing the state witnessed by (A,1) while (A,2) lives is exact),
-- ``child`` (``MVRegState [..., K, S…]``) — the per-key MVReg slab; a
-  content is alive iff its witness dot is in the key's witness set,
+- ``top [..., A]``  — the map's top clock (the one shared causal context),
+- ``child`` (``MVRegState [..., K, S…]``) — the per-key content slab; a
+  content's witness dot is its birth dot and the key's existence witness
+  (pure/map.py composition rule: a key is present iff its child holds
+  any live dot — no separate witness table),
 - ``dcl [..., D, A]`` / ``dkeys [..., D, K]`` / ``dvalid [..., D]`` —
   parked key removes whose clock ran ahead of the top (masked epochs,
   SURVEY.md §7.3), replayed after every state change.
 
-A key is present iff any witness slot is valid. ``join`` is the oracle's
-merge: witness dots survive by the orswot dot rule (kept iff the other
-side also witnesses them or never saw them), children merge by the MVReg
-domination rule and are then pruned to the surviving witnesses — a pure
-pointwise function of the joined witness set, which is what makes the
-join a true lattice (safe under any reduction-tree order). Everything is
-element-wise compares + masks; no data-dependent gathers, so vmap/pjit
-batch it freely and XLA tiles it.
+``join`` is the oracle's merge: per content dot, the orswot dot rule
+under the two top clocks (kept iff the other side also holds it or never
+saw it). Sibling write-clock domination happens ONLY at op-apply time
+(``apply_up``), never at merge — the merge-time variant is
+order-dependent (see pure/map.py); the context rule propagates apply-time
+evictions, making the join a true lattice (safe under any reduction-tree
+order). Everything is element-wise compares + masks; no data-dependent
+gathers, so vmap/pjit batch it freely and XLA tiles it.
 
-All slot tables are kept in canonical form (valid-first, sorted by
-(actor, counter), dead payload zeroed) so converged replicas compare
-equal as raw arrays.
+Slot tables are kept in canonical form (valid-first, sorted by (actor,
+counter), dead payload zeroed) so converged replicas compare equal as
+raw arrays.
 """
 
 from __future__ import annotations
@@ -49,9 +48,6 @@ class MapState(NamedTuple):
     """A (possibly batched) dense Map<K, MVReg> replica state (pytree)."""
 
     top: jax.Array     # [..., A]
-    wact: jax.Array    # [..., K, W] int32
-    wctr: jax.Array    # [..., K, W] uint32
-    wvalid: jax.Array  # [..., K, W] bool
     child: MVRegState  # arrays [..., K, S(, A)]
     dcl: jax.Array     # [..., D, A]
     dkeys: jax.Array   # [..., D, K] bool
@@ -61,7 +57,6 @@ class MapState(NamedTuple):
 def empty(
     n_keys: int,
     n_actors: int,
-    witness_cap: int = 4,
     sibling_cap: int = 4,
     deferred_cap: int = 4,
     batch: tuple = (),
@@ -69,9 +64,6 @@ def empty(
     """The join identity: no dots, no keys, no parked removes."""
     return MapState(
         top=jnp.zeros((*batch, n_actors), DTYPE),
-        wact=jnp.zeros((*batch, n_keys, witness_cap), jnp.int32),
-        wctr=jnp.zeros((*batch, n_keys, witness_cap), DTYPE),
-        wvalid=jnp.zeros((*batch, n_keys, witness_cap), bool),
         child=mvreg.empty(sibling_cap, n_actors, batch=(*batch, n_keys)),
         dcl=jnp.zeros((*batch, deferred_cap, n_actors), DTYPE),
         dkeys=jnp.zeros((*batch, deferred_cap, n_keys), bool),
@@ -79,10 +71,8 @@ def empty(
     )
 
 
-# ---- witness-set helpers -------------------------------------------------
-
 def _top_at(top: jax.Array, act: jax.Array) -> jax.Array:
-    """``top[act]`` for an actor-id table ``act [..., K, W]`` against a
+    """``top[act]`` for an actor-id table ``act [..., K, S]`` against a
     clock ``top [..., A]`` (broadcast gather over the key axis)."""
     return jnp.take_along_axis(
         jnp.broadcast_to(top[..., None, :], (*act.shape[:-1], top.shape[-1])),
@@ -91,44 +81,9 @@ def _top_at(top: jax.Array, act: jax.Array) -> jax.Array:
     )
 
 
-def _witness_in(wact, wctr, wvalid, oact, octr, ovalid) -> jax.Array:
-    """For each witness slot on our side: is the same dot witnessed (in
-    any slot) on the other side? [..., K, W]"""
-    eq = (
-        (wact[..., :, None] == oact[..., None, :])
-        & (wctr[..., :, None] == octr[..., None, :])
-        & ovalid[..., None, :]
-    )
-    return wvalid & jnp.any(eq, axis=-1)
-
-
-def _retain_witnesses(child: MVRegState, wact, wctr, wvalid) -> MVRegState:
-    """The oracle's ``retain_witnesses``: a child content survives iff its
-    witness dot is in the key's (surviving) witness set."""
-    alive = (
-        (child.wact[..., :, None] == wact[..., None, :])
-        & (child.wctr[..., :, None] == wctr[..., None, :])
-        & wvalid[..., None, :]
-    )
-    return child._replace(valid=child.valid & jnp.any(alive, axis=-1))
-
-
-def _canon_witnesses(wact, wctr, wvalid):
+def _canon_child(child: MVRegState) -> MVRegState:
     """Canonical slot order: valid first, then by (actor, counter); dead
     payload zeroed — converged replicas compare equal as raw arrays."""
-    order = jnp.lexsort((wctr, wact, ~wvalid), axis=-1)
-    wact = jnp.take_along_axis(wact, order, axis=-1)
-    wctr = jnp.take_along_axis(wctr, order, axis=-1)
-    wvalid = jnp.take_along_axis(wvalid, order, axis=-1)
-    return (
-        jnp.where(wvalid, wact, 0),
-        jnp.where(wvalid, wctr, 0),
-        wvalid,
-    )
-
-
-def _canon_child(child: MVRegState) -> MVRegState:
-    """Same canonicalisation for the sibling slab (keyed by witness dot)."""
     order = jnp.lexsort((child.wctr, child.wact, ~child.valid), axis=-1)
     valid = jnp.take_along_axis(child.valid, order, axis=-1)
     return MVRegState(
@@ -146,35 +101,35 @@ def _canon_child(child: MVRegState) -> MVRegState:
 
 # ---- removes -------------------------------------------------------------
 
-def _rm_covered(wact, wctr, wvalid, rm_clock, key_mask) -> jax.Array:
-    """Witness survival under one keyset-remove (the oracle's
-    ``_apply_keyset_rm`` filter): masked keys drop dots the rm clock
-    covers. Returns the new wvalid."""
-    covered = wctr <= _top_at(rm_clock, wact)
-    return wvalid & ~(key_mask[..., :, None] & covered)
+def _rm_covered(child: MVRegState, rm_clock: jax.Array, key_mask: jax.Array) -> jax.Array:
+    """Content survival under one keyset-remove (the oracle's
+    ``remove_dots_under``): masked keys drop contents whose witness dot
+    the rm clock covers. Returns the new child valid mask."""
+    covered = child.wctr <= _top_at(rm_clock, child.wact)
+    return child.valid & ~(key_mask[..., :, None] & covered)
 
 
 def _apply_parked(state: MapState) -> MapState:
-    """Replay every parked keyset-remove against the witness table (the
-    removes commute, so scan order is free), then prune children once."""
+    """Replay every parked keyset-remove against the content slab (the
+    removes commute, so scan order is free)."""
 
-    def step(wvalid, slot):
-        cl, keys, valid = slot
-        new = _rm_covered(state.wact, state.wctr, wvalid, cl, keys)
-        return jnp.where(valid[..., None, None], new, wvalid), None
+    def step(valid, slot):
+        cl, keys, dv = slot
+        new = state.child._replace(valid=valid)
+        new_valid = _rm_covered(new, cl, keys)
+        return jnp.where(dv[..., None, None], new_valid, valid), None
 
     d_axis = state.dcl.ndim - 2
-    wvalid, _ = lax.scan(
+    valid, _ = lax.scan(
         step,
-        state.wvalid,
+        state.child.valid,
         (
             jnp.moveaxis(state.dcl, d_axis, 0),
             jnp.moveaxis(state.dkeys, d_axis, 0),
             jnp.moveaxis(state.dvalid, d_axis, 0),
         ),
     )
-    child = _retain_witnesses(state.child, state.wact, state.wctr, wvalid)
-    return state._replace(wvalid=wvalid, child=child)
+    return state._replace(child=state.child._replace(valid=valid))
 
 
 def _drop_stale_deferred(state: MapState) -> MapState:
@@ -191,44 +146,51 @@ def _drop_stale_deferred(state: MapState) -> MapState:
 
 # ---- CvRDT join (the config-4 hot loop) ----------------------------------
 
+def _dot_in(a: MVRegState, b: MVRegState) -> jax.Array:
+    """For each content slot of ``a``: does ``b`` hold the same witness
+    dot (any slot, same key)? [..., K, S]"""
+    eq = (
+        (a.wact[..., :, None] == b.wact[..., None, :])
+        & (a.wctr[..., :, None] == b.wctr[..., None, :])
+        & b.valid[..., None, :]
+    )
+    return a.valid & jnp.any(eq, axis=-1)
+
+
 @jax.jit
 def join(a: MapState, b: MapState):
     """Pairwise lattice join — the oracle's ``Map::merge`` as element-wise
-    arithmetic. Reference: src/map.rs ``CvRDT::merge`` (witness-dot-set
+    arithmetic. Reference: src/map.rs ``CvRDT::merge`` (causal-composition
     semantics per pure/map.py). Returns ``(state, overflow)``."""
-    # Witness survival: the orswot dot rule, uniform over present/absent
-    # keys (an absent key is an empty witness set).
-    keep_a = a.wvalid & (
-        _witness_in(a.wact, a.wctr, a.wvalid, b.wact, b.wctr, b.wvalid)
-        | (a.wctr > _top_at(b.top, a.wact))
+    # Content survival: the orswot dot rule under the top clocks. No
+    # write-clock domination here (see module docstring).
+    keep_a = a.child.valid & (
+        _dot_in(a.child, b.child) | (a.child.wctr > _top_at(b.top, a.child.wact))
     )
-    keep_b = b.wvalid & (
-        _witness_in(b.wact, b.wctr, b.wvalid, a.wact, a.wctr, a.wvalid)
-        | (b.wctr > _top_at(a.top, b.wact))
+    keep_b = b.child.valid & (
+        _dot_in(b.child, a.child) | (b.child.wctr > _top_at(a.top, b.child.wact))
     )
 
-    # Union the surviving witness slots; dedupe dots witnessed by both.
-    wact = jnp.concatenate([a.wact, b.wact], axis=-1)
-    wctr = jnp.concatenate([a.wctr, b.wctr], axis=-1)
-    wvalid = jnp.concatenate([keep_a, keep_b], axis=-1)
+    # Union the survivors (double-width slab for now — parked removes
+    # replay BEFORE the capacity check, so a union that only transiently
+    # exceeds capacity does not flag overflow); dedupe dots held by both
+    # (same dot ⇒ same content).
+    child = MVRegState(
+        wact=jnp.concatenate([a.child.wact, b.child.wact], axis=-1),
+        wctr=jnp.concatenate([a.child.wctr, b.child.wctr], axis=-1),
+        clk=jnp.concatenate([a.child.clk, b.child.clk], axis=-2),
+        val=jnp.concatenate([a.child.val, b.child.val], axis=-1),
+        valid=jnp.concatenate([keep_a, keep_b], axis=-1),
+    )
+    s = child.wact.shape[-1]
     dup = (
-        (wact[..., :, None] == wact[..., None, :])
-        & (wctr[..., :, None] == wctr[..., None, :])
-        & wvalid[..., :, None]
-        & wvalid[..., None, :]
+        (child.wact[..., :, None] == child.wact[..., None, :])
+        & (child.wctr[..., :, None] == child.wctr[..., None, :])
+        & child.valid[..., :, None]
+        & child.valid[..., None, :]
     )
-    w = wact.shape[-1]
     first = jnp.argmax(dup, axis=-1)  # first valid slot holding this dot
-    wvalid = wvalid & (first == jnp.arange(w))
-    wact, wctr, wvalid = _canon_witnesses(wact, wctr, wvalid)
-    wcap = a.wact.shape[-1]
-    w_overflow = jnp.any(jnp.sum(wvalid, axis=-1) > wcap)
-    wact, wctr, wvalid = wact[..., :wcap], wctr[..., :wcap], wvalid[..., :wcap]
-
-    # Children: MVReg domination merge per key, then prune to the joined
-    # witness set (pure pointwise function of the join — lattice-safe).
-    child, c_overflow = mvreg.join(a.child, b.child)
-    child = _retain_witnesses(child, wact, wctr, wvalid)
+    child = child._replace(valid=child.valid & (first == jnp.arange(s)))
 
     top = jnp.maximum(a.top, b.top)
 
@@ -237,20 +199,25 @@ def join(a: MapState, b: MapState):
     dkeys = jnp.concatenate([a.dkeys, b.dkeys], axis=-2)
     dvalid = jnp.concatenate([a.dvalid, b.dvalid], axis=-1)
     dcl, dkeys, dvalid = _dedupe_deferred(dcl, dkeys, dvalid)
-    state = MapState(
-        top=top, wact=wact, wctr=wctr, wvalid=wvalid, child=child,
-        dcl=dcl, dkeys=dkeys, dvalid=dvalid,
-    )
+    state = MapState(top=top, child=child, dcl=dcl, dkeys=dkeys, dvalid=dvalid)
     state = _apply_parked(state)
     state = _drop_stale_deferred(state)
     dcl, dkeys, dvalid, d_overflow = _compact_deferred(
         state.dcl, state.dkeys, state.dvalid, a.dcl.shape[-2]
     )
-    state = state._replace(
-        child=_canon_child(state.child), dcl=dcl, dkeys=dkeys, dvalid=dvalid
+
+    # Now compact the (replayed) slab back to capacity.
+    child = _canon_child(state.child)
+    scap = a.child.wact.shape[-1]
+    c_overflow = jnp.any(jnp.sum(child.valid, axis=-1) > scap)
+    child = jax.tree.map(
+        lambda x: x[..., :scap, :] if x.ndim == child.clk.ndim else x[..., :scap],
+        child,
     )
-    overflow = w_overflow | jnp.any(c_overflow) | jnp.any(d_overflow)
-    return state, overflow
+    state = state._replace(child=child, dcl=dcl, dkeys=dkeys, dvalid=dvalid)
+    # Two flag lanes: [sibling-slab overflow, deferred-buffer overflow] —
+    # models surface them as SlotOverflow vs DeferredOverflow.
+    return state, jnp.stack([c_overflow, jnp.any(d_overflow)])
 
 
 def fold(states: MapState):
@@ -260,9 +227,8 @@ def fold(states: MapState):
     from .lattice import tree_fold
 
     identity = empty(
-        states.wact.shape[-2],
+        states.dkeys.shape[-1],
         states.top.shape[-1],
-        states.wact.shape[-1],
         states.child.wact.shape[-1],
         states.dcl.shape[-2],
     )
@@ -281,28 +247,14 @@ def apply_up(
     val: jax.Array,
 ):
     """Apply ``Op::Up { dot, key, op: Put { clock, val } }`` (reference:
-    src/map.rs CmRDT::apply): drop already-seen dots; else witness the key
-    with the dot, route the put into the key's MVReg, advance the top, and
+    src/map.rs CmRDT::apply): drop already-seen dots; else route the put
+    into the key's register (evicting siblings its clock dominates — the
+    apply-time domination the merge relies on), advance the top, and
     replay parked removes. Returns ``(state, overflow)``."""
     counter = counter.astype(state.top.dtype)
     seen = state.top[..., actor] >= counter
-    k = state.wact.shape[-2]
+    k = state.dkeys.shape[-1]
     key_onehot = jax.nn.one_hot(key, k, dtype=bool)
-
-    # Witness the key: claim the first free slot on the key's row. The dot
-    # is fresh (unseen ⇒ in no witness set), so no dedupe is needed.
-    free = ~state.wvalid & key_onehot[..., :, None]
-    has_free = jnp.any(free, axis=(-2, -1))
-    flat = free.reshape(*free.shape[:-2], -1)
-    slot = jnp.argmax(flat, axis=-1)
-    claim = (
-        jax.nn.one_hot(slot, flat.shape[-1], dtype=bool).reshape(free.shape)
-        & (has_free & ~seen)[..., None, None]
-    )
-    wact = jnp.where(claim, jnp.asarray(actor, jnp.int32)[..., None, None], state.wact)
-    wctr = jnp.where(claim, counter[..., None, None], state.wctr)
-    wvalid = state.wvalid | claim
-    w_overflow = ~seen & ~has_free
 
     # Route the put into the key's child register (computed for every key
     # row, selected at the target — dense-mode style, no dynamic gather).
@@ -312,7 +264,9 @@ def apply_up(
         state.child,
         bc(jnp.asarray(actor, jnp.int32)),
         bc(counter),
-        jnp.broadcast_to(put_clock[..., None, :], (*put_clock.shape[:-1], k, put_clock.shape[-1])),
+        jnp.broadcast_to(
+            put_clock[..., None, :], (*put_clock.shape[:-1], k, put_clock.shape[-1])
+        ),
         bc(jnp.asarray(val, jnp.int32)),
     )
     sel = (key_onehot & ~seen[..., None])[..., None]  # [..., K, 1]
@@ -328,25 +282,20 @@ def apply_up(
     top = jnp.where(
         seen[..., None], state.top, state.top.at[..., actor].max(counter)
     )
-    state = state._replace(
-        top=top, wact=wact, wctr=wctr, wvalid=wvalid, child=child
-    )
+    state = state._replace(top=top, child=child)
     state = _drop_stale_deferred(_apply_parked(state))
-    state = state._replace(child=_canon_child(state.child))
-    return state, w_overflow | c_overflow
+    return state._replace(child=_canon_child(state.child)), c_overflow
 
 
 @jax.jit
 def apply_rm(state: MapState, rm_clock: jax.Array, key_mask: jax.Array):
     """Apply ``Op::Rm { clock, keyset }`` (reference: src/map.rs
-    ``apply_keyset_rm``): always strip the covered witnesses now; if the
-    rm clock is ahead of the top, park it (union on an equal-clock slot,
+    ``apply_keyset_rm``): always kill the covered content now; if the rm
+    clock is ahead of the top, park it (union on an equal-clock slot,
     else claim a free one). Returns ``(state, overflow)``."""
     rm_clock = jnp.asarray(rm_clock, state.top.dtype)
-    wvalid = _rm_covered(state.wact, state.wctr, state.wvalid, rm_clock, key_mask)
-    wact, wctr, wvalid = _canon_witnesses(state.wact, state.wctr, wvalid)
-    child = _retain_witnesses(state.child, wact, wctr, wvalid)
-    child = _canon_child(child)
+    valid = _rm_covered(state.child, rm_clock, key_mask)
+    child = _canon_child(state.child._replace(valid=valid))
 
     ahead = ~jnp.all(rm_clock <= state.top, axis=-1)
     same = state.dvalid & jnp.all(state.dcl == rm_clock[..., None, :], axis=-1)
@@ -364,7 +313,7 @@ def apply_rm(state: MapState, rm_clock: jax.Array, key_mask: jax.Array):
     dkeys = jnp.where(onehot[..., None], key_mask[..., None, :] | live, state.dkeys)
     return (
         MapState(
-            top=state.top, wact=wact, wctr=wctr, wvalid=wvalid, child=child,
+            top=state.top, child=child,
             dcl=dcl, dkeys=dkeys, dvalid=state.dvalid | onehot,
         ),
         overflow,

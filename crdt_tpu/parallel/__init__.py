@@ -31,24 +31,37 @@ from .mesh import (
     REPLICA_AXIS,
     ELEMENT_AXIS,
     make_mesh,
+    map_specs,
+    map_out_specs,
     orswot_specs,
     orswot_out_specs,
+    shard_map_state,
     shard_orswot,
 )
-from .collectives import all_reduce_join, all_reduce_clock, ring_round
-from .anti_entropy import mesh_fold, mesh_fold_clocks, mesh_gossip
+from .collectives import (
+    all_reduce_clock,
+    all_reduce_join,
+    all_reduce_lattice,
+    ring_round,
+)
+from .anti_entropy import mesh_fold, mesh_fold_clocks, mesh_fold_map, mesh_gossip
 
 __all__ = [
     "REPLICA_AXIS",
     "ELEMENT_AXIS",
     "make_mesh",
+    "map_specs",
+    "map_out_specs",
     "orswot_specs",
     "orswot_out_specs",
+    "shard_map_state",
     "shard_orswot",
     "all_reduce_join",
     "all_reduce_clock",
+    "all_reduce_lattice",
     "ring_round",
     "mesh_fold",
     "mesh_fold_clocks",
+    "mesh_fold_map",
     "mesh_gossip",
 ]

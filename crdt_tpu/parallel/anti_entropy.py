@@ -10,6 +10,10 @@ loop (SURVEY.md §4.2 anti-entropy path).
 over the reduced axes (the join is idempotent and the overflow flags are
 psum-reduced), but the static replication checker cannot see that
 through ``ppermute``-based recursive doubling.
+
+Entry points memoise their ``shard_map`` closures per (mesh, input
+shapes) — without this every call re-traces and re-lowers the whole
+collective program, which costs seconds per anti-entropy round.
 """
 
 from __future__ import annotations
@@ -22,17 +26,45 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops import map as map_ops
 from ..ops import orswot as ops
+from ..ops.map import MapState
 from ..ops.orswot import OrswotState
-from .collectives import all_reduce_clock, all_reduce_join, ring_round
+from .collectives import (
+    all_reduce_clock,
+    all_reduce_join,
+    all_reduce_lattice,
+    ring_round,
+)
 from .mesh import (
     ELEMENT_AXIS,
     REPLICA_AXIS,
+    map_out_specs,
+    map_specs,
     orswot_out_specs,
     orswot_specs,
     pad_elements,
+    pad_keys,
     pad_replicas,
+    pad_replicas_map,
 )
+
+
+_FN_CACHE: dict = {}
+
+
+def _cached(kind: str, state, mesh: Mesh, build, *extra):
+    """The memoised shard_map closure for ``kind`` on this (mesh, input
+    shape/dtype signature): jit-wrapped once, so repeated anti-entropy
+    rounds hit the trace/compile cache instead of re-lowering."""
+    sig = tuple(
+        (tuple(x.shape), str(x.dtype)) for x in jax.tree.leaves(state)
+    )
+    key = (kind, mesh, sig, *extra)
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        fn = _FN_CACHE[key] = jax.jit(build())
+    return fn
 
 
 def mesh_fold(state: OrswotState, mesh: Mesh) -> Tuple[OrswotState, jax.Array]:
@@ -48,20 +80,23 @@ def mesh_fold(state: OrswotState, mesh: Mesh) -> Tuple[OrswotState, jax.Array]:
     state = pad_replicas(state, mesh.shape[REPLICA_AXIS])
     state = pad_elements(state, mesh.shape[ELEMENT_AXIS])
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(orswot_specs(),),
-        out_specs=(orswot_out_specs(), P()),
-        check_vma=False,
-    )
-    def fold_fn(local):
-        folded, of_local = ops.fold(local)
-        joined, of_cross = all_reduce_join(folded, REPLICA_AXIS)
-        of = (lax.psum(of_local.astype(jnp.int32), REPLICA_AXIS) > 0) | of_cross
-        return joined, of
+    def build():
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(orswot_specs(),),
+            out_specs=(orswot_out_specs(), P()),
+            check_vma=False,
+        )
+        def fold_fn(local):
+            folded, of_local = ops.fold(local)
+            joined, of_cross = all_reduce_join(folded, REPLICA_AXIS)
+            of = (lax.psum(of_local.astype(jnp.int32), REPLICA_AXIS) > 0) | of_cross
+            return joined, of
 
-    return fold_fn(state)
+        return fold_fn
+
+    return _cached("orswot_fold", state, mesh, build)(state)
 
 
 def mesh_gossip(
@@ -81,22 +116,63 @@ def mesh_gossip(
     state = pad_replicas(state, rsize)
     state = pad_elements(state, mesh.shape[ELEMENT_AXIS])
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(orswot_specs(),),
-        out_specs=(orswot_specs(), P()),
-        check_vma=False,
-    )
-    def gossip_fn(local):
-        folded, of = ops.fold(local)
-        for _ in range(rounds):
-            folded, of_r = ring_round(folded, REPLICA_AXIS, reduce_overflow=False)
-            of = of | of_r
-        of = lax.psum(of.astype(jnp.int32), REPLICA_AXIS) > 0
-        return jax.tree.map(lambda x: x[None], folded), of
+    def build():
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(orswot_specs(),),
+            out_specs=(orswot_specs(), P()),
+            check_vma=False,
+        )
+        def gossip_fn(local):
+            folded, of = ops.fold(local)
+            for _ in range(rounds):
+                folded, of_r = ring_round(
+                    folded, REPLICA_AXIS, reduce_overflow=False
+                )
+                of = of | of_r
+            of = lax.psum(of.astype(jnp.int32), REPLICA_AXIS) > 0
+            return jax.tree.map(lambda x: x[None], folded), of
 
-    return gossip_fn(state)
+        return gossip_fn
+
+    return _cached("orswot_gossip", state, mesh, build, rounds)(state)
+
+
+def mesh_fold_map(state: MapState, mesh: Mesh) -> Tuple[MapState, jax.Array]:
+    """Full-mesh anti-entropy for the composition layer (BASELINE config
+    4): every replica's Map<K, MVReg> state joined into one converged
+    state over the (replica × key) mesh. Key shards never communicate —
+    the map join is key-wise independent (mesh.map_specs); the only
+    collective is the lattice-join all-reduce over the replica axis.
+
+    Returns (converged state [no replica axis, key-sharded], overflow).
+    """
+    state = pad_replicas_map(state, mesh.shape[REPLICA_AXIS])
+    state = pad_keys(state, mesh.shape[ELEMENT_AXIS])
+
+    def build():
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(map_specs(),),
+            out_specs=(map_out_specs(), P()),
+            check_vma=False,
+        )
+        def fold_fn(local):
+            folded, of_local = map_ops.fold(local)
+            joined, of_cross = all_reduce_lattice(
+                folded, REPLICA_AXIS, map_ops.join, map_ops.fold
+            )
+            of = (lax.psum(of_local.astype(jnp.int32), REPLICA_AXIS) > 0) | of_cross
+            # Slab overflows are key-local: reduce across key shards too
+            # so every device reports the global flag.
+            of = lax.psum(of.astype(jnp.int32), ELEMENT_AXIS) > 0
+            return joined, of
+
+        return fold_fn
+
+    return _cached("map_fold", state, mesh, build)(state)
 
 
 def mesh_fold_clocks(clocks: jax.Array, mesh: Mesh) -> jax.Array:
@@ -111,14 +187,17 @@ def mesh_fold_clocks(clocks: jax.Array, mesh: Mesh) -> jax.Array:
             [clocks, jnp.zeros((pad, clocks.shape[1]), clocks.dtype)], axis=0
         )
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(P(REPLICA_AXIS, None),),
-        out_specs=P(None),
-        check_vma=False,
-    )
-    def fold_fn(local):
-        return all_reduce_clock(jnp.max(local, axis=0), REPLICA_AXIS)
+    def build():
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(P(REPLICA_AXIS, None),),
+            out_specs=P(None),
+            check_vma=False,
+        )
+        def fold_fn(local):
+            return all_reduce_clock(jnp.max(local, axis=0), REPLICA_AXIS)
 
-    return fold_fn(clocks)
+        return fold_fn
+
+    return _cached("clock_fold", clocks, mesh, build)(clocks)

@@ -20,7 +20,7 @@ bandwidth must stay at one state, e.g. across DCN.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +32,39 @@ from ..ops.orswot import OrswotState
 
 def _axis_size(axis_name: str) -> int:
     return lax.axis_size(axis_name)
+
+
+def all_reduce_lattice(
+    local: Any,
+    axis_name: str,
+    join_fn: Callable[[Any, Any], Tuple[Any, jax.Array]],
+    fold_fn: Callable[[Any], Tuple[Any, jax.Array]],
+) -> Tuple[Any, jax.Array]:
+    """All-reduce with an arbitrary lattice-join monoid over a mesh axis
+    (the generic core of ``all_reduce_join``; works for any CRDT state
+    pytree whose ``join_fn`` is associative/commutative/idempotent and
+    returns ``(joined, flag)``)."""
+    size = _axis_size(axis_name)
+    overflow = jnp.zeros((), bool)
+    if size & (size - 1) == 0 and size > 1:
+        k = 1
+        while k < size:
+            perm = [(i, i ^ k) for i in range(size)]
+            other = jax.tree.map(
+                lambda x: lax.ppermute(x, axis_name, perm), local
+            )
+            local, of = join_fn(local, other)
+            overflow = overflow | of
+            k *= 2
+    elif size > 1:
+        gathered = jax.tree.map(
+            lambda x: lax.all_gather(x, axis_name, axis=0), local
+        )
+        local, overflow = fold_fn(gathered)
+    # Reduce the per-device overflow flags so the output is truly
+    # replicated (recursive-doubling pairings differ per device).
+    overflow = lax.psum(overflow.astype(jnp.int32), axis_name) > 0
+    return local, overflow
 
 
 def all_reduce_clock(clock: jax.Array, axis_name: str) -> jax.Array:
@@ -57,27 +90,7 @@ def all_reduce_join(
     every edge of the full replica mesh (SURVEY.md §4.2) — collapsed to
     one collective per the north star.
     """
-    size = _axis_size(axis_name)
-    overflow = jnp.zeros((), bool)
-    if size & (size - 1) == 0 and size > 1:
-        k = 1
-        while k < size:
-            perm = [(i, i ^ k) for i in range(size)]
-            other = jax.tree.map(
-                lambda x: lax.ppermute(x, axis_name, perm), local
-            )
-            local, of = ops.join(local, other)
-            overflow = overflow | of
-            k *= 2
-    elif size > 1:
-        gathered = jax.tree.map(
-            lambda x: lax.all_gather(x, axis_name, axis=0), local
-        )
-        local, overflow = ops.fold(gathered)
-    # Reduce the per-device overflow flags so the output is truly
-    # replicated (recursive-doubling pairings differ per device).
-    overflow = lax.psum(overflow.astype(jnp.int32), axis_name) > 0
-    return local, overflow
+    return all_reduce_lattice(local, axis_name, ops.join, ops.fold)
 
 
 def ring_round(
@@ -85,6 +98,7 @@ def ring_round(
     axis_name: str,
     shift: int = 1,
     reduce_overflow: bool = True,
+    join_fn: Callable[[Any, Any], Tuple[Any, jax.Array]] = ops.join,
 ) -> Tuple[OrswotState, jax.Array]:
     """One gossip round: receive the state of the neighbor ``shift``
     positions up-ring and join it in. P-1 unit-shift rounds converge all
@@ -99,7 +113,7 @@ def ring_round(
     size = _axis_size(axis_name)
     perm = [(i, (i + shift) % size) for i in range(size)]
     other = jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), local)
-    joined, of = ops.join(local, other)
+    joined, of = join_fn(local, other)
     if reduce_overflow:
         of = lax.psum(of.astype(jnp.int32), axis_name) > 0
     return joined, of

@@ -17,6 +17,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.map import MapState
+from ..ops.mvreg import MVRegState
 from ..ops.orswot import OrswotState
 
 REPLICA_AXIS = "replica"
@@ -94,6 +96,94 @@ def pad_elements(state: OrswotState, multiple: int) -> OrswotState:
     return state._replace(
         ctr=jnp.pad(state.ctr, ((0, 0), (0, pad), (0, 0))),
         dmask=jnp.pad(state.dmask, ((0, 0), (0, 0), (0, pad))),
+    )
+
+
+def map_specs() -> MapState:
+    """PartitionSpecs for a batched ``MapState`` [R, ...]: replicas and
+    *keys* on the mesh (keys are the Map's element axis — BASELINE
+    config 4 at 1M keys), actor lanes / sibling / deferred slots
+    replicated. The map join is key-wise independent (content survival
+    reads only per-key slots plus the replicated top clocks), so key
+    shards never communicate."""
+    return MapState(
+        top=P(REPLICA_AXIS, None),
+        child=MVRegState(
+            wact=P(REPLICA_AXIS, ELEMENT_AXIS, None),
+            wctr=P(REPLICA_AXIS, ELEMENT_AXIS, None),
+            clk=P(REPLICA_AXIS, ELEMENT_AXIS, None, None),
+            val=P(REPLICA_AXIS, ELEMENT_AXIS, None),
+            valid=P(REPLICA_AXIS, ELEMENT_AXIS, None),
+        ),
+        dcl=P(REPLICA_AXIS, None, None),
+        dkeys=P(REPLICA_AXIS, None, ELEMENT_AXIS),
+        dvalid=P(REPLICA_AXIS, None),
+    )
+
+
+def map_out_specs() -> MapState:
+    """Specs for the converged (replica-reduced) map state."""
+    return MapState(
+        top=P(None),
+        child=MVRegState(
+            wact=P(ELEMENT_AXIS, None),
+            wctr=P(ELEMENT_AXIS, None),
+            clk=P(ELEMENT_AXIS, None, None),
+            val=P(ELEMENT_AXIS, None),
+            valid=P(ELEMENT_AXIS, None),
+        ),
+        dcl=P(None, None),
+        dkeys=P(None, ELEMENT_AXIS),
+        dvalid=P(None),
+    )
+
+
+def pad_replicas_map(state: MapState, multiple: int) -> MapState:
+    """Pad the replica axis with join identities (see ``pad_replicas``)."""
+    import jax.numpy as jnp
+
+    from ..ops.map import empty
+
+    pad = (-state.top.shape[0]) % multiple
+    if pad == 0:
+        return state
+    ident = empty(
+        state.dkeys.shape[-1],
+        state.top.shape[-1],
+        state.child.wact.shape[-1],
+        state.dcl.shape[-2],
+        batch=(pad,),
+    )
+    return jax.tree.map(
+        lambda x, p: jnp.concatenate([x, p.astype(x.dtype)], axis=0), state, ident
+    )
+
+
+def pad_keys(state: MapState, multiple: int) -> MapState:
+    """Pad the key axis with never-written slots so it divides the
+    mesh's element axis (padded keys hold no dots, so the join never
+    surfaces them)."""
+    import jax.numpy as jnp
+
+    pad = (-state.dkeys.shape[-1]) % multiple
+    if pad == 0:
+        return state
+    kpad = lambda x: jnp.pad(x, ((0, 0), (0, pad)) + ((0, 0),) * (x.ndim - 2))
+    return state._replace(
+        child=jax.tree.map(kpad, state.child),
+        dkeys=jnp.pad(state.dkeys, ((0, 0), (0, 0), (0, pad))),
+    )
+
+
+def shard_map_state(state: MapState, mesh: Mesh) -> MapState:
+    """Place a batched map state onto the mesh with the canonical layout
+    (replica × key), padding both axes to divisibility."""
+    state = pad_replicas_map(state, mesh.shape[REPLICA_AXIS])
+    state = pad_keys(state, mesh.shape[ELEMENT_AXIS])
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        state,
+        map_specs(),
     )
 
 

@@ -4,10 +4,9 @@ Reference: src/map.rs ``Map<K, V: Val<A>, A> { clock, entries: BTreeMap<K,
 Entry { clock, val }>, deferred }`` with ``Op::{Nop, Up { dot, key, op },
 Rm { clock, keyset }}`` (SURVEY.md §3 row 11, §4.3). Values must satisfy
 the ``Val`` contract: cloneable, default-constructible, ``CmRDT`` +
-``CvRDT`` + supporting witness-pruning — removal of a key prunes the child
-to the surviving update witnesses, and merge prunes child state whose
-witnessing update dots one side observed and deleted (the hardest
-correctness surface in the reference).
+``CvRDT`` + causally removable (removal of a key kills exactly the child
+state whose birth dots the remove clock covers — the hardest correctness
+surface in the reference).
 
 In Python the ``trait Val<A>`` bound becomes a constructor argument: the
 Map holds ``val_default`` (a zero-arg factory, e.g. ``MVReg`` / ``Orswot``
@@ -15,33 +14,41 @@ Map holds ``val_default`` (a zero-arg factory, e.g. ``MVReg`` / ``Orswot``
 
 Composition rule (the causal-composition law from the delta-CRDT
 literature — Almeida et al., PAPERS.md; chosen per SURVEY.md §0 since the
-mount was empty): each entry tracks its *witness dot set* ``W`` (every
-update dot routed to the key that has not been removed), and
+mount was empty): the map is a DotMap under one shared causal context
+(the map's top clock), and every child is a dot store whose *live birth
+dots* are the key's existence witnesses:
 
-    child state is alive iff its witness dot is in ``W``.
+    a key is present iff its child holds any live dot.
 
-``W`` is a true dot set, not a per-actor-max clock — so removing the state
-witnessed by (A,1) while (A,2) lives is representable exactly, and every
-path maintains the single invariant: key removal filters ``W`` under the
-rm clock and prunes the child to ``W``; merge joins ``W`` with the orswot
-dot rule (a dot survives iff the other side also has it or never saw it),
-plain-merges the children, and prunes to the joined ``W``. Because the
-child prune is a pure pointwise function of the joined witness set —
-never of top clocks or merge order — ``merge`` is a true lattice join
-(commutative, associative, idempotent, bit-for-bit), which the property
-suite asserts and the TPU reduction-tree anti-entropy path requires
-(SURVEY.md §7.3 "deterministic reduction").
+There is no separate per-entry witness set: for contextless children
+(MVReg — a DotFun) the live dots are the content witness dots and merge
+is the orswot dot rule under the outer tops (``causal_merge``); for
+children with their own top clock (Orswot, nested Map) the ``covered``
+invariant keeps child tops equal to the map clock, so their own
+``merge`` IS the context-rule join. Either way child survival in a merge
+is a pointwise function of birth dots and the two (top, context) pairs —
+never of sibling write-clock comparisons at merge time — which makes the
+composed merge a true lattice join (commutative, associative, idempotent,
+bit-for-bit). The property suite asserts this and the TPU reduction-tree
+anti-entropy path requires it (SURVEY.md §7.3 "deterministic reduction").
+
+The earlier design (separate witness dot-sets + MVReg write-clock
+domination at merge) was NOT associative: a dominated sibling could be
+evicted by a merge, then its dominator removed by a key-remove, leaving
+states whose join depended on encounter order. Apply-time domination +
+context-rule merge has no such interaction.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
 from typing import Any, Callable, Dict, FrozenSet, Iterable, Set, Tuple
 
 from ..ctx import AddCtx, ReadCtx, RmCtx
 from ..dot import Dot
 from ..traits import CmRDT, CvRDT, ResetRemove
 from ..vclock import VClock
+
+from dataclasses import dataclass
 
 
 @dataclass(frozen=True)
@@ -67,46 +74,13 @@ class MapRm:
     keyset: Tuple[Any, ...]
 
 
-def _witness_clock(dots: Set[Dot]) -> VClock:
-    """Per-actor-max view of a witness set (the RmCtx wire form —
-    reference: src/map.rs ``Entry.clock``)."""
-    out = VClock()
-    for d in dots:
-        out.apply(d)
-    return out
-
-
-class _Entry:
-    """Reference: src/map.rs ``Entry { clock, val }`` — here the birth
-    witnesses are a dot set (see module docstring for why)."""
-
-    __slots__ = ("dots", "val")
-
-    def __init__(self, dots: Set[Dot], val: Any):
-        self.dots = dots
-        self.val = val
-
-    def clone(self) -> "_Entry":
-        return _Entry(set(self.dots), self.val.clone())
-
-    def __eq__(self, other):
-        return (
-            isinstance(other, _Entry)
-            and self.dots == other.dots
-            and self.val == other.val
-        )
-
-    def __repr__(self):
-        return f"Entry(dots={sorted((repr(d.actor), d.counter) for d in self.dots)}, val={self.val!r})"
-
-
 class Map(CvRDT, CmRDT, ResetRemove):
     __slots__ = ("val_default", "clock", "entries", "deferred")
 
     def __init__(self, val_default: Callable[[], Any]):
         self.val_default = val_default
         self.clock = VClock()
-        self.entries: Dict[Any, _Entry] = {}
+        self.entries: Dict[Any, Any] = {}  # key -> child Val
         self.deferred: Dict[VClock, set] = {}
 
     # ---- reads ---------------------------------------------------------
@@ -124,14 +98,18 @@ class Map(CvRDT, CmRDT, ResetRemove):
         return ctx
 
     def get(self, key: Any) -> ReadCtx:
-        """Reference: src/map.rs ``Map::get`` — rm_clock covers the entry's
-        observed witnesses so a derived rm removes exactly the observed
-        updates."""
-        entry = self.entries.get(key)
+        """Reference: src/map.rs ``Map::get`` — rm_clock covers the child's
+        observed live dots so a derived rm removes exactly the observed
+        state."""
+        val = self.entries.get(key)
+        rm_clock = VClock()
+        if val is not None:
+            for d in val.live_dots():
+                rm_clock.apply(d)
         return ReadCtx(
             add_clock=self.clock.clone(),
-            rm_clock=_witness_clock(entry.dots) if entry is not None else VClock(),
-            val=entry.val.clone() if entry is not None else None,
+            rm_clock=rm_clock,
+            val=val.clone() if val is not None else None,
         )
 
     def keys(self) -> FrozenSet[Any]:
@@ -146,8 +124,8 @@ class Map(CvRDT, CmRDT, ResetRemove):
     ) -> Up:
         """Mint an op applying ``f(current_or_default_child, ctx) ->
         child_op`` at ``key``. Reference: src/map.rs ``Map::update``."""
-        entry = self.entries.get(key)
-        val = entry.val.clone() if entry is not None else self.val_default()
+        val = self.entries.get(key)
+        val = val.clone() if val is not None else self.val_default()
         child_op = f(val, ctx)
         return Up(dot=ctx.dot, key=key, op=child_op)
 
@@ -165,34 +143,32 @@ class Map(CvRDT, CmRDT, ResetRemove):
         if isinstance(op, Up):
             if self.clock.get(op.dot.actor) >= op.dot.counter:
                 return  # already observed this update
-            entry = self.entries.get(op.key)
-            if entry is None:
-                entry = _Entry(set(), self.val_default())
-                self.entries[op.key] = entry
-            entry.dots.add(op.dot)
-            entry.val.apply(op.op)
+            val = self.entries.get(op.key)
+            if val is None:
+                val = self.val_default()
+                val.covered(self.clock)  # adopt the shared context
+                self.entries[op.key] = val
+            val.apply(op.op)
             self.clock.apply(op.dot)
             self._apply_deferred()
             self._cover_children(dot=op.dot)
+            if val.is_bottom() and op.key in self.entries:
+                del self.entries[op.key]
         elif isinstance(op, MapRm):
             self._apply_keyset_rm(op.keyset, op.clock)
         else:
             raise TypeError(f"not a Map op: {op!r}")
 
     def _apply_keyset_rm(self, keyset: Iterable[Any], clock: VClock) -> None:
-        """Reference: src/map.rs ``apply_keyset_rm`` — drop the witnesses
-        the rm clock covers and prune the child to the survivors; defer if
-        the rm clock is ahead of our view."""
+        """Reference: src/map.rs ``apply_keyset_rm`` — kill the child state
+        whose birth dots the rm clock covers; defer if the rm clock is
+        ahead of our view."""
         for key in keyset:
-            entry = self.entries.get(key)
-            if entry is not None:
-                entry.dots = {
-                    d for d in entry.dots if d.counter > clock.get(d.actor)
-                }
-                if not entry.dots:
+            val = self.entries.get(key)
+            if val is not None:
+                val.remove_dots_under(clock)
+                if val.is_bottom():
                     del self.entries[key]
-                else:
-                    entry.val.retain_witnesses(entry.dots)
         if not clock <= self.clock:
             self._defer_remove(clock, keyset)
 
@@ -207,53 +183,29 @@ class Map(CvRDT, CmRDT, ResetRemove):
 
     # ---- CvRDT ---------------------------------------------------------
     def merge(self, other: "Map") -> None:
-        # Witness survival is the orswot dot rule: a dot survives iff the
-        # other side also witnesses it, or has never seen it at all.
-        for key in list(self.entries):
-            if key not in other.entries:
-                entry = self.entries[key]
-                entry.dots = {
-                    d
-                    for d in entry.dots
-                    if d.counter > other.clock.get(d.actor)
-                }
-                if not entry.dots:
-                    del self.entries[key]
-                else:
-                    entry.val.retain_witnesses(entry.dots)
-
-        for key, their_entry in other.entries.items():
-            our_entry = self.entries.get(key)
-            if our_entry is not None:
-                ours, theirs = our_entry.dots, their_entry.dots
-                survivors = (
-                    {
-                        d
-                        for d in ours
-                        if d in theirs or d.counter > other.clock.get(d.actor)
-                    }
-                    | {
-                        d
-                        for d in theirs
-                        if d in ours or d.counter > self.clock.get(d.actor)
-                    }
-                )
-                if not survivors:
-                    del self.entries[key]
-                else:
-                    our_entry.val.merge(their_entry.val)
-                    our_entry.dots = survivors
-                    our_entry.val.retain_witnesses(survivors)
+        """The DotMap context-rule join (see module docstring). Children
+        are joined under the PRE-merge top clocks as contexts; a key
+        absent on one side joins as a default child carrying that side's
+        context, so state the absent side observed-and-removed dies and
+        state it never saw survives."""
+        self_ctx = self.clock.clone()
+        other_ctx = other.clock.clone()
+        for key in set(self.entries) | set(other.entries):
+            mine = self.entries.get(key)
+            if mine is None:
+                mine = self.val_default()
+                mine.covered(self_ctx)
+            theirs = other.entries.get(key)
+            if theirs is None:
+                theirs = self.val_default()
+                theirs.covered(other_ctx)
             else:
-                survivors = {
-                    d
-                    for d in their_entry.dots
-                    if d.counter > self.clock.get(d.actor)
-                }
-                if survivors:
-                    entry = _Entry(survivors, their_entry.val.clone())
-                    entry.val.retain_witnesses(survivors)
-                    self.entries[key] = entry
+                theirs = theirs.clone()
+            mine.causal_merge(theirs, self_ctx, other_ctx)
+            if mine.is_bottom():
+                self.entries.pop(key, None)
+            else:
+                self.entries[key] = mine
 
         for clock, keys in other.deferred.items():
             self._defer_remove(clock, keys)
@@ -272,11 +224,11 @@ class Map(CvRDT, CmRDT, ResetRemove):
         key). The op path advances the clock by exactly one dot, so it
         takes the O(1)-per-child ``covered_dot`` fast path."""
         if dot is not None:
-            for entry in self.entries.values():
-                entry.val.covered_dot(dot)
+            for val in self.entries.values():
+                val.covered_dot(dot)
         else:
-            for entry in self.entries.values():
-                entry.val.covered(self.clock)
+            for val in self.entries.values():
+                val.covered(self.clock)
 
     def covered(self, ctx: VClock) -> None:
         """Causal-composition hook for a containing ``Map`` (nested
@@ -291,17 +243,45 @@ class Map(CvRDT, CmRDT, ResetRemove):
         self._apply_deferred()
         self._cover_children(dot=dot)
 
+    # ---- causal composition (the Val contract, for nesting) ------------
+    def causal_merge(self, other: "Map", self_ctx: VClock, other_ctx: VClock) -> None:
+        """As a child of an outer Map: the ``covered`` invariant keeps
+        this map's top equal to the outer context, so the context-rule
+        join is plain ``merge``."""
+        self.merge(other)
+
+    def live_dots(self) -> Set[Dot]:
+        """All birth dots witnessing live state in this map (recursive) —
+        what a derived key-remove of this child must cover."""
+        out: Set[Dot] = set()
+        for val in self.entries.values():
+            out |= val.live_dots()
+        return out
+
+    def remove_dots_under(self, clock: VClock) -> None:
+        """Causal removal for the Val contract: recursively kill child
+        state born at dots the clock covers. Leaves this map's own top
+        clock and parked removes alone (unlike the standalone
+        ``reset_remove``) — inside an outer Map the top tracks the shared
+        context (``covered`` invariant)."""
+        for key in list(self.entries):
+            val = self.entries[key]
+            val.remove_dots_under(clock)
+            if val.is_bottom():
+                del self.entries[key]
+
+    def is_bottom(self) -> bool:
+        """True iff no live entries — a containing Map entry holding this
+        is dead (its causal history lives on in the outer top clock)."""
+        return not self.entries
+
     # ---- ResetRemove (nested removal, SURVEY §4.3) ---------------------
     def reset_remove(self, clock: VClock) -> None:
         for key in list(self.entries):
-            entry = self.entries[key]
-            entry.dots = {
-                d for d in entry.dots if d.counter > clock.get(d.actor)
-            }
-            if not entry.dots:
+            val = self.entries[key]
+            val.remove_dots_under(clock)
+            if val.is_bottom():
                 del self.entries[key]
-            else:
-                entry.val.retain_witnesses(entry.dots)
         deferred = self.deferred
         self.deferred = {}
         for rm_clock, keys in deferred.items():
@@ -310,18 +290,6 @@ class Map(CvRDT, CmRDT, ResetRemove):
             if not rm_clock.is_empty():
                 self._defer_remove(rm_clock, keys)
         self.clock.reset_remove(clock)
-
-    def retain_witnesses(self, alive: Set[Dot]) -> None:
-        """Causal-composition hook for a containing ``Map``: keep only
-        entries whose witness dots survive in ``alive``, recursing into
-        children."""
-        for key in list(self.entries):
-            entry = self.entries[key]
-            entry.dots &= alive
-            if not entry.dots:
-                del self.entries[key]
-            else:
-                entry.val.retain_witnesses(entry.dots)
 
     # ---- plumbing ------------------------------------------------------
     def __eq__(self, other) -> bool:
@@ -336,7 +304,7 @@ class Map(CvRDT, CmRDT, ResetRemove):
     def clone(self) -> "Map":
         out = Map(self.val_default)
         out.clock = self.clock.clone()
-        out.entries = {k: e.clone() for k, e in self.entries.items()}
+        out.entries = {k: v.clone() for k, v in self.entries.items()}
         out.deferred = {c.clone(): set(ks) for c, ks in self.deferred.items()}
         return out
 

@@ -10,9 +10,11 @@ by their *witness dot* (the AddCtx dot that minted the write) alongside
 the full write clock — the DotFun form from the delta-CRDT literature
 (Almeida et al., "Delta State Replicated Data Types", PAPERS.md). The
 observable semantics (dominance filtering, sibling survival) are the
-reference's; the witness dot is what lets a containing ``Map`` prune child
-state exactly against surviving birth dots (``retain_witnesses``), which
-keeps the composed merge a true lattice join.
+reference's; the witness dot is what lets a containing ``Map`` compose
+this register causally (``causal_merge`` / ``remove_dots_under`` /
+``live_dots`` — the content dots double as the key's existence
+witnesses), which keeps the composed merge a true lattice join (see
+pure/map.py).
 """
 
 from __future__ import annotations
@@ -109,12 +111,44 @@ class MVReg(CvRDT, CmRDT, ResetRemove):
     def covered_dot(self, dot) -> None:
         """One-dot fast path of ``covered`` — also a no-op."""
 
-    def retain_witnesses(self, alive) -> None:
-        """Causal-composition hook for ``Map``: keep only contents whose
-        witness dot is in the entry's surviving witness set."""
+    # ---- causal composition (the Val contract for Map) -----------------
+    def causal_merge(self, other: "MVReg", self_ctx: VClock, other_ctx: VClock) -> None:
+        """Join as a DotFun under shared causal contexts (the containing
+        Map's top clocks): a content survives iff both sides hold its
+        witness dot, or one side holds it and the other's context never
+        saw it (the orswot dot rule — a true lattice join). Write-clock
+        domination is NOT applied here: a put evicts dominated siblings
+        at apply time on every replica that delivers it (causal delivery
+        guarantees the dominated put arrived first), and the context rule
+        propagates those evictions — applying domination at merge time
+        instead is order-dependent and breaks associativity."""
+        keep = {}
+        for d, cv in self.vals.items():
+            if d in other.vals or d.counter > other_ctx.get(d.actor):
+                keep[d] = cv
+        for d, cv in other.vals.items():
+            if d in self.vals or d.counter > self_ctx.get(d.actor):
+                keep[d] = cv
+        self.vals = keep
+
+    def remove_dots_under(self, clock: VClock) -> None:
+        """Causal removal for the Val contract: drop contents whose
+        witness dot the remove clock covers (dot-level, unlike the
+        standalone ``reset_remove`` which compares full write clocks)."""
         self.vals = {
-            d: (c, v) for d, (c, v) in self.vals.items() if d in alive
+            d: cv
+            for d, cv in self.vals.items()
+            if d.counter > clock.get(d.actor)
         }
+
+    def live_dots(self):
+        """The live content witness dots — the covering set a derived
+        key-remove of this register must dominate."""
+        return set(self.vals)
+
+    def is_bottom(self) -> bool:
+        """True iff no live content — a Map entry holding this is dead."""
+        return not self.vals
 
     # ---- plumbing ------------------------------------------------------
     def __eq__(self, other) -> bool:

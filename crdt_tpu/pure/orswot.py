@@ -204,18 +204,39 @@ class Orswot(CvRDT, CmRDT, ResetRemove):
         self.clock.apply(dot)
         self._apply_deferred()
 
-    def retain_witnesses(self, alive) -> None:
-        """Causal-composition hook for a containing ``Map``: keep only
-        member birth dots present in the ``alive`` witness set. Observed
-        knowledge (the top clock) is retained — every dot it covers was
-        genuinely routed through the containing map."""
+    # ---- causal composition (the Val contract for Map) -----------------
+    def causal_merge(self, other: "Orswot", self_ctx: VClock, other_ctx: VClock) -> None:
+        """As a Map child: the ``covered`` invariant keeps this set's top
+        equal to the outer context, so the context-rule join is plain
+        ``merge`` (see pure/map.py module docstring)."""
+        self.merge(other)
+
+    def live_dots(self):
+        """Per-actor-max birth dots of all live members — the covering set
+        a derived key-remove of this child must dominate."""
+        out = set()
+        for entry in self.entries.values():
+            for a, c in entry.dots.items():
+                out.add(Dot(a, c))
+        return out
+
+    def remove_dots_under(self, clock: VClock) -> None:
+        """Causal removal for the Val contract: kill member birth dots the
+        clock covers. Unlike the standalone ``reset_remove`` this leaves
+        the top clock (and parked removes) alone — inside a Map the top
+        tracks the shared context (``covered`` invariant), and its
+        coverage of the killed dots is exactly what encodes
+        observed-and-removed for later merges."""
         for member in list(self.entries):
             entry = self.entries[member]
-            entry.dots = {
-                a: c for a, c in entry.dots.items() if Dot(a, c) in alive
-            }
+            entry.reset_remove(clock)
             if entry.is_empty():
                 del self.entries[member]
+
+    def is_bottom(self) -> bool:
+        """True iff no live members — a Map entry holding this is dead
+        (its causal history lives on in the outer top clock)."""
+        return not self.entries
 
     # ---- plumbing ------------------------------------------------------
     def members(self) -> FrozenSet[Any]:

@@ -192,3 +192,106 @@ def test_orswot_map_convergence(seed):
             m.merge(states[j].clone())
         merged.append(m)
     assert_all_equal(merged)
+
+
+def test_merge_grouping_independence_regression():
+    # Regression for the non-associative witness/domination interaction
+    # (found by the mesh fold property test): a sibling dominated at
+    # apply time, whose dominator is then key-removed, must converge to
+    # the same state under every merge grouping.
+    reps = [mv_map() for _ in range(6)]
+
+    def send(origin, op, deliver):
+        for i in range(6):
+            if i == origin or i in deliver:
+                reps[i].apply(op)
+
+    m = reps[0]
+    op1 = m.update("k1", m.len().derive_add_ctx("s0"), lambda r, c: r.write(0, c))
+    send(0, op1, {3, 5})
+    m = reps[3]
+    op2 = m.update("k1", m.len().derive_add_ctx("s3"), lambda r, c: r.write(0, c))
+    send(3, op2, {1})
+    m = reps[0]
+    op3 = m.update("k2", m.len().derive_add_ctx("s0"), lambda r, c: r.write(0, c))
+    send(0, op3, set())
+    m = reps[1]
+    op4 = m.rm("k1", m.get("k1").derive_rm_ctx())
+    send(1, op4, set())
+
+    def fold(order, grouping):
+        clones = [reps[i].clone() for i in order]
+        while len(clones) > 1:
+            if grouping == "seq":
+                clones[0].merge(clones.pop(1))
+            else:  # pairwise tree
+                nxt = []
+                for i in range(0, len(clones) - 1, 2):
+                    clones[i].merge(clones[i + 1])
+                    nxt.append(clones[i])
+                if len(clones) % 2:
+                    nxt.append(clones[-1])
+                clones = nxt
+        return clones[0]
+
+    results = [
+        fold(range(6), "seq"),
+        fold(range(6), "tree"),
+        fold([5, 4, 3, 2, 1, 0], "seq"),
+        fold([0, 1, 2, 3, 4, 5], "tree"),
+        fold([2, 3, 0, 1, 4, 5], "tree"),
+        fold([1, 3, 5, 0, 2, 4], "seq"),
+    ]
+    assert_all_equal(results)
+    # The dominated sibling (s0,1) was evicted by op2's apply on r3, and
+    # op4 removed op2's write: converged k1 must be gone entirely.
+    final = results[0]
+    assert final.get("k1").val is None
+    assert final.get("k2").val.read().val == [0]
+
+
+@given(seeds)
+def test_map_random_merge_dag_convergence(seed):
+    # Lattice stress: random op history over N sites with random partial
+    # delivery, then fold under several random merge DAGs — all must
+    # agree bit-for-bit (the reduction-tree soundness requirement).
+    rng = random.Random(seed)
+    n = 5
+    reps = [mv_map() for _ in range(n)]
+    # Per-origin prefix delivery: receiving an origin's op k without ops
+    # 1..k-1 violates the DotRange causal precondition (the clock would
+    # jump the gap and claim unseen dots).
+    got = [[0] * n for _ in range(n)]
+    seq = [0] * n
+    for _ in range(14):
+        origin = rng.randrange(n)
+        m = reps[origin]
+        key = rng.choice("xyz")
+        if rng.random() < 0.6 or m.get(key).val is None:
+            op = m.update(
+                key,
+                m.len().derive_add_ctx(f"s{origin}"),
+                lambda r, c: r.write(rng.randrange(4), c),
+            )
+        else:
+            op = m.rm(key, m.get(key).derive_rm_ctx())
+        for i in range(n):
+            if i == origin:
+                reps[i].apply(op)
+            elif got[i][origin] == seq[origin] and rng.random() < 0.5:
+                reps[i].apply(op)
+                got[i][origin] += 1
+        seq[origin] += 1
+
+    outs = []
+    for _ in range(4):
+        clones = [r.clone() for r in reps]
+        rng.shuffle(clones)
+        while len(clones) > 1:
+            i = rng.randrange(len(clones))
+            j = rng.randrange(len(clones))
+            if i == j:
+                continue
+            clones[i].merge(clones.pop(j))
+        outs.append(clones[0])
+    assert_all_equal(outs)

@@ -14,11 +14,11 @@ from strategies import ACTORS, seeds
 from test_map import _site_run, drop, mv_map, put
 
 KEYS = list("pq")
-CAPS = dict(witness_cap=12, sibling_cap=12, deferred_cap=12)
+CAPS = dict(sibling_cap=12, deferred_cap=12)
 
 
 def _interners():
-    return Interner(KEYS), Interner(ACTORS + ["A", "B"])
+    return Interner(KEYS), Interner(ACTORS + ["A", "B", "C"])
 
 
 def _batched(states):
@@ -141,8 +141,8 @@ def test_deferred_keyset_rm_parks_and_replays_on_device():
 
 
 def test_same_actor_partial_remove_no_resurrection_on_device():
-    # Witness (A,1) removed while (A,2) lives — the dot-set witness table
-    # must express it (the reason wact/wctr are dot pairs, not clocks).
+    # Content dot (A,1) removed while (A,2) lives — the content slab must
+    # express it (the reason wact/wctr are dot pairs, not clocks).
     site = mv_map()
     op1 = put(site, "A", "p", 10)
     rm_op = site.rm("p", site.get("p").derive_rm_ctx())
@@ -158,20 +158,24 @@ def test_same_actor_partial_remove_no_resurrection_on_device():
     assert oracle.get("p").val.read().val == [20]
 
 
-def test_witness_overflow_raises():
+def test_sibling_overflow_raises():
+    # Concurrent writes from distinct actors are true siblings: a third
+    # one cannot fit a 2-slot slab and must raise, not drop.
     from crdt_tpu.models import SlotOverflow
 
-    site = mv_map()
-    stream = [put(site, "A", "p", i) for i in range(4)]
+    sites = [mv_map() for _ in range(3)]
+    stream = [
+        s.update("p", s.len().derive_add_ctx(a), lambda r, c: r.write(i, c))
+        for i, (s, a) in enumerate(zip(sites, "ABC"))
+    ]
     keys, actors = _interners()
     device = BatchedMap.from_pure(
-        [mv_map()], keys=keys, actors=actors,
-        witness_cap=2, sibling_cap=2, deferred_cap=2,
+        [mv_map()], keys=keys, actors=actors, sibling_cap=2, deferred_cap=2,
     )
     device.apply(0, stream[0])
+    device.apply(0, stream[1])
     with pytest.raises(SlotOverflow):
-        for op in stream[1:]:
-            device.apply(0, op)
+        device.apply(0, stream[2])
 
 
 def test_deferred_survives_conversion_round_trip():

@@ -23,15 +23,24 @@ from crdt_tpu.pure.orswot import Orswot
 from crdt_tpu.vclock import VClock
 
 
-def _random_replicas(rng_data, n_replicas, members, actors):
+def _random_replicas(rng_data, n_replicas, members, actors=None):
     """Build n oracle replicas from a shared op history with random
-    delivery (every op applied to a random subset, always its origin)."""
+    delivery (every op applied to a random subset, always its origin).
+
+    Causal preconditions (the DotRange contract validate_op enforces):
+    adds mint dots under the ORIGIN's own actor (an actor is owned by one
+    replica — duplicate dots for different events void convergence), and
+    delivery to each replica is a PREFIX of every origin's op stream
+    (receiving dot 6 without 4–5 makes VClock.apply jump the gap, so the
+    clock claims dots the replica never saw — order-dependent merges)."""
     reps = [Orswot() for _ in range(n_replicas)]
     n_ops = rng_data.draw(st.integers(5, 25))
+    got = [[0] * n_replicas for _ in range(n_replicas)]  # got[r][origin]
+    seq = [0] * n_replicas  # ops minted per origin
     for _ in range(n_ops):
         origin = rng_data.draw(st.integers(0, n_replicas - 1))
         m = rng_data.draw(st.sampled_from(members))
-        actor = rng_data.draw(st.sampled_from(actors))
+        actor = f"s{origin}"
         if rng_data.draw(st.booleans()) or not reps[origin].read().val:
             op = reps[origin].add(m, reps[origin].read().derive_add_ctx(actor))
         else:
@@ -40,8 +49,12 @@ def _random_replicas(rng_data, n_replicas, members, actors):
                 victim, reps[origin].contains(victim).derive_rm_ctx()
             )
         for i in range(n_replicas):
-            if i == origin or rng_data.draw(st.booleans()):
+            if i == origin:
                 reps[i].apply(op)
+            elif got[i][origin] == seq[origin] and rng_data.draw(st.booleans()):
+                reps[i].apply(op)
+                got[i][origin] += 1
+        seq[origin] += 1
     return reps
 
 
@@ -61,9 +74,8 @@ def _oracle_fold(reps):
 @settings(max_examples=10, deadline=None)
 def test_mesh_fold_bit_identical(mesh_shape, data):
     members = ["a", "b", "c", "d"]
-    actors = ["p", "q", "r"]
     n_replicas = data.draw(st.integers(2, 12))
-    reps = _random_replicas(data, n_replicas, members, actors)
+    reps = _random_replicas(data, n_replicas, members)
 
     batched = BatchedOrswot.from_pure(reps)
     mesh = make_mesh(*mesh_shape)
@@ -87,9 +99,8 @@ def test_mesh_fold_bit_identical(mesh_shape, data):
 @settings(max_examples=8, deadline=None)
 def test_mesh_gossip_converges_to_fold(data):
     members = ["x", "y", "z"]
-    actors = ["p", "q"]
     n_replicas = data.draw(st.integers(2, 10))
-    reps = _random_replicas(data, n_replicas, members, actors)
+    reps = _random_replicas(data, n_replicas, members)
     batched = BatchedOrswot.from_pure(reps)
     mesh = make_mesh(4, 2)
     sharded = shard_orswot(batched.state, mesh)
@@ -142,3 +153,84 @@ def test_mesh_fold_single_replica_identity():
                         actors=batched.actors)
     out.state = jax.tree.map(lambda x: x[None], folded)
     assert out.to_pure(0) == p
+
+
+# ---- Map over the mesh (BASELINE config 4 distributed path) -------------
+
+def _random_map_replicas(rng_data, n_replicas, keys):
+    """Like ``_random_replicas`` for Map<K, MVReg>: updates mint dots
+    under the origin's own actor, delivery is per-origin prefix (the
+    causal preconditions — see ``_random_replicas``)."""
+    from crdt_tpu.pure.map import Map
+    from crdt_tpu.pure.mvreg import MVReg
+    import hypothesis.strategies as st
+
+    reps = [Map(val_default=MVReg) for _ in range(n_replicas)]
+    n_ops = rng_data.draw(st.integers(4, 16))
+    got = [[0] * n_replicas for _ in range(n_replicas)]
+    seq = [0] * n_replicas
+    for _ in range(n_ops):
+        origin = rng_data.draw(st.integers(0, n_replicas - 1))
+        m = reps[origin]
+        key = rng_data.draw(st.sampled_from(keys))
+        actor = f"s{origin}"
+        if rng_data.draw(st.booleans()) or m.get(key).val is None:
+            ctx = m.len().derive_add_ctx(actor)
+            val = rng_data.draw(st.integers(0, 4))
+            op = m.update(key, ctx, lambda r, c: r.write(val, c))
+        else:
+            op = m.rm(key, m.get(key).derive_rm_ctx())
+        for i in range(n_replicas):
+            if i == origin:
+                reps[i].apply(op)
+            elif got[i][origin] == seq[origin] and rng_data.draw(st.booleans()):
+                reps[i].apply(op)
+                got[i][origin] += 1
+        seq[origin] += 1
+    return reps
+
+
+@pytest.mark.parametrize("mesh_shape", [(4, 2), (2, 4), (3, 1)])
+@given(data=st.data())
+@settings(max_examples=6, deadline=None)
+def test_mesh_fold_map_bit_identical(mesh_shape, data):
+    from crdt_tpu.models import BatchedMap
+    from crdt_tpu.parallel import mesh_fold_map, shard_map_state
+
+    keys = ["k1", "k2", "k3"]
+    # A fixed multiple of the mesh replica axis: padding then never
+    # changes the traced shape, so each mesh shape compiles exactly once.
+    n_replicas = 2 * mesh_shape[0]
+    reps = _random_map_replicas(data, n_replicas, keys)
+
+    from crdt_tpu.utils import Interner
+
+    # Pre-filled interners pin the key/actor universe sizes so traced
+    # shapes don't depend on which actors happened to appear.
+    batched = BatchedMap.from_pure(
+        reps,
+        keys=Interner(keys),
+        actors=Interner([f"s{i}" for i in range(n_replicas)]),
+        sibling_cap=16, deferred_cap=16,
+    )
+    mesh = make_mesh(*mesh_shape)
+    sharded = shard_map_state(batched.state, mesh)
+    folded, overflow = mesh_fold_map(sharded, mesh)
+    assert not bool(overflow.any())
+
+    out = BatchedMap(
+        1,
+        folded.dkeys.shape[-1],
+        folded.top.shape[-1],
+        folded.child.wact.shape[-1],
+        folded.dcl.shape[-2],
+        keys=batched.keys,
+        actors=batched.actors,
+        values=batched.values,
+    )
+    out.state = jax.tree.map(lambda x: x[None], folded)
+
+    expect = reps[0].clone()
+    for r in reps[1:]:
+        expect.merge(r)
+    assert out.to_pure(0) == expect
