@@ -93,7 +93,93 @@ def bench_cpu() -> float:
     return mps
 
 
+def make_edit_trace(n_ops: int, n_actors: int = 4, seed: int = 3):
+    """An automerge-perf-shaped editing trace: mostly typing at a moving
+    cursor, occasional jumps and deletes (BASELINE config 5)."""
+    from crdt_tpu.native import DELETE, INSERT
+
+    rng = np.random.default_rng(seed)
+    kinds, idxs, vals, actors = [], [], [], []
+    length, cursor = 0, 0
+    for _ in range(n_ops):
+        roll = rng.random()
+        if length == 0 or roll < 0.72:       # type at cursor
+            kinds.append(INSERT)
+            idxs.append(cursor)
+            cursor = min(cursor + 1, length + 1)
+            length += 1
+        elif roll < 0.87:                     # jump cursor
+            cursor = int(rng.integers(0, length + 1))
+            kinds.append(INSERT)
+            idxs.append(cursor)
+            cursor += 1
+            length += 1
+        else:                                 # backspace
+            kinds.append(DELETE)
+            victim = max(0, min(cursor - 1, length - 1))
+            idxs.append(victim)
+            cursor = victim
+            length -= 1
+        vals.append(int(rng.integers(0, 128)))
+        actors.append(int(rng.integers(0, n_actors)))
+    return kinds, idxs, vals, actors
+
+
+def bench_list():
+    """Config 5 (diagnostic, stderr): edit-trace ops/sec — pure-Python
+    oracle vs native C++ engine vs device batched replicas."""
+    from crdt_tpu.native import INSERT, ListEngine, native_available
+    from crdt_tpu.pure.list import List
+
+    n_ops = int(os.environ.get("BENCH_LIST_OPS", 20000))
+    r = int(os.environ.get("BENCH_LIST_REPLICAS", 64))
+    trace = make_edit_trace(n_ops)
+
+    t0 = time.perf_counter()
+    oracle = List()
+    for k, ix, v, a in zip(*trace):
+        op = (
+            oracle.insert_index(ix, v, a)
+            if k == INSERT
+            else oracle.delete_index(ix, a)
+        )
+        oracle.apply(op)
+    dt_py = time.perf_counter() - t0
+    log(f"list config5: pure oracle {n_ops} ops: {dt_py*1e3:.0f} ms -> {n_ops/dt_py:,.0f} ops/s")
+
+    t0 = time.perf_counter()
+    engine = ListEngine()
+    engine.apply_trace(*trace)
+    dt_native = time.perf_counter() - t0
+    log(
+        f"list config5: native engine ({'C++' if engine.is_native else 'fallback'}) "
+        f"{n_ops} ops: {dt_native*1e3:.0f} ms -> {n_ops/dt_native:,.0f} ops/s "
+        f"({dt_py/dt_native:.1f}x oracle)"
+    )
+
+    import jax
+
+    from crdt_tpu.models import BatchedList
+
+    model = BatchedList.from_trace(*trace, n_replicas=r)
+    t0 = time.perf_counter()
+    model.apply_trace_to_all(chunk=2048)
+    jax.block_until_ready(model.alive)
+    dt_dev = time.perf_counter() - t0
+    total = n_ops * r
+    log(
+        f"list config5: device batched {r} replicas x {n_ops} ops: "
+        f"{dt_dev*1e3:.0f} ms -> {total/dt_dev:,.0f} replica-ops/s "
+        f"({(total/dt_dev)/(n_ops/dt_py):.1f}x oracle rate)"
+    )
+
+
 def main():
+    if os.environ.get("BENCH_LIST", "1") != "0":
+        try:
+            bench_list()
+        except Exception as exc:  # diagnostic only — never kill the metric of record
+            log(f"list bench failed: {exc!r}")
     cpu_mps = bench_cpu()
     tpu_mps = bench_tpu()
     print(

@@ -16,6 +16,7 @@ from .orswot import BatchedOrswot
 from .gset import BatchedGSet
 from .registers import BatchedLWWReg, BatchedMVReg, SlotOverflow
 from .map import BatchedMap
+from .list import BatchedList
 
 __all__ = [
     "BatchedVClock",
@@ -26,5 +27,6 @@ __all__ = [
     "BatchedLWWReg",
     "BatchedMVReg",
     "BatchedMap",
+    "BatchedList",
     "SlotOverflow",
 ]

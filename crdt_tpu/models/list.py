@@ -1,0 +1,161 @@
+"""BatchedList — N device-resident List replicas over a shared
+identifier universe.
+
+Oracle: ``crdt_tpu.pure.list.List`` (reference: src/list.rs). The split
+per SURVEY.md §7.1: identifier allocation is inherently sequential per
+edit trace and runs in the native host engine
+(``crdt_tpu.native.ListEngine``, C++); the per-replica op application is
+batched on device as masked scatters over an order-maintenance array.
+
+Layout: the engine's total identifier order (which is immutable — dense
+identifiers never move) assigns every identifier a static *slot*; the
+device holds ``vals int32[R, N]`` + ``alive bool[R, N]`` in slot order.
+Applying an insert is ``alive[slot] = True, vals[slot] = v``; a delete is
+``alive[slot] = False``; a read is a host-side compress of ``vals`` by
+``alive`` (already in sequence order). Epochs of ops across all replicas
+land as one scatter each — the batched form of BASELINE config 5's
+"100k ops × 1k replicas".
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dot import OrdDot
+from ..native import DELETE, INSERT, ListEngine
+from ..pure.identifier import Identifier
+from ..pure.list import List
+
+
+class BatchedList:
+    def __init__(self, n_replicas: int, engine: ListEngine, slots: np.ndarray):
+        self.engine = engine
+        self.slots = slots  # rank per identifier handle (total order)
+        n = len(slots)
+        self.vals = jnp.zeros((n_replicas, max(n, 1)), jnp.int32)
+        self.alive = jnp.zeros((n_replicas, max(n, 1)), bool)
+
+    @classmethod
+    def from_trace(
+        cls,
+        kinds: Sequence[int],
+        indices: Sequence[int],
+        values: Sequence[int],
+        actors: Sequence[int],
+        n_replicas: int,
+    ) -> "BatchedList":
+        """Build the shared identifier universe by running the edit trace
+        through the native engine, then stand up ``n_replicas`` empty
+        device replicas over it. Returns the model; per-op slots are in
+        ``.op_slots`` and per-op kinds/values in ``.op_kinds``/``.op_vals``
+        (what ``apply_ops`` scatters)."""
+        engine = ListEngine()
+        handles = engine.apply_trace(kinds, indices, values, actors)
+        rank = engine.total_order()
+        out = cls(n_replicas, engine, rank)
+        out.op_slots = rank[handles]
+        out.op_kinds = np.ascontiguousarray(kinds, np.uint8)
+        out.op_vals = np.ascontiguousarray(values, np.int32)
+        return out
+
+    @property
+    def n_replicas(self) -> int:
+        return self.vals.shape[0]
+
+    # ---- batched op application (the device hot path) -----------------
+    def apply_ops(self, replica_ops: np.ndarray) -> None:
+        """One epoch: ``replica_ops[r]`` lists trace-op indices for
+        replica ``r`` (shape [R, C]; -1 pads). Within one epoch a
+        replica must not touch the same slot twice (scatter order on
+        duplicates is unspecified) — chunk the trace accordingly.
+        The whole epoch is two scatters for ALL replicas."""
+        replica_ops = np.asarray(replica_ops)
+        if replica_ops.ndim != 2 or replica_ops.shape[0] != self.n_replicas:
+            raise ValueError(f"expected [R={self.n_replicas}, C] op indices")
+        valid = replica_ops >= 0
+        safe = np.where(valid, replica_ops, 0)
+        # Pad lanes scatter to the out-of-range slot N and are dropped —
+        # routing them to slot 0 would duplicate-write a real slot with
+        # an unspecified winner.
+        n = self.vals.shape[1]
+        slots = jnp.asarray(np.where(valid, self.op_slots[safe], n))
+        kinds = jnp.asarray(self.op_kinds[safe])
+        vals = jnp.asarray(self.op_vals[safe])
+        self.vals, self.alive = _apply_epoch(
+            self.vals, self.alive, slots, kinds, vals, jnp.asarray(valid)
+        )
+
+    def apply_trace_to_all(self, chunk: int = 4096) -> None:
+        """Apply the construction trace to every replica in fixed-size
+        epochs. Within an epoch, ops on the same slot compose to the
+        LAST one (a slot's lifecycle is insert → delete, so the final
+        write wins exactly) — the host dedupes, and each epoch lands as
+        one conflict-free scatter for all replicas."""
+        n_ops = len(self.op_slots)
+        for start in range(0, n_ops, chunk):
+            ep = np.arange(start, min(start + chunk, n_ops))
+            # keep the last op per slot: first occurrence in the reversed
+            # window is the last in trace order
+            rev = ep[::-1]
+            _, first = np.unique(self.op_slots[rev], return_index=True)
+            keep = rev[first]
+            ops = np.broadcast_to(keep, (self.n_replicas, len(keep)))
+            self.apply_ops(ops)
+
+    # ---- reads ---------------------------------------------------------
+    def read(self, replica: int) -> list:
+        """The replica's sequence of value ids (slot order == identifier
+        order)."""
+        alive = np.asarray(self.alive[replica])
+        vals = np.asarray(self.vals[replica])
+        return vals[alive].tolist()
+
+    def to_pure(self, replica: int, actors_table=None) -> List:
+        """Reconstruct the oracle form (identifiers from the engine,
+        values from device state). ``actors_table`` maps dense actor ids
+        back to caller actors (identity if omitted)."""
+        alive = np.asarray(self.alive[replica])
+        vals = np.asarray(self.vals[replica])
+        out = List()
+        handle_of_slot = np.argsort(self.slots, kind="stable")
+        for slot in range(len(self.slots)):
+            if not alive[slot]:
+                continue
+            handle = int(handle_of_slot[slot])
+            path = self.engine.identifier_path(handle)
+            ident = Identifier(
+                tuple(
+                    (
+                        ix,
+                        OrdDot(
+                            actors_table[a] if actors_table is not None else a,
+                            c,
+                        ),
+                    )
+                    for ix, a, c in path
+                )
+            )
+            out.seq.append(ident)
+            out.vals[ident] = int(vals[slot])
+        return out
+
+
+@jax.jit
+def _apply_epoch(vals, alive, slots, kinds, epoch_vals, valid):
+    """Scatter one epoch of ops into all replicas: inserts set value +
+    alive, deletes clear alive. [R, C] everywhere."""
+    r = jnp.arange(vals.shape[0])[:, None]
+    insert = valid & (kinds == INSERT)
+    delete = valid & (kinds == DELETE)
+    vals = vals.at[r, slots].set(
+        jnp.where(insert, epoch_vals, vals[r, slots]), mode="drop"
+    )
+    new_alive = jnp.where(
+        insert, True, jnp.where(delete, False, alive[r, slots])
+    )
+    alive = alive.at[r, slots].set(new_alive, mode="drop")
+    return vals, alive

@@ -27,8 +27,13 @@ def tree_fold(
     are OR-accumulated across every pairwise join, reducing only the
     batch axis so multi-lane flags (e.g. the map join's [sibling,
     deferred] pair) keep their shape."""
-    flagged = jnp.zeros((), bool)
     r = jax.tree.leaves(states)[0].shape[0]
+    if r == 1:
+        # Join with the identity so the flag comes out in the join's
+        # shape (e.g. the map join's [sibling, deferred] pair) — a bare
+        # scalar initializer would break multi-lane flag consumers.
+        return join(jax.tree.map(lambda x: x[0], states), identity)
+    flagged = jnp.zeros((), bool)
     pow2 = 1
     while pow2 < r:
         pow2 *= 2

@@ -188,3 +188,13 @@ def test_deferred_survives_conversion_round_trip():
     keys, actors = _interners()
     device = BatchedMap.from_pure([b], keys=keys, actors=actors, **CAPS)
     assert device.to_pure(0) == b
+
+
+def test_single_replica_fold():
+    # Review regression: a 1-replica fold must still return the map
+    # join's two-lane overflow flags (tree_fold's r==1 path).
+    a = mv_map()
+    put(a, "A", "p", 1)
+    keys, actors = _interners()
+    device = BatchedMap.from_pure([a], keys=keys, actors=actors, **CAPS)
+    assert device.fold() == a
