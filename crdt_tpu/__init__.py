@@ -39,11 +39,17 @@ from .pure.list import List
 from .pure.glist import GList
 from .pure.merkle_reg import MerkleReg
 
+# Wire/storage encoding + device checkpointing (imported lazily as
+# modules too: ``crdt_tpu.serde`` / ``crdt_tpu.checkpoint``).
+from . import serde
+from .utils.metrics import metrics
+
 __all__ = [
     "CvRDT", "CmRDT", "ResetRemove", "Causal", "ValidationError", "DotRange",
     "Dot", "OrdDot", "VClock", "ReadCtx", "AddCtx", "RmCtx",
     "GCounter", "PNCounter", "Dir", "GSet", "LWWReg", "MVReg", "Orswot",
     "Map", "Identifier", "List", "GList", "MerkleReg",
+    "serde", "metrics",
 ]
 
 __version__ = "0.1.0"

@@ -1,0 +1,129 @@
+"""Checkpoint / resume for device-resident replica state.
+
+Reference story (SURVEY.md §6.4): serde bytes on disk ARE the checkpoint;
+a resumed replica merges back in. Device form: the struct-of-arrays
+state goes into one ``.npz`` (host-synced numpy), the host-side tables
+(interners, capacities) ride along as a canonical-JSON sidecar inside
+the same file. ``load`` reconstructs the model; the resume path is then
+ordinary anti-entropy — ``merge``/``fold`` with the live replicas (the
+resume-then-merge test in tests/test_checkpoint.py).
+
+Interned actors/members/keys/values are serialized with
+``crdt_tpu.serde`` so arbitrary payload types survive the round trip.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+from typing import Union
+
+import jax
+import numpy as np
+
+from . import serde
+from .models.map import BatchedMap
+from .models.orswot import BatchedOrswot
+from .ops import map as map_ops
+from .ops import mvreg as mv_ops
+from .ops import orswot as orswot_ops
+from .utils import Interner
+
+
+def _interner_items(interner: Interner):
+    return [serde.encode(item) for item in interner.items()]
+
+
+def _interner_from(items) -> Interner:
+    return Interner(serde.decode(item) for item in items)
+
+
+def save(path: Union[str, os.PathLike], model) -> None:
+    """Checkpoint a device model to ``path`` (one .npz file)."""
+    if isinstance(model, BatchedOrswot):
+        meta = {
+            "kind": "orswot",
+            "members": _interner_items(model.members),
+            "actors": _interner_items(model.actors),
+        }
+        arrays = {f"s_{k}": np.asarray(v) for k, v in model.state._asdict().items()}
+    elif isinstance(model, BatchedMap):
+        meta = {
+            "kind": "map",
+            "keys": _interner_items(model.keys),
+            "actors": _interner_items(model.actors),
+            "values": _interner_items(model.values),
+        }
+        arrays = {
+            f"s_{k}": np.asarray(v)
+            for k, v in model.state._asdict().items()
+            if k != "child"
+        }
+        arrays.update(
+            {f"c_{k}": np.asarray(v) for k, v in model.state.child._asdict().items()}
+        )
+    else:
+        raise TypeError(f"cannot checkpoint {type(model).__name__}")
+
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        meta=np.frombuffer(
+            json.dumps(meta, sort_keys=True).encode("utf-8"), dtype=np.uint8
+        ),
+        **arrays,
+    )
+    # Write-then-rename: a crash mid-checkpoint never corrupts the last
+    # good checkpoint (the reference's bytes-on-disk story, made atomic).
+    tmp = f"{os.fspath(path)}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    os.replace(tmp, path)
+
+
+def load(path: Union[str, os.PathLike]):
+    """Restore a device model checkpointed by ``save``."""
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+        arrays = {k: z[k] for k in z.files if k != "meta"}
+
+    dev = lambda a: jax.device_put(a)
+    if meta["kind"] == "orswot":
+        state = orswot_ops.OrswotState(
+            **{k[2:]: dev(v) for k, v in arrays.items() if k.startswith("s_")}
+        )
+        model = BatchedOrswot(
+            state.top.shape[0],
+            state.ctr.shape[-2],
+            state.ctr.shape[-1],
+            state.dcl.shape[-2],
+            members=_interner_from(meta["members"]),
+            actors=_interner_from(meta["actors"]),
+        )
+        model.state = state
+        return model
+    if meta["kind"] == "map":
+        child = mv_ops.MVRegState(
+            **{k[2:]: dev(v) for k, v in arrays.items() if k.startswith("c_")}
+        )
+        state = map_ops.MapState(
+            child=child,
+            **{k[2:]: dev(v) for k, v in arrays.items() if k.startswith("s_")},
+        )
+        model = BatchedMap(
+            state.top.shape[0],
+            state.dkeys.shape[-1],
+            state.top.shape[-1],
+            state.child.wact.shape[-1],
+            state.dcl.shape[-2],
+            keys=_interner_from(meta["keys"]),
+            actors=_interner_from(meta["actors"]),
+            values=_interner_from(meta["values"]),
+        )
+        model.state = state
+        return model
+    raise ValueError(f"unknown checkpoint kind {meta['kind']!r}")
+
+
+__all__ = ["save", "load"]

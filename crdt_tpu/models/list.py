@@ -103,7 +103,12 @@ class BatchedList:
             rev = ep[::-1]
             _, first = np.unique(self.op_slots[rev], return_index=True)
             keep = rev[first]
-            ops = np.broadcast_to(keep, (self.n_replicas, len(keep)))
+            # Pad to the fixed chunk width (-1 lanes are dropped) so every
+            # epoch shares one traced shape — a data-dependent width would
+            # recompile _apply_epoch per epoch.
+            padded = np.full(chunk, -1, np.int64)
+            padded[: len(keep)] = keep
+            ops = np.broadcast_to(padded, (self.n_replicas, chunk))
             self.apply_ops(ops)
 
     # ---- reads ---------------------------------------------------------

@@ -23,6 +23,7 @@ from ..ops import mvreg as mv_ops
 from ..pure.map import Map, MapRm, Nop, Up
 from ..pure.mvreg import MVReg, Put
 from ..utils import Interner
+from ..utils.metrics import metrics
 from ..vclock import VClock
 from .orswot import DeferredOverflow
 from .registers import SlotOverflow
@@ -261,6 +262,7 @@ class BatchedMap:
             )
 
     def merge_from(self, dst: int, src: int) -> None:
+        metrics.count("map.merges")
         joined, flags = ops.join(
             self._row(self.state, dst), self._row(self.state, src)
         )
@@ -272,6 +274,11 @@ class BatchedMap:
     def fold(self) -> Map:
         """Full-mesh anti-entropy: join all R replicas in a log2 reduction
         tree and return the converged oracle-form state."""
+        metrics.count("map.merges", max(self.n_replicas - 1, 0))
+        metrics.observe(
+            "map.deferred_depth",
+            float(jnp.sum(self.state.dvalid)) / max(self.n_replicas, 1),
+        )
         folded, flags = ops.fold(self.state)
         self._check_join_flags(flags, "fold")
         tmp = BatchedMap(

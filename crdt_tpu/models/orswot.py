@@ -20,6 +20,7 @@ from ..dot import Dot
 from ..ops import orswot as ops
 from ..pure.orswot import Add, Orswot, Rm
 from ..utils import Interner
+from ..utils.metrics import metrics
 from ..vclock import VClock
 
 
@@ -174,6 +175,7 @@ class BatchedOrswot:
 
     # ---- state path (CvRDT — the benchmark path) ----------------------
     def merge_from(self, dst: int, src: int) -> None:
+        metrics.count("orswot.merges")
         joined, overflow = ops.join(
             self._row(self.state, dst), self._row(self.state, src)
         )
@@ -189,6 +191,11 @@ class BatchedOrswot:
     def fold(self) -> Orswot:
         """Full-mesh anti-entropy: join all R replicas in a log2 reduction
         tree and return the converged oracle-form state."""
+        metrics.count("orswot.merges", max(self.n_replicas - 1, 0))
+        metrics.observe(
+            "orswot.deferred_depth",
+            float(jnp.sum(self.state.dvalid)) / max(self.n_replicas, 1),
+        )
         folded, overflow = ops.fold(self.state)
         if bool(overflow):
             raise DeferredOverflow(

@@ -30,9 +30,16 @@ _SRC = os.path.join(_DIR, "listseq.cpp")
 _LIB = os.path.join(_DIR, "_listseq.so")
 
 
+_BUILD_FAILED = False
+
+
 def _build() -> Optional[str]:
     """Compile listseq.cpp → _listseq.so if stale/missing. Returns the
-    library path, or None if no toolchain is available."""
+    library path, or None if no toolchain is available. A failure is
+    cached so repeated engine constructions don't re-spawn g++."""
+    global _BUILD_FAILED
+    if _BUILD_FAILED:
+        return None
     try:
         if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
             return _LIB
@@ -45,11 +52,13 @@ def _build() -> Optional[str]:
         if proc.returncode != 0:
             os.unlink(tmp)
             print(f"crdt_tpu.native: g++ failed:\n{proc.stderr}", file=sys.stderr)
+            _BUILD_FAILED = True
             return None
         os.replace(tmp, _LIB)
         return _LIB
     except (OSError, FileNotFoundError) as exc:
         print(f"crdt_tpu.native: build unavailable ({exc})", file=sys.stderr)
+        _BUILD_FAILED = True
         return None
 
 
@@ -202,15 +211,20 @@ class ListEngine:
         cidx = np.asarray([c[0] for c in flat], np.int64)
         cactor = np.asarray([c[1] for c in flat], np.int32)
         cctr = np.asarray([c[2] for c in flat], np.uint64)
+        if (counts <= 0).any():
+            bad = int(np.argmax(counts <= 0))
+            raise ValueError(f"remote op {bad}: empty identifier path")
         kinds = np.ascontiguousarray(kinds, np.uint8)
         values = np.ascontiguousarray(values, np.int32)
         out = np.empty(n, np.int64)
         if self._impl is not None:
             self._impl.apply_remote(kinds, counts, cidx, cactor, cctr, values, out)
             return out
-        _lib.ls_apply_remote(
+        rc = _lib.ls_apply_remote(
             self._e, kinds, counts, cidx, cactor, cctr, values, n, out
         )
+        if rc < 0:
+            raise ValueError(f"remote op {-rc - 1}: malformed identifier path")
         return out
 
     # ---- reads ---------------------------------------------------------

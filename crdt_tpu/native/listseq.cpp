@@ -157,6 +157,7 @@ int64_t ls_apply_remote(void* ep, const uint8_t* kinds,
   Engine& e = *static_cast<Engine*>(ep);
   int64_t off = 0;
   for (int64_t i = 0; i < n; ++i) {
+    if (comp_counts[i] <= 0) return -(i + 1);  // malformed wire input
     Path ident;
     ident.reserve(comp_counts[i]);
     for (int64_t c = 0; c < comp_counts[i]; ++c)

@@ -48,6 +48,7 @@ from .mesh import (
     pad_replicas,
     pad_replicas_map,
 )
+from ..utils.metrics import metrics, state_nbytes
 
 
 _FN_CACHE: dict = {}
@@ -96,7 +97,12 @@ def mesh_fold(state: OrswotState, mesh: Mesh) -> Tuple[OrswotState, jax.Array]:
 
         return fold_fn
 
-    return _cached("orswot_fold", state, mesh, build)(state)
+    metrics.count("anti_entropy.fold_rounds")
+    metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
+    with metrics.time("anti_entropy.fold"):
+        out = _cached("orswot_fold", state, mesh, build)(state)
+        jax.block_until_ready(out)  # time device work, not async dispatch
+    return out
 
 
 def mesh_gossip(
@@ -136,7 +142,12 @@ def mesh_gossip(
 
         return gossip_fn
 
-    return _cached("orswot_gossip", state, mesh, build, rounds)(state)
+    metrics.count("anti_entropy.gossip_rounds", rounds)
+    metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
+    with metrics.time("anti_entropy.gossip"):
+        out = _cached("orswot_gossip", state, mesh, build, rounds)(state)
+        jax.block_until_ready(out)  # time device work, not async dispatch
+    return out
 
 
 def mesh_fold_map(state: MapState, mesh: Mesh) -> Tuple[MapState, jax.Array]:
@@ -172,7 +183,12 @@ def mesh_fold_map(state: MapState, mesh: Mesh) -> Tuple[MapState, jax.Array]:
 
         return fold_fn
 
-    return _cached("map_fold", state, mesh, build)(state)
+    metrics.count("anti_entropy.map_fold_rounds")
+    metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
+    with metrics.time("anti_entropy.map_fold"):
+        out = _cached("map_fold", state, mesh, build)(state)
+        jax.block_until_ready(out)  # time device work, not async dispatch
+    return out
 
 
 def mesh_fold_clocks(clocks: jax.Array, mesh: Mesh) -> jax.Array:

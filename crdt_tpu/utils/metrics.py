@@ -1,0 +1,106 @@
+"""Metrics / tracing / observability (SURVEY.md §6.1, §6.5).
+
+The reference has none of this; the BASELINE metrics (merges/sec,
+deferred-buffer depth, bytes exchanged per anti-entropy round) need a
+home, so the framework keeps one process-global registry:
+
+- ``metrics.count(name, n)``          — monotonic counters,
+- ``metrics.observe(name, value)``    — last/min/max/sum/n gauges,
+- ``metrics.time(name)``              — wall-clock context manager,
+- ``metrics.snapshot()`` / ``reset()``.
+
+``profile_trace(logdir)`` wraps ``jax.profiler.trace`` for device-level
+timelines (viewable in TensorBoard/XProf; SURVEY.md §6.1) and degrades
+to a no-op where the profiler is unavailable.
+
+Device code never touches this module (host-side only, zero jit
+impact); the models and the mesh anti-entropy entry points feed it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Dict
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, Dict[str, float]] = {}
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            g = self._gauges.setdefault(
+                name, {"last": 0.0, "min": float("inf"), "max": float("-inf"),
+                       "sum": 0.0, "n": 0},
+            )
+            g["last"] = value
+            g["min"] = min(g["min"], value)
+            g["max"] = max(g["max"], value)
+            g["sum"] += value
+            g["n"] += 1
+
+    @contextlib.contextmanager
+    def time(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(f"{name}_seconds", time.perf_counter() - t0)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": {k: dict(v) for k, v in self._gauges.items()},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+
+
+metrics = Metrics()
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str):
+    """Device-level profiling around a block (perfetto/XProf trace in
+    ``logdir``); no-op if the profiler cannot start (e.g. no device)."""
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception:
+        pass
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+def state_nbytes(state) -> int:
+    """Total device bytes of a pytree state — the per-round 'bytes
+    exchanged' metric for anti-entropy collectives."""
+    import jax
+
+    return sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(state)
+    )
+
+
+__all__ = ["Metrics", "metrics", "profile_trace", "state_nbytes"]

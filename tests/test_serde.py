@@ -1,0 +1,168 @@
+"""serde round-trip of every state and op (SURVEY.md §3.2 "Serde
+round-trip of every state and op"), plus canonical-bytes and the
+checkpoint resume-then-merge story (§6.4)."""
+
+import random
+
+from hypothesis import given, settings
+
+from crdt_tpu import (
+    GCounter,
+    GList,
+    GSet,
+    LWWReg,
+    Map,
+    MerkleReg,
+    MVReg,
+    Orswot,
+    PNCounter,
+    VClock,
+)
+from crdt_tpu.dot import Dot, OrdDot
+from crdt_tpu.pure.list import List
+from crdt_tpu.serde import from_bytes, to_bytes
+
+from strategies import ACTORS, seeds
+
+
+def rt(obj):
+    """Round-trip; decoded must compare equal (and again, stably)."""
+    raw = to_bytes(obj)
+    back = from_bytes(raw)
+    assert back == obj, (back, obj)
+    assert to_bytes(back) == raw, "re-encode not canonical"
+    return back
+
+
+def test_payload_values_round_trip_exactly():
+    for v in [
+        None, True, False, 0, -7, 2**80, 1.5, "x", b"\x00\xff",
+        (1, "a"), [1, 2], {"k": (1, 2)}, frozenset({1, 2}),
+    ]:
+        raw = to_bytes(v)
+        back = from_bytes(raw)
+        assert back == v and type(back) in (type(v), frozenset)
+
+
+def test_clock_and_dot_round_trip():
+    rt(Dot("a", 3))
+    rt(OrdDot(("composite", 1), 9))
+    rt(VClock({"a": 1, ("t", 2): 5}))
+
+
+@given(seeds)
+@settings(max_examples=10)
+def test_counters_round_trip(seed):
+    rng = random.Random(seed)
+    g = GCounter()
+    pn = PNCounter()
+    for _ in range(8):
+        g.apply(g.inc(rng.choice(ACTORS)))
+        pn.apply(pn.inc(rng.choice(ACTORS)) if rng.random() < 0.5
+                 else pn.dec(rng.choice(ACTORS)))
+    assert rt(g).read() == g.read()
+    assert rt(pn).read() == pn.read()
+    rt(pn.inc("a"))
+
+
+def test_registers_round_trip():
+    rt(LWWReg())  # unset
+    rt(LWWReg("v", 9))
+    m = MVReg()
+    op = m.write("hello", m.read().derive_add_ctx("a"))
+    m.apply(op)
+    rt(op)
+    rt(m)
+
+
+@given(seeds)
+@settings(max_examples=10)
+def test_orswot_round_trip_including_deferred(seed):
+    rng = random.Random(seed)
+    from test_orswot import _site_run
+
+    sites, minted = _site_run(rng)
+    for s in sites.values():
+        rt(s)
+    for op in minted:
+        rt(op)
+    # a parked deferred remove survives
+    a = Orswot()
+    a.apply(a.add("m", a.read().derive_add_ctx("x")))
+    b = Orswot()
+    b.apply(a.rm("m", a.contains("m").derive_rm_ctx()))
+    assert b.deferred
+    rt(b)
+
+
+def test_map_round_trip_with_factory_prototype():
+    m = Map(val_default=MVReg)
+    op = m.update("k", m.len().derive_add_ctx("a"), lambda r, c: r.write(1, c))
+    m.apply(op)
+    rt(op)
+    back = rt(m)
+    # the decoded factory must mint working children
+    op2 = back.update("k2", back.len().derive_add_ctx("b"), lambda r, c: r.write(2, c))
+    back.apply(op2)
+    assert back.get("k2").val.read().val == [2]
+
+    nested = Map(val_default=lambda: Map(val_default=MVReg))
+    ctx = nested.len().derive_add_ctx("a")
+    nested.apply(
+        nested.update(
+            "o", ctx, lambda inner, c: inner.update("i", c, lambda r, c2: r.write(7, c2))
+        )
+    )
+    back = rt(nested)
+    assert back.get("o").val.get("i").val.read().val == [7]
+
+
+def test_sequences_round_trip():
+    L = List()
+    ops = []
+    for i, ch in enumerate("abc"):
+        op = L.insert_index(i, ch, "a")
+        L.apply(op)
+        ops.append(op)
+    d = L.delete_index(1, "a")
+    L.apply(d)
+    rt(L)
+    for op in ops:
+        rt(op)
+    rt(d)
+
+    g = GList()
+    op = g.insert_after(None, "x")
+    g.apply(op)
+    g.apply(g.insert_before(None, "y"))
+    rt(g)
+    rt(op)
+    rt(GSet(["a", 1, ("t",)]))
+
+
+def test_merkle_round_trip_with_orphans():
+    r = MerkleReg()
+    n1 = r.write("root")
+    r.apply(n1)
+    n2 = r.write("child", frozenset({n1.hash()}))
+    r.apply(n2)
+    rt(n2)
+    rt(r)
+    # orphan buffered (parent missing) survives the round trip
+    o = MerkleReg()
+    o.apply(n2)
+    assert o.num_orphans() == 1
+    back = rt(o)
+    back.apply(n1)
+    assert back.read().values() == ["child"]
+
+
+def test_wire_bytes_are_state_transport():
+    # The reference's full transport loop: serialize a replica, ship the
+    # bytes, merge on arrival.
+    a, b = Orswot(), Orswot()
+    a.apply(a.add("m1", a.read().derive_add_ctx("a")))
+    b.apply(b.add("m2", b.read().derive_add_ctx("b")))
+    wire = to_bytes(a)
+    b.merge(from_bytes(wire))
+    assert b.members() == frozenset({"m1", "m2"})
