@@ -1,0 +1,145 @@
+"""Config / flag system (SURVEY.md §6.6).
+
+The reference's only configuration is Cargo feature flags (an
+``arbitrary``/``quickcheck`` test feature; the north star imagines a
+``backend = "xla"`` feature). Here that becomes a plain dataclass with a
+process-global instance: ``backend`` selects the execution path the
+``replicaset`` factory hands out (the feature-flag analog, and what the
+bit-identical A/B gate toggles), ``strict`` turns on v7-style
+``validate_op`` checks before every apply, and the capacity knobs feed
+the device models' static slab shapes.
+
+Usage::
+
+    from crdt_tpu.config import config, configure, replicaset
+
+    configure(backend="xla", strict=True)
+    replicas = replicaset("orswot", n_replicas=8, n_members=64, n_actors=8)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Iterator, Optional
+
+
+@dataclasses.dataclass
+class Config:
+    # "pure" — sequential oracle semantics (reference behavior);
+    # "xla"  — batched device-resident models (jit/vmap/pjit kernels).
+    backend: str = "xla"
+    # Raise ValidationError from validate_op before every apply (v7
+    # validation; pure backend only — the device path batches applies).
+    strict: bool = False
+    # Static capacities for the device models' slab shapes.
+    deferred_cap: int = 8
+    sibling_cap: int = 8
+    # Debug mode: jax NaN/inf checks around kernels (SURVEY §6.2).
+    debug_numerics: bool = False
+
+    def validate(self) -> None:
+        if self.backend not in ("pure", "xla"):
+            raise ValueError(f"backend must be 'pure' or 'xla', got {self.backend!r}")
+        if self.deferred_cap < 1 or self.sibling_cap < 1:
+            raise ValueError("capacities must be >= 1")
+
+
+config = Config()
+
+
+def configure(**kwargs) -> Config:
+    """Update the global config in place (unknown keys rejected)."""
+    for key, value in kwargs.items():
+        if not hasattr(config, key):
+            raise TypeError(f"unknown config field {key!r}")
+        setattr(config, key, value)
+    config.validate()
+    if config.debug_numerics:
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
+    return config
+
+
+@contextlib.contextmanager
+def configured(**kwargs) -> Iterator[Config]:
+    """Scoped config override (restores previous values on exit)."""
+    saved = dataclasses.replace(config)
+    try:
+        yield configure(**kwargs)
+    finally:
+        configure(**dataclasses.asdict(saved))
+
+
+def replicaset(
+    kind: str,
+    n_replicas: int,
+    *,
+    n_members: Optional[int] = None,
+    n_actors: Optional[int] = None,
+    n_keys: Optional[int] = None,
+):
+    """The backend-selecting factory: N replicas of ``kind`` under the
+    configured backend — a list of oracle objects for ``pure``, one
+    batched device model for ``xla``. Kinds: orswot, map, gcounter,
+    pncounter, gset, lwwreg, mvreg."""
+    config.validate()
+    if config.backend == "pure":
+        from .pure.gcounter import GCounter
+        from .pure.gset import GSet
+        from .pure.lwwreg import LWWReg
+        from .pure.map import Map
+        from .pure.mvreg import MVReg
+        from .pure.orswot import Orswot
+        from .pure.pncounter import PNCounter
+
+        factories = {
+            "orswot": Orswot,
+            "map": lambda: Map(val_default=MVReg),
+            "gcounter": GCounter,
+            "pncounter": PNCounter,
+            "gset": GSet,
+            "lwwreg": LWWReg,
+            "mvreg": MVReg,
+        }
+        if kind not in factories:
+            raise ValueError(f"unknown replicaset kind {kind!r}")
+        return [factories[kind]() for _ in range(n_replicas)]
+
+    from .models import (
+        BatchedGCounter,
+        BatchedGSet,
+        BatchedLWWReg,
+        BatchedMap,
+        BatchedMVReg,
+        BatchedOrswot,
+        BatchedPNCounter,
+    )
+
+    if kind == "orswot":
+        return BatchedOrswot(
+            n_replicas, n_members or 64, n_actors or 16, config.deferred_cap
+        )
+    if kind == "map":
+        return BatchedMap(
+            n_replicas,
+            n_keys or 64,
+            n_actors or 16,
+            config.sibling_cap,
+            config.deferred_cap,
+        )
+    if kind == "gcounter":
+        return BatchedGCounter(n_replicas, n_actors=n_actors or 16)
+    if kind == "pncounter":
+        return BatchedPNCounter(n_replicas, n_actors=n_actors or 16)
+    if kind == "gset":
+        return BatchedGSet(n_replicas, n_members or 64)
+    if kind == "lwwreg":
+        return BatchedLWWReg(n_replicas)
+    if kind == "mvreg":
+        return BatchedMVReg(n_replicas, n_actors or 16, config.sibling_cap)
+    raise ValueError(f"unknown replicaset kind {kind!r}")
+
+
+__all__ = ["Config", "config", "configure", "configured", "replicaset"]

@@ -36,6 +36,10 @@ class BatchedGCounter:
     def actors(self) -> Interner:
         return self.inner.actors
 
+    @property
+    def n_replicas(self) -> int:
+        return self.inner.clocks.shape[0]
+
     @classmethod
     def from_pure(cls, pures: Sequence[GCounter], actors: Optional[Interner] = None) -> "BatchedGCounter":
         out = cls(0)

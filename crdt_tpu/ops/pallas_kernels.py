@@ -1,0 +1,136 @@
+"""Pallas TPU kernels for the anti-entropy hot loop.
+
+The jnp ``tree_fold`` (ops/lattice.py) makes log2(R) passes over HBM —
+each reduction level materialises a half-size replica batch. The fused
+fold here streams every replica's dot matrix through VMEM once and
+accumulates the lattice join on-chip: HBM traffic drops from
+O(R·E·A·log R) to O(R·E·A), which is the whole game for the
+bandwidth-bound ORSWOT merge (SURVEY.md §4.2; BASELINE config 3).
+
+Layout: the dense state keeps ``ctr[R, E, A]`` with a small actor axis
+(A ≈ 8–32). Lanes are 128-wide on TPU, so computing in ``[E, A]`` layout
+wastes 15/16 of the VPU — the kernel therefore runs transposed
+``[A, E]`` blocks (E on the lane axis), with the wrapper paying two XLA
+transposes (one pass each) around the single fused pass.
+
+Only the entry matrices fold in-kernel. The deferred-removal buffers are
+tiny ([R, D, A] clocks + [R, D, E] masks with D ≈ 4–8) and their replay
+is a pointwise mask over the folded result, so the wrapper handles them
+with stock jnp (XLA fuses it into the epilogue): union all parked
+removes, replay once against the folded entries, drop caught-up slots.
+Replaying once at the end is equivalent to the pairwise join's
+replay-at-every-node because replay is idempotent and monotone (it
+zeroes exactly the dots the rm clocks cover, which no join can
+resurrect past the final replay), and a slot is always replayed before
+the catch-up drop — the property suite pins fused == tree fold.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .orswot import (
+    OrswotState,
+    _apply_parked,
+    _compact_deferred,
+    _dedupe_deferred,
+)
+
+
+def _fold_kernel(tops_ref, ctrs_ref, top_out_ref, ctr_out_ref):
+    """Sequential lattice fold over the replica axis, one E-tile per
+    program. tops_ref: [A, R]; ctrs_ref: [R, A, TILE_E] (transposed
+    layout, E on lanes). Sequential accumulation equals any reduction
+    tree — the join is associative/commutative/idempotent."""
+    r_total = ctrs_ref.shape[0]
+
+    acc_top = tops_ref[:, pl.ds(0, 1)]  # [A, 1]
+    acc_ctr = ctrs_ref[0]               # [A, TILE_E]
+
+    def body(r, carry):
+        acc_top, acc_ctr = carry
+        b_top = tops_ref[:, pl.ds(r, 1)]
+        b_ctr = ctrs_ref[r]
+        # Reference merge rule (ops/orswot.py join): unseen dots survive,
+        # common members keep common dots ∪ each side's unseen dots.
+        wa = jnp.where(acc_ctr > b_top, acc_ctr, 0)
+        wb = jnp.where(b_ctr > acc_top, b_ctr, 0)
+        pa = jnp.any(acc_ctr > 0, axis=0, keepdims=True)  # [1, TILE_E]
+        pb = jnp.any(b_ctr > 0, axis=0, keepdims=True)
+        common = jnp.maximum(jnp.minimum(acc_ctr, b_ctr), jnp.maximum(wa, wb))
+        new_ctr = jnp.where(pa & pb, common, jnp.where(pa, wa, wb))
+        return jnp.maximum(acc_top, b_top), new_ctr
+
+    acc_top, acc_ctr = jax.lax.fori_loop(1, r_total, body, (acc_top, acc_ctr))
+    top_out_ref[:] = acc_top
+    ctr_out_ref[:] = acc_ctr
+
+
+@partial(jax.jit, static_argnames=("tile_e", "interpret"))
+def fold_fused(
+    states: OrswotState, tile_e: int = 512, interpret: Optional[bool] = None
+) -> Tuple[OrswotState, jax.Array]:
+    """Drop-in replacement for ``ops.orswot.fold`` (same result, same
+    overflow flag) with the replica reduction fused into one HBM pass.
+
+    ``interpret`` defaults to auto: compiled on TPU, interpreter
+    elsewhere (CPU tests exercise the same kernel semantics).
+    """
+    if interpret is None:
+        # "axon" is a TPU chip behind a relay (same Mosaic compile path).
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    r, e, a = states.ctr.shape
+    tile_e = min(tile_e, max(e, 1))
+    pad_e = (-e) % tile_e
+
+    ctrs_t = jnp.swapaxes(states.ctr, -1, -2)  # [R, A, E]
+    if pad_e:
+        ctrs_t = jnp.pad(ctrs_t, ((0, 0), (0, 0), (0, pad_e)))
+    e_padded = e + pad_e
+    tops_t = states.top.T  # [A, R]
+
+    top_t, ctr_t = pl.pallas_call(
+        _fold_kernel,
+        grid=(e_padded // tile_e,),
+        in_specs=[
+            pl.BlockSpec((a, r), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (r, a, tile_e), lambda i: (0, 0, i), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((a, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((a, tile_e), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((a, 1), states.top.dtype),
+            jax.ShapeDtypeStruct((a, e_padded), states.ctr.dtype),
+        ],
+        interpret=interpret,
+    )(tops_t, ctrs_t)
+
+    top = top_t[:, 0]
+    ctr = ctr_t.T[:e]
+
+    # Deferred epilogue (stock jnp; see module docstring): union every
+    # replica's parked removes, replay once, drop caught-up, compact.
+    d = states.dcl.shape[-2]
+    dcl = states.dcl.reshape(r * d, a)
+    dmask = states.dmask.reshape(r * d, e)
+    dvalid = states.dvalid.reshape(r * d)
+    dcl, dmask, dvalid = _dedupe_deferred(dcl, dmask, dvalid)
+    ctr = _apply_parked(ctr, dcl, dmask, dvalid)
+    still_ahead = ~jnp.all(dcl <= top[None, :], axis=-1)
+    dvalid = dvalid & still_ahead
+    dcl, dmask, dvalid, overflow = _compact_deferred(dcl, dmask, dvalid, d)
+    return (
+        OrswotState(top=top, ctr=ctr, dcl=dcl, dmask=dmask, dvalid=dvalid),
+        jnp.any(overflow),
+    )

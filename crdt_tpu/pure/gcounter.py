@@ -34,6 +34,11 @@ class GCounter(CvRDT, CmRDT):
         """
         return Dot(actor, self.inner.get(actor) + steps)
 
+    def validate_op(self, op: Dot) -> None:
+        """Reference: src/gcounter.rs ``validate_op`` (delegates to the
+        inner clock's dot-contiguity check)."""
+        self.inner.validate_op(op)
+
     def apply(self, op: Dot) -> None:
         self.inner.apply(op)
 

@@ -137,6 +137,17 @@ class Map(CvRDT, CmRDT, ResetRemove):
         return MapRm(clock=ctx.clock.clone(), keyset=tuple(keys))
 
     # ---- CmRDT ---------------------------------------------------------
+    def validate_op(self, op) -> None:
+        """DotRange unless an Up's dot is the next contiguous event for
+        its actor (Rm/Nop always valid — removes carry clocks, not new
+        dots). Reference: src/map.rs ``validate_op`` (v7)."""
+        if isinstance(op, Up):
+            from ..traits import DotRange
+
+            expected = self.clock.get(op.dot.actor) + 1
+            if op.dot.counter != expected:
+                raise DotRange(op.dot.actor, op.dot.counter, expected)
+
     def apply(self, op) -> None:
         if isinstance(op, Nop):
             return

@@ -47,6 +47,10 @@ class PNCounter(CvRDT, CmRDT):
         """Reference: src/pncounter.rs ``PNCounter::dec``."""
         return PNOp(dot=self.n.inc(actor), dir=Dir.NEG)
 
+    def validate_op(self, op: PNOp) -> None:
+        """Reference: src/pncounter.rs ``validate_op``."""
+        (self.p if op.dir is Dir.POS else self.n).validate_op(op.dot)
+
     def apply(self, op: PNOp) -> None:
         if op.dir is Dir.POS:
             self.p.apply(op.dot)

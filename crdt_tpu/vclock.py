@@ -72,6 +72,15 @@ class VClock(CvRDT, CmRDT, ResetRemove):
         """
         return self.dot(actor).inc()
 
+    def validate_op(self, op: Dot) -> None:
+        """DotRange unless the dot is the next contiguous event for its
+        actor. Reference: src/vclock.rs ``validate_op`` (v7)."""
+        from .traits import DotRange
+
+        expected = self.get(op.actor) + 1
+        if op.counter != expected:
+            raise DotRange(op.actor, op.counter, expected)
+
     def apply(self, op: Dot) -> None:
         """Observe a dot; monotone (ignores stale counters).
 

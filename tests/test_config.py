@@ -1,0 +1,102 @@
+"""Config / flag system (SURVEY.md §6.6): backend selection is the
+feature-flag analog and drives the A/B gate."""
+
+import pytest
+
+from crdt_tpu.config import config, configure, configured, replicaset
+
+
+def test_backend_selects_execution_path():
+    with configured(backend="pure"):
+        reps = replicaset("orswot", 3)
+        assert isinstance(reps, list) and len(reps) == 3
+        from crdt_tpu.pure.orswot import Orswot
+
+        assert all(isinstance(r, Orswot) for r in reps)
+
+    with configured(backend="xla", deferred_cap=4):
+        model = replicaset("orswot", 3, n_members=8, n_actors=4)
+        from crdt_tpu.models import BatchedOrswot
+
+        assert isinstance(model, BatchedOrswot)
+        assert model.n_replicas == 3
+        assert model.state.dcl.shape[-2] == 4  # deferred_cap flows through
+
+
+def test_all_kinds_construct_under_both_backends():
+    kinds = ["orswot", "map", "gcounter", "pncounter", "gset", "lwwreg", "mvreg"]
+    with configured(backend="pure"):
+        for kind in kinds:
+            assert len(replicaset(kind, 2)) == 2
+    with configured(backend="xla"):
+        for kind in kinds:
+            model = replicaset(kind, 2, n_members=4, n_actors=2, n_keys=4)
+            assert model.n_replicas == 2
+
+
+def test_unknown_fields_and_kinds_rejected():
+    with pytest.raises(TypeError):
+        configure(no_such_flag=True)
+    with pytest.raises(ValueError):
+        configure(backend="cuda")
+    configure(backend="xla")  # restore
+    with pytest.raises(ValueError):
+        replicaset("btree", 2)
+
+
+def test_scoped_override_restores():
+    before = config.backend
+    with configured(backend="pure"):
+        assert config.backend == "pure"
+    assert config.backend == before
+
+
+def test_strict_mode_validation():
+    # v7 validate_op: strict appliers reject gapped/duplicate dots.
+    from crdt_tpu.pure.orswot import Orswot
+    from crdt_tpu.traits import DotRange
+
+    site = Orswot()
+    op1 = site.add("m", site.read().derive_add_ctx("a"))
+    site.apply(op1)
+    replica = Orswot()
+    gapped = site.add("m2", site.read().derive_add_ctx("a"))  # dot (a,2)
+    with pytest.raises(DotRange):
+        replica.validate_op(gapped)  # (a,2) without (a,1): gap
+    replica.apply(op1)
+    replica.validate_op(gapped)  # now contiguous
+    with pytest.raises(DotRange):
+        replica.validate_op(op1)  # duplicate
+
+
+def test_validate_op_counters_and_map():
+    from crdt_tpu import GCounter, Map, MVReg, PNCounter, VClock
+    from crdt_tpu.traits import DotRange
+
+    g = GCounter()
+    op = g.inc("a")
+    g.validate_op(op)
+    g.apply(op)
+    with pytest.raises(DotRange):
+        g.validate_op(op)
+
+    pn = PNCounter()
+    pop = pn.dec("a")
+    pn.validate_op(pop)
+    pn.apply(pop)
+    with pytest.raises(DotRange):
+        pn.validate_op(pop)
+
+    m = Map(val_default=MVReg)
+    up = m.update("k", m.len().derive_add_ctx("a"), lambda r, c: r.write(1, c))
+    m.validate_op(up)
+    m.apply(up)
+    with pytest.raises(DotRange):
+        m.validate_op(up)
+
+    vc = VClock()
+    d = vc.inc("a")
+    vc.validate_op(d)
+    vc.apply(d)
+    with pytest.raises(DotRange):
+        vc.validate_op(d)

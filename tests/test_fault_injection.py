@@ -1,0 +1,173 @@
+"""Fault-injection convergence (SURVEY.md §6.3): the CRDT semantics ARE
+the recovery story — drop, duplicate, and reorder op delivery, partition
+and rejoin replicas, and every surviving path must still converge.
+Plus §6.2: reduction-order invariance (the race-detector analog — any
+anti-entropy schedule must produce identical state)."""
+
+import itertools
+import random
+
+from hypothesis import given, settings
+
+from crdt_tpu import Orswot
+from crdt_tpu.models import BatchedOrswot
+from crdt_tpu.utils import Interner
+
+from strategies import seeds
+
+MEMBERS = list(range(5))
+
+
+def _mint_streams(rng, n_sites, n_ops):
+    """Per-site op streams minted under each site's own actor (per-origin
+    causal order is the delivery contract; cross-site order is free)."""
+    sites = [Orswot() for _ in range(n_sites)]
+    streams = [[] for _ in range(n_sites)]
+    for _ in range(n_ops):
+        i = rng.randrange(n_sites)
+        s = sites[i]
+        if rng.random() < 0.7 or not s.read().val:
+            op = s.add(rng.choice(MEMBERS), s.read().derive_add_ctx(f"s{i}"))
+        else:
+            victim = rng.choice(sorted(s.read().val))
+            op = s.rm(victim, s.contains(victim).derive_rm_ctx())
+        s.apply(op)
+        streams[i].append(op)
+    return sites, streams
+
+
+@given(seeds)
+@settings(max_examples=15)
+def test_drop_duplicate_reorder_delivery_converges(seed):
+    rng = random.Random(seed)
+    n = 4
+    sites, streams = _mint_streams(rng, n, 20)
+
+    # Deliver every stream to every other site with faults injected:
+    # - DROP a suffix (prefix delivery is the causal contract);
+    # - DUPLICATE random ops (CmRDT apply must be idempotent on dups);
+    # - REORDER across sites (interleave streams arbitrarily).
+    receivers = [s.clone() for s in sites]
+    for r_ix, receiver in enumerate(receivers):
+        plan = []
+        for s_ix, stream in enumerate(streams):
+            if s_ix == r_ix:
+                continue
+            keep = rng.randint(0, len(stream))  # drop a suffix
+            prefix = stream[:keep]
+            # duplicate some ops (delivered again later, in order)
+            dups = [op for op in prefix if rng.random() < 0.3]
+            plan.append(prefix + dups)
+        # interleave the per-site plans preserving each plan's order
+        merged = []
+        cursors = [0] * len(plan)
+        while any(c < len(p) for c, p in zip(cursors, plan)):
+            choices = [i for i, (c, p) in enumerate(zip(cursors, plan)) if c < len(p)]
+            i = rng.choice(choices)
+            merged.append(plan[i][cursors[i]])
+            cursors[i] += 1
+        for op in merged:
+            receiver.apply(op)
+
+    # The partial views differ; full state exchange must still converge.
+    final = [r.clone() for r in receivers]
+    for a, b in itertools.permutations(range(n), 2):
+        final[a].merge(final[b].clone())
+    for f in final[1:]:
+        assert f == final[0], "divergence after faulty delivery + exchange"
+
+    # And the converged state equals the fault-free oracle join.
+    oracle = sites[0].clone()
+    for s in sites[1:]:
+        oracle.merge(s.clone())
+    assert final[0] == oracle
+
+
+@given(seeds)
+@settings(max_examples=10)
+def test_partition_and_rejoin_converges(seed):
+    rng = random.Random(seed)
+    n = 5
+    sites, streams = _mint_streams(rng, n, 16)
+
+    # Partition: {0,1} and {2,3,4} gossip internally only.
+    def exchange(group):
+        for a in group:
+            for b in group:
+                if a != b:
+                    sites[a].merge(sites[b].clone())
+
+    exchange([0, 1])
+    exchange([2, 3, 4])
+
+    # More ops during the partition (each side diverges further).
+    for i, extra in ((0, "p"), (3, "q")):
+        op = sites[i].add(extra, sites[i].read().derive_add_ctx(f"s{i}"))
+        sites[i].apply(op)
+
+    # Heal: one bridge merge in each direction, then full gossip.
+    sites[1].merge(sites[2].clone())
+    sites[2].merge(sites[1].clone())
+    exchange(range(n))
+    exchange(range(n))
+    for s in sites[1:]:
+        assert s == sites[0], "partition healing failed"
+    assert {"p", "q"} <= sites[0].members()
+
+
+@given(seeds)
+@settings(max_examples=8)
+def test_device_anti_entropy_with_dropouts_converges(seed):
+    # Replica dropouts in the anti-entropy loop: each round only a random
+    # subset of replica pairs exchange state; enough rounds converge all,
+    # and the result equals the oracle join (the device merge path is the
+    # unit of recovery).
+    rng = random.Random(seed)
+    n = 5
+    sites, _ = _mint_streams(rng, n, 14)
+    model = BatchedOrswot.from_pure(
+        sites,
+        members=Interner(MEMBERS + ["p", "q"]),
+        actors=Interner([f"s{i}" for i in range(n)]),
+    )
+
+    oracle = sites[0].clone()
+    for s in sites[1:]:
+        oracle.merge(s.clone())
+
+    # Random pairwise gossip with dropouts: ~half the pairs per round.
+    for _ in range(6):
+        for dst in range(n):
+            src = rng.randrange(n)
+            if src != dst and rng.random() < 0.5:
+                model.merge_from(dst, src)
+    # Finish with one deterministic full sweep (a dropout-free round).
+    for dst in range(n):
+        for src in range(n):
+            if src != dst:
+                model.merge_from(dst, src)
+
+    for i in range(n):
+        assert model.to_pure(i) == oracle, f"replica {i} diverged"
+
+
+@given(seeds)
+@settings(max_examples=10)
+def test_reduction_order_invariance_on_device(seed):
+    # §6.2: permuting the replica batch must not change the fold — the
+    # lattice join's tree reduction is schedule-independent, bit for bit.
+    rng = random.Random(seed)
+    n = 6
+    sites, _ = _mint_streams(rng, n, 18)
+    members = Interner(MEMBERS)
+    actors = Interner([f"s{i}" for i in range(n)])
+
+    base = BatchedOrswot.from_pure(sites, members=members, actors=actors)
+    folded = base.fold()
+
+    perm = list(range(n))
+    rng.shuffle(perm)
+    shuffled = BatchedOrswot.from_pure(
+        [sites[i] for i in perm], members=members.clone(), actors=actors.clone()
+    )
+    assert shuffled.fold() == folded
