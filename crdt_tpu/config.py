@@ -47,9 +47,14 @@ class Config:
 
 config = Config()
 
+# Whether *we* turned jax_debug_nans on — restore symmetrically on
+# disable without stomping a user's own jax.config setting.
+_debug_nans_set = False
+
 
 def configure(**kwargs) -> Config:
     """Update the global config in place (unknown keys rejected)."""
+    global _debug_nans_set
     for key, value in kwargs.items():
         if not hasattr(config, key):
             raise TypeError(f"unknown config field {key!r}")
@@ -59,6 +64,12 @@ def configure(**kwargs) -> Config:
         import jax
 
         jax.config.update("jax_debug_nans", True)
+        _debug_nans_set = True
+    elif _debug_nans_set:
+        import jax
+
+        jax.config.update("jax_debug_nans", False)
+        _debug_nans_set = False
     return config
 
 

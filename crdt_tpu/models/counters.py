@@ -71,6 +71,10 @@ class BatchedPNCounter:
     def actors(self) -> Interner:
         return self.p.actors
 
+    @property
+    def n_replicas(self) -> int:
+        return self.p.clocks.shape[0]
+
     @classmethod
     def from_pure(cls, pures: Sequence[PNCounter], actors: Optional[Interner] = None) -> "BatchedPNCounter":
         actors = actors if actors is not None else Interner()
@@ -85,13 +89,13 @@ class BatchedPNCounter:
     def to_pure(self, i: int) -> PNCounter:
         return PNCounter(GCounter(self.p.to_pure(i)), GCounter(self.n.to_pure(i)))
 
-    def inc(self, replica: int, actor) -> None:
+    def inc(self, replica: int, actor, steps: int = 1) -> None:
         aid = self.p.bounded_id(actor)
-        self.p.clocks = self.p.clocks.at[replica, aid].add(np.uint32(1))
+        self.p.clocks = self.p.clocks.at[replica, aid].add(np.uint32(steps))
 
-    def dec(self, replica: int, actor) -> None:
+    def dec(self, replica: int, actor, steps: int = 1) -> None:
         aid = self.n.bounded_id(actor)
-        self.n.clocks = self.n.clocks.at[replica, aid].add(np.uint32(1))
+        self.n.clocks = self.n.clocks.at[replica, aid].add(np.uint32(steps))
 
     def fold_read(self) -> int:
         """Converged p − n (exact Python int at the API edge, preserving

@@ -199,21 +199,13 @@ class BatchedMap:
                 raise TypeError(
                     f"BatchedMap routes MVReg ops only, got {op.op!r}"
                 )
-            aid = self.actors.id_of(op.dot.actor)
-            kid = self.keys.id_of(op.key)
             na = self.state.top.shape[-1]
-            if aid >= na:
-                raise IndexError(
-                    f"actor id {aid} outside the {na}-lane universe"
-                )
-            if kid >= self.state.dkeys.shape[-1]:
-                raise IndexError(
-                    f"key id {kid} outside the "
-                    f"{self.state.dkeys.shape[-1]}-slot universe"
-                )
+            nk = self.state.dkeys.shape[-1]
+            aid = self.actors.bounded_intern(op.dot.actor, na, "actor")
+            kid = self.keys.bounded_intern(op.key, nk, "key")
             clock = np.zeros((na,), np.uint32)
             for actor, c in op.op.clock.dots.items():
-                clock[self.actors.id_of(actor)] = c
+                clock[self.actors.bounded_intern(actor, na, "actor")] = c
             row, overflow = ops.apply_up(
                 row,
                 jnp.asarray(aid),
@@ -231,10 +223,10 @@ class BatchedMap:
             na = self.state.top.shape[-1]
             cl = np.zeros((na,), np.uint32)
             for actor, c in op.clock.dots.items():
-                cl[self.actors.id_of(actor)] = c
+                cl[self.actors.bounded_intern(actor, na, "actor")] = c
             mask = np.zeros((self.state.dkeys.shape[-1],), bool)
             for k in op.keyset:
-                mask[self.keys.id_of(k)] = True
+                mask[self.keys.bounded_intern(k, self.state.dkeys.shape[-1], "key")] = True
             row, overflow = ops.apply_rm(row, jnp.asarray(cl), jnp.asarray(mask))
             if bool(overflow):
                 raise DeferredOverflow(

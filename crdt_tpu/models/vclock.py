@@ -56,14 +56,9 @@ class BatchedVClock:
     # ---- ops ----------------------------------------------------------
     def bounded_id(self, actor) -> int:
         """Actor id, guaranteed inside the lane universe (JAX scatter
-        silently drops out-of-bounds indices — never rely on it)."""
-        aid = self.actors.id_of(actor)
-        if aid >= self.n_actors:
-            raise IndexError(
-                f"actor {actor!r} (id {aid}) outside the "
-                f"{self.n_actors}-lane universe; rebuild with more lanes"
-            )
-        return aid
+        silently drops out-of-bounds indices — never rely on it). A
+        never-seen actor is interned into a free lane if one exists."""
+        return self.actors.bounded_intern(actor, self.n_actors, "actor")
 
     def apply(self, replica: int, dot: Dot) -> None:
         aid = self.bounded_id(dot.actor)

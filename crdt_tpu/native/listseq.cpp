@@ -227,7 +227,7 @@ void ls_total_order(void* ep, int64_t* out_rank) {
   Engine& e = *static_cast<Engine*>(ep);
   std::vector<int64_t> order(e.ids.size());
   for (size_t i = 0; i < order.size(); ++i) order[i] = int64_t(i);
-  std::sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+  std::stable_sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
     return cmp_path(e.ids[a], e.ids[b]) < 0;
   });
   for (size_t r = 0; r < order.size(); ++r) out_rank[order[r]] = int64_t(r);

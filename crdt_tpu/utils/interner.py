@@ -33,6 +33,25 @@ class Interner:
         """Id for ``item``; KeyError if never interned."""
         return self._ids[item]
 
+    def bounded_intern(self, item: Any, cap: int, what: str = "item") -> int:
+        """Id for ``item``, allocating into a ``cap``-lane universe.
+        IndexError (not a silent out-of-bounds scatter) when the id
+        would land outside the device array's lanes."""
+        ix = self._ids.get(item)
+        if ix is None:
+            if len(self._items) >= cap:
+                raise IndexError(
+                    f"{what} {item!r}: the {cap}-lane universe is full; "
+                    f"rebuild with more lanes"
+                )
+            return self.intern(item)
+        if ix >= cap:
+            raise IndexError(
+                f"{what} {item!r} (id {ix}) outside the {cap}-lane "
+                f"universe; rebuild with more lanes"
+            )
+        return ix
+
     def __getitem__(self, ix: int) -> Any:
         return self._items[ix]
 
