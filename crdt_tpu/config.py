@@ -47,14 +47,15 @@ class Config:
 
 config = Config()
 
-# Whether *we* turned jax_debug_nans on — restore symmetrically on
-# disable without stomping a user's own jax.config setting.
-_debug_nans_set = False
+# jax_debug_nans value from before *we* enabled it (None = we didn't),
+# so disabling debug_numerics restores the user's own setting rather
+# than forcing False.
+_debug_nans_prev = None
 
 
 def configure(**kwargs) -> Config:
     """Update the global config in place (unknown keys rejected)."""
-    global _debug_nans_set
+    global _debug_nans_prev
     for key, value in kwargs.items():
         if not hasattr(config, key):
             raise TypeError(f"unknown config field {key!r}")
@@ -63,13 +64,14 @@ def configure(**kwargs) -> Config:
     if config.debug_numerics:
         import jax
 
+        if _debug_nans_prev is None:
+            _debug_nans_prev = bool(jax.config.jax_debug_nans)
         jax.config.update("jax_debug_nans", True)
-        _debug_nans_set = True
-    elif _debug_nans_set:
+    elif _debug_nans_prev is not None:
         import jax
 
-        jax.config.update("jax_debug_nans", False)
-        _debug_nans_set = False
+        jax.config.update("jax_debug_nans", _debug_nans_prev)
+        _debug_nans_prev = None
     return config
 
 

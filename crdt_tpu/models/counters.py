@@ -28,6 +28,11 @@ def _exact_sum(row) -> int:
     return sum(int(c) for c in np.asarray(row))
 
 
+def _check_steps(steps: int) -> None:
+    if not 0 <= steps < 2**32:
+        raise ValueError(f"steps must be a u32 (0 <= steps < 2**32), got {steps}")
+
+
 class BatchedGCounter:
     def __init__(self, n_replicas: int, actors: Optional[Interner] = None, n_actors: Optional[int] = None):
         self.inner = BatchedVClock(n_replicas, actors=actors, n_actors=n_actors)
@@ -50,6 +55,7 @@ class BatchedGCounter:
         return GCounter(self.inner.to_pure(i))
 
     def inc(self, replica: int, actor, steps: int = 1) -> None:
+        _check_steps(steps)
         aid = self.inner.bounded_id(actor)
         self.inner.clocks = self.inner.clocks.at[replica, aid].add(np.uint32(steps))
 
@@ -90,10 +96,12 @@ class BatchedPNCounter:
         return PNCounter(GCounter(self.p.to_pure(i)), GCounter(self.n.to_pure(i)))
 
     def inc(self, replica: int, actor, steps: int = 1) -> None:
+        _check_steps(steps)
         aid = self.p.bounded_id(actor)
         self.p.clocks = self.p.clocks.at[replica, aid].add(np.uint32(steps))
 
     def dec(self, replica: int, actor, steps: int = 1) -> None:
+        _check_steps(steps)
         aid = self.n.bounded_id(actor)
         self.n.clocks = self.n.clocks.at[replica, aid].add(np.uint32(steps))
 
