@@ -13,6 +13,17 @@ wastes 15/16 of the VPU — the kernel therefore runs transposed
 ``[A, E]`` blocks (E on the lane axis), with the wrapper paying two XLA
 transposes (one pass each) around the single fused pass.
 
+Mosaic constraints shape two choices here:
+- tops ride as ``[R, A, 1]`` and each step reads ``tops_ref[r]`` — a
+  dynamic index on the *untiled leading axis*, which Mosaic supports.
+  (A ``[A, R]`` layout with ``tops_ref[:, pl.ds(r, 1)]`` does not
+  compile: dynamic lane-axis slices must be 128-aligned.)
+- the replica axis is walked by an inner sequential grid dimension in
+  chunks of ``r_chunk``, with the running join living in the output
+  block (same revisited block across the chunk steps — the standard
+  TPU reduction pattern). VMEM holds one ``[r_chunk, A, tile_e]``
+  input block, so R is unbounded.
+
 Only the entry matrices fold in-kernel. The deferred-removal buffers are
 tiny ([R, D, A] clocks + [R, D, E] masks with D ≈ 4–8) and their replay
 is a pointwise mask over the folded result, so the wrapper handles them
@@ -43,81 +54,174 @@ from .orswot import (
 )
 
 
-def _fold_kernel(tops_ref, ctrs_ref, top_out_ref, ctr_out_ref):
-    """Sequential lattice fold over the replica axis, one E-tile per
-    program. tops_ref: [A, R]; ctrs_ref: [R, A, TILE_E] (transposed
-    layout, E on lanes). Sequential accumulation equals any reduction
-    tree — the join is associative/commutative/idempotent."""
-    r_total = ctrs_ref.shape[0]
+def _umax(a, b):
+    # Mosaic cannot legalize vector arith.maxui/minui on this toolchain;
+    # compare+select (cmpi ult + arith.select) lowers fine and keeps
+    # unsigned semantics for u32 counters.
+    return jnp.where(a >= b, a, b)
 
-    acc_top = tops_ref[:, pl.ds(0, 1)]  # [A, 1]
-    acc_ctr = ctrs_ref[0]               # [A, TILE_E]
+
+def _umin(a, b):
+    return jnp.where(a <= b, a, b)
+
+
+def _join_step(acc_top, acc_ctr, b_top, b_ctr):
+    """One pairwise entry-matrix join in transposed [A, E] layout.
+    Reference merge rule (ops/orswot.py ``join``): unseen dots survive,
+    common members keep common dots ∪ each side's unseen dots."""
+    wa = jnp.where(acc_ctr > b_top, acc_ctr, 0)
+    wb = jnp.where(b_ctr > acc_top, b_ctr, 0)
+    pa = jnp.any(acc_ctr > 0, axis=0, keepdims=True)  # [1, TILE_E]
+    pb = jnp.any(b_ctr > 0, axis=0, keepdims=True)
+    common = _umax(_umin(acc_ctr, b_ctr), _umax(wa, wb))
+    new_ctr = jnp.where(pa & pb, common, jnp.where(pa, wa, wb))
+    return _umax(acc_top, b_top), new_ctr
+
+
+def _fold_kernel(tops_ref, ctrs_ref, top_out_ref, ctr_out_ref):
+    """Sequential lattice fold over one replica chunk, one E-tile per
+    program. tops_ref: [RC, A, 1]; ctrs_ref: [RC, A, TILE_E]. The output
+    block is the running accumulator across the (inner, sequential)
+    replica-chunk grid axis. Sequential accumulation equals any
+    reduction tree — the join is associative/commutative/idempotent."""
+    rc = ctrs_ref.shape[0]
+    first = pl.program_id(1) == 0
+
+    @pl.when(first)
+    def _init():
+        top_out_ref[:] = tops_ref[0]
+        ctr_out_ref[:] = ctrs_ref[0]
 
     def body(r, carry):
         acc_top, acc_ctr = carry
-        b_top = tops_ref[:, pl.ds(r, 1)]
-        b_ctr = ctrs_ref[r]
-        # Reference merge rule (ops/orswot.py join): unseen dots survive,
-        # common members keep common dots ∪ each side's unseen dots.
-        wa = jnp.where(acc_ctr > b_top, acc_ctr, 0)
-        wb = jnp.where(b_ctr > acc_top, b_ctr, 0)
-        pa = jnp.any(acc_ctr > 0, axis=0, keepdims=True)  # [1, TILE_E]
-        pb = jnp.any(b_ctr > 0, axis=0, keepdims=True)
-        common = jnp.maximum(jnp.minimum(acc_ctr, b_ctr), jnp.maximum(wa, wb))
-        new_ctr = jnp.where(pa & pb, common, jnp.where(pa, wa, wb))
-        return jnp.maximum(acc_top, b_top), new_ctr
+        return _join_step(acc_top, acc_ctr, tops_ref[r], ctrs_ref[r])
 
-    acc_top, acc_ctr = jax.lax.fori_loop(1, r_total, body, (acc_top, acc_ctr))
+    # Static bounds: re-joining element 0 right after init is a no-op
+    # because the join is idempotent (join(x, x) == x).
+    acc_top, acc_ctr = jax.lax.fori_loop(
+        0, rc, body, (top_out_ref[:], ctr_out_ref[:])
+    )
     top_out_ref[:] = acc_top
     ctr_out_ref[:] = acc_ctr
 
 
-@partial(jax.jit, static_argnames=("tile_e", "interpret"))
+def _fold_entries_fused(
+    top: jax.Array,
+    ctr: jax.Array,
+    tile_e: int,
+    r_chunk: int,
+    interpret: bool,
+    n_passes: int = 1,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused fold of the entry matrices only: ``top[R, A]``,
+    ``ctr[R, E, A]`` → ``(top[A], ctr[E, A])``.
+
+    ``n_passes > 1`` makes the grid re-walk the resident replica chunk
+    that many times, accumulating into the same output block. Because
+    the join is idempotent the result is unchanged, but the DMA and
+    compute stream is exactly that of folding ``n_passes * R`` distinct
+    replicas — the honest way to time a config-3-scale stream whose full
+    dot-state exceeds HBM (bench.py), with one dispatch."""
+    r, e, a = ctr.shape
+    tile_e = min(tile_e, max(e, 1))
+    rc = min(r_chunk, max(r, 1))
+    pad_e = (-e) % tile_e
+    pad_r = (-r) % rc
+
+    ctrs_t = jnp.swapaxes(ctr, -1, -2)  # [R, A, E]
+    tops3 = top[:, :, None]             # [R, A, 1]
+    if pad_e:
+        ctrs_t = jnp.pad(ctrs_t, ((0, 0), (0, 0), (0, pad_e)))
+    if pad_r:
+        # Empty replicas are the join identity (ops/orswot.py ``empty``).
+        ctrs_t = jnp.pad(ctrs_t, ((0, pad_r), (0, 0), (0, 0)))
+        tops3 = jnp.pad(tops3, ((0, pad_r), (0, 0), (0, 0)))
+    e_padded = e + pad_e
+    r_steps = (r + pad_r) // rc
+
+    top_t, ctr_t = pl.pallas_call(
+        _fold_kernel,
+        # Replica chunks on the inner (fastest) axis so the output block
+        # accumulates across them before the E-tile advances.
+        grid=(e_padded // tile_e, n_passes * r_steps),
+        in_specs=[
+            pl.BlockSpec(
+                (rc, a, 1), lambda i, j: (j % r_steps, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (rc, a, tile_e),
+                lambda i, j: (j % r_steps, 0, i),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((a, 1), lambda i, j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((a, tile_e), lambda i, j: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((a, 1), top.dtype),
+            jax.ShapeDtypeStruct((a, e_padded), ctr.dtype),
+        ],
+        interpret=interpret,
+    )(tops3, ctrs_t)
+
+    return top_t[:, 0], ctr_t.T[:e]
+
+
+# VMEM budget for the streamed input block (double-buffered by the
+# pipeline): keep rc·A·tile_e·4B under ~2 MiB so even A=32 fits easily.
+_VMEM_BLOCK_BUDGET = 2 * 1024 * 1024
+
+
+def _pick_r_chunk(r: int, a: int, tile_e: int, r_chunk: Optional[int]) -> int:
+    if r_chunk is None:
+        r_chunk = max(8, _VMEM_BLOCK_BUDGET // (max(a, 1) * tile_e * 4))
+    return min(r_chunk, max(r, 1))
+
+
 def fold_fused(
-    states: OrswotState, tile_e: int = 512, interpret: Optional[bool] = None
+    states: OrswotState,
+    tile_e: int = 512,
+    r_chunk: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    n_passes: int = 1,
 ) -> Tuple[OrswotState, jax.Array]:
     """Drop-in replacement for ``ops.orswot.fold`` (same result, same
     overflow flag) with the replica reduction fused into one HBM pass.
 
+    ``r_chunk`` defaults to a VMEM-safe size for the given actor count;
     ``interpret`` defaults to auto: compiled on TPU, interpreter
     elsewhere (CPU tests exercise the same kernel semantics).
+    ``n_passes`` re-walks the replica batch that many times (identical
+    result by idempotence; used by bench.py to time a stream of
+    ``n_passes * R`` replicas in one dispatch).
     """
     if interpret is None:
         # "axon" is a TPU chip behind a relay (same Mosaic compile path).
         interpret = jax.default_backend() not in ("tpu", "axon")
-
     r, e, a = states.ctr.shape
     tile_e = min(tile_e, max(e, 1))
-    pad_e = (-e) % tile_e
+    r_chunk = _pick_r_chunk(r, a, tile_e, r_chunk)
+    return _fold_fused_jit(states, tile_e, r_chunk, interpret, n_passes)
 
-    ctrs_t = jnp.swapaxes(states.ctr, -1, -2)  # [R, A, E]
-    if pad_e:
-        ctrs_t = jnp.pad(ctrs_t, ((0, 0), (0, 0), (0, pad_e)))
-    e_padded = e + pad_e
-    tops_t = states.top.T  # [A, R]
 
-    top_t, ctr_t = pl.pallas_call(
-        _fold_kernel,
-        grid=(e_padded // tile_e,),
-        in_specs=[
-            pl.BlockSpec((a, r), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec(
-                (r, a, tile_e), lambda i: (0, 0, i), memory_space=pltpu.VMEM
-            ),
-        ],
-        out_specs=[
-            pl.BlockSpec((a, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((a, tile_e), lambda i: (0, i), memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((a, 1), states.top.dtype),
-            jax.ShapeDtypeStruct((a, e_padded), states.ctr.dtype),
-        ],
+@partial(jax.jit, static_argnames=("tile_e", "r_chunk", "interpret", "n_passes"))
+def _fold_fused_jit(
+    states: OrswotState,
+    tile_e: int,
+    r_chunk: int,
+    interpret: bool,
+    n_passes: int = 1,
+) -> Tuple[OrswotState, jax.Array]:
+    r, e, a = states.ctr.shape
+    top, ctr = _fold_entries_fused(
+        states.top,
+        states.ctr,
+        tile_e=tile_e,
+        r_chunk=r_chunk,
         interpret=interpret,
-    )(tops_t, ctrs_t)
-
-    top = top_t[:, 0]
-    ctr = ctr_t.T[:e]
+        n_passes=n_passes,
+    )
 
     # Deferred epilogue (stock jnp; see module docstring): union every
     # replica's parked removes, replay once, drop caught-up, compact.

@@ -1,0 +1,61 @@
+"""Compiled-mode (Mosaic) smoke test for the fused fold.
+
+Runs only when the session's default backend is a real TPU ("tpu" or
+"axon"); under the regular suite (conftest pins CPU) it is skipped.
+Purpose: interpret-mode green must never again mask a Mosaic compile
+failure on hardware — run this file directly on a TPU host:
+
+    JAX_TRACEBACK_FILTERING=off python -m pytest tests/test_pallas_compiled.py -q --no-header -p no:cacheprovider
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+requires_tpu = pytest.mark.skipif(
+    jax.default_backend() not in ("tpu", "axon"),
+    reason="compiled Mosaic path needs a real TPU backend",
+)
+
+
+@requires_tpu
+def test_fused_fold_compiles_and_matches_tree_on_tpu():
+    from crdt_tpu.ops import orswot as ops
+    from crdt_tpu.ops.pallas_kernels import fold_fused
+
+    rng = np.random.default_rng(0)
+    r, e, a = 32, 512, 8
+    ctr = rng.integers(0, 50, (r, e, a)).astype(np.uint32)
+    ctr[rng.random((r, e, a)) < 0.3] = 0
+    top = np.maximum(ctr.max(axis=1), rng.integers(0, 50, (r, a)).astype(np.uint32))
+    state = ops.empty(e, a, deferred_cap=4, batch=(r,))
+    state = state._replace(top=jnp.asarray(top), ctr=jnp.asarray(ctr))
+
+    fused, of_fused = fold_fused(state, interpret=False)  # force Mosaic
+    tree, of_tree = ops.fold(state)
+    for name in ("top", "ctr", "dvalid"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fused, name)),
+            np.asarray(getattr(tree, name)),
+            err_msg=name,
+        )
+    assert bool(of_fused) == bool(of_tree)
+
+
+@requires_tpu
+def test_multi_pass_stream_compiles_on_tpu():
+    from crdt_tpu.ops import orswot as ops
+    from crdt_tpu.ops.pallas_kernels import fold_fused
+
+    rng = np.random.default_rng(1)
+    r, e, a = 16, 256, 8
+    ctr = rng.integers(0, 20, (r, e, a)).astype(np.uint32)
+    top = ctr.max(axis=1)
+    state = ops.empty(e, a, deferred_cap=4, batch=(r,))
+    state = state._replace(top=jnp.asarray(top), ctr=jnp.asarray(ctr))
+    one, _ = fold_fused(state, interpret=False, n_passes=1)
+    four, _ = fold_fused(state, interpret=False, n_passes=4)
+    np.testing.assert_array_equal(np.asarray(one.ctr), np.asarray(four.ctr))
+    np.testing.assert_array_equal(np.asarray(one.top), np.asarray(four.top))
