@@ -95,8 +95,14 @@ def replicaset(
 ):
     """The backend-selecting factory: N replicas of ``kind`` under the
     configured backend — a list of oracle objects for ``pure``, one
-    batched device model for ``xla``. Kinds: orswot, map, gcounter,
-    pncounter, gset, lwwreg, mvreg."""
+    batched device model for ``xla``. Kinds: orswot, map, map_orswot
+    (Map<K, Orswot>), map_map (Map<K1, Map<K2, MVReg>>), gcounter,
+    pncounter, gset, lwwreg, mvreg.
+
+    Lane sizing for the xla backend: ``n_keys`` sizes the (outer) key
+    axis, ``n_members`` sizes the inner axis of the nested kinds — the
+    member universe for map_orswot, the INNER key universe (K2) for
+    map_map — and ``n_actors`` the actor lanes."""
     config.validate()
     if config.backend == "pure":
         from .pure.gcounter import GCounter
@@ -110,6 +116,8 @@ def replicaset(
         factories = {
             "orswot": Orswot,
             "map": lambda: Map(val_default=MVReg),
+            "map_orswot": lambda: Map(val_default=Orswot),
+            "map_map": lambda: Map(val_default=lambda: Map(val_default=MVReg)),
             "gcounter": GCounter,
             "pncounter": PNCounter,
             "gset": GSet,
@@ -125,7 +133,9 @@ def replicaset(
         BatchedGSet,
         BatchedLWWReg,
         BatchedMap,
+        BatchedMapOrswot,
         BatchedMVReg,
+        BatchedNestedMap,
         BatchedOrswot,
         BatchedPNCounter,
     )
@@ -138,6 +148,23 @@ def replicaset(
         return BatchedMap(
             n_replicas,
             n_keys or 64,
+            n_actors or 16,
+            config.sibling_cap,
+            config.deferred_cap,
+        )
+    if kind == "map_orswot":
+        return BatchedMapOrswot(
+            n_replicas,
+            n_keys or 16,
+            n_members or 16,
+            n_actors or 16,
+            config.deferred_cap,
+        )
+    if kind == "map_map":
+        return BatchedNestedMap(
+            n_replicas,
+            n_keys or 16,
+            n_members or 16,
             n_actors or 16,
             config.sibling_cap,
             config.deferred_cap,

@@ -16,6 +16,7 @@ from .orswot import BatchedOrswot
 from .gset import BatchedGSet
 from .registers import BatchedLWWReg, BatchedMVReg, SlotOverflow
 from .map import BatchedMap
+from .map_nested import BatchedMapOrswot, BatchedNestedMap
 from .list import BatchedList
 
 __all__ = [
@@ -27,6 +28,8 @@ __all__ = [
     "BatchedLWWReg",
     "BatchedMVReg",
     "BatchedMap",
+    "BatchedMapOrswot",
+    "BatchedNestedMap",
     "BatchedList",
     "SlotOverflow",
 ]

@@ -1,0 +1,714 @@
+"""Val-generic device Maps: ``Map<K, Orswot<M>>`` and
+``Map<K1, Map<K2, MVReg>>`` replicas on device.
+
+Oracle: ``crdt_tpu.pure.map.Map`` with ``Orswot`` / nested ``Map``
+children (reference: src/map.rs ``Map<K, V: Val<A>, A>`` — the
+``V: Val<A>`` genericity beyond the MVReg specialisation of
+models/map.py). Device form per ops/map_orswot.py and ops/map_map.py:
+the causal-composition invariant (every child top == the map top)
+collapses nested state to ONE slab over the product space (K × M member
+dots, or K1 × K2 content slots) plus a second (outer) deferred buffer —
+slab composition, not trace-time recursion (SURVEY.md §7.1).
+
+Conversions are lossless — birth clocks / content witnesses, inner
+(per-child) parked removes, outer parked keyset-removes — which the
+bit-identical A/B gates in tests/test_models_map_nested.py exercise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dot import Dot
+from ..ops import map_map as nested_ops
+from ..ops import map_orswot as ops
+from ..ops import mvreg as mv_ops
+from ..pure.map import Map, MapRm, Nop, Up
+from ..pure.mvreg import MVReg, Put
+from ..pure.orswot import Add as OrswotAdd, Orswot, Rm as OrswotRm
+from ..utils import Interner
+from ..utils.metrics import metrics
+from ..vclock import VClock
+from .orswot import DeferredOverflow
+from .registers import SlotOverflow
+
+
+class BatchedMapOrswot:
+    def __init__(
+        self,
+        n_replicas: int,
+        n_keys: int,
+        n_members: int,
+        n_actors: int,
+        deferred_cap: int = 4,
+        keys: Optional[Interner] = None,
+        members: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+    ):
+        self.keys = keys if keys is not None else Interner()
+        self.members = members if members is not None else Interner()
+        self.actors = actors if actors is not None else Interner()
+        self.state = ops.empty(
+            n_keys, n_members, n_actors, deferred_cap, batch=(n_replicas,)
+        )
+
+    @property
+    def n_replicas(self) -> int:
+        return self.state.core.top.shape[0]
+
+    @property
+    def n_keys(self) -> int:
+        return self.state.kdkeys.shape[-1]
+
+    @property
+    def n_members(self) -> int:
+        return self.state.core.ctr.shape[-2] // self.n_keys
+
+    # ---- conversion (the A/B gate boundary) ---------------------------
+    @classmethod
+    def from_pure(
+        cls,
+        pures: Sequence[Map],
+        deferred_cap: int = 4,
+        keys: Optional[Interner] = None,
+        members: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+        n_keys: int = 1,
+        n_members: int = 1,
+        n_actors: int = 1,
+    ) -> "BatchedMapOrswot":
+        keys = keys if keys is not None else Interner()
+        members = members if members is not None else Interner()
+        actors = actors if actors is not None else Interner()
+        for p in pures:
+            for actor in p.clock.dots:
+                actors.intern(actor)
+            for k, child in p.entries.items():
+                keys.intern(k)
+                if not isinstance(child, Orswot):
+                    raise TypeError(
+                        f"BatchedMapOrswot children must be Orswot, got {type(child)}"
+                    )
+                if child.clock != p.clock:
+                    raise ValueError(
+                        f"child at {k!r} violates the covered invariant "
+                        f"(child clock != map clock); not a composed state"
+                    )
+                for m, clock in child.entries.items():
+                    members.intern(m)
+                    for actor in clock.dots:
+                        actors.intern(actor)
+                for clock, ms in child.deferred.items():
+                    for actor in clock.dots:
+                        actors.intern(actor)
+                    for m in ms:
+                        members.intern(m)
+            for clock, ks in p.deferred.items():
+                for actor in clock.dots:
+                    actors.intern(actor)
+                for k in ks:
+                    keys.intern(k)
+
+        r = len(pures)
+        # Lane counts: what the pures need, with caller-given floors so a
+        # model built from empty replicas still has room to grow via ops.
+        nk = max(len(keys), n_keys, 1)
+        nm = max(len(members), n_members, 1)
+        na = max(len(actors), n_actors, 1)
+        out = cls(
+            r, nk, nm, na, deferred_cap,
+            keys=keys, members=members, actors=actors,
+        )
+        d = deferred_cap
+        top = np.zeros((r, na), np.uint32)
+        ctr = np.zeros((r, nk * nm, na), np.uint32)
+        dcl = np.zeros((r, d, na), np.uint32)
+        dmask = np.zeros((r, d, nk * nm), bool)
+        dvalid = np.zeros((r, d), bool)
+        kdcl = np.zeros((r, d, na), np.uint32)
+        kdkeys = np.zeros((r, d, nk), bool)
+        kdvalid = np.zeros((r, d), bool)
+        for i, p in enumerate(pures):
+            for actor, c in p.clock.dots.items():
+                top[i, actors.id_of(actor)] = c
+            # Inner parked removes: pure keeps them per child; the shared
+            # device buffer unions equal clocks (what a join produces) —
+            # to_pure splits them back per key.
+            inner: dict = {}
+            for k, child in p.entries.items():
+                ki = keys.id_of(k)
+                for m, clock in child.entries.items():
+                    mi = members.id_of(m)
+                    for actor, c in clock.dots.items():
+                        ctr[i, ki * nm + mi, actors.id_of(actor)] = c
+                for clock, ms in child.deferred.items():
+                    inner.setdefault(clock, set()).update(
+                        ki * nm + members.id_of(m) for m in ms
+                    )
+            if len(inner) > d:
+                raise ValueError(
+                    f"replica {i}: {len(inner)} inner parked removes; "
+                    f"capacity is {d}"
+                )
+            for s, (clock, cells) in enumerate(inner.items()):
+                for actor, c in clock.dots.items():
+                    dcl[i, s, actors.id_of(actor)] = c
+                for cell in cells:
+                    dmask[i, s, cell] = True
+                dvalid[i, s] = True
+            if len(p.deferred) > d:
+                raise ValueError(
+                    f"replica {i}: {len(p.deferred)} outer parked removes; "
+                    f"capacity is {d}"
+                )
+            for s, (clock, ks) in enumerate(p.deferred.items()):
+                for actor, c in clock.dots.items():
+                    kdcl[i, s, actors.id_of(actor)] = c
+                for k in ks:
+                    kdkeys[i, s, keys.id_of(k)] = True
+                kdvalid[i, s] = True
+
+        core = out.state.core._replace(
+            top=jnp.asarray(top),
+            ctr=jnp.asarray(ctr),
+            dcl=jnp.asarray(dcl),
+            dmask=jnp.asarray(dmask),
+            dvalid=jnp.asarray(dvalid),
+        )
+        out.state = ops.MapOrswotState(
+            core=core,
+            kdcl=jnp.asarray(kdcl),
+            kdkeys=jnp.asarray(kdkeys),
+            kdvalid=jnp.asarray(kdvalid),
+        )
+        return out
+
+    def _row(self, arrs, i: int):
+        return jax.tree.map(lambda x: x[i], arrs)
+
+    def to_pure(self, i: int) -> Map:
+        st = jax.device_get(self._row(self.state, i))
+        nk, nm = self.n_keys, self.n_members
+        out = Map(Orswot)
+        out.clock = VClock(
+            {self.actors[a]: int(c) for a, c in enumerate(st.core.top) if c > 0}
+        )
+        ctr = st.core.ctr.reshape(nk, nm, -1)
+        for ki in np.nonzero(ctr.any(axis=(1, 2)))[0]:
+            child = Orswot()
+            child.clock = out.clock.clone()
+            for mi in np.nonzero(ctr[ki].any(axis=-1))[0]:
+                child.entries[self.members[int(mi)]] = VClock(
+                    {
+                        self.actors[a]: int(c)
+                        for a, c in enumerate(ctr[ki, mi])
+                        if c > 0
+                    }
+                )
+            out.entries[self.keys[int(ki)]] = child
+        # Inner parked removes: split each shared slot back per key.
+        for s in np.nonzero(st.core.dvalid)[0]:
+            clock = VClock(
+                {self.actors[a]: int(c) for a, c in enumerate(st.core.dcl[s]) if c > 0}
+            )
+            mask = st.core.dmask[s].reshape(nk, nm)
+            for ki in np.nonzero(mask.any(axis=-1))[0]:
+                child = out.entries.get(self.keys[int(ki)])
+                if child is None:
+                    continue  # scrubbed dead key (oracle dropped it too)
+                child.deferred.setdefault(clock.clone(), set()).update(
+                    self.members[int(mi)] for mi in np.nonzero(mask[ki])[0]
+                )
+        for s in np.nonzero(st.kdvalid)[0]:
+            clock = VClock(
+                {self.actors[a]: int(c) for a, c in enumerate(st.kdcl[s]) if c > 0}
+            )
+            out.deferred[clock] = {
+                self.keys[int(k)] for k in np.nonzero(st.kdkeys[s])[0]
+            }
+        return out
+
+    # ---- op path (CmRDT) ----------------------------------------------
+    def apply(self, replica: int, op) -> None:
+        """Apply an oracle-shaped op to one replica (reference:
+        src/map.rs ``CmRDT::apply`` routing orswot child ops)."""
+        if isinstance(op, Nop):
+            return
+        row = self._row(self.state, replica)
+        na, nk, nm = self.state.core.top.shape[-1], self.n_keys, self.n_members
+        if isinstance(op, Up):
+            kid = self.keys.bounded_intern(op.key, nk, "key")
+            aid = self.actors.bounded_intern(op.dot.actor, na, "actor")
+            if isinstance(op.op, OrswotAdd):
+                if op.op.dot != op.dot:
+                    raise ValueError(
+                        "inner add dot must equal the Up dot (one AddCtx)"
+                    )
+                mask = np.zeros((nm,), bool)
+                for m in op.op.members:
+                    mask[self.members.bounded_intern(m, nm, "member")] = True
+                row = ops.apply_member_add(
+                    row,
+                    jnp.asarray(aid),
+                    jnp.asarray(np.uint32(op.dot.counter)),
+                    jnp.asarray(kid),
+                    jnp.asarray(mask),
+                )
+            elif isinstance(op.op, OrswotRm):
+                clock = np.zeros((na,), np.uint32)
+                for actor, c in op.op.clock.dots.items():
+                    clock[self.actors.bounded_intern(actor, na, "actor")] = c
+                mask = np.zeros((nm,), bool)
+                for m in op.op.members:
+                    mask[self.members.bounded_intern(m, nm, "member")] = True
+                row, overflow = ops.apply_member_rm(
+                    row,
+                    jnp.asarray(aid),
+                    jnp.asarray(np.uint32(op.dot.counter)),
+                    jnp.asarray(kid),
+                    jnp.asarray(clock),
+                    jnp.asarray(mask),
+                )
+                if bool(overflow):
+                    raise DeferredOverflow(
+                        f"replica {replica}: inner deferred buffer full "
+                        f"(cap {self.state.core.dvalid.shape[-1]})"
+                    )
+            else:
+                raise TypeError(
+                    f"BatchedMapOrswot routes Orswot ops only, got {op.op!r}"
+                )
+        elif isinstance(op, MapRm):
+            clock = np.zeros((na,), np.uint32)
+            for actor, c in op.clock.dots.items():
+                clock[self.actors.bounded_intern(actor, na, "actor")] = c
+            mask = np.zeros((nk,), bool)
+            for k in op.keyset:
+                mask[self.keys.bounded_intern(k, nk, "key")] = True
+            row, overflow = ops.apply_key_rm(row, jnp.asarray(clock), jnp.asarray(mask))
+            if bool(overflow):
+                raise DeferredOverflow(
+                    f"replica {replica}: outer deferred buffer full "
+                    f"(cap {self.state.kdvalid.shape[-1]})"
+                )
+        else:
+            raise TypeError(f"not a Map op: {op!r}")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[replica].set(r), self.state, row
+        )
+
+    # ---- state path (CvRDT) -------------------------------------------
+    def _check_flags(self, flags, what: str) -> None:
+        inner, outer = (bool(x) for x in flags)
+        if inner or outer:
+            raise DeferredOverflow(
+                f"{what}: {'inner' if inner else 'outer'} deferred buffer "
+                f"full — rebuild with a larger deferred_cap"
+            )
+
+    def merge_from(self, dst: int, src: int) -> None:
+        metrics.count("map_orswot.merges")
+        joined, flags = ops.join(
+            self._row(self.state, dst), self._row(self.state, src)
+        )
+        self._check_flags(flags, f"merge {src}->{dst}")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[dst].set(r), self.state, joined
+        )
+
+    def fold(self) -> Map:
+        """Full-mesh anti-entropy: join all replicas, return the converged
+        oracle-form state."""
+        metrics.count("map_orswot.merges", max(self.n_replicas - 1, 0))
+        folded, flags = ops.fold(self.state)
+        self._check_flags(flags, "fold")
+        tmp = BatchedMapOrswot(
+            1, self.n_keys, self.n_members,
+            self.state.core.top.shape[-1],
+            self.state.kdcl.shape[-2],
+            keys=self.keys, members=self.members, actors=self.actors,
+        )
+        tmp.state = jax.tree.map(lambda x: x[None], folded)
+        return tmp.to_pure(0)
+
+    def keys_of(self, i: int) -> frozenset:
+        nk, nm = self.n_keys, self.n_members
+        ctr = np.asarray(self.state.core.ctr[i]).reshape(nk, nm, -1)
+        return frozenset(
+            self.keys[int(k)] for k in np.nonzero(ctr.any(axis=(1, 2)))[0]
+        )
+
+
+class BatchedNestedMap:
+    """N dense ``Map<K1, Map<K2, MVReg>>`` replicas (ops/map_map.py)."""
+
+    def __init__(
+        self,
+        n_replicas: int,
+        n_keys1: int,
+        n_keys2: int,
+        n_actors: int,
+        sibling_cap: int = 4,
+        deferred_cap: int = 4,
+        keys1: Optional[Interner] = None,
+        keys2: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+        values: Optional[Interner] = None,
+    ):
+        self.keys1 = keys1 if keys1 is not None else Interner()
+        self.keys2 = keys2 if keys2 is not None else Interner()
+        self.actors = actors if actors is not None else Interner()
+        self.values = values if values is not None else Interner()
+        self.state = nested_ops.empty(
+            n_keys1, n_keys2, n_actors, sibling_cap, deferred_cap,
+            batch=(n_replicas,),
+        )
+
+    @property
+    def n_replicas(self) -> int:
+        return self.state.m.top.shape[0]
+
+    @property
+    def n_keys1(self) -> int:
+        return self.state.odkeys.shape[-1]
+
+    @property
+    def n_keys2(self) -> int:
+        return self.state.m.dkeys.shape[-1] // self.n_keys1
+
+    # ---- conversion (the A/B gate boundary) ---------------------------
+    @classmethod
+    def from_pure(
+        cls,
+        pures: Sequence[Map],
+        sibling_cap: int = 4,
+        deferred_cap: int = 4,
+        keys1: Optional[Interner] = None,
+        keys2: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+        values: Optional[Interner] = None,
+        n_keys1: int = 1,
+        n_keys2: int = 1,
+        n_actors: int = 1,
+    ) -> "BatchedNestedMap":
+        keys1 = keys1 if keys1 is not None else Interner()
+        keys2 = keys2 if keys2 is not None else Interner()
+        actors = actors if actors is not None else Interner()
+        values = values if values is not None else Interner()
+        for p in pures:
+            for actor in p.clock.dots:
+                actors.intern(actor)
+            for k1, child in p.entries.items():
+                keys1.intern(k1)
+                if not isinstance(child, Map):
+                    raise TypeError(
+                        f"BatchedNestedMap children must be Map, got {type(child)}"
+                    )
+                if child.clock != p.clock:
+                    raise ValueError(
+                        f"child at {k1!r} violates the covered invariant "
+                        f"(child clock != map clock); not a composed state"
+                    )
+                for k2, reg in child.entries.items():
+                    keys2.intern(k2)
+                    if not isinstance(reg, MVReg):
+                        raise TypeError(
+                            f"inner children must be MVReg, got {type(reg)}"
+                        )
+                    for d, (clock, v) in reg.vals.items():
+                        actors.intern(d.actor)
+                        for actor in clock.dots:
+                            actors.intern(actor)
+                        values.intern(v)
+                for clock, k2s in child.deferred.items():
+                    for actor in clock.dots:
+                        actors.intern(actor)
+                    for k2 in k2s:
+                        keys2.intern(k2)
+            for clock, k1s in p.deferred.items():
+                for actor in clock.dots:
+                    actors.intern(actor)
+                for k1 in k1s:
+                    keys1.intern(k1)
+
+        r = len(pures)
+        # Lane counts: what the pures need, with caller-given floors so a
+        # model built from empty replicas still has room to grow via ops.
+        nk1 = max(len(keys1), n_keys1, 1)
+        nk2 = max(len(keys2), n_keys2, 1)
+        na = max(len(actors), n_actors, 1)
+        out = cls(
+            r, nk1, nk2, na, sibling_cap, deferred_cap,
+            keys1=keys1, keys2=keys2, actors=actors, values=values,
+        )
+        d, s = deferred_cap, sibling_cap
+        nk = nk1 * nk2
+        top = np.zeros((r, na), np.uint32)
+        cact = np.zeros((r, nk, s), np.int32)
+        cctr = np.zeros((r, nk, s), np.uint32)
+        cclk = np.zeros((r, nk, s, na), np.uint32)
+        cval = np.zeros((r, nk, s), np.int32)
+        cvalid = np.zeros((r, nk, s), bool)
+        dcl = np.zeros((r, d, na), np.uint32)
+        dkeys = np.zeros((r, d, nk), bool)
+        dvalid = np.zeros((r, d), bool)
+        odcl = np.zeros((r, d, na), np.uint32)
+        odkeys = np.zeros((r, d, nk1), bool)
+        odvalid = np.zeros((r, d), bool)
+        for i, p in enumerate(pures):
+            for actor, c in p.clock.dots.items():
+                top[i, actors.id_of(actor)] = c
+            inner: dict = {}
+            for k1, child in p.entries.items():
+                k1i = keys1.id_of(k1)
+                for k2, reg in child.entries.items():
+                    ki = k1i * nk2 + keys2.id_of(k2)
+                    if len(reg.vals) > s:
+                        raise ValueError(
+                            f"replica {i} key ({k1!r},{k2!r}): "
+                            f"{len(reg.vals)} siblings; capacity is {s}"
+                        )
+                    for si, (dot, (clock, v)) in enumerate(
+                        sorted(
+                            reg.vals.items(),
+                            key=lambda kv: (
+                                actors.id_of(kv[0].actor), kv[0].counter,
+                            ),
+                        )
+                    ):
+                        cact[i, ki, si] = actors.id_of(dot.actor)
+                        cctr[i, ki, si] = dot.counter
+                        for actor, c in clock.dots.items():
+                            cclk[i, ki, si, actors.id_of(actor)] = c
+                        cval[i, ki, si] = values.id_of(v)
+                        cvalid[i, ki, si] = True
+                for clock, k2s in child.deferred.items():
+                    inner.setdefault(clock, set()).update(
+                        k1i * nk2 + keys2.id_of(k2) for k2 in k2s
+                    )
+            if len(inner) > d:
+                raise ValueError(
+                    f"replica {i}: {len(inner)} inner parked removes; "
+                    f"capacity is {d}"
+                )
+            for si, (clock, cells) in enumerate(inner.items()):
+                for actor, c in clock.dots.items():
+                    dcl[i, si, actors.id_of(actor)] = c
+                for cell in cells:
+                    dkeys[i, si, cell] = True
+                dvalid[i, si] = True
+            if len(p.deferred) > d:
+                raise ValueError(
+                    f"replica {i}: {len(p.deferred)} outer parked removes; "
+                    f"capacity is {d}"
+                )
+            for si, (clock, k1s) in enumerate(p.deferred.items()):
+                for actor, c in clock.dots.items():
+                    odcl[i, si, actors.id_of(actor)] = c
+                for k1 in k1s:
+                    odkeys[i, si, keys1.id_of(k1)] = True
+                odvalid[i, si] = True
+
+        out.state = nested_ops.NestedMapState(
+            m=out.state.m._replace(
+                top=jnp.asarray(top),
+                child=mv_ops.MVRegState(
+                    wact=jnp.asarray(cact),
+                    wctr=jnp.asarray(cctr),
+                    clk=jnp.asarray(cclk),
+                    val=jnp.asarray(cval),
+                    valid=jnp.asarray(cvalid),
+                ),
+                dcl=jnp.asarray(dcl),
+                dkeys=jnp.asarray(dkeys),
+                dvalid=jnp.asarray(dvalid),
+            ),
+            odcl=jnp.asarray(odcl),
+            odkeys=jnp.asarray(odkeys),
+            odvalid=jnp.asarray(odvalid),
+        )
+        return out
+
+    def _row(self, arrs, i: int):
+        return jax.tree.map(lambda x: x[i], arrs)
+
+    def to_pure(self, i: int) -> Map:
+        st = jax.device_get(self._row(self.state, i))
+        nk1, nk2 = self.n_keys1, self.n_keys2
+        inner_map = lambda: Map(MVReg)
+        out = Map(inner_map)
+        out.clock = VClock(
+            {self.actors[a]: int(c) for a, c in enumerate(st.m.top) if c > 0}
+        )
+        valid = st.m.child.valid.reshape(nk1, nk2, -1)
+        for k1i in np.nonzero(valid.any(axis=(1, 2)))[0]:
+            child = Map(MVReg)
+            child.clock = out.clock.clone()
+            for k2i in np.nonzero(valid[k1i].any(axis=-1))[0]:
+                ki = int(k1i) * nk2 + int(k2i)
+                vals = {}
+                for si in np.nonzero(st.m.child.valid[ki])[0]:
+                    dot = Dot(
+                        self.actors[int(st.m.child.wact[ki, si])],
+                        int(st.m.child.wctr[ki, si]),
+                    )
+                    clock = VClock(
+                        {
+                            self.actors[a]: int(c)
+                            for a, c in enumerate(st.m.child.clk[ki, si])
+                            if c > 0
+                        }
+                    )
+                    vals[dot] = (clock, self.values[int(st.m.child.val[ki, si])])
+                child.entries[self.keys2[int(k2i)]] = MVReg(vals)
+            out.entries[self.keys1[int(k1i)]] = child
+        # Inner parked removes: split each shared slot back per k1.
+        for si in np.nonzero(st.m.dvalid)[0]:
+            clock = VClock(
+                {self.actors[a]: int(c) for a, c in enumerate(st.m.dcl[si]) if c > 0}
+            )
+            mask = st.m.dkeys[si].reshape(nk1, nk2)
+            for k1i in np.nonzero(mask.any(axis=-1))[0]:
+                child = out.entries.get(self.keys1[int(k1i)])
+                if child is None:
+                    continue  # scrubbed dead key (oracle dropped it too)
+                child.deferred.setdefault(clock.clone(), set()).update(
+                    self.keys2[int(k2i)] for k2i in np.nonzero(mask[k1i])[0]
+                )
+        for si in np.nonzero(st.odvalid)[0]:
+            clock = VClock(
+                {self.actors[a]: int(c) for a, c in enumerate(st.odcl[si]) if c > 0}
+            )
+            out.deferred[clock] = {
+                self.keys1[int(k)] for k in np.nonzero(st.odkeys[si])[0]
+            }
+        return out
+
+    # ---- op path (CmRDT) ----------------------------------------------
+    def apply(self, replica: int, op) -> None:
+        """Apply an oracle-shaped op to one replica (reference:
+        src/map.rs ``CmRDT::apply`` routing nested map ops)."""
+        if isinstance(op, Nop):
+            return
+        row = self._row(self.state, replica)
+        na = self.state.m.top.shape[-1]
+        nk1, nk2 = self.n_keys1, self.n_keys2
+        if isinstance(op, Up):
+            k1id = self.keys1.bounded_intern(op.key, nk1, "outer key")
+            aid = self.actors.bounded_intern(op.dot.actor, na, "actor")
+            inner = op.op
+            if isinstance(inner, Up):
+                if inner.dot != op.dot:
+                    raise ValueError(
+                        "inner Up dot must equal the outer Up dot (one AddCtx)"
+                    )
+                if not isinstance(inner.op, Put):
+                    raise TypeError(
+                        f"innermost op must be an MVReg Put, got {inner.op!r}"
+                    )
+                k2id = self.keys2.bounded_intern(inner.key, nk2, "inner key")
+                clock = np.zeros((na,), np.uint32)
+                for actor, c in inner.op.clock.dots.items():
+                    clock[self.actors.bounded_intern(actor, na, "actor")] = c
+                row, overflow = nested_ops.apply_put(
+                    row,
+                    jnp.asarray(aid),
+                    jnp.asarray(np.uint32(op.dot.counter)),
+                    jnp.asarray(k1id),
+                    jnp.asarray(k2id),
+                    jnp.asarray(clock),
+                    jnp.asarray(self.values.intern(inner.op.val)),
+                )
+                if bool(overflow):
+                    raise SlotOverflow(
+                        f"replica {replica}: sibling slab full at "
+                        f"({op.key!r},{inner.key!r})"
+                    )
+            elif isinstance(inner, MapRm):
+                clock = np.zeros((na,), np.uint32)
+                for actor, c in inner.clock.dots.items():
+                    clock[self.actors.bounded_intern(actor, na, "actor")] = c
+                mask = np.zeros((nk2,), bool)
+                for k2 in inner.keyset:
+                    mask[self.keys2.bounded_intern(k2, nk2, "inner key")] = True
+                row, overflow = nested_ops.apply_inner_rm(
+                    row,
+                    jnp.asarray(aid),
+                    jnp.asarray(np.uint32(op.dot.counter)),
+                    jnp.asarray(k1id),
+                    jnp.asarray(clock),
+                    jnp.asarray(mask),
+                )
+                if bool(overflow):
+                    raise DeferredOverflow(
+                        f"replica {replica}: inner deferred buffer full "
+                        f"(cap {self.state.m.dvalid.shape[-1]})"
+                    )
+            else:
+                raise TypeError(
+                    f"BatchedNestedMap routes Map ops only, got {inner!r}"
+                )
+        elif isinstance(op, MapRm):
+            clock = np.zeros((na,), np.uint32)
+            for actor, c in op.clock.dots.items():
+                clock[self.actors.bounded_intern(actor, na, "actor")] = c
+            mask = np.zeros((nk1,), bool)
+            for k1 in op.keyset:
+                mask[self.keys1.bounded_intern(k1, nk1, "outer key")] = True
+            row, overflow = nested_ops.apply_key1_rm(
+                row, jnp.asarray(clock), jnp.asarray(mask)
+            )
+            if bool(overflow):
+                raise DeferredOverflow(
+                    f"replica {replica}: outer deferred buffer full "
+                    f"(cap {self.state.odvalid.shape[-1]})"
+                )
+        else:
+            raise TypeError(f"not a Map op: {op!r}")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[replica].set(r), self.state, row
+        )
+
+    # ---- state path (CvRDT) -------------------------------------------
+    def _check_flags(self, flags, what: str) -> None:
+        sibling, inner, outer = (bool(x) for x in flags)
+        if sibling:
+            raise SlotOverflow(
+                f"{what}: sibling slab full — rebuild with a larger sibling_cap"
+            )
+        if inner or outer:
+            raise DeferredOverflow(
+                f"{what}: {'inner' if inner else 'outer'} deferred buffer "
+                f"full — rebuild with a larger deferred_cap"
+            )
+
+    def merge_from(self, dst: int, src: int) -> None:
+        metrics.count("nested_map.merges")
+        joined, flags = nested_ops.join(
+            self._row(self.state, dst), self._row(self.state, src)
+        )
+        self._check_flags(flags, f"merge {src}->{dst}")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[dst].set(r), self.state, joined
+        )
+
+    def fold(self) -> Map:
+        """Full-mesh anti-entropy: join all replicas, return the converged
+        oracle-form state."""
+        metrics.count("nested_map.merges", max(self.n_replicas - 1, 0))
+        folded, flags = nested_ops.fold(self.state)
+        self._check_flags(flags, "fold")
+        tmp = BatchedNestedMap(
+            1, self.n_keys1, self.n_keys2,
+            self.state.m.top.shape[-1],
+            self.state.m.child.wact.shape[-1],
+            self.state.odcl.shape[-2],
+            keys1=self.keys1, keys2=self.keys2,
+            actors=self.actors, values=self.values,
+        )
+        tmp.state = jax.tree.map(lambda x: x[None], folded)
+        return tmp.to_pure(0)

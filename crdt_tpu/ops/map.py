@@ -39,7 +39,7 @@ from jax import lax
 
 from . import mvreg
 from .mvreg import MVRegState
-from .orswot import _compact_deferred, _dedupe_deferred
+from .orswot import _compact_deferred, _dedupe_deferred, _park_remove
 
 DTYPE = jnp.uint32
 
@@ -298,23 +298,10 @@ def apply_rm(state: MapState, rm_clock: jax.Array, key_mask: jax.Array):
     child = _canon_child(state.child._replace(valid=valid))
 
     ahead = ~jnp.all(rm_clock <= state.top, axis=-1)
-    same = state.dvalid & jnp.all(state.dcl == rm_clock[..., None, :], axis=-1)
-    has_same = jnp.any(same, axis=-1)
-    free = ~state.dvalid
-    has_free = jnp.any(free, axis=-1)
-    slot = jnp.where(has_same, jnp.argmax(same, axis=-1), jnp.argmax(free, axis=-1))
-    park = ahead & (has_same | has_free)
-    overflow = ahead & ~has_same & ~has_free
-
-    d = state.dvalid.shape[-1]
-    onehot = jax.nn.one_hot(slot, d, dtype=bool) & park[..., None]
-    dcl = jnp.where(onehot[..., None], rm_clock[..., None, :], state.dcl)
-    live = state.dkeys & state.dvalid[..., None]
-    dkeys = jnp.where(onehot[..., None], key_mask[..., None, :] | live, state.dkeys)
+    dcl, dkeys, dvalid, overflow = _park_remove(
+        state.dcl, state.dkeys, state.dvalid, rm_clock, key_mask, ahead
+    )
     return (
-        MapState(
-            top=state.top, child=child,
-            dcl=dcl, dkeys=dkeys, dvalid=state.dvalid | onehot,
-        ),
+        MapState(top=state.top, child=child, dcl=dcl, dkeys=dkeys, dvalid=dvalid),
         overflow,
     )

@@ -1,0 +1,232 @@
+"""Dense batched kernels for ``Map<K1, Map<K2, MVReg>>`` — nested maps
+by slab flattening.
+
+Oracle: ``crdt_tpu.pure.map.Map`` with nested ``Map`` children
+(reference: src/map.rs arbitrary ``V: Val<A>`` nesting depth). Under the
+causal-composition rule every child map's top equals the outer top, so
+the two key levels flatten into ONE ``ops.map.MapState`` over the
+K1 × K2 product key space (the MVReg content slab and its semantics are
+reused wholesale) — SURVEY.md §7.1's slab composition instead of
+trace-time recursion.
+
+The flat state's own deferred buffer carries the INNER maps' parked
+keyset-removes (masks over K1×K2, routed via ``Op::Up``); a second
+buffer carries the OUTER map's parked removes (masks over K1). They
+replay with the same covered-dot rule but must stay distinct for
+lossless round-trips (outer ``map.deferred`` vs per-child
+``child.deferred``), and inner parked removes die with a bottomed child
+(``Map.is_bottom`` counts live entries only) — the dead-key scrub.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import map as core_ops
+from .map import MapState, _canon_child, _rm_covered
+from .orswot import _compact_deferred, _dedupe_deferred, _park_remove
+
+DTYPE = jnp.uint32
+
+
+class NestedMapState(NamedTuple):
+    """A (possibly batched) dense Map<K1, Map<K2, MVReg>> replica."""
+
+    m: MapState        # flat over K1*K2; its deferred = inner parked rms
+    odcl: jax.Array    # [..., D, A]   outer parked rm clocks
+    odkeys: jax.Array  # [..., D, K1]  outer parked keysets
+    odvalid: jax.Array # [..., D]
+
+
+def empty(
+    n_keys1: int,
+    n_keys2: int,
+    n_actors: int,
+    sibling_cap: int = 4,
+    deferred_cap: int = 4,
+    batch: tuple = (),
+) -> NestedMapState:
+    """The join identity."""
+    return NestedMapState(
+        m=core_ops.empty(
+            n_keys1 * n_keys2, n_actors, sibling_cap, deferred_cap, batch=batch
+        ),
+        odcl=jnp.zeros((*batch, deferred_cap, n_actors), DTYPE),
+        odkeys=jnp.zeros((*batch, deferred_cap, n_keys1), bool),
+        odvalid=jnp.zeros((*batch, deferred_cap), bool),
+    )
+
+
+def _n_keys1(state: NestedMapState) -> int:
+    return state.odkeys.shape[-1]
+
+
+def _expand1(state: NestedMapState, key1_mask: jax.Array) -> jax.Array:
+    """[..., K1] outer key mask → [..., K1*K2] flat key mask."""
+    k2 = state.m.dkeys.shape[-1] // _n_keys1(state)
+    return jnp.repeat(key1_mask, k2, axis=-1)
+
+
+def _replay_outer(state: NestedMapState) -> NestedMapState:
+    """Replay parked outer keyset-removes against the content slab, then
+    drop slots the top has caught up to."""
+    tmp = state.m._replace(
+        dcl=state.odcl,
+        dkeys=_expand1(state, state.odkeys),
+        dvalid=state.odvalid,
+    )
+    replayed = core_ops._apply_parked(tmp)
+    still = ~jnp.all(state.odcl <= state.m.top[..., None, :], axis=-1)
+    odvalid = state.odvalid & still
+    return NestedMapState(
+        m=state.m._replace(child=_canon_child(replayed.child)),
+        odcl=jnp.where(odvalid[..., None], state.odcl, 0),
+        odkeys=state.odkeys & odvalid[..., None],
+        odvalid=odvalid,
+    )
+
+
+def _scrub_dead_keys(state: NestedMapState) -> NestedMapState:
+    """A bottomed child map is deleted by the oracle together with its
+    parked inner removes (``Map.is_bottom``); clear inner parked masks on
+    K1 rows holding no live content, drop emptied slots. The outer
+    buffer belongs to the outer map and is never scrubbed."""
+    k1 = _n_keys1(state)
+    k2 = state.m.dkeys.shape[-1] // k1
+    alive = jnp.any(
+        state.m.child.valid.reshape(*state.m.child.valid.shape[:-2], k1, k2, -1),
+        axis=(-2, -1),
+    )  # [..., K1]
+    acols = jnp.repeat(alive, k2, axis=-1)
+    dkeys = state.m.dkeys & acols[..., None, :]
+    dvalid = state.m.dvalid & jnp.any(dkeys, axis=-1)
+    return state._replace(
+        m=state.m._replace(
+            dcl=jnp.where(dvalid[..., None], state.m.dcl, 0),
+            dkeys=dkeys & dvalid[..., None],
+            dvalid=dvalid,
+        )
+    )
+
+
+@jax.jit
+def join(a: NestedMapState, b: NestedMapState):
+    """Pairwise lattice join: the flat map join over K1*K2 keys plus the
+    outer buffer union/replay/compaction and the dead-key scrub. Returns
+    ``(state, overflow[3])`` — [sibling-slab, inner-deferred,
+    outer-deferred] (slab/inner lanes conservative as in ops.map)."""
+    m, mf = core_ops.join(a.m, b.m)  # mf = [sibling, inner-deferred]
+
+    odcl = jnp.concatenate([a.odcl, b.odcl], axis=-2)
+    odkeys = jnp.concatenate([a.odkeys, b.odkeys], axis=-2)
+    odvalid = jnp.concatenate([a.odvalid, b.odvalid], axis=-1)
+    odcl, odkeys, odvalid = _dedupe_deferred(odcl, odkeys, odvalid)
+    state = NestedMapState(m=m, odcl=odcl, odkeys=odkeys, odvalid=odvalid)
+    state = _replay_outer(state)
+    odcl, odkeys, odvalid, outer_of = _compact_deferred(
+        state.odcl, state.odkeys, state.odvalid, a.odcl.shape[-2]
+    )
+    state = _scrub_dead_keys(
+        state._replace(odcl=odcl, odkeys=odkeys, odvalid=odvalid)
+    )
+    return state, jnp.stack([mf[0], mf[1], jnp.any(outer_of)])
+
+
+def fold(states: NestedMapState):
+    """Log-tree fold of a replica batch (leading axis)."""
+    from .lattice import tree_fold
+
+    k1 = states.odkeys.shape[-1]
+    k2 = states.m.dkeys.shape[-1] // k1
+    identity = empty(
+        k1, k2,
+        states.m.top.shape[-1],
+        states.m.child.wact.shape[-1],
+        states.odcl.shape[-2],
+    )
+    return tree_fold(states, identity, join)
+
+
+@jax.jit
+def apply_put(
+    state: NestedMapState,
+    actor: jax.Array,
+    counter: jax.Array,
+    key1: jax.Array,
+    key2: jax.Array,
+    put_clock: jax.Array,
+    val: jax.Array,
+):
+    """``Op::Up { dot, k1, op: Up { dot, k2, op: Put } }`` — both Up
+    levels share the one minted dot. Returns ``(state, overflow)``."""
+    k2n = state.m.dkeys.shape[-1] // _n_keys1(state)
+    flat_key = key1 * k2n + key2
+    m, overflow = core_ops.apply_up(
+        state.m, actor, counter, flat_key, put_clock, val
+    )
+    out = _scrub_dead_keys(_replay_outer(state._replace(m=m)))
+    return out, overflow
+
+
+@jax.jit
+def apply_inner_rm(
+    state: NestedMapState,
+    actor: jax.Array,
+    counter: jax.Array,
+    key1: jax.Array,
+    rm_clock: jax.Array,
+    key2_mask: jax.Array,
+):
+    """``Op::Up { dot, k1, op: Rm { clock, keyset2 } }`` — an inner map
+    keyset-remove routed through the outer map: kill covered content at
+    (k1, keyset2) (parking in the INNER buffer if ahead), then witness
+    the Up's dot. Returns ``(state, overflow)``."""
+    counter = counter.astype(state.m.top.dtype)
+    seen = state.m.top[..., actor] >= counter
+    k1n = _n_keys1(state)
+    k2n = state.m.dkeys.shape[-1] // k1n
+    fmask = (
+        jax.nn.one_hot(key1, k1n, dtype=bool)[..., :, None]
+        & key2_mask[..., None, :]
+    ).reshape(*key2_mask.shape[:-1], k1n * k2n)
+    rmed, overflow = core_ops.apply_rm(state.m, rm_clock, fmask)
+    top = rmed.top.at[..., actor].max(counter)
+    m = core_ops._drop_stale_deferred(
+        core_ops._apply_parked(rmed._replace(top=top))
+    )
+    m = m._replace(child=_canon_child(m.child))
+    out = _scrub_dead_keys(_replay_outer(state._replace(m=m)))
+    # A dup dot drops the whole Up (pure/map.py ``apply`` returns early).
+    bshape = lambda new: seen.reshape(seen.shape + (1,) * (new.ndim - seen.ndim))
+    out = jax.tree.map(
+        lambda old, new: jnp.where(bshape(new), old, new), state, out
+    )
+    return out, overflow & ~seen
+
+
+@jax.jit
+def apply_key1_rm(state: NestedMapState, rm_clock: jax.Array, key1_mask: jax.Array):
+    """``Op::Rm { clock, keyset }`` on the outer map: kill covered
+    content across the masked K1 rows now; park in the OUTER buffer if
+    the clock is ahead. Returns ``(state, overflow)``."""
+    rm_clock = jnp.asarray(rm_clock, state.m.top.dtype)
+    fmask = _expand1(state, key1_mask)
+    valid = _rm_covered(state.m.child, rm_clock, fmask)
+    child = _canon_child(state.m.child._replace(valid=valid))
+
+    ahead = ~jnp.all(rm_clock <= state.m.top, axis=-1)
+    odcl, odkeys, odvalid, overflow = _park_remove(
+        state.odcl, state.odkeys, state.odvalid, rm_clock, key1_mask, ahead
+    )
+    out = _scrub_dead_keys(
+        NestedMapState(
+            m=state.m._replace(child=child),
+            odcl=odcl,
+            odkeys=odkeys,
+            odvalid=odvalid,
+        )
+    )
+    return out, overflow

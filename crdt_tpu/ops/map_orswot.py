@@ -1,0 +1,243 @@
+"""Dense batched kernels for ``Map<K, Orswot<M>>`` — slab composition.
+
+Oracle: ``crdt_tpu.pure.map.Map`` with ``Orswot`` children (reference:
+src/map.rs ``Map<K, V: Val<A>, A>`` with an orswot value type —
+SURVEY.md §3 row 11's ``V: Val<A>`` genericity). Under the causal-
+composition rule (pure/map.py module docstring) every child orswot's top
+clock equals the map's top clock, so the child tops need no storage and
+the composed state is *structurally an ORSWOT over the product space
+K × M*: one birth-clock slab ``ctr[..., K*M, A]`` under one top. This is
+SURVEY.md §7.1's "nesting by composition of slabs, not recursion at
+trace time": the nested join IS the flat orswot join over a bigger
+element axis — no new kernel math, no trace-time recursion.
+
+What *is* new is the second deferred buffer: outer key-removes
+(``Op::Rm { clock, keyset }``) park masks over K while inner orswot
+removes (routed via ``Op::Up``) park masks over K×M. Both replay with
+the same covered-dot rule, but they must stay distinct so device state
+round-trips losslessly to the oracle's ``map.deferred`` (keysets) vs
+``child.deferred`` (membersets) — the A/B gate in
+tests/test_models_map_nested.py checks exactly that.
+
+State: ``core`` is a plain ``OrswotState`` with E = K*M (top, ctr, and
+the inner deferred buffer); ``kdcl/kdkeys/kdvalid`` are the outer parked
+keyset-removes.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import orswot as core_ops
+from .orswot import (
+    OrswotState,
+    _apply_parked,
+    _compact_deferred,
+    _dedupe_deferred,
+    _park_remove,
+)
+
+DTYPE = jnp.uint32
+
+
+class MapOrswotState(NamedTuple):
+    """A (possibly batched) dense Map<K, Orswot<M>> replica (pytree)."""
+
+    core: OrswotState  # top [..., A]; ctr [..., K*M, A]; inner deferred
+    kdcl: jax.Array    # [..., D, A]  outer parked rm clocks
+    kdkeys: jax.Array  # [..., D, K]  outer parked keysets
+    kdvalid: jax.Array # [..., D]
+
+
+def empty(
+    n_keys: int,
+    n_members: int,
+    n_actors: int,
+    deferred_cap: int = 4,
+    batch: tuple = (),
+) -> MapOrswotState:
+    """The join identity."""
+    return MapOrswotState(
+        core=core_ops.empty(
+            n_keys * n_members, n_actors, deferred_cap, batch=batch
+        ),
+        kdcl=jnp.zeros((*batch, deferred_cap, n_actors), DTYPE),
+        kdkeys=jnp.zeros((*batch, deferred_cap, n_keys), bool),
+        kdvalid=jnp.zeros((*batch, deferred_cap), bool),
+    )
+
+
+def _n_keys(state: MapOrswotState) -> int:
+    return state.kdkeys.shape[-1]
+
+
+def _expand_keys(state: MapOrswotState, key_mask: jax.Array) -> jax.Array:
+    """[..., K] key mask → [..., K*M] element mask (all members)."""
+    m = state.core.ctr.shape[-2] // _n_keys(state)
+    return jnp.repeat(key_mask, m, axis=-1)
+
+
+def _replay_outer(state: MapOrswotState) -> MapOrswotState:
+    """Replay parked keyset-removes against the slab, then drop slots the
+    top has caught up to (the oracle's ``_apply_deferred``)."""
+    emask = _expand_keys(state, state.kdkeys)
+    ctr = _apply_parked(state.core.ctr, state.kdcl, emask, state.kdvalid)
+    still_ahead = ~jnp.all(
+        state.kdcl <= state.core.top[..., None, :], axis=-1
+    )
+    kdvalid = state.kdvalid & still_ahead
+    return MapOrswotState(
+        core=state.core._replace(ctr=ctr),
+        kdcl=jnp.where(kdvalid[..., None], state.kdcl, 0),
+        kdkeys=state.kdkeys & kdvalid[..., None],
+        kdvalid=kdvalid,
+    )
+
+
+def _scrub_dead_keys(state: MapOrswotState) -> MapOrswotState:
+    """A memberless child is deleted by the oracle — together with its
+    parked inner removes (``Orswot.is_bottom`` counts live members only,
+    and ``Map`` drops bottom children after every apply/merge). Mirror:
+    clear inner parked masks on keys holding no live dot, drop slots
+    whose masks empty out. Outer parked keyset-removes belong to the map
+    itself and are never scrubbed."""
+    k = _n_keys(state)
+    m = state.core.ctr.shape[-2] // k
+    alive = jnp.any(
+        state.core.ctr.reshape(*state.core.ctr.shape[:-2], k, m, -1) > 0,
+        axis=(-2, -1),
+    )  # [..., K]
+    acols = jnp.repeat(alive, m, axis=-1)  # [..., K*M]
+    dmask = state.core.dmask & acols[..., None, :]
+    dvalid = state.core.dvalid & jnp.any(dmask, axis=-1)
+    return state._replace(
+        core=state.core._replace(
+            dcl=jnp.where(dvalid[..., None], state.core.dcl, 0),
+            dmask=dmask & dvalid[..., None],
+            dvalid=dvalid,
+        )
+    )
+
+
+@jax.jit
+def join(a: MapOrswotState, b: MapOrswotState):
+    """Pairwise lattice join: the flat orswot join over K*M elements plus
+    the union/replay/compaction of the outer keyset buffer. Returns
+    ``(state, overflow[2])`` — lanes [inner-deferred, outer-deferred].
+
+    (The core join's inner-overflow flag is conservative here: it counts
+    parked slots before dead-key scrubbing, so a buffer transiently full
+    of dead-key slots can flag where the oracle would not.)"""
+    core, inner_of = core_ops.join(a.core, b.core)
+
+    kdcl = jnp.concatenate([a.kdcl, b.kdcl], axis=-2)
+    kdkeys = jnp.concatenate([a.kdkeys, b.kdkeys], axis=-2)
+    kdvalid = jnp.concatenate([a.kdvalid, b.kdvalid], axis=-1)
+    kdcl, kdkeys, kdvalid = _dedupe_deferred(kdcl, kdkeys, kdvalid)
+    state = MapOrswotState(core=core, kdcl=kdcl, kdkeys=kdkeys, kdvalid=kdvalid)
+    state = _replay_outer(state)
+    kdcl, kdkeys, kdvalid, outer_of = _compact_deferred(
+        state.kdcl, state.kdkeys, state.kdvalid, a.kdcl.shape[-2]
+    )
+    state = _scrub_dead_keys(
+        state._replace(kdcl=kdcl, kdkeys=kdkeys, kdvalid=kdvalid)
+    )
+    return state, jnp.stack([jnp.any(inner_of), jnp.any(outer_of)])
+
+
+def fold(states: MapOrswotState):
+    """Log-tree fold of a replica batch (leading axis)."""
+    from .lattice import tree_fold
+
+    k = states.kdkeys.shape[-1]
+    m = states.core.ctr.shape[-2] // k
+    identity = empty(
+        k, m, states.core.top.shape[-1], states.kdcl.shape[-2]
+    )
+    return tree_fold(states, identity, join)
+
+
+@jax.jit
+def apply_member_add(
+    state: MapOrswotState,
+    actor: jax.Array,
+    counter: jax.Array,
+    key: jax.Array,
+    member_mask: jax.Array,
+) -> MapOrswotState:
+    """``Op::Up { dot, key, op: Add { dot, members } }`` — the inner add
+    shares the Up's dot (both minted from one AddCtx). Dup dots drop the
+    whole op (pure/map.py ``apply``); parked removes replay after."""
+    k = _n_keys(state)
+    m = state.core.ctr.shape[-2] // k
+    emask = (jax.nn.one_hot(key, k, dtype=bool)[..., :, None] & member_mask[..., None, :]).reshape(
+        *member_mask.shape[:-1], k * m
+    )
+    core = core_ops.apply_add(state.core, actor, counter, emask)
+    return _scrub_dead_keys(_replay_outer(state._replace(core=core)))
+
+
+@jax.jit
+def apply_member_rm(
+    state: MapOrswotState,
+    actor: jax.Array,
+    counter: jax.Array,
+    key: jax.Array,
+    rm_clock: jax.Array,
+    member_mask: jax.Array,
+):
+    """``Op::Up { dot, key, op: Rm { clock, members } }`` — an inner
+    orswot remove routed through the map: kill covered dots of the key's
+    masked members (parking in the INNER buffer if ahead), then witness
+    the Up's dot on the top clock. Returns ``(state, overflow)``."""
+    counter = counter.astype(state.core.top.dtype)
+    seen = state.core.top[..., actor] >= counter
+    k = _n_keys(state)
+    m = state.core.ctr.shape[-2] // k
+    emask = (
+        jax.nn.one_hot(key, k, dtype=bool)[..., :, None]
+        & member_mask[..., None, :]
+    ).reshape(*member_mask.shape[:-1], k * m)
+    rmed, overflow = core_ops.apply_rm(state.core, rm_clock, emask)
+    top = rmed.top.at[..., actor].max(counter)
+    # Advancing the top may un-park inner and outer removes: replay both.
+    ctr = _apply_parked(rmed.ctr, rmed.dcl, rmed.dmask, rmed.dvalid)
+    still = ~jnp.all(rmed.dcl <= top[..., None, :], axis=-1)
+    core = rmed._replace(top=top, ctr=ctr, dvalid=rmed.dvalid & still)
+    out = _scrub_dead_keys(_replay_outer(state._replace(core=core)))
+    # A dup dot drops the whole Up (pure/map.py ``apply`` returns early —
+    # nothing applied, nothing parked).
+    bshape = lambda new: seen.reshape(seen.shape + (1,) * (new.ndim - seen.ndim))
+    out = jax.tree.map(
+        lambda old, new: jnp.where(bshape(new), old, new), state, out
+    )
+    return out, overflow & ~seen
+
+
+@jax.jit
+def apply_key_rm(state: MapOrswotState, rm_clock: jax.Array, key_mask: jax.Array):
+    """``Op::Rm { clock, keyset }`` (reference: src/map.rs
+    ``apply_keyset_rm``): kill covered dots across the masked keys' whole
+    member rows now; park in the OUTER buffer if the clock is ahead.
+    Returns ``(state, overflow)``."""
+    rm_clock = jnp.asarray(rm_clock, state.core.top.dtype)
+    emask = _expand_keys(state, key_mask)
+    dominated = emask[..., :, None] & (state.core.ctr <= rm_clock[..., None, :])
+    ctr = jnp.where(dominated, jnp.zeros_like(state.core.ctr), state.core.ctr)
+
+    ahead = ~jnp.all(rm_clock <= state.core.top, axis=-1)
+    kdcl, kdkeys, kdvalid, overflow = _park_remove(
+        state.kdcl, state.kdkeys, state.kdvalid, rm_clock, key_mask, ahead
+    )
+    out = _scrub_dead_keys(
+        MapOrswotState(
+            core=state.core._replace(ctr=ctr),
+            kdcl=kdcl,
+            kdkeys=kdkeys,
+            kdvalid=kdvalid,
+        )
+    )
+    return out, overflow

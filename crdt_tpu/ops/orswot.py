@@ -186,6 +186,34 @@ def apply_add(state: OrswotState, actor: jax.Array, counter: jax.Array, member_m
     return state._replace(top=top, ctr=ctr, dvalid=state.dvalid & still_ahead)
 
 
+def _park_remove(dcl, dmask, dvalid, rm_clock, payload_mask, ahead):
+    """Park an ahead remove: union its payload onto an equal-clock slot,
+    else claim the first free slot (the oracle's ``_defer_remove``
+    dict-union). Shared by every deferred buffer (orswot members, map
+    keysets, nested outer keysets). Returns ``(dcl, dmask, dvalid,
+    overflow)``; overflow is True where an ahead remove found neither an
+    equal-clock slot nor a free one."""
+    same = dvalid & jnp.all(dcl == rm_clock[..., None, :], axis=-1)
+    has_same = jnp.any(same, axis=-1)
+    free = ~dvalid
+    has_free = jnp.any(free, axis=-1)
+    slot = jnp.where(
+        has_same, jnp.argmax(same, axis=-1), jnp.argmax(free, axis=-1)
+    )
+    park = ahead & (has_same | has_free)
+    overflow = ahead & ~has_same & ~has_free
+
+    d = dvalid.shape[-1]
+    onehot = jax.nn.one_hot(slot, d, dtype=bool) & park[..., None]
+    new_dcl = jnp.where(onehot[..., None], rm_clock[..., None, :], dcl)
+    # Union only live payload (a free slot may hold a stale mask).
+    live = dmask & dvalid[..., None]
+    new_dmask = jnp.where(
+        onehot[..., None], payload_mask[..., None, :] | live, dmask
+    )
+    return new_dcl, new_dmask, dvalid | onehot, overflow
+
+
 @jax.jit
 def apply_rm(state: OrswotState, rm_clock: jax.Array, member_mask: jax.Array):
     """CmRDT rm-op application (reference: src/orswot.rs apply_rm): always
@@ -198,22 +226,9 @@ def apply_rm(state: OrswotState, rm_clock: jax.Array, member_mask: jax.Array):
     ctr = jnp.where(dominated, jnp.zeros_like(state.ctr), state.ctr)
 
     ahead = ~jnp.all(rm_clock <= state.top, axis=-1)
-    same = state.dvalid & jnp.all(state.dcl == rm_clock[..., None, :], axis=-1)
-    has_same = jnp.any(same, axis=-1)
-    free = ~state.dvalid
-    first_free = jnp.argmax(free, axis=-1)
-    has_free = jnp.any(free, axis=-1)
-    slot = jnp.where(has_same, jnp.argmax(same, axis=-1), first_free)
-    park = ahead & (has_same | has_free)
-    overflow = ahead & ~has_same & ~has_free
-
-    d = state.dvalid.shape[-1]
-    onehot = jax.nn.one_hot(slot, d, dtype=bool) & park[..., None]
-    dcl = jnp.where(onehot[..., None], rm_clock[..., None, :], state.dcl)
-    # Union only live payload (a free slot may hold a stale mask).
-    live = state.dmask & state.dvalid[..., None]
-    dmask = jnp.where(onehot[..., None], member_mask[..., None, :] | live, state.dmask)
-    dvalid = state.dvalid | onehot
+    dcl, dmask, dvalid, overflow = _park_remove(
+        state.dcl, state.dmask, state.dvalid, rm_clock, member_mask, ahead
+    )
     return (
         OrswotState(top=state.top, ctr=ctr, dcl=dcl, dmask=dmask, dvalid=dvalid),
         overflow,

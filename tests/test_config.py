@@ -24,7 +24,10 @@ def test_backend_selects_execution_path():
 
 
 def test_all_kinds_construct_under_both_backends():
-    kinds = ["orswot", "map", "gcounter", "pncounter", "gset", "lwwreg", "mvreg"]
+    kinds = [
+        "orswot", "map", "map_orswot", "map_map",
+        "gcounter", "pncounter", "gset", "lwwreg", "mvreg",
+    ]
     with configured(backend="pure"):
         for kind in kinds:
             assert len(replicaset(kind, 2)) == 2

@@ -1,0 +1,415 @@
+"""Batched Map<K, Orswot> vs the oracle — the A/B gate for Val-generic
+slab composition (reference: src/map.rs ``V: Val<A>``; SURVEY.md §7.1
+"one slab per value type")."""
+
+import random
+
+import pytest
+from hypothesis import given, settings
+
+from crdt_tpu import Map, Orswot, VClock
+from crdt_tpu.ctx import RmCtx
+from crdt_tpu.models import BatchedMapOrswot
+from crdt_tpu.utils import Interner
+
+from strategies import ACTORS, seeds
+from test_map import drop, sadd, set_map
+
+KEYS = list("pq")
+MEMBERS = list("xyz")
+
+
+def srm(m, actor, key, member):
+    """Inner orswot remove routed through the map (``Op::Up`` carrying
+    ``Orswot::Rm``)."""
+    child = m.entries.get(key)
+    rm_ctx = (
+        child.contains(member).derive_rm_ctx()
+        if child is not None
+        else RmCtx(clock=VClock())
+    )
+    add_ctx = m.len().derive_add_ctx(actor)
+    op = m.update(key, add_ctx, lambda s, c: s.rm(member, rm_ctx))
+    m.apply(op)
+    return op
+
+
+def _interners():
+    return (
+        Interner(KEYS),
+        Interner(MEMBERS),
+        Interner(ACTORS + ["A", "B", "C"]),
+    )
+
+
+def _batched(states, deferred_cap=12):
+    keys, members, actors = _interners()
+    return BatchedMapOrswot.from_pure(
+        states, deferred_cap=deferred_cap,
+        keys=keys, members=members, actors=actors,
+    )
+
+
+def _site_run_set(rng, n_cmds=12):
+    sites = {a: set_map() for a in ACTORS[:3]}
+    for _ in range(n_cmds):
+        actor = rng.choice(list(sites))
+        site = sites[actor]
+        roll = rng.random()
+        key = rng.choice(KEYS)
+        member = rng.choice(MEMBERS)
+        if roll < 0.35:
+            sadd(site, actor, key, member)
+        elif roll < 0.55:
+            srm(site, actor, key, member)
+        elif roll < 0.75:
+            drop(site, key)
+        else:
+            site.merge(sites[rng.choice(list(sites))].clone())
+    return list(sites.values())
+
+
+@given(seeds)
+@settings(max_examples=15)
+def test_join_bit_identical_to_oracle_merge(seed):
+    rng = random.Random(seed)
+    states = _site_run_set(rng)
+    batched = _batched(states)
+
+    expect = states[0].clone()
+    expect.merge(states[1].clone())
+    batched.merge_from(0, 1)
+    assert batched.to_pure(0) == expect
+
+    # round-trip of untouched replicas is lossless
+    assert batched.to_pure(2) == states[2]
+
+
+@given(seeds)
+@settings(max_examples=12)
+def test_fold_bit_identical_to_oracle_fold(seed):
+    rng = random.Random(seed)
+    states = _site_run_set(rng, n_cmds=16)
+    batched = _batched(states)
+
+    expect = states[0].clone()
+    for s in states[1:]:
+        expect.merge(s.clone())
+    assert batched.fold() == expect
+
+
+@given(seeds)
+@settings(max_examples=10)
+def test_op_path_bit_identical(seed):
+    rng = random.Random(seed)
+    # Mint on an oracle site; deliver the same stream to an oracle replica
+    # and a device replica (removes may arrive ahead → both deferred
+    # buffers exercised).
+    site = set_map()
+    stream = []
+    for _ in range(14):
+        key = rng.choice(KEYS)
+        member = rng.choice(MEMBERS)
+        roll = rng.random()
+        if roll < 0.45:
+            stream.append(sadd(site, rng.choice(ACTORS), key, member))
+        elif roll < 0.7:
+            stream.append(srm(site, rng.choice(ACTORS), key, member))
+        else:
+            stream.append(drop(site, key))
+    oracle = set_map()
+    device = _batched([set_map()])
+    for op in stream:
+        oracle.apply(op)
+        device.apply(0, op)
+        assert device.to_pure(0) == oracle
+
+
+@given(seeds)
+@settings(max_examples=8)
+def test_device_join_laws(seed):
+    rng = random.Random(seed)
+    a, b, c = _site_run_set(rng)
+
+    ab = _batched([a, b]); ab.merge_from(0, 1)
+    ba = _batched([b, a]); ba.merge_from(0, 1)
+    assert ab.to_pure(0) == ba.to_pure(0), "device join not commutative"
+
+    abc1 = _batched([a, b, c]); abc1.merge_from(0, 1); abc1.merge_from(0, 2)
+    abc2 = _batched([b, c, a]); abc2.merge_from(0, 1); abc2.merge_from(0, 2)
+    assert abc1.to_pure(0) == abc2.to_pure(0), "device join not associative"
+
+    aa = _batched([a, a]); aa.merge_from(0, 1)
+    assert aa.to_pure(0) == a, "device join not idempotent"
+
+
+def test_concurrent_add_wins_over_key_remove_on_device():
+    a, b = set_map(), set_map()
+    op = sadd(a, "A", "p", "x")
+    b.apply(op)
+    rm_op = a.rm("p", a.get("p").derive_rm_ctx())
+    a.apply(rm_op)
+    up_op = sadd(b, "B", "p", "y")
+
+    device = _batched([set_map(), set_map()])
+    device.apply(0, op)
+    device.apply(1, op)
+    device.apply(0, rm_op)
+    device.apply(1, up_op)
+    device.merge_from(0, 1)
+
+    a.merge(b.clone())
+    assert device.to_pure(0) == a
+    child = device.to_pure(0).get("p").val
+    assert child is not None and child.members() == frozenset({"y"})
+
+
+def test_outer_remove_parks_and_replays_on_device():
+    a = set_map()
+    up = sadd(a, "A", "p", "x")
+    rm_op = a.rm("p", a.get("p").derive_rm_ctx())
+
+    oracle = set_map()
+    device = _batched([set_map()])
+    for op in (rm_op, up):  # remove first: must park (outer), then replay
+        oracle.apply(op)
+        device.apply(0, op)
+    assert oracle.deferred == {} and oracle.get("p").val is None
+    assert device.to_pure(0) == oracle
+
+
+def test_inner_remove_parks_and_replays_on_device():
+    a = set_map()
+    up = sadd(a, "A", "p", "x")
+    inner_rm = srm(a, "A", "p", "x")  # observes (A,1); Up dot (A,2)
+
+    oracle = set_map()
+    device = _batched([set_map()])
+    # Deliver the inner remove before the add it covers: the remove's
+    # clock is ahead, so it parks in the child (inner buffer), then the
+    # add lands and the replay kills x.
+    for op in (inner_rm, up):
+        oracle.apply(op)
+        device.apply(0, op)
+        assert device.to_pure(0) == oracle
+    child = oracle.get("p").val
+    assert child is None or "x" not in child.members()
+
+
+def test_dead_key_drops_inner_parked_removes():
+    # A live child holding a PARKED inner remove bottoms out via an outer
+    # remove: the oracle deletes the child together with its parked
+    # remove, so recreating the key later must not see a stale kill. The
+    # device scrub (_scrub_dead_keys) has to clear the parked mask.
+    site1, site2, site3 = set_map(), set_map(), set_map()
+    op_ax = sadd(site1, "A", "p", "x")        # dot (A,1)
+    op_by = sadd(site2, "B", "p", "y")        # dot (B,1)
+    site2.apply(op_ax)
+    op_brm = srm(site2, "B", "p", "x")        # Up (B,2), rm clock {A:1}
+    site3.apply(op_by)
+    op_crm = site3.rm("p", site3.get("p").derive_rm_ctx())  # clock {B:1}
+
+    oracle = set_map()
+    device = _batched([set_map()])
+    # op_by: child p live with y. op_brm: rm clock {A:1} is ahead of top
+    # {B:2} → parks INNER with the child alive. op_crm: covered → applied
+    # now, kills y → child bottoms → parked inner remove must vanish.
+    # op_ax: recreates p with x; a stale parked mask would kill x.
+    for op in (op_by, op_brm, op_crm, op_ax):
+        oracle.apply(op)
+        device.apply(0, op)
+        assert device.to_pure(0) == oracle
+    child = oracle.get("p").val
+    assert child is not None and child.members() == frozenset({"x"})
+    dev_child = device.to_pure(0).get("p").val
+    assert dev_child is not None and dev_child.members() == frozenset({"x"})
+
+
+def test_round_trip_lossless():
+    rng = random.Random(7)
+    states = _site_run_set(rng, n_cmds=18)
+    batched = _batched(states)
+    for i, s in enumerate(states):
+        assert batched.to_pure(i) == s
+
+
+def test_outer_deferred_overflow_raises():
+    from crdt_tpu.models.orswot import DeferredOverflow
+
+    device = _batched([set_map()], deferred_cap=1)
+    site = set_map()
+    sadd(site, "A", "p", "x")
+    sadd(site, "A", "q", "y")
+    rm1 = site.rm("p", site.get("p").derive_rm_ctx())
+    rm2 = site.rm("q", site.get("q").derive_rm_ctx())
+    device.apply(0, rm1)  # parks (ahead of empty view)
+    with pytest.raises(DeferredOverflow):
+        device.apply(0, rm2)  # distinct clock, buffer full
+
+
+# ---- Map<K1, Map<K2, MVReg>> (BatchedNestedMap) --------------------------
+
+from crdt_tpu import MVReg
+from crdt_tpu.models import BatchedNestedMap
+from test_map import nested_map
+
+
+def nput(m, actor, k1, k2, val):
+    """Nested put: outer Up and inner Up share one AddCtx."""
+    ctx = m.len().derive_add_ctx(actor)
+    op = m.update(
+        k1, ctx, lambda child, c: child.update(k2, c, lambda reg, c2: reg.write(val, c2))
+    )
+    m.apply(op)
+    return op
+
+
+def ndrop2(m, actor, k1, k2):
+    """Inner keyset-remove routed through the outer map."""
+    child = m.entries.get(k1)
+    rm_ctx = (
+        child.get(k2).derive_rm_ctx()
+        if child is not None
+        else RmCtx(clock=VClock())
+    )
+    ctx = m.len().derive_add_ctx(actor)
+    op = m.update(k1, ctx, lambda c_, c: c_.rm(k2, rm_ctx))
+    m.apply(op)
+    return op
+
+
+def ndrop1(m, k1):
+    op = m.rm(k1, m.get(k1).derive_rm_ctx())
+    m.apply(op)
+    return op
+
+
+NCAPS = dict(sibling_cap=8, deferred_cap=12)
+
+
+def _nbatched(states, **caps):
+    kw = dict(NCAPS)
+    kw.update(caps)
+    return BatchedNestedMap.from_pure(
+        states,
+        keys1=Interner(KEYS),
+        keys2=Interner(MEMBERS),
+        actors=Interner(ACTORS + ["A", "B", "C"]),
+        **kw,
+    )
+
+
+def _site_run_nested(rng, n_cmds=12):
+    sites = {a: nested_map() for a in ACTORS[:3]}
+    for _ in range(n_cmds):
+        actor = rng.choice(list(sites))
+        site = sites[actor]
+        roll = rng.random()
+        k1 = rng.choice(KEYS)
+        k2 = rng.choice(MEMBERS)
+        if roll < 0.4:
+            nput(site, actor, k1, k2, rng.randrange(5))
+        elif roll < 0.6:
+            ndrop2(site, actor, k1, k2)
+        elif roll < 0.75:
+            ndrop1(site, k1)
+        else:
+            site.merge(sites[rng.choice(list(sites))].clone())
+    return list(sites.values())
+
+
+@given(seeds)
+@settings(max_examples=12)
+def test_nested_join_bit_identical(seed):
+    rng = random.Random(seed)
+    states = _site_run_nested(rng)
+    batched = _nbatched(states)
+
+    expect = states[0].clone()
+    expect.merge(states[1].clone())
+    batched.merge_from(0, 1)
+    assert batched.to_pure(0) == expect
+    assert batched.to_pure(2) == states[2]
+
+
+@given(seeds)
+@settings(max_examples=10)
+def test_nested_fold_bit_identical(seed):
+    rng = random.Random(seed)
+    states = _site_run_nested(rng, n_cmds=15)
+    batched = _nbatched(states)
+
+    expect = states[0].clone()
+    for s in states[1:]:
+        expect.merge(s.clone())
+    assert batched.fold() == expect
+
+
+@given(seeds)
+@settings(max_examples=8)
+def test_nested_op_path_bit_identical(seed):
+    rng = random.Random(seed)
+    site = nested_map()
+    stream = []
+    for _ in range(12):
+        k1, k2 = rng.choice(KEYS), rng.choice(MEMBERS)
+        roll = rng.random()
+        if roll < 0.5:
+            stream.append(nput(site, rng.choice(ACTORS), k1, k2, rng.randrange(5)))
+        elif roll < 0.75:
+            stream.append(ndrop2(site, rng.choice(ACTORS), k1, k2))
+        else:
+            stream.append(ndrop1(site, k1))
+    oracle = nested_map()
+    device = _nbatched([nested_map()])
+    for op in stream:
+        oracle.apply(op)
+        device.apply(0, op)
+        assert device.to_pure(0) == oracle
+
+
+@given(seeds)
+@settings(max_examples=6)
+def test_nested_device_join_laws(seed):
+    rng = random.Random(seed)
+    a, b, c = _site_run_nested(rng)
+
+    ab = _nbatched([a, b]); ab.merge_from(0, 1)
+    ba = _nbatched([b, a]); ba.merge_from(0, 1)
+    assert ab.to_pure(0) == ba.to_pure(0), "nested device join not commutative"
+
+    abc1 = _nbatched([a, b, c]); abc1.merge_from(0, 1); abc1.merge_from(0, 2)
+    abc2 = _nbatched([b, c, a]); abc2.merge_from(0, 1); abc2.merge_from(0, 2)
+    assert abc1.to_pure(0) == abc2.to_pure(0), "nested device join not associative"
+
+    aa = _nbatched([a, a]); aa.merge_from(0, 1)
+    assert aa.to_pure(0) == a, "nested device join not idempotent"
+
+
+def test_nested_concurrent_put_wins_over_outer_remove():
+    a, b = nested_map(), nested_map()
+    op = nput(a, "A", "p", "x", 1)
+    b.apply(op)
+    rm_op = a.rm("p", a.get("p").derive_rm_ctx())
+    a.apply(rm_op)
+    up_op = nput(b, "B", "p", "y", 2)
+
+    device = _nbatched([nested_map(), nested_map()])
+    device.apply(0, op)
+    device.apply(1, op)
+    device.apply(0, rm_op)
+    device.apply(1, up_op)
+    device.merge_from(0, 1)
+
+    a.merge(b.clone())
+    assert device.to_pure(0) == a
+    child = device.to_pure(0).get("p").val
+    assert child is not None and child.get("y").val.read().val == [2]
+
+
+def test_nested_round_trip_lossless():
+    rng = random.Random(11)
+    states = _site_run_nested(rng, n_cmds=18)
+    batched = _nbatched(states)
+    for i, s in enumerate(states):
+        assert batched.to_pure(i) == s
