@@ -30,7 +30,9 @@ class Config:
     # "xla"  — batched device-resident models (jit/vmap/pjit kernels).
     backend: str = "xla"
     # Raise ValidationError from validate_op before every apply (v7
-    # validation; pure backend only — the device path batches applies).
+    # validation) — on BOTH backends: the pure types validate per type,
+    # the batched models check dot contiguity against the replica's top
+    # clock (models/validation.py) at one device->host scalar per apply.
     strict: bool = False
     # Static capacities for the device models' slab shapes.
     deferred_cap: int = 8

@@ -18,6 +18,7 @@ from .registers import BatchedLWWReg, BatchedMVReg, SlotOverflow
 from .map import BatchedMap
 from .map_nested import BatchedMapOrswot, BatchedNestedMap
 from .list import BatchedList
+from .glist import BatchedGList
 
 __all__ = [
     "BatchedVClock",
@@ -31,5 +32,6 @@ __all__ = [
     "BatchedMapOrswot",
     "BatchedNestedMap",
     "BatchedList",
+    "BatchedGList",
     "SlotOverflow",
 ]

@@ -1,0 +1,129 @@
+"""BatchedGList — N device GList replicas over a shared identifier
+universe.
+
+Oracle: ``crdt_tpu.pure.glist.GList`` (reference: src/glist.rs). A GList
+is a grow-only ordered SET of identifiers, so the device form is even
+leaner than the List's: the shared universe (native engine, insert-only
+trace) fixes every identifier's slot in total order and its element
+payload, and a replica is just an ``alive bool[R, N]`` membership mask.
+Merge is set union — a single elementwise OR — and full-mesh
+anti-entropy over R replicas is ``alive.any(axis=0)``.
+
+Identifier allocation note: the engine mints LSEQ-style (index, actor,
+counter) tree paths while the pure ``between`` embeds the element as the
+final marker — allocation strategies are an implementation choice in
+the reference too, so the A/B gate (tests/test_glist_model.py) drives
+both sides with ENGINE-minted identifiers (via ``to_pure``-shaped ops)
+and checks sequence/merge/convergence behavior bit-identically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dot import OrdDot
+from ..native import INSERT, ListEngine
+from ..pure.glist import GList, Insert
+from ..pure.identifier import Identifier
+from .list import growth_permutation
+
+
+class BatchedGList:
+    def __init__(self, n_replicas: int):
+        self.engine = ListEngine()
+        self.slots = np.empty(0, np.int64)  # rank per handle
+        self.uvals = np.empty(0, np.int32)  # element payload per handle
+        self.alive = jnp.zeros((n_replicas, 1), bool)
+
+    @property
+    def n_replicas(self) -> int:
+        return self.alive.shape[0]
+
+    # ---- universe growth (identifier minting) -------------------------
+    def mint_inserts(
+        self,
+        indices: Sequence[int],
+        values: Sequence[int],
+        actors: Sequence[int],
+    ) -> np.ndarray:
+        """Mint identifiers for inserts at positions in the UNIVERSE
+        sequence (every identifier ever minted — grow-only, nothing
+        dies), growing the shared slot space. Returns the ops' handles;
+        deliver them to replicas with :meth:`apply_inserts`."""
+        kinds = np.full(len(indices), INSERT, np.uint8)
+        handles = self.engine.apply_trace(kinds, indices, values, actors)
+        self.uvals = np.concatenate(
+            [self.uvals, np.ascontiguousarray(values, np.int32)]
+        )
+        new_rank = self.engine.total_order()
+        src = growth_permutation(self.slots, new_rank)
+        self.alive = _remap_alive(self.alive, jnp.asarray(src))
+        self.slots = new_rank
+        return handles
+
+    # ---- op path (CmRDT: Insert delivery) -----------------------------
+    def apply_inserts(self, replica_handles: np.ndarray) -> None:
+        """One epoch: ``replica_handles[r]`` lists identifier handles
+        replica ``r`` receives (shape [R, C]; -1 pads). One scatter for
+        all replicas."""
+        replica_handles = np.asarray(replica_handles)
+        if replica_handles.ndim != 2 or replica_handles.shape[0] != self.n_replicas:
+            raise ValueError(f"expected [R={self.n_replicas}, C] handles")
+        valid = replica_handles >= 0
+        safe = np.where(valid, replica_handles, 0)
+        n = self.alive.shape[1]
+        slots = jnp.asarray(np.where(valid, self.slots[safe], n))
+        self.alive = self.alive.at[
+            jnp.arange(self.n_replicas)[:, None], slots
+        ].set(True, mode="drop")
+
+    # ---- state path (CvRDT: union merge) ------------------------------
+    def union_from(self, dst: int, src: int) -> None:
+        """Set-union merge (reference: src/glist.rs ``CvRDT::merge``)."""
+        self.alive = self.alive.at[dst].set(self.alive[dst] | self.alive[src])
+
+    def fold(self) -> np.ndarray:
+        """Full-mesh anti-entropy: the union of every replica's set."""
+        return np.asarray(jnp.any(self.alive, axis=0))
+
+    # ---- reads ---------------------------------------------------------
+    def read(self, replica: Optional[int] = None) -> list:
+        """The replica's element sequence (None = the folded union)."""
+        mask = (
+            self.fold() if replica is None else np.asarray(self.alive[replica])
+        )
+        if len(self.slots) == 0:
+            return []
+        vals_in_slot_order = np.empty(len(self.slots), np.int32)
+        vals_in_slot_order[self.slots] = self.uvals
+        return vals_in_slot_order[mask[: len(self.slots)]].tolist()
+
+    def identifier(self, handle: int) -> Identifier:
+        """The engine-minted identifier for a handle, in oracle form."""
+        path = self.engine.identifier_path(int(handle))
+        return Identifier(
+            tuple((ix, OrdDot(a, c)) for ix, a, c in path)
+        )
+
+    def to_pure(self, replica: Optional[int] = None) -> GList:
+        """Oracle form of one replica (None = the folded union) with the
+        engine's identifiers."""
+        mask = (
+            self.fold() if replica is None else np.asarray(self.alive[replica])
+        )
+        out = GList()
+        handle_of_slot = np.argsort(self.slots, kind="stable")
+        for slot in range(len(self.slots)):
+            if mask[slot]:
+                out.apply(Insert(id=self.identifier(handle_of_slot[slot])))
+        return out
+
+
+@jax.jit
+def _remap_alive(alive, src):
+    safe = jnp.where(src >= 0, src, 0)
+    return jnp.where(src[None, :] < 0, False, alive[:, safe])

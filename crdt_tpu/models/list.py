@@ -31,13 +31,29 @@ from ..pure.identifier import Identifier
 from ..pure.list import List
 
 
+def growth_permutation(old_slots: np.ndarray, new_rank: np.ndarray) -> np.ndarray:
+    """After the engine mints more identifiers, map the new total order
+    back onto the old one: ``src[s]`` is the old slot feeding new slot
+    ``s`` (-1 = freshly minted). Handles are stable and ordered
+    first-n-minted-first, so the old handles are ``new_rank[:n_old]``."""
+    src = np.full(len(new_rank), -1, np.int64)
+    src[new_rank[: len(old_slots)]] = old_slots
+    return src
+
+
 class BatchedList:
-    def __init__(self, n_replicas: int, engine: ListEngine, slots: np.ndarray):
-        self.engine = engine
-        self.slots = slots  # rank per identifier handle (total order)
-        n = len(slots)
-        self.vals = jnp.zeros((n_replicas, max(n, 1)), jnp.int32)
-        self.alive = jnp.zeros((n_replicas, max(n, 1)), bool)
+    def __init__(self, n_replicas: int):
+        self.engine = ListEngine()
+        # rank per identifier handle (the current total order)
+        self.slots = np.empty(0, np.int64)
+        self.vals = jnp.zeros((n_replicas, 1), jnp.int32)
+        self.alive = jnp.zeros((n_replicas, 1), bool)
+        # The op log: stable identifier handles (slots move when later
+        # inserts interleave the order; handles never do).
+        self.op_handles = np.empty(0, np.int64)
+        self.op_kinds = np.empty(0, np.uint8)
+        self.op_vals = np.empty(0, np.int32)
+        self._applied = 0  # watermark: ops [0, _applied) are on device
 
     @classmethod
     def from_trace(
@@ -50,39 +66,73 @@ class BatchedList:
     ) -> "BatchedList":
         """Build the shared identifier universe by running the edit trace
         through the native engine, then stand up ``n_replicas`` empty
-        device replicas over it. Returns the model; per-op slots are in
-        ``.op_slots`` and per-op kinds/values in ``.op_kinds``/``.op_vals``
-        (what ``apply_ops`` scatters)."""
-        engine = ListEngine()
-        handles = engine.apply_trace(kinds, indices, values, actors)
-        rank = engine.total_order()
-        out = cls(n_replicas, engine, rank)
-        out.op_slots = rank[handles]
-        out.op_kinds = np.ascontiguousarray(kinds, np.uint8)
-        out.op_vals = np.ascontiguousarray(values, np.int32)
+        device replicas over it. For streamed ingestion start from
+        ``BatchedList(n_replicas)`` and call :meth:`extend_trace` per
+        chunk instead."""
+        out = cls(n_replicas)
+        out.extend_trace(kinds, indices, values, actors)
         return out
+
+    def extend_trace(
+        self,
+        kinds: Sequence[int],
+        indices: Sequence[int],
+        values: Sequence[int],
+        actors: Sequence[int],
+    ) -> None:
+        """Grow the shared identifier universe with further local edit
+        ops (streamed ingestion — the trace need not be known up front,
+        SURVEY.md §4.5 / BASELINE config 5). New identifiers may
+        interleave existing ones, so device slots are re-permuted to the
+        new total order; applied state moves with its identifiers."""
+        handles = self.engine.apply_trace(kinds, indices, values, actors)
+        new_rank = self.engine.total_order()
+        if len(new_rank) != len(self.slots):
+            src = growth_permutation(self.slots, new_rank)
+            self.vals, self.alive = _remap_slots(
+                self.vals, self.alive, jnp.asarray(src)
+            )
+            self.slots = new_rank
+        self.op_handles = np.concatenate([self.op_handles, handles])
+        self.op_kinds = np.concatenate(
+            [self.op_kinds, np.ascontiguousarray(kinds, np.uint8)]
+        )
+        self.op_vals = np.concatenate(
+            [self.op_vals, np.ascontiguousarray(values, np.int32)]
+        )
+
+    @property
+    def op_slots(self) -> np.ndarray:
+        """Current slot of every logged op (recomputed: slots move as the
+        universe grows, handles don't)."""
+        return self.slots[self.op_handles]
 
     @property
     def n_replicas(self) -> int:
         return self.vals.shape[0]
 
     # ---- batched op application (the device hot path) -----------------
-    def apply_ops(self, replica_ops: np.ndarray) -> None:
+    def apply_ops(self, replica_ops: np.ndarray, op_slots: Optional[np.ndarray] = None) -> None:
         """One epoch: ``replica_ops[r]`` lists trace-op indices for
         replica ``r`` (shape [R, C]; -1 pads). Within one epoch a
         replica must not touch the same slot twice (scatter order on
         duplicates is unspecified) — chunk the trace accordingly.
-        The whole epoch is two scatters for ALL replicas."""
+        The whole epoch is two scatters for ALL replicas.
+
+        ``op_slots`` lets loop callers pass the op→slot table computed
+        once (it is an O(oplog) gather otherwise)."""
         replica_ops = np.asarray(replica_ops)
         if replica_ops.ndim != 2 or replica_ops.shape[0] != self.n_replicas:
             raise ValueError(f"expected [R={self.n_replicas}, C] op indices")
+        if op_slots is None:
+            op_slots = self.op_slots
         valid = replica_ops >= 0
         safe = np.where(valid, replica_ops, 0)
         # Pad lanes scatter to the out-of-range slot N and are dropped —
         # routing them to slot 0 would duplicate-write a real slot with
         # an unspecified winner.
         n = self.vals.shape[1]
-        slots = jnp.asarray(np.where(valid, self.op_slots[safe], n))
+        slots = jnp.asarray(np.where(valid, op_slots[safe], n))
         kinds = jnp.asarray(self.op_kinds[safe])
         vals = jnp.asarray(self.op_vals[safe])
         self.vals, self.alive = _apply_epoch(
@@ -90,18 +140,20 @@ class BatchedList:
         )
 
     def apply_trace_to_all(self, chunk: int = 4096) -> None:
-        """Apply the construction trace to every replica in fixed-size
-        epochs. Within an epoch, ops on the same slot compose to the
+        """Apply the not-yet-applied tail of the op log to every replica
+        in fixed-size epochs (streamed calls pick up where the last one
+        stopped). Within an epoch, ops on the same slot compose to the
         LAST one (a slot's lifecycle is insert → delete, so the final
         write wins exactly) — the host dedupes, and each epoch lands as
         one conflict-free scatter for all replicas."""
-        n_ops = len(self.op_slots)
-        for start in range(0, n_ops, chunk):
+        n_ops = len(self.op_handles)
+        op_slots = self.op_slots  # one gather; slots are stable herein
+        for start in range(self._applied, n_ops, chunk):
             ep = np.arange(start, min(start + chunk, n_ops))
             # keep the last op per slot: first occurrence in the reversed
             # window is the last in trace order
             rev = ep[::-1]
-            _, first = np.unique(self.op_slots[rev], return_index=True)
+            _, first = np.unique(op_slots[rev], return_index=True)
             keep = rev[first]
             # Pad to the fixed chunk width (-1 lanes are dropped) so every
             # epoch shares one traced shape — a data-dependent width would
@@ -109,7 +161,8 @@ class BatchedList:
             padded = np.full(chunk, -1, np.int64)
             padded[: len(keep)] = keep
             ops = np.broadcast_to(padded, (self.n_replicas, chunk))
-            self.apply_ops(ops)
+            self.apply_ops(ops, op_slots=op_slots)
+        self._applied = n_ops
 
     # ---- reads ---------------------------------------------------------
     def read(self, replica: int) -> list:
@@ -147,6 +200,19 @@ class BatchedList:
             out.seq.append(ident)
             out.vals[ident] = int(vals[slot])
         return out
+
+
+@jax.jit
+def _remap_slots(vals, alive, src):
+    """Permute replica state to a new total order: ``src[s]`` is the old
+    slot feeding new slot ``s`` (-1 = freshly minted identifier, empty
+    on every replica)."""
+    safe = jnp.where(src >= 0, src, 0)
+    fresh = src[None, :] < 0
+    return (
+        jnp.where(fresh, 0, vals[:, safe]),
+        jnp.where(fresh, False, alive[:, safe]),
+    )
 
 
 @jax.jit

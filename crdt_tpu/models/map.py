@@ -27,6 +27,7 @@ from ..utils.metrics import metrics
 from ..vclock import VClock
 from .orswot import DeferredOverflow
 from .registers import SlotOverflow
+from .validation import strict_validate_dot
 
 
 class BatchedMap:
@@ -201,6 +202,7 @@ class BatchedMap:
                 )
             na = self.state.top.shape[-1]
             nk = self.state.dkeys.shape[-1]
+            strict_validate_dot(row.top, self.actors, op.dot.actor, op.dot.counter)
             aid = self.actors.bounded_intern(op.dot.actor, na, "actor")
             kid = self.keys.bounded_intern(op.key, nk, "key")
             clock = np.zeros((na,), np.uint32)

@@ -35,6 +35,7 @@ from ..utils.metrics import metrics
 from ..vclock import VClock
 from .orswot import DeferredOverflow
 from .registers import SlotOverflow
+from .validation import strict_validate_dot
 
 
 class BatchedMapOrswot:
@@ -241,6 +242,7 @@ class BatchedMapOrswot:
         row = self._row(self.state, replica)
         na, nk, nm = self.state.core.top.shape[-1], self.n_keys, self.n_members
         if isinstance(op, Up):
+            strict_validate_dot(row.core.top, self.actors, op.dot.actor, op.dot.counter)
             kid = self.keys.bounded_intern(op.key, nk, "key")
             aid = self.actors.bounded_intern(op.dot.actor, na, "actor")
             if isinstance(op.op, OrswotAdd):
@@ -598,6 +600,7 @@ class BatchedNestedMap:
         na = self.state.m.top.shape[-1]
         nk1, nk2 = self.n_keys1, self.n_keys2
         if isinstance(op, Up):
+            strict_validate_dot(row.m.top, self.actors, op.dot.actor, op.dot.counter)
             k1id = self.keys1.bounded_intern(op.key, nk1, "outer key")
             aid = self.actors.bounded_intern(op.dot.actor, na, "actor")
             inner = op.op

@@ -21,6 +21,7 @@ from ..ops import orswot as ops
 from ..pure.orswot import Add, Orswot, Rm
 from ..utils import Interner
 from ..utils.metrics import metrics
+from .validation import strict_validate_dot
 from ..vclock import VClock
 
 
@@ -142,6 +143,7 @@ class BatchedOrswot:
         src/orswot.rs ``CmRDT::apply``)."""
         row = self._row(self.state, replica)
         if isinstance(op, Add):
+            strict_validate_dot(row.top, self.actors, op.dot.actor, op.dot.counter)
             aid = self.actors.id_of(op.dot.actor)
             if aid >= self.state.top.shape[-1]:
                 raise IndexError(

@@ -1,0 +1,39 @@
+"""Strict-mode op validation for the batched (xla) path.
+
+Reference: src/traits.rs v7 ``CmRDT::validate_op`` + src/dot.rs
+``DotRange`` (SURVEY.md §3.2 checklist). The pure oracle validates per
+type; the batched models share one rule: under ``config.strict`` an
+op's witness dot must be the actor's next contiguous event for the
+receiving replica — a duplicate or gapped dot raises ``DotRange``
+instead of being silently dropped/misapplied. Costs one device→host
+scalar read per apply, which is the point of it being a strict/debug
+mode."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..traits import DotRange
+
+
+def strict_validate_dot(top_row, actors, actor, counter: int) -> None:
+    """Raise DotRange unless ``counter`` is the next contiguous event of
+    ``actor`` against this replica's top clock. No-op unless
+    ``config.strict``.
+
+    Takes the interner (not a lane id) so validation can run BEFORE any
+    lane is allocated — a rejected op must be side-effect free, like the
+    oracle's ``validate_op`` (a never-seen actor's expected counter
+    is 1)."""
+    from ..config import config
+
+    if not config.strict:
+        return
+    arr = np.asarray(top_row)
+    seen = 0
+    if actor in actors:
+        aid = actors.id_of(actor)
+        if aid < arr.shape[-1]:
+            seen = int(arr[aid])
+    if int(counter) != seen + 1:
+        raise DotRange(actor, int(counter), seen + 1)

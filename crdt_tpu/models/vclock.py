@@ -61,6 +61,9 @@ class BatchedVClock:
         return self.actors.bounded_intern(actor, self.n_actors, "actor")
 
     def apply(self, replica: int, dot: Dot) -> None:
+        from .validation import strict_validate_dot
+
+        strict_validate_dot(self.clocks[replica], self.actors, dot.actor, dot.counter)
         aid = self.bounded_id(dot.actor)
         self.clocks = self.clocks.at[replica].set(
             ops.apply_dot(self.clocks[replica], jnp.asarray(aid), jnp.asarray(dot.counter))

@@ -45,8 +45,10 @@ from .collectives import (
     ring_round,
 )
 from .anti_entropy import mesh_fold, mesh_fold_clocks, mesh_fold_map, mesh_gossip
+from . import multihost
 
 __all__ = [
+    "multihost",
     "REPLICA_AXIS",
     "ELEMENT_AXIS",
     "make_mesh",

@@ -1,0 +1,93 @@
+"""Multi-host / multi-process entry points (SURVEY.md §6.8).
+
+The reference ships serde bytes and leaves transport to the caller; the
+TPU build's NCCL-equivalent is XLA collectives over ICI within a slice
+and DCN across slices. This module wires the multi-process runtime:
+
+- ``initialize`` — ``jax.distributed.initialize`` (coordinator
+  rendezvous; must run before the backend initialises),
+- ``global_mesh`` — a ``(replica, element)`` mesh over ALL processes'
+  devices with the replica axis spanning processes. Element shards
+  never communicate (the join is element-parallel, mesh.py), so the
+  only cross-process traffic is the replica-axis lattice-join
+  all-reduce — one state per round over DCN, exactly what the mesh.py
+  docstring prescribes for DCN-facing axes,
+- ``host_to_global`` — lift per-process host-local replica rows into a
+  global sharded array so ``mesh_fold`` / ``mesh_gossip`` run unchanged
+  on the multi-host mesh (the same anti-entropy program, now SPMD over
+  processes).
+
+Tested by tests/test_multihost.py with two local CPU processes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .mesh import ELEMENT_AXIS, REPLICA_AXIS
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join (or start) the distributed runtime. Call before any JAX
+    backend touch; arguments default to JAX's env-var autodetection
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID or
+    the cloud-TPU metadata server)."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(n_element_shards: int = 1):
+    """A ``(replica, element)`` mesh over every process's devices.
+
+    ``jax.devices()`` orders devices process-major, so a row-major
+    reshape puts element shards on neighbouring (same-process, ICI)
+    devices and lets the replica axis span processes — replica-join
+    traffic is the only thing that crosses DCN."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.asarray(jax.devices())
+    n = len(devices)
+    # Element shards must fit INSIDE a process: the layout promise is
+    # that element traffic never crosses DCN, which the total-count
+    # check alone would silently break (shards straddling processes).
+    local = jax.local_device_count()
+    if local % n_element_shards:
+        raise ValueError(
+            f"{n_element_shards} element shards do not divide the "
+            f"{local} per-process devices — element shards would "
+            f"straddle processes (DCN)"
+        )
+    grid = devices.reshape(n // n_element_shards, n_element_shards)
+    return Mesh(grid, (REPLICA_AXIS, ELEMENT_AXIS))
+
+
+def host_to_global(local_state, mesh, specs):
+    """Lift host-local arrays (this process's replica rows, full element
+    extent) into global sharded arrays laid out per ``specs`` — the
+    hand-off between per-host state ingestion and the mesh-wide
+    anti-entropy program."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.host_local_array_to_global_array(
+        local_state, mesh, specs
+    )
+
+
+def global_to_host(global_state):
+    """Host copy of a fully-replicated global result (the converged
+    state every process receives after ``mesh_fold``)."""
+    import jax
+
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), global_state)

@@ -1,0 +1,77 @@
+"""Worker process for tests/test_multihost.py: joins a 2-process
+distributed runtime (4 virtual CPU devices each → 8 global), runs the
+mesh anti-entropy fold over the multi-host mesh, and checks the result
+bit-identical to a single-device fold of the full replica batch.
+
+Usage: python multihost_worker.py <coordinator_port> <process_id>
+"""
+
+import os
+import sys
+
+port, pid = sys.argv[1], int(sys.argv[2])
+
+flags = os.environ.get("XLA_FLAGS", "")
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=4"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge
+
+xla_bridge._backend_factories.pop("axon", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from crdt_tpu.parallel import multihost
+
+multihost.initialize(f"localhost:{port}", num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, jax.devices()
+assert len(jax.local_devices()) == 4
+
+import numpy as np
+import jax.numpy as jnp
+
+from crdt_tpu.ops import orswot as ops
+from crdt_tpu.parallel import mesh_fold
+from crdt_tpu.parallel.mesh import orswot_specs
+
+# The same deterministic 8-replica batch on every process; each process
+# owns rows [pid*4, (pid+1)*4) — sizes divide the mesh so no padding
+# (padding would concatenate non-addressable global arrays).
+R, E, A, D = 8, 16, 4, 2
+rng = np.random.default_rng(0)
+ctr = rng.integers(0, 5, (R, E, A)).astype(np.uint32)
+ctr[rng.random((R, E, A)) < 0.4] = 0
+top = np.maximum(ctr.max(axis=1), rng.integers(0, 5, (R, A)).astype(np.uint32))
+
+mesh = multihost.global_mesh(n_element_shards=2)
+assert mesh.shape["replica"] == 4 and mesh.shape["element"] == 2
+
+local_rows = slice(pid * 4, (pid + 1) * 4)
+local = ops.OrswotState(
+    top=top[local_rows],
+    ctr=ctr[local_rows],
+    dcl=np.zeros((4, D, A), np.uint32),
+    dmask=np.zeros((4, D, E), bool),
+    dvalid=np.zeros((4, D), bool),
+)
+gstate = multihost.host_to_global(local, mesh, orswot_specs())
+
+joined, overflow = mesh_fold(gstate, mesh)
+result = multihost.global_to_host(joined)
+assert not bool(np.asarray(jax.device_get(overflow)))
+
+# Single-device reference fold of the full batch.
+full = ops.empty(E, A, deferred_cap=D, batch=(R,))
+full = full._replace(top=jnp.asarray(top), ctr=jnp.asarray(ctr))
+expect, of2 = ops.fold(full)
+assert not bool(of2)
+np.testing.assert_array_equal(result.top, np.asarray(expect.top))
+np.testing.assert_array_equal(result.ctr, np.asarray(expect.ctr))
+
+print(f"MULTIHOST_OK process={pid}", flush=True)

@@ -72,6 +72,56 @@ def test_strict_mode_validation():
         replica.validate_op(op1)  # duplicate
 
 
+def test_strict_validation_on_xla_backend():
+    # VERDICT r2 #8: a gapped/duplicate dot must raise DotRange on the
+    # batched path too, not only through the pure types' validate_op.
+    from crdt_tpu.models import BatchedMap, BatchedOrswot
+    from crdt_tpu.pure.map import Map
+    from crdt_tpu.pure.mvreg import MVReg
+    from crdt_tpu.pure.orswot import Orswot
+    from crdt_tpu.traits import DotRange
+    from crdt_tpu.utils import Interner
+
+    site = Orswot()
+    op1 = site.add("m", site.read().derive_add_ctx("a"))
+    site.apply(op1)
+    gapped = site.add("m2", site.read().derive_add_ctx("a"))  # dot (a,2)
+
+    def fresh():
+        return BatchedOrswot(
+            1, 4, 2, 2, members=Interner(["m", "m2"]), actors=Interner(["a"])
+        )
+
+    with configured(backend="xla", strict=True):
+        device = fresh()
+        with pytest.raises(DotRange):
+            device.apply(0, gapped)  # (a,2) without (a,1): gap
+        device.apply(0, op1)
+        device.apply(0, gapped)  # now contiguous
+        with pytest.raises(DotRange):
+            device.apply(0, op1)  # duplicate
+    # non-strict: dup/gap ops are silently handled (oracle drop rule)
+    device = fresh()
+    device.apply(0, gapped)
+    device.apply(0, op1)
+
+    # the composition layer too
+    msite = Map(MVReg)
+    mop = msite.update(
+        "k", msite.len().derive_add_ctx("a"), lambda r, c: r.write(1, c)
+    )
+    msite.apply(mop)
+    mgap = msite.update(
+        "k", msite.len().derive_add_ctx("a"), lambda r, c: r.write(2, c)
+    )
+    with configured(backend="xla", strict=True):
+        dmap = BatchedMap(1, 2, 2, 4, 4, keys=Interner(["k"]), actors=Interner(["a"]))
+        with pytest.raises(DotRange):
+            dmap.apply(0, mgap)
+        dmap.apply(0, mop)
+        dmap.apply(0, mgap)
+
+
 def test_validate_op_counters_and_map():
     from crdt_tpu import GCounter, Map, MVReg, PNCounter, VClock
     from crdt_tpu.traits import DotRange
