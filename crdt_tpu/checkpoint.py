@@ -23,8 +23,12 @@ import jax
 import numpy as np
 
 from . import serde
+from .models.glist import BatchedGList
+from .models.list import BatchedList
 from .models.map import BatchedMap
+from .models.map_nested import BatchedMapOrswot, BatchedNestedMap
 from .models.orswot import BatchedOrswot
+from .native import DELETE, INSERT
 from .ops import map as map_ops
 from .ops import mvreg as mv_ops
 from .ops import orswot as orswot_ops
@@ -37,6 +41,80 @@ def _interner_items(interner: Interner):
 
 def _interner_from(items) -> Interner:
     return Interner(serde.decode(item) for item in items)
+
+
+def _state_arrays(state) -> dict:
+    """Flatten any NamedTuple state pytree to numbered host arrays (the
+    leaf order of ``jax.tree`` is deterministic for a fixed pytree
+    type, so load can unflatten through a template of the same type)."""
+    return {f"a_{i}": np.asarray(x) for i, x in enumerate(jax.tree.leaves(state))}
+
+
+def _state_from_arrays(template, arrays):
+    n = sum(1 for k in arrays if k.startswith("a_"))
+    leaves = [jax.device_put(arrays[f"a_{i}"]) for i in range(n)]
+    return jax.tree.unflatten(jax.tree.structure(template), leaves)
+
+
+def _engine_dump(engine) -> dict:
+    """Host-side identifier-tree state: every minted identifier's path
+    (ragged, as counts + flat components) plus the live handle set —
+    enough to re-ingest into a fresh engine via ``apply_remote``."""
+    total = engine.total_ids()
+    counts, cidx, cactor, cctr = [], [], [], []
+    for h in range(total):
+        path = engine.identifier_path(h)
+        counts.append(len(path))
+        for ix, a, c in path:
+            cidx.append(ix)
+            cactor.append(a)
+            cctr.append(c)
+    live, _ = engine.read()
+    clk_actors, clk_ctrs = engine.clock_dump()
+    return {
+        "e_counts": np.asarray(counts, np.int64),
+        "e_cidx": np.asarray(cidx, np.int64),
+        "e_cactor": np.asarray(cactor, np.int32),
+        "e_cctr": np.asarray(cctr, np.uint64),
+        "e_live": np.asarray(live, np.int64),
+        # The mint clock rides separately: deletes consume counters no
+        # surviving identifier path records, and a resumed engine must
+        # not re-mint spent dots.
+        "e_clk_actors": clk_actors,
+        "e_clk_ctrs": clk_ctrs,
+    }
+
+
+def _engine_restore(engine, arrays, values: np.ndarray) -> None:
+    """Re-ingest a dumped identifier tree: INSERT every identifier by
+    path (in mint order, reproducing handle numbering), then DELETE the
+    ones that were dead. ``values[h]`` is identifier ``h``'s payload."""
+    for actor, ctr in zip(arrays["e_clk_actors"], arrays["e_clk_ctrs"]):
+        engine.clock_seed(int(actor), int(ctr))
+    counts = arrays["e_counts"]
+    paths, pos = [], 0
+    for c in counts:
+        c = int(c)
+        paths.append(
+            [
+                (int(arrays["e_cidx"][i]), int(arrays["e_cactor"][i]), int(arrays["e_cctr"][i]))
+                for i in range(pos, pos + c)
+            ]
+        )
+        pos += c
+    if not paths:
+        return
+    kinds = np.full(len(paths), INSERT, np.uint8)
+    handles = engine.apply_remote(kinds, paths, np.asarray(values, np.int32))
+    assert (handles == np.arange(len(paths))).all(), "handle order drifted"
+    live = set(int(h) for h in arrays["e_live"])
+    dead = [h for h in range(len(paths)) if h not in live]
+    if dead:
+        engine.apply_remote(
+            np.full(len(dead), DELETE, np.uint8),
+            [paths[h] for h in dead],
+            np.zeros(len(dead), np.int32),
+        )
 
 
 def save(path: Union[str, os.PathLike], model) -> None:
@@ -63,6 +141,57 @@ def save(path: Union[str, os.PathLike], model) -> None:
         arrays.update(
             {f"c_{k}": np.asarray(v) for k, v in model.state.child._asdict().items()}
         )
+    elif isinstance(model, BatchedMapOrswot):
+        meta = {
+            "kind": "map_orswot",
+            "keys": _interner_items(model.keys),
+            "members": _interner_items(model.members),
+            "actors": _interner_items(model.actors),
+            "dims": [
+                model.n_replicas, model.n_keys, model.n_members,
+                int(model.state.core.top.shape[-1]),
+                int(model.state.kdcl.shape[-2]),
+            ],
+        }
+        arrays = _state_arrays(model.state)
+    elif isinstance(model, BatchedNestedMap):
+        meta = {
+            "kind": "map_map",
+            "keys1": _interner_items(model.keys1),
+            "keys2": _interner_items(model.keys2),
+            "actors": _interner_items(model.actors),
+            "values": _interner_items(model.values),
+            "dims": [
+                model.n_replicas, model.n_keys1, model.n_keys2,
+                int(model.state.m.top.shape[-1]),
+                int(model.state.m.child.wact.shape[-1]),
+                int(model.state.odcl.shape[-2]),
+            ],
+        }
+        arrays = _state_arrays(model.state)
+    elif isinstance(model, BatchedList):
+        ins = model.op_kinds == INSERT
+        values = np.zeros(model.engine.total_ids(), np.int32)
+        values[model.op_handles[ins]] = model.op_vals[ins]
+        meta = {"kind": "list", "n_replicas": model.n_replicas, "applied": model._applied}
+        arrays = {
+            "slots": model.slots,
+            "vals": np.asarray(model.vals),
+            "alive": np.asarray(model.alive),
+            "op_handles": model.op_handles,
+            "op_kinds": model.op_kinds,
+            "op_vals": model.op_vals,
+            "id_values": values,
+            **_engine_dump(model.engine),
+        }
+    elif isinstance(model, BatchedGList):
+        meta = {"kind": "glist", "n_replicas": model.n_replicas}
+        arrays = {
+            "slots": model.slots,
+            "uvals": model.uvals,
+            "alive": np.asarray(model.alive),
+            **_engine_dump(model.engine),
+        }
     else:
         raise TypeError(f"cannot checkpoint {type(model).__name__}")
 
@@ -122,6 +251,51 @@ def load(path: Union[str, os.PathLike]):
             values=_interner_from(meta["values"]),
         )
         model.state = state
+        return model
+    if meta["kind"] == "map_orswot":
+        r, nk, nm, na, d = meta["dims"]
+        model = BatchedMapOrswot(
+            r, nk, nm, na, d,
+            keys=_interner_from(meta["keys"]),
+            members=_interner_from(meta["members"]),
+            actors=_interner_from(meta["actors"]),
+        )
+        model.state = _state_from_arrays(model.state, arrays)
+        return model
+    if meta["kind"] == "map_map":
+        r, nk1, nk2, na, s, d = meta["dims"]
+        model = BatchedNestedMap(
+            r, nk1, nk2, na, s, d,
+            keys1=_interner_from(meta["keys1"]),
+            keys2=_interner_from(meta["keys2"]),
+            actors=_interner_from(meta["actors"]),
+            values=_interner_from(meta["values"]),
+        )
+        model.state = _state_from_arrays(model.state, arrays)
+        return model
+    if meta["kind"] == "list":
+        model = BatchedList(meta["n_replicas"])
+        _engine_restore(model.engine, arrays, arrays["id_values"])
+        model.slots = arrays["slots"]
+        assert (model.engine.total_order() == model.slots).all(), (
+            "restored identifier order drifted from the checkpoint"
+        )
+        model.vals = jax.device_put(arrays["vals"])
+        model.alive = jax.device_put(arrays["alive"])
+        model.op_handles = arrays["op_handles"]
+        model.op_kinds = arrays["op_kinds"]
+        model.op_vals = arrays["op_vals"]
+        model._applied = int(meta["applied"])
+        return model
+    if meta["kind"] == "glist":
+        model = BatchedGList(meta["n_replicas"])
+        _engine_restore(model.engine, arrays, arrays["uvals"])
+        model.slots = arrays["slots"]
+        assert (model.engine.total_order() == model.slots).all(), (
+            "restored identifier order drifted from the checkpoint"
+        )
+        model.uvals = arrays["uvals"]
+        model.alive = jax.device_put(arrays["alive"])
         return model
     raise ValueError(f"unknown checkpoint kind {meta['kind']!r}")
 

@@ -137,6 +137,14 @@ def _load():
     ]
     lib.ls_clock_get.restype = ctypes.c_int64
     lib.ls_clock_get.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.ls_clock_count.restype = ctypes.c_int64
+    lib.ls_clock_count.argtypes = [ctypes.c_void_p]
+    lib.ls_clock_dump.argtypes = [
+        ctypes.c_void_p,
+        np.ctypeslib.ndpointer(np.int32, flags="C"),
+        np.ctypeslib.ndpointer(np.uint64, flags="C"),
+    ]
+    lib.ls_clock_seed.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_uint64]
     _lib = lib
     return lib
 
@@ -277,6 +285,24 @@ class ListEngine:
             return self._impl.clock_get(actor)
         return int(_lib.ls_clock_get(self._e, int(actor)))
 
+    def clock_dump(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(actors, counters) of the mint clock — checkpoint payload
+        (deletes consume counters no identifier path records)."""
+        if self._impl is not None:
+            return self._impl.clock_dump()
+        n = int(_lib.ls_clock_count(self._e))
+        actors = np.empty(n, np.int32)
+        ctrs = np.empty(n, np.uint64)
+        _lib.ls_clock_dump(self._e, actors, ctrs)
+        return actors, ctrs
+
+    def clock_seed(self, actor: int, ctr: int) -> None:
+        """Raise an actor's mint clock to at least ``ctr`` (resume)."""
+        if self._impl is not None:
+            self._impl.clock_seed(actor, ctr)
+        else:
+            _lib.ls_clock_seed(self._e, int(actor), int(ctr))
+
 
 class _PyEngine:
     """Pure-Python fallback with the same surface, driving the oracle
@@ -377,6 +403,16 @@ class _PyEngine:
 
     def clock_get(self, actor):
         return self.clock.get(int(actor), 0)
+
+    def clock_dump(self):
+        actors = np.asarray(list(self.clock.keys()), np.int32)
+        ctrs = np.asarray(list(self.clock.values()), np.uint64)
+        return actors, ctrs
+
+    def clock_seed(self, actor, ctr):
+        actor, ctr = int(actor), int(ctr)
+        if ctr > self.clock.get(actor, 0):
+            self.clock[actor] = ctr
 
 
 __all__ = ["ListEngine", "native_available", "INSERT", "DELETE"]

@@ -258,4 +258,29 @@ int64_t ls_clock_get(void* ep, int32_t actor) {
   return it == e.clock.end() ? 0 : int64_t(it->second);
 }
 
+// Actor-clock persistence (checkpoint/resume): deletes consume mint
+// counters that no surviving identifier path records, so restoring an
+// engine from identifier paths alone would re-mint spent dots. The
+// checkpoint dumps the clock map and re-seeds it after re-ingestion.
+int64_t ls_clock_count(void* ep) {
+  Engine& e = *static_cast<Engine*>(ep);
+  return int64_t(e.clock.size());
+}
+
+void ls_clock_dump(void* ep, int32_t* out_actors, uint64_t* out_ctrs) {
+  Engine& e = *static_cast<Engine*>(ep);
+  size_t i = 0;
+  for (const auto& kv : e.clock) {
+    out_actors[i] = kv.first;
+    out_ctrs[i] = kv.second;
+    ++i;
+  }
+}
+
+void ls_clock_seed(void* ep, int32_t actor, uint64_t ctr) {
+  Engine& e = *static_cast<Engine*>(ep);
+  uint64_t& top = e.clock[actor];
+  if (ctr > top) top = ctr;
+}
+
 }  // extern "C"

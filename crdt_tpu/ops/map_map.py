@@ -20,6 +20,7 @@ lossless round-trips (outer ``map.deferred`` vs per-child
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -89,11 +90,16 @@ def _replay_outer(state: NestedMapState) -> NestedMapState:
     )
 
 
-def _scrub_dead_keys(state: NestedMapState) -> NestedMapState:
+def _scrub_dead_keys(state: NestedMapState, element_axis=None) -> NestedMapState:
     """A bottomed child map is deleted by the oracle together with its
     parked inner removes (``Map.is_bottom``); clear inner parked masks on
     K1 rows holding no live content, drop emptied slots. The outer
-    buffer belongs to the outer map and is never scrubbed."""
+    buffer belongs to the outer map and is never scrubbed.
+
+    K1 liveness is shard-local (element shards align to whole K1
+    blocks); slot liveness reduces across shards (``_any_slots``)."""
+    from .map_orswot import _any_slots
+
     k1 = _n_keys1(state)
     k2 = state.m.dkeys.shape[-1] // k1
     alive = jnp.any(
@@ -102,7 +108,7 @@ def _scrub_dead_keys(state: NestedMapState) -> NestedMapState:
     )  # [..., K1]
     acols = jnp.repeat(alive, k2, axis=-1)
     dkeys = state.m.dkeys & acols[..., None, :]
-    dvalid = state.m.dvalid & jnp.any(dkeys, axis=-1)
+    dvalid = state.m.dvalid & _any_slots(dkeys, element_axis)
     return state._replace(
         m=state.m._replace(
             dcl=jnp.where(dvalid[..., None], state.m.dcl, 0),
@@ -112,12 +118,14 @@ def _scrub_dead_keys(state: NestedMapState) -> NestedMapState:
     )
 
 
-@jax.jit
-def join(a: NestedMapState, b: NestedMapState):
+@partial(jax.jit, static_argnames=("element_axis",))
+def join(a: NestedMapState, b: NestedMapState, element_axis=None):
     """Pairwise lattice join: the flat map join over K1*K2 keys plus the
     outer buffer union/replay/compaction and the dead-key scrub. Returns
     ``(state, overflow[3])`` — [sibling-slab, inner-deferred,
-    outer-deferred] (slab/inner lanes conservative as in ops.map)."""
+    outer-deferred] (slab/inner lanes conservative as in ops.map).
+    ``element_axis`` names the mesh axis the key dimension is sharded
+    over when joining inside shard_map."""
     m, mf = core_ops.join(a.m, b.m)  # mf = [sibling, inner-deferred]
 
     odcl = jnp.concatenate([a.odcl, b.odcl], axis=-2)
@@ -130,12 +138,13 @@ def join(a: NestedMapState, b: NestedMapState):
         state.odcl, state.odkeys, state.odvalid, a.odcl.shape[-2]
     )
     state = _scrub_dead_keys(
-        state._replace(odcl=odcl, odkeys=odkeys, odvalid=odvalid)
+        state._replace(odcl=odcl, odkeys=odkeys, odvalid=odvalid),
+        element_axis=element_axis,
     )
     return state, jnp.stack([mf[0], mf[1], jnp.any(outer_of)])
 
 
-def fold(states: NestedMapState):
+def fold(states: NestedMapState, element_axis=None):
     """Log-tree fold of a replica batch (leading axis)."""
     from .lattice import tree_fold
 
@@ -147,7 +156,7 @@ def fold(states: NestedMapState):
         states.m.child.wact.shape[-1],
         states.odcl.shape[-2],
     )
-    return tree_fold(states, identity, join)
+    return tree_fold(states, identity, partial(join, element_axis=element_axis))
 
 
 @jax.jit

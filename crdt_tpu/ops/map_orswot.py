@@ -26,6 +26,7 @@ keyset-removes.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -97,13 +98,30 @@ def _replay_outer(state: MapOrswotState) -> MapOrswotState:
     )
 
 
-def _scrub_dead_keys(state: MapOrswotState) -> MapOrswotState:
+def _any_slots(mask: jax.Array, element_axis) -> jax.Array:
+    """Per-slot liveness ``any(mask, -1)``, reduced across element
+    shards when the mask's last axis is sharded (``element_axis`` set,
+    inside shard_map): a slot's keys may live in other shards, and slot
+    validity must stay replicated across them."""
+    live = jnp.any(mask, axis=-1)
+    if element_axis is not None:
+        from jax import lax
+
+        live = lax.psum(live.astype(jnp.int32), element_axis) > 0
+    return live
+
+
+def _scrub_dead_keys(state: MapOrswotState, element_axis=None) -> MapOrswotState:
     """A memberless child is deleted by the oracle — together with its
     parked inner removes (``Orswot.is_bottom`` counts live members only,
     and ``Map`` drops bottom children after every apply/merge). Mirror:
     clear inner parked masks on keys holding no live dot, drop slots
     whose masks empty out. Outer parked keyset-removes belong to the map
-    itself and are never scrubbed."""
+    itself and are never scrubbed.
+
+    Key liveness itself is shard-local (element shards align to whole
+    key blocks — K*M is sharded in multiples of M), only the slot
+    liveness reduces across shards (``_any_slots``)."""
     k = _n_keys(state)
     m = state.core.ctr.shape[-2] // k
     alive = jnp.any(
@@ -112,7 +130,7 @@ def _scrub_dead_keys(state: MapOrswotState) -> MapOrswotState:
     )  # [..., K]
     acols = jnp.repeat(alive, m, axis=-1)  # [..., K*M]
     dmask = state.core.dmask & acols[..., None, :]
-    dvalid = state.core.dvalid & jnp.any(dmask, axis=-1)
+    dvalid = state.core.dvalid & _any_slots(dmask, element_axis)
     return state._replace(
         core=state.core._replace(
             dcl=jnp.where(dvalid[..., None], state.core.dcl, 0),
@@ -122,11 +140,13 @@ def _scrub_dead_keys(state: MapOrswotState) -> MapOrswotState:
     )
 
 
-@jax.jit
-def join(a: MapOrswotState, b: MapOrswotState):
+@partial(jax.jit, static_argnames=("element_axis",))
+def join(a: MapOrswotState, b: MapOrswotState, element_axis=None):
     """Pairwise lattice join: the flat orswot join over K*M elements plus
     the union/replay/compaction of the outer keyset buffer. Returns
     ``(state, overflow[2])`` — lanes [inner-deferred, outer-deferred].
+    ``element_axis`` names the mesh axis the key/element dimension is
+    sharded over when joining inside shard_map (see ``_any_slots``).
 
     (The core join's inner-overflow flag is conservative here: it counts
     parked slots before dead-key scrubbing, so a buffer transiently full
@@ -143,12 +163,13 @@ def join(a: MapOrswotState, b: MapOrswotState):
         state.kdcl, state.kdkeys, state.kdvalid, a.kdcl.shape[-2]
     )
     state = _scrub_dead_keys(
-        state._replace(kdcl=kdcl, kdkeys=kdkeys, kdvalid=kdvalid)
+        state._replace(kdcl=kdcl, kdkeys=kdkeys, kdvalid=kdvalid),
+        element_axis=element_axis,
     )
     return state, jnp.stack([jnp.any(inner_of), jnp.any(outer_of)])
 
 
-def fold(states: MapOrswotState):
+def fold(states: MapOrswotState, element_axis=None):
     """Log-tree fold of a replica batch (leading axis)."""
     from .lattice import tree_fold
 
@@ -157,7 +178,7 @@ def fold(states: MapOrswotState):
     identity = empty(
         k, m, states.core.top.shape[-1], states.kdcl.shape[-2]
     )
-    return tree_fold(states, identity, join)
+    return tree_fold(states, identity, partial(join, element_axis=element_axis))
 
 
 @jax.jit

@@ -33,9 +33,13 @@ from .mesh import (
     make_mesh,
     map_specs,
     map_out_specs,
+    map_orswot_specs,
+    nested_map_specs,
     orswot_specs,
     orswot_out_specs,
+    shard_map_orswot,
     shard_map_state,
+    shard_nested_map,
     shard_orswot,
 )
 from .collectives import (
@@ -44,11 +48,24 @@ from .collectives import (
     all_reduce_lattice,
     ring_round,
 )
-from .anti_entropy import mesh_fold, mesh_fold_clocks, mesh_fold_map, mesh_gossip
+from .anti_entropy import (
+    mesh_fold,
+    mesh_fold_clocks,
+    mesh_fold_map,
+    mesh_fold_map_orswot,
+    mesh_fold_nested_map,
+    mesh_gossip,
+)
 from . import multihost
 
 __all__ = [
     "multihost",
+    "map_orswot_specs",
+    "nested_map_specs",
+    "shard_map_orswot",
+    "shard_nested_map",
+    "mesh_fold_map_orswot",
+    "mesh_fold_nested_map",
     "REPLICA_AXIS",
     "ELEMENT_AXIS",
     "make_mesh",

@@ -27,8 +27,12 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import map as map_ops
+from ..ops import map_map as nested_ops
+from ..ops import map_orswot as mo_ops
 from ..ops import orswot as ops
 from ..ops.map import MapState
+from ..ops.map_map import NestedMapState
+from ..ops.map_orswot import MapOrswotState
 from ..ops.orswot import OrswotState
 from .collectives import (
     all_reduce_clock,
@@ -39,12 +43,18 @@ from .collectives import (
 from .mesh import (
     ELEMENT_AXIS,
     REPLICA_AXIS,
+    map_orswot_out_specs,
+    map_orswot_specs,
     map_out_specs,
     map_specs,
+    nested_map_out_specs,
+    nested_map_specs,
     orswot_out_specs,
     orswot_specs,
     pad_elements,
     pad_keys,
+    pad_map_orswot,
+    pad_nested_map,
     pad_replicas,
     pad_replicas_map,
 )
@@ -150,6 +160,47 @@ def mesh_gossip(
     return out
 
 
+def _mesh_fold_lattice(
+    kind: str,
+    state,
+    mesh: Mesh,
+    join_fn,
+    fold_fn,
+    in_specs,
+    out_specs,
+):
+    """Shared scaffold for the map-family mesh folds: local log-tree
+    fold per shard, replica-axis lattice-join all-reduce, and overflow
+    flags reduced over BOTH axes (slab/deferred overflows can be
+    key-shard-local, so every device must report the global flag)."""
+
+    def build():
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(in_specs,),
+            out_specs=(out_specs, P()),
+            check_vma=False,
+        )
+        def mesh_fn(local):
+            folded, of_local = fold_fn(local)
+            joined, of_cross = all_reduce_lattice(
+                folded, REPLICA_AXIS, join_fn, fold_fn
+            )
+            of = (lax.psum(of_local.astype(jnp.int32), REPLICA_AXIS) > 0) | of_cross
+            of = lax.psum(of.astype(jnp.int32), ELEMENT_AXIS) > 0
+            return joined, of
+
+        return mesh_fn
+
+    metrics.count(f"anti_entropy.{kind}_rounds")
+    metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
+    with metrics.time(f"anti_entropy.{kind}"):
+        out = _cached(kind, state, mesh, build)(state)
+        jax.block_until_ready(out)  # time device work, not async dispatch
+    return out
+
+
 def mesh_fold_map(state: MapState, mesh: Mesh) -> Tuple[MapState, jax.Array]:
     """Full-mesh anti-entropy for the composition layer (BASELINE config
     4): every replica's Map<K, MVReg> state joined into one converged
@@ -161,34 +212,48 @@ def mesh_fold_map(state: MapState, mesh: Mesh) -> Tuple[MapState, jax.Array]:
     """
     state = pad_replicas_map(state, mesh.shape[REPLICA_AXIS])
     state = pad_keys(state, mesh.shape[ELEMENT_AXIS])
+    return _mesh_fold_lattice(
+        "map_fold", state, mesh,
+        map_ops.join, map_ops.fold,
+        map_specs(), map_out_specs(),
+    )
 
-    def build():
-        @partial(
-            jax.shard_map,
-            mesh=mesh,
-            in_specs=(map_specs(),),
-            out_specs=(map_out_specs(), P()),
-            check_vma=False,
-        )
-        def fold_fn(local):
-            folded, of_local = map_ops.fold(local)
-            joined, of_cross = all_reduce_lattice(
-                folded, REPLICA_AXIS, map_ops.join, map_ops.fold
-            )
-            of = (lax.psum(of_local.astype(jnp.int32), REPLICA_AXIS) > 0) | of_cross
-            # Slab overflows are key-local: reduce across key shards too
-            # so every device reports the global flag.
-            of = lax.psum(of.astype(jnp.int32), ELEMENT_AXIS) > 0
-            return joined, of
 
-        return fold_fn
+def mesh_fold_map_orswot(
+    state: MapOrswotState, mesh: Mesh
+) -> Tuple[MapOrswotState, jax.Array]:
+    """Full-mesh anti-entropy for ``Map<K, Orswot>`` over the
+    (replica × key) mesh: element shards hold whole keys (K*M blocks)
+    and never exchange content; the collectives are the replica-axis
+    lattice-join all-reduce plus the tiny slot-liveness reduction the
+    dead-key scrub needs across key shards (ops/map_orswot.py
+    ``_any_slots``). Returns (converged state, overflow[2])."""
+    state = pad_map_orswot(
+        state, mesh.shape[REPLICA_AXIS], mesh.shape[ELEMENT_AXIS]
+    )
+    return _mesh_fold_lattice(
+        "map_orswot_fold", state, mesh,
+        partial(mo_ops.join, element_axis=ELEMENT_AXIS),
+        partial(mo_ops.fold, element_axis=ELEMENT_AXIS),
+        map_orswot_specs(), map_orswot_out_specs(),
+    )
 
-    metrics.count("anti_entropy.map_fold_rounds")
-    metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
-    with metrics.time("anti_entropy.map_fold"):
-        out = _cached("map_fold", state, mesh, build)(state)
-        jax.block_until_ready(out)  # time device work, not async dispatch
-    return out
+
+def mesh_fold_nested_map(
+    state: NestedMapState, mesh: Mesh
+) -> Tuple[NestedMapState, jax.Array]:
+    """Full-mesh anti-entropy for ``Map<K1, Map<K2, MVReg>>`` over the
+    (replica × outer-key) mesh (K1*K2 blocks per shard). Returns
+    (converged state, overflow[3])."""
+    state = pad_nested_map(
+        state, mesh.shape[REPLICA_AXIS], mesh.shape[ELEMENT_AXIS]
+    )
+    return _mesh_fold_lattice(
+        "nested_map_fold", state, mesh,
+        partial(nested_ops.join, element_axis=ELEMENT_AXIS),
+        partial(nested_ops.fold, element_axis=ELEMENT_AXIS),
+        nested_map_specs(), nested_map_out_specs(),
+    )
 
 
 def mesh_fold_clocks(clocks: jax.Array, mesh: Mesh) -> jax.Array:

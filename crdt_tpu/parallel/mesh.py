@@ -18,6 +18,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.map import MapState
+from ..ops.map_map import NestedMapState
+from ..ops.map_orswot import MapOrswotState
 from ..ops.mvreg import MVRegState
 from ..ops.orswot import OrswotState
 
@@ -184,6 +186,140 @@ def shard_map_state(state: MapState, mesh: Mesh) -> MapState:
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         state,
         map_specs(),
+    )
+
+
+def map_orswot_specs() -> MapOrswotState:
+    """PartitionSpecs for a batched ``MapOrswotState`` [R, ...]: the
+    K*M product element axis shards in whole-key blocks (pad_keys keeps
+    K divisible by the element axis, so every shard's chunk is a
+    multiple of M), the outer keyset buffer shards over K."""
+    return MapOrswotState(
+        core=orswot_specs(),
+        kdcl=P(REPLICA_AXIS, None, None),
+        kdkeys=P(REPLICA_AXIS, None, ELEMENT_AXIS),
+        kdvalid=P(REPLICA_AXIS, None),
+    )
+
+
+def map_orswot_out_specs() -> MapOrswotState:
+    return MapOrswotState(
+        core=orswot_out_specs(),
+        kdcl=P(None, None),
+        kdkeys=P(None, ELEMENT_AXIS),
+        kdvalid=P(None),
+    )
+
+
+def pad_map_orswot(state: MapOrswotState, rmult: int, kmult: int) -> MapOrswotState:
+    """Pad replicas with join identities and keys (in whole K*M blocks)
+    with never-present slots, to mesh-axis divisibility."""
+    import jax.numpy as jnp
+
+    nk = state.kdkeys.shape[-1]
+    m = state.core.ctr.shape[-2] // nk
+
+    pad_r = (-state.core.top.shape[0]) % rmult
+    if pad_r:
+        from ..ops.map_orswot import empty
+
+        ident = empty(nk, m, state.core.top.shape[-1], state.kdcl.shape[-2], batch=(pad_r,))
+        state = jax.tree.map(
+            lambda x, p: jnp.concatenate([x, p.astype(x.dtype)], axis=0), state, ident
+        )
+    pad_k = (-nk) % kmult
+    if pad_k:
+        state = state._replace(
+            core=state.core._replace(
+                ctr=jnp.pad(state.core.ctr, ((0, 0), (0, pad_k * m), (0, 0))),
+                dmask=jnp.pad(state.core.dmask, ((0, 0), (0, 0), (0, pad_k * m))),
+            ),
+            kdkeys=jnp.pad(state.kdkeys, ((0, 0), (0, 0), (0, pad_k))),
+        )
+    return state
+
+
+def shard_map_orswot(state: MapOrswotState, mesh: Mesh) -> MapOrswotState:
+    """Place a batched Map<K, Orswot> state onto the mesh (replica ×
+    key) with the canonical layout."""
+    state = pad_map_orswot(
+        state, mesh.shape[REPLICA_AXIS], mesh.shape[ELEMENT_AXIS]
+    )
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        state,
+        map_orswot_specs(),
+    )
+
+
+def nested_map_specs() -> NestedMapState:
+    """PartitionSpecs for a batched ``NestedMapState`` [R, ...]: the
+    K1*K2 product key axis shards in whole-K1 blocks, the outer keyset
+    buffer shards over K1."""
+    return NestedMapState(
+        m=map_specs(),
+        odcl=P(REPLICA_AXIS, None, None),
+        odkeys=P(REPLICA_AXIS, None, ELEMENT_AXIS),
+        odvalid=P(REPLICA_AXIS, None),
+    )
+
+
+def nested_map_out_specs() -> NestedMapState:
+    return NestedMapState(
+        m=map_out_specs(),
+        odcl=P(None, None),
+        odkeys=P(None, ELEMENT_AXIS),
+        odvalid=P(None),
+    )
+
+
+def pad_nested_map(state: NestedMapState, rmult: int, kmult: int) -> NestedMapState:
+    """Pad replicas with join identities and K1 (in whole K1*K2 blocks)
+    with never-written slots, to mesh-axis divisibility."""
+    import jax.numpy as jnp
+
+    nk1 = state.odkeys.shape[-1]
+    k2 = state.m.dkeys.shape[-1] // nk1
+
+    pad_r = (-state.m.top.shape[0]) % rmult
+    if pad_r:
+        from ..ops.map_map import empty
+
+        ident = empty(
+            nk1, k2,
+            state.m.top.shape[-1],
+            state.m.child.wact.shape[-1],
+            state.odcl.shape[-2],
+            batch=(pad_r,),
+        )
+        state = jax.tree.map(
+            lambda x, p: jnp.concatenate([x, p.astype(x.dtype)], axis=0), state, ident
+        )
+    pad_k = (-nk1) % kmult
+    if pad_k:
+        kpad = lambda x: jnp.pad(
+            x, ((0, 0), (0, pad_k * k2)) + ((0, 0),) * (x.ndim - 2)
+        )
+        state = state._replace(
+            m=state.m._replace(
+                child=jax.tree.map(kpad, state.m.child),
+                dkeys=jnp.pad(state.m.dkeys, ((0, 0), (0, 0), (0, pad_k * k2))),
+            ),
+            odkeys=jnp.pad(state.odkeys, ((0, 0), (0, 0), (0, pad_k))),
+        )
+    return state
+
+
+def shard_nested_map(state: NestedMapState, mesh: Mesh) -> NestedMapState:
+    """Place a batched Map<K1, Map<K2, MVReg>> state onto the mesh
+    (replica × outer key) with the canonical layout."""
+    state = pad_nested_map(
+        state, mesh.shape[REPLICA_AXIS], mesh.shape[ELEMENT_AXIS]
+    )
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        state,
+        nested_map_specs(),
     )
 
 

@@ -234,3 +234,78 @@ def test_mesh_fold_map_bit_identical(mesh_shape, data):
     for r in reps[1:]:
         expect.merge(r)
     assert out.to_pure(0) == expect
+
+
+@pytest.mark.parametrize("mesh_shape", [(4, 2), (2, 4), (3, 1)])
+@pytest.mark.parametrize("seed", [3, 11, 27])
+def test_mesh_fold_map_orswot_bit_identical(mesh_shape, seed):
+    import random
+
+    from crdt_tpu.models import BatchedMapOrswot
+    from crdt_tpu.parallel import mesh_fold_map_orswot, shard_map_orswot
+    from test_models_map_nested import _batched, _site_run_set
+
+    rng = random.Random(seed)
+    states = _site_run_set(rng, n_cmds=14)
+    batched = _batched(states)
+
+    mesh = make_mesh(*mesh_shape)
+    sharded = shard_map_orswot(batched.state, mesh)
+    folded, overflow = mesh_fold_map_orswot(sharded, mesh)
+    assert not bool(overflow.any())
+
+    out = BatchedMapOrswot(
+        1,
+        folded.kdkeys.shape[-1],
+        folded.core.ctr.shape[-2] // folded.kdkeys.shape[-1],
+        folded.core.top.shape[-1],
+        folded.kdcl.shape[-2],
+        keys=batched.keys,
+        members=batched.members,
+        actors=batched.actors,
+    )
+    out.state = jax.tree.map(lambda x: x[None], folded)
+
+    expect = states[0].clone()
+    for r in states[1:]:
+        expect.merge(r.clone())
+    assert out.to_pure(0) == expect
+
+
+@pytest.mark.parametrize("mesh_shape", [(4, 2), (2, 4)])
+@pytest.mark.parametrize("seed", [5, 19])
+def test_mesh_fold_nested_map_bit_identical(mesh_shape, seed):
+    import random
+
+    from crdt_tpu.models import BatchedNestedMap
+    from crdt_tpu.parallel import mesh_fold_nested_map, shard_nested_map
+    from test_models_map_nested import _nbatched, _site_run_nested
+
+    rng = random.Random(seed)
+    states = _site_run_nested(rng, n_cmds=12)
+    batched = _nbatched(states)
+
+    mesh = make_mesh(*mesh_shape)
+    sharded = shard_nested_map(batched.state, mesh)
+    folded, overflow = mesh_fold_nested_map(sharded, mesh)
+    assert not bool(overflow.any())
+
+    nk1 = folded.odkeys.shape[-1]
+    out = BatchedNestedMap(
+        1,
+        nk1,
+        folded.m.dkeys.shape[-1] // nk1,
+        folded.m.top.shape[-1],
+        folded.m.child.wact.shape[-1],
+        folded.odcl.shape[-2],
+        keys1=batched.keys1,
+        keys2=batched.keys2,
+        actors=batched.actors,
+        values=batched.values,
+    )
+    out.state = jax.tree.map(lambda x: x[None], folded)
+
+    expect = states[0].clone()
+    for r in states[1:]:
+        expect.merge(r.clone())
+    assert out.to_pure(0) == expect
