@@ -48,12 +48,51 @@ class BatchedList:
         self.slots = np.empty(0, np.int64)
         self.vals = jnp.zeros((n_replicas, 1), jnp.int32)
         self.alive = jnp.zeros((n_replicas, 1), bool)
+        self._mesh = None  # set by place(): (replica, element) sharding
         # The op log: stable identifier handles (slots move when later
         # inserts interleave the order; handles never do).
         self.op_handles = np.empty(0, np.int64)
         self.op_kinds = np.empty(0, np.uint8)
         self.op_vals = np.empty(0, np.int32)
         self._applied = 0  # watermark: ops [0, _applied) are on device
+
+    def place(self, mesh) -> None:
+        """Shard the replica state over a ``(replica, element)`` mesh:
+        replicas data-parallel, the slot universe sharded over the
+        element axis (the sequence-parallel analog, SURVEY.md §3.1 —
+        identifier space across devices). Epoch scatters carry
+        replicated indices and XLA partitions them; streamed universe
+        growth re-places after every slot re-permutation."""
+        from ..parallel.mesh import REPLICA_AXIS
+
+        # Validate BEFORE installing: a rejected place() must leave the
+        # model untouched (an installed mesh would make the next
+        # extend_trace mutate the engine and then fail mid-operation).
+        rmult = mesh.shape[REPLICA_AXIS]
+        if self.vals.shape[0] % rmult:
+            raise ValueError(
+                f"{self.vals.shape[0]} replicas do not divide the "
+                f"{rmult}-way replica mesh axis"
+            )
+        self._mesh = mesh
+        self.vals, self.alive = self._placed(self.vals, self.alive)
+
+    def _placed(self, vals, alive):
+        if self._mesh is None:
+            return vals, alive
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import ELEMENT_AXIS, REPLICA_AXIS
+
+        mesh = self._mesh
+        pad_n = (-vals.shape[1]) % mesh.shape[ELEMENT_AXIS]
+        if pad_n:
+            # Dead slot padding: never addressed (scatters drop at the
+            # out-of-range lane, reads mask on alive).
+            vals = jnp.pad(vals, ((0, 0), (0, pad_n)))
+            alive = jnp.pad(alive, ((0, 0), (0, pad_n)))
+        spec = NamedSharding(mesh, P(REPLICA_AXIS, ELEMENT_AXIS))
+        return jax.device_put(vals, spec), jax.device_put(alive, spec)
 
     @classmethod
     def from_trace(
@@ -89,8 +128,8 @@ class BatchedList:
         new_rank = self.engine.total_order()
         if len(new_rank) != len(self.slots):
             src = growth_permutation(self.slots, new_rank)
-            self.vals, self.alive = _remap_slots(
-                self.vals, self.alive, jnp.asarray(src)
+            self.vals, self.alive = self._placed(
+                *_remap_slots(self.vals, self.alive, jnp.asarray(src))
             )
             self.slots = new_rank
         self.op_handles = np.concatenate([self.op_handles, handles])

@@ -165,3 +165,42 @@ def test_glist_convergence_order_independent():
     model.union_from(2, 0)
     model.union_from(2, 1)
     assert model.read(2) == seq_a
+
+
+@given(seeds)
+@settings(max_examples=6, deadline=None)
+def test_element_sharded_list_matches_unsharded(seed):
+    # SP analog (SURVEY §3.1): the slot universe sharded over the
+    # element mesh axis must be bit-identical to the unsharded model,
+    # including through streamed growth (re-permute + re-place).
+    from crdt_tpu.parallel import make_mesh
+
+    rng = random.Random(seed)
+    t1 = _edit_trace(rng, 30)
+    t2 = _edit_trace(rng, 1)
+
+    plain = BatchedList(4)
+    sharded = BatchedList(4)
+    sharded.place(make_mesh(2, 4))
+    for model in (plain, sharded):
+        model.extend_trace(*t1)
+        model.apply_trace_to_all(chunk=8)
+        model.extend_trace(*t2)
+        model.apply_trace_to_all(chunk=8)
+    for r in range(4):
+        assert sharded.read(r) == plain.read(r)
+
+
+def test_place_rejects_nondividing_replicas():
+    import pytest as _pytest
+
+    from crdt_tpu.parallel import make_mesh
+
+    model = BatchedList(3)
+    with _pytest.raises(ValueError):
+        model.place(make_mesh(2, 4))
+    # a rejected place() must leave the model fully usable
+    assert model._mesh is None
+    model.extend_trace([INSERT, INSERT], [0, 1], [1, 2], [0, 0])
+    model.apply_trace_to_all()
+    assert model.read(0) == [1, 2]
