@@ -173,7 +173,15 @@ def save(path: Union[str, os.PathLike], model) -> None:
         ins = model.op_kinds == INSERT
         values = np.zeros(model.engine.total_ids(), np.int32)
         values[model.op_handles[ins]] = model.op_vals[ins]
-        meta = {"kind": "list", "n_replicas": model.n_replicas, "applied": model._applied}
+        # Mesh placement is NOT persisted (a mesh names live devices;
+        # the restoring host's topology may differ). ``placed`` records
+        # that the caller should re-``place`` after load.
+        meta = {
+            "kind": "list",
+            "n_replicas": model.n_replicas,
+            "applied": model._applied,
+            "placed": model._mesh is not None,
+        }
         arrays = {
             "slots": model.slots,
             "vals": np.asarray(model.vals),
@@ -275,6 +283,15 @@ def load(path: Union[str, os.PathLike]):
         return model
     if meta["kind"] == "list":
         model = BatchedList(meta["n_replicas"])
+        if meta.get("placed"):
+            import warnings
+
+            warnings.warn(
+                "checkpointed BatchedList was mesh-placed; placement is "
+                "not persisted — call place(mesh) again on the restored "
+                "model before large-scale use",
+                stacklevel=2,
+            )
         _engine_restore(model.engine, arrays, arrays["id_values"])
         model.slots = arrays["slots"]
         assert (model.engine.total_order() == model.slots).all(), (

@@ -62,7 +62,11 @@ class BatchedList:
         element axis (the sequence-parallel analog, SURVEY.md §3.1 —
         identifier space across devices). Epoch scatters carry
         replicated indices and XLA partitions them; streamed universe
-        growth re-places after every slot re-permutation."""
+        growth re-places after every slot re-permutation.
+
+        Placement is per-session: it is not persisted by
+        ``crdt_tpu.checkpoint`` (a mesh names live devices) — re-call
+        ``place`` on a restored model."""
         from ..parallel.mesh import REPLICA_AXIS
 
         # Validate BEFORE installing: a rejected place() must leave the

@@ -64,27 +64,47 @@ def _present(ctr: jax.Array) -> jax.Array:
     return jnp.any(ctr > 0, axis=-1)
 
 
-def _apply_parked(ctr: jax.Array, dcl: jax.Array, dmask: jax.Array, dvalid: jax.Array) -> jax.Array:
+def _apply_parked(
+    ctr: jax.Array,
+    dcl: jax.Array,
+    dmask: jax.Array,
+    dvalid: jax.Array,
+    slot_chunk: int = 32,
+) -> jax.Array:
     """Replay every parked remove against the entry matrix (the oracle's
     ``_apply_rm`` partial application: zero dots the rm clock dominates,
-    for masked members only). Removes commute — scan order is free."""
+    for masked members only).
 
-    def step(ctr, slot):
-        cl, mask, valid = slot
-        dominated = mask[..., :, None] & (ctr <= cl[..., None, :]) & valid[..., None, None]
-        return jnp.where(dominated, jnp.zeros_like(ctr), ctr), None
-
-    # Move the D axis to the front for scan (batch axes stay in place).
+    Removal is monotone zeroing, so the per-slot condition against the
+    ORIGINAL ctr decides the final value exactly (a dot another slot
+    already zeroed would re-zero to the same 0) — slots can therefore
+    replay as an any-reduction over vectorized chunks instead of one
+    sequential pass per slot. That matters for ``fold_fused``, whose
+    epilogue flattens R·D slots: the scan is O(S) passes over the entry
+    matrix, the chunked form O(S / slot_chunk)."""
     d_axis = dcl.ndim - 2
-    ctr, _ = lax.scan(
-        step,
-        ctr,
-        (
-            jnp.moveaxis(dcl, d_axis, 0),
-            jnp.moveaxis(dmask, d_axis, 0),
-            jnp.moveaxis(dvalid, d_axis, 0),
-        ),
-    )
+    s = dcl.shape[d_axis]
+    chunk = min(slot_chunk, max(s, 1))
+    pad = (-s) % chunk
+    dcl = jnp.moveaxis(dcl, d_axis, 0)
+    dmask = jnp.moveaxis(dmask, d_axis, 0)
+    dvalid = jnp.moveaxis(dvalid, -1, 0)
+    if pad:
+        # Invalid padding slots dominate nothing.
+        zpad = lambda x: jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
+        dcl, dmask, dvalid = zpad(dcl), zpad(dmask), zpad(dvalid)
+
+    def step(ctr, slots):
+        cl, mask, valid = slots  # [C, ..., A], [C, ..., E], [C, ...]
+        dominated = (
+            mask[..., :, None]
+            & (ctr[None] <= cl[..., None, :])
+            & valid[..., None, None]
+        )
+        return jnp.where(jnp.any(dominated, axis=0), 0, ctr), None
+
+    reshape = lambda x: x.reshape((-1, chunk) + x.shape[1:])
+    ctr, _ = lax.scan(step, ctr, (reshape(dcl), reshape(dmask), reshape(dvalid)))
     return ctr
 
 
