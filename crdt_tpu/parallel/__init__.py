@@ -51,8 +51,11 @@ from .collectives import (
 from .anti_entropy import (
     mesh_fold,
     mesh_fold_clocks,
+    mesh_fold_gset,
+    mesh_fold_lww,
     mesh_fold_map,
     mesh_fold_map_orswot,
+    mesh_fold_mvreg,
     mesh_fold_nested_map,
     mesh_gossip,
 )
@@ -66,6 +69,9 @@ __all__ = [
     "shard_nested_map",
     "mesh_fold_map_orswot",
     "mesh_fold_nested_map",
+    "mesh_fold_gset",
+    "mesh_fold_lww",
+    "mesh_fold_mvreg",
     "REPLICA_AXIS",
     "ELEMENT_AXIS",
     "make_mesh",

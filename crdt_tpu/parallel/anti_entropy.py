@@ -256,6 +256,100 @@ def mesh_fold_nested_map(
     )
 
 
+def mesh_fold_gset(present: jax.Array, mesh: Mesh) -> jax.Array:
+    """Converge a GSet replica batch ``present[R, M]`` over the mesh:
+    member-sharded set union (logical OR) with the replica axis reduced —
+    the simplest lattice (reference: src/gset.rs ``CvRDT::merge``).
+    Returns the converged membership ``[M]`` (member-sharded)."""
+    rsize = mesh.shape[REPLICA_AXIS]
+    pad_r = (-present.shape[0]) % rsize
+    if pad_r:
+        present = jnp.pad(present, ((0, pad_r), (0, 0)))
+    esize = mesh.shape[ELEMENT_AXIS]
+    pad_m = (-present.shape[1]) % esize
+    if pad_m:
+        present = jnp.pad(present, ((0, 0), (0, pad_m)))
+    m = present.shape[1] - pad_m
+
+    def build():
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(P(REPLICA_AXIS, ELEMENT_AXIS),),
+            out_specs=P(ELEMENT_AXIS),
+            check_vma=False,
+        )
+        def fold_fn(local):
+            return (
+                lax.psum(
+                    jnp.any(local, axis=0).astype(jnp.int32), REPLICA_AXIS
+                )
+                > 0
+            )
+
+        return fold_fn
+
+    metrics.count("anti_entropy.gset_fold_rounds")
+    metrics.observe("anti_entropy.state_bytes", float(present.nbytes))
+    with metrics.time("anti_entropy.gset_fold"):
+        out = _cached("gset_fold", present, mesh, build)(present)
+        jax.block_until_ready(out)
+    return out[:m]
+
+
+def mesh_fold_lww(states, mesh: Mesh):
+    """Converge an LWWReg replica batch (LWWState with leading axis R)
+    over the mesh's replica axis. Returns ``(state, conflict)``;
+    conflict marks an equal-marker/different-value merge anywhere
+    (reference: src/lwwreg.rs validate_merge)."""
+    from ..ops import lwwreg as lww_ops
+
+    rsize = mesh.shape[REPLICA_AXIS]
+    pad_r = (-states.hi.shape[0]) % rsize
+    if pad_r:
+        ident = lww_ops.empty(batch=(pad_r,))
+        states = jax.tree.map(
+            lambda x, p: jnp.concatenate([x, p.astype(x.dtype)], axis=0),
+            states,
+            ident,
+        )
+
+    template = lww_ops.empty()
+    return _mesh_fold_lattice(
+        "lww_fold", states, mesh,
+        lww_ops.join, lww_ops.fold,
+        jax.tree.map(lambda _: P(REPLICA_AXIS), template),
+        jax.tree.map(lambda _: P(), template),
+    )
+
+
+def mesh_fold_mvreg(states, mesh: Mesh):
+    """Converge an MVReg replica batch (MVRegState with leading axis R)
+    over the mesh's replica axis: dominated contents die, concurrent
+    siblings survive (reference: src/mvreg.rs ``CvRDT::merge``).
+    Returns ``(state, overflow)``."""
+    from ..ops import mvreg as mv
+
+    rsize = mesh.shape[REPLICA_AXIS]
+    pad_r = (-states.wact.shape[0]) % rsize
+    s, a = states.wact.shape[-1], states.clk.shape[-1]
+    if pad_r:
+        ident = mv.empty(s, a, batch=(pad_r,))
+        states = jax.tree.map(
+            lambda x, p: jnp.concatenate([x, p.astype(x.dtype)], axis=0),
+            states,
+            ident,
+        )
+
+    template = mv.empty(s, a)
+    return _mesh_fold_lattice(
+        "mvreg_fold", states, mesh,
+        mv.join, mv.fold,
+        jax.tree.map(lambda _: P(REPLICA_AXIS), template),
+        jax.tree.map(lambda _: P(), template),
+    )
+
+
 def mesh_fold_clocks(clocks: jax.Array, mesh: Mesh) -> jax.Array:
     """Converge a batch of vector clocks [R, A] (VClock / GCounter /
     PNCounter states) over the mesh: local max + ``pmax`` across the

@@ -309,3 +309,62 @@ def test_mesh_fold_nested_map_bit_identical(mesh_shape, seed):
     for r in states[1:]:
         expect.merge(r.clone())
     assert out.to_pure(0) == expect
+
+
+def test_mesh_fold_gset_lww_mvreg_bit_identical():
+    import random
+
+    from crdt_tpu.models import BatchedGSet, BatchedLWWReg, BatchedMVReg
+    from crdt_tpu.parallel import mesh_fold_gset, mesh_fold_lww, mesh_fold_mvreg
+    from crdt_tpu.pure.gset import GSet
+    from crdt_tpu.pure.lwwreg import LWWReg
+    from crdt_tpu.pure.mvreg import MVReg
+    from crdt_tpu.utils import Interner
+
+    rng = random.Random(8)
+    mesh = make_mesh(4, 2)
+
+    # GSet: 6 replicas over an 11-member universe (pads replica AND
+    # member axes — 11 does not divide the element axis, so the trim
+    # path is exercised)
+    members = list(range(11))
+    sets = [GSet() for _ in range(6)]
+    for s in sets:
+        for m in rng.sample(members, rng.randint(0, 6)):
+            s.apply(s.insert(m))
+    gmodel = BatchedGSet.from_pure(sets, members=Interner(members))
+    folded = mesh_fold_gset(gmodel.present, mesh)
+    expect = sets[0].clone()
+    for s in sets[1:]:
+        expect.merge(s.clone())
+    got = {members[i] for i in range(11) if bool(folded[i])}
+    assert got == expect.read()
+
+    # LWWReg: max-marker write wins across the mesh
+    regs = [LWWReg() for _ in range(6)]
+    for i, r in enumerate(regs):
+        r.apply(r.update(val=i * 10, marker=(i * 7) % 11))
+    lmodel = BatchedLWWReg.from_pure(regs)
+    lfolded, conflict = mesh_fold_lww(lmodel.state, mesh)
+    assert not bool(conflict.any())
+    expect = regs[0].clone()
+    for r in regs[1:]:
+        expect.merge(r.clone())
+    assert lmodel.values[int(lfolded.val)] == expect.val
+
+    # MVReg: concurrent writes from distinct actors survive as siblings
+    sites = [MVReg() for _ in range(4)]
+    ops = []
+    for i, (site, actor) in enumerate(zip(sites, "wxyz")):
+        ops.append(site.write(i, site.read().derive_add_ctx(actor)))
+        site.apply(ops[-1])
+    mmodel = BatchedMVReg.from_pure(sites, n_slots=8)
+    mfolded, overflow = mesh_fold_mvreg(mmodel.state, mesh)
+    assert not bool(overflow.any())
+    expect = sites[0].clone()
+    for s in sites[1:]:
+        expect.merge(s.clone())
+    out = BatchedMVReg(1, mfolded.clk.shape[-1], mfolded.wact.shape[-1],
+                       actors=mmodel.actors, values=mmodel.values)
+    out.state = jax.tree.map(lambda x: x[None], mfolded)
+    assert out.to_pure(0) == expect
