@@ -58,6 +58,8 @@ from .anti_entropy import (
     mesh_fold_mvreg,
     mesh_fold_nested_map,
     mesh_gossip,
+    mesh_gossip_map,
+    mesh_gossip_map_orswot,
 )
 from . import multihost
 
@@ -72,6 +74,8 @@ __all__ = [
     "mesh_fold_gset",
     "mesh_fold_lww",
     "mesh_fold_mvreg",
+    "mesh_gossip_map",
+    "mesh_gossip_map_orswot",
     "REPLICA_AXIS",
     "ELEMENT_AXIS",
     "make_mesh",

@@ -115,49 +115,90 @@ def mesh_fold(state: OrswotState, mesh: Mesh) -> Tuple[OrswotState, jax.Array]:
     return out
 
 
-def mesh_gossip(
-    state: OrswotState, mesh: Mesh, rounds: Optional[int] = None
-) -> Tuple[OrswotState, jax.Array]:
-    """Ring anti-entropy: each device folds its local replica block, then
-    runs ``rounds`` unit-shift gossip rounds (default P-1, which fully
-    converges the ring). Bandwidth per round is one state per ICI link —
-    the bounded-traffic mode for DCN-crossing replica axes.
-
-    Returns (per-device states [P, ...], overflow): with the default
-    round count every row equals the full join.
-    """
-    rsize = mesh.shape[REPLICA_AXIS]
+def _mesh_gossip_lattice(
+    kind: str,
+    state,
+    mesh: Mesh,
+    join_fn,
+    fold_fn,
+    in_specs,
+    rounds: Optional[int] = None,
+):
+    """Shared scaffold for ring anti-entropy: each device folds its
+    local replica block, then runs ``rounds`` unit-shift gossip rounds.
+    Bandwidth per round is one state per link — the bounded-traffic mode
+    for DCN-crossing replica axes. Returns (per-device states [P, ...],
+    overflow); with the default rounds = P-1 every row equals the full
+    join."""
     if rounds is None:
-        rounds = rsize - 1
-    state = pad_replicas(state, rsize)
-    state = pad_elements(state, mesh.shape[ELEMENT_AXIS])
+        rounds = mesh.shape[REPLICA_AXIS] - 1
 
     def build():
         @partial(
             jax.shard_map,
             mesh=mesh,
-            in_specs=(orswot_specs(),),
-            out_specs=(orswot_specs(), P()),
+            in_specs=(in_specs,),
+            out_specs=(in_specs, P()),
             check_vma=False,
         )
         def gossip_fn(local):
-            folded, of = ops.fold(local)
+            folded, of = fold_fn(local)
             for _ in range(rounds):
                 folded, of_r = ring_round(
-                    folded, REPLICA_AXIS, reduce_overflow=False
+                    folded, REPLICA_AXIS, reduce_overflow=False, join_fn=join_fn
                 )
                 of = of | of_r
             of = lax.psum(of.astype(jnp.int32), REPLICA_AXIS) > 0
+            of = lax.psum(of.astype(jnp.int32), ELEMENT_AXIS) > 0
             return jax.tree.map(lambda x: x[None], folded), of
 
         return gossip_fn
 
-    metrics.count("anti_entropy.gossip_rounds", rounds)
+    metrics.count(f"anti_entropy.{kind}_rounds", rounds)
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
-    with metrics.time("anti_entropy.gossip"):
-        out = _cached("orswot_gossip", state, mesh, build, rounds)(state)
+    with metrics.time(f"anti_entropy.{kind}"):
+        out = _cached(kind, state, mesh, build, rounds)(state)
         jax.block_until_ready(out)  # time device work, not async dispatch
     return out
+
+
+def mesh_gossip(
+    state: OrswotState, mesh: Mesh, rounds: Optional[int] = None
+) -> Tuple[OrswotState, jax.Array]:
+    """Ring anti-entropy for ORSWOT replica batches (see
+    ``_mesh_gossip_lattice``)."""
+    state = pad_replicas(state, mesh.shape[REPLICA_AXIS])
+    state = pad_elements(state, mesh.shape[ELEMENT_AXIS])
+    return _mesh_gossip_lattice(
+        "orswot_gossip", state, mesh, ops.join, ops.fold, orswot_specs(), rounds
+    )
+
+
+def mesh_gossip_map(
+    state: MapState, mesh: Mesh, rounds: Optional[int] = None
+) -> Tuple[MapState, jax.Array]:
+    """Ring anti-entropy for the composition layer: Map<K, MVReg>
+    replica blocks gossiped one neighbor per round over the replica
+    axis, key shards independent."""
+    state = pad_replicas_map(state, mesh.shape[REPLICA_AXIS])
+    state = pad_keys(state, mesh.shape[ELEMENT_AXIS])
+    return _mesh_gossip_lattice(
+        "map_gossip", state, mesh, map_ops.join, map_ops.fold, map_specs(), rounds
+    )
+
+
+def mesh_gossip_map_orswot(
+    state: MapOrswotState, mesh: Mesh, rounds: Optional[int] = None
+) -> Tuple[MapOrswotState, jax.Array]:
+    """Ring anti-entropy for ``Map<K, Orswot>`` replica blocks (the
+    Val-generic slab composition) over the replica axis."""
+    state = pad_map_orswot(state, mesh.shape[REPLICA_AXIS], mesh.shape[ELEMENT_AXIS])
+    return _mesh_gossip_lattice(
+        "map_orswot_gossip", state, mesh,
+        partial(mo_ops.join, element_axis=ELEMENT_AXIS),
+        partial(mo_ops.fold, element_axis=ELEMENT_AXIS),
+        map_orswot_specs(), rounds,
+    )
 
 
 def _mesh_fold_lattice(

@@ -368,3 +368,58 @@ def test_mesh_fold_gset_lww_mvreg_bit_identical():
                        actors=mmodel.actors, values=mmodel.values)
     out.state = jax.tree.map(lambda x: x[None], mfolded)
     assert out.to_pure(0) == expect
+
+
+def test_mesh_gossip_map_family_converges_to_fold():
+    import random
+
+    import numpy as np
+
+    from crdt_tpu.models import BatchedMap
+    from crdt_tpu.parallel import (
+        mesh_fold_map,
+        mesh_fold_map_orswot,
+        mesh_gossip_map,
+        mesh_gossip_map_orswot,
+        shard_map_orswot,
+        shard_map_state,
+    )
+    from crdt_tpu.utils import Interner
+    from test_map import mv_map, put
+    from test_models_map_nested import _batched, _site_run_set
+
+    mesh = make_mesh(4, 2)
+
+    # Map<K, MVReg>: after P-1 ring rounds every device row equals the fold.
+    rng = random.Random(6)
+    reps = [mv_map() for _ in range(8)]
+    for i, m in enumerate(reps):
+        put(m, f"s{i}", rng.choice("pq"), i)
+    batched = BatchedMap.from_pure(
+        reps,
+        keys=Interner(list("pq")),
+        actors=Interner([f"s{i}" for i in range(8)]),
+        sibling_cap=16, deferred_cap=16,
+    )
+    sharded = shard_map_state(batched.state, mesh)
+    gossiped, g_of = mesh_gossip_map(sharded, mesh)
+    folded, f_of = mesh_fold_map(sharded, mesh)
+    assert not bool(g_of.any()) and not bool(f_of.any())
+    for leaf_g, leaf_f in zip(jax.tree.leaves(gossiped), jax.tree.leaves(folded)):
+        g = np.asarray(leaf_g)
+        f = np.asarray(leaf_f)
+        for row in range(g.shape[0]):
+            np.testing.assert_array_equal(g[row], f)
+
+    # Map<K, Orswot>: same property on the slab-composed type.
+    states = _site_run_set(rng, n_cmds=12)
+    mo = _batched(states)
+    mo_sharded = shard_map_orswot(mo.state, mesh)
+    g2, g2_of = mesh_gossip_map_orswot(mo_sharded, mesh)
+    f2, f2_of = mesh_fold_map_orswot(mo_sharded, mesh)
+    assert not bool(g2_of.any()) and not bool(f2_of.any())
+    for leaf_g, leaf_f in zip(jax.tree.leaves(g2), jax.tree.leaves(f2)):
+        g = np.asarray(leaf_g)
+        f = np.asarray(leaf_f)
+        for row in range(g.shape[0]):
+            np.testing.assert_array_equal(g[row], f)
