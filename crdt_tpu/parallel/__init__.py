@@ -60,6 +60,7 @@ from .anti_entropy import (
     mesh_gossip,
     mesh_gossip_map,
     mesh_gossip_map_orswot,
+    mesh_gossip_nested_map,
 )
 from . import multihost
 
@@ -76,6 +77,7 @@ __all__ = [
     "mesh_fold_mvreg",
     "mesh_gossip_map",
     "mesh_gossip_map_orswot",
+    "mesh_gossip_nested_map",
     "REPLICA_AXIS",
     "ELEMENT_AXIS",
     "make_mesh",

@@ -148,8 +148,7 @@ def _mesh_gossip_lattice(
                     folded, REPLICA_AXIS, reduce_overflow=False, join_fn=join_fn
                 )
                 of = of | of_r
-            of = lax.psum(of.astype(jnp.int32), REPLICA_AXIS) > 0
-            of = lax.psum(of.astype(jnp.int32), ELEMENT_AXIS) > 0
+            of = lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS)) > 0
             return jax.tree.map(lambda x: x[None], folded), of
 
         return gossip_fn
@@ -198,6 +197,20 @@ def mesh_gossip_map_orswot(
         partial(mo_ops.join, element_axis=ELEMENT_AXIS),
         partial(mo_ops.fold, element_axis=ELEMENT_AXIS),
         map_orswot_specs(), rounds,
+    )
+
+
+def mesh_gossip_nested_map(
+    state: NestedMapState, mesh: Mesh, rounds: Optional[int] = None
+) -> Tuple[NestedMapState, jax.Array]:
+    """Ring anti-entropy for ``Map<K1, Map<K2, MVReg>>`` replica blocks
+    over the replica axis."""
+    state = pad_nested_map(state, mesh.shape[REPLICA_AXIS], mesh.shape[ELEMENT_AXIS])
+    return _mesh_gossip_lattice(
+        "nested_map_gossip", state, mesh,
+        partial(nested_ops.join, element_axis=ELEMENT_AXIS),
+        partial(nested_ops.fold, element_axis=ELEMENT_AXIS),
+        nested_map_specs(), rounds,
     )
 
 

@@ -405,11 +405,16 @@ def test_mesh_gossip_map_family_converges_to_fold():
     gossiped, g_of = mesh_gossip_map(sharded, mesh)
     folded, f_of = mesh_fold_map(sharded, mesh)
     assert not bool(g_of.any()) and not bool(f_of.any())
-    for leaf_g, leaf_f in zip(jax.tree.leaves(gossiped), jax.tree.leaves(folded)):
-        g = np.asarray(leaf_g)
-        f = np.asarray(leaf_f)
-        for row in range(g.shape[0]):
-            np.testing.assert_array_equal(g[row], f)
+    def assert_rows_equal(gossiped_state, folded_state):
+        for leaf_g, leaf_f in zip(
+            jax.tree.leaves(gossiped_state), jax.tree.leaves(folded_state)
+        ):
+            g = np.asarray(leaf_g)
+            f = np.asarray(leaf_f)
+            for row in range(g.shape[0]):
+                np.testing.assert_array_equal(g[row], f)
+
+    assert_rows_equal(gossiped, folded)
 
     # Map<K, Orswot>: same property on the slab-composed type.
     states = _site_run_set(rng, n_cmds=12)
@@ -418,8 +423,16 @@ def test_mesh_gossip_map_family_converges_to_fold():
     g2, g2_of = mesh_gossip_map_orswot(mo_sharded, mesh)
     f2, f2_of = mesh_fold_map_orswot(mo_sharded, mesh)
     assert not bool(g2_of.any()) and not bool(f2_of.any())
-    for leaf_g, leaf_f in zip(jax.tree.leaves(g2), jax.tree.leaves(f2)):
-        g = np.asarray(leaf_g)
-        f = np.asarray(leaf_f)
-        for row in range(g.shape[0]):
-            np.testing.assert_array_equal(g[row], f)
+    assert_rows_equal(g2, f2)
+
+    # Map<K1, Map<K2, MVReg>>: nested gossip converges to the nested fold.
+    from crdt_tpu.parallel import mesh_fold_nested_map, mesh_gossip_nested_map, shard_nested_map
+    from test_models_map_nested import _nbatched, _site_run_nested
+
+    nstates = _site_run_nested(rng, n_cmds=10)
+    nm = _nbatched(nstates)
+    nm_sharded = shard_nested_map(nm.state, mesh)
+    g3, g3_of = mesh_gossip_nested_map(nm_sharded, mesh)
+    f3, f3_of = mesh_fold_nested_map(nm_sharded, mesh)
+    assert not bool(g3_of.any()) and not bool(f3_of.any())
+    assert_rows_equal(g3, f3)
