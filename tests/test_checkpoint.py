@@ -10,6 +10,8 @@ from crdt_tpu.utils import Interner
 
 from test_map import mv_map, put
 from test_orswot import _site_run, add
+from test_models_map_nested import _batched, _nbatched, _site_run_nested, _site_run_set
+from test_streamed_lists import _edit_trace
 
 
 def test_orswot_checkpoint_round_trip(tmp_path):
@@ -98,7 +100,6 @@ def test_nested_models_checkpoint_round_trip(tmp_path):
 
     from crdt_tpu.checkpoint import load, save
     from crdt_tpu.models import BatchedMapOrswot, BatchedNestedMap
-    from test_models_map_nested import _batched, _nbatched, _site_run_nested, _site_run_set
 
     rng = random.Random(9)
     mo = _batched(_site_run_set(rng, n_cmds=14))
@@ -124,7 +125,6 @@ def test_list_checkpoint_round_trip_and_resume(tmp_path):
 
     from crdt_tpu.checkpoint import load, save
     from crdt_tpu.models import BatchedList
-    from test_streamed_lists import _edit_trace
 
     rng = random.Random(4)
     t1 = _edit_trace(rng, 40)

@@ -8,6 +8,9 @@ Runs on the 8-virtual-CPU-device mesh from conftest.py.
 import jax
 import jax.numpy as jnp
 import pytest
+from test_map import mv_map, put
+from test_models_map_nested import _batched, _site_run_set
+from test_models_map_nested import _nbatched, _site_run_nested
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
@@ -243,7 +246,6 @@ def test_mesh_fold_map_orswot_bit_identical(mesh_shape, seed):
 
     from crdt_tpu.models import BatchedMapOrswot
     from crdt_tpu.parallel import mesh_fold_map_orswot, shard_map_orswot
-    from test_models_map_nested import _batched, _site_run_set
 
     rng = random.Random(seed)
     states = _site_run_set(rng, n_cmds=14)
@@ -279,7 +281,6 @@ def test_mesh_fold_nested_map_bit_identical(mesh_shape, seed):
 
     from crdt_tpu.models import BatchedNestedMap
     from crdt_tpu.parallel import mesh_fold_nested_map, shard_nested_map
-    from test_models_map_nested import _nbatched, _site_run_nested
 
     rng = random.Random(seed)
     states = _site_run_nested(rng, n_cmds=12)
@@ -385,8 +386,6 @@ def test_mesh_gossip_map_family_converges_to_fold():
         shard_map_state,
     )
     from crdt_tpu.utils import Interner
-    from test_map import mv_map, put
-    from test_models_map_nested import _batched, _site_run_set
 
     mesh = make_mesh(4, 2)
 
@@ -427,7 +426,6 @@ def test_mesh_gossip_map_family_converges_to_fold():
 
     # Map<K1, Map<K2, MVReg>>: nested gossip converges to the nested fold.
     from crdt_tpu.parallel import mesh_fold_nested_map, mesh_gossip_nested_map, shard_nested_map
-    from test_models_map_nested import _nbatched, _site_run_nested
 
     nstates = _site_run_nested(rng, n_cmds=10)
     nm = _nbatched(nstates)
