@@ -1,0 +1,22 @@
+"""Shared example bootstrap: honor a JAX_PLATFORMS=cpu request robustly.
+
+On this development image a sitecustomize registers an experimental TPU
+tunnel backend whose mere enumeration can hang when the tunnel is down;
+when the caller asked for CPU, pin the platform through jax.config and
+drop that factory (a no-op on machines without it)."""
+
+import os
+
+
+def pin_platform():
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge
+
+        xla_bridge._backend_factories.pop("axon", None)
+    except Exception:
+        pass
