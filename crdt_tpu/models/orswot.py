@@ -191,14 +191,18 @@ class BatchedOrswot:
         )
 
     def fold(self) -> Orswot:
-        """Full-mesh anti-entropy: join all R replicas in a log2 reduction
-        tree and return the converged oracle-form state."""
+        """Full-mesh anti-entropy: join all R replicas into the converged
+        oracle-form state — via the fused one-HBM-pass Pallas fold on TPU
+        backends, the jnp log2 reduction tree elsewhere (bit-identical
+        either way; ops/pallas_kernels.py ``fold_auto``)."""
+        from ..ops.pallas_kernels import fold_auto
+
         metrics.count("orswot.merges", max(self.n_replicas - 1, 0))
         metrics.observe(
             "orswot.deferred_depth",
             float(jnp.sum(self.state.dvalid)) / max(self.n_replicas, 1),
         )
-        folded, overflow = ops.fold(self.state)
+        folded, overflow = fold_auto(self.state)
         if bool(overflow):
             raise DeferredOverflow(
                 f"fold: deferred buffer full (cap {self.state.dvalid.shape[-1]})"

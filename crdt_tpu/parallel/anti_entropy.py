@@ -78,16 +78,22 @@ def _cached(kind: str, state, mesh: Mesh, build, *extra):
     return fn
 
 
-def mesh_fold(state: OrswotState, mesh: Mesh) -> Tuple[OrswotState, jax.Array]:
+def mesh_fold(
+    state: OrswotState, mesh: Mesh, local_fold: str = "auto"
+) -> Tuple[OrswotState, jax.Array]:
     """Full-mesh anti-entropy over the device mesh: every replica's state
     joined into one converged state, in one collective round.
 
-    Plan: fold the device-local replica block in a log2 tree (pure local
-    compute), then one lattice-join all-reduce across the ``replica``
-    mesh axis. Element shards never communicate — the join is
-    element-parallel (mesh.py). Returns (converged state [no replica
+    Plan: fold the device-local replica block (the fused one-HBM-pass
+    Pallas kernel on TPU backends, the jnp log2 tree elsewhere —
+    ``local_fold`` = "auto"|"fused"|"tree", see pallas_kernels
+    ``fold_auto``), then one lattice-join all-reduce across the
+    ``replica`` mesh axis. Element shards never communicate — the join
+    is element-parallel (mesh.py). Returns (converged state [no replica
     axis, element-sharded], overflow flag).
     """
+    from ..ops.pallas_kernels import fold_auto
+
     state = pad_replicas(state, mesh.shape[REPLICA_AXIS])
     state = pad_elements(state, mesh.shape[ELEMENT_AXIS])
 
@@ -100,7 +106,7 @@ def mesh_fold(state: OrswotState, mesh: Mesh) -> Tuple[OrswotState, jax.Array]:
             check_vma=False,
         )
         def fold_fn(local):
-            folded, of_local = ops.fold(local)
+            folded, of_local = fold_auto(local, prefer=local_fold)
             joined, of_cross = all_reduce_join(folded, REPLICA_AXIS)
             of = (lax.psum(of_local.astype(jnp.int32), REPLICA_AXIS) > 0) | of_cross
             return joined, of
@@ -110,7 +116,7 @@ def mesh_fold(state: OrswotState, mesh: Mesh) -> Tuple[OrswotState, jax.Array]:
     metrics.count("anti_entropy.fold_rounds")
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
     with metrics.time("anti_entropy.fold"):
-        out = _cached("orswot_fold", state, mesh, build)(state)
+        out = _cached("orswot_fold", state, mesh, build, local_fold)(state)
         jax.block_until_ready(out)  # time device work, not async dispatch
     return out
 
@@ -123,6 +129,7 @@ def _mesh_gossip_lattice(
     fold_fn,
     in_specs,
     rounds: Optional[int] = None,
+    cache_extra: tuple = (),
 ):
     """Shared scaffold for ring anti-entropy: each device folds its
     local replica block, then runs ``rounds`` unit-shift gossip rounds.
@@ -156,20 +163,28 @@ def _mesh_gossip_lattice(
     metrics.count(f"anti_entropy.{kind}_rounds", rounds)
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
     with metrics.time(f"anti_entropy.{kind}"):
-        out = _cached(kind, state, mesh, build, rounds)(state)
+        out = _cached(kind, state, mesh, build, rounds, *cache_extra)(state)
         jax.block_until_ready(out)  # time device work, not async dispatch
     return out
 
 
 def mesh_gossip(
-    state: OrswotState, mesh: Mesh, rounds: Optional[int] = None
+    state: OrswotState,
+    mesh: Mesh,
+    rounds: Optional[int] = None,
+    local_fold: str = "auto",
 ) -> Tuple[OrswotState, jax.Array]:
     """Ring anti-entropy for ORSWOT replica batches (see
-    ``_mesh_gossip_lattice``)."""
+    ``_mesh_gossip_lattice``); the device-local pre-fold dispatches like
+    ``mesh_fold`` (fused Pallas on TPU backends)."""
+    from ..ops.pallas_kernels import fold_auto
+
     state = pad_replicas(state, mesh.shape[REPLICA_AXIS])
     state = pad_elements(state, mesh.shape[ELEMENT_AXIS])
     return _mesh_gossip_lattice(
-        "orswot_gossip", state, mesh, ops.join, ops.fold, orswot_specs(), rounds
+        "orswot_gossip", state, mesh, ops.join,
+        partial(fold_auto, prefer=local_fold), orswot_specs(), rounds,
+        cache_extra=(local_fold,),
     )
 
 

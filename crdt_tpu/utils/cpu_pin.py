@@ -1,0 +1,50 @@
+"""Pin JAX onto the host CPU backend, robustly, for tests and tools.
+
+This development image's sitecustomize registers an experimental TPU
+tunnel backend ("axon") whose mere enumeration can hang when the tunnel
+is down, and it imports jax at interpreter startup — so plain env-var
+overrides are sometimes too late. The one reliable recipe (used by the
+test suite, the multihost worker processes, and the runnable examples)
+lives here: set the platform through ``jax.config`` AND drop the axon
+backend factory before first backend initialization.
+
+Must be called before anything queries devices (``jax.devices()``,
+first jit execution); importing jax or crdt_tpu beforehand is fine —
+backend initialization is lazy.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def pin_cpu(virtual_devices: int | None = None) -> None:
+    """Force the CPU backend, optionally with N virtual devices.
+
+    ``virtual_devices`` sets ``--xla_force_host_platform_device_count``
+    in XLA_FLAGS, replacing any count inherited from a parent process
+    (multihost worker processes want their own per-process count).
+    """
+    if virtual_devices:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            os.environ.get("XLA_FLAGS", ""),
+        ).strip()
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={virtual_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge
+
+        xla_bridge._backend_factories.pop("axon", None)
+    except Exception:
+        # Private API — if it moves, the jax.config pin alone still
+        # selects CPU; only the hung-tunnel enumeration hazard returns.
+        pass

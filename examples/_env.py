@@ -1,22 +1,19 @@
 """Shared example bootstrap: honor a JAX_PLATFORMS=cpu request robustly.
 
-On this development image a sitecustomize registers an experimental TPU
-tunnel backend whose mere enumeration can hang when the tunnel is down;
-when the caller asked for CPU, pin the platform through jax.config and
-drop that factory (a no-op on machines without it)."""
+Thin wrapper over :mod:`crdt_tpu.utils.cpu_pin` (the one copy of the
+pin-CPU / drop-axon-backend recipe) that only acts when the caller asked
+for CPU via the environment.
+"""
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def pin_platform():
     if os.environ.get("JAX_PLATFORMS") != "cpu":
         return
-    import jax
+    from crdt_tpu.utils.cpu_pin import pin_cpu
 
-    try:
-        jax.config.update("jax_platforms", "cpu")
-        from jax._src import xla_bridge
-
-        xla_bridge._backend_factories.pop("axon", None)
-    except Exception:
-        pass
+    pin_cpu()

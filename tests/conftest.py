@@ -1,34 +1,20 @@
 """Test config: force JAX onto CPU with 8 virtual devices so the multi-chip
 sharding paths (crdt_tpu.parallel) compile and run without TPU hardware.
 
-Hazards handled here:
-- the host sitecustomize imports jax at interpreter startup with
-  ``JAX_PLATFORMS=axon`` (the real-TPU tunnel), so env overrides in this
-  file are too late — the platform must be forced via ``jax.config``;
-- a wedged tunnel can hang any touch of the axon backend, so its backend
-  factory is removed outright before first backend initialization.
+The pin-CPU / drop-axon-backend recipe (and why env vars alone are not
+enough on this image) lives in ``crdt_tpu.utils.cpu_pin``.
 """
 
 import os
+import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from crdt_tpu.utils.cpu_pin import pin_cpu
+
+pin_cpu(virtual_devices=8)
 
 import jax
-
-jax.config.update("jax_platforms", "cpu")
-
-from jax._src import xla_bridge
-
-for _plugin in ("axon",):
-    try:
-        xla_bridge._backend_factories.pop(_plugin, None)
-    except Exception:
-        pass
 
 assert jax.default_backend() == "cpu", jax.default_backend()
 assert len(jax.devices()) == 8, jax.devices()

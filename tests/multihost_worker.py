@@ -11,20 +11,13 @@ import sys
 
 port, pid = sys.argv[1], int(sys.argv[2])
 
-flags = os.environ.get("XLA_FLAGS", "")
-os.environ["XLA_FLAGS"] = (
-    flags + " --xla_force_host_platform_device_count=4"
-).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from crdt_tpu.utils.cpu_pin import pin_cpu
+
+pin_cpu(virtual_devices=4)
 
 import jax
-
-jax.config.update("jax_platforms", "cpu")
-from jax._src import xla_bridge
-
-xla_bridge._backend_factories.pop("axon", None)
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from crdt_tpu.parallel import multihost
 

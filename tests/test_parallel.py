@@ -434,3 +434,34 @@ def test_mesh_gossip_map_family_converges_to_fold():
     f3, f3_of = mesh_fold_nested_map(nm_sharded, mesh)
     assert not bool(g3_of.any()) and not bool(f3_of.any())
     assert_rows_equal(g3, f3)
+
+
+def test_mesh_fold_fused_local_matches_tree():
+    """The device-local pre-fold inside mesh_fold/mesh_gossip dispatches
+    to the fused Pallas kernel on TPU backends (fold_auto). Force both
+    modes here (fused runs the same kernel code in interpret mode on the
+    CPU mesh) and pin bit-identical results through the collective."""
+    import numpy as np
+
+    from crdt_tpu.ops import orswot as oo
+
+    rng = np.random.default_rng(11)
+    r, e, a = 8, 24, 4
+    ctr = rng.integers(0, 30, (r, e, a)).astype(np.uint32)
+    ctr[rng.random((r, e, a)) < 0.4] = 0
+    top = ctr.max(axis=1)
+    state = oo.empty(e, a, deferred_cap=4, batch=(r,))
+    state = state._replace(top=jnp.asarray(top), ctr=jnp.asarray(ctr))
+
+    mesh = make_mesh(4, 2)
+    sharded = shard_orswot(state, mesh)
+    tree, of_t = mesh_fold(sharded, mesh, local_fold="tree")
+    fused, of_f = mesh_fold(sharded, mesh, local_fold="fused")
+    for x, y in zip(jax.tree.leaves(tree), jax.tree.leaves(fused)):
+        assert bool(jnp.array_equal(x, y))
+    assert bool(of_t) == bool(of_f)
+
+    g_tree, _ = mesh_gossip(sharded, mesh, local_fold="tree")
+    g_fused, _ = mesh_gossip(sharded, mesh, local_fold="fused")
+    for x, y in zip(jax.tree.leaves(g_tree), jax.tree.leaves(g_fused)):
+        assert bool(jnp.array_equal(x, y))
