@@ -67,4 +67,79 @@ assert not bool(of2)
 np.testing.assert_array_equal(result.top, np.asarray(expect.top))
 np.testing.assert_array_equal(result.ctr, np.asarray(expect.ctr))
 
+# Ring gossip over the same multi-host mesh: P-1 unit-shift rounds over
+# the DCN-facing replica axis must leave every device row equal to the
+# full-mesh fold (bounded bandwidth, same converged state).
+from jax.experimental import multihost_utils
+
+from crdt_tpu.parallel import mesh_gossip
+
+gossiped, g_of = mesh_gossip(gstate, mesh)
+assert not bool(np.asarray(jax.device_get(g_of)))
+g_local = multihost_utils.global_array_to_host_local_array(
+    gossiped, mesh, orswot_specs()
+)
+for row in range(np.asarray(g_local.top).shape[0]):
+    np.testing.assert_array_equal(
+        np.asarray(g_local.top)[row], np.asarray(expect.top)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(g_local.ctr)[row], np.asarray(expect.ctr)
+    )
+
+# Composition layer across processes: Map<K, MVReg> mesh fold on the
+# same mesh, bit-identical to the single-device map fold (the nested
+# clock/sibling join crossing DCN, not just the flat set).
+from crdt_tpu.ops import map as map_ops
+from crdt_tpu.parallel import mesh_fold_map
+from crdt_tpu.parallel.mesh import map_specs
+
+# Sibling cap 8: the 8 replicas write under 4 distinct actors x 2 slot
+# counters, so the fold can surface up to 8 concurrent siblings per key.
+K, S, AM = 16, 8, 4
+mrng = np.random.default_rng(1)
+mctr = np.broadcast_to(
+    (np.arange(K)[:, None] * S + np.arange(S) + 1).astype(np.uint32), (R, K, S)
+).copy()
+mact = np.broadcast_to(
+    (np.arange(R) % AM)[:, None, None].astype(np.int32), (R, K, S)
+).copy()
+mvalid = (np.arange(S) == 0) | (
+    (np.arange(S) < 2) & (mrng.random((R, K, S)) < 0.5)
+)
+mclk = np.zeros((R, K, S, AM), np.uint32)
+np.put_along_axis(mclk, mact[..., None].astype(np.int64), mctr[..., None], axis=-1)
+mclk[~mvalid] = 0
+mtop = np.zeros((R, AM), np.uint32)
+mtop[np.arange(R), np.arange(R) % AM] = K * S + 1
+
+# Distinct payloads keyed by the write's dot (actor, counter) — the
+# same event carries the same value on every replica that saw it, but
+# any value-slot permutation/drop in the DCN fold path breaks the
+# bit-identity comparison below.
+mval = (mact * (K * S + 2) + mctr.astype(np.int64) + 7).astype(np.int32)
+mfull = map_ops.empty(K, AM, sibling_cap=S, batch=(R,))
+mfull = mfull._replace(
+    top=jnp.asarray(mtop),
+    child=mfull.child._replace(
+        wact=jnp.asarray(np.where(mvalid, mact, 0)),
+        wctr=jnp.asarray(np.where(mvalid, mctr, 0)),
+        clk=jnp.asarray(mclk),
+        val=jnp.asarray(np.where(mvalid, mval, 0)),
+        valid=jnp.asarray(mvalid),
+    ),
+)
+mexpect, m_of2 = map_ops.fold(mfull)
+assert not bool(np.asarray(m_of2).any())
+
+mlocal = jax.tree.map(lambda x: np.asarray(x)[local_rows], mfull)
+mgstate = multihost.host_to_global(mlocal, mesh, map_specs())
+mjoined, m_of = mesh_fold_map(mgstate, mesh)
+assert not bool(np.asarray(jax.device_get(m_of)).any())
+mresult = multihost.global_to_host(mjoined)
+for got, want in zip(jax.tree.leaves(mresult), jax.tree.leaves(mexpect)):
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(jax.device_get(want))
+    )
+
 print(f"MULTIHOST_OK process={pid}", flush=True)

@@ -3,6 +3,8 @@
 
 import random
 
+import pytest
+
 import jax
 import numpy as np
 from hypothesis import given, settings
@@ -97,3 +99,12 @@ def test_fused_fold_with_parked_removes():
     fused, _ = fold_fused(model.state, tile_e=2)
     np.testing.assert_array_equal(np.asarray(tree.ctr), np.asarray(fused.ctr))
     np.testing.assert_array_equal(np.asarray(tree.top), np.asarray(fused.top))
+
+
+def test_fold_auto_rejects_unknown_prefer():
+    from crdt_tpu.ops import orswot as oo
+    from crdt_tpu.ops.pallas_kernels import fold_auto
+
+    state = oo.empty(4, 2, deferred_cap=2, batch=(2,))
+    with pytest.raises(ValueError):
+        fold_auto(state, prefer="pallas")
