@@ -26,6 +26,7 @@ from . import serde
 from .models.glist import BatchedGList
 from .models.list import BatchedList
 from .models.map import BatchedMap
+from .models.map3 import BatchedMap3
 from .models.map_nested import BatchedMapOrswot, BatchedNestedMap
 from .models.orswot import BatchedOrswot
 from .native import DELETE, INSERT
@@ -169,6 +170,21 @@ def save(path: Union[str, os.PathLike], model) -> None:
             ],
         }
         arrays = _state_arrays(model.state)
+    elif isinstance(model, BatchedMap3):
+        meta = {
+            "kind": "map3",
+            "keys1": _interner_items(model.keys1),
+            "keys2": _interner_items(model.keys2),
+            "members": _interner_items(model.members),
+            "actors": _interner_items(model.actors),
+            "dims": [
+                model.n_replicas, model.n_keys1, model.n_keys2,
+                model.n_members,
+                int(model.state.mo.core.top.shape[-1]),
+                int(model.state.odcl.shape[-2]),
+            ],
+        }
+        arrays = _state_arrays(model.state)
     elif isinstance(model, BatchedList):
         ins = model.op_kinds == INSERT
         values = np.zeros(model.engine.total_ids(), np.int32)
@@ -278,6 +294,17 @@ def load(path: Union[str, os.PathLike]):
             keys2=_interner_from(meta["keys2"]),
             actors=_interner_from(meta["actors"]),
             values=_interner_from(meta["values"]),
+        )
+        model.state = _state_from_arrays(model.state, arrays)
+        return model
+    if meta["kind"] == "map3":
+        r, nk1, nk2, nm, na, d = meta["dims"]
+        model = BatchedMap3(
+            r, nk1, nk2, nm, na, d,
+            keys1=_interner_from(meta["keys1"]),
+            keys2=_interner_from(meta["keys2"]),
+            members=_interner_from(meta["members"]),
+            actors=_interner_from(meta["actors"]),
         )
         model.state = _state_from_arrays(model.state, arrays)
         return model

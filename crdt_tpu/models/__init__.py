@@ -16,6 +16,7 @@ from .orswot import BatchedOrswot
 from .gset import BatchedGSet
 from .registers import BatchedLWWReg, BatchedMVReg, SlotOverflow
 from .map import BatchedMap
+from .map3 import BatchedMap3
 from .map_nested import BatchedMapOrswot, BatchedNestedMap
 from .list import BatchedList
 from .glist import BatchedGList
@@ -29,6 +30,7 @@ __all__ = [
     "BatchedLWWReg",
     "BatchedMVReg",
     "BatchedMap",
+    "BatchedMap3",
     "BatchedMapOrswot",
     "BatchedNestedMap",
     "BatchedList",

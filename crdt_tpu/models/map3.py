@@ -1,0 +1,441 @@
+"""BatchedMap3 — N dense ``Map<K1, Map<K2, Orswot<M>>>`` replicas.
+
+Oracle: ``crdt_tpu.pure.map.Map`` with nested ``Map(Orswot)`` children
+(reference: src/map.rs ``V: Val<A>`` at depth 3). Device form per
+ops/map3.py: the depth-2 ``map_orswot`` slab over the K1×K2 product key
+space plus one more outer deferred buffer — the slab-composition
+induction step applied once more (SURVEY.md §7.1).
+
+Conversions are lossless across all THREE deferred levels (leaf member
+removes, K2 keyset removes, K1 keyset removes), which the A/B gates in
+tests/test_models_map3.py exercise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import map3 as ops
+from ..pure.map import Map, MapRm, Nop, Up
+from ..pure.orswot import Add as OrswotAdd, Orswot, Rm as OrswotRm
+from ..utils import Interner
+from ..utils.metrics import metrics
+from ..vclock import VClock
+from .orswot import DeferredOverflow
+from .validation import strict_validate_dot
+
+
+class BatchedMap3:
+    def __init__(
+        self,
+        n_replicas: int,
+        n_keys1: int,
+        n_keys2: int,
+        n_members: int,
+        n_actors: int,
+        deferred_cap: int = 4,
+        keys1: Optional[Interner] = None,
+        keys2: Optional[Interner] = None,
+        members: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+    ):
+        self.keys1 = keys1 if keys1 is not None else Interner()
+        self.keys2 = keys2 if keys2 is not None else Interner()
+        self.members = members if members is not None else Interner()
+        self.actors = actors if actors is not None else Interner()
+        self.state = ops.empty(
+            n_keys1, n_keys2, n_members, n_actors, deferred_cap,
+            batch=(n_replicas,),
+        )
+
+    @property
+    def n_replicas(self) -> int:
+        return self.state.mo.core.top.shape[0]
+
+    @property
+    def n_keys1(self) -> int:
+        return self.state.odkeys.shape[-1]
+
+    @property
+    def n_keys2(self) -> int:
+        return self.state.mo.kdkeys.shape[-1] // self.n_keys1
+
+    @property
+    def n_members(self) -> int:
+        return self.state.mo.core.ctr.shape[-2] // self.state.mo.kdkeys.shape[-1]
+
+    # ---- conversion (the A/B gate boundary) ---------------------------
+    @classmethod
+    def from_pure(
+        cls,
+        pures: Sequence[Map],
+        deferred_cap: int = 4,
+        keys1: Optional[Interner] = None,
+        keys2: Optional[Interner] = None,
+        members: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+        n_keys1: int = 1,
+        n_keys2: int = 1,
+        n_members: int = 1,
+        n_actors: int = 1,
+    ) -> "BatchedMap3":
+        keys1 = keys1 if keys1 is not None else Interner()
+        keys2 = keys2 if keys2 is not None else Interner()
+        members = members if members is not None else Interner()
+        actors = actors if actors is not None else Interner()
+        for p in pures:
+            for actor in p.clock.dots:
+                actors.intern(actor)
+            for k1, child in p.entries.items():
+                keys1.intern(k1)
+                if not isinstance(child, Map):
+                    raise TypeError(
+                        f"BatchedMap3 children must be Map, got {type(child)}"
+                    )
+                if child.clock != p.clock:
+                    raise ValueError(
+                        f"child at {k1!r} violates the covered invariant "
+                        f"(child clock != map clock); not a composed state"
+                    )
+                for k2, leaf in child.entries.items():
+                    keys2.intern(k2)
+                    if not isinstance(leaf, Orswot):
+                        raise TypeError(
+                            f"leaf children must be Orswot, got {type(leaf)}"
+                        )
+                    if leaf.clock != p.clock:
+                        raise ValueError(
+                            f"leaf at ({k1!r},{k2!r}) violates the covered "
+                            f"invariant; not a composed state"
+                        )
+                    for m, clock in leaf.entries.items():
+                        members.intern(m)
+                        for actor in clock.dots:
+                            actors.intern(actor)
+                    for clock, ms in leaf.deferred.items():
+                        for actor in clock.dots:
+                            actors.intern(actor)
+                        for m in ms:
+                            members.intern(m)
+                for clock, k2s in child.deferred.items():
+                    for actor in clock.dots:
+                        actors.intern(actor)
+                    for k2 in k2s:
+                        keys2.intern(k2)
+            for clock, k1s in p.deferred.items():
+                for actor in clock.dots:
+                    actors.intern(actor)
+                for k1 in k1s:
+                    keys1.intern(k1)
+
+        r = len(pures)
+        nk1 = max(len(keys1), n_keys1, 1)
+        nk2 = max(len(keys2), n_keys2, 1)
+        nm = max(len(members), n_members, 1)
+        na = max(len(actors), n_actors, 1)
+        out = cls(
+            r, nk1, nk2, nm, na, deferred_cap,
+            keys1=keys1, keys2=keys2, members=members, actors=actors,
+        )
+        d = deferred_cap
+        nk = nk1 * nk2
+        top = np.zeros((r, na), np.uint32)
+        ctr = np.zeros((r, nk * nm, na), np.uint32)
+        dcl = np.zeros((r, d, na), np.uint32)       # leaf member removes
+        dmask = np.zeros((r, d, nk * nm), bool)
+        dvalid = np.zeros((r, d), bool)
+        kdcl = np.zeros((r, d, na), np.uint32)      # K2 keyset removes
+        kdkeys = np.zeros((r, d, nk), bool)
+        kdvalid = np.zeros((r, d), bool)
+        odcl = np.zeros((r, d, na), np.uint32)      # K1 keyset removes
+        odkeys = np.zeros((r, d, nk1), bool)
+        odvalid = np.zeros((r, d), bool)
+        for i, p in enumerate(pures):
+            for actor, c in p.clock.dots.items():
+                top[i, actors.id_of(actor)] = c
+            leafd: dict = {}
+            midd: dict = {}
+            for k1, child in p.entries.items():
+                k1i = keys1.id_of(k1)
+                for k2, leaf in child.entries.items():
+                    ki = k1i * nk2 + keys2.id_of(k2)
+                    for m, clock in leaf.entries.items():
+                        mi = members.id_of(m)
+                        for actor, c in clock.dots.items():
+                            ctr[i, ki * nm + mi, actors.id_of(actor)] = c
+                    for clock, ms in leaf.deferred.items():
+                        leafd.setdefault(clock, set()).update(
+                            ki * nm + members.id_of(m) for m in ms
+                        )
+                for clock, k2s in child.deferred.items():
+                    midd.setdefault(clock, set()).update(
+                        k1i * nk2 + keys2.id_of(k2) for k2 in k2s
+                    )
+            for what, parked, cap in (
+                ("leaf", leafd, d), ("middle", midd, d),
+            ):
+                if len(parked) > cap:
+                    raise ValueError(
+                        f"replica {i}: {len(parked)} {what} parked removes; "
+                        f"capacity is {cap}"
+                    )
+            for s, (clock, cells) in enumerate(leafd.items()):
+                for actor, c in clock.dots.items():
+                    dcl[i, s, actors.id_of(actor)] = c
+                for cell in cells:
+                    dmask[i, s, cell] = True
+                dvalid[i, s] = True
+            for s, (clock, cells) in enumerate(midd.items()):
+                for actor, c in clock.dots.items():
+                    kdcl[i, s, actors.id_of(actor)] = c
+                for cell in cells:
+                    kdkeys[i, s, cell] = True
+                kdvalid[i, s] = True
+            if len(p.deferred) > d:
+                raise ValueError(
+                    f"replica {i}: {len(p.deferred)} outer parked removes; "
+                    f"capacity is {d}"
+                )
+            for s, (clock, k1s) in enumerate(p.deferred.items()):
+                for actor, c in clock.dots.items():
+                    odcl[i, s, actors.id_of(actor)] = c
+                for k1 in k1s:
+                    odkeys[i, s, keys1.id_of(k1)] = True
+                odvalid[i, s] = True
+
+        core = out.state.mo.core._replace(
+            top=jnp.asarray(top),
+            ctr=jnp.asarray(ctr),
+            dcl=jnp.asarray(dcl),
+            dmask=jnp.asarray(dmask),
+            dvalid=jnp.asarray(dvalid),
+        )
+        out.state = ops.Map3State(
+            mo=ops.MapOrswotState(
+                core=core,
+                kdcl=jnp.asarray(kdcl),
+                kdkeys=jnp.asarray(kdkeys),
+                kdvalid=jnp.asarray(kdvalid),
+            ),
+            odcl=jnp.asarray(odcl),
+            odkeys=jnp.asarray(odkeys),
+            odvalid=jnp.asarray(odvalid),
+        )
+        return out
+
+    def _row(self, arrs, i: int):
+        return jax.tree.map(lambda x: x[i], arrs)
+
+    def to_pure(self, i: int) -> Map:
+        st = jax.device_get(self._row(self.state, i))
+        nk1, nk2, nm = self.n_keys1, self.n_keys2, self.n_members
+        out = Map(val_default=lambda: Map(val_default=Orswot))
+        out.clock = VClock(
+            {self.actors[a]: int(c) for a, c in enumerate(st.mo.core.top) if c > 0}
+        )
+        ctr = st.mo.core.ctr.reshape(nk1, nk2, nm, -1)
+        for k1i in np.nonzero(ctr.any(axis=(1, 2, 3)))[0]:
+            child = Map(val_default=Orswot)
+            child.clock = out.clock.clone()
+            for k2i in np.nonzero(ctr[k1i].any(axis=(1, 2)))[0]:
+                leaf = Orswot()
+                leaf.clock = out.clock.clone()
+                for mi in np.nonzero(ctr[k1i, k2i].any(axis=-1))[0]:
+                    leaf.entries[self.members[int(mi)]] = VClock(
+                        {
+                            self.actors[a]: int(c)
+                            for a, c in enumerate(ctr[k1i, k2i, mi])
+                            if c > 0
+                        }
+                    )
+                child.entries[self.keys2[int(k2i)]] = leaf
+            out.entries[self.keys1[int(k1i)]] = child
+        # Leaf parked member-removes: split each shared slot per (k1, k2).
+        for s in np.nonzero(st.mo.core.dvalid)[0]:
+            clock = VClock(
+                {self.actors[a]: int(c) for a, c in enumerate(st.mo.core.dcl[s]) if c > 0}
+            )
+            mask = st.mo.core.dmask[s].reshape(nk1, nk2, nm)
+            for k1i, k2i in zip(*np.nonzero(mask.any(axis=-1))):
+                child = out.entries.get(self.keys1[int(k1i)])
+                leaf = (
+                    child.entries.get(self.keys2[int(k2i)])
+                    if child is not None
+                    else None
+                )
+                if leaf is None:
+                    continue  # scrubbed dead key (oracle dropped it too)
+                leaf.deferred.setdefault(clock.clone(), set()).update(
+                    self.members[int(mi)]
+                    for mi in np.nonzero(mask[k1i, k2i])[0]
+                )
+        # Middle (K2) parked keyset-removes: split per k1.
+        for s in np.nonzero(st.mo.kdvalid)[0]:
+            clock = VClock(
+                {self.actors[a]: int(c) for a, c in enumerate(st.mo.kdcl[s]) if c > 0}
+            )
+            mask = st.mo.kdkeys[s].reshape(nk1, nk2)
+            for k1i in np.nonzero(mask.any(axis=-1))[0]:
+                child = out.entries.get(self.keys1[int(k1i)])
+                if child is None:
+                    continue
+                child.deferred.setdefault(clock.clone(), set()).update(
+                    self.keys2[int(k2i)] for k2i in np.nonzero(mask[k1i])[0]
+                )
+        for s in np.nonzero(st.odvalid)[0]:
+            clock = VClock(
+                {self.actors[a]: int(c) for a, c in enumerate(st.odcl[s]) if c > 0}
+            )
+            out.deferred[clock] = {
+                self.keys1[int(k)] for k in np.nonzero(st.odkeys[s])[0]
+            }
+        return out
+
+    # ---- op path (CmRDT) ----------------------------------------------
+    def apply(self, replica: int, op) -> None:
+        """Apply an oracle-shaped op to one replica (reference:
+        src/map.rs ``CmRDT::apply`` routing through two map levels)."""
+        if isinstance(op, Nop):
+            return
+        row = self._row(self.state, replica)
+        na = self.state.mo.core.top.shape[-1]
+        nk1, nk2, nm = self.n_keys1, self.n_keys2, self.n_members
+        if isinstance(op, Up):
+            strict_validate_dot(
+                row.mo.core.top, self.actors, op.dot.actor, op.dot.counter
+            )
+            k1id = self.keys1.bounded_intern(op.key, nk1, "outer key")
+            aid = self.actors.bounded_intern(op.dot.actor, na, "actor")
+            mid = op.op
+            if isinstance(mid, Up):
+                if mid.dot != op.dot:
+                    raise ValueError(
+                        "inner Up dot must equal the outer Up dot (one AddCtx)"
+                    )
+                k2id = self.keys2.bounded_intern(mid.key, nk2, "inner key")
+                leaf_op = mid.op
+                if isinstance(leaf_op, OrswotAdd):
+                    if leaf_op.dot != op.dot:
+                        raise ValueError(
+                            "leaf add dot must equal the Up dot (one AddCtx)"
+                        )
+                    mask = np.zeros((nm,), bool)
+                    for m in leaf_op.members:
+                        mask[self.members.bounded_intern(m, nm, "member")] = True
+                    row = ops.apply_member_add(
+                        row,
+                        jnp.asarray(aid),
+                        jnp.asarray(np.uint32(op.dot.counter)),
+                        jnp.asarray(k1id),
+                        jnp.asarray(k2id),
+                        jnp.asarray(mask),
+                    )
+                elif isinstance(leaf_op, OrswotRm):
+                    clock = np.zeros((na,), np.uint32)
+                    for actor, c in leaf_op.clock.dots.items():
+                        clock[self.actors.bounded_intern(actor, na, "actor")] = c
+                    mask = np.zeros((nm,), bool)
+                    for m in leaf_op.members:
+                        mask[self.members.bounded_intern(m, nm, "member")] = True
+                    row, overflow = ops.apply_member_rm(
+                        row,
+                        jnp.asarray(aid),
+                        jnp.asarray(np.uint32(op.dot.counter)),
+                        jnp.asarray(k1id),
+                        jnp.asarray(k2id),
+                        jnp.asarray(clock),
+                        jnp.asarray(mask),
+                    )
+                    if bool(overflow):
+                        raise DeferredOverflow(
+                            f"replica {replica}: leaf deferred buffer full "
+                            f"(cap {self.state.mo.core.dvalid.shape[-1]})"
+                        )
+                else:
+                    raise TypeError(
+                        f"leaf ops must be Orswot ops, got {leaf_op!r}"
+                    )
+            elif isinstance(mid, MapRm):
+                clock = np.zeros((na,), np.uint32)
+                for actor, c in mid.clock.dots.items():
+                    clock[self.actors.bounded_intern(actor, na, "actor")] = c
+                mask = np.zeros((nk2,), bool)
+                for k2 in mid.keyset:
+                    mask[self.keys2.bounded_intern(k2, nk2, "inner key")] = True
+                row, overflow = ops.apply_key2_rm(
+                    row,
+                    jnp.asarray(aid),
+                    jnp.asarray(np.uint32(op.dot.counter)),
+                    jnp.asarray(k1id),
+                    jnp.asarray(clock),
+                    jnp.asarray(mask),
+                )
+                if bool(overflow):
+                    raise DeferredOverflow(
+                        f"replica {replica}: K2 deferred buffer full "
+                        f"(cap {self.state.mo.kdvalid.shape[-1]})"
+                    )
+            else:
+                raise TypeError(
+                    f"BatchedMap3 routes Map ops only, got {mid!r}"
+                )
+        elif isinstance(op, MapRm):
+            clock = np.zeros((na,), np.uint32)
+            for actor, c in op.clock.dots.items():
+                clock[self.actors.bounded_intern(actor, na, "actor")] = c
+            mask = np.zeros((nk1,), bool)
+            for k1 in op.keyset:
+                mask[self.keys1.bounded_intern(k1, nk1, "outer key")] = True
+            row, overflow = ops.apply_key1_rm(
+                row, jnp.asarray(clock), jnp.asarray(mask)
+            )
+            if bool(overflow):
+                raise DeferredOverflow(
+                    f"replica {replica}: outer deferred buffer full "
+                    f"(cap {self.state.odvalid.shape[-1]})"
+                )
+        else:
+            raise TypeError(f"not a Map op: {op!r}")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[replica].set(r), self.state, row
+        )
+
+    # ---- state path (CvRDT) -------------------------------------------
+    def _check_flags(self, flags, what: str) -> None:
+        leaf, mid, outer = (bool(x) for x in flags)
+        if leaf or mid or outer:
+            level = "leaf" if leaf else ("K2" if mid else "K1")
+            raise DeferredOverflow(
+                f"{what}: {level} deferred buffer full — rebuild with a "
+                f"larger deferred_cap"
+            )
+
+    def merge_from(self, dst: int, src: int) -> None:
+        metrics.count("map3.merges")
+        joined, flags = ops.join(
+            self._row(self.state, dst), self._row(self.state, src)
+        )
+        self._check_flags(flags, f"merge {src}->{dst}")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[dst].set(r), self.state, joined
+        )
+
+    def fold(self) -> Map:
+        """Full-mesh anti-entropy: join all replicas, return the converged
+        oracle-form state."""
+        metrics.count("map3.merges", max(self.n_replicas - 1, 0))
+        folded, flags = ops.fold(self.state)
+        self._check_flags(flags, "fold")
+        tmp = BatchedMap3(
+            1, self.n_keys1, self.n_keys2, self.n_members,
+            self.state.mo.core.top.shape[-1],
+            self.state.odcl.shape[-2],
+            keys1=self.keys1, keys2=self.keys2,
+            members=self.members, actors=self.actors,
+        )
+        tmp.state = jax.tree.map(lambda x: x[None], folded)
+        return tmp.to_pure(0)

@@ -33,10 +33,12 @@ from .mesh import (
     make_mesh,
     map_specs,
     map_out_specs,
+    map3_specs,
     map_orswot_specs,
     nested_map_specs,
     orswot_specs,
     orswot_out_specs,
+    shard_map3,
     shard_map_orswot,
     shard_map_state,
     shard_nested_map,
@@ -54,11 +56,13 @@ from .anti_entropy import (
     mesh_fold_gset,
     mesh_fold_lww,
     mesh_fold_map,
+    mesh_fold_map3,
     mesh_fold_map_orswot,
     mesh_fold_mvreg,
     mesh_fold_nested_map,
     mesh_gossip,
     mesh_gossip_map,
+    mesh_gossip_map3,
     mesh_gossip_map_orswot,
     mesh_gossip_nested_map,
 )
@@ -66,16 +70,20 @@ from . import multihost
 
 __all__ = [
     "multihost",
+    "map3_specs",
     "map_orswot_specs",
     "nested_map_specs",
+    "shard_map3",
     "shard_map_orswot",
     "shard_nested_map",
+    "mesh_fold_map3",
     "mesh_fold_map_orswot",
     "mesh_fold_nested_map",
     "mesh_fold_gset",
     "mesh_fold_lww",
     "mesh_fold_mvreg",
     "mesh_gossip_map",
+    "mesh_gossip_map3",
     "mesh_gossip_map_orswot",
     "mesh_gossip_nested_map",
     "REPLICA_AXIS",

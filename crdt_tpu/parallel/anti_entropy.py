@@ -445,3 +445,34 @@ def mesh_fold_clocks(clocks: jax.Array, mesh: Mesh) -> jax.Array:
         return fold_fn
 
     return _cached("clock_fold", clocks, mesh, build)(clocks)
+
+
+def mesh_fold_map3(state, mesh: Mesh):
+    """Full-mesh anti-entropy for ``Map<K1, Map<K2, Orswot>>`` over the
+    (replica × outer-key) mesh (K1×K2×M blocks per shard; ops/map3.py
+    depth-3 slab composition). Returns (converged state, overflow[3])."""
+    from ..ops import map3 as map3_ops
+    from .mesh import map3_out_specs, map3_specs, pad_map3
+
+    state = pad_map3(state, mesh.shape[REPLICA_AXIS], mesh.shape[ELEMENT_AXIS])
+    return _mesh_fold_lattice(
+        "map3_fold", state, mesh,
+        partial(map3_ops.join, element_axis=ELEMENT_AXIS),
+        partial(map3_ops.fold, element_axis=ELEMENT_AXIS),
+        map3_specs(), map3_out_specs(),
+    )
+
+
+def mesh_gossip_map3(state, mesh: Mesh, rounds: Optional[int] = None):
+    """Ring anti-entropy for ``Map<K1, Map<K2, Orswot>>`` replica blocks
+    over the replica axis."""
+    from ..ops import map3 as map3_ops
+    from .mesh import map3_specs, pad_map3
+
+    state = pad_map3(state, mesh.shape[REPLICA_AXIS], mesh.shape[ELEMENT_AXIS])
+    return _mesh_gossip_lattice(
+        "map3_gossip", state, mesh,
+        partial(map3_ops.join, element_axis=ELEMENT_AXIS),
+        partial(map3_ops.fold, element_axis=ELEMENT_AXIS),
+        map3_specs(), rounds,
+    )

@@ -334,3 +334,84 @@ def shard_orswot(state: OrswotState, mesh: Mesh) -> OrswotState:
         state,
         orswot_specs(),
     )
+
+
+def map3_specs():
+    """PartitionSpecs for a batched ``Map3State`` [R, ...]
+    (``Map<K1, Map<K2, Orswot<M>>>``): the K1×K2×M product element axis
+    shards in whole-K1 blocks (pad_map3 keeps K1 divisible by the
+    element axis), the K2 buffer shards over K1×K2, the K1 buffer over
+    K1."""
+    from ..ops.map3 import Map3State
+
+    return Map3State(
+        mo=map_orswot_specs(),
+        odcl=P(REPLICA_AXIS, None, None),
+        odkeys=P(REPLICA_AXIS, None, ELEMENT_AXIS),
+        odvalid=P(REPLICA_AXIS, None),
+    )
+
+
+def map3_out_specs():
+    from ..ops.map3 import Map3State
+
+    return Map3State(
+        mo=map_orswot_out_specs(),
+        odcl=P(None, None),
+        odkeys=P(None, ELEMENT_AXIS),
+        odvalid=P(None),
+    )
+
+
+def pad_map3(state, rmult: int, k1mult: int):
+    """Pad replicas with join identities and K1 (in whole K1×K2×M
+    blocks) with never-present slots, to mesh-axis divisibility."""
+    import jax.numpy as jnp
+
+    nk1 = state.odkeys.shape[-1]
+    k2 = state.mo.kdkeys.shape[-1] // nk1
+    m = state.mo.core.ctr.shape[-2] // state.mo.kdkeys.shape[-1]
+
+    pad_r = (-state.mo.core.top.shape[0]) % rmult
+    if pad_r:
+        from ..ops.map3 import empty
+
+        ident = empty(
+            nk1, k2, m,
+            state.mo.core.top.shape[-1],
+            state.odcl.shape[-2],
+            batch=(pad_r,),
+        )
+        state = jax.tree.map(
+            lambda x, p: jnp.concatenate([x, p.astype(x.dtype)], axis=0), state, ident
+        )
+    pad_k = (-nk1) % k1mult
+    if pad_k:
+        state = state._replace(
+            mo=state.mo._replace(
+                core=state.mo.core._replace(
+                    ctr=jnp.pad(
+                        state.mo.core.ctr, ((0, 0), (0, pad_k * k2 * m), (0, 0))
+                    ),
+                    dmask=jnp.pad(
+                        state.mo.core.dmask, ((0, 0), (0, 0), (0, pad_k * k2 * m))
+                    ),
+                ),
+                kdkeys=jnp.pad(
+                    state.mo.kdkeys, ((0, 0), (0, 0), (0, pad_k * k2))
+                ),
+            ),
+            odkeys=jnp.pad(state.odkeys, ((0, 0), (0, 0), (0, pad_k))),
+        )
+    return state
+
+
+def shard_map3(state, mesh: Mesh):
+    """Place a batched Map<K1, Map<K2, Orswot>> state onto the mesh
+    (replica × outer key) with the canonical layout."""
+    state = pad_map3(state, mesh.shape[REPLICA_AXIS], mesh.shape[ELEMENT_AXIS])
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        state,
+        map3_specs(),
+    )

@@ -1,0 +1,255 @@
+"""Batched ``Map<K1, Map<K2, Orswot<M>>>`` vs the oracle — the A/B gate
+for depth-3 Val-generic slab composition (reference: src/map.rs
+arbitrary ``V: Val<A>`` nesting; ops/map3.py is the induction step
+applied to the depth-2 map_orswot slab)."""
+
+import random
+
+from hypothesis import given, settings
+
+from crdt_tpu import Map, Orswot, VClock
+from crdt_tpu.ctx import RmCtx
+from crdt_tpu.models import BatchedMap3
+from crdt_tpu.utils import Interner
+
+from strategies import ACTORS, seeds
+
+KEYS1 = list("pq")
+KEYS2 = list("uv")
+MEMBERS = list("xyz")
+
+
+def map3():
+    return Map(val_default=lambda: Map(val_default=Orswot))
+
+
+def d3add(m, actor, k1, k2, member):
+    """Leaf add routed through both map levels (one AddCtx, one dot)."""
+    ctx = m.len().derive_add_ctx(actor)
+    op = m.update(
+        k1, ctx, lambda child, c: child.update(
+            k2, c, lambda s, c2: s.add(member, c2)
+        )
+    )
+    m.apply(op)
+    return op
+
+
+def d3rm(m, actor, k1, k2, member):
+    """Leaf member remove routed through both map levels."""
+    child = m.entries.get(k1)
+    leaf = child.entries.get(k2) if child is not None else None
+    rm_ctx = (
+        leaf.contains(member).derive_rm_ctx()
+        if leaf is not None
+        else RmCtx(clock=VClock())
+    )
+    ctx = m.len().derive_add_ctx(actor)
+    op = m.update(
+        k1, ctx, lambda child, c: child.update(
+            k2, c, lambda s, c2: s.rm(member, rm_ctx)
+        )
+    )
+    m.apply(op)
+    return op
+
+
+def d3drop2(m, actor, k1, k2):
+    """Middle keyset-remove: drop k2 inside the k1 child (``Op::Up``
+    carrying ``Map::Rm``)."""
+    child = m.entries.get(k1)
+    rm_ctx = (
+        child.get(k2).derive_rm_ctx()
+        if child is not None
+        else RmCtx(clock=VClock())
+    )
+    ctx = m.len().derive_add_ctx(actor)
+    op = m.update(k1, ctx, lambda child, c: child.rm(k2, rm_ctx))
+    m.apply(op)
+    return op
+
+
+def d3drop1(m, k1):
+    """Outer keyset-remove (top-level ``Op::Rm``)."""
+    op = m.rm(k1, m.get(k1).derive_rm_ctx())
+    m.apply(op)
+    return op
+
+
+def _interners():
+    return (
+        Interner(KEYS1),
+        Interner(KEYS2),
+        Interner(MEMBERS),
+        Interner(ACTORS + ["A", "B", "C"]),
+    )
+
+
+def _batched(states, deferred_cap=12):
+    keys1, keys2, members, actors = _interners()
+    return BatchedMap3.from_pure(
+        states, deferred_cap=deferred_cap,
+        keys1=keys1, keys2=keys2, members=members, actors=actors,
+    )
+
+
+def _site_run(rng, n_cmds=12):
+    sites = {a: map3() for a in ACTORS[:3]}
+    for _ in range(n_cmds):
+        actor = rng.choice(list(sites))
+        site = sites[actor]
+        roll = rng.random()
+        k1 = rng.choice(KEYS1)
+        k2 = rng.choice(KEYS2)
+        member = rng.choice(MEMBERS)
+        if roll < 0.35:
+            d3add(site, actor, k1, k2, member)
+        elif roll < 0.5:
+            d3rm(site, actor, k1, k2, member)
+        elif roll < 0.65:
+            d3drop2(site, actor, k1, k2)
+        elif roll < 0.8:
+            d3drop1(site, k1)
+        else:
+            site.merge(sites[rng.choice(list(sites))].clone())
+    return list(sites.values())
+
+
+@given(seeds)
+@settings(max_examples=15, deadline=None)
+def test_join_bit_identical_to_oracle_merge(seed):
+    rng = random.Random(seed)
+    states = _site_run(rng)
+    batched = _batched(states)
+
+    expect = states[0].clone()
+    expect.merge(states[1].clone())
+    batched.merge_from(0, 1)
+    assert batched.to_pure(0) == expect
+
+    # round-trip of untouched replicas is lossless
+    assert batched.to_pure(2) == states[2]
+
+
+@given(seeds)
+@settings(max_examples=12, deadline=None)
+def test_fold_bit_identical_to_oracle_fold(seed):
+    rng = random.Random(seed)
+    states = _site_run(rng, n_cmds=16)
+    batched = _batched(states)
+
+    expect = states[0].clone()
+    for s in states[1:]:
+        expect.merge(s.clone())
+    assert batched.fold() == expect
+
+
+@given(seeds)
+@settings(max_examples=10, deadline=None)
+def test_op_path_bit_identical(seed):
+    rng = random.Random(seed)
+    # Mint on an oracle site; deliver the same stream to an oracle
+    # replica and a device replica (removes may arrive ahead of adds, so
+    # every deferred level gets exercised).
+    site = map3()
+    stream = []
+    for _ in range(14):
+        k1 = rng.choice(KEYS1)
+        k2 = rng.choice(KEYS2)
+        member = rng.choice(MEMBERS)
+        roll = rng.random()
+        if roll < 0.4:
+            stream.append(d3add(site, rng.choice(ACTORS), k1, k2, member))
+        elif roll < 0.6:
+            stream.append(d3rm(site, rng.choice(ACTORS), k1, k2, member))
+        elif roll < 0.8:
+            stream.append(d3drop2(site, rng.choice(ACTORS), k1, k2))
+        else:
+            stream.append(d3drop1(site, k1))
+    oracle = map3()
+    keys1, keys2, members, actors = _interners()
+    dev = BatchedMap3.from_pure(
+        [map3()], deferred_cap=16,
+        keys1=keys1, keys2=keys2, members=members, actors=actors,
+        n_keys1=len(KEYS1), n_keys2=len(KEYS2),
+        n_members=len(MEMBERS), n_actors=len(ACTORS) + 3,
+    )
+    for op in stream:
+        oracle.apply(op)
+        dev.apply(0, op)
+        assert dev.to_pure(0) == oracle
+
+
+@given(seeds)
+@settings(max_examples=8, deadline=None)
+def test_convergence_under_random_delivery(seed):
+    rng = random.Random(seed)
+    states = _site_run(rng, n_cmds=14)
+    batched = _batched(states)
+    n = batched.n_replicas
+    # pairwise gossip until a full pass changes nothing
+    expect = states[0].clone()
+    for s in states[1:]:
+        expect.merge(s.clone())
+    order = [(d, s) for d in range(n) for s in range(n) if d != s]
+    rng.shuffle(order)
+    for d, s in order:
+        batched.merge_from(d, s)
+    for i in range(n):
+        assert batched.to_pure(i) == expect
+
+
+def test_k1_replay_scrubs_bottomed_leaf_deferred():
+    """A K1-level remove that bottoms one (k1, k2) orswot while its K1
+    block stays alive must drop that orswot's parked member-removes, as
+    the oracle does (child dies with its deferred) — the (K1,K2)-granular
+    scrub after the K1 replay, not just the K1-granular one."""
+    a, b = ACTORS[0], ACTORS[1]
+    site1 = map3()                       # actor a mints three adds
+    op1 = d3add(site1, a, "p", "u", "x")     # dot a:1
+    op2 = d3add(site1, a, "p", "v", "y")     # dot a:2
+    op3 = d3add(site1, a, "p", "u", "z")     # dot a:3 (delivered to C LAST)
+
+    site2 = map3()                       # saw everything; mints the leaf rm
+    for op in (op1, op2):
+        site2.apply(op)
+    site2.merge(site1.clone())
+    rm_leaf = None
+    leaf = site2.entries["p"].entries["u"]
+    rm_ctx = leaf.contains("z").derive_rm_ctx()   # clock {a:3} — ahead for C
+    ctx = site2.len().derive_add_ctx(b)
+    rm_leaf = site2.update(
+        "p", ctx, lambda child, c: child.update(
+            "u", c, lambda s, c2: s.rm("z", rm_ctx)
+        )
+    )
+    site2.apply(rm_leaf)
+
+    site3 = map3()                       # saw only a:1; mints the K1 drop
+    site3.apply(op1)
+    rm_k1 = site3.rm("p", site3.get("p").derive_rm_ctx())  # clock {a:1}
+    site3.apply(rm_k1)
+
+    # Replica C: a:1, a:2, then the leaf rm (parks — clock {a:3} ahead),
+    # then the K1 rm (clock {a:1} covered -> kills (p,u,x) now; (p,v,y)
+    # survives on dot a:2, so the p block stays alive).
+    # The late a:3 add then re-creates (p, u): the oracle dropped the
+    # parked rm with the dead orswot, so z must SURVIVE — a stale device
+    # mask would wrongly kill it on replay.
+    stream = [op1, op2, rm_leaf, rm_k1, op3]
+    oracle = map3()
+    keys1, keys2, members, actors = _interners()
+    dev = BatchedMap3.from_pure(
+        [map3()], deferred_cap=8,
+        keys1=keys1, keys2=keys2, members=members, actors=actors,
+        n_keys1=len(KEYS1), n_keys2=len(KEYS2),
+        n_members=len(MEMBERS), n_actors=len(ACTORS) + 3,
+    )
+    for op in stream:
+        oracle.apply(op)
+        dev.apply(0, op)
+        assert dev.to_pure(0) == oracle
+    # the surviving content: (p, v, y) plus the re-created (p, u, z)
+    assert set(oracle.entries) == {"p"}
+    assert set(oracle.entries["p"].entries) == {"u", "v"}
+    assert oracle.entries["p"].entries["u"].members() == frozenset({"z"})

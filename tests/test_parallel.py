@@ -465,3 +465,53 @@ def test_mesh_fold_fused_local_matches_tree():
     g_fused, _ = mesh_gossip(sharded, mesh, local_fold="fused")
     for x, y in zip(jax.tree.leaves(g_tree), jax.tree.leaves(g_fused)):
         assert bool(jnp.array_equal(x, y))
+
+
+@pytest.mark.parametrize("mesh_shape", [(4, 2), (2, 4)])
+@pytest.mark.parametrize("seed", [7, 23])
+def test_mesh_fold_map3_bit_identical(mesh_shape, seed):
+    import random
+
+    from crdt_tpu.models import BatchedMap3
+    from crdt_tpu.parallel import mesh_fold_map3, mesh_gossip_map3, shard_map3
+    from test_models_map3 import _batched as _m3batched, _site_run as _m3run
+
+    rng = random.Random(seed)
+    states = _m3run(rng, n_cmds=14)
+    batched = _m3batched(states)
+
+    mesh = make_mesh(*mesh_shape)
+    sharded = shard_map3(batched.state, mesh)
+    folded, overflow = mesh_fold_map3(sharded, mesh)
+    assert not bool(overflow.any())
+
+    nk1 = folded.odkeys.shape[-1]
+    nk2 = folded.mo.kdkeys.shape[-1] // nk1
+    out = BatchedMap3(
+        1,
+        nk1,
+        nk2,
+        folded.mo.core.ctr.shape[-2] // folded.mo.kdkeys.shape[-1],
+        folded.mo.core.top.shape[-1],
+        folded.odcl.shape[-2],
+        keys1=batched.keys1,
+        keys2=batched.keys2,
+        members=batched.members,
+        actors=batched.actors,
+    )
+    out.state = jax.tree.map(lambda x: x[None], folded)
+
+    expect = states[0].clone()
+    for r in states[1:]:
+        expect.merge(r.clone())
+    assert out.to_pure(0) == expect
+
+    # ring gossip reaches the identical converged state on every row
+    gossiped, g_of = mesh_gossip_map3(sharded, mesh)
+    assert not bool(g_of.any())
+    import numpy as np
+
+    for leaf_g, leaf_f in zip(jax.tree.leaves(gossiped), jax.tree.leaves(folded)):
+        g, f = np.asarray(leaf_g), np.asarray(leaf_f)
+        for row in range(g.shape[0]):
+            np.testing.assert_array_equal(g[row], f)

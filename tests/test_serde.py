@@ -187,3 +187,34 @@ def test_map_orswot_children_round_trip():
     m.apply(rm)
     back.apply(decode(encode(rm)))
     assert back == m
+
+
+def test_map3_children_round_trip():
+    # Depth-3 nesting: Map<K1, Map<K2, Orswot>> (and its three op forms)
+    # must survive the wire format — the arbitrary-depth Val genericity.
+    from crdt_tpu import Map, Orswot
+    from crdt_tpu.serde import decode, encode
+
+    m = Map(val_default=lambda: Map(val_default=Orswot))
+    ctx = m.len().derive_add_ctx("a")
+    up = m.update(
+        "k1", ctx, lambda child, c: child.update(
+            "k2", c, lambda s, c2: s.add("x", c2)
+        )
+    )
+    m.apply(up)
+    drop2 = m.update(
+        "k1", m.len().derive_add_ctx("b"),
+        lambda child, c: child.rm("k2", child.get("k2").derive_rm_ctx()),
+    )
+    rm1 = m.rm("k1", m.get("k1").derive_rm_ctx())
+
+    back = rt(m)
+    rt(up)
+    rt(drop2)
+    rt(rm1)
+    m.apply(drop2)
+    back.apply(decode(encode(drop2)))
+    m.apply(rm1)
+    back.apply(decode(encode(rm1)))
+    assert back == m
