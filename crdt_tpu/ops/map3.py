@@ -32,12 +32,8 @@ import jax.numpy as jnp
 
 from . import map_orswot as mo_ops
 from .map_orswot import MapOrswotState, _any_slots
-from .orswot import (
-    _apply_parked,
-    _compact_deferred,
-    _dedupe_deferred,
-    _park_remove,
-)
+from .orswot import _apply_parked, _park_remove
+from .outer_level import concat_outer, settle_outer_level
 
 DTYPE = jnp.uint32
 
@@ -157,20 +153,22 @@ def join(a: Map3State, b: Map3State, element_axis=None):
     sharded over when joining inside shard_map."""
     mo, mo_flags = mo_ops.join(a.mo, b.mo, element_axis=element_axis)
 
-    odcl = jnp.concatenate([a.odcl, b.odcl], axis=-2)
-    odkeys = jnp.concatenate([a.odkeys, b.odkeys], axis=-2)
-    odvalid = jnp.concatenate([a.odvalid, b.odvalid], axis=-1)
-    odcl, odkeys, odvalid = _dedupe_deferred(odcl, odkeys, odvalid)
-    state = Map3State(mo=mo, odcl=odcl, odkeys=odkeys, odvalid=odvalid)
-    state = _replay_outer(state)
-    odcl, odkeys, odvalid, outer_of = _compact_deferred(
-        state.odcl, state.odkeys, state.odvalid, a.odcl.shape[-2]
+    state = Map3State(
+        mo,
+        *concat_outer(
+            (a.odcl, a.odkeys, a.odvalid), (b.odcl, b.odkeys, b.odvalid)
+        ),
     )
-    state = _scrub_dead1(
-        state._replace(odcl=odcl, odkeys=odkeys, odvalid=odvalid),
+    state, outer_of = settle_outer_level(
+        state,
+        a.odcl.shape[-2],
+        get_bufs=lambda s: (s.odcl, s.odkeys, s.odvalid),
+        with_bufs=lambda s, cl, ks, v: s._replace(odcl=cl, odkeys=ks, odvalid=v),
+        replay=_replay_outer,
+        scrub=_scrub_dead1,
         element_axis=element_axis,
     )
-    return state, jnp.stack([mo_flags[0], mo_flags[1], jnp.any(outer_of)])
+    return state, jnp.stack([mo_flags[0], mo_flags[1], outer_of])
 
 
 def fold(states: Map3State, element_axis=None):

@@ -28,7 +28,8 @@ import jax.numpy as jnp
 
 from . import map as core_ops
 from .map import MapState, _canon_child, _rm_covered
-from .orswot import _compact_deferred, _dedupe_deferred, _park_remove
+from .orswot import _park_remove
+from .outer_level import concat_outer, settle_outer_level
 
 DTYPE = jnp.uint32
 
@@ -128,20 +129,22 @@ def join(a: NestedMapState, b: NestedMapState, element_axis=None):
     over when joining inside shard_map."""
     m, mf = core_ops.join(a.m, b.m)  # mf = [sibling, inner-deferred]
 
-    odcl = jnp.concatenate([a.odcl, b.odcl], axis=-2)
-    odkeys = jnp.concatenate([a.odkeys, b.odkeys], axis=-2)
-    odvalid = jnp.concatenate([a.odvalid, b.odvalid], axis=-1)
-    odcl, odkeys, odvalid = _dedupe_deferred(odcl, odkeys, odvalid)
-    state = NestedMapState(m=m, odcl=odcl, odkeys=odkeys, odvalid=odvalid)
-    state = _replay_outer(state)
-    odcl, odkeys, odvalid, outer_of = _compact_deferred(
-        state.odcl, state.odkeys, state.odvalid, a.odcl.shape[-2]
+    state = NestedMapState(
+        m,
+        *concat_outer(
+            (a.odcl, a.odkeys, a.odvalid), (b.odcl, b.odkeys, b.odvalid)
+        ),
     )
-    state = _scrub_dead_keys(
-        state._replace(odcl=odcl, odkeys=odkeys, odvalid=odvalid),
+    state, outer_of = settle_outer_level(
+        state,
+        a.odcl.shape[-2],
+        get_bufs=lambda s: (s.odcl, s.odkeys, s.odvalid),
+        with_bufs=lambda s, cl, ks, v: s._replace(odcl=cl, odkeys=ks, odvalid=v),
+        replay=_replay_outer,
+        scrub=_scrub_dead_keys,
         element_axis=element_axis,
     )
-    return state, jnp.stack([mf[0], mf[1], jnp.any(outer_of)])
+    return state, jnp.stack([mf[0], mf[1], outer_of])
 
 
 def fold(states: NestedMapState, element_axis=None):

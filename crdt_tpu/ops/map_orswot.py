@@ -33,13 +33,8 @@ import jax
 import jax.numpy as jnp
 
 from . import orswot as core_ops
-from .orswot import (
-    OrswotState,
-    _apply_parked,
-    _compact_deferred,
-    _dedupe_deferred,
-    _park_remove,
-)
+from .orswot import OrswotState, _apply_parked, _park_remove
+from .outer_level import concat_outer, settle_outer_level
 
 DTYPE = jnp.uint32
 
@@ -153,20 +148,22 @@ def join(a: MapOrswotState, b: MapOrswotState, element_axis=None):
     of dead-key slots can flag where the oracle would not.)"""
     core, inner_of = core_ops.join(a.core, b.core)
 
-    kdcl = jnp.concatenate([a.kdcl, b.kdcl], axis=-2)
-    kdkeys = jnp.concatenate([a.kdkeys, b.kdkeys], axis=-2)
-    kdvalid = jnp.concatenate([a.kdvalid, b.kdvalid], axis=-1)
-    kdcl, kdkeys, kdvalid = _dedupe_deferred(kdcl, kdkeys, kdvalid)
-    state = MapOrswotState(core=core, kdcl=kdcl, kdkeys=kdkeys, kdvalid=kdvalid)
-    state = _replay_outer(state)
-    kdcl, kdkeys, kdvalid, outer_of = _compact_deferred(
-        state.kdcl, state.kdkeys, state.kdvalid, a.kdcl.shape[-2]
+    state = MapOrswotState(
+        core,
+        *concat_outer(
+            (a.kdcl, a.kdkeys, a.kdvalid), (b.kdcl, b.kdkeys, b.kdvalid)
+        ),
     )
-    state = _scrub_dead_keys(
-        state._replace(kdcl=kdcl, kdkeys=kdkeys, kdvalid=kdvalid),
+    state, outer_of = settle_outer_level(
+        state,
+        a.kdcl.shape[-2],
+        get_bufs=lambda s: (s.kdcl, s.kdkeys, s.kdvalid),
+        with_bufs=lambda s, cl, ks, v: s._replace(kdcl=cl, kdkeys=ks, kdvalid=v),
+        replay=_replay_outer,
+        scrub=_scrub_dead_keys,
         element_axis=element_axis,
     )
-    return state, jnp.stack([jnp.any(inner_of), jnp.any(outer_of)])
+    return state, jnp.stack([jnp.any(inner_of), outer_of])
 
 
 def fold(states: MapOrswotState, element_axis=None):

@@ -1,0 +1,60 @@
+"""The shared outer-key-level settle sequence for nested-map slabs.
+
+Every nesting level a map wraps around an already-flattened causal slab
+(``map_orswot`` around orswot, ``map_map`` around the MVReg map,
+``map3`` around map_orswot — SURVEY.md §7.1 slab composition) carries
+the same outer deferred buffer and runs the same join-time sequence:
+
+    union both sides' parked keyset-removes
+    → dedupe equal-clock slots (dict-union semantics)
+    → replay against the content slab, dropping caught-up slots
+    → compact back to capacity (overflow if a live slot won't fit)
+    → scrub parked state inside bottomed children
+
+The per-type pieces — how a level-keyset mask expands onto the leaf
+slab, and which inner buffers a dead child takes down with it — stay in
+the type modules as the ``replay``/``scrub`` closures. The ORDER of the
+sequence lives here, once: it is correctness-critical (e.g. the scrub
+must follow the replay, because a replayed remove can newly bottom a
+child — tests/test_models_map3.py pins the failure mode).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .orswot import _compact_deferred, _dedupe_deferred
+
+Bufs = Tuple[jax.Array, jax.Array, jax.Array]  # (dcl, dkeys, dvalid)
+
+
+def concat_outer(a: Bufs, b: Bufs) -> Bufs:
+    """Union two outer buffers (slot-list concatenation; dedupe happens
+    in ``settle_outer_level``)."""
+    return (
+        jnp.concatenate([a[0], b[0]], axis=-2),
+        jnp.concatenate([a[1], b[1]], axis=-2),
+        jnp.concatenate([a[2], b[2]], axis=-1),
+    )
+
+
+def settle_outer_level(
+    state,
+    cap: int,
+    get_bufs: Callable,    # state -> (dcl, dkeys, dvalid)
+    with_bufs: Callable,   # (state, dcl, dkeys, dvalid) -> state
+    replay: Callable,      # state -> state   (kill covered + drop caught-up)
+    scrub: Callable,       # (state, element_axis) -> state
+    element_axis=None,
+):
+    """Dedupe → replay → compact → scrub one outer buffer level.
+    ``state`` arrives with the buffers already unioned (``concat_outer``)
+    and the inner levels already joined. Returns ``(state, overflow)``."""
+    dcl, dkeys, dvalid = _dedupe_deferred(*get_bufs(state))
+    state = replay(with_bufs(state, dcl, dkeys, dvalid))
+    dcl, dkeys, dvalid, overflow = _compact_deferred(*get_bufs(state), cap)
+    state = scrub(with_bufs(state, dcl, dkeys, dvalid), element_axis)
+    return state, jnp.any(overflow)

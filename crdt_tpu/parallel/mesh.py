@@ -387,20 +387,12 @@ def pad_map3(state, rmult: int, k1mult: int):
         )
     pad_k = (-nk1) % k1mult
     if pad_k:
+        # Pad whole K1 blocks: on the inner map_orswot slab that is
+        # exactly pad_map_orswot's key padding at k1mult*k2 granularity
+        # ((-nk1*k2) % (k1mult*k2) == pad_k*k2); only the K1-level
+        # buffer mask is map3-specific.
         state = state._replace(
-            mo=state.mo._replace(
-                core=state.mo.core._replace(
-                    ctr=jnp.pad(
-                        state.mo.core.ctr, ((0, 0), (0, pad_k * k2 * m), (0, 0))
-                    ),
-                    dmask=jnp.pad(
-                        state.mo.core.dmask, ((0, 0), (0, 0), (0, pad_k * k2 * m))
-                    ),
-                ),
-                kdkeys=jnp.pad(
-                    state.mo.kdkeys, ((0, 0), (0, 0), (0, pad_k * k2))
-                ),
-            ),
+            mo=pad_map_orswot(state.mo, 1, k1mult * k2),
             odkeys=jnp.pad(state.odkeys, ((0, 0), (0, 0), (0, pad_k))),
         )
     return state
