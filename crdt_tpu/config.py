@@ -94,17 +94,20 @@ def replicaset(
     n_members: Optional[int] = None,
     n_actors: Optional[int] = None,
     n_keys: Optional[int] = None,
+    n_keys2: Optional[int] = None,
 ):
     """The backend-selecting factory: N replicas of ``kind`` under the
     configured backend — a list of oracle objects for ``pure``, one
     batched device model for ``xla``. Kinds: orswot, map, map_orswot
-    (Map<K, Orswot>), map_map (Map<K1, Map<K2, MVReg>>), gcounter,
-    pncounter, gset, lwwreg, mvreg.
+    (Map<K, Orswot>), map_map (Map<K1, Map<K2, MVReg>>), map3
+    (Map<K1, Map<K2, Orswot>>), gcounter, pncounter, gset, lwwreg,
+    mvreg.
 
     Lane sizing for the xla backend: ``n_keys`` sizes the (outer) key
     axis, ``n_members`` sizes the inner axis of the nested kinds — the
     member universe for map_orswot, the INNER key universe (K2) for
-    map_map — and ``n_actors`` the actor lanes."""
+    map_map — ``n_keys2`` the K2 axis of map3, and ``n_actors`` the
+    actor lanes."""
     config.validate()
     if config.backend == "pure":
         from .pure.gcounter import GCounter
@@ -120,6 +123,7 @@ def replicaset(
             "map": lambda: Map(val_default=MVReg),
             "map_orswot": lambda: Map(val_default=Orswot),
             "map_map": lambda: Map(val_default=lambda: Map(val_default=MVReg)),
+            "map3": lambda: Map(val_default=lambda: Map(val_default=Orswot)),
             "gcounter": GCounter,
             "pncounter": PNCounter,
             "gset": GSet,
@@ -135,6 +139,7 @@ def replicaset(
         BatchedGSet,
         BatchedLWWReg,
         BatchedMap,
+        BatchedMap3,
         BatchedMapOrswot,
         BatchedMVReg,
         BatchedNestedMap,
@@ -169,6 +174,15 @@ def replicaset(
             n_members or 16,
             n_actors or 16,
             config.sibling_cap,
+            config.deferred_cap,
+        )
+    if kind == "map3":
+        return BatchedMap3(
+            n_replicas,
+            n_keys or 8,
+            n_keys2 or 8,
+            n_members or 8,
+            n_actors or 16,
             config.deferred_cap,
         )
     if kind == "gcounter":
