@@ -1,0 +1,110 @@
+"""Multi-host anti-entropy: two processes join one distributed runtime
+(``jax.distributed``), build a global (replica × element) mesh with the
+replica axis spanning processes — the DCN-facing axis — and run the SAME
+``mesh_fold`` program SPMD. The only cross-process traffic is the
+replica-axis lattice-join all-reduce (the NCCL/MPI-equivalent layer the
+reference leaves to its callers; SURVEY.md §6.8).
+
+Run (spawns its own two worker processes on CPU):
+  JAX_PLATFORMS=cpu python examples/04_multihost_dcn.py
+(on real multi-host TPU slices, run one worker per host with the
+coordinator address of host 0 — ``crdt_tpu.parallel.multihost``
+autodetects the cloud-TPU environment when called with no arguments)
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_WORKER = r"""
+import sys
+
+port, pid = sys.argv[1], int(sys.argv[2])
+
+from crdt_tpu.utils.cpu_pin import pin_cpu
+
+pin_cpu(virtual_devices=4)  # 4 virtual CPU devices per "host"
+
+import jax
+import numpy as np
+
+from crdt_tpu.parallel import multihost
+from crdt_tpu.parallel.mesh import orswot_specs
+
+multihost.initialize(f"localhost:{port}", num_processes=2, process_id=pid)
+
+from crdt_tpu.ops import orswot as ops
+
+# Eight replicas; each process owns rows [pid*4, (pid+1)*4). Every
+# replica adds members under its own actor lane.
+R, E, A = 8, 64, 8
+rng = np.random.default_rng(7)
+ctr = np.zeros((R, E, A), np.uint32)
+for i in range(R):
+    mine = rng.random(E) < 0.4
+    ctr[i, mine, i] = 1
+top = ctr.max(axis=1)
+
+mesh = multihost.global_mesh(n_element_shards=2)
+rows = slice(pid * 4, (pid + 1) * 4)
+local = ops.OrswotState(
+    top=top[rows],
+    ctr=ctr[rows],
+    dcl=np.zeros((4, 2, A), np.uint32),
+    dmask=np.zeros((4, 2, E), bool),
+    dvalid=np.zeros((4, 2), bool),
+)
+gstate = multihost.host_to_global(local, mesh, orswot_specs())
+
+from crdt_tpu.parallel import mesh_fold
+
+joined, overflow = mesh_fold(gstate, mesh)
+result = multihost.global_to_host(joined)
+assert not bool(np.asarray(jax.device_get(overflow)))
+
+members = int((np.asarray(result.ctr) > 0).any(-1).sum())
+union = int((ctr > 0).any((0, 2)).sum())
+assert members == union
+print(f"process {pid}: converged set has {members}/{union} members", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def main():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # each worker provisions its own devices
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep)
+    ).rstrip(os.pathsep)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(port), str(pid)],
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        rcs = [p.wait(timeout=120) for p in procs]
+    except subprocess.TimeoutExpired:
+        # One worker dying can leave its peer blocked in the rendezvous
+        # or all-reduce — never orphan it.
+        for p in procs:
+            p.kill()
+        raise
+    assert rcs == [0, 0], f"worker exit codes {rcs}"
+    print("both processes agree: multi-host fold over DCN converged")
+
+
+if __name__ == "__main__":
+    main()

@@ -12,7 +12,12 @@ _EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(__file__)), "examples")
 
 @pytest.mark.parametrize(
     "script",
-    ["01_collaborative_tags.py", "02_mesh_anti_entropy.py", "03_streamed_editing.py"],
+    [
+        "01_collaborative_tags.py",
+        "02_mesh_anti_entropy.py",
+        "03_streamed_editing.py",
+        "04_multihost_dcn.py",
+    ],
 )
 def test_example_runs(script):
     env = dict(os.environ)
