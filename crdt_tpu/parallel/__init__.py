@@ -66,10 +66,24 @@ from .anti_entropy import (
     mesh_gossip_map_orswot,
     mesh_gossip_nested_map,
 )
+from .delta import (
+    DeltaPacket,
+    apply_delta,
+    dirty_between,
+    extract_delta,
+    interval_accumulate,
+    mesh_delta_gossip,
+)
 from . import multihost
 
 __all__ = [
     "multihost",
+    "DeltaPacket",
+    "apply_delta",
+    "dirty_between",
+    "interval_accumulate",
+    "extract_delta",
+    "mesh_delta_gossip",
     "map3_specs",
     "map_orswot_specs",
     "nested_map_specs",

@@ -1,0 +1,296 @@
+"""δ-state anti-entropy: ship bounded deltas, not whole states.
+
+The delta-CRDT line (Almeida et al., "Efficient State-based CRDTs by
+Delta-Mutation" / "Delta State Replicated Data Types" — PAPERS.md) keeps
+state-based convergence but exchanges join-decompositions: only the
+sub-state that changed since the last exchange. The reference crate has
+no delta support; BASELINE config 3 names a "delta-state anti-entropy
+round" as the shape of the headline workload, and this module is that
+mode for the dense TPU slabs.
+
+TPU form (static shapes, no dynamic sparsity): each replica carries a
+``dirty[E]`` row mask and an ``fctx[E, A]`` per-row FORWARDING CONTEXT —
+for each changed element, the clock of every dot whose fate the replica
+can attest for that element (its live dots plus the dots it saw removed
+there). A delta round ships a fixed-size ``DeltaPacket`` of up to
+``cap`` (index, row, row-context) triples plus the bounded parked-remove
+buffer.
+
+Why per-row contexts and NOT the sender's top clock: a packet is a
+join-decomposition only if every dot its context covers is accounted for
+by its store. Shipping the full top with a partial row set lets the
+receiver's context outrun its rows; when the receiver later forwards a
+row under that inflated context, downstream peers read the missing dots
+as removals and wrongly kill live entries (a real failure mode — pinned
+by tests/test_delta.py). With row-scoped contexts the receiver's top
+grows only by knowledge its rows now reflect, so the ORSWOT invariant
+(rows reflect top) survives partial exchange.
+
+The receiver scatter-joins packet rows under (receiver top, packet row
+context) — the full ``ops.orswot.join`` survival rule restricted to the
+packet rows — and re-marks every row the packet carried (domain
+forwarding: the row's interpreting context grew even if its dots did
+not), which propagates deltas transitively around the ring. A sender
+clears rows it ships; residue past ``cap`` stays dirty and drains over
+subsequent rounds (bounded backlog, no loss).
+
+Tracking contract: accumulate (dirty, fctx) with ``interval_accumulate``
+at op granularity — or any granularity fine enough that no dot is both
+born and removed between two accumulation points — starting from a
+moment the replicas were mutually synced (genesis counts). Bandwidth per
+round per link is O(cap·2A + D·E/8) instead of O(E·A).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.orswot import (
+    OrswotState,
+    _apply_parked,
+    _compact_deferred,
+    _dedupe_deferred,
+)
+from ..utils.metrics import metrics, state_nbytes
+from .mesh import (
+    ELEMENT_AXIS,
+    REPLICA_AXIS,
+    orswot_specs,
+    pad_elements,
+    pad_replicas,
+)
+
+
+class DeltaPacket(NamedTuple):
+    """One replica's bounded delta (shard-local element indices)."""
+
+    idx: jax.Array    # [C] int32
+    rows: jax.Array   # [C, A]  live dots of the shipped elements
+    ctxs: jax.Array   # [C, A]  per-row causal context (dots accounted for)
+    valid: jax.Array  # [C] bool
+    dcl: jax.Array    # [D, A]  parked removes ride whole (bounded)
+    dmask: jax.Array  # [D, E]
+    dvalid: jax.Array # [D]
+
+
+def interval_accumulate(
+    dirty: jax.Array, fctx: jax.Array, old: OrswotState, new: OrswotState
+) -> Tuple[jax.Array, jax.Array]:
+    """Fold one mutation step into the (dirty, fctx) tracking pair:
+    changed rows become dirty and their context absorbs both endpoint
+    rows (a dot the old row held and the new row lacks is a dot this
+    replica saw removed — that knowledge must ride the delta)."""
+    changed = jnp.any(old.ctr != new.ctr, axis=-1)
+    grown = jnp.maximum(fctx, jnp.maximum(old.ctr, new.ctr))
+    return dirty | changed, jnp.where(changed[..., None], grown, fctx)
+
+
+def dirty_between(old: OrswotState, new: OrswotState) -> jax.Array:
+    """Row mask of elements whose dot rows differ."""
+    return jnp.any(old.ctr != new.ctr, axis=-1)
+
+
+def extract_delta(
+    state: OrswotState,
+    dirty: jax.Array,
+    fctx: jax.Array,
+    cap: int,
+    start=0,
+) -> Tuple[DeltaPacket, jax.Array, jax.Array]:
+    """Pack up to ``cap`` dirty rows with their contexts and clear them
+    locally (the ring delivers reliably; residue past ``cap`` drains
+    next round). ``start`` rotates the scan origin — domain-forwarded
+    rows re-enter the queue, so a fixed lowest-index-first order would
+    starve high-index rows under a small cap; rotating by
+    ``round * cap`` round-robins every row a slot within E/cap rounds.
+    Returns ``(packet, dirty, fctx)``."""
+    e = dirty.shape[-1]
+    pos = (jnp.arange(e) - start) % e
+    order = jnp.argsort(jnp.where(dirty, pos, e + pos))
+    idx = order[:cap].astype(jnp.int32)
+    valid = jnp.take(dirty, idx)
+    rows = jnp.take(state.ctr, idx, axis=0)
+    ctxs = jnp.maximum(jnp.take(fctx, idx, axis=0), rows)
+    pkt = DeltaPacket(
+        idx=idx,
+        rows=jnp.where(valid[:, None], rows, 0),
+        ctxs=jnp.where(valid[:, None], ctxs, 0),
+        valid=valid,
+        dcl=state.dcl,
+        dmask=state.dmask,
+        dvalid=state.dvalid,
+    )
+    fctx = fctx.at[idx].set(jnp.where(valid[:, None], 0, jnp.take(fctx, idx, axis=0)))
+    return pkt, dirty.at[idx].set(False), fctx
+
+
+def apply_delta(
+    state: OrswotState, pkt: DeltaPacket, dirty: jax.Array, fctx: jax.Array
+) -> Tuple[OrswotState, jax.Array, jax.Array, jax.Array]:
+    """Join a delta into ``state``: per-row orswot survival under
+    (receiver top, packet row context) — ops.orswot.join restricted to
+    the packet rows — plus the full deferred union/replay/compaction.
+    The receiver's top and per-row forwarding contexts absorb only the
+    packet's row-scoped knowledge. Returns
+    ``(state, dirty, fctx, overflow)``."""
+    recv = jnp.take(state.ctr, pkt.idx, axis=0)  # [C, A]
+    wa = jnp.where(recv > pkt.ctxs, recv, 0)
+    wb = jnp.where(pkt.rows > state.top[None, :], pkt.rows, 0)
+    pa = jnp.any(recv > 0, axis=-1)
+    pb = jnp.any(pkt.rows > 0, axis=-1)
+    common = jnp.maximum(jnp.minimum(recv, pkt.rows), jnp.maximum(wa, wb))
+    new = jnp.where(
+        (pa & pb)[:, None],
+        common,
+        jnp.where((pa & ~pb)[:, None], wa, jnp.where((pb & ~pa)[:, None], wb, 0)),
+    ).astype(recv.dtype)
+    new = jnp.where(pkt.valid[:, None], new, recv)
+    ctr = state.ctr.at[pkt.idx].set(new)
+    # Row-scoped knowledge only: each applied context covers dots of its
+    # own element, and that row now reflects it — the invariant "rows
+    # reflect top" survives, unlike joining the sender's whole top.
+    applied_ctx = jnp.max(
+        jnp.where(pkt.valid[:, None], pkt.ctxs, 0), axis=0
+    )
+    top = jnp.maximum(state.top, applied_ctx)
+
+    # Deferred union — identical tail to ops.orswot.join (rm clocks are
+    # their own contexts, so parked removes ship whole and stay sound).
+    dcl = jnp.concatenate([state.dcl, pkt.dcl], axis=-2)
+    dmask = jnp.concatenate([state.dmask, pkt.dmask], axis=-2)
+    dvalid = jnp.concatenate([state.dvalid, pkt.dvalid], axis=-1)
+    dcl, dmask, dvalid = _dedupe_deferred(dcl, dmask, dvalid)
+    before = ctr
+    ctr = _apply_parked(ctr, dcl, dmask, dvalid)
+    still_ahead = ~jnp.all(dcl <= top[None, :], axis=-1)
+    dvalid = dvalid & still_ahead
+    cap_d = state.dcl.shape[-2]
+    dcl, dmask, dvalid, overflow = _compact_deferred(dcl, dmask, dvalid, cap_d)
+
+    # Forward on packet DOMAIN, not on content change: a remove-delta
+    # can land on a row the receiver already lacks — nothing changes
+    # locally, but downstream peers may still hold the dots, so the
+    # (row, context) pair keeps riding the ring. Finite `rounds` bounds
+    # the redundant re-circulation.
+    old_f = jnp.take(fctx, pkt.idx, axis=0)
+    new_f = jnp.where(
+        pkt.valid[:, None], jnp.maximum(jnp.maximum(old_f, pkt.ctxs), new), old_f
+    )
+    fctx = fctx.at[pkt.idx].set(new_f)
+    dirty = dirty.at[pkt.idx].set(jnp.take(dirty, pkt.idx) | pkt.valid)
+    dirty = dirty | jnp.any(ctr != before, axis=-1)
+    fctx = jnp.maximum(fctx, jnp.where(jnp.any(ctr != before, axis=-1)[:, None], before, 0))
+    out = OrswotState(top=top, ctr=ctr, dcl=dcl, dmask=dmask, dvalid=dvalid)
+    return out, dirty, fctx, jnp.any(overflow)
+
+
+def mesh_delta_gossip(
+    state: OrswotState,
+    dirty: jax.Array,
+    fctx: jax.Array,
+    mesh: Mesh,
+    rounds: Optional[int] = None,
+    cap: int = 64,
+    local_fold: str = "auto",
+):
+    """Ring δ anti-entropy over the mesh: each device folds its local
+    replica block (OR-folding dirty, max-folding contexts), then runs
+    ``rounds`` unit-shift ring rounds shipping ONE bounded DeltaPacket
+    per link per round instead of a whole state (``mesh_gossip``'s
+    bandwidth mode for large, low-churn element universes).
+
+    ``dirty [R, E]`` / ``fctx [R, E, A]`` come from
+    ``interval_accumulate`` tracking since the replicas last synced.
+    With ``rounds`` = P-1 (default) and ``cap`` covering the per-device
+    dirty load, every device row equals the full join; residue past
+    ``cap`` drains with extra rounds (forwarding hops add rounds too:
+    budget P-1 ring latencies of the backlog).
+
+    Returns ``(states [P, ...], dirty [P, E], overflow)`` — overflow is
+    the deferred-buffer flag, as in ``mesh_gossip``."""
+    from ..ops.pallas_kernels import fold_auto
+
+    p = mesh.shape[REPLICA_AXIS]
+    if rounds is None:
+        rounds = p - 1
+    state = pad_replicas(state, p)
+    state = pad_elements(state, mesh.shape[ELEMENT_AXIS])
+    pad_r = state.top.shape[0] - dirty.shape[0]
+    pad_e = state.ctr.shape[-2] - dirty.shape[-1]
+    dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_e)))
+    fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_e), (0, 0)))
+
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def build():
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(
+                orswot_specs(),
+                P(REPLICA_AXIS, ELEMENT_AXIS),
+                P(REPLICA_AXIS, ELEMENT_AXIS, None),
+            ),
+            out_specs=(orswot_specs(), P(REPLICA_AXIS, ELEMENT_AXIS), P()),
+            check_vma=False,
+        )
+        def gossip_fn(local, local_dirty, local_fctx):
+            folded, of = fold_auto(local, prefer=local_fold)
+            d = jnp.any(local_dirty, axis=0)
+            f = jnp.max(local_fctx, axis=0)
+
+            def round_body(r, carry):
+                st, d, f, of = carry
+                pkt, d, f = extract_delta(st, d, f, cap, start=r * cap)
+                pkt = jax.tree.map(
+                    lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt
+                )
+                st, d, f, of_r = apply_delta(st, pkt, d, f)
+                return st, d, f, of | of_r
+
+            folded, d, f, of = lax.fori_loop(
+                0, rounds, round_body, (folded, d, f, of)
+            )
+            # Close the books on the top clock: per-row contexts grow
+            # tops only by row-scoped knowledge, so per-device tops
+            # lag the full-join top (and diverge across element
+            # shards). The union of the LOCAL-FOLD tops over the whole
+            # mesh IS the full-join top, and once content has
+            # converged, adopting it (then re-replaying parked removes
+            # under it) reproduces the full fold exactly.
+            top = lax.pmax(
+                lax.pmax(folded.top, REPLICA_AXIS), ELEMENT_AXIS
+            )
+            ctr = _apply_parked(
+                folded.ctr, folded.dcl, folded.dmask, folded.dvalid
+            )
+            still = ~jnp.all(folded.dcl <= top[None, :], axis=-1)
+            dvalid = folded.dvalid & still
+            folded = OrswotState(
+                top=top,
+                ctr=ctr,
+                dcl=jnp.where(dvalid[:, None], folded.dcl, 0),
+                dmask=folded.dmask & dvalid[:, None],
+                dvalid=dvalid,
+            )
+            of = lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS)) > 0
+            return jax.tree.map(lambda x: x[None], folded), d[None], of
+
+        return gossip_fn
+
+    metrics.count("anti_entropy.delta_rounds", rounds)
+    metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
+    with metrics.time("anti_entropy.delta_gossip"):
+        from .anti_entropy import _cached
+
+        out = _cached("delta_gossip", state, mesh, build, rounds, cap, local_fold)(
+            state, dirty, fctx
+        )
+        jax.block_until_ready(out)
+    return out

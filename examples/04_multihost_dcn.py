@@ -16,8 +16,7 @@ import os
 import socket
 import subprocess
 import sys
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import time
 
 _WORKER = r"""
 import sys
@@ -94,14 +93,18 @@ def main():
         )
         for pid in (0, 1)
     ]
+    deadline = time.monotonic() + 120  # shared budget across BOTH waits
     try:
-        rcs = [p.wait(timeout=120) for p in procs]
-    except subprocess.TimeoutExpired:
+        rcs = [
+            p.wait(timeout=max(1.0, deadline - time.monotonic()))
+            for p in procs
+        ]
+    finally:
         # One worker dying can leave its peer blocked in the rendezvous
-        # or all-reduce — never orphan it.
+        # or all-reduce — never orphan it, on any exit path.
         for p in procs:
-            p.kill()
-        raise
+            if p.poll() is None:
+                p.kill()
     assert rcs == [0, 0], f"worker exit codes {rcs}"
     print("both processes agree: multi-host fold over DCN converged")
 
