@@ -185,3 +185,43 @@ def test_delta_gossip_drains_past_cap():
     )
     assert not bool(of)
     _rows_equal(gossiped, folded)
+
+
+def test_interval_accumulate_tracking_converges():
+    """Tracking built with interval_accumulate (per-op endpoint diffs,
+    the contract-documented API) must drive δ-gossip to the full fold
+    like the op-log builder does."""
+    from crdt_tpu.parallel import interval_accumulate
+
+    rng = random.Random(11)
+    states, applied = _rand_states(rng, 8, ["a", "b", "c"])
+    batched = BatchedOrswot.from_pure(states)
+
+    # Rebuild each replica's device state op by op, accumulating
+    # (dirty, fctx) from the endpoint states of every step.
+    e, a = batched.state.ctr.shape[-2], batched.state.ctr.shape[-1]
+    dirty = jnp.zeros((8, e), bool)
+    fctx = jnp.zeros((8, e, a), jnp.uint32)
+    replay = BatchedOrswot(
+        8, e, a, batched.state.dcl.shape[-2],
+        members=batched.members, actors=batched.actors,
+    )
+    for i, ops_i in enumerate(applied):
+        for op in ops_i:
+            old = jax.tree.map(lambda x: x[i], replay.state)
+            replay.apply(i, op)
+            new = jax.tree.map(lambda x: x[i], replay.state)
+            d_i, f_i = interval_accumulate(dirty[i], fctx[i], old, new)
+            dirty, fctx = dirty.at[i].set(d_i), fctx.at[i].set(f_i)
+    np.testing.assert_array_equal(
+        np.asarray(replay.state.ctr), np.asarray(batched.state.ctr)
+    )
+
+    mesh = make_mesh(4, 2)
+    sharded = shard_orswot(replay.state, mesh)
+    folded, _ = mesh_fold(sharded, mesh)
+    gossiped, _, of = mesh_delta_gossip(
+        sharded, dirty, fctx, mesh, rounds=8, cap=32
+    )
+    assert not bool(of)
+    _rows_equal(gossiped, folded)
