@@ -35,6 +35,41 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def tpu_reachable(timeout_s: int = 150) -> bool:
+    """Probe backend initialization in a SUBPROCESS with a hard timeout.
+
+    The TPU here sits behind a relay; when the relay is down, merely
+    touching ``jax.devices()`` blocks forever — which would hang the
+    whole bench (and the driver's round artifact) rather than fail it.
+    A throwaway process takes the risk instead. "Reachable" requires the
+    probe to actually land on a TPU backend: a quick axon-init failure
+    silently falls back to XLA:CPU, which must NOT pass as a chip."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; jax.devices(); print(jax.default_backend())",
+            ],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"TPU probe timed out after {timeout_s}s (wedged relay)")
+        return False
+    backend = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    if proc.returncode == 0 and backend in ("tpu", "axon"):
+        return True
+    log(
+        f"TPU probe failed: rc={proc.returncode}, backend={backend!r}, "
+        f"stderr tail: {proc.stderr.strip()[-400:]}"
+    )
+    return False
+
+
 # Config-3 shape; override via env for scaled runs.
 R = int(os.environ.get("BENCH_REPLICAS", 10240))
 E = int(os.environ.get("BENCH_ELEMS", 102400))
@@ -409,6 +444,21 @@ def bench_list():
 
 
 def main():
+    global R, E, CHUNK
+    degraded = False
+    if os.environ.get("BENCH_PROBE", "1") != "0" and not tpu_reachable():
+        # No real TPU: fail FAST and honest instead of hanging the round.
+        # Pin CPU (dropping the wedged backend), scale the shape to
+        # something XLA:CPU finishes, and label the result so it is
+        # never mistaken for a chip number.
+        log("no TPU backend available; running the CPU-fallback bench")
+        from crdt_tpu.utils.cpu_pin import pin_cpu
+
+        pin_cpu()
+        degraded = True
+        R, E, CHUNK = min(R, 64), min(E, 4096), min(CHUNK, 16)
+        for var, cpu_cap in (("BENCH_MAP_KEYS", 20000), ("BENCH_LIST_OPS", 5000)):
+            os.environ[var] = str(min(int(os.environ.get(var, cpu_cap)), cpu_cap))
     for name, fn in [
         ("clocks", bench_clocks),
         ("map", bench_map),
@@ -428,7 +478,7 @@ def main():
                 "value": round(tpu_mps, 1),
                 "unit": "merges/s",
                 "vs_baseline": round(tpu_mps / cpu_mps, 2),
-                "path": path,
+                "path": "cpu-fallback" if degraded else path,
                 "gbps": round(gbps, 1),
                 "bytes_moved": bytes_moved,
                 "shape": shape,
