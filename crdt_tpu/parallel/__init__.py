@@ -74,6 +74,13 @@ from .delta import (
     interval_accumulate,
     mesh_delta_gossip,
 )
+from .delta_map import (
+    MapDeltaPacket,
+    apply_delta_map,
+    extract_delta_map,
+    interval_accumulate_map,
+    mesh_delta_gossip_map,
+)
 from . import multihost
 
 __all__ = [
@@ -82,6 +89,11 @@ __all__ = [
     "apply_delta",
     "dirty_between",
     "interval_accumulate",
+    "MapDeltaPacket",
+    "apply_delta_map",
+    "extract_delta_map",
+    "interval_accumulate_map",
+    "mesh_delta_gossip_map",
     "extract_delta",
     "mesh_delta_gossip",
     "map3_specs",

@@ -1,0 +1,343 @@
+"""δ-state anti-entropy for the composition layer: ``Map<K, MVReg>``.
+
+Same discipline as :mod:`.delta` (which documents the theory and the
+two failure modes that force per-row contexts and domain forwarding),
+applied to the config-4 map slabs: a delta packet ships up to ``cap``
+(key index, content slots, per-key causal context) triples plus the
+bounded parked keyset-remove buffer. Per-key survival is the full
+``ops.map.join`` rule restricted to the packet keys — content survives
+iff the peer holds the same witness dot or the dot is unseen by the
+peer's per-key context — so convergence is inherited from the join, not
+re-proven.
+
+A key's forwarding context covers the dots the replica can attest for
+THAT KEY: the witness dots it saw there (live or since superseded) plus
+any keyset-rm clocks applied there — and nothing cross-key (see
+``_key_knowledge`` for why a put's stored clock must stay out). Track
+with ``interval_accumulate_map`` or from op logs at op granularity, as
+in delta.py's contract.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import map as map_ops
+from ..ops.map import (
+    MapState,
+    _apply_parked,
+    _canon_child,
+    _dot_in,
+    _drop_stale_deferred,
+)
+from ..ops.mvreg import MVRegState
+from ..ops.orswot import _compact_deferred, _dedupe_deferred
+from ..utils.metrics import metrics, state_nbytes
+from .mesh import (
+    ELEMENT_AXIS,
+    REPLICA_AXIS,
+    map_specs,
+    pad_keys,
+    pad_replicas_map,
+)
+
+
+class MapDeltaPacket(NamedTuple):
+    """One replica's bounded map delta (shard-local key indices)."""
+
+    idx: jax.Array     # [C] int32
+    child: MVRegState  # [C, S(, A)] content slots of the shipped keys
+    ctxs: jax.Array    # [C, A] per-key causal context
+    valid: jax.Array   # [C] bool
+    dcl: jax.Array     # [D, A] parked keyset-removes ride whole
+    dkeys: jax.Array   # [D, K]
+    dvalid: jax.Array  # [D]
+
+
+def _key_knowledge(child: MVRegState) -> jax.Array:
+    """Per-key clock of the WITNESS DOTS the content slots attest.
+    child [..., K, S] → [..., K, A].
+
+    Deliberately excludes the slots' write clocks: a put's stored clock
+    is its minter's whole-map top at mint time — CROSS-key knowledge.
+    Folding it into a per-key context lets a delta claim dots of other
+    keys that its slots cannot account for, which kills concurrent
+    siblings the full join keeps (found the hard way; the A/B gates in
+    test_delta_map.py pin it). Superseded-sibling removal knowledge
+    still propagates: whoever held the sibling witnessed its dot, so
+    the dot enters that replica's tracking at this key."""
+    a = child.clk.shape[-1]
+    wdot = (
+        jax.nn.one_hot(child.wact, a, dtype=child.wctr.dtype)
+        * child.wctr[..., None]
+    )
+    return jnp.max(jnp.where(child.valid[..., None], wdot, 0), axis=-2)
+
+
+def interval_accumulate_map(
+    dirty: jax.Array, fctx: jax.Array, old: MapState, new: MapState
+) -> Tuple[jax.Array, jax.Array]:
+    """Fold one mutation step into (dirty, fctx): changed keys become
+    dirty and their context absorbs both endpoints' per-key knowledge."""
+    changed = jnp.any(
+        jnp.stack(
+            [
+                jnp.any(old.child.wact != new.child.wact, axis=-1),
+                jnp.any(old.child.wctr != new.child.wctr, axis=-1),
+                jnp.any(old.child.valid != new.child.valid, axis=-1),
+                jnp.any(old.child.clk != new.child.clk, axis=(-2, -1)),
+                jnp.any(old.child.val != new.child.val, axis=-1),
+            ]
+        ),
+        axis=0,
+    )
+    grown = jnp.maximum(
+        fctx, jnp.maximum(_key_knowledge(old.child), _key_knowledge(new.child))
+    )
+    return dirty | changed, jnp.where(changed[..., None], grown, fctx)
+
+
+def extract_delta_map(
+    state: MapState, dirty: jax.Array, fctx: jax.Array, cap: int, start=0
+) -> Tuple[MapDeltaPacket, jax.Array, jax.Array]:
+    """Pack up to ``cap`` dirty keys with their contexts and clear them
+    locally; rotation as in delta.extract_delta. Returns
+    ``(packet, dirty, fctx)``."""
+    k = dirty.shape[-1]
+    pos = (jnp.arange(k) - start) % k
+    order = jnp.argsort(jnp.where(dirty, pos, k + pos))
+    idx = order[:cap].astype(jnp.int32)
+    valid = jnp.take(dirty, idx)
+    rows = jax.tree.map(lambda x: jnp.take(x, idx, axis=0), state.child)
+    ctxs = jnp.maximum(jnp.take(fctx, idx, axis=0), _key_knowledge(rows))
+    zero = lambda x: jnp.where(
+        valid.reshape((-1,) + (1,) * (x.ndim - 1)), x, jnp.zeros_like(x)
+    )
+    pkt = MapDeltaPacket(
+        idx=idx,
+        child=jax.tree.map(zero, rows),
+        ctxs=jnp.where(valid[:, None], ctxs, 0),
+        valid=valid,
+        dcl=state.dcl,
+        dkeys=state.dkeys,
+        dvalid=state.dvalid,
+    )
+    fctx = fctx.at[idx].set(jnp.where(valid[:, None], 0, jnp.take(fctx, idx, axis=0)))
+    return pkt, dirty.at[idx].set(False), fctx
+
+
+def _cov(clock: jax.Array, act: jax.Array, ctr: jax.Array) -> jax.Array:
+    """ctr <= clock[act] per slot: [C, A] clock vs [C, S] (act, ctr)."""
+    return ctr <= jnp.take_along_axis(clock, act, axis=-1)
+
+
+def _replay_on_rows(rows: MVRegState, idx, dcl, dkeys, dvalid) -> MVRegState:
+    """Kill covered content among packet-key rows [C, S*] under every
+    parked (clock, keyset) slot, keysets gathered at ``idx`` — the
+    per-row form of ops.map._apply_parked."""
+
+    def step(valid, slot):
+        cl, keys, dv = slot  # [A], [K], []
+        kmask = jnp.take(keys, idx)  # [C]
+        c = idx.shape[0]
+        dead = (
+            kmask[:, None]
+            & _cov(jnp.broadcast_to(cl[None, :], (c, cl.shape[-1])),
+                   rows.wact, rows.wctr)
+            & dv
+        )
+        return valid & ~dead, None
+
+    valid, _ = lax.scan(step, rows.valid, (dcl, dkeys, dvalid))
+    return rows._replace(valid=valid)
+
+
+def apply_delta_map(
+    state: MapState, pkt: MapDeltaPacket, dirty: jax.Array, fctx: jax.Array
+) -> Tuple[MapState, jax.Array, jax.Array, jax.Array]:
+    """Join a map delta into ``state``: the ops.map.join content rule
+    restricted to the packet keys, with per-key packet contexts standing
+    in for the sender's top. Returns ``(state, dirty, fctx,
+    overflow[2])`` — [sibling-slab, deferred] as in ops.map.join."""
+    recv = jax.tree.map(lambda x: jnp.take(x, pkt.idx, axis=0), state.child)
+    c = pkt.idx.shape[0]
+    rtop = jnp.broadcast_to(state.top[None, :], (c, state.top.shape[-1]))
+
+    keep_r = recv.valid & (
+        _dot_in(recv, pkt.child) | ~_cov(pkt.ctxs, recv.wact, recv.wctr)
+    )
+    keep_p = pkt.child.valid & (
+        _dot_in(pkt.child, recv) | ~_cov(rtop, pkt.child.wact, pkt.child.wctr)
+    )
+    union = MVRegState(
+        wact=jnp.concatenate([recv.wact, pkt.child.wact], axis=-1),
+        wctr=jnp.concatenate([recv.wctr, pkt.child.wctr], axis=-1),
+        clk=jnp.concatenate([recv.clk, pkt.child.clk], axis=-2),
+        val=jnp.concatenate([recv.val, pkt.child.val], axis=-1),
+        valid=jnp.concatenate([keep_r, keep_p], axis=-1),
+    )
+    s2 = union.wact.shape[-1]
+    dup = (
+        (union.wact[..., :, None] == union.wact[..., None, :])
+        & (union.wctr[..., :, None] == union.wctr[..., None, :])
+        & union.valid[..., :, None]
+        & union.valid[..., None, :]
+    )
+    first = jnp.argmax(dup, axis=-1)
+    union = union._replace(valid=union.valid & (first == jnp.arange(s2)))
+
+    # Union the deferred keyset buffers FIRST and replay them on the
+    # double-width union before the capacity check — as ops.map.join
+    # does ("a union that only transiently exceeds capacity does not
+    # flag overflow"): a parked remove arriving in this very packet may
+    # be what keeps the survivors within the slab.
+    dcl = jnp.concatenate([state.dcl, pkt.dcl], axis=-2)
+    dkeys = jnp.concatenate([state.dkeys, pkt.dkeys], axis=-2)
+    dvalid = jnp.concatenate([state.dvalid, pkt.dvalid], axis=-1)
+    dcl, dkeys, dvalid = _dedupe_deferred(dcl, dkeys, dvalid)
+    union = _replay_on_rows(union, pkt.idx, dcl, dkeys, dvalid)
+
+    union = _canon_child(union)
+    scap = state.child.wact.shape[-1]
+    slab_of = jnp.any(
+        (jnp.sum(union.valid, axis=-1) > scap) & pkt.valid
+    )
+    merged = jax.tree.map(
+        lambda x: x[..., :scap, :] if x.ndim == union.clk.ndim else x[..., :scap],
+        union,
+    )
+    # Skip invalid packet slots; scatter merged rows back.
+    put = lambda whole, rows, per_row: whole.at[pkt.idx].set(
+        jnp.where(
+            pkt.valid.reshape((-1,) + (1,) * (rows.ndim - 1)), rows, per_row
+        )
+    )
+    child = jax.tree.map(
+        lambda whole, rows, old: put(whole, rows, old),
+        state.child,
+        merged,
+        recv,
+    )
+    applied_ctx = jnp.max(jnp.where(pkt.valid[:, None], pkt.ctxs, 0), axis=0)
+    top = jnp.maximum(state.top, applied_ctx)
+
+    st = MapState(top=top, child=child, dcl=dcl, dkeys=dkeys, dvalid=dvalid)
+    before = st.child
+    st = _drop_stale_deferred(_apply_parked(st))
+    dcl, dkeys, dvalid, d_of = _compact_deferred(
+        st.dcl, st.dkeys, st.dvalid, state.dcl.shape[-2]
+    )
+    st = st._replace(
+        child=_canon_child(st.child), dcl=dcl, dkeys=dkeys, dvalid=dvalid
+    )
+
+    # Domain forwarding + context accumulation (see delta.py).
+    old_f = jnp.take(fctx, pkt.idx, axis=0)
+    row_know = _key_knowledge(
+        jax.tree.map(lambda x: jnp.take(x, pkt.idx, axis=0), st.child)
+    )
+    new_f = jnp.where(
+        pkt.valid[:, None],
+        jnp.maximum(jnp.maximum(old_f, pkt.ctxs), row_know),
+        old_f,
+    )
+    fctx = fctx.at[pkt.idx].set(new_f)
+    dirty = dirty.at[pkt.idx].set(jnp.take(dirty, pkt.idx) | pkt.valid)
+    # A parked-remove replay that killed content is removal knowledge
+    # the killed keys must forward (the delta.py analog of growing fctx
+    # by the pre-replay rows): absorb the pre-replay witness dots.
+    replay_changed = jnp.any(st.child.valid != before.valid, axis=-1)
+    dirty = dirty | replay_changed
+    fctx = jnp.maximum(
+        fctx,
+        jnp.where(replay_changed[:, None], _key_knowledge(before), 0),
+    )
+    return st, dirty, fctx, jnp.stack([slab_of, jnp.any(d_of)])
+
+
+def mesh_delta_gossip_map(
+    state: MapState,
+    dirty: jax.Array,
+    fctx: jax.Array,
+    mesh: Mesh,
+    rounds: Optional[int] = None,
+    cap: int = 64,
+):
+    """Ring δ anti-entropy for Map<K, MVReg> replica batches over the
+    mesh — the bandwidth-bounded mode for large key universes with local
+    churn (see delta.mesh_delta_gossip for semantics, rounds/cap
+    budgeting, and the top-closure step). Returns
+    ``(states [P, ...], dirty [P, K], overflow[2])``."""
+    p = mesh.shape[REPLICA_AXIS]
+    if rounds is None:
+        rounds = p - 1
+    state = pad_replicas_map(state, p)
+    state = pad_keys(state, mesh.shape[ELEMENT_AXIS])
+    pad_r = state.top.shape[0] - dirty.shape[0]
+    pad_k = state.dkeys.shape[-1] - dirty.shape[-1]
+    dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_k)))
+    fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_k), (0, 0)))
+
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def build():
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(
+                map_specs(),
+                P(REPLICA_AXIS, ELEMENT_AXIS),
+                P(REPLICA_AXIS, ELEMENT_AXIS, None),
+            ),
+            out_specs=(map_specs(), P(REPLICA_AXIS, ELEMENT_AXIS), P()),
+            check_vma=False,
+        )
+        def gossip_fn(local, local_dirty, local_fctx):
+            folded, of = map_ops.fold(local)
+            d = jnp.any(local_dirty, axis=0)
+            f = jnp.max(local_fctx, axis=0)
+
+            def round_body(r, carry):
+                st, d, f, of = carry
+                pkt, d, f = extract_delta_map(st, d, f, cap, start=r * cap)
+                pkt = jax.tree.map(
+                    lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt
+                )
+                st, d, f, of_r = apply_delta_map(st, pkt, d, f)
+                return st, d, f, of | of_r
+
+            folded, d, f, of = lax.fori_loop(
+                0, rounds, round_body, (folded, d, f, of)
+            )
+            # Top closure (see delta.py): per-key contexts under-fill
+            # the top; the union of local-fold tops is the full top.
+            # Re-replay parked keyset-removes under it.
+            top = lax.pmax(lax.pmax(folded.top, REPLICA_AXIS), ELEMENT_AXIS)
+            folded = _drop_stale_deferred(
+                _apply_parked(folded._replace(top=top))
+            )
+            folded = folded._replace(child=_canon_child(folded.child))
+            of = (
+                lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS))
+                > 0
+            )
+            return jax.tree.map(lambda x: x[None], folded), d[None], of
+
+        return gossip_fn
+
+    metrics.count("anti_entropy.map_delta_rounds", rounds)
+    metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
+    with metrics.time("anti_entropy.map_delta_gossip"):
+        from .anti_entropy import _cached
+
+        out = _cached(
+            "map_delta_gossip", state, mesh, build, rounds, cap
+        )(state, dirty, fctx)
+        jax.block_until_ready(out)
+    return out

@@ -1,0 +1,172 @@
+"""δ-state anti-entropy for Map<K, MVReg> (parallel/delta_map.py):
+bounded per-key delta packets on the ring must reach the same converged
+state as the full mesh fold."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from crdt_tpu.models import BatchedMap
+from crdt_tpu.parallel import (
+    make_mesh,
+    mesh_delta_gossip_map,
+    mesh_fold_map,
+    shard_map_state,
+)
+from crdt_tpu.pure.map import MapRm, Up
+from crdt_tpu.utils import Interner
+
+from test_map import drop, mv_map, put
+
+N_SITES = 6
+KEYS = list("pqrs")
+ACTORS = [f"s{i}" for i in range(N_SITES)]  # one actor per site: no forks
+VALS = list(range(40))
+
+
+def _interners():
+    return dict(
+        keys=Interner(KEYS),
+        actors=Interner(ACTORS),
+        values=Interner(VALS),
+    )
+
+
+def _site_run(rng, n_sites=N_SITES, n_cmds=14):
+    """Sites mint put/drop ops with random per-origin PREFIX delivery
+    (causal discipline as in test_delta._rand_states); returns the final
+    site states and each site's applied-op log."""
+    sites = [mv_map() for _ in range(n_sites)]
+    applied = [[] for _ in range(n_sites)]
+    got = [[0] * n_sites for _ in range(n_sites)]
+    seq = [0] * n_sites
+    for _ in range(n_cmds):
+        i = rng.randrange(n_sites)
+        key = rng.choice(KEYS)
+        if rng.random() < 0.7:
+            op = put(sites[i], ACTORS[i], key, rng.choice(VALS))
+        else:
+            op = drop(sites[i], key)
+        applied[i].append(op)
+        for j in range(n_sites):
+            if j != i and got[j][i] == seq[i] and rng.random() < 0.5:
+                sites[j].apply(op)
+                applied[j].append(op)
+                got[j][i] += 1
+        seq[i] += 1
+    return sites, applied
+
+
+def _tracking(batched, applied):
+    """(dirty, fctx) from op logs: a put contributes its witness dot at
+    its key; a keyset-remove its (key-scoped) clock at every key it
+    names."""
+    r = batched.n_replicas
+    k, a = batched.state.dkeys.shape[-1], batched.state.top.shape[-1]
+    dirty = np.zeros((r, k), bool)
+    fctx = np.zeros((r, k, a), np.uint32)
+    for i, ops_i in enumerate(applied):
+        for op in ops_i:
+            if isinstance(op, Up):
+                # The witness dot only — the put's CLOCK is its minter's
+                # whole-map top (cross-key knowledge) and must not enter
+                # a per-key context (see delta_map._key_knowledge).
+                kid = batched.keys.id_of(op.key)
+                aid = batched.actors.id_of(op.dot.actor)
+                dirty[i, kid] = True
+                fctx[i, kid, aid] = max(fctx[i, kid, aid], op.dot.counter)
+            elif isinstance(op, MapRm):
+                for key in op.keyset:
+                    kid = batched.keys.id_of(key)
+                    dirty[i, kid] = True
+                    for actor, c in op.clock.dots.items():
+                        ai = batched.actors.id_of(actor)
+                        fctx[i, kid, ai] = max(fctx[i, kid, ai], c)
+    return jnp.asarray(dirty), jnp.asarray(fctx)
+
+
+def _rows_equal(gossiped, folded):
+    for leaf_g, leaf_f in zip(jax.tree.leaves(gossiped), jax.tree.leaves(folded)):
+        g, f = np.asarray(leaf_g), np.asarray(leaf_f)
+        for row in range(g.shape[0]):
+            np.testing.assert_array_equal(g[row], f)
+
+
+@pytest.mark.parametrize("mesh_shape", [(4, 2), (2, 4), (8, 1)])
+@pytest.mark.parametrize("seed", [2, 13, 29])
+def test_map_delta_gossip_matches_fold(mesh_shape, seed):
+    rng = random.Random(seed)
+    sites, applied = _site_run(rng)
+    batched = BatchedMap.from_pure(sites, **_interners())
+    mesh = make_mesh(*mesh_shape)
+    sharded = shard_map_state(batched.state, mesh)
+
+    folded, of_f = mesh_fold_map(sharded, mesh)
+    assert not bool(of_f.any())
+
+    dirty, fctx = _tracking(batched, applied)
+    p = mesh_shape[0]
+    gossiped, _, of = mesh_delta_gossip_map(
+        sharded, dirty, fctx, mesh, rounds=2 * p, cap=16
+    )
+    assert not bool(of.any())
+    _rows_equal(gossiped, folded)
+
+
+def test_map_delta_drains_past_cap():
+    rng = random.Random(7)
+    sites, applied = _site_run(rng, n_cmds=18)
+    batched = BatchedMap.from_pure(sites, **_interners())
+    mesh = make_mesh(4, 2)
+    sharded = shard_map_state(batched.state, mesh)
+    folded, _ = mesh_fold_map(sharded, mesh)
+
+    dirty, fctx = _tracking(batched, applied)
+    k_local = sharded.dkeys.shape[-1] // 2
+    rounds = 4 * 4 * (k_local + 2)
+    gossiped, _, of = mesh_delta_gossip_map(
+        sharded, dirty, fctx, mesh, rounds=rounds, cap=1
+    )
+    assert not bool(of.any())
+    _rows_equal(gossiped, folded)
+
+
+def test_interval_accumulate_map_tracking_converges():
+    """Tracking built with interval_accumulate_map (per-op endpoint
+    diffs) must drive δ-gossip to the full fold like the op-log
+    builder."""
+    from crdt_tpu.parallel import interval_accumulate_map
+
+    rng = random.Random(19)
+    sites, applied = _site_run(rng)
+    batched = BatchedMap.from_pure(sites, **_interners())
+
+    k = batched.state.dkeys.shape[-1]
+    a = batched.state.top.shape[-1]
+    s = batched.state.child.wact.shape[-1]
+    dirty = jnp.zeros((N_SITES, k), bool)
+    fctx = jnp.zeros((N_SITES, k, a), jnp.uint32)
+    replay = BatchedMap(
+        N_SITES, k, a, s, batched.state.dcl.shape[-2],
+        keys=batched.keys, actors=batched.actors, values=batched.values,
+    )
+    for i, ops_i in enumerate(applied):
+        for op in ops_i:
+            old = jax.tree.map(lambda x: x[i], replay.state)
+            replay.apply(i, op)
+            new = jax.tree.map(lambda x: x[i], replay.state)
+            d_i, f_i = interval_accumulate_map(dirty[i], fctx[i], old, new)
+            dirty, fctx = dirty.at[i].set(d_i), fctx.at[i].set(f_i)
+
+    mesh = make_mesh(4, 2)
+    sharded = shard_map_state(replay.state, mesh)
+    folded, _ = mesh_fold_map(sharded, mesh)
+    gossiped, _, of = mesh_delta_gossip_map(
+        sharded, dirty, fctx, mesh, rounds=10, cap=16
+    )
+    assert not bool(of.any())
+    _rows_equal(gossiped, folded)
