@@ -170,3 +170,79 @@ def test_interval_accumulate_map_tracking_converges():
     )
     assert not bool(of.any())
     _rows_equal(gossiped, folded)
+
+
+def test_packet_parked_remove_rescues_transient_capacity():
+    """A packet whose parked keyset-remove kills the receiver's siblings
+    must not flag slab overflow: the replay runs on the double-width
+    union BEFORE the capacity check, exactly as ops.map.join does —
+    and the result is bit-identical to the full join."""
+    from crdt_tpu.ops import map as map_ops
+    from crdt_tpu.ops.mvreg import MVRegState
+    from crdt_tpu.parallel.delta_map import MapDeltaPacket, apply_delta_map
+
+    K, S, A, D = 2, 2, 4, 2
+
+    def mk(top, slots):
+        st = map_ops.empty(K, A, sibling_cap=S, deferred_cap=D)
+        wact = np.zeros((K, S), np.int32)
+        wctr = np.zeros((K, S), np.uint32)
+        clk = np.zeros((K, S, A), np.uint32)
+        val = np.zeros((K, S), np.int32)
+        valid = np.zeros((K, S), bool)
+        for s_i, (k, a, c, v) in enumerate(slots):
+            wact[k, s_i % S] = a
+            wctr[k, s_i % S] = c
+            clk[k, s_i % S, a] = c
+            val[k, s_i % S] = v
+            valid[k, s_i % S] = True
+        t = np.zeros((A,), np.uint32)
+        for a, c in top.items():
+            t[a] = c
+        return st._replace(
+            top=jnp.asarray(t),
+            child=MVRegState(
+                wact=jnp.asarray(wact), wctr=jnp.asarray(wctr),
+                clk=jnp.asarray(clk), val=jnp.asarray(val),
+                valid=jnp.asarray(valid),
+            ),
+        )
+
+    # Receiver: a full slab (2 siblings) at key 0 by actors 0, 1.
+    recv = mk({0: 1, 1: 1}, [(0, 0, 1, 10), (0, 1, 1, 11)])
+    # Sender: 2 NEW concurrent siblings by actors 2, 3 plus a parked
+    # keyset-remove covering the receiver's dots.
+    sender = mk({2: 1, 3: 1}, [(0, 2, 1, 20), (0, 3, 1, 21)])
+    dcl = np.zeros((D, A), np.uint32)
+    dcl[0, 0] = 1
+    dcl[0, 1] = 1
+    dkeys = np.zeros((D, K), bool)
+    dkeys[0, 0] = True
+    dvalid = np.zeros((D,), bool)
+    dvalid[0] = True
+    sender = sender._replace(
+        dcl=jnp.asarray(dcl), dkeys=jnp.asarray(dkeys), dvalid=jnp.asarray(dvalid)
+    )
+
+    joined, jflags = map_ops.join(recv, sender)
+    assert not bool(np.asarray(jflags).any())
+
+    ctx = np.zeros((2, A), np.uint32)
+    ctx[0, 2] = 1
+    ctx[0, 3] = 1
+    pkt = MapDeltaPacket(
+        idx=jnp.asarray([0, 1], jnp.int32),
+        child=jax.tree.map(lambda x: x[:2], sender.child),
+        ctxs=jnp.asarray(ctx),
+        valid=jnp.asarray([True, False]),
+        dcl=sender.dcl,
+        dkeys=sender.dkeys,
+        dvalid=sender.dvalid,
+    )
+    dirty = jnp.zeros((K,), bool)
+    fctx = jnp.zeros((K, A), jnp.uint32)
+    out, _, _, of = apply_delta_map(recv, pkt, dirty, fctx)
+    assert not bool(np.asarray(of).any()), "spurious overflow"
+    for a, b in zip(jax.tree.leaves(out.child), jax.tree.leaves(joined.child)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(out.top), np.asarray(joined.top))
