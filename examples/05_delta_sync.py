@@ -1,0 +1,98 @@
+"""δ-state synchronization: after a burst of local edits, replicas
+exchange bounded delta packets (dirty rows + per-row causal contexts —
+the delta-CRDT discipline) over the ring instead of whole states, and
+still land bit-identical to the full-state fold.
+
+Run on 8 virtual CPU devices:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/05_delta_sync.py
+(on a real TPU slice, drop the env vars)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _env import pin_platform
+
+pin_platform()
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.models.orswot import BatchedOrswot
+    from crdt_tpu.parallel import (
+        interval_accumulate,
+        make_mesh,
+        mesh_delta_gossip,
+        mesh_fold,
+        shard_orswot,
+    )
+    from crdt_tpu.pure.orswot import Orswot
+
+    n = len(jax.devices())
+    mesh = make_mesh(n // 2, 2) if n % 2 == 0 and n > 1 else make_mesh(n, 1)
+    print(f"mesh: {dict(mesh.shape)} over {n} devices")
+
+    # A large, mostly-quiet member universe: 8 replicas, 4096 members,
+    # but this sync interval only touched a handful of rows per replica.
+    rng = np.random.default_rng(3)
+    members = [f"item-{i}" for i in range(4096)]
+    sites = [Orswot() for _ in range(8)]
+    from crdt_tpu.utils import Interner
+
+    interners = dict(
+        members=Interner(members),
+        actors=Interner([f"site-{i}" for i in range(8)]),
+    )
+    base = BatchedOrswot.from_pure(sites, **interners)
+
+    # Local burst: each site adds ~6 members and removes one, tracked at
+    # op granularity with interval_accumulate.
+    e, a = base.state.ctr.shape[-2], base.state.ctr.shape[-1]
+    dirty = jnp.zeros((8, e), bool)
+    fctx = jnp.zeros((8, e, a), jnp.uint32)
+    model = BatchedOrswot(8, e, a, base.state.dcl.shape[-2], **interners)
+    for i, site in enumerate(sites):
+        for _ in range(6):
+            m = members[int(rng.integers(0, len(members)))]
+            op = site.add(m, site.read().derive_add_ctx(f"site-{i}"))
+            site.apply(op)
+            old = jax.tree.map(lambda x: x[i], model.state)
+            model.apply(i, op)
+            new = jax.tree.map(lambda x: x[i], model.state)
+            d_i, f_i = interval_accumulate(dirty[i], fctx[i], old, new)
+            dirty, fctx = dirty.at[i].set(d_i), fctx.at[i].set(f_i)
+
+    n_dirty = int(dirty.sum())
+    sharded = shard_orswot(model.state, mesh)
+    folded, _ = mesh_fold(sharded, mesh)
+
+    cap = 16
+    gossiped, _, overflow = mesh_delta_gossip(
+        sharded, dirty, fctx, mesh, rounds=2 * mesh.shape["replica"], cap=cap
+    )
+    assert not bool(overflow)
+    for g, f in zip(jax.tree.leaves(gossiped), jax.tree.leaves(folded)):
+        for row in range(np.asarray(g).shape[0]):
+            np.testing.assert_array_equal(np.asarray(g)[row], np.asarray(f))
+
+    full_bytes = model.state.ctr.nbytes // 8  # one replica's row slab
+    pkt_bytes = cap * (a * 4 * 2 + 4 + 1)     # rows + ctxs + idx + valid
+    print(
+        f"{n_dirty} dirty rows of {dirty.size}; delta packet ≈ "
+        f"{pkt_bytes/1024:.1f} KiB per link per round vs "
+        f"{full_bytes/1024:.0f} KiB full row slab "
+        f"({full_bytes/pkt_bytes:.0f}x less traffic)"
+    )
+    print("delta gossip converged bit-identical to the full-state fold")
+
+
+if __name__ == "__main__":
+    main()

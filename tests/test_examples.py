@@ -17,6 +17,7 @@ _EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(__file__)), "examples")
         "02_mesh_anti_entropy.py",
         "03_streamed_editing.py",
         "04_multihost_dcn.py",
+        "05_delta_sync.py",
     ],
 )
 def test_example_runs(script):
