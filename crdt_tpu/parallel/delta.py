@@ -48,8 +48,7 @@ from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..ops.orswot import (
     OrswotState,
@@ -57,7 +56,6 @@ from ..ops.orswot import (
     _compact_deferred,
     _dedupe_deferred,
 )
-from ..utils.metrics import metrics, state_nbytes
 from .mesh import (
     ELEMENT_AXIS,
     REPLICA_AXIS,
@@ -215,82 +213,35 @@ def mesh_delta_gossip(
     Returns ``(states [P, ...], dirty [P, E], overflow)`` — overflow is
     the deferred-buffer flag, as in ``mesh_gossip``."""
     from ..ops.pallas_kernels import fold_auto
+    from .delta_ring import run_delta_ring
 
-    p = mesh.shape[REPLICA_AXIS]
-    if rounds is None:
-        rounds = p - 1
-    state = pad_replicas(state, p)
+    state = pad_replicas(state, mesh.shape[REPLICA_AXIS])
     state = pad_elements(state, mesh.shape[ELEMENT_AXIS])
     pad_r = state.top.shape[0] - dirty.shape[0]
     pad_e = state.ctr.shape[-2] - dirty.shape[-1]
     dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_e)))
     fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_e), (0, 0)))
 
-    perm = [(i, (i + 1) % p) for i in range(p)]
-
-    def build():
-        @partial(
-            jax.shard_map,
-            mesh=mesh,
-            in_specs=(
-                orswot_specs(),
-                P(REPLICA_AXIS, ELEMENT_AXIS),
-                P(REPLICA_AXIS, ELEMENT_AXIS, None),
-            ),
-            out_specs=(orswot_specs(), P(REPLICA_AXIS, ELEMENT_AXIS), P()),
-            check_vma=False,
+    def close_top(folded: OrswotState, top: jax.Array) -> OrswotState:
+        """Adopt the mesh-wide top and re-replay parked removes under it
+        (delta_ring documents why the closure is needed and sound)."""
+        ctr = _apply_parked(folded.ctr, folded.dcl, folded.dmask, folded.dvalid)
+        still = ~jnp.all(folded.dcl <= top[None, :], axis=-1)
+        dvalid = folded.dvalid & still
+        return OrswotState(
+            top=top,
+            ctr=ctr,
+            dcl=jnp.where(dvalid[:, None], folded.dcl, 0),
+            dmask=folded.dmask & dvalid[:, None],
+            dvalid=dvalid,
         )
-        def gossip_fn(local, local_dirty, local_fctx):
-            folded, of = fold_auto(local, prefer=local_fold)
-            d = jnp.any(local_dirty, axis=0)
-            f = jnp.max(local_fctx, axis=0)
 
-            def round_body(r, carry):
-                st, d, f, of = carry
-                pkt, d, f = extract_delta(st, d, f, cap, start=r * cap)
-                pkt = jax.tree.map(
-                    lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt
-                )
-                st, d, f, of_r = apply_delta(st, pkt, d, f)
-                return st, d, f, of | of_r
-
-            folded, d, f, of = lax.fori_loop(
-                0, rounds, round_body, (folded, d, f, of)
-            )
-            # Close the books on the top clock: per-row contexts grow
-            # tops only by row-scoped knowledge, so per-device tops
-            # lag the full-join top (and diverge across element
-            # shards). The union of the LOCAL-FOLD tops over the whole
-            # mesh IS the full-join top, and once content has
-            # converged, adopting it (then re-replaying parked removes
-            # under it) reproduces the full fold exactly.
-            top = lax.pmax(
-                lax.pmax(folded.top, REPLICA_AXIS), ELEMENT_AXIS
-            )
-            ctr = _apply_parked(
-                folded.ctr, folded.dcl, folded.dmask, folded.dvalid
-            )
-            still = ~jnp.all(folded.dcl <= top[None, :], axis=-1)
-            dvalid = folded.dvalid & still
-            folded = OrswotState(
-                top=top,
-                ctr=ctr,
-                dcl=jnp.where(dvalid[:, None], folded.dcl, 0),
-                dmask=folded.dmask & dvalid[:, None],
-                dvalid=dvalid,
-            )
-            of = lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS)) > 0
-            return jax.tree.map(lambda x: x[None], folded), d[None], of
-
-        return gossip_fn
-
-    metrics.count("anti_entropy.delta_rounds", rounds)
-    metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
-    with metrics.time("anti_entropy.delta_gossip"):
-        from .anti_entropy import _cached
-
-        out = _cached("delta_gossip", state, mesh, build, rounds, cap, local_fold)(
-            state, dirty, fctx
-        )
-        jax.block_until_ready(out)
-    return out
+    return run_delta_ring(
+        "delta_gossip", state, dirty, fctx, mesh, rounds, cap,
+        specs=orswot_specs(),
+        local_fold=partial(fold_auto, prefer=local_fold),
+        extract=extract_delta,
+        apply_fn=apply_delta,
+        close_top=close_top,
+        cache_extra=(local_fold,),
+    )

@@ -20,13 +20,12 @@ in delta.py's contract.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..ops import map as map_ops
 from ..ops.map import (
@@ -38,7 +37,6 @@ from ..ops.map import (
 )
 from ..ops.mvreg import MVRegState
 from ..ops.orswot import _compact_deferred, _dedupe_deferred
-from ..utils.metrics import metrics, state_nbytes
 from .mesh import (
     ELEMENT_AXIS,
     REPLICA_AXIS,
@@ -274,70 +272,26 @@ def mesh_delta_gossip_map(
     churn (see delta.mesh_delta_gossip for semantics, rounds/cap
     budgeting, and the top-closure step). Returns
     ``(states [P, ...], dirty [P, K], overflow[2])``."""
-    p = mesh.shape[REPLICA_AXIS]
-    if rounds is None:
-        rounds = p - 1
-    state = pad_replicas_map(state, p)
+    from .delta_ring import run_delta_ring
+
+    state = pad_replicas_map(state, mesh.shape[REPLICA_AXIS])
     state = pad_keys(state, mesh.shape[ELEMENT_AXIS])
     pad_r = state.top.shape[0] - dirty.shape[0]
     pad_k = state.dkeys.shape[-1] - dirty.shape[-1]
     dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_k)))
     fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_k), (0, 0)))
 
-    perm = [(i, (i + 1) % p) for i in range(p)]
+    def close_top(folded: MapState, top: jax.Array) -> MapState:
+        """Adopt the mesh-wide top and re-replay parked keyset-removes
+        under it (delta_ring documents why)."""
+        folded = _drop_stale_deferred(_apply_parked(folded._replace(top=top)))
+        return folded._replace(child=_canon_child(folded.child))
 
-    def build():
-        @partial(
-            jax.shard_map,
-            mesh=mesh,
-            in_specs=(
-                map_specs(),
-                P(REPLICA_AXIS, ELEMENT_AXIS),
-                P(REPLICA_AXIS, ELEMENT_AXIS, None),
-            ),
-            out_specs=(map_specs(), P(REPLICA_AXIS, ELEMENT_AXIS), P()),
-            check_vma=False,
-        )
-        def gossip_fn(local, local_dirty, local_fctx):
-            folded, of = map_ops.fold(local)
-            d = jnp.any(local_dirty, axis=0)
-            f = jnp.max(local_fctx, axis=0)
-
-            def round_body(r, carry):
-                st, d, f, of = carry
-                pkt, d, f = extract_delta_map(st, d, f, cap, start=r * cap)
-                pkt = jax.tree.map(
-                    lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt
-                )
-                st, d, f, of_r = apply_delta_map(st, pkt, d, f)
-                return st, d, f, of | of_r
-
-            folded, d, f, of = lax.fori_loop(
-                0, rounds, round_body, (folded, d, f, of)
-            )
-            # Top closure (see delta.py): per-key contexts under-fill
-            # the top; the union of local-fold tops is the full top.
-            # Re-replay parked keyset-removes under it.
-            top = lax.pmax(lax.pmax(folded.top, REPLICA_AXIS), ELEMENT_AXIS)
-            folded = _drop_stale_deferred(
-                _apply_parked(folded._replace(top=top))
-            )
-            folded = folded._replace(child=_canon_child(folded.child))
-            of = (
-                lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS))
-                > 0
-            )
-            return jax.tree.map(lambda x: x[None], folded), d[None], of
-
-        return gossip_fn
-
-    metrics.count("anti_entropy.map_delta_rounds", rounds)
-    metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
-    with metrics.time("anti_entropy.map_delta_gossip"):
-        from .anti_entropy import _cached
-
-        out = _cached(
-            "map_delta_gossip", state, mesh, build, rounds, cap
-        )(state, dirty, fctx)
-        jax.block_until_ready(out)
-    return out
+    return run_delta_ring(
+        "map_delta_gossip", state, dirty, fctx, mesh, rounds, cap,
+        specs=map_specs(),
+        local_fold=map_ops.fold,
+        extract=extract_delta_map,
+        apply_fn=apply_delta_map,
+        close_top=close_top,
+    )

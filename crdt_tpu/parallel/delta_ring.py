@@ -1,0 +1,105 @@
+"""The shared ring scaffold for δ-state anti-entropy.
+
+Both delta flavors (orswot rows — delta.py; map keys — delta_map.py)
+run the identical mesh program: pad and shard (state, dirty, fctx),
+locally fold the replica block (OR-folding dirty, max-folding
+contexts), then ``rounds`` ppermute ring rounds of extract → shift →
+apply, and finally the top-closure collective (the per-row contexts
+grow tops only by row-scoped knowledge, so per-device tops lag the
+full-join top and diverge across element shards; the union of the
+LOCAL-FOLD tops over the whole mesh IS the full-join top, and once
+content has converged, adopting it and re-replaying parked removes
+reproduces the full fold exactly).
+
+Only the type-specific pieces come in as closures: the local fold, the
+extract/apply pair, the state specs, and the post-closure replay."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..utils.metrics import metrics, state_nbytes
+from .mesh import ELEMENT_AXIS, REPLICA_AXIS
+
+
+def run_delta_ring(
+    kind: str,
+    state,
+    dirty: jax.Array,
+    fctx: jax.Array,
+    mesh: Mesh,
+    rounds: Optional[int],
+    cap: int,
+    specs,                    # PartitionSpec pytree for the state
+    local_fold: Callable,     # local -> (folded, overflow)
+    extract: Callable,        # (state, dirty, fctx, cap, start) -> (pkt, dirty, fctx)
+    apply_fn: Callable,       # (state, pkt, dirty, fctx) -> (state, dirty, fctx, of)
+    close_top: Callable,      # (state, full_top) -> state  (re-replay parked)
+    top_of: Callable = lambda s: s.top,
+    cache_extra: tuple = (),
+):
+    """Run the δ ring program; ``state``/``dirty``/``fctx`` must already
+    be padded to the mesh. Returns ``(states [P, ...], dirty, overflow)``
+    with the same conventions as mesh_gossip."""
+    p = mesh.shape[REPLICA_AXIS]
+    if rounds is None:
+        rounds = p - 1
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def build():
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(
+                specs,
+                P(REPLICA_AXIS, ELEMENT_AXIS),
+                P(REPLICA_AXIS, ELEMENT_AXIS, None),
+            ),
+            out_specs=(specs, P(REPLICA_AXIS, ELEMENT_AXIS), P()),
+            check_vma=False,
+        )
+        def gossip_fn(local, local_dirty, local_fctx):
+            folded, of = local_fold(local)
+            d = jnp.any(local_dirty, axis=0)
+            f = jnp.max(local_fctx, axis=0)
+
+            def round_body(r, carry):
+                st, d, f, of = carry
+                pkt, d, f = extract(st, d, f, cap, start=r * cap)
+                pkt = jax.tree.map(
+                    lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt
+                )
+                st, d, f, of_r = apply_fn(st, pkt, d, f)
+                return st, d, f, of | of_r
+
+            folded, d, f, of = lax.fori_loop(
+                0, rounds, round_body, (folded, d, f, of)
+            )
+            top = lax.pmax(
+                lax.pmax(top_of(folded), REPLICA_AXIS), ELEMENT_AXIS
+            )
+            folded = close_top(folded, top)
+            of = (
+                lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS))
+                > 0
+            )
+            return jax.tree.map(lambda x: x[None], folded), d[None], of
+
+        return gossip_fn
+
+    metrics.count(f"anti_entropy.{kind}_rounds", rounds)
+    metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
+    with metrics.time(f"anti_entropy.{kind}"):
+        from .anti_entropy import _cached
+
+        out = _cached(kind, state, mesh, build, rounds, cap, *cache_extra)(
+            state, dirty, fctx
+        )
+        jax.block_until_ready(out)
+    return out
