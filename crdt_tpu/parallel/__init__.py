@@ -81,6 +81,13 @@ from .delta_map import (
     interval_accumulate_map,
     mesh_delta_gossip_map,
 )
+from .delta_map_orswot import (
+    MapOrswotDeltaPacket,
+    apply_delta_mo,
+    extract_delta_mo,
+    interval_accumulate_mo,
+    mesh_delta_gossip_map_orswot,
+)
 from . import multihost
 
 __all__ = [
@@ -94,6 +101,11 @@ __all__ = [
     "extract_delta_map",
     "interval_accumulate_map",
     "mesh_delta_gossip_map",
+    "MapOrswotDeltaPacket",
+    "apply_delta_mo",
+    "extract_delta_mo",
+    "interval_accumulate_mo",
+    "mesh_delta_gossip_map_orswot",
     "extract_delta",
     "mesh_delta_gossip",
     "map3_specs",

@@ -188,6 +188,22 @@ def apply_delta(
     return out, dirty, fctx, jnp.any(overflow)
 
 
+def close_top_orswot(folded: OrswotState, top: jax.Array) -> OrswotState:
+    """Adopt the mesh-wide top and re-replay parked removes under it
+    (delta_ring documents why the closure is needed and sound). Shared
+    by the plain-orswot and map_orswot delta flavors."""
+    ctr = _apply_parked(folded.ctr, folded.dcl, folded.dmask, folded.dvalid)
+    still = ~jnp.all(folded.dcl <= top[None, :], axis=-1)
+    dvalid = folded.dvalid & still
+    return OrswotState(
+        top=top,
+        ctr=ctr,
+        dcl=jnp.where(dvalid[:, None], folded.dcl, 0),
+        dmask=folded.dmask & dvalid[:, None],
+        dvalid=dvalid,
+    )
+
+
 def mesh_delta_gossip(
     state: OrswotState,
     dirty: jax.Array,
@@ -222,26 +238,12 @@ def mesh_delta_gossip(
     dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_e)))
     fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_e), (0, 0)))
 
-    def close_top(folded: OrswotState, top: jax.Array) -> OrswotState:
-        """Adopt the mesh-wide top and re-replay parked removes under it
-        (delta_ring documents why the closure is needed and sound)."""
-        ctr = _apply_parked(folded.ctr, folded.dcl, folded.dmask, folded.dvalid)
-        still = ~jnp.all(folded.dcl <= top[None, :], axis=-1)
-        dvalid = folded.dvalid & still
-        return OrswotState(
-            top=top,
-            ctr=ctr,
-            dcl=jnp.where(dvalid[:, None], folded.dcl, 0),
-            dmask=folded.dmask & dvalid[:, None],
-            dvalid=dvalid,
-        )
-
     return run_delta_ring(
         "delta_gossip", state, dirty, fctx, mesh, rounds, cap,
         specs=orswot_specs(),
         local_fold=partial(fold_auto, prefer=local_fold),
         extract=extract_delta,
         apply_fn=apply_delta,
-        close_top=close_top,
+        close_top=close_top_orswot,
         cache_extra=(local_fold,),
     )

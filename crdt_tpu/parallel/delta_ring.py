@@ -41,6 +41,7 @@ def run_delta_ring(
     extract: Callable,        # (state, dirty, fctx, cap, start) -> (pkt, dirty, fctx)
     apply_fn: Callable,       # (state, pkt, dirty, fctx) -> (state, dirty, fctx, of)
     close_top: Callable,      # (state, full_top) -> state  (re-replay parked)
+    top_of: Callable = lambda s: s.top,  # composed states nest their top
     cache_extra: tuple = (),
 ):
     """Run the δ ring program; ``state``/``dirty``/``fctx`` must already
@@ -81,7 +82,7 @@ def run_delta_ring(
                 0, rounds, round_body, (folded, d, f, of)
             )
             top = lax.pmax(
-                lax.pmax(folded.top, REPLICA_AXIS), ELEMENT_AXIS
+                lax.pmax(top_of(folded), REPLICA_AXIS), ELEMENT_AXIS
             )
             folded = close_top(folded, top)
             of = (

@@ -1,0 +1,157 @@
+"""δ-state anti-entropy for Map<K, Orswot> (parallel/delta_map_orswot):
+bounded (key, member)-cell delta packets on the ring must reach the
+same converged state as the full mesh fold."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from crdt_tpu.models import BatchedMapOrswot
+from crdt_tpu.parallel import (
+    make_mesh,
+    mesh_delta_gossip_map_orswot,
+    mesh_fold_map_orswot,
+    shard_map_orswot,
+)
+from crdt_tpu.pure.map import MapRm, Up
+from crdt_tpu.pure.orswot import Add as OrswotAdd
+from crdt_tpu.utils import Interner
+
+from test_map import set_map
+from test_models_map_nested import KEYS, MEMBERS, sadd, srm
+
+N_SITES = 6
+ACTORS = [f"s{i}" for i in range(N_SITES)]
+
+
+def _interners():
+    return dict(
+        keys=Interner(KEYS),
+        members=Interner(MEMBERS),
+        actors=Interner(ACTORS),
+    )
+
+
+def _site_run(rng, n_sites=N_SITES, n_cmds=16):
+    """Sites mint inner add/rm and outer drop ops with per-origin PREFIX
+    delivery; returns final states and per-site applied-op logs."""
+    from test_map import drop
+
+    sites = [set_map() for _ in range(n_sites)]
+    applied = [[] for _ in range(n_sites)]
+    got = [[0] * n_sites for _ in range(n_sites)]
+    seq = [0] * n_sites
+    for _ in range(n_cmds):
+        i = rng.randrange(n_sites)
+        key = rng.choice(KEYS)
+        member = rng.choice(MEMBERS)
+        roll = rng.random()
+        if roll < 0.5:
+            op = sadd(sites[i], ACTORS[i], key, member)
+        elif roll < 0.75:
+            op = srm(sites[i], ACTORS[i], key, member)
+        else:
+            op = drop(sites[i], key)
+        applied[i].append(op)
+        for j in range(n_sites):
+            if j != i and got[j][i] == seq[i] and rng.random() < 0.5:
+                sites[j].apply(op)
+                applied[j].append(op)
+                got[j][i] += 1
+        seq[i] += 1
+    return sites, applied
+
+
+def _tracking(batched, applied):
+    """(dirty, fctx) over the K×M cell space from op logs: inner adds
+    mark their (key, member) cell with the dot; inner rms their cells
+    with the rm clock; outer keyset-removes the key's whole block."""
+    r = batched.n_replicas
+    nk, nm = batched.n_keys, batched.n_members
+    a = batched.state.core.top.shape[-1]
+    dirty = np.zeros((r, nk * nm), bool)
+    fctx = np.zeros((r, nk * nm, a), np.uint32)
+
+    def clock_into(row_slice, dots):
+        for actor, c in dots.items():
+            ai = batched.actors.id_of(actor)
+            fctx[row_slice + (ai,)] = np.maximum(fctx[row_slice + (ai,)], c)
+
+    for i, ops_i in enumerate(applied):
+        for op in ops_i:
+            if isinstance(op, Up):
+                kid = batched.keys.id_of(op.key)
+                if isinstance(op.op, OrswotAdd):
+                    aid = batched.actors.id_of(op.dot.actor)
+                    for m in op.op.members:
+                        cell = kid * nm + batched.members.id_of(m)
+                        dirty[i, cell] = True
+                        fctx[i, cell, aid] = max(
+                            fctx[i, cell, aid], op.dot.counter
+                        )
+                else:  # inner orswot rm (dotted Up)
+                    aid = batched.actors.id_of(op.dot.actor)
+                    for m in op.op.members:
+                        cell = kid * nm + batched.members.id_of(m)
+                        dirty[i, cell] = True
+                        fctx[i, cell, aid] = max(
+                            fctx[i, cell, aid], op.dot.counter
+                        )
+                        clock_into((i, cell), op.op.clock.dots)
+            elif isinstance(op, MapRm):
+                for key in op.keyset:
+                    kid = batched.keys.id_of(key)
+                    for cell in range(kid * nm, (kid + 1) * nm):
+                        dirty[i, cell] = True
+                        clock_into((i, cell), op.clock.dots)
+    return jnp.asarray(dirty), jnp.asarray(fctx)
+
+
+def _rows_equal(gossiped, folded):
+    for leaf_g, leaf_f in zip(jax.tree.leaves(gossiped), jax.tree.leaves(folded)):
+        g, f = np.asarray(leaf_g), np.asarray(leaf_f)
+        for row in range(g.shape[0]):
+            np.testing.assert_array_equal(g[row], f)
+
+
+@pytest.mark.parametrize("mesh_shape", [(4, 2), (2, 4), (8, 1)])
+@pytest.mark.parametrize("seed", [4, 21])
+def test_mo_delta_gossip_matches_fold(mesh_shape, seed):
+    rng = random.Random(seed)
+    sites, applied = _site_run(rng)
+    batched = BatchedMapOrswot.from_pure(sites, **_interners())
+    mesh = make_mesh(*mesh_shape)
+    sharded = shard_map_orswot(batched.state, mesh)
+
+    folded, of_f = mesh_fold_map_orswot(sharded, mesh)
+    assert not bool(of_f.any())
+
+    dirty, fctx = _tracking(batched, applied)
+    p = mesh_shape[0]
+    gossiped, _, of = mesh_delta_gossip_map_orswot(
+        sharded, dirty, fctx, mesh, rounds=2 * p, cap=24
+    )
+    assert not bool(of.any())
+    _rows_equal(gossiped, folded)
+
+
+def test_mo_delta_drains_past_cap():
+    rng = random.Random(31)
+    sites, applied = _site_run(rng, n_cmds=20)
+    batched = BatchedMapOrswot.from_pure(sites, **_interners())
+    mesh = make_mesh(4, 2)
+    sharded = shard_map_orswot(batched.state, mesh)
+    folded, _ = mesh_fold_map_orswot(sharded, mesh)
+
+    dirty, fctx = _tracking(batched, applied)
+    e_local = sharded.core.ctr.shape[-2] // 2
+    rounds = 4 * 4 * (e_local + 2)
+    gossiped, _, of = mesh_delta_gossip_map_orswot(
+        sharded, dirty, fctx, mesh, rounds=rounds, cap=1
+    )
+    assert not bool(of.any())
+    _rows_equal(gossiped, folded)
