@@ -88,6 +88,13 @@ from .delta_map_orswot import (
     interval_accumulate_mo,
     mesh_delta_gossip_map_orswot,
 )
+from .delta_map3 import (
+    Map3DeltaPacket,
+    apply_delta_m3,
+    extract_delta_m3,
+    interval_accumulate_m3,
+    mesh_delta_gossip_map3,
+)
 from . import multihost
 
 __all__ = [
@@ -106,6 +113,11 @@ __all__ = [
     "extract_delta_mo",
     "interval_accumulate_mo",
     "mesh_delta_gossip_map_orswot",
+    "Map3DeltaPacket",
+    "apply_delta_m3",
+    "extract_delta_m3",
+    "interval_accumulate_m3",
+    "mesh_delta_gossip_map3",
     "extract_delta",
     "mesh_delta_gossip",
     "map3_specs",

@@ -22,9 +22,14 @@ by its store. Shipping the full top with a partial row set lets the
 receiver's context outrun its rows; when the receiver later forwards a
 row under that inflated context, downstream peers read the missing dots
 as removals and wrongly kill live entries (a real failure mode — pinned
-by tests/test_delta.py). With row-scoped contexts the receiver's top
-grows only by knowledge its rows now reflect, so the ORSWOT invariant
-(rows reflect top) survives partial exchange.
+by tests/test_delta.py). Worse, clock coverage is a per-actor PREFIX:
+even a row-scoped context covering (a, c) implicitly covers (a, c') for
+c' < c — dots of OTHER rows — so contexts may never be folded into the
+receiver's top at all (pinned by the capped depth-3 drain test). The
+top therefore stays FROZEN at the local-fold value through the ring
+(rows always reflect it); packet-learned knowledge lives in the
+per-row fctx, and the ring's final top-closure collective restores the
+exact full-join top from the untouched local tops.
 
 The receiver scatter-joins packet rows under (receiver top, packet row
 context) — the full ``ops.orswot.join`` survival rule restricted to the
@@ -124,7 +129,12 @@ def extract_delta(
         dmask=state.dmask,
         dvalid=state.dvalid,
     )
-    fctx = fctx.at[idx].set(jnp.where(valid[:, None], 0, jnp.take(fctx, idx, axis=0)))
+    # fctx is NEVER cleared: it is a monotone knowledge cache, not a
+    # send queue. Clearing it on ship would let a later stale packet
+    # (carrying a dot live under a non-covering context) resurrect a
+    # removal this replica had already learned — with the top frozen
+    # mid-ring, fctx is the only receiver-side record of packet-learned
+    # removals, and monotone knowledge makes convergence monotone.
     return pkt, dirty.at[idx].set(False), fctx
 
 
@@ -138,8 +148,19 @@ def apply_delta(
     packet's row-scoped knowledge. Returns
     ``(state, dirty, fctx, overflow)``."""
     recv = jnp.take(state.ctr, pkt.idx, axis=0)  # [C, A]
+    # Receiver-side knowledge stays PER-CELL: its honest top (the local
+    # fold's — rows reflect it) joined with what packets taught it about
+    # THIS cell (fctx). The top itself must NOT grow mid-ring: clock
+    # coverage is a per-actor prefix, so a cell-scoped context covering
+    # (a, c) implicitly covers (a, c') for c' < c — dots of OTHER cells.
+    # Folding such a context into the global top makes the receiver
+    # claim observed-and-removed for rows it never saw, and genuine rows
+    # arriving later get dropped (found the hard way at depth 3 — the
+    # capped map3 drain test pins it). The ring's final top closure
+    # restores the exact full-join top from the untouched local tops.
+    rctx = jnp.maximum(state.top[None, :], jnp.take(fctx, pkt.idx, axis=0))
     wa = jnp.where(recv > pkt.ctxs, recv, 0)
-    wb = jnp.where(pkt.rows > state.top[None, :], pkt.rows, 0)
+    wb = jnp.where(pkt.rows > rctx, pkt.rows, 0)
     pa = jnp.any(recv > 0, axis=-1)
     pb = jnp.any(pkt.rows > 0, axis=-1)
     common = jnp.maximum(jnp.minimum(recv, pkt.rows), jnp.maximum(wa, wb))
@@ -150,13 +171,7 @@ def apply_delta(
     ).astype(recv.dtype)
     new = jnp.where(pkt.valid[:, None], new, recv)
     ctr = state.ctr.at[pkt.idx].set(new)
-    # Row-scoped knowledge only: each applied context covers dots of its
-    # own element, and that row now reflects it — the invariant "rows
-    # reflect top" survives, unlike joining the sender's whole top.
-    applied_ctx = jnp.max(
-        jnp.where(pkt.valid[:, None], pkt.ctxs, 0), axis=0
-    )
-    top = jnp.maximum(state.top, applied_ctx)
+    top = state.top
 
     # Deferred union — identical tail to ops.orswot.join (rm clocks are
     # their own contexts, so parked removes ship whole and stay sound).

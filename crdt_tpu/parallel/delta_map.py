@@ -126,7 +126,8 @@ def extract_delta_map(
         dkeys=state.dkeys,
         dvalid=state.dvalid,
     )
-    fctx = fctx.at[idx].set(jnp.where(valid[:, None], 0, jnp.take(fctx, idx, axis=0)))
+    # fctx is never cleared — monotone knowledge cache (see
+    # delta.extract_delta).
     return pkt, dirty.at[idx].set(False), fctx
 
 
@@ -164,14 +165,16 @@ def apply_delta_map(
     in for the sender's top. Returns ``(state, dirty, fctx,
     overflow[2])`` — [sibling-slab, deferred] as in ops.map.join."""
     recv = jax.tree.map(lambda x: jnp.take(x, pkt.idx, axis=0), state.child)
-    c = pkt.idx.shape[0]
-    rtop = jnp.broadcast_to(state.top[None, :], (c, state.top.shape[-1]))
+    # Per-key receiver knowledge: honest top ∨ what packets taught about
+    # THIS key. The global top must not grow mid-ring (see
+    # delta.apply_delta — prefix coverage would leak cross-key claims).
+    rctx = jnp.maximum(state.top[None, :], jnp.take(fctx, pkt.idx, axis=0))
 
     keep_r = recv.valid & (
         _dot_in(recv, pkt.child) | ~_cov(pkt.ctxs, recv.wact, recv.wctr)
     )
     keep_p = pkt.child.valid & (
-        _dot_in(pkt.child, recv) | ~_cov(rtop, pkt.child.wact, pkt.child.wctr)
+        _dot_in(pkt.child, recv) | ~_cov(rctx, pkt.child.wact, pkt.child.wctr)
     )
     union = MVRegState(
         wact=jnp.concatenate([recv.wact, pkt.child.wact], axis=-1),
@@ -222,8 +225,7 @@ def apply_delta_map(
         merged,
         recv,
     )
-    applied_ctx = jnp.max(jnp.where(pkt.valid[:, None], pkt.ctxs, 0), axis=0)
-    top = jnp.maximum(state.top, applied_ctx)
+    top = state.top  # never grows mid-ring; the closure restores it
 
     st = MapState(top=top, child=child, dcl=dcl, dkeys=dkeys, dvalid=dvalid)
     before = st.child

@@ -4,12 +4,13 @@ Both delta flavors (orswot rows — delta.py; map keys — delta_map.py)
 run the identical mesh program: pad and shard (state, dirty, fctx),
 locally fold the replica block (OR-folding dirty, max-folding
 contexts), then ``rounds`` ppermute ring rounds of extract → shift →
-apply, and finally the top-closure collective (the per-row contexts
-grow tops only by row-scoped knowledge, so per-device tops lag the
-full-join top and diverge across element shards; the union of the
-LOCAL-FOLD tops over the whole mesh IS the full-join top, and once
-content has converged, adopting it and re-replaying parked removes
-reproduces the full fold exactly).
+apply, and finally the top-closure collective (tops stay FROZEN at
+their local-fold values through the ring — see delta.py for why
+contexts must never fold into them — so they lag the full-join top and
+diverge across element shards; the union of the LOCAL-FOLD tops over
+the whole mesh IS the full-join top, and once content has converged,
+adopting it and re-replaying parked removes reproduces the full fold
+exactly).
 
 Only the type-specific pieces come in as closures: the local fold, the
 extract/apply pair, the state specs, and the post-closure replay."""

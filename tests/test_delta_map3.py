@@ -1,0 +1,158 @@
+"""δ-state anti-entropy for the depth-3 map (parallel/delta_map3):
+bounded leaf-cell delta packets on the ring must reach the same
+converged state as the full mesh fold."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from crdt_tpu.models import BatchedMap3
+from crdt_tpu.parallel import (
+    make_mesh,
+    mesh_delta_gossip_map3,
+    mesh_fold_map3,
+    shard_map3,
+)
+from crdt_tpu.pure.map import MapRm, Up
+from crdt_tpu.pure.orswot import Add as OrswotAdd
+from crdt_tpu.utils import Interner
+
+from test_models_map3 import KEYS1, KEYS2, MEMBERS, d3add, d3drop1, d3drop2, d3rm, map3
+
+N_SITES = 6
+ACTORS = [f"s{i}" for i in range(N_SITES)]
+
+
+def _interners():
+    return dict(
+        keys1=Interner(KEYS1),
+        keys2=Interner(KEYS2),
+        members=Interner(MEMBERS),
+        actors=Interner(ACTORS),
+    )
+
+
+def _site_run(rng, n_sites=N_SITES, n_cmds=18):
+    """Op-only histories (no state merges) with per-origin PREFIX
+    delivery, so op-log delta tracking is sound."""
+    sites = [map3() for _ in range(n_sites)]
+    applied = [[] for _ in range(n_sites)]
+    got = [[0] * n_sites for _ in range(n_sites)]
+    seq = [0] * n_sites
+    for _ in range(n_cmds):
+        i = rng.randrange(n_sites)
+        k1, k2 = rng.choice(KEYS1), rng.choice(KEYS2)
+        member = rng.choice(MEMBERS)
+        roll = rng.random()
+        if roll < 0.45:
+            op = d3add(sites[i], ACTORS[i], k1, k2, member)
+        elif roll < 0.65:
+            op = d3rm(sites[i], ACTORS[i], k1, k2, member)
+        elif roll < 0.85:
+            op = d3drop2(sites[i], ACTORS[i], k1, k2)
+        else:
+            op = d3drop1(sites[i], k1)
+        applied[i].append(op)
+        for j in range(n_sites):
+            if j != i and got[j][i] == seq[i] and rng.random() < 0.5:
+                sites[j].apply(op)
+                applied[j].append(op)
+                got[j][i] += 1
+        seq[i] += 1
+    return sites, applied
+
+
+def _tracking(batched, applied):
+    """(dirty, fctx) over the K1×K2×M leaf-cell space from op logs."""
+    r = batched.n_replicas
+    nk1, nk2, nm = batched.n_keys1, batched.n_keys2, batched.n_members
+    a = batched.state.mo.core.top.shape[-1]
+    cells = nk1 * nk2 * nm
+    dirty = np.zeros((r, cells), bool)
+    fctx = np.zeros((r, cells, a), np.uint32)
+
+    def clock_into(i, cell, dots):
+        for actor, c in dots.items():
+            ai = batched.actors.id_of(actor)
+            fctx[i, cell, ai] = max(fctx[i, cell, ai], c)
+
+    for i, ops_i in enumerate(applied):
+        for op in ops_i:
+            if isinstance(op, Up):
+                k1 = batched.keys1.id_of(op.key)
+                mid = op.op
+                if isinstance(mid, Up):
+                    k2 = batched.keys2.id_of(mid.key)
+                    base = (k1 * nk2 + k2) * nm
+                    leaf = mid.op
+                    aid = batched.actors.id_of(op.dot.actor)
+                    for m in leaf.members:
+                        cell = base + batched.members.id_of(m)
+                        dirty[i, cell] = True
+                        fctx[i, cell, aid] = max(
+                            fctx[i, cell, aid], op.dot.counter
+                        )
+                        if not isinstance(leaf, OrswotAdd):
+                            clock_into(i, cell, leaf.clock.dots)
+                else:  # K2-level keyset rm routed via Up
+                    for key2 in mid.keyset:
+                        k2 = batched.keys2.id_of(key2)
+                        base = (k1 * nk2 + k2) * nm
+                        for cell in range(base, base + nm):
+                            dirty[i, cell] = True
+                            clock_into(i, cell, mid.clock.dots)
+            elif isinstance(op, MapRm):
+                for key1 in op.keyset:
+                    k1 = batched.keys1.id_of(key1)
+                    base = k1 * nk2 * nm
+                    for cell in range(base, base + nk2 * nm):
+                        dirty[i, cell] = True
+                        clock_into(i, cell, op.clock.dots)
+    return jnp.asarray(dirty), jnp.asarray(fctx)
+
+
+from test_delta import _rows_equal  # noqa: E402  (shared comparator)
+
+
+
+@pytest.mark.parametrize("mesh_shape", [(4, 2), (2, 4)])
+@pytest.mark.parametrize("seed", [6, 23])
+def test_map3_delta_gossip_matches_fold(mesh_shape, seed):
+    rng = random.Random(seed)
+    sites, applied = _site_run(rng)
+    batched = BatchedMap3.from_pure(sites, deferred_cap=12, **_interners())
+    mesh = make_mesh(*mesh_shape)
+    sharded = shard_map3(batched.state, mesh)
+
+    folded, of_f = mesh_fold_map3(sharded, mesh)
+    assert not bool(of_f.any())
+
+    dirty, fctx = _tracking(batched, applied)
+    p = mesh_shape[0]
+    gossiped, _, of = mesh_delta_gossip_map3(
+        sharded, dirty, fctx, mesh, rounds=2 * p, cap=32
+    )
+    assert not bool(of.any())
+    _rows_equal(gossiped, folded)
+
+
+def test_map3_delta_drains_past_cap():
+    rng = random.Random(37)
+    sites, applied = _site_run(rng, n_cmds=16)
+    batched = BatchedMap3.from_pure(sites, deferred_cap=12, **_interners())
+    mesh = make_mesh(4, 2)
+    sharded = shard_map3(batched.state, mesh)
+    folded, _ = mesh_fold_map3(sharded, mesh)
+
+    dirty, fctx = _tracking(batched, applied)
+    e_local = sharded.mo.core.ctr.shape[-2] // 2
+    rounds = 4 * 4 * (e_local + 2)
+    gossiped, _, of = mesh_delta_gossip_map3(
+        sharded, dirty, fctx, mesh, rounds=rounds, cap=2
+    )
+    assert not bool(of.any())
+    _rows_equal(gossiped, folded)

@@ -111,11 +111,8 @@ def _tracking(batched, applied):
     return jnp.asarray(dirty), jnp.asarray(fctx)
 
 
-def _rows_equal(gossiped, folded):
-    for leaf_g, leaf_f in zip(jax.tree.leaves(gossiped), jax.tree.leaves(folded)):
-        g, f = np.asarray(leaf_g), np.asarray(leaf_f)
-        for row in range(g.shape[0]):
-            np.testing.assert_array_equal(g[row], f)
+from test_delta import _rows_equal  # noqa: E402  (shared comparator)
+
 
 
 @pytest.mark.parametrize("mesh_shape", [(4, 2), (2, 4), (8, 1)])
