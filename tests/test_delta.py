@@ -225,3 +225,25 @@ def test_interval_accumulate_tracking_converges():
     )
     assert not bool(of)
     _rows_equal(gossiped, folded)
+
+
+@pytest.mark.parametrize("cap", [1, 2, 3, 5])
+@pytest.mark.parametrize("seed", [7, 29])
+def test_delta_converges_for_any_cap(cap, seed):
+    """Convergence is cap-independent given the drain budget: rounds =
+    P ring latencies of the worst-case per-device backlog."""
+    rng = random.Random(seed)
+    states, applied = _rand_states(rng, 8, ["a", "b", "c", "d"])
+    batched = BatchedOrswot.from_pure(states)
+    mesh = make_mesh(4, 2)
+    sharded = shard_orswot(batched.state, mesh)
+    folded, _ = mesh_fold(sharded, mesh)
+
+    dirty, fctx = _tracking(batched, applied)
+    e_local = sharded.ctr.shape[-2] // 2
+    rounds = 4 * 4 * (-(-e_local // cap) + 2)
+    gossiped, _, of = mesh_delta_gossip(
+        sharded, dirty, fctx, mesh, rounds=rounds, cap=cap
+    )
+    assert not bool(of)
+    _rows_equal(gossiped, folded)
