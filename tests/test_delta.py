@@ -167,26 +167,6 @@ def test_delta_gossip_tracks_changes_since_sync():
     _rows_equal(gossiped, folded)
 
 
-def test_delta_gossip_drains_past_cap():
-    """cap=1: one row per link per round — the backlog must drain over
-    extra rounds and still converge."""
-    rng = random.Random(3)
-    states, applied = _rand_states(rng, 6, ["x", "y", "z"])
-    batched = BatchedOrswot.from_pure(states)
-    mesh = make_mesh(4, 2)
-    sharded = shard_orswot(batched.state, mesh)
-    folded, _ = mesh_fold(sharded, mesh)
-
-    dirty, fctx = _tracking(batched, applied)
-    e_local = sharded.ctr.shape[-2] // 2  # 2 element shards
-    rounds = 4 * 4 * (e_local + 2)  # P hops x per-row drain, generous
-    gossiped, _, of = mesh_delta_gossip(
-        sharded, dirty, fctx, mesh, rounds=rounds, cap=1
-    )
-    assert not bool(of)
-    _rows_equal(gossiped, folded)
-
-
 def test_interval_accumulate_tracking_converges():
     """Tracking built with interval_accumulate (per-op endpoint diffs,
     the contract-documented API) must drive δ-gossip to the full fold

@@ -1,0 +1,64 @@
+"""Run the real-chip checks outside pytest (tests/conftest.py pins the
+suite to a virtual CPU mesh, so the compiled Mosaic tests there always
+skip — this script is how to actually exercise them on hardware):
+
+    python tools/run_tpu_checks.py
+
+Runs, in order: a backend probe (fail-fast on a wedged relay, same
+mechanism as bench.py), the compiled fused-fold equality tests, the
+entry() compile check, and a scaled fused-vs-tree bench sanity."""
+
+import importlib.util
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> int:
+    # bench.py reads the BENCH_* env into module globals at import time,
+    # so the scaled sanity shape must be set BEFORE the import.
+    os.environ.setdefault("BENCH_REPLICAS", "2048")
+    os.environ.setdefault("BENCH_ELEMS", "16384")
+    import bench
+
+    if not bench.tpu_reachable():
+        print("FAIL: no TPU backend reachable (see stderr for the probe)")
+        return 1
+
+    import jax
+
+    print(f"backend: {jax.default_backend()}, devices: {jax.devices()}")
+
+    spec = importlib.util.spec_from_file_location(
+        "tpc", os.path.join(ROOT, "tests", "test_pallas_compiled.py")
+    )
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    t0 = time.time()
+    m.test_fused_fold_compiles_and_matches_tree_on_tpu()
+    print(f"compiled fused fold == tree fold   [{time.time()-t0:.0f}s]")
+    t0 = time.time()
+    m.test_multi_pass_stream_compiles_on_tpu()
+    print(f"multi-pass stream idempotent       [{time.time()-t0:.0f}s]")
+
+    t0 = time.time()
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    jax.jit(fn).lower(*args).compile()
+    print(f"entry() compiles                   [{time.time()-t0:.0f}s]")
+
+    mps, path, gbps, _, shape = bench.bench_tpu()
+    print(f"bench sanity: {mps:,.0f} merges/s ({path}, {gbps:.0f} GB/s, {shape})")
+    if path != "fused":
+        print("FAIL: fused path did not run on the chip")
+        return 1
+    print("ALL TPU CHECKS PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
