@@ -220,10 +220,20 @@ def join(a: MapState, b: MapState):
     return state, jnp.stack([c_overflow, jnp.any(d_overflow)])
 
 
-def fold(states: MapState):
-    """Join a whole replica batch (leading axis) in a log2 reduction tree
-    — sound because ``join`` is a true lattice join (tests assert this on
-    device shapes). Returns ``(state, overflow)``."""
+def fold(states: MapState, prefer: str = "auto"):
+    """Join a whole replica batch (leading axis) — the fused dense-slab
+    Pallas fold on TPU backends (pallas_kernels.fold_fused_map), the jnp
+    log2 reduction tree elsewhere; both sound because ``join`` is a true
+    lattice join (tests assert this on device shapes, and fused == tree
+    is pinned by tests/test_pallas_fold.py). Returns
+    ``(state, overflow)``."""
+    from .pallas_kernels import fold_auto_map
+
+    return fold_auto_map(states, prefer)
+
+
+def _tree_fold(states: MapState):
+    """The jnp log-tree fold (the fused path's oracle)."""
     from .lattice import tree_fold
 
     identity = empty(

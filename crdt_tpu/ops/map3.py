@@ -1,18 +1,14 @@
 """Dense batched kernels for ``Map<K1, Map<K2, Orswot<M>>>`` — depth-3
-nesting by the same slab induction that built the depth-2 types.
+nesting as a second application of the ``ops.nest`` induction step.
 
 Oracle: ``crdt_tpu.pure.map.Map`` with nested ``Map(Orswot)`` children
 (reference: src/map.rs arbitrary ``V: Val<A>`` nesting depth). The
 causal-composition rule (pure/map.py) pins every child top to the outer
 top, so the inner two levels collapse into ONE ``map_orswot`` slab over
-the K1 × K2 product key space — and this module is *structurally
-identical to ops/map_map.py with a different core module*. That is the
-induction step SURVEY.md §7.1's slab-composition plan promises: nesting
-a map around ANY already-flattened causal slab costs exactly one more
-outer deferred buffer (parked keyset-removes at the new level) plus the
-replay/compaction/dead-key-scrub cascade below; depth N is N-1
-applications of this wrapper around a leaf slab. No trace-time
-recursion, no new kernel math.
+the K1 × K2 product key space, and this module is literally
+``NestLevel(map_orswot.LEVEL)`` — the combinator applied to the already-
+wrapped slab. Depth N is N-1 ``NestLevel`` applications around a leaf
+slab (tests/test_nest_depth4.py composes depth 4 with no new module).
 
 Buffer levels in this state, outermost first:
 - ``odcl/odkeys/odvalid`` — K1-level parked keyset-removes (NEW here),
@@ -31,11 +27,8 @@ import jax
 import jax.numpy as jnp
 
 from . import map_orswot as mo_ops
-from .map_orswot import MapOrswotState, _any_slots
-from .orswot import _apply_parked, _park_remove
-from .outer_level import concat_outer, settle_outer_level
-
-DTYPE = jnp.uint32
+from .map_orswot import MapOrswotState
+from .nest import NestLevel
 
 
 class Map3State(NamedTuple):
@@ -47,6 +40,9 @@ class Map3State(NamedTuple):
     odvalid: jax.Array  # [..., D]
 
 
+LEVEL = NestLevel(mo_ops.LEVEL, Map3State)
+
+
 def empty(
     n_keys1: int,
     n_keys2: int,
@@ -56,13 +52,11 @@ def empty(
     batch: tuple = (),
 ) -> Map3State:
     """The join identity."""
-    return Map3State(
-        mo=mo_ops.empty(
+    return LEVEL.empty(
+        mo_ops.empty(
             n_keys1 * n_keys2, n_members, n_actors, deferred_cap, batch=batch
         ),
-        odcl=jnp.zeros((*batch, deferred_cap, n_actors), DTYPE),
-        odkeys=jnp.zeros((*batch, deferred_cap, n_keys1), bool),
-        odvalid=jnp.zeros((*batch, deferred_cap), bool),
+        n_keys1, n_actors, deferred_cap, batch,
     )
 
 
@@ -78,70 +72,8 @@ def _nm(state: Map3State) -> int:
     return state.mo.core.ctr.shape[-2] // state.mo.kdkeys.shape[-1]
 
 
-def _expand1(state: Map3State, key1_mask: jax.Array, to: str) -> jax.Array:
-    """[..., K1] outer mask → K1*K2 key mask (``to="keys"``) or
-    K1*K2*M element mask (``to="elems"``)."""
-    n = _n2(state) * (_nm(state) if to == "elems" else 1)
-    return jnp.repeat(key1_mask, n, axis=-1)
-
-
-def _replay_outer(state: Map3State) -> Map3State:
-    """Replay parked K1 keyset-removes against the leaf dot slab, then
-    drop slots the top has caught up to (the oracle's
-    ``_apply_deferred``)."""
-    emask = _expand1(state, state.odkeys, "elems")
-    ctr = _apply_parked(state.mo.core.ctr, state.odcl, emask, state.odvalid)
-    still = ~jnp.all(state.odcl <= state.mo.core.top[..., None, :], axis=-1)
-    odvalid = state.odvalid & still
-    return Map3State(
-        mo=state.mo._replace(core=state.mo.core._replace(ctr=ctr)),
-        odcl=jnp.where(odvalid[..., None], state.odcl, 0),
-        odkeys=state.odkeys & odvalid[..., None],
-        odvalid=odvalid,
-    )
-
-
-def _scrub_dead1(state: Map3State, element_axis=None) -> Map3State:
-    """A bottomed K1 child (no live leaf dot anywhere in its block) is
-    deleted by the oracle together with ALL parked state inside it — its
-    middle-map keyset-removes and its orswots' member-removes. The K1
-    buffer belongs to the outer map itself and is never scrubbed.
-
-    Runs the (K1,K2)-granular leaf scrub FIRST: a replayed K1-level
-    remove can bottom one (k1, k2) orswot while its K1 block stays
-    alive, and the oracle drops that orswot with its parked member-
-    removes even though the k1 child survives (mo_ops._scrub_dead_keys
-    last ran inside mo_ops.join, before our K1 replay killed content).
-
-    K1 liveness is shard-local (element shards align to whole K1
-    blocks); slot liveness reduces across shards (``_any_slots``)."""
-    state = state._replace(
-        mo=mo_ops._scrub_dead_keys(state.mo, element_axis=element_axis)
-    )
-    k1, k2, m = _n1(state), _n2(state), _nm(state)
-    ctr = state.mo.core.ctr
-    alive1 = jnp.any(
-        ctr.reshape(*ctr.shape[:-2], k1, k2 * m, ctr.shape[-1]) > 0,
-        axis=(-2, -1),
-    )  # [..., K1]
-    kcols = jnp.repeat(alive1, k2, axis=-1)       # [..., K1*K2]
-    ecols = jnp.repeat(alive1, k2 * m, axis=-1)   # [..., K1*K2*M]
-    kdkeys = state.mo.kdkeys & kcols[..., None, :]
-    kdvalid = state.mo.kdvalid & _any_slots(kdkeys, element_axis)
-    dmask = state.mo.core.dmask & ecols[..., None, :]
-    dvalid = state.mo.core.dvalid & _any_slots(dmask, element_axis)
-    return state._replace(
-        mo=state.mo._replace(
-            core=state.mo.core._replace(
-                dcl=jnp.where(dvalid[..., None], state.mo.core.dcl, 0),
-                dmask=dmask & dvalid[..., None],
-                dvalid=dvalid,
-            ),
-            kdcl=jnp.where(kdvalid[..., None], state.mo.kdcl, 0),
-            kdkeys=kdkeys & kdvalid[..., None],
-            kdvalid=kdvalid,
-        )
-    )
+_replay_outer = LEVEL.replay_outer
+_scrub_dead1 = LEVEL.scrub_self
 
 
 @partial(jax.jit, static_argnames=("element_axis",))
@@ -151,35 +83,17 @@ def join(a: Map3State, b: Map3State, element_axis=None):
     ``(state, overflow[3])`` — [leaf-deferred, K2-deferred, K1-deferred].
     ``element_axis`` names the mesh axis the key/element dimension is
     sharded over when joining inside shard_map."""
-    mo, mo_flags = mo_ops.join(a.mo, b.mo, element_axis=element_axis)
-
-    state = Map3State(
-        mo,
-        *concat_outer(
-            (a.odcl, a.odkeys, a.odvalid), (b.odcl, b.odkeys, b.odvalid)
-        ),
-    )
-    state, outer_of = settle_outer_level(
-        state,
-        a.odcl.shape[-2],
-        get_bufs=lambda s: (s.odcl, s.odkeys, s.odvalid),
-        with_bufs=lambda s, cl, ks, v: s._replace(odcl=cl, odkeys=ks, odvalid=v),
-        replay=_replay_outer,
-        scrub=_scrub_dead1,
-        element_axis=element_axis,
-    )
-    return state, jnp.stack([mo_flags[0], mo_flags[1], outer_of])
+    return LEVEL.join(a, b, element_axis)
 
 
-def fold(states: Map3State, element_axis=None):
-    """Log-tree fold of a replica batch (leading axis)."""
-    from .lattice import tree_fold
+def fold(states: Map3State, element_axis=None, prefer: str = "auto"):
+    """Replica-batch fold with backend-appropriate dispatch: the fused
+    one-HBM-pass Pallas kernel on TPU backends, the jnp log-tree fold
+    elsewhere (``prefer`` = "auto"|"fused"|"tree" as in
+    pallas_kernels.fold_auto)."""
+    from .pallas_kernels import fold_auto_level
 
-    k1, k2, m = _n1(states), _n2(states), _nm(states)
-    identity = empty(
-        k1, k2, m, states.mo.core.top.shape[-1], states.odcl.shape[-2]
-    )
-    return tree_fold(states, identity, partial(join, element_axis=element_axis))
+    return fold_auto_level(LEVEL, states, prefer, element_axis)
 
 
 # ---- op application (CmRDT) ----------------------------------------------
@@ -200,7 +114,7 @@ def apply_member_add(
     mo = mo_ops.apply_member_add(
         state.mo, actor, counter, flat_key, member_mask
     )
-    return _scrub_dead1(_replay_outer(state._replace(mo=mo)))
+    return LEVEL.cascade(state, mo)
 
 
 @jax.jit
@@ -216,12 +130,16 @@ def apply_member_rm(
     """``Op::Up { dot, k1, op: Up { dot, k2, op: Rm { clock, members } } }``
     — a leaf member remove routed through both map levels. Returns
     ``(state, overflow)``."""
+    k = _n1(state) * _n2(state)
+    m = _nm(state)
     flat_key = key1 * _n2(state) + key2
-    mo, overflow = mo_ops.apply_member_rm(
-        state.mo, actor, counter, flat_key, rm_clock, member_mask
+    emask = (
+        jax.nn.one_hot(flat_key, k, dtype=bool)[..., :, None]
+        & member_mask[..., None, :]
+    ).reshape(*member_mask.shape[:-1], k * m)
+    return LEVEL.apply_up_rm(
+        state, actor, counter, rm_clock, emask, levels_down=2
     )
-    out = _scrub_dead1(_replay_outer(state._replace(mo=mo)))
-    return out, overflow
 
 
 @jax.jit
@@ -237,28 +155,14 @@ def apply_key2_rm(
     keyset-remove routed through the outer map: kill covered content at
     (k1, keyset2) (parking in the K2 buffer if ahead), then witness the
     Up's dot. Returns ``(state, overflow)``."""
-    counter = counter.astype(state.mo.core.top.dtype)
-    seen = state.mo.core.top[..., actor] >= counter
     k1n, k2n = _n1(state), _n2(state)
     fmask = (
         jax.nn.one_hot(key1, k1n, dtype=bool)[..., :, None]
         & key2_mask[..., None, :]
     ).reshape(*key2_mask.shape[:-1], k1n * k2n)
-    rmed, overflow = mo_ops.apply_key_rm(state.mo, rm_clock, fmask)
-    top = rmed.core.top.at[..., actor].max(counter)
-    # Advancing the top may un-park removes at every level: replay leaf,
-    # then middle, then outer, each dropping caught-up slots.
-    ctr = _apply_parked(rmed.core.ctr, rmed.core.dcl, rmed.core.dmask, rmed.core.dvalid)
-    still = ~jnp.all(rmed.core.dcl <= top[..., None, :], axis=-1)
-    core = rmed.core._replace(top=top, ctr=ctr, dvalid=rmed.core.dvalid & still)
-    mo = mo_ops._scrub_dead_keys(mo_ops._replay_outer(rmed._replace(core=core)))
-    out = _scrub_dead1(_replay_outer(state._replace(mo=mo)))
-    # A dup dot drops the whole Up (pure/map.py ``apply`` returns early).
-    bshape = lambda new: seen.reshape(seen.shape + (1,) * (new.ndim - seen.ndim))
-    out = jax.tree.map(
-        lambda old, new: jnp.where(bshape(new), old, new), state, out
+    return LEVEL.apply_up_rm(
+        state, actor, counter, rm_clock, fmask, levels_down=1
     )
-    return out, overflow & ~seen
 
 
 @jax.jit
@@ -267,22 +171,4 @@ def apply_key1_rm(state: Map3State, rm_clock: jax.Array, key1_mask: jax.Array):
     src/map.rs ``apply_keyset_rm``): kill covered leaf dots across the
     masked K1 blocks now; park in the K1 buffer if the clock is ahead.
     Returns ``(state, overflow)``."""
-    rm_clock = jnp.asarray(rm_clock, state.mo.core.top.dtype)
-    emask = _expand1(state, key1_mask, "elems")
-    ctr = state.mo.core.ctr
-    dominated = emask[..., :, None] & (ctr <= rm_clock[..., None, :])
-    ctr = jnp.where(dominated, jnp.zeros_like(ctr), ctr)
-
-    ahead = ~jnp.all(rm_clock <= state.mo.core.top, axis=-1)
-    odcl, odkeys, odvalid, overflow = _park_remove(
-        state.odcl, state.odkeys, state.odvalid, rm_clock, key1_mask, ahead
-    )
-    out = _scrub_dead1(
-        Map3State(
-            mo=state.mo._replace(core=state.mo.core._replace(ctr=ctr)),
-            odcl=odcl,
-            odkeys=odkeys,
-            odvalid=odvalid,
-        )
-    )
-    return out, overflow
+    return LEVEL.rm_parked(state, rm_clock, key1_mask)

@@ -16,6 +16,10 @@ replay with the same covered-dot rule but must stay distinct for
 lossless round-trips (outer ``map.deferred`` vs per-child
 ``child.deferred``), and inner parked removes die with a bottomed child
 (``Map.is_bottom`` counts live entries only) — the dead-key scrub.
+
+This is ONE application of the nesting induction step around the
+``Map<K, MVReg>`` leaf slab, instantiated via ``ops.nest.NestLevel``;
+only the CmRDT op-routing signatures are flavor-specific.
 """
 
 from __future__ import annotations
@@ -27,11 +31,8 @@ import jax
 import jax.numpy as jnp
 
 from . import map as core_ops
-from .map import MapState, _canon_child, _rm_covered
-from .orswot import _park_remove
-from .outer_level import concat_outer, settle_outer_level
-
-DTYPE = jnp.uint32
+from .map import MapState
+from .nest import MAP_MVREG, NestLevel
 
 
 class NestedMapState(NamedTuple):
@@ -43,6 +44,9 @@ class NestedMapState(NamedTuple):
     odvalid: jax.Array # [..., D]
 
 
+LEVEL = NestLevel(MAP_MVREG, NestedMapState)
+
+
 def empty(
     n_keys1: int,
     n_keys2: int,
@@ -52,13 +56,11 @@ def empty(
     batch: tuple = (),
 ) -> NestedMapState:
     """The join identity."""
-    return NestedMapState(
-        m=core_ops.empty(
+    return LEVEL.empty(
+        core_ops.empty(
             n_keys1 * n_keys2, n_actors, sibling_cap, deferred_cap, batch=batch
         ),
-        odcl=jnp.zeros((*batch, deferred_cap, n_actors), DTYPE),
-        odkeys=jnp.zeros((*batch, deferred_cap, n_keys1), bool),
-        odvalid=jnp.zeros((*batch, deferred_cap), bool),
+        n_keys1, n_actors, deferred_cap, batch,
     )
 
 
@@ -66,57 +68,8 @@ def _n_keys1(state: NestedMapState) -> int:
     return state.odkeys.shape[-1]
 
 
-def _expand1(state: NestedMapState, key1_mask: jax.Array) -> jax.Array:
-    """[..., K1] outer key mask → [..., K1*K2] flat key mask."""
-    k2 = state.m.dkeys.shape[-1] // _n_keys1(state)
-    return jnp.repeat(key1_mask, k2, axis=-1)
-
-
-def _replay_outer(state: NestedMapState) -> NestedMapState:
-    """Replay parked outer keyset-removes against the content slab, then
-    drop slots the top has caught up to."""
-    tmp = state.m._replace(
-        dcl=state.odcl,
-        dkeys=_expand1(state, state.odkeys),
-        dvalid=state.odvalid,
-    )
-    replayed = core_ops._apply_parked(tmp)
-    still = ~jnp.all(state.odcl <= state.m.top[..., None, :], axis=-1)
-    odvalid = state.odvalid & still
-    return NestedMapState(
-        m=state.m._replace(child=_canon_child(replayed.child)),
-        odcl=jnp.where(odvalid[..., None], state.odcl, 0),
-        odkeys=state.odkeys & odvalid[..., None],
-        odvalid=odvalid,
-    )
-
-
-def _scrub_dead_keys(state: NestedMapState, element_axis=None) -> NestedMapState:
-    """A bottomed child map is deleted by the oracle together with its
-    parked inner removes (``Map.is_bottom``); clear inner parked masks on
-    K1 rows holding no live content, drop emptied slots. The outer
-    buffer belongs to the outer map and is never scrubbed.
-
-    K1 liveness is shard-local (element shards align to whole K1
-    blocks); slot liveness reduces across shards (``_any_slots``)."""
-    from .map_orswot import _any_slots
-
-    k1 = _n_keys1(state)
-    k2 = state.m.dkeys.shape[-1] // k1
-    alive = jnp.any(
-        state.m.child.valid.reshape(*state.m.child.valid.shape[:-2], k1, k2, -1),
-        axis=(-2, -1),
-    )  # [..., K1]
-    acols = jnp.repeat(alive, k2, axis=-1)
-    dkeys = state.m.dkeys & acols[..., None, :]
-    dvalid = state.m.dvalid & _any_slots(dkeys, element_axis)
-    return state._replace(
-        m=state.m._replace(
-            dcl=jnp.where(dvalid[..., None], state.m.dcl, 0),
-            dkeys=dkeys & dvalid[..., None],
-            dvalid=dvalid,
-        )
-    )
+_replay_outer = LEVEL.replay_outer
+_scrub_dead_keys = LEVEL.scrub_self
 
 
 @partial(jax.jit, static_argnames=("element_axis",))
@@ -127,39 +80,17 @@ def join(a: NestedMapState, b: NestedMapState, element_axis=None):
     outer-deferred] (slab/inner lanes conservative as in ops.map).
     ``element_axis`` names the mesh axis the key dimension is sharded
     over when joining inside shard_map."""
-    m, mf = core_ops.join(a.m, b.m)  # mf = [sibling, inner-deferred]
-
-    state = NestedMapState(
-        m,
-        *concat_outer(
-            (a.odcl, a.odkeys, a.odvalid), (b.odcl, b.odkeys, b.odvalid)
-        ),
-    )
-    state, outer_of = settle_outer_level(
-        state,
-        a.odcl.shape[-2],
-        get_bufs=lambda s: (s.odcl, s.odkeys, s.odvalid),
-        with_bufs=lambda s, cl, ks, v: s._replace(odcl=cl, odkeys=ks, odvalid=v),
-        replay=_replay_outer,
-        scrub=_scrub_dead_keys,
-        element_axis=element_axis,
-    )
-    return state, jnp.stack([mf[0], mf[1], outer_of])
+    return LEVEL.join(a, b, element_axis)
 
 
-def fold(states: NestedMapState, element_axis=None):
-    """Log-tree fold of a replica batch (leading axis)."""
-    from .lattice import tree_fold
+def fold(states: NestedMapState, element_axis=None, prefer: str = "auto"):
+    """Replica-batch fold with backend-appropriate dispatch: the fused
+    dense-slab Pallas kernel on TPU backends, the jnp log-tree fold
+    elsewhere (``prefer`` = "auto"|"fused"|"tree" as in
+    pallas_kernels.fold_auto)."""
+    from .pallas_kernels import fold_auto_level
 
-    k1 = states.odkeys.shape[-1]
-    k2 = states.m.dkeys.shape[-1] // k1
-    identity = empty(
-        k1, k2,
-        states.m.top.shape[-1],
-        states.m.child.wact.shape[-1],
-        states.odcl.shape[-2],
-    )
-    return tree_fold(states, identity, partial(join, element_axis=element_axis))
+    return fold_auto_level(LEVEL, states, prefer, element_axis)
 
 
 @jax.jit
@@ -179,8 +110,7 @@ def apply_put(
     m, overflow = core_ops.apply_up(
         state.m, actor, counter, flat_key, put_clock, val
     )
-    out = _scrub_dead_keys(_replay_outer(state._replace(m=m)))
-    return out, overflow
+    return LEVEL.cascade(state, m), overflow
 
 
 @jax.jit
@@ -196,27 +126,15 @@ def apply_inner_rm(
     keyset-remove routed through the outer map: kill covered content at
     (k1, keyset2) (parking in the INNER buffer if ahead), then witness
     the Up's dot. Returns ``(state, overflow)``."""
-    counter = counter.astype(state.m.top.dtype)
-    seen = state.m.top[..., actor] >= counter
     k1n = _n_keys1(state)
     k2n = state.m.dkeys.shape[-1] // k1n
     fmask = (
         jax.nn.one_hot(key1, k1n, dtype=bool)[..., :, None]
         & key2_mask[..., None, :]
     ).reshape(*key2_mask.shape[:-1], k1n * k2n)
-    rmed, overflow = core_ops.apply_rm(state.m, rm_clock, fmask)
-    top = rmed.top.at[..., actor].max(counter)
-    m = core_ops._drop_stale_deferred(
-        core_ops._apply_parked(rmed._replace(top=top))
+    return LEVEL.apply_up_rm(
+        state, actor, counter, rm_clock, fmask, levels_down=1
     )
-    m = m._replace(child=_canon_child(m.child))
-    out = _scrub_dead_keys(_replay_outer(state._replace(m=m)))
-    # A dup dot drops the whole Up (pure/map.py ``apply`` returns early).
-    bshape = lambda new: seen.reshape(seen.shape + (1,) * (new.ndim - seen.ndim))
-    out = jax.tree.map(
-        lambda old, new: jnp.where(bshape(new), old, new), state, out
-    )
-    return out, overflow & ~seen
 
 
 @jax.jit
@@ -224,21 +142,4 @@ def apply_key1_rm(state: NestedMapState, rm_clock: jax.Array, key1_mask: jax.Arr
     """``Op::Rm { clock, keyset }`` on the outer map: kill covered
     content across the masked K1 rows now; park in the OUTER buffer if
     the clock is ahead. Returns ``(state, overflow)``."""
-    rm_clock = jnp.asarray(rm_clock, state.m.top.dtype)
-    fmask = _expand1(state, key1_mask)
-    valid = _rm_covered(state.m.child, rm_clock, fmask)
-    child = _canon_child(state.m.child._replace(valid=valid))
-
-    ahead = ~jnp.all(rm_clock <= state.m.top, axis=-1)
-    odcl, odkeys, odvalid, overflow = _park_remove(
-        state.odcl, state.odkeys, state.odvalid, rm_clock, key1_mask, ahead
-    )
-    out = _scrub_dead_keys(
-        NestedMapState(
-            m=state.m._replace(child=child),
-            odcl=odcl,
-            odkeys=odkeys,
-            odvalid=odvalid,
-        )
-    )
-    return out, overflow
+    return LEVEL.rm_parked(state, rm_clock, key1_mask)

@@ -19,6 +19,11 @@ round-trips losslessly to the oracle's ``map.deferred`` (keysets) vs
 ``child.deferred`` (membersets) — the A/B gate in
 tests/test_models_map_nested.py checks exactly that.
 
+All of that is ONE application of the nesting induction step, so this
+module is now an instantiation of ``ops.nest.NestLevel`` around the
+orswot leaf slab; only the CmRDT op-routing signatures (which flatten
+(key, member) coordinates) are flavor-specific.
+
 State: ``core`` is a plain ``OrswotState`` with E = K*M (top, ctr, and
 the inner deferred buffer); ``kdcl/kdkeys/kdvalid`` are the outer parked
 keyset-removes.
@@ -33,19 +38,19 @@ import jax
 import jax.numpy as jnp
 
 from . import orswot as core_ops
-from .orswot import OrswotState, _apply_parked, _park_remove
-from .outer_level import concat_outer, settle_outer_level
-
-DTYPE = jnp.uint32
+from .nest import ORSWOT, DTYPE, NestLevel, _any_slots  # noqa: F401 (re-export)
 
 
 class MapOrswotState(NamedTuple):
     """A (possibly batched) dense Map<K, Orswot<M>> replica (pytree)."""
 
-    core: OrswotState  # top [..., A]; ctr [..., K*M, A]; inner deferred
+    core: core_ops.OrswotState  # top [..., A]; ctr [..., K*M, A]; inner deferred
     kdcl: jax.Array    # [..., D, A]  outer parked rm clocks
     kdkeys: jax.Array  # [..., D, K]  outer parked keysets
     kdvalid: jax.Array # [..., D]
+
+
+LEVEL = NestLevel(ORSWOT, MapOrswotState)
 
 
 def empty(
@@ -56,13 +61,9 @@ def empty(
     batch: tuple = (),
 ) -> MapOrswotState:
     """The join identity."""
-    return MapOrswotState(
-        core=core_ops.empty(
-            n_keys * n_members, n_actors, deferred_cap, batch=batch
-        ),
-        kdcl=jnp.zeros((*batch, deferred_cap, n_actors), DTYPE),
-        kdkeys=jnp.zeros((*batch, deferred_cap, n_keys), bool),
-        kdvalid=jnp.zeros((*batch, deferred_cap), bool),
+    return LEVEL.empty(
+        core_ops.empty(n_keys * n_members, n_actors, deferred_cap, batch=batch),
+        n_keys, n_actors, deferred_cap, batch,
     )
 
 
@@ -72,67 +73,12 @@ def _n_keys(state: MapOrswotState) -> int:
 
 def _expand_keys(state: MapOrswotState, key_mask: jax.Array) -> jax.Array:
     """[..., K] key mask → [..., K*M] element mask (all members)."""
-    m = state.core.ctr.shape[-2] // _n_keys(state)
-    return jnp.repeat(key_mask, m, axis=-1)
+    return LEVEL.expand(state, key_mask)
 
 
-def _replay_outer(state: MapOrswotState) -> MapOrswotState:
-    """Replay parked keyset-removes against the slab, then drop slots the
-    top has caught up to (the oracle's ``_apply_deferred``)."""
-    emask = _expand_keys(state, state.kdkeys)
-    ctr = _apply_parked(state.core.ctr, state.kdcl, emask, state.kdvalid)
-    still_ahead = ~jnp.all(
-        state.kdcl <= state.core.top[..., None, :], axis=-1
-    )
-    kdvalid = state.kdvalid & still_ahead
-    return MapOrswotState(
-        core=state.core._replace(ctr=ctr),
-        kdcl=jnp.where(kdvalid[..., None], state.kdcl, 0),
-        kdkeys=state.kdkeys & kdvalid[..., None],
-        kdvalid=kdvalid,
-    )
-
-
-def _any_slots(mask: jax.Array, element_axis) -> jax.Array:
-    """Per-slot liveness ``any(mask, -1)``, reduced across element
-    shards when the mask's last axis is sharded (``element_axis`` set,
-    inside shard_map): a slot's keys may live in other shards, and slot
-    validity must stay replicated across them."""
-    live = jnp.any(mask, axis=-1)
-    if element_axis is not None:
-        from jax import lax
-
-        live = lax.psum(live.astype(jnp.int32), element_axis) > 0
-    return live
-
-
-def _scrub_dead_keys(state: MapOrswotState, element_axis=None) -> MapOrswotState:
-    """A memberless child is deleted by the oracle — together with its
-    parked inner removes (``Orswot.is_bottom`` counts live members only,
-    and ``Map`` drops bottom children after every apply/merge). Mirror:
-    clear inner parked masks on keys holding no live dot, drop slots
-    whose masks empty out. Outer parked keyset-removes belong to the map
-    itself and are never scrubbed.
-
-    Key liveness itself is shard-local (element shards align to whole
-    key blocks — K*M is sharded in multiples of M), only the slot
-    liveness reduces across shards (``_any_slots``)."""
-    k = _n_keys(state)
-    m = state.core.ctr.shape[-2] // k
-    alive = jnp.any(
-        state.core.ctr.reshape(*state.core.ctr.shape[:-2], k, m, -1) > 0,
-        axis=(-2, -1),
-    )  # [..., K]
-    acols = jnp.repeat(alive, m, axis=-1)  # [..., K*M]
-    dmask = state.core.dmask & acols[..., None, :]
-    dvalid = state.core.dvalid & _any_slots(dmask, element_axis)
-    return state._replace(
-        core=state.core._replace(
-            dcl=jnp.where(dvalid[..., None], state.core.dcl, 0),
-            dmask=dmask & dvalid[..., None],
-            dvalid=dvalid,
-        )
-    )
+# Shared-level entry points (delta flavors and tests use these names).
+_replay_outer = LEVEL.replay_outer
+_scrub_dead_keys = LEVEL.scrub_self
 
 
 @partial(jax.jit, static_argnames=("element_axis",))
@@ -146,36 +92,17 @@ def join(a: MapOrswotState, b: MapOrswotState, element_axis=None):
     (The core join's inner-overflow flag is conservative here: it counts
     parked slots before dead-key scrubbing, so a buffer transiently full
     of dead-key slots can flag where the oracle would not.)"""
-    core, inner_of = core_ops.join(a.core, b.core)
-
-    state = MapOrswotState(
-        core,
-        *concat_outer(
-            (a.kdcl, a.kdkeys, a.kdvalid), (b.kdcl, b.kdkeys, b.kdvalid)
-        ),
-    )
-    state, outer_of = settle_outer_level(
-        state,
-        a.kdcl.shape[-2],
-        get_bufs=lambda s: (s.kdcl, s.kdkeys, s.kdvalid),
-        with_bufs=lambda s, cl, ks, v: s._replace(kdcl=cl, kdkeys=ks, kdvalid=v),
-        replay=_replay_outer,
-        scrub=_scrub_dead_keys,
-        element_axis=element_axis,
-    )
-    return state, jnp.stack([jnp.any(inner_of), outer_of])
+    return LEVEL.join(a, b, element_axis)
 
 
-def fold(states: MapOrswotState, element_axis=None):
-    """Log-tree fold of a replica batch (leading axis)."""
-    from .lattice import tree_fold
+def fold(states: MapOrswotState, element_axis=None, prefer: str = "auto"):
+    """Replica-batch fold with backend-appropriate dispatch: the fused
+    one-HBM-pass Pallas kernel on TPU backends, the jnp log-tree fold
+    elsewhere (``prefer`` = "auto"|"fused"|"tree" as in
+    pallas_kernels.fold_auto)."""
+    from .pallas_kernels import fold_auto_level
 
-    k = states.kdkeys.shape[-1]
-    m = states.core.ctr.shape[-2] // k
-    identity = empty(
-        k, m, states.core.top.shape[-1], states.kdcl.shape[-2]
-    )
-    return tree_fold(states, identity, partial(join, element_axis=element_axis))
+    return fold_auto_level(LEVEL, states, prefer, element_axis)
 
 
 @jax.jit
@@ -191,11 +118,12 @@ def apply_member_add(
     whole op (pure/map.py ``apply``); parked removes replay after."""
     k = _n_keys(state)
     m = state.core.ctr.shape[-2] // k
-    emask = (jax.nn.one_hot(key, k, dtype=bool)[..., :, None] & member_mask[..., None, :]).reshape(
-        *member_mask.shape[:-1], k * m
-    )
+    emask = (
+        jax.nn.one_hot(key, k, dtype=bool)[..., :, None]
+        & member_mask[..., None, :]
+    ).reshape(*member_mask.shape[:-1], k * m)
     core = core_ops.apply_add(state.core, actor, counter, emask)
-    return _scrub_dead_keys(_replay_outer(state._replace(core=core)))
+    return LEVEL.cascade(state, core)
 
 
 @jax.jit
@@ -211,28 +139,15 @@ def apply_member_rm(
     orswot remove routed through the map: kill covered dots of the key's
     masked members (parking in the INNER buffer if ahead), then witness
     the Up's dot on the top clock. Returns ``(state, overflow)``."""
-    counter = counter.astype(state.core.top.dtype)
-    seen = state.core.top[..., actor] >= counter
     k = _n_keys(state)
     m = state.core.ctr.shape[-2] // k
     emask = (
         jax.nn.one_hot(key, k, dtype=bool)[..., :, None]
         & member_mask[..., None, :]
     ).reshape(*member_mask.shape[:-1], k * m)
-    rmed, overflow = core_ops.apply_rm(state.core, rm_clock, emask)
-    top = rmed.top.at[..., actor].max(counter)
-    # Advancing the top may un-park inner and outer removes: replay both.
-    ctr = _apply_parked(rmed.ctr, rmed.dcl, rmed.dmask, rmed.dvalid)
-    still = ~jnp.all(rmed.dcl <= top[..., None, :], axis=-1)
-    core = rmed._replace(top=top, ctr=ctr, dvalid=rmed.dvalid & still)
-    out = _scrub_dead_keys(_replay_outer(state._replace(core=core)))
-    # A dup dot drops the whole Up (pure/map.py ``apply`` returns early —
-    # nothing applied, nothing parked).
-    bshape = lambda new: seen.reshape(seen.shape + (1,) * (new.ndim - seen.ndim))
-    out = jax.tree.map(
-        lambda old, new: jnp.where(bshape(new), old, new), state, out
+    return LEVEL.apply_up_rm(
+        state, actor, counter, rm_clock, emask, levels_down=1
     )
-    return out, overflow & ~seen
 
 
 @jax.jit
@@ -241,21 +156,4 @@ def apply_key_rm(state: MapOrswotState, rm_clock: jax.Array, key_mask: jax.Array
     ``apply_keyset_rm``): kill covered dots across the masked keys' whole
     member rows now; park in the OUTER buffer if the clock is ahead.
     Returns ``(state, overflow)``."""
-    rm_clock = jnp.asarray(rm_clock, state.core.top.dtype)
-    emask = _expand_keys(state, key_mask)
-    dominated = emask[..., :, None] & (state.core.ctr <= rm_clock[..., None, :])
-    ctr = jnp.where(dominated, jnp.zeros_like(state.core.ctr), state.core.ctr)
-
-    ahead = ~jnp.all(rm_clock <= state.core.top, axis=-1)
-    kdcl, kdkeys, kdvalid, overflow = _park_remove(
-        state.kdcl, state.kdkeys, state.kdvalid, rm_clock, key_mask, ahead
-    )
-    out = _scrub_dead_keys(
-        MapOrswotState(
-            core=state.core._replace(ctr=ctr),
-            kdcl=kdcl,
-            kdkeys=kdkeys,
-            kdvalid=kdvalid,
-        )
-    )
-    return out, overflow
+    return LEVEL.rm_parked(state, rm_clock, key_mask)

@@ -1,0 +1,414 @@
+"""THE nesting combinator — one induction step, any depth.
+
+Reference: src/map.rs ``Map<K, V: Val<A>, A>`` composes causal CRDTs to
+arbitrary depth. Under the causal-composition rule (pure/map.py) every
+child's top clock equals the outer top, so each map level flattens onto
+its child's slab over a product key space, and nesting a map around ANY
+already-flattened causal slab costs exactly one more outer deferred
+buffer (parked keyset-removes at the new level) plus the
+replay/compaction/dead-key-scrub cascade. Through round 3 that induction
+step was *prose* — ops/map_orswot.py, ops/map_map.py, and ops/map3.py
+were three hand-written instantiations (map3.py's own docstring: "depth
+N is N-1 applications of this wrapper"). This module is the wrapper AS
+CODE: ``NestLevel`` takes any slab satisfying the small protocol below
+and IS the one-more-outer-buffer slab — itself nestable, so depth 4+
+needs no new module (tests/test_nest_depth4.py builds
+``Map<K1, Map<K2, Map<K3, Orswot>>>`` by composing three levels).
+
+Protocol (every nestable slab level implements; ``s`` is its state
+pytree):
+
+- ``keys_width(s)``          — size of the level's keyset-mask axis.
+- ``top(s)`` / ``witness(s, actor, counter)`` — the shared top clock
+  (lives on the leaf slab; one dot witnesses at every level at once).
+- ``join(a, b, element_axis=None) -> (s, flags[L])`` — full lattice
+  join; flags are scalar overflow lanes, innermost level first.
+- ``replay_keyset(s, dcl, dmask, dvalid) -> s`` — kill content covered
+  by parked (clock, keyset-mask-over-my-keys) slots. Monotone zeroing;
+  touches no buffers, so replay order across levels is free.
+- ``rm_parked(s, rm_clock, mask) -> (s, overflow)`` — apply the covered
+  part of a keyset-remove now, parking the clock in THIS level's buffer
+  when it runs ahead of the top.
+- ``alive(s) -> bool[..., keys_width]`` — per-key liveness.
+- ``scrub_cols(s, alive_cols, element_axis) -> s`` — mask ALL of the
+  level's buffers (own + inner) to live columns, dropping emptied
+  slots. Used by the ENCLOSING level when my keys die with its keys.
+- ``scrub_self(s, element_axis) -> s`` — the level's own dead-key
+  scrub: bottomed children die with their parked state (the oracle's
+  ``is_bottom`` drop); the level's OWN buffer belongs to it and is
+  never self-scrubbed.
+- ``settle_self(s, element_axis) -> s`` — after a top advance: replay
+  parked slots at every level (innermost first), drop caught-up slots,
+  then scrub.
+- ``leaf_ctr(s)`` — the leaf dot slab (delta flavors diff it).
+
+Leaf adapters: ``ORSWOT`` (the dot-matrix slab of ops/orswot.py — leaf
+of the orswot-valued family) and ``MAP_MVREG`` (the slot-table slab of
+ops/map.py — the ``Map<K, MVReg>`` leaf, whose own dkeys buffer makes it
+directly nestable). The concrete flavor modules instantiate:
+``map_orswot.LEVEL = NestLevel(ORSWOT)``, ``map_map.LEVEL =
+NestLevel(MAP_MVREG)``, ``map3.LEVEL = NestLevel(map_orswot.LEVEL)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import map as map_ops
+from . import orswot as orswot_ops
+from .orswot import (
+    _apply_parked,
+    _compact_deferred,
+    _dedupe_deferred,
+    _park_remove,
+)
+
+DTYPE = jnp.uint32
+
+
+def _any_slots(mask: jax.Array, element_axis) -> jax.Array:
+    """Per-slot liveness ``any(mask, -1)``, reduced across element
+    shards when the mask's last axis is sharded (``element_axis`` set,
+    inside shard_map): a slot's keys may live in other shards, and slot
+    validity must stay replicated across them."""
+    live = jnp.any(mask, axis=-1)
+    if element_axis is not None:
+        from jax import lax
+
+        live = lax.psum(live.astype(jnp.int32), element_axis) > 0
+    return live
+
+
+class NestedState(NamedTuple):
+    """Generic one-more-level state: any nestable slab + one outer
+    parked-keyset-remove buffer. The concrete flavors keep their own
+    NamedTuple classes (same POSITIONAL layout — field names differ for
+    compatibility); ``NestLevel`` accesses fields positionally so any
+    4-field (core, dcl, dkeys, dvalid) class works."""
+
+    core: Any
+    dcl: jax.Array     # [..., D, A]  parked rm clocks
+    dkeys: jax.Array   # [..., D, K]  parked keysets
+    dvalid: jax.Array  # [..., D]
+
+
+class OrswotSlab:
+    """Leaf adapter: the flat orswot dot slab (ops/orswot.py). Its
+    "keys" are its elements; its buffer parks member-removes."""
+
+    def keys_width(self, s):
+        return s.ctr.shape[-2]
+
+    def top(self, s):
+        return s.top
+
+    def witness(self, s, actor, counter):
+        return s._replace(top=s.top.at[..., actor].max(counter))
+
+    def join(self, a, b, element_axis=None):
+        st, of = orswot_ops.join(a, b)
+        return st, jnp.atleast_1d(jnp.any(of))
+
+    def replay_keyset(self, s, dcl, dmask, dvalid):
+        return s._replace(ctr=_apply_parked(s.ctr, dcl, dmask, dvalid))
+
+    def rm_parked(self, s, rm_clock, mask):
+        return orswot_ops.apply_rm(s, rm_clock, mask)
+
+    def alive(self, s):
+        return jnp.any(s.ctr > 0, axis=-1)
+
+    def scrub_cols(self, s, cols, element_axis=None):
+        dmask = s.dmask & cols[..., None, :]
+        dvalid = s.dvalid & _any_slots(dmask, element_axis)
+        return s._replace(
+            dcl=jnp.where(dvalid[..., None], s.dcl, 0),
+            dmask=dmask & dvalid[..., None],
+            dvalid=dvalid,
+        )
+
+    def scrub_self(self, s, element_axis=None):
+        return s  # elements have nothing inside them to scrub
+
+    def settle_self(self, s, element_axis=None):
+        ctr = _apply_parked(s.ctr, s.dcl, s.dmask, s.dvalid)
+        still = ~jnp.all(s.dcl <= s.top[..., None, :], axis=-1)
+        return s._replace(ctr=ctr, dvalid=s.dvalid & still)
+
+    def rm_route(self, s, levels_down, rm_clock, mask):
+        assert levels_down == 0, "leaf slab cannot route deeper"
+        return self.rm_parked(s, rm_clock, mask)
+
+    def leaf_ctr(self, s):
+        return s.ctr
+
+
+class MapMVRegSlab:
+    """Leaf adapter: the Map<K, MVReg> slot slab (ops/map.py). Its
+    buffer parks keyset-removes; content lives in per-key slot tables."""
+
+    def keys_width(self, s):
+        return s.dkeys.shape[-1]
+
+    def top(self, s):
+        return s.top
+
+    def witness(self, s, actor, counter):
+        return s._replace(top=s.top.at[..., actor].max(counter))
+
+    def join(self, a, b, element_axis=None):
+        return map_ops.join(a, b)  # flags already [sibling, deferred]
+
+    def replay_keyset(self, s, dcl, dkeys, dvalid):
+        tmp = s._replace(dcl=dcl, dkeys=dkeys, dvalid=dvalid)
+        replayed = map_ops._apply_parked(tmp)
+        return s._replace(child=map_ops._canon_child(replayed.child))
+
+    def rm_parked(self, s, rm_clock, mask):
+        return map_ops.apply_rm(s, rm_clock, mask)
+
+    def alive(self, s):
+        return jnp.any(s.child.valid, axis=-1)
+
+    def scrub_cols(self, s, cols, element_axis=None):
+        dkeys = s.dkeys & cols[..., None, :]
+        dvalid = s.dvalid & _any_slots(dkeys, element_axis)
+        return s._replace(
+            dcl=jnp.where(dvalid[..., None], s.dcl, 0),
+            dkeys=dkeys & dvalid[..., None],
+            dvalid=dvalid,
+        )
+
+    def scrub_self(self, s, element_axis=None):
+        return s  # MVReg children hold no parked state of their own
+
+    def settle_self(self, s, element_axis=None):
+        out = map_ops._drop_stale_deferred(map_ops._apply_parked(s))
+        return out._replace(child=map_ops._canon_child(out.child))
+
+    def rm_route(self, s, levels_down, rm_clock, mask):
+        assert levels_down == 0, "leaf slab cannot route deeper"
+        return self.rm_parked(s, rm_clock, mask)
+
+    def leaf_ctr(self, s):
+        # The witness-counter table stands in for a dot slab: delta
+        # flavors only diff it for change detection.
+        return s.child.wctr
+
+
+ORSWOT = OrswotSlab()
+MAP_MVREG = MapMVRegSlab()
+
+
+class NestLevel:
+    """One application of the map-nesting induction step: wraps any
+    protocol-satisfying slab with one outer parked-keyset buffer. The
+    result satisfies the same protocol, so levels compose to any depth.
+
+    ``state_cls`` is any 4-field NamedTuple with positional layout
+    (core, dcl, dkeys, dvalid) — the concrete flavors pass their own
+    classes so their public state types stay stable."""
+
+    def __init__(self, core, state_cls=NestedState):
+        self.core = core
+        self.state_cls = state_cls
+
+    def _make(self, core_state, dcl, dkeys, dvalid):
+        return self.state_cls(core_state, dcl, dkeys, dvalid)
+
+    def _bufs(self, s):
+        return (s[1], s[2], s[3])
+
+    def empty(self, core_state, n_keys: int, n_actors: int,
+              deferred_cap: int, batch: tuple = ()):
+        """Wrap an (empty) core state with an empty outer buffer."""
+        return self._make(
+            core_state,
+            jnp.zeros((*batch, deferred_cap, n_actors), DTYPE),
+            jnp.zeros((*batch, deferred_cap, n_keys), bool),
+            jnp.zeros((*batch, deferred_cap), bool),
+        )
+
+    # ---- protocol -----------------------------------------------------
+
+    def keys_width(self, s):
+        return s[2].shape[-1]
+
+    def mult(self, s) -> int:
+        """Core keys per key of this level (the product-space factor)."""
+        return self.core.keys_width(s[0]) // self.keys_width(s)
+
+    def expand(self, s, mask):
+        """[..., K] mask at this level → core keyset-mask."""
+        return jnp.repeat(mask, self.mult(s), axis=-1)
+
+    def top(self, s):
+        return self.core.top(s[0])
+
+    def witness(self, s, actor, counter):
+        return self._make(self.core.witness(s[0], actor, counter), *self._bufs(s))
+
+    def alive(self, s):
+        ca = self.core.alive(s[0])
+        k = self.keys_width(s)
+        return jnp.any(ca.reshape(*ca.shape[:-1], k, -1), axis=-1)
+
+    def replay_keyset(self, s, dcl, dmask, dvalid):
+        return self._make(
+            self.core.replay_keyset(s[0], dcl, self.expand(s, dmask), dvalid),
+            *self._bufs(s),
+        )
+
+    def scrub_cols(self, s, cols, element_axis=None):
+        dkeys = s[2] & cols[..., None, :]
+        dvalid = s[3] & _any_slots(dkeys, element_axis)
+        core = self.core.scrub_cols(s[0], self.expand(s, cols), element_axis)
+        return self._make(
+            core,
+            jnp.where(dvalid[..., None], s[1], 0),
+            dkeys & dvalid[..., None],
+            dvalid,
+        )
+
+    def replay_outer(self, s):
+        """Replay this level's parked keyset-removes against the content
+        slab, then drop slots the top has caught up to (the oracle's
+        ``_apply_deferred``)."""
+        replayed = self.replay_keyset(s, s[1], s[2], s[3])
+        still = ~jnp.all(s[1] <= self.top(s)[..., None, :], axis=-1)
+        dvalid = s[3] & still
+        return self._make(
+            replayed[0],
+            jnp.where(dvalid[..., None], s[1], 0),
+            s[2] & dvalid[..., None],
+            dvalid,
+        )
+
+    def scrub_self(self, s, element_axis=None):
+        """A bottomed child (no live leaf dot in its block) is deleted
+        by the oracle together with ALL parked state inside it — at
+        every inner level. Core's own scrub runs FIRST: a replayed
+        remove at this level can newly bottom an inner child while this
+        level's block stays alive (tests/test_models_map3.py pins the
+        ordering). This level's own buffer is never self-scrubbed."""
+        core = self.core.scrub_self(s[0], element_axis)
+        s2 = self._make(core, *self._bufs(s))
+        cols = self.alive(s2)
+        core = self.core.scrub_cols(core, self.expand(s2, cols), element_axis)
+        return self._make(core, *self._bufs(s))
+
+    def settle_self(self, s, element_axis=None):
+        core = self.core.settle_self(s[0], element_axis)
+        out = self.replay_outer(self._make(core, *self._bufs(s)))
+        return self.scrub_self(out, element_axis)
+
+    def leaf_ctr(self, s):
+        return self.core.leaf_ctr(s[0])
+
+    def concat_bufs(self, a, b):
+        """Union two replicas' outer buffers (slot-list concatenation;
+        dedupe happens in ``settle_outer``)."""
+        return (
+            jnp.concatenate([a[1], b[1]], axis=-2),
+            jnp.concatenate([a[2], b[2]], axis=-2),
+            jnp.concatenate([a[3], b[3]], axis=-1),
+        )
+
+    def settle_outer(self, s, cap: int, element_axis=None):
+        """Settle this level's buffer after a union: dedupe equal-clock
+        slots (dict-union semantics) → replay against the content slab,
+        dropping caught-up slots → compact back to capacity (overflow if
+        a live slot won't fit) → scrub parked state inside bottomed
+        children. The ORDER is correctness-critical: the scrub must
+        follow the replay, because a replayed remove can newly bottom a
+        child (tests/test_models_map3.py pins the failure mode). Returns
+        ``(state, overflow)``."""
+        dcl, dkeys, dvalid = _dedupe_deferred(s[1], s[2], s[3])
+        s = self.replay_outer(self._make(s[0], dcl, dkeys, dvalid))
+        dcl, dkeys, dvalid, overflow = _compact_deferred(s[1], s[2], s[3], cap)
+        s = self.scrub_self(self._make(s[0], dcl, dkeys, dvalid), element_axis)
+        return s, jnp.any(overflow)
+
+    def join(self, a, b, element_axis=None):
+        """Pairwise lattice join: the core join plus this level's buffer
+        union → dedupe → replay → compact → scrub sequence
+        (``settle_outer`` holds the order). Returns ``(state,
+        flags[L+1])`` — core lanes first, this level last."""
+        core, core_flags = self.core.join(a[0], b[0], element_axis)
+        state = self._make(core, *self.concat_bufs(a, b))
+        state, of = self.settle_outer(state, a[1].shape[-2], element_axis)
+        return state, jnp.concatenate([core_flags, of[None]])
+
+    def fold(self, states, element_axis=None):
+        """Log-tree fold of a replica batch (leading axis)."""
+        from functools import partial
+
+        from .lattice import tree_fold
+
+        identity = jax.tree.map(
+            lambda x: jnp.zeros(x.shape[1:], x.dtype), states
+        )
+        return tree_fold(
+            states, identity, partial(self.join, element_axis=element_axis)
+        )
+
+    # ---- op application (CmRDT) --------------------------------------
+
+    def rm_parked(self, s, rm_clock, mask):
+        """``Op::Rm { clock, keyset }`` addressed to THIS level: kill
+        covered content now, park in this level's buffer if the clock is
+        ahead, scrub newly-bottomed children. Returns ``(s, overflow)``."""
+        rm_clock = jnp.asarray(rm_clock, self.top(s).dtype)
+        killed = self.replay_keyset(
+            s,
+            rm_clock[..., None, :],
+            mask[..., None, :],
+            jnp.ones(rm_clock.shape[:-1] + (1,), bool),
+        )
+        ahead = ~jnp.all(rm_clock <= self.top(s), axis=-1)
+        dcl, dkeys, dvalid, overflow = _park_remove(
+            s[1], s[2], s[3], rm_clock, mask, ahead
+        )
+        out = self.scrub_self(self._make(killed[0], dcl, dkeys, dvalid))
+        return out, overflow
+
+    def rm_route(self, s, levels_down: int, rm_clock, mask):
+        """Route a keyset-remove ``levels_down`` levels into the core
+        (0 = this level's own buffer). ``mask`` is already flattened to
+        the target level's key space."""
+        if levels_down == 0:
+            return self.rm_parked(s, rm_clock, mask)
+        core, overflow = self.core.rm_route(s[0], levels_down - 1, rm_clock, mask)
+        return self._make(core, *self._bufs(s)), overflow
+
+    def apply_up_rm(self, s, actor, counter, rm_clock, mask,
+                    levels_down: int, element_axis=None):
+        """``Op::Up^j { dot, …, op: Rm { clock, keyset } }`` — a
+        keyset-remove routed through ``j`` Up levels sharing one minted
+        dot: kill+park at the target level, witness the dot on the
+        shared top, settle every level, dup-drop the whole Up
+        (pure/map.py ``apply`` returns early on a seen dot). Returns
+        ``(s, overflow)``."""
+        counter = jnp.asarray(counter).astype(self.top(s).dtype)
+        seen = self.top(s)[..., actor] >= counter
+        rmed, overflow = self.rm_route(s, levels_down, rm_clock, mask)
+        out = self.settle_self(
+            self.witness(rmed, actor, counter), element_axis
+        )
+        bshape = lambda new: seen.reshape(
+            seen.shape + (1,) * (new.ndim - seen.ndim)
+        )
+        out = jax.tree.map(
+            lambda old, new: jnp.where(bshape(new), old, new), s, out
+        )
+        return out, overflow & ~seen
+
+    def cascade(self, s, new_core, element_axis=None):
+        """After a core-level op application (which witnessed its own
+        dot): replay this level's parked removes under the advanced top
+        and scrub newly-bottomed children."""
+        out = self.replay_outer(self._make(new_core, *self._bufs(s)))
+        return self.scrub_self(out, element_axis)

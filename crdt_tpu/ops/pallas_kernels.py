@@ -87,7 +87,22 @@ def _join_step(acc_top, acc_ctr, b_top, b_ctr):
     return _umax(acc_top, b_top), new_ctr
 
 
-def _fold_kernel(tops_ref, ctrs_ref, top_out_ref, ctr_out_ref):
+def _join_step_cells(acc_top, acc_ctr, b_top, b_ctr):
+    """Cell-granular dot join for the dense Map<K, MVReg> encoding: cell
+    (k, y) holds actor y's sole live witness counter at key k (the
+    per-(key, actor) uniqueness invariant — ``_map_to_dense``), so the
+    survival rule collapses per cell: same counter ⇒ same dot (keep);
+    else each side's counter survives only if the other side's top never
+    saw it — at most one side can win (y's counters are totally ordered
+    and each side's top covers its own dots). No cross-lane presence
+    term: absent is 0 and 0==0 keeps 0."""
+    wa = jnp.where(acc_ctr > b_top, acc_ctr, 0)
+    wb = jnp.where(b_ctr > acc_top, b_ctr, 0)
+    new_ctr = jnp.where(acc_ctr == b_ctr, acc_ctr, _umax(wa, wb))
+    return _umax(acc_top, b_top), new_ctr
+
+
+def _fold_kernel(tops_ref, ctrs_ref, top_out_ref, ctr_out_ref, *, join_step):
     """Lattice fold over one replica chunk, one E-tile per program.
     tops_ref: [RC, A, 1]; ctrs_ref: [RC, A, TILE_E], RC a power of two.
 
@@ -97,14 +112,18 @@ def _fold_kernel(tops_ref, ctrs_ref, top_out_ref, ctr_out_ref):
     vectors and the dependency chain is log2(RC) deep, not RC. The
     output block is the running accumulator across the (inner,
     sequential) replica-chunk grid axis; tree order equals sequential
-    order because the join is associative/commutative/idempotent."""
+    order because the join is associative/commutative/idempotent.
+
+    ``join_step`` picks the merge rule: the orswot element rule
+    (``_join_step``) or the cell-granular MVReg rule
+    (``_join_step_cells``)."""
     rc = ctrs_ref.shape[0]
     tops = tops_ref[:]
     ctrs = ctrs_ref[:]
     n = rc
     while n > 1:
         h = n // 2
-        tops, ctrs = _join_step(tops[h:n], ctrs[h:n], tops[:h], ctrs[:h])
+        tops, ctrs = join_step(tops[h:n], ctrs[h:n], tops[:h], ctrs[:h])
         n = h
     chunk_top, chunk_ctr = tops[0], ctrs[0]
 
@@ -117,7 +136,7 @@ def _fold_kernel(tops_ref, ctrs_ref, top_out_ref, ctr_out_ref):
 
     @pl.when(jnp.logical_not(first))
     def _acc():
-        acc_top, acc_ctr = _join_step(
+        acc_top, acc_ctr = join_step(
             top_out_ref[:], ctr_out_ref[:], chunk_top, chunk_ctr
         )
         top_out_ref[:] = acc_top
@@ -131,6 +150,7 @@ def _fold_entries_fused(
     r_chunk: int,
     interpret: bool,
     n_passes: int = 1,
+    cellwise: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fused fold of the entry matrices only: ``top[R, A]``,
     ``ctr[R, E, A]`` → ``(top[A], ctr[E, A])``.
@@ -140,7 +160,10 @@ def _fold_entries_fused(
     the join is idempotent the result is unchanged, but the DMA and
     compute stream is exactly that of folding ``n_passes * R`` distinct
     replicas — the honest way to time a config-3-scale stream whose full
-    dot-state exceeds HBM (bench.py), with one dispatch."""
+    dot-state exceeds HBM (bench.py), with one dispatch.
+
+    ``cellwise`` selects the cell-granular MVReg dot rule
+    (``_join_step_cells``) instead of the orswot element rule."""
     r, e, a = ctr.shape
     tile_e = min(tile_e, max(e, 1))
     rc = _pick_r_chunk(r, a, tile_e, r_chunk)  # clamped power of two
@@ -159,7 +182,10 @@ def _fold_entries_fused(
     r_steps = (r + pad_r) // rc
 
     top_t, ctr_t = pl.pallas_call(
-        _fold_kernel,
+        partial(
+            _fold_kernel,
+            join_step=_join_step_cells if cellwise else _join_step,
+        ),
         # Replica chunks on the inner (fastest) axis so the output block
         # accumulates across them before the E-tile advances.
         grid=(e_padded // tile_e, n_passes * r_steps),
@@ -204,6 +230,16 @@ def _pick_r_chunk(r: int, a: int, tile_e: int, r_chunk: Optional[int]) -> int:
     return 1 << (r_chunk.bit_length() - 1)
 
 
+def _fused_backend() -> bool:
+    """THE backend-dispatch decision, in one place: the fused Pallas
+    kernels run where they compile to Mosaic ("axon" is a TPU chip
+    behind a relay — same compile path); everywhere else "fused" would
+    mean the Pallas *interpreter*, orders of magnitude slower than
+    XLA:CPU. bench.py labels its reported path with this same predicate
+    so cross-round numbers stay comparable."""
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def fold_fused(
     states: OrswotState,
     tile_e: int = 512,
@@ -222,8 +258,7 @@ def fold_fused(
     ``n_passes * R`` replicas in one dispatch).
     """
     if interpret is None:
-        # "axon" is a TPU chip behind a relay (same Mosaic compile path).
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        interpret = not _fused_backend()
     r, e, a = states.ctr.shape
     tile_e = min(tile_e, max(e, 1))
     r_chunk = _pick_r_chunk(r, a, tile_e, r_chunk)
@@ -245,9 +280,7 @@ def fold_auto(states: OrswotState, prefer: str = "auto"):
 
     if prefer not in ("auto", "fused", "tree"):
         raise ValueError(f"prefer must be auto|fused|tree, got {prefer!r}")
-    if prefer == "fused" or (
-        prefer == "auto" and jax.default_backend() in ("tpu", "axon")
-    ):
+    if prefer == "fused" or (prefer == "auto" and _fused_backend()):
         return fold_fused(states)
     return tree_fold(states)
 
@@ -285,3 +318,245 @@ def _fold_fused_jit(
         OrswotState(top=top, ctr=ctr, dcl=dcl, dmask=dmask, dvalid=dvalid),
         jnp.any(overflow),
     )
+
+
+# ---- fused folds for the composition layer -------------------------------
+
+def _level_chain(level, states):
+    """(outermost-first wrapper list, leaf OrswotState) of a nested
+    orswot-leaf state."""
+    from .nest import NestLevel
+
+    chain, st = [], states
+    lv = level
+    while isinstance(lv, NestLevel):
+        chain.append((lv, st))
+        lv, st = lv.core, st[0]
+    return chain, st
+
+
+def fold_fused_level(
+    level,
+    states,
+    tile_e: int = 512,
+    r_chunk: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    element_axis=None,
+) -> Tuple[object, jax.Array]:
+    """Drop-in fused replacement for ``NestLevel.fold`` on any
+    orswot-leaf nested level (map_orswot, map3, deeper compositions):
+    the leaf entry slab folds in ONE Pallas HBM pass exactly as
+    ``fold_fused`` does, and every deferred-buffer level settles once in
+    a jnp epilogue — leaf member-removes first (flatten R·D slots →
+    dedupe → replay → drop caught-up → compact), then each keyset level
+    innermost-out through the level's own ``settle_outer`` (dedupe →
+    replay → compact → scrub). Same once-at-the-end soundness argument
+    as the plain fold (module docstring): replay is monotone, idempotent
+    zeroing and always precedes the catch-up drop; the per-level
+    property gates in tests/test_pallas_fold.py pin fused == tree.
+
+    Returns ``(state, flags[L])`` with ``NestLevel.fold``'s lane order
+    (innermost level first)."""
+    if interpret is None:
+        interpret = not _fused_backend()
+    _, leaf = _level_chain(level, states)
+    if isinstance(leaf, OrswotState):
+        r, e, a = leaf.ctr.shape
+    else:  # the Map<K, MVReg> leaf: dense cells are [R, K, A]
+        r, e, _ = leaf.child.wact.shape
+        a = leaf.top.shape[-1]
+    tile_e = min(tile_e, max(e, 1))
+    r_chunk = _pick_r_chunk(r, a, tile_e, r_chunk)
+    return _fold_fused_level_jit(
+        level, states, tile_e, r_chunk, interpret, element_axis
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("level", "tile_e", "r_chunk", "interpret", "element_axis"),
+)
+def _fold_fused_level_jit(
+    level, states, tile_e, r_chunk, interpret, element_axis=None
+):
+    chain, leaf = _level_chain(level, states)
+    if isinstance(leaf, OrswotState):
+        folded_leaf, leaf_of = _fold_fused_jit(leaf, tile_e, r_chunk, interpret)
+    else:  # the Map<K, MVReg> leaf (map_map family)
+        folded_leaf, leaf_of = _fold_fused_map_jit(
+            leaf, tile_e, r_chunk, interpret
+        )
+
+    folded = folded_leaf
+    flags = [jnp.atleast_1d(leaf_of)]
+    for lv, bst in reversed(chain):  # innermost wrapper first
+        d = bst[1].shape[-2]
+        flat = lambda x: x.reshape((-1,) + x.shape[2:])
+        wrapped = lv._make(folded, flat(bst[1]), flat(bst[2]), flat(bst[3]))
+        wrapped, of = lv.settle_outer(wrapped, d, element_axis)
+        folded = wrapped
+        flags.append(of[None])
+    return folded, jnp.concatenate(flags)
+
+
+def _map_to_dense(child):
+    """Slot table ``MVRegState [R, K, S…]`` → dense per-(key, actor)
+    arrays (wctr [R, K, A], val [R, K, A], clk [R, K, A, A]).
+
+    Sound because a key holds at most one live sibling per actor: a
+    later write by the same actor carries a clock ≥ its earlier write's
+    (actor knowledge is monotone), so apply-time domination evicts the
+    older one, and the merge survival rule kills the smaller counter
+    against the witnessing side's top (``_join_step_cells``). The A/B
+    suite pins the round-trip on every reachable state."""
+    r, k, s = child.wact.shape
+    a = child.clk.shape[-1]
+    br = jnp.arange(r)[:, None, None]
+    bk = jnp.arange(k)[None, :, None]
+    act = jnp.where(child.valid, child.wact, 0)
+    live = child.valid
+    wctr = jnp.zeros((r, k, a), child.wctr.dtype).at[br, bk, act].max(
+        jnp.where(live, child.wctr, 0)
+    )
+    # val ids are ≥ 0; shift by one so "absent" is distinguishable.
+    val1 = jnp.zeros((r, k, a), jnp.uint32).at[br, bk, act].max(
+        jnp.where(live, child.val.astype(jnp.uint32) + 1, 0)
+    )
+    clk = jnp.zeros((r, k, a, a), child.clk.dtype).at[br, bk, act].max(
+        jnp.where(live[..., None], child.clk, 0)
+    )
+    return wctr, val1, clk
+
+
+def _dense_to_slots(wctr, val1, clk):
+    """Dense per-(key, actor) arrays (unbatched: [K, A]…) → slot table
+    with S′ = A slots (no truncation — capacity is checked by the caller
+    AFTER parked-remove replay, matching the tree join's
+    transient-overflow semantics)."""
+    from .mvreg import MVRegState
+
+    k, a = wctr.shape
+    present = wctr > 0
+    # Canonical slot order (ops/map._canon_child): valid first, then by
+    # actor (unique per key, so no further tiebreak needed).
+    order = jnp.argsort(~present, axis=-1, stable=True)  # actor ids stable
+    take = lambda x: jnp.take_along_axis(x, order, axis=-1)
+    valid = take(present)
+    return MVRegState(
+        wact=jnp.where(valid, take(jnp.broadcast_to(jnp.arange(a), (k, a))), 0),
+        wctr=jnp.where(valid, take(wctr), 0),
+        clk=jnp.where(
+            valid[..., None],
+            jnp.take_along_axis(clk, order[..., None], axis=-2),
+            0,
+        ),
+        val=jnp.where(valid, take(val1).astype(jnp.int32) - 1, 0),
+        valid=valid,
+    )
+
+
+def fold_fused_map(
+    states,
+    tile_e: int = 512,
+    r_chunk: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[object, jax.Array]:
+    """Fused fold for ``Map<K, MVReg>`` (``ops.map.MapState``) — the
+    config-4 hot loop in one streamed HBM pass.
+
+    The slot tables convert to a dense per-(key, actor) witness-counter
+    slab (``_map_to_dense``), whose replica fold is the cell-granular
+    dot rule — the Pallas kernel with ``_join_step_cells``. Payload
+    (val, clk) follows the surviving counter by a winner-select
+    reduction in the jnp epilogue, then the parked keyset-removes replay
+    once on the A-wide decoded table BEFORE the sibling-capacity check
+    (the tree join's transient-overflow semantics). Returns
+    ``(state, overflow[2])`` like ``ops.map.fold``."""
+    if interpret is None:
+        interpret = not _fused_backend()
+    r, k, s = states.child.wact.shape
+    a = states.top.shape[-1]
+    tile_e = min(tile_e, max(k, 1))
+    r_chunk = _pick_r_chunk(r, a, tile_e, r_chunk)
+    return _fold_fused_map_jit(states, tile_e, r_chunk, interpret)
+
+
+@partial(jax.jit, static_argnames=("tile_e", "r_chunk", "interpret"))
+def _fold_fused_map_jit(states, tile_e, r_chunk, interpret):
+    from . import map as map_ops
+
+    r, k, s = states.child.wact.shape
+    a = states.top.shape[-1]
+    wctr, val1, clk = _map_to_dense(states.child)
+
+    top, folded_wctr = _fold_entries_fused(
+        states.top, wctr, tile_e, r_chunk, interpret, cellwise=True
+    )
+
+    # Winner-select payload: the surviving counter's replica supplies
+    # val and clk (ties ⇒ same dot ⇒ same payload, max is safe).
+    match = (wctr == folded_wctr[None]) & (folded_wctr[None] > 0)
+    val1 = jnp.max(jnp.where(match, val1, 0), axis=0)
+    clk = jnp.max(jnp.where(match[..., None], clk, 0), axis=0)
+
+    child = _dense_to_slots(folded_wctr, val1, clk)
+
+    # Parked keyset-removes: union → dedupe → replay on the A-wide table
+    # → drop caught-up → compact, then the sibling-capacity check.
+    d = states.dcl.shape[-2]
+    dcl = states.dcl.reshape(r * d, a)
+    dkeys = states.dkeys.reshape(r * d, k)
+    dvalid = states.dvalid.reshape(r * d)
+    dcl, dkeys, dvalid = _dedupe_deferred(dcl, dkeys, dvalid)
+    tmp = map_ops.MapState(
+        top=top, child=child, dcl=dcl, dkeys=dkeys, dvalid=dvalid
+    )
+    tmp = map_ops._drop_stale_deferred(map_ops._apply_parked(tmp))
+    dcl, dkeys, dvalid, d_of = _compact_deferred(
+        tmp.dcl, tmp.dkeys, tmp.dvalid, d
+    )
+
+    child = map_ops._canon_child(tmp.child)
+    c_of = jnp.any(jnp.sum(child.valid, axis=-1) > s)
+    # Back to the slot capacity: truncate (A > S) or zero-pad (A < S) —
+    # canonical form keeps dead slots zeroed either way.
+    def fit(x):
+        axis = -2 if x.ndim == child.clk.ndim else -1
+        cur = x.shape[axis]
+        if cur >= s:
+            return x[..., :s, :] if axis == -2 else x[..., :s]
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (0, s - cur)
+        return jnp.pad(x, pad)
+
+    child = jax.tree.map(fit, child)
+    return (
+        map_ops.MapState(
+            top=top, child=child, dcl=dcl, dkeys=dkeys, dvalid=dvalid
+        ),
+        jnp.stack([c_of, jnp.any(d_of)]),
+    )
+
+
+def fold_auto_level(level, states, prefer: str = "auto", element_axis=None):
+    """Backend-appropriate fold dispatch for the nested family — the
+    ``fold_auto`` of composed slabs: the fused Pallas path where it
+    compiles to Mosaic (TPU backends), the jnp log-tree fold elsewhere.
+    Same ``(state, flags)`` contract as ``NestLevel.fold``."""
+    if prefer not in ("auto", "fused", "tree"):
+        raise ValueError(f"prefer must be auto|fused|tree, got {prefer!r}")
+    if prefer == "fused" or (prefer == "auto" and _fused_backend()):
+        return fold_fused_level(level, states, element_axis=element_axis)
+    return level.fold(states, element_axis)
+
+
+def fold_auto_map(states, prefer: str = "auto"):
+    """Backend-appropriate fold dispatch for ``Map<K, MVReg>`` replica
+    batches; same contract as ``ops.map.fold``."""
+    from .map import _tree_fold
+
+    if prefer not in ("auto", "fused", "tree"):
+        raise ValueError(f"prefer must be auto|fused|tree, got {prefer!r}")
+    if prefer == "fused" or (prefer == "auto" and _fused_backend()):
+        return fold_fused_map(states)
+    return _tree_fold(states)

@@ -236,10 +236,19 @@ def mesh_delta_gossip(
 
     ``dirty [R, E]`` / ``fctx [R, E, A]`` come from
     ``interval_accumulate`` tracking since the replicas last synced.
-    With ``rounds`` = P-1 (default) and ``cap`` covering the per-device
-    dirty load, every device row equals the full join; residue past
-    ``cap`` drains with extra rounds (forwarding hops add rounds too:
-    budget P-1 ring latencies of the backlog).
+
+    ROUNDS BUDGET — read this before trusting the default: ``rounds`` =
+    P-1 (default) guarantees convergence only when ``cap`` covers each
+    device's dirty backlog every round. If the backlog exceeds ``cap``,
+    residue drains over EXTRA rounds (round-robin, no loss) and each
+    forwarding hop needs its own ring latency — budget
+    ``(P-1) * (1 + ceil(backlog / cap))`` rounds for a capped drain.
+    There is NO runtime convergence signal for an under-budgeted run:
+    ``overflow`` stays False (it flags the parked-remove buffer, not
+    residue) and the returned ``dirty`` mask is noisy with domain-
+    forwarding re-marks, so it cannot be read as "rows still out of
+    sync". The cap-independence property tests (test_delta*.py) pin the
+    budget formula; when in doubt, pass explicit ``rounds``.
 
     Returns ``(states [P, ...], dirty [P, E], overflow)`` — overflow is
     the deferred-buffer flag, as in ``mesh_gossip``."""

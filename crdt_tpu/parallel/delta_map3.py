@@ -1,12 +1,12 @@
 """δ-state anti-entropy for ``Map<K1, Map<K2, Orswot<M>>>`` — the
-delta induction applied once more: the depth-3 state is the map_orswot
-delta machinery on its flat ``mo`` slab (cells over K1×K2×M) plus the
-K1-level parked keyset buffer riding whole, settled through the shared
-outer-level sequence and scrubbed at (K1,K2) and K1 granularity exactly
-as ops/map3.join does."""
+δ induction (``delta_nest.nested_delta``) applied once more: the
+depth-3 flavor is the map_orswot delta machinery on the flat ``mo``
+slab (cells over K1×K2×M) plus the K1-level parked keyset buffer riding
+whole, settled and scrubbed exactly as ops/map3.join does."""
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -15,13 +15,13 @@ from jax.sharding import Mesh
 
 from ..ops import map3 as m3_ops
 from ..ops.map3 import Map3State
-from ..ops.outer_level import concat_outer, settle_outer_level
-from .delta import close_top_orswot, interval_accumulate
+from .delta import interval_accumulate
 from .delta_map_orswot import (
     MapOrswotDeltaPacket,
     apply_delta_mo,
     extract_delta_mo,
 )
+from .delta_nest import close_top_nested, nested_delta
 from .mesh import ELEMENT_AXIS, REPLICA_AXIS, map3_specs, pad_map3
 
 
@@ -41,56 +41,12 @@ def interval_accumulate_m3(
     return interval_accumulate(dirty, fctx, old.mo.core, new.mo.core)
 
 
-def extract_delta_m3(
-    state: Map3State, dirty: jax.Array, fctx: jax.Array, cap: int, start=0
-) -> Tuple[Map3DeltaPacket, jax.Array, jax.Array]:
-    mo_pkt, dirty, fctx = extract_delta_mo(state.mo, dirty, fctx, cap, start)
-    return (
-        Map3DeltaPacket(
-            mo=mo_pkt,
-            odcl=state.odcl,
-            odkeys=state.odkeys,
-            odvalid=state.odvalid,
-        ),
-        dirty,
-        fctx,
-    )
-
-
-def apply_delta_m3(
-    state: Map3State,
-    pkt: Map3DeltaPacket,
-    dirty: jax.Array,
-    fctx: jax.Array,
-    element_axis=None,
-):
-    """mo-delta apply on the flat slab, then the K1 buffer settle and
-    dead-K1 scrub. Returns ``(state, dirty, fctx, overflow[3])``."""
-    mo, dirty, fctx, mo_of = apply_delta_mo(
-        state.mo, pkt.mo, dirty, fctx, element_axis=element_axis
-    )
-
-    before = mo.core.ctr
-    st = Map3State(
-        mo,
-        *concat_outer(
-            (state.odcl, state.odkeys, state.odvalid),
-            (pkt.odcl, pkt.odkeys, pkt.odvalid),
-        ),
-    )
-    st, outer_of = settle_outer_level(
-        st,
-        state.odcl.shape[-2],
-        get_bufs=lambda s: (s.odcl, s.odkeys, s.odvalid),
-        with_bufs=lambda s, cl, ks, v: s._replace(odcl=cl, odkeys=ks, odvalid=v),
-        replay=m3_ops._replay_outer,
-        scrub=m3_ops._scrub_dead1,
-        element_axis=element_axis,
-    )
-    replay_changed = jnp.any(st.mo.core.ctr != before, axis=-1)
-    dirty = dirty | replay_changed
-    fctx = jnp.maximum(fctx, jnp.where(replay_changed[:, None], before, 0))
-    return st, dirty, fctx, jnp.stack([mo_of[0], mo_of[1], outer_of])
+extract_delta_m3, apply_delta_m3 = nested_delta(
+    m3_ops.LEVEL,
+    extract_delta_mo,
+    apply_delta_mo,
+    packet_cls=Map3DeltaPacket,
+)
 
 
 def mesh_delta_gossip_map3(
@@ -102,11 +58,11 @@ def mesh_delta_gossip_map3(
     cap: int = 64,
 ):
     """Ring δ anti-entropy for depth-3 map replica batches (see
-    delta.mesh_delta_gossip for semantics and budgeting). ``dirty`` /
-    ``fctx`` are at leaf (k1, k2, member) cell granularity. Returns
+    delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET warning:
+    the P-1 default silently under-converges when the backlog exceeds
+    ``cap``, with no runtime signal). ``dirty`` / ``fctx`` are at leaf
+    (k1, k2, member) cell granularity. Returns
     ``(states [P, ...], dirty, overflow[3])``."""
-    from functools import partial
-
     from .delta_ring import run_delta_ring
 
     state = pad_map3(state, mesh.shape[REPLICA_AXIS], mesh.shape[ELEMENT_AXIS])
@@ -115,22 +71,14 @@ def mesh_delta_gossip_map3(
     dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_e)))
     fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_e), (0, 0)))
 
-    def close_top(folded: Map3State, top: jax.Array) -> Map3State:
-        core = close_top_orswot(folded.mo.core, top)
-        mo = folded.mo._replace(core=core)
-        # K2-level replay drops its caught-up slots; then the K1 level.
-        from ..ops import map_orswot as mo_ops
-
-        mo = mo_ops._replay_outer(mo)
-        st = m3_ops._replay_outer(folded._replace(mo=mo))
-        return m3_ops._scrub_dead1(st, element_axis=ELEMENT_AXIS)
-
     return run_delta_ring(
         "map3_delta_gossip", state, dirty, fctx, mesh, rounds, cap,
         specs=map3_specs(),
         local_fold=partial(m3_ops.fold, element_axis=ELEMENT_AXIS),
         extract=extract_delta_m3,
         apply_fn=partial(apply_delta_m3, element_axis=ELEMENT_AXIS),
-        close_top=close_top,
+        close_top=partial(
+            close_top_nested, m3_ops.LEVEL, element_axis=ELEMENT_AXIS
+        ),
         top_of=lambda s: s.mo.core.top,
     )

@@ -6,7 +6,8 @@ remove buffer (ops/map_orswot.py). The delta packet is therefore
 delta.py's (element row, per-row context) machinery on the core — rows
 at (key, member) granularity — with the outer parked keyset buffer
 riding whole next to the leaf buffer, replayed and dead-key-scrubbed at
-apply time exactly as ``mo_ops.join`` does.
+apply time exactly as ``mo_ops.join`` does. The wrapping itself is one
+application of ``delta_nest.nested_delta`` (the δ induction step).
 
 Tracking contract as in delta.py (op granularity): an inner add/rm
 marks its (key, member) rows; an outer keyset-remove marks the key's
@@ -15,6 +16,7 @@ whole row block with its (key-scoped) clock.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -23,14 +25,13 @@ from jax.sharding import Mesh
 
 from ..ops import map_orswot as mo_ops
 from ..ops.map_orswot import MapOrswotState
-from ..ops.outer_level import concat_outer, settle_outer_level
 from .delta import (
     DeltaPacket,
     apply_delta,
-    close_top_orswot,
     extract_delta,
     interval_accumulate,
 )
+from .delta_nest import close_top_nested, nested_delta
 from .mesh import ELEMENT_AXIS, REPLICA_AXIS, map_orswot_specs, pad_map_orswot
 
 
@@ -52,60 +53,12 @@ def interval_accumulate_mo(
     return interval_accumulate(dirty, fctx, old.core, new.core)
 
 
-def extract_delta_mo(
-    state: MapOrswotState, dirty: jax.Array, fctx: jax.Array, cap: int, start=0
-) -> Tuple[MapOrswotDeltaPacket, jax.Array, jax.Array]:
-    core_pkt, dirty, fctx = extract_delta(state.core, dirty, fctx, cap, start)
-    return (
-        MapOrswotDeltaPacket(
-            core=core_pkt,
-            kdcl=state.kdcl,
-            kdkeys=state.kdkeys,
-            kdvalid=state.kdvalid,
-        ),
-        dirty,
-        fctx,
-    )
-
-
-def apply_delta_mo(
-    state: MapOrswotState,
-    pkt: MapOrswotDeltaPacket,
-    dirty: jax.Array,
-    fctx: jax.Array,
-    element_axis=None,
-):
-    """Core row-join via delta.apply_delta, then the outer keyset level:
-    union/replay/compact the kd buffer (mo_ops' settle semantics) and
-    scrub parked state inside bottomed keys. Returns
-    ``(state, dirty, fctx, overflow[2])`` — [inner, outer] as in
-    mo_ops.join."""
-    core, dirty, fctx, inner_of = apply_delta(state.core, pkt.core, dirty, fctx)
-
-    before = core.ctr
-    st = MapOrswotState(
-        core,
-        *concat_outer(
-            (state.kdcl, state.kdkeys, state.kdvalid),
-            (pkt.kdcl, pkt.kdkeys, pkt.kdvalid),
-        ),
-    )
-    st, outer_of = settle_outer_level(
-        st,
-        state.kdcl.shape[-2],
-        get_bufs=lambda s: (s.kdcl, s.kdkeys, s.kdvalid),
-        with_bufs=lambda s, cl, ks, v: s._replace(kdcl=cl, kdkeys=ks, kdvalid=v),
-        replay=mo_ops._replay_outer,
-        scrub=mo_ops._scrub_dead_keys,
-        element_axis=element_axis,
-    )
-    # Rows the outer replay killed forward their pre-replay knowledge
-    # (the delta.py invariant); the kd slots themselves ride every
-    # packet, so the removal clocks propagate regardless.
-    replay_changed = jnp.any(st.core.ctr != before, axis=-1)
-    dirty = dirty | replay_changed
-    fctx = jnp.maximum(fctx, jnp.where(replay_changed[:, None], before, 0))
-    return st, dirty, fctx, jnp.stack([jnp.any(inner_of), outer_of])
+extract_delta_mo, apply_delta_mo = nested_delta(
+    mo_ops.LEVEL,
+    extract_delta,
+    lambda s, p, d, f, element_axis=None: apply_delta(s, p, d, f),
+    packet_cls=MapOrswotDeltaPacket,
+)
 
 
 def mesh_delta_gossip_map_orswot(
@@ -117,11 +70,11 @@ def mesh_delta_gossip_map_orswot(
     cap: int = 64,
 ):
     """Ring δ anti-entropy for Map<K, Orswot> replica batches (see
-    delta.mesh_delta_gossip for semantics and budgeting). ``dirty`` /
-    ``fctx`` are at (key, member) cell granularity over K×M. Returns
+    delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET warning:
+    the P-1 default silently under-converges when the backlog exceeds
+    ``cap``, with no runtime signal). ``dirty`` / ``fctx`` are at
+    (key, member) cell granularity over K×M. Returns
     ``(states [P, ...], dirty, overflow[2])``."""
-    from functools import partial
-
     from .delta_ring import run_delta_ring
 
     state = pad_map_orswot(
@@ -132,19 +85,14 @@ def mesh_delta_gossip_map_orswot(
     dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_e)))
     fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_e), (0, 0)))
 
-    def close_top(folded: MapOrswotState, top: jax.Array) -> MapOrswotState:
-        core = close_top_orswot(folded.core, top)
-        # _replay_outer also drops outer slots the new top caught up to;
-        # slot liveness must stay replicated across element shards.
-        st = mo_ops._replay_outer(folded._replace(core=core))
-        return mo_ops._scrub_dead_keys(st, element_axis=ELEMENT_AXIS)
-
     return run_delta_ring(
         "map_orswot_delta_gossip", state, dirty, fctx, mesh, rounds, cap,
         specs=map_orswot_specs(),
         local_fold=partial(mo_ops.fold, element_axis=ELEMENT_AXIS),
         extract=extract_delta_mo,
         apply_fn=partial(apply_delta_mo, element_axis=ELEMENT_AXIS),
-        close_top=close_top,
+        close_top=partial(
+            close_top_nested, mo_ops.LEVEL, element_axis=ELEMENT_AXIS
+        ),
         top_of=lambda s: s.core.top,
     )

@@ -1,0 +1,98 @@
+"""The δ-state induction step, as code — the delta-side twin of
+``ops.nest.NestLevel``.
+
+Every nesting level wraps the core's delta machinery the same way:
+packets gain the level's whole (bounded) parked-keyset buffer, apply
+joins the core delta then settles the level's buffer (union → dedupe →
+replay → compact → scrub), and rows the level's replay killed forward
+their pre-replay knowledge (the delta.py invariant). Through round 3
+that was two hand-written flavors (delta_map_orswot.py, delta_map3.py)
+that had to be patched in lockstep (commit 8025404 touched all delta
+files at once — the hazard the combinator removes). Depth N needs no
+new flavor: ``nested_delta(level, *nested_delta(inner, leaf_extract,
+leaf_apply))`` composes, and the depth-4 gate in
+tests/test_nest_depth4.py runs exactly that.
+
+Only orswot-leaf chains close generically (``close_top_nested`` ends in
+delta.close_top_orswot); the Map<K, MVReg> leaf flavor (delta_map.py)
+has slot-table packets and its own closure — it is a *leaf*, not an
+induction instance.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.nest import NestLevel
+from .delta import close_top_orswot
+
+
+class NestedDeltaPacket(NamedTuple):
+    """The core's delta packet + one level's parked-keyset buffer riding
+    whole (bounded). Concrete flavors may substitute their own 4-field
+    class (same positional layout) to keep public packet types stable."""
+
+    core: Any
+    dcl: jax.Array    # [D, A]
+    dkeys: jax.Array  # [D, K]
+    dvalid: jax.Array # [D]
+
+
+def nested_delta(
+    level: NestLevel,
+    core_extract: Callable,
+    core_apply: Callable,
+    packet_cls=NestedDeltaPacket,
+) -> Tuple[Callable, Callable]:
+    """Wrap a core (extract, apply) delta pair with one nesting level.
+    ``core_apply`` must accept ``(state, pkt, dirty, fctx,
+    element_axis=None)``; adapt leaf appliers with a lambda. Returns the
+    level's ``(extract, apply)`` pair with the same signatures, so the
+    construction composes to any depth."""
+
+    def extract(state, dirty, fctx, cap, start=0):
+        core_pkt, dirty, fctx = core_extract(state[0], dirty, fctx, cap, start)
+        return packet_cls(core_pkt, state[1], state[2], state[3]), dirty, fctx
+
+    def apply_fn(state, pkt, dirty, fctx, element_axis=None):
+        core, dirty, fctx, core_of = core_apply(
+            state[0], pkt[0], dirty, fctx, element_axis
+        )
+
+        before = level.core.leaf_ctr(core)
+        st = level._make(core, *level.concat_bufs(state, pkt))
+        st, outer_of = level.settle_outer(
+            st, state[1].shape[-2], element_axis
+        )
+        # Rows this level's replay killed forward their pre-replay
+        # knowledge (the delta.py invariant); the parked slots
+        # themselves ride every packet, so the removal clocks propagate
+        # regardless.
+        after = level.leaf_ctr(st)
+        replay_changed = jnp.any(after != before, axis=-1)
+        dirty = dirty | replay_changed
+        fctx = jnp.maximum(
+            fctx, jnp.where(replay_changed[:, None], before, 0)
+        )
+        return st, dirty, fctx, jnp.concatenate(
+            [jnp.atleast_1d(core_of), outer_of[None]]
+        )
+
+    return extract, apply_fn
+
+
+def close_top_nested(level, folded, top, element_axis=None):
+    """Adopt the mesh-wide top and re-replay parked removes at EVERY
+    level, innermost first, then scrub (delta_ring documents why the
+    closure is needed and sound). Orswot-leaf chains only."""
+
+    def rec(lv, s):
+        if isinstance(lv, NestLevel):
+            core = rec(lv.core, s[0])
+            return lv.replay_outer(lv._make(core, s[1], s[2], s[3]))
+        return close_top_orswot(s, top)
+
+    return level.scrub_self(rec(level, folded), element_axis)

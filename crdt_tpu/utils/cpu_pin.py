@@ -23,8 +23,10 @@ def pin_cpu(virtual_devices: int | None = None) -> None:
     """Force the CPU backend, optionally with N virtual devices.
 
     ``virtual_devices`` sets ``--xla_force_host_platform_device_count``
-    in XLA_FLAGS, replacing any count inherited from a parent process
-    (multihost worker processes want their own per-process count).
+    in XLA_FLAGS, REPLACING any count inherited from the environment or a
+    parent process (multihost worker processes want their own per-process
+    count, and the test suite pins exactly 8 — run with
+    ``virtual_devices=None`` to keep a caller-supplied XLA_FLAGS count).
     """
     if virtual_devices:
         flags = re.sub(

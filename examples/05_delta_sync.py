@@ -84,7 +84,13 @@ def main():
             np.testing.assert_array_equal(np.asarray(g)[row], np.asarray(f))
 
     full_bytes = model.state.ctr.nbytes // 8  # one replica's row slab
-    pkt_bytes = cap * (a * 4 * 2 + 4 + 1)     # rows + ctxs + idx + valid
+    d = model.state.dcl.shape[-2]
+    # Real device bytes throughout (bool masks are 1 byte/element on
+    # device; a bitpacked wire encoding would divide the dmask term by 8).
+    pkt_bytes = (
+        cap * (a * 4 * 2 + 4 + 1)  # rows + ctxs + idx + valid
+        + d * (a * 4 + e + 1)      # parked removes ride whole: dcl + dmask + dvalid
+    )
     print(
         f"{n_dirty} dirty rows of {dirty.size}; delta packet ≈ "
         f"{pkt_bytes/1024:.1f} KiB per link per round vs "

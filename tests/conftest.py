@@ -1,6 +1,11 @@
 """Test config: force JAX onto CPU with 8 virtual devices so the multi-chip
 sharding paths (crdt_tpu.parallel) compile and run without TPU hardware.
 
+NOTE: this OVERRIDES any ``--xla_force_host_platform_device_count`` you
+set in XLA_FLAGS — the suite's mesh-shape tests assume exactly 8 virtual
+devices. Edit the ``pin_cpu(virtual_devices=8)`` call below if you need a
+different count.
+
 The pin-CPU / drop-axon-backend recipe (and why env vars alone are not
 enough on this image) lives in ``crdt_tpu.utils.cpu_pin``.
 """

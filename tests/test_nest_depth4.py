@@ -1,0 +1,478 @@
+"""Depth-4 ``Map<K1, Map<K2, Map<K3, Orswot<M>>>>`` vs the oracle — the
+gate that the nesting induction (ops/nest.py) actually CLOSES: depth 4
+is built here by composing ``NestLevel`` around the depth-3 level, with
+NO new ops module (reference: src/map.rs arbitrary ``V: Val<A>`` depth).
+
+The device state is ``NestedState(core=Map3State, ...)`` where the
+Map3State's key spaces are products: mo over K1·K2·K3 keys of M members,
+K3-level buffer over K1·K2·K3, K2-level buffer over K1·K2, and the new
+K1-level buffer over K1. Conversions are lossless across all FOUR
+deferred levels, so the A/B gates here are exact equality with the pure
+nested-Map oracle, like the depth-2/3 gates in test_models_map_nested.py
+and test_models_map3.py."""
+
+import random
+
+import numpy as np
+from hypothesis import given, settings
+
+import jax
+import jax.numpy as jnp
+
+from crdt_tpu import Map, Orswot, VClock
+from crdt_tpu.ctx import RmCtx
+from crdt_tpu.ops import map3 as m3_ops
+from crdt_tpu.ops.nest import NestedState, NestLevel
+from crdt_tpu.utils import Interner
+from crdt_tpu.vclock import VClock as VC
+
+from strategies import ACTORS, seeds
+
+KEYS1 = list("pq")
+KEYS2 = list("uv")
+KEYS3 = list("gh")
+MEMBERS = list("xy")
+ALL_ACTORS = ACTORS[:3]
+
+K1, K2, K3, M = len(KEYS1), len(KEYS2), len(KEYS3), len(MEMBERS)
+A = len(ALL_ACTORS)
+D = 12  # deferred cap at every level
+
+LEVEL4 = NestLevel(m3_ops.LEVEL)  # depth 4 = one more induction step
+
+
+def empty4(batch=()):
+    return LEVEL4.empty(
+        m3_ops.empty(K1 * K2, K3, M, A, D, batch=batch), K1, A, D, batch
+    )
+
+
+# jitted entry points built ONLY from the generic level
+_join4 = jax.jit(LEVEL4.join, static_argnames=("element_axis",))
+_rm_parked4 = jax.jit(LEVEL4.rm_parked)
+_up_rm4 = jax.jit(LEVEL4.apply_up_rm, static_argnames=("levels_down",))
+
+
+def map4():
+    return Map(
+        val_default=lambda: Map(
+            val_default=lambda: Map(val_default=Orswot)
+        )
+    )
+
+
+# ---- oracle op minting (one AddCtx, one dot through all levels) ----------
+
+def d4add(m, actor, k1, k2, k3, member):
+    ctx = m.len().derive_add_ctx(actor)
+    op = m.update(
+        k1, ctx, lambda c2, c: c2.update(
+            k2, c, lambda c3, cc: c3.update(
+                k3, cc, lambda s, c3x: s.add(member, c3x)
+            )
+        )
+    )
+    m.apply(op)
+    return op
+
+
+def d4rm(m, actor, k1, k2, k3, member):
+    lvl2 = m.entries.get(k1)
+    lvl3 = lvl2.entries.get(k2) if lvl2 is not None else None
+    leaf = lvl3.entries.get(k3) if lvl3 is not None else None
+    rm_ctx = (
+        leaf.contains(member).derive_rm_ctx()
+        if leaf is not None
+        else RmCtx(clock=VClock())
+    )
+    ctx = m.len().derive_add_ctx(actor)
+    op = m.update(
+        k1, ctx, lambda c2, c: c2.update(
+            k2, c, lambda c3, cc: c3.update(
+                k3, cc, lambda s, c3x: s.rm(member, rm_ctx)
+            )
+        )
+    )
+    m.apply(op)
+    return op
+
+
+def d4drop3(m, actor, k1, k2, k3):
+    lvl2 = m.entries.get(k1)
+    lvl3 = lvl2.entries.get(k2) if lvl2 is not None else None
+    rm_ctx = (
+        lvl3.get(k3).derive_rm_ctx()
+        if lvl3 is not None
+        else RmCtx(clock=VClock())
+    )
+    ctx = m.len().derive_add_ctx(actor)
+    op = m.update(
+        k1, ctx, lambda c2, c: c2.update(k2, c, lambda c3, cc: c3.rm(k3, rm_ctx))
+    )
+    m.apply(op)
+    return op
+
+
+def d4drop2(m, actor, k1, k2):
+    lvl2 = m.entries.get(k1)
+    rm_ctx = (
+        lvl2.get(k2).derive_rm_ctx()
+        if lvl2 is not None
+        else RmCtx(clock=VClock())
+    )
+    ctx = m.len().derive_add_ctx(actor)
+    op = m.update(k1, ctx, lambda c2, c: c2.rm(k2, rm_ctx))
+    m.apply(op)
+    return op
+
+
+def d4drop1(m, k1):
+    op = m.rm(k1, m.get(k1).derive_rm_ctx())
+    m.apply(op)
+    return op
+
+
+# ---- lossless encode/decode (the A/B boundary) ---------------------------
+
+ACT = Interner(ALL_ACTORS)
+IK1, IK2, IK3, IM = (
+    Interner(KEYS1), Interner(KEYS2), Interner(KEYS3), Interner(MEMBERS)
+)
+
+
+def _clock_vec(clock: VC) -> np.ndarray:
+    v = np.zeros((A,), np.uint32)
+    for actor, c in clock.dots.items():
+        v[ACT.id_of(actor)] = c
+    return v
+
+
+def _vec_clock(v) -> VC:
+    return VC({ALL_ACTORS[a]: int(c) for a, c in enumerate(np.asarray(v)) if c})
+
+
+def encode(pures):
+    """Pure nested maps → one batched depth-4 device state (all four
+    deferred levels carried)."""
+    r = len(pures)
+    st = empty4(batch=(r,))
+    top = np.zeros((r, A), np.uint32)
+    ctr = np.zeros((r, K1 * K2 * K3 * M, A), np.uint32)
+    bufs = {
+        lvl: (
+            np.zeros((r, D, A), np.uint32),
+            np.zeros((r, D, w), bool),
+            np.zeros((r, D), bool),
+        )
+        for lvl, w in (
+            ("leaf", K1 * K2 * K3 * M), ("k3", K1 * K2 * K3),
+            ("k2", K1 * K2), ("k1", K1),
+        )
+    }
+
+    def park(i, lvl, parked, index_of):
+        cl, ks, va = bufs[lvl]
+        used = {}
+        for clock, items in parked.items():
+            s = used.setdefault(clock, len(used))
+            assert s < D, f"{lvl} deferred overflow in test encode"
+            cl[i, s] = np.maximum(cl[i, s], _clock_vec(clock))
+            for it in items:
+                ks[i, s, index_of(it)] = True
+            va[i, s] = True
+
+    for i, p in enumerate(pures):
+        top[i] = _clock_vec(p.clock)
+        park(i, "k1", p.deferred, lambda k: IK1.id_of(k))
+        for k1, c2 in p.entries.items():
+            i1 = IK1.id_of(k1)
+            park(i, "k2", c2.deferred,
+                 lambda k, i1=i1: i1 * K2 + IK2.id_of(k))
+            for k2, c3 in c2.entries.items():
+                i12 = i1 * K2 + IK2.id_of(k2)
+                park(i, "k3", c3.deferred,
+                     lambda k, i12=i12: i12 * K3 + IK3.id_of(k))
+                for k3, leaf in c3.entries.items():
+                    i123 = i12 * K3 + IK3.id_of(k3)
+                    park(i, "leaf", leaf.deferred,
+                         lambda mm, i123=i123: i123 * M + IM.id_of(mm))
+                    for member, clock in leaf.entries.items():
+                        ctr[i, i123 * M + IM.id_of(member)] = _clock_vec(clock)
+
+    core = st.core.mo.core._replace(
+        top=jnp.asarray(top), ctr=jnp.asarray(ctr),
+        dcl=jnp.asarray(bufs["leaf"][0]),
+        dmask=jnp.asarray(bufs["leaf"][1]),
+        dvalid=jnp.asarray(bufs["leaf"][2]),
+    )
+    mo = st.core.mo._replace(
+        core=core,
+        kdcl=jnp.asarray(bufs["k3"][0]),
+        kdkeys=jnp.asarray(bufs["k3"][1]),
+        kdvalid=jnp.asarray(bufs["k3"][2]),
+    )
+    m3 = st.core._replace(
+        mo=mo,
+        odcl=jnp.asarray(bufs["k2"][0]),
+        odkeys=jnp.asarray(bufs["k2"][1]),
+        odvalid=jnp.asarray(bufs["k2"][2]),
+    )
+    return NestedState(
+        m3,
+        jnp.asarray(bufs["k1"][0]),
+        jnp.asarray(bufs["k1"][1]),
+        jnp.asarray(bufs["k1"][2]),
+    )
+
+
+def decode(state) -> Map:
+    """One (unbatched) device state → the pure nested map."""
+    st = jax.device_get(state)
+    out = map4()
+    out.clock = _vec_clock(st.core.mo.core.top)
+    ctr = np.asarray(st.core.mo.core.ctr).reshape(K1, K2, K3, M, A)
+    for i1 in np.nonzero(ctr.any(axis=(1, 2, 3, 4)))[0]:
+        c2 = Map(val_default=lambda: Map(val_default=Orswot))
+        c2.clock = out.clock.clone()
+        for i2 in np.nonzero(ctr[i1].any(axis=(1, 2, 3)))[0]:
+            c3 = Map(val_default=Orswot)
+            c3.clock = out.clock.clone()
+            for i3 in np.nonzero(ctr[i1, i2].any(axis=(1, 2)))[0]:
+                leaf = Orswot()
+                leaf.clock = out.clock.clone()
+                for im in np.nonzero(ctr[i1, i2, i3].any(axis=-1))[0]:
+                    leaf.entries[MEMBERS[im]] = _vec_clock(ctr[i1, i2, i3, im])
+                c3.entries[KEYS3[i3]] = leaf
+            c2.entries[KEYS2[i2]] = c3
+        out.entries[KEYS1[i1]] = c2
+
+    def parked_slots(cl, mask, valid, shape):
+        for s in np.nonzero(np.asarray(valid))[0]:
+            yield _vec_clock(cl[s]), np.asarray(mask[s]).reshape(shape)
+
+    # leaf member removes → per-(k1,k2,k3) orswot deferred
+    for clock, mask in parked_slots(
+        st.core.mo.core.dcl, st.core.mo.core.dmask, st.core.mo.core.dvalid,
+        (K1, K2, K3, M),
+    ):
+        for i1, i2, i3 in zip(*np.nonzero(mask.any(axis=-1))):
+            c2 = out.entries.get(KEYS1[i1])
+            c3 = c2.entries.get(KEYS2[i2]) if c2 else None
+            leaf = c3.entries.get(KEYS3[i3]) if c3 else None
+            if leaf is None:
+                continue  # scrubbed dead key (oracle dropped it too)
+            leaf.deferred.setdefault(clock.clone(), set()).update(
+                MEMBERS[im] for im in np.nonzero(mask[i1, i2, i3])[0]
+            )
+    # K3 keyset removes → per-(k1,k2) map deferred
+    for clock, mask in parked_slots(
+        st.core.mo.kdcl, st.core.mo.kdkeys, st.core.mo.kdvalid, (K1, K2, K3)
+    ):
+        for i1, i2 in zip(*np.nonzero(mask.any(axis=-1))):
+            c2 = out.entries.get(KEYS1[i1])
+            c3 = c2.entries.get(KEYS2[i2]) if c2 else None
+            if c3 is None:
+                continue
+            c3.deferred.setdefault(clock.clone(), set()).update(
+                KEYS3[i3] for i3 in np.nonzero(mask[i1, i2])[0]
+            )
+    # K2 keyset removes → per-k1 map deferred
+    for clock, mask in parked_slots(
+        st.core.odcl, st.core.odkeys, st.core.odvalid, (K1, K2)
+    ):
+        for i1 in np.nonzero(mask.any(axis=-1))[0]:
+            c2 = out.entries.get(KEYS1[i1])
+            if c2 is None:
+                continue
+            c2.deferred.setdefault(clock.clone(), set()).update(
+                KEYS2[i2] for i2 in np.nonzero(mask[i1])[0]
+            )
+    # K1 keyset removes → the outer map's own deferred
+    for clock, mask in parked_slots(st[1], st[2], st[3], (K1,)):
+        out.deferred[clock] = {KEYS1[i1] for i1 in np.nonzero(mask)[0]}
+    return out
+
+
+# ---- device op application through the generic level ---------------------
+
+def dev_apply(state, op):
+    """Route an oracle-shaped op into one (unbatched) device state using
+    ONLY the generic level machinery + the depth-3 leaf appliers."""
+    from crdt_tpu.pure.map import MapRm, Up
+    from crdt_tpu.pure.orswot import Add as OAdd, Rm as ORm
+
+    def clockv(c):
+        return jnp.asarray(_clock_vec(c))
+
+    if isinstance(op, Up):
+        aid = ACT.id_of(op.dot.actor)
+        ctr = jnp.uint32(op.dot.counter)
+        i1 = IK1.id_of(op.key)
+        mid = op.op
+        if isinstance(mid, Up):
+            i2 = IK2.id_of(mid.key)
+            inner = mid.op
+            if isinstance(inner, Up):
+                i3 = IK3.id_of(inner.key)
+                leaf_op = inner.op
+                mmask = np.zeros((M,), bool)
+                for mm in leaf_op.members:
+                    mmask[IM.id_of(mm)] = True
+                if isinstance(leaf_op, OAdd):
+                    core3 = m3_ops.apply_member_add(
+                        state.core, jnp.asarray(aid), ctr,
+                        jnp.asarray(i1 * K2 + i2), jnp.asarray(i3),
+                        jnp.asarray(mmask),
+                    )
+                    return LEVEL4.cascade(state, core3)
+                assert isinstance(leaf_op, ORm)
+                cell = ((i1 * K2 + i2) * K3 + i3) * M
+                emask = np.zeros((K1 * K2 * K3 * M,), bool)
+                emask[cell:cell + M] = mmask
+                out, of = _up_rm4(
+                    state, jnp.asarray(aid), ctr, clockv(leaf_op.clock),
+                    jnp.asarray(emask), levels_down=3,
+                )
+                assert not bool(of)
+                return out
+            if isinstance(inner, MapRm):  # K3-level keyset remove
+                mask = np.zeros((K1 * K2 * K3,), bool)
+                for k3 in inner.keyset:
+                    mask[(i1 * K2 + i2) * K3 + IK3.id_of(k3)] = True
+                out, of = _up_rm4(
+                    state, jnp.asarray(aid), ctr, clockv(inner.clock),
+                    jnp.asarray(mask), levels_down=2,
+                )
+                assert not bool(of)
+                return out
+        if isinstance(mid, MapRm):  # K2-level keyset remove
+            mask = np.zeros((K1 * K2,), bool)
+            for k2 in mid.keyset:
+                mask[i1 * K2 + IK2.id_of(k2)] = True
+            out, of = _up_rm4(
+                state, jnp.asarray(aid), ctr, clockv(mid.clock),
+                jnp.asarray(mask), levels_down=1,
+            )
+            assert not bool(of)
+            return out
+        raise TypeError(f"unroutable Up payload: {mid!r}")
+    if isinstance(op, MapRm):  # K1-level keyset remove
+        mask = np.zeros((K1,), bool)
+        for k1 in op.keyset:
+            mask[IK1.id_of(k1)] = True
+        out, of = _rm_parked4(state, clockv(op.clock), jnp.asarray(mask))
+        assert not bool(of)
+        return out
+    raise TypeError(f"not a Map op: {op!r}")
+
+
+def _site_run(rng, n_cmds=12):
+    sites = {a: map4() for a in ALL_ACTORS}
+    for _ in range(n_cmds):
+        actor = rng.choice(list(sites))
+        site = sites[actor]
+        roll = rng.random()
+        k1, k2, k3 = (
+            rng.choice(KEYS1), rng.choice(KEYS2), rng.choice(KEYS3)
+        )
+        member = rng.choice(MEMBERS)
+        if roll < 0.3:
+            d4add(site, actor, k1, k2, k3, member)
+        elif roll < 0.45:
+            d4rm(site, actor, k1, k2, k3, member)
+        elif roll < 0.58:
+            d4drop3(site, actor, k1, k2, k3)
+        elif roll < 0.7:
+            d4drop2(site, actor, k1, k2)
+        elif roll < 0.82:
+            d4drop1(site, k1)
+        else:
+            site.merge(sites[rng.choice(list(sites))].clone())
+    return list(sites.values())
+
+
+def _rows(state, i):
+    return jax.tree.map(lambda x: x[i], state)
+
+
+@given(seeds)
+@settings(max_examples=10, deadline=None)
+def test_depth4_join_bit_identical_to_oracle_merge(seed):
+    rng = random.Random(seed)
+    states = _site_run(rng)
+    batched = encode(states)
+
+    expect = states[0].clone()
+    expect.merge(states[1].clone())
+    joined, flags = _join4(_rows(batched, 0), _rows(batched, 1))
+    assert flags.shape == (4,) and not bool(flags.any())
+    assert decode(joined) == expect
+
+    # round-trip of untouched replicas is lossless
+    assert decode(_rows(batched, 2)) == states[2]
+
+
+@given(seeds)
+@settings(max_examples=8, deadline=None)
+def test_depth4_fold_bit_identical_to_oracle_fold(seed):
+    rng = random.Random(seed)
+    states = _site_run(rng, n_cmds=16)
+    batched = encode(states)
+
+    expect = states[0].clone()
+    for s in states[1:]:
+        expect.merge(s.clone())
+    folded, flags = LEVEL4.fold(batched)
+    assert not bool(flags.any())
+    assert decode(folded) == expect
+
+
+@given(seeds)
+@settings(max_examples=6, deadline=None)
+def test_depth4_op_path_bit_identical(seed):
+    rng = random.Random(seed)
+    site = map4()
+    stream = []
+    for _ in range(14):
+        k1, k2, k3 = (
+            rng.choice(KEYS1), rng.choice(KEYS2), rng.choice(KEYS3)
+        )
+        member = rng.choice(MEMBERS)
+        roll = rng.random()
+        actor = rng.choice(ALL_ACTORS)
+        if roll < 0.35:
+            stream.append(d4add(site, actor, k1, k2, k3, member))
+        elif roll < 0.55:
+            stream.append(d4rm(site, actor, k1, k2, k3, member))
+        elif roll < 0.7:
+            stream.append(d4drop3(site, actor, k1, k2, k3))
+        elif roll < 0.85:
+            stream.append(d4drop2(site, actor, k1, k2))
+        else:
+            stream.append(d4drop1(site, k1))
+    oracle = map4()
+    dev = _rows(empty4(batch=(1,)), 0)
+    for op in stream:
+        oracle.apply(op)
+        dev = dev_apply(dev, op)
+        assert decode(dev) == oracle
+
+
+@given(seeds)
+@settings(max_examples=5, deadline=None)
+def test_depth4_convergence_under_random_delivery(seed):
+    rng = random.Random(seed)
+    states = _site_run(rng, n_cmds=14)
+    batched = encode(states)
+    n = len(states)
+    expect = states[0].clone()
+    for s in states[1:]:
+        expect.merge(s.clone())
+    rows = [_rows(batched, i) for i in range(n)]
+    order = [(d, s) for d in range(n) for s in range(n) if d != s]
+    rng.shuffle(order)
+    for d, s in order:
+        rows[d], flags = _join4(rows[d], rows[s])
+        assert not bool(flags.any())
+    for i in range(n):
+        assert decode(rows[i]) == expect

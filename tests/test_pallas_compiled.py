@@ -59,3 +59,75 @@ def test_multi_pass_stream_compiles_on_tpu():
     four, _ = fold_fused(state, interpret=False, n_passes=4)
     np.testing.assert_array_equal(np.asarray(one.ctr), np.asarray(four.ctr))
     np.testing.assert_array_equal(np.asarray(one.top), np.asarray(four.top))
+
+
+@requires_tpu
+def test_fused_map_fold_compiles_and_matches_tree_on_tpu():
+    """The cell-granular dense kernel (Map<K, MVReg>) under real Mosaic."""
+    from crdt_tpu.ops import map as map_ops
+    from crdt_tpu.ops.pallas_kernels import fold_fused_map
+
+    r, k, s, a = 8, 4096, 2, 4
+    rng = np.random.default_rng(2)
+    state = map_ops.empty(k, a, sibling_cap=s, batch=(r,))
+    cctr = np.tile(
+        (np.arange(k)[:, None] * s + np.arange(s) + 1).astype(np.uint32),
+        (r, 1, 1),
+    )
+    cact = ((np.arange(r)[:, None, None] + np.arange(s)[None, None, :]) % a) * np.ones(
+        (r, k, s), np.int32
+    )
+    cvalid = (np.arange(s) == 0) | (rng.random((r, k, s)) < 0.5)
+    cclk = np.zeros((r, k, s, a), np.uint32)
+    np.put_along_axis(
+        cclk, cact[..., None].astype(np.int64), cctr[..., None], axis=-1
+    )
+    cclk[~cvalid] = 0
+    top = np.max(np.where(cvalid[..., None], cclk, 0), axis=(1, 2))
+    state = state._replace(
+        top=jnp.asarray(top),
+        child=state.child._replace(
+            wact=jnp.asarray(np.where(cvalid, cact, 0).astype(np.int32)),
+            wctr=jnp.asarray(np.where(cvalid, cctr, 0)),
+            clk=jnp.asarray(cclk),
+            valid=jnp.asarray(cvalid),
+        ),
+    )
+    fused, off = fold_fused_map(state, interpret=False)  # force Mosaic
+    tree, oft = map_ops._tree_fold(state)
+    for x, y in zip(jax.tree_util.tree_leaves(fused), jax.tree_util.tree_leaves(tree)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert bool(off.any()) == bool(oft.any())
+
+
+@requires_tpu
+def test_fused_level_folds_compile_and_match_tree_on_tpu():
+    """The generic nested fused fold (map_orswot + map3) under Mosaic."""
+    from crdt_tpu.ops import map3 as m3
+    from crdt_tpu.ops import map_orswot as mo
+    from crdt_tpu.ops.pallas_kernels import fold_fused_level
+
+    rng = np.random.default_rng(3)
+    s = mo.empty(256, 16, 8, 4, batch=(16,))
+    ctr = rng.integers(0, 30, (16, 4096, 8)).astype(np.uint32)
+    ctr[rng.random(ctr.shape) < 0.4] = 0
+    top = ctr.max(axis=1)
+    s = s._replace(core=s.core._replace(top=jnp.asarray(top), ctr=jnp.asarray(ctr)))
+    fused, _ = fold_fused_level(mo.LEVEL, s, interpret=False)
+    tree, _ = mo.LEVEL.fold(s)
+    for x, y in zip(jax.tree_util.tree_leaves(fused), jax.tree_util.tree_leaves(tree)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    s3 = m3.empty(16, 16, 16, 8, 4, batch=(8,))
+    ctr = rng.integers(0, 30, (8, 4096, 8)).astype(np.uint32)
+    ctr[rng.random(ctr.shape) < 0.4] = 0
+    top = ctr.max(axis=1)
+    s3 = s3._replace(
+        mo=s3.mo._replace(
+            core=s3.mo.core._replace(top=jnp.asarray(top), ctr=jnp.asarray(ctr))
+        )
+    )
+    fused3, _ = fold_fused_level(m3.LEVEL, s3, interpret=False)
+    tree3, _ = m3.LEVEL.fold(s3)
+    for x, y in zip(jax.tree_util.tree_leaves(fused3), jax.tree_util.tree_leaves(tree3)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

@@ -108,3 +108,94 @@ def test_fold_auto_rejects_unknown_prefer():
     state = oo.empty(4, 2, deferred_cap=2, batch=(2,))
     with pytest.raises(ValueError):
         fold_auto(state, prefer="pallas")
+
+
+# ---- fused folds for the composition layer (pallas_kernels.fold_fused_*) --
+
+from crdt_tpu.models import BatchedMap, BatchedMapOrswot
+from crdt_tpu.ops import map as map_ops
+from crdt_tpu.ops import map3 as m3
+from crdt_tpu.ops import map_map as mm
+from crdt_tpu.ops import map_orswot as mo
+from crdt_tpu.ops.pallas_kernels import fold_fused_level, fold_fused_map
+from crdt_tpu.utils import Interner
+
+from strategies import ACTORS
+from test_map import _site_run as _map_site_run, mv_map
+from test_models_map3 import _batched as _m3_batched, _site_run as _m3_site_run
+from test_models_map_nested import (
+    KEYS,
+    MEMBERS,
+    _nbatched,
+    _site_run_nested,
+    _site_run_set,
+)
+
+def _tree_eq(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@given(seeds)
+@settings(max_examples=8, deadline=None)
+def test_fused_map_fold_matches_tree_fold(seed):
+    """Map<K, MVReg>: the dense cell-granular kernel + winner-select
+    payload epilogue == the slot-table log-tree fold, on reachable
+    states (incl. parked keyset-removes)."""
+    rng = random.Random(seed)
+    states = _map_site_run(rng, mv_map, n_cmds=14)
+    model = BatchedMap.from_pure(
+        states, keys=Interner(list("pq")),
+        actors=Interner(ACTORS + ["A", "B", "C"]),
+        sibling_cap=12, deferred_cap=12,
+    )
+    tree, oft = map_ops._tree_fold(model.state)
+    fused, off = fold_fused_map(model.state, tile_e=2)
+    assert bool(oft.any()) == bool(off.any())
+    _tree_eq(tree, fused)
+
+
+@given(seeds)
+@settings(max_examples=8, deadline=None)
+def test_fused_map_orswot_fold_matches_tree_fold(seed):
+    """Map<K, Orswot>: the generic level-fused fold == the tree fold on
+    reachable states (both deferred levels carried)."""
+    rng = random.Random(seed)
+    states = _site_run_set(rng, n_cmds=14)
+    model = BatchedMapOrswot.from_pure(
+        states, deferred_cap=12,
+        keys=Interner(KEYS), members=Interner(MEMBERS),
+        actors=Interner(ACTORS + ["A", "B", "C"]),
+    )
+    tree, oft = mo.LEVEL.fold(model.state)
+    fused, off = fold_fused_level(mo.LEVEL, model.state, tile_e=2)
+    assert bool(oft.any()) == bool(off.any())
+    _tree_eq(tree, fused)
+
+
+@given(seeds)
+@settings(max_examples=6, deadline=None)
+def test_fused_map3_fold_matches_tree_fold(seed):
+    """Depth-3: the level-fused fold settles all THREE deferred levels
+    identically to the tree fold."""
+    rng = random.Random(seed)
+    states = _m3_site_run(rng, n_cmds=14)
+    model = _m3_batched(states)
+    tree, oft = m3.LEVEL.fold(model.state)
+    fused, off = fold_fused_level(m3.LEVEL, model.state, tile_e=2)
+    assert bool(oft.any()) == bool(off.any())
+    _tree_eq(tree, fused)
+
+
+@given(seeds)
+@settings(max_examples=5, deadline=None)
+def test_fused_nested_map_fold_matches_tree_fold(seed):
+    """Map<K1, Map<K2, MVReg>>: the MVReg-leaf level-fused fold == the
+    tree fold (dense leaf kernel + outer settle)."""
+    rng = random.Random(seed)
+    states = _site_run_nested(rng, n_cmds=12)
+    model = _nbatched(states)
+    tree, oft = mm.LEVEL.fold(model.state)
+    fused, off = fold_fused_level(mm.LEVEL, model.state, tile_e=2)
+    assert bool(oft.any()) == bool(off.any())
+    _tree_eq(tree, fused)

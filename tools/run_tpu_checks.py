@@ -5,8 +5,10 @@ skip — this script is how to actually exercise them on hardware):
     python tools/run_tpu_checks.py
 
 Runs, in order: a backend probe (fail-fast on a wedged relay, same
-mechanism as bench.py), the compiled fused-fold equality tests, the
-entry() compile check, and a scaled fused-vs-tree bench sanity."""
+mechanism as bench.py), the compiled fused-fold equality tests (plain
+orswot, Map<K, MVReg>, map_orswot + map3 nested levels), the n_passes
+streaming-equivalence A/B, the entry() compile check, and a scaled
+fused-vs-tree bench sanity."""
 
 import importlib.util
 import os
@@ -15,6 +17,55 @@ import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
+
+
+def npasses_streaming_ab() -> bool:
+    """A/B-verify the bench's n_passes equivalence claim (bench.py module
+    docstring): at a shape where K distinct chunks fit in HBM, folding K
+    concatenated copies of a chunk (K distinct HBM regions — the real
+    stream) must take the same time as K grid re-walks of one resident
+    chunk, and produce the same bits (join idempotence). A big gap would
+    mean re-walks hit some cache effect and the streamed bench number is
+    not an honest distinct-replica number."""
+    import jax
+    import numpy as np
+
+    import bench
+
+    k_chunks, r, e = 4, 512, 16384
+    chunk = bench.make_chunk_on_device(r, e)
+    big = jax.tree.map(
+        lambda x: jax.numpy.concatenate([x] * k_chunks, axis=0), chunk
+    )
+    jax.block_until_ready(big.ctr)
+    from crdt_tpu.ops.pallas_kernels import fold_fused
+
+    distinct, _ = fold_fused(big)                       # warm + result
+    rewalk, _ = fold_fused(chunk, n_passes=k_chunks)    # warm + result
+    for a, b in zip(jax.tree_util.tree_leaves(distinct), jax.tree_util.tree_leaves(rewalk)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def med(fn, n=5):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            out, _ = fn()
+            jax.block_until_ready(out.ctr)
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    t_distinct = med(lambda: fold_fused(big))
+    t_rewalk = med(lambda: fold_fused(chunk, n_passes=k_chunks))
+    ratio = t_rewalk / t_distinct
+    print(
+        f"n_passes A/B: distinct {k_chunks}x{r} chunks {t_distinct*1e3:.1f} ms "
+        f"vs {k_chunks} re-walks {t_rewalk*1e3:.1f} ms (ratio {ratio:.2f}); "
+        f"results bit-identical"
+    )
+    if not 0.67 <= ratio <= 1.5:
+        print("FAIL: re-walk stream is not time-equivalent to distinct chunks")
+        return False
+    return True
 
 
 def main() -> int:
@@ -37,12 +88,22 @@ def main() -> int:
     )
     m = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(m)
-    t0 = time.time()
-    m.test_fused_fold_compiles_and_matches_tree_on_tpu()
-    print(f"compiled fused fold == tree fold   [{time.time()-t0:.0f}s]")
-    t0 = time.time()
-    m.test_multi_pass_stream_compiles_on_tpu()
-    print(f"multi-pass stream idempotent       [{time.time()-t0:.0f}s]")
+    for name, label in [
+        ("test_fused_fold_compiles_and_matches_tree_on_tpu",
+         "compiled fused fold == tree fold"),
+        ("test_multi_pass_stream_compiles_on_tpu",
+         "multi-pass stream idempotent"),
+        ("test_fused_map_fold_compiles_and_matches_tree_on_tpu",
+         "compiled MVReg-map fused fold == tree"),
+        ("test_fused_level_folds_compile_and_match_tree_on_tpu",
+         "compiled mo/map3 fused folds == tree"),
+    ]:
+        t0 = time.time()
+        getattr(m, name)()
+        print(f"{label:<35}[{time.time()-t0:.0f}s]")
+
+    if not npasses_streaming_ab():
+        return 1
 
     t0 = time.time()
     import __graft_entry__ as g
@@ -56,6 +117,12 @@ def main() -> int:
     if path != "fused":
         print("FAIL: fused path did not run on the chip")
         return 1
+
+    os.environ["BENCH_MAP_KEYS"] = os.environ.get("BENCH_MAP_KEYS", "1000000")
+    t0 = time.time()
+    bench.bench_map()
+    print(f"config4 1M-key fused fold ran      [{time.time()-t0:.0f}s]")
+
     print("ALL TPU CHECKS PASSED")
     return 0
 
