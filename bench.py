@@ -315,7 +315,9 @@ def bench_clocks():
         f"read {total} (63 merges -> {63/dt:,.0f} merges/s)"
     )
 
-    # Config 2: 1k replicas, full pairwise merge matrix.
+    # Config 2: 1k replicas, full pairwise merge matrix — the VClock
+    # kernel, then the PNCounter form (p/n = TWO clock matrices per
+    # replica, BASELINE names both types for this config).
     clocks2 = jnp.asarray(
         rng.integers(0, 1000, (1000, A)).astype(np.uint32)
     )
@@ -329,6 +331,27 @@ def bench_clocks():
     log(
         f"config2 vclock: 1k x 1k pairwise merge matrix: {dt*1e3:.2f} ms "
         f"-> {1e6/dt:,.0f} pair-merges/s"
+    )
+
+    p2 = jnp.asarray(rng.integers(0, 1000, (1000, A)).astype(np.uint32))
+    n2 = jnp.asarray(rng.integers(0, 1000, (1000, A)).astype(np.uint32))
+
+    @jax.jit
+    def pn_pair(p, n):
+        return vops.pairwise_merge_matrix(p), vops.pairwise_merge_matrix(n)
+
+    jax.block_until_ready(pn_pair(p2, n2))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        pm, nm = pn_pair(p2, n2)
+    jax.block_until_ready((pm, nm))
+    dt = (time.perf_counter() - t0) / 10
+    # Converged read p − n as exact host ints (BigInt-at-the-edge
+    # discipline, SURVEY §7.3).
+    total = int(np.asarray(vops.fold(p2)).sum()) - int(np.asarray(vops.fold(n2)).sum())
+    log(
+        f"config2 pncounter: 1k x 1k pairwise merge (p+n): {dt*1e3:.2f} ms "
+        f"-> {1e6/dt:,.0f} pair-merges/s; converged read {total}"
     )
 
 
@@ -426,8 +449,10 @@ def bench_list():
     from crdt_tpu.native import INSERT, ListEngine
     from crdt_tpu.pure.list import List
 
-    n_ops = int(os.environ.get("BENCH_LIST_OPS", 20000))
-    r = int(os.environ.get("BENCH_LIST_REPLICAS", 64))
+    # BASELINE config-5 scale by default (100k-op trace x 1k replicas);
+    # the CPU fallback path caps both (main()).
+    n_ops = int(os.environ.get("BENCH_LIST_OPS", 100_000))
+    r = int(os.environ.get("BENCH_LIST_REPLICAS", 1024))
     trace = make_edit_trace(n_ops)
 
     t0 = time.perf_counter()
@@ -483,7 +508,11 @@ def main():
         pin_cpu()
         degraded = True
         R, E, CHUNK = min(R, 64), min(E, 4096), min(CHUNK, 16)
-        for var, cpu_cap in (("BENCH_MAP_KEYS", 20000), ("BENCH_LIST_OPS", 5000)):
+        for var, cpu_cap in (
+            ("BENCH_MAP_KEYS", 20000),
+            ("BENCH_LIST_OPS", 5000),
+            ("BENCH_LIST_REPLICAS", 64),
+        ):
             os.environ[var] = str(min(int(os.environ.get(var, cpu_cap)), cpu_cap))
     for name, fn in [
         ("clocks", bench_clocks),

@@ -39,30 +39,59 @@ class Config:
     sibling_cap: int = 8
     # Debug mode: jax NaN/inf checks around kernels (SURVEY §6.2).
     debug_numerics: bool = False
+    # Device counter width for the clock/counter family (reference:
+    # src/vclock.rs is BTreeMap<A, u64>). "uint32" (default) matches the
+    # dot-slab lattice and allows 2^32-1 events per actor — the envelope
+    # the strict-mode saturation trap guards (models/validation.py);
+    # "uint64" restores full reference width for VClock / GCounter /
+    # PNCounter (enables jax x64 mode — see ``configure``). The
+    # orswot/map dot slabs stay u32 (VMEM/bandwidth: the fused fold's
+    # whole advantage rides on 4-byte lanes); long-lived actors there
+    # are covered by the trap, not by widening.
+    counter_dtype: str = "uint32"
 
     def validate(self) -> None:
         if self.backend not in ("pure", "xla"):
             raise ValueError(f"backend must be 'pure' or 'xla', got {self.backend!r}")
         if self.deferred_cap < 1 or self.sibling_cap < 1:
             raise ValueError("capacities must be >= 1")
+        if self.counter_dtype not in ("uint32", "uint64"):
+            raise ValueError(
+                f"counter_dtype must be 'uint32' or 'uint64', got {self.counter_dtype!r}"
+            )
 
 
 config = Config()
 
-# jax_debug_nans value from before *we* enabled it (None = we didn't),
-# so disabling debug_numerics restores the user's own setting rather
-# than forcing False.
+# jax_debug_nans / jax_enable_x64 values from before *we* enabled them
+# (None = we didn't), so turning the feature back off restores the
+# user's own setting rather than forcing False.
 _debug_nans_prev = None
+_x64_prev = None
 
 
 def configure(**kwargs) -> Config:
     """Update the global config in place (unknown keys rejected)."""
-    global _debug_nans_prev
+    global _debug_nans_prev, _x64_prev
     for key, value in kwargs.items():
         if not hasattr(config, key):
             raise TypeError(f"unknown config field {key!r}")
         setattr(config, key, value)
     config.validate()
+    if config.counter_dtype == "uint64":
+        # uint64 arrays silently truncate to uint32 without x64 mode.
+        # Enabled globally (jax has no narrower switch); affects default
+        # widths of NEW arrays only — the dot slabs pin uint32 explicitly.
+        import jax
+
+        if _x64_prev is None:
+            _x64_prev = bool(jax.config.jax_enable_x64)
+        jax.config.update("jax_enable_x64", True)
+    elif _x64_prev is not None:
+        import jax
+
+        jax.config.update("jax_enable_x64", _x64_prev)
+        _x64_prev = None
     if config.debug_numerics:
         import jax
 

@@ -28,9 +28,29 @@ def _exact_sum(row) -> int:
     return sum(int(c) for c in np.asarray(row))
 
 
-def _check_steps(steps: int) -> None:
-    if not 0 <= steps < 2**32:
-        raise ValueError(f"steps must be a u32 (0 <= steps < 2**32), got {steps}")
+def _check_steps(steps: int, dtype) -> None:
+    limit = int(np.iinfo(np.dtype(str(dtype))).max)
+    if not 0 <= steps <= limit:
+        raise ValueError(
+            f"steps must fit the counter dtype (0 <= steps <= {limit}), got {steps}"
+        )
+
+
+def _bump(batch: "BatchedVClock", replica: int, actor, steps: int) -> None:
+    """The one counter-increment sequence (GCounter.inc, PNCounter.inc/
+    dec are the same op on different clock batches): bounds-check steps
+    against the lane dtype, allocate the actor lane, trap saturation in
+    strict mode (the only path that pays the device read), and add."""
+    from ..config import config
+
+    dt = batch.clocks.dtype
+    _check_steps(steps, dt)
+    aid = batch.bounded_id(actor)
+    if config.strict:
+        from .validation import strict_check_headroom
+
+        strict_check_headroom(batch.clocks[replica, aid], actor, steps, dt)
+    batch.clocks = batch.clocks.at[replica, aid].add(dt.type(steps))
 
 
 class BatchedGCounter:
@@ -55,9 +75,7 @@ class BatchedGCounter:
         return GCounter(self.inner.to_pure(i))
 
     def inc(self, replica: int, actor, steps: int = 1) -> None:
-        _check_steps(steps)
-        aid = self.inner.bounded_id(actor)
-        self.inner.clocks = self.inner.clocks.at[replica, aid].add(np.uint32(steps))
+        _bump(self.inner, replica, actor, steps)
 
     def fold_read(self) -> int:
         """Converged total: one join + one lane sum (config 1's kernel)."""
@@ -96,14 +114,10 @@ class BatchedPNCounter:
         return PNCounter(GCounter(self.p.to_pure(i)), GCounter(self.n.to_pure(i)))
 
     def inc(self, replica: int, actor, steps: int = 1) -> None:
-        _check_steps(steps)
-        aid = self.p.bounded_id(actor)
-        self.p.clocks = self.p.clocks.at[replica, aid].add(np.uint32(steps))
+        _bump(self.p, replica, actor, steps)
 
     def dec(self, replica: int, actor, steps: int = 1) -> None:
-        _check_steps(steps)
-        aid = self.n.bounded_id(actor)
-        self.n.clocks = self.n.clocks.at[replica, aid].add(np.uint32(steps))
+        _bump(self.n, replica, actor, steps)
 
     def fold_read(self) -> int:
         """Converged p − n (exact Python int at the API edge, preserving

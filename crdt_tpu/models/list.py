@@ -167,6 +167,21 @@ class BatchedList:
         replica_ops = np.asarray(replica_ops)
         if replica_ops.ndim != 2 or replica_ops.shape[0] != self.n_replicas:
             raise ValueError(f"expected [R={self.n_replicas}, C] op indices")
+        from ..config import config
+
+        if config.strict:
+            # The device analog of pure.list.List.validate_op's dup
+            # rejection: a trace-op index delivered twice to one replica
+            # in one epoch is a duplicate dot (the engine mints each op's
+            # dot once), and scatter order on duplicates is unspecified.
+            from ..traits import DotRange
+
+            for r in range(replica_ops.shape[0]):
+                live = replica_ops[r][replica_ops[r] >= 0]
+                uniq, counts = np.unique(live, return_counts=True)
+                if (counts > 1).any():
+                    dup = int(uniq[counts > 1][0])
+                    raise DotRange(f"replica {r} trace op", dup, dup)
         if op_slots is None:
             op_slots = self.op_slots
         valid = replica_ops >= 0

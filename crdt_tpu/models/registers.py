@@ -184,14 +184,34 @@ class BatchedMVReg:
 
     def apply(self, replica: int, op: Put) -> None:
         """Apply an oracle-shaped Put to one replica (reference:
-        src/mvreg.rs ``CmRDT::apply``)."""
+        src/mvreg.rs ``CmRDT::apply``). Under ``config.strict`` the
+        Put's witness dot must be the minter's next contiguous event
+        against the replica's observed clock (the join of its live
+        content clocks — MVReg stores no top), mirroring
+        ``pure.mvreg.MVReg.validate_op``. Validation runs FIRST (before
+        actor-lane allocation) so a rejected op is side-effect free and
+        never-seen actors get DotRange, not KeyError."""
+        from ..config import config
+
+        if config.strict:
+            from .validation import strict_validate_dot
+
+            row_clk = jnp.max(
+                jnp.where(
+                    self.state.valid[replica][..., None],
+                    self.state.clk[replica],
+                    0,
+                ),
+                axis=-2,
+            )
+            strict_validate_dot(
+                row_clk, self.actors, op.dot.actor, op.dot.counter
+            )
         a = self.state.clk.shape[-1]
-        aid = self.actors.id_of(op.dot.actor)
-        if aid >= a:
-            raise IndexError(f"actor id {aid} outside the {a}-lane universe")
+        aid = self.actors.bounded_intern(op.dot.actor, a, "actor")
         cl = np.zeros((a,), np.uint32)
         for actor, c in op.clock.dots.items():
-            cl[self.actors.id_of(actor)] = c
+            cl[self.actors.bounded_intern(actor, a, "actor")] = c
         row = jax.tree.map(lambda x: x[replica], self.state)
         row, overflow = mv_ops.apply_put(
             row,

@@ -13,13 +13,19 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..traits import DotRange
+from ..traits import CounterSaturation, DotRange
+
+
+def _dtype_max(dtype) -> int:
+    return int(np.iinfo(np.dtype(str(dtype))).max)
 
 
 def strict_validate_dot(top_row, actors, actor, counter: int) -> None:
     """Raise DotRange unless ``counter`` is the next contiguous event of
-    ``actor`` against this replica's top clock. No-op unless
-    ``config.strict``.
+    ``actor`` against this replica's top clock, and CounterSaturation if
+    the lane has reached its dtype maximum (the u32 overflow trap —
+    SURVEY.md §7.3 "overflow discipline"; the next mint would wrap and
+    silently break clock monotonicity). No-op unless ``config.strict``.
 
     Takes the interner (not a lane id) so validation can run BEFORE any
     lane is allocated — a rejected op must be side-effect free, like the
@@ -35,5 +41,22 @@ def strict_validate_dot(top_row, actors, actor, counter: int) -> None:
         aid = actors.id_of(actor)
         if aid < arr.shape[-1]:
             seen = int(arr[aid])
+    limit = _dtype_max(arr.dtype)
+    if seen >= limit:
+        raise CounterSaturation(actor, seen, limit)
     if int(counter) != seen + 1:
         raise DotRange(actor, int(counter), seen + 1)
+
+
+def strict_check_headroom(lane_value, actor, steps: int, dtype) -> None:
+    """Counter-increment headroom trap: raise CounterSaturation when a
+    ``steps``-sized add would exceed the lane dtype's maximum. No-op
+    unless ``config.strict`` (the unchecked path wraps, as documented in
+    the u32 envelope note — config.counter_dtype)."""
+    from ..config import config
+
+    if not config.strict:
+        return
+    limit = _dtype_max(dtype)
+    if int(lane_value) + int(steps) > limit:
+        raise CounterSaturation(actor, int(lane_value), limit)

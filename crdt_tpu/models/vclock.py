@@ -40,7 +40,10 @@ class BatchedVClock:
             for actor in p.dots:
                 actors.intern(actor)
         out = cls(len(pures), actors=actors, n_actors=max(len(actors), 1))
-        mat = np.zeros((len(pures), max(len(actors), 1)), dtype=np.uint32)
+        mat = np.zeros(
+            (len(pures), max(len(actors), 1)),
+            dtype=np.dtype(str(out.clocks.dtype)),
+        )
         for i, p in enumerate(pures):
             for actor, counter in p.dots.items():
                 mat[i, actors.id_of(actor)] = counter

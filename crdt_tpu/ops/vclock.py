@@ -16,15 +16,22 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-DTYPE = jnp.uint32
-
 # compare() result codes. None (concurrent) has no scalar analog, so the
 # device encoding is: -1 less, 0 equal, 1 greater, 2 concurrent.
 LESS, EQUAL, GREATER, CONCURRENT = -1, 0, 1, 2
 
 
+def counter_dtype():
+    """The configured clock/counter lane dtype (config.counter_dtype —
+    u64 restores reference src/vclock.rs width for the counter family;
+    every kernel below is dtype-generic)."""
+    from ..config import config
+
+    return jnp.uint64 if config.counter_dtype == "uint64" else jnp.uint32
+
+
 def zeros(n_actors: int, batch: tuple = ()) -> jax.Array:
-    return jnp.zeros((*batch, n_actors), dtype=DTYPE)
+    return jnp.zeros((*batch, n_actors), dtype=counter_dtype())
 
 
 @jax.jit

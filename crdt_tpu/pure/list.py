@@ -18,7 +18,7 @@ from dataclasses import dataclass
 from typing import Any, Iterator, List as PyList, Optional, Tuple
 
 from ..dot import Dot, OrdDot
-from ..traits import CmRDT
+from ..traits import CmRDT, DotRange, ValidationError
 from ..vclock import VClock
 from .identifier import Identifier, between
 
@@ -81,6 +81,33 @@ class List(CmRDT):
         return Delete(id=self.seq[ix], dot=dot)
 
     # ---- CmRDT ---------------------------------------------------------
+    def validate_op(self, op) -> None:
+        """v7 validation parity (reference: src/traits.rs ``CmRDT::
+        validate_op``; SURVEY.md §3.2 "the same set + List"):
+
+        - ``Insert``: the id's minted dot must be the actor's next
+          contiguous event (a duplicate identifier IS a duplicate dot —
+          the id embeds it — so dup inserts are caught here too);
+        - ``Delete``: the delete's own dot must be contiguous, and the
+          TARGET id's dot must already be observed — deleting an insert
+          this replica never saw breaks the causal-delivery assumption
+          the tombstone-free design relies on (both → DotRange)."""
+        if isinstance(op, Insert):
+            seen = self.clock.get(op.dot.actor)
+            if op.dot.counter != seen + 1:
+                raise DotRange(op.dot.actor, op.dot.counter, seen + 1)
+        elif isinstance(op, Delete):
+            seen = self.clock.get(op.dot.actor)
+            if op.dot.counter != seen + 1:
+                raise DotRange(op.dot.actor, op.dot.counter, seen + 1)
+            target: OrdDot = op.id.value()
+            tdot = target.to_dot()
+            observed = self.clock.get(tdot.actor)
+            if tdot.counter > observed:
+                raise DotRange(tdot.actor, tdot.counter, observed)
+        else:
+            raise ValidationError(f"not a List op: {op!r}")
+
     def apply(self, op) -> None:
         if isinstance(op, Insert):
             if op.id not in self.vals:

@@ -24,7 +24,7 @@ from typing import Any, Dict, Tuple
 
 from ..ctx import AddCtx, ReadCtx
 from ..dot import Dot
-from ..traits import CmRDT, CvRDT, ResetRemove
+from ..traits import CmRDT, CvRDT, DotRange, ResetRemove, ValidationError
 from ..vclock import VClock
 
 
@@ -72,6 +72,25 @@ class MVReg(CvRDT, CmRDT, ResetRemove):
         contains the fresh dot, so the put dominates everything read.
         """
         return Put(dot=ctx.dot, clock=ctx.clock.clone(), val=val)
+
+    def validate_op(self, op: Put) -> None:
+        """v7 validation parity (reference: src/traits.rs ``CmRDT::
+        validate_op``; SURVEY.md §3.2 "the same set + List"): a Put must
+        be well-formed — its clock contains its own witness dot as the
+        minter's latest self-event (every AddCtx mints exactly that) —
+        and its dot must be the minter's next contiguous event against
+        this register's observed clock (duplicate or gapped → DotRange,
+        exactly the orswot Add rule)."""
+        if not isinstance(op, Put):
+            raise ValidationError(f"not an MVReg op: {op!r}")
+        if op.clock.get(op.dot.actor) != op.dot.counter:
+            raise ValidationError(
+                f"malformed Put: clock {op.clock!r} does not carry its own "
+                f"witness dot {op.dot!r}"
+            )
+        expected = self.clock().get(op.dot.actor) + 1
+        if op.dot.counter != expected:
+            raise DotRange(op.dot.actor, op.dot.counter, expected)
 
     def apply(self, op: Put) -> None:
         if op.clock.is_empty():

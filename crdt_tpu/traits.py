@@ -42,6 +42,26 @@ class DotRange(ValidationError):
         )
 
 
+class CounterSaturation(ValidationError):
+    """A device counter lane is at (or would exceed) its dtype's maximum.
+
+    No reference analog — src/vclock.rs is u64 end to end; the device
+    lattice defaults to u32 lanes (the fused fold's bandwidth advantage
+    rides on 4-byte lanes), so a lane reaching 2^32-1 would silently
+    break clock monotonicity on the next event. Strict mode turns that
+    into this error; ``configure(counter_dtype="uint64")`` restores
+    reference width for the clock/counter family."""
+
+    def __init__(self, actor: Any, counter: int, limit: int):
+        self.actor = actor
+        self.counter = counter
+        self.limit = limit
+        super().__init__(
+            f"counter lane for {actor!r} at {counter} is saturated "
+            f"(dtype max {limit}); widen counter_dtype or retire the actor"
+        )
+
+
 class ConflictingMarker(ValidationError):
     """LWW merge saw equal markers guarding different values.
 

@@ -153,3 +153,147 @@ def test_validate_op_counters_and_map():
     vc.apply(d)
     with pytest.raises(DotRange):
         vc.validate_op(d)
+
+
+def test_validate_op_mvreg_both_backends():
+    """v7 validation parity for MVReg (SURVEY §3.2: "the same set"):
+    dup/gap Puts raise DotRange on the oracle AND, under strict mode, on
+    the batched path; malformed Puts (clock missing its own witness dot)
+    are rejected outright."""
+    from crdt_tpu.dot import Dot
+    from crdt_tpu.models import BatchedMVReg
+    from crdt_tpu.pure.mvreg import MVReg, Put
+    from crdt_tpu.traits import DotRange, ValidationError
+    from crdt_tpu.utils import Interner
+    from crdt_tpu.vclock import VClock
+
+    site = MVReg()
+    op1 = site.write(10, site.read().derive_add_ctx("a"))
+    site.apply(op1)
+    op2 = site.write(20, site.read().derive_add_ctx("a"))  # dot (a,2)
+
+    replica = MVReg()
+    with pytest.raises(DotRange):
+        replica.validate_op(op2)  # gap: (a,2) before (a,1)
+    replica.validate_op(op1)
+    replica.apply(op1)
+    with pytest.raises(DotRange):
+        replica.validate_op(op1)  # duplicate
+    replica.validate_op(op2)  # contiguous now
+    with pytest.raises(ValidationError):
+        replica.validate_op(Put(dot=Dot("a", 2), clock=VClock({"b": 1}), val=0))
+    with pytest.raises(ValidationError):
+        replica.validate_op("garbage")
+
+    def fresh():
+        return BatchedMVReg(
+            1, 2, n_slots=4, actors=Interner(["a"]), values=Interner([10, 20])
+        )
+
+    with configured(backend="xla", strict=True):
+        device = fresh()
+        with pytest.raises(DotRange):
+            device.apply(0, op2)  # gap
+        device.apply(0, op1)
+        with pytest.raises(DotRange):
+            device.apply(0, op1)  # duplicate
+        device.apply(0, op2)
+    # non-strict: the oracle drop rule handles dups silently
+    device = fresh()
+    device.apply(0, op1)
+    device.apply(0, op1)
+
+
+def test_validate_op_list_both_backends():
+    """v7 validation parity for List (SURVEY §3.2: "+ List"): gapped and
+    duplicate insert dots, deletes of unseen identifiers, and duplicate
+    trace delivery on the device path all raise DotRange."""
+    import numpy as np
+
+    from crdt_tpu.models import BatchedList
+    from crdt_tpu.pure.list import List
+    from crdt_tpu.traits import DotRange, ValidationError
+
+    site = List()
+    ins1 = site.insert_index(0, "x", "a")
+    site.apply(ins1)
+    ins2 = site.insert_index(1, "y", "a")  # dot (a,2)
+    site.apply(ins2)
+    dele = site.delete_index(0, "a")       # dot (a,3), targets ins1
+
+    replica = List()
+    with pytest.raises(DotRange):
+        replica.validate_op(ins2)  # gap
+    replica.validate_op(ins1)
+    replica.apply(ins1)
+    with pytest.raises(DotRange):
+        replica.validate_op(ins1)  # duplicate
+    with pytest.raises(DotRange):
+        # delete whose own dot gaps ((a,3) after (a,1))
+        replica.validate_op(dele)
+    replica.apply(ins2)
+    replica.validate_op(dele)  # contiguous + target observed
+
+    # unseen-target branch: the delete's OWN dot is contiguous (fresh
+    # actor "b"), but the targeted insert (a,2) was never observed
+    deleter = site.clone()
+    del_unseen = deleter.delete_index(1, "b")  # dot (b,1), targets (a,2)
+    behind = List()
+    behind.apply(ins1)  # saw only (a,1)
+    with pytest.raises(DotRange):
+        behind.validate_op(del_unseen)
+    replica.validate_op(del_unseen)  # replica saw (a,2): fine
+    with pytest.raises(ValidationError):
+        replica.validate_op(object())
+
+    # device path: duplicate delivery of one trace op to one replica
+    from crdt_tpu.native import INSERT
+
+    kinds, idxs, vals, actors = [INSERT, INSERT], [0, 1], [1, 2], [0, 0]
+    model = BatchedList.from_trace(kinds, idxs, vals, actors, n_replicas=2)
+    with configured(strict=True):
+        with pytest.raises(DotRange):
+            model.apply_ops(np.asarray([[0, 0], [1, -1]]))
+    model.apply_ops(np.asarray([[0, 1], [1, -1]]))  # unique: fine
+
+
+def test_counter_dtype_u64_and_saturation_trap():
+    """Counter-width parity (reference src/vclock.rs u64; SURVEY §7.3
+    overflow discipline): the clock/counter family widens to uint64 via
+    config, and the u32 path traps saturation under strict mode instead
+    of silently wrapping."""
+    import numpy as np
+
+    from crdt_tpu.models import BatchedPNCounter, BatchedVClock
+    from crdt_tpu.traits import CounterSaturation
+    from crdt_tpu.utils import Interner
+
+    # u64: increments past 2^32 accumulate exactly
+    with configured(counter_dtype="uint64"):
+        pn = BatchedPNCounter(1, actors=Interner(["a"]))
+        assert str(pn.p.clocks.dtype) == "uint64"
+        big = (1 << 32) + 5
+        pn.inc(0, "a", steps=big)
+        pn.dec(0, "a", steps=3)
+        assert pn.fold_read() == big - 3
+        vc = BatchedVClock(1, actors=Interner(["a"]))
+        assert str(vc.clocks.dtype) == "uint64"
+
+    # u32 + strict: an increment that would exceed the lane max traps
+    with configured(counter_dtype="uint32", strict=True):
+        pn32 = BatchedPNCounter(1, actors=Interner(["a"]))
+        pn32.inc(0, "a", steps=(1 << 32) - 2)
+        with pytest.raises(CounterSaturation):
+            pn32.inc(0, "a", steps=5)
+        # a saturated top lane rejects further dot mints too
+        vc32 = BatchedVClock(1, actors=Interner(["a"]))
+        vc32.clocks = vc32.clocks.at[0, 0].set(np.uint32((1 << 32) - 1))
+        from crdt_tpu.dot import Dot
+
+        with pytest.raises(CounterSaturation):
+            vc32.apply(0, Dot("a", 1))
+
+    # steps outside the dtype envelope rejected on both widths
+    pn = BatchedPNCounter(1, actors=Interner(["a"]))
+    with pytest.raises(ValueError):
+        pn.inc(0, "a", steps=1 << 33)

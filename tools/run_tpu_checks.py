@@ -123,6 +123,10 @@ def main() -> int:
     bench.bench_map()
     print(f"config4 1M-key fused fold ran      [{time.time()-t0:.0f}s]")
 
+    t0 = time.time()
+    bench.bench_list()  # BASELINE scale: 100k-op trace x 1024 replicas
+    print(f"config5 100kx1024 ran              [{time.time()-t0:.0f}s]")
+
     print("ALL TPU CHECKS PASSED")
     return 0
 
