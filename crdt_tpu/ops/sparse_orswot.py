@@ -1,0 +1,365 @@
+"""Compressed (segment-encoded) ORSWOT — the sparse mode for element
+universes where the dense ``ctr[R, E, A]`` cube stops scaling.
+
+SURVEY.md §7.3 names the tension: 10k replicas × 1M elements × A actors
+cannot be a dense u32 cube (4·E·A bytes per replica regardless of how
+many dots are LIVE). This module is the compressed dot representation
+the survey prescribes — exactly why ORSWOT is tombstone-free: the top
+clock subsumes removal history, so a replica's whole state is
+
+    top[A]  +  the set of live dots {(element, actor, counter)}.
+
+TPU form (static shapes, no ragged data): a bounded dot-segment table
+sorted by (element, actor) —
+
+- ``eid [..., C] int32``  — element id per live dot (-pad = invalid),
+- ``act [..., C] int32``  — actor lane,
+- ``ctr [..., C] u32``    — the dot counter (> 0 where valid),
+- ``valid [..., C] bool``,
+
+plus the same masked-epoch deferred-removal buffer as the dense form,
+with element LISTS instead of E-wide masks (``dcl [D, A]``,
+``didx [D, Q]`` element ids, ``dvalid [D]``).
+
+``join`` is the reference merge rule (src/orswot.rs ``CvRDT::merge``)
+on segments: concatenate both tables, keep a dot iff the other side
+also holds it (same triple) or its counter exceeds the other top's
+actor lane, dedupe identical triples, sort-compact to capacity. The
+sort is the price of sparsity (XLA lowers it to a bitonic network —
+O(C log² C) VPU work vs the dense join's O(E·A) HBM traffic), which is
+the crossover the bench measures: sparse wins when live dots ≪ E·A.
+
+Capacity discipline matches the deferred buffers: ``C`` bounds live
+dots per replica; a join whose survivor set exceeds C reports overflow
+(callers size C for their workload — the A/B suite pins behavior below
+capacity bit-identically to the dense form via ``to_dense``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .orswot import OrswotState, empty as dense_empty
+
+DTYPE = jnp.uint32
+
+
+class SparseOrswotState(NamedTuple):
+    """A (possibly batched) segment-encoded ORSWOT replica (pytree)."""
+
+    top: jax.Array    # [..., A]
+    eid: jax.Array    # [..., C] int32
+    act: jax.Array    # [..., C] int32
+    ctr: jax.Array    # [..., C] u32
+    valid: jax.Array  # [..., C]
+    dcl: jax.Array    # [..., D, A]
+    didx: jax.Array   # [..., D, Q] int32 element ids (-1 = empty lane)
+    dvalid: jax.Array # [..., D]
+
+
+def empty(
+    dot_cap: int,
+    n_actors: int,
+    deferred_cap: int = 4,
+    rm_width: int = 8,
+    batch: tuple = (),
+) -> SparseOrswotState:
+    """The join identity: no dots, no parked removes."""
+    return SparseOrswotState(
+        top=jnp.zeros((*batch, n_actors), DTYPE),
+        eid=jnp.full((*batch, dot_cap), -1, jnp.int32),
+        act=jnp.zeros((*batch, dot_cap), jnp.int32),
+        ctr=jnp.zeros((*batch, dot_cap), DTYPE),
+        valid=jnp.zeros((*batch, dot_cap), bool),
+        dcl=jnp.zeros((*batch, deferred_cap, n_actors), DTYPE),
+        didx=jnp.full((*batch, deferred_cap, rm_width), -1, jnp.int32),
+        dvalid=jnp.zeros((*batch, deferred_cap), bool),
+    )
+
+
+def _canon(eid, act, ctr, valid, cap: int):
+    """Sort live dots by (eid, act, ctr), dead lanes last with zeroed
+    payload; truncate to ``cap``. Returns the table + overflow flag."""
+    order = jnp.lexsort((ctr, act, jnp.where(valid, eid, jnp.iinfo(jnp.int32).max), ~valid), axis=-1)
+    take = lambda x: jnp.take_along_axis(x, order, axis=-1)
+    eid, act, ctr, valid = take(eid), take(act), take(ctr), take(valid)
+    overflow = jnp.sum(valid, axis=-1) > cap
+    eid, act, ctr, valid = (
+        eid[..., :cap], act[..., :cap], ctr[..., :cap], valid[..., :cap]
+    )
+    return (
+        jnp.where(valid, eid, -1),
+        jnp.where(valid, act, 0),
+        jnp.where(valid, ctr, 0),
+        valid,
+        overflow,
+    )
+
+
+def _replay_parked(eid, act, ctr, valid, dcl, didx, dvalid):
+    """Kill dots of listed elements that the parked rm clocks cover
+    (the oracle's deferred-remove replay): dot (e, a, c) dies iff some
+    valid slot lists e and has clock[a] >= c."""
+    listed = jnp.any(
+        eid[..., None, :, None] == didx[..., :, None, :], axis=-1
+    )  # [..., D, C]
+    cl_at = jnp.take_along_axis(
+        dcl, jnp.broadcast_to(act[..., None, :], listed.shape), axis=-1
+    )  # [..., D, C] clock value at each dot's actor lane
+    covered = listed & (ctr[..., None, :] <= cl_at) & dvalid[..., None]
+    return valid & ~jnp.any(covered, axis=-2)
+
+
+def _match_other(eid, act, valid, oeid, oact, octr, ovalid, n_act: int):
+    """For each segment lane: the OTHER side's counter at the same
+    (element, actor) cell (0 = absent), plus the match mask.
+
+    Both tables are in canonical segment order (valid-first, sorted by
+    (eid, act); (eid, act) is unique per replica — the dense form keeps
+    one counter per cell), so the packed key ``eid·A + act`` is strictly
+    ascending over the valid prefix and a binary search replaces the
+    all-pairs matrix: O(C log C), which is what keeps the documented
+    O(C log² C) join cost honest. The int32 key bounds the universe at
+    ``E·A < 2^31`` (E ≤ 268M at A=8 — far past any dense-comparable
+    scale)."""
+    if eid.ndim > 1:
+        inner = partial(_match_other, n_act=n_act)
+        return jax.vmap(inner)(eid, act, valid, oeid, oact, octr, ovalid)
+    big = jnp.iinfo(jnp.int32).max
+    key = jnp.where(valid, eid * n_act + act, big)
+    okey = jnp.where(ovalid, oeid * n_act + oact, big)
+    pos = jnp.clip(jnp.searchsorted(okey, key), 0, okey.shape[-1] - 1)
+    hit = valid & jnp.take(ovalid, pos) & (jnp.take(okey, pos) == key)
+    return jnp.where(hit, jnp.take(octr, pos), 0), hit
+
+
+@jax.jit
+def join(a: SparseOrswotState, b: SparseOrswotState):
+    """Pairwise lattice join on dot segments — the reference merge rule
+    with top-clock subsumption. A cell counter is a PREFIX clock (the
+    per-element VClock lane: counter c attests dots 1..c by that actor
+    — exactly the dense ``ctr[e, a]`` semantics), so the per-cell rule
+    mirrors ops.orswot.join's: common part ``min(ca, cb)`` ∪ each
+    side's unseen tail (``c > other.top[actor]``); a cell held by one
+    side only keeps its unseen tail. Inputs must be in canonical
+    segment order (every constructor and ``join`` itself produce it).
+    Returns ``(state, overflow)``; overflow's two lanes are
+    [dot-capacity, deferred-capacity]."""
+    n_act = a.top.shape[-1]
+    cb_at_a, a_matched = _match_other(
+        a.eid, a.act, a.valid, b.eid, b.act, b.ctr, b.valid, n_act
+    )
+    _, b_matched = _match_other(
+        b.eid, b.act, b.valid, a.eid, a.act, a.ctr, a.valid, n_act
+    )
+    btop_at_a = jnp.take_along_axis(b.top, a.act, axis=-1)
+    atop_at_b = jnp.take_along_axis(a.top, b.act, axis=-1)
+    wa = jnp.where(a.ctr > btop_at_a, a.ctr, 0)
+    wb_at_a = jnp.where(cb_at_a > jnp.take_along_axis(a.top, a.act, axis=-1), cb_at_a, 0)
+    out_a = jnp.maximum(jnp.minimum(a.ctr, cb_at_a), jnp.maximum(wa, wb_at_a))
+    out_a = jnp.where(a.valid, out_a, 0)
+    # b's matched cells are fully accounted for by a's lane; keep only
+    # b's unmatched unseen tails.
+    wb = jnp.where(b.ctr > atop_at_b, b.ctr, 0)
+    out_b = jnp.where(b.valid & ~b_matched, wb, 0)
+
+    eid = jnp.concatenate([a.eid, b.eid], axis=-1)
+    act = jnp.concatenate([a.act, b.act], axis=-1)
+    ctr = jnp.concatenate([out_a, out_b], axis=-1)
+    valid = jnp.concatenate([out_a > 0, out_b > 0], axis=-1)
+    top = jnp.maximum(a.top, b.top)
+
+    # Deferred union (dict-union on equal clocks as element-list union),
+    # replay against the joined dots, drop caught-up slots, compact.
+    dcl = jnp.concatenate([a.dcl, b.dcl], axis=-2)
+    didx = jnp.concatenate([a.didx, b.didx], axis=-2)
+    dvalid = jnp.concatenate([a.dvalid, b.dvalid], axis=-1)
+    dcl, didx, dvalid = _dedupe_parked(dcl, didx, dvalid)
+    valid = _replay_parked(eid, act, ctr, valid, dcl, didx, dvalid)
+    still = ~jnp.all(dcl <= top[..., None, :], axis=-1)
+    dvalid = dvalid & still
+    dcl, didx, dvalid, d_of = _compact_parked(
+        dcl, didx, dvalid, a.dcl.shape[-2]
+    )
+
+    eid, act, ctr, valid, overflow = _canon(
+        eid, act, ctr, valid, a.eid.shape[-1]
+    )
+    return (
+        SparseOrswotState(
+            top=top, eid=eid, act=act, ctr=ctr, valid=valid,
+            dcl=dcl, didx=didx, dvalid=dvalid,
+        ),
+        jnp.stack([jnp.any(overflow), jnp.any(d_of)]),
+    )
+
+
+_INT32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _canon_rmlist(didx):
+    """Canonical parked-element list: ids sorted ascending, duplicates
+    removed, -1 padding last — equal sets compare equal as raw lanes
+    (join commutativity holds bitwise)."""
+    big = jnp.where(didx < 0, _INT32_MAX, didx)
+    s = jnp.sort(big, axis=-1)
+    dup = jnp.concatenate(
+        [jnp.zeros_like(s[..., :1], bool), s[..., 1:] == s[..., :-1]], axis=-1
+    )
+    s = jnp.sort(jnp.where(dup, _INT32_MAX, s), axis=-1)
+    return jnp.where(s == _INT32_MAX, -1, s)
+
+
+def _dedupe_parked(dcl, didx, dvalid):
+    """Union element lists of slots holding equal rm clocks (the
+    oracle's ``defer_remove`` dict-union), keeping the first slot of
+    each group — when the UNIQUE union fits the fixed Q lanes (identical
+    slots therefore always merge, keeping the join idempotent). A group
+    whose deduplicated union exceeds Q keeps its member slots separate
+    instead (replay is per-slot, so correctness is unaffected; only the
+    capacity accounting is conservative — the buffer may flag overflow
+    where the oracle's dict would not)."""
+    d = dcl.shape[-2]
+    q = didx.shape[-1]
+    idx = jnp.arange(d)
+    eq = (
+        dvalid[..., :, None]
+        & dvalid[..., None, :]
+        & jnp.all(dcl[..., :, None, :] == dcl[..., None, :, :], axis=-1)
+    )  # [..., D, D]
+    rep = jnp.argmax(eq, axis=-2)          # first valid slot w/ equal clock
+    is_rep = dvalid & (rep == idx)
+    # group[i, j]: slot j belongs to representative i
+    group = eq & (rep[..., None, :] == idx[..., :, None])
+    gathered = jnp.where(
+        group[..., None], didx[..., None, :, :], -1
+    ).reshape(*didx.shape[:-2], d, d * q)
+    union = _canon_rmlist(gathered)        # sorted unique, -1 last
+    need = jnp.sum(union >= 0, axis=-1)
+    fits = need <= q                       # [..., D] per representative
+    didx = jnp.where((is_rep & fits)[..., None], union[..., :q], didx)
+    absorbed = jnp.any(
+        group & fits[..., :, None] & ~jnp.eye(d, dtype=bool), axis=-2
+    )  # member slots folded into a fitting representative
+    return dcl, didx, dvalid & ~absorbed
+
+
+def _compact_parked(dcl, didx, dvalid, cap: int):
+    order = jnp.argsort(~dvalid, axis=-1, stable=True)
+    dcl = jnp.take_along_axis(dcl, order[..., None], axis=-2)
+    didx = jnp.take_along_axis(didx, order[..., None], axis=-2)
+    dvalid = jnp.take_along_axis(dvalid, order, axis=-1)
+    overflow = jnp.sum(dvalid, axis=-1) > cap
+    dcl, didx, dvalid = dcl[..., :cap, :], didx[..., :cap, :], dvalid[..., :cap]
+    dcl = jnp.where(dvalid[..., None], dcl, 0)
+    didx = _canon_rmlist(jnp.where(dvalid[..., None], didx, -1))
+    return dcl, didx, dvalid, overflow
+
+
+def fold(states: SparseOrswotState):
+    """Log-tree fold of a replica batch (leading axis)."""
+    from .lattice import tree_fold
+
+    identity = jax.tree.map(lambda x: jnp.zeros(x.shape[1:], x.dtype), states)
+    identity = identity._replace(
+        eid=jnp.full_like(identity.eid, -1),
+        didx=jnp.full_like(identity.didx, -1),
+    )
+    return tree_fold(states, identity, join)
+
+
+# ---- dense interop (the A/B boundary) ------------------------------------
+
+def from_dense(state: OrswotState, dot_cap: int, rm_width: int = 8):
+    """Dense → sparse. Raises if live dots exceed ``dot_cap`` or any
+    parked mask lists more than ``rm_width`` elements (host-side check;
+    conversion is a tooling/test path, not a hot loop)."""
+    import numpy as np
+
+    top = np.asarray(state.top)
+    ctr = np.asarray(state.ctr)
+    dmask = np.asarray(state.dmask)
+    batch = ctr.shape[:-2]
+    flat = int(np.prod(batch)) if batch else 1
+    e, a = ctr.shape[-2:]
+    d = state.dcl.shape[-2]
+    out = empty(
+        dot_cap, a, deferred_cap=d, rm_width=rm_width, batch=batch
+    )
+    eid = np.full((flat, dot_cap), -1, np.int32)
+    act = np.zeros((flat, dot_cap), np.int32)
+    cv = np.zeros((flat, dot_cap), np.uint32)
+    valid = np.zeros((flat, dot_cap), bool)
+    didx = np.full((flat, d, rm_width), -1, np.int32)
+    for i in range(flat):
+        es, as_ = np.nonzero(ctr.reshape(flat, e, a)[i])
+        if len(es) > dot_cap:
+            raise ValueError(f"replica {i}: {len(es)} live dots > cap {dot_cap}")
+        eid[i, : len(es)] = es
+        act[i, : len(es)] = as_
+        cv[i, : len(es)] = ctr.reshape(flat, e, a)[i, es, as_]
+        valid[i, : len(es)] = True
+        for s in range(d):
+            els = np.nonzero(dmask.reshape(flat, d, e)[i, s])[0]
+            if len(els) > rm_width:
+                raise ValueError(
+                    f"replica {i} slot {s}: {len(els)} parked elements > "
+                    f"rm_width {rm_width}"
+                )
+            didx[i, s, : len(els)] = els
+    rs = lambda x: jnp.asarray(x.reshape(*batch, *x.shape[1:]) if batch else x[0])
+    out = out._replace(
+        top=jnp.asarray(top),
+        eid=rs(eid), act=rs(act), ctr=rs(cv), valid=rs(valid),
+        dcl=state.dcl, didx=rs(didx), dvalid=state.dvalid,
+    )
+    # Canonical order so sparse states are comparable as raw arrays.
+    ceid, cact, cctr, cvalid, _ = _canon(
+        out.eid, out.act, out.ctr, out.valid, dot_cap
+    )
+    return out._replace(eid=ceid, act=cact, ctr=cctr, valid=cvalid)
+
+
+def to_dense(state: SparseOrswotState, n_elems: int) -> OrswotState:
+    """Sparse → dense (the bit-identity bridge to ops.orswot)."""
+    lead = state.eid.shape[:-1]
+    a = state.top.shape[-1]
+    d = state.dcl.shape[-2]
+
+    def one(s: SparseOrswotState) -> OrswotState:
+        out = dense_empty(n_elems, a, deferred_cap=d)
+        safe_e = jnp.where(s.valid, s.eid, n_elems)  # OOB lanes drop
+        ctr = out.ctr.at[safe_e, s.act].max(
+            jnp.where(s.valid, s.ctr, 0), mode="drop"
+        )
+        safe_q = jnp.where(s.didx >= 0, s.didx, n_elems)
+        dmask = out.dmask.at[jnp.arange(d)[:, None], safe_q].set(
+            True, mode="drop"
+        )
+        dmask = dmask & s.dvalid[..., None]
+        return out._replace(
+            top=s.top, ctr=ctr, dcl=s.dcl, dmask=dmask, dvalid=s.dvalid
+        )
+
+    if not lead:
+        return one(state)
+    import numpy as np
+
+    n = int(np.prod(lead))
+    flat = jax.tree.map(lambda x: x.reshape(n, *x.shape[len(lead):]), state)
+    out = jax.vmap(one)(flat)
+    return jax.tree.map(lambda x: x.reshape(*lead, *x.shape[1:]), out)
+
+
+def nbytes(state: SparseOrswotState) -> int:
+    """Device bytes of one replica's sparse state (the crossover
+    metric vs the dense 4·E·A + masks)."""
+    import numpy as np
+
+    total = sum(x.nbytes for x in jax.tree_util.tree_leaves(state))
+    lead = state.eid.shape[:-1]
+    return total // (int(np.prod(lead)) if lead else 1)

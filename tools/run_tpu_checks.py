@@ -127,6 +127,15 @@ def main() -> int:
     bench.bench_list()  # BASELINE scale: 100k-op trace x 1024 replicas
     print(f"config5 100kx1024 ran              [{time.time()-t0:.0f}s]")
 
+    # In-process (libtpu is exclusive per process — a subprocess could
+    # not reach the already-initialized chip).
+    t0 = time.time()
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    from sparse_crossover import run as crossover_run
+
+    line = crossover_run()
+    print(f"sparse crossover: {line}   [{time.time()-t0:.0f}s]")
+
     print("ALL TPU CHECKS PASSED")
     return 0
 
