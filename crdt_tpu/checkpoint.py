@@ -29,6 +29,7 @@ from .models.map import BatchedMap
 from .models.map3 import BatchedMap3
 from .models.map_nested import BatchedMapOrswot, BatchedNestedMap
 from .models.orswot import BatchedOrswot
+from .models.sparse_orswot import BatchedSparseOrswot
 from .native import DELETE, INSERT
 from .ops import map as map_ops
 from .ops import mvreg as mv_ops
@@ -123,6 +124,13 @@ def save(path: Union[str, os.PathLike], model) -> None:
     if isinstance(model, BatchedOrswot):
         meta = {
             "kind": "orswot",
+            "members": _interner_items(model.members),
+            "actors": _interner_items(model.actors),
+        }
+        arrays = {f"s_{k}": np.asarray(v) for k, v in model.state._asdict().items()}
+    elif isinstance(model, BatchedSparseOrswot):
+        meta = {
+            "kind": "sparse_orswot",
             "members": _interner_items(model.members),
             "actors": _interner_items(model.actors),
         }
@@ -251,6 +259,23 @@ def load(path: Union[str, os.PathLike]):
             state.ctr.shape[-2],
             state.ctr.shape[-1],
             state.dcl.shape[-2],
+            members=_interner_from(meta["members"]),
+            actors=_interner_from(meta["actors"]),
+        )
+        model.state = state
+        return model
+    if meta["kind"] == "sparse_orswot":
+        from .ops import sparse_orswot as sparse_ops
+
+        state = sparse_ops.SparseOrswotState(
+            **{k[2:]: dev(v) for k, v in arrays.items() if k.startswith("s_")}
+        )
+        model = BatchedSparseOrswot(
+            state.top.shape[0],
+            state.eid.shape[-1],
+            state.top.shape[-1],
+            state.dcl.shape[-2],
+            state.didx.shape[-1],
             members=_interner_from(meta["members"]),
             actors=_interner_from(meta["actors"]),
         )

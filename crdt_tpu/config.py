@@ -136,7 +136,9 @@ def replicaset(
     axis, ``n_members`` sizes the inner axis of the nested kinds — the
     member universe for map_orswot, the INNER key universe (K2) for
     map_map — ``n_keys2`` the K2 axis of map3, and ``n_actors`` the
-    actor lanes."""
+    actor lanes. ``sparse_orswot`` (xla) is the segment-encoded mode
+    for huge member universes: ``n_members`` there sizes the LIVE-dot
+    capacity, not the universe (which is unbounded)."""
     config.validate()
     if config.backend == "pure":
         from .pure.gcounter import GCounter
@@ -158,6 +160,7 @@ def replicaset(
             "gset": GSet,
             "lwwreg": LWWReg,
             "mvreg": MVReg,
+            "sparse_orswot": Orswot,  # same oracle; sparsity is a backend trait
         }
         if kind not in factories:
             raise ValueError(f"unknown replicaset kind {kind!r}")
@@ -174,11 +177,16 @@ def replicaset(
         BatchedNestedMap,
         BatchedOrswot,
         BatchedPNCounter,
+        BatchedSparseOrswot,
     )
 
     if kind == "orswot":
         return BatchedOrswot(
             n_replicas, n_members or 64, n_actors or 16, config.deferred_cap
+        )
+    if kind == "sparse_orswot":
+        return BatchedSparseOrswot(
+            n_replicas, n_members or 256, n_actors or 16, config.deferred_cap
         )
     if kind == "map":
         return BatchedMap(

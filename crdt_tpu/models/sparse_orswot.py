@@ -1,0 +1,264 @@
+"""BatchedSparseOrswot — N segment-encoded ORSWOT replicas on device.
+
+The sparse counterpart of :class:`.orswot.BatchedOrswot` for element
+universes where the dense ``ctr[R, E, A]`` cube stops scaling (SURVEY.md
+§7.3): state size tracks LIVE (member, actor) cells, not the universe.
+Members are interned exactly as in the dense model — the member
+universe may be unboundedly large; only ``dot_cap`` bounds the live
+cells per replica. Conversion to/from the oracle is lossless (including
+parked removes, bounded by ``rm_width`` elements per parked clock), and
+never materializes a dense cube.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import sparse_orswot as ops
+from ..pure.orswot import Add, Orswot, Rm
+from ..utils import Interner
+from ..utils.metrics import metrics
+from ..vclock import VClock
+from .orswot import DeferredOverflow
+
+
+class DotCapacityOverflow(RuntimeError):
+    """A replica's live cells exceeded ``dot_cap`` — rebuild the model
+    with a larger capacity (sparse mode bounds live dots, not the
+    universe)."""
+
+
+class BatchedSparseOrswot:
+    def __init__(
+        self,
+        n_replicas: int,
+        dot_cap: int,
+        n_actors: int,
+        deferred_cap: int = 4,
+        rm_width: int = 8,
+        members: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+    ):
+        self.members = members if members is not None else Interner()
+        self.actors = actors if actors is not None else Interner()
+        self.state = ops.empty(
+            dot_cap, n_actors, deferred_cap, rm_width, batch=(n_replicas,)
+        )
+
+    @property
+    def n_replicas(self) -> int:
+        return self.state.top.shape[0]
+
+    @property
+    def dot_cap(self) -> int:
+        return self.state.eid.shape[-1]
+
+    # ---- conversion (the A/B gate boundary) ---------------------------
+    @classmethod
+    def from_pure(
+        cls,
+        pures: Sequence[Orswot],
+        dot_cap: int = 256,
+        deferred_cap: int = 4,
+        rm_width: int = 8,
+        members: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+        n_actors: int = 1,
+    ) -> "BatchedSparseOrswot":
+        members = members if members is not None else Interner()
+        actors = actors if actors is not None else Interner()
+        for p in pures:
+            for a in p.clock.dots:
+                actors.intern(a)
+            for m, clock in p.entries.items():
+                members.intern(m)
+                for a in clock.dots:
+                    actors.intern(a)
+            for clock, ms in p.deferred.items():
+                for a in clock.dots:
+                    actors.intern(a)
+                for m in ms:
+                    members.intern(m)
+
+        r = len(pures)
+        na = max(len(actors), n_actors, 1)
+        out = cls(
+            r, dot_cap, na, deferred_cap, rm_width,
+            members=members, actors=actors,
+        )
+        top = np.zeros((r, na), np.uint32)
+        eid = np.full((r, dot_cap), -1, np.int32)
+        act = np.zeros((r, dot_cap), np.int32)
+        ctr = np.zeros((r, dot_cap), np.uint32)
+        valid = np.zeros((r, dot_cap), bool)
+        dcl = np.zeros((r, deferred_cap, na), np.uint32)
+        didx = np.full((r, deferred_cap, rm_width), -1, np.int32)
+        dvalid = np.zeros((r, deferred_cap), bool)
+        for i, p in enumerate(pures):
+            for a, c in p.clock.dots.items():
+                top[i, actors.id_of(a)] = c
+            cells = sorted(
+                (members.id_of(m), actors.id_of(a), c)
+                for m, clock in p.entries.items()
+                for a, c in clock.dots.items()
+            )
+            if len(cells) > dot_cap:
+                raise DotCapacityOverflow(
+                    f"replica {i}: {len(cells)} live cells > dot_cap {dot_cap}"
+                )
+            for s, (e, a, c) in enumerate(cells):
+                eid[i, s], act[i, s], ctr[i, s], valid[i, s] = e, a, c, True
+            if len(p.deferred) > deferred_cap:
+                raise DeferredOverflow(
+                    f"replica {i}: {len(p.deferred)} parked removes; "
+                    f"capacity is {deferred_cap}"
+                )
+            for s, (clock, ms) in enumerate(p.deferred.items()):
+                ids = sorted(members.id_of(m) for m in ms)
+                if len(ids) > rm_width:
+                    raise DeferredOverflow(
+                        f"replica {i} slot {s}: {len(ids)} parked elements "
+                        f"> rm_width {rm_width}"
+                    )
+                for a, c in clock.dots.items():
+                    dcl[i, s, actors.id_of(a)] = c
+                didx[i, s, : len(ids)] = ids
+                dvalid[i, s] = True
+        out.state = ops.SparseOrswotState(
+            top=jnp.asarray(top), eid=jnp.asarray(eid), act=jnp.asarray(act),
+            ctr=jnp.asarray(ctr), valid=jnp.asarray(valid),
+            dcl=jnp.asarray(dcl), didx=jnp.asarray(didx),
+            dvalid=jnp.asarray(dvalid),
+        )
+        return out
+
+    def _row(self, arrs, i: int):
+        return jax.tree.map(lambda x: x[i], arrs)
+
+    def to_pure(self, i: int) -> Orswot:
+        st = jax.device_get(self._row(self.state, i))
+        out = Orswot()
+        out.clock = VClock(
+            {self.actors[a]: int(c) for a, c in enumerate(st.top) if c > 0}
+        )
+        for s in np.nonzero(st.valid)[0]:
+            m = self.members[int(st.eid[s])]
+            entry = out.entries.setdefault(m, VClock())
+            entry.dots[self.actors[int(st.act[s])]] = int(st.ctr[s])
+        for s in np.nonzero(st.dvalid)[0]:
+            clock = VClock(
+                {self.actors[a]: int(c) for a, c in enumerate(st.dcl[s]) if c > 0}
+            )
+            # Equal-clock slots union into ONE oracle entry (the sparse
+            # form legitimately splits a clock's list across slots when
+            # the union exceeds rm_width — the oracle's dict cannot).
+            out.deferred.setdefault(clock, set()).update(
+                self.members[int(e)] for e in st.didx[s] if e >= 0
+            )
+        return out
+
+    # ---- op path (CmRDT) ----------------------------------------------
+    def _eids(self, members_iter, width: Optional[int] = None) -> np.ndarray:
+        """Intern the op's members into a fixed-width id list. ``width``
+        None sizes by the op (rounded up to a power-of-two bucket ≥ 8 to
+        bound jit retraces); the rm path passes ``rm_width`` because a
+        parked list must fit its buffer lane."""
+        ids = [self.members.intern(m) for m in members_iter]
+        if width is None:
+            width = 8
+            while width < len(ids):
+                width *= 2
+        if len(ids) > width:
+            raise ValueError(
+                f"op lists {len(ids)} members; rm_width is {width} — "
+                f"rebuild with a larger rm_width or split the op"
+            )
+        out = np.full(width, -1, np.int32)
+        out[: len(ids)] = ids
+        return out
+
+    def apply(self, replica: int, op) -> None:
+        """Apply an oracle-shaped op to one replica (reference:
+        src/orswot.rs ``CmRDT::apply``)."""
+        from .validation import strict_validate_dot
+
+        row = self._row(self.state, replica)
+        na = self.state.top.shape[-1]
+        if isinstance(op, Add):
+            strict_validate_dot(row.top, self.actors, op.dot.actor, op.dot.counter)
+            aid = self.actors.bounded_intern(op.dot.actor, na, "actor")
+            row, overflow = ops.apply_add(
+                row,
+                jnp.asarray(aid),
+                jnp.asarray(np.uint32(op.dot.counter)),
+                jnp.asarray(self._eids(op.members)),
+            )
+            if bool(overflow):
+                raise DotCapacityOverflow(
+                    f"replica {replica}: dot_cap {self.dot_cap} exceeded"
+                )
+        elif isinstance(op, Rm):
+            clock = np.zeros((na,), np.uint32)
+            for actor, c in op.clock.dots.items():
+                clock[self.actors.bounded_intern(actor, na, "actor")] = c
+            row, overflow = ops.apply_rm(
+                row,
+                jnp.asarray(clock),
+                jnp.asarray(
+                    self._eids(op.members, width=self.state.didx.shape[-1])
+                ),
+            )
+            if bool(overflow):
+                raise DeferredOverflow(
+                    f"replica {replica}: deferred buffer full "
+                    f"(cap {self.state.dvalid.shape[-1]})"
+                )
+        else:
+            raise TypeError(f"not an Orswot op: {op!r}")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[replica].set(r), self.state, row
+        )
+
+    # ---- state path (CvRDT) -------------------------------------------
+    def _check(self, flags, what: str) -> None:
+        if bool(flags[0]):
+            raise DotCapacityOverflow(
+                f"{what}: survivor cells exceed dot_cap {self.dot_cap}"
+            )
+        if bool(flags[1]):
+            raise DeferredOverflow(f"{what}: deferred buffer full")
+
+    def merge_from(self, dst: int, src: int) -> None:
+        metrics.count("sparse_orswot.merges")
+        joined, flags = ops.join(
+            self._row(self.state, dst), self._row(self.state, src)
+        )
+        self._check(flags, f"merge {src}->{dst}")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[dst].set(r), self.state, joined
+        )
+
+    def fold(self) -> Orswot:
+        """Full-mesh anti-entropy: join all replicas, return the
+        converged oracle-form state."""
+        metrics.count("sparse_orswot.merges", max(self.n_replicas - 1, 0))
+        folded, flags = ops.fold(self.state)
+        self._check(flags, "fold")
+        tmp = BatchedSparseOrswot(
+            1, self.dot_cap, self.state.top.shape[-1],
+            self.state.dcl.shape[-2], self.state.didx.shape[-1],
+            members=self.members, actors=self.actors,
+        )
+        tmp.state = jax.tree.map(lambda x: x[None], folded)
+        return tmp.to_pure(0)
+
+    def members_of(self, i: int) -> frozenset:
+        st = jax.device_get(self._row(self.state, i))
+        return frozenset(
+            self.members[int(e)]
+            for e in np.unique(np.asarray(st.eid)[np.asarray(st.valid)])
+        )

@@ -260,6 +260,129 @@ def _compact_parked(dcl, didx, dvalid, cap: int):
     return dcl, didx, dvalid, overflow
 
 
+# ---- op application (CmRDT) ----------------------------------------------
+
+@jax.jit
+def apply_add(
+    state: SparseOrswotState,
+    actor: jax.Array,
+    counter: jax.Array,
+    eids: jax.Array,
+):
+    """CmRDT add-op application on segments (reference: src/orswot.rs
+    apply, Op::Add): drop already-seen dots, else stamp the birth dot on
+    every listed element — updating existing (element, actor) cells in
+    place and inserting new cells into free lanes — advance the top,
+    and replay parked removes. ``eids [W] int32`` lists the op's member
+    ids (-1 = pad). Unbatched state. Returns ``(state, overflow)``;
+    overflow = not enough free lanes for the new cells."""
+    c = state.eid.shape[-1]
+    n_act = state.top.shape[-1]
+    counter = counter.astype(state.top.dtype)
+    seen = state.top[actor] >= counter
+    want = eids >= 0
+
+    # Existing (eid, actor) cells among the targets.
+    big = jnp.iinfo(jnp.int32).max
+    okey = jnp.where(state.valid, state.eid * n_act + state.act, big)
+    tkey = jnp.where(want, eids * n_act + actor, big)
+    pos = jnp.clip(jnp.searchsorted(okey, tkey), 0, c - 1)
+    hit = want & (jnp.take(okey, pos) == tkey)
+    ctr = state.ctr.at[jnp.where(hit & ~seen, pos, c)].max(
+        counter, mode="drop"
+    )
+
+    # New cells into free lanes, one per missing target, scattered via
+    # out-of-range drop for every non-inserting position (no lane
+    # collisions: put ranks are unique, everything else targets lane C).
+    miss = want & ~hit & ~seen
+    free_order = jnp.argsort(state.valid, stable=True)  # invalid lanes first
+    n_free = jnp.sum(~state.valid)
+    slot_rank = jnp.cumsum(miss) - 1
+    put = miss & (slot_rank < n_free)
+    overflow = jnp.any(miss & (slot_rank >= n_free))
+    lane = jnp.where(
+        put, jnp.take(free_order, jnp.clip(slot_rank, 0, c - 1)), c
+    )
+    eid = state.eid.at[lane].set(eids, mode="drop")
+    act = state.act.at[lane].set(
+        jnp.broadcast_to(actor, eids.shape), mode="drop"
+    )
+    ctr = ctr.at[lane].set(counter, mode="drop")
+    valid = state.valid.at[lane].set(True, mode="drop")
+
+    top = jnp.where(seen, state.top, state.top.at[actor].max(counter))
+    valid = _replay_parked(eid, act, ctr, valid, state.dcl, state.didx, state.dvalid)
+    still = ~jnp.all(state.dcl <= top[None, :], axis=-1)
+    eid, act, ctr, valid, _ = _canon(eid, act, ctr, valid, c)
+    return (
+        state._replace(
+            top=top, eid=eid, act=act, ctr=ctr, valid=valid,
+            dvalid=state.dvalid & still,
+        ),
+        overflow & ~seen,
+    )
+
+
+@jax.jit
+def apply_rm(state: SparseOrswotState, rm_clock: jax.Array, eids: jax.Array):
+    """CmRDT rm-op application on segments (reference: src/orswot.rs
+    apply_rm): kill the covered part now (cells of listed elements whose
+    counter the rm clock covers); park the (clock, element-list) if the
+    clock runs ahead of the top — union onto an equal-clock slot when
+    the combined list fits, else claim a free slot. Unbatched state.
+    Returns ``(state, overflow)``."""
+    q = state.didx.shape[-1]
+    w = eids.shape[-1]
+    assert w <= q, "rm op element-list width must fit rm_width"
+    rm_clock = jnp.asarray(rm_clock, state.top.dtype)
+    listed = jnp.any(
+        (state.eid[:, None] == eids[None, :]) & (eids[None, :] >= 0), axis=-1
+    )
+    covered = (
+        state.valid & listed & (state.ctr <= jnp.take(rm_clock, state.act))
+    )
+    valid = state.valid & ~covered
+
+    ahead = ~jnp.all(rm_clock <= state.top)
+    # Park: union onto an equal-clock slot if the canonical union fits,
+    # else claim a free slot.
+    same = state.dvalid & jnp.all(state.dcl == rm_clock[None, :], axis=-1)
+    merged = _canon_rmlist(
+        jnp.concatenate(
+            [state.didx, jnp.broadcast_to(eids, (state.didx.shape[0], w))],
+            axis=-1,
+        )
+    )
+    fits = jnp.sum(merged >= 0, axis=-1) <= q
+    use_same = same & fits
+    has_same = jnp.any(use_same)
+    free = ~state.dvalid
+    has_free = jnp.any(free)
+    slot = jnp.where(has_same, jnp.argmax(use_same), jnp.argmax(free))
+    park = ahead & (has_same | has_free)
+    overflow = ahead & ~has_same & ~has_free
+    onehot = jax.nn.one_hot(slot, state.dvalid.shape[-1], dtype=bool) & park
+    fresh = _canon_rmlist(
+        jnp.pad(eids, (0, q - w), constant_values=-1)
+    )
+    new_list = jnp.where(has_same, merged[slot][:q], fresh)
+    dcl = jnp.where(onehot[:, None], rm_clock[None, :], state.dcl)
+    didx = jnp.where(onehot[:, None], new_list[None, :], state.didx)
+    dvalid = state.dvalid | onehot
+
+    eid, act, ctr, valid, _ = _canon(
+        state.eid, state.act, state.ctr, valid, state.eid.shape[-1]
+    )
+    return (
+        state._replace(
+            eid=eid, act=act, ctr=ctr, valid=valid,
+            dcl=dcl, didx=didx, dvalid=dvalid,
+        ),
+        overflow,
+    )
+
+
 def fold(states: SparseOrswotState):
     """Log-tree fold of a replica batch (leading axis)."""
     from .lattice import tree_fold

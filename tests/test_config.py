@@ -25,7 +25,7 @@ def test_backend_selects_execution_path():
 
 def test_all_kinds_construct_under_both_backends():
     kinds = [
-        "orswot", "map", "map_orswot", "map_map", "map3",
+        "orswot", "sparse_orswot", "map", "map_orswot", "map_map", "map3",
         "gcounter", "pncounter", "gset", "lwwreg", "mvreg",
     ]
     with configured(backend="pure"):

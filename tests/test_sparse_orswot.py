@@ -189,3 +189,174 @@ def test_sparse_ring_gossip_matches_dense_fold(seed):
         back = sp.to_dense(rows[i], e)
         np.testing.assert_array_equal(np.asarray(back.ctr), np.asarray(dense.ctr))
         np.testing.assert_array_equal(np.asarray(back.top), np.asarray(dense.top))
+
+
+@given(seeds)
+@settings(max_examples=8, deadline=None)
+def test_sparse_apply_stream_matches_dense(seed):
+    """CmRDT parity: a random add/rm op stream applied through the
+    sparse segment appliers lands bit-identical to the dense appliers
+    (content, top, and parked removes), including removes that arrive
+    ahead and park."""
+    import jax.numpy as jnp
+
+    nrng = np.random.default_rng(seed)
+    prng = random.Random(seed)
+    E, A, C, W = 24, 4, 64, 8
+    d = dense_ops.empty(E, A, deferred_cap=4)
+    s = sp.from_dense(d, C, rm_width=8)
+    tops = np.zeros((A,), np.uint32)
+    for step in range(40):
+        actor = prng.randrange(A)
+        if prng.random() < 0.7:
+            tops[actor] += 1
+            members = nrng.choice(E, size=nrng.integers(1, 5), replace=False)
+            mask = np.zeros(E, bool)
+            mask[members] = True
+            d, _ = (
+                dense_ops.apply_add(
+                    d, jnp.asarray(actor),
+                    jnp.asarray(np.uint32(tops[actor])), jnp.asarray(mask)
+                ),
+                None,
+            )
+            eids = np.full(W, -1, np.int32)
+            eids[: len(members)] = members
+            s, of = sp.apply_add(
+                s, jnp.asarray(actor),
+                jnp.asarray(np.uint32(tops[actor])), jnp.asarray(eids),
+            )
+            assert not bool(of)
+        else:
+            members = nrng.choice(E, size=nrng.integers(1, 4), replace=False)
+            mask = np.zeros(E, bool)
+            mask[members] = True
+            cl = np.asarray(d.top).copy()
+            if prng.random() < 0.3:
+                cl[prng.randrange(A)] += 2  # ahead → parks
+            d, ofd = dense_ops.apply_rm(d, jnp.asarray(cl), jnp.asarray(mask))
+            eids = np.full(W, -1, np.int32)
+            eids[: len(members)] = members
+            s, ofs = sp.apply_rm(s, jnp.asarray(cl), jnp.asarray(eids))
+            assert bool(ofd) == bool(ofs)
+    back = sp.to_dense(s, E)
+    np.testing.assert_array_equal(np.asarray(back.ctr), np.asarray(d.ctr))
+    np.testing.assert_array_equal(np.asarray(back.top), np.asarray(d.top))
+    dm, dv, dc = (np.asarray(d.dmask), np.asarray(d.dvalid), np.asarray(d.dcl))
+    bm, bv, bc = (
+        np.asarray(back.dmask), np.asarray(back.dvalid), np.asarray(back.dcl)
+    )
+    dense_parked = {
+        (tuple(dc[i]), frozenset(np.nonzero(dm[i])[0])) for i in np.nonzero(dv)[0]
+    }
+    sp_parked = {
+        (tuple(bc[i]), frozenset(np.nonzero(bm[i])[0])) for i in np.nonzero(bv)[0]
+    }
+    assert dense_parked == sp_parked
+
+
+@given(seeds)
+@settings(max_examples=8, deadline=None)
+def test_sparse_model_ab_gate(seed):
+    """BatchedSparseOrswot: lossless round-trip, op-path parity, and
+    fold == oracle merge — the dense model's A/B gate through the
+    sparse backend (no dense cube ever materialized)."""
+    from crdt_tpu.models import BatchedSparseOrswot
+    from crdt_tpu.pure.orswot import Orswot
+
+    rng = random.Random(seed)
+    n = rng.randint(2, 5)
+    sites, stream = _mint_streams(rng, n, 14)
+    model = BatchedSparseOrswot.from_pure(sites, dot_cap=128, rm_width=16)
+    for i in range(n):
+        assert model.to_pure(i) == sites[i]  # lossless
+    expect = sites[0].clone()
+    for s in sites[1:]:
+        expect.merge(s.clone())
+    assert model.fold() == expect
+
+    # op path: deliver the minted streams (per-origin order preserved,
+    # cross-origin interleaved) to a fresh oracle + device pair
+    oracle = Orswot()
+    dev = BatchedSparseOrswot.from_pure(
+        [Orswot()], dot_cap=128, rm_width=16,
+        members=model.members, actors=model.actors,
+        n_actors=model.state.top.shape[-1],
+    )
+    queues = [list(s) for s in stream]
+    while any(queues):
+        q = rng.choice([x for x in queues if x])
+        op = q.pop(0)
+        oracle.apply(op)
+        dev.apply(0, op)
+    assert dev.to_pure(0) == oracle
+
+
+def test_sparse_model_checkpoint_resume():
+    from crdt_tpu import checkpoint
+    from crdt_tpu.models import BatchedSparseOrswot
+
+    rng = random.Random(7)
+    sites, _ = _mint_streams(rng, 3, 10)
+    model = BatchedSparseOrswot.from_pure(sites, dot_cap=64, rm_width=16)
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "sp.npz")
+        checkpoint.save(path, model)
+        back = checkpoint.load(path)
+    for i in range(3):
+        assert back.to_pure(i) == sites[i]
+    expect = sites[0].clone()
+    for s in sites[1:]:
+        expect.merge(s.clone())
+    assert back.fold() == expect
+
+
+def test_sparse_model_equal_clock_slots_union_in_to_pure():
+    """Two parked removes under the SAME clock that exceed rm_width
+    split across slots on device; to_pure must union them into one
+    oracle entry (review r4 regression)."""
+    from crdt_tpu.models import BatchedSparseOrswot
+    from crdt_tpu.pure.orswot import Orswot
+    from crdt_tpu.vclock import VClock
+    from crdt_tpu.ctx import RmCtx
+    from crdt_tpu.pure.orswot import Rm as ORm
+
+    minter = Orswot()
+    for i in range(5):
+        minter.apply(minter.add(f"m{i}", minter.read().derive_add_ctx("a")))
+    clock = minter.read().add_clock.clone()
+
+    dev = BatchedSparseOrswot(1, 64, 1, deferred_cap=4, rm_width=4)
+    dev.actors.intern("a")
+    op1 = ORm(clock=clock.clone(), members=tuple(f"m{i}" for i in range(4)))
+    op2 = ORm(clock=clock.clone(), members=("m4",))
+    dev.apply(0, op1)  # parks (clock ahead of empty replica)
+    dev.apply(0, op2)  # union exceeds rm_width=4 -> fresh slot
+    oracle = Orswot()
+    oracle.apply(op1)
+    oracle.apply(op2)
+    assert dev.to_pure(0) == oracle
+
+
+def test_sparse_model_wide_add_not_capped_by_rm_width():
+    """Adds may list more members than rm_width (dot_cap is the real
+    bound) — review r4 regression."""
+    from crdt_tpu.models import BatchedSparseOrswot
+    from crdt_tpu.pure.orswot import Orswot
+
+    site = Orswot()
+    members = tuple(f"w{i}" for i in range(9))
+    op = site.add_all(members, site.read().derive_add_ctx("a")) if hasattr(site, "add_all") else None
+    if op is None:
+        from crdt_tpu.pure.orswot import Add
+        from crdt_tpu.dot import Dot
+
+        ctx = site.read().derive_add_ctx("a")
+        op = Add(dot=ctx.dot, members=members)
+    site.apply(op)
+    dev = BatchedSparseOrswot(1, 64, 1, deferred_cap=2, rm_width=8)
+    dev.actors.intern("a")
+    dev.apply(0, op)
+    assert dev.to_pure(0) == site
