@@ -60,6 +60,7 @@ from .anti_entropy import (
     mesh_fold_map_orswot,
     mesh_fold_mvreg,
     mesh_fold_nested_map,
+    mesh_fold_sparse,
     mesh_gossip,
     mesh_gossip_map,
     mesh_gossip_map3,

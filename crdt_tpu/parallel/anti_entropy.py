@@ -366,6 +366,19 @@ def mesh_fold_gset(present: jax.Array, mesh: Mesh) -> jax.Array:
     return out[:m]
 
 
+def _pad_with_identity(states, rsize: int, ident):
+    """Pad the replica axis to a multiple of the mesh's replica-axis
+    size with join identities (absorbed by any lattice join)."""
+    lead = jax.tree.leaves(states)[0].shape[0]
+    if lead % rsize == 0:
+        return states
+    return jax.tree.map(
+        lambda x, p: jnp.concatenate([x, p.astype(x.dtype)], axis=0),
+        states,
+        ident,
+    )
+
+
 def mesh_fold_lww(states, mesh: Mesh):
     """Converge an LWWReg replica batch (LWWState with leading axis R)
     over the mesh's replica axis. Returns ``(state, conflict)``;
@@ -375,13 +388,9 @@ def mesh_fold_lww(states, mesh: Mesh):
 
     rsize = mesh.shape[REPLICA_AXIS]
     pad_r = (-states.hi.shape[0]) % rsize
-    if pad_r:
-        ident = lww_ops.empty(batch=(pad_r,))
-        states = jax.tree.map(
-            lambda x, p: jnp.concatenate([x, p.astype(x.dtype)], axis=0),
-            states,
-            ident,
-        )
+    states = _pad_with_identity(
+        states, rsize, lww_ops.empty(batch=(pad_r,)) if pad_r else None
+    )
 
     template = lww_ops.empty()
     return _mesh_fold_lattice(
@@ -402,18 +411,53 @@ def mesh_fold_mvreg(states, mesh: Mesh):
     rsize = mesh.shape[REPLICA_AXIS]
     pad_r = (-states.wact.shape[0]) % rsize
     s, a = states.wact.shape[-1], states.clk.shape[-1]
-    if pad_r:
-        ident = mv.empty(s, a, batch=(pad_r,))
-        states = jax.tree.map(
-            lambda x, p: jnp.concatenate([x, p.astype(x.dtype)], axis=0),
-            states,
-            ident,
-        )
+    states = _pad_with_identity(
+        states, rsize, mv.empty(s, a, batch=(pad_r,)) if pad_r else None
+    )
 
     template = mv.empty(s, a)
     return _mesh_fold_lattice(
         "mvreg_fold", states, mesh,
         mv.join, mv.fold,
+        jax.tree.map(lambda _: P(REPLICA_AXIS), template),
+        jax.tree.map(lambda _: P(), template),
+    )
+
+
+def mesh_fold_sparse(states, mesh: Mesh):
+    """Converge a SPARSE (segment-encoded) ORSWOT replica batch over the
+    mesh's replica axis. Sparse mode has no dense element dimension to
+    shard — the segment table IS the element-axis compression — so the
+    state rides the replica axis only and stays replicated across the
+    element axis (a sparse replica set scales by live dots, not by
+    universe size). Returns ``(state, overflow[2])``."""
+    from ..ops import sparse_orswot as sp
+
+    rsize = mesh.shape[REPLICA_AXIS]
+    pad_r = (-states.top.shape[0]) % rsize
+    states = _pad_with_identity(
+        states,
+        rsize,
+        sp.empty(
+            states.eid.shape[-1],
+            states.top.shape[-1],
+            states.dcl.shape[-2],
+            states.didx.shape[-1],
+            batch=(pad_r,),
+        )
+        if pad_r
+        else None,
+    )
+
+    template = sp.empty(
+        states.eid.shape[-1],
+        states.top.shape[-1],
+        states.dcl.shape[-2],
+        states.didx.shape[-1],
+    )
+    return _mesh_fold_lattice(
+        "sparse_orswot_fold", states, mesh,
+        sp.join, sp.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template),
         jax.tree.map(lambda _: P(), template),
     )

@@ -476,3 +476,62 @@ def test_depth4_convergence_under_random_delivery(seed):
         assert not bool(flags.any())
     for i in range(n):
         assert decode(rows[i]) == expect
+
+
+@given(seeds)
+@settings(max_examples=5, deadline=None)
+def test_depth4_delta_exchange_converges(seed):
+    """The δ induction composes too: nested_delta applied to the depth-3
+    delta pair gives a depth-4 flavor whose bounded-packet exchange
+    converges two replicas onto their full join (content + top after
+    the closure) — no hand-written depth-4 delta module exists."""
+    import jax.numpy as jnp
+
+    from crdt_tpu.parallel.delta import interval_accumulate
+    from crdt_tpu.parallel.delta_map3 import apply_delta_m3, extract_delta_m3
+    from crdt_tpu.parallel.delta_nest import close_top_nested, nested_delta
+
+    extract4, apply4 = nested_delta(LEVEL4, extract_delta_m3, apply_delta_m3)
+
+    rng = random.Random(seed)
+    states = _site_run(rng, n_cmds=12)[:2]
+    batched = encode(states)
+    a = _rows(batched, 0)
+    b = _rows(batched, 1)
+    expect, flags = _join4(a, b)
+    assert not bool(flags.any())
+
+    cells = a.core.mo.core.ctr.shape[-2]
+    na = a.core.mo.core.top.shape[-1]
+    empty_row = _rows(empty4(batch=(1,)), 0)
+    da, fa = interval_accumulate(
+        jnp.zeros((cells,), bool), jnp.zeros((cells, na), jnp.uint32),
+        empty_row.core.mo.core, a.core.mo.core,
+    )
+    db, fb = interval_accumulate(
+        jnp.zeros((cells,), bool), jnp.zeros((cells, na), jnp.uint32),
+        empty_row.core.mo.core, b.core.mo.core,
+    )
+
+    for rnd in range(4):  # 2 replicas, generous rounds for forwarding
+        pkt, da, fa = extract4(a, da, fa, cap=cells, start=rnd * cells)
+        b, db, fb, of_b = apply4(b, pkt, db, fb)
+        assert not bool(of_b.any())
+        pkt, db, fb = extract4(b, db, fb, cap=cells, start=rnd * cells)
+        a, da, fa, of_a = apply4(a, pkt, da, fa)
+        assert not bool(of_a.any())
+
+    top = jnp.maximum(
+        a.core.mo.core.top, b.core.mo.core.top
+    )  # the ring's top-closure collective, host form
+    a = close_top_nested(LEVEL4, a, top)
+    b = close_top_nested(LEVEL4, b, top)
+    np.testing.assert_array_equal(
+        np.asarray(a.core.mo.core.ctr), np.asarray(expect.core.mo.core.ctr)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(b.core.mo.core.ctr), np.asarray(expect.core.mo.core.ctr)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.core.mo.core.top), np.asarray(expect.core.mo.core.top)
+    )

@@ -360,3 +360,22 @@ def test_sparse_model_wide_add_not_capped_by_rm_width():
     dev.actors.intern("a")
     dev.apply(0, op)
     assert dev.to_pure(0) == site
+
+
+def test_mesh_fold_sparse_matches_host_fold():
+    """Sparse replica batches converge over the device mesh's replica
+    axis (replica-parallel only: sparsity IS the element-axis story)."""
+    from crdt_tpu.parallel import make_mesh, mesh_fold_sparse
+
+    rng = random.Random(9)
+    sites, _ = _mint_streams(rng, 6, 14)
+    model = BatchedOrswot.from_pure(sites)
+    spstate = _sparse_from_model(model)
+    host, _ = sp.fold(spstate)
+
+    n = len(jax.devices())
+    mesh = make_mesh(n // 2, 2) if n % 2 == 0 and n > 1 else make_mesh(n, 1)
+    meshed, of = mesh_fold_sparse(spstate, mesh)
+    assert not bool(np.asarray(of).any())
+    for x, y in zip(jax.tree_util.tree_leaves(meshed), jax.tree_util.tree_leaves(host)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
