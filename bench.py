@@ -251,6 +251,11 @@ def bench_tpu():
 
     mps = (r_total - 1) / dt
     gbps = bytes_moved / dt / 1e9
+    from crdt_tpu.utils.metrics import metrics, observe_depth
+
+    metrics.count("bench.merges", r_total - 1)
+    metrics.observe("bench.orswot_merges_per_sec", mps)
+    observe_depth("bench.orswot_chunk", chunk)
     log(
         f"TPU {path} fold: {r_total} replicas x {E} elems x {A} actors "
         f"({n_passes} passes of {chunk_r}): {dt*1e3:.1f} ms/stream -> "
@@ -314,6 +319,11 @@ def bench_clocks():
         f"config1 gcounter: 64 replicas, 10k incs: fold {dt*1e6:.0f} us, "
         f"read {total} (63 merges -> {63/dt:,.0f} merges/s)"
     )
+    records = [{
+        "config": 1, "metric": "gcounter_merges_per_sec",
+        "value": round(63 / dt, 1), "unit": "merges/s",
+        "shape": "64x10000", "read": total,
+    }]
 
     # Config 2: 1k replicas, full pairwise merge matrix — the VClock
     # kernel, then the PNCounter form (p/n = TWO clock matrices per
@@ -332,6 +342,11 @@ def bench_clocks():
         f"config2 vclock: 1k x 1k pairwise merge matrix: {dt*1e3:.2f} ms "
         f"-> {1e6/dt:,.0f} pair-merges/s"
     )
+    records.append({
+        "config": 2, "metric": "vclock_pair_merges_per_sec",
+        "value": round(1e6 / dt, 1), "unit": "pair-merges/s",
+        "shape": f"1000x1000x{A}",
+    })
 
     p2 = jnp.asarray(rng.integers(0, 1000, (1000, A)).astype(np.uint32))
     n2 = jnp.asarray(rng.integers(0, 1000, (1000, A)).astype(np.uint32))
@@ -353,6 +368,12 @@ def bench_clocks():
         f"config2 pncounter: 1k x 1k pairwise merge (p+n): {dt*1e3:.2f} ms "
         f"-> {1e6/dt:,.0f} pair-merges/s; converged read {total}"
     )
+    records.append({
+        "config": 2, "metric": "pncounter_pair_merges_per_sec",
+        "value": round(1e6 / dt, 1), "unit": "pair-merges/s",
+        "shape": f"1000x1000x{A}", "read": total,
+    })
+    return records
 
 
 def bench_map():
@@ -409,6 +430,58 @@ def bench_map():
         f"config4 map: {r} replicas x {k} keys fold ({path}): {dt*1e3:.1f} ms "
         f"-> {(r-1)/dt:,.1f} merges/s, {nbytes/dt/1e9:.1f} GB/s child-state"
     )
+    return {
+        "config": 4, "metric": "map_merges_per_sec",
+        "value": round((r - 1) / dt, 1), "unit": "merges/s",
+        "path": path, "gbps": round(nbytes / dt / 1e9, 1),
+        "shape": f"{r}x{k}",
+    }
+
+
+def load_automerge_trace(path: str, n_actors: int = 4, limit: int = 0):
+    """Load the REAL automerge-perf editing trace (BASELINE config 5;
+    github.com/automerge/automerge-perf ``edit-by-index/trace.json``).
+
+    Format: a JSON array of edits, each ``[position, n_deleted,
+    inserted_string...]`` — positions are indices into the current text.
+    Flattened here to the engine's op stream: ``n_deleted`` DELETEs at
+    ``position``, then one INSERT per inserted character. The trace is
+    single-author; actors are assigned round-robin per op so the
+    replica-batch path still exercises multi-actor minting. ``limit``
+    truncates the flattened op stream (0 = everything).
+
+    Offline boxes can't fetch the file, so the synthetic generator below
+    stays the fallback — set BENCH_TRACE_PATH when a copy is available
+    and ``bench_list`` switches to it (``"trace": "automerge-perf"`` in
+    its JSON record)."""
+    from crdt_tpu.native import DELETE, INSERT
+
+    with open(path) as f:
+        edits = json.load(f)
+    kinds, idxs, vals, actors = [], [], [], []
+    n = 0
+    for edit in edits:
+        pos, ndel = int(edit[0]), int(edit[1])
+        for _ in range(ndel):
+            kinds.append(DELETE)
+            idxs.append(pos)
+            vals.append(0)
+            actors.append(n % n_actors)
+            n += 1
+            if limit and n >= limit:
+                return kinds, idxs, vals, actors
+        at = pos
+        for chunk in edit[2:]:
+            for ch in str(chunk):
+                kinds.append(INSERT)
+                idxs.append(at)
+                vals.append(ord(ch) & 0x7F)
+                actors.append(n % n_actors)
+                at += 1
+                n += 1
+                if limit and n >= limit:
+                    return kinds, idxs, vals, actors
+    return kinds, idxs, vals, actors
 
 
 def make_edit_trace(n_ops: int, n_actors: int = 4, seed: int = 3):
@@ -453,7 +526,15 @@ def bench_list():
     # the CPU fallback path caps both (main()).
     n_ops = int(os.environ.get("BENCH_LIST_OPS", 100_000))
     r = int(os.environ.get("BENCH_LIST_REPLICAS", 1024))
-    trace = make_edit_trace(n_ops)
+    trace_path = os.environ.get("BENCH_TRACE_PATH", "")
+    if trace_path and os.path.exists(trace_path):
+        trace = load_automerge_trace(trace_path, limit=n_ops)
+        n_ops = len(trace[0])
+        trace_kind = "automerge-perf"
+        log(f"config5 list: REAL automerge-perf trace ({n_ops} ops from {trace_path})")
+    else:
+        trace = make_edit_trace(n_ops)
+        trace_kind = "synthetic"
 
     t0 = time.perf_counter()
     oracle = List()
@@ -492,6 +573,15 @@ def bench_list():
         f"{dt_dev*1e3:.0f} ms -> {total/dt_dev:,.0f} replica-ops/s "
         f"({(total/dt_dev)/(n_ops/dt_py):.1f}x oracle rate)"
     )
+    return {
+        "config": 5, "metric": "list_replica_ops_per_sec",
+        "value": round(total / dt_dev, 1), "unit": "replica-ops/s",
+        "vs_oracle_rate": round((total / dt_dev) / (n_ops / dt_py), 1),
+        "native_ops_per_sec": round(n_ops / dt_native, 1),
+        "oracle_ops_per_sec": round(n_ops / dt_py, 1),
+        "shape": f"{r}x{n_ops}",
+        "trace": trace_kind,
+    }
 
 
 def main():
@@ -514,6 +604,7 @@ def main():
             ("BENCH_LIST_REPLICAS", 64),
         ):
             os.environ[var] = str(min(int(os.environ.get(var, cpu_cap)), cpu_cap))
+    records = []
     for name, fn in [
         ("clocks", bench_clocks),
         ("map", bench_map),
@@ -521,25 +612,39 @@ def main():
     ]:
         if os.environ.get(f"BENCH_{name.upper()}", "1") != "0":
             try:
-                fn()
+                out = fn()
             except Exception as exc:  # diagnostic only — never kill the metric
                 log(f"{name} bench failed: {exc!r}")
+            else:
+                records.extend(out if isinstance(out, list) else [out])
     cpu_mps = bench_cpu()
     tpu_mps, path, gbps, bytes_moved, shape = bench_tpu()
-    print(
-        json.dumps(
-            {
-                "metric": "orswot_merges_per_sec",
-                "value": round(tpu_mps, 1),
-                "unit": "merges/s",
-                "vs_baseline": round(tpu_mps / cpu_mps, 2),
-                "path": "cpu-fallback" if degraded else path,
-                "gbps": round(gbps, 1),
-                "bytes_moved": bytes_moved,
-                "shape": shape,
-            }
-        )
-    )
+    headline = {
+        "metric": "orswot_merges_per_sec",
+        "value": round(tpu_mps, 1),
+        "unit": "merges/s",
+        "vs_baseline": round(tpu_mps / cpu_mps, 2),
+        "path": "cpu-fallback" if degraded else path,
+        "gbps": round(gbps, 1),
+        "bytes_moved": bytes_moved,
+        "shape": shape,
+    }
+    records.append({"config": 3, **headline})
+    # Per-config JSON lines (machine-readable) on stderr + a sidecar
+    # file; stdout stays EXACTLY one line — the driver's contract.
+    for rec in records:
+        rec["degraded"] = degraded
+        log(json.dumps(rec))
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_CONFIGS.json"), "w") as f:
+            json.dump(records, f, indent=1)
+    except OSError as exc:
+        log(f"could not write BENCH_CONFIGS.json: {exc!r}")
+    from crdt_tpu.utils.metrics import metrics
+
+    log("metrics snapshot: " + json.dumps(metrics.snapshot()))
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

@@ -23,7 +23,7 @@ from ..ops import map3 as ops
 from ..pure.map import Map, MapRm, Nop, Up
 from ..pure.orswot import Add as OrswotAdd, Orswot, Rm as OrswotRm
 from ..utils import Interner
-from ..utils.metrics import metrics
+from ..utils.metrics import metrics, observe_depth
 from ..vclock import VClock
 from .orswot import DeferredOverflow
 from .validation import strict_validate_dot
@@ -428,6 +428,7 @@ class BatchedMap3:
         """Full-mesh anti-entropy: join all replicas, return the converged
         oracle-form state."""
         metrics.count("map3.merges", max(self.n_replicas - 1, 0))
+        observe_depth("map3", self.state)
         folded, flags = ops.fold(self.state)
         self._check_flags(flags, "fold")
         tmp = BatchedMap3(

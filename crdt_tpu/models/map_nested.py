@@ -31,7 +31,7 @@ from ..pure.map import Map, MapRm, Nop, Up
 from ..pure.mvreg import MVReg, Put
 from ..pure.orswot import Add as OrswotAdd, Orswot, Rm as OrswotRm
 from ..utils import Interner
-from ..utils.metrics import metrics
+from ..utils.metrics import metrics, observe_depth
 from ..vclock import VClock
 from .orswot import DeferredOverflow
 from .registers import SlotOverflow
@@ -326,6 +326,7 @@ class BatchedMapOrswot:
         """Full-mesh anti-entropy: join all replicas, return the converged
         oracle-form state."""
         metrics.count("map_orswot.merges", max(self.n_replicas - 1, 0))
+        observe_depth("map_orswot", self.state)
         folded, flags = ops.fold(self.state)
         self._check_flags(flags, "fold")
         tmp = BatchedMapOrswot(
@@ -703,6 +704,7 @@ class BatchedNestedMap:
         """Full-mesh anti-entropy: join all replicas, return the converged
         oracle-form state."""
         metrics.count("nested_map.merges", max(self.n_replicas - 1, 0))
+        observe_depth("nested_map", self.state)
         folded, flags = nested_ops.fold(self.state)
         self._check_flags(flags, "fold")
         tmp = BatchedNestedMap(

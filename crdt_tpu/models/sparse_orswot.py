@@ -21,7 +21,7 @@ import numpy as np
 from ..ops import sparse_orswot as ops
 from ..pure.orswot import Add, Orswot, Rm
 from ..utils import Interner
-from ..utils.metrics import metrics
+from ..utils.metrics import metrics, observe_depth
 from ..vclock import VClock
 from .orswot import DeferredOverflow
 
@@ -246,6 +246,7 @@ class BatchedSparseOrswot:
         """Full-mesh anti-entropy: join all replicas, return the
         converged oracle-form state."""
         metrics.count("sparse_orswot.merges", max(self.n_replicas - 1, 0))
+        observe_depth("sparse_orswot", self.state)
         folded, flags = ops.fold(self.state)
         self._check(flags, "fold")
         tmp = BatchedSparseOrswot(

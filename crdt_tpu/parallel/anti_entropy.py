@@ -58,7 +58,7 @@ from .mesh import (
     pad_replicas,
     pad_replicas_map,
 )
-from ..utils.metrics import metrics, state_nbytes
+from ..utils.metrics import metrics, observe_depth, state_nbytes
 
 
 _FN_CACHE: dict = {}
@@ -114,7 +114,11 @@ def mesh_fold(
         return fold_fn
 
     metrics.count("anti_entropy.fold_rounds")
+    metrics.count(
+        "anti_entropy.merges", max(jax.tree.leaves(state)[0].shape[0] - 1, 0)
+    )
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
+    observe_depth("anti_entropy.orswot_fold", state)
     with metrics.time("anti_entropy.fold"):
         out = _cached("orswot_fold", state, mesh, build, local_fold)(state)
         jax.block_until_ready(out)  # time device work, not async dispatch
@@ -162,6 +166,7 @@ def _mesh_gossip_lattice(
 
     metrics.count(f"anti_entropy.{kind}_rounds", rounds)
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
+    observe_depth(f"anti_entropy.{kind}", state)
     with metrics.time(f"anti_entropy.{kind}"):
         out = _cached(kind, state, mesh, build, rounds, *cache_extra)(state)
         jax.block_until_ready(out)  # time device work, not async dispatch
@@ -263,7 +268,11 @@ def _mesh_fold_lattice(
         return mesh_fn
 
     metrics.count(f"anti_entropy.{kind}_rounds")
+    metrics.count(
+        "anti_entropy.merges", max(jax.tree.leaves(state)[0].shape[0] - 1, 0)
+    )
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
+    observe_depth(f"anti_entropy.{kind}", state)
     with metrics.time(f"anti_entropy.{kind}"):
         out = _cached(kind, state, mesh, build)(state)
         jax.block_until_ready(out)  # time device work, not async dispatch

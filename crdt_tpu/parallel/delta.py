@@ -243,15 +243,17 @@ def mesh_delta_gossip(
     residue drains over EXTRA rounds (round-robin, no loss) and each
     forwarding hop needs its own ring latency — budget
     ``(P-1) * (1 + ceil(backlog / cap))`` rounds for a capped drain.
-    There is NO runtime convergence signal for an under-budgeted run:
-    ``overflow`` stays False (it flags the parked-remove buffer, not
-    residue) and the returned ``dirty`` mask is noisy with domain-
-    forwarding re-marks, so it cannot be read as "rows still out of
-    sync". The cap-independence property tests (test_delta*.py) pin the
-    budget formula; when in doubt, pass explicit ``rounds``.
+    The returned ``residue`` is the RUNTIME signal for an under-budgeted
+    run (``overflow`` flags the parked-remove buffer, not residue, and
+    the ``dirty`` mask is noisy with domain-forwarding re-marks):
+    ``residue == 0`` proves the budget sufficed, ``> 0`` means re-run
+    with more rounds per the formula (delta_ring.run_delta_ring
+    documents the indicator's soundness). The cap-independence property
+    tests (test_delta*.py) pin the budget formula.
 
-    Returns ``(states [P, ...], dirty [P, E], overflow)`` — overflow is
-    the deferred-buffer flag, as in ``mesh_gossip``."""
+    Returns ``(states [P, ...], dirty [P, E], overflow, residue)`` —
+    overflow is the deferred-buffer flag, as in ``mesh_gossip``;
+    residue the convergence indicator above."""
     from ..ops.pallas_kernels import fold_auto
     from .delta_ring import run_delta_ring
 

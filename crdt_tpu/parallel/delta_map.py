@@ -272,9 +272,10 @@ def mesh_delta_gossip_map(
     """Ring δ anti-entropy for Map<K, MVReg> replica batches over the
     mesh — the bandwidth-bounded mode for large key universes with local
     churn (see delta.mesh_delta_gossip for semantics, the ROUNDS BUDGET
-    warning — the P-1 default silently under-converges when the backlog
-    exceeds ``cap``, with no runtime signal — and the top-closure step).
-    Returns ``(states [P, ...], dirty [P, K], overflow[2])``."""
+    warning, and the top-closure step). Returns
+    ``(states [P, ...], dirty [P, K], overflow[2], residue)`` — residue
+    is the runtime convergence indicator (0 = provably converged; see
+    delta_ring.run_delta_ring)."""
     from .delta_ring import run_delta_ring
 
     state = pad_replicas_map(state, mesh.shape[REPLICA_AXIS])

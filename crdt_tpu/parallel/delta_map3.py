@@ -58,11 +58,11 @@ def mesh_delta_gossip_map3(
     cap: int = 64,
 ):
     """Ring δ anti-entropy for depth-3 map replica batches (see
-    delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET warning:
-    the P-1 default silently under-converges when the backlog exceeds
-    ``cap``, with no runtime signal). ``dirty`` / ``fctx`` are at leaf
-    (k1, k2, member) cell granularity. Returns
-    ``(states [P, ...], dirty, overflow[3])``."""
+    delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET
+    warning). ``dirty`` / ``fctx`` are at leaf (k1, k2, member) cell
+    granularity. Returns ``(states [P, ...], dirty, overflow[3],
+    residue)`` — residue is the runtime convergence indicator (0 =
+    provably converged; see delta_ring.run_delta_ring)."""
     from .delta_ring import run_delta_ring
 
     state = pad_map3(state, mesh.shape[REPLICA_AXIS], mesh.shape[ELEMENT_AXIS])

@@ -70,11 +70,11 @@ def mesh_delta_gossip_map_orswot(
     cap: int = 64,
 ):
     """Ring δ anti-entropy for Map<K, Orswot> replica batches (see
-    delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET warning:
-    the P-1 default silently under-converges when the backlog exceeds
-    ``cap``, with no runtime signal). ``dirty`` / ``fctx`` are at
-    (key, member) cell granularity over K×M. Returns
-    ``(states [P, ...], dirty, overflow[2])``."""
+    delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET
+    warning). ``dirty`` / ``fctx`` are at (key, member) cell granularity
+    over K×M. Returns ``(states [P, ...], dirty, overflow[2], residue)``
+    — residue is the runtime convergence indicator (0 = provably
+    converged; see delta_ring.run_delta_ring)."""
     from .delta_ring import run_delta_ring
 
     state = pad_map_orswot(

@@ -46,8 +46,30 @@ def run_delta_ring(
     cache_extra: tuple = (),
 ):
     """Run the δ ring program; ``state``/``dirty``/``fctx`` must already
-    be padded to the mesh. Returns ``(states [P, ...], dirty, overflow)``
-    with the same conventions as mesh_gossip."""
+    be padded to the mesh. Returns ``(states [P, ...], dirty, overflow,
+    residue)`` — the first three with the same conventions as
+    mesh_gossip; ``residue`` is the RUNTIME convergence indicator the
+    ROUNDS BUDGET docstrings promise (int32 scalar): the mesh-wide count
+    of slot-starved row-rounds WITHIN THE FINAL P-1 ROUNDS — rows that
+    wanted a packet slot but lost it to ``cap``. Extract clears every
+    row it ships, so rows still dirty right after an extract ARE the
+    round's unshipped backlog — domain-forwarding re-marks (added back
+    at apply time) never inflate the count. Soundness: every
+    ever-changed row keeps at least one circulating mark, and a
+    starvation-free round advances every mark one hop, so P-1
+    consecutive starvation-free FINAL rounds walk every mark through all
+    P devices — ``residue == 0`` means the gossip provably equals the
+    full join. The indicator is ONE-SIDED: ``residue > 0`` does not
+    prove divergence, it means the run cannot be certified — either
+    genuine residue, or a ``cap`` too small to clear the circulating
+    forwarding marks (marks never die, they only coalesce, so a tight
+    cap can starve forever even after content converges). Re-run with
+    more rounds (the budget formula in delta.py) and a cap comfortably
+    above the steady-state per-device mark count. Starvation in EARLIER
+    rounds of an extended budget is expected drain behavior and
+    deliberately not counted. A budget below P-1 rounds cannot complete
+    a ring loop at all, so residue is forced >= 1 there regardless of
+    starvation."""
     p = mesh.shape[REPLICA_AXIS]
     if rounds is None:
         rounds = p - 1
@@ -62,7 +84,7 @@ def run_delta_ring(
                 P(REPLICA_AXIS, ELEMENT_AXIS),
                 P(REPLICA_AXIS, ELEMENT_AXIS, None),
             ),
-            out_specs=(specs, P(REPLICA_AXIS, ELEMENT_AXIS), P()),
+            out_specs=(specs, P(REPLICA_AXIS, ELEMENT_AXIS), P(), P()),
             check_vma=False,
         )
         def gossip_fn(local, local_dirty, local_fctx):
@@ -71,16 +93,21 @@ def run_delta_ring(
             f = jnp.max(local_fctx, axis=0)
 
             def round_body(r, carry):
-                st, d, f, of = carry
+                st, d, f, of, starved = carry
                 pkt, d, f = extract(st, d, f, cap, start=r * cap)
+                in_window = r >= rounds - (p - 1)
+                starved = starved + jnp.where(
+                    in_window, jnp.sum(d.astype(jnp.int32)), 0
+                )
                 pkt = jax.tree.map(
                     lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt
                 )
                 st, d, f, of_r = apply_fn(st, pkt, d, f)
-                return st, d, f, of | of_r
+                return st, d, f, of | of_r, starved
 
-            folded, d, f, of = lax.fori_loop(
-                0, rounds, round_body, (folded, d, f, of)
+            folded, d, f, of, starved = lax.fori_loop(
+                0, rounds, round_body,
+                (folded, d, f, of, jnp.zeros((), jnp.int32)),
             )
             top = lax.pmax(
                 lax.pmax(top_of(folded), REPLICA_AXIS), ELEMENT_AXIS
@@ -90,7 +117,12 @@ def run_delta_ring(
                 lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS))
                 > 0
             )
-            return jax.tree.map(lambda x: x[None], folded), d[None], of
+            residue = lax.psum(starved, (REPLICA_AXIS, ELEMENT_AXIS))
+            if rounds < p - 1:
+                # A budget below P-1 can never complete a ring loop; the
+                # certificate must not be issuable no matter the cap.
+                residue = jnp.maximum(residue, 1)
+            return jax.tree.map(lambda x: x[None], folded), d[None], of, residue
 
         return gossip_fn
 
@@ -103,4 +135,19 @@ def run_delta_ring(
             state, dirty, fctx
         )
         jax.block_until_ready(out)
+    if not isinstance(out[3], jax.core.Tracer):
+        # Host-side residue accounting — skipped when the ring runs
+        # under an outer jit (callers then read the returned residue).
+        residue = int(out[3])
+        metrics.observe(f"anti_entropy.{kind}.residue", float(residue))
+        if residue:
+            import warnings
+
+            warnings.warn(
+                f"{kind}: round budget left residue ({residue} slot-starved "
+                f"row-rounds) — the ring is NOT guaranteed converged; raise "
+                f"`rounds` (see the ROUNDS BUDGET note in parallel/delta.py) "
+                f"or `cap`",
+                stacklevel=3,
+            )
     return out

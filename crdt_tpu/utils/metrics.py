@@ -93,6 +93,49 @@ def profile_trace(logdir: str):
                 pass
 
 
+def deferred_depth(state) -> float:
+    """Live deferred-buffer depth of a (possibly batched) state pytree:
+    the MAX over replicas of valid parked slots summed across every
+    buffer level (fields named ``*dvalid`` — the shared masked-epoch
+    convention across the dense, sparse, and nested families). The
+    BASELINE §6.5 'deferred-buffer depth' gauge; callers observe it at
+    join/fold time. Returns -1.0 (and records nothing via
+    ``observe_depth``) when the state is a traced value — the mesh entry
+    points may legitimately run under an outer jit (e.g. a fully jitted
+    train step), where host-side metrics cannot see concrete values."""
+    import jax
+    import numpy as np
+
+    total = None
+    if any(isinstance(x, jax.core.Tracer) for x in jax.tree.leaves(state)):
+        return -1.0
+
+    def walk(node):
+        nonlocal total
+        if hasattr(node, "_fields"):
+            for name in node._fields:
+                child = getattr(node, name)
+                if name.endswith("dvalid"):
+                    # Sum slot axis (last); accumulate per leading batch.
+                    d = np.asarray(child).astype(np.int64)
+                    d = d.sum(axis=-1)
+                    total = d if total is None else total + d
+                elif hasattr(child, "_fields"):
+                    walk(child)
+    walk(state)
+    if total is None:
+        return 0.0
+    return float(np.max(total))
+
+
+def observe_depth(name: str, state) -> None:
+    """Record ``deferred_depth(state)`` under ``<name>.deferred_depth``
+    (a no-op under tracing — see ``deferred_depth``)."""
+    depth = deferred_depth(state)
+    if depth >= 0:
+        metrics.observe(f"{name}.deferred_depth", depth)
+
+
 def state_nbytes(state) -> int:
     """Total device bytes of a pytree state — the per-round 'bytes
     exchanged' metric for anti-entropy collectives."""
@@ -103,4 +146,7 @@ def state_nbytes(state) -> int:
     )
 
 
-__all__ = ["Metrics", "metrics", "profile_trace", "state_nbytes"]
+__all__ = [
+    "Metrics", "metrics", "profile_trace", "state_nbytes",
+    "deferred_depth", "observe_depth",
+]

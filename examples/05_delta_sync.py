@@ -75,7 +75,7 @@ def main():
     folded, _ = mesh_fold(sharded, mesh)
 
     cap = 16
-    gossiped, _, overflow = mesh_delta_gossip(
+    gossiped, _, overflow, residue = mesh_delta_gossip(
         sharded, dirty, fctx, mesh, rounds=2 * mesh.shape["replica"], cap=cap
     )
     assert not bool(overflow)

@@ -1,0 +1,98 @@
+"""Format tests for bench.py's host-side tooling: the automerge-perf
+trace loader (BASELINE config 5 — the REAL trace format, loadable
+whenever a copy of ``edit-by-index/trace.json`` is dropped on the box)
+and the metrics deferred-depth gauge (SURVEY §6.5's missing metric,
+VERDICT r04 item #6)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+
+import bench
+from crdt_tpu.native import DELETE, INSERT
+from crdt_tpu.pure.list import List
+from crdt_tpu.utils.metrics import deferred_depth, metrics, observe_depth
+
+
+def test_automerge_trace_loader_format(tmp_path):
+    # The published format: [position, n_deleted, inserted_string...].
+    edits = [
+        [0, 0, "h", "i"],       # insert "hi"
+        [2, 0, " there"],       # append a multi-char chunk
+        [0, 1],                 # delete the "h"
+        [1, 2, "X"],            # replace two chars with "X"
+    ]
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps(edits))
+    kinds, idxs, vals, actors = bench.load_automerge_trace(str(p), n_actors=3)
+
+    # Replay through the oracle: the loader's flattening must reproduce
+    # the document the edit script describes.
+    doc = List()
+    for k, ix, v, a in zip(kinds, idxs, vals, actors):
+        op = (
+            doc.insert_index(ix, v, a)
+            if k == INSERT
+            else doc.delete_index(ix, a)
+        )
+        doc.apply(op)
+    text = "".join(chr(v) for v in doc.read())
+    assert text == "iX" + "here"  # "hi there" -> "i there" -> "iXhere"
+
+    assert set(actors) <= {0, 1, 2}
+    assert all(k in (INSERT, DELETE) for k in kinds)
+
+
+def test_automerge_trace_loader_limit(tmp_path):
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps([[0, 0, "abcdefgh"]]))
+    kinds, idxs, vals, actors = bench.load_automerge_trace(str(p), limit=3)
+    assert len(kinds) == len(idxs) == len(vals) == len(actors) == 3
+    assert vals == [ord("a"), ord("b"), ord("c")]
+
+
+def test_deferred_depth_counts_all_buffer_levels():
+    from crdt_tpu.ops import map3 as map3_ops
+
+    st = map3_ops.empty(2, 2, 2, 4, deferred_cap=3, batch=(5,))
+    assert deferred_depth(st) == 0.0
+    # Mark parked slots at two different nesting levels of one replica
+    # and one at another: max-per-replica must see the 2-slot replica.
+    st = st._replace(odvalid=st.odvalid.at[1, 0].set(True))
+    st = st._replace(
+        mo=st.mo._replace(kdvalid=st.mo.kdvalid.at[1, 1].set(True))
+    )
+    st = st._replace(
+        mo=st.mo._replace(
+            core=st.mo.core._replace(
+                dvalid=st.mo.core.dvalid.at[3, 2].set(True)
+            )
+        )
+    )
+    assert deferred_depth(st) == 2.0  # replica 1 holds two live slots
+
+    metrics.reset()
+    observe_depth("t", st)
+    snap = metrics.snapshot()
+    assert snap["gauges"]["t.deferred_depth"]["last"] == 2.0
+
+
+def test_anti_entropy_records_depth_and_merges():
+    import jax
+    from jax.sharding import Mesh
+
+    from crdt_tpu.models import BatchedOrswot
+    from crdt_tpu.parallel.anti_entropy import mesh_fold
+    from crdt_tpu.parallel.mesh import make_mesh
+
+    metrics.reset()
+    mesh = make_mesh(4, 2)
+    m = BatchedOrswot(4, 16, 4, 2)
+    mesh_fold(m.state, mesh)
+    snap = metrics.snapshot()
+    assert snap["counters"]["anti_entropy.merges"] >= 3
+    assert "anti_entropy.orswot_fold.deferred_depth" in snap["gauges"]

@@ -102,7 +102,7 @@ def test_delta_gossip_matches_fold(mesh_shape, seed):
     dirty, fctx = _tracking(batched, applied)
     # extra rounds: forwarded rows take P-1 hops after local drain
     p = mesh_shape[0]
-    gossiped, _, of = mesh_delta_gossip(
+    gossiped, _, of, _ = mesh_delta_gossip(
         sharded, dirty, fctx, mesh, rounds=2 * p, cap=64
     )
     assert not bool(of)
@@ -160,7 +160,7 @@ def test_delta_gossip_tracks_changes_since_sync():
     mesh = make_mesh(4, 2)
     sharded = shard_orswot(diverged.state, mesh)
     folded, _ = mesh_fold(sharded, mesh)
-    gossiped, _, of = mesh_delta_gossip(
+    gossiped, _, of, _ = mesh_delta_gossip(
         sharded, dirty, fctx, mesh, rounds=10, cap=8
     )
     assert not bool(of)
@@ -200,7 +200,7 @@ def test_interval_accumulate_tracking_converges():
     mesh = make_mesh(4, 2)
     sharded = shard_orswot(replay.state, mesh)
     folded, _ = mesh_fold(sharded, mesh)
-    gossiped, _, of = mesh_delta_gossip(
+    gossiped, _, of, _ = mesh_delta_gossip(
         sharded, dirty, fctx, mesh, rounds=8, cap=32
     )
     assert not bool(of)
@@ -222,8 +222,50 @@ def test_delta_converges_for_any_cap(cap, seed):
     dirty, fctx = _tracking(batched, applied)
     e_local = sharded.ctr.shape[-2] // 2
     rounds = 4 * 4 * (-(-e_local // cap) + 2)
-    gossiped, _, of = mesh_delta_gossip(
+    gossiped, _, of, _ = mesh_delta_gossip(
         sharded, dirty, fctx, mesh, rounds=rounds, cap=cap
     )
+    assert not bool(of)
+    _rows_equal(gossiped, folded)
+
+
+@pytest.mark.parametrize("seed", [7])
+def test_residue_reports_underbudgeted_run(seed):
+    """VERDICT r04 item #4: a capped backlog with the default P-1 rounds
+    must REPORT non-convergence at runtime (residue > 0) instead of
+    silently returning an under-converged ring — and a properly budgeted
+    run of the same workload must report residue == 0."""
+    import warnings
+
+    rng = random.Random(seed)
+    states, applied = _rand_states(rng, 8, ["a", "b", "c", "d"])
+    batched = BatchedOrswot.from_pure(states)
+    mesh = make_mesh(4, 2)
+    sharded = shard_orswot(batched.state, mesh)
+    folded, _ = mesh_fold(sharded, mesh)
+
+    dirty, fctx = _tracking(batched, applied)
+    assert int(dirty.sum()) > 4  # backlog genuinely exceeds cap=1
+
+    # Under-budgeted: cap=1 starves the backlog within the default P-1
+    # rounds — the runtime indicator must fire (and warn).
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        _, _, _, residue = mesh_delta_gossip(
+            sharded, dirty, fctx, mesh, cap=1
+        )
+    assert int(residue) > 0
+    assert any("residue" in str(w.message) for w in caught)
+
+    # Properly budgeted — enough rounds AND a cap that clears the
+    # steady-state circulating-mark load: residue must certify
+    # convergence, and the result must equal the fold. (At cap=1 the
+    # indicator could never certify: forwarding marks circulate
+    # indefinitely, so some device stays slot-starved forever — the
+    # one-sidedness run_delta_ring documents.)
+    gossiped, _, of, residue = mesh_delta_gossip(
+        sharded, dirty, fctx, mesh, rounds=8, cap=64
+    )
+    assert int(residue) == 0
     assert not bool(of)
     _rows_equal(gossiped, folded)

@@ -107,7 +107,7 @@ def test_map_delta_gossip_matches_fold(mesh_shape, seed):
 
     dirty, fctx = _tracking(batched, applied)
     p = mesh_shape[0]
-    gossiped, _, of = mesh_delta_gossip_map(
+    gossiped, _, of, _ = mesh_delta_gossip_map(
         sharded, dirty, fctx, mesh, rounds=2 * p, cap=16
     )
     assert not bool(of.any())
@@ -125,7 +125,7 @@ def test_map_delta_drains_past_cap():
     dirty, fctx = _tracking(batched, applied)
     k_local = sharded.dkeys.shape[-1] // 2
     rounds = 4 * 4 * (k_local + 2)
-    gossiped, _, of = mesh_delta_gossip_map(
+    gossiped, _, of, _ = mesh_delta_gossip_map(
         sharded, dirty, fctx, mesh, rounds=rounds, cap=1
     )
     assert not bool(of.any())
@@ -162,7 +162,7 @@ def test_interval_accumulate_map_tracking_converges():
     mesh = make_mesh(4, 2)
     sharded = shard_map_state(replay.state, mesh)
     folded, _ = mesh_fold_map(sharded, mesh)
-    gossiped, _, of = mesh_delta_gossip_map(
+    gossiped, _, of, _ = mesh_delta_gossip_map(
         sharded, dirty, fctx, mesh, rounds=10, cap=16
     )
     assert not bool(of.any())

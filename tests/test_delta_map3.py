@@ -133,7 +133,7 @@ def test_map3_delta_gossip_matches_fold(mesh_shape, seed):
 
     dirty, fctx = _tracking(batched, applied)
     p = mesh_shape[0]
-    gossiped, _, of = mesh_delta_gossip_map3(
+    gossiped, _, of, _ = mesh_delta_gossip_map3(
         sharded, dirty, fctx, mesh, rounds=2 * p, cap=32
     )
     assert not bool(of.any())
@@ -151,7 +151,7 @@ def test_map3_delta_drains_past_cap():
     dirty, fctx = _tracking(batched, applied)
     e_local = sharded.mo.core.ctr.shape[-2] // 2
     rounds = 4 * 4 * (e_local + 2)
-    gossiped, _, of = mesh_delta_gossip_map3(
+    gossiped, _, of, _ = mesh_delta_gossip_map3(
         sharded, dirty, fctx, mesh, rounds=rounds, cap=2
     )
     assert not bool(of.any())

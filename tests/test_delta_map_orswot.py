@@ -129,7 +129,7 @@ def test_mo_delta_gossip_matches_fold(mesh_shape, seed):
 
     dirty, fctx = _tracking(batched, applied)
     p = mesh_shape[0]
-    gossiped, _, of = mesh_delta_gossip_map_orswot(
+    gossiped, _, of, _ = mesh_delta_gossip_map_orswot(
         sharded, dirty, fctx, mesh, rounds=2 * p, cap=24
     )
     assert not bool(of.any())
@@ -147,7 +147,7 @@ def test_mo_delta_drains_past_cap():
     dirty, fctx = _tracking(batched, applied)
     e_local = sharded.core.ctr.shape[-2] // 2
     rounds = 4 * 4 * (e_local + 2)
-    gossiped, _, of = mesh_delta_gossip_map_orswot(
+    gossiped, _, of, _ = mesh_delta_gossip_map_orswot(
         sharded, dirty, fctx, mesh, rounds=rounds, cap=1
     )
     assert not bool(of.any())
