@@ -13,6 +13,7 @@ state (SURVEY.md §7.1) and exposes:
 from .vclock import BatchedVClock
 from .counters import BatchedGCounter, BatchedPNCounter
 from .orswot import BatchedOrswot
+from .sparse_map import BatchedSparseMapOrswot
 from .sparse_orswot import BatchedSparseOrswot
 from .gset import BatchedGSet
 from .registers import BatchedLWWReg, BatchedMVReg, SlotOverflow
@@ -27,6 +28,7 @@ __all__ = [
     "BatchedGCounter",
     "BatchedPNCounter",
     "BatchedOrswot",
+    "BatchedSparseMapOrswot",
     "BatchedSparseOrswot",
     "BatchedGSet",
     "BatchedLWWReg",
