@@ -119,6 +119,12 @@ def _engine_restore(engine, arrays, values: np.ndarray) -> None:
         )
 
 
+def _is_sparse_map(model) -> bool:
+    from .models.sparse_map import BatchedSparseMapOrswot
+
+    return isinstance(model, BatchedSparseMapOrswot)
+
+
 def save(path: Union[str, os.PathLike], model) -> None:
     """Checkpoint a device model to ``path`` (one .npz file)."""
     if isinstance(model, BatchedOrswot):
@@ -135,6 +141,20 @@ def save(path: Union[str, os.PathLike], model) -> None:
             "actors": _interner_items(model.actors),
         }
         arrays = {f"s_{k}": np.asarray(v) for k, v in model.state._asdict().items()}
+    elif _is_sparse_map(model):
+        meta = {
+            "kind": "sparse_map_orswot",
+            "span": model.span,
+            "keys": _interner_items(model.keys),
+            "members": _interner_items(model.members),
+            "actors": _interner_items(model.actors),
+        }
+        arrays = {
+            **{f"c_{k}": np.asarray(v)
+               for k, v in model.state.core._asdict().items()},
+            **{f"s_{k}": np.asarray(v)
+               for k, v in model.state._asdict().items() if k != "core"},
+        }
     elif isinstance(model, BatchedMap):
         meta = {
             "kind": "map",
@@ -276,6 +296,33 @@ def load(path: Union[str, os.PathLike]):
             state.top.shape[-1],
             state.dcl.shape[-2],
             state.didx.shape[-1],
+            members=_interner_from(meta["members"]),
+            actors=_interner_from(meta["actors"]),
+        )
+        model.state = state
+        return model
+    if meta["kind"] == "sparse_map_orswot":
+        from .models.sparse_map import BatchedSparseMapOrswot
+        from .ops import sparse_nest as nest_ops
+        from .ops import sparse_orswot as sparse_ops
+
+        core = sparse_ops.SparseOrswotState(
+            **{k[2:]: dev(v) for k, v in arrays.items() if k.startswith("c_")}
+        )
+        state = nest_ops.SparseNestState(
+            core=core,
+            **{k[2:]: dev(v) for k, v in arrays.items() if k.startswith("s_")},
+        )
+        model = BatchedSparseMapOrswot(
+            core.top.shape[0],
+            int(meta["span"]),
+            core.eid.shape[-1],
+            core.top.shape[-1],
+            core.dcl.shape[-2],
+            core.didx.shape[-1],
+            state.kcl.shape[-2],
+            state.kidx.shape[-1],
+            keys=_interner_from(meta["keys"]),
             members=_interner_from(meta["members"]),
             actors=_interner_from(meta["actors"]),
         )

@@ -130,7 +130,8 @@ def replicaset(
     batched device model for ``xla``. Kinds: orswot, map, map_orswot
     (Map<K, Orswot>), map_map (Map<K1, Map<K2, MVReg>>), map3
     (Map<K1, Map<K2, Orswot>>), gcounter, pncounter, gset, lwwreg,
-    mvreg.
+    mvreg, sparse_orswot, sparse_map_orswot (segment-encoded
+    Map<K, Orswot> for huge key universes).
 
     Lane sizing for the xla backend: ``n_keys`` sizes the (outer) key
     axis, ``n_members`` sizes the inner axis of the nested kinds — the
@@ -161,6 +162,7 @@ def replicaset(
             "lwwreg": LWWReg,
             "mvreg": MVReg,
             "sparse_orswot": Orswot,  # same oracle; sparsity is a backend trait
+            "sparse_map_orswot": lambda: Map(val_default=Orswot),
         }
         if kind not in factories:
             raise ValueError(f"unknown replicaset kind {kind!r}")
@@ -187,6 +189,20 @@ def replicaset(
     if kind == "sparse_orswot":
         return BatchedSparseOrswot(
             n_replicas, n_members or 256, n_actors or 16, config.deferred_cap
+        )
+    if kind == "sparse_map_orswot":
+        from .models import BatchedSparseMapOrswot
+
+        # n_members sizes the per-key span (the member-universe capacity
+        # per key — cheap, it is virtual); n_keys2 repurposed as the
+        # live-dot capacity per replica.
+        return BatchedSparseMapOrswot(
+            n_replicas,
+            n_members or 64,
+            n_keys2 or 256,
+            n_actors or 16,
+            config.deferred_cap,
+            key_deferred_cap=config.deferred_cap,
         )
     if kind == "map":
         return BatchedMap(

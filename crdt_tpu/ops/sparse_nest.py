@@ -86,15 +86,25 @@ def _sorted_key(leaf: SparseOrswotState) -> jax.Array:
     return jnp.where(leaf.valid, leaf.eid, _INT32_MAX)
 
 
-def _ids_alive(leaf: SparseOrswotState, ids: jax.Array, span: int) -> jax.Array:
+def _ids_alive(
+    leaf: SparseOrswotState, ids: jax.Array, span: int, element_axis=None
+) -> jax.Array:
     """For each id list entry (level-local key ids, -1 = pad): does the
-    key have any live leaf dot? Dead pads report False."""
+    key have any live leaf dot? Dead pads report False. Under element
+    sharding (``element_axis`` set, inside shard_map) a key's dots may
+    live in OTHER shards — liveness is psum-reduced across the axis, so
+    every shard agrees whether a key is alive (the sparse analog of
+    ops/nest._any_slots)."""
     shape = ids.shape
     flat = ids.reshape(*shape[:-2], -1) if ids.ndim > 1 else ids
     lo = jnp.where(flat >= 0, flat * span, _INT32_MAX)
     hi = jnp.where(flat >= 0, (flat + 1) * span, _INT32_MAX)
-    alive = _bsearch_count(_sorted_key(leaf), lo, hi) > 0
-    return alive.reshape(shape)
+    count = _bsearch_count(_sorted_key(leaf), lo, hi)
+    if element_axis is not None:
+        from jax import lax
+
+        count = lax.psum(count, element_axis)
+    return (count > 0).reshape(shape)
 
 
 class SparseLeaf:
@@ -113,10 +123,11 @@ class SparseLeaf:
     def witness(self, s, actor, counter):
         return s._replace(top=s.top.at[..., actor].max(counter.astype(s.top.dtype)))
 
-    def join(self, a, b):
+    def join(self, a, b, element_axis=None):
         return sp.join(a, b)  # flags [dot-cap, deferred]
 
     def replay_keylist(self, s, kcl, kidx, kdvalid, span: int):
+        # (shard-oblivious: kills only dots present in THIS table)
         """Kill dots whose level-key (eid // span) a valid parked slot
         lists with a covering clock — the sparse analog of the dense
         expanded-mask replay. Re-canonicalizes (kills open holes)."""
@@ -134,12 +145,12 @@ class SparseLeaf:
         )
         return s._replace(eid=eid, act=act, ctr=ctr, valid=valid)
 
-    def scrub_enclosing(self, s, span: int):
+    def scrub_enclosing(self, s, span: int, element_axis=None):
         """Drop parked member-remove entries whose enclosing span-key is
         dead (the oracle deletes a bottomed child WITH its deferred
         buffer); emptied slots die."""
         entry_key = jnp.where(s.didx >= 0, s.didx // span, -1)
-        alive = _ids_alive(self.leaf(s), entry_key, span)
+        alive = _ids_alive(self.leaf(s), entry_key, span, element_axis)
         didx = _canon_rmlist(jnp.where(alive, s.didx, -1))
         dvalid = s.dvalid & jnp.any(didx >= 0, axis=-1)
         return s._replace(
@@ -148,10 +159,10 @@ class SparseLeaf:
             dvalid=dvalid,
         )
 
-    def scrub_self(self, s):
+    def scrub_self(self, s, element_axis=None):
         return s  # leaf elements hold nothing inside them
 
-    def settle_self(self, s):
+    def settle_self(self, s, element_axis=None):
         """Replay the leaf's own parked member-removes under the (maybe
         advanced) top, drop caught-up slots."""
         valid = _replay_parked(
@@ -224,6 +235,7 @@ class SparseNestLevel:
         )
 
     def replay_keylist(self, s, kcl, kidx, kdvalid, span: int):
+        # (shard-oblivious: kills only dots present in THIS table)
         """An OUTER level's parked removes replay straight through to
         the leaf segments (content only; buffers untouched — matching
         NestLevel.replay_keyset)."""
@@ -245,7 +257,7 @@ class SparseNestLevel:
             kdvalid,
         )
 
-    def scrub_enclosing(self, s, span: int):
+    def scrub_enclosing(self, s, span: int, element_axis=None):
         """Called by an ENCLOSING level: drop this level's parked
         entries (and recursively the core's) whose enclosing span-key is
         dead. A key id j at this level starts at leaf id j·self.span, so
@@ -254,61 +266,67 @@ class SparseNestLevel:
         entry_key = jnp.where(
             s[2] >= 0, (s[2] * self.span) // span, -1
         )
-        alive = _ids_alive(leaf, entry_key, span)
+        alive = _ids_alive(leaf, entry_key, span, element_axis)
         kidx = _canon_rmlist(jnp.where(alive, s[2], -1))
         kdvalid = s[3] & jnp.any(kidx >= 0, axis=-1)
         return self._make(
-            self.core.scrub_enclosing(s[0], span),
+            self.core.scrub_enclosing(s[0], span, element_axis),
             jnp.where(kdvalid[..., None], s[1], 0),
             jnp.where(kdvalid[..., None], kidx, -1),
             kdvalid,
         )
 
-    def scrub_self(self, s):
+    def scrub_self(self, s, element_axis=None):
         """Drop parked state inside THIS level's bottomed children —
         recursing inner-first (a replayed remove here can newly bottom
         an inner child). This level's OWN buffer is never self-scrubbed
         (it belongs to the level, not to any child)."""
-        core = self.core.scrub_self(s[0])
-        core = self.core.scrub_enclosing(core, self.span)
+        core = self.core.scrub_self(s[0], element_axis)
+        core = self.core.scrub_enclosing(core, self.span, element_axis)
         return self._make(core, *self._bufs(s))
 
-    def settle_self(self, s):
-        core = self.core.settle_self(s[0])
+    def settle_self(self, s, element_axis=None):
+        core = self.core.settle_self(s[0], element_axis)
         out = self.replay_outer(self._make(core, *self._bufs(s)))
-        return self.scrub_self(out)
+        return self.scrub_self(out, element_axis)
 
-    def settle_outer(self, s, cap: int):
+    def settle_outer(self, s, cap: int, element_axis=None):
         """Post-union buffer settlement: dedupe equal-clock slots →
         replay → compact → scrub; the order is correctness-critical
         (ops/nest.py ``settle_outer`` documents why)."""
         kcl, kidx, kdvalid = _dedupe_parked(s[1], s[2], s[3])
         s = self.replay_outer(self._make(s[0], kcl, kidx, kdvalid))
         kcl, kidx, kdvalid, overflow = _compact_parked(s[1], s[2], s[3], cap)
-        s = self.scrub_self(self._make(s[0], kcl, kidx, kdvalid))
+        s = self.scrub_self(self._make(s[0], kcl, kidx, kdvalid), element_axis)
         return s, jnp.any(overflow)
 
-    def join(self, a, b):
+    def join(self, a, b, element_axis=None):
         """Pairwise lattice join. Returns ``(state, flags[L+1])`` —
-        core lanes first, this level's parked-capacity lane last."""
-        core, core_flags = self.core.join(a[0], b[0])
+        core lanes first, this level's parked-capacity lane last.
+        ``element_axis`` (inside shard_map, leaf sharded by eid % S)
+        routes the scrub's key-liveness psum across element shards."""
+        core, core_flags = self.core.join(a[0], b[0], element_axis)
         kcl = jnp.concatenate([a[1], b[1]], axis=-2)
         kidx = jnp.concatenate([a[2], b[2]], axis=-2)
         kdvalid = jnp.concatenate([a[3], b[3]], axis=-1)
         state, of = self.settle_outer(
-            self._make(core, kcl, kidx, kdvalid), a[1].shape[-2]
+            self._make(core, kcl, kidx, kdvalid), a[1].shape[-2], element_axis
         )
         return state, jnp.concatenate([core_flags, of[None]])
 
-    def fold(self, states):
+    def fold(self, states, element_axis=None):
         """Log-tree fold of a replica batch (leading axis)."""
+        from functools import partial
+
         from .lattice import tree_fold
 
         identity = jax.tree.map(
             lambda x: jnp.zeros(x.shape[1:], x.dtype), states
         )
         identity = _sparse_identity_like(identity)
-        return tree_fold(states, identity, self.join)
+        return tree_fold(
+            states, identity, partial(self.join, element_axis=element_axis)
+        )
 
     # ---- op application (CmRDT) --------------------------------------
 

@@ -67,6 +67,12 @@ from .anti_entropy import (
     mesh_gossip_map_orswot,
     mesh_gossip_nested_map,
 )
+from .sparse_shard import (
+    mesh_fold_sparse_map,
+    mesh_fold_sparse_sharded,
+    split_nested,
+    split_segments,
+)
 from .delta import (
     DeltaPacket,
     apply_delta,
@@ -133,6 +139,10 @@ __all__ = [
     "mesh_fold_gset",
     "mesh_fold_lww",
     "mesh_fold_mvreg",
+    "mesh_fold_sparse_map",
+    "mesh_fold_sparse_sharded",
+    "split_nested",
+    "split_segments",
     "mesh_gossip_map",
     "mesh_gossip_map3",
     "mesh_gossip_map_orswot",

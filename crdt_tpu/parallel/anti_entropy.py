@@ -435,11 +435,12 @@ def mesh_fold_mvreg(states, mesh: Mesh):
 
 def mesh_fold_sparse(states, mesh: Mesh):
     """Converge a SPARSE (segment-encoded) ORSWOT replica batch over the
-    mesh's replica axis. Sparse mode has no dense element dimension to
-    shard — the segment table IS the element-axis compression — so the
-    state rides the replica axis only and stays replicated across the
-    element axis (a sparse replica set scales by live dots, not by
-    universe size). Returns ``(state, overflow[2])``."""
+    mesh's replica axis, with the segment table REPLICATED across the
+    element axis — the simple layout for moderate dot counts. For true
+    element scaling, partition the table by ``eid % S`` and use
+    ``sparse_shard.mesh_fold_sparse_sharded`` (per-device state and join
+    cost drop by S; restriction commutes with the join, so shard-local
+    joins are exact). Returns ``(state, overflow[2])``."""
     from ..ops import sparse_orswot as sp
 
     rsize = mesh.shape[REPLICA_AXIS]

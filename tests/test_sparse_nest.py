@@ -211,3 +211,37 @@ def test_huge_universe_smoke():
     assert m.to_pure(0) == site
     nbytes = sum(x.nbytes for x in jax.tree.leaves(m.state)) // 2
     assert nbytes < 10_000  # vs 40M cells * 2 actors * 4B dense
+
+
+def test_sparse_map_checkpoint_round_trip(tmp_path):
+    """Device checkpoint of the sparse map model: save -> load -> states
+    and interners identical; resumed model still merges correctly."""
+    from crdt_tpu import checkpoint
+
+    rng = random.Random(5)
+    states = _site_run_set(rng, n_cmds=10)
+    m = _batched(states)
+    p = tmp_path / "sm.npz"
+    checkpoint.save(p, m)
+    back = checkpoint.load(p)
+    assert back.span == m.span
+    for x, y in zip(jax.tree.leaves(back.state), jax.tree.leaves(m.state)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert list(back.keys) == list(m.keys)
+    back.merge_from(0, 1)
+    m.merge_from(0, 1)
+    assert back.to_pure(0) == m.to_pure(0)
+
+
+def test_sparse_map_factory():
+    from crdt_tpu.config import configured, replicaset
+    from crdt_tpu.models import BatchedSparseMapOrswot
+    from crdt_tpu.pure.map import Map
+
+    with configured(backend="xla"):
+        m = replicaset("sparse_map_orswot", 4, n_members=16, n_keys2=64)
+        assert isinstance(m, BatchedSparseMapOrswot)
+        assert m.span == 16 and m.dot_cap == 64
+    with configured(backend="pure"):
+        ps = replicaset("sparse_map_orswot", 2)
+        assert len(ps) == 2 and isinstance(ps[0], Map)

@@ -1,0 +1,168 @@
+"""Element-sharded sparse folds vs the unsharded path — the SP analog
+for the segment-encoded backend (VERDICT r04 Missing #2: 'shard segment
+tables across the element axis'). Restriction commutes with the join,
+so the sharded mesh fold must reproduce the unsharded fold exactly on
+content, tops, and the parked-remove SET (slot packing may differ per
+shard — each shard is its own restricted CRDT)."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from hypothesis import given, settings
+
+from crdt_tpu.models import BatchedSparseMapOrswot, BatchedSparseOrswot
+from crdt_tpu.parallel import (
+    make_mesh,
+    mesh_fold_sparse_map,
+    mesh_fold_sparse_sharded,
+    split_nested,
+    split_segments,
+)
+from crdt_tpu.ops import sparse_orswot as sp_ops
+from crdt_tpu.pure.orswot import Orswot
+
+from strategies import ACTORS, seeds
+from test_sparse_nest import _batched as _nest_batched, _site_run_set
+
+
+def _rand_orswots(rng, n=8):
+    members = [f"m{i}" for i in range(16)]
+    sites = [Orswot() for _ in range(n)]
+    ops = []
+    for i, site in enumerate(sites):
+        for _ in range(4):
+            m = rng.choice(members)
+            op = site.add(m, site.read().derive_add_ctx(f"s{i}"))
+            site.apply(op)
+            ops.append(op)
+        if rng.random() < 0.5:
+            live = sorted(site.read().val)
+            if live:
+                op = site.rm(rng.choice(live), site.read().derive_rm_ctx())
+                site.apply(op)
+    return sites
+
+
+def _parked_set(st: "jax.Array", batched):
+    """The set of (clock-tuple, element) parked pairs of a device state
+    (slot packing is not canonical across shardings; the SET is)."""
+    st = jax.device_get(st)
+    out = set()
+    for s in np.nonzero(st.dvalid)[0]:
+        clock = tuple(int(c) for c in st.dcl[s])
+        for e in st.didx[s]:
+            if e >= 0:
+                out.add((clock, int(e)))
+    return out
+
+
+@given(seeds)
+@settings(max_examples=8, deadline=None)
+def test_sharded_flat_fold_matches_unsharded(seed):
+    rng = random.Random(seed)
+    sites = _rand_orswots(rng)
+    b = BatchedSparseOrswot.from_pure(sites, dot_cap=64)
+    mesh = make_mesh(4, 2)
+
+    sharded = split_segments(b.state, 2)
+    out, of = mesh_fold_sparse_sharded(sharded, mesh)
+    assert not bool(jnp.any(of))
+
+    expect = b.fold()  # oracle-form converged state
+
+    # Reassemble: per-shard live cells union + shared top.
+    got = Orswot()
+    from crdt_tpu.vclock import VClock
+
+    st = jax.device_get(out)
+    top0 = st.top[0]
+    np.testing.assert_array_equal(st.top[0], st.top[1])  # replicated
+    got.clock = VClock(
+        {b.actors[a]: int(c) for a, c in enumerate(top0) if c > 0}
+    )
+    for shard in range(2):
+        row = jax.tree.map(lambda x: x[shard], st)
+        for s in np.nonzero(row.valid)[0]:
+            m = b.members[int(row.eid[s])]
+            entry = got.entries.setdefault(m, VClock())
+            entry.dots[b.actors[int(row.act[s])]] = int(row.ctr[s])
+    assert got.clock == expect.clock
+    assert got.entries == expect.entries
+
+    # Parked sets: union of shard sets == unsharded set.
+    folded_un, _ = sp_ops.fold(b.state)
+    un_set = _parked_set(folded_un, b)
+    sh_set = set()
+    for shard in range(2):
+        sh_set |= _parked_set(jax.tree.map(lambda x: x[shard], out), b)
+    assert sh_set == un_set
+
+
+@given(seeds)
+@settings(max_examples=6, deadline=None)
+def test_sharded_nested_fold_matches_oracle(seed):
+    """Sharded sparse Map<K, Orswot> mesh fold == the oracle fold (the
+    scrub's cross-shard key-liveness psum is what this exercises: a
+    key's members split across shards must count as one live child)."""
+    rng = random.Random(seed)
+    states = _site_run_set(rng, n_cmds=14)
+    b = _nest_batched(states)
+    mesh = make_mesh(4, 2)
+
+    sharded = split_nested(b.state, 2)
+    out, of = mesh_fold_sparse_map(sharded, mesh, span=b.span)
+    assert not bool(jnp.any(of))
+
+    expect = states[0].clone()
+    for s in states[1:]:
+        expect.merge(s.clone())
+
+    # Rebuild oracle state from the sharded device fold.
+    recon = BatchedSparseMapOrswot(
+        1, b.span, b.dot_cap, b.state.core.top.shape[-1],
+        b.state.core.dcl.shape[-2], b.state.core.didx.shape[-1],
+        b.state.kcl.shape[-2], b.state.kidx.shape[-1],
+        keys=b.keys, members=b.members, actors=b.actors,
+    )
+    got_parts = []
+    for shard in range(2):
+        recon.state = jax.tree.map(lambda x: x[shard][None], out)
+        got_parts.append(recon.to_pure(0))
+    # Reassembly is a plain UNION of the element-disjoint restrictions —
+    # NOT an oracle merge (both parts carry the full top, so a merge
+    # would read the other shard's absent members as observed-and-
+    # removed and kill them).
+    merged = got_parts[0]
+    other = got_parts[1]
+    assert merged.clock == other.clock  # tops replicated
+    for k, child in other.entries.items():
+        mine = merged.entries.get(k)
+        if mine is None:
+            merged.entries[k] = child
+        else:
+            mine.entries.update(child.entries)
+            for clock, ms in child.deferred.items():
+                mine.deferred.setdefault(clock, set()).update(ms)
+    for clock, ks in other.deferred.items():
+        merged.deferred.setdefault(clock, set()).update(ks)
+    assert merged == expect
+
+
+def test_split_preserves_state_and_respects_residue_classes():
+    rng = random.Random(3)
+    sites = _rand_orswots(rng, n=4)
+    b = BatchedSparseOrswot.from_pure(sites, dot_cap=64)
+    sharded = split_segments(b.state, 2)
+    st = jax.device_get(sharded)
+    for shard in range(2):
+        eids = st.eid[:, shard][st.valid[:, shard]]
+        assert np.all(eids % 2 == shard)
+        didx = st.didx[:, shard]
+        assert np.all((didx < 0) | (didx % 2 == shard))
+    # Tops replicated across shards.
+    np.testing.assert_array_equal(st.top[:, 0], st.top[:, 1])
+    # No dot lost: per-shard live counts sum to the original.
+    assert int(st.valid.sum()) == int(jax.device_get(b.state.valid).sum())
