@@ -222,6 +222,76 @@ class BatchedList:
             self.apply_ops(ops, op_slots=op_slots)
         self._applied = n_ops
 
+    # ---- cross-process op exchange (SURVEY §4.5: the reference ships
+    # ``Op::Insert { id, val }`` bytes to ANY replica; the TPU build's
+    # multi-host analog ships identifier paths over DCN) ----------------
+    def export_ops(self, start: int = 0, end: Optional[int] = None):
+        """Flatten ops ``[start, end)`` of the local log to plain numpy
+        arrays (kind, value, path length, flattened (index, actor,
+        counter) components) — the wire form for
+        ``parallel.multihost.sync_list``. Identifier paths are globally
+        unique and totally ordered by construction, so a remote engine
+        ingesting them reproduces the same total order."""
+        end = len(self.op_handles) if end is None else end
+        kinds = self.op_kinds[start:end]
+        values = self.op_vals[start:end]
+        paths = [
+            self.engine.identifier_path(int(h))
+            for h in self.op_handles[start:end]
+        ]
+        counts = np.asarray([len(p) for p in paths], np.int64)
+        flat = [c for p in paths for c in p]
+        return {
+            "kinds": np.ascontiguousarray(kinds, np.uint8),
+            "values": np.ascontiguousarray(values, np.int32),
+            "counts": counts,
+            "cidx": np.asarray([c[0] for c in flat], np.int64),
+            "cactor": np.asarray([c[1] for c in flat], np.int32),
+            "cctr": np.asarray([c[2] for c in flat], np.uint64),
+        }
+
+    def ingest_remote_ops(self, wire) -> None:
+        """Apply a remote process's exported ops into the local engine
+        (idempotent: duplicate identifiers no-op) and append them to the
+        op log; device slots re-permute to the grown total order."""
+        counts = wire["counts"]
+        if len(counts) == 0:
+            return
+        offsets = np.concatenate([[0], np.cumsum(counts)])
+        paths = [
+            [
+                (
+                    int(wire["cidx"][i]),
+                    int(wire["cactor"][i]),
+                    int(wire["cctr"][i]),
+                )
+                for i in range(offsets[j], offsets[j + 1])
+            ]
+            for j in range(len(counts))
+        ]
+        handles = self.engine.apply_remote(
+            wire["kinds"], paths, wire["values"]
+        )
+        new_rank = self.engine.total_order()
+        if len(new_rank) != len(self.slots):
+            src = growth_permutation(self.slots, new_rank)
+            self.vals, self.alive = self._placed(
+                *_remap_slots(self.vals, self.alive, jnp.asarray(src))
+            )
+            self.slots = new_rank
+        # A delete of an identifier the engine never saw is an idempotent
+        # no-op and yields handle -1 — it must NOT enter the op log
+        # (self.slots[-1] would wrap to the highest-ranked identifier and
+        # the scatter would clear an unrelated element).
+        ok = handles >= 0
+        self.op_handles = np.concatenate([self.op_handles, handles[ok]])
+        self.op_kinds = np.concatenate(
+            [self.op_kinds, np.ascontiguousarray(wire["kinds"], np.uint8)[ok]]
+        )
+        self.op_vals = np.concatenate(
+            [self.op_vals, np.ascontiguousarray(wire["values"], np.int32)[ok]]
+        )
+
     # ---- reads ---------------------------------------------------------
     def read(self, replica: int) -> list:
         """The replica's sequence of value ids (slot order == identifier

@@ -90,21 +90,45 @@ def _ids_alive(
     leaf: SparseOrswotState, ids: jax.Array, span: int, element_axis=None
 ) -> jax.Array:
     """For each id list entry (level-local key ids, -1 = pad): does the
-    key have any live leaf dot? Dead pads report False. Under element
-    sharding (``element_axis`` set, inside shard_map) a key's dots may
-    live in OTHER shards — liveness is psum-reduced across the axis, so
-    every shard agrees whether a key is alive (the sparse analog of
-    ops/nest._any_slots)."""
+    key have any live leaf dot? Dead pads report False.
+
+    Under element sharding (``element_axis`` set, inside shard_map) a
+    key's dots spread across ALL shards (eid % S partitioning), and the
+    query lists themselves may be shard-local (the leaf's parked didx
+    entries are restricted per shard) — so a plain psum of per-position
+    counts would add up answers for DIFFERENT keys. Instead every shard
+    all-gathers the query keys, answers every shard's queries against
+    its local table, and the answer matrix is psum-reduced; each shard
+    then reads its own row. Sound for both shard-local lists (leaf) and
+    replicated lists (outer levels, where all rows coincide)."""
     shape = ids.shape
     flat = ids.reshape(*shape[:-2], -1) if ids.ndim > 1 else ids
-    lo = jnp.where(flat >= 0, flat * span, _INT32_MAX)
-    hi = jnp.where(flat >= 0, (flat + 1) * span, _INT32_MAX)
-    count = _bsearch_count(_sorted_key(leaf), lo, hi)
-    if element_axis is not None:
-        from jax import lax
+    key = _sorted_key(leaf)
+    if element_axis is None:
+        lo = jnp.where(flat >= 0, flat * span, _INT32_MAX)
+        hi = jnp.where(flat >= 0, (flat + 1) * span, _INT32_MAX)
+        return (_bsearch_count(key, lo, hi) > 0).reshape(shape)
 
-        count = lax.psum(count, element_axis)
-    return (count > 0).reshape(shape)
+    from jax import lax
+
+    qk = lax.all_gather(flat, element_axis)        # [S, ...same as flat]
+    lo = jnp.where(qk >= 0, qk * span, _INT32_MAX)
+    hi = jnp.where(qk >= 0, (qk + 1) * span, _INT32_MAX)
+    if flat.ndim > 1:
+        # Batched states: fold the shard axis into the query width so the
+        # batched bsearch maps over the leading batch only.
+        s = qk.shape[0]
+        lo2 = jnp.moveaxis(lo, 0, -2).reshape(*flat.shape[:-1], -1)
+        hi2 = jnp.moveaxis(hi, 0, -2).reshape(*flat.shape[:-1], -1)
+        counts = _bsearch_count(key, lo2, hi2)
+        counts = jnp.moveaxis(
+            counts.reshape(*flat.shape[:-1], s, flat.shape[-1]), -2, 0
+        )
+    else:
+        counts = jax.vmap(lambda l, h: _bsearch_count(key, l, h))(lo, hi)
+    counts = lax.psum(counts, element_axis)        # [S, ...]
+    me = lax.axis_index(element_axis)
+    return (counts[me] > 0).reshape(shape)
 
 
 class SparseLeaf:

@@ -91,3 +91,67 @@ def global_to_host(global_state):
     import jax
 
     return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), global_state)
+
+
+def _allgather_host(arr: np.ndarray):
+    """All-gather a per-process host array of possibly different lengths
+    (axis 0); returns the per-process list. Lengths are exchanged first,
+    data rides one padded device all-gather."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    n = np.asarray([arr.shape[0]], np.int64)
+    lens = multihost_utils.process_allgather(n).reshape(-1)
+    maxlen = int(lens.max())
+    padded = np.zeros((maxlen, *arr.shape[1:]), arr.dtype)
+    padded[: arr.shape[0]] = arr
+    gathered = multihost_utils.process_allgather(padded)
+    return [gathered[p, : int(lens[p])] for p in range(len(lens))]
+
+
+def sync_list(model, since: int = 0) -> int:
+    """Converge ``BatchedList`` identifier universes across processes
+    (SURVEY.md §4.5 — the reference ships ``Op::Insert { id, val }``
+    bytes to any replica; here the op log's identifier paths ride a DCN
+    all-gather). Each process exports its local ops ``[since, ...)``,
+    gathers every process's export, and ingests the remote ones in
+    process order — identifier paths are globally unique and totally
+    ordered by construction, so every process reconverges to the SAME
+    total order regardless of mint site. Returns the new local-op
+    watermark to pass as ``since`` next round.
+
+    Device state re-permutes with the growing universe; run
+    ``model.apply_trace_to_all()`` afterwards to land the new ops."""
+    import jax
+
+    wire = dict(model.export_ops(since))
+    # The gather rides device arrays; without x64 mode jax silently
+    # truncates 64-bit dtypes to 32 (config.py documents the hazard), so
+    # wide fields ship as checked/split 32-bit lanes and reassemble on
+    # the host. cctr (engine mint counters, uint64) splits hi/lo; cidx
+    # and counts are range-checked into int32.
+    for f in ("cidx", "counts"):
+        if wire[f].size and wire[f].max() > np.iinfo(np.int32).max:
+            raise OverflowError(f"wire field {f} exceeds int32 range")
+        wire[f] = wire[f].astype(np.int32)
+    cctr = wire.pop("cctr")
+    wire["cctr_hi"] = (cctr >> np.uint64(32)).astype(np.uint32)
+    wire["cctr_lo"] = cctr.astype(np.uint32)
+    fields = ("kinds", "values", "counts", "cidx", "cactor",
+              "cctr_hi", "cctr_lo")
+    gathered = {f: _allgather_host(np.asarray(wire[f])) for f in fields}
+    me = jax.process_index()
+    for p in range(jax.process_count()):
+        if p == me:
+            continue
+        remote = {f: gathered[f][p] for f in fields}
+        remote["cctr"] = (
+            remote.pop("cctr_hi").astype(np.uint64) << np.uint64(32)
+        ) | remote.pop("cctr_lo").astype(np.uint64)
+        remote["counts"] = remote["counts"].astype(np.int64)
+        remote["cidx"] = remote["cidx"].astype(np.int64)
+        model.ingest_remote_ops(remote)
+    # Ops below this watermark are now known to every process (each
+    # ingested everyone's export this round) — the next sync ships only
+    # ops minted after it.
+    return len(model.op_handles)

@@ -107,7 +107,14 @@ def deferred_depth(state) -> float:
     import numpy as np
 
     total = None
-    if any(isinstance(x, jax.core.Tracer) for x in jax.tree.leaves(state)):
+    def opaque(x):
+        # Traced values have no concrete data; multi-host global arrays
+        # span non-addressable devices — either way, nothing to record.
+        return isinstance(x, jax.core.Tracer) or (
+            isinstance(x, jax.Array) and not x.is_fully_addressable
+        )
+
+    if any(opaque(x) for x in jax.tree.leaves(state)):
         return -1.0
 
     def walk(node):

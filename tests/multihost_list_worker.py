@@ -1,0 +1,81 @@
+"""Worker for tests/test_multihost.py::test_two_process_list_sync:
+each process mints a DIVERGENT local edit log on its own actors, syncs
+identifier universes over the 2-process runtime (op-log all-gather +
+remote ingestion — the reference's "ship Op::Insert{id, val} to any
+replica", SURVEY.md §4.5), applies everything to its device replicas,
+and checks every process reads the SAME converged sequence.
+
+Usage: python multihost_list_worker.py <coordinator_port> <process_id>
+"""
+
+import os
+import sys
+
+port, pid = sys.argv[1], int(sys.argv[2])
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from crdt_tpu.utils.cpu_pin import pin_cpu
+
+pin_cpu(virtual_devices=4)
+
+import jax
+import numpy as np
+
+from crdt_tpu.parallel import multihost
+
+multihost.initialize(f"localhost:{port}", num_processes=2, process_id=pid)
+assert jax.process_count() == 2
+
+from crdt_tpu.models import BatchedList
+from crdt_tpu.native import DELETE, INSERT
+
+R = 4
+model = BatchedList(R)
+
+# Divergent local logs: process 0 types "ab" then deletes one char;
+# process 1 types "XY" at the front. Actor ids are disjoint per process.
+if pid == 0:
+    kinds = [INSERT, INSERT, DELETE]
+    idxs = [0, 1, 0]
+    vals = [ord("a"), ord("b"), 0]
+    actors = [0, 0, 0]
+else:
+    kinds = [INSERT, INSERT]
+    idxs = [0, 0]
+    vals = [ord("X"), ord("Y")]
+    actors = [1, 1]
+model.extend_trace(kinds, idxs, vals, actors)
+
+watermark = multihost.sync_list(model)
+model.apply_trace_to_all()
+reads = [model.read(r) for r in range(R)]
+assert all(r == reads[0] for r in reads), reads
+
+# Both processes must converge to the same sequence (identifier order
+# is path-determined, independent of mint site); the union contains
+# process 0's surviving 'b' and process 1's 'X', 'Y'.
+got = sorted(reads[0])
+assert sorted([ord("b"), ord("X"), ord("Y")]) == got, reads[0]
+
+# Every process's read must be IDENTICAL, not just same multiset:
+# compare through an all-gather of the padded sequence.
+seq = np.asarray(reads[0], np.int64)
+others = multihost._allgather_host(seq)
+assert all(np.array_equal(o, seq) for o in others), others
+
+# Second round: more divergent edits after the first sync, incremental
+# watermark export only.
+if pid == 0:
+    model.extend_trace([INSERT], [0], [ord("z")], [0])
+else:
+    model.extend_trace([DELETE], [0], [0], [1])
+watermark = multihost.sync_list(model, since=watermark)
+model.apply_trace_to_all()
+reads2 = [model.read(r) for r in range(R)]
+assert all(r == reads2[0] for r in reads2)
+seq2 = np.asarray(reads2[0], np.int64)
+others2 = multihost._allgather_host(seq2)
+assert all(np.array_equal(o, seq2) for o in others2), others2
+
+print(f"MULTIHOST_LIST_OK process={pid} seq={reads2[0]}")

@@ -48,3 +48,36 @@ def test_two_process_mesh_fold_bit_identical():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"MULTIHOST_OK process={pid}" in out, out
+
+
+def test_two_process_list_sync():
+    """Multi-host List (VERDICT r04 Missing #4): divergent per-process
+    edit logs converge after op-log sync — identifier minting is local,
+    identifier PATHS ship over the 2-process runtime, and every process
+    reads the same sequence."""
+    worker = os.path.join(os.path.dirname(__file__), "multihost_list_worker.py")
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(port), str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("list workers timed out:\n" + "\n---\n".join(outs))
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"MULTIHOST_LIST_OK process={pid}" in out, out

@@ -166,3 +166,55 @@ def test_split_preserves_state_and_respects_residue_classes():
     np.testing.assert_array_equal(st.top[:, 0], st.top[:, 1])
     # No dot lost: per-shard live counts sum to the original.
     assert int(st.valid.sum()) == int(jax.device_get(b.state.valid).sum())
+
+
+def test_cross_shard_key_liveness_keeps_parked_state():
+    """A parked member-remove whose elements land in one shard while the
+    key's only live dots land in the OTHER shard: the scrub's liveness
+    test must see across shards (all-gathered queries, not a positional
+    psum) or the parked entry is wrongly dropped and the removed member
+    resurrects."""
+    from crdt_tpu.pure.map import Map
+    from crdt_tpu.vclock import VClock
+    from test_sparse_nest import _batched as _nest_batched, set_map
+
+    # Oracle: key "p" holds live member id 1 (odd -> shard 1) and a
+    # PARKED remove for member id 0 (even -> shard 0) under an ahead
+    # clock.
+    m = set_map()
+    op = m.update(
+        "p", m.len().derive_add_ctx("alpha"), lambda s, c: s.add("x", c)
+    )
+    m.apply(op)
+    from crdt_tpu.pure.orswot import Rm as ORm
+
+    ahead = VClock({"alpha": 9})
+    rm = m.update(
+        "p", m.len().derive_add_ctx("beta"),
+        lambda s, c: ORm(clock=ahead.clone(), members=("w",)),
+    )
+    m.apply(rm)
+    b = BatchedSparseMapOrswot.from_pure(
+        [m], span=4, dot_cap=16, rm_width=8, key_rm_width=8,
+        keys=None, members=None, actors=None,
+    )
+    # Sanity on the shard split premise: the live dot and the parked
+    # entry sit in different residue classes.
+    st = jax.device_get(jax.tree.map(lambda x: x[0], b.state))
+    live_eids = st.core.eid[st.core.valid].tolist()
+    parked = [int(e) for e in st.core.didx[st.core.dvalid].ravel() if e >= 0]
+    assert parked and live_eids
+    assert {e % 2 for e in live_eids} != {e % 2 for e in parked}
+
+    mesh = make_mesh(4, 2)
+    sharded = split_nested(b.state, 2)
+    out, of = mesh_fold_sparse_map(sharded, mesh, span=b.span)
+    assert not bool(jnp.any(of))
+    o = jax.device_get(out)
+    surviving = [
+        int(e)
+        for shard in range(2)
+        for e in o.core.didx[shard][o.core.dvalid[shard]].ravel()
+        if e >= 0
+    ]
+    assert surviving == parked, (surviving, parked)

@@ -204,3 +204,60 @@ def test_place_rejects_nondividing_replicas():
     model.extend_trace([INSERT, INSERT], [0, 1], [1, 2], [0, 0])
     model.apply_trace_to_all()
     assert model.read(0) == [1, 2]
+
+
+def test_export_ingest_round_trip_converges():
+    """The cross-process wire form, single-process: two BatchedList
+    instances mint divergent logs, exchange exports both ways, apply —
+    identical reads (identifier paths are mint-site independent)."""
+    from crdt_tpu.models import BatchedList
+    from crdt_tpu.native import DELETE, INSERT
+
+    a = BatchedList(2)
+    b = BatchedList(2)
+    a.extend_trace(
+        [INSERT, INSERT, DELETE], [0, 1, 0], [10, 11, 0], [0, 0, 0]
+    )
+    b.extend_trace([INSERT, INSERT], [0, 0], [20, 21], [1, 1])
+
+    wa, wb = a.export_ops(), b.export_ops()
+    a.ingest_remote_ops(wb)
+    b.ingest_remote_ops(wa)
+    a.apply_trace_to_all()
+    b.apply_trace_to_all()
+    ra = [a.read(r) for r in range(2)]
+    rb = [b.read(r) for r in range(2)]
+    assert ra[0] == ra[1] == rb[0] == rb[1]
+    assert sorted(ra[0]) == [11, 20, 21]
+
+    # Duplicate ingestion is idempotent (same ops delivered twice).
+    before = a.read(0)
+    a.ingest_remote_ops(wb)
+    a.apply_trace_to_all()
+    assert a.read(0) == before
+
+
+def test_ingest_absent_delete_is_dropped():
+    """A delete for an identifier the local engine never saw must be an
+    idempotent no-op — the -1 handle apply_remote returns must NOT enter
+    the op log (slots[-1] would wrap onto the highest-ranked identifier
+    and clear an unrelated element)."""
+    from crdt_tpu.models import BatchedList
+    from crdt_tpu.native import DELETE, INSERT
+
+    a = BatchedList(1)
+    a.extend_trace([INSERT, INSERT], [0, 1], [1, 2], [0, 0])
+    a.apply_trace_to_all()
+    assert a.read(0) == [1, 2]
+
+    # b mints an identifier a never learns, deletes it, and exports ONLY
+    # the delete (e.g. a pruned / partial exchange).
+    b = BatchedList(1)
+    b.extend_trace([INSERT, DELETE], [0, 0], [99, 0], [1, 1])
+    only_delete = b.export_ops(start=1)
+
+    before = len(a.op_handles)
+    a.ingest_remote_ops(only_delete)
+    assert len(a.op_handles) == before  # dropped, not appended as -1
+    a.apply_trace_to_all()
+    assert a.read(0) == [1, 2]  # nothing unrelated was cleared
