@@ -41,7 +41,7 @@ from .pure.merkle_reg import MerkleReg
 
 # Wire/storage encoding + device checkpointing (imported lazily as
 # modules too: ``crdt_tpu.serde`` / ``crdt_tpu.checkpoint``).
-from . import serde
+from . import lifecycle, serde
 from .utils.metrics import metrics
 
 __all__ = [
@@ -49,7 +49,8 @@ __all__ = [
     "Dot", "OrdDot", "VClock", "ReadCtx", "AddCtx", "RmCtx",
     "GCounter", "PNCounter", "Dir", "GSet", "LWWReg", "MVReg", "Orswot",
     "Map", "Identifier", "List", "GList", "MerkleReg",
-    "serde", "metrics",
+    "serde",
+    "lifecycle", "metrics",
 ]
 
 __version__ = "0.1.0"

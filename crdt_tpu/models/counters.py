@@ -123,3 +123,8 @@ class BatchedPNCounter:
         """Converged p − n (exact Python int at the API edge, preserving
         the reference's BigInt read — SURVEY.md §7.3)."""
         return _exact_sum(ops.fold(self.p.clocks)) - _exact_sum(ops.fold(self.n.clocks))
+
+    def read(self, i: int) -> int:
+        """One replica's local p − n (reference: src/pncounter.rs
+        ``read``), exact host int."""
+        return _exact_sum(self.p.clocks[i]) - _exact_sum(self.n.clocks[i])
