@@ -62,6 +62,7 @@ from .anti_entropy import (
     mesh_fold_nested_map,
     mesh_fold_sparse,
     mesh_gossip,
+    mesh_gossip_sparse,
     mesh_gossip_map,
     mesh_gossip_map3,
     mesh_gossip_map_orswot,
@@ -144,6 +145,7 @@ __all__ = [
     "split_nested",
     "split_segments",
     "mesh_gossip_map",
+    "mesh_gossip_sparse",
     "mesh_gossip_map3",
     "mesh_gossip_map_orswot",
     "mesh_gossip_nested_map",

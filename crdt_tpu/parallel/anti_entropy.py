@@ -433,6 +433,25 @@ def mesh_fold_mvreg(states, mesh: Mesh):
     )
 
 
+def _sparse_pad_and_template(states, rsize: int):
+    """Identity-pad a sparse replica batch to the mesh's replica-axis
+    size and build the (unbatched) spec template — shared shape plumbing
+    for the sparse mesh entry points."""
+    from ..ops import sparse_orswot as sp
+
+    shape_args = (
+        states.eid.shape[-1],
+        states.top.shape[-1],
+        states.dcl.shape[-2],
+        states.didx.shape[-1],
+    )
+    pad_r = (-states.top.shape[0]) % rsize
+    states = _pad_with_identity(
+        states, rsize, sp.empty(*shape_args, batch=(pad_r,)) if pad_r else None
+    )
+    return states, sp.empty(*shape_args)
+
+
 def mesh_fold_sparse(states, mesh: Mesh):
     """Converge a SPARSE (segment-encoded) ORSWOT replica batch over the
     mesh's replica axis, with the segment table REPLICATED across the
@@ -443,33 +462,33 @@ def mesh_fold_sparse(states, mesh: Mesh):
     joins are exact). Returns ``(state, overflow[2])``."""
     from ..ops import sparse_orswot as sp
 
-    rsize = mesh.shape[REPLICA_AXIS]
-    pad_r = (-states.top.shape[0]) % rsize
-    states = _pad_with_identity(
-        states,
-        rsize,
-        sp.empty(
-            states.eid.shape[-1],
-            states.top.shape[-1],
-            states.dcl.shape[-2],
-            states.didx.shape[-1],
-            batch=(pad_r,),
-        )
-        if pad_r
-        else None,
-    )
-
-    template = sp.empty(
-        states.eid.shape[-1],
-        states.top.shape[-1],
-        states.dcl.shape[-2],
-        states.didx.shape[-1],
+    states, template = _sparse_pad_and_template(
+        states, mesh.shape[REPLICA_AXIS]
     )
     return _mesh_fold_lattice(
         "sparse_orswot_fold", states, mesh,
         sp.join, sp.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template),
         jax.tree.map(lambda _: P(), template),
+    )
+
+
+def mesh_gossip_sparse(
+    states, mesh: Mesh, rounds: Optional[int] = None
+):
+    """Ring anti-entropy for SPARSE (segment-encoded) ORSWOT replica
+    batches over the replica axis (the bounded-bandwidth mode —
+    per-round traffic is one segment table per link, which for sparse
+    states is proportional to LIVE dots, not the universe). Same
+    replicated-element-axis layout as ``mesh_fold_sparse``."""
+    from ..ops import sparse_orswot as sp
+
+    states, template = _sparse_pad_and_template(
+        states, mesh.shape[REPLICA_AXIS]
+    )
+    return _mesh_gossip_lattice(
+        "sparse_gossip", states, mesh, sp.join, sp.fold,
+        jax.tree.map(lambda _: P(REPLICA_AXIS), template), rounds,
     )
 
 

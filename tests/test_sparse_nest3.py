@@ -1,0 +1,247 @@
+"""Depth-3 SPARSE ``Map<K1, Map<K2, Orswot>>`` vs the oracle — the gate
+that the sparse nesting induction COMPOSES: depth 3 is built here by
+wrapping ``SparseNestLevel`` around the depth-2 level, with NO new ops
+module (mirroring tests/test_nest_depth4.py for the dense family;
+reference: src/map.rs arbitrary ``V: Val<A>`` depth).
+
+Leaf ids flatten the full product: e = (i1·K2 + i2)·M + im. The inner
+(K2) level has span M with key ids i1·K2 + i2; the outer (K1) level has
+span K2·M with key ids i1. Conversions are lossless across all three
+parked levels, so the gates are exact oracle equality."""
+
+import random
+
+import jax
+import numpy as np
+from hypothesis import given, settings
+
+from crdt_tpu.ops import sparse_nest as nest
+from crdt_tpu.ops import sparse_orswot as sp
+from crdt_tpu.pure.map import Map
+from crdt_tpu.pure.orswot import Orswot
+from crdt_tpu.utils import Interner
+from crdt_tpu.vclock import VClock
+
+from strategies import ACTORS, seeds
+from test_models_map3 import (
+    KEYS1,
+    KEYS2,
+    MEMBERS,
+    _site_run,
+    map3,
+)
+
+ALL_ACTORS = ACTORS + ["A", "B", "C"]
+K1, K2, M = len(KEYS1), len(KEYS2), len(MEMBERS)
+A = len(ALL_ACTORS)
+D = 12
+CAP = 128
+W = 32  # parked-list width at every level
+
+ACT = Interner(ALL_ACTORS)
+IK1, IK2, IM = Interner(KEYS1), Interner(KEYS2), Interner(MEMBERS)
+
+LEVEL2 = nest.SparseNestLevel(nest.SPARSE_LEAF, M)          # K2 level
+LEVEL3 = nest.SparseNestLevel(LEVEL2, K2 * M)               # K1 level
+
+
+def empty3(batch=()):
+    leaf = sp.empty(CAP, A, deferred_cap=D, rm_width=W, batch=batch)
+    mid = LEVEL2.empty(leaf, A, D, W, batch=batch)
+    return LEVEL3.empty(mid, A, D, W, batch=batch)
+
+
+def _clock_vec(clock: VClock) -> np.ndarray:
+    v = np.zeros((A,), np.uint32)
+    for actor, c in clock.dots.items():
+        v[ACT.id_of(actor)] = c
+    return v
+
+
+def _vec_clock(v) -> VClock:
+    return VClock(
+        {ALL_ACTORS[a]: int(c) for a, c in enumerate(np.asarray(v)) if c}
+    )
+
+
+def _park(parked_dict, id_of):
+    """Oracle deferred dict -> (dcl, idx, dvalid) list-slot arrays."""
+    dcl = np.zeros((D, A), np.uint32)
+    idx = np.full((D, W), -1, np.int32)
+    valid = np.zeros((D,), bool)
+    for s, (clock, items) in enumerate(parked_dict.items()):
+        assert s < D, "test encode: deferred overflow"
+        dcl[s] = _clock_vec(clock)
+        ids = sorted(id_of(it) for it in items)
+        assert len(ids) <= W
+        idx[s, : len(ids)] = ids
+        valid[s] = True
+    return dcl, idx, valid
+
+
+def encode(pures):
+    """Pure nested maps -> one batched sparse depth-3 state."""
+    rows = []
+    for p in pures:
+        cells = sorted(
+            (
+                ((IK1.id_of(k1) * K2 + IK2.id_of(k2)) * M + IM.id_of(m)),
+                ACT.id_of(a),
+                c,
+            )
+            for k1, c2 in p.entries.items()
+            for k2, leaf in c2.entries.items()
+            for m, clock in leaf.entries.items()
+            for a, c in clock.dots.items()
+        )
+        assert len(cells) <= CAP
+        eid = np.full((CAP,), -1, np.int32)
+        act = np.zeros((CAP,), np.int32)
+        ctr = np.zeros((CAP,), np.uint32)
+        valid = np.zeros((CAP,), bool)
+        for s, (e, a, c) in enumerate(cells):
+            eid[s], act[s], ctr[s], valid[s] = e, a, c, True
+
+        leaf_parked: dict = {}
+        mid_parked: dict = {}
+        for k1, c2 in p.entries.items():
+            i1 = IK1.id_of(k1)
+            for clock, ks in c2.deferred.items():
+                mid_parked.setdefault(clock, set()).update(
+                    i1 * K2 + IK2.id_of(k) for k in ks
+                )
+            for k2, leaf in c2.entries.items():
+                base = (i1 * K2 + IK2.id_of(k2)) * M
+                for clock, ms in leaf.deferred.items():
+                    leaf_parked.setdefault(clock, set()).update(
+                        base + IM.id_of(m) for m in ms
+                    )
+        dcl, didx, dvalid = _park(leaf_parked, lambda x: x)
+        kcl2, kidx2, kvalid2 = _park(mid_parked, lambda x: x)
+        kcl1, kidx1, kvalid1 = _park(p.deferred, lambda k: IK1.id_of(k))
+
+        leaf_state = sp.SparseOrswotState(
+            top=_clock_vec(p.clock), eid=eid, act=act, ctr=ctr, valid=valid,
+            dcl=dcl, didx=didx, dvalid=dvalid,
+        )
+        ceid, cact, cctr, cvalid, _ = sp._canon(
+            leaf_state.eid, leaf_state.act, leaf_state.ctr,
+            leaf_state.valid, CAP,
+        )
+        leaf_state = leaf_state._replace(
+            eid=ceid, act=cact, ctr=cctr, valid=cvalid
+        )
+        rows.append(
+            nest.SparseNestState(
+                core=nest.SparseNestState(
+                    core=leaf_state, kcl=kcl2, kidx=kidx2, kdvalid=kvalid2
+                ),
+                kcl=kcl1, kidx=kidx1, kdvalid=kvalid1,
+            )
+        )
+    return jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]), *rows)
+
+
+def decode(state) -> Map:
+    """One (unbatched) sparse depth-3 state -> the oracle form."""
+    st = jax.device_get(state)
+    leaf = st.core.core
+    out = map3()
+    out.clock = _vec_clock(leaf.top)
+
+    def child2(k1):
+        c2 = out.entries.get(k1)
+        if c2 is None:
+            c2 = Map(val_default=Orswot)
+            c2.clock = out.clock.clone()
+            out.entries[k1] = c2
+        return c2
+
+    def leaf_of(k1, k2):
+        c2 = child2(k1)
+        lf = c2.entries.get(k2)
+        if lf is None:
+            lf = Orswot()
+            lf.clock = out.clock.clone()
+            c2.entries[k2] = lf
+        return lf
+
+    for s in np.nonzero(leaf.valid)[0]:
+        e = int(leaf.eid[s])
+        i12, im = divmod(e, M)
+        i1, i2 = divmod(i12, K2)
+        lf = leaf_of(KEYS1[i1], KEYS2[i2])
+        entry = lf.entries.setdefault(MEMBERS[im], VClock())
+        entry.dots[ALL_ACTORS[int(leaf.act[s])]] = int(leaf.ctr[s])
+
+    for s in np.nonzero(leaf.dvalid)[0]:
+        clock = _vec_clock(leaf.dcl[s])
+        for e in leaf.didx[s]:
+            if e < 0:
+                continue
+            i12, im = divmod(int(e), M)
+            i1, i2 = divmod(i12, K2)
+            c2 = out.entries.get(KEYS1[i1])
+            lf = c2.entries.get(KEYS2[i2]) if c2 is not None else None
+            if lf is None:
+                continue  # scrubbed dead key (oracle dropped it too)
+            lf.deferred.setdefault(clock.clone(), set()).add(MEMBERS[im])
+    for s in np.nonzero(st.core.kdvalid)[0]:
+        clock = _vec_clock(st.core.kcl[s])
+        for k in st.core.kidx[s]:
+            if k < 0:
+                continue
+            i1, i2 = divmod(int(k), K2)
+            c2 = out.entries.get(KEYS1[i1])
+            if c2 is None:
+                continue
+            c2.deferred.setdefault(clock.clone(), set()).add(KEYS2[i2])
+    for s in np.nonzero(st.kdvalid)[0]:
+        clock = _vec_clock(st.kcl[s])
+        out.deferred.setdefault(clock.clone(), set()).update(
+            KEYS1[int(k)] for k in st.kidx[s] if k >= 0
+        )
+    return out
+
+
+def _rows(batched, i):
+    return jax.tree.map(lambda x: x[i], batched)
+
+
+@given(seeds)
+@settings(max_examples=10, deadline=None)
+def test_sparse_depth3_round_trip_lossless(seed):
+    rng = random.Random(seed)
+    states = _site_run(rng, n_cmds=12)
+    batched = encode(states)
+    for i, p in enumerate(states):
+        assert decode(_rows(batched, i)) == p
+
+
+@given(seeds)
+@settings(max_examples=10, deadline=None)
+def test_sparse_depth3_join_matches_oracle(seed):
+    rng = random.Random(seed)
+    states = _site_run(rng, n_cmds=12)
+    batched = encode(states)
+
+    expect = states[0].clone()
+    expect.merge(states[1].clone())
+    joined, flags = LEVEL3.join(_rows(batched, 0), _rows(batched, 1))
+    assert not bool(np.asarray(flags).any())
+    assert decode(joined) == expect
+
+
+@given(seeds)
+@settings(max_examples=8, deadline=None)
+def test_sparse_depth3_fold_matches_oracle(seed):
+    rng = random.Random(seed)
+    states = _site_run(rng, n_cmds=14)
+    batched = encode(states)
+
+    expect = states[0].clone()
+    for s in states[1:]:
+        expect.merge(s.clone())
+    folded, flags = LEVEL3.fold(jax.tree.map(lambda x: np.asarray(x), batched))
+    assert not bool(np.asarray(flags).any())
+    assert decode(folded) == expect

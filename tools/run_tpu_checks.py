@@ -127,6 +127,14 @@ def main() -> int:
     bench.bench_list()  # BASELINE scale: 100k-op trace x 1024 replicas
     print(f"config5 100kx1024 ran              [{time.time()-t0:.0f}s]")
 
+    t0 = time.time()
+    rec = bench.bench_sparse()  # 1M-element universe, segment-encoded
+    print(
+        f"config-sparse 1M-universe ran       [{time.time()-t0:.0f}s] "
+        f"({rec['value']:,.0f} merges/s, {rec['compression']:,.0f}x "
+        f"compression)"
+    )
+
     # In-process (libtpu is exclusive per process — a subprocess could
     # not reach the already-initialized chip).
     t0 = time.time()
