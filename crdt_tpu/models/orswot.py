@@ -57,7 +57,12 @@ class BatchedOrswot:
         members: Optional[Interner] = None,
         actors: Optional[Interner] = None,
         deferred_cap: int = 8,
+        n_members: int = 0,
+        n_actors: int = 0,
     ) -> "BatchedOrswot":
+        """``n_members`` / ``n_actors`` set capacity FLOORS above the
+        names present in ``pures`` — spare lanes that later ops minting
+        new members/actors intern into (``apply``)."""
         members = members if members is not None else Interner()
         actors = actors if actors is not None else Interner()
         for p in pures:
@@ -73,7 +78,9 @@ class BatchedOrswot:
                 for m in ms:
                     members.intern(m)
 
-        r, e, a = len(pures), max(len(members), 1), max(len(actors), 1)
+        r = len(pures)
+        e = max(len(members), n_members, 1)
+        a = max(len(actors), n_actors, 1)
         top = np.zeros((r, a), np.uint32)
         ctr = np.zeros((r, e, a), np.uint32)
         dcl = np.zeros((r, deferred_cap, a), np.uint32)
@@ -141,28 +148,40 @@ class BatchedOrswot:
     def apply(self, replica: int, op) -> None:
         """Apply an oracle-shaped op to one replica (reference:
         src/orswot.rs ``CmRDT::apply``)."""
+        # Unseen names intern into spare lanes (the reference's apply
+        # accepts ops minting new members/actors — src/orswot.rs
+        # CmRDT::apply inserts into its BTreeMaps); a full universe is a
+        # clear IndexError, same convention as every other model. A
+        # rejected op must be side-effect free (the validation.py
+        # contract), so interner allocations roll back on any rejection.
+        nm0, na0 = len(self.members), len(self.actors)
+        try:
+            self._apply(replica, op)
+        except Exception:
+            self.members.truncate(nm0)
+            self.actors.truncate(na0)
+            raise
+
+    def _apply(self, replica: int, op) -> None:
         row = self._row(self.state, replica)
+        na = self.state.top.shape[-1]
+        ne = self.state.ctr.shape[-2]
         if isinstance(op, Add):
             strict_validate_dot(row.top, self.actors, op.dot.actor, op.dot.counter)
-            aid = self.actors.id_of(op.dot.actor)
-            if aid >= self.state.top.shape[-1]:
-                raise IndexError(
-                    f"actor id {aid} outside the {self.state.top.shape[-1]}-lane universe"
-                )
-            mask = np.zeros((self.state.ctr.shape[-2],), bool)
+            aid = self.actors.bounded_intern(op.dot.actor, na, "actor")
+            mask = np.zeros((ne,), bool)
             for m in op.members:
-                mask[self.members.id_of(m)] = True
+                mask[self.members.bounded_intern(m, ne, "member")] = True
             row = ops.apply_add(
                 row, jnp.asarray(aid), jnp.asarray(op.dot.counter), jnp.asarray(mask)
             )
         elif isinstance(op, Rm):
-            a = self.state.top.shape[-1]
-            cl = np.zeros((a,), np.uint32)
+            cl = np.zeros((na,), np.uint32)
             for actor, c in op.clock.dots.items():
-                cl[self.actors.id_of(actor)] = c
-            mask = np.zeros((self.state.ctr.shape[-2],), bool)
+                cl[self.actors.bounded_intern(actor, na, "actor")] = c
+            mask = np.zeros((ne,), bool)
             for m in op.members:
-                mask[self.members.id_of(m)] = True
+                mask[self.members.bounded_intern(m, ne, "member")] = True
             row, overflow = ops.apply_rm(row, jnp.asarray(cl), jnp.asarray(mask))
             if bool(overflow):
                 raise DeferredOverflow(

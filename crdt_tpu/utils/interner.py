@@ -52,6 +52,16 @@ class Interner:
             )
         return ix
 
+    def truncate(self, n: int) -> None:
+        """Roll back to the first ``n`` ids. ONLY for transactional op
+        application: a rejected op must be side-effect free (the
+        validation.py contract), so names it interned before the
+        rejection are un-allocated again. Never valid once any state
+        references the dropped lanes."""
+        for item in self._items[n:]:
+            del self._ids[item]
+        del self._items[n:]
+
     def __getitem__(self, ix: int) -> Any:
         return self._items[ix]
 

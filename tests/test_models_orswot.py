@@ -180,3 +180,59 @@ def test_to_pure_keeps_empty_deferred_slot():
     assert ahead in p.deferred and p.deferred[ahead] == set()
     dev = BatchedOrswot.from_pure([p])
     assert dev.to_pure(0) == p
+
+
+def test_apply_interns_new_names_into_spare_lanes():
+    # The reference's CmRDT::apply accepts ops minting never-seen
+    # members/actors (src/orswot.rs inserts into its BTreeMaps). The
+    # dense model matches within its static universe: unseen names
+    # intern into spare lanes (n_members/n_actors floors in from_pure);
+    # a full universe is a clear IndexError, not a KeyError.
+    import copy
+
+    import pytest
+
+    pures = []
+    for r in range(3):
+        o = Orswot()
+        o.apply(o.add(f"m{r}", o.read().derive_add_ctx(f"actor{r}")))
+        pures.append(o)
+    dev = BatchedOrswot.from_pure(pures, n_members=8, n_actors=8)
+    donor = dev.to_pure(0)
+    op = donor.add("fresh-member", donor.read().derive_add_ctx("fresh-actor"))
+    dev.apply(0, op)
+
+    oracle = Orswot()
+    pures[0].apply(op)
+    for p in pures:
+        oracle.merge(copy.deepcopy(p))
+    assert dev.fold() == oracle
+    assert "fresh-member" in oracle.read().val
+
+    tight = BatchedOrswot.from_pure(pures[:1])
+    src = tight.to_pure(0)
+    op2 = src.add("no-room", src.read().derive_add_ctx("actor0"))
+    with pytest.raises(IndexError, match="universe is full"):
+        tight.apply(0, op2)
+
+
+def test_rejected_apply_rolls_back_interned_names():
+    # A rejected op is side-effect free (validation.py contract): names
+    # interned before the rejection un-allocate, so capacity is not
+    # consumed by ops that never applied.
+    import pytest
+
+    from crdt_tpu.pure.orswot import Add
+
+    o = Orswot()
+    o.apply(o.add("m0", o.read().derive_add_ctx("actor0")))
+    dev = BatchedOrswot.from_pure([o], n_members=2)  # exactly one spare lane
+    donor = dev.to_pure(0)
+    op = donor.add("x", donor.read().derive_add_ctx("actor0"))
+    two = Add(dot=op.dot, members=frozenset({"x", "y"}))  # needs two lanes
+    with pytest.raises(IndexError):
+        dev.apply(0, two)
+    assert "x" not in dev.members and "y" not in dev.members
+    # The spare lane is still free for a valid single-member op.
+    dev.apply(0, op)
+    assert "x" in dev.to_pure(0).read().val

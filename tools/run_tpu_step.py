@@ -1,0 +1,181 @@
+"""Run ONE named on-hardware check in this process and exit 0/1.
+
+The monolithic ``tools/run_tpu_checks.py`` battery needs ~30 minutes of
+continuous relay uptime, and rounds 3-5 all watched the relay tunnel die
+mid-battery (a process's tunnel port is assigned at backend init; when
+the tunnel process dies, every subsequent remote_compile in that process
+is a connection-refused, so one relay hiccup erases the whole run).
+This runner is the unit of the checkpointed capture strategy
+(``tools/capture_tpu_evidence.py``): each step is small (one or two
+Mosaic compiles), runs in a fresh process with a fresh tunnel, and
+reports its own result — so a relay death costs one step, not the
+battery.
+
+    python tools/run_tpu_step.py <step>
+    python tools/run_tpu_step.py --list
+
+On success the LAST stdout line is a one-line human summary (sometimes
+a JSON object) that the capture loop records as the step's detail.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _pallas_tests():
+    spec = importlib.util.spec_from_file_location(
+        "tpc", os.path.join(ROOT, "tests", "test_pallas_compiled.py")
+    )
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+def _require_tpu():
+    import jax
+
+    backend = jax.default_backend()
+    if backend not in ("tpu", "axon"):
+        print(f"FAIL: backend is {backend}, not a TPU")
+        sys.exit(1)
+    print(f"backend: {backend}, devices: {jax.devices()}", file=sys.stderr)
+
+
+def step_mosaic_fused():
+    _require_tpu()
+    _pallas_tests().test_fused_fold_compiles_and_matches_tree_on_tpu()
+    print("compiled fused fold == tree fold (bit-identical on hardware)")
+
+
+def step_mosaic_stream():
+    _require_tpu()
+    _pallas_tests().test_multi_pass_stream_compiles_on_tpu()
+    print("multi-pass stream fold idempotent (compiled)")
+
+
+def step_mosaic_map():
+    _require_tpu()
+    _pallas_tests().test_fused_map_fold_compiles_and_matches_tree_on_tpu()
+    print("compiled Map<K, MVReg> fused fold == tree fold")
+
+
+def step_mosaic_levels():
+    _require_tpu()
+    _pallas_tests().test_fused_level_folds_compile_and_match_tree_on_tpu()
+    print("compiled map_orswot + map3 nested fused folds == tree folds")
+
+
+def step_bench_fused():
+    """The flagship: BASELINE config-3 full-scale streamed fused fold.
+    Fails unless the fused Pallas path actually ran on the chip."""
+    import bench
+
+    _require_tpu()
+    mps, path, gbps, nbytes, shape = bench.bench_tpu()
+    if path != "fused":
+        print(f"FAIL: path={path}, fused kernel did not run")
+        sys.exit(1)
+    print(json.dumps({
+        "metric": "orswot_merges_per_sec", "value": round(mps, 1),
+        "unit": "merges/s", "path": path, "gbps": round(gbps, 1),
+        "bytes_moved": nbytes, "shape": shape,
+    }))
+
+
+def step_config4_map():
+    os.environ.setdefault("BENCH_MAP_KEYS", "1000000")
+    import bench
+
+    _require_tpu()
+    rec = bench.bench_map()
+    if rec["path"] != "fused":
+        print(f"FAIL: config4 path={rec['path']}")
+        sys.exit(1)
+    print(json.dumps(rec))
+
+
+def step_config5_list():
+    import bench
+
+    _require_tpu()
+    print(json.dumps(bench.bench_list()))
+
+
+def step_sparse_1m():
+    import bench
+
+    _require_tpu()
+    print(json.dumps(bench.bench_sparse()))
+
+
+def step_npasses_ab():
+    import run_tpu_checks
+
+    _require_tpu()
+    if not run_tpu_checks.npasses_streaming_ab():
+        sys.exit(1)
+    print("n_passes re-walk stream time-equivalent to distinct chunks, same bits")
+
+
+def step_entry_compile():
+    _require_tpu()
+    import jax
+
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    t0 = time.time()
+    jax.jit(fn).lower(*args).compile()
+    print(f"entry() compiles on hardware [{time.time()-t0:.0f}s]")
+
+
+def step_crossover():
+    _require_tpu()
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    from sparse_crossover import run as crossover_run
+
+    print(f"sparse crossover: {crossover_run()}")
+
+
+STEPS = {
+    "bench_fused": step_bench_fused,
+    "mosaic_levels": step_mosaic_levels,
+    "config4_map": step_config4_map,
+    "config5_list": step_config5_list,
+    "sparse_1m": step_sparse_1m,
+    "mosaic_fused": step_mosaic_fused,
+    "mosaic_stream": step_mosaic_stream,
+    "mosaic_map": step_mosaic_map,
+    "npasses_ab": step_npasses_ab,
+    "entry_compile": step_entry_compile,
+    "crossover": step_crossover,
+}
+
+
+def main() -> int:
+    if len(sys.argv) != 2 or sys.argv[1] in ("-h", "--help"):
+        print(f"usage: {sys.argv[0]} <step>|--list", file=sys.stderr)
+        return 2
+    if sys.argv[1] == "--list":
+        print("\n".join(STEPS))
+        return 0
+    name = sys.argv[1]
+    if name not in STEPS:
+        print(f"unknown step {name!r}; see --list", file=sys.stderr)
+        return 2
+    # tools/ on the path for run_tpu_checks import (npasses_ab).
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    STEPS[name]()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
