@@ -27,12 +27,19 @@ class BatchedGSet:
         return self.present.shape[0]
 
     @classmethod
-    def from_pure(cls, pures: Sequence[GSet], members: Optional[Interner] = None) -> "BatchedGSet":
+    def from_pure(
+        cls,
+        pures: Sequence[GSet],
+        members: Optional[Interner] = None,
+        n_members: int = 0,
+    ) -> "BatchedGSet":
+        """``n_members`` sets a capacity FLOOR above the members present
+        in ``pures`` — spare lanes later inserts intern into."""
         members = members if members is not None else Interner()
         for p in pures:
             for m in sorted(p.value, key=repr):
                 members.intern(m)
-        arr = np.zeros((len(pures), max(len(members), 1)), bool)
+        arr = np.zeros((len(pures), max(len(members), n_members, 1)), bool)
         for i, p in enumerate(pures):
             for m in p.value:
                 arr[i, members.id_of(m)] = True
@@ -45,17 +52,24 @@ class BatchedGSet:
         return GSet(self.members[int(e)] for e in np.nonzero(row)[0])
 
     def insert(self, replica: int, member) -> None:
-        mid = self.members.intern(member)
-        if mid >= self.present.shape[-1]:
-            raise IndexError(
-                f"member id {mid} outside the {self.present.shape[-1]}-lane universe"
-            )
+        # bounded_intern raises BEFORE allocating when the universe is
+        # full — a rejected insert is side-effect free (validation.py
+        # contract), so contains() can never see a laneless name.
+        mid = self.members.bounded_intern(
+            member, self.present.shape[-1], "member"
+        )
         self.present = self.present.at[replica, mid].set(True)
 
     def contains(self, replica: int, member) -> bool:
         if member not in self.members:
             return False
-        return bool(self.present[replica, self.members.id_of(member)])
+        mid = self.members.id_of(member)
+        if mid >= self.present.shape[-1]:
+            # Shared-interner name beyond this model's lanes (JAX gather
+            # would clamp to the last lane and answer for a DIFFERENT
+            # member).
+            return False
+        return bool(self.present[replica, mid])
 
     def merge_from(self, dst: int, src: int) -> None:
         self.present = self.present.at[dst].set(

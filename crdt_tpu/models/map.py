@@ -22,7 +22,7 @@ from ..ops import map as ops
 from ..ops import mvreg as mv_ops
 from ..pure.map import Map, MapRm, Nop, Up
 from ..pure.mvreg import MVReg, Put
-from ..utils import Interner
+from ..utils import Interner, transactional_apply
 from ..utils.metrics import metrics
 from ..vclock import VClock
 from .orswot import DeferredOverflow
@@ -63,7 +63,11 @@ class BatchedMap:
         values: Optional[Interner] = None,
         sibling_cap: int = 4,
         deferred_cap: int = 4,
+        n_keys: int = 0,
+        n_actors: int = 0,
     ) -> "BatchedMap":
+        """``n_keys`` / ``n_actors`` set capacity FLOORS above the names
+        present in ``pures`` — spare lanes later ops intern into."""
         keys = keys if keys is not None else Interner()
         actors = actors if actors is not None else Interner()
         values = values if values is not None else Interner()
@@ -88,7 +92,7 @@ class BatchedMap:
                     keys.intern(k)
 
         r = len(pures)
-        nk, na = max(len(keys), 1), max(len(actors), 1)
+        nk, na = max(len(keys), n_keys, 1), max(len(actors), n_actors, 1)
         out = cls(
             r, nk, na, sibling_cap, deferred_cap,
             keys=keys, actors=actors, values=values,
@@ -189,6 +193,7 @@ class BatchedMap:
         return out
 
     # ---- op path (CmRDT) ----------------------------------------------
+    @transactional_apply("keys", "actors", "values")
     def apply(self, replica: int, op) -> None:
         """Apply an oracle-shaped op to one replica (reference:
         src/map.rs ``CmRDT::apply``)."""

@@ -22,7 +22,7 @@ import numpy as np
 from ..ops import map3 as ops
 from ..pure.map import Map, MapRm, Nop, Up
 from ..pure.orswot import Add as OrswotAdd, Orswot, Rm as OrswotRm
-from ..utils import Interner
+from ..utils import Interner, transactional_apply
 from ..utils.metrics import metrics, observe_depth
 from ..vclock import VClock
 from .orswot import DeferredOverflow
@@ -296,6 +296,7 @@ class BatchedMap3:
         return out
 
     # ---- op path (CmRDT) ----------------------------------------------
+    @transactional_apply("keys1", "keys2", "members", "actors")
     def apply(self, replica: int, op) -> None:
         """Apply an oracle-shaped op to one replica (reference:
         src/map.rs ``CmRDT::apply`` routing through two map levels)."""

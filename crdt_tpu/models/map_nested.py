@@ -30,7 +30,7 @@ from ..ops import mvreg as mv_ops
 from ..pure.map import Map, MapRm, Nop, Up
 from ..pure.mvreg import MVReg, Put
 from ..pure.orswot import Add as OrswotAdd, Orswot, Rm as OrswotRm
-from ..utils import Interner
+from ..utils import Interner, transactional_apply
 from ..utils.metrics import metrics, observe_depth
 from ..vclock import VClock
 from .orswot import DeferredOverflow
@@ -234,6 +234,7 @@ class BatchedMapOrswot:
         return out
 
     # ---- op path (CmRDT) ----------------------------------------------
+    @transactional_apply("keys", "members", "actors")
     def apply(self, replica: int, op) -> None:
         """Apply an oracle-shaped op to one replica (reference:
         src/map.rs ``CmRDT::apply`` routing orswot child ops)."""
@@ -592,6 +593,7 @@ class BatchedNestedMap:
         return out
 
     # ---- op path (CmRDT) ----------------------------------------------
+    @transactional_apply("keys1", "keys2", "actors", "values")
     def apply(self, replica: int, op) -> None:
         """Apply an oracle-shaped op to one replica (reference:
         src/map.rs ``CmRDT::apply`` routing nested map ops)."""

@@ -19,7 +19,7 @@ import numpy as np
 from ..dot import Dot
 from ..ops import orswot as ops
 from ..pure.orswot import Add, Orswot, Rm
-from ..utils import Interner
+from ..utils import Interner, transactional
 from ..utils.metrics import metrics
 from .validation import strict_validate_dot
 from ..vclock import VClock
@@ -154,13 +154,8 @@ class BatchedOrswot:
         # clear IndexError, same convention as every other model. A
         # rejected op must be side-effect free (the validation.py
         # contract), so interner allocations roll back on any rejection.
-        nm0, na0 = len(self.members), len(self.actors)
-        try:
+        with transactional(self.members, self.actors):
             self._apply(replica, op)
-        except Exception:
-            self.members.truncate(nm0)
-            self.actors.truncate(na0)
-            raise
 
     def _apply(self, replica: int, op) -> None:
         row = self._row(self.state, replica)

@@ -23,7 +23,7 @@ from ..ops import mvreg as mv_ops
 from ..pure.lwwreg import UNSET, LWWReg
 from ..pure.mvreg import MVReg, Put
 from ..traits import ConflictingMarker
-from ..utils import Interner
+from ..utils import Interner, transactional_apply
 from ..vclock import VClock
 
 
@@ -76,6 +76,7 @@ class BatchedLWWReg:
         marker = (int(self.state.hi[i]) << 32) | int(self.state.lo[i])
         return LWWReg(self.values[int(self.state.val[i])], marker)
 
+    @transactional_apply("values")
     def update(self, replica: int, val, marker: int) -> None:
         """Reference: src/lwwreg.rs ``update`` + validation."""
         h, l = _split_marker(marker)
@@ -136,7 +137,10 @@ class BatchedMVReg:
         actors: Optional[Interner] = None,
         values: Optional[Interner] = None,
         n_slots: int = 8,
+        n_actors: int = 0,
     ) -> "BatchedMVReg":
+        """``n_actors`` sets a capacity FLOOR above the actors present
+        in ``pures`` — spare lanes later ops intern into."""
         actors = actors if actors is not None else Interner()
         values = values if values is not None else Interner()
         for p in pures:
@@ -146,7 +150,7 @@ class BatchedMVReg:
                     actors.intern(a)
                 values.intern(v)
 
-        r, a = len(pures), max(len(actors), 1)
+        r, a = len(pures), max(len(actors), n_actors, 1)
         out = cls(r, a, n_slots=n_slots, actors=actors, values=values)
         wact = np.zeros((r, n_slots), np.int32)
         wctr = np.zeros((r, n_slots), np.uint32)
@@ -182,6 +186,7 @@ class BatchedMVReg:
             out.vals[dot] = (clock, self.values[int(st.val[s])])
         return out
 
+    @transactional_apply("actors", "values")
     def apply(self, replica: int, op: Put) -> None:
         """Apply an oracle-shaped Put to one replica (reference:
         src/mvreg.rs ``CmRDT::apply``). Under ``config.strict`` the

@@ -24,7 +24,7 @@ from ..ops import sparse_nest as nest
 from ..ops import sparse_orswot as sp
 from ..pure.map import Map, MapRm, Nop, Up
 from ..pure.orswot import Add as OrswotAdd, Orswot, Rm as OrswotRm
-from ..utils import Interner
+from ..utils import Interner, transactional_apply
 from ..utils.metrics import metrics, observe_depth
 from ..vclock import VClock
 from .orswot import DeferredOverflow
@@ -276,6 +276,7 @@ class BatchedSparseMapOrswot:
         out[: len(ids)] = ids
         return out
 
+    @transactional_apply("keys", "members", "actors")
     def apply(self, replica: int, op) -> None:
         """Apply an oracle-shaped op to one replica (reference:
         src/map.rs ``CmRDT::apply`` routing orswot child ops)."""

@@ -20,7 +20,7 @@ import numpy as np
 
 from ..ops import sparse_orswot as ops
 from ..pure.orswot import Add, Orswot, Rm
-from ..utils import Interner
+from ..utils import Interner, transactional_apply
 from ..utils.metrics import metrics, observe_depth
 from ..vclock import VClock
 from .orswot import DeferredOverflow
@@ -181,6 +181,7 @@ class BatchedSparseOrswot:
         out[: len(ids)] = ids
         return out
 
+    @transactional_apply("members", "actors")
     def apply(self, replica: int, op) -> None:
         """Apply an oracle-shaped op to one replica (reference:
         src/orswot.rs ``CmRDT::apply``)."""

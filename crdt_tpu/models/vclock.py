@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import vclock as ops
-from ..utils import Interner
+from ..utils import Interner, transactional_apply
 from ..vclock import VClock
 from ..dot import Dot
 
@@ -34,14 +34,22 @@ class BatchedVClock:
 
     # ---- conversion (the A/B gate boundary) ---------------------------
     @classmethod
-    def from_pure(cls, pures: Sequence[VClock], actors: Optional[Interner] = None) -> "BatchedVClock":
+    def from_pure(
+        cls,
+        pures: Sequence[VClock],
+        actors: Optional[Interner] = None,
+        n_actors: int = 0,
+    ) -> "BatchedVClock":
+        """``n_actors`` sets a capacity FLOOR above the actors present
+        in ``pures`` — spare lanes later ops intern into."""
         actors = actors if actors is not None else Interner()
         for p in pures:
             for actor in p.dots:
                 actors.intern(actor)
-        out = cls(len(pures), actors=actors, n_actors=max(len(actors), 1))
+        n = max(len(actors), n_actors, 1)
+        out = cls(len(pures), actors=actors, n_actors=n)
         mat = np.zeros(
-            (len(pures), max(len(actors), 1)),
+            (len(pures), n),
             dtype=np.dtype(str(out.clocks.dtype)),
         )
         for i, p in enumerate(pures):
@@ -63,6 +71,7 @@ class BatchedVClock:
         never-seen actor is interned into a free lane if one exists."""
         return self.actors.bounded_intern(actor, self.n_actors, "actor")
 
+    @transactional_apply("actors")
     def apply(self, replica: int, dot: Dot) -> None:
         from .validation import strict_validate_dot
 
@@ -72,6 +81,7 @@ class BatchedVClock:
             ops.apply_dot(self.clocks[replica], jnp.asarray(aid), jnp.asarray(dot.counter))
         )
 
+    @transactional_apply("actors")
     def inc(self, replica: int, actor) -> None:
         aid = self.bounded_id(actor)
         self.clocks = self.clocks.at[replica].set(

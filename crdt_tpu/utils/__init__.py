@@ -1,5 +1,5 @@
 """Host-side utilities: interning, serialization, checkpoint, metrics."""
 
-from .interner import Interner
+from .interner import Interner, transactional, transactional_apply
 
-__all__ = ["Interner"]
+__all__ = ["Interner", "transactional", "transactional_apply"]

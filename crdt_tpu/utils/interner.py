@@ -8,7 +8,8 @@ order and never reused.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, List
+import contextlib
+from typing import Any, Dict, Iterable, Iterator, List
 
 
 class Interner:
@@ -76,3 +77,33 @@ class Interner:
 
     def clone(self) -> "Interner":
         return Interner(self._items)
+
+
+@contextlib.contextmanager
+def transactional(*interners: Interner) -> Iterator[None]:
+    """Roll back any names the body interned if it raises — the
+    rejected-op contract (models/validation.py: 'a rejected op must be
+    side-effect free'). Wrap every model ``apply`` body that interns
+    names before a kernel/validation step can still reject the op."""
+    marks = [len(i) for i in interners]
+    try:
+        yield
+    except Exception:
+        for i, n in zip(interners, marks):
+            i.truncate(n)
+        raise
+
+
+def transactional_apply(*interner_attrs: str):
+    """Decorator form of ``transactional`` for model op methods: names
+    the instance's interner attributes to roll back when the op is
+    rejected (``@transactional_apply("keys", "actors", "values")``)."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            with transactional(*(getattr(self, a) for a in interner_attrs)):
+                return fn(self, *args, **kwargs)
+        return wrapper
+    return deco

@@ -46,7 +46,8 @@ def _require_tpu():
     if backend not in ("tpu", "axon"):
         print(f"FAIL: backend is {backend}, not a TPU")
         sys.exit(1)
-    print(f"backend: {backend}, devices: {jax.devices()}", file=sys.stderr)
+    # To stdout: the recorded PASS detail must carry the device proof.
+    print(f"backend: {backend}, devices: {jax.devices()}")
 
 
 def step_mosaic_fused():

@@ -74,11 +74,12 @@ def main() -> int:
         mps = (PASSES * R) / dt
         return out, gbps, mps
 
+    from crdt_tpu.ops.pallas_kernels import _pick_r_chunk
+
     rows = []
     # The shipped default first — it is the bit-identity reference for
     # every other combo AND the "vs default" anchor of the ranking.
-    default_rc = 1 << ((1024 * 1024 // (a * 512 * 4)).bit_length() - 1)
-    cands = [(512, default_rc)]
+    cands = [(512, _pick_r_chunk(R, a, 512, None))]
     for tile_e in (256, 512, 1024, 2048):
         for budget_blocks in (0.5, 1, 2):
             rc = max(8, int(budget_blocks * 1024 * 1024) // (a * tile_e * 4))
