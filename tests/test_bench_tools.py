@@ -96,3 +96,46 @@ def test_anti_entropy_records_depth_and_merges():
     snap = metrics.snapshot()
     assert snap["counters"]["anti_entropy.merges"] >= 3
     assert "anti_entropy.orswot_fold.deferred_depth" in snap["gauges"]
+
+
+def test_cached_hardware_headline_parses_step_detail(tmp_path, monkeypatch):
+    # When the relay is down at bench time, main() reports the round's
+    # machine-captured on-chip number (checkpointed by the capture
+    # loop) instead of burying it under a CPU stand-in — labeled cached.
+    detail = (
+        "backend: axon, devices: [TPU v5 lite0]\n"
+        + json.dumps({
+            "metric": "orswot_merges_per_sec", "value": 150000.0,
+            "unit": "merges/s", "path": "fused", "gbps": 480.0,
+            "bytes_moved": 33554432000, "shape": "10240x102400x8",
+        })
+    )
+    import datetime
+    fresh_utc = datetime.datetime.now(datetime.timezone.utc).isoformat()
+    state = {"ok": False, "steps": {"bench_fused": {
+        "ok": True, "utc": fresh_utc,
+        "duration_s": 300.0, "detail": detail,
+    }}}
+    fake_root = tmp_path
+    (fake_root / "TPU_EVIDENCE_r05.json").write_text(json.dumps(state))
+    monkeypatch.setattr(bench, "__file__", str(fake_root / "bench.py"))
+    rec = bench.cached_hardware_headline()
+    assert rec is not None and rec["value"] == 150000.0
+    assert rec["captured_utc"] == fresh_utc
+    assert rec["path"] == "fused"
+
+    # Stale evidence (a previous round's capture) yields None.
+    stale = dict(state["steps"]["bench_fused"])
+    stale["utc"] = (
+        datetime.datetime.now(datetime.timezone.utc)
+        - datetime.timedelta(hours=13)
+    ).isoformat()
+    (fake_root / "TPU_EVIDENCE_r05.json").write_text(
+        json.dumps({"ok": False, "steps": {"bench_fused": stale}})
+    )
+    assert bench.cached_hardware_headline() is None
+
+    # An unpassed step yields None (never report a failed capture).
+    state["steps"]["bench_fused"]["ok"] = False
+    (fake_root / "TPU_EVIDENCE_r05.json").write_text(json.dumps(state))
+    assert bench.cached_hardware_headline() is None
