@@ -96,8 +96,11 @@ def run_delta_ring(
                 st, d, f, of, starved = carry
                 pkt, d, f = extract(st, d, f, cap, start=r * cap)
                 in_window = r >= rounds - (p - 1)
+                # Explicit accumulator dtype: without it jnp.sum widens
+                # int32 -> int64 under x64 mode (counter_dtype="uint64")
+                # and the fori_loop carry type changes mid-loop.
                 starved = starved + jnp.where(
-                    in_window, jnp.sum(d.astype(jnp.int32)), 0
+                    in_window, jnp.sum(d, dtype=jnp.int32), 0
                 )
                 pkt = jax.tree.map(
                     lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt

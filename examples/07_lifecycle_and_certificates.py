@@ -1,0 +1,145 @@
+"""Operating the lattice over time: actor lifecycle migrations and the
+δ-ring convergence certificate.
+
+Two operational subsystems the reference never needed (src/vclock.rs is
+u64 end to end and ships no runtime), but a device lattice does:
+
+1. **Actor lifecycle** (crdt_tpu/lifecycle.py): the device lanes
+   default to u32 for bandwidth; strict mode traps an approaching
+   overflow with ``CounterSaturation``. The two prescribed remedies as
+   code — widen u32 → u64 in place (reference width), or retire the
+   hot actor into the ``__retired__`` aggregate lane and compact the
+   universe.
+2. **Convergence certificates** (crdt_tpu/parallel/delta.py): a
+   bounded δ-ring under-converges silently when the dirty backlog
+   exceeds the packet cap × round budget. Every ring returns a
+   ``residue`` count — 0 certifies the result equals the full join;
+   > 0 says exactly how many slot-starved row-rounds remain.
+
+Run on 8 virtual CPU devices:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/07_lifecycle_and_certificates.py
+(on a real TPU slice, drop the env vars)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _env import pin_platform
+
+pin_platform()
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.config import configure
+    from crdt_tpu.lifecycle import (
+        RETIRED,
+        compact_actors,
+        retire_actor,
+        widen_counters,
+    )
+    from crdt_tpu.models.counters import BatchedPNCounter
+
+    # ---- 1. lifecycle: a counter fleet nearing the u32 ceiling -------
+    # Four tills, one hot actor ("till-0") whose lane is close to
+    # saturating after years of increments.
+    fleet = BatchedPNCounter(n_replicas=4, n_actors=8)
+    for t in range(4):
+        fleet.inc(t, "till-0", steps=2**31 - 1)  # the hot legacy lane
+        fleet.inc(t, f"till-{t}", steps=100 + t)
+        if t:
+            fleet.dec(t, f"till-{t}", steps=t)
+    before = fleet.fold_read()
+    print(f"fleet converged read before migration: {before:,}")
+
+    # Remedy A: widen to the reference's u64 width (bit-identical).
+    configure(counter_dtype="uint64")
+    widen_counters(fleet)
+    assert fleet.fold_read() == before
+    print(f"widened u32 -> u64 in place; read unchanged: {fleet.fold_read():,}")
+
+    # Remedy B: retire the hot actor. Converge its lane first (retire
+    # moves a lane sum, so rows must agree), then fold its count into
+    # the __retired__ aggregate and reclaim its lane.
+    for vc in (fleet.p, fleet.n):
+        folded = vc.clocks.max(axis=0)
+        vc.clocks = jnp.broadcast_to(folded, vc.clocks.shape)
+    retire_actor(fleet, "till-0")
+    assert fleet.fold_read() == before
+    compact_actors(fleet)
+    assert fleet.fold_read() == before
+    lanes = [fleet.p.actors[i] for i in range(len(fleet.p.actors))]
+    print(f"retired till-0 into {RETIRED!r}; lanes now {lanes}; "
+          f"read still {fleet.fold_read():,}")
+
+    # ---- 2. δ-ring residue: the convergence certificate --------------
+    from crdt_tpu.models.orswot import BatchedOrswot
+    from crdt_tpu.parallel import (
+        interval_accumulate,
+        make_mesh,
+        mesh_delta_gossip,
+        shard_orswot,
+    )
+    from crdt_tpu.pure.orswot import Orswot
+
+    n = len(jax.devices())
+    mesh = make_mesh(n, 1)
+    p = mesh.shape["replica"]
+
+    # A burst that dirties MANY rows per replica — more than one packet
+    # can carry.
+    rng = np.random.default_rng(11)
+    sites = [Orswot() for _ in range(p)]
+    for i, site in enumerate(sites):
+        for m in rng.choice(512, size=96, replace=False):
+            site.apply(site.add(int(m), site.read().derive_add_ctx(f"r{i}")))
+    model = BatchedOrswot.from_pure(sites, n_members=512)
+    state = shard_orswot(model.state, mesh)
+    empty = jax.tree.map(jnp.zeros_like, state)
+    dirty0 = jnp.zeros(state.ctr.shape[:2], bool)
+    ctx0 = jnp.zeros_like(state.ctr[:, :, :])
+    dirty, fctx = interval_accumulate(dirty0, ctx0, empty, state)
+
+    import warnings
+
+    cap = 16  # each packet carries 16 rows; backlog is 96 rows/replica
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # the residue warning, expected
+        _, _, _, residue = mesh_delta_gossip(
+            state, dirty, fctx, mesh, rounds=p - 1, cap=cap
+        )
+    starved = int(jax.device_get(residue))
+    print(f"under-budgeted ring (P-1={p-1} rounds, cap {cap}): "
+          f"residue {starved} row-rounds -> NOT certified converged")
+    assert starved > 0
+
+    # Certified re-run. Domain forwarding means the worst-case backlog
+    # on any device is the whole LOCAL row universe (everyone's rows
+    # transit every device), so budget generously — the property tests
+    # pin this formula (tests/test_delta.py): P ring latencies of the
+    # worst-case per-device drain. A bigger packet cap buys it down.
+    cap = 128
+    rounds = p * p * (-(-512 // cap) + 2)
+    out, _, _, residue = mesh_delta_gossip(
+        state, dirty, fctx, mesh, rounds=rounds, cap=cap
+    )
+    assert int(jax.device_get(residue)) == 0
+    from crdt_tpu.parallel import mesh_fold
+
+    full, _ = mesh_fold(state, mesh)
+    same = bool(jnp.all(out.ctr == full.ctr[None]))
+    print(f"re-run with {rounds} rounds: residue 0 -> certified; "
+          f"rows == full join: {same}")
+    assert same
+
+
+if __name__ == "__main__":
+    main()

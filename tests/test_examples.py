@@ -19,6 +19,7 @@ _EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(__file__)), "examples")
         "04_multihost_dcn.py",
         "05_delta_sync.py",
         "06_deep_nesting_and_sparse.py",
+        "07_lifecycle_and_certificates.py",
     ],
 )
 def test_example_runs(script):
