@@ -392,7 +392,7 @@ def bench_map():
     rng = np.random.default_rng(2)
     state = map_ops.empty(k, a, sibling_cap=s, batch=(r,))
     # Valid causal state respecting the per-(key, actor) uniqueness
-    # invariant the fused path relies on (pallas_kernels._map_to_dense):
+    # invariant the fused path relies on (pallas_kernels._decode_wide):
     # slot j of replica i writes under actor (i + j) % a with one
     # globally-fixed counter per (key, slot); each replica's top covers
     # exactly the dots it holds.

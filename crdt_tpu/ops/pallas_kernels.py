@@ -50,6 +50,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -90,7 +91,7 @@ def _join_step(acc_top, acc_ctr, b_top, b_ctr):
 def _join_step_cells(acc_top, acc_ctr, b_top, b_ctr):
     """Cell-granular dot join for the dense Map<K, MVReg> encoding: cell
     (k, y) holds actor y's sole live witness counter at key k (the
-    per-(key, actor) uniqueness invariant — ``_map_to_dense``), so the
+    per-(key, actor) uniqueness invariant — ``_decode_wide``), so the
     survival rule collapses per cell: same counter ⇒ same dot (keep);
     else each side's counter survives only if the other side's top never
     saw it — at most one side can win (y's counters are totally ordered
@@ -151,6 +152,8 @@ def _fold_entries_fused(
     interpret: bool,
     n_passes: int = 1,
     cellwise: bool = False,
+    pre_t: bool = False,
+    out_t: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fused fold of the entry matrices only: ``top[R, A]``,
     ``ctr[R, E, A]`` → ``(top[A], ctr[E, A])``.
@@ -163,14 +166,24 @@ def _fold_entries_fused(
     dot-state exceeds HBM (bench.py), with one dispatch.
 
     ``cellwise`` selects the cell-granular MVReg dot rule
-    (``_join_step_cells``) instead of the orswot element rule."""
-    r, e, a = ctr.shape
+    (``_join_step_cells``) instead of the orswot element rule.
+    ``pre_t`` accepts ``ctr`` already in the kernel's transposed
+    ``[R, A, E]`` layout; ``out_t`` returns ``ctr[A, E]`` untransposed
+    (E-minor) — large-E callers keep everything E-minor so no
+    lane-padded [.., E, small] temp ever materialises (TPU tiling pads
+    a narrow minor dim to 128 lanes; at E ≈ 1M that 32× blow-up is an
+    OOM, the r5 config-4 failure)."""
+    if pre_t:
+        r, a, e = ctr.shape
+        ctrs_t = ctr
+    else:
+        r, e, a = ctr.shape
+        ctrs_t = jnp.swapaxes(ctr, -1, -2)  # [R, A, E]
     tile_e = min(tile_e, max(e, 1))
     rc = _pick_r_chunk(r, a, tile_e, r_chunk)  # clamped power of two
     pad_e = (-e) % tile_e
     pad_r = (-r) % rc
 
-    ctrs_t = jnp.swapaxes(ctr, -1, -2)  # [R, A, E]
     tops3 = top[:, :, None]             # [R, A, 1]
     if pad_e:
         ctrs_t = jnp.pad(ctrs_t, ((0, 0), (0, 0), (0, pad_e)))
@@ -210,7 +223,7 @@ def _fold_entries_fused(
         interpret=interpret,
     )(tops3, ctrs_t)
 
-    return top_t[:, 0], ctr_t.T[:e]
+    return top_t[:, 0], (ctr_t[:, :e] if out_t else ctr_t.T[:e])
 
 
 # VMEM budget for the streamed input block (double-buffered by the
@@ -399,59 +412,82 @@ def _fold_fused_level_jit(
     return folded, jnp.concatenate(flags)
 
 
-def _map_to_dense(child):
-    """Slot table ``MVRegState [R, K, S…]`` → dense per-(key, actor)
-    arrays (wctr [R, K, A], val [R, K, A], clk [R, K, A, A]).
+def _decode_wide(child, a: int):
+    """Slot table ``MVRegState [R, K, S…]`` → K-minor dense per-(actor,
+    key) arrays (wctr [R, A, K], val1 [R, A, K], clk [R, A, A, K]).
 
     Sound because a key holds at most one live sibling per actor: a
     later write by the same actor carries a clock ≥ its earlier write's
     (actor knowledge is monotone), so apply-time domination evicts the
     older one, and the merge survival rule kills the smaller counter
     against the witnessing side's top (``_join_step_cells``). The A/B
-    suite pins the round-trip on every reachable state."""
+    suite pins the round-trip on every reachable state.
+
+    K-minor layout throughout: TPU tiling pads the two minor dims to
+    (8, 128), so any [.., K, small] temp pays a 16-64× lane-padding
+    blow-up — at K = 1M that is an instant OOM (the r5 config-4
+    failure). With K on the lane axis padding is ≤2× (the tiny
+    slot/actor axis rides the sublane dim), and the decode itself is a
+    static unroll over the S ≤ 8 slots instead of a device scatter."""
     r, k, s = child.wact.shape
-    a = child.clk.shape[-1]
-    br = jnp.arange(r)[:, None, None]
-    bk = jnp.arange(k)[None, :, None]
-    act = jnp.where(child.valid, child.wact, 0)
-    live = child.valid
-    wctr = jnp.zeros((r, k, a), child.wctr.dtype).at[br, bk, act].max(
-        jnp.where(live, child.wctr, 0)
-    )
-    # val ids are ≥ 0; shift by one so "absent" is distinguishable.
-    val1 = jnp.zeros((r, k, a), jnp.uint32).at[br, bk, act].max(
-        jnp.where(live, child.val.astype(jnp.uint32) + 1, 0)
-    )
-    clk = jnp.zeros((r, k, a, a), child.clk.dtype).at[br, bk, act].max(
-        jnp.where(live[..., None], child.clk, 0)
-    )
+    act_t = jnp.swapaxes(child.wact, -1, -2)    # [R, S, K]
+    wctr_t = jnp.swapaxes(child.wctr, -1, -2)
+    val_t = jnp.swapaxes(child.val, -1, -2)
+    live_t = jnp.swapaxes(child.valid, -1, -2)
+    clk_t = jnp.transpose(child.clk, (0, 2, 3, 1))  # [R, S, A, K]
+    ids = jnp.arange(a, dtype=child.wact.dtype)
+    wctr = jnp.zeros((r, a, k), child.wctr.dtype)
+    val1 = jnp.zeros((r, a, k), jnp.uint32)
+    clk = jnp.zeros((r, a, a, k), child.clk.dtype)
+    for si in range(s):
+        own = (act_t[:, si, None, :] == ids[None, :, None]) & live_t[:, si, None, :]
+        wctr = _umax(wctr, jnp.where(own, wctr_t[:, si, None, :], 0))
+        # val ids are ≥ 0; shift by one so "absent" is distinguishable.
+        val1 = _umax(
+            val1,
+            jnp.where(own, val_t[:, si, None, :].astype(jnp.uint32) + 1, 0),
+        )
+        clk = _umax(clk, jnp.where(own[:, :, None, :], clk_t[:, si, None, :, :], 0))
     return wctr, val1, clk
 
 
-def _dense_to_slots(wctr, val1, clk):
-    """Dense per-(key, actor) arrays (unbatched: [K, A]…) → slot table
-    with S′ = A slots (no truncation — capacity is checked by the caller
-    AFTER parked-remove replay, matching the tree join's
-    transient-overflow semantics)."""
+def _wide_to_slots(wctr, val1, clk, s: int):
+    """K-minor dense cells (unbatched: wctr [A, K], val1 [A, K],
+    clk [A, A, K]) → canonical slot table fitted to S slots, API shapes
+    ``[K, S(, A)]``. Every large intermediate stays K-minor; only the
+    final (output) transposes leave the lane-friendly layout."""
     from .mvreg import MVRegState
 
-    k, a = wctr.shape
+    a, k = wctr.shape
     present = wctr > 0
     # Canonical slot order (ops/map._canon_child): valid first, then by
     # actor (unique per key, so no further tiebreak needed).
-    order = jnp.argsort(~present, axis=-1, stable=True)  # actor ids stable
-    take = lambda x: jnp.take_along_axis(x, order, axis=-1)
+    order = jnp.argsort(~present, axis=0, stable=True)  # [A, K] actor ids
+    take = lambda x: jnp.take_along_axis(x, order, axis=0)
     valid = take(present)
+    acts = jnp.broadcast_to(jnp.arange(a, dtype=jnp.int32)[:, None], (a, k))
+    wact_s = jnp.where(valid, take(acts), 0)
+    wctr_s = jnp.where(valid, take(wctr), 0)
+    val_s = jnp.where(valid, take(val1).astype(jnp.int32) - 1, 0)
+    clk_s = jnp.where(
+        valid[:, None, :],
+        jnp.take_along_axis(clk, order[:, None, :], axis=0),
+        0,
+    )
+
+    # Back to the slot capacity: truncate (A > S) or zero-pad (A < S) —
+    # canonical form keeps dead slots zeroed either way.
+    def fit(x):
+        if a >= s:
+            return x[:s]
+        return jnp.pad(x, [(0, s - a)] + [(0, 0)] * (x.ndim - 1))
+
     return MVRegState(
-        wact=jnp.where(valid, take(jnp.broadcast_to(jnp.arange(a), (k, a))), 0),
-        wctr=jnp.where(valid, take(wctr), 0),
-        clk=jnp.where(
-            valid[..., None],
-            jnp.take_along_axis(clk, order[..., None], axis=-2),
-            0,
-        ),
-        val=jnp.where(valid, take(val1).astype(jnp.int32) - 1, 0),
-        valid=valid,
+        wact=fit(wact_s).T,
+        wctr=fit(wctr_s).T,
+        clk=jnp.transpose(fit(clk_s), (2, 0, 1)),
+        val=fit(val_s).T,
+        valid=fit(valid).T,
     )
 
 
@@ -465,7 +501,7 @@ def fold_fused_map(
     config-4 hot loop in one streamed HBM pass.
 
     The slot tables convert to a dense per-(key, actor) witness-counter
-    slab (``_map_to_dense``), whose replica fold is the cell-granular
+    slab (``_decode_wide``), whose replica fold is the cell-granular
     dot rule — the Pallas kernel with ``_join_step_cells``. Payload
     (val, clk) follows the surviving counter by a winner-select
     reduction in the jnp epilogue, then the parked keyset-removes replay
@@ -487,49 +523,46 @@ def _fold_fused_map_jit(states, tile_e, r_chunk, interpret):
 
     r, k, s = states.child.wact.shape
     a = states.top.shape[-1]
-    wctr, val1, clk = _map_to_dense(states.child)
+    wctr, val1, clk = _decode_wide(states.child, a)  # [R, A, K] K-minor
 
-    top, folded_wctr = _fold_entries_fused(
-        states.top, wctr, tile_e, r_chunk, interpret, cellwise=True
-    )
+    top, folded_w = _fold_entries_fused(
+        states.top, wctr, tile_e, r_chunk, interpret, cellwise=True,
+        pre_t=True, out_t=True,
+    )  # top [A], folded_w [A, K]
 
     # Winner-select payload: the surviving counter's replica supplies
     # val and clk (ties ⇒ same dot ⇒ same payload, max is safe).
-    match = (wctr == folded_wctr[None]) & (folded_wctr[None] > 0)
-    val1 = jnp.max(jnp.where(match, val1, 0), axis=0)
-    clk = jnp.max(jnp.where(match[..., None], clk, 0), axis=0)
+    match = (wctr == folded_w[None]) & (folded_w[None] > 0)
+    val1 = jnp.max(jnp.where(match, val1, 0), axis=0)              # [A, K]
+    clk = jnp.max(jnp.where(match[:, :, None, :], clk, 0), axis=0)  # [A, A, K]
 
-    child = _dense_to_slots(folded_wctr, val1, clk)
-
-    # Parked keyset-removes: union → dedupe → replay on the A-wide table
-    # → drop caught-up → compact, then the sibling-capacity check.
+    # Parked keyset-removes: union → dedupe → replay directly on the
+    # K-minor cells (cell (y, k) dies iff some parked slot masks key k
+    # with a clock covering its dot) → drop caught-up → compact, then
+    # the sibling-capacity check — the tree join's transient-overflow
+    # semantics (replay precedes the capacity check).
     d = states.dcl.shape[-2]
     dcl = states.dcl.reshape(r * d, a)
     dkeys = states.dkeys.reshape(r * d, k)
     dvalid = states.dvalid.reshape(r * d)
     dcl, dkeys, dvalid = _dedupe_deferred(dcl, dkeys, dvalid)
-    tmp = map_ops.MapState(
-        top=top, child=child, dcl=dcl, dkeys=dkeys, dvalid=dvalid
-    )
-    tmp = map_ops._drop_stale_deferred(map_ops._apply_parked(tmp))
-    dcl, dkeys, dvalid, d_of = _compact_deferred(
-        tmp.dcl, tmp.dkeys, tmp.dvalid, d
-    )
 
-    child = map_ops._canon_child(tmp.child)
-    c_of = jnp.any(jnp.sum(child.valid, axis=-1) > s)
-    # Back to the slot capacity: truncate (A > S) or zero-pad (A < S) —
-    # canonical form keeps dead slots zeroed either way.
-    def fit(x):
-        axis = -2 if x.ndim == child.clk.ndim else -1
-        cur = x.shape[axis]
-        if cur >= s:
-            return x[..., :s, :] if axis == -2 else x[..., :s]
-        pad = [(0, 0)] * x.ndim
-        pad[axis] = (0, s - cur)
-        return jnp.pad(x, pad)
+    def cover(maxcov, slot):
+        cl, keys, dv = slot
+        return _umax(maxcov, jnp.where(dv & keys[None, :], cl[:, None], 0)), None
 
-    child = jax.tree.map(fit, child)
+    maxcov, _ = lax.scan(cover, jnp.zeros_like(folded_w), (dcl, dkeys, dvalid))
+    kill = (folded_w > 0) & (folded_w <= maxcov)
+    folded_w = jnp.where(kill, 0, folded_w)
+    val1 = jnp.where(kill, 0, val1)
+    clk = jnp.where(kill[:, None, :], 0, clk)
+
+    still_ahead = ~jnp.all(dcl <= top[None, :], axis=-1)
+    dvalid = dvalid & still_ahead
+    dcl, dkeys, dvalid, d_of = _compact_deferred(dcl, dkeys, dvalid, d)
+
+    c_of = jnp.any(jnp.sum(folded_w > 0, axis=0) > s)
+    child = _wide_to_slots(folded_w, val1, clk, s)
     return (
         map_ops.MapState(
             top=top, child=child, dcl=dcl, dkeys=dkeys, dvalid=dvalid
