@@ -3,8 +3,10 @@
 For a fixed live-dot budget C, the dense join costs O(E·A) HBM traffic
 regardless of sparsity while the segment join costs O(C log² C) sort
 work — so there is an element-universe size E* past which sparse wins.
-This tool times both joins over a sweep of E at constant C and prints
-the measured crossover:
+This tool times both joins over a sweep of E at constant C — as
+chip-side MARGINAL per-join cost (a fori_loop chain of n joins in one
+dispatch, t(2n) − t(n), so the relay's fixed round-trip cancels) — and
+prints the measured crossover:
 
     python tools/sparse_crossover.py              # on the TPU
     JAX_PLATFORMS=cpu python tools/sparse_crossover.py --cpu   # scaled
@@ -25,18 +27,57 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 
-def _timed(fn, *args, iters=5):
+def _marginal(join1, xa, xb, n: int | None = None, iters: int = 5) -> float:
+    """Chip-side marginal per-join time via the K-vs-2K method (bench.py
+    module docstring): a ``fori_loop`` chain of ``n`` joins runs in ONE
+    dispatch, so the relay's ~69 ms fixed round-trip — and its async
+    dispatch queue, which acks ``block_until_ready`` before the work
+    drains and made single-join timings read as low as 0.04 ms — cancel
+    in ``t(2n) − t(n)``. The trip count is a traced operand, so both
+    lengths share one compile. The n- and 2n-timings interleave within
+    one loop (bench.py's convention) so slow relay drift cancels too,
+    and a non-positive marginal falls back to the conservative
+    ``t(2n)/2n`` bound instead of letting jitter fabricate a 0-ms
+    winner."""
     import jax
+    import numpy as np
+    from jax import lax
 
-    out = fn(*args)
-    jax.block_until_ready(out)
-    ts = []
+    if n is None:
+        # The chain exists to amortise the relay round-trip; on CPU
+        # there is none, so keep the sweep quick.
+        n = 4 if jax.default_backend() == "cpu" else 32
+
+    @jax.jit
+    def chain(x, y, k):
+        return lax.fori_loop(0, k, lambda i, s: join1(s, y), x)
+
+    def once(k):
+        out = chain(xa, xb, k)
+        # Scalar device->host fetch: cannot be acked early by the relay.
+        return np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+
+    once(n)
+    once(2 * n)  # shared compile + warm both trip counts
+
+    t1s, t2s = [], []
     for _ in range(iters):
         t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
-        ts.append(time.perf_counter() - t0)
-    return sorted(ts)[len(ts) // 2]
+        once(n)
+        t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        once(2 * n)
+        t2s.append(time.perf_counter() - t0)
+    t1 = sorted(t1s)[len(t1s) // 2]
+    t2 = sorted(t2s)[len(t2s) // 2]
+    dt = t2 - t1
+    if dt <= 0:
+        print(
+            f"  WARNING: non-positive marginal (T(n)={t1*1e3:.1f} ms, "
+            f"T(2n)={t2*1e3:.1f} ms); using conservative T(2n)/2n"
+        )
+        dt = t2 / 2
+    return dt / n
 
 
 def run(sweep=None, dots: int = 4096, actors: int = 8) -> str:
@@ -73,7 +114,7 @@ def run(sweep=None, dots: int = 4096, actors: int = 8) -> str:
         dense = dense._replace(top=jnp.asarray(top), ctr=jnp.asarray(ctr))
         da = jax.tree.map(lambda x: x[0], dense)
         db = jax.tree.map(lambda x: x[1], dense)
-        t_dense = _timed(lambda x, y: dense_ops.join(x, y)[0].ctr, da, db)
+        t_dense = _marginal(lambda x, y: dense_ops.join(x, y)[0], da, db)
 
         spstate = sp.from_dense(dense, cap, rm_width=8)
         sa = jax.tree.map(lambda x: x[0], spstate)
@@ -81,14 +122,14 @@ def run(sweep=None, dots: int = 4096, actors: int = 8) -> str:
         joined, of = sp.join(sa, sb)
         assert not bool(jnp.any(of)), "sparse join overflowed — sweep is lossy"
         assert int(joined.valid.sum()) == 2 * c, "survivor count wrong"
-        t_sparse = _timed(lambda x, y: sp.join(x, y)[0].ctr, sa, sb)
+        t_sparse = _marginal(lambda x, y: sp.join(x, y)[0], sa, sb)
 
         flag = "sparse" if t_sparse < t_dense else "dense"
         if crossover is None and t_sparse < t_dense:
             crossover = e
         print(
-            f"E={e:>9,}: dense {t_dense*1e3:8.2f} ms "
-            f"({4*e*a/1e6:8.1f} MB/replica) | sparse {t_sparse*1e3:8.2f} ms "
+            f"E={e:>9,}: dense {t_dense*1e3:8.3f} ms/join "
+            f"({4*e*a/1e6:8.1f} MB/replica) | sparse {t_sparse*1e3:8.3f} ms/join "
             f"-> {flag}"
         )
     if crossover:
