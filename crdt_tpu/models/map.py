@@ -22,7 +22,7 @@ from ..ops import map as ops
 from ..ops import mvreg as mv_ops
 from ..pure.map import Map, MapRm, Nop, Up
 from ..pure.mvreg import MVReg, Put
-from ..utils import Interner, transactional_apply
+from ..utils import Interner, clock_lanes, transactional_apply
 from ..utils.metrics import metrics
 from ..vclock import VClock
 from .orswot import DeferredOverflow
@@ -210,9 +210,7 @@ class BatchedMap:
             strict_validate_dot(row.top, self.actors, op.dot.actor, op.dot.counter)
             aid = self.actors.bounded_intern(op.dot.actor, na, "actor")
             kid = self.keys.bounded_intern(op.key, nk, "key")
-            clock = np.zeros((na,), np.uint32)
-            for actor, c in op.op.clock.dots.items():
-                clock[self.actors.bounded_intern(actor, na, "actor")] = c
+            clock = clock_lanes(op.op.clock, self.actors, na)
             row, overflow = ops.apply_up(
                 row,
                 jnp.asarray(aid),
@@ -228,9 +226,7 @@ class BatchedMap:
                 )
         elif isinstance(op, MapRm):
             na = self.state.top.shape[-1]
-            cl = np.zeros((na,), np.uint32)
-            for actor, c in op.clock.dots.items():
-                cl[self.actors.bounded_intern(actor, na, "actor")] = c
+            cl = clock_lanes(op.clock, self.actors, na)
             mask = np.zeros((self.state.dkeys.shape[-1],), bool)
             for k in op.keyset:
                 mask[self.keys.bounded_intern(k, self.state.dkeys.shape[-1], "key")] = True
@@ -242,6 +238,19 @@ class BatchedMap:
                 )
         else:
             raise TypeError(f"not a Map op: {op!r}")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[replica].set(r), self.state, row
+        )
+
+    @transactional_apply("actors")
+    def reset_remove(self, replica: int, clock) -> None:
+        """``Causal::reset_remove`` on one replica: nested causal
+        removal — children drop contents whose witness dot the given
+        ``VClock`` covers, bottomed keys die, parked removes and the
+        outer clock forget covered lanes (reference: src/map.rs
+        ResetRemove impl; oracle: pure/map.py ``reset_remove``)."""
+        cl = clock_lanes(clock, self.actors, self.state.top.shape[-1])
+        row = ops.reset_remove(self._row(self.state, replica), jnp.asarray(cl))
         self.state = jax.tree.map(
             lambda full, r: full.at[replica].set(r), self.state, row
         )

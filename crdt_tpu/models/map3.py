@@ -22,7 +22,7 @@ import numpy as np
 from ..ops import map3 as ops
 from ..pure.map import Map, MapRm, Nop, Up
 from ..pure.orswot import Add as OrswotAdd, Orswot, Rm as OrswotRm
-from ..utils import Interner, transactional_apply
+from ..utils import Interner, clock_lanes, transactional_apply
 from ..utils.metrics import metrics, observe_depth
 from ..vclock import VClock
 from .orswot import DeferredOverflow
@@ -336,9 +336,7 @@ class BatchedMap3:
                         jnp.asarray(mask),
                     )
                 elif isinstance(leaf_op, OrswotRm):
-                    clock = np.zeros((na,), np.uint32)
-                    for actor, c in leaf_op.clock.dots.items():
-                        clock[self.actors.bounded_intern(actor, na, "actor")] = c
+                    clock = clock_lanes(leaf_op.clock, self.actors, na)
                     mask = np.zeros((nm,), bool)
                     for m in leaf_op.members:
                         mask[self.members.bounded_intern(m, nm, "member")] = True
@@ -361,9 +359,7 @@ class BatchedMap3:
                         f"leaf ops must be Orswot ops, got {leaf_op!r}"
                     )
             elif isinstance(mid, MapRm):
-                clock = np.zeros((na,), np.uint32)
-                for actor, c in mid.clock.dots.items():
-                    clock[self.actors.bounded_intern(actor, na, "actor")] = c
+                clock = clock_lanes(mid.clock, self.actors, na)
                 mask = np.zeros((nk2,), bool)
                 for k2 in mid.keyset:
                     mask[self.keys2.bounded_intern(k2, nk2, "inner key")] = True
@@ -385,9 +381,7 @@ class BatchedMap3:
                     f"BatchedMap3 routes Map ops only, got {mid!r}"
                 )
         elif isinstance(op, MapRm):
-            clock = np.zeros((na,), np.uint32)
-            for actor, c in op.clock.dots.items():
-                clock[self.actors.bounded_intern(actor, na, "actor")] = c
+            clock = clock_lanes(op.clock, self.actors, na)
             mask = np.zeros((nk1,), bool)
             for k1 in op.keyset:
                 mask[self.keys1.bounded_intern(k1, nk1, "outer key")] = True

@@ -30,7 +30,7 @@ from ..ops import mvreg as mv_ops
 from ..pure.map import Map, MapRm, Nop, Up
 from ..pure.mvreg import MVReg, Put
 from ..pure.orswot import Add as OrswotAdd, Orswot, Rm as OrswotRm
-from ..utils import Interner, transactional_apply
+from ..utils import Interner, clock_lanes, transactional_apply
 from ..utils.metrics import metrics, observe_depth
 from ..vclock import VClock
 from .orswot import DeferredOverflow
@@ -262,9 +262,7 @@ class BatchedMapOrswot:
                     jnp.asarray(mask),
                 )
             elif isinstance(op.op, OrswotRm):
-                clock = np.zeros((na,), np.uint32)
-                for actor, c in op.op.clock.dots.items():
-                    clock[self.actors.bounded_intern(actor, na, "actor")] = c
+                clock = clock_lanes(op.op.clock, self.actors, na)
                 mask = np.zeros((nm,), bool)
                 for m in op.op.members:
                     mask[self.members.bounded_intern(m, nm, "member")] = True
@@ -286,9 +284,7 @@ class BatchedMapOrswot:
                     f"BatchedMapOrswot routes Orswot ops only, got {op.op!r}"
                 )
         elif isinstance(op, MapRm):
-            clock = np.zeros((na,), np.uint32)
-            for actor, c in op.clock.dots.items():
-                clock[self.actors.bounded_intern(actor, na, "actor")] = c
+            clock = clock_lanes(op.clock, self.actors, na)
             mask = np.zeros((nk,), bool)
             for k in op.keyset:
                 mask[self.keys.bounded_intern(k, nk, "key")] = True
@@ -617,9 +613,7 @@ class BatchedNestedMap:
                         f"innermost op must be an MVReg Put, got {inner.op!r}"
                     )
                 k2id = self.keys2.bounded_intern(inner.key, nk2, "inner key")
-                clock = np.zeros((na,), np.uint32)
-                for actor, c in inner.op.clock.dots.items():
-                    clock[self.actors.bounded_intern(actor, na, "actor")] = c
+                clock = clock_lanes(inner.op.clock, self.actors, na)
                 row, overflow = nested_ops.apply_put(
                     row,
                     jnp.asarray(aid),
@@ -635,9 +629,7 @@ class BatchedNestedMap:
                         f"({op.key!r},{inner.key!r})"
                     )
             elif isinstance(inner, MapRm):
-                clock = np.zeros((na,), np.uint32)
-                for actor, c in inner.clock.dots.items():
-                    clock[self.actors.bounded_intern(actor, na, "actor")] = c
+                clock = clock_lanes(inner.clock, self.actors, na)
                 mask = np.zeros((nk2,), bool)
                 for k2 in inner.keyset:
                     mask[self.keys2.bounded_intern(k2, nk2, "inner key")] = True
@@ -659,9 +651,7 @@ class BatchedNestedMap:
                     f"BatchedNestedMap routes Map ops only, got {inner!r}"
                 )
         elif isinstance(op, MapRm):
-            clock = np.zeros((na,), np.uint32)
-            for actor, c in op.clock.dots.items():
-                clock[self.actors.bounded_intern(actor, na, "actor")] = c
+            clock = clock_lanes(op.clock, self.actors, na)
             mask = np.zeros((nk1,), bool)
             for k1 in op.keyset:
                 mask[self.keys1.bounded_intern(k1, nk1, "outer key")] = True

@@ -19,7 +19,7 @@ import numpy as np
 from ..dot import Dot
 from ..ops import orswot as ops
 from ..pure.orswot import Add, Orswot, Rm
-from ..utils import Interner, transactional
+from ..utils import Interner, clock_lanes, transactional, transactional_apply
 from ..utils.metrics import metrics
 from .validation import strict_validate_dot
 from ..vclock import VClock
@@ -171,9 +171,7 @@ class BatchedOrswot:
                 row, jnp.asarray(aid), jnp.asarray(op.dot.counter), jnp.asarray(mask)
             )
         elif isinstance(op, Rm):
-            cl = np.zeros((na,), np.uint32)
-            for actor, c in op.clock.dots.items():
-                cl[self.actors.bounded_intern(actor, na, "actor")] = c
+            cl = clock_lanes(op.clock, self.actors, na)
             mask = np.zeros((ne,), bool)
             for m in op.members:
                 mask[self.members.bounded_intern(m, ne, "member")] = True
@@ -185,6 +183,17 @@ class BatchedOrswot:
                 )
         else:
             raise TypeError(f"not an Orswot op: {op!r}")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[replica].set(r), self.state, row
+        )
+
+    @transactional_apply("actors")
+    def reset_remove(self, replica: int, clock) -> None:
+        """``Causal::reset_remove`` on one replica: forget all causal
+        history the given ``VClock`` dominates (reference: src/orswot.rs
+        ResetRemove impl; oracle: pure/orswot.py ``reset_remove``)."""
+        cl = clock_lanes(clock, self.actors, self.state.top.shape[-1])
+        row = ops.reset_remove(self._row(self.state, replica), jnp.asarray(cl))
         self.state = jax.tree.map(
             lambda full, r: full.at[replica].set(r), self.state, row
         )

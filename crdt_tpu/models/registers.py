@@ -23,7 +23,7 @@ from ..ops import mvreg as mv_ops
 from ..pure.lwwreg import UNSET, LWWReg
 from ..pure.mvreg import MVReg, Put
 from ..traits import ConflictingMarker
-from ..utils import Interner, transactional_apply
+from ..utils import Interner, clock_lanes, transactional_apply
 from ..vclock import VClock
 
 
@@ -214,9 +214,7 @@ class BatchedMVReg:
             )
         a = self.state.clk.shape[-1]
         aid = self.actors.bounded_intern(op.dot.actor, a, "actor")
-        cl = np.zeros((a,), np.uint32)
-        for actor, c in op.clock.dots.items():
-            cl[self.actors.bounded_intern(actor, a, "actor")] = c
+        cl = clock_lanes(op.clock, self.actors, a)
         row = jax.tree.map(lambda x: x[replica], self.state)
         row, overflow = mv_ops.apply_put(
             row,
@@ -229,6 +227,19 @@ class BatchedMVReg:
             raise SlotOverflow(
                 f"replica {replica}: sibling slots full (cap {self.state.valid.shape[-1]})"
             )
+        self.state = jax.tree.map(
+            lambda full, r: full.at[replica].set(r), self.state, row
+        )
+
+    @transactional_apply("actors")
+    def reset_remove(self, replica: int, clock) -> None:
+        """``Causal::reset_remove`` on one replica: forget siblings whose
+        full write clock the given ``VClock`` dominates (reference:
+        src/mvreg.rs ResetRemove impl; oracle: pure/mvreg.py)."""
+        cl = clock_lanes(clock, self.actors, self.state.clk.shape[-1])
+        row = mv_ops.reset_remove(
+            jax.tree.map(lambda x: x[replica], self.state), jnp.asarray(cl)
+        )
         self.state = jax.tree.map(
             lambda full, r: full.at[replica].set(r), self.state, row
         )

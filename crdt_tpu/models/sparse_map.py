@@ -24,7 +24,7 @@ from ..ops import sparse_nest as nest
 from ..ops import sparse_orswot as sp
 from ..pure.map import Map, MapRm, Nop, Up
 from ..pure.orswot import Add as OrswotAdd, Orswot, Rm as OrswotRm
-from ..utils import Interner, transactional_apply
+from ..utils import Interner, clock_lanes, transactional_apply
 from ..utils.metrics import metrics, observe_depth
 from ..vclock import VClock
 from .orswot import DeferredOverflow
@@ -309,9 +309,7 @@ class BatchedSparseMapOrswot:
                         f"replica {replica}: dot_cap {self.dot_cap} exceeded"
                     )
             elif isinstance(op.op, OrswotRm):
-                clock = np.zeros((na,), np.uint32)
-                for actor, c in op.op.clock.dots.items():
-                    clock[self.actors.bounded_intern(actor, na, "actor")] = c
+                clock = clock_lanes(op.op.clock, self.actors, na)
                 ids = self._ids(
                     (kid * span + self._member_id(m) for m in op.op.members),
                     width=self.state.core.didx.shape[-1],
@@ -331,9 +329,7 @@ class BatchedSparseMapOrswot:
                     f"routes Orswot ops only, got {op.op!r}"
                 )
         elif isinstance(op, MapRm):
-            clock = np.zeros((na,), np.uint32)
-            for actor, c in op.clock.dots.items():
-                clock[self.actors.bounded_intern(actor, na, "actor")] = c
+            clock = clock_lanes(op.clock, self.actors, na)
             ids = self._ids(
                 (self.keys.intern(k) for k in op.keyset),
                 width=self.state.kidx.shape[-1],

@@ -20,7 +20,7 @@ import numpy as np
 
 from ..ops import sparse_orswot as ops
 from ..pure.orswot import Add, Orswot, Rm
-from ..utils import Interner, transactional_apply
+from ..utils import Interner, clock_lanes, transactional_apply
 from ..utils.metrics import metrics, observe_depth
 from ..vclock import VClock
 from .orswot import DeferredOverflow
@@ -203,9 +203,7 @@ class BatchedSparseOrswot:
                     f"replica {replica}: dot_cap {self.dot_cap} exceeded"
                 )
         elif isinstance(op, Rm):
-            clock = np.zeros((na,), np.uint32)
-            for actor, c in op.clock.dots.items():
-                clock[self.actors.bounded_intern(actor, na, "actor")] = c
+            clock = clock_lanes(op.clock, self.actors, na)
             row, overflow = ops.apply_rm(
                 row,
                 jnp.asarray(clock),
@@ -232,6 +230,18 @@ class BatchedSparseOrswot:
             )
         if bool(flags[1]):
             raise DeferredOverflow(f"{what}: deferred buffer full")
+
+    @transactional_apply("actors")
+    def reset_remove(self, replica: int, clock) -> None:
+        """``Causal::reset_remove`` on one replica: forget all causal
+        history the given ``VClock`` dominates (reference: src/orswot.rs
+        ResetRemove impl; oracle: pure/orswot.py; dense sibling:
+        BatchedOrswot.reset_remove)."""
+        cl = clock_lanes(clock, self.actors, self.state.top.shape[-1])
+        row = ops.reset_remove(self._row(self.state, replica), jnp.asarray(cl))
+        self.state = jax.tree.map(
+            lambda full, r: full.at[replica].set(r), self.state, row
+        )
 
     def merge_from(self, dst: int, src: int) -> None:
         metrics.count("sparse_orswot.merges")

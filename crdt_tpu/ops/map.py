@@ -158,6 +158,35 @@ def _dot_in(a: MVRegState, b: MVRegState) -> jax.Array:
 
 
 @jax.jit
+def reset_remove(state: MapState, clock: jax.Array) -> MapState:
+    """ResetRemove — nested causal removal (pure/map.py ``reset_remove``,
+    SURVEY §4.3; reference: src/map.rs ResetRemove impl). Children drop
+    contents whose WITNESS DOT the clock covers (``remove_dots_under``
+    dot-level semantics — not full-clock domination), a bottomed child's
+    key dies implicitly (all slots invalid), parked keyset-removes reset
+    like the orswot deferred buffer (slot dies when its clock empties,
+    equal survivors re-union), and the outer clock forgets covered
+    lanes. Nothing grows, so no overflow is possible."""
+    from . import vclock
+
+    clock = jnp.asarray(clock, state.top.dtype)
+    valid = state.child.valid & (
+        state.child.wctr > _top_at(clock, state.child.wact)
+    )
+    child = _canon_child(state.child._replace(valid=valid))
+    dcl = vclock.reset_remove(state.dcl, clock[..., None, :])
+    dvalid = state.dvalid & jnp.any(dcl > 0, axis=-1)
+    dcl = jnp.where(dvalid[..., None], dcl, 0)
+    dkeys = state.dkeys & dvalid[..., None]
+    dcl, dkeys, dvalid = _dedupe_deferred(dcl, dkeys, dvalid)
+    dcl, dkeys, dvalid, _ = _compact_deferred(
+        dcl, dkeys, dvalid, state.dvalid.shape[-1]
+    )
+    top = vclock.reset_remove(state.top, clock)
+    return MapState(top=top, child=child, dcl=dcl, dkeys=dkeys, dvalid=dvalid)
+
+
+@jax.jit
 def join(a: MapState, b: MapState):
     """Pairwise lattice join — the oracle's ``Map::merge`` as element-wise
     arithmetic. Reference: src/map.rs ``CvRDT::merge`` (causal-composition

@@ -98,6 +98,21 @@ def _compact(state: MVRegState, cap: int):
 
 
 @jax.jit
+def reset_remove(state: MVRegState, clock: jax.Array) -> MVRegState:
+    """ResetRemove — forget siblings whose FULL write clock the given
+    clock dominates (pure/mvreg.py ``reset_remove``; dot-level removal
+    is the separate ``remove_dots_under`` used by Map composition).
+    Reference: src/mvreg.rs ResetRemove impl (SURVEY §3.2). Slots only
+    die, so compaction cannot overflow."""
+    clock = jnp.asarray(clock, state.clk.dtype)
+    dead = state.valid & jnp.all(state.clk <= clock[..., None, :], axis=-1)
+    out, _ = _compact(
+        state._replace(valid=state.valid & ~dead), state.wact.shape[-1]
+    )
+    return out
+
+
+@jax.jit
 def join(a: MVRegState, b: MVRegState):
     """Pairwise merge: drop strictly-dominated siblings, union the rest.
     Returns ``(state, overflow)``. Reference: src/mvreg.rs CvRDT::merge."""

@@ -159,6 +159,36 @@ def _compact_deferred(dcl, dmask, dvalid, cap: int):
 
 
 @jax.jit
+def reset_remove(state: OrswotState, clock: jax.Array) -> OrswotState:
+    """ResetRemove — the ``Causal`` trait's ``forget``: erase all causal
+    history ``clock`` dominates, lane-wise. Reference: src/orswot.rs
+    ResetRemove impl (SURVEY §3.2).
+
+    Dense translation of the oracle (pure/orswot.py ``reset_remove``):
+    entry clocks zero every lane the given clock covers (a member whose
+    lanes all zero is gone — dense encodes absent as all-zero); each
+    parked rm clock resets the same way, a slot dies when its clock
+    empties, and surviving equal clocks re-union (the oracle re-defers
+    into a dict); the top clock forgets covered lanes
+    (ops/vclock.reset_remove). Capacity cannot overflow — slots only
+    die."""
+    from . import vclock
+
+    clock = jnp.asarray(clock, state.ctr.dtype)
+    ctr = vclock.reset_remove(state.ctr, clock[..., None, :])
+    dcl = vclock.reset_remove(state.dcl, clock[..., None, :])
+    dvalid = state.dvalid & jnp.any(dcl > 0, axis=-1)
+    dcl = jnp.where(dvalid[..., None], dcl, 0)
+    dmask = state.dmask & dvalid[..., None]
+    dcl, dmask, dvalid = _dedupe_deferred(dcl, dmask, dvalid)
+    dcl, dmask, dvalid, _ = _compact_deferred(
+        dcl, dmask, dvalid, state.dvalid.shape[-1]
+    )
+    top = vclock.reset_remove(state.top, clock)
+    return OrswotState(top=top, ctr=ctr, dcl=dcl, dmask=dmask, dvalid=dvalid)
+
+
+@jax.jit
 def join(a: OrswotState, b: OrswotState):
     """Pairwise lattice join — the reference's ``Orswot::merge`` as pure
     element-wise arithmetic. Reference: src/orswot.rs CvRDT::merge.

@@ -260,6 +260,42 @@ def _compact_parked(dcl, didx, dvalid, cap: int):
     return dcl, didx, dvalid, overflow
 
 
+@jax.jit
+def reset_remove(state: SparseOrswotState, clock: jax.Array) -> SparseOrswotState:
+    """ResetRemove — the ``Causal`` trait's ``forget`` on the
+    segment-encoded backend (reference: src/orswot.rs ResetRemove impl;
+    oracle: pure/orswot.py ``reset_remove``; dense sibling:
+    ops/orswot.reset_remove). A dot (e, a, c) dies iff ``c <=
+    clock[a]``; parked rm clocks zero covered lanes, a slot dies when
+    its clock empties and surviving equal clocks re-union; the top
+    clock forgets covered lanes. Nothing grows, so no overflow."""
+    from . import vclock
+
+    clock = jnp.asarray(clock, state.ctr.dtype)
+    cl_at = jnp.take_along_axis(
+        jnp.broadcast_to(clock, (*state.act.shape[:-1], clock.shape[-1])),
+        state.act,
+        axis=-1,
+    )
+    valid = state.valid & (state.ctr > cl_at)
+    eid, act, ctr, valid, _ = _canon(
+        state.eid, state.act, state.ctr, valid, state.eid.shape[-1]
+    )
+    dcl = vclock.reset_remove(state.dcl, clock[..., None, :])
+    dvalid = state.dvalid & jnp.any(dcl > 0, axis=-1)
+    dcl = jnp.where(dvalid[..., None], dcl, 0)
+    didx = jnp.where(dvalid[..., None], state.didx, -1)
+    dcl, didx, dvalid = _dedupe_parked(dcl, didx, dvalid)
+    dcl, didx, dvalid, _ = _compact_parked(
+        dcl, didx, dvalid, state.dvalid.shape[-1]
+    )
+    top = vclock.reset_remove(state.top, clock)
+    return SparseOrswotState(
+        top=top, eid=eid, act=act, ctr=ctr, valid=valid,
+        dcl=dcl, didx=didx, dvalid=dvalid,
+    )
+
+
 # ---- op application (CmRDT) ----------------------------------------------
 
 @jax.jit

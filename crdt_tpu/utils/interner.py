@@ -9,6 +9,8 @@ order and never reused.
 from __future__ import annotations
 
 import contextlib
+
+import numpy as np
 from typing import Any, Dict, Iterable, Iterator, List
 
 
@@ -107,3 +109,14 @@ def transactional_apply(*interner_attrs: str):
                 return fn(self, *args, **kwargs)
         return wrapper
     return deco
+
+
+def clock_lanes(clock, actors: Interner, n_actors: int, what: str = "actor"):
+    """``VClock`` → the dense per-actor lane array the device encodes
+    clocks as (uint32 [n_actors]), interning unseen actors within the
+    ``n_actors`` bound. The one place the dict→lane conversion lives —
+    every model op/reset path that ships a clock to the device uses it."""
+    lanes = np.zeros((n_actors,), np.uint32)
+    for actor, c in clock.dots.items():
+        lanes[actors.bounded_intern(actor, n_actors, what)] = c
+    return lanes

@@ -1,0 +1,172 @@
+"""Device-side ``Causal::reset_remove`` vs the oracle — the A/B gate for
+the forget path (SURVEY §3.2: ResetRemove for VClock, MVReg, Orswot,
+Map; reference: the ``ResetRemove`` impls of src/orswot.rs, src/mvreg.rs,
+src/map.rs). VClock's device reset_remove is covered in
+tests/test_ops_vclock.py; this file gates the three causal containers."""
+
+import random
+
+import pytest
+from hypothesis import given, settings
+
+from crdt_tpu import MVReg, Orswot, VClock
+from crdt_tpu.models import BatchedMap, BatchedMVReg, BatchedOrswot
+from crdt_tpu.utils import Interner
+
+from strategies import ACTORS, seeds
+from test_map import _site_run as map_site_run, mv_map
+from test_orswot import _site_run as orswot_site_run
+
+
+def _random_clock(rng, states_clocks):
+    """A clock that partially dominates the run: start from a real site
+    clock (so some dots are exactly covered) and randomly perturb lanes
+    down/off (so others survive)."""
+    base = rng.choice(states_clocks)
+    dots = {}
+    for actor, c in base.dots.items():
+        roll = rng.random()
+        if roll < 0.3:
+            continue  # lane absent: nothing of this actor forgotten
+        dots[actor] = rng.randint(1, c) if roll < 0.6 else c
+    return VClock(dots)
+
+
+@pytest.mark.smoke
+@given(seeds)
+@settings(max_examples=20, deadline=None)
+def test_orswot_reset_remove_bit_identical(seed):
+    rng = random.Random(seed)
+    sites, _ = orswot_site_run(rng)
+    states = list(sites.values())
+    members, actors = Interner(list(range(6))), Interner(ACTORS)
+    batched = BatchedOrswot.from_pure(states, members=members, actors=actors)
+
+    clock = _random_clock(rng, [s.clock for s in states])
+    for i, s in enumerate(states):
+        expect = s.clone()
+        expect.reset_remove(clock.clone())
+        batched.reset_remove(i, clock)
+        assert batched.to_pure(i) == expect, f"replica {i} diverged"
+
+
+@given(seeds)
+@settings(max_examples=20, deadline=None)
+def test_mvreg_reset_remove_bit_identical(seed):
+    rng = random.Random(seed)
+    regs = [MVReg() for _ in range(3)]
+    for step in range(10):
+        i = rng.randrange(3)
+        op = regs[i].write(
+            f"v{step}", regs[i].read().derive_add_ctx(ACTORS[rng.randrange(3)])
+        )
+        regs[i].apply(op)
+        if rng.random() < 0.3:
+            regs[rng.randrange(3)].merge(regs[i].clone())
+
+    actors, values = Interner(ACTORS), Interner([f"v{s}" for s in range(10)])
+    batched = BatchedMVReg.from_pure(regs, actors=actors, values=values)
+
+    # MVReg has no top clock; build the forget clock from live write clocks
+    clocks = [c for r in regs for c, _ in r.vals.values()]
+    if not clocks:
+        return
+    clock = _random_clock(rng, clocks)
+    for i, r in enumerate(regs):
+        expect = r.clone()
+        expect.reset_remove(clock.clone())
+        batched.reset_remove(i, clock)
+        assert batched.to_pure(i) == expect, f"replica {i} diverged"
+
+
+@given(seeds)
+@settings(max_examples=15, deadline=None)
+def test_map_reset_remove_bit_identical(seed):
+    rng = random.Random(seed)
+    states = map_site_run(rng, mv_map)
+    keys, actors = Interner(list("pq")), Interner(ACTORS + ["A", "B", "C"])
+    batched = BatchedMap.from_pure(
+        states, keys=keys, actors=actors, sibling_cap=12, deferred_cap=12
+    )
+
+    clock = _random_clock(rng, [s.clock for s in states])
+    for i, s in enumerate(states):
+        expect = s.clone()
+        expect.reset_remove(clock.clone())
+        batched.reset_remove(i, clock)
+        assert batched.to_pure(i) == expect, f"replica {i} diverged"
+
+
+@given(seeds)
+@settings(max_examples=15, deadline=None)
+def test_sparse_orswot_reset_remove_bit_identical(seed):
+    from crdt_tpu.models import BatchedSparseOrswot
+
+    rng = random.Random(seed)
+    sites, _ = orswot_site_run(rng)
+    states = list(sites.values())
+    members, actors = Interner(list(range(6))), Interner(ACTORS)
+    batched = BatchedSparseOrswot.from_pure(
+        states, members=members, actors=actors, dot_cap=64
+    )
+
+    clock = _random_clock(rng, [s.clock for s in states])
+    for i, s in enumerate(states):
+        expect = s.clone()
+        expect.reset_remove(clock.clone())
+        batched.reset_remove(i, clock)
+        assert batched.to_pure(i) == expect, f"replica {i} diverged"
+
+
+def test_reset_remove_rejection_is_side_effect_free():
+    """A forget clock naming more unseen actors than spare lanes must
+    fail WITHOUT polluting the interner (the side-effect-free-rejection
+    contract every apply path honours): after the failed call a
+    legitimate new actor can still claim the spare lane."""
+    a = Orswot()
+    op = a.add(1, a.read().derive_add_ctx("x"))
+    a.apply(op)
+    batched = BatchedOrswot.from_pure(
+        [a], members=Interner([1]), actors=Interner(["x"]), n_actors=2
+    )
+    with pytest.raises(Exception):
+        batched.reset_remove(0, VClock({"new1": 1, "new2": 1}))
+    assert "new1" not in list(batched.actors), "failed forget leaked an actor"
+    # the spare lane is still usable by a real op
+    b = a.clone()
+    op = b.add(2, b.read().derive_add_ctx("fresh"))
+    b.apply(op)
+    batched2 = BatchedOrswot.from_pure(
+        [a], members=Interner([1, 2]), actors=Interner(["x"]), n_actors=2
+    )
+    with pytest.raises(Exception):
+        batched2.reset_remove(0, VClock({"g1": 1, "g2": 1}))
+    batched2.apply(0, op)
+    assert batched2.to_pure(0) == b
+
+
+def test_reset_remove_then_merge_stays_forgotten():
+    """Forget, then re-merge a replica the clock dominates: the forgotten
+    dots must NOT resurrect (they are covered by nothing — reset_remove
+    erases history, unlike rm it leaves no tombstone — so a merge with a
+    stale replica re-introduces them as NEW dots; the oracle defines the
+    exact expected membership)."""
+    a, b = Orswot(), Orswot()
+    for s, actor in ((a, "x"), (b, "y")):
+        op = s.add(1, s.read().derive_add_ctx(actor))
+        s.apply(op)
+    a.merge(b.clone())
+
+    members, actors = Interner([1]), Interner(["x", "y"])
+    batched = BatchedOrswot.from_pure([a, b], members=members, actors=actors)
+
+    clock = a.clock.clone()
+    ea, eb = a.clone(), b.clone()
+    ea.reset_remove(clock.clone())
+    batched.reset_remove(0, clock)
+    assert batched.to_pure(0) == ea
+
+    # device merge after forget == oracle merge after forget
+    ea.merge(eb.clone())
+    batched.merge_from(0, 1)
+    assert batched.to_pure(0) == ea

@@ -143,7 +143,7 @@ def step_crossover():
     sys.path.insert(0, os.path.join(ROOT, "tools"))
     from sparse_crossover import run as crossover_run
 
-    print(f"sparse crossover: {crossover_run()}")
+    print(crossover_run())
 
 
 STEPS = {
