@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import vclock as ops
-from ..utils import Interner, transactional_apply
+from ..utils import Interner, clock_lanes, transactional_apply
 from ..vclock import VClock
 from ..dot import Dot
 
@@ -86,6 +86,17 @@ class BatchedVClock:
         aid = self.bounded_id(actor)
         self.clocks = self.clocks.at[replica].set(
             ops.inc(self.clocks[replica], jnp.asarray(aid))
+        )
+
+    @transactional_apply("actors")
+    def reset_remove(self, replica: int, clock) -> None:
+        """``Causal::reset_remove`` on one replica: forget lanes the
+        given ``VClock`` dominates (reference: src/vclock.rs
+        ResetRemove/forget; oracle: crdt_tpu/vclock.py)."""
+        cl = clock_lanes(clock, self.actors, self.n_actors,
+                         dtype=np.dtype(str(self.clocks.dtype)))
+        self.clocks = self.clocks.at[replica].set(
+            ops.reset_remove(self.clocks[replica], jnp.asarray(cl))
         )
 
     def merge_from(self, dst: int, src: int) -> None:

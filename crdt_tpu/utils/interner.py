@@ -111,12 +111,15 @@ def transactional_apply(*interner_attrs: str):
     return deco
 
 
-def clock_lanes(clock, actors: Interner, n_actors: int, what: str = "actor"):
+def clock_lanes(clock, actors: Interner, n_actors: int, what: str = "actor",
+                dtype=np.uint32):
     """``VClock`` → the dense per-actor lane array the device encodes
-    clocks as (uint32 [n_actors]), interning unseen actors within the
-    ``n_actors`` bound. The one place the dict→lane conversion lives —
-    every model op/reset path that ships a clock to the device uses it."""
-    lanes = np.zeros((n_actors,), np.uint32)
+    clocks as ([n_actors], default uint32 — pass the model's counter
+    dtype where config widens it to uint64), interning unseen actors
+    within the ``n_actors`` bound. The one place the dict→lane
+    conversion lives — every model op/reset path that ships a clock to
+    the device uses it."""
+    lanes = np.zeros((n_actors,), dtype)
     for actor, c in clock.dots.items():
         lanes[actors.bounded_intern(actor, n_actors, what)] = c
     return lanes

@@ -32,6 +32,46 @@ def _random_clock(rng, states_clocks):
     return VClock(dots)
 
 
+@given(seeds)
+@settings(max_examples=20, deadline=None)
+def test_vclock_reset_remove_bit_identical(seed):
+    from crdt_tpu.models import BatchedVClock
+
+    rng = random.Random(seed)
+    pures = [
+        VClock({a: rng.randint(1, 9) for a in ACTORS if rng.random() < 0.8})
+        for _ in range(3)
+    ]
+    batched = BatchedVClock.from_pure([p.clone() for p in pures])
+    clock = _random_clock(rng, pures)
+    for i, p in enumerate(pures):
+        expect = p.clone()
+        expect.reset_remove(clock.clone())
+        batched.reset_remove(i, clock)
+        assert batched.to_pure(i) == expect, f"replica {i} diverged"
+
+
+def test_vclock_reset_remove_u64_counters():
+    """Widened (uint64) clocks forget counters beyond 2^32 — the lane
+    conversion must use the model's dtype (a uint32 lanes array raises
+    OverflowError on such counters)."""
+    from crdt_tpu.config import configured
+    from crdt_tpu.models import BatchedVClock
+
+    big = 2**33 + 5
+    with configured(counter_dtype="uint64"):
+        p = VClock({"a": big, "b": 7})
+        batched = BatchedVClock.from_pure([p.clone()])
+        assert str(batched.clocks.dtype) == "uint64"
+        clock = VClock({"a": big, "b": 3})
+        expect = p.clone()
+        expect.reset_remove(clock.clone())
+        batched.reset_remove(0, clock)
+        assert batched.to_pure(0) == expect
+        assert batched.to_pure(0).get("a") == 0  # the big lane forgot
+        assert batched.to_pure(0).get("b") == 7  # partially-covered lane kept
+
+
 @pytest.mark.smoke
 @given(seeds)
 @settings(max_examples=20, deadline=None)
