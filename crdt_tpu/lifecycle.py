@@ -21,7 +21,9 @@ as CODE, for the clock/counter family:
   replica set — it is an administrative migration, not a CRDT op).
   VClock retirement is deliberately NOT offered: clock comparisons are
   per-actor, so lanes cannot be merged without changing the partial
-  order.
+  order — causal types (VClock, Orswot, MVReg, Map) retire an actor
+  via ``Causal::reset_remove`` on their models instead (forget the
+  departed actor's causal history; see tests/test_reset_remove.py).
 - :func:`compact_actors` — rebuild the interner/lane universe without
   all-zero lanes (retired or never-used actors), shrinking device
   state. Reads are preserved exactly; freed lanes make room for new
@@ -91,7 +93,8 @@ def retire_actor(model, actor) -> None:
         raise TypeError(
             "retire_actor is a counter migration (reads are lane sums); "
             "VClock lanes cannot be merged without changing the partial "
-            f"order — got {type(model).__name__}"
+            "order — causal types retire via model.reset_remove(...) "
+            f"instead; got {type(model).__name__}"
         )
     clocks = _vclock_models(model)
     actors = clocks[0].actors

@@ -80,15 +80,38 @@ def main():
     print(f"retired till-0 into {RETIRED!r}; lanes now {lanes}; "
           f"read still {fleet.fold_read():,}")
 
-    # ---- 2. δ-ring residue: the convergence certificate --------------
+    # Remedy C — causal types (ORSWOT/MVReg/Map/VClock): counters can't
+    # fold into an aggregate lane (clock comparisons are per-actor), so
+    # retirement is the reference's ``Causal::reset_remove`` — forget
+    # the departed actor's causal history on every replica; the A/B
+    # gates pin device == oracle (tests/test_reset_remove.py).
     from crdt_tpu.models.orswot import BatchedOrswot
+    from crdt_tpu.pure.orswot import Orswot
+    from crdt_tpu.vclock import VClock
+
+    carts = [Orswot() for _ in range(3)]
+    for i, site in enumerate(carts):
+        op = site.add(f"item-{i}", site.read().derive_add_ctx(f"till-{i}"))
+        site.apply(op)
+    for dst in range(3):
+        for src in range(3):
+            if src != dst:
+                carts[dst].merge(carts[src].clone())
+    model = BatchedOrswot.from_pure(carts)
+    gone = VClock({"till-0": carts[0].clock.get("till-0")})
+    for i in range(3):
+        model.reset_remove(i, gone)
+    print(f"reset_remove(till-0) on every replica; members now "
+          f"{sorted(model.members_of(0))}; top {model.to_pure(0).clock}")
+    assert model.to_pure(0).clock.get("till-0") == 0
+
+    # ---- 2. δ-ring residue: the convergence certificate --------------
     from crdt_tpu.parallel import (
         interval_accumulate,
         make_mesh,
         mesh_delta_gossip,
         shard_orswot,
     )
-    from crdt_tpu.pure.orswot import Orswot
 
     n = len(jax.devices())
     mesh = make_mesh(n, 1)
