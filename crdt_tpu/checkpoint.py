@@ -125,6 +125,12 @@ def _is_sparse_map(model) -> bool:
     return isinstance(model, BatchedSparseMapOrswot)
 
 
+def _is_sparse_mvmap(model) -> bool:
+    from .models.sparse_mvmap import BatchedSparseMap
+
+    return isinstance(model, BatchedSparseMap)
+
+
 def save(path: Union[str, os.PathLike], model) -> None:
     """Checkpoint a device model to ``path`` (one .npz file)."""
     if isinstance(model, BatchedOrswot):
@@ -155,6 +161,16 @@ def save(path: Union[str, os.PathLike], model) -> None:
             **{f"s_{k}": np.asarray(v)
                for k, v in model.state._asdict().items() if k != "core"},
         }
+    elif _is_sparse_mvmap(model):
+        meta = {
+            "kind": "sparse_map",
+            "n_keys": model.n_keys,
+            "sibling_cap": model.sibling_cap,
+            "keys": _interner_items(model.keys),
+            "actors": _interner_items(model.actors),
+            "values": _interner_items(model.values),
+        }
+        arrays = {f"s_{k}": np.asarray(v) for k, v in model.state._asdict().items()}
     elif isinstance(model, BatchedMap):
         meta = {
             "kind": "map",
@@ -325,6 +341,27 @@ def load(path: Union[str, os.PathLike]):
             keys=_interner_from(meta["keys"]),
             members=_interner_from(meta["members"]),
             actors=_interner_from(meta["actors"]),
+        )
+        model.state = state
+        return model
+    if meta["kind"] == "sparse_map":
+        from .models.sparse_mvmap import BatchedSparseMap
+        from .ops import sparse_mvmap as smv_ops
+
+        state = smv_ops.SparseMVMapState(
+            **{k[2:]: dev(v) for k, v in arrays.items() if k.startswith("s_")}
+        )
+        model = BatchedSparseMap(
+            state.top.shape[0],
+            int(meta["n_keys"]),
+            state.top.shape[-1],
+            state.kid.shape[-1],
+            int(meta["sibling_cap"]),
+            state.dcl.shape[-2],
+            state.kidx.shape[-1],
+            keys=_interner_from(meta["keys"]),
+            actors=_interner_from(meta["actors"]),
+            values=_interner_from(meta["values"]),
         )
         model.state = state
         return model

@@ -131,7 +131,8 @@ def replicaset(
     (Map<K, Orswot>), map_map (Map<K1, Map<K2, MVReg>>), map3
     (Map<K1, Map<K2, Orswot>>), gcounter, pncounter, gset, lwwreg,
     mvreg, sparse_orswot, sparse_map_orswot (segment-encoded
-    Map<K, Orswot> for huge key universes).
+    Map<K, Orswot> for huge key universes), sparse_map (segment-encoded
+    Map<K, MVReg> — the config-4 flavor at huge key universes).
 
     Lane sizing for the xla backend: ``n_keys`` sizes the (outer) key
     axis, ``n_members`` sizes the inner axis of the nested kinds — the
@@ -163,6 +164,7 @@ def replicaset(
             "mvreg": MVReg,
             "sparse_orswot": Orswot,  # same oracle; sparsity is a backend trait
             "sparse_map_orswot": lambda: Map(val_default=Orswot),
+            "sparse_map": lambda: Map(val_default=MVReg),
         }
         if kind not in factories:
             raise ValueError(f"unknown replicaset kind {kind!r}")
@@ -203,6 +205,20 @@ def replicaset(
             n_actors or 16,
             config.deferred_cap,
             key_deferred_cap=config.deferred_cap,
+        )
+    if kind == "sparse_map":
+        from .models import BatchedSparseMap
+
+        # n_keys bounds the (virtual) key-id universe; n_keys2
+        # repurposed as the live-cell capacity per replica.
+        na = n_actors or 16
+        return BatchedSparseMap(
+            n_replicas,
+            n_keys or (2**31 - 1) // na,  # widest int32-packable universe
+            na,
+            n_keys2 or 256,
+            config.sibling_cap,
+            config.deferred_cap,
         )
     if kind == "map":
         return BatchedMap(

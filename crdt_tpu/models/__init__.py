@@ -14,6 +14,7 @@ from .vclock import BatchedVClock
 from .counters import BatchedGCounter, BatchedPNCounter
 from .orswot import BatchedOrswot
 from .sparse_map import BatchedSparseMapOrswot
+from .sparse_mvmap import BatchedSparseMap
 from .sparse_orswot import BatchedSparseOrswot
 from .gset import BatchedGSet
 from .registers import BatchedLWWReg, BatchedMVReg, SlotOverflow
@@ -28,6 +29,7 @@ __all__ = [
     "BatchedGCounter",
     "BatchedPNCounter",
     "BatchedOrswot",
+    "BatchedSparseMap",
     "BatchedSparseMapOrswot",
     "BatchedSparseOrswot",
     "BatchedGSet",

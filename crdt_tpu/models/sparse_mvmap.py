@@ -1,0 +1,324 @@
+"""BatchedSparseMap — N segment-encoded ``Map<K, MVReg<V>>`` replicas.
+
+The sparse sibling of ``BatchedMap`` (models/map.py): same oracle
+(``crdt_tpu.pure.map.Map`` with MVReg children, reference src/map.rs at
+the BASELINE config-4 shape), same op surface, same lossless
+``to_pure``/``from_pure`` A/B boundary — but state proportional to LIVE
+cells (``ops/sparse_mvmap.py``), so the key universe can be 100M+ ids
+wide while a replica holds kilobytes. Conversion builds segments
+directly from the oracle dicts (never materialising a dense slab), so
+``from_pure`` scales with content, not with the universe.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dot import Dot
+from ..ops import sparse_mvmap as ops
+from ..pure.map import Map, MapRm, Nop, Up
+from ..pure.mvreg import MVReg, Put
+from ..utils import Interner, clock_lanes, transactional_apply
+from ..utils.metrics import metrics
+from ..vclock import VClock
+from .orswot import DeferredOverflow
+from .registers import SlotOverflow
+from .sparse_orswot import DotCapacityOverflow
+from .validation import strict_validate_dot
+
+
+class BatchedSparseMap:
+    def __init__(
+        self,
+        n_replicas: int,
+        n_keys: int,
+        n_actors: int,
+        cell_cap: int = 64,
+        sibling_cap: int = 4,
+        deferred_cap: int = 4,
+        rm_width: int = 8,
+        keys: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+        values: Optional[Interner] = None,
+    ):
+        if n_keys * n_actors > 2**31 - 1:
+            raise ValueError(
+                f"key universe too wide for the int32 packed-cell key: "
+                f"n_keys * n_actors = {n_keys * n_actors:,} > 2^31-1 "
+                f"(shrink n_keys or n_actors)"
+            )
+        self.keys = keys if keys is not None else Interner()
+        self.actors = actors if actors is not None else Interner()
+        self.values = values if values is not None else Interner()
+        self.n_keys = n_keys
+        self.sibling_cap = sibling_cap
+        self.state = ops.empty(
+            cell_cap, n_actors, deferred_cap, rm_width, batch=(n_replicas,)
+        )
+
+    @property
+    def n_replicas(self) -> int:
+        return self.state.top.shape[0]
+
+    @property
+    def cell_cap(self) -> int:
+        return self.state.kid.shape[-1]
+
+    # ---- conversion (the A/B gate boundary) ---------------------------
+    @classmethod
+    def from_pure(
+        cls,
+        pures: Sequence[Map],
+        keys: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+        values: Optional[Interner] = None,
+        cell_cap: int = 64,
+        sibling_cap: int = 4,
+        deferred_cap: int = 4,
+        rm_width: int = 8,
+        n_keys: int = 0,
+        n_actors: int = 0,
+    ) -> "BatchedSparseMap":
+        """Build segments straight from the oracle dicts — cost is
+        O(live cells), independent of the key universe. ``n_keys`` /
+        ``n_actors`` set capacity FLOORS above the names present."""
+        keys = keys if keys is not None else Interner()
+        actors = actors if actors is not None else Interner()
+        values = values if values is not None else Interner()
+        for p in pures:
+            for actor in p.clock.dots:
+                actors.intern(actor)
+            for k, child in p.entries.items():
+                keys.intern(k)
+                if not isinstance(child, MVReg):
+                    raise TypeError(
+                        f"BatchedSparseMap children must be MVReg, got "
+                        f"{type(child)}"
+                    )
+                for d, (clock, v) in child.vals.items():
+                    actors.intern(d.actor)
+                    for actor in clock.dots:
+                        actors.intern(actor)
+                    values.intern(v)
+            for clock, ks in p.deferred.items():
+                for actor in clock.dots:
+                    actors.intern(actor)
+                for k in ks:
+                    keys.intern(k)
+
+        r = len(pures)
+        na = max(len(actors), n_actors, 1)
+        out = cls(
+            r, max(len(keys), n_keys, 1), na, cell_cap, sibling_cap,
+            deferred_cap, rm_width, keys=keys, actors=actors, values=values,
+        )
+        d = deferred_cap
+        top = np.zeros((r, na), np.uint32)
+        kid = np.full((r, cell_cap), -1, np.int32)
+        act = np.zeros((r, cell_cap), np.int32)
+        ctr = np.zeros((r, cell_cap), np.uint32)
+        val = np.zeros((r, cell_cap), np.int32)
+        clk = np.zeros((r, cell_cap, na), np.uint32)
+        valid = np.zeros((r, cell_cap), bool)
+        dcl = np.zeros((r, d, na), np.uint32)
+        kidx = np.full((r, d, rm_width), -1, np.int32)
+        dvalid = np.zeros((r, d), bool)
+        for i, p in enumerate(pures):
+            for actor, c in p.clock.dots.items():
+                top[i, actors.id_of(actor)] = c
+            cells = []
+            for k, child in p.entries.items():
+                for dd, (clock, v) in child.vals.items():
+                    cells.append((keys.id_of(k), actors.id_of(dd.actor),
+                                  dd.counter, clock, v))
+            if len(cells) > cell_cap:
+                raise DotCapacityOverflow(
+                    f"replica {i}: {len(cells)} live cells > cap {cell_cap}"
+                )
+            for s, (ki, ai, c, clock, v) in enumerate(
+                sorted(cells, key=lambda t: (t[0], t[1]))
+            ):
+                kid[i, s], act[i, s], ctr[i, s] = ki, ai, c
+                val[i, s] = values.id_of(v)
+                for actor, cc in clock.dots.items():
+                    clk[i, s, actors.id_of(actor)] = cc
+                valid[i, s] = True
+            if len(p.deferred) > deferred_cap:
+                raise DeferredOverflow(
+                    f"replica {i}: {len(p.deferred)} parked removes > "
+                    f"cap {deferred_cap}"
+                )
+            for s, (clock, ks) in enumerate(p.deferred.items()):
+                for actor, cc in clock.dots.items():
+                    dcl[i, s, actors.id_of(actor)] = cc
+                ids = sorted(keys.id_of(k) for k in ks)
+                if len(ids) > rm_width:
+                    raise DeferredOverflow(
+                        f"replica {i} slot {s}: {len(ids)} parked keys > "
+                        f"rm_width {rm_width}"
+                    )
+                kidx[i, s, : len(ids)] = ids
+                dvalid[i, s] = True
+
+        out.state = ops.SparseMVMapState(
+            top=jnp.asarray(top), kid=jnp.asarray(kid), act=jnp.asarray(act),
+            ctr=jnp.asarray(ctr), val=jnp.asarray(val), clk=jnp.asarray(clk),
+            valid=jnp.asarray(valid), dcl=jnp.asarray(dcl),
+            kidx=jnp.asarray(kidx), dvalid=jnp.asarray(dvalid),
+        )
+        return out
+
+    def _row(self, arrs, i: int):
+        return jax.tree.map(lambda x: x[i], arrs)
+
+    def to_pure(self, i: int) -> Map:
+        st = jax.device_get(self._row(self.state, i))
+        out = Map(MVReg)
+        out.clock = VClock(
+            {self.actors[a]: int(c) for a, c in enumerate(st.top) if c > 0}
+        )
+        for s in np.nonzero(st.valid)[0]:
+            k = self.keys[int(st.kid[s])]
+            d = Dot(self.actors[int(st.act[s])], int(st.ctr[s]))
+            clock = VClock(
+                {self.actors[a]: int(c)
+                 for a, c in enumerate(st.clk[s]) if c > 0}
+            )
+            out.entries.setdefault(k, MVReg())
+            out.entries[k].vals[d] = (clock, self.values[int(st.val[s])])
+        for s in np.nonzero(st.dvalid)[0]:
+            clock = VClock(
+                {self.actors[a]: int(c)
+                 for a, c in enumerate(st.dcl[s]) if c > 0}
+            )
+            out.deferred[clock] = {
+                self.keys[int(k)] for k in st.kidx[s] if k >= 0
+            }
+        return out
+
+    # ---- op path (CmRDT) ----------------------------------------------
+    @transactional_apply("keys", "actors", "values")
+    def apply(self, replica: int, op) -> None:
+        """Apply an oracle-shaped op to one replica (reference:
+        src/map.rs ``CmRDT::apply``)."""
+        if isinstance(op, Nop):
+            return
+        row = self._row(self.state, replica)
+        na = self.state.top.shape[-1]
+        if isinstance(op, Up):
+            if not isinstance(op.op, Put):
+                raise TypeError(
+                    f"BatchedSparseMap routes MVReg ops only, got {op.op!r}"
+                )
+            strict_validate_dot(
+                row.top, self.actors, op.dot.actor, op.dot.counter
+            )
+            aid = self.actors.bounded_intern(op.dot.actor, na, "actor")
+            kid = self.keys.bounded_intern(op.key, self.n_keys, "key")
+            cl = clock_lanes(op.op.clock, self.actors, na)
+            row, overflow = ops.apply_up(
+                row,
+                jnp.asarray(aid),
+                jnp.asarray(np.uint32(op.dot.counter)),
+                jnp.asarray(kid),
+                jnp.asarray(cl),
+                jnp.asarray(self.values.intern(op.op.val)),
+            )
+            if bool(overflow):
+                raise DotCapacityOverflow(
+                    f"replica {replica}: cell table full on Up at key "
+                    f"{op.key!r} — rebuild with a larger cell_cap"
+                )
+        elif isinstance(op, MapRm):
+            cl = clock_lanes(op.clock, self.actors, na)
+            q = self.state.kidx.shape[-1]
+            ids = sorted(
+                self.keys.bounded_intern(k, self.n_keys, "key")
+                for k in op.keyset
+            )
+            if len(ids) > q:
+                raise DeferredOverflow(
+                    f"replica {replica}: rm keyset of {len(ids)} keys > "
+                    f"rm_width {q}"
+                )
+            kids = np.full((q,), -1, np.int32)
+            kids[: len(ids)] = ids
+            row, overflow = ops.apply_rm(row, jnp.asarray(cl), jnp.asarray(kids))
+            if bool(overflow):
+                raise DeferredOverflow(
+                    f"replica {replica}: deferred buffer full "
+                    f"(cap {self.state.dvalid.shape[-1]})"
+                )
+        else:
+            raise TypeError(f"not a Map op: {op!r}")
+        self.state = jax.tree.map(
+            lambda full, r_: full.at[replica].set(r_), self.state, row
+        )
+
+    @transactional_apply("actors")
+    def reset_remove(self, replica: int, clock) -> None:
+        """``Causal::reset_remove`` on one replica (reference:
+        src/map.rs ResetRemove impl; dense sibling:
+        BatchedMap.reset_remove)."""
+        cl = clock_lanes(clock, self.actors, self.state.top.shape[-1])
+        row = ops.reset_remove(self._row(self.state, replica), jnp.asarray(cl))
+        self.state = jax.tree.map(
+            lambda full, r_: full.at[replica].set(r_), self.state, row
+        )
+
+    # ---- state path (CvRDT) -------------------------------------------
+    def _check(self, flags, what: str) -> None:
+        cells, deferred, siblings = (bool(x) for x in flags)
+        if cells:
+            raise DotCapacityOverflow(
+                f"{what}: cell table full — rebuild with a larger cell_cap"
+            )
+        if deferred:
+            raise DeferredOverflow(
+                f"{what}: deferred buffer full — rebuild with a larger "
+                f"deferred_cap"
+            )
+        if siblings:
+            raise SlotOverflow(
+                f"{what}: a key exceeds sibling_cap concurrent writers"
+            )
+
+    def merge_from(self, dst: int, src: int) -> None:
+        metrics.count("sparse_map.merges")
+        joined, flags = ops.join(
+            self._row(self.state, dst),
+            self._row(self.state, src),
+            sibling_cap=self.sibling_cap,
+        )
+        self._check(flags, f"merge {src}->{dst}")
+        self.state = jax.tree.map(
+            lambda full, r_: full.at[dst].set(r_), self.state, joined
+        )
+
+    def fold(self) -> Map:
+        """Full-mesh anti-entropy: join all replicas, return the
+        converged oracle-form state."""
+        metrics.count("sparse_map.merges", max(self.n_replicas - 1, 0))
+        folded, flags = ops.fold(self.state, sibling_cap=self.sibling_cap)
+        self._check(flags, "fold")
+        tmp = BatchedSparseMap(
+            1, self.n_keys, self.state.top.shape[-1], self.cell_cap,
+            self.sibling_cap, self.state.dvalid.shape[-1],
+            self.state.kidx.shape[-1],
+            keys=self.keys, actors=self.actors, values=self.values,
+        )
+        tmp.state = jax.tree.map(lambda x: x[None], folded)
+        return tmp.to_pure(0)
+
+    def keys_of(self, i: int) -> frozenset:
+        st = jax.device_get(self._row(self.state, i))
+        return frozenset(
+            self.keys[int(k)] for k in st.kid[st.valid] if k >= 0
+        )
+
+    def nbytes(self) -> int:
+        return ops.nbytes(self.state)

@@ -1,0 +1,396 @@
+"""Sparse (segment-encoded) ``Map<K, MVReg>`` — the config-4 flavor for
+huge key universes.
+
+Reference semantics: src/map.rs ``Map<K, MVReg<_>, A>`` (SURVEY §3 r11
+specialised to BASELINE config 4) under the causal-composition rule of
+pure/map.py. The dense slab (ops/map.py ``MapState``) spends O(K·S·A)
+state on the full key universe; at 100M+ keys — or tiny live sets over
+1M-key spaces — that loses to live-content-proportional storage the
+same way the flat ORSWOT does (ops/sparse_orswot.py, SURVEY §7.3).
+
+Representation: one segment table of live CELLS. Under the
+per-(key, actor) uniqueness invariant (a later write by the same actor
+carries a clock ≥ its earlier write's, so apply-time domination evicts
+the older one — the same invariant the fused dense kernel rests on,
+ops/pallas_kernels._decode_wide), a register map is exactly a set of
+cells ``(key, actor) → (witness counter, value, write clock)``:
+
+- ``kid/act/ctr/valid [..., C]``  — the cell dot, canonically sorted by
+  (kid, act), dead lanes last (raw arrays of converged replicas are
+  bit-comparable),
+- ``val [..., C]`` + ``clk [..., C, A]`` — the payload riding the dot,
+- ``dcl [..., D, A]`` + ``kidx [..., D, Q]`` — parked keyset-removes as
+  (clock, key-LIST) slots (lists where the dense level uses K-wide
+  masks — state proportional to the op, not the universe; shared
+  machinery with ops/sparse_nest.py's list-flavored buffers).
+
+The join is the cell-granular dot rule of the fused dense path
+(ops/pallas_kernels._join_step_cells), matched across sides by binary
+search on the packed ``kid·A + act`` key (O(C log C), the same trick as
+sparse_orswot._match_other): equal counters keep the cell (same dot ⇒
+same payload); otherwise a side's cell survives iff the other side's
+top never saw it — at most one side can win, because an actor's
+counters are totally ordered and each side's top covers its own dots.
+The payload follows the surviving counter. Sibling capacity is a
+PER-KEY live-cell bound checked after replay (the dense join's
+transient-overflow semantics).
+
+A/B gates: tests/test_sparse_mvmap.py pins this module against the
+pure oracle AND bit-for-bit against the dense ``BatchedMap`` through
+``to_pure`` on every reachable state.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .sparse_nest import _park_list
+from .sparse_orswot import (
+    DTYPE,
+    _compact_parked,
+    _dedupe_parked,
+    _replay_parked,
+)
+
+_INT32_MAX = jnp.iinfo(jnp.int32).max
+
+
+class SparseMVMapState(NamedTuple):
+    """A (possibly batched) segment-encoded Map<K, MVReg> replica."""
+
+    top: jax.Array    # [..., A]
+    kid: jax.Array    # [..., C] int32 key id (-1 = dead lane)
+    act: jax.Array    # [..., C] int32
+    ctr: jax.Array    # [..., C] u32 witness counter
+    val: jax.Array    # [..., C] int32 interned value
+    clk: jax.Array    # [..., C, A] u32 full write clock
+    valid: jax.Array  # [..., C]
+    dcl: jax.Array    # [..., D, A] parked rm clocks
+    kidx: jax.Array   # [..., D, Q] int32 parked key lists (-1 = empty)
+    dvalid: jax.Array # [..., D]
+
+
+def empty(
+    cell_cap: int,
+    n_actors: int,
+    deferred_cap: int = 4,
+    rm_width: int = 8,
+    batch: tuple = (),
+) -> SparseMVMapState:
+    """The join identity: no cells, no parked removes."""
+    return SparseMVMapState(
+        top=jnp.zeros((*batch, n_actors), DTYPE),
+        kid=jnp.full((*batch, cell_cap), -1, jnp.int32),
+        act=jnp.zeros((*batch, cell_cap), jnp.int32),
+        ctr=jnp.zeros((*batch, cell_cap), DTYPE),
+        val=jnp.zeros((*batch, cell_cap), jnp.int32),
+        clk=jnp.zeros((*batch, cell_cap, n_actors), DTYPE),
+        valid=jnp.zeros((*batch, cell_cap), bool),
+        dcl=jnp.zeros((*batch, deferred_cap, n_actors), DTYPE),
+        kidx=jnp.full((*batch, deferred_cap, rm_width), -1, jnp.int32),
+        dvalid=jnp.zeros((*batch, deferred_cap), bool),
+    )
+
+
+def _canon(kid, act, ctr, val, clk, valid, cap: int):
+    """Sort live cells by (kid, act), dead lanes last with zeroed
+    payload; truncate to ``cap``. Returns the table + overflow flag."""
+    order = jnp.lexsort(
+        (act, jnp.where(valid, kid, _INT32_MAX), ~valid), axis=-1
+    )
+    take = lambda x: jnp.take_along_axis(x, order, axis=-1)
+    kid, act, ctr, val, valid = (
+        take(kid), take(act), take(ctr), take(val), take(valid)
+    )
+    clk = jnp.take_along_axis(clk, order[..., None], axis=-2)
+    overflow = jnp.sum(valid, axis=-1) > cap
+    kid, act, ctr, val, valid = (
+        kid[..., :cap], act[..., :cap], ctr[..., :cap],
+        val[..., :cap], valid[..., :cap],
+    )
+    clk = clk[..., :cap, :]
+    return (
+        jnp.where(valid, kid, -1),
+        jnp.where(valid, act, 0),
+        jnp.where(valid, ctr, 0),
+        jnp.where(valid, val, 0),
+        jnp.where(valid[..., None], clk, 0),
+        valid,
+        overflow,
+    )
+
+
+def _match_pos(kid, act, valid, okid, oact, ovalid, n_act: int):
+    """For each cell lane: the OTHER side's lane holding the same
+    (key, actor) cell — ``(pos, hit)``. Both tables canonical, so the
+    packed key ``kid·A + act`` is ascending over the valid prefix and a
+    binary search replaces the all-pairs matrix (int32 bound:
+    ``K·A < 2^31``)."""
+    if kid.ndim > 1:
+        inner = partial(_match_pos, n_act=n_act)
+        return jax.vmap(inner)(kid, act, valid, okid, oact, ovalid)
+    key = jnp.where(valid, kid * n_act + act, _INT32_MAX)
+    okey = jnp.where(ovalid, okid * n_act + oact, _INT32_MAX)
+    pos = jnp.clip(jnp.searchsorted(okey, key), 0, okey.shape[-1] - 1)
+    hit = valid & jnp.take(ovalid, pos) & (jnp.take(okey, pos) == key)
+    return pos, hit
+
+
+def _sibling_overflow(kid, valid, sibling_cap: int):
+    """Per-key live-cell count must stay ≤ sibling_cap. Cells are
+    canonically sorted by kid, so a cell's sibling rank is its lane
+    index minus its key-run's start (binary search)."""
+    if kid.ndim > 1:
+        return jax.vmap(partial(_sibling_overflow, sibling_cap=sibling_cap))(
+            kid, valid
+        )
+    kids = jnp.where(valid, kid, _INT32_MAX)
+    start = jnp.searchsorted(kids, kids, side="left")
+    rank = jnp.arange(kid.shape[-1]) - start
+    return jnp.any(valid & (rank >= sibling_cap))
+
+
+@partial(jax.jit, static_argnames=("sibling_cap",))
+def join(a: SparseMVMapState, b: SparseMVMapState, sibling_cap: int = 4):
+    """Pairwise lattice join on cell segments — the cell-granular dot
+    rule with payload winner-select (reference: src/map.rs
+    ``CvRDT::merge`` specialised to MVReg children; dense sibling:
+    ops/map.join and the fused ``_join_step_cells``). Returns
+    ``(state, overflow[3])``: [cell-capacity, deferred-capacity,
+    sibling-capacity] lanes."""
+    n_act = a.top.shape[-1]
+    pos_a, hit_a = _match_pos(
+        a.kid, a.act, a.valid, b.kid, b.act, b.valid, n_act
+    )
+    _, hit_b = _match_pos(
+        b.kid, b.act, b.valid, a.kid, a.act, a.valid, n_act
+    )
+    octr = jnp.take_along_axis(b.ctr, pos_a, axis=-1)
+    oval = jnp.take_along_axis(b.val, pos_a, axis=-1)
+    oclk = jnp.take_along_axis(b.clk, pos_a[..., None], axis=-2)
+
+    btop_at_a = jnp.take_along_axis(b.top, a.act, axis=-1)
+    atop_at_a = jnp.take_along_axis(a.top, a.act, axis=-1)
+    atop_at_b = jnp.take_along_axis(a.top, b.act, axis=-1)
+
+    # Per a-lane cell: equal dots keep; else the unilateral winner (at
+    # most one side's counter escapes the other's top — totally-ordered
+    # actor counters, tops cover own dots).
+    equal = hit_a & (octr == a.ctr)
+    a_wins = a.ctr > btop_at_a
+    b_wins = hit_a & (octr > atop_at_a)
+    out_ctr = jnp.where(
+        equal | a_wins, a.ctr, jnp.where(b_wins, octr, 0)
+    )
+    out_ctr = jnp.where(a.valid, out_ctr, 0)
+    take_b = b_wins & ~(equal | a_wins)
+    out_val = jnp.where(take_b, oval, a.val)
+    out_clk = jnp.where(take_b[..., None], oclk, a.clk)
+
+    # b's matched cells are accounted for on a's lane; keep only b's
+    # unmatched winners.
+    keep_b = b.valid & ~hit_b & (b.ctr > atop_at_b)
+
+    kid = jnp.concatenate([a.kid, b.kid], axis=-1)
+    act = jnp.concatenate([a.act, b.act], axis=-1)
+    ctr = jnp.concatenate([out_ctr, jnp.where(keep_b, b.ctr, 0)], axis=-1)
+    val = jnp.concatenate([out_val, b.val], axis=-1)
+    clk = jnp.concatenate([out_clk, b.clk], axis=-2)
+    valid = jnp.concatenate([out_ctr > 0, keep_b], axis=-1)
+    top = jnp.maximum(a.top, b.top)
+
+    # Parked keyset-removes: dict-union on equal clocks, replay against
+    # the joined cells, drop caught-up slots, compact.
+    dcl = jnp.concatenate([a.dcl, b.dcl], axis=-2)
+    kidx = jnp.concatenate([a.kidx, b.kidx], axis=-2)
+    dvalid = jnp.concatenate([a.dvalid, b.dvalid], axis=-1)
+    dcl, kidx, dvalid = _dedupe_parked(dcl, kidx, dvalid)
+    valid = _replay_parked(kid, act, ctr, valid, dcl, kidx, dvalid)
+    still = ~jnp.all(dcl <= top[..., None, :], axis=-1)
+    dvalid = dvalid & still
+    dcl, kidx, dvalid, d_of = _compact_parked(
+        dcl, kidx, dvalid, a.dcl.shape[-2]
+    )
+
+    kid, act, ctr, val, clk, valid, c_of = _canon(
+        kid, act, ctr, val, clk, valid, a.kid.shape[-1]
+    )
+    s_of = _sibling_overflow(kid, valid, sibling_cap)
+    return (
+        SparseMVMapState(
+            top=top, kid=kid, act=act, ctr=ctr, val=val, clk=clk,
+            valid=valid, dcl=dcl, kidx=kidx, dvalid=dvalid,
+        ),
+        jnp.stack([jnp.any(c_of), jnp.any(d_of), jnp.any(s_of)]),
+    )
+
+
+@jax.jit
+def apply_up(
+    state: SparseMVMapState,
+    wact: jax.Array,
+    wctr: jax.Array,
+    key: jax.Array,
+    clock: jax.Array,
+    val: jax.Array,
+):
+    """CmRDT apply of ``Op::Up { dot, key, MVReg Put }`` (reference:
+    src/map.rs CmRDT::apply routing src/mvreg.rs Put; dense sibling:
+    ops/map.apply_up). A seen dot is a no-op; otherwise siblings of the
+    key that the Put's write clock strictly dominates are evicted
+    (same-actor older writes always are — actor clocks are monotone),
+    the cell lands in its existing (key, actor) lane or a free one, the
+    top advances, and parked removes replay. Unbatched. Returns
+    ``(state, overflow)`` — overflow = no free lane for a new cell."""
+    c = state.kid.shape[-1]
+    n_act = state.top.shape[-1]
+    wctr = wctr.astype(state.top.dtype)
+    clock = jnp.asarray(clock, state.clk.dtype)
+    seen = state.top[wact] >= wctr
+    same_key = state.valid & (state.kid == key)
+
+    # A put some existing sibling's clock already dominates is a
+    # CONTENT no-op — but its dot still advances the top (the mvreg
+    # apply_put rule the dense path routes through).
+    content_noop = jnp.any(
+        same_key & jnp.all(state.clk >= clock[None, :], axis=-1)
+    )
+    act_on = ~seen & ~content_noop
+
+    # Evict strictly-dominated siblings of this key.
+    dominated = (
+        same_key
+        & jnp.all(state.clk <= clock[None, :], axis=-1)
+        & jnp.any(state.clk < clock[None, :], axis=-1)
+    )
+    valid = state.valid & ~(dominated & act_on)
+
+    # Upsert: the (key, wact) lane if it exists (searched on the
+    # canonical PRE-eviction table — eviction holes would break the
+    # ascending packed-key order searchsorted needs; a same-actor
+    # evicted cell is exactly the lane being overwritten), else a free
+    # lane.
+    okey = jnp.where(state.valid, state.kid * n_act + state.act, _INT32_MAX)
+    tkey = key * n_act + wact
+    pos = jnp.clip(jnp.searchsorted(okey, tkey), 0, c - 1)
+    hit = jnp.take(state.valid, pos) & (jnp.take(okey, pos) == tkey)
+    free_order = jnp.argsort(valid, stable=True)
+    has_free = jnp.any(~valid)
+    lane = jnp.where(hit, pos, jnp.where(has_free, free_order[0], c))
+    write = act_on & (hit | has_free)
+    overflow = act_on & ~hit & ~has_free
+    lane = jnp.where(write, lane, c)
+
+    kid = state.kid.at[lane].set(key, mode="drop")
+    act = state.act.at[lane].set(wact, mode="drop")
+    ctr = state.ctr.at[lane].set(wctr, mode="drop")
+    valr = state.val.at[lane].set(val, mode="drop")
+    clk = state.clk.at[lane].set(clock, mode="drop")
+    valid = valid.at[lane].set(True, mode="drop")
+
+    top = jnp.where(seen, state.top, state.top.at[wact].max(wctr))
+    valid = _replay_parked(
+        kid, act, ctr, valid, state.dcl, state.kidx, state.dvalid
+    )
+    still = ~jnp.all(state.dcl <= top[None, :], axis=-1)
+    kid, act, ctr, valr, clk, valid, _ = _canon(
+        kid, act, ctr, valr, clk, valid, c
+    )
+    return (
+        state._replace(
+            top=top, kid=kid, act=act, ctr=ctr, val=valr, clk=clk,
+            valid=valid, dvalid=state.dvalid & still,
+        ),
+        overflow,
+    )
+
+
+@jax.jit
+def apply_rm(state: SparseMVMapState, rm_clock: jax.Array, kids: jax.Array):
+    """CmRDT apply of ``Op::Rm { clock, keyset }`` (reference:
+    src/map.rs CmRDT::apply; dense sibling: ops/map.apply_rm): kill the
+    covered cells of listed keys now; park the (clock, key-list) when
+    the clock runs ahead of the top. Unbatched. Returns
+    ``(state, overflow)``."""
+    rm_clock = jnp.asarray(rm_clock, state.top.dtype)
+    listed = jnp.any(
+        (state.kid[:, None] == kids[None, :]) & (kids[None, :] >= 0), axis=-1
+    )
+    covered = (
+        state.valid & listed & (state.ctr <= jnp.take(rm_clock, state.act))
+    )
+    valid = state.valid & ~covered
+
+    ahead = ~jnp.all(rm_clock <= state.top)
+    dcl, kidx, dvalid, overflow = _park_list(
+        state.dcl, state.kidx, state.dvalid, rm_clock, kids, ahead
+    )
+
+    kid, act, ctr, val, clk, valid, _ = _canon(
+        state.kid, state.act, state.ctr, state.val, state.clk, valid,
+        state.kid.shape[-1],
+    )
+    return (
+        state._replace(
+            kid=kid, act=act, ctr=ctr, val=val, clk=clk, valid=valid,
+            dcl=dcl, kidx=kidx, dvalid=dvalid,
+        ),
+        overflow,
+    )
+
+
+@jax.jit
+def reset_remove(state: SparseMVMapState, clock: jax.Array) -> SparseMVMapState:
+    """ResetRemove — nested causal forget on the segment table
+    (reference: src/map.rs ResetRemove impl; dense sibling:
+    ops/map.reset_remove): cells whose witness dot the clock covers
+    die, parked rm clocks zero covered lanes (slot dies when empty,
+    equal survivors re-union), the top forgets covered lanes."""
+    from . import vclock
+
+    clock = jnp.asarray(clock, state.ctr.dtype)
+    cl_at = jnp.take_along_axis(
+        jnp.broadcast_to(clock, (*state.act.shape[:-1], clock.shape[-1])),
+        state.act,
+        axis=-1,
+    )
+    valid = state.valid & (state.ctr > cl_at)
+    kid, act, ctr, val, clk, valid, _ = _canon(
+        state.kid, state.act, state.ctr, state.val, state.clk, valid,
+        state.kid.shape[-1],
+    )
+    dcl = vclock.reset_remove(state.dcl, clock[..., None, :])
+    dvalid = state.dvalid & jnp.any(dcl > 0, axis=-1)
+    dcl = jnp.where(dvalid[..., None], dcl, 0)
+    kidx = jnp.where(dvalid[..., None], state.kidx, -1)
+    dcl, kidx, dvalid = _dedupe_parked(dcl, kidx, dvalid)
+    dcl, kidx, dvalid, _ = _compact_parked(
+        dcl, kidx, dvalid, state.dvalid.shape[-1]
+    )
+    top = vclock.reset_remove(state.top, clock)
+    return SparseMVMapState(
+        top=top, kid=kid, act=act, ctr=ctr, val=val, clk=clk,
+        valid=valid, dcl=dcl, kidx=kidx, dvalid=dvalid,
+    )
+
+
+def fold(states: SparseMVMapState, sibling_cap: int = 4):
+    """Log-tree fold of a replica batch (leading axis)."""
+    from .lattice import tree_fold
+
+    identity = jax.tree.map(lambda x: jnp.zeros(x.shape[1:], x.dtype), states)
+    identity = identity._replace(
+        kid=jnp.full_like(identity.kid, -1),
+        kidx=jnp.full_like(identity.kidx, -1),
+    )
+    return tree_fold(
+        states, identity, partial(join, sibling_cap=sibling_cap)
+    )
+
+
+def nbytes(state: SparseMVMapState) -> int:
+    return sum(x.nbytes for x in state)

@@ -473,6 +473,36 @@ def mesh_fold_sparse(states, mesh: Mesh):
     )
 
 
+def mesh_fold_sparse_mvmap(states, mesh: Mesh, sibling_cap: int = 4):
+    """Converge a SPARSE ``Map<K, MVReg>`` replica batch
+    (ops/sparse_mvmap) over the mesh's replica axis, cell table
+    replicated across the element axis — the layout that pairs with the
+    backend's live-cell-proportional state (the key universe is
+    virtual, so there is nothing to shard until cell counts demand it).
+    Returns ``(state, overflow[3])``."""
+    from ..ops import sparse_mvmap as smv
+
+    shape_args = (
+        states.kid.shape[-1],
+        states.top.shape[-1],
+        states.dcl.shape[-2],
+        states.kidx.shape[-1],
+    )
+    rsize = mesh.shape[REPLICA_AXIS]
+    pad_r = (-states.top.shape[0]) % rsize
+    states = _pad_with_identity(
+        states, rsize, smv.empty(*shape_args, batch=(pad_r,)) if pad_r else None
+    )
+    template = smv.empty(*shape_args)
+    return _mesh_fold_lattice(
+        f"sparse_mvmap_fold_s{sibling_cap}", states, mesh,
+        partial(smv.join, sibling_cap=sibling_cap),
+        partial(smv.fold, sibling_cap=sibling_cap),
+        jax.tree.map(lambda _: P(REPLICA_AXIS), template),
+        jax.tree.map(lambda _: P(), template),
+    )
+
+
 def mesh_gossip_sparse(
     states, mesh: Mesh, rounds: Optional[int] = None
 ):

@@ -1,0 +1,247 @@
+"""Segment-encoded ``Map<K, MVReg>`` vs the oracle AND the dense slab —
+the A/B gates for the sparse config-4 flavor (SURVEY §3 r11 at huge key
+universes; reference: src/map.rs ``Map<K, MVReg<_>, A>``)."""
+
+import random
+
+import pytest
+from hypothesis import given, settings
+
+from crdt_tpu import Map, MVReg, VClock
+from crdt_tpu.models import BatchedMap, BatchedSparseMap
+from crdt_tpu.models.orswot import DeferredOverflow
+from crdt_tpu.models.registers import SlotOverflow
+from crdt_tpu.utils import Interner
+
+from strategies import ACTORS, seeds
+from test_map import _site_run, mv_map, put
+
+KEYS = list("pq")
+CAPS = dict(cell_cap=64, sibling_cap=12, deferred_cap=12, rm_width=8)
+
+
+def _interners():
+    return Interner(KEYS), Interner(ACTORS + ["A", "B", "C"])
+
+
+def _batched(states):
+    keys, actors = _interners()
+    return BatchedSparseMap.from_pure(states, keys=keys, actors=actors, **CAPS)
+
+
+@given(seeds)
+@settings(max_examples=15, deadline=None)
+def test_roundtrip_lossless(seed):
+    rng = random.Random(seed)
+    states = _site_run(rng, mv_map)
+    batched = _batched(states)
+    for i, s in enumerate(states):
+        assert batched.to_pure(i) == s, f"replica {i}"
+
+
+@pytest.mark.smoke
+@given(seeds)
+@settings(max_examples=15, deadline=None)
+def test_join_bit_identical_to_oracle_merge(seed):
+    rng = random.Random(seed)
+    states = _site_run(rng, mv_map)
+    batched = _batched(states)
+
+    expect = states[0].clone()
+    expect.merge(states[1].clone())
+    batched.merge_from(0, 1)
+    assert batched.to_pure(0) == expect
+
+    # round-trip of untouched replicas is lossless
+    assert batched.to_pure(2) == states[2]
+
+
+@given(seeds)
+@settings(max_examples=15, deadline=None)
+def test_fold_bit_identical_to_oracle_fold(seed):
+    rng = random.Random(seed)
+    states = _site_run(rng, mv_map, n_cmds=14)
+    batched = _batched(states)
+
+    expect = states[0].clone()
+    for s in states[1:]:
+        expect.merge(s.clone())
+    assert batched.fold() == expect
+
+
+@given(seeds)
+@settings(max_examples=10, deadline=None)
+def test_op_path_bit_identical(seed):
+    """Every minted op cross-delivered through the sparse apply path
+    equals the oracle, op for op."""
+    rng = random.Random(seed)
+    keys, actors = _interners()
+    batched = BatchedSparseMap(
+        3, len(KEYS), len(ACTORS) + 3, keys=keys, actors=actors, **CAPS
+    )
+    oracles = [mv_map() for _ in range(3)]
+    sites = [mv_map() for _ in range(3)]
+    ops = []
+    for step in range(12):
+        i = rng.randrange(3)
+        k = rng.choice(KEYS)
+        m = sites[i]
+        if rng.random() < 0.3 and m.get(k) is not None:
+            op = m.rm(k, m.len().derive_rm_ctx())
+        else:
+            op = m.update(
+                k, m.len().derive_add_ctx(ACTORS[i]),
+                lambda r, c, v=f"v{step}": r.write(v, c),
+            )
+        m.apply(op)
+        ops.append(op)
+    for dst in range(3):
+        for op in ops:
+            oracles[dst].apply(op)
+            batched.apply(dst, op)
+        assert batched.to_pure(dst) == oracles[dst], f"replica {dst}"
+
+
+@given(seeds)
+@settings(max_examples=10, deadline=None)
+def test_sparse_matches_dense_model(seed):
+    """The sparse and dense backends agree state-for-state through
+    to_pure on the same site run — merge, fold, and reset_remove."""
+    rng = random.Random(seed)
+    states = _site_run(rng, mv_map)
+    keys, actors = _interners()
+    dense = BatchedMap.from_pure(
+        [s.clone() for s in states], keys=Interner(KEYS),
+        actors=Interner(ACTORS + ["A", "B", "C"]),
+        sibling_cap=12, deferred_cap=12,
+    )
+    sparse = _batched(states)
+
+    dense.merge_from(0, 1)
+    sparse.merge_from(0, 1)
+    assert dense.to_pure(0) == sparse.to_pure(0)
+    assert dense.fold() == sparse.fold()
+
+    clock = VClock(dict(list(states[0].clock.dots.items())[:1]))
+    if clock.dots:
+        dense.reset_remove(2, clock)
+        sparse.reset_remove(2, clock)
+        assert dense.to_pure(2) == sparse.to_pure(2)
+
+
+def test_deferred_rm_parks_and_replays():
+    """An rm clock ahead of the local top parks in the (clock, key-list)
+    buffer and replays when the adds arrive — the oracle's deferred
+    path."""
+    a, b = mv_map(), mv_map()
+    put(a, "A", "p", "x")
+    # b removes p with a's clock before seeing a's add: parks.
+    ctx = a.len().derive_rm_ctx()
+    rm_op = b.rm("p", ctx)
+    b.apply(rm_op)
+    batched = _batched([a, b])
+    assert batched.to_pure(1) == b  # parked slot round-trips
+
+    # deliver the add; the parked remove replays on both sides
+    expect = b.clone()
+    expect.merge(a.clone())
+    batched.merge_from(1, 0)
+    assert batched.to_pure(1) == expect
+    assert batched.to_pure(1).get("p") is None or \
+        batched.to_pure(1).get("p").val is None
+
+
+def test_sibling_capacity_overflow_raises():
+    """More concurrent writers on one key than sibling_cap flags the
+    join (the dense slab's transient-overflow contract)."""
+    sites = [mv_map() for _ in range(3)]
+    for i, s in enumerate(sites):
+        put(s, ACTORS[i], "p", f"v{i}")
+    keys, actors = _interners()
+    batched = BatchedSparseMap.from_pure(
+        sites, keys=keys, actors=actors,
+        cell_cap=64, sibling_cap=2, deferred_cap=4,
+    )
+    batched.merge_from(0, 1)  # two siblings: at capacity
+    with pytest.raises(SlotOverflow):
+        batched.merge_from(0, 2)  # third concurrent writer
+
+
+def test_cell_capacity_overflow_raises():
+    m = mv_map()
+    put(m, "A", "p", "x")
+    put(m, "B", "q", "y")
+    keys, actors = _interners()
+    with pytest.raises(Exception):
+        BatchedSparseMap.from_pure(
+            [m], keys=keys, actors=actors, cell_cap=1
+        )
+
+
+def test_huge_key_universe_stays_small():
+    """The whole point: a 100M-key universe costs only live-cell
+    state."""
+    m = mv_map()
+    put(m, "A", "k-31415926", "x")
+    put(m, "B", "k-99999999", "y")
+    batched = BatchedSparseMap.from_pure(
+        [m], n_keys=100_000_000, cell_cap=8, sibling_cap=4
+    )
+    assert batched.to_pure(0) == m
+    assert batched.nbytes() < 4096, batched.nbytes()
+    # ops still apply against the huge universe
+    op = m.update(
+        "k-12345678", m.len().derive_add_ctx("A"),
+        lambda r, c: r.write("z", c),
+    )
+    m.apply(op)
+    batched.apply(0, op)
+    assert batched.to_pure(0) == m
+
+
+def test_checkpoint_round_trip(tmp_path):
+    from crdt_tpu import checkpoint
+
+    states = _site_run(random.Random(5), mv_map)
+    batched = _batched(states)
+    p = tmp_path / "sparse_map.npz"
+    checkpoint.save(p, batched)
+    loaded = checkpoint.load(p)
+    assert type(loaded).__name__ == "BatchedSparseMap"
+    for i, s in enumerate(states):
+        assert loaded.to_pure(i) == s
+    assert loaded.n_keys == batched.n_keys
+    assert loaded.sibling_cap == batched.sibling_cap
+
+
+def test_factory_kind():
+    from crdt_tpu.config import configured, replicaset
+
+    m = mv_map()
+    op = put(m, "A", "p", "x")
+    with configured(backend="xla"):
+        rs = replicaset("sparse_map", n_replicas=2, n_actors=4)
+        rs.apply(0, op)
+        assert rs.to_pure(0) == m
+        assert rs.to_pure(1) == mv_map()
+
+
+def test_mesh_fold_matches_host_fold():
+    """8-virtual-device replica-axis fold == the host tree fold, state
+    for state through to_pure."""
+    import jax
+
+    from crdt_tpu.parallel import make_mesh, mesh_fold_sparse_mvmap
+
+    states = _site_run(random.Random(9), mv_map)
+    batched = _batched(states)
+    expect = batched.fold()
+
+    mesh = make_mesh(len(jax.devices()), 1)
+    folded, of = mesh_fold_sparse_mvmap(
+        batched.state, mesh, sibling_cap=batched.sibling_cap
+    )
+    assert not bool(of.any())
+    tmp = _batched(states)  # same interners/caps; swap in the mesh result
+    tmp.state = jax.tree.map(lambda x: x[None], folded)
+    assert tmp.to_pure(0) == expect
