@@ -62,6 +62,7 @@ from .anti_entropy import (
     mesh_fold_nested_map,
     mesh_fold_sparse,
     mesh_fold_sparse_mvmap,
+    mesh_gossip_sparse_mvmap,
     mesh_gossip,
     mesh_gossip_sparse,
     mesh_gossip_map,
@@ -143,6 +144,7 @@ __all__ = [
     "mesh_fold_mvreg",
     "mesh_fold_sparse_map",
     "mesh_fold_sparse_mvmap",
+    "mesh_gossip_sparse_mvmap",
     "mesh_fold_sparse_sharded",
     "split_nested",
     "split_segments",

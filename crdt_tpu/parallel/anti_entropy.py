@@ -473,6 +473,26 @@ def mesh_fold_sparse(states, mesh: Mesh):
     )
 
 
+def _sparse_mvmap_pad_and_template(states, rsize: int):
+    """Identity-pad a sparse Map<K, MVReg> replica batch to the mesh's
+    replica-axis size and build the (unbatched) spec template — shared
+    shape plumbing for the two mesh entry points (the mvmap analog of
+    ``_sparse_pad_and_template``)."""
+    from ..ops import sparse_mvmap as smv
+
+    shape_args = (
+        states.kid.shape[-1],
+        states.top.shape[-1],
+        states.dcl.shape[-2],
+        states.kidx.shape[-1],
+    )
+    pad_r = (-states.top.shape[0]) % rsize
+    states = _pad_with_identity(
+        states, rsize, smv.empty(*shape_args, batch=(pad_r,)) if pad_r else None
+    )
+    return states, smv.empty(*shape_args)
+
+
 def mesh_fold_sparse_mvmap(states, mesh: Mesh, sibling_cap: int = 4):
     """Converge a SPARSE ``Map<K, MVReg>`` replica batch
     (ops/sparse_mvmap) over the mesh's replica axis, cell table
@@ -482,24 +502,35 @@ def mesh_fold_sparse_mvmap(states, mesh: Mesh, sibling_cap: int = 4):
     Returns ``(state, overflow[3])``."""
     from ..ops import sparse_mvmap as smv
 
-    shape_args = (
-        states.kid.shape[-1],
-        states.top.shape[-1],
-        states.dcl.shape[-2],
-        states.kidx.shape[-1],
+    states, template = _sparse_mvmap_pad_and_template(
+        states, mesh.shape[REPLICA_AXIS]
     )
-    rsize = mesh.shape[REPLICA_AXIS]
-    pad_r = (-states.top.shape[0]) % rsize
-    states = _pad_with_identity(
-        states, rsize, smv.empty(*shape_args, batch=(pad_r,)) if pad_r else None
-    )
-    template = smv.empty(*shape_args)
     return _mesh_fold_lattice(
         f"sparse_mvmap_fold_s{sibling_cap}", states, mesh,
         partial(smv.join, sibling_cap=sibling_cap),
         partial(smv.fold, sibling_cap=sibling_cap),
         jax.tree.map(lambda _: P(REPLICA_AXIS), template),
         jax.tree.map(lambda _: P(), template),
+    )
+
+
+def mesh_gossip_sparse_mvmap(
+    states, mesh: Mesh, rounds: Optional[int] = None, sibling_cap: int = 4
+):
+    """Ring anti-entropy for SPARSE ``Map<K, MVReg>`` replica batches
+    over the replica axis — per-round traffic is one cell table per
+    link, proportional to LIVE cells, not the key universe. Same
+    replicated-element-axis layout as ``mesh_fold_sparse_mvmap``."""
+    from ..ops import sparse_mvmap as smv
+
+    states, template = _sparse_mvmap_pad_and_template(
+        states, mesh.shape[REPLICA_AXIS]
+    )
+    return _mesh_gossip_lattice(
+        f"sparse_mvmap_gossip_s{sibling_cap}", states, mesh,
+        partial(smv.join, sibling_cap=sibling_cap),
+        partial(smv.fold, sibling_cap=sibling_cap),
+        jax.tree.map(lambda _: P(REPLICA_AXIS), template), rounds,
     )
 
 

@@ -245,3 +245,24 @@ def test_mesh_fold_matches_host_fold():
     tmp = _batched(states)  # same interners/caps; swap in the mesh result
     tmp.state = jax.tree.map(lambda x: x[None], folded)
     assert tmp.to_pure(0) == expect
+
+
+def test_mesh_gossip_converges_every_device():
+    """P-1 ring rounds leave every device row equal to the full join."""
+    import jax
+
+    from crdt_tpu.parallel import make_mesh, mesh_gossip_sparse_mvmap
+
+    states = _site_run(random.Random(13), mv_map)
+    batched = _batched(states)
+    expect = batched.fold()
+
+    mesh = make_mesh(len(jax.devices()), 1)
+    rows, of = mesh_gossip_sparse_mvmap(
+        batched.state, mesh, sibling_cap=batched.sibling_cap
+    )
+    assert not bool(of.any())
+    for dev in range(rows.top.shape[0]):
+        tmp = _batched(states)
+        tmp.state = jax.tree.map(lambda x: x[dev][None], rows)
+        assert tmp.to_pure(0) == expect, f"device row {dev} diverged"
