@@ -656,6 +656,88 @@ def bench_sparse():
     }
 
 
+def bench_sparse_map():
+    """Sparse Map<K, MVReg> (diagnostic, stderr): the segment-encoded
+    config-4 flavor — fold throughput over a 100M-key universe at
+    live-cell-proportional state (``ops/sparse_mvmap.py``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.ops import sparse_mvmap as smv
+
+    r = int(os.environ.get("BENCH_SMAP_REPLICAS", 256))
+    cap = int(os.environ.get("BENCH_SMAP_CELLS", 2048))
+    universe = int(os.environ.get("BENCH_SMAP_UNIVERSE", 100_000_000))
+    s_cap = 8
+    rng = np.random.default_rng(11)
+
+    # Causally-consistent cells: unique (kid, act) per replica (dup keys
+    # dropped), counters covered by the replica's top, payload clocks
+    # witnessing the cell's own dot.
+    kid = rng.choice(universe, size=(r, cap), replace=True).astype(np.int32)
+    act = rng.integers(0, A, (r, cap)).astype(np.int32)
+    # Sort by the packed (kid, act) cell key so EVERY duplicate cell is
+    # adjacent (kid-only sorting leaves same-kid different-actor runs
+    # unsorted and can hide duplicates), then drop adjacent equals.
+    packed = kid.astype(np.int64) * A + act
+    order = np.argsort(packed, axis=-1)
+    take = lambda x: np.take_along_axis(x, order, axis=-1)
+    kid, act, packed = take(kid), take(act), take(packed)
+    dup = np.concatenate(
+        [np.zeros((r, 1), bool), packed[:, 1:] == packed[:, :-1]], axis=-1
+    )
+    valid = ~dup
+    ctr = rng.integers(1, 100, (r, cap)).astype(np.uint32)
+    val = rng.integers(0, 1 << 20, (r, cap)).astype(np.int32)
+    clk = np.zeros((r, cap, A), np.uint32)
+    np.put_along_axis(clk, act[..., None].astype(np.int64), ctr[..., None], axis=-1)
+    clk[~valid] = 0
+    top = np.zeros((r, A), np.uint32)
+    np.maximum.at(top, (np.arange(r)[:, None], act), np.where(valid, ctr, 0))
+    state = smv.empty(cap, A, batch=(r,))
+    ckid, cact, cctr, cval, cclk, cvalid, _ = smv._canon(
+        jnp.asarray(np.where(valid, kid, -1)),
+        jnp.asarray(np.where(valid, act, 0)),
+        jnp.asarray(np.where(valid, ctr, 0)),
+        jnp.asarray(np.where(valid, val, 0)),
+        jnp.asarray(clk),
+        jnp.asarray(valid),
+        cap,
+    )
+    state = state._replace(
+        top=jnp.asarray(top), kid=ckid, act=cact, ctr=cctr, val=cval,
+        clk=cclk, valid=cvalid,
+    )
+    live = int(valid.sum())
+    nbytes = smv.nbytes(state)
+    # dense equivalent: the MapState child at this (K, S, A) — int32/u32
+    # planes at 4 bytes, the valid plane at 1 (matching smv.nbytes's
+    # actual-bytes convention on the sparse side)
+    dense_bytes = r * universe * (3 * s_cap * 4 + s_cap * A * 4 + s_cap)
+
+    fold = jax.jit(lambda st: smv.fold(st, sibling_cap=s_cap))
+    out, _ = fold(state)
+    jax.block_until_ready(out.top)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out, _ = fold(state)
+        jax.block_until_ready(out.top)
+    dt = (time.perf_counter() - t0) / 3
+    log(
+        f"config-sparse-map: {r} replicas x {cap} cell-cap over a "
+        f"{universe:,}-key universe: fold {dt*1e3:.1f} ms -> "
+        f"{(r-1)/dt:,.0f} merges/s ({live:,} live cells; state "
+        f"{nbytes/1e6:.1f} MB vs dense {dense_bytes/1e12:,.1f} TB)"
+    )
+    return {
+        "config": "sparse_map", "metric": "sparse_map_merges_per_sec",
+        "value": round((r - 1) / dt, 1), "unit": "merges/s",
+        "universe": universe, "live_cells": live,
+        "state_bytes": nbytes, "dense_equiv_bytes": dense_bytes,
+        "shape": f"{r}x{cap}x{A}",
+    }
+
+
 def cached_hardware_headline():
     """The last MACHINE-CAPTURED on-chip flagship measurement, from the
     round's checkpointed evidence artifact (TPU_EVIDENCE_r05.json,
@@ -729,11 +811,14 @@ def main():
     if degraded:
         os.environ.setdefault("BENCH_SPARSE_REPLICAS", "32")
         os.environ.setdefault("BENCH_SPARSE_DOTS", "512")
+        os.environ.setdefault("BENCH_SMAP_REPLICAS", "32")
+        os.environ.setdefault("BENCH_SMAP_CELLS", "512")
     for name, fn in [
         ("clocks", bench_clocks),
         ("map", bench_map),
         ("list", bench_list),
         ("sparse", bench_sparse),
+        ("sparse_map", bench_sparse_map),
     ]:
         if os.environ.get(f"BENCH_{name.upper()}", "1") != "0":
             try:

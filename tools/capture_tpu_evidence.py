@@ -47,6 +47,7 @@ STEPS = [
     ("config4_map", 1200),
     ("config5_list", 1200),
     ("sparse_1m", 900),
+    ("sparse_map_100m", 900),
     ("mosaic_fused", 900),
     ("mosaic_stream", 600),
     ("mosaic_map", 900),

@@ -117,6 +117,13 @@ def step_sparse_1m():
     print(json.dumps(bench.bench_sparse()))
 
 
+def step_sparse_map_100m():
+    import bench
+
+    _require_tpu()
+    print(json.dumps(bench.bench_sparse_map()))
+
+
 def step_npasses_ab():
     import run_tpu_checks
 
@@ -152,6 +159,7 @@ STEPS = {
     "config4_map": step_config4_map,
     "config5_list": step_config5_list,
     "sparse_1m": step_sparse_1m,
+    "sparse_map_100m": step_sparse_map_100m,
     "mosaic_fused": step_mosaic_fused,
     "mosaic_stream": step_mosaic_stream,
     "mosaic_map": step_mosaic_map,
