@@ -131,6 +131,12 @@ def _is_sparse_mvmap(model) -> bool:
     return isinstance(model, BatchedSparseMap)
 
 
+def _is_sparse_nested_map(model) -> bool:
+    from .models.sparse_nested_map import BatchedSparseNestedMap
+
+    return isinstance(model, BatchedSparseNestedMap)
+
+
 def save(path: Union[str, os.PathLike], model) -> None:
     """Checkpoint a device model to ``path`` (one .npz file)."""
     if isinstance(model, BatchedOrswot):
@@ -154,6 +160,22 @@ def save(path: Union[str, os.PathLike], model) -> None:
             "keys": _interner_items(model.keys),
             "members": _interner_items(model.members),
             "actors": _interner_items(model.actors),
+        }
+        arrays = {
+            **{f"c_{k}": np.asarray(v)
+               for k, v in model.state.core._asdict().items()},
+            **{f"s_{k}": np.asarray(v)
+               for k, v in model.state._asdict().items() if k != "core"},
+        }
+    elif _is_sparse_nested_map(model):
+        meta = {
+            "kind": "sparse_map_map",
+            "span": model.span,
+            "sibling_cap": model.sibling_cap,
+            "keys1": _interner_items(model.keys1),
+            "keys2": _interner_items(model.keys2),
+            "actors": _interner_items(model.actors),
+            "values": _interner_items(model.values),
         }
         arrays = {
             **{f"c_{k}": np.asarray(v)
@@ -341,6 +363,35 @@ def load(path: Union[str, os.PathLike]):
             keys=_interner_from(meta["keys"]),
             members=_interner_from(meta["members"]),
             actors=_interner_from(meta["actors"]),
+        )
+        model.state = state
+        return model
+    if meta["kind"] == "sparse_map_map":
+        from .models.sparse_nested_map import BatchedSparseNestedMap
+        from .ops import sparse_mvmap as smv_ops
+        from .ops import sparse_nest as nest_ops
+
+        core = smv_ops.SparseMVMapState(
+            **{k[2:]: dev(v) for k, v in arrays.items() if k.startswith("c_")}
+        )
+        state = nest_ops.SparseNestState(
+            core=core,
+            **{k[2:]: dev(v) for k, v in arrays.items() if k.startswith("s_")},
+        )
+        model = BatchedSparseNestedMap(
+            core.top.shape[0],
+            int(meta["span"]),
+            core.kid.shape[-1],
+            core.top.shape[-1],
+            int(meta["sibling_cap"]),
+            core.dcl.shape[-2],
+            core.kidx.shape[-1],
+            state.kcl.shape[-2],
+            state.kidx.shape[-1],
+            keys1=_interner_from(meta["keys1"]),
+            keys2=_interner_from(meta["keys2"]),
+            actors=_interner_from(meta["actors"]),
+            values=_interner_from(meta["values"]),
         )
         model.state = state
         return model

@@ -132,7 +132,8 @@ def replicaset(
     (Map<K1, Map<K2, Orswot>>), gcounter, pncounter, gset, lwwreg,
     mvreg, sparse_orswot, sparse_map_orswot (segment-encoded
     Map<K, Orswot> for huge key universes), sparse_map (segment-encoded
-    Map<K, MVReg> — the config-4 flavor at huge key universes).
+    Map<K, MVReg> — the config-4 flavor at huge key universes),
+    sparse_map_map (segment-encoded Map<K1, Map<K2, MVReg>>).
 
     Lane sizing for the xla backend: ``n_keys`` sizes the (outer) key
     axis, ``n_members`` sizes the inner axis of the nested kinds — the
@@ -165,6 +166,7 @@ def replicaset(
             "sparse_orswot": Orswot,  # same oracle; sparsity is a backend trait
             "sparse_map_orswot": lambda: Map(val_default=Orswot),
             "sparse_map": lambda: Map(val_default=MVReg),
+            "sparse_map_map": lambda: Map(val_default=lambda: Map(val_default=MVReg)),
         }
         if kind not in factories:
             raise ValueError(f"unknown replicaset kind {kind!r}")
@@ -204,6 +206,20 @@ def replicaset(
             n_keys2 or 256,
             n_actors or 16,
             config.deferred_cap,
+            key_deferred_cap=config.deferred_cap,
+        )
+    if kind == "sparse_map_map":
+        from .models import BatchedSparseNestedMap
+
+        # n_members = the (virtual) inner-key span; n_keys2 repurposed
+        # as the live-cell capacity per replica.
+        return BatchedSparseNestedMap(
+            n_replicas,
+            span=n_members or 1 << 16,
+            cell_cap=n_keys2 or 256,
+            n_actors=n_actors or 16,
+            sibling_cap=config.sibling_cap,
+            deferred_cap=config.deferred_cap,
             key_deferred_cap=config.deferred_cap,
         )
     if kind == "sparse_map":

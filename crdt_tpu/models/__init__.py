@@ -15,6 +15,7 @@ from .counters import BatchedGCounter, BatchedPNCounter
 from .orswot import BatchedOrswot
 from .sparse_map import BatchedSparseMapOrswot
 from .sparse_mvmap import BatchedSparseMap
+from .sparse_nested_map import BatchedSparseNestedMap
 from .sparse_orswot import BatchedSparseOrswot
 from .gset import BatchedGSet
 from .registers import BatchedLWWReg, BatchedMVReg, SlotOverflow
@@ -31,6 +32,7 @@ __all__ = [
     "BatchedOrswot",
     "BatchedSparseMap",
     "BatchedSparseMapOrswot",
+    "BatchedSparseNestedMap",
     "BatchedSparseOrswot",
     "BatchedGSet",
     "BatchedLWWReg",

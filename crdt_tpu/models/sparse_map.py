@@ -24,7 +24,7 @@ from ..ops import sparse_nest as nest
 from ..ops import sparse_orswot as sp
 from ..pure.map import Map, MapRm, Nop, Up
 from ..pure.orswot import Add as OrswotAdd, Orswot, Rm as OrswotRm
-from ..utils import Interner, clock_lanes, transactional_apply
+from ..utils import Interner, clock_lanes, pad_id_list, transactional_apply
 from ..utils.metrics import metrics, observe_depth
 from ..vclock import VClock
 from .orswot import DeferredOverflow
@@ -262,19 +262,7 @@ class BatchedSparseMapOrswot:
     def _ids(self, pairs, width: Optional[int] = None) -> np.ndarray:
         """Flattened (key, member) cell ids, fixed width (power-of-two
         bucket ≥ 8 when unconstrained, to bound jit retraces)."""
-        ids = sorted(pairs)
-        if width is None:
-            width = 8
-            while width < len(ids):
-                width *= 2
-        if len(ids) > width:
-            raise ValueError(
-                f"op lists {len(ids)} targets; the buffer lane is {width} "
-                f"— rebuild with a larger rm_width or split the op"
-            )
-        out = np.full(width, -1, np.int32)
-        out[: len(ids)] = ids
-        return out
+        return pad_id_list(pairs, width)
 
     @transactional_apply("keys", "members", "actors")
     def apply(self, replica: int, op) -> None:

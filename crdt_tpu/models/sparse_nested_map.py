@@ -1,0 +1,440 @@
+"""BatchedSparseNestedMap — N segment-encoded ``Map<K1, Map<K2, MVReg>>``
+replicas.
+
+The sparse sibling of ``BatchedNestedMap`` (models/map_nested.py): same
+oracle (nested ``crdt_tpu.pure.map.Map`` with MVReg grandchildren,
+reference src/map.rs ``V: Val<A>`` composition), same op surface, same
+lossless ``to_pure``/``from_pure`` A/B boundary — but state proportional
+to LIVE cells: the causal-composition invariant flattens the nest onto
+ONE register-map cell table over the product key space (flat kid =
+k1·span + k2, ``ops/sparse_mvmap.SparseMVMapLeaf``) wrapped by one
+outer parked-keylist buffer (``ops/sparse_nest.SparseNestLevel``). Both
+key universes are virtual, so K1·K2 can reach 2^31/A while a replica
+holds kilobytes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dot import Dot
+from ..ops import sparse_mvmap as smv
+from ..pure.map import Map, MapRm, Nop, Up
+from ..pure.mvreg import MVReg, Put
+from ..utils import Interner, clock_lanes, pad_id_list, transactional_apply
+from ..utils.metrics import metrics, observe_depth
+from ..vclock import VClock
+from .orswot import DeferredOverflow
+from .registers import SlotOverflow
+from .sparse_orswot import DotCapacityOverflow
+from .validation import strict_validate_dot
+
+
+class BatchedSparseNestedMap:
+    def __init__(
+        self,
+        n_replicas: int,
+        span: int,
+        cell_cap: int = 64,
+        n_actors: int = 16,
+        sibling_cap: int = 4,
+        deferred_cap: int = 4,
+        rm_width: int = 8,
+        key_deferred_cap: int = 4,
+        key_rm_width: int = 8,
+        n_keys1: int = 0,
+        keys1: Optional[Interner] = None,
+        keys2: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+        values: Optional[Interner] = None,
+    ):
+        # The int32 packed cell key is (k1·span + k2)·A + act, so the
+        # OUTER key universe must be bounded too: an unbounded k1 wraps
+        # the key and joins silently lose cells. ``n_keys1`` defaults to
+        # the widest universe the packing allows.
+        cap1 = (2**31 - 1) // max(span * n_actors, 1)
+        if cap1 < 1:
+            raise ValueError("span * n_actors must fit the int32 packed key")
+        self.n_keys1 = min(n_keys1, cap1) if n_keys1 else cap1
+        self.keys1 = keys1 if keys1 is not None else Interner()
+        self.keys2 = keys2 if keys2 is not None else Interner()
+        self.actors = actors if actors is not None else Interner()
+        self.values = values if values is not None else Interner()
+        self.sibling_cap = sibling_cap
+        self.level, self.state = smv.empty_map_mvreg(
+            span, cell_cap, n_actors, deferred_cap, rm_width,
+            key_deferred_cap, key_rm_width, sibling_cap, batch=(n_replicas,),
+        )
+
+    @property
+    def n_replicas(self) -> int:
+        return self.state.core.top.shape[0]
+
+    @property
+    def span(self) -> int:
+        return self.level.span
+
+    @property
+    def cell_cap(self) -> int:
+        return self.state.core.kid.shape[-1]
+
+    # ---- conversion (the A/B gate boundary) ---------------------------
+    @classmethod
+    def from_pure(
+        cls,
+        pures: Sequence[Map],
+        span: int = 1 << 16,
+        cell_cap: int = 64,
+        sibling_cap: int = 4,
+        deferred_cap: int = 4,
+        rm_width: int = 8,
+        key_deferred_cap: int = 4,
+        key_rm_width: int = 8,
+        keys1: Optional[Interner] = None,
+        keys2: Optional[Interner] = None,
+        actors: Optional[Interner] = None,
+        values: Optional[Interner] = None,
+        n_actors: int = 0,
+    ) -> "BatchedSparseNestedMap":
+        """Build segments straight from the oracle dicts — cost is
+        O(live cells), independent of both key universes. ``span`` is
+        the (virtual) inner-key universe width."""
+        keys1 = keys1 if keys1 is not None else Interner()
+        keys2 = keys2 if keys2 is not None else Interner()
+        actors = actors if actors is not None else Interner()
+        values = values if values is not None else Interner()
+        for p in pures:
+            for actor in p.clock.dots:
+                actors.intern(actor)
+            for k1, child in p.entries.items():
+                keys1.intern(k1)
+                if not isinstance(child, Map):
+                    raise TypeError(
+                        f"children must be Map, got {type(child)}"
+                    )
+                if child.clock != p.clock:
+                    raise ValueError(
+                        f"child at {k1!r} violates the covered invariant"
+                    )
+                for k2, reg in child.entries.items():
+                    keys2.intern(k2)
+                    if not isinstance(reg, MVReg):
+                        raise TypeError(
+                            f"inner children must be MVReg, got {type(reg)}"
+                        )
+                    for d, (clock, v) in reg.vals.items():
+                        actors.intern(d.actor)
+                        for actor in clock.dots:
+                            actors.intern(actor)
+                        values.intern(v)
+                for clock, k2s in child.deferred.items():
+                    for actor in clock.dots:
+                        actors.intern(actor)
+                    for k2 in k2s:
+                        keys2.intern(k2)
+            for clock, k1s in p.deferred.items():
+                for actor in clock.dots:
+                    actors.intern(actor)
+                for k1 in k1s:
+                    keys1.intern(k1)
+        if len(keys2) > span:
+            raise ValueError(
+                f"{len(keys2)} inner keys exceed the span {span}"
+            )
+        na_bound = max(len(actors), n_actors, 1)
+        if len(keys1) * span * na_bound > 2**31 - 1:
+            raise ValueError(
+                f"{len(keys1)} outer keys x span {span} x {na_bound} actors "
+                f"overflow the int32 packed cell key"
+            )
+
+        r = len(pures)
+        na = max(len(actors), n_actors, 1)
+        out = cls(
+            r, span, cell_cap, na, sibling_cap, deferred_cap, rm_width,
+            key_deferred_cap, key_rm_width,
+            keys1=keys1, keys2=keys2, actors=actors, values=values,
+        )
+        top = np.zeros((r, na), np.uint32)
+        kid = np.full((r, cell_cap), -1, np.int32)
+        act = np.zeros((r, cell_cap), np.int32)
+        ctr = np.zeros((r, cell_cap), np.uint32)
+        val = np.zeros((r, cell_cap), np.int32)
+        clk = np.zeros((r, cell_cap, na), np.uint32)
+        valid = np.zeros((r, cell_cap), bool)
+        d = deferred_cap
+        dcl = np.zeros((r, d, na), np.uint32)
+        kidx = np.full((r, d, rm_width), -1, np.int32)
+        dvalid = np.zeros((r, d), bool)
+        kd = key_deferred_cap
+        kcl = np.zeros((r, kd, na), np.uint32)
+        kkidx = np.full((r, kd, key_rm_width), -1, np.int32)
+        kdvalid = np.zeros((r, kd), bool)
+        for i, p in enumerate(pures):
+            for actor, c in p.clock.dots.items():
+                top[i, actors.id_of(actor)] = c
+            cells = []
+            inner: dict = {}
+            for k1, child in p.entries.items():
+                k1i = keys1.id_of(k1)
+                for k2, reg in child.entries.items():
+                    flat = k1i * span + keys2.id_of(k2)
+                    for dd, (clock, v) in reg.vals.items():
+                        cells.append(
+                            (flat, actors.id_of(dd.actor), dd.counter,
+                             clock, v)
+                        )
+                for clock, k2s in child.deferred.items():
+                    inner.setdefault(clock, set()).update(
+                        k1i * span + keys2.id_of(k2) for k2 in k2s
+                    )
+            if len(cells) > cell_cap:
+                raise DotCapacityOverflow(
+                    f"replica {i}: {len(cells)} live cells > cap {cell_cap}"
+                )
+            for s, (ki, ai, c, clock, v) in enumerate(
+                sorted(cells, key=lambda t: (t[0], t[1]))
+            ):
+                kid[i, s], act[i, s], ctr[i, s] = ki, ai, c
+                val[i, s] = values.id_of(v)
+                for actor, cc in clock.dots.items():
+                    clk[i, s, actors.id_of(actor)] = cc
+                valid[i, s] = True
+            if len(inner) > d:
+                raise DeferredOverflow(
+                    f"replica {i}: {len(inner)} inner parked removes > {d}"
+                )
+            for s, (clock, flats) in enumerate(inner.items()):
+                for actor, cc in clock.dots.items():
+                    dcl[i, s, actors.id_of(actor)] = cc
+                ids = sorted(flats)
+                if len(ids) > rm_width:
+                    raise DeferredOverflow(
+                        f"replica {i}: inner parked list of {len(ids)} "
+                        f"cells > rm_width {rm_width}"
+                    )
+                kidx[i, s, : len(ids)] = ids
+                dvalid[i, s] = True
+            if len(p.deferred) > kd:
+                raise DeferredOverflow(
+                    f"replica {i}: {len(p.deferred)} outer parked removes "
+                    f"> {kd}"
+                )
+            for s, (clock, k1s) in enumerate(p.deferred.items()):
+                for actor, cc in clock.dots.items():
+                    kcl[i, s, actors.id_of(actor)] = cc
+                ids = sorted(keys1.id_of(k1) for k1 in k1s)
+                if len(ids) > key_rm_width:
+                    raise DeferredOverflow(
+                        f"replica {i}: outer parked list of {len(ids)} "
+                        f"keys > key_rm_width {key_rm_width}"
+                    )
+                kkidx[i, s, : len(ids)] = ids
+                kdvalid[i, s] = True
+
+        out.state = out.state._replace(
+            core=smv.SparseMVMapState(
+                top=jnp.asarray(top), kid=jnp.asarray(kid),
+                act=jnp.asarray(act), ctr=jnp.asarray(ctr),
+                val=jnp.asarray(val), clk=jnp.asarray(clk),
+                valid=jnp.asarray(valid), dcl=jnp.asarray(dcl),
+                kidx=jnp.asarray(kidx), dvalid=jnp.asarray(dvalid),
+            ),
+            kcl=jnp.asarray(kcl),
+            kidx=jnp.asarray(kkidx),
+            kdvalid=jnp.asarray(kdvalid),
+        )
+        return out
+
+    def _row(self, arrs, i: int):
+        return jax.tree.map(lambda x: x[i], arrs)
+
+    def to_pure(self, i: int) -> Map:
+        st = jax.device_get(self._row(self.state, i))
+        span = self.span
+        out = Map(lambda: Map(MVReg))
+        out.clock = VClock(
+            {self.actors[a]: int(c)
+             for a, c in enumerate(st.core.top) if c > 0}
+        )
+        for s in np.nonzero(st.core.valid)[0]:
+            flat = int(st.core.kid[s])
+            k1, k2 = self.keys1[flat // span], self.keys2[flat % span]
+            dot = Dot(self.actors[int(st.core.act[s])], int(st.core.ctr[s]))
+            clock = VClock(
+                {self.actors[a]: int(c)
+                 for a, c in enumerate(st.core.clk[s]) if c > 0}
+            )
+            child = out.entries.get(k1)
+            if child is None:
+                child = Map(MVReg)
+                child.clock = out.clock.clone()
+                out.entries[k1] = child
+            child.entries.setdefault(k2, MVReg())
+            child.entries[k2].vals[dot] = (
+                clock, self.values[int(st.core.val[s])]
+            )
+        # Inner parked removes: split each shared slot back per k1.
+        for s in np.nonzero(st.core.dvalid)[0]:
+            clock = VClock(
+                {self.actors[a]: int(c)
+                 for a, c in enumerate(st.core.dcl[s]) if c > 0}
+            )
+            per_k1: dict = {}
+            for flat in st.core.kidx[s]:
+                if flat >= 0:
+                    per_k1.setdefault(int(flat) // span, set()).add(
+                        self.keys2[int(flat) % span]
+                    )
+            for k1i, k2s in per_k1.items():
+                child = out.entries.get(self.keys1[k1i])
+                if child is None:
+                    continue  # scrubbed dead key (oracle dropped it too)
+                child.deferred.setdefault(clock.clone(), set()).update(k2s)
+        for s in np.nonzero(st.kdvalid)[0]:
+            clock = VClock(
+                {self.actors[a]: int(c)
+                 for a, c in enumerate(st.kcl[s]) if c > 0}
+            )
+            out.deferred[clock] = {
+                self.keys1[int(k)] for k in st.kidx[s] if k >= 0
+            }
+        return out
+
+    def _k2_id(self, k2) -> int:
+        k2i = self.keys2.intern(k2)
+        if k2i >= self.span:
+            raise ValueError(
+                f"inner key universe exceeded the span {self.span}"
+            )
+        return k2i
+
+    # ---- op path (CmRDT) ----------------------------------------------
+    @transactional_apply("keys1", "keys2", "actors", "values")
+    def apply(self, replica: int, op) -> None:
+        """Apply an oracle-shaped op to one replica (reference:
+        src/map.rs ``CmRDT::apply`` routing nested map ops)."""
+        if isinstance(op, Nop):
+            return
+        row = self._row(self.state, replica)
+        na = self.state.core.top.shape[-1]
+        if isinstance(op, Up):
+            strict_validate_dot(
+                row.core.top, self.actors, op.dot.actor, op.dot.counter
+            )
+            aid = self.actors.bounded_intern(op.dot.actor, na, "actor")
+            k1i = self.keys1.bounded_intern(op.key, self.n_keys1, "outer key")
+            inner = op.op
+            if isinstance(inner, Up):
+                if inner.dot != op.dot:
+                    raise ValueError(
+                        "inner Up dot must equal the outer Up dot"
+                    )
+                if not isinstance(inner.op, Put):
+                    raise TypeError(
+                        f"innermost op must be an MVReg Put, got {inner.op!r}"
+                    )
+                flat = k1i * self.span + self._k2_id(inner.key)
+                cl = clock_lanes(inner.op.clock, self.actors, na)
+                row, overflow = smv.nest_apply_up_put(
+                    self.level, row,
+                    jnp.asarray(aid),
+                    jnp.asarray(np.uint32(op.dot.counter)),
+                    jnp.asarray(flat),
+                    jnp.asarray(cl),
+                    jnp.asarray(self.values.intern(inner.op.val)),
+                )
+                if bool(overflow):
+                    raise DotCapacityOverflow(
+                        f"replica {replica}: cell_cap {self.cell_cap} "
+                        f"exceeded"
+                    )
+            elif isinstance(inner, MapRm):
+                cl = clock_lanes(inner.clock, self.actors, na)
+                ids = pad_id_list(
+                    (k1i * self.span + self._k2_id(k2)
+                     for k2 in inner.keyset),
+                    width=self.state.core.kidx.shape[-1],
+                )
+                row, overflow = self.level.apply_up_rm(
+                    row, jnp.asarray(aid),
+                    jnp.asarray(np.uint32(op.dot.counter)),
+                    jnp.asarray(cl), jnp.asarray(ids), levels_down=1,
+                )
+                if bool(overflow):
+                    raise DeferredOverflow(
+                        f"replica {replica}: inner deferred buffer full"
+                    )
+            else:
+                raise TypeError(f"routes Map ops only, got {inner!r}")
+        elif isinstance(op, MapRm):
+            cl = clock_lanes(op.clock, self.actors, na)
+            ids = pad_id_list(
+                (self.keys1.bounded_intern(k1, self.n_keys1, "outer key")
+                 for k1 in op.keyset),
+                width=self.state.kidx.shape[-1],
+            )
+            row, overflow = self.level.rm_parked(
+                row, jnp.asarray(cl), jnp.asarray(ids)
+            )
+            if bool(overflow):
+                raise DeferredOverflow(
+                    f"replica {replica}: outer deferred buffer full"
+                )
+        else:
+            raise TypeError(f"not a Map op: {op!r}")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[replica].set(r), self.state, row
+        )
+
+    # ---- state path (CvRDT) -------------------------------------------
+    def _check_flags(self, flags, what: str) -> None:
+        cells, leaf_d, siblings, outer_d = (bool(x) for x in flags)
+        if cells:
+            raise DotCapacityOverflow(
+                f"{what}: cell table full — rebuild with a larger cell_cap"
+            )
+        if siblings:
+            raise SlotOverflow(
+                f"{what}: a key exceeds sibling_cap concurrent writers"
+            )
+        if leaf_d or outer_d:
+            raise DeferredOverflow(
+                f"{what}: {'inner' if leaf_d else 'outer'} deferred buffer "
+                f"full — rebuild with a larger capacity"
+            )
+
+    def merge_from(self, dst: int, src: int) -> None:
+        metrics.count("sparse_nested_map.merges")
+        joined, flags = self.level.join(
+            self._row(self.state, dst), self._row(self.state, src)
+        )
+        self._check_flags(flags, f"merge {src}->{dst}")
+        self.state = jax.tree.map(
+            lambda full, r: full.at[dst].set(r), self.state, joined
+        )
+
+    def fold(self) -> Map:
+        """Full-mesh anti-entropy: join all replicas, return the
+        converged oracle-form state."""
+        metrics.count("sparse_nested_map.merges", max(self.n_replicas - 1, 0))
+        observe_depth("sparse_nested_map", self.state)
+        folded, flags = self.level.fold(self.state)
+        self._check_flags(flags, "fold")
+        tmp = BatchedSparseNestedMap(
+            1, self.span, self.cell_cap, self.state.core.top.shape[-1],
+            self.sibling_cap, self.state.core.dcl.shape[-2],
+            self.state.core.kidx.shape[-1], self.state.kcl.shape[-2],
+            self.state.kidx.shape[-1],
+            keys1=self.keys1, keys2=self.keys2, actors=self.actors,
+            values=self.values,
+        )
+        tmp.state = jax.tree.map(lambda x: x[None], folded)
+        return tmp.to_pure(0)
+
+    def nbytes(self) -> int:
+        return sum(x.nbytes for x in jax.tree.leaves(self.state))

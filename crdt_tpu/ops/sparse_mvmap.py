@@ -394,3 +394,141 @@ def fold(states: SparseMVMapState, sibling_cap: int = 4):
 
 def nbytes(state: SparseMVMapState) -> int:
     return sum(x.nbytes for x in state)
+
+
+# ---- the nesting protocol adapter (sparse Map<K1, Map<K2, MVReg>>) -------
+
+class SparseMVMapLeaf:
+    """Protocol adapter: the register-map cell table as the innermost
+    level of the sparse nesting induction (ops/sparse_nest.py
+    ``SparseNestLevel`` — the list-flavored ``NestLevel``). Its ids are
+    FLAT key ids (outer key · span + inner key under the causal
+    composition rule), and its own ``kidx`` buffer holds the inner map
+    level's parked keyset-removes — exactly how the dense ``MAP_MVREG``
+    leaf nests (ops/nest.py). ``SparseNestLevel(SparseMVMapLeaf(s), K2)``
+    is therefore the sparse ``Map<K1, Map<K2, MVReg>>``; reference:
+    src/map.rs nested Val composition (SURVEY §3 r11)."""
+
+    span = 1
+
+    def __init__(self, sibling_cap: int = 4):
+        self.sibling_cap = sibling_cap
+
+    def leaf(self, s: SparseMVMapState) -> SparseMVMapState:
+        return s
+
+    def top(self, s):
+        return s.top
+
+    def witness(self, s, actor, counter):
+        return s._replace(
+            top=s.top.at[..., actor].max(counter.astype(s.top.dtype))
+        )
+
+    def join(self, a, b, element_axis=None):
+        return join(a, b, sibling_cap=self.sibling_cap)
+
+    def replay_keylist(self, s, kcl, kidx, kdvalid, span: int):
+        """Kill cells whose level-key (kid // span) a valid parked slot
+        lists with a clock covering the cell's dot; payload dies with
+        the cell (canonical zeroing)."""
+        key_of = jnp.where(s.valid, s.kid // span, -2)
+        listed = jnp.any(
+            key_of[..., None, :, None] == kidx[..., :, None, :], axis=-1
+        )  # [..., D, C]
+        cl_at = jnp.take_along_axis(
+            kcl, jnp.broadcast_to(s.act[..., None, :], listed.shape), axis=-1
+        )
+        covered = listed & (s.ctr[..., None, :] <= cl_at) & kdvalid[..., None]
+        valid = s.valid & ~jnp.any(covered, axis=-2)
+        kid, act, ctr, val, clk, valid, _ = _canon(
+            s.kid, s.act, s.ctr, s.val, s.clk, valid, s.kid.shape[-1]
+        )
+        return s._replace(
+            kid=kid, act=act, ctr=ctr, val=val, clk=clk, valid=valid
+        )
+
+    def scrub_enclosing(self, s, span: int, element_axis=None):
+        """Drop parked inner-keyset entries whose enclosing span-key is
+        dead (a bottomed child dies WITH its parked state); emptied
+        slots die."""
+        from .sparse_nest import _canon_rmlist, _ids_alive
+
+        entry_key = jnp.where(s.kidx >= 0, s.kidx // span, -1)
+        alive = _ids_alive(s, entry_key, span, element_axis)
+        kidx = _canon_rmlist(jnp.where(alive, s.kidx, -1))
+        dvalid = s.dvalid & jnp.any(kidx >= 0, axis=-1)
+        return s._replace(
+            kidx=jnp.where(dvalid[..., None], kidx, -1),
+            dcl=jnp.where(dvalid[..., None], s.dcl, 0),
+            dvalid=dvalid,
+        )
+
+    def scrub_self(self, s, element_axis=None):
+        return s  # a register cell holds nothing inside it
+
+    def settle_self(self, s, element_axis=None):
+        """Replay the table's own parked keyset-removes under the (maybe
+        advanced) top, drop caught-up slots."""
+        valid = _replay_parked(
+            s.kid, s.act, s.ctr, s.valid, s.dcl, s.kidx, s.dvalid
+        )
+        still = ~jnp.all(s.dcl <= s.top[..., None, :], axis=-1)
+        kid, act, ctr, val, clk, valid, _ = _canon(
+            s.kid, s.act, s.ctr, s.val, s.clk, valid, s.kid.shape[-1]
+        )
+        return s._replace(
+            kid=kid, act=act, ctr=ctr, val=val, clk=clk, valid=valid,
+            dvalid=s.dvalid & still,
+        )
+
+    def rm_route(self, s, levels_down: int, rm_clock, ids):
+        assert levels_down == 0, "leaf cannot route deeper"
+        return apply_rm(s, rm_clock, ids)
+
+
+def level_map_mvreg(span: int, sibling_cap: int = 4):
+    """The sparse ``Map<K1, Map<K2, MVReg>>`` level: one nesting step
+    around the register-map cell table. ``span`` = the inner key
+    universe width K2 (flat kid = k1·span + k2)."""
+    from .sparse_nest import SparseNestLevel
+
+    return SparseNestLevel(SparseMVMapLeaf(sibling_cap), span)
+
+
+def empty_map_mvreg(
+    span: int,
+    cell_cap: int,
+    n_actors: int,
+    deferred_cap: int = 4,
+    rm_width: int = 8,
+    key_deferred_cap: int = 4,
+    key_rm_width: int = 8,
+    sibling_cap: int = 4,
+    batch: tuple = (),
+):
+    """(level, state) for an empty sparse ``Map<K1, Map<K2, MVReg>>``."""
+    level = level_map_mvreg(span, sibling_cap)
+    state = level.empty(
+        empty(cell_cap, n_actors, deferred_cap, rm_width, batch=batch),
+        n_actors, key_deferred_cap, key_rm_width, batch=batch,
+    )
+    return level, state
+
+
+def nest_apply_up_put(level, s, wact, wctr, kid_flat, clock, val):
+    """``Op::Up { dot, k1, Up { k2, Put } }`` for the nested flavor —
+    the put lands in the leaf cell table at the FLAT key id (the leaf
+    applier witnesses the shared top and replays its own buffer), then
+    every outer level settles. Seen dots are full no-ops."""
+    from .sparse_nest import _graft_leaf
+
+    wctr = jnp.asarray(wctr).astype(level.top(s).dtype)
+    seen = level.top(s)[..., wact] >= wctr
+    new_leaf, overflow = apply_up(level.leaf(s), wact, wctr, kid_flat, clock, val)
+    out = level.settle_self(_graft_leaf(level, s, new_leaf))
+    keep = lambda old, new: jnp.where(
+        seen.reshape(seen.shape + (1,) * (new.ndim - seen.ndim)), old, new
+    )
+    out = jax.tree.map(keep, s, out)
+    return out, overflow & ~seen

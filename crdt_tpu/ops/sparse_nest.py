@@ -80,10 +80,14 @@ def _bsearch_count(key: jax.Array, lo: jax.Array, hi: jax.Array) -> jax.Array:
     return jnp.searchsorted(key, hi) - jnp.searchsorted(key, lo)
 
 
-def _sorted_key(leaf: SparseOrswotState) -> jax.Array:
+def _sorted_key(leaf) -> jax.Array:
     """The leaf table's ascending search key (invalid lanes sort last —
-    canonical order guarantees the valid prefix is eid-ascending)."""
-    return jnp.where(leaf.valid, leaf.eid, _INT32_MAX)
+    canonical order guarantees the valid prefix is id-ascending). Works
+    for any leaf slab whose first id plane is canonically sorted: the
+    ORSWOT segment table (``eid``) and the sparse register-map cell
+    table (``kid``, ops/sparse_mvmap.py)."""
+    ids = leaf.eid if hasattr(leaf, "eid") else leaf.kid
+    return jnp.where(leaf.valid, ids, _INT32_MAX)
 
 
 def _ids_alive(
@@ -418,7 +422,7 @@ class SparseNestLevel:
 
 def _graft_leaf(level, s, new_leaf):
     """Rebuild the nest state with a replaced leaf slab."""
-    if isinstance(level.core, SparseLeaf):
+    if not isinstance(level.core, SparseNestLevel):  # any leaf adapter
         return level._make(new_leaf, *level._bufs(s))
     inner = _graft_leaf(level.core, s[0], new_leaf)
     return level._make(inner, *level._bufs(s))
@@ -433,6 +437,11 @@ def _sparse_identity_like(identity):
                 didx=jnp.full_like(node.didx, -1),
             )
         if isinstance(node, tuple) and hasattr(node, "_fields"):
+            if "kid" in node._fields:  # sparse register-map cell table
+                return node._replace(
+                    kid=jnp.full_like(node.kid, -1),
+                    kidx=jnp.full_like(node.kidx, -1),
+                )
             fixed = fix(node[0])
             return type(node)(
                 fixed, node[1], jnp.full_like(node[2], -1), node[3]

@@ -62,6 +62,7 @@ from .anti_entropy import (
     mesh_fold_nested_map,
     mesh_fold_sparse,
     mesh_fold_sparse_mvmap,
+    mesh_fold_sparse_nested,
     mesh_gossip_sparse_mvmap,
     mesh_gossip,
     mesh_gossip_sparse,
@@ -144,6 +145,7 @@ __all__ = [
     "mesh_fold_mvreg",
     "mesh_fold_sparse_map",
     "mesh_fold_sparse_mvmap",
+    "mesh_fold_sparse_nested",
     "mesh_gossip_sparse_mvmap",
     "mesh_fold_sparse_sharded",
     "split_nested",

@@ -534,6 +534,44 @@ def mesh_gossip_sparse_mvmap(
     )
 
 
+def mesh_fold_sparse_nested(states, mesh: Mesh, level):
+    """Converge a SPARSE nested-map replica batch (any
+    ``sparse_nest.SparseNestLevel`` composition — e.g. the
+    ``Map<K1, Map<K2, MVReg>>`` of ops/sparse_mvmap.level_map_mvreg)
+    over the mesh's replica axis, state replicated across the element
+    axis. ``level`` carries the join/fold (and their static caps).
+    Returns ``(state, flags[L+1])``."""
+    rsize = mesh.shape[REPLICA_AXIS]
+    pad_r = (-jax.tree.leaves(states)[0].shape[0]) % rsize
+    if pad_r:
+        from ..ops.sparse_nest import _sparse_identity_like
+
+        identity = jax.tree.map(
+            lambda x: jnp.zeros((pad_r, *x.shape[1:]), x.dtype), states
+        )
+        identity = _sparse_identity_like(identity)
+        states = jax.tree.map(
+            lambda s, p: jnp.concatenate([s, p], axis=0), states, identity
+        )
+    template = jax.tree.map(lambda x: x[0], states)
+    # Cache key from the level's static shape/caps (an id() key could be
+    # reused after GC and resurrect a closure with the wrong caps).
+    spans, core = [], level
+    while hasattr(core, "core"):
+        spans.append(str(core.span))
+        core = core.core
+    kind = (
+        f"sparse_nested_fold_{'x'.join(spans)}"
+        f"_s{getattr(core, 'sibling_cap', 0)}"
+    )
+    return _mesh_fold_lattice(
+        kind, states, mesh,
+        level.join, level.fold,
+        jax.tree.map(lambda _: P(REPLICA_AXIS), template),
+        jax.tree.map(lambda _: P(), template),
+    )
+
+
 def mesh_gossip_sparse(
     states, mesh: Mesh, rounds: Optional[int] = None
 ):

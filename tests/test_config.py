@@ -26,7 +26,7 @@ def test_backend_selects_execution_path():
 def test_all_kinds_construct_under_both_backends():
     kinds = [
         "orswot", "sparse_orswot", "map", "map_orswot", "map_map", "map3",
-        "sparse_map_orswot", "sparse_map",
+        "sparse_map_orswot", "sparse_map", "sparse_map_map",
         "gcounter", "pncounter", "gset", "lwwreg", "mvreg",
     ]
     with configured(backend="pure"):

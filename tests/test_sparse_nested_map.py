@@ -1,0 +1,200 @@
+"""Segment-encoded ``Map<K1, Map<K2, MVReg>>`` vs the oracle AND the
+dense nested slab — the A/B gates for the sparse map_map flavor
+(reference: src/map.rs nested ``V: Val<A>`` composition, SURVEY §3 r11
+at huge key universes on BOTH levels)."""
+
+import random
+
+import pytest
+from hypothesis import given, settings
+
+from crdt_tpu import Map, MVReg
+from crdt_tpu.models import BatchedNestedMap, BatchedSparseNestedMap
+from crdt_tpu.models.orswot import DeferredOverflow
+from crdt_tpu.utils import Interner
+
+from strategies import ACTORS, seeds
+from test_models_map_nested import (
+    _site_run_nested,
+    ndrop1,
+    ndrop2,
+    nested_map,
+    nput,
+)
+
+CAPS = dict(
+    span=64, cell_cap=64, sibling_cap=8, deferred_cap=12, rm_width=16,
+    key_deferred_cap=12, key_rm_width=8,
+)
+
+
+def _batched(states):
+    return BatchedSparseNestedMap.from_pure(states, **CAPS)
+
+
+@given(seeds)
+@settings(max_examples=12, deadline=None)
+def test_roundtrip_lossless(seed):
+    rng = random.Random(seed)
+    states = _site_run_nested(rng)
+    batched = _batched(states)
+    for i, s in enumerate(states):
+        assert batched.to_pure(i) == s, f"replica {i}"
+
+
+@pytest.mark.smoke
+@given(seeds)
+@settings(max_examples=12, deadline=None)
+def test_join_bit_identical_to_oracle_merge(seed):
+    rng = random.Random(seed)
+    states = _site_run_nested(rng)
+    batched = _batched(states)
+
+    expect = states[0].clone()
+    expect.merge(states[1].clone())
+    batched.merge_from(0, 1)
+    assert batched.to_pure(0) == expect
+    assert batched.to_pure(2) == states[2]
+
+
+@given(seeds)
+@settings(max_examples=12, deadline=None)
+def test_fold_bit_identical_to_oracle_fold(seed):
+    rng = random.Random(seed)
+    states = _site_run_nested(rng)
+    batched = _batched(states)
+
+    expect = states[0].clone()
+    for s in states[1:]:
+        expect.merge(s.clone())
+    assert batched.fold() == expect
+
+
+@given(seeds)
+@settings(max_examples=8, deadline=None)
+def test_op_path_bit_identical(seed):
+    rng = random.Random(seed)
+    batched = BatchedSparseNestedMap(3, n_actors=6, **CAPS)
+    oracles = [nested_map() for _ in range(3)]
+    sites = [nested_map() for _ in range(3)]
+    ops = []
+    for step in range(12):
+        i = rng.randrange(3)
+        site = sites[i]
+        roll = rng.random()
+        k1, k2 = rng.choice("pq"), rng.choice("xyz")
+        if roll < 0.5:
+            ops.append(nput(site, ACTORS[i], k1, k2, rng.randrange(5)))
+        elif roll < 0.75:
+            ops.append(ndrop2(site, ACTORS[i], k1, k2))
+        else:
+            ops.append(ndrop1(site, k1))
+    for dst in range(3):
+        for op in ops:
+            oracles[dst].apply(op)
+            batched.apply(dst, op)
+        assert batched.to_pure(dst) == oracles[dst], f"replica {dst}"
+
+
+@given(seeds)
+@settings(max_examples=8, deadline=None)
+def test_sparse_matches_dense_model(seed):
+    """Sparse and dense nested backends agree through to_pure on the
+    same site run — merge and fold."""
+    rng = random.Random(seed)
+    states = _site_run_nested(rng)
+    dense = BatchedNestedMap.from_pure(
+        [s.clone() for s in states],
+        keys1=Interner("pq"), keys2=Interner("xyz"),
+        actors=Interner(ACTORS + ["A", "B", "C"]),
+        sibling_cap=8, deferred_cap=12,
+    )
+    sparse = _batched(states)
+
+    dense.merge_from(0, 1)
+    sparse.merge_from(0, 1)
+    assert dense.to_pure(0) == sparse.to_pure(0)
+    assert dense.fold() == sparse.fold()
+
+
+def test_huge_universes_stay_small():
+    """Both key levels are virtual: 30k outer x 64k inner key ids cost
+    only live-cell state."""
+    m = nested_map()
+    nput(m, "A", "doc-29999", "field-60000", 7)
+    nput(m, "B", "doc-1", "field-2", 9)
+    batched = BatchedSparseNestedMap.from_pure(
+        [m], span=1 << 16, cell_cap=8, sibling_cap=4,
+    )
+    assert batched.to_pure(0) == m
+    assert batched.nbytes() < 8192, batched.nbytes()
+
+
+def test_dead_outer_key_drops_inner_parked_state():
+    """A bottomed child dies WITH its parked inner removes (the
+    oracle's is_bottom drop) — the leaf scrub keyed on kid // span."""
+    a, b = nested_map(), nested_map()
+    nput(a, "A", "p", "x", 1)
+    # b parks an inner remove for ("p","x") it has not seen adds for
+    op = ndrop2(a, "A", "p", "x")  # on a: applied; clock now ahead for b
+    nput(a, "A", "p", "y", 2)
+    b.apply(op)
+    batched = _batched([a, b])
+    for i, s in enumerate((a, b)):
+        assert batched.to_pure(i) == s
+
+    # outer-remove p on a converged state: child + its parked state die
+    merged = a.clone()
+    merged.merge(b.clone())
+    batched.merge_from(0, 1)
+    assert batched.to_pure(0) == merged
+    rm = ndrop1(merged, "p")
+    batched.apply(0, rm)
+    assert batched.to_pure(0) == merged
+
+
+def test_checkpoint_round_trip(tmp_path):
+    from crdt_tpu import checkpoint
+
+    states = _site_run_nested(random.Random(7))
+    batched = _batched(states)
+    p = tmp_path / "sparse_map_map.npz"
+    checkpoint.save(p, batched)
+    loaded = checkpoint.load(p)
+    assert type(loaded).__name__ == "BatchedSparseNestedMap"
+    for i, s in enumerate(states):
+        assert loaded.to_pure(i) == s
+    assert loaded.span == batched.span
+    assert loaded.sibling_cap == batched.sibling_cap
+
+
+def test_factory_kind():
+    from crdt_tpu.config import configured, replicaset
+
+    m = nested_map()
+    op = nput(m, "A", "p", "x", 3)
+    with configured(backend="xla"):
+        rs = replicaset("sparse_map_map", n_replicas=2, n_actors=4)
+        rs.apply(0, op)
+        assert rs.to_pure(0) == m
+        assert rs.to_pure(1) == nested_map()
+
+
+def test_mesh_fold_matches_host_fold():
+    """8-virtual-device replica-axis fold == the host level fold."""
+    import jax
+
+    from crdt_tpu.parallel import make_mesh, mesh_fold_sparse_nested
+
+    states = _site_run_nested(random.Random(21))
+    batched = _batched(states)
+    expect = batched.fold()
+
+    mesh = make_mesh(len(jax.devices()), 1)
+    folded, flags = mesh_fold_sparse_nested(
+        batched.state, mesh, batched.level
+    )
+    assert not bool(flags.any())
+    tmp = _batched(states)
+    tmp.state = jax.tree.map(lambda x: x[None], folded)
+    assert tmp.to_pure(0) == expect
