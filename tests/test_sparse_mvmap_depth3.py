@@ -1,0 +1,156 @@
+"""Depth-3 sparse ``Map<K1, Map<K2, Map<K3, MVReg>>>`` — the gate that
+the register-map leaf COMPOSES through the sparse nesting induction the
+same way the orswot leaf does (tests/test_sparse_nest3.py): depth 3 is
+built by wrapping ``SparseNestLevel`` around the depth-2 level with NO
+new ops module. Oracle A/B at depth 2 lives in
+tests/test_sparse_nested_map.py; the new surface at depth 3 is the
+composition, gated here by the lattice laws and exact convergence on
+op-built divergent replicas (flat kid = ((k1·K2 + k2)·K3 + k3))."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings
+
+from crdt_tpu.ops import sparse_mvmap as smv
+from crdt_tpu.ops import sparse_nest as nest
+
+from strategies import seeds
+
+K2, K3, A = 3, 4, 4
+SIB = 4
+RM_WIDTH = 16
+LEVEL2 = nest.SparseNestLevel(smv.SparseMVMapLeaf(SIB), K3)       # K2 level
+LEVEL3 = nest.SparseNestLevel(LEVEL2, K2 * K3)                    # K1 level
+
+
+def empty3():
+    leaf = smv.empty(32, A, deferred_cap=6, rm_width=RM_WIDTH)
+    mid = LEVEL2.empty(leaf, A, 6, RM_WIDTH)
+    return LEVEL3.empty(mid, A, 6, RM_WIDTH)
+
+
+def _flat(k1, k2, k3):
+    return (k1 * K2 + k2) * K3 + k3
+
+
+def _rand_state(rng, actor):
+    """One replica built through the composed level's own op appliers:
+    causally-minted puts and routed removes at every depth."""
+    s = empty3()
+    ctr = 0
+    for _ in range(rng.randrange(3, 8)):
+        ctr += 1
+        k1, k2, k3 = rng.randrange(2), rng.randrange(K2), rng.randrange(K3)
+        roll = rng.random()
+        if roll < 0.6:
+            clock = jnp.zeros((A,), jnp.uint32).at[actor].set(ctr)
+            s, of = smv.nest_apply_up_put(
+                LEVEL3, s, jnp.asarray(actor),
+                jnp.asarray(ctr, jnp.uint32),
+                jnp.asarray(_flat(k1, k2, k3)),
+                clock, jnp.asarray(100 + ctr),
+            )
+        else:
+            # dot-witnessed keyset remove, routed to a random depth:
+            # 0 = K1 buffer (k1 ids), 1 = K2 buffer (k1*K2+k2 ids),
+            # 2 = leaf buffer (flat cell ids)
+            depth = rng.randrange(3)
+            ids = {
+                0: [k1],
+                1: [k1 * K2 + k2],
+                2: [_flat(k1, k2, k3)],
+            }[depth]
+            rm_clock = LEVEL3.top(s)  # covers own history
+            idsv = np.full((RM_WIDTH,), -1, np.int32)
+            idsv[: len(ids)] = ids
+            s, of = LEVEL3.apply_up_rm(
+                s, jnp.asarray(actor), jnp.asarray(ctr, jnp.uint32),
+                rm_clock, jnp.asarray(idsv), levels_down=depth,
+            )
+        assert not bool(jnp.asarray(of).any())
+    return s
+
+
+def _eq(a, b) -> bool:
+    return all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+@given(seeds)
+@settings(max_examples=10, deadline=None)
+def test_depth3_join_laws(seed):
+    rng = random.Random(seed)
+    a, b, c = (_rand_state(rng, i) for i in range(3))
+
+    ab, f1 = LEVEL3.join(a, b)
+    ba, f2 = LEVEL3.join(b, a)
+    assert _eq(ab, ba), "join not commutative at depth 3"
+    assert bool(jnp.array_equal(f1, f2))
+
+    abc1, _ = LEVEL3.join(ab, c)
+    bc, _ = LEVEL3.join(b, c)
+    abc2, _ = LEVEL3.join(a, bc)
+    assert _eq(abc1, abc2), "join not associative at depth 3"
+
+    again, _ = LEVEL3.join(abc1, abc1)
+    assert _eq(again, abc1), "join not idempotent at depth 3"
+
+
+@given(seeds)
+@settings(max_examples=10, deadline=None)
+def test_depth3_fold_equals_sequential_joins(seed):
+    rng = random.Random(seed)
+    states = [_rand_state(rng, i % A) for i in range(4)]
+    batched = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+    folded, flags = LEVEL3.fold(batched)
+    assert not bool(jnp.asarray(flags).any())
+
+    acc = states[0]
+    for s in states[1:]:
+        acc, _ = LEVEL3.join(acc, s)
+    assert _eq(folded, acc), "depth-3 fold != sequential joins"
+
+
+def test_depth3_routed_remove_hits_the_right_buffer():
+    """A remove with a clock AHEAD of the local top parks at exactly the
+    routed level. The enclosing keys must be LIVE — a parked remove
+    under a bottomed child is scrubbed immediately (the oracle drops a
+    dead child WITH its parked state; test_sparse_nested_map.py gates
+    that path)."""
+    s = empty3()
+    # Live cells keeping every targeted enclosing key alive: flat 0
+    # (k1=0 group) and flat(1,0,0) (k1=1 group).
+    for ctr, flat in ((1, _flat(0, 0, 0)), (2, _flat(1, 0, 0))):
+        clock = jnp.zeros((A,), jnp.uint32).at[0].set(ctr)
+        s, of = smv.nest_apply_up_put(
+            LEVEL3, s, jnp.asarray(0), jnp.asarray(ctr, jnp.uint32),
+            jnp.asarray(flat), clock, jnp.asarray(7),
+        )
+        assert not bool(jnp.asarray(of).any())
+
+    ahead = jnp.full((A,), 9, jnp.uint32)
+    ids = np.full((RM_WIDTH,), -1, np.int32)
+    ids[0] = 1  # k1=1 / mid-key (0,1) / flat (0,0,1) — enclosed by k1=0
+    for depth, bufs in ((0, lambda st: st[3]),
+                        (1, lambda st: st[0][3]),
+                        (2, lambda st: st[0][0].dvalid)):
+        out, of = LEVEL3.apply_up_rm(
+            s, jnp.asarray(0), jnp.asarray(3, jnp.uint32),
+            ahead, jnp.asarray(ids), levels_down=depth,
+        )
+        assert not bool(jnp.asarray(of).any())
+        assert bool(jnp.asarray(bufs(out)).any()), f"depth {depth} not parked"
+        others = [0, 1, 2]
+        others.remove(depth)
+        for o in others:
+            sel = {0: lambda st: st[3], 1: lambda st: st[0][3],
+                   2: lambda st: st[0][0].dvalid}[o]
+            assert not bool(jnp.asarray(sel(out)).any()), (
+                f"depth-{depth} rm leaked into level {o}"
+            )
