@@ -1,6 +1,6 @@
 """Depth-4 nesting from the combinator + sparse mode for huge universes.
 
-Two round-4 capabilities in one tour:
+Three capabilities in one tour:
 
 1. ``Map<org, Map<team, Map<channel, Orswot<member>>>>`` — FOUR causal
    levels — built by composing ``ops.nest.NestLevel`` around the
@@ -9,6 +9,9 @@ Two round-4 capabilities in one tour:
    nesting).
 2. A presence set over a 1M-member universe in SPARSE mode: state size
    tracks live members, not the universe (``ops/sparse_orswot.py``).
+3. A sparse document store ``Map<doc, Map<field, MVReg>>`` — the
+   register-map family sparse too, virtual universes on BOTH key
+   levels (``ops/sparse_mvmap.py`` under ``SparseNestLevel``).
 
 Run:  JAX_PLATFORMS=cpu python examples/06_deep_nesting_and_sparse.py
 """
@@ -95,9 +98,48 @@ def sparse_presence():
     )
 
 
+def sparse_documents():
+    """The register-map family is sparse too: a document store
+    ``Map<doc, Map<field, MVReg>>`` over virtual universes on BOTH key
+    levels — live-cell-proportional state (ops/sparse_mvmap.py +
+    SparseNestLevel), same oracle, same op surface."""
+    import random
+
+    from crdt_tpu import Map, MVReg
+    from crdt_tpu.models import BatchedSparseNestedMap
+
+    rng = random.Random(6)
+    mk = lambda: Map(lambda: Map(MVReg))
+    sites = [mk() for _ in range(3)]
+    for step in range(30):
+        i = rng.randrange(3)
+        m = sites[i]
+        doc = f"doc-{rng.randrange(1_000_000)}" if rng.random() < 0.4 else "doc-hot"
+        field = rng.choice(["title", "body", "owner"])
+        ctx = m.len().derive_add_ctx(f"site-{i}")
+        op = m.update(doc, ctx, lambda im, c, f=field, v=f"r{step}":
+                      im.update(f, c, lambda reg, c2: reg.write(v, c2)))
+        m.apply(op)
+    model = BatchedSparseNestedMap.from_pure(
+        sites, span=1 << 16, cell_cap=128, sibling_cap=8
+    )
+    expect = sites[0].clone()
+    for site in sites[1:]:
+        expect.merge(site.clone())
+    assert model.fold() == expect
+    hot = expect.entries["doc-hot"]
+    print(
+        f"sparse documents: {len(expect.entries)} live docs over a "
+        f"2^31/A-key product space; {model.nbytes()/1024:.0f} KiB device "
+        f"state; doc-hot holds {len(hot.entries)} fields — converged == "
+        f"oracle"
+    )
+
+
 def main():
     deep_nesting()
     sparse_presence()
+    sparse_documents()
 
 
 if __name__ == "__main__":
