@@ -142,4 +142,45 @@ for got, want in zip(jax.tree.leaves(mresult), jax.tree.leaves(mexpect)):
         np.asarray(got), np.asarray(jax.device_get(want))
     )
 
+# Sparse register-map across processes: the segment-encoded
+# Map<K, MVReg> fold over the same DCN-spanning mesh, bit-identical to
+# the single-device fold (live-cell tables riding the replica-axis
+# all-reduce — per-link traffic proportional to content).
+from crdt_tpu.ops import sparse_mvmap as smv
+from crdt_tpu.parallel import mesh_fold_sparse_mvmap
+from jax.sharding import PartitionSpec as P
+
+SC, SA = 16, 4
+sfull = smv.empty(SC, SA, batch=(R,))
+rows = []
+for i in range(R):
+    # Causal minting: actor i%SA's (i//SA + 1)-th write; overlapping keys.
+    wct = i // SA + 1
+    row = jax.tree.map(lambda x: x[i], sfull)
+    row, s_of = smv.apply_up(
+        row,
+        jnp.asarray(i % SA),
+        jnp.asarray(wct, jnp.uint32),
+        jnp.asarray(40 + i % 3),
+        jnp.zeros((SA,), jnp.uint32).at[i % SA].set(wct),
+        jnp.asarray(900 + i),
+    )
+    assert not bool(s_of)
+    rows.append(row)
+sfull = jax.tree.map(lambda *xs: jnp.stack(xs), *rows)
+sexpect, s_of2 = smv.fold(sfull, sibling_cap=SA)
+assert not bool(np.asarray(s_of2).any())
+
+slocal = jax.tree.map(lambda x: np.asarray(x)[local_rows], sfull)
+sspecs = jax.tree.map(lambda _: P("replica"), sfull)
+sgstate = multihost.host_to_global(slocal, mesh, sspecs)
+sjoined, sm_of = mesh_fold_sparse_mvmap(sgstate, mesh, sibling_cap=SA)
+assert not bool(np.asarray(jax.device_get(sm_of)).any())
+sresult = multihost.global_to_host(sjoined)
+for got, want in zip(jax.tree.leaves(sresult), jax.tree.leaves(sexpect)):
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(jax.device_get(want))
+    )
+print(f"MULTIHOST_SPARSE_OK process={pid}", flush=True)
+
 print(f"MULTIHOST_OK process={pid}", flush=True)

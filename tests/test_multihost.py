@@ -48,6 +48,7 @@ def test_two_process_mesh_fold_bit_identical():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"MULTIHOST_OK process={pid}" in out, out
+        assert f"MULTIHOST_SPARSE_OK process={pid}" in out, out
 
 
 def test_two_process_list_sync():
