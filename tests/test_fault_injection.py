@@ -36,6 +36,30 @@ def _mint_streams(rng, n_sites, n_ops):
     return sites, streams
 
 
+def _faulty_delivery(rng, streams, r_ix):
+    """One receiver's faulty delivery schedule:
+    - DROP a suffix of each foreign stream (prefix delivery is the
+      causal contract);
+    - DUPLICATE random ops (CmRDT apply must be idempotent on dups);
+    - REORDER across sites (interleave streams arbitrarily, each
+      stream's own order preserved)."""
+    plan = []
+    for s_ix, stream in enumerate(streams):
+        if s_ix == r_ix:
+            continue
+        keep = rng.randint(0, len(stream))  # drop a suffix
+        prefix = stream[:keep]
+        dups = [op for op in prefix if rng.random() < 0.3]
+        plan.append(prefix + dups)
+    merged, cursors = [], [0] * len(plan)
+    while any(c < len(p) for c, p in zip(cursors, plan)):
+        choices = [i for i, (c, p) in enumerate(zip(cursors, plan)) if c < len(p)]
+        i = rng.choice(choices)
+        merged.append(plan[i][cursors[i]])
+        cursors[i] += 1
+    return merged
+
+
 @given(seeds)
 @settings(max_examples=15)
 def test_drop_duplicate_reorder_delivery_converges(seed):
@@ -43,30 +67,9 @@ def test_drop_duplicate_reorder_delivery_converges(seed):
     n = 4
     sites, streams = _mint_streams(rng, n, 20)
 
-    # Deliver every stream to every other site with faults injected:
-    # - DROP a suffix (prefix delivery is the causal contract);
-    # - DUPLICATE random ops (CmRDT apply must be idempotent on dups);
-    # - REORDER across sites (interleave streams arbitrarily).
     receivers = [s.clone() for s in sites]
     for r_ix, receiver in enumerate(receivers):
-        plan = []
-        for s_ix, stream in enumerate(streams):
-            if s_ix == r_ix:
-                continue
-            keep = rng.randint(0, len(stream))  # drop a suffix
-            prefix = stream[:keep]
-            # duplicate some ops (delivered again later, in order)
-            dups = [op for op in prefix if rng.random() < 0.3]
-            plan.append(prefix + dups)
-        # interleave the per-site plans preserving each plan's order
-        merged = []
-        cursors = [0] * len(plan)
-        while any(c < len(p) for c, p in zip(cursors, plan)):
-            choices = [i for i, (c, p) in enumerate(zip(cursors, plan)) if c < len(p)]
-            i = rng.choice(choices)
-            merged.append(plan[i][cursors[i]])
-            cursors[i] += 1
-        for op in merged:
+        for op in _faulty_delivery(rng, streams, r_ix):
             receiver.apply(op)
 
     # The partial views differ; full state exchange must still converge.
@@ -171,3 +174,60 @@ def test_reduction_order_invariance_on_device(seed):
         [sites[i] for i in perm], members=members.clone(), actors=actors.clone()
     )
     assert shuffled.fold() == folded
+
+
+@given(seeds)
+@settings(max_examples=10, deadline=None)
+def test_sparse_map_faulty_delivery_converges(seed):
+    """The sparse register map under drop/duplicate/reorder delivery:
+    the device op path absorbs the same faults the oracle does, and
+    state exchange converges both backends to the fault-free join."""
+    from crdt_tpu import MVReg
+    from crdt_tpu.models import BatchedSparseMap
+    from crdt_tpu.pure.map import Map
+
+    rng = random.Random(seed)
+    n = 3
+    KEYS = list("pqr")
+    sites = [Map(MVReg) for _ in range(n)]
+    streams = [[] for _ in range(n)]
+    for step in range(18):
+        i = rng.randrange(n)
+        m = sites[i]
+        k = rng.choice(KEYS)
+        if rng.random() < 0.25 and m.get(k).val is not None:
+            op = m.rm(k, m.get(k).derive_rm_ctx())  # observed remove
+        else:
+            op = m.update(
+                k, m.len().derive_add_ctx(f"s{i}"),
+                lambda r, c, v=f"v{step}": r.write(v, c),
+            )
+        m.apply(op)
+        streams[i].append(op)
+
+    # Faulty delivery to BOTH the oracle clones and the device model.
+    receivers = [s.clone() for s in sites]
+    model = BatchedSparseMap.from_pure(
+        [s.clone() for s in sites], cell_cap=64,
+        sibling_cap=8, deferred_cap=12, n_keys=len(KEYS),
+    )
+    for r_ix in range(n):
+        for op in _faulty_delivery(rng, streams, r_ix):
+            receivers[r_ix].apply(op)
+            model.apply(r_ix, op)
+        assert model.to_pure(r_ix) == receivers[r_ix], (
+            f"device op path diverged from oracle on replica {r_ix}"
+        )
+
+    # Full state exchange converges, and equals the fault-free join.
+    oracle = sites[0].clone()
+    for s in sites[1:]:
+        oracle.merge(s.clone())
+    for dst in range(n):
+        for src in range(n):
+            if src != dst:
+                receivers[dst].merge(receivers[src].clone())
+                model.merge_from(dst, src)
+                assert model.to_pure(dst) == receivers[dst]
+    assert model.to_pure(0) == oracle
+    assert model.fold() == oracle
