@@ -73,7 +73,9 @@ from .anti_entropy import (
 )
 from .sparse_shard import (
     mesh_fold_sparse_map,
+    mesh_fold_sparse_mvmap_sharded,
     mesh_fold_sparse_sharded,
+    split_cells,
     split_nested,
     split_segments,
 )
@@ -145,9 +147,11 @@ __all__ = [
     "mesh_fold_mvreg",
     "mesh_fold_sparse_map",
     "mesh_fold_sparse_mvmap",
+    "mesh_fold_sparse_mvmap_sharded",
     "mesh_fold_sparse_nested",
     "mesh_gossip_sparse_mvmap",
     "mesh_fold_sparse_sharded",
+    "split_cells",
     "split_nested",
     "split_segments",
     "mesh_gossip_map",

@@ -125,15 +125,21 @@ def _pad_replica_axis(state, rsize: int, make_identity):
     )
 
 
-def mesh_fold_sparse_sharded(
-    states: SparseOrswotState, mesh: Mesh
-) -> Tuple[SparseOrswotState, jax.Array]:
-    """Converge an element-SHARDED sparse replica batch ``[R, S, ...]``
-    (from ``split_segments``; S must equal the mesh's element-axis size)
-    over the mesh. Shard-local joins are exact (restriction commutes
-    with join), so the only collective is the replica-axis lattice
-    all-reduce — per-device state and join cost drop by S. Returns
-    ``(state [S, ...], overflow[2])`` with the element axis preserved."""
+def _sharded_fold(
+    kind: str,
+    states,
+    mesh: Mesh,
+    join_fn,
+    fold_fn,
+    identity_fix,
+    cache_extra: tuple = (),
+):
+    """Shared scaffold for every element-sharded mesh fold: replica-axis
+    identity padding, shard-axis check, shard-local fold + replica-axis
+    lattice all-reduce inside shard_map, overflow psum over BOTH axes,
+    metrics. ``identity_fix(tree)`` repairs -1 id-lane conventions on a
+    zeros-built padding batch; ``join_fn``/``fold_fn`` may close over an
+    ``element_axis`` for cross-shard scrubs."""
     s_axis = jax.tree.leaves(states)[0].shape[1]
     if s_axis != mesh.shape[ELEMENT_AXIS]:
         raise ValueError(
@@ -142,12 +148,9 @@ def mesh_fold_sparse_sharded(
         )
     states = _pad_replica_axis(
         states, mesh.shape[REPLICA_AXIS],
-        lambda pad: jax.tree.map(
+        lambda pad: identity_fix(jax.tree.map(
             lambda x: jnp.zeros((pad, *x.shape[1:]), x.dtype), states
-        )._replace(
-            eid=jnp.full((pad, *states.eid.shape[1:]), -1, jnp.int32),
-            didx=jnp.full((pad, *states.didx.shape[1:]), -1, jnp.int32),
-        ),
+        )),
     )
 
     def build():
@@ -158,25 +161,113 @@ def mesh_fold_sparse_sharded(
             out_specs=(_all_specs(states, (ELEMENT_AXIS,)), P()),
             check_vma=False,
         )
-        def fold_fn(local):
+        def fold_fn_mesh(local):
             local = jax.tree.map(lambda x: x[:, 0], local)  # drop shard axis
-            folded, of_local = sp.fold(local)
-            joined, of_cross = _lattice_allreduce(folded, sp.join, sp.fold)
+            folded, of_local = fold_fn(local)
+            joined, of_cross = _lattice_allreduce(folded, join_fn, fold_fn)
             of = (
                 lax.psum(of_local.astype(jnp.int32), REPLICA_AXIS) > 0
             ) | of_cross
             of = lax.psum(of.astype(jnp.int32), ELEMENT_AXIS) > 0
             return jax.tree.map(lambda x: x[None], joined), of
 
-        return fold_fn
+        return fold_fn_mesh
 
-    metrics.count("anti_entropy.sparse_sharded_fold_rounds")
+    metrics.count(f"anti_entropy.{kind}_rounds")
     metrics.observe("anti_entropy.state_bytes", state_nbytes(states))
-    observe_depth("anti_entropy.sparse_sharded_fold", states)
-    with metrics.time("anti_entropy.sparse_sharded_fold"):
-        out = _cached("sparse_sharded_fold", states, mesh, build)(states)
+    observe_depth(f"anti_entropy.{kind}", states)
+    with metrics.time(f"anti_entropy.{kind}"):
+        out = _cached(kind, states, mesh, build, *cache_extra)(states)
         jax.block_until_ready(out)
     return out
+
+
+def mesh_fold_sparse_sharded(
+    states: SparseOrswotState, mesh: Mesh
+) -> Tuple[SparseOrswotState, jax.Array]:
+    """Converge an element-SHARDED sparse replica batch ``[R, S, ...]``
+    (from ``split_segments``; S must equal the mesh's element-axis size)
+    over the mesh. Shard-local joins are exact (restriction commutes
+    with join), so the only collective is the replica-axis lattice
+    all-reduce — per-device state and join cost drop by S. Returns
+    ``(state [S, ...], overflow[2])`` with the element axis preserved."""
+    return _sharded_fold(
+        "sparse_sharded_fold", states, mesh, sp.join, sp.fold,
+        lambda t: t._replace(
+            eid=jnp.full_like(t.eid, -1), didx=jnp.full_like(t.didx, -1)
+        ),
+    )
+
+
+def split_cells(
+    states, n_shards: int, cell_cap: Optional[int] = None
+):
+    """Partition a (batched) sparse ``Map<K, MVReg>`` cell table
+    (ops/sparse_mvmap.SparseMVMapState) by ``kid % n_shards``:
+    ``[R, ...] -> [R, S, ...]``. Keys are wholly within one shard, so
+    restriction commutes with the cellwise join — per-cell matching,
+    payload winner-select, per-key sibling ranks, and parked keyset
+    replay are all key-local; the top clock replicates per shard (every
+    shard computes the same max). Parked key LISTS partition with their
+    keys (an entry k only ever kills cells with kid == k)."""
+    from ..ops import sparse_mvmap as smv
+
+    cap = cell_cap or states.kid.shape[-1]
+
+    def restrict(shard: int):
+        keep = states.valid & (states.kid % n_shards == shard)
+        kid, act, ctr, val, clk, valid, overflow = smv._canon(
+            jnp.where(keep, states.kid, -1),
+            jnp.where(keep, states.act, 0),
+            jnp.where(keep, states.ctr, 0),
+            jnp.where(keep, states.val, 0),
+            jnp.where(keep[..., None], states.clk, 0),
+            keep,
+            cap,
+        )
+        if bool(jnp.any(overflow)):
+            raise ValueError(
+                f"shard {shard}: live cells exceed the per-shard cap {cap}"
+            )
+        kidx = _canon_rmlist(
+            jnp.where(
+                (states.kidx >= 0) & (states.kidx % n_shards == shard),
+                states.kidx,
+                -1,
+            )
+        )
+        dvalid = states.dvalid & jnp.any(kidx >= 0, axis=-1)
+        return smv.SparseMVMapState(
+            top=states.top,  # replicated per shard
+            kid=kid, act=act, ctr=ctr, val=val, clk=clk, valid=valid,
+            dcl=jnp.where(dvalid[..., None], states.dcl, 0),
+            kidx=jnp.where(dvalid[..., None], kidx, -1),
+            dvalid=dvalid,
+        )
+
+    shards = [restrict(s_) for s_ in range(n_shards)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=1), *shards)
+
+
+def mesh_fold_sparse_mvmap_sharded(
+    states, mesh: Mesh, sibling_cap: int = 4
+):
+    """Converge a key-SHARDED sparse ``Map<K, MVReg>`` replica batch
+    ``[R, S, ...]`` (from ``split_cells``) over the mesh — the SP
+    analog for the register family. Shard-local joins are exact, so the
+    only collective is the replica-axis lattice all-reduce; per-device
+    state and join cost drop by S. Returns ``(state [S, ...],
+    overflow[3])``."""
+    from ..ops import sparse_mvmap as smv
+
+    return _sharded_fold(
+        f"sparse_mvmap_sharded_fold_s{sibling_cap}", states, mesh,
+        partial(smv.join, sibling_cap=sibling_cap),
+        partial(smv.fold, sibling_cap=sibling_cap),
+        lambda t: t._replace(
+            kid=jnp.full_like(t.kid, -1), kidx=jnp.full_like(t.kidx, -1)
+        ),
+    )
 
 
 def mesh_fold_sparse_map(
@@ -188,61 +279,20 @@ def mesh_fold_sparse_map(
     across the element axis. ``span`` is the level's static leaf-ids-
     per-key constant (``BatchedSparseMapOrswot.span``). Returns
     ``(state [S, ...], overflow[3])``."""
-    s_axis = jax.tree.leaves(states)[0].shape[1]
-    if s_axis != mesh.shape[ELEMENT_AXIS]:
-        raise ValueError(
-            f"state has {s_axis} element shards, mesh axis is "
-            f"{mesh.shape[ELEMENT_AXIS]}"
-        )
     level = nest.level_map_orswot(span)
-    states = _pad_replica_axis(
-        states, mesh.shape[REPLICA_AXIS],
-        lambda pad: jax.tree.map(
-            lambda x: jnp.zeros((pad, *x.shape[1:]), x.dtype), states
-        )._replace(
-            core=jax.tree.map(
-                lambda x: jnp.zeros((pad, *x.shape[1:]), x.dtype), states.core
-            )._replace(
-                eid=jnp.full((pad, *states.core.eid.shape[1:]), -1, jnp.int32),
-                didx=jnp.full(
-                    (pad, *states.core.didx.shape[1:]), -1, jnp.int32
-                ),
+    return _sharded_fold(
+        "sparse_map_fold", states, mesh,
+        partial(level.join, element_axis=ELEMENT_AXIS),
+        partial(level.fold, element_axis=ELEMENT_AXIS),
+        lambda t: t._replace(
+            core=t.core._replace(
+                eid=jnp.full_like(t.core.eid, -1),
+                didx=jnp.full_like(t.core.didx, -1),
             ),
-            kidx=jnp.full((pad, *states.kidx.shape[1:]), -1, jnp.int32),
+            kidx=jnp.full_like(t.kidx, -1),
         ),
+        cache_extra=(span,),
     )
-
-    def build():
-        @partial(
-            jax.shard_map,
-            mesh=mesh,
-            in_specs=(_all_specs(states),),
-            out_specs=(_all_specs(states, (ELEMENT_AXIS,)), P()),
-            check_vma=False,
-        )
-        def fold_fn(local):
-            local = jax.tree.map(lambda x: x[:, 0], local)
-            folded, of_local = level.fold(local, element_axis=ELEMENT_AXIS)
-            joined, of_cross = _lattice_allreduce(
-                folded,
-                partial(level.join, element_axis=ELEMENT_AXIS),
-                partial(level.fold, element_axis=ELEMENT_AXIS),
-            )
-            of = (
-                lax.psum(of_local.astype(jnp.int32), REPLICA_AXIS) > 0
-            ) | of_cross
-            of = lax.psum(of.astype(jnp.int32), ELEMENT_AXIS) > 0
-            return jax.tree.map(lambda x: x[None], joined), of
-
-        return fold_fn
-
-    metrics.count("anti_entropy.sparse_map_fold_rounds")
-    metrics.observe("anti_entropy.state_bytes", state_nbytes(states))
-    observe_depth("anti_entropy.sparse_map_fold", states)
-    with metrics.time("anti_entropy.sparse_map_fold"):
-        out = _cached("sparse_map_fold", states, mesh, build, span)(states)
-        jax.block_until_ready(out)
-    return out
 
 
 def _lattice_allreduce(local, join_fn, fold_fn):

@@ -4,6 +4,8 @@ universes; reference: src/map.rs ``Map<K, MVReg<_>, A>``)."""
 
 import random
 
+import numpy as np
+
 import pytest
 from hypothesis import given, settings
 
@@ -266,3 +268,54 @@ def test_mesh_gossip_converges_every_device():
         tmp = _batched(states)
         tmp.state = jax.tree.map(lambda x: x[dev][None], rows)
         assert tmp.to_pure(0) == expect, f"device row {dev} diverged"
+
+
+def test_sharded_mesh_fold_matches_unsharded_fold():
+    """SP scaling for the register family: cells partitioned by
+    kid % n_shards over the element axis, shard-local joins exact —
+    the recombined sharded fold equals the unsharded fold."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.ops import sparse_mvmap as smv
+    from crdt_tpu.parallel import (
+        make_mesh,
+        mesh_fold_sparse_mvmap_sharded,
+        split_cells,
+    )
+
+    states = _site_run(random.Random(17), mv_map)
+    batched = _batched(states)
+    expect, e_of = smv.fold(batched.state, sibling_cap=batched.sibling_cap)
+    assert not bool(jnp.asarray(e_of).any())
+
+    n = len(jax.devices())
+    mesh = make_mesh(n // 2, 2)
+    sharded = split_cells(batched.state, 2)
+    folded, of = mesh_fold_sparse_mvmap_sharded(
+        sharded, mesh, sibling_cap=batched.sibling_cap
+    )
+    assert not bool(jnp.asarray(of).any())
+
+    # Recombine the two shard restrictions: their live cells partition
+    # the expected fold's cells exactly.
+    got = []
+    for shard in range(2):
+        row = jax.tree.map(lambda x: np.asarray(x[shard]), folded)
+        for lane in np.nonzero(row.valid)[0]:
+            got.append((
+                int(row.kid[lane]), int(row.act[lane]), int(row.ctr[lane]),
+                int(row.val[lane]), tuple(row.clk[lane].tolist()),
+            ))
+        assert (np.asarray(row.kid)[row.valid] % 2 == shard).all()
+    want = []
+    erow = jax.tree.map(np.asarray, expect)
+    for lane in np.nonzero(erow.valid)[0]:
+        want.append((
+            int(erow.kid[lane]), int(erow.act[lane]), int(erow.ctr[lane]),
+            int(erow.val[lane]), tuple(erow.clk[lane].tolist()),
+        ))
+    assert sorted(got) == sorted(want), "sharded fold lost or changed cells"
+    # the replicated top agrees on every shard
+    for shard in range(2):
+        assert bool(jnp.array_equal(folded.top[shard], expect.top))
