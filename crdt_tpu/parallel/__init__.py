@@ -74,6 +74,7 @@ from .anti_entropy import (
 from .sparse_shard import (
     mesh_fold_sparse_map,
     mesh_fold_sparse_mvmap_sharded,
+    mesh_fold_sparse_nested_sharded,
     mesh_fold_sparse_sharded,
     split_cells,
     split_nested,
@@ -148,6 +149,7 @@ __all__ = [
     "mesh_fold_sparse_map",
     "mesh_fold_sparse_mvmap",
     "mesh_fold_sparse_mvmap_sharded",
+    "mesh_fold_sparse_nested_sharded",
     "mesh_fold_sparse_nested",
     "mesh_gossip_sparse_mvmap",
     "mesh_fold_sparse_sharded",

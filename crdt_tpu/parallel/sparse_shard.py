@@ -95,17 +95,40 @@ def split_segments(
 def split_nested(
     state: SparseNestState, n_shards: int, dot_cap: Optional[int] = None
 ) -> SparseNestState:
-    """Partition a (batched) nested sparse state: leaf segments split by
-    ``eid % n_shards``, parked KEY lists replicated to every shard
-    (``[R, ...] -> [R, S, ...]`` on every leaf)."""
+    """Partition a (batched) nested sparse state: the leaf table splits
+    by ``id % n_shards`` (segment ``eid`` for the orswot leaf, cell
+    ``kid`` for the register-map leaf), parked KEY lists replicated to
+    every shard (``[R, ...] -> [R, S, ...]`` on every leaf)."""
     if isinstance(state.core, SparseNestState):
         core = split_nested(state.core, n_shards, dot_cap)
+    elif hasattr(state.core, "kid"):  # sparse register-map leaf
+        core = split_cells(state.core, n_shards, dot_cap)
     else:
         core = split_segments(state.core, n_shards, dot_cap)
     rep = lambda x: jnp.repeat(x[:, None], n_shards, axis=1)
     return SparseNestState(
         core=core, kcl=rep(state.kcl), kidx=rep(state.kidx),
         kdvalid=rep(state.kdvalid),
+    )
+
+
+def mesh_fold_sparse_nested_sharded(states, mesh: Mesh, level):
+    """Converge a leaf-SHARDED sparse NESTED replica batch ``[R, S, ...]``
+    (from ``split_nested``; works for any SparseNestLevel composition —
+    orswot or register-map leaf) over the mesh. Shard-local joins are
+    exact except the scrub's key-liveness test, which psums across the
+    element axis. Returns ``(state [S, ...], flags[L+1])``."""
+    spans, core = [], level
+    while hasattr(core, "core"):
+        spans.append(str(core.span))
+        core = core.core
+    return _sharded_fold(
+        f"sparse_nested_sharded_{'x'.join(spans)}"
+        f"_s{getattr(core, 'sibling_cap', 0)}",
+        states, mesh,
+        partial(level.join, element_axis=ELEMENT_AXIS),
+        partial(level.fold, element_axis=ELEMENT_AXIS),
+        nest._sparse_identity_like,
     )
 
 
@@ -193,9 +216,7 @@ def mesh_fold_sparse_sharded(
     ``(state [S, ...], overflow[2])`` with the element axis preserved."""
     return _sharded_fold(
         "sparse_sharded_fold", states, mesh, sp.join, sp.fold,
-        lambda t: t._replace(
-            eid=jnp.full_like(t.eid, -1), didx=jnp.full_like(t.didx, -1)
-        ),
+        nest._sparse_identity_like,
     )
 
 
@@ -264,9 +285,7 @@ def mesh_fold_sparse_mvmap_sharded(
         f"sparse_mvmap_sharded_fold_s{sibling_cap}", states, mesh,
         partial(smv.join, sibling_cap=sibling_cap),
         partial(smv.fold, sibling_cap=sibling_cap),
-        lambda t: t._replace(
-            kid=jnp.full_like(t.kid, -1), kidx=jnp.full_like(t.kidx, -1)
-        ),
+        nest._sparse_identity_like,
     )
 
 
@@ -284,13 +303,7 @@ def mesh_fold_sparse_map(
         "sparse_map_fold", states, mesh,
         partial(level.join, element_axis=ELEMENT_AXIS),
         partial(level.fold, element_axis=ELEMENT_AXIS),
-        lambda t: t._replace(
-            core=t.core._replace(
-                eid=jnp.full_like(t.core.eid, -1),
-                didx=jnp.full_like(t.core.didx, -1),
-            ),
-            kidx=jnp.full_like(t.kidx, -1),
-        ),
+        nest._sparse_identity_like,
         cache_extra=(span,),
     )
 

@@ -198,3 +198,54 @@ def test_mesh_fold_matches_host_fold():
     tmp = _batched(states)
     tmp.state = jax.tree.map(lambda x: x[None], folded)
     assert tmp.to_pure(0) == expect
+
+
+def test_sharded_mesh_fold_matches_unsharded_fold():
+    """Leaf cells partitioned kid % S across the element axis; the
+    recombined sharded nested fold equals the unsharded level fold
+    (outer parked buffers replicated and identical on every shard)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from crdt_tpu.parallel import (
+        make_mesh,
+        mesh_fold_sparse_nested_sharded,
+        split_nested,
+    )
+
+    states = _site_run_nested(random.Random(31))
+    batched = _batched(states)
+    expect, e_flags = batched.level.fold(batched.state)
+    assert not bool(jnp.asarray(e_flags).any())
+
+    n = len(jax.devices())
+    mesh = make_mesh(n // 2, 2)
+    sharded = split_nested(batched.state, 2)
+    folded, flags = mesh_fold_sparse_nested_sharded(
+        sharded, mesh, batched.level
+    )
+    assert not bool(jnp.asarray(flags).any())
+
+    got, want = [], []
+    core = folded.core
+    for shard in range(2):
+        row = jax.tree.map(lambda x: np.asarray(x[shard]), core)
+        for lane in np.nonzero(row.valid)[0]:
+            got.append((int(row.kid[lane]), int(row.act[lane]),
+                        int(row.ctr[lane]), int(row.val[lane]),
+                        tuple(row.clk[lane].tolist())))
+        assert (np.asarray(row.kid)[row.valid] % 2 == shard).all()
+        # the replicated shared top agrees on every shard
+        assert bool(jnp.array_equal(core.top[shard], expect.core.top))
+    erow = jax.tree.map(np.asarray, expect.core)
+    for lane in np.nonzero(erow.valid)[0]:
+        want.append((int(erow.kid[lane]), int(erow.act[lane]),
+                     int(erow.ctr[lane]), int(erow.val[lane]),
+                     tuple(erow.clk[lane].tolist())))
+    assert sorted(got) == sorted(want), "sharded nested fold changed cells"
+    # outer parked buffers replicated and equal to the unsharded fold's
+    for shard in range(2):
+        assert bool(jnp.array_equal(folded.kcl[shard], expect.kcl))
+        assert bool(jnp.array_equal(folded.kidx[shard], expect.kidx))
+        assert bool(jnp.array_equal(folded.kdvalid[shard], expect.kdvalid))
