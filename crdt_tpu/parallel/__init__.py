@@ -64,6 +64,7 @@ from .anti_entropy import (
     mesh_fold_sparse_mvmap,
     mesh_fold_sparse_nested,
     mesh_gossip_sparse_mvmap,
+    mesh_gossip_sparse_nested,
     mesh_gossip,
     mesh_gossip_sparse,
     mesh_gossip_map,
@@ -152,6 +153,7 @@ __all__ = [
     "mesh_fold_sparse_nested_sharded",
     "mesh_fold_sparse_nested",
     "mesh_gossip_sparse_mvmap",
+    "mesh_gossip_sparse_nested",
     "mesh_fold_sparse_sharded",
     "split_cells",
     "split_nested",

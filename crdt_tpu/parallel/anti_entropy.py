@@ -541,34 +541,58 @@ def mesh_fold_sparse_nested(states, mesh: Mesh, level):
     over the mesh's replica axis, state replicated across the element
     axis. ``level`` carries the join/fold (and their static caps).
     Returns ``(state, flags[L+1])``."""
-    rsize = mesh.shape[REPLICA_AXIS]
-    pad_r = (-jax.tree.leaves(states)[0].shape[0]) % rsize
-    if pad_r:
-        from ..ops.sparse_nest import _sparse_identity_like
-
-        identity = jax.tree.map(
-            lambda x: jnp.zeros((pad_r, *x.shape[1:]), x.dtype), states
-        )
-        identity = _sparse_identity_like(identity)
-        states = jax.tree.map(
-            lambda s, p: jnp.concatenate([s, p], axis=0), states, identity
-        )
-    template = jax.tree.map(lambda x: x[0], states)
-    # Cache key from the level's static shape/caps (an id() key could be
-    # reused after GC and resurrect a closure with the wrong caps).
-    spans, core = [], level
-    while hasattr(core, "core"):
-        spans.append(str(core.span))
-        core = core.core
-    kind = (
-        f"sparse_nested_fold_{'x'.join(spans)}"
-        f"_s{getattr(core, 'sibling_cap', 0)}"
+    states, template, kind = _sparse_nested_pad_and_key(
+        states, mesh.shape[REPLICA_AXIS], level, "fold"
     )
     return _mesh_fold_lattice(
         kind, states, mesh,
         level.join, level.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template),
         jax.tree.map(lambda _: P(), template),
+    )
+
+
+def _sparse_nested_pad_and_key(states, rsize: int, level, op: str):
+    """Identity-pad a nested sparse replica batch and derive the memo
+    key for its mesh entry points. The key MUST come from the level's
+    static shape/caps — an id()-based key could be reused after GC and
+    resurrect a compiled closure with the wrong caps."""
+    from ..ops.sparse_nest import _sparse_identity_like
+
+    pad_r = (-jax.tree.leaves(states)[0].shape[0]) % rsize
+    if pad_r:
+        identity = _sparse_identity_like(jax.tree.map(
+            lambda x: jnp.zeros((pad_r, *x.shape[1:]), x.dtype), states
+        ))
+        states = jax.tree.map(
+            lambda s, p: jnp.concatenate([s, p], axis=0), states, identity
+        )
+    template = jax.tree.map(lambda x: x[0], states)
+    spans, core = [], level
+    while hasattr(core, "core"):
+        spans.append(str(core.span))
+        core = core.core
+    kind = (
+        f"sparse_nested_{op}_{'x'.join(spans)}"
+        f"_s{getattr(core, 'sibling_cap', 0)}"
+    )
+    return states, template, kind
+
+
+def mesh_gossip_sparse_nested(
+    states, mesh: Mesh, level, rounds: Optional[int] = None
+):
+    """Ring anti-entropy for SPARSE nested-map replica batches (any
+    ``SparseNestLevel`` composition) over the replica axis — per-round
+    traffic is one live-content-proportional state per link. State
+    replicated across the element axis (the sharded fold is the
+    element-scaling mode)."""
+    states, template, kind = _sparse_nested_pad_and_key(
+        states, mesh.shape[REPLICA_AXIS], level, "gossip"
+    )
+    return _mesh_gossip_lattice(
+        kind, states, mesh, level.join, level.fold,
+        jax.tree.map(lambda _: P(REPLICA_AXIS), template), rounds,
     )
 
 

@@ -249,3 +249,25 @@ def test_sharded_mesh_fold_matches_unsharded_fold():
         assert bool(jnp.array_equal(folded.kcl[shard], expect.kcl))
         assert bool(jnp.array_equal(folded.kidx[shard], expect.kidx))
         assert bool(jnp.array_equal(folded.kdvalid[shard], expect.kdvalid))
+
+
+def test_mesh_gossip_converges_every_device():
+    """P-1 ring rounds leave every device row of the nested sparse map
+    equal to the full join."""
+    import jax
+
+    from crdt_tpu.parallel import make_mesh, mesh_gossip_sparse_nested
+
+    states = _site_run_nested(random.Random(41))
+    batched = _batched(states)
+    expect = batched.fold()
+
+    mesh = make_mesh(len(jax.devices()), 1)
+    rows, flags = mesh_gossip_sparse_nested(
+        batched.state, mesh, batched.level
+    )
+    assert not bool(flags.any())
+    tmp = _batched(states)
+    for dev in range(jax.tree.leaves(rows)[0].shape[0]):
+        tmp.state = jax.tree.map(lambda x: x[dev][None], rows)
+        assert tmp.to_pure(0) == expect, f"device row {dev} diverged"
