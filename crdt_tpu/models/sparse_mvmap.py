@@ -23,7 +23,7 @@ from ..ops import sparse_mvmap as ops
 from ..pure.map import Map, MapRm, Nop, Up
 from ..pure.mvreg import MVReg, Put
 from ..utils import Interner, clock_lanes, transactional_apply
-from ..utils.metrics import metrics
+from ..utils.metrics import metrics, observe_depth
 from ..vclock import VClock
 from .orswot import DeferredOverflow
 from .registers import SlotOverflow
@@ -303,6 +303,7 @@ class BatchedSparseMap:
         """Full-mesh anti-entropy: join all replicas, return the
         converged oracle-form state."""
         metrics.count("sparse_map.merges", max(self.n_replicas - 1, 0))
+        observe_depth("sparse_map", self.state)
         folded, flags = ops.fold(self.state, sibling_cap=self.sibling_cap)
         self._check(flags, "fold")
         tmp = BatchedSparseMap(
