@@ -99,8 +99,11 @@ def empty(
 def _canon(kid, act, ctr, val, clk, valid, cap: int):
     """Sort live cells by (kid, act), dead lanes last with zeroed
     payload; truncate to ``cap``. Returns the table + overflow flag."""
+    # Two keys, not three: the masked kid (MAX sentinel) already sends
+    # dead lanes last — live kids are bounded by K·A < 2^31, strictly
+    # below the sentinel (see sparse_orswot._canon).
     order = jnp.lexsort(
-        (act, jnp.where(valid, kid, _INT32_MAX), ~valid), axis=-1
+        (act, jnp.where(valid, kid, _INT32_MAX)), axis=-1
     )
     take = lambda x: jnp.take_along_axis(x, order, axis=-1)
     kid, act, ctr, val, valid = (

@@ -82,9 +82,18 @@ def empty(
 
 
 def _canon(eid, act, ctr, valid, cap: int):
-    """Sort live dots by (eid, act, ctr), dead lanes last with zeroed
-    payload; truncate to ``cap``. Returns the table + overflow flag."""
-    order = jnp.lexsort((ctr, act, jnp.where(valid, eid, jnp.iinfo(jnp.int32).max), ~valid), axis=-1)
+    """Sort live dots by (eid, act), dead lanes last with zeroed
+    payload; truncate to ``cap``. Returns the table + overflow flag.
+
+    Two sort keys, not four: every key is a full stable-sort pass on
+    TPU. The masked eid (MAX sentinel) already sends dead lanes last
+    (a separate ~valid key is redundant — live eids are bounded by
+    E·A < 2^31, strictly below the sentinel), and (eid, act) is unique
+    among live lanes (one counter per cell), so a ctr tiebreak can
+    never fire. Order is bit-identical to the old 4-key sort."""
+    order = jnp.lexsort(
+        (act, jnp.where(valid, eid, jnp.iinfo(jnp.int32).max)), axis=-1
+    )
     take = lambda x: jnp.take_along_axis(x, order, axis=-1)
     eid, act, ctr, valid = take(eid), take(act), take(ctr), take(valid)
     overflow = jnp.sum(valid, axis=-1) > cap
