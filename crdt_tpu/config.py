@@ -141,7 +141,13 @@ def replicaset(
     map_map — ``n_keys2`` the K2 axis of map3, and ``n_actors`` the
     actor lanes. ``sparse_orswot`` (xla) is the segment-encoded mode
     for huge member universes: ``n_members`` there sizes the LIVE-dot
-    capacity, not the universe (which is unbounded)."""
+    capacity, not the universe (which is unbounded). The other sparse
+    kinds repurpose lanes the same way: ``sparse_map_orswot`` takes
+    ``n_members`` as the per-key span and ``n_keys2`` as live-dot
+    capacity; ``sparse_map`` takes ``n_keys`` as the (virtual) key
+    universe bound and ``n_keys2`` as live-cell capacity;
+    ``sparse_map_map`` takes ``n_members`` as the (virtual) inner-key
+    span and ``n_keys2`` as live-cell capacity."""
     config.validate()
     if config.backend == "pure":
         from .pure.gcounter import GCounter
