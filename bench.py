@@ -264,6 +264,51 @@ def bench_tpu():
     return mps, path, gbps, bytes_moved, f"{r_total}x{E}x{A}"
 
 
+def bench_elastic():
+    """Elastic capacity migration (diagnostic, stderr): wall-clock of the
+    sanctioned overflow recovery — ``elastic.widen`` 2×-ing the
+    element/dot axis with the live device state re-encoded in place
+    (crdt_tpu/elastic.py) — for the dense and sparse ORSWOT flavors.
+    Also the operator pressure view: per-kind headroom gauges plus the
+    ``elastic.widen_events`` / ``elastic.migrated_bytes`` counters land
+    in the metrics snapshot main() logs."""
+    import jax
+
+    from crdt_tpu import elastic
+    from crdt_tpu.models.orswot import BatchedOrswot
+    from crdt_tpu.models.sparse_orswot import BatchedSparseOrswot
+    from crdt_tpu.utils.metrics import state_nbytes
+
+    r = int(os.environ.get("BENCH_ELASTIC_REPLICAS", 256))
+    e = int(os.environ.get("BENCH_ELASTIC_ELEMS", 4096))
+    recs = []
+    for kind, axis, model in (
+        ("orswot", "n_members", BatchedOrswot(r, e, A, 8)),
+        ("sparse_orswot", "dot_cap", BatchedSparseOrswot(r, e, A, 8, 8)),
+    ):
+        elastic.record_headroom(model)
+        before = state_nbytes(model.state)
+        t0 = time.perf_counter()
+        grown = elastic.widen(model, (axis,))
+        jax.block_until_ready(jax.tree.leaves(model.state))
+        dt = time.perf_counter() - t0
+        after = state_nbytes(model.state)
+        log(
+            f"config-elastic {kind}: {axis} {e} -> {grown[axis]} over "
+            f"{r} replicas in {dt*1e3:.1f} ms "
+            f"({before/1e6:.1f} -> {after/1e6:.1f} MB, first-shape "
+            f"compile included — migrations are one-shot)"
+        )
+        recs.append({
+            "config": "elastic", "metric": f"widen_ms_{kind}",
+            "value": round(dt * 1e3, 2), "unit": "ms",
+            "axis": axis, "grown_to": grown[axis],
+            "state_bytes_before": before, "state_bytes_after": after,
+            "shape": f"{r}x{e}x{A}",
+        })
+    return recs
+
+
 def bench_cpu() -> float:
     from crdt_tpu.pure.orswot import Orswot
     from crdt_tpu.vclock import VClock
@@ -819,6 +864,7 @@ def main():
         ("list", bench_list),
         ("sparse", bench_sparse),
         ("sparse_map", bench_sparse_map),
+        ("elastic", bench_elastic),
     ]:
         if os.environ.get(f"BENCH_{name.upper()}", "1") != "0":
             try:

@@ -40,7 +40,9 @@ from .pure.glist import GList
 from .pure.merkle_reg import MerkleReg
 
 # Wire/storage encoding + device checkpointing (imported lazily as
-# modules too: ``crdt_tpu.serde`` / ``crdt_tpu.checkpoint``).
+# modules too: ``crdt_tpu.serde`` / ``crdt_tpu.checkpoint``). The
+# elastic capacity manager (``crdt_tpu.elastic``) rides the models, so
+# it stays a lazy module import to keep ``import crdt_tpu`` light.
 from . import lifecycle, serde
 from .utils.metrics import metrics
 

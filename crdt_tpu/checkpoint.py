@@ -172,6 +172,7 @@ def save(path: Union[str, os.PathLike], model) -> None:
             "kind": "sparse_map_map",
             "span": model.span,
             "sibling_cap": model.sibling_cap,
+            "n_keys1": model.n_keys1,
             "keys1": _interner_items(model.keys1),
             "keys2": _interner_items(model.keys2),
             "actors": _interner_items(model.actors),
@@ -388,6 +389,9 @@ def load(path: Union[str, os.PathLike]):
             core.kidx.shape[-1],
             state.kcl.shape[-2],
             state.kidx.shape[-1],
+            # Older checkpoints predate the persisted bound; 0 falls back
+            # to the packing-max default (their save-time value).
+            n_keys1=int(meta.get("n_keys1", 0)),
             keys1=_interner_from(meta["keys1"]),
             keys2=_interner_from(meta["keys2"]),
             actors=_interner_from(meta["actors"]),

@@ -12,9 +12,10 @@ anti-entropy over R replicas is ``alive.any(axis=0)``.
 Identifier allocation note: the engine mints LSEQ-style (index, actor,
 counter) tree paths while the pure ``between`` embeds the element as the
 final marker — allocation strategies are an implementation choice in
-the reference too, so the A/B gate (tests/test_glist_model.py) drives
-both sides with ENGINE-minted identifiers (via ``to_pure``-shaped ops)
-and checks sequence/merge/convergence behavior bit-identically.
+the reference too, so the A/B gates (tests/test_streamed_lists.py for
+sequence/merge/convergence behavior, tests/test_checkpoint.py for the
+persisted identifier universe) drive both sides with ENGINE-minted
+identifiers (via ``to_pure``-shaped ops) and check bit-identically.
 """
 
 from __future__ import annotations

@@ -210,7 +210,9 @@ class BatchedMap:
             strict_validate_dot(row.top, self.actors, op.dot.actor, op.dot.counter)
             aid = self.actors.bounded_intern(op.dot.actor, na, "actor")
             kid = self.keys.bounded_intern(op.key, nk, "key")
-            clock = clock_lanes(op.op.clock, self.actors, na)
+            clock = clock_lanes(
+                op.op.clock, self.actors, na, dtype=self.state.top.dtype
+            )
             row, overflow = ops.apply_up(
                 row,
                 jnp.asarray(aid),
@@ -226,7 +228,9 @@ class BatchedMap:
                 )
         elif isinstance(op, MapRm):
             na = self.state.top.shape[-1]
-            cl = clock_lanes(op.clock, self.actors, na)
+            cl = clock_lanes(
+                op.clock, self.actors, na, dtype=self.state.top.dtype
+            )
             mask = np.zeros((self.state.dkeys.shape[-1],), bool)
             for k in op.keyset:
                 mask[self.keys.bounded_intern(k, self.state.dkeys.shape[-1], "key")] = True
@@ -249,7 +253,10 @@ class BatchedMap:
         ``VClock`` covers, bottomed keys die, parked removes and the
         outer clock forget covered lanes (reference: src/map.rs
         ResetRemove impl; oracle: pure/map.py ``reset_remove``)."""
-        cl = clock_lanes(clock, self.actors, self.state.top.shape[-1])
+        cl = clock_lanes(
+            clock, self.actors, self.state.top.shape[-1],
+            dtype=self.state.top.dtype,
+        )
         row = ops.reset_remove(self._row(self.state, replica), jnp.asarray(cl))
         self.state = jax.tree.map(
             lambda full, r: full.at[replica].set(r), self.state, row
@@ -305,3 +312,22 @@ class BatchedMap:
     def keys_of(self, i: int) -> frozenset:
         present = np.asarray(self.state.child.valid[i].any(axis=-1))
         return frozenset(self.keys[int(k)] for k in np.nonzero(present)[0])
+
+    # ---- elastic capacity migration (elastic.py) ----------------------
+    def widen_capacity(
+        self,
+        n_keys: int = 0,
+        n_actors: int = 0,
+        sibling_cap: int = 0,
+        deferred_cap: int = 0,
+    ) -> None:
+        """Re-encode the live device state into a wider layout in place
+        — the sanctioned recovery from ``SlotOverflow`` /
+        ``DeferredOverflow`` / a full key universe (elastic.py drives
+        this; the migration is ``ops.map.widen`` riding
+        ``ops.mvreg.widen`` for the sibling slab). 0 keeps a width;
+        interners and ids are untouched and the result is bit-identical
+        to a from-scratch model built at the wider capacity."""
+        self.state = ops.widen(
+            self.state, n_keys, n_actors, sibling_cap, deferred_cap
+        )

@@ -171,7 +171,9 @@ class BatchedOrswot:
                 row, jnp.asarray(aid), jnp.asarray(op.dot.counter), jnp.asarray(mask)
             )
         elif isinstance(op, Rm):
-            cl = clock_lanes(op.clock, self.actors, na)
+            cl = clock_lanes(
+                op.clock, self.actors, na, dtype=self.state.top.dtype
+            )
             mask = np.zeros((ne,), bool)
             for m in op.members:
                 mask[self.members.bounded_intern(m, ne, "member")] = True
@@ -192,7 +194,10 @@ class BatchedOrswot:
         """``Causal::reset_remove`` on one replica: forget all causal
         history the given ``VClock`` dominates (reference: src/orswot.rs
         ResetRemove impl; oracle: pure/orswot.py ``reset_remove``)."""
-        cl = clock_lanes(clock, self.actors, self.state.top.shape[-1])
+        cl = clock_lanes(
+            clock, self.actors, self.state.top.shape[-1],
+            dtype=self.state.top.dtype,
+        )
         row = ops.reset_remove(self._row(self.state, replica), jnp.asarray(cl))
         self.state = jax.tree.map(
             lambda full, r: full.at[replica].set(r), self.state, row
@@ -244,3 +249,19 @@ class BatchedOrswot:
     def members_of(self, i: int) -> frozenset:
         present = np.asarray(self.state.ctr[i].any(axis=-1))
         return frozenset(self.members[int(e)] for e in np.nonzero(present)[0])
+
+    # ---- elastic capacity migration (elastic.py) ----------------------
+    def widen_capacity(
+        self,
+        n_members: int = 0,
+        n_actors: int = 0,
+        deferred_cap: int = 0,
+    ) -> None:
+        """Re-encode the live device state into a wider layout in place
+        — the sanctioned recovery from ``DeferredOverflow`` / a full
+        interned universe (elastic.py drives this; the migration itself
+        is ``ops.orswot.widen``). 0 keeps a width. Interners are
+        untouched: ids keep their lanes, the new tail lanes are spare
+        capacity, and the result is bit-identical to a from-scratch
+        model built at the wider capacity holding the same state."""
+        self.state = ops.widen(self.state, n_members, n_actors, deferred_cap)

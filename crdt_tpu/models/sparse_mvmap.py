@@ -219,7 +219,9 @@ class BatchedSparseMap:
             )
             aid = self.actors.bounded_intern(op.dot.actor, na, "actor")
             kid = self.keys.bounded_intern(op.key, self.n_keys, "key")
-            cl = clock_lanes(op.op.clock, self.actors, na)
+            cl = clock_lanes(
+                op.op.clock, self.actors, na, dtype=self.state.top.dtype
+            )
             row, overflow = ops.apply_up(
                 row,
                 jnp.asarray(aid),
@@ -234,7 +236,9 @@ class BatchedSparseMap:
                     f"{op.key!r} — rebuild with a larger cell_cap"
                 )
         elif isinstance(op, MapRm):
-            cl = clock_lanes(op.clock, self.actors, na)
+            cl = clock_lanes(
+                op.clock, self.actors, na, dtype=self.state.top.dtype
+            )
             q = self.state.kidx.shape[-1]
             ids = sorted(
                 self.keys.bounded_intern(k, self.n_keys, "key")
@@ -264,7 +268,10 @@ class BatchedSparseMap:
         """``Causal::reset_remove`` on one replica (reference:
         src/map.rs ResetRemove impl; dense sibling:
         BatchedMap.reset_remove)."""
-        cl = clock_lanes(clock, self.actors, self.state.top.shape[-1])
+        cl = clock_lanes(
+            clock, self.actors, self.state.top.shape[-1],
+            dtype=self.state.top.dtype,
+        )
         row = ops.reset_remove(self._row(self.state, replica), jnp.asarray(cl))
         self.state = jax.tree.map(
             lambda full, r_: full.at[replica].set(r_), self.state, row
@@ -323,3 +330,47 @@ class BatchedSparseMap:
 
     def nbytes(self) -> int:
         return ops.nbytes(self.state)
+
+    # ---- elastic capacity migration (elastic.py) ----------------------
+    def widen_capacity(
+        self,
+        cell_cap: int = 0,
+        n_keys: int = 0,
+        n_actors: int = 0,
+        sibling_cap: int = 0,
+        deferred_cap: int = 0,
+        rm_width: int = 0,
+    ) -> None:
+        """Cell-table repack into a wider layout in place — the
+        sanctioned recovery from ``DotCapacityOverflow`` /
+        ``SlotOverflow`` / ``DeferredOverflow`` / a full key universe
+        (elastic.py drives this; the device migration is
+        ``ops.sparse_mvmap.widen``). ``n_keys`` and ``sibling_cap`` are
+        host-side bounds (the key universe is virtual and the sibling
+        cap is a join-time check), so they update without touching
+        device state — but the packed int32 cell key still bounds
+        ``n_keys · n_actors``. 0 keeps a width; shrinking is refused."""
+        na = n_actors or self.state.top.shape[-1]
+        # An unpinned key bound auto-clamps to what the packing allows
+        # at the (possibly wider) actor count; a pinned one must fit.
+        nk = n_keys or min(self.n_keys, (2**31 - 1) // max(na, 1))
+        if n_keys and n_keys < self.n_keys:
+            raise ValueError("widen_capacity cannot shrink n_keys")
+        if sibling_cap and sibling_cap < self.sibling_cap:
+            raise ValueError("widen_capacity cannot shrink sibling_cap")
+        if nk < len(self.keys):
+            raise ValueError(
+                f"n_keys = {nk} would orphan {len(self.keys)} "
+                f"already-interned keys"
+            )
+        if nk * na > 2**31 - 1:
+            raise ValueError(
+                f"key universe too wide for the int32 packed-cell key: "
+                f"n_keys * n_actors = {nk * na:,} > 2^31-1"
+            )
+        self.state = ops.widen(
+            self.state, cell_cap, n_actors, deferred_cap, rm_width
+        )
+        self.n_keys = nk
+        if sibling_cap:
+            self.sibling_cap = sibling_cap

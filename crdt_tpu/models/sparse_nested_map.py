@@ -59,7 +59,16 @@ class BatchedSparseNestedMap:
         cap1 = (2**31 - 1) // max(span * n_actors, 1)
         if cap1 < 1:
             raise ValueError("span * n_actors must fit the int32 packed key")
-        self.n_keys1 = min(n_keys1, cap1) if n_keys1 else cap1
+        if n_keys1 > cap1:
+            # Mirror BatchedSparseMap's constructor check: a clamped
+            # bound would silently weaken bounded_intern validation and
+            # let later interns wrap the packed key.
+            raise ValueError(
+                f"n_keys1 = {n_keys1:,} exceeds the int32 packed-key cap "
+                f"{cap1:,} at span {span} x {n_actors} actors "
+                f"(shrink n_keys1, span, or n_actors)"
+            )
+        self.n_keys1 = n_keys1 if n_keys1 else cap1
         self.keys1 = keys1 if keys1 is not None else Interner()
         self.keys2 = keys2 if keys2 is not None else Interner()
         self.actors = actors if actors is not None else Interner()
@@ -306,12 +315,10 @@ class BatchedSparseNestedMap:
         return out
 
     def _k2_id(self, k2) -> int:
-        k2i = self.keys2.intern(k2)
-        if k2i >= self.span:
-            raise ValueError(
-                f"inner key universe exceeded the span {self.span}"
-            )
-        return k2i
+        # IndexError (the interner's full-universe signal, raised BEFORE
+        # allocating) so elastic.elastic_call can widen the span and
+        # retry; a plain ValueError would leave the replica stuck.
+        return self.keys2.bounded_intern(k2, self.span, "inner key")
 
     # ---- op path (CmRDT) ----------------------------------------------
     @transactional_apply("keys1", "keys2", "actors", "values")
@@ -339,7 +346,10 @@ class BatchedSparseNestedMap:
                         f"innermost op must be an MVReg Put, got {inner.op!r}"
                     )
                 flat = k1i * self.span + self._k2_id(inner.key)
-                cl = clock_lanes(inner.op.clock, self.actors, na)
+                cl = clock_lanes(
+                    inner.op.clock, self.actors, na,
+                    dtype=self.state.core.top.dtype,
+                )
                 row, overflow = smv.nest_apply_up_put(
                     self.level, row,
                     jnp.asarray(aid),
@@ -354,12 +364,21 @@ class BatchedSparseNestedMap:
                         f"exceeded"
                     )
             elif isinstance(inner, MapRm):
-                cl = clock_lanes(inner.clock, self.actors, na)
-                ids = pad_id_list(
-                    (k1i * self.span + self._k2_id(k2)
-                     for k2 in inner.keyset),
-                    width=self.state.core.kidx.shape[-1],
+                cl = clock_lanes(
+                    inner.clock, self.actors, na,
+                    dtype=self.state.core.top.dtype,
                 )
+                try:
+                    ids = pad_id_list(
+                        (k1i * self.span + self._k2_id(k2)
+                         for k2 in inner.keyset),
+                        width=self.state.core.kidx.shape[-1],
+                    )
+                except ValueError as e:
+                    # A too-narrow parked keylist lane is capacity
+                    # pressure: surface the recoverable type so
+                    # elastic can widen rm_width and retry.
+                    raise DeferredOverflow(str(e)) from e
                 row, overflow = self.level.apply_up_rm(
                     row, jnp.asarray(aid),
                     jnp.asarray(np.uint32(op.dot.counter)),
@@ -372,12 +391,19 @@ class BatchedSparseNestedMap:
             else:
                 raise TypeError(f"routes Map ops only, got {inner!r}")
         elif isinstance(op, MapRm):
-            cl = clock_lanes(op.clock, self.actors, na)
-            ids = pad_id_list(
-                (self.keys1.bounded_intern(k1, self.n_keys1, "outer key")
-                 for k1 in op.keyset),
-                width=self.state.kidx.shape[-1],
+            cl = clock_lanes(
+                op.clock, self.actors, na,
+                dtype=self.state.core.top.dtype,
             )
+            try:
+                ids = pad_id_list(
+                    (self.keys1.bounded_intern(k1, self.n_keys1, "outer key")
+                     for k1 in op.keyset),
+                    width=self.state.kidx.shape[-1],
+                )
+            except ValueError as e:
+                # key_rm_width pressure — recoverable, as above.
+                raise DeferredOverflow(str(e)) from e
             row, overflow = self.level.rm_parked(
                 row, jnp.asarray(cl), jnp.asarray(ids)
             )
@@ -438,3 +464,70 @@ class BatchedSparseNestedMap:
 
     def nbytes(self) -> int:
         return sum(x.nbytes for x in jax.tree.leaves(self.state))
+
+    # ---- elastic capacity migration (elastic.py) ----------------------
+    def widen_capacity(
+        self,
+        span: int = 0,
+        cell_cap: int = 0,
+        n_actors: int = 0,
+        sibling_cap: int = 0,
+        deferred_cap: int = 0,
+        rm_width: int = 0,
+        key_deferred_cap: int = 0,
+        key_rm_width: int = 0,
+        n_keys1: int = 0,
+    ) -> None:
+        """Re-encode the nested cell table into a wider layout in place
+        — the sanctioned recovery for every capacity this model bounds.
+        A ``span`` widening is the segment-table repack
+        (``ops.sparse_nest.widen_span``): flat cell ids and the inner
+        parked lists remap ``k1·span + k2`` → ``k1·span' + k2`` on
+        device (monotone, so canonical order survives); outer key ids
+        are untouched. Everything else is tail padding
+        (``ops.sparse_mvmap.widen`` inside ``sparse_nest.widen_level``).
+        0 keeps a width; the int32 packed key re-bounds
+        ``n_keys1 · span · n_actors`` after the migration."""
+        from ..ops import sparse_nest as nest_ops
+
+        old_span = self.span
+        nspan = span or old_span
+        na = n_actors or self.state.core.top.shape[-1]
+        nsib = sibling_cap or self.sibling_cap
+        if nsib < self.sibling_cap:
+            raise ValueError("widen_capacity cannot shrink sibling_cap")
+        cap1 = (2**31 - 1) // max(nspan * na, 1)
+        nk1 = n_keys1 or min(self.n_keys1, cap1)
+        if n_keys1 and n_keys1 < self.n_keys1:
+            raise ValueError("widen_capacity cannot shrink n_keys1")
+        if nk1 > cap1 or cap1 < 1:
+            raise ValueError(
+                f"n_keys1 = {nk1:,} exceeds the int32 packed-key cap "
+                f"{cap1:,} at span {nspan} x {na} actors"
+            )
+        if nk1 < len(self.keys1):
+            raise ValueError(
+                f"n_keys1 = {nk1} would orphan {len(self.keys1)} "
+                f"already-interned outer keys"
+            )
+        state = self.state
+        if nspan != old_span:
+            if len(self.keys2) > 0 and nspan < len(self.keys2):
+                raise ValueError(
+                    f"span {nspan} below {len(self.keys2)} interned inner keys"
+                )
+            state = nest_ops.widen_span(state, old_span, nspan)
+        state = nest_ops.widen_level(
+            state,
+            lambda core: smv.widen(
+                core, cell_cap, n_actors, deferred_cap, rm_width
+            ),
+            key_deferred_cap,
+            key_rm_width,
+            n_actors,
+        )
+        self.state = state
+        self.n_keys1 = nk1
+        self.sibling_cap = nsib
+        if nspan != old_span or nsib != self.level.core.sibling_cap:
+            self.level = smv.level_map_mvreg(nspan, nsib)

@@ -173,7 +173,10 @@ class BatchedSparseOrswot:
             while width < len(ids):
                 width *= 2
         if len(ids) > width:
-            raise ValueError(
+            # DeferredOverflow (not ValueError): a too-narrow parked
+            # lane is capacity pressure, and elastic.axes_for implicates
+            # rm_width so the recovery loop can widen it and retry.
+            raise DeferredOverflow(
                 f"op lists {len(ids)} members; rm_width is {width} — "
                 f"rebuild with a larger rm_width or split the op"
             )
@@ -203,7 +206,9 @@ class BatchedSparseOrswot:
                     f"replica {replica}: dot_cap {self.dot_cap} exceeded"
                 )
         elif isinstance(op, Rm):
-            clock = clock_lanes(op.clock, self.actors, na)
+            clock = clock_lanes(
+                op.clock, self.actors, na, dtype=self.state.top.dtype
+            )
             row, overflow = ops.apply_rm(
                 row,
                 jnp.asarray(clock),
@@ -237,7 +242,10 @@ class BatchedSparseOrswot:
         history the given ``VClock`` dominates (reference: src/orswot.rs
         ResetRemove impl; oracle: pure/orswot.py; dense sibling:
         BatchedOrswot.reset_remove)."""
-        cl = clock_lanes(clock, self.actors, self.state.top.shape[-1])
+        cl = clock_lanes(
+            clock, self.actors, self.state.top.shape[-1],
+            dtype=self.state.top.dtype,
+        )
         row = ops.reset_remove(self._row(self.state, replica), jnp.asarray(cl))
         self.state = jax.tree.map(
             lambda full, r: full.at[replica].set(r), self.state, row
@@ -273,4 +281,22 @@ class BatchedSparseOrswot:
         return frozenset(
             self.members[int(e)]
             for e in np.unique(np.asarray(st.eid)[np.asarray(st.valid)])
+        )
+
+    # ---- elastic capacity migration (elastic.py) ----------------------
+    def widen_capacity(
+        self,
+        dot_cap: int = 0,
+        n_actors: int = 0,
+        deferred_cap: int = 0,
+        rm_width: int = 0,
+    ) -> None:
+        """Segment-table repack into a wider layout in place — the
+        sanctioned recovery from ``DotCapacityOverflow`` /
+        ``DeferredOverflow`` (elastic.py drives this; the migration is
+        ``ops.sparse_orswot.widen``). 0 keeps a width; interners and ids
+        are untouched and the result is bit-identical to a from-scratch
+        model built at the wider capacity holding the same state."""
+        self.state = ops.widen(
+            self.state, dot_cap, n_actors, deferred_cap, rm_width
         )

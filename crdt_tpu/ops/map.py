@@ -31,6 +31,7 @@ raw arrays.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -39,7 +40,7 @@ from jax import lax
 
 from . import mvreg
 from .mvreg import MVRegState
-from .orswot import _compact_deferred, _dedupe_deferred, _park_remove
+from .orswot import _compact_deferred, _dedupe_deferred, _pad_tail, _park_remove
 
 DTYPE = jnp.uint32
 
@@ -68,6 +69,46 @@ def empty(
         dcl=jnp.zeros((*batch, deferred_cap, n_actors), DTYPE),
         dkeys=jnp.zeros((*batch, deferred_cap, n_keys), bool),
         dvalid=jnp.zeros((*batch, deferred_cap), bool),
+    )
+
+
+def widen(
+    state: MapState,
+    n_keys: int = 0,
+    n_actors: int = 0,
+    sibling_cap: int = 0,
+    deferred_cap: int = 0,
+) -> MapState:
+    """Re-encode into a wider key/actor/sibling/deferred layout
+    (elastic.py). The child slab rides ``mvreg.widen`` with the key axis
+    as one more batch axis, then pads fresh (all-dead) key rows at the
+    tail; deferred key masks pad False on both axes. Bit-identical to a
+    from-scratch wider state holding the same dots. 0 keeps a width;
+    shrinking is refused."""
+    k, a = state.dkeys.shape[-1], state.top.shape[-1]
+    s, d = state.child.wact.shape[-1], state.dvalid.shape[-1]
+    nk, na = n_keys or k, n_actors or a
+    ns, nd = sibling_cap or s, deferred_cap or d
+    if nk < k or na < a or ns < s or nd < d:
+        raise ValueError(
+            f"widen cannot shrink: ({k}, {a}, {s}, {d}) -> "
+            f"({nk}, {na}, {ns}, {nd})"
+        )
+    lead = state.top.ndim - 1
+    pad = partial(_pad_tail, lead=lead)
+    child = mvreg.widen(state.child, ns, na)
+    child = jax.tree.map(
+        lambda x: jnp.pad(
+            x, ((0, 0),) * lead + ((0, nk - k),) + ((0, 0),) * (x.ndim - lead - 1)
+        ),
+        child,
+    )
+    return MapState(
+        top=pad(state.top, (0, na - a)),
+        child=child,
+        dcl=pad(state.dcl, (0, nd - d), (0, na - a)),
+        dkeys=pad(state.dkeys, (0, nd - d), (0, nk - k)),
+        dvalid=pad(state.dvalid, (0, nd - d)),
     )
 
 

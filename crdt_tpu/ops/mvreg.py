@@ -19,10 +19,13 @@ buffer — models raise rather than drop siblings). Oracle:
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+
+from .orswot import _pad_tail
 
 DTYPE = jnp.uint32
 
@@ -42,6 +45,26 @@ def empty(n_slots: int, n_actors: int, batch: tuple = ()) -> MVRegState:
         clk=jnp.zeros((*batch, n_slots, n_actors), DTYPE),
         val=jnp.zeros((*batch, n_slots), jnp.int32),
         valid=jnp.zeros((*batch, n_slots), bool),
+    )
+
+
+def widen(state: MVRegState, n_slots: int = 0, n_actors: int = 0) -> MVRegState:
+    """Re-encode into a wider sibling-slot/actor layout (elastic.py).
+    Slot tables are canonical valid-first, so tail padding with dead
+    slots preserves canonical form; new actor lanes are zero (= unseen).
+    0 keeps the current width; shrinking is refused."""
+    s, a = state.clk.shape[-2:]
+    ns, na = n_slots or s, n_actors or a
+    if ns < s or na < a:
+        raise ValueError(f"widen cannot shrink: ({s}, {a}) -> ({ns}, {na})")
+    lead = state.wact.ndim - 1
+    pad = partial(_pad_tail, lead=lead)
+    return MVRegState(
+        wact=pad(state.wact, (0, ns - s)),
+        wctr=pad(state.wctr, (0, ns - s)),
+        clk=pad(state.clk, (0, ns - s), (0, na - a)),
+        val=pad(state.val, (0, ns - s)),
+        valid=pad(state.valid, (0, ns - s)),
     )
 
 

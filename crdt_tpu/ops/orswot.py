@@ -25,6 +25,7 @@ into a log2(N) reduction tree (``fold``) — the device analog of
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -52,6 +53,45 @@ def empty(n_elems: int, n_actors: int, deferred_cap: int = 8, batch: tuple = ())
         dcl=jnp.zeros((*batch, deferred_cap, n_actors), DTYPE),
         dmask=jnp.zeros((*batch, deferred_cap, n_elems), bool),
         dvalid=jnp.zeros((*batch, deferred_cap), bool),
+    )
+
+
+def _pad_tail(x, *tail, lead: int, fill=0):
+    """Tail-pad trailing axes of ``x`` with ``fill`` — the one pad
+    helper every kind's ``widen`` kernel shares (sparse repacks pass
+    dead sentinels like -1; dense absence is the 0/False default)."""
+    spec = ((0, 0),) * lead + tail
+    return jnp.pad(x, spec, constant_values=fill)
+
+
+def widen(
+    state: OrswotState,
+    n_elems: int = 0,
+    n_actors: int = 0,
+    deferred_cap: int = 0,
+) -> OrswotState:
+    """Re-encode a (possibly batched) dense state into a wider layout —
+    the elastic capacity migration (elastic.py). Dense absence is
+    all-zero, so growing an axis is pure zero/False padding at the tail:
+    interned ids keep their lanes, and the result is bit-identical to a
+    from-scratch state of the wider shape holding the same dots. A
+    capacity of 0 keeps the current width; shrinking is refused (lanes
+    may hold live dots)."""
+    e, a = state.ctr.shape[-2:]
+    d = state.dvalid.shape[-1]
+    ne, na, nd = n_elems or e, n_actors or a, deferred_cap or d
+    if ne < e or na < a or nd < d:
+        raise ValueError(
+            f"widen cannot shrink: ({e}, {a}, {d}) -> ({ne}, {na}, {nd})"
+        )
+    lead = state.top.ndim - 1
+    pad = partial(_pad_tail, lead=lead)
+    return OrswotState(
+        top=pad(state.top, (0, na - a)),
+        ctr=pad(state.ctr, (0, ne - e), (0, na - a)),
+        dcl=pad(state.dcl, (0, nd - d), (0, na - a)),
+        dmask=pad(state.dmask, (0, nd - d), (0, ne - e)),
+        dvalid=pad(state.dvalid, (0, nd - d)),
     )
 
 

@@ -53,6 +53,7 @@ from .sparse_orswot import (
     DTYPE,
     _compact_parked,
     _dedupe_parked,
+    _pad_tail,
     _replay_parked,
 )
 
@@ -93,6 +94,44 @@ def empty(
         dcl=jnp.zeros((*batch, deferred_cap, n_actors), DTYPE),
         kidx=jnp.full((*batch, deferred_cap, rm_width), -1, jnp.int32),
         dvalid=jnp.zeros((*batch, deferred_cap), bool),
+    )
+
+
+def widen(
+    state: SparseMVMapState,
+    cell_cap: int = 0,
+    n_actors: int = 0,
+    deferred_cap: int = 0,
+    rm_width: int = 0,
+) -> SparseMVMapState:
+    """Cell-table repack into a wider layout — the elastic capacity
+    migration (elastic.py; segment sibling: sparse_orswot.widen). Dead
+    lanes sort last under canonical order, so every axis grows by tail
+    padding with its dead sentinel; the result is bit-identical to a
+    from-scratch wider table holding the same cells. 0 keeps a width;
+    shrinking is refused."""
+    c, a = state.kid.shape[-1], state.top.shape[-1]
+    d, q = state.kidx.shape[-2:]
+    nc, na = cell_cap or c, n_actors or a
+    nd, nq = deferred_cap or d, rm_width or q
+    if nc < c or na < a or nd < d or nq < q:
+        raise ValueError(
+            f"widen cannot shrink: ({c}, {a}, {d}, {q}) -> "
+            f"({nc}, {na}, {nd}, {nq})"
+        )
+    lead = state.top.ndim - 1
+    pad = partial(_pad_tail, lead=lead)
+    return SparseMVMapState(
+        top=pad(state.top, (0, na - a)),
+        kid=pad(state.kid, (0, nc - c), fill=-1),
+        act=pad(state.act, (0, nc - c)),
+        ctr=pad(state.ctr, (0, nc - c)),
+        val=pad(state.val, (0, nc - c)),
+        clk=pad(state.clk, (0, nc - c), (0, na - a)),
+        valid=pad(state.valid, (0, nc - c), fill=False),
+        dcl=pad(state.dcl, (0, nd - d), (0, na - a)),
+        kidx=pad(state.kidx, (0, nd - d), (0, nq - q), fill=-1),
+        dvalid=pad(state.dvalid, (0, nd - d), fill=False),
     )
 
 

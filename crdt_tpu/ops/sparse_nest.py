@@ -42,6 +42,7 @@ with ALL parked state inside it):
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, NamedTuple
 
 import jax
@@ -54,6 +55,7 @@ from .sparse_orswot import (
     _canon_rmlist,
     _compact_parked,
     _dedupe_parked,
+    _pad_tail,
     _replay_parked,
 )
 
@@ -133,6 +135,82 @@ def _ids_alive(
     counts = lax.psum(counts, element_axis)        # [S, ...]
     me = lax.axis_index(element_axis)
     return (counts[me] > 0).reshape(shape)
+
+
+def widen_level(
+    state: SparseNestState,
+    core_widen,
+    key_deferred_cap: int = 0,
+    key_rm_width: int = 0,
+    n_actors: int = 0,
+) -> SparseNestState:
+    """Widen one nest level's parked-keylist buffer (and, via
+    ``core_widen``, everything inside it) — the elastic capacity
+    migration for nested sparse states (elastic.py). ``core_widen`` maps
+    the core slab to its widened form (compose ``sparse_orswot.widen``/
+    ``sparse_mvmap.widen``/a nested ``widen_level``); 0 keeps a width;
+    shrinking is refused."""
+    d, a = state.kcl.shape[-2:]
+    q = state.kidx.shape[-1]
+    nd, nq = key_deferred_cap or d, key_rm_width or q
+    na = n_actors or a
+    if nd < d or nq < q or na < a:
+        raise ValueError(
+            f"widen cannot shrink: ({d}, {q}, {a}) -> ({nd}, {nq}, {na})"
+        )
+    lead = state.kdvalid.ndim - 1
+    pad = partial(_pad_tail, lead=lead)
+    return type(state)(
+        core_widen(state.core),
+        pad(state.kcl, (0, nd - d), (0, na - a)),
+        pad(state.kidx, (0, nd - d), (0, nq - q), fill=-1),
+        pad(state.kdvalid, (0, nd - d), fill=False),
+    )
+
+
+def rekey_flat(ids: jax.Array, old_span: int, new_span: int) -> jax.Array:
+    """Remap flat leaf ids ``key·old_span + off`` → ``key·new_span +
+    off`` (the segment-table repack of a span widening). Monotone for
+    ``new_span >= old_span`` with offsets < old_span, so canonical
+    segment order survives without a re-sort; negative pads pass
+    through."""
+    return jnp.where(ids >= 0, (ids // old_span) * new_span + ids % old_span, ids)
+
+
+def widen_span(state: SparseNestState, old_span: int, new_span: int) -> SparseNestState:
+    """Re-encode a depth-2 nest under a wider per-key span (more leaf
+    ids per key of THIS level): flat ids in the leaf slab's id plane AND
+    the leaf's own parked lists remap via :func:`rekey_flat`; this
+    level's parked lists hold level-local key ids and are untouched.
+    Keys keep their ids, so the result is bit-identical to a
+    from-scratch nest built at the wider span over the same content.
+    Deeper nests must compose the remap level by level (every
+    intermediate level's lists would need its own rekey) — refused
+    here."""
+    if new_span < old_span:
+        raise ValueError(f"widen_span cannot shrink: {old_span} -> {new_span}")
+    if new_span % old_span:
+        raise ValueError(
+            f"new span {new_span} must be a multiple of the old {old_span} "
+            f"(key-id preservation needs aligned offsets)"
+        )
+    leaf = state.core
+    if isinstance(leaf, SparseNestState):
+        raise TypeError(
+            "widen_span covers depth-2 nests; rekey deeper nests level "
+            "by level with rekey_flat"
+        )
+    if hasattr(leaf, "eid"):
+        new_leaf = leaf._replace(
+            eid=rekey_flat(leaf.eid, old_span, new_span),
+            didx=rekey_flat(leaf.didx, old_span, new_span),
+        )
+    else:  # the sparse register-map cell table (ops/sparse_mvmap.py)
+        new_leaf = leaf._replace(
+            kid=rekey_flat(leaf.kid, old_span, new_span),
+            kidx=rekey_flat(leaf.kidx, old_span, new_span),
+        )
+    return type(state)(new_leaf, state.kcl, state.kidx, state.kdvalid)
 
 
 class SparseLeaf:

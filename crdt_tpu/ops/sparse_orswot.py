@@ -43,7 +43,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from .orswot import OrswotState, empty as dense_empty
+from .orswot import OrswotState, _pad_tail, empty as dense_empty
 
 DTYPE = jnp.uint32
 
@@ -78,6 +78,43 @@ def empty(
         dcl=jnp.zeros((*batch, deferred_cap, n_actors), DTYPE),
         didx=jnp.full((*batch, deferred_cap, rm_width), -1, jnp.int32),
         dvalid=jnp.zeros((*batch, deferred_cap), bool),
+    )
+
+
+def widen(
+    state: SparseOrswotState,
+    dot_cap: int = 0,
+    n_actors: int = 0,
+    deferred_cap: int = 0,
+    rm_width: int = 0,
+) -> SparseOrswotState:
+    """Segment-table repack into a wider layout — the elastic capacity
+    migration (elastic.py). Canonical order puts dead lanes last, so
+    growing any axis is tail padding with the axis's dead sentinel
+    (-1 eids / -1 parked ids / zero lanes / False masks): the valid
+    prefix is untouched and the result is bit-identical to a
+    from-scratch wider table holding the same dots. 0 keeps a width;
+    shrinking is refused (lanes may be live)."""
+    c, a = state.eid.shape[-1], state.top.shape[-1]
+    d, q = state.didx.shape[-2:]
+    nc, na = dot_cap or c, n_actors or a
+    nd, nq = deferred_cap or d, rm_width or q
+    if nc < c or na < a or nd < d or nq < q:
+        raise ValueError(
+            f"widen cannot shrink: ({c}, {a}, {d}, {q}) -> "
+            f"({nc}, {na}, {nd}, {nq})"
+        )
+    lead = state.top.ndim - 1
+    pad = partial(_pad_tail, lead=lead)
+    return SparseOrswotState(
+        top=pad(state.top, (0, na - a)),
+        eid=pad(state.eid, (0, nc - c), fill=-1),
+        act=pad(state.act, (0, nc - c)),
+        ctr=pad(state.ctr, (0, nc - c)),
+        valid=pad(state.valid, (0, nc - c), fill=False),
+        dcl=pad(state.dcl, (0, nd - d), (0, na - a)),
+        didx=pad(state.didx, (0, nd - d), (0, nq - q), fill=-1),
+        dvalid=pad(state.dvalid, (0, nd - d), fill=False),
     )
 
 

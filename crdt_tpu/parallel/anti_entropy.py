@@ -615,6 +615,95 @@ def mesh_gossip_sparse(
     )
 
 
+def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
+                   policy=None):
+    """Ring anti-entropy with elastic capacity recovery — the
+    overflow→widen→resume loop at mesh scale (elastic.py).
+
+    Runs the model family's ring gossip on ``model.state``; when a
+    capacity lane overflows mid-round, the round's result is DISCARDED
+    (the gossip entry points never commit to the model, and the join is
+    idempotent, so re-entering from the pre-round state is sound), the
+    implicated axis widens 2× (policy-configurable) with the live state
+    re-encoded on device, and the ring re-enters. Because the widened
+    state is bit-identical to a from-scratch wider model, the re-entered
+    gossip converges to exactly the full join of the wider family —
+    replicas pause, migrate, and rejoin; nothing replays.
+
+    Returns ``(rows, widened)``: ``rows`` are the per-device converged
+    states ([P, ...] — every row equals the full join after the default
+    P-1 rounds, as in ``mesh_gossip``), ``widened`` the dict of axes
+    grown along the way (empty when capacity sufficed). Widening is
+    administrative — apply the same growth on every host holding the
+    replica set before the next round (elastic.py module docstring)."""
+    from .. import elastic
+    from ..models.map import BatchedMap
+    from ..models.orswot import BatchedOrswot
+    from ..models.sparse_mvmap import BatchedSparseMap
+    from ..models.sparse_nested_map import BatchedSparseNestedMap
+    from ..models.sparse_orswot import BatchedSparseOrswot
+
+    policy = policy or elastic.DEFAULT_POLICY
+
+    def plan(m):
+        # (gossip runner, overflow-flag lane -> elastic axis)
+        if isinstance(m, BatchedOrswot):
+            return (
+                lambda: mesh_gossip(m.state, mesh, rounds),
+                ("deferred_cap",),
+            )
+        if isinstance(m, BatchedSparseOrswot):
+            return (
+                lambda: mesh_gossip_sparse(m.state, mesh, rounds),
+                ("dot_cap", "deferred_cap"),
+            )
+        if isinstance(m, BatchedMap):
+            return (
+                lambda: mesh_gossip_map(m.state, mesh, rounds),
+                ("sibling_cap", "deferred_cap"),
+            )
+        if isinstance(m, BatchedSparseMap):
+            return (
+                lambda: mesh_gossip_sparse_mvmap(
+                    m.state, mesh, rounds, sibling_cap=m.sibling_cap
+                ),
+                ("cell_cap", "deferred_cap", "sibling_cap"),
+            )
+        if isinstance(m, BatchedSparseNestedMap):
+            return (
+                lambda: mesh_gossip_sparse_nested(
+                    m.state, mesh, m.level, rounds
+                ),
+                ("cell_cap", "deferred_cap", "sibling_cap",
+                 "key_deferred_cap"),
+            )
+        raise TypeError(
+            f"gossip_elastic covers the batched set/map family, got "
+            f"{type(m).__name__}"
+        )
+
+    widened: dict = {}
+    migrations = 0
+    while True:
+        run, lanes = plan(model)
+        rows, flags = run()
+        flags = jnp.atleast_1d(flags)
+        hot = tuple(
+            axis for lane, axis in enumerate(lanes) if bool(flags[lane])
+        )
+        if not hot:
+            return rows, widened
+        if migrations >= policy.max_migrations:
+            raise RuntimeError(
+                f"gossip still overflowing after {migrations} migrations "
+                f"(axes grown: {widened}) — raise policy.factor or "
+                f"max_migrations"
+            )
+        metrics.count("elastic.gossip_migrations")
+        widened.update(elastic.widen(model, hot, policy))
+        migrations += 1
+
+
 def mesh_fold_clocks(clocks: jax.Array, mesh: Mesh) -> jax.Array:
     """Converge a batch of vector clocks [R, A] (VClock / GCounter /
     PNCounter states) over the mesh: local max + ``pmax`` across the

@@ -31,7 +31,11 @@ from ..ops.orswot import OrswotState
 
 
 def _axis_size(axis_name: str) -> int:
-    return lax.axis_size(axis_name)
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    # jax < 0.5 has no lax.axis_size; psum of a python literal stays a
+    # static int under tracing, which the round-count loops need.
+    return lax.psum(1, axis_name)
 
 
 def all_reduce_lattice(

@@ -138,6 +138,11 @@ def run_delta_ring(
             state, dirty, fctx
         )
         jax.block_until_ready(out)
+    _warn_residue(kind, out)
+    return out
+
+
+def _warn_residue(kind: str, out) -> None:
     if not isinstance(out[3], jax.core.Tracer):
         # Host-side residue accounting — skipped when the ring runs
         # under an outer jit (callers then read the returned residue).
@@ -151,6 +156,60 @@ def run_delta_ring(
                 f"row-rounds) — the ring is NOT guaranteed converged; raise "
                 f"`rounds` (see the ROUNDS BUDGET note in parallel/delta.py) "
                 f"or `cap`",
-                stacklevel=3,
+                # _warn_residue -> run_delta_ring -> mesh entry -> user.
+                stacklevel=4,
             )
-    return out
+
+
+def delta_gossip_elastic(
+    model,
+    dirty: jax.Array,
+    fctx: jax.Array,
+    mesh: Mesh,
+    rounds: Optional[int] = None,
+    cap: int = 64,
+    local_fold: str = "auto",
+    policy=None,
+):
+    """δ-ring anti-entropy with elastic capacity recovery for dense
+    ORSWOT replica batches (``BatchedOrswot``): the mid-round
+    overflow→widen→resume loop of ``anti_entropy.gossip_elastic``, δ
+    flavored.
+
+    When a ring run flags parked-buffer overflow, the run's result is
+    discarded (the δ entry never commits to the model), the replica
+    pauses while ``deferred_cap`` widens 2× (policy-configurable) with
+    the live state re-encoded on device, and the ring re-enters with the
+    SAME (dirty, fctx) tracking — sound because the rejected run
+    mutated nothing, the widened state is bit-identical to a
+    wider-born one, and the tracking contract (delta.py) binds dirty
+    marks to dots, not to layout. Element/actor-axis growth composes
+    the same way: ``mesh_delta_gossip`` re-pads dirty/fctx to the
+    state's (post-migration) shape. The residue certificate is
+    unchanged — the re-entered ring's ``residue == 0`` still proves the
+    gossip equals the full join of the widened family.
+
+    Returns ``(states, dirty, overflow, residue, widened)`` — the
+    ``mesh_delta_gossip`` tuple plus the dict of axes grown (empty when
+    capacity sufficed)."""
+    from .. import elastic
+    from .delta import mesh_delta_gossip
+
+    policy = policy or elastic.DEFAULT_POLICY
+    widened: dict = {}
+    migrations = 0
+    while True:
+        out = mesh_delta_gossip(
+            model.state, dirty, fctx, mesh, rounds, cap, local_fold
+        )
+        if not bool(jnp.any(out[2])):
+            return (*out, widened)
+        if migrations >= policy.max_migrations:
+            raise RuntimeError(
+                f"δ ring still overflowing after {migrations} migrations "
+                f"(axes grown: {widened}) — raise policy.factor or "
+                f"max_migrations"
+            )
+        metrics.count("elastic.delta_migrations")
+        widened.update(elastic.widen(model, ("deferred_cap",), policy))
+        migrations += 1

@@ -40,6 +40,16 @@ def initialize(
     the cloud-TPU metadata server)."""
     import jax
 
+    if "cpu" in str(jax.config.jax_platforms or "cpu").lower():
+        # The default XLA CPU client rejects multiprocess programs
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend"); the gloo transport is the CPU stand-in for
+        # ICI/DCN. Harmless on TPU (the flag only shapes CPU-client
+        # construction, which happens after this call).
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # older jax without the option: keep its default
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

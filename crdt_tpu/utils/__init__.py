@@ -1,5 +1,15 @@
 """Host-side utilities: interning, serialization, checkpoint, metrics."""
 
-from .interner import Interner, clock_lanes, pad_id_list, transactional, transactional_apply
+from .interner import (
+    Interner,
+    UniverseFull,
+    clock_lanes,
+    pad_id_list,
+    transactional,
+    transactional_apply,
+)
 
-__all__ = ["Interner", "clock_lanes", "pad_id_list", "transactional", "transactional_apply"]
+__all__ = [
+    "Interner", "UniverseFull", "clock_lanes", "pad_id_list",
+    "transactional", "transactional_apply",
+]

@@ -14,6 +14,13 @@ import numpy as np
 from typing import Any, Dict, Iterable, Iterator, List
 
 
+class UniverseFull(IndexError):
+    """A bounded intern would land outside the device array's lanes.
+    Subclasses IndexError (the historical signal) so existing handlers
+    keep working; elastic.py catches THIS type, so unrelated
+    IndexErrors are never mistaken for capacity pressure."""
+
+
 class Interner:
     __slots__ = ("_ids", "_items")
 
@@ -38,18 +45,18 @@ class Interner:
 
     def bounded_intern(self, item: Any, cap: int, what: str = "item") -> int:
         """Id for ``item``, allocating into a ``cap``-lane universe.
-        IndexError (not a silent out-of-bounds scatter) when the id
-        would land outside the device array's lanes."""
+        UniverseFull (an IndexError, not a silent out-of-bounds scatter)
+        when the id would land outside the device array's lanes."""
         ix = self._ids.get(item)
         if ix is None:
             if len(self._items) >= cap:
-                raise IndexError(
+                raise UniverseFull(
                     f"{what} {item!r}: the {cap}-lane universe is full; "
                     f"rebuild with more lanes"
                 )
             return self.intern(item)
         if ix >= cap:
-            raise IndexError(
+            raise UniverseFull(
                 f"{what} {item!r} (id {ix}) outside the {cap}-lane "
                 f"universe; rebuild with more lanes"
             )
@@ -130,8 +137,6 @@ def pad_id_list(items, width=None):
     keylist encoding of the sparse backends). ``width=None`` picks a
     power-of-two bucket >= 8 to bound jit retraces; an explicit width is
     the buffer lane size and overflow raises."""
-    import numpy as np
-
     ids = sorted(items)
     if width is None:
         width = 8

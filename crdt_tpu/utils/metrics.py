@@ -9,6 +9,14 @@ home, so the framework keeps one process-global registry:
 - ``metrics.time(name)``              — wall-clock context manager,
 - ``metrics.snapshot()`` / ``reset()``.
 
+Elastic capacity pressure (fed by crdt_tpu/elastic.py; visible in the
+bench metrics snapshot): ``elastic.widen_events`` (+ per-kind
+``elastic.widen_events.<kind>``) and ``elastic.migrated_bytes``
+counters for every overflow→widen→resume migration, and
+``elastic.<kind>.headroom.<axis>`` free-fraction gauges (0.0 = at
+capacity — the operator signal to widen BEFORE overflow) refreshed by
+``elastic.record_headroom``.
+
 ``profile_trace(logdir)`` wraps ``jax.profiler.trace`` for device-level
 timelines (viewable in TensorBoard/XProf; SURVEY.md §6.1) and degrades
 to a no-op where the profiler is unavailable.

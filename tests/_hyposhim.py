@@ -1,0 +1,244 @@
+"""Fallback property-test runner for environments without ``hypothesis``.
+
+The suite's ground truth is hypothesis-driven; some CI images ship the
+jax toolchain but not hypothesis, and the tier-1 gate must still run.
+``conftest.py`` imports this module ONLY when ``import hypothesis``
+fails, and it installs itself as ``hypothesis`` / ``hypothesis.strategies``
+in ``sys.modules`` — with the real package present it is never loaded.
+
+Scope: exactly the API surface the suite uses — ``given``, ``settings``
+(decorator + profile registry), and the strategies ``integers``,
+``booleans``, ``sampled_from``, ``dictionaries``, ``sets``, ``lists``,
+``tuples``, ``just``, ``one_of``, ``data`` plus ``.map``/``.filter``.
+Draws are plain deterministic PRNG sampling (seeded per test + example
+index, so failures reproduce run to run); there is no shrinking, no
+example database, and no health checks. The drawn values of a failing
+example are printed to stderr before the exception propagates.
+
+``HYPOSHIM_MAX_EXAMPLES`` caps per-test example counts (default 20) so
+the fallback suite fits the tier-1 wall-clock budget; set it higher for
+a deeper local run.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import os
+import random
+import sys
+import types
+import zlib
+from typing import Any, Callable, Dict, Optional
+
+_EXAMPLE_CAP = int(os.environ.get("HYPOSHIM_MAX_EXAMPLES", "20"))
+
+
+class SearchStrategy:
+    """A draw function wrapper: ``draw(rng) -> value``."""
+
+    def __init__(self, draw: Callable[[random.Random], Any]):
+        self._draw = draw
+
+    def draw(self, rng: random.Random) -> Any:
+        return self._draw(rng)
+
+    def map(self, fn: Callable[[Any], Any]) -> "SearchStrategy":
+        return SearchStrategy(lambda rng: fn(self._draw(rng)))
+
+    def filter(self, pred: Callable[[Any], bool]) -> "SearchStrategy":
+        def drawer(rng: random.Random):
+            for _ in range(1000):
+                v = self._draw(rng)
+                if pred(v):
+                    return v
+            raise RuntimeError("filter predicate rejected 1000 draws")
+
+        return SearchStrategy(drawer)
+
+
+def integers(min_value: int = 0, max_value: Optional[int] = None) -> SearchStrategy:
+    hi = (2**64 if max_value is None else max_value)
+    return SearchStrategy(lambda rng: rng.randint(min_value, hi))
+
+
+def booleans() -> SearchStrategy:
+    return SearchStrategy(lambda rng: rng.random() < 0.5)
+
+
+def sampled_from(seq) -> SearchStrategy:
+    items = list(seq)
+    return SearchStrategy(lambda rng: items[rng.randrange(len(items))])
+
+
+def just(value) -> SearchStrategy:
+    return SearchStrategy(lambda rng: value)
+
+
+def one_of(*strategies) -> SearchStrategy:
+    return SearchStrategy(
+        lambda rng: strategies[rng.randrange(len(strategies))].draw(rng)
+    )
+
+
+def lists(elements: SearchStrategy, min_size: int = 0,
+          max_size: Optional[int] = None) -> SearchStrategy:
+    hi = min_size + 8 if max_size is None else max_size
+    return SearchStrategy(
+        lambda rng: [elements.draw(rng) for _ in range(rng.randint(min_size, hi))]
+    )
+
+
+def tuples(*strategies) -> SearchStrategy:
+    return SearchStrategy(lambda rng: tuple(s.draw(rng) for s in strategies))
+
+
+def sets(elements: SearchStrategy, min_size: int = 0,
+         max_size: Optional[int] = None) -> SearchStrategy:
+    hi = min_size + 8 if max_size is None else max_size
+
+    def drawer(rng: random.Random):
+        want = rng.randint(min_size, hi)
+        out = set()
+        for _ in range(200):
+            if len(out) >= want:
+                break
+            out.add(elements.draw(rng))
+        return out
+
+    return SearchStrategy(drawer)
+
+
+def dictionaries(keys: SearchStrategy, values: SearchStrategy,
+                 min_size: int = 0,
+                 max_size: Optional[int] = None) -> SearchStrategy:
+    key_sets = sets(keys, min_size, max_size)
+    return SearchStrategy(
+        lambda rng: {k: values.draw(rng) for k in key_sets.draw(rng)}
+    )
+
+
+class DataObject:
+    """The interactive-draw handle behind ``st.data()``."""
+
+    def __init__(self, rng: random.Random):
+        self._rng = rng
+        self.drawn = []
+
+    def draw(self, strategy: SearchStrategy, label: Optional[str] = None):
+        v = strategy.draw(self._rng)
+        self.drawn.append(v if label is None else (label, v))
+        return v
+
+
+class _DataStrategy(SearchStrategy):
+    def __init__(self):
+        super().__init__(lambda rng: DataObject(rng))
+
+
+def data() -> _DataStrategy:
+    return _DataStrategy()
+
+
+class settings:
+    """Per-test overrides + the tiny profile registry conftest uses."""
+
+    _profiles: Dict[str, Dict[str, Any]] = {"default": {"max_examples": 100}}
+    _current: Dict[str, Any] = _profiles["default"]
+
+    def __init__(self, parent=None, **kwargs):
+        self.kwargs = kwargs
+
+    def __call__(self, fn):
+        fn._hyposhim_settings = self.kwargs
+        return fn
+
+    @classmethod
+    def register_profile(cls, name: str, parent=None, **kwargs) -> None:
+        cls._profiles[name] = kwargs
+
+    @classmethod
+    def load_profile(cls, name: str) -> None:
+        cls._current = cls._profiles[name]
+
+    @classmethod
+    def max_examples_for(cls, fn) -> int:
+        override = getattr(fn, "_hyposhim_settings", {})
+        n = override.get("max_examples", cls._current.get("max_examples", 100))
+        return max(1, min(int(n), _EXAMPLE_CAP))
+
+
+def given(*arg_strategies, **kw_strategies):
+    """Run the test once per example with freshly drawn arguments.
+
+    Positional strategies bind to the RIGHTMOST parameters (hypothesis
+    convention — leading parameters stay visible to pytest as fixtures
+    or parametrize targets); keyword strategies bind by name."""
+
+    def deco(fn):
+        sig = inspect.signature(fn)
+        params = list(sig.parameters)
+        bound = set(kw_strategies)
+        if arg_strategies:
+            free = [p for p in params if p not in bound]
+            tail = free[len(free) - len(arg_strategies):]
+            bound |= set(tail)
+            positional = dict(zip(tail, arg_strategies))
+        else:
+            positional = {}
+        fixture_params = [p for p in params if p not in bound]
+
+        @functools.wraps(fn)
+        def wrapper(*wargs, **wkwargs):
+            fixtures = dict(zip(fixture_params, wargs))
+            fixtures.update(wkwargs)
+            # Read from the WRAPPER: @settings above @given lands its
+            # overrides there (below @given they are copied across).
+            n = settings.max_examples_for(wrapper)
+            base = zlib.crc32(
+                f"{fn.__module__}:{fn.__qualname__}".encode()
+            )
+            for i in range(n):
+                rng = random.Random((base << 20) + i)
+                drawn = {name: s.draw(rng) for name, s in positional.items()}
+                drawn.update(
+                    {name: s.draw(rng) for name, s in kw_strategies.items()}
+                )
+                try:
+                    fn(**fixtures, **drawn)
+                except Exception:
+                    shown = {
+                        k: (v.drawn if isinstance(v, DataObject) else v)
+                        for k, v in drawn.items()
+                    }
+                    print(
+                        f"[hyposhim] falsifying example {i + 1}/{n} for "
+                        f"{fn.__qualname__}: {shown!r}",
+                        file=sys.stderr,
+                    )
+                    raise
+
+        wrapper.__signature__ = inspect.Signature(
+            [sig.parameters[p] for p in fixture_params]
+        )
+        wrapper._hyposhim_settings = getattr(fn, "_hyposhim_settings", {})
+        return wrapper
+
+    return deco
+
+
+def _install() -> None:
+    """Register this module as ``hypothesis`` (+ ``.strategies``)."""
+    hyp = types.ModuleType("hypothesis")
+    hyp.given = given
+    hyp.settings = settings
+    hyp.SearchStrategy = SearchStrategy
+    st_mod = types.ModuleType("hypothesis.strategies")
+    for name in (
+        "integers", "booleans", "sampled_from", "just", "one_of", "lists",
+        "tuples", "sets", "dictionaries", "data", "SearchStrategy",
+    ):
+        setattr(st_mod, name, globals()[name])
+    hyp.strategies = st_mod
+    sys.modules["hypothesis"] = hyp
+    sys.modules["hypothesis.strategies"] = st_mod

@@ -21,15 +21,44 @@ pin_cpu(virtual_devices=8)
 
 import jax
 
+# Persistent XLA compilation cache: the suite is compile-bound on CPU
+# (hundreds of shard_map/jit programs), and the cache is keyed on the
+# HLO so it is safe across reruns. First run warms it; repeat runs of
+# the same suite drop well under the tier-1 time budget.
+_CACHE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+)
+# Env vars too, not just jax.config: the multihost/example tests spawn
+# worker subprocesses (inheriting os.environ) that must hit the same
+# cache — their cold compiles otherwise dominate those tests.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
+try:
+    jax.config.update("jax_compilation_cache_dir", _CACHE)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+except Exception:
+    pass  # older jax without the persistent cache: run uncached
+
 assert jax.default_backend() == "cpu", jax.default_backend()
 assert len(jax.devices()) == 8, jax.devices()
 
 import pytest
 
+try:
+    import hypothesis  # noqa: F401  (the real package, when installed)
+except ModuleNotFoundError:
+    # Some CI images ship jax but not hypothesis; the property suite
+    # still runs on the deterministic fallback sampler (_hyposhim.py).
+    from _hyposhim import _install
+
+    _install()
+
 from hypothesis import settings
 
-# One CPU core in CI: keep example counts modest by default.
-settings.register_profile("ci", max_examples=40, deadline=None)
+# One CPU core in CI: keep example counts modest by default (24 keeps
+# the full tier-1 suite inside its wall-clock budget on this box; crank
+# locally with an explicit @settings(max_examples=...) on the test).
+settings.register_profile("ci", max_examples=24, deadline=None)
 settings.load_profile("ci")
 # Quick-iteration profile for the smoke subset (selected below).
 settings.register_profile("smoke", max_examples=8, deadline=None)
@@ -62,9 +91,35 @@ SMOKE_PREFIXES = (
 )
 
 
+# ---- slow tier ------------------------------------------------------------
+# Tier-1 CI runs ``-m 'not slow'`` under a hard wall-clock budget. These
+# are the heaviest gates whose law/path each has a faster cousin that
+# stays in tier-1 (named alongside); run the full set with plain
+# ``pytest tests/``. Curated here, like SMOKE_PREFIXES, to stay auditable.
+SLOW_NODEIDS = (
+    # deep-nesting demo; 01/03 cover the example harness, nest laws in
+    # test_models_map_nested / test_sparse_nested_map
+    "test_examples.py::test_example_runs[06_deep_nesting_and_sparse.py]",
+    # 2-process fold; examples/04_multihost_dcn.py drives the same
+    # worker pair, and test_two_process_list_sync keeps the runtime gate
+    "test_multihost.py::test_two_process_mesh_fold_bit_identical",
+    # depth-3 sparse laws; depth-2 laws in test_sparse_mvmap.py, dense
+    # depth-3 in test_models_map3 / test_delta_map3
+    "test_sparse_mvmap_depth3.py::test_depth3_join_laws",
+    "test_sparse_mvmap_depth3.py::test_depth3_fold_equals_sequential_joins",
+    # deep sparse-nest folds; depth-2 fold in test_sparse_nest.py,
+    # depth-4 single-shot fold gate stays (test_nest_depth4)
+    "test_sparse_nest3.py::test_sparse_depth3_fold_matches_oracle",
+    "test_nest_depth4.py::test_depth4_delta_exchange_converges",
+)
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "smoke: one fast A/B gate per CRDT family (~1 min subset)"
+    )
+    config.addinivalue_line(
+        "markers", "slow: heavyweight gates excluded from tier-1 CI"
     )
     if (config.getoption("-m") or "").strip() == "smoke":
         settings.load_profile("smoke")
@@ -74,6 +129,8 @@ def pytest_collection_modifyitems(config, items):
     seen = set()
     for item in items:
         nodeid = item.nodeid.split("/")[-1]
+        if nodeid in SLOW_NODEIDS:
+            item.add_marker(pytest.mark.slow)
         for p in SMOKE_PREFIXES:
             if nodeid.startswith(p) and p not in seen:
                 seen.add(p)

@@ -1,0 +1,500 @@
+"""Elastic capacity manager — overflow→widen→resume (crdt_tpu/elastic.py).
+
+The contract under test (ISSUE 1): a replica that hits a capacity
+overflow mid-gossip can widen the implicated axis, rejoin the ring, and
+reach a converged state BIT-IDENTICAL to the full join of a from-scratch
+model built at the wider capacity — for the dense ORSWOT, sparse ORSWOT,
+and sparse ``Map<K, MVReg>`` flavors — and the migration composes with
+lifecycle.py dtype widening and checkpoint.py round-trips.
+
+Runs on the 8-virtual-CPU-device mesh from conftest.py.
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from crdt_tpu import elastic
+from crdt_tpu.models.orswot import BatchedOrswot, DeferredOverflow
+from crdt_tpu.models.sparse_mvmap import BatchedSparseMap
+from crdt_tpu.models.sparse_orswot import (
+    BatchedSparseOrswot,
+    DotCapacityOverflow,
+)
+from crdt_tpu.parallel import gossip_elastic, make_mesh, mesh_gossip
+from crdt_tpu.pure.orswot import Orswot
+from crdt_tpu.utils.metrics import metrics
+from crdt_tpu.vclock import VClock
+
+from test_map import mv_map, put
+
+
+def _trees_equal(a, b) -> bool:
+    return all(
+        x.dtype == y.dtype and x.shape == y.shape and bool((x == y).all())
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _orswot_pures(n_replicas: int, parked_each: int, rng=None):
+    """Replicas with live adds plus ``parked_each`` UNABSORBABLE parked
+    removes each (phantom-actor clocks no add ever covers) — globally
+    distinct, so ring joins must hold the union and a small
+    ``deferred_cap`` overflows MID-GOSSIP, not at build time."""
+    reps = [Orswot() for _ in range(n_replicas)]
+    for i, p in enumerate(reps):
+        adds = 1 if rng is None else rng.randint(1, 2)
+        for j in range(adds):
+            p.apply(p.add(f"m{i}_{j}", p.read().derive_add_ctx(f"s{i}")))
+        for j in range(parked_each):
+            p.deferred[VClock({f"ghost{i}_{j}": 1})] = {f"m{i}_0"}
+    return reps
+
+
+@pytest.mark.smoke
+@given(st.integers(0, 2**32 - 1))
+@settings(max_examples=5, deadline=None)
+def test_orswot_overflow_widen_converge_bit_identical(seed):
+    rng = random.Random(seed)
+    mesh = make_mesh(4, 2)
+    reps = _orswot_pures(4, parked_each=2, rng=rng)
+
+    # Fixed member/actor floors keep shapes example-independent, so the
+    # gossip programs compile once across hypothesis examples.
+    floors = dict(n_members=8, n_actors=16)
+    model = BatchedOrswot.from_pure(reps, deferred_cap=2, **floors)
+    # The union of 8 distinct parked clocks cannot fit 2 lanes: the
+    # plain ring flags overflow mid-round.
+    _, overflow = mesh_gossip(model.state, mesh)
+    assert bool(overflow)
+
+    rows, widened = gossip_elastic(model, mesh)
+    assert "deferred_cap" in widened and widened["deferred_cap"] >= 8
+
+    # From-scratch model born at the widened capacity: its gossip rows
+    # must equal the recovered ones bit for bit.
+    fresh = BatchedOrswot.from_pure(
+        reps, deferred_cap=widened["deferred_cap"], **floors
+    )
+    fresh_rows, fresh_overflow = mesh_gossip(fresh.state, mesh)
+    assert not bool(fresh_overflow)
+    assert _trees_equal(rows, fresh_rows)
+
+    # Pure↔device A/B gate: every converged row reads back as the
+    # oracle fold (live members AND the still-parked removes).
+    oracle = reps[0].clone()
+    for p in reps[1:]:
+        oracle.merge(p.clone())
+    out = BatchedOrswot(
+        1, rows.ctr.shape[-2], rows.ctr.shape[-1], rows.dcl.shape[-2],
+        members=model.members, actors=model.actors,
+    )
+    for i in range(rows.top.shape[0]):
+        out.state = jax.tree.map(lambda x: x[i][None], rows)
+        assert out.to_pure(0) == oracle
+
+
+@pytest.mark.smoke
+def test_sparse_orswot_overflow_widen_converge_bit_identical():
+    mesh = make_mesh(4, 2)
+    reps = [Orswot() for _ in range(4)]
+    for i, p in enumerate(reps):
+        for j in range(3):
+            p.apply(p.add(f"m{i}_{j}", p.read().derive_add_ctx(f"s{i}")))
+
+    # 3 live dots per replica fit dot_cap=4; the 12-dot union cannot:
+    # the segment table overflows mid-gossip.
+    model = BatchedSparseOrswot.from_pure(reps, dot_cap=4)
+    rows, widened = gossip_elastic(model, mesh)
+    assert widened.get("dot_cap", 0) >= 12
+
+    fresh = BatchedSparseOrswot.from_pure(reps, dot_cap=widened["dot_cap"])
+    fresh_rows, fresh_overflow = gossip_elastic(fresh, mesh)
+    assert fresh_overflow == {}
+    assert _trees_equal(rows, fresh_rows)
+
+    oracle = reps[0].clone()
+    for p in reps[1:]:
+        oracle.merge(p.clone())
+    out = BatchedSparseOrswot(
+        1, rows.eid.shape[-1], rows.top.shape[-1], rows.dcl.shape[-2],
+        rows.didx.shape[-1], members=model.members, actors=model.actors,
+    )
+    for i in range(rows.top.shape[0]):
+        out.state = jax.tree.map(lambda x: x[i][None], rows)
+        assert out.to_pure(0) == oracle
+
+
+@pytest.mark.smoke
+def test_sparse_map_overflow_widen_converge_bit_identical():
+    mesh = make_mesh(4, 2)
+    pures = []
+    for i in range(4):
+        m = mv_map()
+        for j in range(3):
+            put(m, f"s{i}", f"k{i}_{j}", i * 10 + j)
+        pures.append(m)
+
+    # Disjoint key sets: 3 live cells per replica, a 12-cell union —
+    # cell_cap=4 overflows mid-gossip.
+    model = BatchedSparseMap.from_pure(pures, cell_cap=4)
+    rows, widened = gossip_elastic(model, mesh)
+    assert widened.get("cell_cap", 0) >= 12
+
+    fresh = BatchedSparseMap.from_pure(pures, cell_cap=widened["cell_cap"])
+    fresh_rows, fresh_overflow = gossip_elastic(fresh, mesh)
+    assert fresh_overflow == {}
+    assert _trees_equal(rows, fresh_rows)
+
+    oracle = pures[0].clone()
+    for p in pures[1:]:
+        oracle.merge(p.clone())
+    out = BatchedSparseMap(
+        1, model.n_keys, rows.top.shape[-1], rows.kid.shape[-1],
+        model.sibling_cap, rows.dcl.shape[-2], rows.kidx.shape[-1],
+        keys=model.keys, actors=model.actors, values=model.values,
+    )
+    for i in range(rows.top.shape[0]):
+        out.state = jax.tree.map(lambda x: x[i][None], rows)
+        assert out.to_pure(0) == oracle
+
+
+def test_delta_gossip_elastic_recovers_parked_overflow():
+    """The δ-ring flavor: a parked-buffer overflow mid-δ-round widens
+    ``deferred_cap`` and the re-entered ring converges (residue 0) to
+    the same rows as a wider-born model under the SAME tracking."""
+    import jax.numpy as jnp
+
+    from crdt_tpu.parallel import delta_gossip_elastic, mesh_delta_gossip
+
+    mesh = make_mesh(4, 2)
+    reps = _orswot_pures(4, parked_each=2)
+    floors = dict(n_members=8, n_actors=16)
+    model = BatchedOrswot.from_pure(reps, deferred_cap=2, **floors)
+    dirty = jnp.ones((4, 8), bool)
+    fctx = jnp.zeros((4, 8, 16), jnp.uint32)
+
+    plain = mesh_delta_gossip(model.state, dirty, fctx, mesh)
+    assert bool(jnp.any(plain[2]))  # the parked union overflows 2 lanes
+
+    rows, _, overflow, residue, widened = delta_gossip_elastic(
+        model, dirty, fctx, mesh
+    )
+    assert not bool(jnp.any(overflow))
+    assert int(residue) == 0
+    assert widened.get("deferred_cap", 0) >= 8
+
+    fresh = BatchedOrswot.from_pure(
+        reps, deferred_cap=widened["deferred_cap"], **floors
+    )
+    f_rows, _, f_overflow, f_residue = mesh_delta_gossip(
+        fresh.state, dirty, fctx, mesh
+    )
+    assert not bool(jnp.any(f_overflow)) and int(f_residue) == 0
+    assert _trees_equal(rows, f_rows)
+
+
+def test_gossip_elastic_map_family_branch():
+    """The dense composition-layer branch of gossip_elastic: a
+    ``BatchedMap`` whose parked keyset-removes overflow mid-gossip
+    widens deferred_cap and converges to the wider-born rows."""
+    from crdt_tpu.models import BatchedMap
+
+    mesh = make_mesh(4, 2)
+    pures = []
+    for i in range(4):
+        m = mv_map()
+        put(m, f"s{i}", f"k{i}", i)
+        for j in range(2):
+            m.deferred[VClock({f"g{i}_{j}": 1})] = {f"k{i}"}
+        pures.append(m)
+    model = BatchedMap.from_pure(pures, deferred_cap=2)
+    rows, widened = gossip_elastic(model, mesh)
+    assert widened.get("deferred_cap", 0) >= 8
+
+    fresh = BatchedMap.from_pure(pures, deferred_cap=widened["deferred_cap"])
+    fresh_rows, fresh_widened = gossip_elastic(fresh, mesh)
+    assert fresh_widened == {}
+    assert _trees_equal(rows, fresh_rows)
+
+
+def test_elastic_call_recovers_apply_overflow():
+    """The op-path loop, twice over: the op first hits a FULL member
+    universe (IndexError), then — member lanes widened — a full
+    deferred buffer (DeferredOverflow); each migration retries and the
+    op finally lands (sound: rejected ops are side-effect free)."""
+    reps = _orswot_pures(1, parked_each=2)
+    model = BatchedOrswot.from_pure(reps, deferred_cap=2, n_actors=8)
+    remover = Orswot()
+    remover.apply(remover.add("mx", remover.read().derive_add_ctx("zz")))
+    op = remover.rm("mx", remover.contains("mx").derive_rm_ctx())
+    with pytest.raises(IndexError):
+        model.apply(0, op)
+    elastic.elastic_call(lambda: model.apply(0, op), model)
+    assert model.state.ctr.shape[-2] >= 2  # member universe widened
+    assert model.state.dvalid.shape[-1] > 2  # deferred buffer widened
+    assert len(model.to_pure(0).deferred) == 3
+
+
+def test_elastic_call_recovers_rm_width_overflow():
+    """An rm keyset wider than the parked keylist lane raises
+    DeferredOverflow (the lane is a parked-state bound, not a caller
+    bug), so the recovery loop must widen rm_width — not spin on
+    deferred_cap and re-raise (the failure mode before rm_width joined
+    the DeferredOverflow implication)."""
+    from crdt_tpu.models.sparse_mvmap import BatchedSparseMap
+    from crdt_tpu.pure.map import Map
+    from crdt_tpu.pure.mvreg import MVReg
+
+    mirror = Map(val_default=MVReg)
+    keys = [f"k{i}" for i in range(5)]
+    for k in keys:
+        op = mirror.update(
+            k, mirror.len().derive_add_ctx("s0"),
+            lambda reg, c: reg.write(1, c),
+        )
+        mirror.apply(op)
+    model = BatchedSparseMap.from_pure([mirror], rm_width=2, n_actors=4)
+
+    rm = mirror.rm_all(keys, mirror.len().derive_rm_ctx())
+    mirror.apply(rm)
+    with pytest.raises(DeferredOverflow):
+        model.apply(0, rm)
+    elastic.elastic_call(lambda: model.apply(0, rm), model)
+    assert model.state.kidx.shape[-1] >= 5  # rm_width widened
+    assert model.to_pure(0) == mirror
+
+
+def test_widen_refuses_shrink_and_unknown_axes():
+    model = BatchedOrswot.from_pure(_orswot_pures(2, 1), deferred_cap=2)
+    with pytest.raises(ValueError):
+        elastic.widen(model, ("no_such_axis",))
+    with pytest.raises(ValueError):
+        model.widen_capacity(deferred_cap=1)
+    with pytest.raises(ValueError):
+        elastic.widen(model)  # nothing to widen
+
+
+def test_widen_emits_metrics_and_headroom():
+    metrics.reset()
+    model = BatchedSparseOrswot.from_pure(_orswot_pures(2, 1), dot_cap=8)
+    elastic.record_headroom(model)
+    snap = metrics.snapshot()
+    assert "elastic.sparse_orswot.headroom.dot_cap" in snap["gauges"]
+
+    elastic.widen(model, ("dot_cap",))
+    snap = metrics.snapshot()
+    assert snap["counters"]["elastic.widen_events"] == 1
+    assert snap["counters"]["elastic.widen_events.sparse_orswot"] == 1
+    assert snap["counters"]["elastic.migrated_bytes"] > 0
+    # Headroom gauges refresh on migration: the widened axis frees up.
+    free = snap["gauges"]["elastic.sparse_orswot.headroom.dot_cap"]["last"]
+    assert free > 0.5
+
+
+def test_widen_composes_with_dtype_migration():
+    """u32→u64 + capacity 2× in ONE migration (elastic.migrate riding
+    lifecycle.py's x64 contract) — oracle form unchanged."""
+    from crdt_tpu.config import configured
+
+    reps = _orswot_pures(2, 1)
+    model = BatchedOrswot.from_pure(reps, deferred_cap=2)
+    before = [model.to_pure(i) for i in range(2)]
+    caps_before = elastic.capacities(model)
+    with pytest.raises(RuntimeError, match="x64"):
+        elastic.widen_dtype(model)  # same guard as lifecycle.py
+    with configured(counter_dtype="uint64", strict=True):
+        grown = elastic.migrate(
+            model, counter_dtype="uint64", axes=("n_members", "deferred_cap")
+        )
+        assert model.state.top.dtype == np.dtype("uint64")
+        assert model.state.ctr.dtype == np.dtype("uint64")
+        assert grown["n_members"] == 2 * caps_before["n_members"]
+        assert grown["deferred_cap"] == 2 * caps_before["deferred_cap"]
+        assert [model.to_pure(i) for i in range(2)] == before
+        # The widened model still takes ops (the resumed-replica path).
+        p = model.to_pure(0)
+        model.apply(0, p.add("fresh", p.read().derive_add_ctx("s0")))
+        assert "fresh" in model.to_pure(0).read().val
+
+
+def test_widen_then_checkpoint_then_resume(tmp_path):
+    """Post-widening shapes round-trip through checkpoint.py and the
+    restored replica resumes gossip."""
+    from crdt_tpu import checkpoint
+
+    mesh = make_mesh(4, 2)
+    reps = [Orswot() for _ in range(4)]
+    for i, p in enumerate(reps):
+        for j in range(3):
+            p.apply(p.add(f"m{i}_{j}", p.read().derive_add_ctx(f"s{i}")))
+    model = BatchedSparseOrswot.from_pure(reps, dot_cap=4)
+    model.widen_capacity(dot_cap=16, deferred_cap=8)
+
+    path = tmp_path / "widened.npz"
+    checkpoint.save(path, model)
+    restored = checkpoint.load(path)
+    assert _trees_equal(restored.state, model.state)
+    assert elastic.capacities(restored) == elastic.capacities(model)
+
+    rows, widened = gossip_elastic(restored, mesh)
+    assert widened == {}  # 16 lanes hold the 12-dot union
+    fresh = BatchedSparseOrswot.from_pure(reps, dot_cap=16, deferred_cap=8)
+    fresh_rows, _ = gossip_elastic(fresh, mesh)
+    assert _trees_equal(rows, fresh_rows)
+
+
+def test_sparse_nested_checkpoint_persists_n_keys1(tmp_path):
+    """checkpoint.py regression: the outer key-universe bound survives
+    the round trip instead of silently reloading as the packing max."""
+    from crdt_tpu import checkpoint
+    from crdt_tpu.models.sparse_nested_map import BatchedSparseNestedMap
+
+    model = BatchedSparseNestedMap(2, span=8, n_actors=4, n_keys1=100)
+    path = tmp_path / "nested.npz"
+    checkpoint.save(path, model)
+    restored = checkpoint.load(path)
+    assert restored.n_keys1 == 100
+    assert restored.span == model.span
+    assert _trees_equal(restored.state, model.state)
+
+
+def test_sparse_nested_constructor_rejects_overwide_n_keys1():
+    """models/sparse_nested_map.py regression: an n_keys1 beyond the
+    int32 packing cap raises instead of silently clamping."""
+    from crdt_tpu.models.sparse_nested_map import BatchedSparseNestedMap
+
+    cap1 = (2**31 - 1) // (8 * 4)
+    with pytest.raises(ValueError, match="packed-key cap"):
+        BatchedSparseNestedMap(1, span=8, n_actors=4, n_keys1=cap1 + 1)
+    # At the cap exactly: fine.
+    m = BatchedSparseNestedMap(1, span=8, n_actors=4, n_keys1=cap1)
+    assert m.n_keys1 == cap1
+
+
+def test_sparse_nested_widen_span_and_keys():
+    """Span widening remaps flat ids k1·span+k2 → k1·span'+k2 on device:
+    the nested model reads back identically and accepts inner keys the
+    old span refused."""
+    from crdt_tpu.models.sparse_nested_map import BatchedSparseNestedMap
+    from crdt_tpu.pure.map import Map
+    from crdt_tpu.pure.mvreg import MVReg
+
+    def nested():
+        return Map(val_default=lambda: Map(val_default=MVReg))
+
+    pures = []
+    for i in range(2):
+        m = nested()
+        ctx = m.len().derive_add_ctx(f"s{i}")
+        op = m.update(
+            "outer", ctx,
+            lambda child, c: child.update(
+                f"in{i}", c, lambda reg, cc: reg.write(i, cc)
+            ),
+        )
+        m.apply(op)
+        pures.append(m)
+    model = BatchedSparseNestedMap.from_pure(pures, span=4)
+    before = [model.to_pure(i) for i in range(2)]
+    widened = elastic.widen(model, ("span",))
+    assert model.span == 8 and widened["span"] == 8
+    assert [model.to_pure(i) for i in range(2)] == before
+
+    fresh = BatchedSparseNestedMap.from_pure(
+        pures, span=8,
+        n_actors=model.state.core.top.shape[-1],
+    )
+    assert _trees_equal(model.state, fresh.state)
+
+
+def test_elastic_call_recovers_span_overflow():
+    """A full INNER key universe on the nested sparse map surfaces as
+    the interner's IndexError (raised before allocating), elastic_call
+    widens the span — the segment-table repack — and the retried op
+    lands; the model stays oracle-identical."""
+    from crdt_tpu.models.sparse_nested_map import BatchedSparseNestedMap
+    from crdt_tpu.pure.map import Map
+    from crdt_tpu.pure.mvreg import MVReg
+
+    mirror = Map(val_default=lambda: Map(val_default=MVReg))
+    model = BatchedSparseNestedMap.from_pure([mirror], span=2, n_actors=4)
+
+    def mint(k2, val):
+        ctx = mirror.len().derive_add_ctx("s0")
+        op = mirror.update(
+            "outer", ctx,
+            lambda child, c: child.update(
+                k2, c, lambda reg, cc: reg.write(val, cc)
+            ),
+        )
+        mirror.apply(op)
+        return op
+
+    model.apply(0, mint("a", 1))
+    model.apply(0, mint("b", 2))
+    op = mint("c", 3)  # the 2-lane inner universe is full
+    with pytest.raises(IndexError):
+        model.apply(0, op)
+    elastic.elastic_call(lambda: model.apply(0, op), model)
+    assert model.span >= 4
+    assert model.to_pure(0) == mirror
+
+
+def test_elastic_call_recovers_nested_key_rm_width_overflow():
+    """The nested kind's outer MapRm keyset overflow (pad_id_list's
+    lane check) must surface as DeferredOverflow, so elastic_call can
+    widen key_rm_width and retry — a plain ValueError left the replica
+    stuck."""
+    from crdt_tpu.models.sparse_nested_map import BatchedSparseNestedMap
+    from crdt_tpu.pure.map import Map
+    from crdt_tpu.pure.mvreg import MVReg
+
+    mirror = Map(val_default=lambda: Map(val_default=MVReg))
+    outers = [f"o{i}" for i in range(3)]
+    for o in outers:
+        op = mirror.update(
+            o, mirror.len().derive_add_ctx("s0"),
+            lambda child, c: child.update(
+                "x", c, lambda reg, cc: reg.write(1, cc)
+            ),
+        )
+        mirror.apply(op)
+    model = BatchedSparseNestedMap.from_pure(
+        [mirror], span=4, n_actors=4, key_rm_width=2
+    )
+
+    rm = mirror.rm_all(outers, mirror.len().derive_rm_ctx())
+    mirror.apply(rm)
+    with pytest.raises(DeferredOverflow):
+        model.apply(0, rm)
+    elastic.elastic_call(lambda: model.apply(0, rm), model)
+    assert model.state.kidx.shape[-1] >= 3  # key_rm_width widened
+    assert model.to_pure(0) == mirror
+
+
+def test_axes_for_maps_errors_to_axes():
+    from crdt_tpu.utils import UniverseFull
+
+    model = BatchedSparseOrswot.from_pure(
+        _orswot_pures(2, 1), dot_cap=8, n_actors=16
+    )
+    assert elastic.axes_for(model, DotCapacityOverflow("x")) == ("dot_cap",)
+    # DeferredOverflow covers both slot-count and parked-keylist-lane
+    # (rm_width) pressure, so every parked axis the kind has is fair game.
+    assert elastic.axes_for(model, DeferredOverflow("x")) == (
+        "deferred_cap", "rm_width"
+    )
+    # Only the interner's typed signal is capacity pressure: a plain
+    # IndexError is a caller bug and never implicates axes — even when
+    # from_pure left universes exactly full (the no-floor default).
+    tight = BatchedSparseOrswot.from_pure(_orswot_pures(2, 1), dot_cap=8)
+    assert elastic.axes_for(tight, IndexError("some bug")) == ()
+    # (sparse orswot's only lane-bounded universe is the actor axis)
+    assert elastic.axes_for(tight, UniverseFull("full")) == ("n_actors",)
+    with pytest.raises(KeyError):
+        elastic.recover(model, KeyError("not capacity"))

@@ -1,0 +1,109 @@
+"""Reference-mount inventory check (SURVEY.md §0 provenance caveat).
+
+SURVEY.md was reconstructed with the reference mount EMPTY, and its §0
+mandates: "run `ls /root/reference/src`; if the mount is populated,
+re-verify this inventory". This script is that step as a CI-runnable
+tool:
+
+    python tools/check_reference.py [--reference DIR] [--out FILE]
+
+- Mount absent/empty: records that fact in the evidence artifact
+  (REFERENCE_CHECK.json by default) and exits 0 — SURVEY.md stays the
+  blueprint of record.
+- Mount populated: inventories `src/*.rs`, diffs against the module
+  files SURVEY.md cites, and writes both directions of the delta
+  (cited-but-missing / present-but-uncited) plus per-file line counts
+  so a reviewer can upgrade SURVEY.md citations to file:line. Exits 1
+  on any delta so CI surfaces the drift.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Survey rows that are section/test globs, not src/ module files.
+_NON_MODULES = {"build.rs"}
+
+
+def survey_cited_modules(survey_path: str) -> list:
+    """Every `<name>.rs` SURVEY.md cites as a reference module file."""
+    with open(survey_path, encoding="utf-8") as f:
+        text = f.read()
+    cited = set(re.findall(r"`(?:src/)?([a-z0-9_]+\.rs)`", text))
+    return sorted(cited - _NON_MODULES)
+
+
+def inventory(src_dir: str) -> dict:
+    """``{file: line_count}`` for every .rs file under ``src_dir``."""
+    out = {}
+    for dirpath, _, files in os.walk(src_dir):
+        for name in sorted(files):
+            if not name.endswith(".rs"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, src_dir)
+            with open(path, "rb") as f:
+                out[rel] = f.read().count(b"\n")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--reference", default="/root/reference")
+    ap.add_argument("--survey", default=os.path.join(ROOT, "SURVEY.md"))
+    ap.add_argument(
+        "--out", default=os.path.join(ROOT, "REFERENCE_CHECK.json")
+    )
+    args = ap.parse_args(argv)
+
+    src = os.path.join(args.reference, "src")
+    cited = survey_cited_modules(args.survey)
+    evidence = {
+        "checked_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "reference": args.reference,
+        "survey_cited_modules": cited,
+    }
+
+    inv = inventory(src) if os.path.isdir(src) else {}
+    if not inv:
+        evidence["mount"] = "absent-or-empty"
+        evidence["verdict"] = (
+            "reference mount absent/empty; SURVEY.md remains the "
+            "blueprint of record (SURVEY.md §0)"
+        )
+        rc = 0
+    else:
+        missing = sorted(set(cited) - set(inv))
+        uncited = sorted(set(inv) - set(cited))
+        evidence.update(
+            mount="populated",
+            src_inventory=inv,
+            cited_but_missing=missing,
+            present_but_uncited=uncited,
+        )
+        if missing or uncited:
+            evidence["verdict"] = (
+                "inventory drift: re-verify SURVEY.md module table and "
+                "upgrade citations to file:line (SURVEY.md §0)"
+            )
+            rc = 1
+        else:
+            evidence["verdict"] = "inventory matches SURVEY.md citations"
+            rc = 0
+
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(evidence, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"{evidence['verdict']} -> {args.out}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
