@@ -832,8 +832,26 @@ def cached_hardware_headline():
 CPU_BASELINE_FULL_SCALE = 2.07
 
 
-def main():
+def parse_args(argv=None):
+    """``--metrics-out`` (or env BENCH_METRICS_OUT): JSONL sink the
+    observability drain appends to — registry snapshot, telemetry
+    records, bench spans — so metric trajectories persist per run
+    instead of dying in stderr (schema:
+    tools/telemetry_schema.json)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--metrics-out",
+        default=os.environ.get("BENCH_METRICS_OUT", ""),
+        help="append the metrics snapshot / telemetry / span JSONL here",
+    )
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
     global R, E, CHUNK
+    args = parse_args(argv)
     degraded = False
     if os.environ.get("BENCH_PROBE", "1") != "0" and not tpu_reachable():
         # No real TPU: fail FAST and honest instead of hanging the round.
@@ -858,6 +876,8 @@ def main():
         os.environ.setdefault("BENCH_SPARSE_DOTS", "512")
         os.environ.setdefault("BENCH_SMAP_REPLICAS", "32")
         os.environ.setdefault("BENCH_SMAP_CELLS", "512")
+    from crdt_tpu.telemetry import span
+
     for name, fn in [
         ("clocks", bench_clocks),
         ("map", bench_map),
@@ -868,13 +888,16 @@ def main():
     ]:
         if os.environ.get(f"BENCH_{name.upper()}", "1") != "0":
             try:
-                out = fn()
+                with span(f"bench.{name}", degraded=degraded):
+                    out = fn()
             except Exception as exc:  # diagnostic only — never kill the metric
                 log(f"{name} bench failed: {exc!r}")
             else:
                 records.extend(out if isinstance(out, list) else [out])
-    cpu_mps = bench_cpu()
-    tpu_mps, path, gbps, bytes_moved, shape = bench_tpu()
+    with span("bench.cpu"):
+        cpu_mps = bench_cpu()
+    with span("bench.tpu", degraded=degraded):
+        tpu_mps, path, gbps, bytes_moved, shape = bench_tpu()
     headline = {
         "metric": "orswot_merges_per_sec",
         "value": round(tpu_mps, 1),
@@ -917,6 +940,16 @@ def main():
                     "path": "cpu-fallback",
                 },
             }
+    from crdt_tpu import exporter
+    from crdt_tpu.utils.metrics import metrics
+
+    # Persist the observability trajectory INTO the round artifacts
+    # instead of letting it die in stderr: the full registry snapshot
+    # rides the headline record (so the driver-captured BENCH_r*.json
+    # carries it) and, when --metrics-out is set, the JSONL drain
+    # (snapshot + spans; schema-checked by tier-1).
+    snapshot = metrics.snapshot()
+    headline["metrics"] = snapshot
     records.append({"config": 3, **headline})
     # Per-config JSON lines (machine-readable) on stderr + a sidecar
     # file; stdout stays EXACTLY one line — the driver's contract.
@@ -929,9 +962,13 @@ def main():
             json.dump(records, f, indent=1)
     except OSError as exc:
         log(f"could not write BENCH_CONFIGS.json: {exc!r}")
-    from crdt_tpu.utils.metrics import metrics
-
-    log("metrics snapshot: " + json.dumps(metrics.snapshot()))
+    if args.metrics_out:
+        try:
+            n = exporter.drain_jsonl(args.metrics_out, snapshot=snapshot)
+            log(f"metrics drain: {n} records -> {args.metrics_out}")
+        except OSError as exc:
+            log(f"could not write {args.metrics_out}: {exc!r}")
+    log("metrics snapshot: " + json.dumps(snapshot))
     print(json.dumps(headline))
 
 

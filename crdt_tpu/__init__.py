@@ -46,6 +46,12 @@ from .pure.merkle_reg import MerkleReg
 from . import lifecycle, serde
 from .utils.metrics import metrics
 
+# Observability: the host registry (above), the in-jit Telemetry
+# sidecar + span tracing (``crdt_tpu.telemetry``), and the
+# Prometheus/JSONL drain (``crdt_tpu.exporter``).
+from . import exporter, telemetry
+from .telemetry import Telemetry, span
+
 __all__ = [
     "CvRDT", "CmRDT", "ResetRemove", "Causal", "ValidationError", "DotRange",
     "Dot", "OrdDot", "VClock", "ReadCtx", "AddCtx", "RmCtx",
@@ -53,6 +59,7 @@ __all__ = [
     "Map", "Identifier", "List", "GList", "MerkleReg",
     "serde",
     "lifecycle", "metrics",
+    "Telemetry", "exporter", "span", "telemetry",
 ]
 
 __version__ = "0.1.0"

@@ -277,7 +277,10 @@ def widen(
     # the model's own business: widen_capacity auto-clamps bounds the
     # caller did not pin and raises — never silently clamps — on
     # explicit ones.
-    model.widen_capacity(**new)
+    from .telemetry import span
+
+    with span("elastic.widen", kind=kind, axes=sorted(new)):
+        model.widen_capacity(**new)
     metrics.count("elastic.widen_events")
     metrics.count(f"elastic.widen_events.{kind}")
     metrics.count("elastic.migrated_bytes", state_nbytes(model.state))

@@ -1,0 +1,144 @@
+"""Metrics exporter: drain the observability layer to Prometheus + JSONL.
+
+Three producers feed one drain:
+
+- the host registry (``utils.metrics.metrics`` — counters/gauges,
+  including the ``elastic.<kind>.headroom.<axis>`` pressure gauges),
+- concrete :class:`crdt_tpu.telemetry.Telemetry` pytrees returned by
+  the mesh entry points (``telemetry=True``),
+- span trace events buffered by ``telemetry.span``.
+
+Two sinks:
+
+- :func:`prometheus_text` — the Prometheus text exposition format
+  (``# TYPE``-annotated; dotted metric names sanitized to underscores,
+  gauge min/max/sum/count exploded into suffixed series) for scrape
+  endpoints or textfile collectors;
+- :func:`drain_jsonl` — append-only JSONL, one self-describing record
+  per line (``{"record": "snapshot"|"telemetry"|"span", "ts": ...}``),
+  the trajectory format ``bench.py --metrics-out`` writes and
+  ``tools/check_telemetry_schema.py`` validates (committed schema:
+  ``tools/telemetry_schema.json`` — drift fails tier-1).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from typing import Any, Dict, Iterable, Optional
+
+from .telemetry import Telemetry, drain_events, is_concrete, to_dict
+from .utils.metrics import metrics
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize(name: str) -> str:
+    """A Prometheus-legal metric name (dots and other punctuation to
+    underscores; leading digit guarded)."""
+    out = _NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def prometheus_text(
+    snapshot: Optional[Dict[str, Any]] = None,
+    telemetry: Optional[Dict[str, Telemetry]] = None,
+) -> str:
+    """Render a registry snapshot (default: the live global registry)
+    plus optional per-kind Telemetry pytrees as Prometheus text
+    exposition. Counters become ``counter`` series; each gauge becomes
+    ``<name>`` (last) plus ``_min``/``_max``/``_sum``/``_count``
+    series; Telemetry fields land under
+    ``crdt_tpu_telemetry_<field>{kind="..."}``."""
+    snap = metrics.snapshot() if snapshot is None else snapshot
+    lines = []
+    for name, value in sorted(snap.get("counters", {}).items()):
+        pname = sanitize(name)
+        lines.append(f"# TYPE {pname} counter")
+        lines.append(f"{pname} {value}")
+    for name, g in sorted(snap.get("gauges", {}).items()):
+        pname = sanitize(name)
+        lines.append(f"# TYPE {pname} gauge")
+        lines.append(f"{pname} {g['last']}")
+        for stat in ("min", "max", "sum"):
+            lines.append(f"{pname}_{stat} {g[stat]}")
+        lines.append(f"{pname}_count {g['n']}")
+    # Field-major: ONE # TYPE block per metric with every {kind=...}
+    # sample grouped under it — a second TYPE line for the same metric
+    # is invalid exposition and fails the whole scrape.
+    tels = {
+        kind: to_dict(tel)
+        for kind, tel in sorted((telemetry or {}).items())
+        if is_concrete(tel)
+    }
+    for field in Telemetry._fields:
+        if not tels:
+            break
+        pname = f"crdt_tpu_telemetry_{sanitize(field)}"
+        lines.append(f"# TYPE {pname} gauge")
+        for kind, d in tels.items():
+            label = json.dumps(kind)  # quote + escape
+            lines.append(f"{pname}{{kind={label}}} {d[field]}")
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus(path: str, **kw) -> None:
+    """``prometheus_text`` to a file (textfile-collector handoff)."""
+    with open(path, "w") as f:
+        f.write(prometheus_text(**kw))
+
+
+def snapshot_record(snapshot: Optional[Dict[str, Any]] = None) -> dict:
+    snap = metrics.snapshot() if snapshot is None else snapshot
+    return {
+        "record": "snapshot",
+        "ts": time.time(),
+        "counters": snap.get("counters", {}),
+        "gauges": snap.get("gauges", {}),
+    }
+
+
+def telemetry_record(kind: str, tel: Telemetry) -> dict:
+    """One JSONL line for a concrete Telemetry pytree."""
+    return {"record": "telemetry", "ts": time.time(), "kind": kind,
+            **to_dict(tel)}
+
+
+def drain_jsonl(
+    path: str,
+    snapshot: Optional[Dict[str, Any]] = None,
+    telemetry: Optional[Dict[str, Telemetry]] = None,
+    spans: Optional[Iterable[dict]] = None,
+) -> int:
+    """Append one snapshot record, every concrete Telemetry record, and
+    the span events (default: drain the telemetry.span buffer) to
+    ``path``. Returns the number of lines written. Every line conforms
+    to ``tools/telemetry_schema.json``."""
+    written = 0
+    with open(path, "a") as f:
+        # Drain the span ring only AFTER the sink opened: an unwritable
+        # path must not destroy the buffered events.
+        records = [snapshot_record(snapshot)]
+        for kind, tel in sorted((telemetry or {}).items()):
+            if is_concrete(tel):
+                records.append(telemetry_record(kind, tel))
+        records.extend(drain_events() if spans is None else spans)
+        for rec in records:
+            try:
+                # default=str: span attrs may carry numpy/jnp scalars —
+                # one bad event must not abort the whole drain.
+                line = json.dumps(rec, default=str)
+            except (TypeError, ValueError):
+                continue
+            f.write(line + "\n")
+            written += 1
+    return written
+
+
+__all__ = [
+    "drain_jsonl", "prometheus_text", "sanitize", "snapshot_record",
+    "telemetry_record", "write_prometheus",
+]

@@ -205,6 +205,9 @@ class BatchedOrswot:
 
     # ---- state path (CvRDT — the benchmark path) ----------------------
     def merge_from(self, dst: int, src: int) -> None:
+        # No span here: this is the per-pair hot path, and a span per
+        # merge floods the trace ring — the fold/mesh entry points are
+        # the span granularity (telemetry.py).
         metrics.count("orswot.merges")
         joined, overflow = ops.join(
             self._row(self.state, dst), self._row(self.state, src)
@@ -224,13 +227,15 @@ class BatchedOrswot:
         backends, the jnp log2 reduction tree elsewhere (bit-identical
         either way; ops/pallas_kernels.py ``fold_auto``)."""
         from ..ops.pallas_kernels import fold_auto
+        from ..telemetry import span
 
         metrics.count("orswot.merges", max(self.n_replicas - 1, 0))
         metrics.observe(
             "orswot.deferred_depth",
             float(jnp.sum(self.state.dvalid)) / max(self.n_replicas, 1),
         )
-        folded, overflow = fold_auto(self.state)
+        with span("model.orswot.fold", replicas=self.n_replicas):
+            folded, overflow = fold_auto(self.state)
         if bool(overflow):
             raise DeferredOverflow(
                 f"fold: deferred buffer full (cap {self.state.dvalid.shape[-1]})"

@@ -252,6 +252,7 @@ class BatchedSparseOrswot:
         )
 
     def merge_from(self, dst: int, src: int) -> None:
+        # No per-merge span: hot path — spans live at fold granularity.
         metrics.count("sparse_orswot.merges")
         joined, flags = ops.join(
             self._row(self.state, dst), self._row(self.state, src)
@@ -264,9 +265,12 @@ class BatchedSparseOrswot:
     def fold(self) -> Orswot:
         """Full-mesh anti-entropy: join all replicas, return the
         converged oracle-form state."""
+        from ..telemetry import span
+
         metrics.count("sparse_orswot.merges", max(self.n_replicas - 1, 0))
         observe_depth("sparse_orswot", self.state)
-        folded, flags = ops.fold(self.state)
+        with span("model.sparse_orswot.fold", replicas=self.n_replicas):
+            folded, flags = ops.fold(self.state)
         self._check(flags, "fold")
         tmp = BatchedSparseOrswot(
             1, self.dot_cap, self.state.top.shape[-1],

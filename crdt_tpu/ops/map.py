@@ -290,6 +290,23 @@ def join(a: MapState, b: MapState):
     return state, jnp.stack([c_overflow, jnp.any(d_overflow)])
 
 
+def changed_keys(a: MapState, b: MapState) -> jax.Array:
+    """Telemetry counter emitted next to the merge masks: keys whose
+    MVReg cell slab (writer, counter, clock, value, liveness) differs
+    between two states (uint32, summed over every leading batch lane).
+    Counts only the key-sharded child planes, so element-shard psums
+    never double count the replicated top/deferred buffers
+    (telemetry.py)."""
+    diff = (
+        jnp.any(a.child.wact != b.child.wact, axis=-1)
+        | jnp.any(a.child.wctr != b.child.wctr, axis=-1)
+        | jnp.any(a.child.clk != b.child.clk, axis=(-2, -1))
+        | jnp.any(a.child.val != b.child.val, axis=-1)
+        | jnp.any(a.child.valid != b.child.valid, axis=-1)
+    )
+    return jnp.sum(diff, dtype=jnp.uint32)
+
+
 def fold(states: MapState, prefer: str = "auto"):
     """Join a whole replica batch (leading axis) — the fused dense-slab
     Pallas fold on TPU backends (pallas_kernels.fold_fused_map), the jnp

@@ -264,6 +264,16 @@ def join(a: OrswotState, b: OrswotState):
     )
 
 
+def changed_members(a: OrswotState, b: OrswotState) -> jax.Array:
+    """Telemetry counter emitted next to the merge masks: members whose
+    birth clocks differ between two states (uint32, summed over every
+    leading batch lane). The dense kind's ``slots_changed`` — birth
+    clocks are the membership-deciding plane, and ``ctr`` is the
+    element-sharded plane, so element-shard psums of this count never
+    double count replicated buffers (telemetry.py)."""
+    return jnp.sum(jnp.any(a.ctr != b.ctr, axis=-1), dtype=jnp.uint32)
+
+
 def fold(states: OrswotState):
     """Join a whole replica batch (leading axis) in a log2 reduction tree.
     Sound because ``join`` is associative/commutative/idempotent — the

@@ -420,6 +420,19 @@ def reset_remove(state: SparseMVMapState, clock: jax.Array) -> SparseMVMapState:
     )
 
 
+def changed_cells(a: SparseMVMapState, b: SparseMVMapState) -> jax.Array:
+    """Telemetry counter emitted next to the merge tables: cell lanes
+    whose (kid, act, ctr, val, clk, valid) payload differs between two
+    canonical states (uint32, summed over every leading batch lane) —
+    the sparse map kind's ``slots_changed`` (telemetry.py)."""
+    diff = (
+        (a.kid != b.kid) | (a.act != b.act) | (a.ctr != b.ctr)
+        | (a.val != b.val) | (a.valid != b.valid)
+        | jnp.any(a.clk != b.clk, axis=-1)
+    )
+    return jnp.sum(diff, dtype=jnp.uint32)
+
+
 def fold(states: SparseMVMapState, sibling_cap: int = 4):
     """Log-tree fold of a replica batch (leading axis)."""
     from .lattice import tree_fold

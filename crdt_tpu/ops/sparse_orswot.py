@@ -465,6 +465,18 @@ def apply_rm(state: SparseOrswotState, rm_clock: jax.Array, eids: jax.Array):
     )
 
 
+def changed_dots(a: SparseOrswotState, b: SparseOrswotState) -> jax.Array:
+    """Telemetry counter emitted next to the merge tables: dot-segment
+    lanes whose (eid, act, ctr, valid) payload differs between two
+    canonical states (uint32, summed over every leading batch lane) —
+    the sparse kind's ``slots_changed`` (telemetry.py)."""
+    diff = (
+        (a.eid != b.eid) | (a.act != b.act)
+        | (a.ctr != b.ctr) | (a.valid != b.valid)
+    )
+    return jnp.sum(diff, dtype=jnp.uint32)
+
+
 def fold(states: SparseOrswotState):
     """Log-tree fold of a replica batch (leading axis)."""
     from .lattice import tree_fold

@@ -59,9 +59,43 @@ from .mesh import (
     pad_replicas_map,
 )
 from ..utils.metrics import metrics, observe_depth, state_nbytes
+from .. import telemetry as tele
 
 
 _FN_CACHE: dict = {}
+
+
+def _exchange_count(p: int) -> int:
+    """Static per-device exchange count of the replica-axis all-reduce:
+    log2(P) recursive-doubling hops on a power-of-two axis, P-1 shipped
+    shards on the all_gather fallback (collectives.all_reduce_lattice).
+    Feeds the telemetry merge/byte counters."""
+    if p <= 1:
+        return 0
+    if p & (p - 1) == 0:
+        return p.bit_length() - 1
+    return p - 1
+
+
+def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
+                 sum_axes, residue=None):
+    """Mesh-reduce per-device telemetry into replicated scalars (inside
+    shard_map): throughput counters psum over the replica axis (and the
+    element axis only for ``slots`` when the content plane is
+    element-sharded — ``sum_axes``; None = caller already reduced);
+    bytes psum over ALL devices (element copies physically transmit);
+    final-state gauges pmax."""
+    both = (REPLICA_AXIS, ELEMENT_AXIS)
+    return tele.Telemetry(
+        merges=lax.psum(jnp.uint32(merges_per_dev), REPLICA_AXIS),
+        slots_changed=slots if sum_axes is None else lax.psum(slots, sum_axes),
+        deferred_depth=lax.pmax(tele.device_depth(folded), both),
+        bytes_exchanged=lax.psum(jnp.float32(bytes_per_dev), both),
+        residue=(
+            jnp.zeros((), jnp.int32) if residue is None else residue
+        ),
+        widen_pressure=lax.pmax(tele.device_pressure(folded), both),
+    )
 
 
 def _cached(kind: str, state, mesh: Mesh, build, *extra):
@@ -79,7 +113,8 @@ def _cached(kind: str, state, mesh: Mesh, build, *extra):
 
 
 def mesh_fold(
-    state: OrswotState, mesh: Mesh, local_fold: str = "auto"
+    state: OrswotState, mesh: Mesh, local_fold: str = "auto",
+    telemetry: bool = False,
 ) -> Tuple[OrswotState, jax.Array]:
     """Full-mesh anti-entropy over the device mesh: every replica's state
     joined into one converged state, in one collective round.
@@ -90,7 +125,10 @@ def mesh_fold(
     ``fold_auto``), then one lattice-join all-reduce across the
     ``replica`` mesh axis. Element shards never communicate — the join
     is element-parallel (mesh.py). Returns (converged state [no replica
-    axis, element-sharded], overflow flag).
+    axis, element-sharded], overflow flag); with ``telemetry=True`` a
+    :class:`crdt_tpu.telemetry.Telemetry` pytree rides along as a third
+    element (in-kernel counters — they survive an outer jit; the flag
+    off traces exactly the flag-free program).
     """
     from ..ops.pallas_kernels import fold_auto
 
@@ -113,6 +151,35 @@ def mesh_fold(
 
         return fold_fn
 
+    def build_tel():
+        n_ex = _exchange_count(mesh.shape[REPLICA_AXIS])
+
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(orswot_specs(),),
+            out_specs=(orswot_out_specs(), P(), tele.specs()),
+            check_vma=False,
+        )
+        def fold_tel_fn(local):
+            folded, of_local = fold_auto(local, prefer=local_fold)
+            joined, of_cross = all_reduce_join(folded, REPLICA_AXIS)
+            of = (lax.psum(of_local.astype(jnp.int32), REPLICA_AXIS) > 0) | of_cross
+            local_rows = jax.tree.leaves(local)[0].shape[0]
+            tel = _tel_reduced(
+                joined,
+                lax.psum(
+                    ops.changed_members(folded, joined),
+                    (REPLICA_AXIS, ELEMENT_AXIS),
+                ),
+                max(local_rows - 1, 0) + n_ex,
+                tele.shipped_bytes(folded) * n_ex,
+                sum_axes=None,  # already reduced above
+            )
+            return joined, of, tel
+
+        return fold_tel_fn
+
     metrics.count("anti_entropy.fold_rounds")
     metrics.count(
         "anti_entropy.merges", max(jax.tree.leaves(state)[0].shape[0] - 1, 0)
@@ -120,8 +187,13 @@ def mesh_fold(
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
     observe_depth("anti_entropy.orswot_fold", state)
     with metrics.time("anti_entropy.fold"):
-        out = _cached("orswot_fold", state, mesh, build, local_fold)(state)
+        out = _cached(
+            "orswot_fold", state, mesh,
+            build_tel if telemetry else build, local_fold, telemetry,
+        )(state)
         jax.block_until_ready(out)  # time device work, not async dispatch
+    if telemetry and tele.is_concrete(out[2]):
+        tele.record("orswot_fold", out[2])
     return out
 
 
@@ -134,13 +206,22 @@ def _mesh_gossip_lattice(
     in_specs,
     rounds: Optional[int] = None,
     cache_extra: tuple = (),
+    telemetry: bool = False,
+    slots_fn=None,
+    element_sharded: bool = True,
 ):
     """Shared scaffold for ring anti-entropy: each device folds its
     local replica block, then runs ``rounds`` unit-shift gossip rounds.
     Bandwidth per round is one state per link — the bounded-traffic mode
     for DCN-crossing replica axes. Returns (per-device states [P, ...],
     overflow); with the default rounds = P-1 every row equals the full
-    join."""
+    join.
+
+    ``telemetry=True`` appends an in-kernel accumulated Telemetry pytree
+    (telemetry.py) — per-round joins feed ``slots_fn`` (the kind's
+    changed-lane counter; ``element_sharded`` picks the psum axes for it)
+    and the shipped-state bytes; the flag off traces exactly the
+    flag-free program."""
     if rounds is None:
         rounds = mesh.shape[REPLICA_AXIS] - 1
 
@@ -164,12 +245,52 @@ def _mesh_gossip_lattice(
 
         return gossip_fn
 
+    def build_tel():
+        slots_of = slots_fn or tele.generic_slots_changed
+        sum_axes = (
+            (REPLICA_AXIS, ELEMENT_AXIS) if element_sharded
+            else (REPLICA_AXIS,)
+        )
+
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(in_specs,),
+            out_specs=(in_specs, P(), tele.specs()),
+            check_vma=False,
+        )
+        def gossip_tel_fn(local):
+            folded, of = fold_fn(local)
+            slots = jnp.zeros((), jnp.uint32)
+            for _ in range(rounds):
+                new, of_r = ring_round(
+                    folded, REPLICA_AXIS, reduce_overflow=False, join_fn=join_fn
+                )
+                slots = slots + slots_of(folded, new)
+                folded, of = new, of | of_r
+            local_rows = jax.tree.leaves(local)[0].shape[0]
+            tel = _tel_reduced(
+                folded, slots,
+                max(local_rows - 1, 0) + rounds,
+                tele.shipped_bytes(folded) * rounds,
+                sum_axes,
+            )
+            of = lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS)) > 0
+            return jax.tree.map(lambda x: x[None], folded), of, tel
+
+        return gossip_tel_fn
+
     metrics.count(f"anti_entropy.{kind}_rounds", rounds)
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
     observe_depth(f"anti_entropy.{kind}", state)
     with metrics.time(f"anti_entropy.{kind}"):
-        out = _cached(kind, state, mesh, build, rounds, *cache_extra)(state)
+        out = _cached(
+            kind, state, mesh, build_tel if telemetry else build,
+            rounds, telemetry, *cache_extra,
+        )(state)
         jax.block_until_ready(out)  # time device work, not async dispatch
+    if telemetry and tele.is_concrete(out[2]):
+        tele.record(kind, out[2])
     return out
 
 
@@ -178,10 +299,12 @@ def mesh_gossip(
     mesh: Mesh,
     rounds: Optional[int] = None,
     local_fold: str = "auto",
+    telemetry: bool = False,
 ) -> Tuple[OrswotState, jax.Array]:
     """Ring anti-entropy for ORSWOT replica batches (see
     ``_mesh_gossip_lattice``); the device-local pre-fold dispatches like
-    ``mesh_fold`` (fused Pallas on TPU backends)."""
+    ``mesh_fold`` (fused Pallas on TPU backends). ``telemetry=True``
+    appends the in-kernel Telemetry pytree (telemetry.py)."""
     from ..ops.pallas_kernels import fold_auto
 
     state = pad_replicas(state, mesh.shape[REPLICA_AXIS])
@@ -190,11 +313,13 @@ def mesh_gossip(
         "orswot_gossip", state, mesh, ops.join,
         partial(fold_auto, prefer=local_fold), orswot_specs(), rounds,
         cache_extra=(local_fold,),
+        telemetry=telemetry, slots_fn=ops.changed_members,
     )
 
 
 def mesh_gossip_map(
-    state: MapState, mesh: Mesh, rounds: Optional[int] = None
+    state: MapState, mesh: Mesh, rounds: Optional[int] = None,
+    telemetry: bool = False,
 ) -> Tuple[MapState, jax.Array]:
     """Ring anti-entropy for the composition layer: Map<K, MVReg>
     replica blocks gossiped one neighbor per round over the replica
@@ -202,12 +327,14 @@ def mesh_gossip_map(
     state = pad_replicas_map(state, mesh.shape[REPLICA_AXIS])
     state = pad_keys(state, mesh.shape[ELEMENT_AXIS])
     return _mesh_gossip_lattice(
-        "map_gossip", state, mesh, map_ops.join, map_ops.fold, map_specs(), rounds
+        "map_gossip", state, mesh, map_ops.join, map_ops.fold, map_specs(),
+        rounds, telemetry=telemetry, slots_fn=map_ops.changed_keys,
     )
 
 
 def mesh_gossip_map_orswot(
-    state: MapOrswotState, mesh: Mesh, rounds: Optional[int] = None
+    state: MapOrswotState, mesh: Mesh, rounds: Optional[int] = None,
+    telemetry: bool = False,
 ) -> Tuple[MapOrswotState, jax.Array]:
     """Ring anti-entropy for ``Map<K, Orswot>`` replica blocks (the
     Val-generic slab composition) over the replica axis."""
@@ -217,11 +344,14 @@ def mesh_gossip_map_orswot(
         partial(mo_ops.join, element_axis=ELEMENT_AXIS),
         partial(mo_ops.fold, element_axis=ELEMENT_AXIS),
         map_orswot_specs(), rounds,
+        telemetry=telemetry,
+        slots_fn=lambda a, b: ops.changed_members(a.core, b.core),
     )
 
 
 def mesh_gossip_nested_map(
-    state: NestedMapState, mesh: Mesh, rounds: Optional[int] = None
+    state: NestedMapState, mesh: Mesh, rounds: Optional[int] = None,
+    telemetry: bool = False,
 ) -> Tuple[NestedMapState, jax.Array]:
     """Ring anti-entropy for ``Map<K1, Map<K2, MVReg>>`` replica blocks
     over the replica axis."""
@@ -231,6 +361,8 @@ def mesh_gossip_nested_map(
         partial(nested_ops.join, element_axis=ELEMENT_AXIS),
         partial(nested_ops.fold, element_axis=ELEMENT_AXIS),
         nested_map_specs(), rounds,
+        telemetry=telemetry,
+        slots_fn=lambda a, b: map_ops.changed_keys(a.m, b.m),
     )
 
 
@@ -242,11 +374,16 @@ def _mesh_fold_lattice(
     fold_fn,
     in_specs,
     out_specs,
+    telemetry: bool = False,
+    slots_fn=None,
+    element_sharded: bool = False,
 ):
     """Shared scaffold for the map-family mesh folds: local log-tree
     fold per shard, replica-axis lattice-join all-reduce, and overflow
     flags reduced over BOTH axes (slab/deferred overflows can be
-    key-shard-local, so every device must report the global flag)."""
+    key-shard-local, so every device must report the global flag).
+    ``telemetry=True`` appends the in-kernel Telemetry pytree
+    (telemetry.py); the flag off traces exactly the flag-free program."""
 
     def build():
         @partial(
@@ -267,6 +404,39 @@ def _mesh_fold_lattice(
 
         return mesh_fn
 
+    def build_tel():
+        slots_of = slots_fn or tele.generic_slots_changed
+        sum_axes = (
+            (REPLICA_AXIS, ELEMENT_AXIS) if element_sharded
+            else (REPLICA_AXIS,)
+        )
+        n_ex = _exchange_count(mesh.shape[REPLICA_AXIS])
+
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(in_specs,),
+            out_specs=(out_specs, P(), tele.specs()),
+            check_vma=False,
+        )
+        def mesh_tel_fn(local):
+            folded, of_local = fold_fn(local)
+            joined, of_cross = all_reduce_lattice(
+                folded, REPLICA_AXIS, join_fn, fold_fn
+            )
+            of = (lax.psum(of_local.astype(jnp.int32), REPLICA_AXIS) > 0) | of_cross
+            of = lax.psum(of.astype(jnp.int32), ELEMENT_AXIS) > 0
+            local_rows = jax.tree.leaves(local)[0].shape[0]
+            tel = _tel_reduced(
+                joined, slots_of(folded, joined),
+                max(local_rows - 1, 0) + n_ex,
+                tele.shipped_bytes(folded) * n_ex,
+                sum_axes,
+            )
+            return joined, of, tel
+
+        return mesh_tel_fn
+
     metrics.count(f"anti_entropy.{kind}_rounds")
     metrics.count(
         "anti_entropy.merges", max(jax.tree.leaves(state)[0].shape[0] - 1, 0)
@@ -274,12 +444,18 @@ def _mesh_fold_lattice(
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
     observe_depth(f"anti_entropy.{kind}", state)
     with metrics.time(f"anti_entropy.{kind}"):
-        out = _cached(kind, state, mesh, build)(state)
+        out = _cached(
+            kind, state, mesh, build_tel if telemetry else build, telemetry
+        )(state)
         jax.block_until_ready(out)  # time device work, not async dispatch
+    if telemetry and tele.is_concrete(out[2]):
+        tele.record(kind, out[2])
     return out
 
 
-def mesh_fold_map(state: MapState, mesh: Mesh) -> Tuple[MapState, jax.Array]:
+def mesh_fold_map(
+    state: MapState, mesh: Mesh, telemetry: bool = False
+) -> Tuple[MapState, jax.Array]:
     """Full-mesh anti-entropy for the composition layer (BASELINE config
     4): every replica's Map<K, MVReg> state joined into one converged
     state over the (replica × key) mesh. Key shards never communicate —
@@ -294,11 +470,13 @@ def mesh_fold_map(state: MapState, mesh: Mesh) -> Tuple[MapState, jax.Array]:
         "map_fold", state, mesh,
         map_ops.join, map_ops.fold,
         map_specs(), map_out_specs(),
+        telemetry=telemetry, slots_fn=map_ops.changed_keys,
+        element_sharded=True,
     )
 
 
 def mesh_fold_map_orswot(
-    state: MapOrswotState, mesh: Mesh
+    state: MapOrswotState, mesh: Mesh, telemetry: bool = False
 ) -> Tuple[MapOrswotState, jax.Array]:
     """Full-mesh anti-entropy for ``Map<K, Orswot>`` over the
     (replica × key) mesh: element shards hold whole keys (K*M blocks)
@@ -314,11 +492,14 @@ def mesh_fold_map_orswot(
         partial(mo_ops.join, element_axis=ELEMENT_AXIS),
         partial(mo_ops.fold, element_axis=ELEMENT_AXIS),
         map_orswot_specs(), map_orswot_out_specs(),
+        telemetry=telemetry,
+        slots_fn=lambda a, b: ops.changed_members(a.core, b.core),
+        element_sharded=True,
     )
 
 
 def mesh_fold_nested_map(
-    state: NestedMapState, mesh: Mesh
+    state: NestedMapState, mesh: Mesh, telemetry: bool = False
 ) -> Tuple[NestedMapState, jax.Array]:
     """Full-mesh anti-entropy for ``Map<K1, Map<K2, MVReg>>`` over the
     (replica × outer-key) mesh (K1*K2 blocks per shard). Returns
@@ -331,6 +512,9 @@ def mesh_fold_nested_map(
         partial(nested_ops.join, element_axis=ELEMENT_AXIS),
         partial(nested_ops.fold, element_axis=ELEMENT_AXIS),
         nested_map_specs(), nested_map_out_specs(),
+        telemetry=telemetry,
+        slots_fn=lambda a, b: map_ops.changed_keys(a.m, b.m),
+        element_sharded=True,
     )
 
 
@@ -388,7 +572,7 @@ def _pad_with_identity(states, rsize: int, ident):
     )
 
 
-def mesh_fold_lww(states, mesh: Mesh):
+def mesh_fold_lww(states, mesh: Mesh, telemetry: bool = False):
     """Converge an LWWReg replica batch (LWWState with leading axis R)
     over the mesh's replica axis. Returns ``(state, conflict)``;
     conflict marks an equal-marker/different-value merge anywhere
@@ -407,10 +591,11 @@ def mesh_fold_lww(states, mesh: Mesh):
         lww_ops.join, lww_ops.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template),
         jax.tree.map(lambda _: P(), template),
+        telemetry=telemetry,
     )
 
 
-def mesh_fold_mvreg(states, mesh: Mesh):
+def mesh_fold_mvreg(states, mesh: Mesh, telemetry: bool = False):
     """Converge an MVReg replica batch (MVRegState with leading axis R)
     over the mesh's replica axis: dominated contents die, concurrent
     siblings survive (reference: src/mvreg.rs ``CvRDT::merge``).
@@ -430,6 +615,7 @@ def mesh_fold_mvreg(states, mesh: Mesh):
         mv.join, mv.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template),
         jax.tree.map(lambda _: P(), template),
+        telemetry=telemetry,
     )
 
 
@@ -452,7 +638,7 @@ def _sparse_pad_and_template(states, rsize: int):
     return states, sp.empty(*shape_args)
 
 
-def mesh_fold_sparse(states, mesh: Mesh):
+def mesh_fold_sparse(states, mesh: Mesh, telemetry: bool = False):
     """Converge a SPARSE (segment-encoded) ORSWOT replica batch over the
     mesh's replica axis, with the segment table REPLICATED across the
     element axis — the simple layout for moderate dot counts. For true
@@ -470,6 +656,7 @@ def mesh_fold_sparse(states, mesh: Mesh):
         sp.join, sp.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template),
         jax.tree.map(lambda _: P(), template),
+        telemetry=telemetry, slots_fn=sp.changed_dots,
     )
 
 
@@ -493,7 +680,9 @@ def _sparse_mvmap_pad_and_template(states, rsize: int):
     return states, smv.empty(*shape_args)
 
 
-def mesh_fold_sparse_mvmap(states, mesh: Mesh, sibling_cap: int = 4):
+def mesh_fold_sparse_mvmap(
+    states, mesh: Mesh, sibling_cap: int = 4, telemetry: bool = False
+):
     """Converge a SPARSE ``Map<K, MVReg>`` replica batch
     (ops/sparse_mvmap) over the mesh's replica axis, cell table
     replicated across the element axis — the layout that pairs with the
@@ -511,11 +700,13 @@ def mesh_fold_sparse_mvmap(states, mesh: Mesh, sibling_cap: int = 4):
         partial(smv.fold, sibling_cap=sibling_cap),
         jax.tree.map(lambda _: P(REPLICA_AXIS), template),
         jax.tree.map(lambda _: P(), template),
+        telemetry=telemetry, slots_fn=smv.changed_cells,
     )
 
 
 def mesh_gossip_sparse_mvmap(
-    states, mesh: Mesh, rounds: Optional[int] = None, sibling_cap: int = 4
+    states, mesh: Mesh, rounds: Optional[int] = None, sibling_cap: int = 4,
+    telemetry: bool = False,
 ):
     """Ring anti-entropy for SPARSE ``Map<K, MVReg>`` replica batches
     over the replica axis — per-round traffic is one cell table per
@@ -531,10 +722,12 @@ def mesh_gossip_sparse_mvmap(
         partial(smv.join, sibling_cap=sibling_cap),
         partial(smv.fold, sibling_cap=sibling_cap),
         jax.tree.map(lambda _: P(REPLICA_AXIS), template), rounds,
+        telemetry=telemetry, slots_fn=smv.changed_cells,
+        element_sharded=False,
     )
 
 
-def mesh_fold_sparse_nested(states, mesh: Mesh, level):
+def mesh_fold_sparse_nested(states, mesh: Mesh, level, telemetry: bool = False):
     """Converge a SPARSE nested-map replica batch (any
     ``sparse_nest.SparseNestLevel`` composition — e.g. the
     ``Map<K1, Map<K2, MVReg>>`` of ops/sparse_mvmap.level_map_mvreg)
@@ -549,6 +742,7 @@ def mesh_fold_sparse_nested(states, mesh: Mesh, level):
         level.join, level.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template),
         jax.tree.map(lambda _: P(), template),
+        telemetry=telemetry,
     )
 
 
@@ -580,7 +774,8 @@ def _sparse_nested_pad_and_key(states, rsize: int, level, op: str):
 
 
 def mesh_gossip_sparse_nested(
-    states, mesh: Mesh, level, rounds: Optional[int] = None
+    states, mesh: Mesh, level, rounds: Optional[int] = None,
+    telemetry: bool = False,
 ):
     """Ring anti-entropy for SPARSE nested-map replica batches (any
     ``SparseNestLevel`` composition) over the replica axis — per-round
@@ -593,11 +788,13 @@ def mesh_gossip_sparse_nested(
     return _mesh_gossip_lattice(
         kind, states, mesh, level.join, level.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template), rounds,
+        telemetry=telemetry, element_sharded=False,
     )
 
 
 def mesh_gossip_sparse(
-    states, mesh: Mesh, rounds: Optional[int] = None
+    states, mesh: Mesh, rounds: Optional[int] = None,
+    telemetry: bool = False,
 ):
     """Ring anti-entropy for SPARSE (segment-encoded) ORSWOT replica
     batches over the replica axis (the bounded-bandwidth mode —
@@ -612,11 +809,13 @@ def mesh_gossip_sparse(
     return _mesh_gossip_lattice(
         "sparse_gossip", states, mesh, sp.join, sp.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template), rounds,
+        telemetry=telemetry, slots_fn=sp.changed_dots,
+        element_sharded=False,
     )
 
 
 def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
-                   policy=None):
+                   policy=None, telemetry: bool = False):
     """Ring anti-entropy with elastic capacity recovery — the
     overflow→widen→resume loop at mesh scale (elastic.py).
 
@@ -635,7 +834,12 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
     P-1 rounds, as in ``mesh_gossip``), ``widened`` the dict of axes
     grown along the way (empty when capacity sufficed). Widening is
     administrative — apply the same growth on every host holding the
-    replica set before the next round (elastic.py module docstring)."""
+    replica set before the next round (elastic.py module docstring).
+
+    ``telemetry=True`` appends a Telemetry pytree folded across every
+    attempt (``telemetry.combine``: counters from discarded overflow
+    runs still count — they were real work — while the final-state
+    gauges come from the successful run)."""
     from .. import elastic
     from ..models.map import BatchedMap
     from ..models.orswot import BatchedOrswot
@@ -649,30 +853,34 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
         # (gossip runner, overflow-flag lane -> elastic axis)
         if isinstance(m, BatchedOrswot):
             return (
-                lambda: mesh_gossip(m.state, mesh, rounds),
+                lambda: mesh_gossip(m.state, mesh, rounds,
+                                    telemetry=telemetry),
                 ("deferred_cap",),
             )
         if isinstance(m, BatchedSparseOrswot):
             return (
-                lambda: mesh_gossip_sparse(m.state, mesh, rounds),
+                lambda: mesh_gossip_sparse(m.state, mesh, rounds,
+                                           telemetry=telemetry),
                 ("dot_cap", "deferred_cap"),
             )
         if isinstance(m, BatchedMap):
             return (
-                lambda: mesh_gossip_map(m.state, mesh, rounds),
+                lambda: mesh_gossip_map(m.state, mesh, rounds,
+                                        telemetry=telemetry),
                 ("sibling_cap", "deferred_cap"),
             )
         if isinstance(m, BatchedSparseMap):
             return (
                 lambda: mesh_gossip_sparse_mvmap(
-                    m.state, mesh, rounds, sibling_cap=m.sibling_cap
+                    m.state, mesh, rounds, sibling_cap=m.sibling_cap,
+                    telemetry=telemetry,
                 ),
                 ("cell_cap", "deferred_cap", "sibling_cap"),
             )
         if isinstance(m, BatchedSparseNestedMap):
             return (
                 lambda: mesh_gossip_sparse_nested(
-                    m.state, mesh, m.level, rounds
+                    m.state, mesh, m.level, rounds, telemetry=telemetry
                 ),
                 ("cell_cap", "deferred_cap", "sibling_cap",
                  "key_deferred_cap"),
@@ -684,15 +892,19 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
 
     widened: dict = {}
     migrations = 0
+    tel = None
     while True:
         run, lanes = plan(model)
-        rows, flags = run()
+        out = run()
+        rows, flags = out[0], out[1]
+        if telemetry:
+            tel = out[2] if tel is None else tele.combine(tel, out[2])
         flags = jnp.atleast_1d(flags)
         hot = tuple(
             axis for lane, axis in enumerate(lanes) if bool(flags[lane])
         )
         if not hot:
-            return rows, widened
+            return (rows, widened, tel) if telemetry else (rows, widened)
         if migrations >= policy.max_migrations:
             raise RuntimeError(
                 f"gossip still overflowing after {migrations} migrations "
@@ -732,7 +944,7 @@ def mesh_fold_clocks(clocks: jax.Array, mesh: Mesh) -> jax.Array:
     return _cached("clock_fold", clocks, mesh, build)(clocks)
 
 
-def mesh_fold_map3(state, mesh: Mesh):
+def mesh_fold_map3(state, mesh: Mesh, telemetry: bool = False):
     """Full-mesh anti-entropy for ``Map<K1, Map<K2, Orswot>>`` over the
     (replica × outer-key) mesh (K1×K2×M blocks per shard; ops/map3.py
     depth-3 slab composition). Returns (converged state, overflow[3])."""
@@ -745,10 +957,15 @@ def mesh_fold_map3(state, mesh: Mesh):
         partial(map3_ops.join, element_axis=ELEMENT_AXIS),
         partial(map3_ops.fold, element_axis=ELEMENT_AXIS),
         map3_specs(), map3_out_specs(),
+        telemetry=telemetry,
+        slots_fn=lambda a, b: ops.changed_members(a.mo.core, b.mo.core),
+        element_sharded=True,
     )
 
 
-def mesh_gossip_map3(state, mesh: Mesh, rounds: Optional[int] = None):
+def mesh_gossip_map3(
+    state, mesh: Mesh, rounds: Optional[int] = None, telemetry: bool = False
+):
     """Ring anti-entropy for ``Map<K1, Map<K2, Orswot>>`` replica blocks
     over the replica axis."""
     from ..ops import map3 as map3_ops
@@ -760,4 +977,6 @@ def mesh_gossip_map3(state, mesh: Mesh, rounds: Optional[int] = None):
         partial(map3_ops.join, element_axis=ELEMENT_AXIS),
         partial(map3_ops.fold, element_axis=ELEMENT_AXIS),
         map3_specs(), rounds,
+        telemetry=telemetry,
+        slots_fn=lambda a, b: ops.changed_members(a.mo.core, b.mo.core),
     )

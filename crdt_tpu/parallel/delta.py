@@ -227,6 +227,7 @@ def mesh_delta_gossip(
     rounds: Optional[int] = None,
     cap: int = 64,
     local_fold: str = "auto",
+    telemetry: bool = False,
 ):
     """Ring δ anti-entropy over the mesh: each device folds its local
     replica block (OR-folding dirty, max-folding contexts), then runs
@@ -253,7 +254,8 @@ def mesh_delta_gossip(
 
     Returns ``(states [P, ...], dirty [P, E], overflow, residue)`` —
     overflow is the deferred-buffer flag, as in ``mesh_gossip``;
-    residue the convergence indicator above."""
+    residue the convergence indicator above. ``telemetry=True`` appends
+    the in-kernel Telemetry pytree (telemetry.py) as a fifth element."""
     from ..ops.pallas_kernels import fold_auto
     from .delta_ring import run_delta_ring
 
@@ -264,6 +266,8 @@ def mesh_delta_gossip(
     dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_e)))
     fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_e), (0, 0)))
 
+    from ..ops.orswot import changed_members
+
     return run_delta_ring(
         "delta_gossip", state, dirty, fctx, mesh, rounds, cap,
         specs=orswot_specs(),
@@ -272,4 +276,5 @@ def mesh_delta_gossip(
         apply_fn=apply_delta,
         close_top=close_top_orswot,
         cache_extra=(local_fold,),
+        telemetry=telemetry, slots_fn=changed_members,
     )

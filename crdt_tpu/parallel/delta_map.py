@@ -268,6 +268,7 @@ def mesh_delta_gossip_map(
     mesh: Mesh,
     rounds: Optional[int] = None,
     cap: int = 64,
+    telemetry: bool = False,
 ):
     """Ring δ anti-entropy for Map<K, MVReg> replica batches over the
     mesh — the bandwidth-bounded mode for large key universes with local
@@ -298,4 +299,5 @@ def mesh_delta_gossip_map(
         extract=extract_delta_map,
         apply_fn=apply_delta_map,
         close_top=close_top,
+        telemetry=telemetry, slots_fn=map_ops.changed_keys,
     )

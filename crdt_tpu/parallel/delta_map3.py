@@ -15,6 +15,7 @@ from jax.sharding import Mesh
 
 from ..ops import map3 as m3_ops
 from ..ops.map3 import Map3State
+from ..ops.orswot import changed_members
 from .delta import interval_accumulate
 from .delta_map_orswot import (
     MapOrswotDeltaPacket,
@@ -56,6 +57,7 @@ def mesh_delta_gossip_map3(
     mesh: Mesh,
     rounds: Optional[int] = None,
     cap: int = 64,
+    telemetry: bool = False,
 ):
     """Ring δ anti-entropy for depth-3 map replica batches (see
     delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET
@@ -81,4 +83,6 @@ def mesh_delta_gossip_map3(
             close_top_nested, m3_ops.LEVEL, element_axis=ELEMENT_AXIS
         ),
         top_of=lambda s: s.mo.core.top,
+        telemetry=telemetry,
+        slots_fn=lambda a, b: changed_members(a.mo.core, b.mo.core),
     )

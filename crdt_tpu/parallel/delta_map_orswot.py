@@ -25,6 +25,7 @@ from jax.sharding import Mesh
 
 from ..ops import map_orswot as mo_ops
 from ..ops.map_orswot import MapOrswotState
+from ..ops.orswot import changed_members
 from .delta import (
     DeltaPacket,
     apply_delta,
@@ -68,6 +69,7 @@ def mesh_delta_gossip_map_orswot(
     mesh: Mesh,
     rounds: Optional[int] = None,
     cap: int = 64,
+    telemetry: bool = False,
 ):
     """Ring δ anti-entropy for Map<K, Orswot> replica batches (see
     delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET
@@ -95,4 +97,6 @@ def mesh_delta_gossip_map_orswot(
             close_top_nested, mo_ops.LEVEL, element_axis=ELEMENT_AXIS
         ),
         top_of=lambda s: s.core.top,
+        telemetry=telemetry,
+        slots_fn=lambda a, b: changed_members(a.core, b.core),
     )

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .. import telemetry as tele
 from ..utils.metrics import metrics, state_nbytes
 from .mesh import ELEMENT_AXIS, REPLICA_AXIS
 
@@ -44,6 +45,8 @@ def run_delta_ring(
     close_top: Callable,      # (state, full_top) -> state  (re-replay parked)
     top_of: Callable = lambda s: s.top,  # composed states nest their top
     cache_extra: tuple = (),
+    telemetry: bool = False,
+    slots_fn: Optional[Callable] = None,
 ):
     """Run the δ ring program; ``state``/``dirty``/``fctx`` must already
     be padded to the mesh. Returns ``(states [P, ...], dirty, overflow,
@@ -69,13 +72,27 @@ def run_delta_ring(
     rounds of an extended budget is expected drain behavior and
     deliberately not counted. A budget below P-1 rounds cannot complete
     a ring loop at all, so residue is forced >= 1 there regardless of
-    starvation."""
+    starvation.
+
+    ``telemetry=True`` appends an in-kernel Telemetry pytree as a fifth
+    output (telemetry.py): per-round packet bytes and ``slots_fn``
+    changed-lane counts accumulate in the fori_loop carry, the
+    final-state gauges read the post-closure fold, and ``residue``
+    mirrors the fourth output. The flag off traces exactly the
+    flag-free program."""
+    from .anti_entropy import _cached, _tel_reduced
+
     p = mesh.shape[REPLICA_AXIS]
     if rounds is None:
         rounds = p - 1
     perm = [(i, (i + 1) % p) for i in range(p)]
 
     def build():
+        out_specs = (specs, P(REPLICA_AXIS, ELEMENT_AXIS), P(), P())
+        if telemetry:
+            out_specs = out_specs + (tele.specs(),)
+        slots_of = slots_fn or tele.generic_slots_changed
+
         @partial(
             jax.shard_map,
             mesh=mesh,
@@ -84,7 +101,7 @@ def run_delta_ring(
                 P(REPLICA_AXIS, ELEMENT_AXIS),
                 P(REPLICA_AXIS, ELEMENT_AXIS, None),
             ),
-            out_specs=(specs, P(REPLICA_AXIS, ELEMENT_AXIS), P(), P()),
+            out_specs=out_specs,
             check_vma=False,
         )
         def gossip_fn(local, local_dirty, local_fctx):
@@ -93,7 +110,10 @@ def run_delta_ring(
             f = jnp.max(local_fctx, axis=0)
 
             def round_body(r, carry):
-                st, d, f, of, starved = carry
+                if telemetry:
+                    st, d, f, of, starved, slots, shipped = carry
+                else:
+                    st, d, f, of, starved = carry
                 pkt, d, f = extract(st, d, f, cap, start=r * cap)
                 in_window = r >= rounds - (p - 1)
                 # Explicit accumulator dtype: without it jnp.sum widens
@@ -105,13 +125,22 @@ def run_delta_ring(
                 pkt = jax.tree.map(
                     lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt
                 )
+                if telemetry:
+                    before = st
+                    shipped = shipped + jnp.float32(tele.shipped_bytes(pkt))
                 st, d, f, of_r = apply_fn(st, pkt, d, f)
+                if telemetry:
+                    slots = slots + slots_of(before, st)
+                    return st, d, f, of | of_r, starved, slots, shipped
                 return st, d, f, of | of_r, starved
 
-            folded, d, f, of, starved = lax.fori_loop(
-                0, rounds, round_body,
-                (folded, d, f, of, jnp.zeros((), jnp.int32)),
-            )
+            init = (folded, d, f, of, jnp.zeros((), jnp.int32))
+            if telemetry:
+                init = init + (
+                    jnp.zeros((), jnp.uint32), jnp.zeros((), jnp.float32)
+                )
+            carry = lax.fori_loop(0, rounds, round_body, init)
+            folded, d, f, of, starved = carry[:5]
             top = lax.pmax(
                 lax.pmax(top_of(folded), REPLICA_AXIS), ELEMENT_AXIS
             )
@@ -125,20 +154,31 @@ def run_delta_ring(
                 # A budget below P-1 can never complete a ring loop; the
                 # certificate must not be issuable no matter the cap.
                 residue = jnp.maximum(residue, 1)
-            return jax.tree.map(lambda x: x[None], folded), d[None], of, residue
+            outs = (
+                jax.tree.map(lambda x: x[None], folded), d[None], of, residue
+            )
+            if telemetry:
+                slots, shipped = carry[5], carry[6]
+                local_rows = jax.tree.leaves(local)[0].shape[0]
+                outs = outs + (_tel_reduced(
+                    folded, slots,
+                    max(local_rows - 1, 0) + rounds, shipped,
+                    (REPLICA_AXIS, ELEMENT_AXIS), residue=residue,
+                ),)
+            return outs
 
         return gossip_fn
 
     metrics.count(f"anti_entropy.{kind}_rounds", rounds)
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
     with metrics.time(f"anti_entropy.{kind}"):
-        from .anti_entropy import _cached
-
-        out = _cached(kind, state, mesh, build, rounds, cap, *cache_extra)(
-            state, dirty, fctx
-        )
+        out = _cached(
+            kind, state, mesh, build, rounds, cap, telemetry, *cache_extra
+        )(state, dirty, fctx)
         jax.block_until_ready(out)
     _warn_residue(kind, out)
+    if telemetry and tele.is_concrete(out[4]):
+        tele.record(kind, out[4])
     return out
 
 
@@ -170,6 +210,7 @@ def delta_gossip_elastic(
     cap: int = 64,
     local_fold: str = "auto",
     policy=None,
+    telemetry: bool = False,
 ):
     """δ-ring anti-entropy with elastic capacity recovery for dense
     ORSWOT replica batches (``BatchedOrswot``): the mid-round
@@ -191,18 +232,26 @@ def delta_gossip_elastic(
 
     Returns ``(states, dirty, overflow, residue, widened)`` — the
     ``mesh_delta_gossip`` tuple plus the dict of axes grown (empty when
-    capacity sufficed)."""
+    capacity sufficed). ``telemetry=True`` appends a Telemetry pytree
+    folded across every attempt (``telemetry.combine``) as the last
+    element."""
     from .. import elastic
     from .delta import mesh_delta_gossip
 
     policy = policy or elastic.DEFAULT_POLICY
     widened: dict = {}
     migrations = 0
+    tel = None
     while True:
         out = mesh_delta_gossip(
-            model.state, dirty, fctx, mesh, rounds, cap, local_fold
+            model.state, dirty, fctx, mesh, rounds, cap, local_fold,
+            telemetry=telemetry,
         )
+        if telemetry:
+            tel = out[4] if tel is None else tele.combine(tel, out[4])
         if not bool(jnp.any(out[2])):
+            if telemetry:
+                return (*out[:4], widened, tel)
             return (*out, widened)
         if migrations >= policy.max_migrations:
             raise RuntimeError(

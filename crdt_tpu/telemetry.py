@@ -1,0 +1,306 @@
+"""jit-transparent telemetry: on-device convergence counters + spans.
+
+The host-side registry (utils/metrics.py) goes blind exactly where
+production traffic lives — inside jit, ``deferred_depth`` returns the
+-1 traced sentinel and a fully jitted train/serve step records nothing.
+This module is the device-side complement: a :class:`Telemetry` pytree
+sidecar computed **in-kernel** with ``lax`` ops, so it survives
+jit/shard_map, accumulates across gossip rounds, and returns alongside
+state from the mesh entry points (``mesh_gossip*`` / ``mesh_fold*`` /
+``run_delta_ring`` / ``gossip_elastic``) behind a ``telemetry=`` flag
+that defaults off and traces NOTHING when disabled (the telemetry=False
+program lowers to HLO identical to the flag-free one —
+tests/test_telemetry.py pins this by ``lower().as_text()`` comparison).
+
+The counters are the headline evaluation quantities of the δ-CRDT
+literature (Almeida et al. 1603.01529; Enes et al. 1803.02750 — bytes
+shipped and sync metadata per round), measured natively per round:
+
+- ``merges``          — pairwise lattice-join applications (local fold
+  joins, nominally rows-1, plus one per ring round per replica rank;
+  all-reduce entry points count log2(P) / P-1 exchange joins),
+  summed over replica ranks.
+- ``slots_changed``   — content lanes the cross-replica joins actually
+  changed (per-kind definition: dense ORSWOT members whose birth
+  clocks changed, map keys whose cells changed, sparse dot/cell lanes
+  changed; the generic fallback diffs every state plane).
+- ``deferred_depth``  — final parked-slot depth: max over replicas of
+  valid slots summed across every ``*dvalid`` buffer level (the same
+  masked-epoch convention ``metrics.deferred_depth`` walks on host).
+- ``bytes_exchanged`` — physical bytes shipped over mesh links: the
+  per-device shipped pytree's bytes × exchanges, summed over ALL
+  devices (element-axis copies each really transmit).
+- ``residue``         — the δ-ring convergence indicator
+  (parallel/delta_ring.py); 0 for non-δ entry points.
+- ``widen_pressure``  — max occupancy fraction over the bounded parked
+  buffers (1.0 = at capacity: the in-jit analog of the
+  ``elastic.<kind>.headroom`` gauges, which report 1 - this).
+
+Every field is a replicated scalar, so the pytree costs six words of
+output and no extra collectives beyond one psum/pmax fusion group.
+
+Span tracing (:func:`span`) is the host-side half: a context manager
+that emits structured JSONL trace events (``configure_tracing`` points
+them at a file; ``drain_events`` empties the in-memory ring) and nests
+``jax.named_scope`` + ``jax.profiler.TraceAnnotation`` so the same
+span names appear in XProf device timelines. Exporting both worlds —
+registry snapshots, Telemetry pytrees, spans — to Prometheus text and
+JSONL lives in :mod:`crdt_tpu.exporter`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .utils.metrics import metrics
+
+
+class Telemetry(NamedTuple):
+    """On-device convergence counters (a pytree of replicated scalars)."""
+
+    merges: jax.Array          # uint32 — join applications
+    slots_changed: jax.Array   # uint32 — content lanes changed by joins
+    deferred_depth: jax.Array  # uint32 — final max parked-slot depth
+    bytes_exchanged: jax.Array # float32 — physical bytes over mesh links
+    residue: jax.Array         # int32 — δ-ring residue (0 elsewhere)
+    widen_pressure: jax.Array  # float32 — max parked-buffer occupancy
+
+
+def zeros() -> Telemetry:
+    """The accumulation identity."""
+    return Telemetry(
+        merges=jnp.zeros((), jnp.uint32),
+        slots_changed=jnp.zeros((), jnp.uint32),
+        deferred_depth=jnp.zeros((), jnp.uint32),
+        bytes_exchanged=jnp.zeros((), jnp.float32),
+        residue=jnp.zeros((), jnp.int32),
+        widen_pressure=jnp.zeros((), jnp.float32),
+    )
+
+
+def specs() -> Telemetry:
+    """shard_map out_specs: every field is a replicated scalar."""
+    from jax.sharding import PartitionSpec as P
+
+    return Telemetry(P(), P(), P(), P(), P(), P())
+
+
+def combine(a: Telemetry, b: Telemetry) -> Telemetry:
+    """Fold two runs' telemetry (e.g. across elastic migrations):
+    throughput counters add; the final-state gauges (depth, residue,
+    pressure) come from the LATER run — they describe where the state
+    ended, not a rate."""
+    return Telemetry(
+        merges=a.merges + b.merges,
+        slots_changed=a.slots_changed + b.slots_changed,
+        bytes_exchanged=a.bytes_exchanged + b.bytes_exchanged,
+        deferred_depth=b.deferred_depth,
+        residue=b.residue,
+        widen_pressure=b.widen_pressure,
+    )
+
+
+# ---- in-kernel reducers ---------------------------------------------------
+# All pure lax/jnp on static shapes: safe inside jit AND shard_map.
+
+def device_depth(state) -> jax.Array:
+    """In-kernel ``deferred_depth``: max over leading (replica) lanes of
+    valid parked slots summed across every ``*dvalid`` buffer level —
+    the jit-transparent twin of ``utils.metrics.deferred_depth`` (same
+    masked-epoch field convention, uint32 instead of the -1 host
+    sentinel)."""
+    total = None
+
+    def walk(node):
+        nonlocal total
+        for name in node._fields:
+            child = getattr(node, name)
+            if name.endswith("dvalid"):
+                d = jnp.sum(child.astype(jnp.uint32), axis=-1)
+                total = d if total is None else total + d
+            elif hasattr(child, "_fields"):
+                walk(child)
+
+    if hasattr(state, "_fields"):
+        walk(state)
+    if total is None:
+        return jnp.zeros((), jnp.uint32)
+    return jnp.max(total).astype(jnp.uint32)
+
+
+def device_pressure(state) -> jax.Array:
+    """Max occupancy fraction over the bounded parked buffers (every
+    ``*dvalid`` level): 1.0 = some replica's buffer is at capacity —
+    the widen-before-overflow signal, in-kernel."""
+    worst = None
+
+    def walk(node):
+        nonlocal worst
+        for name in node._fields:
+            child = getattr(node, name)
+            if name.endswith("dvalid"):
+                cap = max(child.shape[-1], 1)
+                frac = jnp.max(
+                    jnp.sum(child.astype(jnp.float32), axis=-1) / cap
+                )
+                worst = frac if worst is None else jnp.maximum(worst, frac)
+            elif hasattr(child, "_fields"):
+                walk(child)
+
+    if hasattr(state, "_fields"):
+        walk(state)
+    if worst is None:
+        return jnp.zeros((), jnp.float32)
+    return worst.astype(jnp.float32)
+
+
+def generic_slots_changed(a, b) -> jax.Array:
+    """Fallback slots-changed counter: entries that differ across EVERY
+    state plane. Exact for element-replicated layouts; kinds with a
+    sharded content plane use their ops kernel's specialized counter
+    (``ops.orswot.changed_members`` etc.) so element-shard psums don't
+    double count replicated planes."""
+    total = jnp.zeros((), jnp.uint32)
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        total = total + jnp.sum(x != y, dtype=jnp.uint32)
+    return total
+
+
+def shipped_bytes(pytree) -> int:
+    """STATIC per-exchange byte count of a shipped pytree (shapes are
+    static under tracing, so this is a Python int even in-kernel)."""
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(pytree))
+
+
+# ---- host-side drain ------------------------------------------------------
+
+def is_concrete(tel: Telemetry) -> bool:
+    return not any(
+        isinstance(x, jax.core.Tracer) for x in jax.tree.leaves(tel)
+    )
+
+
+def to_dict(tel: Telemetry) -> Dict[str, Any]:
+    """Host ints/floats for a CONCRETE Telemetry (exporter/JSONL form)."""
+    return {
+        "merges": int(tel.merges),
+        "slots_changed": int(tel.slots_changed),
+        "deferred_depth": int(tel.deferred_depth),
+        "bytes_exchanged": float(tel.bytes_exchanged),
+        "residue": int(tel.residue),
+        "widen_pressure": float(tel.widen_pressure),
+    }
+
+
+def record(kind: str, tel: Telemetry) -> None:
+    """Drain a concrete Telemetry into the host registry under
+    ``telemetry.<kind>.*`` (counters for the monotone fields, gauges
+    for the final-state ones). A no-op under tracing — the caller then
+    owns the returned pytree (that is the whole point of it)."""
+    if not is_concrete(tel):
+        return
+    d = to_dict(tel)
+    metrics.count(f"telemetry.{kind}.merges", d["merges"])
+    metrics.count(f"telemetry.{kind}.slots_changed", d["slots_changed"])
+    metrics.count(
+        f"telemetry.{kind}.bytes_exchanged", int(d["bytes_exchanged"])
+    )
+    metrics.observe(f"telemetry.{kind}.deferred_depth", d["deferred_depth"])
+    metrics.observe(f"telemetry.{kind}.residue", d["residue"])
+    metrics.observe(f"telemetry.{kind}.widen_pressure", d["widen_pressure"])
+
+
+# ---- span tracing ---------------------------------------------------------
+
+_trace_lock = threading.Lock()
+_trace_events: list = []
+_trace_path: Optional[str] = None
+_MAX_BUFFERED_EVENTS = 65536
+_local = threading.local()
+
+
+def configure_tracing(path: Optional[str]) -> None:
+    """Point span JSONL emission at ``path`` (append mode; None = keep
+    events only in the in-memory ring for :func:`drain_events`)."""
+    global _trace_path
+    with _trace_lock:
+        _trace_path = path
+
+
+def drain_events() -> list:
+    """Pop and return every buffered span event (oldest first)."""
+    with _trace_lock:
+        out, _trace_events[:] = list(_trace_events), []
+    return out
+
+
+def _emit(event: Dict[str, Any]) -> None:
+    with _trace_lock:
+        _trace_events.append(event)
+        del _trace_events[:-_MAX_BUFFERED_EVENTS]
+        path = _trace_path
+    if path:
+        try:
+            # default=str: attrs may carry numpy/jnp scalars; tracing
+            # must never take down the traced program.
+            line = json.dumps(event, default=str)
+            with open(path, "a") as f:
+                f.write(line + "\n")
+        except (OSError, TypeError, ValueError):
+            pass
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """A named span: structured JSONL event on exit (wall-clock start,
+    duration, attrs, parent span) AND the same name nested into
+    ``jax.named_scope`` + ``jax.profiler.TraceAnnotation``, so host
+    spans line up with XProf device timelines. Also feeds the registry
+    timer histogram (``<name>_seconds`` gauge) so snapshot-only
+    consumers see span durations too. Attrs must be JSON-serializable.
+    """
+    stack = getattr(_local, "stack", None)
+    if stack is None:
+        stack = _local.stack = []
+    parent = stack[-1] if stack else None
+    stack.append(name)
+    t_wall = time.time()
+    t0 = time.perf_counter()
+    try:
+        with contextlib.ExitStack() as es:
+            # The registry timer owns the `<name>_seconds` gauge (same
+            # shape as every other metrics.time site); the local clock
+            # below only feeds the trace event.
+            es.enter_context(metrics.time(name))
+            es.enter_context(jax.named_scope(name))
+            try:
+                es.enter_context(jax.profiler.TraceAnnotation(name))
+            except Exception:
+                pass  # profiler backend unavailable — host event still fires
+            yield
+    finally:
+        stack.pop()
+        dur = time.perf_counter() - t0
+        _emit({
+            "record": "span",
+            "name": name,
+            "ts": t_wall,
+            "dur_s": dur,
+            "parent": parent,
+            "attrs": attrs,
+        })
+
+
+__all__ = [
+    "Telemetry", "combine", "configure_tracing", "device_depth",
+    "device_pressure", "drain_events", "generic_slots_changed",
+    "is_concrete", "record", "shipped_bytes", "span", "specs",
+    "to_dict", "zeros",
+]

@@ -79,18 +79,35 @@ class Metrics:
 metrics = Metrics()
 
 
+_profile_trace_logged = False
+
+
 @contextlib.contextmanager
 def profile_trace(logdir: str):
     """Device-level profiling around a block (perfetto/XProf trace in
-    ``logdir``); no-op if the profiler cannot start (e.g. no device)."""
+    ``logdir``); no-op if the profiler cannot start (e.g. no device) —
+    but a DIAGNOSABLE no-op: each failed start records
+    ``profile_trace.start_failed`` and the first one logs the reason,
+    so a missing XProf trace points at its cause instead of silence."""
     import jax
 
     started = False
     try:
         jax.profiler.start_trace(logdir)
         started = True
-    except Exception:
-        pass
+    except Exception as exc:
+        global _profile_trace_logged
+        metrics.count("profile_trace.start_failed")
+        if not _profile_trace_logged:
+            _profile_trace_logged = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "jax.profiler.start_trace(%r) failed (%r); proceeding "
+                "without a device trace (logged once; subsequent "
+                "failures only count profile_trace.start_failed)",
+                logdir, exc,
+            )
     try:
         yield
     finally:
@@ -110,7 +127,11 @@ def deferred_depth(state) -> float:
     join/fold time. Returns -1.0 (and records nothing via
     ``observe_depth``) when the state is a traced value — the mesh entry
     points may legitimately run under an outer jit (e.g. a fully jitted
-    train step), where host-side metrics cannot see concrete values."""
+    train step), where host-side metrics cannot see concrete values.
+    Each such skip counts ``anti_entropy.depth_skipped_traced`` so
+    operators SEE the blindness (and know to ask the entry point for
+    the in-jit ``telemetry=`` sidecar — crdt_tpu/telemetry.py) instead
+    of inferring it from absent gauges."""
     import jax
     import numpy as np
 
@@ -123,6 +144,7 @@ def deferred_depth(state) -> float:
         )
 
     if any(opaque(x) for x in jax.tree.leaves(state)):
+        metrics.count("anti_entropy.depth_skipped_traced")
         return -1.0
 
     def walk(node):

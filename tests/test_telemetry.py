@@ -1,0 +1,308 @@
+"""Acceptance gates for the in-jit Telemetry sidecar (crdt_tpu/telemetry.py).
+
+Two contracts pinned here:
+
+1. A JITTED gossip loop (dense ORSWOT + the sparse kind) returns a
+   Telemetry pytree whose merge/bytes/depth counters match a host-side
+   recomputation BIT-EXACTLY — the replay applies the same un-jitted
+   joins in ring order and counts with numpy.
+2. ``telemetry=False`` adds zero cost: the entry point's lowered HLO is
+   IDENTICAL to the pre-telemetry program, asserted by reconstructing
+   that program here and comparing ``jax.jit(...).lower().as_text()``.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from crdt_tpu import telemetry as tele
+from crdt_tpu.models.orswot import BatchedOrswot
+from crdt_tpu.models.sparse_orswot import BatchedSparseOrswot
+from crdt_tpu.ops import orswot as ops
+from crdt_tpu.ops import sparse_orswot as sp
+from crdt_tpu.ops.pallas_kernels import fold_auto
+from crdt_tpu.parallel import (
+    gossip_elastic,
+    make_mesh,
+    mesh_delta_gossip,
+    mesh_fold,
+    mesh_gossip,
+    mesh_gossip_sparse,
+    shard_orswot,
+)
+from crdt_tpu.parallel.anti_entropy import _sparse_pad_and_template
+from crdt_tpu.parallel.collectives import ring_round
+from crdt_tpu.parallel.mesh import ELEMENT_AXIS, REPLICA_AXIS, orswot_specs
+from crdt_tpu.pure.orswot import Orswot
+
+P_REPLICAS = 4  # replica-axis size for every mesh here
+
+
+def _oracle_replicas():
+    """Six diverged replicas, one holding a PARKED remove that stays
+    parked through convergence (its rm ctx cites a GHOST replica whose
+    add is never delivered to anyone, so no top ever covers the clock),
+    keeping deferred depth/pressure nonzero for the telemetry gauges."""
+    reps = [Orswot() for _ in range(6)]
+    for i in range(5):
+        r = reps[i]
+        r.apply(r.add(f"m{i}", r.read().derive_add_ctx(f"s{i}")))
+        if i % 2:
+            r.apply(r.add("shared", r.read().derive_add_ctx(f"s{i}")))
+    ghost = Orswot()
+    ghost.apply(ghost.add("x", ghost.read().derive_add_ctx("ghost")))
+    rm = ghost.rm("x", ghost.contains("x").derive_rm_ctx())
+    reps[5].apply(rm)  # the ghost's add never arrives -> parked forever
+    return reps
+
+
+def _split(state, p):
+    lead = jax.tree.leaves(state)[0].shape[0]
+    lr = lead // p
+    return [
+        jax.tree.map(lambda x: x[i * lr:(i + 1) * lr], state)
+        for i in range(p)
+    ], lr
+
+
+def _replay_ring(blocks, rounds, fold_fn, join_fn, changed_np):
+    """Host-side recomputation of the ring gossip: per-device local
+    fold, then ``rounds`` synchronous unit-shift rounds (device i joins
+    in the state of device i-1 — collectives.ring_round's perm), with
+    the changed-lane counter accumulated in numpy."""
+    devs = [fold_fn(b)[0] for b in blocks]
+    p = len(devs)
+    slots = 0
+    for _ in range(rounds):
+        new = []
+        for i in range(p):
+            j, _ = join_fn(devs[i], devs[(i - 1) % p])
+            slots += changed_np(devs[i], j)
+            new.append(j)
+        devs = new
+    return devs, slots
+
+
+def _np_depth(dev):
+    return int(np.asarray(dev.dvalid).sum())
+
+
+def _np_pressure(dev):
+    dv = np.asarray(dev.dvalid)
+    return dv.sum() / dv.shape[-1]
+
+
+def _state_bytes(dev):
+    return sum(np.asarray(x).nbytes for x in jax.tree.leaves(dev))
+
+
+def test_jitted_dense_gossip_telemetry_matches_host_recompute():
+    reps = _oracle_replicas()
+    batched = BatchedOrswot.from_pure(reps)
+    mesh = make_mesh(P_REPLICAS, 1)
+    sharded = shard_orswot(batched.state, mesh)
+    rounds = P_REPLICAS - 1
+
+    run = jax.jit(
+        lambda s: mesh_gossip(s, mesh, local_fold="tree", telemetry=True)
+    )
+    rows, overflow, tel = run(sharded)
+    assert not bool(overflow)
+
+    blocks, lr = _split(sharded, P_REPLICAS)
+
+    def changed_np(a, b):
+        return int(np.any(
+            np.asarray(a.ctr) != np.asarray(b.ctr), axis=-1
+        ).sum())
+
+    devs, slots = _replay_ring(blocks, rounds, ops.fold, ops.join, changed_np)
+
+    assert int(tel.merges) == P_REPLICAS * (lr - 1 + rounds)
+    assert int(tel.slots_changed) == slots
+    assert int(tel.deferred_depth) == max(_np_depth(d) for d in devs)
+    assert int(tel.deferred_depth) > 0  # the parked remove is visible
+    assert float(tel.bytes_exchanged) == float(
+        np.float32(P_REPLICAS * rounds * _state_bytes(devs[0]))
+    )
+    assert int(tel.residue) == 0
+    assert float(tel.widen_pressure) == pytest.approx(
+        max(_np_pressure(d) for d in devs)
+    )
+    # The converged rows are the replayed per-device states bit-exactly.
+    for i, dev in enumerate(devs):
+        row = jax.tree.map(lambda x: x[i], rows)
+        assert all(
+            bool(jnp.array_equal(x, y))
+            for x, y in zip(jax.tree.leaves(row), jax.tree.leaves(dev))
+        )
+
+
+def test_jitted_sparse_gossip_telemetry_matches_host_recompute():
+    reps = _oracle_replicas()
+    batched = BatchedSparseOrswot.from_pure(reps, dot_cap=8)
+    mesh = make_mesh(P_REPLICAS, 1)
+    padded, _ = _sparse_pad_and_template(batched.state, P_REPLICAS)
+    rounds = P_REPLICAS - 1
+
+    run = jax.jit(
+        lambda s: mesh_gossip_sparse(s, mesh, telemetry=True)
+    )
+    rows, flags, tel = run(batched.state)
+    assert not bool(jnp.any(flags))
+
+    blocks, lr = _split(padded, P_REPLICAS)
+
+    def changed_np(a, b):
+        diff = (
+            (np.asarray(a.eid) != np.asarray(b.eid))
+            | (np.asarray(a.act) != np.asarray(b.act))
+            | (np.asarray(a.ctr) != np.asarray(b.ctr))
+            | (np.asarray(a.valid) != np.asarray(b.valid))
+        )
+        return int(diff.sum())
+
+    devs, slots = _replay_ring(blocks, rounds, sp.fold, sp.join, changed_np)
+
+    assert int(tel.merges) == P_REPLICAS * (lr - 1 + rounds)
+    assert int(tel.slots_changed) == slots
+    assert int(tel.deferred_depth) == max(_np_depth(d) for d in devs)
+    assert int(tel.deferred_depth) > 0
+    assert float(tel.bytes_exchanged) == float(
+        np.float32(P_REPLICAS * rounds * _state_bytes(devs[0]))
+    )
+    assert int(tel.residue) == 0
+    for i, dev in enumerate(devs):
+        row = jax.tree.map(lambda x: x[i], rows)
+        assert all(
+            bool(jnp.array_equal(x, y))
+            for x, y in zip(jax.tree.leaves(row), jax.tree.leaves(dev))
+        )
+
+
+def test_telemetry_off_hlo_identical_to_pretelemetry_program():
+    """``telemetry=False`` must trace EXACTLY the pre-telemetry gossip
+    program: this reconstructs that program (the flag-free shard_map
+    closure as it existed before the telemetry layer) and compares
+    lowered HLO text — any op the flag smuggles in fails the string
+    equality."""
+    reps = _oracle_replicas()
+    batched = BatchedOrswot.from_pure(reps)
+    mesh = make_mesh(P_REPLICAS, 1)
+    sharded = shard_orswot(batched.state, mesh)
+    rounds = P_REPLICAS - 1
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(orswot_specs(),),
+        out_specs=(orswot_specs(), P()),
+        check_vma=False,
+    )
+    def gossip_fn(local):
+        fold_fn = partial(fold_auto, prefer="tree")
+        folded, of = fold_fn(local)
+        for _ in range(rounds):
+            folded, of_r = ring_round(
+                folded, REPLICA_AXIS, reduce_overflow=False, join_fn=ops.join
+            )
+            of = of | of_r
+        of = lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS)) > 0
+        return jax.tree.map(lambda x: x[None], folded), of
+
+    baseline = jax.jit(gossip_fn)
+    baseline_txt = jax.jit(lambda s: baseline(s)).lower(sharded).as_text()
+    entry_txt = jax.jit(
+        lambda s: mesh_gossip(
+            s, mesh, rounds=rounds, local_fold="tree", telemetry=False
+        )
+    ).lower(sharded).as_text()
+    assert entry_txt == baseline_txt
+
+
+def test_telemetry_flag_leaves_results_bit_identical():
+    """Flag on vs off: same converged states, same overflow — the
+    sidecar only ADDS outputs."""
+    reps = _oracle_replicas()
+    batched = BatchedOrswot.from_pure(reps)
+    mesh = make_mesh(P_REPLICAS, 1)
+    sharded = shard_orswot(batched.state, mesh)
+
+    rows0, of0 = mesh_gossip(sharded, mesh, local_fold="tree")
+    rows1, of1, _ = mesh_gossip(
+        sharded, mesh, local_fold="tree", telemetry=True
+    )
+    assert bool(of0) == bool(of1)
+    assert all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(rows0), jax.tree.leaves(rows1))
+    )
+
+    out0 = mesh_fold(sharded, mesh, local_fold="tree")
+    out1 = mesh_fold(sharded, mesh, local_fold="tree", telemetry=True)
+    assert len(out0) == 2 and len(out1) == 3
+    assert all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(out0[0]), jax.tree.leaves(out1[0]))
+    )
+    assert int(out1[2].merges) > 0
+
+
+def test_delta_ring_telemetry_reports_residue_and_bytes():
+    reps = _oracle_replicas()
+    batched = BatchedOrswot.from_pure(reps)
+    mesh = make_mesh(P_REPLICAS, 1)
+    sharded = shard_orswot(batched.state, mesh)
+    e = sharded.ctr.shape[-2]
+    dirty = jnp.ones((sharded.top.shape[0], e), bool)
+    fctx = jnp.where(dirty[..., None], sharded.ctr, 0)
+
+    out0 = mesh_delta_gossip(sharded, dirty, fctx, mesh, local_fold="tree")
+    out1 = mesh_delta_gossip(
+        sharded, dirty, fctx, mesh, local_fold="tree", telemetry=True
+    )
+    assert len(out0) == 4 and len(out1) == 5
+    tel = out1[4]
+    assert int(tel.residue) == int(out1[3])  # sidecar mirrors output 4
+    assert int(out1[3]) == int(out0[3])
+    lr = sharded.top.shape[0] // P_REPLICAS
+    assert int(tel.merges) == P_REPLICAS * (lr - 1 + (P_REPLICAS - 1))
+    assert float(tel.bytes_exchanged) > 0
+    assert all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(out0[0]), jax.tree.leaves(out1[0]))
+    )
+
+
+def test_gossip_elastic_threads_telemetry_through():
+    reps = _oracle_replicas()
+    batched = BatchedOrswot.from_pure(reps)
+    mesh = make_mesh(P_REPLICAS, 1)
+    rows, widened, tel = gossip_elastic(batched, mesh, telemetry=True)
+    assert widened == {}
+    assert isinstance(tel, tele.Telemetry)
+    assert int(tel.merges) > 0
+    rows0, widened0 = gossip_elastic(batched, mesh)
+    assert widened0 == {}
+
+
+def test_device_reducers_match_host_metrics_walk():
+    """The in-kernel depth/pressure walkers agree with the host-side
+    ``deferred_depth`` on concrete states (the un-jitted small case)."""
+    from crdt_tpu.utils.metrics import deferred_depth
+
+    state = ops.empty(4, 2, deferred_cap=4, batch=(3,))
+    dvalid = jnp.asarray(
+        [[True, False, False, False],
+         [True, True, True, False],
+         [False, False, False, False]]
+    )
+    state = state._replace(dvalid=dvalid)
+    assert int(tele.device_depth(state)) == 3
+    assert deferred_depth(state) == 3.0
+    assert float(tele.device_pressure(state)) == pytest.approx(0.75)
