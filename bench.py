@@ -264,6 +264,103 @@ def bench_tpu():
     return mps, path, gbps, bytes_moved, f"{r_total}x{E}x{A}"
 
 
+def bench_comms():
+    """Anti-entropy COMMS leg (``--quick-comms`` runs it alone): wire
+    and payload bytes per ring round for full-state gossip vs the
+    digest-gated δ exchange, on a sparse low-churn workload (<5% dirty
+    rows — the regime the δ papers target, PAPERS.md 1603.01529 /
+    1803.02750). The in-kernel telemetry counters (telemetry.py
+    ``bytes_exchanged`` wire / ``bytes_useful`` post-mask) ARE the
+    measurement, so the number reported is exactly what the links
+    carried. Converged states are asserted bit-identical across digest
+    on/off before any ratio is reported — a byte win that changed the
+    lattice would be a bug, not a win."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.ops import orswot as ops
+    from crdt_tpu.parallel import (
+        make_mesh, mesh_delta_gossip, mesh_gossip,
+    )
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        log("comms leg needs >= 2 devices for a ring; skipping")
+        return []
+    p = n_dev
+    e = int(os.environ.get("BENCH_COMMS_ELEMS", 2048))
+    a = int(os.environ.get("BENCH_COMMS_ACTORS", 8))
+    cap = int(os.environ.get("BENCH_COMMS_CAP", 64))
+    mesh = make_mesh(p, 1)
+
+    # Synced base (every replica holds the same first-half dots), then
+    # <5% churn: each replica mints one fresh dot on its own row — the
+    # steady-state shape of a large, mostly-quiet element universe.
+    base = jnp.zeros((p, e, a), jnp.uint32).at[:, : e // 2, 0].set(1)
+    state = ops.empty(e, a, deferred_cap=4, batch=(p,))
+    churn_rows = jnp.arange(p) + e // 2
+    actors = jnp.arange(p) % a
+    ctr = base.at[jnp.arange(p), churn_rows, actors].set(2)
+    top = jnp.max(ctr, axis=1)
+    state = state._replace(top=top, ctr=ctr)
+    dirty = jnp.zeros((p, e), bool).at[jnp.arange(p), churn_rows].set(True)
+    fctx = jnp.where(dirty[..., None], ctr, 0)
+    churn = float(dirty.sum() / dirty.size)
+    assert churn < 0.05
+
+    _, _, tel_full = mesh_gossip(state, mesh, telemetry=True)
+    rounds_full = p - 1
+    # Pin the δ budget explicitly (the pipelined default window) so the
+    # per-link-round denominators below always match the rounds run.
+    rounds_delta = 2 * (p - 1) - 1
+    outs = {}
+    for digest in (False, True):
+        outs[digest] = mesh_delta_gossip(
+            state, dirty, fctx, mesh, rounds=rounds_delta, cap=cap,
+            digest=digest, telemetry=True,
+        )
+    rows_off, rows_on = outs[False][0], outs[True][0]
+    identical = all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(rows_off), jax.tree.leaves(rows_on))
+    )
+    assert identical, "digest gating changed the converged lattice"
+    assert int(outs[True][3]) == 0, "comms leg did not certify convergence"
+    tel_off, tel_on = outs[False][4], outs[True][4]
+
+    # Per-link-round byte rates make the three modes comparable across
+    # their different round budgets.
+    links_full = p * rounds_full
+    links_delta = p * rounds_delta
+    full_rate = float(tel_full.bytes_exchanged) / links_full
+    wire_rate = float(tel_on.bytes_exchanged) / links_delta
+    useful_rate = float(tel_on.bytes_useful) / links_delta
+    useful_rate_off = float(tel_off.bytes_useful) / links_delta
+    ratio = wire_rate / full_rate
+    log(
+        f"config-comms: {p} ranks x {e} elems ({churn:.2%} churn, cap "
+        f"{cap}): full-state {full_rate:,.0f} B/link-round; δ wire "
+        f"{wire_rate:,.0f} ({ratio:.1%} of full); δ useful gated "
+        f"{useful_rate:,.0f} vs ungated {useful_rate_off:,.0f}; "
+        f"converged states bit-identical"
+    )
+    return [{
+        "config": "comms", "metric": "delta_wire_vs_full_ratio",
+        "value": round(ratio, 4), "unit": "ratio",
+        "bytes_full_per_link_round": round(full_rate, 1),
+        "bytes_delta_wire_per_link_round": round(wire_rate, 1),
+        "bytes_delta_useful_per_link_round": round(useful_rate, 1),
+        "bytes_delta_useful_ungated_per_link_round":
+            round(useful_rate_off, 1),
+        "bytes_exchanged_full_total": float(tel_full.bytes_exchanged),
+        "bytes_exchanged_delta_total": float(tel_on.bytes_exchanged),
+        "bytes_useful_delta_total": float(tel_on.bytes_useful),
+        "rounds_full": rounds_full, "rounds_delta": rounds_delta,
+        "churn": round(churn, 4), "cap": cap, "bit_identical": identical,
+        "shape": f"{p}x{e}x{a}",
+    }]
+
+
 def bench_elastic():
     """Elastic capacity migration (diagnostic, stderr): wall-clock of the
     sanctioned overflow recovery — ``elastic.widen`` 2×-ing the
@@ -846,6 +943,12 @@ def parse_args(argv=None):
         default=os.environ.get("BENCH_METRICS_OUT", ""),
         help="append the metrics snapshot / telemetry / span JSONL here",
     )
+    ap.add_argument(
+        "--quick-comms",
+        action="store_true",
+        help="run ONLY the comms leg (full vs digest-gated gossip bytes "
+             "per round) and print its record to stdout",
+    )
     return ap.parse_args(argv)
 
 
@@ -853,6 +956,21 @@ def main(argv=None):
     global R, E, CHUNK
     args = parse_args(argv)
     degraded = False
+    if args.quick_comms:
+        # The fast comms-only mode: one leg, one stdout JSON line.
+        if os.environ.get("BENCH_PROBE", "1") != "0" and not tpu_reachable():
+            from crdt_tpu.utils.cpu_pin import pin_cpu
+
+            pin_cpu(virtual_devices=8)
+        from crdt_tpu.telemetry import span
+
+        with span("bench.comms", quick=True):
+            recs = bench_comms()
+        for rec in recs:
+            log(json.dumps(rec))
+        print(json.dumps(recs[0] if recs else {"config": "comms",
+                                               "skipped": True}))
+        return
     if os.environ.get("BENCH_PROBE", "1") != "0" and not tpu_reachable():
         # No real TPU: fail FAST and honest instead of hanging the round.
         # Pin CPU (dropping the wedged backend), scale the shape to
@@ -885,6 +1003,7 @@ def main(argv=None):
         ("sparse", bench_sparse),
         ("sparse_map", bench_sparse_map),
         ("elastic", bench_elastic),
+        ("comms", bench_comms),
     ]:
         if os.environ.get(f"BENCH_{name.upper()}", "1") != "0":
             try:
@@ -950,6 +1069,19 @@ def main(argv=None):
     # (snapshot + spans; schema-checked by tier-1).
     snapshot = metrics.snapshot()
     headline["metrics"] = snapshot
+    # The comms ratio rides the headline record too (the driver captures
+    # only the headline into BENCH_r*.json; the digest-gating win is a
+    # round metric, not a diagnostic).
+    comms = next((r for r in records if r.get("config") == "comms"), None)
+    if comms is not None:
+        headline["comms"] = {
+            k: comms[k] for k in (
+                "value", "bytes_full_per_link_round",
+                "bytes_delta_wire_per_link_round",
+                "bytes_delta_useful_per_link_round", "churn",
+                "bit_identical",
+            ) if k in comms
+        }
     records.append({"config": 3, **headline})
     # Per-config JSON lines (machine-readable) on stderr + a sidecar
     # file; stdout stays EXACTLY one line — the driver's contract.

@@ -233,8 +233,56 @@ def _fold_entries_fused(
 # vs 77-436 GB/s at 2 MiB in the r3 sweep), and 4 MiB fails to compile.
 _VMEM_BLOCK_BUDGET = 1024 * 1024
 
+# Measured (tile_e, r_chunk) overrides from tools/tile_sweep.py
+# --write-table, committed at tools/tile_table.json. None = not loaded
+# yet; {} = no table / no entries (pure heuristic). The sweep writes
+# entries keyed by actor count so the defaults are evidence, not
+# folklore — see _tile_table().
+_TILE_TABLE: Optional[dict] = None
+
+
+def _tile_table() -> dict:
+    """The committed autotune table (tools/tile_table.json), loaded
+    once per process: ``{"entries": [{"a": .., "tile_e": ..,
+    "r_chunk": ..}, ...]}`` as written by ``tools/tile_sweep.py
+    --write-table``. Missing or malformed files degrade to the
+    heuristic (an empty table) — the committed table is an override,
+    never a requirement."""
+    global _TILE_TABLE
+    if _TILE_TABLE is None:
+        import json
+        import os
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            "tools", "tile_table.json",
+        )
+        try:
+            with open(path) as f:
+                table = json.load(f)
+            table.get("entries", [])  # shape check
+            _TILE_TABLE = table if isinstance(
+                table.get("entries", None), list) else {}
+        except (OSError, ValueError, AttributeError):
+            _TILE_TABLE = {}
+    return _TILE_TABLE
+
 
 def _pick_r_chunk(r: int, a: int, tile_e: int, r_chunk: Optional[int]) -> int:
+    if r_chunk is None:
+        # A committed sweep result for this (actor count, tile_e) wins
+        # over the VMEM-budget heuristic; both still get clamped to the
+        # batch and rounded to the halving tree's power of two below.
+        # A malformed entry (missing/non-numeric r_chunk) degrades to
+        # the heuristic — the table is an override, never a requirement.
+        for entry in _tile_table().get("entries", ()):
+            try:
+                if entry.get("a") == a and entry.get("tile_e") == tile_e:
+                    r_chunk = int(entry["r_chunk"])
+                    break
+            except (AttributeError, KeyError, TypeError, ValueError):
+                continue
     if r_chunk is None:
         r_chunk = max(8, _VMEM_BLOCK_BUDGET // (max(a, 1) * tile_e * 4))
     r_chunk = min(r_chunk, max(r, 1))

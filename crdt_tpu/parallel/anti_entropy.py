@@ -78,19 +78,26 @@ def _exchange_count(p: int) -> int:
 
 
 def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
-                 sum_axes, residue=None):
+                 sum_axes, residue=None, useful_per_dev=None):
     """Mesh-reduce per-device telemetry into replicated scalars (inside
     shard_map): throughput counters psum over the replica axis (and the
     element axis only for ``slots`` when the content plane is
     element-sharded — ``sum_axes``; None = caller already reduced);
     bytes psum over ALL devices (element copies physically transmit);
-    final-state gauges pmax."""
+    final-state gauges pmax. ``useful_per_dev`` is the per-device
+    post-mask payload byte count (δ-ring packets after digest gating);
+    None = no mask exists, wire == useful (whole-state exchanges)."""
     both = (REPLICA_AXIS, ELEMENT_AXIS)
+    wire = lax.psum(jnp.float32(bytes_per_dev), both)
     return tele.Telemetry(
         merges=lax.psum(jnp.uint32(merges_per_dev), REPLICA_AXIS),
         slots_changed=slots if sum_axes is None else lax.psum(slots, sum_axes),
         deferred_depth=lax.pmax(tele.device_depth(folded), both),
-        bytes_exchanged=lax.psum(jnp.float32(bytes_per_dev), both),
+        bytes_exchanged=wire,
+        bytes_useful=(
+            wire if useful_per_dev is None
+            else lax.psum(jnp.float32(useful_per_dev), both)
+        ),
         residue=(
             jnp.zeros((), jnp.int32) if residue is None else residue
         ),
@@ -98,23 +105,63 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
     )
 
 
-def _cached(kind: str, state, mesh: Mesh, build, *extra):
+def _cached(kind: str, state, mesh: Mesh, build, *extra, donate_argnums=()):
     """The memoised shard_map closure for ``kind`` on this (mesh, input
     shape/dtype signature): jit-wrapped once, so repeated anti-entropy
-    rounds hit the trace/compile cache instead of re-lowering."""
+    rounds hit the trace/compile cache instead of re-lowering.
+    ``donate_argnums`` rides the cache key — a donating call consumes
+    its inputs, so it must never share a compiled program with the
+    copying flavor."""
     sig = tuple(
         (tuple(x.shape), str(x.dtype)) for x in jax.tree.leaves(state)
     )
-    key = (kind, mesh, sig, *extra)
+    key = (kind, mesh, sig, tuple(donate_argnums), *extra)
     fn = _FN_CACHE.get(key)
     if fn is None:
-        fn = _FN_CACHE[key] = jax.jit(build())
+        fn = _FN_CACHE[key] = jax.jit(
+            build(), donate_argnums=tuple(donate_argnums)
+        )
     return fn
+
+
+def _ring_donate_argnums(state, mesh: Mesh, donate: bool, n: int = 1):
+    """The donate_argnums for a ring/gossip entry point whose outputs
+    keep the ``[P, ...]`` per-device layout: the first ``n`` args
+    (state pytree, and for δ flavors the dirty mask) alias their
+    outputs in place — zero-copy — exactly when the padded replica axis
+    equals the mesh's (one replica block row per device), which is the
+    steady-state mesh shape. A larger batch reduces away leading rows,
+    XLA would silently drop the donation (with a warning), so we fall
+    back to the copying program and count the miss instead."""
+    if not donate:
+        return ()
+    lead = jax.tree.leaves(state)[0].shape[0]
+    if lead != mesh.shape[REPLICA_AXIS]:
+        metrics.count("anti_entropy.donate_unaliasable")
+        return ()
+    return tuple(range(n))
+
+
+def _consume(donate: bool, *trees) -> None:
+    """Donation semantics for the entry points whose outputs cannot
+    alias their inputs (the fold family reduces the replica axis away —
+    no output shares the batched input's shape): the caller yielded
+    ownership, so free the input buffers NOW rather than at whatever
+    point the last reference dies. Already-deleted / tracer leaves are
+    skipped (a donating ring call upstream may have consumed them)."""
+    if not donate:
+        return
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            try:
+                leaf.delete()
+            except Exception:
+                pass  # tracers / already-donated buffers
 
 
 def mesh_fold(
     state: OrswotState, mesh: Mesh, local_fold: str = "auto",
-    telemetry: bool = False,
+    telemetry: bool = False, donate: bool = False,
 ) -> Tuple[OrswotState, jax.Array]:
     """Full-mesh anti-entropy over the device mesh: every replica's state
     joined into one converged state, in one collective round.
@@ -129,9 +176,17 @@ def mesh_fold(
     :class:`crdt_tpu.telemetry.Telemetry` pytree rides along as a third
     element (in-kernel counters — they survive an outer jit; the flag
     off traces exactly the flag-free program).
-    """
+
+    ``donate=True`` consumes ``state``: the fold reduces the replica
+    axis away, so no output can alias the batched input — the input
+    buffers are instead freed as soon as the reduction lands rather
+    than when the caller's last reference dies, halving the entry's
+    resident HBM tail. The caller must not touch ``state`` afterwards
+    (in-place aliasing is the *ring* family's mode — ``mesh_gossip*``
+    keep the [P, ...] layout, so there donation really aliases)."""
     from ..ops.pallas_kernels import fold_auto
 
+    orig = state
     state = pad_replicas(state, mesh.shape[REPLICA_AXIS])
     state = pad_elements(state, mesh.shape[ELEMENT_AXIS])
 
@@ -192,6 +247,7 @@ def mesh_fold(
             build_tel if telemetry else build, local_fold, telemetry,
         )(state)
         jax.block_until_ready(out)  # time device work, not async dispatch
+    _consume(donate, state, orig)
     if telemetry and tele.is_concrete(out[2]):
         tele.record("orswot_fold", out[2])
     return out
@@ -209,6 +265,7 @@ def _mesh_gossip_lattice(
     telemetry: bool = False,
     slots_fn=None,
     element_sharded: bool = True,
+    donate: bool = False,
 ):
     """Shared scaffold for ring anti-entropy: each device folds its
     local replica block, then runs ``rounds`` unit-shift gossip rounds.
@@ -221,9 +278,19 @@ def _mesh_gossip_lattice(
     (telemetry.py) — per-round joins feed ``slots_fn`` (the kind's
     changed-lane counter; ``element_sharded`` picks the psum axes for it)
     and the shipped-state bytes; the flag off traces exactly the
-    flag-free program."""
+    flag-free program.
+
+    ``donate=True`` consumes the input state and — when the padded
+    replica axis equals the mesh's, the steady-state shape — aliases
+    the output rows onto the input buffers (``input_output_alias`` in
+    the lowering; tools/check_aliasing.py gates it), so the gossip
+    carries no second copy of the state in HBM. Larger batches cannot
+    alias (the local fold reduces leading rows away); they fall back to
+    freeing the input after the run and count
+    ``anti_entropy.donate_unaliasable``."""
     if rounds is None:
         rounds = mesh.shape[REPLICA_AXIS] - 1
+    argnums = _ring_donate_argnums(state, mesh, donate)
 
     def build():
         @partial(
@@ -286,9 +353,14 @@ def _mesh_gossip_lattice(
     with metrics.time(f"anti_entropy.{kind}"):
         out = _cached(
             kind, state, mesh, build_tel if telemetry else build,
-            rounds, telemetry, *cache_extra,
+            rounds, telemetry, *cache_extra, donate_argnums=argnums,
         )(state)
         jax.block_until_ready(out)  # time device work, not async dispatch
+    # Aliased buffers are already consumed by the donation; this frees
+    # the leftovers — the unaliasable fallback, and originals that were
+    # implicitly resharded onto the mesh (the executable then donated
+    # the committed copy, not the caller's array).
+    _consume(donate, state)
     if telemetry and tele.is_concrete(out[2]):
         tele.record(kind, out[2])
     return out
@@ -300,11 +372,14 @@ def mesh_gossip(
     rounds: Optional[int] = None,
     local_fold: str = "auto",
     telemetry: bool = False,
+    donate: bool = False,
 ) -> Tuple[OrswotState, jax.Array]:
     """Ring anti-entropy for ORSWOT replica batches (see
     ``_mesh_gossip_lattice``); the device-local pre-fold dispatches like
     ``mesh_fold`` (fused Pallas on TPU backends). ``telemetry=True``
-    appends the in-kernel Telemetry pytree (telemetry.py)."""
+    appends the in-kernel Telemetry pytree (telemetry.py);
+    ``donate=True`` consumes ``state`` and aliases the converged rows
+    onto its buffers in place (zero-copy — ``_mesh_gossip_lattice``)."""
     from ..ops.pallas_kernels import fold_auto
 
     state = pad_replicas(state, mesh.shape[REPLICA_AXIS])
@@ -313,13 +388,13 @@ def mesh_gossip(
         "orswot_gossip", state, mesh, ops.join,
         partial(fold_auto, prefer=local_fold), orswot_specs(), rounds,
         cache_extra=(local_fold,),
-        telemetry=telemetry, slots_fn=ops.changed_members,
+        telemetry=telemetry, slots_fn=ops.changed_members, donate=donate,
     )
 
 
 def mesh_gossip_map(
     state: MapState, mesh: Mesh, rounds: Optional[int] = None,
-    telemetry: bool = False,
+    telemetry: bool = False, donate: bool = False,
 ) -> Tuple[MapState, jax.Array]:
     """Ring anti-entropy for the composition layer: Map<K, MVReg>
     replica blocks gossiped one neighbor per round over the replica
@@ -329,12 +404,13 @@ def mesh_gossip_map(
     return _mesh_gossip_lattice(
         "map_gossip", state, mesh, map_ops.join, map_ops.fold, map_specs(),
         rounds, telemetry=telemetry, slots_fn=map_ops.changed_keys,
+        donate=donate,
     )
 
 
 def mesh_gossip_map_orswot(
     state: MapOrswotState, mesh: Mesh, rounds: Optional[int] = None,
-    telemetry: bool = False,
+    telemetry: bool = False, donate: bool = False,
 ) -> Tuple[MapOrswotState, jax.Array]:
     """Ring anti-entropy for ``Map<K, Orswot>`` replica blocks (the
     Val-generic slab composition) over the replica axis."""
@@ -346,12 +422,13 @@ def mesh_gossip_map_orswot(
         map_orswot_specs(), rounds,
         telemetry=telemetry,
         slots_fn=lambda a, b: ops.changed_members(a.core, b.core),
+        donate=donate,
     )
 
 
 def mesh_gossip_nested_map(
     state: NestedMapState, mesh: Mesh, rounds: Optional[int] = None,
-    telemetry: bool = False,
+    telemetry: bool = False, donate: bool = False,
 ) -> Tuple[NestedMapState, jax.Array]:
     """Ring anti-entropy for ``Map<K1, Map<K2, MVReg>>`` replica blocks
     over the replica axis."""
@@ -363,6 +440,7 @@ def mesh_gossip_nested_map(
         nested_map_specs(), rounds,
         telemetry=telemetry,
         slots_fn=lambda a, b: map_ops.changed_keys(a.m, b.m),
+        donate=donate,
     )
 
 
@@ -377,13 +455,17 @@ def _mesh_fold_lattice(
     telemetry: bool = False,
     slots_fn=None,
     element_sharded: bool = False,
+    donate: bool = False,
 ):
     """Shared scaffold for the map-family mesh folds: local log-tree
     fold per shard, replica-axis lattice-join all-reduce, and overflow
     flags reduced over BOTH axes (slab/deferred overflows can be
     key-shard-local, so every device must report the global flag).
     ``telemetry=True`` appends the in-kernel Telemetry pytree
-    (telemetry.py); the flag off traces exactly the flag-free program."""
+    (telemetry.py); the flag off traces exactly the flag-free program.
+    ``donate=True`` consumes the input batch: the fold reduces the
+    replica axis away so no output aliases it — the buffers are freed
+    as soon as the reduction lands (see ``mesh_fold``)."""
 
     def build():
         @partial(
@@ -448,13 +530,15 @@ def _mesh_fold_lattice(
             kind, state, mesh, build_tel if telemetry else build, telemetry
         )(state)
         jax.block_until_ready(out)  # time device work, not async dispatch
+    _consume(donate, state)
     if telemetry and tele.is_concrete(out[2]):
         tele.record(kind, out[2])
     return out
 
 
 def mesh_fold_map(
-    state: MapState, mesh: Mesh, telemetry: bool = False
+    state: MapState, mesh: Mesh, telemetry: bool = False,
+    donate: bool = False,
 ) -> Tuple[MapState, jax.Array]:
     """Full-mesh anti-entropy for the composition layer (BASELINE config
     4): every replica's Map<K, MVReg> state joined into one converged
@@ -471,12 +555,13 @@ def mesh_fold_map(
         map_ops.join, map_ops.fold,
         map_specs(), map_out_specs(),
         telemetry=telemetry, slots_fn=map_ops.changed_keys,
-        element_sharded=True,
+        element_sharded=True, donate=donate,
     )
 
 
 def mesh_fold_map_orswot(
-    state: MapOrswotState, mesh: Mesh, telemetry: bool = False
+    state: MapOrswotState, mesh: Mesh, telemetry: bool = False,
+    donate: bool = False,
 ) -> Tuple[MapOrswotState, jax.Array]:
     """Full-mesh anti-entropy for ``Map<K, Orswot>`` over the
     (replica × key) mesh: element shards hold whole keys (K*M blocks)
@@ -494,12 +579,13 @@ def mesh_fold_map_orswot(
         map_orswot_specs(), map_orswot_out_specs(),
         telemetry=telemetry,
         slots_fn=lambda a, b: ops.changed_members(a.core, b.core),
-        element_sharded=True,
+        element_sharded=True, donate=donate,
     )
 
 
 def mesh_fold_nested_map(
-    state: NestedMapState, mesh: Mesh, telemetry: bool = False
+    state: NestedMapState, mesh: Mesh, telemetry: bool = False,
+    donate: bool = False,
 ) -> Tuple[NestedMapState, jax.Array]:
     """Full-mesh anti-entropy for ``Map<K1, Map<K2, MVReg>>`` over the
     (replica × outer-key) mesh (K1*K2 blocks per shard). Returns
@@ -514,7 +600,7 @@ def mesh_fold_nested_map(
         nested_map_specs(), nested_map_out_specs(),
         telemetry=telemetry,
         slots_fn=lambda a, b: map_ops.changed_keys(a.m, b.m),
-        element_sharded=True,
+        element_sharded=True, donate=donate,
     )
 
 
@@ -572,7 +658,8 @@ def _pad_with_identity(states, rsize: int, ident):
     )
 
 
-def mesh_fold_lww(states, mesh: Mesh, telemetry: bool = False):
+def mesh_fold_lww(states, mesh: Mesh, telemetry: bool = False,
+                  donate: bool = False):
     """Converge an LWWReg replica batch (LWWState with leading axis R)
     over the mesh's replica axis. Returns ``(state, conflict)``;
     conflict marks an equal-marker/different-value merge anywhere
@@ -591,11 +678,12 @@ def mesh_fold_lww(states, mesh: Mesh, telemetry: bool = False):
         lww_ops.join, lww_ops.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template),
         jax.tree.map(lambda _: P(), template),
-        telemetry=telemetry,
+        telemetry=telemetry, donate=donate,
     )
 
 
-def mesh_fold_mvreg(states, mesh: Mesh, telemetry: bool = False):
+def mesh_fold_mvreg(states, mesh: Mesh, telemetry: bool = False,
+                    donate: bool = False):
     """Converge an MVReg replica batch (MVRegState with leading axis R)
     over the mesh's replica axis: dominated contents die, concurrent
     siblings survive (reference: src/mvreg.rs ``CvRDT::merge``).
@@ -615,7 +703,7 @@ def mesh_fold_mvreg(states, mesh: Mesh, telemetry: bool = False):
         mv.join, mv.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template),
         jax.tree.map(lambda _: P(), template),
-        telemetry=telemetry,
+        telemetry=telemetry, donate=donate,
     )
 
 
@@ -638,7 +726,8 @@ def _sparse_pad_and_template(states, rsize: int):
     return states, sp.empty(*shape_args)
 
 
-def mesh_fold_sparse(states, mesh: Mesh, telemetry: bool = False):
+def mesh_fold_sparse(states, mesh: Mesh, telemetry: bool = False,
+                     donate: bool = False):
     """Converge a SPARSE (segment-encoded) ORSWOT replica batch over the
     mesh's replica axis, with the segment table REPLICATED across the
     element axis — the simple layout for moderate dot counts. For true
@@ -656,7 +745,7 @@ def mesh_fold_sparse(states, mesh: Mesh, telemetry: bool = False):
         sp.join, sp.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template),
         jax.tree.map(lambda _: P(), template),
-        telemetry=telemetry, slots_fn=sp.changed_dots,
+        telemetry=telemetry, slots_fn=sp.changed_dots, donate=donate,
     )
 
 
@@ -681,7 +770,8 @@ def _sparse_mvmap_pad_and_template(states, rsize: int):
 
 
 def mesh_fold_sparse_mvmap(
-    states, mesh: Mesh, sibling_cap: int = 4, telemetry: bool = False
+    states, mesh: Mesh, sibling_cap: int = 4, telemetry: bool = False,
+    donate: bool = False,
 ):
     """Converge a SPARSE ``Map<K, MVReg>`` replica batch
     (ops/sparse_mvmap) over the mesh's replica axis, cell table
@@ -700,13 +790,13 @@ def mesh_fold_sparse_mvmap(
         partial(smv.fold, sibling_cap=sibling_cap),
         jax.tree.map(lambda _: P(REPLICA_AXIS), template),
         jax.tree.map(lambda _: P(), template),
-        telemetry=telemetry, slots_fn=smv.changed_cells,
+        telemetry=telemetry, slots_fn=smv.changed_cells, donate=donate,
     )
 
 
 def mesh_gossip_sparse_mvmap(
     states, mesh: Mesh, rounds: Optional[int] = None, sibling_cap: int = 4,
-    telemetry: bool = False,
+    telemetry: bool = False, donate: bool = False,
 ):
     """Ring anti-entropy for SPARSE ``Map<K, MVReg>`` replica batches
     over the replica axis — per-round traffic is one cell table per
@@ -723,11 +813,12 @@ def mesh_gossip_sparse_mvmap(
         partial(smv.fold, sibling_cap=sibling_cap),
         jax.tree.map(lambda _: P(REPLICA_AXIS), template), rounds,
         telemetry=telemetry, slots_fn=smv.changed_cells,
-        element_sharded=False,
+        element_sharded=False, donate=donate,
     )
 
 
-def mesh_fold_sparse_nested(states, mesh: Mesh, level, telemetry: bool = False):
+def mesh_fold_sparse_nested(states, mesh: Mesh, level,
+                            telemetry: bool = False, donate: bool = False):
     """Converge a SPARSE nested-map replica batch (any
     ``sparse_nest.SparseNestLevel`` composition — e.g. the
     ``Map<K1, Map<K2, MVReg>>`` of ops/sparse_mvmap.level_map_mvreg)
@@ -742,7 +833,7 @@ def mesh_fold_sparse_nested(states, mesh: Mesh, level, telemetry: bool = False):
         level.join, level.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template),
         jax.tree.map(lambda _: P(), template),
-        telemetry=telemetry,
+        telemetry=telemetry, donate=donate,
     )
 
 
@@ -775,7 +866,7 @@ def _sparse_nested_pad_and_key(states, rsize: int, level, op: str):
 
 def mesh_gossip_sparse_nested(
     states, mesh: Mesh, level, rounds: Optional[int] = None,
-    telemetry: bool = False,
+    telemetry: bool = False, donate: bool = False,
 ):
     """Ring anti-entropy for SPARSE nested-map replica batches (any
     ``SparseNestLevel`` composition) over the replica axis — per-round
@@ -788,13 +879,13 @@ def mesh_gossip_sparse_nested(
     return _mesh_gossip_lattice(
         kind, states, mesh, level.join, level.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template), rounds,
-        telemetry=telemetry, element_sharded=False,
+        telemetry=telemetry, element_sharded=False, donate=donate,
     )
 
 
 def mesh_gossip_sparse(
     states, mesh: Mesh, rounds: Optional[int] = None,
-    telemetry: bool = False,
+    telemetry: bool = False, donate: bool = False,
 ):
     """Ring anti-entropy for SPARSE (segment-encoded) ORSWOT replica
     batches over the replica axis (the bounded-bandwidth mode —
@@ -810,12 +901,13 @@ def mesh_gossip_sparse(
         "sparse_gossip", states, mesh, sp.join, sp.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template), rounds,
         telemetry=telemetry, slots_fn=sp.changed_dots,
-        element_sharded=False,
+        element_sharded=False, donate=donate,
     )
 
 
 def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
-                   policy=None, telemetry: bool = False):
+                   policy=None, telemetry: bool = False,
+                   donate: bool = False):
     """Ring anti-entropy with elastic capacity recovery — the
     overflow→widen→resume loop at mesh scale (elastic.py).
 
@@ -839,7 +931,15 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
     ``telemetry=True`` appends a Telemetry pytree folded across every
     attempt (``telemetry.combine``: counters from discarded overflow
     runs still count — they were real work — while the final-state
-    gauges come from the successful run)."""
+    gauges come from the successful run).
+
+    ``donate=True`` donates each attempt's state into the ring (the
+    gossip rows then alias it in place — ``_mesh_gossip_lattice``) and
+    restores ``model.state`` from a pre-round device copy afterwards:
+    the overflow→widen fallback needs the pre-round state alive across
+    a failed attempt, so the wrapper trades the ring-internal second
+    state copy for one explicit snapshot while keeping the model
+    coherent either way."""
     from .. import elastic
     from ..models.map import BatchedMap
     from ..models.orswot import BatchedOrswot
@@ -854,33 +954,36 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
         if isinstance(m, BatchedOrswot):
             return (
                 lambda: mesh_gossip(m.state, mesh, rounds,
-                                    telemetry=telemetry),
+                                    telemetry=telemetry, donate=donate),
                 ("deferred_cap",),
             )
         if isinstance(m, BatchedSparseOrswot):
             return (
                 lambda: mesh_gossip_sparse(m.state, mesh, rounds,
-                                           telemetry=telemetry),
+                                           telemetry=telemetry,
+                                           donate=donate),
                 ("dot_cap", "deferred_cap"),
             )
         if isinstance(m, BatchedMap):
             return (
                 lambda: mesh_gossip_map(m.state, mesh, rounds,
-                                        telemetry=telemetry),
+                                        telemetry=telemetry,
+                                        donate=donate),
                 ("sibling_cap", "deferred_cap"),
             )
         if isinstance(m, BatchedSparseMap):
             return (
                 lambda: mesh_gossip_sparse_mvmap(
                     m.state, mesh, rounds, sibling_cap=m.sibling_cap,
-                    telemetry=telemetry,
+                    telemetry=telemetry, donate=donate,
                 ),
                 ("cell_cap", "deferred_cap", "sibling_cap"),
             )
         if isinstance(m, BatchedSparseNestedMap):
             return (
                 lambda: mesh_gossip_sparse_nested(
-                    m.state, mesh, m.level, rounds, telemetry=telemetry
+                    m.state, mesh, m.level, rounds, telemetry=telemetry,
+                    donate=donate,
                 ),
                 ("cell_cap", "deferred_cap", "sibling_cap",
                  "key_deferred_cap"),
@@ -895,7 +998,11 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
     tel = None
     while True:
         run, lanes = plan(model)
+        if donate:
+            snap = jax.tree.map(jnp.copy, model.state)
         out = run()
+        if donate:
+            model.state = snap
         rows, flags = out[0], out[1]
         if telemetry:
             tel = out[2] if tel is None else tele.combine(tel, out[2])
@@ -944,7 +1051,8 @@ def mesh_fold_clocks(clocks: jax.Array, mesh: Mesh) -> jax.Array:
     return _cached("clock_fold", clocks, mesh, build)(clocks)
 
 
-def mesh_fold_map3(state, mesh: Mesh, telemetry: bool = False):
+def mesh_fold_map3(state, mesh: Mesh, telemetry: bool = False,
+                   donate: bool = False):
     """Full-mesh anti-entropy for ``Map<K1, Map<K2, Orswot>>`` over the
     (replica × outer-key) mesh (K1×K2×M blocks per shard; ops/map3.py
     depth-3 slab composition). Returns (converged state, overflow[3])."""
@@ -959,12 +1067,13 @@ def mesh_fold_map3(state, mesh: Mesh, telemetry: bool = False):
         map3_specs(), map3_out_specs(),
         telemetry=telemetry,
         slots_fn=lambda a, b: ops.changed_members(a.mo.core, b.mo.core),
-        element_sharded=True,
+        element_sharded=True, donate=donate,
     )
 
 
 def mesh_gossip_map3(
-    state, mesh: Mesh, rounds: Optional[int] = None, telemetry: bool = False
+    state, mesh: Mesh, rounds: Optional[int] = None, telemetry: bool = False,
+    donate: bool = False,
 ):
     """Ring anti-entropy for ``Map<K1, Map<K2, Orswot>>`` replica blocks
     over the replica axis."""
@@ -979,4 +1088,5 @@ def mesh_gossip_map3(
         map3_specs(), rounds,
         telemetry=telemetry,
         slots_fn=lambda a, b: ops.changed_members(a.mo.core, b.mo.core),
+        donate=donate,
     )

@@ -203,6 +203,42 @@ def apply_delta(
     return out, dirty, fctx, jnp.any(overflow)
 
 
+def gate_delta(pkt: DeltaPacket, digest: jax.Array) -> DeltaPacket:
+    """Digest gate: invalidate packet slots that provably cannot change
+    the receiver, judged against the receiver's digest clock (its
+    frozen local-fold top, shipped once before the ring by
+    ``run_delta_ring``). A slot is redundant only when BOTH hold:
+
+    - ``ctxs == rows`` lane-wise — the slot attests NO removals: every
+      dot its context accounts for is live in its row. A context lane
+      above the row is removal knowledge (the sender saw that dot die),
+      and a top digest can never prove the receiver knows a removal —
+      a dot covered by both tops may be live at one store and removed
+      at the other; that asymmetry is exactly what observed-remove
+      resolves, so removal-carrying slots always ship.
+    - ``rows <= digest`` — the receiver's honest top covers every live
+      dot, so its store already accounts for each one (same dot live,
+      or removed under its own covering context); joining the add-only
+      slot is a content no-op either way.
+
+    Dropping the slot's domain-forwarding re-mark is also safe: dots
+    covered by the receiver's local-fold top entered its block's
+    history post-sync through ops its tracking marked (the delta.py
+    contract), so the receiver minted its own circulating marks for
+    those rows — transitive delivery survives. Masked slots are zeroed
+    so the packet stays canonical (and ``bytes_useful`` honest); the
+    wire shape is unchanged."""
+    covered = jnp.all(pkt.ctxs == pkt.rows, axis=-1) & jnp.all(
+        pkt.rows <= digest[None, :], axis=-1
+    )
+    keep = pkt.valid & ~covered
+    return pkt._replace(
+        valid=keep,
+        rows=jnp.where(keep[:, None], pkt.rows, 0),
+        ctxs=jnp.where(keep[:, None], pkt.ctxs, 0),
+    )
+
+
 def close_top_orswot(folded: OrswotState, top: jax.Array) -> OrswotState:
     """Adopt the mesh-wide top and re-replay parked removes under it
     (delta_ring documents why the closure is needed and sound). Shared
@@ -228,6 +264,9 @@ def mesh_delta_gossip(
     cap: int = 64,
     local_fold: str = "auto",
     telemetry: bool = False,
+    pipeline: bool = True,
+    digest: bool = True,
+    donate: bool = False,
 ):
     """Ring δ anti-entropy over the mesh: each device folds its local
     replica block (OR-folding dirty, max-folding contexts), then runs
@@ -252,6 +291,21 @@ def mesh_delta_gossip(
     documents the indicator's soundness). The cap-independence property
     tests (test_delta*.py) pin the budget formula.
 
+    With ``pipeline=True`` (default) the schedule is double-buffered —
+    round r+1's packet ships while round r's merges, hiding the DMA
+    behind the merge kernels — at the price of sends one apply stale:
+    propagation takes TWO rounds per hop, so the default budget (and
+    the certificate window) becomes ``2*(P-1)-1`` rounds and an
+    explicit budget tuned for the sequential schedule should roughly
+    double. ``pipeline=False`` restores the sequential
+    extract→ship→apply rounds (bit-identical HLO to the pre-flag
+    program). ``digest=True`` (default) prepends one tiny inverse-ring
+    exchange of the frozen receiver tops and masks out packet slots the
+    receiver provably already covers (``gate_delta``) — converged
+    states stay bit-identical while ``bytes_useful`` drops to
+    O(changed); ``donate=True`` consumes (state, dirty) and aliases the
+    outputs in place (run_delta_ring documents all three).
+
     Returns ``(states [P, ...], dirty [P, E], overflow, residue)`` —
     overflow is the deferred-buffer flag, as in ``mesh_gossip``;
     residue the convergence indicator above. ``telemetry=True`` appends
@@ -263,8 +317,9 @@ def mesh_delta_gossip(
     state = pad_elements(state, mesh.shape[ELEMENT_AXIS])
     pad_r = state.top.shape[0] - dirty.shape[0]
     pad_e = state.ctr.shape[-2] - dirty.shape[-1]
-    dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_e)))
-    fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_e), (0, 0)))
+    if pad_r or pad_e:  # zero-pad copies would defeat donation
+        dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_e)))
+        fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_e), (0, 0)))
 
     from ..ops.orswot import changed_members
 
@@ -277,4 +332,5 @@ def mesh_delta_gossip(
         close_top=close_top_orswot,
         cache_extra=(local_fold,),
         telemetry=telemetry, slots_fn=changed_members,
+        pipeline=pipeline, digest=digest, gate=gate_delta, donate=donate,
     )

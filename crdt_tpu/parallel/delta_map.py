@@ -261,6 +261,32 @@ def apply_delta_map(
     return st, dirty, fctx, jnp.stack([slab_of, jnp.any(d_of)])
 
 
+def gate_delta_map(pkt: MapDeltaPacket, digest: jax.Array) -> MapDeltaPacket:
+    """Digest gate for map deltas (delta.gate_delta documents the
+    two-part soundness argument): a slot is redundant only when its
+    context carries NO knowledge beyond its live content's witness
+    dots (``ctxs == _key_knowledge(child)`` — anything above is a
+    superseded-sibling or keyset-remove the receiver may lack, and a
+    top digest cannot prove otherwise) AND the receiver's frozen top
+    covers those witness dots — witness dots are per-write events, so
+    an honest top covering one means the receiver's store accounts for
+    that exact write at this key (live or superseded) and the
+    restricted join is a content no-op."""
+    know = _key_knowledge(pkt.child)
+    covered = jnp.all(pkt.ctxs == know, axis=-1) & jnp.all(
+        know <= digest[None, :], axis=-1
+    )
+    keep = pkt.valid & ~covered
+    zero = lambda x: jnp.where(
+        keep.reshape((-1,) + (1,) * (x.ndim - 1)), x, jnp.zeros_like(x)
+    )
+    return pkt._replace(
+        valid=keep,
+        child=jax.tree.map(zero, pkt.child),
+        ctxs=jnp.where(keep[:, None], pkt.ctxs, 0),
+    )
+
+
 def mesh_delta_gossip_map(
     state: MapState,
     dirty: jax.Array,
@@ -269,6 +295,9 @@ def mesh_delta_gossip_map(
     rounds: Optional[int] = None,
     cap: int = 64,
     telemetry: bool = False,
+    pipeline: bool = True,
+    digest: bool = True,
+    donate: bool = False,
 ):
     """Ring δ anti-entropy for Map<K, MVReg> replica batches over the
     mesh — the bandwidth-bounded mode for large key universes with local
@@ -283,8 +312,9 @@ def mesh_delta_gossip_map(
     state = pad_keys(state, mesh.shape[ELEMENT_AXIS])
     pad_r = state.top.shape[0] - dirty.shape[0]
     pad_k = state.dkeys.shape[-1] - dirty.shape[-1]
-    dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_k)))
-    fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_k), (0, 0)))
+    if pad_r or pad_k:  # zero-pad copies would defeat donation
+        dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_k)))
+        fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_k), (0, 0)))
 
     def close_top(folded: MapState, top: jax.Array) -> MapState:
         """Adopt the mesh-wide top and re-replay parked keyset-removes
@@ -300,4 +330,6 @@ def mesh_delta_gossip_map(
         apply_fn=apply_delta_map,
         close_top=close_top,
         telemetry=telemetry, slots_fn=map_ops.changed_keys,
+        pipeline=pipeline, digest=digest, gate=gate_delta_map,
+        donate=donate,
     )

@@ -21,8 +21,9 @@ from .delta_map_orswot import (
     MapOrswotDeltaPacket,
     apply_delta_mo,
     extract_delta_mo,
+    gate_delta_mo,
 )
-from .delta_nest import close_top_nested, nested_delta
+from .delta_nest import close_top_nested, nested_delta, nested_gate
 from .mesh import ELEMENT_AXIS, REPLICA_AXIS, map3_specs, pad_map3
 
 
@@ -48,6 +49,7 @@ extract_delta_m3, apply_delta_m3 = nested_delta(
     apply_delta_mo,
     packet_cls=Map3DeltaPacket,
 )
+gate_delta_m3 = nested_gate(gate_delta_mo, Map3DeltaPacket)
 
 
 def mesh_delta_gossip_map3(
@@ -58,6 +60,9 @@ def mesh_delta_gossip_map3(
     rounds: Optional[int] = None,
     cap: int = 64,
     telemetry: bool = False,
+    pipeline: bool = True,
+    digest: bool = True,
+    donate: bool = False,
 ):
     """Ring δ anti-entropy for depth-3 map replica batches (see
     delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET
@@ -70,8 +75,9 @@ def mesh_delta_gossip_map3(
     state = pad_map3(state, mesh.shape[REPLICA_AXIS], mesh.shape[ELEMENT_AXIS])
     pad_r = state.mo.core.top.shape[0] - dirty.shape[0]
     pad_e = state.mo.core.ctr.shape[-2] - dirty.shape[-1]
-    dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_e)))
-    fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_e), (0, 0)))
+    if pad_r or pad_e:  # zero-pad copies would defeat donation
+        dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_e)))
+        fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_e), (0, 0)))
 
     return run_delta_ring(
         "map3_delta_gossip", state, dirty, fctx, mesh, rounds, cap,
@@ -85,4 +91,6 @@ def mesh_delta_gossip_map3(
         top_of=lambda s: s.mo.core.top,
         telemetry=telemetry,
         slots_fn=lambda a, b: changed_members(a.mo.core, b.mo.core),
+        pipeline=pipeline, digest=digest, gate=gate_delta_m3,
+        donate=donate,
     )

@@ -30,9 +30,10 @@ from .delta import (
     DeltaPacket,
     apply_delta,
     extract_delta,
+    gate_delta,
     interval_accumulate,
 )
-from .delta_nest import close_top_nested, nested_delta
+from .delta_nest import close_top_nested, nested_delta, nested_gate
 from .mesh import ELEMENT_AXIS, REPLICA_AXIS, map_orswot_specs, pad_map_orswot
 
 
@@ -60,6 +61,7 @@ extract_delta_mo, apply_delta_mo = nested_delta(
     lambda s, p, d, f, element_axis=None: apply_delta(s, p, d, f),
     packet_cls=MapOrswotDeltaPacket,
 )
+gate_delta_mo = nested_gate(gate_delta, MapOrswotDeltaPacket)
 
 
 def mesh_delta_gossip_map_orswot(
@@ -70,6 +72,9 @@ def mesh_delta_gossip_map_orswot(
     rounds: Optional[int] = None,
     cap: int = 64,
     telemetry: bool = False,
+    pipeline: bool = True,
+    digest: bool = True,
+    donate: bool = False,
 ):
     """Ring δ anti-entropy for Map<K, Orswot> replica batches (see
     delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET
@@ -84,8 +89,9 @@ def mesh_delta_gossip_map_orswot(
     )
     pad_r = state.core.top.shape[0] - dirty.shape[0]
     pad_e = state.core.ctr.shape[-2] - dirty.shape[-1]
-    dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_e)))
-    fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_e), (0, 0)))
+    if pad_r or pad_e:  # zero-pad copies would defeat donation
+        dirty = jnp.pad(dirty, ((0, pad_r), (0, pad_e)))
+        fctx = jnp.pad(fctx, ((0, pad_r), (0, pad_e), (0, 0)))
 
     return run_delta_ring(
         "map_orswot_delta_gossip", state, dirty, fctx, mesh, rounds, cap,
@@ -99,4 +105,6 @@ def mesh_delta_gossip_map_orswot(
         top_of=lambda s: s.core.top,
         telemetry=telemetry,
         slots_fn=lambda a, b: changed_members(a.core, b.core),
+        pipeline=pipeline, digest=digest, gate=gate_delta_mo,
+        donate=donate,
     )

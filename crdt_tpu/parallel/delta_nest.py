@@ -84,6 +84,19 @@ def nested_delta(
     return extract, apply_fn
 
 
+def nested_gate(core_gate: Callable, packet_cls=NestedDeltaPacket) -> Callable:
+    """Lift a core digest gate through one nesting level: only the core
+    packet's slots gate (delta.gate_delta documents the soundness
+    argument); the level's parked-keyset buffer rides whole regardless
+    — parked rm clocks are their own context and already carry a
+    per-slot validity mask, so there is nothing further to gate."""
+
+    def gate(pkt, digest):
+        return packet_cls(core_gate(pkt[0], digest), *pkt[1:])
+
+    return gate
+
+
 def close_top_nested(level, folded, top, element_axis=None):
     """Adopt the mesh-wide top and re-replay parked removes at EVERY
     level, innermost first, then scrub (delta_ring documents why the

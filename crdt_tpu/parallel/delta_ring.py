@@ -13,7 +13,42 @@ adopting it and re-replaying parked removes reproduces the full fold
 exactly).
 
 Only the type-specific pieces come in as closures: the local fold, the
-extract/apply pair, the state specs, and the post-closure replay."""
+extract/apply pair, the state specs, the post-closure replay — and,
+for the zero-copy pipelined mode, the per-flavor digest gate.
+
+Three orthogonal performance modes (all default-on where safe):
+
+- ``donate=True`` — the jit donates (state, dirty); when the padded
+  replica axis equals the mesh's the outputs alias those buffers in
+  place (``input_output_alias``, gated by tools/check_aliasing.py), so
+  the ring holds ONE copy of the state in HBM instead of two. ``fctx``
+  is never donated: it has no matching output (the per-device fctx is
+  loop-internal), so donating it would only trip XLA's unusable-
+  donation warning.
+- ``pipeline=True`` — double-buffered schedule: round r+1's packet is
+  extracted from the pre-apply state and its ``ppermute`` put in
+  flight BEFORE round r's packet merges, so the in-flight DMA crosses
+  the loop edge and XLA's latency-hiding scheduler overlaps it with
+  the merge kernels. The price is sends one apply stale: knowledge
+  advances one hop per TWO rounds, so the default budget and the
+  residue-certificate window widen to ``2*(P-1)-1`` (a pair of
+  consecutive starvation-free rounds advances every mark one hop, and
+  P-1 hops complete the ring). Same packets-per-round as the
+  sequential schedule — latency is hidden, not bandwidth spent.
+- ``digest=True`` — one tiny inverse-ring exchange of the FROZEN
+  receiver tops before the loop (tops never change mid-ring, so one
+  [A]-clock ppermute serves every round), then the flavor's ``gate``
+  masks out packet slots whose whole knowledge the receiver's top
+  already covers. Converged states are bit-identical — a covered
+  slot's apply is a content no-op, and the tracking contract
+  guarantees the covering device minted its own marks for those dots,
+  so transitive delivery survives the dropped re-mark (delta.py
+  ``gate_delta``). ``bytes_useful`` telemetry drops to O(changed
+  lanes) while the wire shape (``bytes_exchanged``) stays static.
+
+With every flag at its off value the traced program is byte-identical
+to the pre-flag sequential ring (pinned by HLO comparison in
+tests/test_zero_copy_ring.py, the PR-2 telemetry pattern)."""
 
 from __future__ import annotations
 
@@ -47,45 +82,65 @@ def run_delta_ring(
     cache_extra: tuple = (),
     telemetry: bool = False,
     slots_fn: Optional[Callable] = None,
+    pipeline: bool = True,
+    digest: bool = True,
+    gate: Optional[Callable] = None,  # (pkt, digest_clock) -> pkt
+    donate: bool = False,
 ):
     """Run the δ ring program; ``state``/``dirty``/``fctx`` must already
     be padded to the mesh. Returns ``(states [P, ...], dirty, overflow,
     residue)`` — the first three with the same conventions as
     mesh_gossip; ``residue`` is the RUNTIME convergence indicator the
     ROUNDS BUDGET docstrings promise (int32 scalar): the mesh-wide count
-    of slot-starved row-rounds WITHIN THE FINAL P-1 ROUNDS — rows that
-    wanted a packet slot but lost it to ``cap``. Extract clears every
-    row it ships, so rows still dirty right after an extract ARE the
-    round's unshipped backlog — domain-forwarding re-marks (added back
-    at apply time) never inflate the count. Soundness: every
-    ever-changed row keeps at least one circulating mark, and a
-    starvation-free round advances every mark one hop, so P-1
-    consecutive starvation-free FINAL rounds walk every mark through all
-    P devices — ``residue == 0`` means the gossip provably equals the
-    full join. The indicator is ONE-SIDED: ``residue > 0`` does not
+    of slot-starved row-rounds WITHIN THE FINAL CERTIFICATE WINDOW —
+    rows that wanted a packet slot but lost it to ``cap``. Extract
+    clears every row it ships, so rows still dirty right after an
+    extract ARE the round's unshipped backlog — domain-forwarding
+    re-marks (added back at apply time) never inflate the count.
+
+    Soundness: every ever-changed row keeps at least one circulating
+    mark (digest gating retires a mark only at a device whose frozen
+    top covers it — a device the tracking contract guarantees minted
+    its own equivalent mark), and a starvation-free round advances
+    every mark one hop — one hop per TWO rounds under ``pipeline=True``
+    (sends are one apply stale). The certificate window is therefore
+    ``P-1`` sequential rounds, ``2*(P-1)-1`` pipelined; that many
+    consecutive starvation-free FINAL rounds walk every mark through
+    all P devices — ``residue == 0`` means the gossip provably equals
+    the full join. The indicator is ONE-SIDED: ``residue > 0`` does not
     prove divergence, it means the run cannot be certified — either
     genuine residue, or a ``cap`` too small to clear the circulating
-    forwarding marks (marks never die, they only coalesce, so a tight
-    cap can starve forever even after content converges). Re-run with
-    more rounds (the budget formula in delta.py) and a cap comfortably
-    above the steady-state per-device mark count. Starvation in EARLIER
-    rounds of an extended budget is expected drain behavior and
-    deliberately not counted. A budget below P-1 rounds cannot complete
-    a ring loop at all, so residue is forced >= 1 there regardless of
-    starvation.
+    forwarding marks (ungated marks never die, they only coalesce, so a
+    tight cap can starve forever even after content converges). Re-run
+    with more rounds (the budget formula in delta.py — doubled under
+    ``pipeline=True``) and a cap comfortably above the steady-state
+    per-device mark count. Starvation in EARLIER rounds of an extended
+    budget is expected drain behavior and deliberately not counted. A
+    budget below the window cannot complete the ring's propagation at
+    all, so residue is forced >= 1 there regardless of starvation.
 
     ``telemetry=True`` appends an in-kernel Telemetry pytree as a fifth
-    output (telemetry.py): per-round packet bytes and ``slots_fn``
-    changed-lane counts accumulate in the fori_loop carry, the
-    final-state gauges read the post-closure fold, and ``residue``
-    mirrors the fourth output. The flag off traces exactly the
-    flag-free program."""
-    from .anti_entropy import _cached, _tel_reduced
+    output (telemetry.py): per-round packet wire AND post-mask payload
+    bytes (``bytes_exchanged`` / ``bytes_useful``) and ``slots_fn``
+    changed-lane counts accumulate in the loop carry, the final-state
+    gauges read the post-closure fold, and ``residue`` mirrors the
+    fourth output. ``pipeline`` / ``digest`` / ``donate`` are the
+    zero-copy modes the module docstring describes; with every flag off
+    the trace is the flag-free program."""
+    from .anti_entropy import _cached, _ring_donate_argnums, _tel_reduced
 
     p = mesh.shape[REPLICA_AXIS]
+    gated = digest and gate is not None
+    # Certificate window / propagation diameter: one hop per round
+    # sequentially, one hop per two rounds pipelined (module docstring).
+    win = (p - 1) if not pipeline else max(2 * (p - 1) - 1, 0)
     if rounds is None:
-        rounds = p - 1
+        rounds = win
     perm = [(i, (i + 1) % p) for i in range(p)]
+    # Digest exchange runs AGAINST the ring: device i's packets land on
+    # i+1, so i needs i+1's frozen top — ship tops one hop down-ring.
+    inv_perm = [(i, (i - 1) % p) for i in range(p)]
+    argnums = _ring_donate_argnums(state, mesh, donate, n=2)
 
     def build():
         out_specs = (specs, P(REPLICA_AXIS, ELEMENT_AXIS), P(), P())
@@ -108,39 +163,115 @@ def run_delta_ring(
             folded, of = local_fold(local)
             d = jnp.any(local_dirty, axis=0)
             f = jnp.max(local_fctx, axis=0)
+            if gated:
+                rtop = lax.ppermute(top_of(folded), REPLICA_AXIS, inv_perm)
 
             def round_body(r, carry):
                 if telemetry:
-                    st, d, f, of, starved, slots, shipped = carry
+                    st, d, f, of, starved, slots, shipped, useful = carry
                 else:
                     st, d, f, of, starved = carry
                 pkt, d, f = extract(st, d, f, cap, start=r * cap)
-                in_window = r >= rounds - (p - 1)
+                in_window = r >= rounds - win
                 # Explicit accumulator dtype: without it jnp.sum widens
                 # int32 -> int64 under x64 mode (counter_dtype="uint64")
                 # and the fori_loop carry type changes mid-loop.
                 starved = starved + jnp.where(
                     in_window, jnp.sum(d, dtype=jnp.int32), 0
                 )
+                if gated:
+                    pkt = gate(pkt, rtop)
                 pkt = jax.tree.map(
                     lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt
                 )
                 if telemetry:
                     before = st
                     shipped = shipped + jnp.float32(tele.shipped_bytes(pkt))
+                    useful = useful + tele.packet_useful_bytes(pkt)
                 st, d, f, of_r = apply_fn(st, pkt, d, f)
                 if telemetry:
                     slots = slots + slots_of(before, st)
-                    return st, d, f, of | of_r, starved, slots, shipped
+                    return st, d, f, of | of_r, starved, slots, shipped, useful
                 return st, d, f, of | of_r, starved
 
-            init = (folded, d, f, of, jnp.zeros((), jnp.int32))
-            if telemetry:
-                init = init + (
-                    jnp.zeros((), jnp.uint32), jnp.zeros((), jnp.float32)
+            def pipe_body(r, carry):
+                # Double-buffered round: extract round r+1's packet
+                # from the PRE-apply state and put its ppermute in
+                # flight, THEN merge round r's in-flight packet — the
+                # send crosses the loop edge, so its DMA overlaps the
+                # merge kernels (module docstring; stale by one apply).
+                if telemetry:
+                    st, d, f, of, starved, flight, slots, shipped, useful = (
+                        carry
+                    )
+                else:
+                    st, d, f, of, starved, flight = carry
+                pkt, d, f = extract(st, d, f, cap, start=(r + 1) * cap)
+                starved = starved + jnp.where(
+                    (r + 1) >= rounds - win, jnp.sum(d, dtype=jnp.int32), 0
                 )
-            carry = lax.fori_loop(0, rounds, round_body, init)
-            folded, d, f, of, starved = carry[:5]
+                if gated:
+                    pkt = gate(pkt, rtop)
+                nxt = jax.tree.map(
+                    lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt
+                )
+                if telemetry:
+                    before = st
+                    shipped = shipped + jnp.float32(tele.shipped_bytes(nxt))
+                    useful = useful + tele.packet_useful_bytes(nxt)
+                st, d, f, of_r = apply_fn(st, flight, d, f)
+                if telemetry:
+                    slots = slots + slots_of(before, st)
+                    return (st, d, f, of | of_r, starved, nxt, slots,
+                            shipped, useful)
+                return st, d, f, of | of_r, starved, nxt
+
+            zeros_tel = (
+                jnp.zeros((), jnp.uint32),   # slots
+                jnp.zeros((), jnp.float32),  # shipped (wire)
+                jnp.zeros((), jnp.float32),  # useful (post-mask)
+            )
+            if pipeline and rounds > 0:
+                # Prologue: round 0's packet goes in flight pre-loop.
+                pkt, d, f = extract(folded, d, f, cap, start=0)
+                starved = jnp.where(
+                    jnp.asarray(0 >= rounds - win),
+                    jnp.sum(d, dtype=jnp.int32), 0,
+                )
+                if gated:
+                    pkt = gate(pkt, rtop)
+                flight = jax.tree.map(
+                    lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt
+                )
+                init = (folded, d, f, of, starved, flight)
+                if telemetry:
+                    init = init + (
+                        zeros_tel[0],
+                        zeros_tel[1] + jnp.float32(tele.shipped_bytes(flight)),
+                        zeros_tel[2] + tele.packet_useful_bytes(flight),
+                    )
+                carry = lax.fori_loop(0, rounds - 1, pipe_body, init)
+                folded, d, f, of, starved, flight = carry[:6]
+                # Epilogue: merge the final in-flight packet.
+                if telemetry:
+                    before = folded
+                folded, d, f, of_r = apply_fn(folded, flight, d, f)
+                of = of | of_r
+                if telemetry:
+                    slots, shipped, useful = carry[6:]
+                    slots = slots + slots_of(before, folded)
+            else:
+                init = (folded, d, f, of, jnp.zeros((), jnp.int32))
+                if telemetry:
+                    init = init + zeros_tel
+                carry = lax.fori_loop(0, rounds, round_body, init)
+                folded, d, f, of, starved = carry[:5]
+                if telemetry:
+                    slots, shipped, useful = carry[5:]
+            if telemetry and gated:
+                # The digest exchange itself rides the wire once.
+                dig = jnp.float32(tele.shipped_bytes(rtop))
+                shipped, useful = shipped + dig, useful + dig
             top = lax.pmax(
                 lax.pmax(top_of(folded), REPLICA_AXIS), ELEMENT_AXIS
             )
@@ -150,20 +281,21 @@ def run_delta_ring(
                 > 0
             )
             residue = lax.psum(starved, (REPLICA_AXIS, ELEMENT_AXIS))
-            if rounds < p - 1:
-                # A budget below P-1 can never complete a ring loop; the
-                # certificate must not be issuable no matter the cap.
+            if rounds < win:
+                # A budget below the certificate window can never
+                # complete the ring's propagation; the certificate must
+                # not be issuable no matter the cap.
                 residue = jnp.maximum(residue, 1)
             outs = (
                 jax.tree.map(lambda x: x[None], folded), d[None], of, residue
             )
             if telemetry:
-                slots, shipped = carry[5], carry[6]
                 local_rows = jax.tree.leaves(local)[0].shape[0]
                 outs = outs + (_tel_reduced(
                     folded, slots,
                     max(local_rows - 1, 0) + rounds, shipped,
                     (REPLICA_AXIS, ELEMENT_AXIS), residue=residue,
+                    useful_per_dev=useful,
                 ),)
             return outs
 
@@ -173,13 +305,32 @@ def run_delta_ring(
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
     with metrics.time(f"anti_entropy.{kind}"):
         out = _cached(
-            kind, state, mesh, build, rounds, cap, telemetry, *cache_extra
+            kind, state, mesh, build, rounds, cap, telemetry, pipeline,
+            gated, *cache_extra, donate_argnums=argnums,
         )(state, dirty, fctx)
         jax.block_until_ready(out)
+    if donate:
+        # Free whatever the donation did not consume in place: the
+        # unaliasable fallback, and originals implicitly resharded onto
+        # the mesh (the executable then donated the committed copies).
+        from .anti_entropy import _consume
+
+        _consume(True, state, dirty)
     _warn_residue(kind, out)
     if telemetry and tele.is_concrete(out[4]):
         tele.record(kind, out[4])
     return out
+
+
+# Kinds whose residue warning already fired this process — repeats only
+# count in the registry (see _warn_residue).
+_RESIDUE_WARNED: set = set()
+
+
+def reset_residue_warnings() -> None:
+    """Re-arm the once-per-kind residue warning (tests; or after an
+    operator fixed the budget and wants fresh signal)."""
+    _RESIDUE_WARNED.clear()
 
 
 def _warn_residue(kind: str, out) -> None:
@@ -189,13 +340,23 @@ def _warn_residue(kind: str, out) -> None:
         residue = int(out[3])
         metrics.observe(f"anti_entropy.{kind}.residue", float(residue))
         if residue:
+            # Every occurrence counts in the registry; the warning
+            # itself fires once per kind per process — an under-budgeted
+            # ring in a loop would otherwise emit one warning per round
+            # (the repeat count lives in the counter, where operators
+            # can actually read a rate).
+            metrics.count(f"anti_entropy.{kind}.residue_runs")
+            if kind in _RESIDUE_WARNED:
+                return
+            _RESIDUE_WARNED.add(kind)
             import warnings
 
             warnings.warn(
                 f"{kind}: round budget left residue ({residue} slot-starved "
                 f"row-rounds) — the ring is NOT guaranteed converged; raise "
-                f"`rounds` (see the ROUNDS BUDGET note in parallel/delta.py) "
-                f"or `cap`",
+                f"`rounds` (see the ROUNDS BUDGET note in parallel/delta.py; "
+                f"pipeline=True budgets are ~2x) or `cap`. Warned once per "
+                f"kind; repeats count in anti_entropy.{kind}.residue_runs",
                 # _warn_residue -> run_delta_ring -> mesh entry -> user.
                 stacklevel=4,
             )
@@ -211,6 +372,9 @@ def delta_gossip_elastic(
     local_fold: str = "auto",
     policy=None,
     telemetry: bool = False,
+    pipeline: bool = True,
+    digest: bool = True,
+    donate: bool = False,
 ):
     """δ-ring anti-entropy with elastic capacity recovery for dense
     ORSWOT replica batches (``BatchedOrswot``): the mid-round
@@ -230,6 +394,15 @@ def delta_gossip_elastic(
     unchanged — the re-entered ring's ``residue == 0`` still proves the
     gossip equals the full join of the widened family.
 
+    ``pipeline`` / ``digest`` thread through to every attempt
+    (run_delta_ring). ``donate=True`` donates each attempt's
+    (state, dirty) into the ring and restores ``model.state`` and the
+    tracking pair from a pre-round device copy afterwards — the widen
+    fallback needs the pre-round state alive across a failed attempt,
+    so the wrapper trades the ring-internal second state copy for one
+    explicit snapshot (net HBM even; the in-ring temporaries still
+    shrink) while keeping the model coherent either way.
+
     Returns ``(states, dirty, overflow, residue, widened)`` — the
     ``mesh_delta_gossip`` tuple plus the dict of axes grown (empty when
     capacity sufficed). ``telemetry=True`` appends a Telemetry pytree
@@ -243,10 +416,16 @@ def delta_gossip_elastic(
     migrations = 0
     tel = None
     while True:
+        if donate:
+            snap = jax.tree.map(jnp.copy, model.state)
+            snap_dirty = jnp.copy(dirty)
         out = mesh_delta_gossip(
             model.state, dirty, fctx, mesh, rounds, cap, local_fold,
-            telemetry=telemetry,
+            telemetry=telemetry, pipeline=pipeline, digest=digest,
+            donate=donate,
         )
+        if donate:
+            model.state, dirty = snap, snap_dirty
         if telemetry:
             tel = out[4] if tel is None else tele.combine(tel, out[4])
         if not bool(jnp.any(out[2])):

@@ -27,9 +27,15 @@ shipped and sync metadata per round), measured natively per round:
 - ``deferred_depth``  — final parked-slot depth: max over replicas of
   valid slots summed across every ``*dvalid`` buffer level (the same
   masked-epoch convention ``metrics.deferred_depth`` walks on host).
-- ``bytes_exchanged`` — physical bytes shipped over mesh links: the
-  per-device shipped pytree's bytes × exchanges, summed over ALL
-  devices (element-axis copies each really transmit).
+- ``bytes_exchanged`` — physical WIRE bytes shipped over mesh links:
+  the per-device shipped pytree's STATIC bytes × exchanges, summed over
+  ALL devices (element-axis copies each really transmit). Padded /
+  invalid packet lanes count — this is what the links carry.
+- ``bytes_useful``    — post-mask PAYLOAD bytes: only the packet lanes
+  whose validity masks survive (δ-ring slot ``valid`` and parked
+  ``*dvalid`` masks — :func:`packet_useful_bytes`), so digest gating's
+  byte win is visible next to the unchanged wire count. Non-δ entry
+  points ship whole states with no mask and report wire == useful.
 - ``residue``         — the δ-ring convergence indicator
   (parallel/delta_ring.py); 0 for non-δ entry points.
 - ``widen_pressure``  — max occupancy fraction over the bounded parked
@@ -68,7 +74,8 @@ class Telemetry(NamedTuple):
     merges: jax.Array          # uint32 — join applications
     slots_changed: jax.Array   # uint32 — content lanes changed by joins
     deferred_depth: jax.Array  # uint32 — final max parked-slot depth
-    bytes_exchanged: jax.Array # float32 — physical bytes over mesh links
+    bytes_exchanged: jax.Array # float32 — physical WIRE bytes over links
+    bytes_useful: jax.Array    # float32 — post-mask payload bytes
     residue: jax.Array         # int32 — δ-ring residue (0 elsewhere)
     widen_pressure: jax.Array  # float32 — max parked-buffer occupancy
 
@@ -80,6 +87,7 @@ def zeros() -> Telemetry:
         slots_changed=jnp.zeros((), jnp.uint32),
         deferred_depth=jnp.zeros((), jnp.uint32),
         bytes_exchanged=jnp.zeros((), jnp.float32),
+        bytes_useful=jnp.zeros((), jnp.float32),
         residue=jnp.zeros((), jnp.int32),
         widen_pressure=jnp.zeros((), jnp.float32),
     )
@@ -89,7 +97,7 @@ def specs() -> Telemetry:
     """shard_map out_specs: every field is a replicated scalar."""
     from jax.sharding import PartitionSpec as P
 
-    return Telemetry(P(), P(), P(), P(), P(), P())
+    return Telemetry(P(), P(), P(), P(), P(), P(), P())
 
 
 def combine(a: Telemetry, b: Telemetry) -> Telemetry:
@@ -101,6 +109,7 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         merges=a.merges + b.merges,
         slots_changed=a.slots_changed + b.slots_changed,
         bytes_exchanged=a.bytes_exchanged + b.bytes_exchanged,
+        bytes_useful=a.bytes_useful + b.bytes_useful,
         deferred_depth=b.deferred_depth,
         residue=b.residue,
         widen_pressure=b.widen_pressure,
@@ -179,6 +188,59 @@ def shipped_bytes(pytree) -> int:
     return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(pytree))
 
 
+def packet_useful_bytes(pkt) -> jax.Array:
+    """DYNAMIC post-mask byte count of one δ packet (``bytes_useful``):
+    slot lanes weighted by the packet's slot ``valid`` mask, parked
+    buffers by their ``*dvalid`` masks. Walks the packet convention the
+    δ flavors share — a leaf packet carries ``idx``/``valid`` plus its
+    slot planes, wrapper packets nest the core packet first with one
+    parked group (``[k|o]?d{cl,mask,keys,valid}``) riding whole per
+    level — so every current and future ``nested_delta`` composition is
+    covered without per-flavor byte tables. Pure lax on static shapes:
+    safe inside jit and shard_map."""
+    total = jnp.zeros((), jnp.float32)
+
+    def group(mask, values):
+        n = max(mask.shape[0], 1)
+        per = sum(
+            (leaf.size // n) * leaf.dtype.itemsize
+            for v in values
+            for leaf in jax.tree.leaves(v)
+        )
+        return jnp.sum(mask, dtype=jnp.float32) * per
+
+    def walk(node):
+        nonlocal total
+        names = node._fields
+        parked = {}
+        for f in names:
+            if f.endswith("dvalid"):
+                pref = f[: -len("dvalid")]
+                parked[pref] = [
+                    getattr(node, pref + s)
+                    for s in ("dcl", "dmask", "dkeys", "dvalid")
+                    if pref + s in names
+                ]
+        parked_names = {
+            pref + s
+            for pref in parked
+            for s in ("dcl", "dmask", "dkeys", "dvalid")
+            if pref + s in names
+        }
+        if "idx" in names:  # leaf packet: slot planes gated by `valid`
+            total = total + group(
+                node.valid,
+                [getattr(node, f) for f in names if f not in parked_names],
+            )
+        else:  # wrapper packet: the core packet rides first
+            walk(node[0])
+        for bufs in parked.values():
+            total = total + group(bufs[-1], bufs)  # bufs[-1] is *dvalid
+
+    walk(pkt)
+    return total
+
+
 # ---- host-side drain ------------------------------------------------------
 
 def is_concrete(tel: Telemetry) -> bool:
@@ -194,6 +256,7 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "slots_changed": int(tel.slots_changed),
         "deferred_depth": int(tel.deferred_depth),
         "bytes_exchanged": float(tel.bytes_exchanged),
+        "bytes_useful": float(tel.bytes_useful),
         "residue": int(tel.residue),
         "widen_pressure": float(tel.widen_pressure),
     }
@@ -212,6 +275,7 @@ def record(kind: str, tel: Telemetry) -> None:
     metrics.count(
         f"telemetry.{kind}.bytes_exchanged", int(d["bytes_exchanged"])
     )
+    metrics.count(f"telemetry.{kind}.bytes_useful", int(d["bytes_useful"]))
     metrics.observe(f"telemetry.{kind}.deferred_depth", d["deferred_depth"])
     metrics.observe(f"telemetry.{kind}.residue", d["residue"])
     metrics.observe(f"telemetry.{kind}.widen_pressure", d["widen_pressure"])
@@ -301,6 +365,6 @@ def span(name: str, **attrs):
 __all__ = [
     "Telemetry", "combine", "configure_tracing", "device_depth",
     "device_pressure", "drain_events", "generic_slots_changed",
-    "is_concrete", "record", "shipped_bytes", "span", "specs",
-    "to_dict", "zeros",
+    "is_concrete", "packet_useful_bytes", "record", "shipped_bytes",
+    "span", "specs", "to_dict", "zeros",
 ]

@@ -1,0 +1,78 @@
+"""Tier-1 gate: tools/check_aliasing.py — every donated mesh entry
+point keeps its zero-copy ``input_output_alias`` lowering (the HBM
+footprint halving of the donation tentpole survives refactors), and
+the tile-table autotune override (tools/tile_table.json →
+ops/pallas_kernels._pick_r_chunk) stays wired."""
+
+import json
+import os
+import sys
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+sys.path.insert(0, TOOLS)
+
+import check_aliasing  # noqa: E402
+
+
+def test_every_donated_entry_point_aliases():
+    results = check_aliasing.check_all()
+    kinds = {k for k, _, _ in results}
+    # The whole gossip family is covered — losing a CASE is as bad as
+    # losing an alias.
+    assert {
+        "orswot_gossip", "map_gossip", "map_orswot_gossip",
+        "nested_map_gossip", "map3_gossip", "sparse_gossip",
+        "sparse_mvmap_gossip_s4", "delta_gossip", "map_delta_gossip",
+        "map_orswot_delta_gossip", "map3_delta_gossip",
+    } <= kinds
+    bad = [(k, d) for k, ok, d in results if not ok]
+    assert not bad, f"entry points lost their aliasing lowering: {bad}"
+
+
+def test_tile_table_override_reaches_pick_r_chunk(monkeypatch):
+    from crdt_tpu.ops import pallas_kernels as pk
+
+    # Heuristic default for a=2, tile_e=512 at the 1 MiB budget.
+    monkeypatch.setattr(pk, "_TILE_TABLE", {})
+    heuristic = pk._pick_r_chunk(4096, 2, 512, None)
+    assert heuristic == 1 << (max(8, pk._VMEM_BLOCK_BUDGET // (2 * 512 * 4))
+                              ).bit_length() - 1
+    # A committed entry overrides it (still power-of-two clamped).
+    monkeypatch.setattr(
+        pk, "_TILE_TABLE",
+        {"entries": [{"a": 2, "tile_e": 512, "r_chunk": 48}]},
+    )
+    assert pk._pick_r_chunk(4096, 2, 512, None) == 32
+    # No exact (a, tile_e) match -> heuristic again.
+    assert pk._pick_r_chunk(4096, 4, 512, None) != 48
+    # Explicit r_chunk always wins over the table.
+    assert pk._pick_r_chunk(4096, 2, 512, 64) == 64
+
+
+def test_committed_tile_table_is_loadable():
+    with open(os.path.join(TOOLS, "tile_table.json")) as f:
+        table = json.load(f)
+    assert isinstance(table.get("entries"), list)
+    for e in table["entries"]:
+        assert {"a", "tile_e", "r_chunk"} <= set(e)
+
+
+def test_write_table_merges_by_key(tmp_path):
+    import tile_sweep
+
+    path = str(tmp_path / "tile_table.json")
+    tile_sweep.write_table(2, (512, 64, 430.0, 0, ""), "64x1024x2",
+                           path=path)
+    tile_sweep.write_table(2, (512, 128, 460.0, 0, ""), "64x1024x2",
+                           path=path)
+    tile_sweep.write_table(4, (256, 64, 200.0, 0, ""), "64x1024x4",
+                           path=path)
+    table = json.load(open(path))
+    assert len(table["entries"]) == 2  # (2,512) replaced, (4,256) added
+    by_key = {(e["a"], e["tile_e"]): e for e in table["entries"]}
+    assert by_key[(2, 512)]["r_chunk"] == 128
+    assert by_key[(4, 256)]["r_chunk"] == 64
+    assert all("swept_utc" in e and "gbps" in e for e in table["entries"])

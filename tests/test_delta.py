@@ -247,8 +247,13 @@ def test_residue_reports_underbudgeted_run(seed):
     dirty, fctx = _tracking(batched, applied)
     assert int(dirty.sum()) > 4  # backlog genuinely exceeds cap=1
 
-    # Under-budgeted: cap=1 starves the backlog within the default P-1
-    # rounds — the runtime indicator must fire (and warn).
+    # Under-budgeted: cap=1 starves the backlog within the default
+    # round budget — the runtime indicator must fire (and warn ONCE per
+    # kind: repeats only count in the metrics registry).
+    from crdt_tpu.parallel.delta_ring import reset_residue_warnings
+    from crdt_tpu.utils.metrics import metrics
+
+    reset_residue_warnings()
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
         _, _, _, residue = mesh_delta_gossip(
@@ -256,6 +261,20 @@ def test_residue_reports_underbudgeted_run(seed):
         )
     assert int(residue) > 0
     assert any("residue" in str(w.message) for w in caught)
+
+    # The SAME under-budgeted run again: deduped to silence, but the
+    # registry counter keeps the rate.
+    runs_before = metrics.snapshot()["counters"].get(
+        "anti_entropy.delta_gossip.residue_runs", 0
+    )
+    with warnings.catch_warnings(record=True) as again:
+        warnings.simplefilter("always")
+        mesh_delta_gossip(sharded, dirty, fctx, mesh, cap=1)
+    assert not any("residue" in str(w.message) for w in again)
+    runs_after = metrics.snapshot()["counters"][
+        "anti_entropy.delta_gossip.residue_runs"
+    ]
+    assert runs_after == runs_before + 1
 
     # Properly budgeted — enough rounds AND a cap that clears the
     # steady-state circulating-mark load: residue must certify

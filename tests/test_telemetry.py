@@ -271,8 +271,12 @@ def test_delta_ring_telemetry_reports_residue_and_bytes():
     assert int(tel.residue) == int(out1[3])  # sidecar mirrors output 4
     assert int(out1[3]) == int(out0[3])
     lr = sharded.top.shape[0] // P_REPLICAS
-    assert int(tel.merges) == P_REPLICAS * (lr - 1 + (P_REPLICAS - 1))
+    # Default budget under the (default-on) pipelined schedule: the
+    # doubled certificate window 2*(P-1)-1 (parallel/delta_ring.py).
+    rounds = 2 * (P_REPLICAS - 1) - 1
+    assert int(tel.merges) == P_REPLICAS * (lr - 1 + rounds)
     assert float(tel.bytes_exchanged) > 0
+    assert 0 < float(tel.bytes_useful) <= float(tel.bytes_exchanged)
     assert all(
         bool(jnp.array_equal(x, y))
         for x, y in zip(jax.tree.leaves(out0[0]), jax.tree.leaves(out1[0]))

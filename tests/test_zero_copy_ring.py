@@ -1,0 +1,316 @@
+"""Acceptance gates for the zero-copy pipelined δ-ring
+(parallel/delta_ring.py): the ``pipeline=`` / ``digest=`` flags.
+
+Pinned contracts:
+
+1. Flags off trace EXACTLY the pre-flag sequential ring — reconstructed
+   here and compared by lowered-HLO string equality (the PR-2 telemetry
+   pattern: any op a flag smuggles into the off path fails).
+2. The pipelined schedule (sends one apply stale, DMA overlapped with
+   the merge) converges to the same full-join rows as the sequential
+   one, under its doubled budget; its default budget certifies
+   (residue == 0) and an under-window budget force-fails the
+   certificate.
+3. Digest gating leaves converged states bit-identical while
+   ``bytes_useful`` drops on low-churn workloads (the O(changed)
+   claim); removal-carrying packets are never gated away.
+4. ``telemetry.packet_useful_bytes`` counts exactly the valid slot +
+   parked lanes of a packet.
+"""
+
+import random
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from crdt_tpu import telemetry as tele
+from crdt_tpu.models.orswot import BatchedOrswot
+from crdt_tpu.ops.pallas_kernels import fold_auto
+from crdt_tpu.parallel import (
+    make_mesh,
+    mesh_delta_gossip,
+    mesh_fold,
+    shard_orswot,
+)
+from crdt_tpu.parallel.delta import (
+    DeltaPacket,
+    apply_delta,
+    close_top_orswot,
+    extract_delta,
+    gate_delta,
+)
+from crdt_tpu.parallel.mesh import ELEMENT_AXIS, REPLICA_AXIS, orswot_specs
+from crdt_tpu.pure.orswot import Orswot
+from crdt_tpu.utils import Interner
+
+from test_delta import _rand_states, _tracking, _rows_equal
+
+P_REP = 4
+MEMBERS = ["a", "b", "c", "d"]
+
+
+def _workload(seed):
+    rng = random.Random(seed)
+    states, applied = _rand_states(rng, 8, MEMBERS)
+    # Preset interners pin E=4 / A=8, already mesh-divisible, so the
+    # sharded state needs no padding — the HLO-equality baseline below
+    # can then take the exact same (unpadded) args as the entry point.
+    batched = BatchedOrswot.from_pure(
+        states, members=Interner(MEMBERS),
+        actors=Interner([f"s{i}" for i in range(8)]),
+    )
+    mesh = make_mesh(P_REP, 2)
+    sharded = shard_orswot(batched.state, mesh)
+    dirty, fctx = _tracking(batched, applied)
+    folded, _ = mesh_fold(sharded, mesh)
+    return mesh, sharded, dirty, fctx, folded
+
+
+def test_flags_off_hlo_identical_to_sequential_ring():
+    """pipeline=False digest=False must trace the pre-flag program:
+    reconstruct that program (the sequential extract→ship→apply ring as
+    it existed before this PR) and compare lowered HLO text."""
+    mesh, sharded, dirty, fctx, _ = _workload(3)
+    p = P_REP
+    rounds, cap = p - 1, 8
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            orswot_specs(),
+            P(REPLICA_AXIS, ELEMENT_AXIS),
+            P(REPLICA_AXIS, ELEMENT_AXIS, None),
+        ),
+        out_specs=(orswot_specs(), P(REPLICA_AXIS, ELEMENT_AXIS), P(), P()),
+        check_vma=False,
+    )
+    def gossip_fn(local, local_dirty, local_fctx):
+        # Named gossip_fn so the lowered module's private function name
+        # matches the entry point's closure — the comparison is then
+        # pure program text.
+        folded, of = fold_auto(local, prefer="tree")
+        d = jnp.any(local_dirty, axis=0)
+        f = jnp.max(local_fctx, axis=0)
+
+        def round_body(r, carry):
+            st, d, f, of, starved = carry
+            pkt, d, f = extract_delta(st, d, f, cap, start=r * cap)
+            in_window = r >= rounds - (p - 1)
+            starved = starved + jnp.where(
+                in_window, jnp.sum(d, dtype=jnp.int32), 0
+            )
+            pkt = jax.tree.map(
+                lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt
+            )
+            st, d, f, of_r = apply_delta(st, pkt, d, f)
+            return st, d, f, of | of_r, starved
+
+        init = (folded, d, f, of, jnp.zeros((), jnp.int32))
+        folded, d, f, of, starved = lax.fori_loop(0, rounds, round_body, init)
+        top = lax.pmax(lax.pmax(folded.top, REPLICA_AXIS), ELEMENT_AXIS)
+        folded = close_top_orswot(folded, top)
+        of = lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS)) > 0
+        residue = lax.psum(starved, (REPLICA_AXIS, ELEMENT_AXIS))
+        return jax.tree.map(lambda x: x[None], folded), d[None], of, residue
+
+    baseline = jax.jit(gossip_fn)
+    baseline_txt = jax.jit(
+        lambda s, d, f: baseline(s, d, f)
+    ).lower(sharded, dirty, fctx).as_text()
+    entry_txt = jax.jit(
+        lambda s, d, f: mesh_delta_gossip(
+            s, d, f, mesh, rounds=rounds, cap=cap, local_fold="tree",
+            pipeline=False, digest=False,
+        )
+    ).lower(sharded, dirty, fctx).as_text()
+    assert entry_txt == baseline_txt
+
+
+@pytest.mark.parametrize("seed", [1, 9, 17])
+def test_pipelined_ring_matches_fold(seed):
+    """The double-buffered schedule under its doubled budget reproduces
+    the full fold bit-for-bit, digest on or off."""
+    mesh, sharded, dirty, fctx, folded = _workload(seed)
+    for digest in (False, True):
+        rows, _, of, residue = mesh_delta_gossip(
+            sharded, dirty, fctx, mesh, rounds=4 * P_REP, cap=64,
+            pipeline=True, digest=digest,
+        )
+        assert not bool(of)
+        assert int(residue) == 0
+        _rows_equal(rows, folded)
+
+
+def test_pipelined_default_budget_certifies():
+    """rounds=None under pipeline=True budgets the doubled window
+    2*(P-1)-1 and certifies convergence with an ample cap."""
+    mesh, sharded, dirty, fctx, folded = _workload(5)
+    rows, _, of, residue = mesh_delta_gossip(
+        sharded, dirty, fctx, mesh, cap=64, pipeline=True
+    )
+    assert not bool(of)
+    assert int(residue) == 0
+    _rows_equal(rows, folded)
+
+
+def test_pipelined_underwindow_budget_cannot_certify():
+    """A pipelined budget below 2*(P-1)-1 rounds cannot complete the
+    ring's (two-rounds-per-hop) propagation: residue is forced >= 1 no
+    matter the cap — the sequential P-1 default is NOT enough here."""
+    mesh, sharded, dirty, fctx, _ = _workload(5)
+    from crdt_tpu.parallel.delta_ring import reset_residue_warnings
+
+    reset_residue_warnings()
+    with pytest.warns(UserWarning, match="residue"):
+        _, _, _, residue = mesh_delta_gossip(
+            sharded, dirty, fctx, mesh, rounds=P_REP - 1, cap=64,
+            pipeline=True,
+        )
+    assert int(residue) >= 1
+
+
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_digest_gating_bit_identical_and_fewer_useful_bytes(pipeline):
+    """Digest on vs off: bit-identical converged rows; on a synced base
+    with add-only local churn the gated ``bytes_useful`` drops strictly
+    below the ungated count (redundant re-circulated adds are masked),
+    while the wire bytes stay equal (static packet shapes)."""
+    # Synced base, then add-only divergence: every re-circulated slot
+    # is add-only, so the gate has real redundancy to cut.
+    rng = random.Random(11)
+    members = [f"m{i}" for i in range(16)]
+    interners = dict(
+        members=Interner(members),
+        actors=Interner([f"s{i}" for i in range(8)]),
+    )
+    sites = [Orswot() for _ in range(8)]
+    minted = []
+    for i, site in enumerate(sites):
+        m = rng.choice(members)
+        op = site.add(m, site.read().derive_add_ctx(f"s{i}"))
+        site.apply(op)
+        minted.append((i, op))
+    for j, site in enumerate(sites):
+        for i, op in minted:
+            if i != j:
+                site.apply(op)
+    phase2 = [[] for _ in range(8)]
+    for i, site in enumerate(sites):
+        op = site.add(rng.choice(members),
+                      site.read().derive_add_ctx(f"s{i}"))
+        site.apply(op)
+        phase2[i].append(op)
+    batched = BatchedOrswot.from_pure(sites, **interners)
+    dirty, fctx = _tracking(batched, phase2)
+
+    mesh = make_mesh(4, 2)
+    sharded = shard_orswot(batched.state, mesh)
+    folded, _ = mesh_fold(sharded, mesh)
+
+    outs = {}
+    for digest in (False, True):
+        rows, _, of, residue, tel = mesh_delta_gossip(
+            sharded, dirty, fctx, mesh, rounds=12, cap=16,
+            pipeline=pipeline, digest=digest, telemetry=True,
+        )
+        assert not bool(of) and int(residue) == 0
+        _rows_equal(rows, folded)
+        outs[digest] = (rows, tel)
+    rows_off, tel_off = outs[False]
+    rows_on, tel_on = outs[True]
+    assert all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(rows_off), jax.tree.leaves(rows_on))
+    )
+    # Wire bytes identical up to the one tiny digest clock per device...
+    digest_bytes = 8 * sharded.top.shape[-1] * sharded.top.dtype.itemsize
+    assert float(tel_on.bytes_exchanged) == pytest.approx(
+        float(tel_off.bytes_exchanged) + digest_bytes
+    )
+    # ...while the payload drops strictly: gating masked real slots.
+    assert float(tel_on.bytes_useful) < float(tel_off.bytes_useful)
+    assert float(tel_on.bytes_useful) < float(tel_on.bytes_exchanged)
+    # The ungated ring has no mask beyond extract's own valid bits, but
+    # packets are still mostly padding at cap=16 — useful < wire there
+    # too (the satellite fix: padded bytes no longer masquerade as
+    # payload).
+    assert float(tel_off.bytes_useful) < float(tel_off.bytes_exchanged)
+
+
+def test_gate_never_masks_removal_knowledge():
+    """A slot whose context exceeds its row (an attested removal) must
+    ship regardless of digest coverage; an add-only covered slot must
+    not."""
+    a = 4
+    idx = jnp.arange(2, dtype=jnp.int32)
+    rows = jnp.asarray([[0, 0, 0, 0], [2, 0, 0, 0]], jnp.uint32)
+    ctxs = jnp.asarray([[3, 0, 0, 0], [2, 0, 0, 0]], jnp.uint32)
+    pkt = DeltaPacket(
+        idx=idx, rows=rows, ctxs=ctxs,
+        valid=jnp.ones((2,), bool),
+        dcl=jnp.zeros((1, a), jnp.uint32),
+        dmask=jnp.zeros((1, 8), bool),
+        dvalid=jnp.zeros((1,), bool),
+    )
+    digest = jnp.asarray([9, 9, 9, 9], jnp.uint32)  # covers everything
+    gated = gate_delta(pkt, digest)
+    assert bool(gated.valid[0])       # removal slot (ctx > row): ships
+    assert not bool(gated.valid[1])   # covered add-only slot: masked
+    # Uncovered add-only slot ships too.
+    gated2 = gate_delta(pkt, jnp.asarray([1, 0, 0, 0], jnp.uint32))
+    assert bool(gated2.valid[1])
+
+
+def test_packet_useful_bytes_counts_masked_lanes():
+    a, e, c, dcap = 4, 8, 3, 2
+    pkt = DeltaPacket(
+        idx=jnp.zeros((c,), jnp.int32),
+        rows=jnp.zeros((c, a), jnp.uint32),
+        ctxs=jnp.zeros((c, a), jnp.uint32),
+        valid=jnp.asarray([True, False, True]),
+        dcl=jnp.zeros((dcap, a), jnp.uint32),
+        dmask=jnp.zeros((dcap, e), bool),
+        dvalid=jnp.asarray([True, False]),
+    )
+    per_slot = 4 + a * 4 + a * 4 + 1          # idx + rows + ctxs + valid
+    per_parked = a * 4 + e * 1 + 1            # dcl + dmask + dvalid
+    expect = 2 * per_slot + 1 * per_parked
+    assert float(tele.packet_useful_bytes(pkt)) == float(expect)
+    # All-invalid packet: zero payload.
+    empty = pkt._replace(
+        valid=jnp.zeros((c,), bool), dvalid=jnp.zeros((dcap,), bool)
+    )
+    assert float(tele.packet_useful_bytes(empty)) == 0.0
+
+
+def test_nested_packet_useful_bytes_walks_levels():
+    from crdt_tpu.parallel.delta_map_orswot import MapOrswotDeltaPacket
+
+    a, e, c, dcap, k = 2, 4, 2, 1, 3
+    core = DeltaPacket(
+        idx=jnp.zeros((c,), jnp.int32),
+        rows=jnp.zeros((c, a), jnp.uint32),
+        ctxs=jnp.zeros((c, a), jnp.uint32),
+        valid=jnp.asarray([True, True]),
+        dcl=jnp.zeros((dcap, a), jnp.uint32),
+        dmask=jnp.zeros((dcap, e), bool),
+        dvalid=jnp.asarray([False]),
+    )
+    pkt = MapOrswotDeltaPacket(
+        core=core,
+        kdcl=jnp.zeros((dcap, a), jnp.uint32),
+        kdkeys=jnp.zeros((dcap, k), bool),
+        kdvalid=jnp.asarray([True]),
+    )
+    per_slot = 4 + a * 4 + a * 4 + 1
+    per_outer = a * 4 + k * 1 + 1
+    assert float(tele.packet_useful_bytes(pkt)) == float(
+        2 * per_slot + per_outer
+    )

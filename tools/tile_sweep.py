@@ -5,7 +5,9 @@ tile_e at 512 (ops/pallas_kernels.py `_VMEM_BLOCK_BUDGET`). This tool
 re-measures the neighborhood on the real toolchain at the bench
 config-3 stream shape so the defaults are evidence, not folklore:
 
-    python tools/tile_sweep.py            # sweep, print a ranked table
+    python tools/tile_sweep.py                # sweep, print a ranked table
+    python tools/tile_sweep.py --write-table  # sweep AND commit the winner
+                                              # into tools/tile_table.json
 
 For each candidate it times the same marginal K-vs-2K stream bench.py
 uses (relay-RTT independent) and reports achieved GB/s. Combos that
@@ -13,10 +15,18 @@ fail Mosaic compilation are reported as such and skipped — that is data
 too (the 4 MiB block failure is recorded in the kernel's module
 docstring). Run only when the chip is free (libtpu is process-exclusive
 behind the relay).
+
+``--write-table`` closes the loop that made sweep results write-only:
+the best measured (tile_e, r_chunk) for this shape's actor count is
+merged into the committed ``tools/tile_table.json``, which
+``ops/pallas_kernels._pick_r_chunk`` consults before its VMEM-budget
+heuristic — so a committed sweep changes the production default, with
+provenance (GB/s, shape, UTC timestamp) riding each entry.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
@@ -118,7 +128,46 @@ def main() -> int:
         f"BEST: tile_e={best[0]} r_chunk={best[1]} {best[2]:.1f} GB/s "
         f"(all results bit-identical)"
     )
+    if "--write-table" in sys.argv[1:]:
+        path = write_table(a, best, shape=f"{R}x{E}x{a}")
+        print(f"committed tile_e={best[0]} r_chunk={best[1]} -> {path}")
     return 0
+
+
+TABLE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tile_table.json")
+
+
+def write_table(a: int, best, shape: str, path: str = TABLE_PATH) -> str:
+    """Merge the winning (tile_e, r_chunk) for actor count ``a`` into
+    the committed autotune table (one entry per (a, tile_e) — a re-run
+    replaces its own previous measurement). Provenance (GB/s, shape,
+    UTC timestamp) rides each entry so a stale override is auditable."""
+    try:
+        with open(path) as f:
+            table = json.load(f)
+    except (OSError, ValueError):
+        table = {"version": 1, "entries": []}
+    entries = [
+        e for e in table.get("entries", [])
+        if not (e.get("a") == a and e.get("tile_e") == best[0])
+    ]
+    entries.append({
+        "a": a,
+        "tile_e": best[0],
+        "r_chunk": best[1],
+        "gbps": round(best[2], 1),
+        "shape": shape,
+        "swept_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    })
+    table["entries"] = sorted(
+        entries, key=lambda e: (e.get("a", 0), e.get("tile_e", 0))
+    )
+    table.setdefault("version", 1)
+    with open(path, "w") as f:
+        json.dump(table, f, indent=2)
+        f.write("\n")
+    return path
 
 
 if __name__ == "__main__":
