@@ -1,0 +1,46 @@
+"""crdt_tpu.analysis — the machine-checked invariant layer.
+
+Correctness of the whole framework rests on two families of properties
+that no runtime test can pin globally:
+
+- every merge kernel is a join-semilattice (commutative, associative,
+  idempotent — the algebraic precondition for the reduction-tree folds,
+  the δ gating, and the elastic migrations to be sound; Weidner et al.,
+  arXiv:2004.04303; Almeida et al., arXiv:1410.2803), and
+- every mesh entry point stays jit-pure: no host branches on traced
+  values, no nondeterministic or unstable reductions, no dtype-overflow
+  hazards in counter/clock lanes, no read-after-donate aliasing holes.
+
+This package is the static gate for both:
+
+- :mod:`.registry` — every op kind self-registers its merge fn, state
+  generator and canonical form; every mesh entry point self-registers
+  its cache kind, example-args builder and donation arity. A kind or
+  entry point that exists but is not registered FAILS CI (discovery
+  tests in tests/test_analysis.py).
+- :mod:`.laws` — traces each registered merge to a jaxpr and verifies
+  commutativity / associativity / idempotence / identity absorption /
+  δ-inflation bit-exactly over exhaustive small domains (plus
+  property-sampled larger ones where registered).
+- :mod:`.jit_lint` — walks the jaxprs of all registered mesh entry
+  points flagging traced-value host branches, unstable sorts, inexact
+  floating accumulations, unsigned-narrowing converts, sub-32-bit
+  counter arithmetic, and donated buffers with no aliasable output.
+- :mod:`.fixtures` — deliberately-broken kernels proving each detector
+  fires (tests/test_analysis.py).
+
+Runner: ``python tools/run_static_checks.py`` chains lint + laws +
+aliasing + telemetry schema as one fast tier-1 command.
+"""
+
+from .registry import (  # noqa: F401
+    MergeKind,
+    EntryPoint,
+    register_merge,
+    register_entry_point,
+    merge_kinds,
+    entry_points,
+    unregistered_entry_points,
+    ensure_registered,
+)
+from .report import Finding, format_findings  # noqa: F401

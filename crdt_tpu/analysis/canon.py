@@ -1,0 +1,69 @@
+"""Canonical forms for bit-exact law comparison.
+
+The joins keep their STATE canonical where cheap (sorted segment
+tables, valid-first slot compaction), but two buffers are ordered by
+join *operand order*, not by content: the masked-epoch deferred buffers
+(parked removes concatenate left-then-right before compaction) and the
+MVReg sibling slot table. ``join(a, b)`` and ``join(b, a)`` then hold
+the same SET of slots in different lanes — semantically equal, raw
+arrays unequal. The law engine compares ``canon(state)`` instead:
+content-ordered, bit-exact, with dead lanes already zeroed by the
+kernels' own compaction.
+
+These helpers are shared by the op modules' ``canon=`` registrations
+(registry.py). They are batch-polymorphic (leading axes broadcast) so
+the engine can canonicalize whole stacked comparison batches at once.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def canon_epochs(dcl, payload, dvalid, payload_fill=0):
+    """Canonicalize a masked-epoch deferred buffer for comparison: dead
+    slots carry no payload (the joins' own ``_compact`` convention —
+    the CmRDT applies drop a caught-up slot's ``dvalid`` without
+    scrubbing its clock, so op-built states hold semantically-dead
+    stale lanes), then valid slots first, ordered lexicographically by
+    rm clock (unique among valid slots — every join dedupes equal
+    clocks before compacting).
+
+    ``dcl [..., D, A]`` clocks, ``payload [..., D, X]`` member
+    masks/key masks/id lists (``payload_fill`` is the kind's dead value
+    — 0/False for masks, -1 for id lists), ``dvalid [..., D]``.
+    Returns the three canonical arrays."""
+    dcl = jnp.where(dvalid[..., None], dcl, jnp.zeros_like(dcl))
+    payload = jnp.where(
+        dvalid[..., None], payload,
+        jnp.full_like(payload, payload_fill),
+    )
+    a = dcl.shape[-1]
+    keys = tuple(dcl[..., i] for i in range(a - 1, -1, -1)) + (~dvalid,)
+    order = jnp.lexsort(keys, axis=-1)
+    return (
+        jnp.take_along_axis(dcl, order[..., None], axis=-2),
+        jnp.take_along_axis(payload, order[..., None], axis=-2),
+        jnp.take_along_axis(dvalid, order, axis=-1),
+    )
+
+
+def canon_mvreg(state):
+    """Content-order an MVReg slot table: valid first, then by witness
+    dot (actor, counter) — unique per live slot, so the order is total.
+    Dead payload is zeroed (matches ops/map._canon_child, which the map
+    kinds already apply inside their joins)."""
+    order = jnp.lexsort((state.wctr, state.wact, ~state.valid), axis=-1)
+    valid = jnp.take_along_axis(state.valid, order, axis=-1)
+    take = lambda x: jnp.take_along_axis(x, order, axis=-1)
+    return state._replace(
+        wact=jnp.where(valid, take(state.wact), 0),
+        wctr=jnp.where(valid, take(state.wctr), 0),
+        clk=jnp.where(
+            valid[..., None],
+            jnp.take_along_axis(state.clk, order[..., None], axis=-2),
+            0,
+        ),
+        val=jnp.where(valid, take(state.val), 0),
+        valid=valid,
+    )

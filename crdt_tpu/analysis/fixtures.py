@@ -1,0 +1,109 @@
+"""Deliberately-broken kernels — proof each detector actually fires.
+
+Every law the engine checks and every lint detector has a committed
+counterexample here; tests/test_analysis.py asserts the corresponding
+finding appears (and that the honest twins stay clean). None of this is
+imported by production code.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import MergeKind
+
+# ---- broken merges (law-engine fixtures) ---------------------------------
+#
+# States are scalar uint32 lattices; the honest join is max. Each broken
+# kind violates exactly the law named, keeping the others intact where
+# algebraically possible.
+
+
+def _scalar_states():
+    return [jnp.uint32(v) for v in (0, 1, 2, 3, 5)]
+
+
+GOOD_MAX = MergeKind(
+    name="fixture_good_max", join=jnp.maximum, states=_scalar_states,
+    module=__name__,
+)
+
+# Keeps the left operand: idempotent and associative, NOT commutative
+# (and absorbs nothing on the right).
+NOT_COMMUTATIVE = MergeKind(
+    name="fixture_not_commutative", join=lambda a, b: a,
+    states=_scalar_states, module=__name__,
+)
+
+# Saturating add: commutative and associative (plain + on uint32 wraps
+# but is still associative; these domains stay tiny), NOT idempotent.
+NOT_IDEMPOTENT = MergeKind(
+    name="fixture_not_idempotent", join=lambda a, b: a + b,
+    states=_scalar_states, module=__name__,
+)
+
+# Truncated mean: commutative and idempotent, NOT associative.
+NOT_ASSOCIATIVE = MergeKind(
+    name="fixture_not_associative", join=lambda a, b: (a + b) // 2,
+    states=_scalar_states, module=__name__,
+)
+
+
+# ---- jit-lint fixtures ---------------------------------------------------
+
+def kernel_traced_branch(x):
+    """Host ``if`` on a traced value — aborts tracing."""
+    if x.sum() > 0:
+        return x + 1
+    return x
+
+
+def kernel_unstable_sort(x):
+    """sort with is_stable=False — backend-dependent tie order."""
+    return lax.sort(x, is_stable=False)
+
+
+def kernel_float_accum(x):
+    """Sums uint32 counters through float32 — non-associative bits."""
+    return jnp.sum(x.astype(jnp.float32))
+
+
+def kernel_exact_bool_accum(sel, mask):
+    """Honest twin of the above: the ORSWOT dedupe group-OR matmul —
+    0/1 boolean masks ride the MXU as bf16 with an f32 accumulator,
+    exact at any realistic slot count. Must NOT be flagged."""
+    merged = jnp.einsum(
+        "ij,ie->je",
+        sel.astype(jnp.bfloat16),
+        mask.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+    return (merged > 0.5), jnp.sum(mask.astype(jnp.float32))
+
+
+def kernel_u16_counter(x):
+    """Increments a uint16 counter lane — wraps at 65k ops."""
+    return x + jnp.uint16(1)
+
+
+def kernel_narrowing_convert(x):
+    """uint32 clock truncated to uint16 — dot comparisons reorder."""
+    return x.astype(jnp.uint16)
+
+
+def donating_reshape(n: int = 8):
+    """A donating jit whose output no longer matches the donated input's
+    layout — the donation silently degrades to a copy. Returns
+    ``(fn, args)`` for lint_callable(n_donated_leaves=1)."""
+    fn = jax.jit(
+        lambda s: s.reshape(2, n // 2) + jnp.uint32(1), donate_argnums=0
+    )
+    return fn, (jnp.zeros((n,), jnp.uint32),)
+
+
+def donating_aligned(n: int = 8):
+    """Honest twin: output aliases the donated input — must stay clean."""
+    fn = jax.jit(lambda s: s + jnp.uint32(1), donate_argnums=0)
+    return fn, (jnp.zeros((n,), jnp.uint32),)

@@ -1,0 +1,101 @@
+"""Shared example-state builders for the entry-point gates.
+
+The registration blocks at the bottom of ``parallel/anti_entropy.py``,
+``parallel/delta*.py``, and ``parallel/sparse_shard.py`` all need the
+same thing: an R == P replica batch of join identities in ONE agreed
+gate geometry (aliasing and jaxpr shape are properties of shapes, not
+content). Keeping the shapes and builders here — an analysis-side
+module with deferred ops imports — gives those five modules one
+declared API instead of reaching into each other's privates, and keeps
+gate fixtures out of the production anti-entropy code. The constants
+mirror the pre-registry ``tools/check_aliasing.py`` gate shapes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Gate geometry: element/actor/deferred widths and the nested key split.
+GE, GA, GD = 8, 4, 4
+GK1, GK2, GM = 4, 2, 2
+
+
+def replicas(mesh) -> int:
+    """R == P: one replica block row per device on the replica axis."""
+    from ..parallel.mesh import REPLICA_AXIS
+
+    return mesh.shape[REPLICA_AXIS]
+
+
+def mk_dense(p):
+    from ..ops import orswot
+
+    return orswot.empty(GE, GA, GD, batch=(p,))
+
+
+def mk_map(p):
+    from ..ops import map as map_ops
+
+    return map_ops.empty(GE, GA, 2, GD, batch=(p,))
+
+
+def mk_map_orswot(p):
+    from ..ops import map_orswot as mo_ops
+
+    return mo_ops.empty(GK1, GM, GA, GD, batch=(p,))
+
+
+def mk_nested_map(p):
+    from ..ops import map_map as nested_ops
+
+    return nested_ops.empty(GK1, GK2, GA, 2, GD, batch=(p,))
+
+
+def mk_map3(p):
+    from ..ops import map3 as map3_ops
+
+    return map3_ops.empty(GK1, GK2, GM, GA, GD, batch=(p,))
+
+
+def mk_sparse(p):
+    from ..ops import sparse_orswot as sp
+
+    return sp.empty(GE, GA, GD, 8, batch=(p,))
+
+
+def mk_sparse_mvmap(p):
+    from ..ops import sparse_mvmap as smv
+
+    return smv.empty(GE, GA, GD, 8, batch=(p,))
+
+
+def mk_sparse_nested(p):
+    from ..ops import sparse_nest as snest
+
+    return snest.empty_map_orswot(GM, GE, GA, GD, 8, GD, 8, batch=(p,))
+
+
+def sparse_nested_level():
+    from ..ops import sparse_nest as snest
+
+    return snest.level_map_orswot(GM)
+
+
+def mk_gset(p):
+    return jnp.zeros((p, GE), bool)
+
+
+def mk_lww(p):
+    from ..ops import lwwreg as lww_ops
+
+    return lww_ops.empty(batch=(p,))
+
+
+def mk_mvreg(p):
+    from ..ops import mvreg as mv
+
+    return mv.empty(GD, GA, batch=(p,))
+
+
+def mk_clocks(p):
+    return jnp.zeros((p, GA), jnp.uint32)

@@ -1,0 +1,309 @@
+"""The jit-safety lint — jaxpr-level purity checks for mesh entry points.
+
+Walks the jaxpr of every registered mesh entry point (and any fixture
+callable) and flags the hazards that break determinism or zero-copy on
+an accelerator, each reported with the offending eqn:
+
+- **traced-branch** — host Python control flow on a traced value: the
+  trace itself aborts (``TracerBoolConversionError``); the lint turns
+  the crash into a finding with the source frame.
+- **unstable-sort** — a ``sort`` primitive lowered with
+  ``is_stable=False``: tie order then depends on backend/tile schedule,
+  so converged replicas stop being bit-identical.
+- **float-accum** — an additive reduction (``reduce_sum``,
+  ``dot_general``, ``cumsum``, float ``psum``/``reduce_window_sum``)
+  over floating operands whose values are NOT provably exact 0/1
+  (i.e. derived from booleans): float addition is non-associative, so
+  the reduction order XLA picks changes the bits. The provenance walk
+  follows bool-preserving ops (converts, broadcasts, reshapes,
+  transposes, boolean logic, 0/1 products) through nested call jaxprs —
+  the ORSWOT dedupe matmul (bf16 0/1 masks, f32 accumulator) passes,
+  a genuine float accumulation fails.
+- **dtype-overflow** — counter/clock-lane hazards: arithmetic on
+  sub-32-bit unsigned integers (saturates in thousands of ops) and
+  unsigned-narrowing ``convert_element_type`` (a u64→u32 or u32→u16
+  truncation silently reorders dot comparisons).
+- **donation-alias** — a donated input leaf whose (shape, dtype) has no
+  matching output leaf: XLA cannot alias it, the donation silently
+  degrades to a copy (the jaxpr-level shadow of tools/check_aliasing.py's
+  compiled-HLO gate).
+
+Entry-point driver: :func:`lint_entry_points` builds each registered
+entry's example args, runs it once so the memoised jit exists, then
+lints the cached function's jaxpr. Fixture driver: :func:`lint_callable`
+takes any callable + example args (tests/test_analysis.py proves every
+detector fires on crdt_tpu/analysis/fixtures.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Set
+
+import jax
+import numpy as np
+from jax import core as jcore
+
+from .report import Finding, slice_jaxpr
+
+# Additive (order-sensitive when floating) accumulations.
+_ACCUM_PRIMS = {
+    "reduce_sum", "cumsum", "dot_general", "psum", "reduce_window_sum",
+}
+# Integer arithmetic that can wrap a narrow counter lane.
+_INT_ARITH_PRIMS = {"add", "sub", "mul", "reduce_sum", "cumsum"}
+# Value-preserving ops through which 0/1-ness survives.
+_SHAPE_PRIMS = {
+    "broadcast_in_dim", "reshape", "transpose", "squeeze", "slice",
+    "dynamic_slice", "rev", "concatenate", "expand_dims", "copy",
+    "convert_element_type", "stop_gradient", "gather", "select_n",
+    "and", "or", "xor", "not", "reduce_or", "reduce_and", "reduce_max",
+    "reduce_min", "max", "min", "mul", "pad",
+}
+
+
+def _is_float(aval) -> bool:
+    return np.issubdtype(aval.dtype, np.floating)
+
+
+def _sub_jaxprs(eqn):
+    """(param_name, Jaxpr) pairs nested under an eqn (pjit, shard_map,
+    scan, while, cond, custom_* — anything carrying a sub-program)."""
+    for name, val in eqn.params.items():
+        vals = val if isinstance(val, (list, tuple)) else (val,)
+        for v in vals:
+            if isinstance(v, jcore.ClosedJaxpr):
+                yield name, v.jaxpr
+            elif isinstance(v, jcore.Jaxpr):
+                yield name, v
+
+
+class _Walker:
+    """One pass over a closed jaxpr tracking 0/1 provenance."""
+
+    def __init__(self, label: str):
+        self.label = label
+        self.findings: List[Finding] = []
+
+    def _finding(self, check: str, eqn, detail: str, path: str) -> None:
+        self.findings.append(Finding(
+            check, self.label,
+            f"{detail} (at {path or 'top level'})",
+            jaxpr_slice=slice_jaxpr(eqn, max_lines=6),
+        ))
+
+    def walk(self, jaxpr: jcore.Jaxpr, exact: Set[Any], path: str = "") -> None:
+        """``exact`` holds vars whose runtime values are provably all in
+        {0, 1} (bool inputs/constants and anything value-preserving
+        derived from them)."""
+
+        def is_exact(v) -> bool:
+            if isinstance(v, jcore.Literal):
+                val = np.asarray(v.val)
+                return bool(np.isin(val, (0, 1)).all())
+            if v.aval.dtype == np.bool_:
+                return True
+            return v in exact
+
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            ins_exact = all(is_exact(v) for v in eqn.invars)
+
+            if prim == "sort" and not eqn.params.get("is_stable", True):
+                self._finding(
+                    "unstable-sort", eqn,
+                    "sort lowered with is_stable=False — tie order is "
+                    "backend-dependent", path,
+                )
+
+            if prim in _ACCUM_PRIMS:
+                float_ins = [v for v in eqn.invars if _is_float(v.aval)]
+                if float_ins and not all(is_exact(v) for v in float_ins):
+                    self._finding(
+                        "float-accum", eqn,
+                        f"{prim} accumulates floating values not provably "
+                        "0/1 — float addition is non-associative, bits "
+                        "depend on reduction order", path,
+                    )
+
+            if prim in _INT_ARITH_PRIMS:
+                for v in eqn.invars:
+                    dt = v.aval.dtype
+                    if (np.issubdtype(dt, np.unsignedinteger)
+                            and dt.itemsize < 4):
+                        self._finding(
+                            "dtype-overflow", eqn,
+                            f"{prim} on {dt} counter lane — sub-32-bit "
+                            "unsigned arithmetic wraps under realistic op "
+                            "counts", path,
+                        )
+                        break
+
+            if prim == "convert_element_type":
+                src = eqn.invars[0].aval.dtype
+                dst = eqn.params.get("new_dtype")
+                if (dst is not None
+                        and np.issubdtype(src, np.unsignedinteger)
+                        and np.issubdtype(np.dtype(dst), np.unsignedinteger)
+                        and np.dtype(dst).itemsize < np.dtype(src).itemsize):
+                    self._finding(
+                        "dtype-overflow", eqn,
+                        f"narrowing convert {src} -> {np.dtype(dst)} "
+                        "truncates counter/clock lanes", path,
+                    )
+
+            # Propagate 0/1 provenance.
+            if eqn.outvars:
+                out_exact = False
+                if prim in _SHAPE_PRIMS:
+                    if prim == "pad":
+                        out_exact = ins_exact  # padding value is an invar
+                    elif prim == "select_n":
+                        out_exact = all(is_exact(v) for v in eqn.invars[1:])
+                    else:
+                        out_exact = ins_exact
+                elif all(
+                    not isinstance(v, jcore.Literal)
+                    and v.aval.dtype == np.bool_
+                    for v in eqn.outvars
+                ):
+                    out_exact = True  # comparisons etc. produce bools
+                if out_exact:
+                    exact.update(
+                        v for v in eqn.outvars
+                        if not isinstance(v, jcore.DropVar)
+                    )
+
+            # Recurse into sub-programs, mapping provenance positionally
+            # where the calling convention is 1:1 (pjit/closed_call/
+            # shard_map/scan prefix); unknown conventions start cold.
+            for pname, sub in _sub_jaxprs(eqn):
+                sub_exact: Set[Any] = set()
+                if len(sub.invars) == len(eqn.invars):
+                    sub_exact = {
+                        sv for sv, ov in zip(sub.invars, eqn.invars)
+                        if is_exact(ov)
+                    }
+                for cv in sub.constvars:
+                    av = getattr(cv, "aval", None)
+                    if av is not None and av.dtype == np.bool_:
+                        sub_exact.add(cv)
+                self.walk(sub, sub_exact, f"{path}/{prim}" if path else prim)
+
+
+def lint_jaxpr(
+    closed: jcore.ClosedJaxpr,
+    label: str,
+    donated_avals: Sequence[Any] = (),
+) -> List[Finding]:
+    """All detectors over one closed jaxpr. ``donated_avals`` are the
+    (shape, dtype) pairs of donated input leaves for the aliasing
+    check."""
+    w = _Walker(label)
+    w.walk(closed.jaxpr, set())
+
+    if donated_avals:
+        outs = [(tuple(v.aval.shape), np.dtype(v.aval.dtype))
+                for v in closed.jaxpr.outvars]
+        for shape, dtype in donated_avals:
+            key = (tuple(shape), np.dtype(dtype))
+            if key in outs:
+                outs.remove(key)
+            else:
+                w.findings.append(Finding(
+                    "donation-alias", label,
+                    f"donated input {dtype}{list(shape)} has no "
+                    "shape/dtype-matching output leaf — XLA cannot alias "
+                    "it and will silently copy",
+                ))
+    return w.findings
+
+
+def lint_callable(
+    fn,
+    args: tuple,
+    label: Optional[str] = None,
+    n_donated_leaves: int = 0,
+) -> List[Finding]:
+    """Trace ``fn`` on ``args`` and lint the jaxpr. A trace abort on a
+    host branch over a traced value becomes a ``traced-branch``
+    finding. ``n_donated_leaves`` marks the first N flattened input
+    leaves donated (for the aliasing check)."""
+    label = label or getattr(fn, "__name__", repr(fn))
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except (jax.errors.TracerBoolConversionError,
+            jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.TracerIntegerConversionError) as exc:
+        return [Finding(
+            "traced-branch", label,
+            "host Python control flow on a traced value aborts the "
+            f"trace: {str(exc).splitlines()[0]}",
+        )]
+    donated = [
+        (np.shape(leaf), np.asarray(leaf).dtype)
+        for leaf in jax.tree.leaves(args)[:n_donated_leaves]
+    ]
+    return lint_jaxpr(closed, label, donated)
+
+
+def _cached_entry_fn(kind: str, n_donated: int):
+    """The memoised jit the entry's run populated
+    (parallel.anti_entropy._FN_CACHE; donate_argnums is key[3])."""
+    from ..parallel import anti_entropy as ae
+
+    hits = [
+        fn for key, fn in ae._FN_CACHE.items()
+        if key[0] == kind and key[3] == tuple(range(n_donated))
+    ]
+    return hits[-1] if hits else None
+
+
+def lint_entry_points(mesh=None, names: Optional[Sequence[str]] = None
+                      ) -> List[Finding]:
+    """Lint every registered mesh entry point's jaxpr (running each once
+    so the memoised jit exists). Unregistered-but-discoverable entry
+    points are findings too — the registry is the coverage contract."""
+    from .registry import entry_points, unregistered_entry_points
+
+    findings: List[Finding] = []
+    for name in unregistered_entry_points():
+        findings.append(Finding(
+            "unregistered-entry", name,
+            "public mesh entry point is not registered with "
+            "crdt_tpu.analysis.registry — the static gates cannot see it",
+        ))
+
+    if mesh is None:
+        from ..parallel import make_mesh
+
+        n = len(jax.devices())
+        p = max(n // 2, 1)
+        mesh = make_mesh(p, n // p)
+
+    for ep in entry_points():
+        if names is not None and ep.name not in names:
+            continue
+        try:
+            ep.invoke(mesh, ep.make_args(mesh))
+            fn = _cached_entry_fn(ep.kind, ep.n_donated)
+            if fn is None:
+                findings.append(Finding(
+                    "entry-cache", ep.name,
+                    f"no cached jit for kind {ep.kind!r} after invoking — "
+                    "registration out of sync with the entry's cache key",
+                ))
+                continue
+            args = ep.make_args(mesh)
+            donated = [
+                (np.shape(leaf), np.asarray(leaf).dtype)
+                for a in args[:ep.n_donated]
+                for leaf in jax.tree.leaves(a)
+            ]
+            closed = jax.make_jaxpr(fn)(*args)
+            findings += lint_jaxpr(closed, ep.name, donated)
+        except Exception as exc:  # a broken entry is a failed gate, loudly
+            findings.append(Finding(
+                "entry-error", ep.name, f"{type(exc).__name__}: {exc}",
+            ))
+    return findings

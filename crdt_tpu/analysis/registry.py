@@ -1,0 +1,203 @@
+"""The two self-registration registries behind every static gate.
+
+**Merge kinds** — every op module (``crdt_tpu/ops/*``) registers each
+lattice it implements: the merge fn, a small-domain state generator,
+and (where raw slot order is join-order dependent) a canonicalizer.
+The lattice-law engine (:mod:`.laws`) iterates this registry; a module
+that defines a ``join``/``merge`` without registering fails the
+completeness test in tests/test_analysis.py. The contract for a new
+CRDT kind:
+
+    from ..analysis.registry import register_merge
+
+    register_merge(
+        "my_kind", module=__name__,
+        join=join,                  # join(a, b) -> state | (state, flags)
+        states=_law_states,         # () -> [identity, s1, s2, ...] — the
+                                    #   FIRST state must be the join
+                                    #   identity (empty); all states must
+                                    #   be reachable via CmRDT ops with
+                                    #   enough capacity headroom that no
+                                    #   overflow flag fires
+        canon=_law_canon,           # optional: state -> canonical state
+                                    #   (bit-exact comparable); None if
+                                    #   raw arrays are already canonical
+        big_states=_law_states_big, # optional: () -> larger sampled domain
+    )
+
+**Mesh entry points** — every public anti-entropy entry
+(``mesh_gossip*`` / ``mesh_fold*`` / ``mesh_delta_gossip*``) registers
+its jit-cache kind, an example-args builder, an invoker, and how many
+leading args it donates. tools/check_aliasing.py and the jit-safety
+lint (:mod:`.jit_lint`) iterate this registry, and
+:func:`unregistered_entry_points` scans ``crdt_tpu.parallel`` for
+matching public names that forgot to register — a new entry point is
+auto-discovered or CI fails.
+
+This module must stay import-light (stdlib only): op modules import it
+at definition time, so it can never import ``crdt_tpu.ops`` or
+``crdt_tpu.parallel`` at module level.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class MergeKind:
+    """One registered lattice: the unit the law engine checks."""
+
+    name: str
+    join: Callable[[Any, Any], Any]       # -> state | (state, flags)
+    states: Callable[[], list]            # small domain; [0] = identity
+    canon: Optional[Callable[[Any], Any]] = None
+    big_states: Optional[Callable[[], list]] = None
+    module: str = ""
+
+
+@dataclass(frozen=True)
+class EntryPoint:
+    """One registered mesh entry point.
+
+    - ``name``: the public symbol in ``crdt_tpu.parallel``.
+    - ``kind``: the entry's jit-cache key head
+      (``parallel.anti_entropy._FN_CACHE`` key[0]).
+    - ``make_args(mesh)``: fresh example args (R == P replica batch of
+      join identities — aliasing and jaxpr shape are properties of
+      shapes, not content).
+    - ``invoke(mesh, args)``: run the entry once (``donate=True`` for
+      donatable entries) so the memoised jit exists; consumes ``args``.
+    - ``n_donated``: leading donated args (0 = the entry never aliases
+      outputs onto inputs — the fold family).
+    """
+
+    name: str
+    kind: str
+    make_args: Callable[[Any], tuple]
+    invoke: Callable[[Any, tuple], Any]
+    n_donated: int = 0
+
+
+_MERGE: Dict[str, MergeKind] = {}
+_ENTRY: Dict[str, EntryPoint] = {}
+
+# Public callables in crdt_tpu.parallel matching this are mesh entry
+# points and MUST be registered (gossip_elastic/delta_gossip_elastic are
+# retry wrappers over already-registered kinds; run_delta_ring is the
+# generic engine the registered δ flavors instantiate).
+ENTRY_NAME_RE = re.compile(r"^mesh_(gossip|fold|delta_gossip)")
+
+
+def register_merge(
+    name: str,
+    *,
+    join: Callable,
+    states: Callable[[], list],
+    canon: Optional[Callable] = None,
+    big_states: Optional[Callable[[], list]] = None,
+    module: str = "",
+) -> MergeKind:
+    kind = MergeKind(
+        name=name, join=join, states=states, canon=canon,
+        big_states=big_states, module=module,
+    )
+    _MERGE[name] = kind
+    return kind
+
+
+def register_entry_point(
+    name: str,
+    *,
+    kind: str,
+    make_args: Callable[[Any], tuple],
+    invoke: Callable[[Any, tuple], Any],
+    n_donated: int = 0,
+) -> EntryPoint:
+    ep = EntryPoint(
+        name=name, kind=kind, make_args=make_args, invoke=invoke,
+        n_donated=n_donated,
+    )
+    _ENTRY[name] = ep
+    return ep
+
+
+def merge_kinds() -> Tuple[MergeKind, ...]:
+    ensure_registered()
+    return tuple(_MERGE[k] for k in sorted(_MERGE))
+
+
+def get_merge_kind(name: str) -> MergeKind:
+    ensure_registered()
+    return _MERGE[name]
+
+
+def entry_points(donatable: Optional[bool] = None) -> Tuple[EntryPoint, ...]:
+    ensure_registered()
+    eps = tuple(_ENTRY[k] for k in sorted(_ENTRY))
+    if donatable is None:
+        return eps
+    return tuple(ep for ep in eps if (ep.n_donated > 0) == donatable)
+
+
+def registered_entry_names() -> Tuple[str, ...]:
+    ensure_registered()
+    return tuple(sorted(_ENTRY))
+
+
+def unregistered_entry_points() -> List[str]:
+    """Mesh-entry-shaped public callables that never registered — each
+    one fails the aliasing gate. Discovery scans the package surface
+    AND every ``crdt_tpu.parallel`` submodule's own definitions (by
+    ``__module__``), so an entry point that also skipped the
+    ``parallel/__init__`` re-export list cannot hide from the gate."""
+    import importlib
+    import pkgutil
+
+    import crdt_tpu.parallel as par
+
+    ensure_registered()
+    found = {
+        n for n in dir(par)
+        if ENTRY_NAME_RE.match(n) and callable(getattr(par, n))
+    }
+    for info in pkgutil.iter_modules(par.__path__):
+        mod = importlib.import_module(f"crdt_tpu.parallel.{info.name}")
+        for n in dir(mod):
+            obj = getattr(mod, n)
+            if (ENTRY_NAME_RE.match(n) and callable(obj)
+                    and getattr(obj, "__module__", "") == mod.__name__):
+                found.add(n)
+    return sorted(found - set(_ENTRY))
+
+
+_ENSURED = False
+
+
+def ensure_registered() -> None:
+    """Import every module carrying registrations (idempotent). Op
+    modules and the parallel package self-register at import time; this
+    makes 'iterate the registry' deterministic regardless of what the
+    caller already imported."""
+    global _ENSURED
+    if _ENSURED:
+        return
+    import importlib
+    import pkgutil
+
+    # EVERY ops module, discovered not hardcoded — a new ops/foo.py that
+    # calls register_merge() is picked up with no registry edit (and one
+    # that defines a join without registering fails the completeness
+    # test in tests/test_analysis.py).
+    import crdt_tpu.ops as ops_pkg
+
+    for info in pkgutil.iter_modules(ops_pkg.__path__):
+        importlib.import_module(f"crdt_tpu.ops.{info.name}")
+    # Mesh entry points (anti_entropy, delta*, sparse_shard).
+    importlib.import_module("crdt_tpu.parallel")
+    # Only mark done once EVERY registration module imported — a failed
+    # import must retry (and re-raise) on the next call, not leave the
+    # registry silently partial for the rest of the process.
+    _ENSURED = True

@@ -1,0 +1,68 @@
+"""Findings — the one result type every analysis pass emits.
+
+A ``Finding`` is one detected violation (or warning), carrying enough
+context to act on it: which check, which subject (merge kind / entry
+point / fixture), what happened, and — for jaxpr-level detections — the
+offending jaxpr slice so the report points at the compiled program, not
+just the Python source.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Sequence
+
+
+@dataclass(frozen=True)
+class Finding:
+    check: str           # "commutativity", "traced-branch", "dtype-overflow", ...
+    subject: str         # merge kind or entry-point name
+    detail: str          # human-readable one-liner
+    severity: str = "error"   # "error" fails the gate; "warning" is advisory
+    jaxpr_slice: str = ""     # pretty-printed offending eqn(s), possibly truncated
+
+    def __str__(self) -> str:
+        head = f"[{self.severity.upper()}] {self.subject}: {self.check} — {self.detail}"
+        if self.jaxpr_slice:
+            body = "\n".join(
+                "    | " + line for line in self.jaxpr_slice.splitlines()
+            )
+            return f"{head}\n{body}"
+        return head
+
+
+def errors(findings: Sequence[Finding]) -> List[Finding]:
+    return [f for f in findings if f.severity == "error"]
+
+
+def format_findings(findings: Sequence[Finding], header: str = "") -> str:
+    if not findings:
+        return f"{header}: clean" if header else "clean"
+    lines = [header] if header else []
+    lines += [str(f) for f in findings]
+    return "\n".join(lines)
+
+
+def slice_jaxpr(jaxpr, max_lines: int = 24) -> str:
+    """Pretty-print a jaxpr (or eqn) truncated to ``max_lines`` — the
+    "offending slice" attached to law violations and lint findings."""
+    text = str(jaxpr)
+    lines = text.splitlines()
+    if len(lines) > max_lines:
+        lines = lines[:max_lines] + [f"... (+{len(text.splitlines()) - max_lines} lines)"]
+    return "\n".join(lines)
+
+
+@dataclass
+class SectionResult:
+    """One runner section (tools/run_static_checks.py): named, timed,
+    carrying its findings."""
+
+    name: str
+    findings: List[Finding] = field(default_factory=list)
+    seconds: float = 0.0
+    skipped: str = ""  # non-empty = skipped, value says why
+
+    @property
+    def ok(self) -> bool:
+        return not errors(self.findings)

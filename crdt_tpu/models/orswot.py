@@ -16,7 +16,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..dot import Dot
 from ..ops import orswot as ops
 from ..pure.orswot import Add, Orswot, Rm
 from ..utils import Interner, clock_lanes, transactional, transactional_apply

@@ -38,3 +38,18 @@ def insert(present: jax.Array, member: jax.Array) -> jax.Array:
 @jax.jit
 def contains(present: jax.Array, member: jax.Array) -> jax.Array:
     return present[..., member]
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _law_states():
+    """Exhaustive: every subset of a 3-member universe (identity first)."""
+    return [
+        jnp.array([bool(bits >> i & 1) for i in range(3)])
+        for bits in range(8)
+    ]
+
+
+from ..analysis.registry import register_merge  # noqa: E402
+
+register_merge("gset", module=__name__, join=join, states=_law_states)

@@ -92,3 +92,24 @@ def apply_update(state: LWWState, hi, lo, val):
         has=jnp.ones(jnp.shape(hi), bool),
     )
     return join(state, put)
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _law_states():
+    """Exhaustive over 2-bit markers, CONFLICT-FREE by construction: the
+    value is a function of the marker (equal markers guarding different
+    values are the documented validation error — join returns the
+    ``conflict`` mask and the lattice laws only hold on the conflict-free
+    domain, exactly like the reference's validate_merge)."""
+    states = [empty()]
+    for hi in range(2):
+        for lo in range(2):
+            s, _ = apply_update(empty(), hi, lo, hi * 2 + lo + 1)
+            states.append(s)
+    return states
+
+
+from ..analysis.registry import register_merge  # noqa: E402
+
+register_merge("lwwreg", module=__name__, join=join, states=_law_states)

@@ -384,6 +384,34 @@ def apply_up(
     return state._replace(child=_canon_child(state.child)), c_overflow
 
 
+def _law_states():
+    """Concurrent puts, a covered key-remove, and parked (ahead)
+    removes over 2 keys × 2 actors with sibling/deferred headroom."""
+    cl = lambda x, y: jnp.array([x, y], DTYPE)
+    k0 = jnp.array([True, False])
+    k1 = jnp.array([False, True])
+    kb = jnp.array([True, True])
+    e = empty(2, 2, sibling_cap=4, deferred_cap=4)
+    u1, _ = apply_up(e, 0, jnp.uint32(1), 0, cl(1, 0), 5)
+    u2, _ = apply_up(u1, 0, jnp.uint32(2), 1, cl(2, 0), 6)
+    v1, _ = apply_up(e, 1, jnp.uint32(1), 0, cl(0, 1), 7)
+    uv, _ = join(u2, v1)
+    r1, _ = apply_rm(uv, cl(2, 1), k0)   # covered: kills key 0 now
+    r2, _ = apply_rm(u1, cl(0, 2), k1)   # ahead: parks
+    r3, _ = apply_rm(e, cl(1, 1), kb)    # ahead on empty: parks
+    return [e, u1, u2, v1, r1, r2, r3]
+
+
+def _law_canon(s: MapState) -> MapState:
+    from ..analysis.canon import canon_epochs, canon_mvreg
+
+    dcl, dkeys, dvalid = canon_epochs(s.dcl, s.dkeys, s.dvalid)
+    return MapState(
+        top=s.top, child=canon_mvreg(s.child),
+        dcl=dcl, dkeys=dkeys, dvalid=dvalid,
+    )
+
+
 @jax.jit
 def apply_rm(state: MapState, rm_clock: jax.Array, key_mask: jax.Array):
     """Apply ``Op::Rm { clock, keyset }`` (reference: src/map.rs
@@ -402,3 +430,13 @@ def apply_rm(state: MapState, rm_clock: jax.Array, key_mask: jax.Array):
         MapState(top=state.top, child=child, dcl=dcl, dkeys=dkeys, dvalid=dvalid),
         overflow,
     )
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+from ..analysis.registry import register_merge  # noqa: E402
+
+register_merge(
+    "map", module=__name__, join=join, states=_law_states,
+    canon=_law_canon,
+)

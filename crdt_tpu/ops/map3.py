@@ -172,3 +172,41 @@ def apply_key1_rm(state: Map3State, rm_clock: jax.Array, key1_mask: jax.Array):
     masked K1 blocks now; park in the K1 buffer if the clock is ahead.
     Returns ``(state, overflow)``."""
     return LEVEL.rm_parked(state, rm_clock, key1_mask)
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _law_states():
+    """Depth-3 adds, routed K2 keyset-removes, and covered/ahead K1
+    removes over a 2×2×2 universe with headroom."""
+    cl = lambda x, y: jnp.array([x, y], jnp.uint32)
+    m0 = jnp.array([True, False])
+    mb = jnp.array([True, True])
+    k0 = jnp.array([True, False])
+    kb = jnp.array([True, True])
+    e = empty(2, 2, 2, 2, deferred_cap=4)
+    a1 = apply_member_add(e, 0, jnp.uint32(1), 0, 0, m0)
+    a2 = apply_member_add(a1, 0, jnp.uint32(2), 1, 1, mb)
+    b1 = apply_member_add(e, 1, jnp.uint32(1), 0, 1, mb)
+    k2r, _ = apply_key2_rm(a2, 0, jnp.uint32(3), 0, cl(1, 0), kb)
+    k1r1, _ = apply_key1_rm(b1, cl(0, 1), k0)  # covered K1 rm
+    k1r2, _ = apply_key1_rm(a1, cl(0, 2), kb)  # ahead: parks in K1 buffer
+    return [e, a1, a2, b1, k2r, k1r1, k1r2]
+
+
+def _law_canon(s: Map3State) -> Map3State:
+    from ..analysis.canon import canon_epochs
+    from .map_orswot import _law_canon as _canon_core
+
+    odcl, odkeys, odvalid = canon_epochs(s.odcl, s.odkeys, s.odvalid)
+    return Map3State(
+        mo=_canon_core(s.mo), odcl=odcl, odkeys=odkeys, odvalid=odvalid,
+    )
+
+
+from ..analysis.registry import register_merge  # noqa: E402
+
+register_merge(
+    "map3", module=__name__, join=join, states=_law_states,
+    canon=_law_canon,
+)

@@ -143,3 +143,39 @@ def apply_key1_rm(state: NestedMapState, rm_clock: jax.Array, key1_mask: jax.Arr
     content across the masked K1 rows now; park in the OUTER buffer if
     the clock is ahead. Returns ``(state, overflow)``."""
     return LEVEL.rm_parked(state, rm_clock, key1_mask)
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _law_states():
+    """Nested puts, routed inner keyset-removes, and covered/ahead outer
+    removes over 2×2 keys × 2 actors with headroom."""
+    cl = lambda x, y: jnp.array([x, y], jnp.uint32)
+    k0 = jnp.array([True, False])
+    kb = jnp.array([True, True])
+    e = empty(2, 2, 2, sibling_cap=3, deferred_cap=4)
+    u1, _ = apply_put(e, 0, jnp.uint32(1), 0, 0, cl(1, 0), 5)
+    u2, _ = apply_put(u1, 0, jnp.uint32(2), 1, 1, cl(2, 0), 6)
+    v1, _ = apply_put(e, 1, jnp.uint32(1), 0, 1, cl(0, 1), 7)
+    ir, _ = apply_inner_rm(u2, 0, jnp.uint32(3), 0, cl(1, 0), kb)
+    or1, _ = apply_key1_rm(v1, cl(0, 1), k0)  # covered outer rm
+    or2, _ = apply_key1_rm(u1, cl(0, 2), kb)  # ahead: parks in outer buffer
+    return [e, u1, u2, v1, ir, or1, or2]
+
+
+def _law_canon(s: NestedMapState) -> NestedMapState:
+    from ..analysis.canon import canon_epochs
+    from .map import _law_canon as _canon_core
+
+    odcl, odkeys, odvalid = canon_epochs(s.odcl, s.odkeys, s.odvalid)
+    return NestedMapState(
+        m=_canon_core(s.m), odcl=odcl, odkeys=odkeys, odvalid=odvalid,
+    )
+
+
+from ..analysis.registry import register_merge  # noqa: E402
+
+register_merge(
+    "map_map", module=__name__, join=join, states=_law_states,
+    canon=_law_canon,
+)

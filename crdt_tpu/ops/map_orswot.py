@@ -157,3 +157,41 @@ def apply_key_rm(state: MapOrswotState, rm_clock: jax.Array, key_mask: jax.Array
     member rows now; park in the OUTER buffer if the clock is ahead.
     Returns ``(state, overflow)``."""
     return LEVEL.rm_parked(state, rm_clock, key_mask)
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _law_states():
+    """Member adds, routed member-removes, and covered/ahead key-removes
+    over 2 keys × 2 members × 2 actors with deferred headroom."""
+    cl = lambda x, y: jnp.array([x, y], DTYPE)
+    m0 = jnp.array([True, False])
+    mb = jnp.array([True, True])
+    k0 = jnp.array([True, False])
+    kb = jnp.array([True, True])
+    e = empty(2, 2, 2, deferred_cap=4)
+    a1 = apply_member_add(e, 0, jnp.uint32(1), 0, m0)
+    a2 = apply_member_add(a1, 0, jnp.uint32(2), 1, mb)
+    b1 = apply_member_add(e, 1, jnp.uint32(1), 0, mb)
+    mr, _ = apply_member_rm(a2, 0, jnp.uint32(3), 0, cl(1, 0), m0)
+    kr1, _ = apply_key_rm(b1, cl(0, 1), k0)   # covered key rm
+    kr2, _ = apply_key_rm(a1, cl(0, 2), kb)   # ahead: parks in outer buffer
+    return [e, a1, a2, b1, mr, kr1, kr2]
+
+
+def _law_canon(s: MapOrswotState) -> MapOrswotState:
+    from ..analysis.canon import canon_epochs
+    from .orswot import _law_canon as _canon_core
+
+    kdcl, kdkeys, kdvalid = canon_epochs(s.kdcl, s.kdkeys, s.kdvalid)
+    return MapOrswotState(
+        core=_canon_core(s.core), kdcl=kdcl, kdkeys=kdkeys, kdvalid=kdvalid,
+    )
+
+
+from ..analysis.registry import register_merge  # noqa: E402
+
+register_merge(
+    "map_orswot", module=__name__, join=join, states=_law_states,
+    canon=_law_canon,
+)

@@ -190,3 +190,35 @@ def apply_put(state: MVRegState, wact, wctr, clock, val):
         ),
         overflow,
     )
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _law_states():
+    """Concurrent / dominating / duplicate puts over 2 actors with slot
+    headroom (S = 6 ≫ the 2-3 live siblings any state holds)."""
+    cl = lambda x, y: jnp.array([x, y], DTYPE)
+    e = empty(6, 2)
+    p0, _ = apply_put(e, 0, 1, cl(1, 0), 5)         # actor-0 write
+    p1, _ = apply_put(e, 1, 1, cl(0, 1), 6)         # concurrent actor-1 write
+    both, _ = apply_put(p0, 1, 1, cl(0, 1), 6)      # two live siblings
+    dom, _ = apply_put(both, 0, 2, cl(2, 1), 7)     # dominates both
+    seen, _ = apply_put(dom, 0, 2, cl(2, 1), 7)     # duplicate dot no-op
+    p2, _ = apply_put(p1, 1, 2, cl(0, 2), 8)        # actor-1 advances alone
+    return [e, p0, p1, both, dom, seen, p2]
+
+
+def _law_canon(s: MVRegState) -> MVRegState:
+    """Sibling slot order depends on join operand order (``_compact``
+    docstring) — compare content-ordered."""
+    from ..analysis.canon import canon_mvreg
+
+    return canon_mvreg(s)
+
+
+from ..analysis.registry import register_merge  # noqa: E402
+
+register_merge(
+    "mvreg", module=__name__, join=join, states=_law_states,
+    canon=_law_canon,
+)

@@ -350,3 +350,85 @@ def apply_rm(state: OrswotState, rm_clock: jax.Array, member_mask: jax.Array):
         OrswotState(top=state.top, ctr=ctr, dcl=dcl, dmask=dmask, dvalid=dvalid),
         overflow,
     )
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _law_apply(s: OrswotState, op):
+    if op[0] == "add":
+        _, actor, ctr, mask = op
+        return apply_add(s, actor, jnp.uint32(ctr), mask)
+    _, clock, mask = op
+    return apply_rm(s, clock, mask)[0]
+
+
+def _law_states():
+    """Adds, covered removes, and parked (ahead) removes over a 2×2
+    universe with deferred headroom (D = 4)."""
+    m0 = jnp.array([True, False])
+    m1 = jnp.array([False, True])
+    mb = jnp.array([True, True])
+    cl = lambda x, y: jnp.array([x, y], DTYPE)
+    e = empty(2, 2, 4)
+    a1 = apply_add(e, 0, jnp.uint32(1), m0)
+    a2 = apply_add(a1, 0, jnp.uint32(2), m1)
+    b1 = apply_add(e, 1, jnp.uint32(1), mb)
+    ab, _ = join(a2, b1)
+    r1, _ = apply_rm(ab, cl(2, 1), m0)   # covered: kills elem 0 now
+    r2, _ = apply_rm(a1, cl(0, 2), m1)   # ahead: parks in the buffer
+    r3, _ = apply_rm(e, cl(1, 1), mb)    # ahead on empty: parks
+    return [e, a1, a2, b1, r1, r2, r3]
+
+
+def _law_states_big():
+    """Property-sampled larger domain: replicas applying ordered
+    subsets of one shared 10-op history (per-actor counter order is
+    causal delivery; rm clocks observed at the mint site, occasionally
+    nudged ahead so parking happens)."""
+    import numpy as np
+
+    rng = np.random.default_rng(20260803)
+    e_n, a_n, d_n = 4, 3, 6
+    site = empty(e_n, a_n, d_n)
+    history = []
+    next_ctr = [0] * a_n
+    for _ in range(10):
+        actor = int(rng.integers(a_n))
+        if rng.random() < 0.7 or not history:
+            next_ctr[actor] += 1
+            mask = jnp.asarray(rng.random(e_n) < 0.5)
+            op = ("add", actor, next_ctr[actor], mask)
+        else:
+            top = np.asarray(site.top).astype(np.uint64)
+            if rng.random() < 0.3:
+                top[actor] += 1  # ahead -> parks
+            mask = jnp.asarray(rng.random(e_n) < 0.5)
+            op = ("rm", jnp.asarray(top, DTYPE), mask)
+        site = _law_apply(site, op)
+        history.append(op)
+    states = [empty(e_n, a_n, d_n)]
+    for _ in range(6):
+        take = rng.random(len(history)) < 0.6
+        s = empty(e_n, a_n, d_n)
+        for keep, op in zip(take, history):
+            if keep:
+                s = _law_apply(s, op)
+        states.append(s)
+    return states
+
+
+def _law_canon(s: OrswotState) -> OrswotState:
+    """Deferred slot order depends on join operand order — compare
+    content-ordered (clocks are unique among valid slots post-dedupe)."""
+    from ..analysis.canon import canon_epochs
+
+    dcl, dmask, dvalid = canon_epochs(s.dcl, s.dmask, s.dvalid)
+    return s._replace(dcl=dcl, dmask=dmask, dvalid=dvalid)
+
+
+from ..analysis.registry import register_merge  # noqa: E402
+
+register_merge(
+    "orswot", module=__name__, join=join, states=_law_states,
+    canon=_law_canon, big_states=_law_states_big,
+)

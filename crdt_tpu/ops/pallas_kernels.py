@@ -264,7 +264,14 @@ def _tile_table() -> dict:
             table.get("entries", [])  # shape check
             _TILE_TABLE = table if isinstance(
                 table.get("entries", None), list) else {}
-        except (OSError, ValueError, AttributeError):
+        except OSError:
+            _TILE_TABLE = {}  # no committed table: the heuristic is fine
+        except (ValueError, AttributeError):
+            # A table that EXISTS but does not parse is operator error,
+            # not absence — degrade to the heuristic, but visibly.
+            from ..utils.metrics import metrics
+
+            metrics.count("pallas.tile_table.load_failed")
             _TILE_TABLE = {}
     return _TILE_TABLE
 
@@ -275,13 +282,18 @@ def _pick_r_chunk(r: int, a: int, tile_e: int, r_chunk: Optional[int]) -> int:
         # over the VMEM-budget heuristic; both still get clamped to the
         # batch and rounded to the halving tree's power of two below.
         # A malformed entry (missing/non-numeric r_chunk) degrades to
-        # the heuristic — the table is an override, never a requirement.
+        # the heuristic — the table is an override, never a requirement —
+        # but counts in the registry so a fat-fingered sweep table is an
+        # operator signal, not silence (tests/test_analysis.py pins it).
         for entry in _tile_table().get("entries", ()):
             try:
                 if entry.get("a") == a and entry.get("tile_e") == tile_e:
                     r_chunk = int(entry["r_chunk"])
                     break
             except (AttributeError, KeyError, TypeError, ValueError):
+                from ..utils.metrics import metrics
+
+                metrics.count("pallas.tile_table.malformed_entry")
                 continue
     if r_chunk is None:
         r_chunk = max(8, _VMEM_BLOCK_BUDGET // (max(a, 1) * tile_e * 4))

@@ -587,3 +587,41 @@ def nest_apply_up_put(level, s, wact, wctr, kid_flat, clock, val):
     )
     out = jax.tree.map(keep, s, out)
     return out, overflow & ~seen
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _law_states():
+    """Cell puts (concurrent / dominating / duplicate) and covered/ahead
+    key-removes over 3 keys × 2 actors with cell headroom."""
+    cl = lambda x, y: jnp.array([x, y], DTYPE)
+    ids = lambda *xs: jnp.array(list(xs) + [-1] * (4 - len(xs)), jnp.int32)
+    e = empty(8, 2, deferred_cap=3, rm_width=4)
+    u1, _ = apply_up(e, 0, jnp.uint32(1), 0, cl(1, 0), 5)
+    u2, _ = apply_up(u1, 0, jnp.uint32(2), 1, cl(2, 0), 6)
+    v1, _ = apply_up(e, 1, jnp.uint32(1), 0, cl(0, 1), 7)
+    # Actor 1's second write after observing both branches: its clock
+    # dominates u1's and v1's key-0 siblings (a FRESH dot — reusing a
+    # witness dot for different content is a non-causal history and no
+    # CRDT's laws survive that).
+    uv, _ = join(u2, v1)
+    dom, _ = apply_up(uv, 1, jnp.uint32(2), 0, cl(2, 2), 8)
+    r1, _ = apply_rm(dom, cl(2, 1), ids(0))   # covered key rm
+    r2, _ = apply_rm(u1, cl(0, 2), ids(1))    # ahead: parks
+    r3, _ = apply_rm(e, cl(1, 1), ids(0, 2))  # ahead on empty
+    return [e, u1, u2, v1, dom, r1, r2, r3]
+
+
+def _law_canon(s: SparseMVMapState) -> SparseMVMapState:
+    from ..analysis.canon import canon_epochs
+
+    dcl, kidx, dvalid = canon_epochs(s.dcl, s.kidx, s.dvalid, payload_fill=-1)
+    return s._replace(dcl=dcl, kidx=kidx, dvalid=dvalid)
+
+
+from ..analysis.registry import register_merge  # noqa: E402
+
+register_merge(
+    "sparse_mvmap", module=__name__, join=join, states=_law_states,
+    canon=_law_canon,
+)

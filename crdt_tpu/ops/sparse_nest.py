@@ -585,3 +585,47 @@ def empty_map_orswot(
         sp.empty(dot_cap, n_actors, deferred_cap, rm_width, batch=batch),
         n_actors, key_deferred_cap, key_rm_width, batch=batch,
     )
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _law_states():
+    """Sparse ``Map<K, Orswot>`` (span 2): member adds, leaf-routed
+    member-removes, and covered/ahead key-removes with headroom."""
+    lvl = level_map_orswot(2)
+    cl = lambda x, y: jnp.array([x, y], DTYPE)
+    ids = lambda *xs: jnp.array(list(xs) + [-1] * (4 - len(xs)), jnp.int32)
+    mk = lambda: empty_map_orswot(
+        2, 8, 2, deferred_cap=3, rm_width=4,
+        key_deferred_cap=3, key_rm_width=4,
+    )
+    e = mk()
+    a1, _ = lvl.apply_up_add(e, 0, jnp.uint32(1), ids(0))        # key 0, member 0
+    a2, _ = lvl.apply_up_add(a1, 0, jnp.uint32(2), ids(2, 3))    # key 1, both members
+    b1, _ = lvl.apply_up_add(e, 1, jnp.uint32(1), ids(1, 2))
+    mr, _ = lvl.apply_up_rm(a2, 0, jnp.uint32(3), cl(1, 0), ids(0), levels_down=1)
+    kr1, _ = lvl.rm_parked(b1, cl(0, 1), ids(0))   # covered key rm
+    kr2, _ = lvl.rm_parked(a1, cl(0, 2), ids(1))   # ahead: parks
+    return [e, a1, a2, b1, mr, kr1, kr2]
+
+
+def _law_canon(s: SparseNestState) -> SparseNestState:
+    from ..analysis.canon import canon_epochs
+    from .sparse_orswot import _law_canon as _canon_leaf
+
+    kcl, kidx, kdvalid = canon_epochs(s.kcl, s.kidx, s.kdvalid, payload_fill=-1)
+    return SparseNestState(
+        core=_canon_leaf(s.core), kcl=kcl, kidx=kidx, kdvalid=kdvalid,
+    )
+
+
+def _law_join(a, b):
+    return level_map_orswot(2).join(a, b)
+
+
+from ..analysis.registry import register_merge  # noqa: E402
+
+register_merge(
+    "sparse_nested_map", module=__name__, join=_law_join,
+    states=_law_states, canon=_law_canon,
+)

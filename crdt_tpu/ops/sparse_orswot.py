@@ -38,7 +38,7 @@ capacity bit-identically to the dense form via ``to_dense``).
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -580,3 +580,83 @@ def nbytes(state: SparseOrswotState) -> int:
     total = sum(x.nbytes for x in jax.tree_util.tree_leaves(state))
     lead = state.eid.shape[:-1]
     return total // (int(np.prod(lead)) if lead else 1)
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _law_ids(*xs, w: int = 4):
+    return jnp.array(list(xs) + [-1] * (w - len(xs)), jnp.int32)
+
+
+def _law_states():
+    """Segment adds, covered removes, and parked (ahead) removes over a
+    small element universe with dot/deferred headroom."""
+    cl = lambda x, y: jnp.array([x, y], DTYPE)
+    e = empty(8, 2, deferred_cap=3, rm_width=4)
+    a1, _ = apply_add(e, 0, jnp.uint32(1), _law_ids(0))
+    a2, _ = apply_add(a1, 0, jnp.uint32(2), _law_ids(1, 2))
+    b1, _ = apply_add(e, 1, jnp.uint32(1), _law_ids(0, 3))
+    ab, _ = join(a2, b1)
+    r1, _ = apply_rm(ab, cl(2, 1), _law_ids(0))     # covered
+    r2, _ = apply_rm(a1, cl(0, 2), _law_ids(1))     # ahead: parks
+    r3, _ = apply_rm(e, cl(1, 1), _law_ids(0, 2))   # ahead on empty
+    return [e, a1, a2, b1, r1, r2, r3]
+
+
+def _law_states_big():
+    """Property-sampled: replicas applying ordered subsets of one shared
+    op history over a 6-element universe, 3 actors."""
+    import numpy as np
+
+    rng = np.random.default_rng(20260803)
+    e_n, a_n = 6, 3
+    mk = lambda: empty(16, a_n, deferred_cap=4, rm_width=6)
+    site = mk()
+    history = []
+    next_ctr = [0] * a_n
+
+    def apply_op(s, op):
+        if op[0] == "add":
+            return apply_add(s, op[1], jnp.uint32(op[2]), op[3])[0]
+        return apply_rm(s, op[1], op[2])[0]
+
+    for _ in range(10):
+        actor = int(rng.integers(a_n))
+        eids = np.flatnonzero(rng.random(e_n) < 0.4)[:6]
+        lst = jnp.asarray(
+            np.pad(eids, (0, 6 - len(eids)), constant_values=-1), jnp.int32
+        )
+        if rng.random() < 0.7 or not history:
+            next_ctr[actor] += 1
+            op = ("add", actor, next_ctr[actor], lst)
+        else:
+            top = np.asarray(site.top).astype(np.uint64)
+            if rng.random() < 0.3:
+                top[actor] += 1  # ahead -> parks
+            op = ("rm", jnp.asarray(top, DTYPE), lst)
+        site = apply_op(site, op)
+        history.append(op)
+    states = [mk()]
+    for _ in range(6):
+        take = rng.random(len(history)) < 0.6
+        s = mk()
+        for keep, op in zip(take, history):
+            if keep:
+                s = apply_op(s, op)
+        states.append(s)
+    return states
+
+
+def _law_canon(s: SparseOrswotState) -> SparseOrswotState:
+    from ..analysis.canon import canon_epochs
+
+    dcl, didx, dvalid = canon_epochs(s.dcl, s.didx, s.dvalid, payload_fill=-1)
+    return s._replace(dcl=dcl, didx=didx, dvalid=dvalid)
+
+
+from ..analysis.registry import register_merge  # noqa: E402
+
+register_merge(
+    "sparse_orswot", module=__name__, join=join, states=_law_states,
+    canon=_law_canon, big_states=_law_states_big,
+)

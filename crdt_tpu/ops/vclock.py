@@ -120,3 +120,19 @@ def pairwise_merge_matrix(clocks: jax.Array) -> jax.Array:
     return jax.vmap(lambda a: jax.vmap(lambda b: jnp.maximum(a, b))(clocks))(
         clocks
     )
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _law_states():
+    """Exhaustive: 2-actor clocks with counters in {0, 1, 2} (identity
+    first)."""
+    return [
+        jnp.array([i, j], counter_dtype())
+        for i in range(3) for j in range(3)
+    ]
+
+
+from ..analysis.registry import register_merge  # noqa: E402
+
+register_merge("vclock", module=__name__, join=merge, states=_law_states)

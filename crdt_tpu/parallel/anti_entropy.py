@@ -1090,3 +1090,103 @@ def mesh_gossip_map3(
         slots_fn=lambda a, b: ops.changed_members(a.mo.core, b.mo.core),
         donate=donate,
     )
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+#
+# Every mesh entry point defined here registers its jit-cache kind, an
+# example-args builder (an R == P batch of join identities, in the
+# shared gate geometry of crdt_tpu.analysis.gate_states) and an
+# invoker. tools/check_aliasing.py and crdt_tpu.analysis.jit_lint
+# iterate this registry, and a public ``mesh_*`` entry that forgets to
+# register fails discovery (tests/test_analysis.py).
+
+from ..analysis import gate_states as _gs  # noqa: E402
+from ..analysis.registry import register_entry_point as _reg_ep  # noqa: E402
+
+
+def _reg(name, kind, mk, call, n_donated):
+    _reg_ep(
+        name, kind=kind,
+        make_args=lambda mesh: (mk(_gs.replicas(mesh)),),
+        invoke=lambda mesh, args: call(args[0], mesh),
+        n_donated=n_donated,
+    )
+
+
+def _reg_gossip(name, kind, mk, call):
+    _reg(name, kind, mk, call, n_donated=1)
+
+
+def _reg_fold(name, kind, mk, call):
+    _reg(name, kind, mk, call, n_donated=0)
+
+
+_reg_gossip(
+    "mesh_gossip", "orswot_gossip", _gs.mk_dense,
+    lambda s, mesh: mesh_gossip(s, mesh, local_fold="tree", donate=True),
+)
+_reg_gossip(
+    "mesh_gossip_map", "map_gossip", _gs.mk_map,
+    lambda s, mesh: mesh_gossip_map(s, mesh, donate=True),
+)
+_reg_gossip(
+    "mesh_gossip_map_orswot", "map_orswot_gossip", _gs.mk_map_orswot,
+    lambda s, mesh: mesh_gossip_map_orswot(s, mesh, donate=True),
+)
+_reg_gossip(
+    "mesh_gossip_nested_map", "nested_map_gossip", _gs.mk_nested_map,
+    lambda s, mesh: mesh_gossip_nested_map(s, mesh, donate=True),
+)
+_reg_gossip(
+    "mesh_gossip_map3", "map3_gossip", _gs.mk_map3,
+    lambda s, mesh: mesh_gossip_map3(s, mesh, donate=True),
+)
+_reg_gossip(
+    "mesh_gossip_sparse", "sparse_gossip", _gs.mk_sparse,
+    lambda s, mesh: mesh_gossip_sparse(s, mesh, donate=True),
+)
+_reg_gossip(
+    "mesh_gossip_sparse_mvmap", "sparse_mvmap_gossip_s4", _gs.mk_sparse_mvmap,
+    lambda s, mesh: mesh_gossip_sparse_mvmap(s, mesh, donate=True),
+)
+_reg_gossip(
+    "mesh_gossip_sparse_nested", f"sparse_nested_gossip_{_gs.GM}_s0",
+    _gs.mk_sparse_nested,
+    lambda s, mesh: mesh_gossip_sparse_nested(
+        s, mesh, _gs.sparse_nested_level(), donate=True
+    ),
+)
+
+_reg_fold(
+    "mesh_fold", "orswot_fold", _gs.mk_dense,
+    lambda s, mesh: mesh_fold(s, mesh, local_fold="tree"),
+)
+_reg_fold("mesh_fold_map", "map_fold", _gs.mk_map, mesh_fold_map)
+_reg_fold(
+    "mesh_fold_map_orswot", "map_orswot_fold", _gs.mk_map_orswot,
+    mesh_fold_map_orswot,
+)
+_reg_fold(
+    "mesh_fold_nested_map", "nested_map_fold", _gs.mk_nested_map,
+    mesh_fold_nested_map,
+)
+_reg_fold("mesh_fold_map3", "map3_fold", _gs.mk_map3, mesh_fold_map3)
+_reg_fold("mesh_fold_gset", "gset_fold", _gs.mk_gset, mesh_fold_gset)
+_reg_fold("mesh_fold_lww", "lww_fold", _gs.mk_lww, mesh_fold_lww)
+_reg_fold("mesh_fold_mvreg", "mvreg_fold", _gs.mk_mvreg, mesh_fold_mvreg)
+_reg_fold(
+    "mesh_fold_sparse", "sparse_orswot_fold", _gs.mk_sparse, mesh_fold_sparse
+)
+_reg_fold(
+    "mesh_fold_sparse_mvmap", "sparse_mvmap_fold_s4", _gs.mk_sparse_mvmap,
+    mesh_fold_sparse_mvmap,
+)
+_reg_fold(
+    "mesh_fold_sparse_nested", f"sparse_nested_fold_{_gs.GM}_s0",
+    _gs.mk_sparse_nested,
+    lambda s, mesh: mesh_fold_sparse_nested(
+        s, mesh, _gs.sparse_nested_level()
+    ),
+)
+_reg_fold("mesh_fold_clocks", "clock_fold", _gs.mk_clocks, mesh_fold_clocks)

@@ -334,3 +334,45 @@ def mesh_delta_gossip(
         telemetry=telemetry, slots_fn=changed_members,
         pipeline=pipeline, digest=digest, gate=gate_delta, donate=donate,
     )
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _reg_delta_ep(name, kind, mk_state, n_rows, call):
+    """Register a δ-ring entry: (state, dirty, fctx) example args with
+    R == P identity batches in the shared gate geometry
+    (crdt_tpu.analysis.gate_states — fctx actor lanes = gate_states.GA,
+    dtype following the state's clock lanes)."""
+    from ..analysis import gate_states as gs
+    from ..analysis.registry import register_entry_point
+
+    def make_args(mesh):
+        p = gs.replicas(mesh)
+        state = mk_state(p)
+        dirty = jnp.zeros((p, n_rows), bool)
+        # fctx rides the state's clock dtype (the leading leaf is the
+        # top clock for every flavor) so a counter_dtype="uint64"
+        # config gates the same program production runs.
+        clock_dtype = jax.tree.leaves(state)[0].dtype
+        fctx = jnp.zeros((p, n_rows, gs.GA), clock_dtype)
+        return state, dirty, fctx
+
+    register_entry_point(
+        name, kind=kind, make_args=make_args,
+        invoke=lambda mesh, args: call(*args, mesh),
+        n_donated=2,
+    )
+
+
+def _register():
+    from ..analysis import gate_states as gs
+
+    _reg_delta_ep(
+        "mesh_delta_gossip", "delta_gossip", gs.mk_dense, gs.GE,
+        lambda s, d, f, mesh: mesh_delta_gossip(
+            s, d, f, mesh, local_fold="tree", donate=True
+        ),
+    )
+
+
+_register()

@@ -333,3 +333,20 @@ def mesh_delta_gossip_map(
         pipeline=pipeline, digest=digest, gate=gate_delta_map,
         donate=donate,
     )
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _register():
+    from ..analysis import gate_states as gs
+    from .delta import _reg_delta_ep
+
+    _reg_delta_ep(
+        "mesh_delta_gossip_map", "map_delta_gossip", gs.mk_map, gs.GE,
+        lambda s, d, f, mesh: mesh_delta_gossip_map(
+            s, d, f, mesh, donate=True
+        ),
+    )
+
+
+_register()

@@ -94,3 +94,21 @@ def mesh_delta_gossip_map3(
         pipeline=pipeline, digest=digest, gate=gate_delta_m3,
         donate=donate,
     )
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _register():
+    from ..analysis import gate_states as gs
+    from .delta import _reg_delta_ep
+
+    _reg_delta_ep(
+        "mesh_delta_gossip_map3", "map3_delta_gossip",
+        gs.mk_map3, gs.GK1 * gs.GK2 * gs.GM,
+        lambda s, d, f, mesh: mesh_delta_gossip_map3(
+            s, d, f, mesh, donate=True
+        ),
+    )
+
+
+_register()

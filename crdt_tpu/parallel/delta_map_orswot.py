@@ -108,3 +108,21 @@ def mesh_delta_gossip_map_orswot(
         pipeline=pipeline, digest=digest, gate=gate_delta_mo,
         donate=donate,
     )
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _register():
+    from ..analysis import gate_states as gs
+    from .delta import _reg_delta_ep
+
+    _reg_delta_ep(
+        "mesh_delta_gossip_map_orswot", "map_orswot_delta_gossip",
+        gs.mk_map_orswot, gs.GK1 * gs.GM,
+        lambda s, d, f, mesh: mesh_delta_gossip_map_orswot(
+            s, d, f, mesh, donate=True
+        ),
+    )
+
+
+_register()

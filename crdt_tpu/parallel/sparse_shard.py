@@ -37,7 +37,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import sparse_nest as nest
 from ..ops import sparse_orswot as sp
@@ -313,3 +313,44 @@ def _lattice_allreduce(local, join_fn, fold_fn):
     from .collectives import all_reduce_lattice
 
     return all_reduce_lattice(local, REPLICA_AXIS, join_fn, fold_fn)
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _register():
+    from ..analysis import gate_states as gs
+    from ..analysis.registry import register_entry_point
+
+    def shards(mesh):
+        return mesh.shape[ELEMENT_AXIS]
+
+    def reg(name, kind, make_args, invoke):
+        register_entry_point(
+            name, kind=kind, make_args=make_args, invoke=invoke, n_donated=0
+        )
+
+    reg(
+        "mesh_fold_sparse_sharded", "sparse_sharded_fold",
+        lambda mesh: (split_segments(gs.mk_sparse(gs.replicas(mesh)), shards(mesh)),),
+        lambda mesh, args: mesh_fold_sparse_sharded(args[0], mesh),
+    )
+    reg(
+        "mesh_fold_sparse_mvmap_sharded", "sparse_mvmap_sharded_fold_s4",
+        lambda mesh: (split_cells(gs.mk_sparse_mvmap(gs.replicas(mesh)), shards(mesh)),),
+        lambda mesh, args: mesh_fold_sparse_mvmap_sharded(args[0], mesh),
+    )
+    reg(
+        "mesh_fold_sparse_nested_sharded", f"sparse_nested_sharded_{gs.GM}_s0",
+        lambda mesh: (split_nested(gs.mk_sparse_nested(gs.replicas(mesh)), shards(mesh)),),
+        lambda mesh, args: mesh_fold_sparse_nested_sharded(
+            args[0], mesh, nest.level_map_orswot(gs.GM)
+        ),
+    )
+    reg(
+        "mesh_fold_sparse_map", "sparse_map_fold",
+        lambda mesh: (split_nested(gs.mk_sparse_nested(gs.replicas(mesh)), shards(mesh)),),
+        lambda mesh, args: mesh_fold_sparse_map(args[0], mesh, span=gs.GM),
+    )
+
+
+_register()

@@ -25,7 +25,6 @@ import base64
 import json
 from typing import Any
 
-from .ctx import AddCtx, ReadCtx, RmCtx
 from .dot import Dot, OrdDot
 from .pure.gcounter import GCounter
 from .pure.glist import GList

@@ -362,9 +362,20 @@ def span(name: str, **attrs):
         })
 
 
+def reset_residue_warnings() -> None:
+    """Re-arm the δ-ring's once-per-kind residue warning (the dedupe
+    lives in parallel.delta_ring; re-exported here because tests and
+    operators reach for it next to the telemetry registry — see
+    tests/test_residue_warnings.py)."""
+    from .parallel.delta_ring import reset_residue_warnings as _reset
+
+    _reset()
+
+
 __all__ = [
     "Telemetry", "combine", "configure_tracing", "device_depth",
     "device_pressure", "drain_events", "generic_slots_changed",
-    "is_concrete", "packet_useful_bytes", "record", "shipped_bytes",
+    "is_concrete", "packet_useful_bytes", "record",
+    "reset_residue_warnings", "shipped_bytes",
     "span", "specs", "to_dict", "zeros",
 ]

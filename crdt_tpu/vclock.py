@@ -13,7 +13,7 @@ implementation under the property suite in tests/.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterator, Optional, Tuple
+from typing import Any, Dict, Iterator, Optional
 
 from .dot import Dot
 from .traits import CmRDT, CvRDT, ResetRemove

@@ -10,7 +10,7 @@ random.
 from __future__ import annotations
 
 import random
-from typing import Any, Callable, List, Sequence, Tuple
+from typing import Any, Callable, List, Sequence
 
 from hypothesis import strategies as st
 

@@ -10,7 +10,6 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax.numpy as jnp
 
 import bench
 from crdt_tpu.native import DELETE, INSERT
@@ -82,8 +81,6 @@ def test_deferred_depth_counts_all_buffer_levels():
 
 
 def test_anti_entropy_records_depth_and_merges():
-    import jax
-    from jax.sharding import Mesh
 
     from crdt_tpu.models import BatchedOrswot
     from crdt_tpu.parallel.anti_entropy import mesh_fold

@@ -3,7 +3,7 @@ replica set merges back in — the CRDT recovery story."""
 
 import random
 
-from crdt_tpu import Map, MVReg, Orswot
+from crdt_tpu import Orswot
 from crdt_tpu.checkpoint import load, save
 from crdt_tpu.models import BatchedMap, BatchedOrswot
 from crdt_tpu.utils import Interner
@@ -99,7 +99,6 @@ def test_nested_models_checkpoint_round_trip(tmp_path):
     import random
 
     from crdt_tpu.checkpoint import load, save
-    from crdt_tpu.models import BatchedMapOrswot, BatchedNestedMap
 
     rng = random.Random(9)
     mo = _batched(_site_run_set(rng, n_cmds=14))

@@ -7,7 +7,6 @@ import random
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from crdt_tpu.models import BatchedMapOrswot

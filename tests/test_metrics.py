@@ -9,7 +9,6 @@ import threading
 
 import jax
 import jax.numpy as jnp
-import pytest
 
 from crdt_tpu.ops import map_map as mm_ops
 from crdt_tpu.ops import orswot as ops

@@ -6,7 +6,6 @@ import random
 import pytest
 from hypothesis import given, settings
 
-from crdt_tpu import Map, MVReg, VClock
 from crdt_tpu.models import BatchedMap
 from crdt_tpu.utils import Interner
 

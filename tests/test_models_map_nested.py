@@ -7,7 +7,7 @@ import random
 import pytest
 from hypothesis import given, settings
 
-from crdt_tpu import Map, Orswot, VClock
+from crdt_tpu import VClock
 from crdt_tpu.ctx import RmCtx
 from crdt_tpu.models import BatchedMapOrswot
 from crdt_tpu.utils import Interner
@@ -249,7 +249,6 @@ def test_outer_deferred_overflow_raises():
 
 # ---- Map<K1, Map<K2, MVReg>> (BatchedNestedMap) --------------------------
 
-from crdt_tpu import MVReg
 from crdt_tpu.models import BatchedNestedMap
 from test_map import nested_map
 

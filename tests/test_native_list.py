@@ -10,7 +10,6 @@ import random
 
 from hypothesis import given, settings
 
-from crdt_tpu.dot import OrdDot
 from crdt_tpu.models import BatchedList
 from crdt_tpu.native import DELETE, INSERT, ListEngine, native_available
 from crdt_tpu.pure.list import List

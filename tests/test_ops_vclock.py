@@ -40,7 +40,6 @@ def test_compare_matches_partial_cmp(da, db):
 
 @given(clock_dicts, clock_dicts)
 def test_reset_remove_and_glb_and_without(da, db):
-    import jax.numpy as jnp
 
     b = batch(da, db)
     a_pure, b_pure = VClock(da), VClock(db)

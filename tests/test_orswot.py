@@ -5,10 +5,9 @@ import random
 
 import pytest
 from hypothesis import given
-from hypothesis import strategies as st
 
 from crdt_tpu import Dot, Orswot, VClock
-from crdt_tpu.pure.orswot import Add, Rm
+from crdt_tpu.pure.orswot import Add
 from crdt_tpu.traits import DotRange
 
 from strategies import (

@@ -1,0 +1,89 @@
+"""ISSUE-4 satellite: the δ-ring residue warning dedupe.
+
+``parallel.delta_ring._warn_residue`` warns ONCE per kind per process
+(an under-budgeted ring in a loop would otherwise emit one warning per
+round) while every occurrence counts in
+``anti_entropy.<kind>.residue_runs``; ``reset_residue_warnings``
+re-arms the dedupe. This pins the interaction across kinds and the
+``crdt_tpu.telemetry`` re-export.
+"""
+
+import warnings
+
+import jax.numpy as jnp
+import pytest
+
+from crdt_tpu import telemetry
+from crdt_tpu.parallel import delta_ring
+from crdt_tpu.utils.metrics import metrics
+
+
+def _out(residue: int):
+    """A δ-ring result tuple shaped like run_delta_ring's (states,
+    dirty, overflow, residue) — _warn_residue only reads out[3]."""
+    return (None, None, None, jnp.int32(residue))
+
+
+def _runs(kind: str) -> int:
+    return metrics.snapshot()["counters"].get(
+        f"anti_entropy.{kind}.residue_runs", 0
+    )
+
+
+@pytest.fixture(autouse=True)
+def _fresh_dedupe():
+    delta_ring.reset_residue_warnings()
+    yield
+    delta_ring.reset_residue_warnings()
+
+
+def test_warns_once_per_kind_but_counts_every_run():
+    kind = "law_test_kind_a"
+    base = _runs(kind)
+    with pytest.warns(UserWarning, match=kind):
+        delta_ring._warn_residue(kind, _out(3))
+    # Second under-budgeted run: counted, NOT re-warned.
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        delta_ring._warn_residue(kind, _out(5))
+    assert _runs(kind) == base + 2
+
+
+def test_dedupe_is_per_kind_not_global():
+    with pytest.warns(UserWarning, match="law_test_kind_b"):
+        delta_ring._warn_residue("law_test_kind_b", _out(1))
+    # A DIFFERENT kind still gets its own (first) warning.
+    with pytest.warns(UserWarning, match="law_test_kind_c"):
+        delta_ring._warn_residue("law_test_kind_c", _out(1))
+    # And kind b stays deduped.
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        delta_ring._warn_residue("law_test_kind_b", _out(2))
+
+
+def test_zero_residue_neither_warns_nor_counts():
+    kind = "law_test_kind_d"
+    base = _runs(kind)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        delta_ring._warn_residue(kind, _out(0))
+    assert _runs(kind) == base
+
+
+def test_reset_rearms_each_kind():
+    kind = "law_test_kind_e"
+    with pytest.warns(UserWarning):
+        delta_ring._warn_residue(kind, _out(1))
+    delta_ring.reset_residue_warnings()
+    with pytest.warns(UserWarning):
+        delta_ring._warn_residue(kind, _out(1))
+
+
+def test_telemetry_reexport_resets_the_same_state():
+    kind = "law_test_kind_f"
+    with pytest.warns(UserWarning):
+        delta_ring._warn_residue(kind, _out(1))
+    telemetry.reset_residue_warnings()  # the re-export, not the original
+    assert kind not in delta_ring._RESIDUE_WARNED
+    with pytest.warns(UserWarning):
+        delta_ring._warn_residue(kind, _out(1))

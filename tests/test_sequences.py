@@ -6,7 +6,7 @@ import random
 from hypothesis import given
 from hypothesis import strategies as st
 
-from crdt_tpu import GList, Identifier, List, OrdDot
+from crdt_tpu import GList, List, OrdDot
 from crdt_tpu.pure.identifier import between
 
 from strategies import assert_all_equal, interleave, seeds

@@ -9,9 +9,8 @@ import numpy as np
 import pytest
 from hypothesis import given, settings
 
-from crdt_tpu import Map, MVReg, VClock
+from crdt_tpu import VClock
 from crdt_tpu.models import BatchedMap, BatchedSparseMap
-from crdt_tpu.models.orswot import DeferredOverflow
 from crdt_tpu.models.registers import SlotOverflow
 from crdt_tpu.utils import Interner
 

@@ -8,12 +8,10 @@ dense/sparse cross-check and the newly-bottomed-child scrub ordering."""
 import random
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-import pytest
 from hypothesis import given, settings
 
-from crdt_tpu import Map, Orswot, VClock
+from crdt_tpu import Map, VClock
 from crdt_tpu.models import BatchedMapOrswot, BatchedSparseMapOrswot
 from crdt_tpu.utils import Interner
 
@@ -144,7 +142,6 @@ def test_scrub_drops_parked_state_of_newly_bottomed_child():
     # b parks a member-remove inside "p" from a clock it hasn't seen
     # (ahead), so b holds parked state inside child "p".
     ahead = VClock({"alpha": 5})
-    from crdt_tpu.ctx import RmCtx
     from crdt_tpu.pure.orswot import Rm as ORm
 
     rm_inner = b.update(

@@ -8,9 +8,7 @@ import random
 import pytest
 from hypothesis import given, settings
 
-from crdt_tpu import Map, MVReg
 from crdt_tpu.models import BatchedNestedMap, BatchedSparseNestedMap
-from crdt_tpu.models.orswot import DeferredOverflow
 from crdt_tpu.utils import Interner
 
 from strategies import ACTORS, seeds

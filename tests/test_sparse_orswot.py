@@ -319,8 +319,6 @@ def test_sparse_model_equal_clock_slots_union_in_to_pure():
     oracle entry (review r4 regression)."""
     from crdt_tpu.models import BatchedSparseOrswot
     from crdt_tpu.pure.orswot import Orswot
-    from crdt_tpu.vclock import VClock
-    from crdt_tpu.ctx import RmCtx
     from crdt_tpu.pure.orswot import Rm as ORm
 
     minter = Orswot()
@@ -351,7 +349,6 @@ def test_sparse_model_wide_add_not_capped_by_rm_width():
     op = site.add_all(members, site.read().derive_add_ctx("a")) if hasattr(site, "add_all") else None
     if op is None:
         from crdt_tpu.pure.orswot import Add
-        from crdt_tpu.dot import Dot
 
         ctx = site.read().derive_add_ctx("a")
         op = Add(dot=ctx.dot, members=members)

@@ -10,7 +10,6 @@ import random
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from hypothesis import given, settings
 
 from crdt_tpu.models import BatchedSparseMapOrswot, BatchedSparseOrswot
@@ -24,7 +23,7 @@ from crdt_tpu.parallel import (
 from crdt_tpu.ops import sparse_orswot as sp_ops
 from crdt_tpu.pure.orswot import Orswot
 
-from strategies import ACTORS, seeds
+from strategies import seeds
 from test_sparse_nest import _batched as _nest_batched, _site_run_set
 
 
@@ -174,7 +173,6 @@ def test_cross_shard_key_liveness_keeps_parked_state():
     test must see across shards (all-gathered queries, not a positional
     psum) or the parked entry is wrongly dropped and the removed member
     resurrects."""
-    from crdt_tpu.pure.map import Map
     from crdt_tpu.vclock import VClock
     from test_sparse_nest import _batched as _nest_batched, set_map
 

@@ -4,7 +4,6 @@ live editing, src/glist.rs; SURVEY.md §4.5 / BASELINE config 5)."""
 import random
 
 import numpy as np
-import pytest
 from hypothesis import given, settings
 
 from crdt_tpu.models import BatchedGList, BatchedList

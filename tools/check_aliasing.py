@@ -11,10 +11,17 @@ outputs onto the input buffers. That property is easy to lose silently
 falls back to copying (with nothing but a warning at trace time). This
 gate fails CI instead.
 
-For every covered entry point it builds a minimal R == P replica batch
-(join identities — aliasing is a property of shapes and shardings, not
-content), runs the entry once with ``donate=True``, then checks BOTH
-halves of the contract on the memoised jit:
+Coverage is REGISTRY-DRIVEN (crdt_tpu.analysis.registry): every mesh
+entry point self-registers its cache kind, example-args builder, and
+donation arity next to its definition, so a newly added entry point is
+picked up here automatically — and a public ``mesh_*`` symbol that
+forgot to register is itself a FAILURE row (discovery), not a silent
+coverage gap. (Before PR 4 this file hardcoded an 11-entry list.)
+
+For every registered donating entry point it builds a minimal R == P
+replica batch (join identities — aliasing is a property of shapes and
+shardings, not content), runs the entry once with ``donate=True``, then
+checks BOTH halves of the contract on the memoised jit:
 
 - the StableHLO lowering marks every expected donated input
   (``tf.aliasing_output`` when jax resolves the alias itself,
@@ -40,11 +47,6 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-# Shapes: tiny, E divisible by the element axis, R == P so the ring
-# outputs alias (anti_entropy._ring_donate_argnums).
-E, A, D = 8, 4, 4
-K1, K2, M = 4, 2, 2
-
 
 def _mesh():
     import jax
@@ -56,168 +58,69 @@ def _mesh():
     return make_mesh(p, n // p if p else 1)
 
 
-def _cases(mesh):
-    """(kind, run) per donated entry point; run() must execute the
-    entry with donate=True on a fresh R == P batch and return the args
-    to re-lower the memoised jit with."""
-    import jax.numpy as jnp
-
-    from crdt_tpu.ops import map as map_ops
-    from crdt_tpu.ops import map3 as m3_ops
-    from crdt_tpu.ops import map_map as mm_ops
-    from crdt_tpu.ops import map_orswot as mo_ops
-    from crdt_tpu.ops import orswot as ops
-    from crdt_tpu.ops import sparse_mvmap as smv
-    from crdt_tpu.ops import sparse_orswot as sp
-    from crdt_tpu import parallel as par
-    from crdt_tpu.parallel.mesh import REPLICA_AXIS
-
-    p = mesh.shape[REPLICA_AXIS]
-
-    def dense():
-        return ops.empty(E, A, D, batch=(p,))
-
-    def delta_args(state, e):
-        dirty = jnp.zeros((p, e), bool)
-        fctx = jnp.zeros((p, e, A), state.top.dtype if hasattr(state, "top")
-                         else jnp.uint32)
-        return dirty, fctx
-
-    def case_gossip():
-        s = dense()
-        par.mesh_gossip(s, mesh, local_fold="tree", donate=True)
-        return (dense(),)
-
-    def case_gossip_map():
-        mk = lambda: map_ops.empty(E, A, 2, D, batch=(p,))
-        par.mesh_gossip_map(mk(), mesh, donate=True)
-        return (mk(),)
-
-    def case_gossip_mo():
-        mk = lambda: mo_ops.empty(K1, M, A, D, batch=(p,))
-        par.mesh_gossip_map_orswot(mk(), mesh, donate=True)
-        return (mk(),)
-
-    def case_gossip_nested():
-        mk = lambda: mm_ops.empty(K1, K2, A, 2, D, batch=(p,))
-        par.mesh_gossip_nested_map(mk(), mesh, donate=True)
-        return (mk(),)
-
-    def case_gossip_map3():
-        mk = lambda: m3_ops.empty(K1, K2, M, A, D, batch=(p,))
-        par.mesh_gossip_map3(mk(), mesh, donate=True)
-        return (mk(),)
-
-    def case_gossip_sparse():
-        mk = lambda: sp.empty(E, A, D, 8, batch=(p,))
-        par.mesh_gossip_sparse(mk(), mesh, donate=True)
-        return (mk(),)
-
-    def case_gossip_smv():
-        mk = lambda: smv.empty(E, A, D, 8, batch=(p,))
-        par.mesh_gossip_sparse_mvmap(mk(), mesh, donate=True)
-        return (mk(),)
-
-    def case_delta():
-        s = dense()
-        d, f = delta_args(s, E)
-        par.mesh_delta_gossip(s, d, f, mesh, local_fold="tree", donate=True)
-        s = dense()
-        return (s, *delta_args(s, E))
-
-    def case_delta_map():
-        mk = lambda: map_ops.empty(E, A, 2, D, batch=(p,))
-        s = mk()
-        d, f = delta_args(s, E)
-        par.mesh_delta_gossip_map(s, d, f, mesh, donate=True)
-        s = mk()
-        return (s, *delta_args(s, E))
-
-    def case_delta_mo():
-        mk = lambda: mo_ops.empty(K1, M, A, D, batch=(p,))
-        s = mk()
-        d, f = delta_args(s, K1 * M)
-        par.mesh_delta_gossip_map_orswot(s, d, f, mesh, donate=True)
-        s = mk()
-        return (s, *delta_args(s, K1 * M))
-
-    def case_delta_m3():
-        mk = lambda: m3_ops.empty(K1, K2, M, A, D, batch=(p,))
-        s = mk()
-        d, f = delta_args(s, K1 * K2 * M)
-        par.mesh_delta_gossip_map3(s, d, f, mesh, donate=True)
-        s = mk()
-        return (s, *delta_args(s, K1 * K2 * M))
-
-    return [
-        ("orswot_gossip", case_gossip, 1),
-        ("map_gossip", case_gossip_map, 1),
-        ("map_orswot_gossip", case_gossip_mo, 1),
-        ("nested_map_gossip", case_gossip_nested, 1),
-        ("map3_gossip", case_gossip_map3, 1),
-        ("sparse_gossip", case_gossip_sparse, 1),
-        ("sparse_mvmap_gossip_s4", case_gossip_smv, 1),
-        ("delta_gossip", case_delta, 2),
-        ("map_delta_gossip", case_delta_map, 2),
-        ("map_orswot_delta_gossip", case_delta_mo, 2),
-        ("map3_delta_gossip", case_delta_m3, 2),
-    ]
-
-
 def _donating_fn(kind: str, n_donated: int):
-    """The memoised donating jit for ``kind`` (anti_entropy._FN_CACHE;
-    donate_argnums is the 4th key element by construction)."""
-    from crdt_tpu.parallel import anti_entropy as ae
+    """The memoised donating jit for ``kind`` — ONE home for the cache
+    key layout assumption (crdt_tpu.analysis.jit_lint)."""
+    from crdt_tpu.analysis.jit_lint import _cached_entry_fn
 
-    hits = [
-        fn for key, fn in ae._FN_CACHE.items()
-        if key[0] == kind and key[3] == tuple(range(n_donated))
-    ]
-    return hits[-1] if hits else None
+    return _cached_entry_fn(kind, n_donated)
 
 
 def check_all():
-    """Run every case; returns [(kind, ok, detail)]."""
+    """Run every registered donating entry point; returns
+    [(kind, ok, detail)]. Unregistered-but-public mesh entry points are
+    failure rows too."""
     import jax
+
+    from crdt_tpu.analysis.registry import (
+        entry_points,
+        unregistered_entry_points,
+    )
 
     mesh = _mesh()
     results = []
-    for kind, run, n_donated in _cases(mesh):
+    for name in unregistered_entry_points():
+        results.append(
+            (name, False, "public mesh entry point not registered with "
+             "crdt_tpu.analysis.registry — the gate cannot cover it")
+        )
+    for ep in entry_points(donatable=True):
         try:
-            args = run()
-            fn = _donating_fn(kind, n_donated)
+            ep.invoke(mesh, ep.make_args(mesh))
+            args = ep.make_args(mesh)
+            fn = _donating_fn(ep.kind, ep.n_donated)
             if fn is None:
                 results.append(
-                    (kind, False, "no donating jit cached — donation "
+                    (ep.kind, False, "no donating jit cached — donation "
                      "was dropped before lowering")
                 )
                 continue
             low = fn.lower(*args)
             txt = low.as_text()
             n_leaves = sum(
-                len(jax.tree.leaves(args[i])) for i in range(n_donated)
+                len(jax.tree.leaves(args[i])) for i in range(ep.n_donated)
             )
             marked = txt.count("tf.aliasing_output") + txt.count(
                 "jax.buffer_donor"
             )
             if marked < n_leaves:
                 results.append(
-                    (kind, False,
+                    (ep.kind, False,
                      f"lowering marks {marked}/{n_leaves} donated leaves")
                 )
                 continue
             compiled = low.compile().as_text()
             if "input_output_alias" not in compiled:
                 results.append(
-                    (kind, False,
+                    (ep.kind, False,
                      "compiled HLO has no input_output_alias — XLA "
                      "dropped the donation (output no longer matches "
                      "the input layout?)")
                 )
                 continue
-            results.append((kind, True, f"{marked} donated leaves alias"))
+            results.append((ep.kind, True, f"{marked} donated leaves alias"))
         except Exception as exc:  # a broken case is a failed gate, loudly
-            results.append((kind, False, f"{type(exc).__name__}: {exc}"))
+            results.append((ep.kind, False, f"{type(exc).__name__}: {exc}"))
     return results
 
 

@@ -25,7 +25,7 @@ import json
 import math
 import os
 import sys
-from typing import Any, Dict, List
+from typing import Any, List
 
 SCHEMA_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "telemetry_schema.json")

@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""THE static-check suite — one fast tier-1 command chaining every gate.
+
+Sections (each timed, each independently skippable):
+
+- ``lint``     — ``ruff check .`` against the committed ``ruff.toml``
+  when a ruff binary/module exists; otherwise the built-in fallback
+  linter (F401 unused imports, E722 bare except, E999 syntax errors —
+  the highest-signal subset, honoring ``# noqa``) so the gate never
+  silently vanishes on images without ruff.
+- ``schema``   — the telemetry export contract
+  (tools/check_telemetry_schema.py) against a live registry snapshot.
+- ``laws``     — the lattice-law engine (crdt_tpu.analysis.laws) over
+  every registered merge kind: commutativity / associativity /
+  idempotence / identity / δ-inflation, bit-exact on canonical forms.
+- ``jit-lint`` — the jaxpr walker (crdt_tpu.analysis.jit_lint) over
+  every registered mesh entry point: traced-branch, unstable-sort,
+  float-accum, dtype-overflow, donation-alias — plus registry
+  discovery (an unregistered public ``mesh_*`` entry is a failure).
+- ``aliasing`` — the compiled-HLO input_output_alias gate
+  (tools/check_aliasing.py) over every registered donating entry.
+
+CLI::
+
+    python tools/run_static_checks.py              # everything, rc != 0 on any error
+    python tools/run_static_checks.py --only laws,jit-lint
+    python tools/run_static_checks.py --skip lint
+
+The jax-heavy sections share one process (and the repo's persistent XLA
+compilation cache at .jax_cache/), so a warm run of the whole suite
+stays under the 60 s budget in ISSUE 4's acceptance criteria.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import subprocess
+import sys
+import time
+from typing import List, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+SECTIONS = ("lint", "schema", "laws", "jit-lint", "aliasing")
+
+# Directories the fallback linter walks (ruff takes its own config).
+LINT_TARGETS = ("crdt_tpu", "tools", "tests", "examples", "bench.py")
+
+
+# ---- section: lint -------------------------------------------------------
+
+def _noqa_lines(src: str) -> dict:
+    """line number -> set of noqa'd codes ('*' = bare noqa). Codes may
+    be followed by free-text commentary (``# noqa: F401  (reason)``)."""
+    import re
+
+    out = {}
+    for i, line in enumerate(src.splitlines(), 1):
+        if "# noqa" not in line:
+            continue
+        tail = line.split("# noqa", 1)[1]
+        codes = set(re.findall(r"[A-Z]+[0-9]+", tail)) if (
+            tail.lstrip().startswith(":")
+        ) else set()
+        out[i] = codes or {"*"}
+    return out
+
+
+def _mini_lint_file(path: str) -> List[str]:
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as exc:
+        return [f"{path}:{exc.lineno}: E999 {exc.msg}"]
+    noqa = _noqa_lines(src)
+
+    def quiet(lineno: int, code: str) -> bool:
+        codes = noqa.get(lineno, ())
+        return "*" in codes or code in codes
+
+    errs: List[str] = []
+    imports: List[Tuple[str, int]] = []
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for al in node.names:
+                imports.append(
+                    (al.asname or al.name.split(".")[0], node.lineno)
+                )
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for al in node.names:
+                if al.name == "*":
+                    continue
+                imports.append((al.asname or al.name, node.lineno))
+        elif isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.ExceptHandler) and node.type is None:
+            if not quiet(node.lineno, "E722"):
+                errs.append(f"{path}:{node.lineno}: E722 bare except")
+    # Names exported via __all__ count as used.
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(getattr(t, "id", "") == "__all__"
+                        for t in node.targets)):
+            for c in ast.walk(node.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    used.add(c.value)
+    if os.path.basename(path) != "__init__.py":  # __init__ = re-export surface
+        for name, lineno in imports:
+            if name not in used and not quiet(lineno, "F401"):
+                errs.append(f"{path}:{lineno}: F401 unused import '{name}'")
+    return errs
+
+
+def mini_lint(targets=LINT_TARGETS) -> List[str]:
+    errs: List[str] = []
+    for target in targets:
+        target = os.path.join(ROOT, target)
+        if os.path.isfile(target):
+            errs += _mini_lint_file(target)
+            continue
+        for dirpath, dirnames, filenames in os.walk(target):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    errs += _mini_lint_file(os.path.join(dirpath, fn))
+    return errs
+
+
+def _ruff_cmd():
+    import shutil
+
+    if shutil.which("ruff"):
+        return ["ruff"]
+    try:
+        import ruff  # noqa: F401
+
+        return [sys.executable, "-m", "ruff"]
+    except ImportError:
+        return None
+
+
+def run_lint() -> List[str]:
+    cmd = _ruff_cmd()
+    if cmd is not None:
+        proc = subprocess.run(
+            cmd + ["check", ROOT], capture_output=True, text=True
+        )
+        if proc.returncode == 0:
+            return []
+        return (proc.stdout + proc.stderr).strip().splitlines()
+    return [f"(ruff unavailable — built-in F401/E722/E999 subset) {e}"
+            for e in mini_lint()] or []
+
+
+# ---- section: schema -----------------------------------------------------
+
+def run_schema() -> List[str]:
+    from crdt_tpu.utils.metrics import metrics
+
+    from check_telemetry_schema import validate_snapshot
+
+    metrics.count("static_checks.runs")
+    metrics.observe("static_checks.heartbeat", 1.0)
+    return validate_snapshot(metrics.snapshot())
+
+
+# ---- section: laws / jit-lint / aliasing ---------------------------------
+
+def run_laws() -> List[str]:
+    from crdt_tpu.analysis import laws
+    from crdt_tpu.analysis.report import errors
+
+    return [str(f) for f in errors(laws.check_all())]
+
+
+def run_jit_lint() -> List[str]:
+    from crdt_tpu.analysis.jit_lint import lint_entry_points
+    from crdt_tpu.analysis.report import errors
+
+    return [str(f) for f in errors(lint_entry_points())]
+
+
+def run_aliasing() -> List[str]:
+    import check_aliasing
+
+    return [
+        f"{kind}: {detail}"
+        for kind, ok, detail in check_aliasing.check_all()
+        if not ok
+    ]
+
+
+RUNNERS = {
+    "lint": run_lint,
+    "schema": run_schema,
+    "laws": run_laws,
+    "jit-lint": run_jit_lint,
+    "aliasing": run_aliasing,
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--only", default="", help="comma-separated sections")
+    ap.add_argument("--skip", default="", help="comma-separated sections")
+    args = ap.parse_args(argv)
+
+    only = {s for s in args.only.split(",") if s}
+    skip = {s for s in args.skip.split(",") if s}
+    unknown = (only | skip) - set(SECTIONS)
+    if unknown:
+        ap.error(f"unknown sections {sorted(unknown)}; know {SECTIONS}")
+    chosen = [
+        s for s in SECTIONS
+        if (not only or s in only) and s not in skip
+    ]
+
+    if any(s in chosen for s in ("laws", "jit-lint", "aliasing")):
+        # One CPU pin + one persistent compile cache for every jax
+        # section (mirrors tests/conftest.py) — this is what keeps the
+        # warm full suite inside the 60 s budget.
+        if ("XLA_FLAGS" not in os.environ
+                and "JAX_PLATFORMS" not in os.environ):
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ["XLA_FLAGS"] = (
+                "--xla_force_host_platform_device_count=8"
+            )
+        os.environ.setdefault(
+            "JAX_COMPILATION_CACHE_DIR", os.path.join(ROOT, ".jax_cache")
+        )
+        os.environ.setdefault(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2"
+        )
+
+    rc = 0
+    t_all = time.perf_counter()
+    for section in chosen:
+        t0 = time.perf_counter()
+        try:
+            errs = RUNNERS[section]()
+        except Exception as exc:  # a crashed section is a failed gate
+            errs = [f"section crashed: {type(exc).__name__}: {exc}"]
+        dt = time.perf_counter() - t0
+        status = "PASS" if not errs else "FAIL"
+        print(f"{status} {section:<10} ({dt:5.1f}s)")
+        for e in errs:
+            print(f"     {e}")
+        if errs:
+            rc = 1
+    print(f"{'OK' if rc == 0 else 'FAILED'} static checks "
+          f"({time.perf_counter() - t_all:.1f}s)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
