@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import MergeKind
+from .registry import Compactor, MergeKind
 
 # ---- broken merges (law-engine fixtures) ---------------------------------
 #
@@ -48,6 +48,29 @@ NOT_IDEMPOTENT = MergeKind(
 NOT_ASSOCIATIVE = MergeKind(
     name="fixture_not_associative", join=lambda a, b: (a + b) // 2,
     states=_scalar_states, module=__name__,
+)
+
+
+# ---- broken compactors (compaction-invariance fixtures) ------------------
+
+def _fixture_compact_ok(s, frontier):
+    return s, jnp.zeros((), jnp.uint32), jnp.zeros((), jnp.float32)
+
+
+def _fixture_compact_lossy(s, frontier):
+    """Discards observable state (halves the value) — the read changes,
+    so compact-read-invariance must fire."""
+    return s // 2, jnp.ones((), jnp.uint32), jnp.zeros((), jnp.float32)
+
+
+GOOD_COMPACTOR = Compactor(
+    name="fixture_good_max", compact=_fixture_compact_ok,
+    observe=lambda s: s, module=__name__,
+)
+
+LOSSY_COMPACTOR = Compactor(
+    name="fixture_lossy_max", compact=_fixture_compact_lossy,
+    observe=lambda s: s, module=__name__,
 )
 
 

@@ -102,6 +102,88 @@ def check_all(big: bool = True) -> List[Finding]:
     out: List[Finding] = []
     for kind in merge_kinds():
         out.extend(check_kind(kind, big=big))
+    # The reclaim/ gate rides the same runner section for free
+    # (tools/run_static_checks.py `laws`).
+    out.extend(check_compaction_all())
+    return out
+
+
+# ---- the compaction-invariance law (reclaim/) -----------------------------
+
+def check_compaction_kind(kind: MergeKind, comp=None) -> List[Finding]:
+    """The two halves of the compaction-invariance law over the kind's
+    small domain, bit-exact on observable reads:
+
+    - **read invariance**   ``observe(compact(s)) == observe(s)`` —
+      compaction may discard metadata, never anything a read sees;
+    - **merge commutation** ``observe(compact(a ∨ b)) ==
+      observe(compact(a) ∨ compact(b))`` — replicas may compact
+      independently at any point between gossip rounds without the
+      converged observable state depending on who compacted when.
+
+    The frontier is derived from the domain itself (per-actor min over
+    every seed's top clock — the registered ``top_of``), so every seed
+    is a frontier participant and the ``frontier <= top`` contract
+    holds by construction, exactly as on a live mesh."""
+    from .registry import get_compactor
+
+    if comp is None:
+        try:
+            comp = get_compactor(kind.name)
+        except KeyError:
+            return [Finding(
+                "compact-coverage", kind.name,
+                "merge kind has no registered compactor "
+                "(register_compactor — see registry.py)",
+            )]
+    join = _norm_join(kind.join)
+    seeds = kind.states()
+    frontier = None
+    if comp.top_of is not None:
+        tops = np.stack([np.asarray(comp.top_of(s)) for s in seeds])
+        frontier = jnp.asarray(tops.min(axis=0))
+
+    compact1 = jax.jit(jax.vmap(lambda s: comp.compact(s, frontier)[0]))
+    observe = jax.jit(jax.vmap(comp.observe))
+    findings: List[Finding] = []
+
+    m = len(seeds)
+    S = _stack(seeds)
+    CS = compact1(S)
+
+    def _report(check, got, want, describe):
+        for row, path in _mismatches(got, want):
+            i, j = describe(max(row, 0))
+            pair = f"S{i}" + (f" ∨ S{j}" if j is not None else "")
+            findings.append(Finding(
+                check, kind.name,
+                f"compact({pair}) observable mismatch at leaf {path}",
+            ))
+            break
+
+    _report(
+        "compact-read-invariance", observe(CS), observe(S),
+        lambda r: (int(r), None),
+    )
+
+    _vj = jax.jit(jax.vmap(lambda a, b: join(a, b)[0]))
+    ii, jj = np.meshgrid(np.arange(m), np.arange(m), indexing="ij")
+    ii, jj = ii.ravel(), jj.ravel()
+    A, B = _take(S, ii), _take(S, jj)
+    joined = _vj(A, B)
+    _report(
+        "compact-merge-commute",
+        observe(compact1(joined)),
+        observe(_vj(_take(CS, ii), _take(CS, jj))),
+        lambda r: (int(ii[r]), int(jj[r])),
+    )
+    return findings
+
+
+def check_compaction_all() -> List[Finding]:
+    out: List[Finding] = []
+    for kind in merge_kinds():
+        out.extend(check_compaction_kind(kind))
     return out
 
 

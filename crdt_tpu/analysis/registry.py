@@ -25,6 +25,27 @@ CRDT kind:
         big_states=_law_states_big, # optional: () -> larger sampled domain
     )
 
+**Compactors** — every merge kind additionally registers its
+causal-stability compaction kernel (crdt_tpu/reclaim/): the compact fn,
+the observable-read projection the compaction-invariance law compares,
+and (for clocked kinds) the top-clock accessor the law derives its
+frontier from. Coverage is total by contract — a merge kind without a
+compactor fails tests/test_analysis.py discovery:
+
+    from ..analysis.registry import register_compactor
+
+    register_compactor(
+        "my_kind",
+        compact=compact,        # (state, frontier) ->
+                                #   (state, freed_slots u32, freed_bytes f32)
+        observe=_observe,       # state -> observable-read pytree
+                                #   (canonical: converged replicas compare
+                                #   equal leaf-wise as raw arrays)
+        top_of=lambda s: s.top, # None for clockless kinds (frontier is
+                                #   then None and compact must no-op
+                                #   retirement)
+    )
+
 **Mesh entry points** — every public anti-entropy entry
 (``mesh_gossip*`` / ``mesh_fold*`` / ``mesh_delta_gossip*``) registers
 its jit-cache kind, an example-args builder, an invoker, and how many
@@ -81,8 +102,20 @@ class EntryPoint:
     n_donated: int = 0
 
 
+@dataclass(frozen=True)
+class Compactor:
+    """One registered causal-stability compaction kernel (reclaim/)."""
+
+    name: str
+    compact: Callable[[Any, Any], tuple]  # (state, frontier) -> (state, n, b)
+    observe: Callable[[Any], Any]         # state -> observable read
+    top_of: Optional[Callable[[Any], Any]] = None
+    module: str = ""
+
+
 _MERGE: Dict[str, MergeKind] = {}
 _ENTRY: Dict[str, EntryPoint] = {}
+_COMPACT: Dict[str, Compactor] = {}
 
 # Public callables in crdt_tpu.parallel matching this are mesh entry
 # points and MUST be registered (gossip_elastic/delta_gossip_elastic are
@@ -122,6 +155,40 @@ def register_entry_point(
     )
     _ENTRY[name] = ep
     return ep
+
+
+def register_compactor(
+    name: str,
+    *,
+    compact: Callable,
+    observe: Callable,
+    top_of: Optional[Callable] = None,
+    module: str = "",
+) -> Compactor:
+    comp = Compactor(
+        name=name, compact=compact, observe=observe, top_of=top_of,
+        module=module,
+    )
+    _COMPACT[name] = comp
+    return comp
+
+
+def compactors() -> Tuple[Compactor, ...]:
+    ensure_registered()
+    return tuple(_COMPACT[k] for k in sorted(_COMPACT))
+
+
+def get_compactor(name: str) -> Compactor:
+    ensure_registered()
+    return _COMPACT[name]
+
+
+def uncompactable_kinds() -> List[str]:
+    """Merge kinds without a registered compactor — the reclaim/
+    coverage gap list; non-empty fails tests/test_analysis.py (the same
+    total-coverage contract as joins and mesh entry points)."""
+    ensure_registered()
+    return sorted(set(_MERGE) - set(_COMPACT))
 
 
 def merge_kinds() -> Tuple[MergeKind, ...]:

@@ -137,8 +137,31 @@ def _is_sparse_nested_map(model) -> bool:
     return isinstance(model, BatchedSparseNestedMap)
 
 
-def save(path: Union[str, os.PathLike], model) -> None:
-    """Checkpoint a device model to ``path`` (one .npz file)."""
+def save(path: Union[str, os.PathLike], model, compact: bool = False) -> None:
+    """Checkpoint a device model to ``path`` (one .npz file).
+
+    ``compact=True`` runs causal-stability compaction against the
+    model's OWN replica rows first (``reclaim.compact_model`` — sound
+    because the checkpointed batch is the replica set the frontier is
+    computed over): retired parked slots and stale dead payload never
+    reach disk, and a model shrunk after restore starts from the
+    compacted occupancy. Models outside the compactable family (lists,
+    counters) save as-is with ``reclaim.compact_on_save_unsupported``
+    counted — compact-on-save must never make a checkpoint impossible."""
+    if compact:
+        from . import elastic
+        from .reclaim import compact_model
+        from .utils.metrics import metrics
+
+        # Only the family check may soften to a counter — a TypeError
+        # raised INSIDE a registered compaction kernel is a kernel bug
+        # and must surface, not be miscounted as "unsupported".
+        try:
+            elastic.kind_of(model)
+        except TypeError:
+            metrics.count("reclaim.compact_on_save_unsupported")
+        else:
+            compact_model(model)
     if isinstance(model, BatchedOrswot):
         meta = {
             "kind": "orswot",

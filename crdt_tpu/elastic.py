@@ -29,6 +29,13 @@ widening (VERDICT r5 Weak #6):
   gauges (``elastic.<kind>.headroom.<axis>``) so operators see pressure
   BEFORE overflow; :func:`widen` feeds ``elastic.widen_events`` and
   ``elastic.migrated_bytes`` counters.
+- :func:`shrink` / :class:`Hysteresis` — the INVERSE migration
+  (reclaim/, ISSUE 5): per-kind ``narrow``/``narrow_span`` kernels
+  slice dead tail lanes off (refused when occupancy does not fit),
+  governed by a low-water hysteresis so widen/shrink cannot thrash;
+  feeds ``reclaim.shrink_events`` / ``reclaim.reclaimed_bytes``.
+  Run ``reclaim.compact_model`` first so retired parked slots and
+  stale payload do not pin lanes.
 
 Like lifecycle.py's migrations, widening is ADMINISTRATIVE: apply it
 identically on every host holding the replica set. It commutes with
@@ -64,12 +71,23 @@ CAPACITY_ERRORS = (
 
 @dataclass(frozen=True)
 class ElasticPolicy:
-    """How aggressively to widen. ``factor`` scales each implicated
-    axis (ceil, never less than +1 lane); ``max_migrations`` bounds the
-    overflow→widen→retry loop of :func:`elastic_call`."""
+    """How aggressively to widen — and how cautiously to shrink.
+
+    ``factor`` scales each implicated axis on widen (ceil, never less
+    than +1 lane) and divides it on shrink; ``max_migrations`` bounds
+    the overflow→widen→retry loop of :func:`elastic_call`.
+
+    The shrink half (reclaim/, ISSUE 5) is deliberately hysteretic so
+    widen/shrink cannot thrash: :class:`Hysteresis` shrinks an axis
+    only after its occupancy sat below ``low_water`` for
+    ``shrink_rounds`` CONSECUTIVE observations, never below
+    ``shrink_floor`` lanes, and any widening resets the streak."""
 
     factor: float = 2.0
     max_migrations: int = 4
+    low_water: float = 0.25
+    shrink_rounds: int = 4
+    shrink_floor: int = 8
 
 
 DEFAULT_POLICY = ElasticPolicy()
@@ -288,6 +306,134 @@ def widen(
     return new
 
 
+# ---- the inverse migration (reclaim/, ISSUE 5) ----------------------------
+
+def _shrink_target(cap: int, used: int, policy: ElasticPolicy) -> int:
+    """Where one shrink step lands: one ``factor`` step down, but never
+    below live occupancy or the policy floor."""
+    return max(int(math.ceil(cap / policy.factor)), used, policy.shrink_floor)
+
+
+def _narrowable_axes(model) -> Tuple[str, ...]:
+    """The elastic axes this model's ``narrow_capacity`` accepts —
+    axes it cannot narrow (e.g. the nested kind's ``n_keys1``, whose
+    ids are pinned by packing) are simply not shrink candidates."""
+    import inspect
+
+    try:
+        params = inspect.signature(model.narrow_capacity).parameters
+    except (AttributeError, TypeError, ValueError):
+        return ()
+    return tuple(a for a in capacities(model) if a in params)
+
+
+def shrink(
+    model,
+    axes: Optional[Tuple[str, ...]] = None,
+    policy: ElasticPolicy = DEFAULT_POLICY,
+    **explicit: int,
+) -> Dict[str, int]:
+    """The inverse of :func:`widen` — narrow ``axes`` by one
+    ``policy.factor`` step (or to the ``explicit`` values), re-encoding
+    the live device state in place via the model's ``narrow_capacity``
+    (which REFUSES when occupancy does not fit — compaction first,
+    ``reclaim.compact_model``, frees retired parked slots so they do
+    not pin lanes). Axes already at occupancy/floor are skipped, not
+    errors — steady-state callers ask every round. Returns the new
+    capacities of the axes actually narrowed and feeds
+    ``reclaim.shrink_events`` + ``reclaim.reclaimed_bytes``.
+
+    Like widening, shrinking is ADMINISTRATIVE: apply it identically on
+    every host holding the replica set. It commutes with gossip for the
+    same reason widening does — the narrowed state is bit-identical to
+    a narrower-born model holding the same dots (the tail lanes sliced
+    off were dead), so every later join is the same lattice join."""
+    kind, table = _lookup(model)
+    util = {k: (cap, used()) for k, (cap, used) in table(model).items()}
+    for axis in tuple(axes or ()) + tuple(explicit):
+        if axis not in util:
+            raise ValueError(f"{kind} has no elastic axis {axis!r}")
+    new: Dict[str, int] = {}
+    for axis in axes or ():
+        cap, used = util[axis]
+        target = _shrink_target(cap, used, policy)
+        if target < cap:
+            new[axis] = target
+    for axis, target in explicit.items():
+        cap, used = util[axis]
+        if target > cap:
+            # Same error surface as the ops narrow kernels: an explicit
+            # target is the caller's claim, not a steady-state poll.
+            raise ValueError(
+                f"shrink cannot grow {axis}: {cap} -> {target}"
+            )
+        if target < cap:
+            new[axis] = target  # narrow_capacity enforces occupancy fit
+    if not new:
+        return {}
+    from .telemetry import span
+
+    before = state_nbytes(model.state)
+    with span("elastic.shrink", kind=kind, axes=sorted(new)):
+        model.narrow_capacity(**new)
+    freed = max(before - state_nbytes(model.state), 0)
+    metrics.count("reclaim.shrink_events")
+    metrics.count(f"reclaim.shrink_events.{kind}")
+    metrics.count("reclaim.reclaimed_bytes", freed)
+    record_headroom(model)
+    return new
+
+
+class Hysteresis:
+    """The shrink governor (reclaim/): call :meth:`observe` once per
+    gossip round and it narrows an axis only after occupancy sat below
+    ``policy.low_water`` for ``policy.shrink_rounds`` CONSECUTIVE
+    rounds — a single quiet round after a burst reclaims nothing, and a
+    widening (capacity grew between observations) resets every streak,
+    so the widen loop and the shrink loop cannot chase each other.
+    Composes with ``gossip_elastic``/``delta_gossip_elastic`` via their
+    ``reclaim=`` parameter the same way widening composes via overflow
+    recovery."""
+
+    def __init__(self, policy: ElasticPolicy = DEFAULT_POLICY):
+        self.policy = policy
+        self._streak: Dict[str, int] = {}
+        self._caps: Dict[str, int] = {}
+
+    def observe(
+        self, model, policy: Optional[ElasticPolicy] = None
+    ) -> Dict[str, int]:
+        """Record one round's occupancy; shrink and return the narrowed
+        axes when the hysteresis clears (usually ``{}``)."""
+        policy = policy or self.policy
+        candidates = []
+        narrowable = _narrowable_axes(model)
+        for axis, (cap, used) in utilization(model).items():
+            prev = self._caps.get(axis)
+            if prev is not None and cap > prev:
+                self._streak[axis] = 0  # widened since last round
+            self._caps[axis] = cap
+            shrinkable = (
+                axis in narrowable
+                and cap > 0
+                and used / cap < policy.low_water
+                and _shrink_target(cap, used, policy) < cap
+            )
+            if shrinkable:
+                self._streak[axis] = self._streak.get(axis, 0) + 1
+            else:
+                self._streak[axis] = 0
+            if self._streak[axis] >= policy.shrink_rounds:
+                candidates.append(axis)
+        if not candidates:
+            return {}
+        shrunk = shrink(model, tuple(candidates), policy)
+        for axis in shrunk:
+            self._streak[axis] = 0
+            self._caps[axis] = capacities(model)[axis]
+        return shrunk
+
+
 def axes_for(model, exc: BaseException) -> Tuple[str, ...]:
     """The capacity axes a surfaced overflow implicates — the
     exception-type → axis mapping of the recovery loop. Empty tuple
@@ -400,7 +546,8 @@ def migrate(
 
 
 __all__ = [
-    "CAPACITY_ERRORS", "DEFAULT_POLICY", "ElasticPolicy", "axes_for",
-    "capacities", "elastic_call", "kind_of", "migrate", "record_headroom",
-    "recover", "utilization", "widen", "widen_dtype",
+    "CAPACITY_ERRORS", "DEFAULT_POLICY", "ElasticPolicy", "Hysteresis",
+    "axes_for", "capacities", "elastic_call", "kind_of", "migrate",
+    "record_headroom", "recover", "shrink", "utilization", "widen",
+    "widen_dtype",
 ]

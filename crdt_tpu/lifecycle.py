@@ -28,6 +28,15 @@ as CODE, for the clock/counter family:
   all-zero lanes (retired or never-used actors), shrinking device
   state. Reads are preserved exactly; freed lanes make room for new
   actors in the fixed-width universe.
+
+:func:`compact_actors` is the counter family's host-side reclamation
+path and reports through the same ``reclaim.*`` counters as the
+causal-stability subsystem (crdt_tpu/reclaim/ — frontier-driven
+compaction + ``elastic.shrink`` for the set/map family): freed lanes
+count as ``reclaim.reclaimed_slots``, and a run that actually freed
+lanes counts one ``reclaim.shrink_events`` (the live universe shrank
+into the fixed width — the freed tail is reclaimed headroom, exactly
+what a capacity shrink reclaims for the causal kinds).
 """
 
 from __future__ import annotations
@@ -130,7 +139,15 @@ def compact_actors(model) -> None:
     interner, so both must keep the same lanes). The LANE WIDTH is
     preserved — live lanes move to the front and the freed tail becomes
     zero headroom for new actors (shrinking to the live count would
-    leave a full universe and defeat the point of retiring)."""
+    leave a full universe and defeat the point of retiring).
+
+    Reclamation accounting rides the shared ``reclaim.*`` namespace
+    (crdt_tpu/reclaim/compaction.py ``record_reclaim``): freed lanes →
+    ``reclaimed_slots``; a run that freed any lane → one
+    ``shrink_events`` (see the module docstring)."""
+    from .reclaim.compaction import record_reclaim
+    from .utils.metrics import metrics
+
     clocks = _vclock_models(model)
     live = None
     for vc in clocks:
@@ -138,6 +155,11 @@ def compact_actors(model) -> None:
         live = lanes if live is None else (live | lanes)
     actors = clocks[0].actors
     keep = [a for a in range(min(len(live), len(actors))) if live[a]]
+    freed = len(actors) - len(keep)
+    if freed > 0:
+        record_reclaim("actors", freed, 0)
+        metrics.count("reclaim.shrink_events")
+        metrics.count("reclaim.shrink_events.actors")
     new_actors = Interner(actors[a] for a in keep)
     idx = jnp.asarray(np.asarray(keep, np.int64))
     for vc in clocks:

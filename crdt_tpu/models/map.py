@@ -331,3 +331,29 @@ class BatchedMap:
         self.state = ops.widen(
             self.state, n_keys, n_actors, sibling_cap, deferred_cap
         )
+
+    def narrow_capacity(
+        self,
+        n_keys: int = 0,
+        n_actors: int = 0,
+        sibling_cap: int = 0,
+        deferred_cap: int = 0,
+    ) -> None:
+        """The inverse migration — re-encode into a NARROWER layout in
+        place (elastic.shrink drives this under the hysteresis policy).
+        Refuses when a dropped lane holds live state or an interned
+        name's lane (``ops.map.narrow`` checks the device planes). 0
+        keeps a width."""
+        if n_keys and n_keys < len(self.keys):
+            raise ValueError(
+                f"narrow refused: {len(self.keys)} keys interned > "
+                f"target n_keys {n_keys}"
+            )
+        if n_actors and n_actors < len(self.actors):
+            raise ValueError(
+                f"narrow refused: {len(self.actors)} actors interned > "
+                f"target n_actors {n_actors}"
+            )
+        self.state = ops.narrow(
+            self.state, n_keys, n_actors, sibling_cap, deferred_cap
+        )

@@ -269,3 +269,27 @@ class BatchedOrswot:
         capacity, and the result is bit-identical to a from-scratch
         model built at the wider capacity holding the same state."""
         self.state = ops.widen(self.state, n_members, n_actors, deferred_cap)
+
+    def narrow_capacity(
+        self,
+        n_members: int = 0,
+        n_actors: int = 0,
+        deferred_cap: int = 0,
+    ) -> None:
+        """The inverse migration — re-encode into a NARROWER layout in
+        place (elastic.shrink drives this under the hysteresis policy).
+        Refuses when a dropped lane holds live state OR a lane id the
+        interner has minted (a member/actor name must keep its lane —
+        ``ops.orswot.narrow`` checks the device planes, this checks the
+        host tables). 0 keeps a width."""
+        if n_members and n_members < len(self.members):
+            raise ValueError(
+                f"narrow refused: {len(self.members)} members interned > "
+                f"target n_members {n_members}"
+            )
+        if n_actors and n_actors < len(self.actors):
+            raise ValueError(
+                f"narrow refused: {len(self.actors)} actors interned > "
+                f"target n_actors {n_actors}"
+            )
+        self.state = ops.narrow(self.state, n_members, n_actors, deferred_cap)

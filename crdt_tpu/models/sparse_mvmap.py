@@ -374,3 +374,44 @@ class BatchedSparseMap:
         self.n_keys = nk
         if sibling_cap:
             self.sibling_cap = sibling_cap
+
+    def narrow_capacity(
+        self,
+        cell_cap: int = 0,
+        n_keys: int = 0,
+        n_actors: int = 0,
+        sibling_cap: int = 0,
+        deferred_cap: int = 0,
+        rm_width: int = 0,
+    ) -> None:
+        """The inverse migration — slice the cell table down in place
+        (elastic.shrink drives this under the hysteresis policy).
+        ``ops.sparse_mvmap.narrow`` refuses when occupancy does not fit;
+        the host-side bounds (``n_keys`` / ``sibling_cap``) only narrow
+        down to what the interner / live sibling counts allow. 0 keeps
+        a width."""
+        if n_keys:
+            if n_keys < len(self.keys):
+                raise ValueError(
+                    f"narrow refused: {len(self.keys)} keys interned > "
+                    f"target n_keys {n_keys}"
+                )
+            self.n_keys = n_keys
+        if n_actors and n_actors < len(self.actors):
+            raise ValueError(
+                f"narrow refused: {len(self.actors)} actors interned > "
+                f"target n_actors {n_actors}"
+            )
+        if sibling_cap:
+            from ..elastic import _max_siblings
+
+            live = _max_siblings(self.state)
+            if sibling_cap < live:
+                raise ValueError(
+                    f"narrow refused: {live} live siblings > target "
+                    f"sibling_cap {sibling_cap}"
+                )
+            self.sibling_cap = sibling_cap
+        self.state = ops.narrow(
+            self.state, cell_cap, n_actors, deferred_cap, rm_width
+        )

@@ -531,3 +531,49 @@ class BatchedSparseNestedMap:
         self.sibling_cap = nsib
         if nspan != old_span or nsib != self.level.core.sibling_cap:
             self.level = smv.level_map_mvreg(nspan, nsib)
+
+    def narrow_capacity(
+        self,
+        span: int = 0,
+        cell_cap: int = 0,
+        n_actors: int = 0,
+        deferred_cap: int = 0,
+        rm_width: int = 0,
+        key_deferred_cap: int = 0,
+        key_rm_width: int = 0,
+    ) -> None:
+        """The inverse migration — slice the nested cell table down in
+        place (elastic.shrink drives this under the hysteresis policy).
+        A ``span`` narrowing is ``ops.sparse_nest.narrow_span`` (flat
+        ids remap; refused when any live offset does not fit);
+        everything else is tail slicing through
+        ``sparse_nest.narrow_level`` riding ``sparse_mvmap.narrow`` —
+        each kernel refuses when occupancy does not fit. 0 keeps a
+        width."""
+        from ..ops import sparse_nest as nest_ops
+
+        old_span = self.span
+        nspan = span or old_span
+        if nspan != old_span:
+            if len(self.keys2) > 0 and nspan < len(self.keys2):
+                raise ValueError(
+                    f"narrow refused: span {nspan} below "
+                    f"{len(self.keys2)} interned inner keys"
+                )
+            self.state = nest_ops.narrow_span(self.state, old_span, nspan)
+        if n_actors and n_actors < len(self.actors):
+            raise ValueError(
+                f"narrow refused: {len(self.actors)} actors interned > "
+                f"target n_actors {n_actors}"
+            )
+        self.state = nest_ops.narrow_level(
+            self.state,
+            lambda core: smv.narrow(
+                core, cell_cap, n_actors, deferred_cap, rm_width
+            ),
+            key_deferred_cap,
+            key_rm_width,
+            n_actors,
+        )
+        if nspan != old_span:
+            self.level = smv.level_map_mvreg(nspan, self.sibling_cap)

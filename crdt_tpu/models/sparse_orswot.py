@@ -304,3 +304,24 @@ class BatchedSparseOrswot:
         self.state = ops.widen(
             self.state, dot_cap, n_actors, deferred_cap, rm_width
         )
+
+    def narrow_capacity(
+        self,
+        dot_cap: int = 0,
+        n_actors: int = 0,
+        deferred_cap: int = 0,
+        rm_width: int = 0,
+    ) -> None:
+        """The inverse migration — slice the segment table down in
+        place (elastic.shrink drives this under the hysteresis policy).
+        Refuses when occupancy does not fit (``ops.sparse_orswot.narrow``
+        checks the device planes; the actor check also covers the
+        interner — actor ids are lane ids). 0 keeps a width."""
+        if n_actors and n_actors < len(self.actors):
+            raise ValueError(
+                f"narrow refused: {len(self.actors)} actors interned > "
+                f"target n_actors {n_actors}"
+            )
+        self.state = ops.narrow(
+            self.state, dot_cap, n_actors, deferred_cap, rm_width
+        )

@@ -50,6 +50,13 @@ def _law_states():
     ]
 
 
-from ..analysis.registry import register_merge  # noqa: E402
+from ..analysis.registry import register_compactor, register_merge  # noqa: E402
+from ..reclaim.compaction import _noop_compact  # noqa: E402
 
 register_merge("gset", module=__name__, join=join, states=_law_states)
+# A G-Set is its own observable read and holds no causal metadata — the
+# identity compactor keeps the reclaim/ coverage contract total.
+register_compactor(
+    "gset", module=__name__, compact=_noop_compact, observe=lambda s: s,
+    top_of=None,
+)

@@ -110,6 +110,13 @@ def _law_states():
     return states
 
 
-from ..analysis.registry import register_merge  # noqa: E402
+from ..analysis.registry import register_compactor, register_merge  # noqa: E402
+from ..reclaim.compaction import _noop_compact  # noqa: E402
 
 register_merge("lwwreg", module=__name__, join=join, states=_law_states)
+# One marker + one value IS the state — nothing reclaimable; identity
+# compactor keeps the reclaim/ coverage contract total.
+register_compactor(
+    "lwwreg", module=__name__, compact=_noop_compact, observe=lambda s: s,
+    top_of=None,
+)

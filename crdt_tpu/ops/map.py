@@ -112,6 +112,60 @@ def widen(
     )
 
 
+def narrow(
+    state: MapState,
+    n_keys: int = 0,
+    n_actors: int = 0,
+    sibling_cap: int = 0,
+    deferred_cap: int = 0,
+) -> MapState:
+    """The inverse of :func:`widen` — slice tail key/actor/sibling/
+    deferred lanes off (elastic.shrink drives this). Any live data in a
+    dropped lane REFUSES with ValueError; run ``compact`` first so
+    retired parked slots and stale payload do not pin lanes."""
+    k, a = state.dkeys.shape[-1], state.top.shape[-1]
+    s, d = state.child.wact.shape[-1], state.dvalid.shape[-1]
+    nk, na = n_keys or k, n_actors or a
+    ns, nd = sibling_cap or s, deferred_cap or d
+    if nk > k or na > a or ns > s or nd > d:
+        raise ValueError(
+            f"narrow cannot grow: ({k}, {a}, {s}, {d}) -> "
+            f"({nk}, {na}, {ns}, {nd})"
+        )
+    from . import mvreg as mv_ops
+
+    live = []
+    if nk < k and bool(
+        jnp.any(state.child.valid[..., nk:, :])
+        | jnp.any(state.dkeys[..., :, nk:])
+    ):
+        live.append(f"n_keys {k}->{nk}")
+    if na < a and bool(
+        jnp.any(state.top[..., na:]) | jnp.any(state.dcl[..., :, na:])
+    ):
+        live.append(f"n_actors {a}->{na}")
+    if nd < d and bool(jnp.any(state.dvalid[..., nd:])):
+        live.append(f"deferred_cap {d}->{nd}")
+    if live:
+        raise ValueError(
+            f"narrow refused — dropped lanes hold live state: {live} "
+            f"(compact first, or shrink less)"
+        )
+    child = jax.tree.map(
+        lambda x: x[..., :nk, :, :] if x.ndim == state.child.clk.ndim
+        else x[..., :nk, :],
+        state.child,
+    )
+    child = mv_ops.narrow(child, ns, na)  # refuses live sibling/actor lanes
+    return MapState(
+        top=state.top[..., :na],
+        child=child,
+        dcl=state.dcl[..., :nd, :na],
+        dkeys=state.dkeys[..., :nd, :nk],
+        dvalid=state.dvalid[..., :nd],
+    )
+
+
 def _top_at(top: jax.Array, act: jax.Array) -> jax.Array:
     """``top[act]`` for an actor-id table ``act [..., K, S]`` against a
     clock ``top [..., A]`` (broadcast gather over the key axis)."""
@@ -434,9 +488,44 @@ def apply_rm(state: MapState, rm_clock: jax.Array, key_mask: jax.Array):
 
 # ---- static-analysis registration (crdt_tpu.analysis) --------------------
 
-from ..analysis.registry import register_merge  # noqa: E402
+@jax.jit
+def compact(state: MapState, frontier: jax.Array):
+    """Causal-stability compaction (reclaim/): retire parked
+    keyset-removes the stable frontier has caught up to, scrub stale
+    parked payload, and re-canonicalize the child slab (dead sibling
+    slots of removed keys carry no payload — the dead-key scrub).
+    Observable reads (live values per key) untouched. Returns
+    ``(state, freed_slots, freed_bytes)``."""
+    from ..reclaim.compaction import retire_epochs
+
+    dcl, dkeys, dvalid, freed, freed_b = retire_epochs(
+        state.dcl, state.dkeys, state.dvalid, state.top, frontier
+    )
+    return (
+        state._replace(
+            child=_canon_child(state.child), dcl=dcl, dkeys=dkeys,
+            dvalid=dvalid,
+        ),
+        freed,
+        freed_b,
+    )
+
+
+def _observe(s: MapState):
+    """The observable read: per-key live value sets, content-ordered
+    (the map read of pure/map.py — key present iff its child holds a
+    live dot, value = the MVReg sibling set)."""
+    cc = _canon_child(s.child)
+    return (cc.val, cc.valid)
+
+
+from ..analysis.registry import register_compactor, register_merge  # noqa: E402
 
 register_merge(
     "map", module=__name__, join=join, states=_law_states,
     canon=_law_canon,
+)
+register_compactor(
+    "map", module=__name__, compact=compact, observe=_observe,
+    top_of=lambda s: s.top,
 )

@@ -204,9 +204,37 @@ def _law_canon(s: Map3State) -> Map3State:
     )
 
 
-from ..analysis.registry import register_merge  # noqa: E402
+@jax.jit
+def compact(state: Map3State, frontier: jax.Array):
+    """Causal-stability compaction (reclaim/): retire stable parked K1
+    removes, then compact the flat ``map_orswot`` core (K2 buffer +
+    leaf orswot buffer + dead-slot scrub) — three buffer levels, one
+    frontier. Returns ``(state, freed_slots, freed_bytes)``."""
+    from ..reclaim.compaction import retire_epochs
+
+    mo, n0, b0 = mo_ops.compact(state.mo, frontier)
+    odcl, odkeys, odvalid, n1, b1 = retire_epochs(
+        state.odcl, state.odkeys, state.odvalid, state.mo.core.top, frontier
+    )
+    return (
+        Map3State(mo=mo, odcl=odcl, odkeys=odkeys, odvalid=odvalid),
+        n0 + n1,
+        b0 + b1,
+    )
+
+
+def _observe(s: Map3State):
+    """The observable read: the K1×K2×M membership mask."""
+    return mo_ops._observe(s.mo)
+
+
+from ..analysis.registry import register_compactor, register_merge  # noqa: E402
 
 register_merge(
     "map3", module=__name__, join=join, states=_law_states,
     canon=_law_canon,
+)
+register_compactor(
+    "map3", module=__name__, compact=compact, observe=_observe,
+    top_of=lambda s: s.mo.core.top,
 )

@@ -173,9 +173,37 @@ def _law_canon(s: NestedMapState) -> NestedMapState:
     )
 
 
-from ..analysis.registry import register_merge  # noqa: E402
+@jax.jit
+def compact(state: NestedMapState, frontier: jax.Array):
+    """Causal-stability compaction (reclaim/): retire stable parked
+    K1 removes at the outer level, then compact the flat map core
+    (inner parked buffer + child-slab scrub). Returns
+    ``(state, freed_slots, freed_bytes)``."""
+    from ..reclaim.compaction import retire_epochs
+
+    m, n0, b0 = core_ops.compact(state.m, frontier)
+    odcl, odkeys, odvalid, n1, b1 = retire_epochs(
+        state.odcl, state.odkeys, state.odvalid, state.m.top, frontier
+    )
+    return (
+        NestedMapState(m=m, odcl=odcl, odkeys=odkeys, odvalid=odvalid),
+        n0 + n1,
+        b0 + b1,
+    )
+
+
+def _observe(s: NestedMapState):
+    """The observable read: the flat map's per-key live value sets."""
+    return core_ops._observe(s.m)
+
+
+from ..analysis.registry import register_compactor, register_merge  # noqa: E402
 
 register_merge(
     "map_map", module=__name__, join=join, states=_law_states,
     canon=_law_canon,
+)
+register_compactor(
+    "map_map", module=__name__, compact=compact, observe=_observe,
+    top_of=lambda s: s.m.top,
 )

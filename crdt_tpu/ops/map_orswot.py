@@ -189,9 +189,40 @@ def _law_canon(s: MapOrswotState) -> MapOrswotState:
     )
 
 
-from ..analysis.registry import register_merge  # noqa: E402
+@jax.jit
+def compact(state: MapOrswotState, frontier: jax.Array):
+    """Causal-stability compaction (reclaim/): retire stable parked
+    keyset-removes at the OUTER level, then compact the flat orswot
+    core (its own parked buffer + dead-slot scrub) — the dead-key scrub
+    rides the core's canonical zeroing, since a dead key is exactly an
+    all-dead member row of the product slab. Returns
+    ``(state, freed_slots, freed_bytes)``."""
+    from ..reclaim.compaction import retire_epochs
+
+    core, n0, b0 = core_ops.compact(state.core, frontier)
+    kdcl, kdkeys, kdvalid, n1, b1 = retire_epochs(
+        state.kdcl, state.kdkeys, state.kdvalid, state.core.top, frontier
+    )
+    return (
+        MapOrswotState(core=core, kdcl=kdcl, kdkeys=kdkeys, kdvalid=kdvalid),
+        n0 + n1,
+        b0 + b1,
+    )
+
+
+def _observe(s: MapOrswotState):
+    """The observable read: the K×M membership mask (key present iff
+    any member row lives — the causal-composition read)."""
+    return core_ops._present(s.core.ctr)
+
+
+from ..analysis.registry import register_compactor, register_merge  # noqa: E402
 
 register_merge(
     "map_orswot", module=__name__, join=join, states=_law_states,
     canon=_law_canon,
+)
+register_compactor(
+    "map_orswot", module=__name__, compact=compact, observe=_observe,
+    top_of=lambda s: s.core.top,
 )

@@ -68,6 +68,35 @@ def widen(state: MVRegState, n_slots: int = 0, n_actors: int = 0) -> MVRegState:
     )
 
 
+def narrow(state: MVRegState, n_slots: int = 0, n_actors: int = 0) -> MVRegState:
+    """The inverse of :func:`widen` — slice tail sibling/actor lanes
+    off (elastic.shrink drives this through the map kinds). Slot tables
+    are canonical valid-first, so narrowing is tail slicing once the
+    occupancy check passes; live data in a dropped lane REFUSES."""
+    s, a = state.clk.shape[-2:]
+    ns, na = n_slots or s, n_actors or a
+    if ns > s or na > a:
+        raise ValueError(f"narrow cannot grow: ({s}, {a}) -> ({ns}, {na})")
+    live = []
+    if ns < s and bool(jnp.any(state.valid[..., ns:])):
+        live.append(f"n_slots {s}->{ns}")
+    if na < a and bool(
+        jnp.any(state.clk[..., na:]) | jnp.any(state.valid & (state.wact >= na))
+    ):
+        live.append(f"n_actors {a}->{na}")
+    if live:
+        raise ValueError(
+            f"narrow refused — dropped lanes hold live state: {live}"
+        )
+    return MVRegState(
+        wact=state.wact[..., :ns],
+        wctr=state.wctr[..., :ns],
+        clk=state.clk[..., :ns, :na],
+        val=state.val[..., :ns],
+        valid=state.valid[..., :ns],
+    )
+
+
 def _strictly_dominated(clk_a, valid_a, clk_b, valid_b) -> jax.Array:
     """For each slot i of a: ∃ valid j in b with clk_a[i] < clk_b[j]
     (partial-order strict less: all lanes ≤ and some lane <)."""
@@ -216,9 +245,42 @@ def _law_canon(s: MVRegState) -> MVRegState:
     return canon_mvreg(s)
 
 
-from ..analysis.registry import register_merge  # noqa: E402
+@jax.jit
+def compact(state: MVRegState, frontier: jax.Array):
+    """Causal-stability compaction (reclaim/): a register has no parked
+    buffer, so the only reclaimable state is the stale payload evicted
+    slots leave behind (``apply_put`` flips ``valid`` without
+    scrubbing) — zero it and repack valid-first. The frontier is unused
+    (nothing here is clock-retired); reads are untouched. Returns
+    ``(state, freed_slots, freed_bytes)``."""
+    stale = ~state.valid & (
+        (state.wact != 0) | (state.wctr != 0) | (state.val != 0)
+        | jnp.any(state.clk != 0, axis=-1)
+    )
+    out, _ = _compact(state, state.wact.shape[-1])
+    return (
+        out,
+        jnp.sum(stale, dtype=jnp.uint32),
+        jnp.zeros((), jnp.float32),
+    )
+
+
+def _observe(s: MVRegState):
+    """The observable read: the live sibling value set, content-ordered
+    (canon_mvreg) so converged replicas compare equal leaf-wise."""
+    from ..analysis.canon import canon_mvreg
+
+    c = canon_mvreg(s)
+    return (c.val, c.valid)
+
+
+from ..analysis.registry import register_compactor, register_merge  # noqa: E402
 
 register_merge(
     "mvreg", module=__name__, join=join, states=_law_states,
     canon=_law_canon,
+)
+register_compactor(
+    "mvreg", module=__name__, compact=compact, observe=_observe,
+    top_of=None,
 )

@@ -95,6 +95,53 @@ def widen(
     )
 
 
+def narrow(
+    state: OrswotState,
+    n_elems: int = 0,
+    n_actors: int = 0,
+    deferred_cap: int = 0,
+) -> OrswotState:
+    """The inverse of :func:`widen` — re-encode into a NARROWER layout
+    by slicing tail lanes off (elastic.shrink drives this after the
+    hysteresis policy clears it). Precondition, checked here: every
+    dropped lane must be dead (zero dots / False masks / invalid
+    slots) — a live lane REFUSES with ValueError rather than silently
+    forgetting state. Run ``compact`` first so retired parked slots and
+    stale payload do not pin lanes. 0 keeps a width; growing is
+    ``widen``'s job."""
+    e, a = state.ctr.shape[-2:]
+    d = state.dvalid.shape[-1]
+    ne, na, nd = n_elems or e, n_actors or a, deferred_cap or d
+    if ne > e or na > a or nd > d:
+        raise ValueError(
+            f"narrow cannot grow: ({e}, {a}, {d}) -> ({ne}, {na}, {nd})"
+        )
+    live = []
+    if ne < e and bool(
+        jnp.any(state.ctr[..., ne:, :]) | jnp.any(state.dmask[..., :, ne:])
+    ):
+        live.append(f"n_elems {e}->{ne}")
+    if na < a and bool(
+        jnp.any(state.top[..., na:]) | jnp.any(state.ctr[..., :, na:])
+        | jnp.any(state.dcl[..., :, na:])
+    ):
+        live.append(f"n_actors {a}->{na}")
+    if nd < d and bool(jnp.any(state.dvalid[..., nd:])):
+        live.append(f"deferred_cap {d}->{nd}")
+    if live:
+        raise ValueError(
+            f"narrow refused — dropped lanes hold live state: {live} "
+            f"(compact first, or shrink less)"
+        )
+    return OrswotState(
+        top=state.top[..., :na],
+        ctr=state.ctr[..., :ne, :na],
+        dcl=state.dcl[..., :nd, :na],
+        dmask=state.dmask[..., :nd, :ne],
+        dvalid=state.dvalid[..., :nd],
+    )
+
+
 def _without(ctr: jax.Array, top: jax.Array) -> jax.Array:
     """Per-element clocks shorn of dots the top clock has seen."""
     return jnp.where(ctr > top[..., None, :], ctr, jnp.zeros_like(ctr))
@@ -426,9 +473,39 @@ def _law_canon(s: OrswotState) -> OrswotState:
     return s._replace(dcl=dcl, dmask=dmask, dvalid=dvalid)
 
 
-from ..analysis.registry import register_merge  # noqa: E402
+@jax.jit
+def compact(state: OrswotState, frontier: jax.Array):
+    """Causal-stability compaction (reclaim/): retire parked removes
+    the stable frontier has caught up to (every replica's top covers
+    them — they can never kill another dot anywhere) and scrub the
+    stale dead-slot payload ``apply_add`` leaves behind, repacking
+    valid slots to the front. Dense entry lanes are fixed-shape, so the
+    byte win here is the parked buffer; observable reads (the present
+    mask) are untouched — the compaction-invariance law pins it.
+    Returns ``(state, freed_slots, freed_bytes)``."""
+    from ..reclaim.compaction import retire_epochs
+
+    dcl, dmask, dvalid, freed, freed_b = retire_epochs(
+        state.dcl, state.dmask, state.dvalid, state.top, frontier
+    )
+    return (
+        state._replace(dcl=dcl, dmask=dmask, dvalid=dvalid), freed, freed_b
+    )
+
+
+def _observe(s: OrswotState) -> jax.Array:
+    """The observable read: the membership mask (pure/orswot.py
+    ``read().val`` as the dense present mask)."""
+    return _present(s.ctr)
+
+
+from ..analysis.registry import register_compactor, register_merge  # noqa: E402
 
 register_merge(
     "orswot", module=__name__, join=join, states=_law_states,
     canon=_law_canon, big_states=_law_states_big,
+)
+register_compactor(
+    "orswot", module=__name__, compact=compact, observe=_observe,
+    top_of=lambda s: s.top,
 )

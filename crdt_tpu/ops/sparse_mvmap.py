@@ -135,6 +135,59 @@ def widen(
     )
 
 
+def narrow(
+    state: SparseMVMapState,
+    cell_cap: int = 0,
+    n_actors: int = 0,
+    deferred_cap: int = 0,
+    rm_width: int = 0,
+) -> SparseMVMapState:
+    """The inverse of :func:`widen` — slice tail lanes off the cell
+    table (elastic.shrink drives this). Canonical order keeps dead
+    lanes last, so narrowing is tail slicing once the occupancy check
+    passes; live data in a dropped lane REFUSES. Run ``compact`` first
+    so retired parked slots do not pin lanes. 0 keeps a width."""
+    c, a = state.kid.shape[-1], state.top.shape[-1]
+    d, q = state.kidx.shape[-2:]
+    nc, na = cell_cap or c, n_actors or a
+    nd, nq = deferred_cap or d, rm_width or q
+    if nc > c or na > a or nd > d or nq > q:
+        raise ValueError(
+            f"narrow cannot grow: ({c}, {a}, {d}, {q}) -> "
+            f"({nc}, {na}, {nd}, {nq})"
+        )
+    live = []
+    if nc < c and bool(jnp.any(state.valid[..., nc:])):
+        live.append(f"cell_cap {c}->{nc}")
+    if na < a and bool(
+        jnp.any(state.top[..., na:]) | jnp.any(state.dcl[..., :, na:])
+        | jnp.any(state.clk[..., na:])
+        | jnp.any(state.valid & (state.act >= na))
+    ):
+        live.append(f"n_actors {a}->{na}")
+    if nd < d and bool(jnp.any(state.dvalid[..., nd:])):
+        live.append(f"deferred_cap {d}->{nd}")
+    if nq < q and bool(jnp.any(state.kidx[..., nq:] >= 0)):
+        live.append(f"rm_width {q}->{nq}")
+    if live:
+        raise ValueError(
+            f"narrow refused — dropped lanes hold live state: {live} "
+            f"(compact first, or shrink less)"
+        )
+    return SparseMVMapState(
+        top=state.top[..., :na],
+        kid=state.kid[..., :nc],
+        act=state.act[..., :nc],
+        ctr=state.ctr[..., :nc],
+        val=state.val[..., :nc],
+        clk=state.clk[..., :nc, :na],
+        valid=state.valid[..., :nc],
+        dcl=state.dcl[..., :nd, :na],
+        kidx=state.kidx[..., :nd, :nq],
+        dvalid=state.dvalid[..., :nd],
+    )
+
+
 def _canon(kid, act, ctr, val, clk, valid, cap: int):
     """Sort live cells by (kid, act), dead lanes last with zeroed
     payload; truncate to ``cap``. Returns the table + overflow flag."""
@@ -619,9 +672,56 @@ def _law_canon(s: SparseMVMapState) -> SparseMVMapState:
     return s._replace(dcl=dcl, kidx=kidx, dvalid=dvalid)
 
 
-from ..analysis.registry import register_merge  # noqa: E402
+@jax.jit
+def compact(state: SparseMVMapState, frontier: jax.Array):
+    """Causal-stability compaction (reclaim/): replay parked
+    keyset-removes against the cell table (kills cells their caught-up
+    clocks still cover), retire slots the stable frontier dominates,
+    scrub stale parked payload, and re-canonicalize so freed lanes pack
+    to the tail — the headroom ``elastic.shrink`` turns into bytes.
+    Observable reads (live values per key) untouched. Returns
+    ``(state, freed_slots, freed_bytes)``."""
+    from ..reclaim.compaction import retire_epochs
+
+    valid = _replay_parked(
+        state.kid, state.act, state.ctr, state.valid,
+        state.dcl, state.kidx, state.dvalid,
+    )
+    kid, act, ctr, val, clk, valid, _ = _canon(
+        state.kid, state.act, state.ctr, state.val, state.clk, valid,
+        state.kid.shape[-1],
+    )
+    dcl, kidx, dvalid, freed, freed_b = retire_epochs(
+        state.dcl, state.kidx, state.dvalid, state.top, frontier,
+        payload_fill=-1,
+    )
+    return (
+        SparseMVMapState(
+            top=state.top, kid=kid, act=act, ctr=ctr, val=val, clk=clk,
+            valid=valid, dcl=dcl, kidx=kidx, dvalid=dvalid,
+        ),
+        freed,
+        freed_b,
+    )
+
+
+def _observe(s: SparseMVMapState):
+    """The observable read: the live ``(key, value)`` cell set in
+    canonical (kid, act) order — the register map's sibling-set read."""
+    return (
+        jnp.where(s.valid, s.kid, -1),
+        jnp.where(s.valid, s.val, 0),
+        s.valid,
+    )
+
+
+from ..analysis.registry import register_compactor, register_merge  # noqa: E402
 
 register_merge(
     "sparse_mvmap", module=__name__, join=join, states=_law_states,
     canon=_law_canon,
+)
+register_compactor(
+    "sparse_mvmap", module=__name__, compact=compact, observe=_observe,
+    top_of=lambda s: s.top,
 )

@@ -168,6 +168,82 @@ def widen_level(
     )
 
 
+def narrow_level(
+    state: SparseNestState,
+    core_narrow,
+    key_deferred_cap: int = 0,
+    key_rm_width: int = 0,
+    n_actors: int = 0,
+) -> SparseNestState:
+    """The inverse of :func:`widen_level` — slice one nest level's
+    parked-keylist buffer (and, via ``core_narrow``, everything inside
+    it) down to a narrower layout (elastic.shrink drives this). Live
+    data in a dropped lane REFUSES with ValueError; run the kind's
+    ``compact`` first so retired slots do not pin lanes. 0 keeps a
+    width."""
+    d, a = state.kcl.shape[-2:]
+    q = state.kidx.shape[-1]
+    nd, nq = key_deferred_cap or d, key_rm_width or q
+    na = n_actors or a
+    if nd > d or nq > q or na > a:
+        raise ValueError(
+            f"narrow cannot grow: ({d}, {q}, {a}) -> ({nd}, {nq}, {na})"
+        )
+    live = []
+    if nd < d and bool(jnp.any(state.kdvalid[..., nd:])):
+        live.append(f"key_deferred_cap {d}->{nd}")
+    if nq < q and bool(jnp.any(state.kidx[..., nq:] >= 0)):
+        live.append(f"key_rm_width {q}->{nq}")
+    if na < a and bool(jnp.any(state.kcl[..., na:])):
+        live.append(f"n_actors {a}->{na}")
+    if live:
+        raise ValueError(
+            f"narrow refused — dropped lanes hold live state: {live} "
+            f"(compact first, or shrink less)"
+        )
+    return type(state)(
+        core_narrow(state.core),
+        state.kcl[..., :nd, :na],
+        state.kidx[..., :nd, :nq],
+        state.kdvalid[..., :nd],
+    )
+
+
+def narrow_span(state: SparseNestState, old_span: int, new_span: int) -> SparseNestState:
+    """The inverse of :func:`widen_span` — re-encode a depth-2 nest
+    under a NARROWER per-key span. Preconditions: the old span must be
+    a multiple of the new (aligned offsets preserve key ids) and every
+    live flat id's offset must fit the new span — a live offset beyond
+    it REFUSES with ValueError (the occupancy-fits contract of every
+    narrow kernel)."""
+    if new_span > old_span:
+        raise ValueError(f"narrow_span cannot grow: {old_span} -> {new_span}")
+    if old_span % new_span:
+        raise ValueError(
+            f"old span {old_span} must be a multiple of the new {new_span} "
+            f"(key-id preservation needs aligned offsets)"
+        )
+    leaf = state.core
+    if isinstance(leaf, SparseNestState):
+        raise TypeError(
+            "narrow_span covers depth-2 nests; rekey deeper nests level "
+            "by level with rekey_flat"
+        )
+    id_planes = ("eid", "didx") if hasattr(leaf, "eid") else ("kid", "kidx")
+    for plane in id_planes:
+        ids = getattr(leaf, plane)
+        if bool(jnp.any((ids >= 0) & (ids % old_span >= new_span))):
+            raise ValueError(
+                f"narrow_span refused — {plane} holds offsets >= "
+                f"{new_span} (occupancy does not fit the narrower span)"
+            )
+    new_leaf = leaf._replace(**{
+        plane: rekey_flat(getattr(leaf, plane), old_span, new_span)
+        for plane in id_planes
+    })
+    return type(state)(new_leaf, state.kcl, state.kidx, state.kdvalid)
+
+
 def rekey_flat(ids: jax.Array, old_span: int, new_span: int) -> jax.Array:
     """Remap flat leaf ids ``key·old_span + off`` → ``key·new_span +
     off`` (the segment-table repack of a span widening). Monotone for
@@ -623,9 +699,66 @@ def _law_join(a, b):
     return level_map_orswot(2).join(a, b)
 
 
-from ..analysis.registry import register_merge  # noqa: E402
+@jax.jit
+def compact(state: SparseNestState, frontier: jax.Array):
+    """Causal-stability compaction (reclaim/) for any sparse nest:
+    compact the core slab (recursing through inner levels down to the
+    ORSWOT segment table or the register-map cell table), then retire
+    this level's stable parked keylist slots and scrub their stale
+    payload. Returns ``(state, freed_slots, freed_bytes)``."""
+    from ..reclaim.compaction import retire_epochs
+    from ..reclaim.frontier import top_of
+
+    core = state.core
+    if isinstance(core, SparseNestState):
+        core, n0, b0 = compact(core, frontier)
+    elif hasattr(core, "eid"):
+        core, n0, b0 = sp.compact(core, frontier)
+    else:  # the sparse register-map cell table (ops/sparse_mvmap.py)
+        from .sparse_mvmap import compact as _smv_compact
+
+        core, n0, b0 = _smv_compact(core, frontier)
+    kcl, kidx, kdvalid, n1, b1 = retire_epochs(
+        state.kcl, state.kidx, state.kdvalid, top_of(state), frontier,
+        payload_fill=-1,
+    )
+    return (
+        type(state)(core, kcl, kidx, kdvalid),
+        n0 + n1,
+        b0 + b1,
+    )
+
+
+def _observe(s: SparseNestState):
+    """The observable read: the LEAF slab's read (membership ids for an
+    ORSWOT leaf, (key, value) cells for a register-map leaf) — the
+    causal-composition rule makes every outer level's read a projection
+    of it."""
+    leaf = s
+    while isinstance(leaf, SparseNestState):
+        leaf = leaf.core
+    if hasattr(leaf, "eid"):
+        from .sparse_orswot import _observe as _leaf_observe
+
+        return _leaf_observe(leaf)
+    from .sparse_mvmap import _observe as _leaf_observe
+
+    return _leaf_observe(leaf)
+
+
+from ..analysis.registry import register_compactor, register_merge  # noqa: E402
 
 register_merge(
     "sparse_nested_map", module=__name__, join=_law_join,
     states=_law_states, canon=_law_canon,
+)
+def _top_of(s):
+    from ..reclaim.frontier import top_of
+
+    return top_of(s)
+
+
+register_compactor(
+    "sparse_nested_map", module=__name__, compact=compact,
+    observe=_observe, top_of=_top_of,
 )

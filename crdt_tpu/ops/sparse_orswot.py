@@ -118,6 +118,57 @@ def widen(
     )
 
 
+def narrow(
+    state: SparseOrswotState,
+    dot_cap: int = 0,
+    n_actors: int = 0,
+    deferred_cap: int = 0,
+    rm_width: int = 0,
+) -> SparseOrswotState:
+    """The inverse of :func:`widen` — slice tail lanes off the segment
+    table (elastic.shrink drives this). Canonical order keeps dead
+    lanes last, so narrowing an axis is pure tail slicing once the
+    occupancy check passes; any live data in a dropped lane REFUSES
+    with ValueError. Run ``compact`` first so retired parked slots do
+    not pin lanes. 0 keeps a width."""
+    c, a = state.eid.shape[-1], state.top.shape[-1]
+    d, q = state.didx.shape[-2:]
+    nc, na = dot_cap or c, n_actors or a
+    nd, nq = deferred_cap or d, rm_width or q
+    if nc > c or na > a or nd > d or nq > q:
+        raise ValueError(
+            f"narrow cannot grow: ({c}, {a}, {d}, {q}) -> "
+            f"({nc}, {na}, {nd}, {nq})"
+        )
+    live = []
+    if nc < c and bool(jnp.any(state.valid[..., nc:])):
+        live.append(f"dot_cap {c}->{nc}")
+    if na < a and bool(
+        jnp.any(state.top[..., na:]) | jnp.any(state.dcl[..., :, na:])
+        | jnp.any(state.valid & (state.act >= na))
+    ):
+        live.append(f"n_actors {a}->{na}")
+    if nd < d and bool(jnp.any(state.dvalid[..., nd:])):
+        live.append(f"deferred_cap {d}->{nd}")
+    if nq < q and bool(jnp.any(state.didx[..., nq:] >= 0)):
+        live.append(f"rm_width {q}->{nq}")
+    if live:
+        raise ValueError(
+            f"narrow refused — dropped lanes hold live state: {live} "
+            f"(compact first, or shrink less)"
+        )
+    return SparseOrswotState(
+        top=state.top[..., :na],
+        eid=state.eid[..., :nc],
+        act=state.act[..., :nc],
+        ctr=state.ctr[..., :nc],
+        valid=state.valid[..., :nc],
+        dcl=state.dcl[..., :nd, :na],
+        didx=state.didx[..., :nd, :nq],
+        dvalid=state.dvalid[..., :nd],
+    )
+
+
 def _canon(eid, act, ctr, valid, cap: int):
     """Sort live dots by (eid, act), dead lanes last with zeroed
     payload; truncate to ``cap``. Returns the table + overflow flag.
@@ -654,9 +705,63 @@ def _law_canon(s: SparseOrswotState) -> SparseOrswotState:
     return s._replace(dcl=dcl, didx=didx, dvalid=dvalid)
 
 
-from ..analysis.registry import register_merge  # noqa: E402
+@jax.jit
+def compact(state: SparseOrswotState, frontier: jax.Array):
+    """Causal-stability compaction (reclaim/): replay parked removes
+    against the segment table (kills any dots their caught-up clocks
+    still cover — the "caught-up" part; idempotent for states that
+    settled at the last join), retire the slots the stable frontier
+    dominates, scrub stale parked payload, and re-canonicalize so dead
+    lanes pack to the tail — the freed tail is the headroom
+    ``elastic.shrink`` turns into bytes. Observable reads (membership)
+    are untouched: a retired slot's removal effect was already applied
+    at park time (``apply_rm`` kills the covered part immediately) and
+    at every replica whose top covers it. Returns
+    ``(state, freed_slots, freed_bytes)``."""
+    from ..reclaim.compaction import retire_epochs
+
+    valid = _replay_parked(
+        state.eid, state.act, state.ctr, state.valid,
+        state.dcl, state.didx, state.dvalid,
+    )
+    eid, act, ctr, valid, _ = _canon(
+        state.eid, state.act, jnp.where(valid, state.ctr, 0), valid,
+        state.eid.shape[-1],
+    )
+    dcl, didx, dvalid, freed, freed_b = retire_epochs(
+        state.dcl, state.didx, state.dvalid, state.top, frontier,
+        payload_fill=-1,
+    )
+    return (
+        SparseOrswotState(
+            top=state.top, eid=eid, act=act, ctr=ctr, valid=valid,
+            dcl=dcl, didx=didx, dvalid=dvalid,
+        ),
+        freed,
+        freed_b,
+    )
+
+
+def _observe(s: SparseOrswotState):
+    """The observable read: the live member-id set, deduped across
+    witness actors and canonically sorted (dead lanes as -1) so
+    converged replicas compare equal leaf-wise."""
+    first = jnp.concatenate(
+        [jnp.ones_like(s.valid[..., :1]), s.eid[..., 1:] != s.eid[..., :-1]],
+        axis=-1,
+    )
+    member = jnp.where(s.valid & first, s.eid, _INT32_MAX)
+    member = jnp.sort(member, axis=-1)
+    return jnp.where(member == _INT32_MAX, -1, member)
+
+
+from ..analysis.registry import register_compactor, register_merge  # noqa: E402
 
 register_merge(
     "sparse_orswot", module=__name__, join=join, states=_law_states,
     canon=_law_canon, big_states=_law_states_big,
+)
+register_compactor(
+    "sparse_orswot", module=__name__, compact=compact, observe=_observe,
+    top_of=lambda s: s.top,
 )

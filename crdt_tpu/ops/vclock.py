@@ -133,6 +133,15 @@ def _law_states():
     ]
 
 
-from ..analysis.registry import register_merge  # noqa: E402
+from ..analysis.registry import register_compactor, register_merge  # noqa: E402
+from ..reclaim.compaction import _noop_compact  # noqa: E402
 
 register_merge("vclock", module=__name__, join=merge, states=_law_states)
+# A clock's read IS the clock; frontier-dominated lanes are exactly the
+# read, so nothing can be discarded read-invariantly — identity
+# compactor (actor-LANE reclamation is lifecycle.compact_actors, an
+# administrative host-side migration, not a kernel).
+register_compactor(
+    "vclock", module=__name__, compact=_noop_compact, observe=lambda s: s,
+    top_of=None,
+)

@@ -102,6 +102,11 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
             jnp.zeros((), jnp.int32) if residue is None else residue
         ),
         widen_pressure=lax.pmax(tele.device_pressure(folded), both),
+        # The reclaim fields are zero unless the stability path fills
+        # them in (gossip_stab_fn's _replace).
+        reclaimed_slots=jnp.zeros((), jnp.uint32),
+        reclaimed_bytes=jnp.zeros((), jnp.float32),
+        frontier_lag=jnp.zeros((), jnp.uint32),
     )
 
 
@@ -266,6 +271,8 @@ def _mesh_gossip_lattice(
     slots_fn=None,
     element_sharded: bool = True,
     donate: bool = False,
+    stability: bool = False,
+    compact_fn=None,
 ):
     """Shared scaffold for ring anti-entropy: each device folds its
     local replica block, then runs ``rounds`` unit-shift gossip rounds.
@@ -287,73 +294,105 @@ def _mesh_gossip_lattice(
     carries no second copy of the state in HBM. Larger batches cannot
     alias (the local fold reduces leading rows away); they fall back to
     freeing the input after the run and count
-    ``anti_entropy.donate_unaliasable``."""
+    ``anti_entropy.donate_unaliasable``.
+
+    ``stability=True`` piggybacks the mesh-wide STABLE FRONTIER on the
+    round (reclaim/frontier.py): one lax ``pmin`` over the replica axis
+    of the PRE-fold input tops — the knowledge each replica entered
+    with, so a straggler row pins the frontier — appended as the last
+    output (replicated ``[A]``), and the kind's registered compaction
+    kernel (``compact_fn``) runs in-kernel on the converged rows before
+    they ship out. The flag off traces exactly the flag-free program
+    (same HLO-identity discipline as ``telemetry=``); with both flags
+    on, the Telemetry pytree carries ``reclaimed_slots`` /
+    ``reclaimed_bytes`` / ``frontier_lag``."""
     if rounds is None:
         rounds = mesh.shape[REPLICA_AXIS] - 1
     argnums = _ring_donate_argnums(state, mesh, donate)
 
     def build():
-        @partial(
-            jax.shard_map,
-            mesh=mesh,
-            in_specs=(in_specs,),
-            out_specs=(in_specs, P()),
-            check_vma=False,
-        )
-        def gossip_fn(local):
-            folded, of = fold_fn(local)
-            for _ in range(rounds):
-                folded, of_r = ring_round(
-                    folded, REPLICA_AXIS, reduce_overflow=False, join_fn=join_fn
-                )
-                of = of | of_r
-            of = lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS)) > 0
-            return jax.tree.map(lambda x: x[None], folded), of
+        # ONE parameterized builder for every (telemetry, stability)
+        # combination: the flag-off branches trace EXACTLY the pre-flag
+        # program (Python conditionals emit nothing when off — the
+        # HLO-identity pins in tests/test_telemetry.py and
+        # tests/test_reclaim.py hold on this single body).
+        from ..reclaim.frontier import frontier_lag as _lag, top_of as _top
 
-        return gossip_fn
-
-    def build_tel():
         slots_of = slots_fn or tele.generic_slots_changed
         sum_axes = (
             (REPLICA_AXIS, ELEMENT_AXIS) if element_sharded
             else (REPLICA_AXIS,)
         )
+        out_specs = [in_specs, P()]
+        if telemetry:
+            out_specs.append(tele.specs())
+        if stability:
+            out_specs.append(P())  # the frontier, replicated
 
         @partial(
             jax.shard_map,
             mesh=mesh,
             in_specs=(in_specs,),
-            out_specs=(in_specs, P(), tele.specs()),
+            out_specs=tuple(out_specs),
             check_vma=False,
         )
-        def gossip_tel_fn(local):
+        def gossip_fn(local):
+            if stability:
+                # Frontier over the PRE-fold input tops: the knowledge
+                # each replica ENTERED the round with — a straggler row
+                # pins it.
+                frontier = lax.pmin(
+                    jnp.min(_top(local), axis=0), REPLICA_AXIS
+                )
             folded, of = fold_fn(local)
-            slots = jnp.zeros((), jnp.uint32)
+            if telemetry:
+                slots = jnp.zeros((), jnp.uint32)
             for _ in range(rounds):
                 new, of_r = ring_round(
-                    folded, REPLICA_AXIS, reduce_overflow=False, join_fn=join_fn
+                    folded, REPLICA_AXIS, reduce_overflow=False,
+                    join_fn=join_fn,
                 )
-                slots = slots + slots_of(folded, new)
+                if telemetry:
+                    slots = slots + slots_of(folded, new)
                 folded, of = new, of | of_r
-            local_rows = jax.tree.leaves(local)[0].shape[0]
-            tel = _tel_reduced(
-                folded, slots,
-                max(local_rows - 1, 0) + rounds,
-                tele.shipped_bytes(folded) * rounds,
-                sum_axes,
-            )
+            if stability:
+                freed = jnp.zeros((), jnp.uint32)
+                freed_b = jnp.zeros((), jnp.float32)
+                if compact_fn is not None:
+                    folded, freed, freed_b = compact_fn(folded, frontier)
             of = lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS)) > 0
-            return jax.tree.map(lambda x: x[None], folded), of, tel
+            outs = [jax.tree.map(lambda x: x[None], folded), of]
+            if telemetry:
+                local_rows = jax.tree.leaves(local)[0].shape[0]
+                tel = _tel_reduced(
+                    folded, slots,
+                    max(local_rows - 1, 0) + rounds,
+                    tele.shipped_bytes(folded) * rounds,
+                    sum_axes,
+                )
+                if stability:
+                    tel = tel._replace(
+                        reclaimed_slots=lax.psum(freed, REPLICA_AXIS),
+                        reclaimed_bytes=lax.psum(freed_b, REPLICA_AXIS),
+                        frontier_lag=lax.pmax(
+                            _lag(_top(folded), frontier), REPLICA_AXIS
+                        ),
+                    )
+                outs.append(tel)
+            if stability:
+                outs.append(frontier)
+            return tuple(outs)
 
-        return gossip_tel_fn
+        return gossip_fn
 
     metrics.count(f"anti_entropy.{kind}_rounds", rounds)
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
     observe_depth(f"anti_entropy.{kind}", state)
     with metrics.time(f"anti_entropy.{kind}"):
         out = _cached(
-            kind, state, mesh, build_tel if telemetry else build,
-            rounds, telemetry, *cache_extra, donate_argnums=argnums,
+            kind, state, mesh, build,
+            rounds, telemetry, stability, *cache_extra,
+            donate_argnums=argnums,
         )(state)
         jax.block_until_ready(out)  # time device work, not async dispatch
     # Aliased buffers are already consumed by the donation; this frees
@@ -373,13 +412,16 @@ def mesh_gossip(
     local_fold: str = "auto",
     telemetry: bool = False,
     donate: bool = False,
+    stability: bool = False,
 ) -> Tuple[OrswotState, jax.Array]:
     """Ring anti-entropy for ORSWOT replica batches (see
     ``_mesh_gossip_lattice``); the device-local pre-fold dispatches like
     ``mesh_fold`` (fused Pallas on TPU backends). ``telemetry=True``
     appends the in-kernel Telemetry pytree (telemetry.py);
     ``donate=True`` consumes ``state`` and aliases the converged rows
-    onto its buffers in place (zero-copy — ``_mesh_gossip_lattice``)."""
+    onto its buffers in place (zero-copy — ``_mesh_gossip_lattice``);
+    ``stability=True`` appends the mesh-wide stable frontier and
+    compacts the rows in-kernel (reclaim/)."""
     from ..ops.pallas_kernels import fold_auto
 
     state = pad_replicas(state, mesh.shape[REPLICA_AXIS])
@@ -389,12 +431,14 @@ def mesh_gossip(
         partial(fold_auto, prefer=local_fold), orswot_specs(), rounds,
         cache_extra=(local_fold,),
         telemetry=telemetry, slots_fn=ops.changed_members, donate=donate,
+        stability=stability, compact_fn=ops.compact,
     )
 
 
 def mesh_gossip_map(
     state: MapState, mesh: Mesh, rounds: Optional[int] = None,
     telemetry: bool = False, donate: bool = False,
+    stability: bool = False,
 ) -> Tuple[MapState, jax.Array]:
     """Ring anti-entropy for the composition layer: Map<K, MVReg>
     replica blocks gossiped one neighbor per round over the replica
@@ -404,13 +448,14 @@ def mesh_gossip_map(
     return _mesh_gossip_lattice(
         "map_gossip", state, mesh, map_ops.join, map_ops.fold, map_specs(),
         rounds, telemetry=telemetry, slots_fn=map_ops.changed_keys,
-        donate=donate,
+        donate=donate, stability=stability, compact_fn=map_ops.compact,
     )
 
 
 def mesh_gossip_map_orswot(
     state: MapOrswotState, mesh: Mesh, rounds: Optional[int] = None,
     telemetry: bool = False, donate: bool = False,
+    stability: bool = False,
 ) -> Tuple[MapOrswotState, jax.Array]:
     """Ring anti-entropy for ``Map<K, Orswot>`` replica blocks (the
     Val-generic slab composition) over the replica axis."""
@@ -422,13 +467,14 @@ def mesh_gossip_map_orswot(
         map_orswot_specs(), rounds,
         telemetry=telemetry,
         slots_fn=lambda a, b: ops.changed_members(a.core, b.core),
-        donate=donate,
+        donate=donate, stability=stability, compact_fn=mo_ops.compact,
     )
 
 
 def mesh_gossip_nested_map(
     state: NestedMapState, mesh: Mesh, rounds: Optional[int] = None,
     telemetry: bool = False, donate: bool = False,
+    stability: bool = False,
 ) -> Tuple[NestedMapState, jax.Array]:
     """Ring anti-entropy for ``Map<K1, Map<K2, MVReg>>`` replica blocks
     over the replica axis."""
@@ -440,7 +486,7 @@ def mesh_gossip_nested_map(
         nested_map_specs(), rounds,
         telemetry=telemetry,
         slots_fn=lambda a, b: map_ops.changed_keys(a.m, b.m),
-        donate=donate,
+        donate=donate, stability=stability, compact_fn=nested_ops.compact,
     )
 
 
@@ -797,6 +843,7 @@ def mesh_fold_sparse_mvmap(
 def mesh_gossip_sparse_mvmap(
     states, mesh: Mesh, rounds: Optional[int] = None, sibling_cap: int = 4,
     telemetry: bool = False, donate: bool = False,
+    stability: bool = False,
 ):
     """Ring anti-entropy for SPARSE ``Map<K, MVReg>`` replica batches
     over the replica axis — per-round traffic is one cell table per
@@ -814,6 +861,7 @@ def mesh_gossip_sparse_mvmap(
         jax.tree.map(lambda _: P(REPLICA_AXIS), template), rounds,
         telemetry=telemetry, slots_fn=smv.changed_cells,
         element_sharded=False, donate=donate,
+        stability=stability, compact_fn=smv.compact,
     )
 
 
@@ -867,12 +915,15 @@ def _sparse_nested_pad_and_key(states, rsize: int, level, op: str):
 def mesh_gossip_sparse_nested(
     states, mesh: Mesh, level, rounds: Optional[int] = None,
     telemetry: bool = False, donate: bool = False,
+    stability: bool = False,
 ):
     """Ring anti-entropy for SPARSE nested-map replica batches (any
     ``SparseNestLevel`` composition) over the replica axis — per-round
     traffic is one live-content-proportional state per link. State
     replicated across the element axis (the sharded fold is the
     element-scaling mode)."""
+    from ..ops import sparse_nest as nest_ops
+
     states, template, kind = _sparse_nested_pad_and_key(
         states, mesh.shape[REPLICA_AXIS], level, "gossip"
     )
@@ -880,12 +931,14 @@ def mesh_gossip_sparse_nested(
         kind, states, mesh, level.join, level.fold,
         jax.tree.map(lambda _: P(REPLICA_AXIS), template), rounds,
         telemetry=telemetry, element_sharded=False, donate=donate,
+        stability=stability, compact_fn=nest_ops.compact,
     )
 
 
 def mesh_gossip_sparse(
     states, mesh: Mesh, rounds: Optional[int] = None,
     telemetry: bool = False, donate: bool = False,
+    stability: bool = False,
 ):
     """Ring anti-entropy for SPARSE (segment-encoded) ORSWOT replica
     batches over the replica axis (the bounded-bandwidth mode —
@@ -902,12 +955,14 @@ def mesh_gossip_sparse(
         jax.tree.map(lambda _: P(REPLICA_AXIS), template), rounds,
         telemetry=telemetry, slots_fn=sp.changed_dots,
         element_sharded=False, donate=donate,
+        stability=stability, compact_fn=sp.compact,
     )
 
 
 def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
                    policy=None, telemetry: bool = False,
-                   donate: bool = False):
+                   donate: bool = False, stability: bool = False,
+                   reclaim=None):
     """Ring anti-entropy with elastic capacity recovery — the
     overflow→widen→resume loop at mesh scale (elastic.py).
 
@@ -939,7 +994,16 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
     the overflow→widen fallback needs the pre-round state alive across
     a failed attempt, so the wrapper trades the ring-internal second
     state copy for one explicit snapshot while keeping the model
-    coherent either way."""
+    coherent either way.
+
+    ``stability=True`` threads the flag into the ring (the rows come
+    back compacted, the mesh-wide frontier rides as the LAST tuple
+    element — reclaim/). ``reclaim=`` takes an ``elastic.Hysteresis``
+    tracker and is the shrink analog of the widen loop: after the
+    successful attempt it observes the model's occupancy and — once the
+    low-water streak clears — narrows the implicated axes in place, so
+    the model carries the reclaimed capacity into its next round
+    (administrative, like widening: apply identically on every host)."""
     from .. import elastic
     from ..models.map import BatchedMap
     from ..models.orswot import BatchedOrswot
@@ -954,21 +1018,24 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
         if isinstance(m, BatchedOrswot):
             return (
                 lambda: mesh_gossip(m.state, mesh, rounds,
-                                    telemetry=telemetry, donate=donate),
+                                    telemetry=telemetry, donate=donate,
+                                    stability=stability),
                 ("deferred_cap",),
             )
         if isinstance(m, BatchedSparseOrswot):
             return (
                 lambda: mesh_gossip_sparse(m.state, mesh, rounds,
                                            telemetry=telemetry,
-                                           donate=donate),
+                                           donate=donate,
+                                           stability=stability),
                 ("dot_cap", "deferred_cap"),
             )
         if isinstance(m, BatchedMap):
             return (
                 lambda: mesh_gossip_map(m.state, mesh, rounds,
                                         telemetry=telemetry,
-                                        donate=donate),
+                                        donate=donate,
+                                        stability=stability),
                 ("sibling_cap", "deferred_cap"),
             )
         if isinstance(m, BatchedSparseMap):
@@ -976,6 +1043,7 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
                 lambda: mesh_gossip_sparse_mvmap(
                     m.state, mesh, rounds, sibling_cap=m.sibling_cap,
                     telemetry=telemetry, donate=donate,
+                    stability=stability,
                 ),
                 ("cell_cap", "deferred_cap", "sibling_cap"),
             )
@@ -983,7 +1051,7 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
             return (
                 lambda: mesh_gossip_sparse_nested(
                     m.state, mesh, m.level, rounds, telemetry=telemetry,
-                    donate=donate,
+                    donate=donate, stability=stability,
                 ),
                 ("cell_cap", "deferred_cap", "sibling_cap",
                  "key_deferred_cap"),
@@ -1004,6 +1072,7 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
         if donate:
             model.state = snap
         rows, flags = out[0], out[1]
+        frontier = out[-1] if stability else None
         if telemetry:
             tel = out[2] if tel is None else tele.combine(tel, out[2])
         flags = jnp.atleast_1d(flags)
@@ -1011,7 +1080,22 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
             axis for lane, axis in enumerate(lanes) if bool(flags[lane])
         )
         if not hot:
-            return (rows, widened, tel) if telemetry else (rows, widened)
+            if reclaim is not None:
+                # The shrink half of the elastic loop: COMMIT the
+                # converged rows into the model (the shrink must narrow
+                # the state the model carries into its next round, not
+                # the stale pre-round one), then let the hysteresis
+                # decide — see elastic.Hysteresis. After a reclaim
+                # round, read the model, not the returned rows (a
+                # shrink leaves them at the old capacity).
+                _commit_rows(model, rows)
+                reclaim.observe(model)
+            ret = [rows, widened]
+            if telemetry:
+                ret.append(tel)
+            if stability:
+                ret.append(frontier)
+            return tuple(ret) if len(ret) > 2 else (rows, widened)
         if migrations >= policy.max_migrations:
             raise RuntimeError(
                 f"gossip still overflowing after {migrations} migrations "
@@ -1021,6 +1105,21 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
         metrics.count("elastic.gossip_migrations")
         widened.update(elastic.widen(model, hot, policy))
         migrations += 1
+
+
+def _commit_rows(model, rows) -> None:
+    """Commit gossip rows back into a model for the reclaim path: slice
+    the identity-padded tail off and assign — skipped (model untouched)
+    when the mesh padded other axes too and shapes cannot line up
+    (shrinking the pre-round state is still sound; narrow refuses
+    anything unfit)."""
+    lead = jax.tree.leaves(model.state)[0].shape[0]
+    sliced = jax.tree.map(lambda x: x[:lead], rows)
+    if all(
+        a.shape == b.shape and a.dtype == b.dtype
+        for a, b in zip(jax.tree.leaves(sliced), jax.tree.leaves(model.state))
+    ):
+        model.state = sliced
 
 
 def mesh_fold_clocks(clocks: jax.Array, mesh: Mesh) -> jax.Array:
@@ -1073,7 +1172,7 @@ def mesh_fold_map3(state, mesh: Mesh, telemetry: bool = False,
 
 def mesh_gossip_map3(
     state, mesh: Mesh, rounds: Optional[int] = None, telemetry: bool = False,
-    donate: bool = False,
+    donate: bool = False, stability: bool = False,
 ):
     """Ring anti-entropy for ``Map<K1, Map<K2, Orswot>>`` replica blocks
     over the replica axis."""
@@ -1088,7 +1187,7 @@ def mesh_gossip_map3(
         map3_specs(), rounds,
         telemetry=telemetry,
         slots_fn=lambda a, b: ops.changed_members(a.mo.core, b.mo.core),
-        donate=donate,
+        donate=donate, stability=stability, compact_fn=map3_ops.compact,
     )
 
 

@@ -375,6 +375,7 @@ def delta_gossip_elastic(
     pipeline: bool = True,
     digest: bool = True,
     donate: bool = False,
+    reclaim=None,
 ):
     """δ-ring anti-entropy with elastic capacity recovery for dense
     ORSWOT replica batches (``BatchedOrswot``): the mid-round
@@ -407,7 +408,15 @@ def delta_gossip_elastic(
     ``mesh_delta_gossip`` tuple plus the dict of axes grown (empty when
     capacity sufficed). ``telemetry=True`` appends a Telemetry pytree
     folded across every attempt (``telemetry.combine``) as the last
-    element."""
+    element.
+
+    ``reclaim=`` takes an ``elastic.Hysteresis`` tracker — the shrink
+    half of the elastic loop, composing here exactly as in
+    ``anti_entropy.gossip_elastic``: after the successful attempt the
+    tracker observes occupancy and narrows cleared axes in place (the
+    δ path computes its frontier host-side —
+    ``reclaim.host_frontier`` / ``reclaim.compact_model`` — since the
+    residue-certificated ring has no spare output lane for it)."""
     from .. import elastic
     from .delta import mesh_delta_gossip
 
@@ -429,6 +438,17 @@ def delta_gossip_elastic(
         if telemetry:
             tel = out[4] if tel is None else tele.combine(tel, out[4])
         if not bool(jnp.any(out[2])):
+            if reclaim is not None:
+                from ..reclaim import compact_model
+                from .anti_entropy import _commit_rows
+
+                _commit_rows(model, out[0])
+                # The δ ring has no spare output lane for an in-kernel
+                # frontier; compact host-side against the committed
+                # rows' own frontier (the batch IS the replica set)
+                # so retired slots do not pin lanes the shrink needs.
+                compact_model(model)
+                reclaim.observe(model)
             if telemetry:
                 return (*out[:4], widened, tel)
             return (*out, widened)

@@ -1,0 +1,66 @@
+"""Causal-stability reclamation — the inverse of the growth story.
+
+PR 1 gave every bounded structure an overflow→widen→resume loop, so a
+long-lived replica under churn only ever GROWS: capacity ratchets up at
+the occupancy peak and nothing ever computes a clock that is *safe* to
+forget mesh-wide (``traits.ResetRemove`` exists, but the caller supplies
+the clock). This package closes the loop with three layers:
+
+- :mod:`.frontier` — the mesh-wide **stable frontier**: the per-actor
+  minimum over every replica's top clock. Every dot at or below it has
+  been seen by every replica (delta-state causal stability, Almeida et
+  al. 1603.01529; Enes et al. 1803.02750), so state it dominates can be
+  discarded without any replica ever noticing. Computed as a lax-only
+  ``pmin`` piggybacked on gossip rounds (``stability=`` on the mesh
+  entry points, default off and HLO-identical off — the ``telemetry=``
+  discipline), with a host-side fallback for the pure/multihost paths.
+  A straggler or partitioned replica simply pins the frontier:
+  degradation is graceful, never unsafe.
+- :mod:`.compaction` — per-kind frontier-driven compaction: retire
+  parked-remove slots the frontier has caught up to, scrub stale dead
+  payload, repack. Observable reads are bit-identical before/after
+  (the compaction-invariance law in ``analysis/laws.py`` pins
+  ``canonical(read(compact(s))) == canonical(read(s))`` and
+  merge/compact commutation for every registered kind).
+- ``elastic.shrink`` / ``elastic.Hysteresis`` — the inverse of
+  ``elastic.widen``: ops-level ``narrow``/``narrow_span`` kernels
+  (refused when occupancy does not fit) under a hysteresis policy
+  (shrink only after occupancy sits below the low-water mark for K
+  consecutive rounds, never below a floor) so widen/shrink cannot
+  thrash. Re-exported here so one import serves the subsystem.
+
+Host-side actor-lane compaction for the counter family lives in
+:mod:`crdt_tpu.lifecycle` (``compact_actors``) and feeds the same
+``reclaim.*`` counters.
+"""
+
+from .compaction import (
+    compact_model,
+    compact_state,
+    record_reclaim,
+)
+from .frontier import (
+    frontier_lag,
+    host_frontier,
+    model_frontier,
+    stable_frontier,
+    top_of,
+)
+
+# The shrink half lives in elastic.py (it IS the inverse of widen and
+# shares the axis tables); re-exported lazily for one-stop imports —
+# a module-level import here would cycle (elastic -> models -> ops ->
+# reclaim.compaction triggers this package __init__).
+def __getattr__(name):
+    if name in ("Hysteresis", "shrink"):
+        from .. import elastic
+
+        return getattr(elastic, name)
+    raise AttributeError(name)
+
+
+__all__ = [
+    "Hysteresis", "compact_model", "compact_state", "frontier_lag",
+    "host_frontier", "model_frontier", "record_reclaim", "shrink",
+    "stable_frontier", "top_of",
+]

@@ -1,0 +1,153 @@
+"""Frontier-driven space reclamation — shared kernels + the model driver.
+
+What compaction may discard is bounded by one invariant: **observable
+reads are bit-identical before and after** (the compaction-invariance
+law in ``analysis/laws.py`` pins it for every registered kind). Under
+that bound, the sound reclamation for the masked-epoch buffers is:
+
+- **retire stable parked removes** — a parked slot whose rm clock the
+  frontier dominates has been replayed by every replica (each top >=
+  frontier >= the slot clock), so it can never kill another dot
+  anywhere; dropping it is the eager form of what the next join's
+  caught-up check does. Gated on BOTH the frontier and the local top
+  (``frontier <= top`` holds for every frontier participant, but a
+  restored straggler may trail the mesh — the extra bound keeps the
+  kernel read-invariant unconditionally rather than relying on the
+  caller's frontier discipline);
+- **scrub stale dead payload** — the CmRDT appliers drop a caught-up
+  slot's ``dvalid`` without zeroing its clock/mask lanes (see
+  analysis/canon.py), and dense ``apply_add`` leaves dead-slot payload
+  behind; compaction zeroes it and repacks valid slots to the front, so
+  the state is byte-comparable and the freed tail is genuine headroom
+  for ``elastic.shrink``.
+
+Per-kind ``compact(state, frontier)`` kernels live at the bottom of
+each ``ops/*.py`` (composed from :func:`retire_epochs` here) and
+register via ``analysis.registry.register_compactor`` — an unregistered
+kind fails tests/test_analysis.py discovery, the same contract as joins
+and mesh entry points. Kernels are pure lax/jnp on static shapes, so
+the ``stability=`` gossip flag can run them in-kernel on the converged
+rows, and return ``(state, freed_slots, freed_bytes)`` scalars feeding
+the ``reclaimed_slots`` / ``reclaimed_bytes`` telemetry fields.
+"""
+
+from __future__ import annotations
+
+from .frontier import model_frontier
+
+
+def retire_epochs(dcl, payload, dvalid, top, frontier, payload_fill=0):
+    """Retire + scrub one masked-epoch buffer level.
+
+    ``dcl [..., D, A]`` parked rm clocks, ``payload [..., D, X]``
+    member masks / key masks / id lists (``payload_fill`` is the dead
+    value — 0/False for masks, -1 for id lists), ``dvalid [..., D]``,
+    ``top [..., A]`` the state's top clock, ``frontier [A]`` (or None
+    to skip retirement and only scrub).
+
+    Returns ``(dcl, payload, dvalid, freed_slots, freed_bytes)`` with
+    valid slots repacked to the front (stable, matching the joins'
+    ``_compact`` convention) and dead lanes zeroed/filled.
+    ``freed_slots`` (uint32) counts retired slots plus scrubbed stale
+    dead lanes; ``freed_bytes`` (float32) counts only the retired
+    slots' static lane bytes — scrubbed lanes were already dead.
+
+    Staleness is detected on the CLOCK plane only (a dead slot whose
+    clock is nonzero): the clock plane is replicated across element
+    shards on every kind, so the count stays shard-consistent inside
+    ``shard_map`` even where the payload plane (dense member/key masks)
+    is element-sharded. Payload-only stale lanes are still SCRUBBED —
+    they just are not counted — and the only writer that zeroes a dead
+    slot's clock while leaving payload (``reset_remove``) scrubs its
+    own payload, so the undercount is nil in practice."""
+    import jax.numpy as jnp
+
+    stale = ~dvalid & jnp.any(dcl != 0, axis=-1)
+    if frontier is None:
+        covered = jnp.zeros_like(dvalid)
+    else:
+        frontier = jnp.asarray(frontier, dcl.dtype)
+        covered = (
+            dvalid
+            & jnp.all(dcl <= frontier, axis=-1)
+            & jnp.all(dcl <= top[..., None, :], axis=-1)
+        )
+    dvalid = dvalid & ~covered
+
+    # Scrub + repack (valid-first, stable — the `_compact_*` order).
+    order = jnp.argsort(~dvalid, axis=-1, stable=True)
+    dcl = jnp.take_along_axis(dcl, order[..., None], axis=-2)
+    payload = jnp.take_along_axis(payload, order[..., None], axis=-2)
+    dvalid = jnp.take_along_axis(dvalid, order, axis=-1)
+    dcl = jnp.where(dvalid[..., None], dcl, jnp.zeros_like(dcl))
+    payload = jnp.where(
+        dvalid[..., None], payload, jnp.full_like(payload, payload_fill)
+    )
+
+    slot_bytes = (
+        dcl.shape[-1] * dcl.dtype.itemsize
+        + payload.shape[-1] * payload.dtype.itemsize
+        + dvalid.dtype.itemsize
+    )
+    freed_slots = jnp.sum(covered, dtype=jnp.uint32) + jnp.sum(
+        stale, dtype=jnp.uint32
+    )
+    freed_bytes = jnp.sum(covered, dtype=jnp.float32) * slot_bytes
+    return dcl, payload, dvalid, freed_slots, freed_bytes
+
+
+def compact_state(state, frontier, kind: str):
+    """Run ``kind``'s registered compactor on ``state``. Returns
+    ``(state, freed_slots, freed_bytes)`` (freed as device scalars)."""
+    from ..analysis.registry import get_compactor
+
+    return get_compactor(kind).compact(state, frontier)
+
+
+def record_reclaim(kind: str, slots: int, nbytes: float) -> None:
+    """Feed the host registry: ``reclaim.reclaimed_slots`` /
+    ``reclaim.reclaimed_bytes`` (plus the per-kind variants) — the same
+    names the in-kernel Telemetry fields drain under, so host-side
+    paths (checkpoint compact-on-save, ``lifecycle.compact_actors``)
+    and the in-kernel path share one counter namespace."""
+    from ..utils.metrics import metrics
+
+    metrics.count("reclaim.reclaimed_slots", int(slots))
+    metrics.count(f"reclaim.reclaimed_slots.{kind}", int(slots))
+    metrics.count("reclaim.reclaimed_bytes", int(nbytes))
+
+
+def compact_model(model, frontier=None) -> dict:
+    """Compact a batched model IN PLACE against ``frontier`` (default:
+    the model's own replica rows' frontier — sound when the device
+    batch is the whole replica set; pass a mesh-wide
+    ``host_frontier(...)`` when it is one shard of a larger mesh).
+    Returns ``{"reclaimed_slots": int, "reclaimed_bytes": int}`` and
+    feeds the ``reclaim.*`` counters. Covers the elastic model family
+    (elastic.kind_of)."""
+    from .. import elastic
+    from ..telemetry import span
+
+    kind = elastic.kind_of(model)
+    if frontier is None:
+        frontier = model_frontier(model)
+    with span("reclaim.compact", kind=kind):
+        state, slots, nbytes = compact_state(model.state, frontier, kind)
+    model.state = state
+    slots, nbytes = int(slots), int(nbytes)
+    record_reclaim(kind, slots, nbytes)
+    return {"reclaimed_slots": slots, "reclaimed_bytes": nbytes}
+
+
+__all__ = [
+    "compact_model", "compact_state", "record_reclaim", "retire_epochs",
+]
+
+
+def _noop_compact(state, frontier):
+    """The identity compactor for kinds with nothing reclaimable
+    (gset/lwwreg/vclock: no parked buffers, no dead payload lanes).
+    Registered so the coverage contract stays total."""
+    import jax.numpy as jnp
+
+    return state, jnp.zeros((), jnp.uint32), jnp.zeros((), jnp.float32)

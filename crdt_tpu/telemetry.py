@@ -41,8 +41,17 @@ shipped and sync metadata per round), measured natively per round:
 - ``widen_pressure``  — max occupancy fraction over the bounded parked
   buffers (1.0 = at capacity: the in-jit analog of the
   ``elastic.<kind>.headroom`` gauges, which report 1 - this).
+- ``reclaimed_slots`` / ``reclaimed_bytes`` — lanes retired and their
+  static bytes discarded by in-kernel causal-stability compaction
+  (reclaim/; populated by the ``stability=`` flag on the gossip entry
+  points, 0 elsewhere — host-side reclamation paths count under the
+  same names in the registry via ``reclaim.record_reclaim``).
+- ``frontier_lag``    — max over replicas/actor lanes of
+  ``top - stable_frontier`` (0 = fully stable mesh); a lag growing
+  under steady traffic means a straggler is pinning the frontier and
+  reclamation has stalled (reclaim/frontier.py).
 
-Every field is a replicated scalar, so the pytree costs six words of
+Every field is a replicated scalar, so the pytree costs ten words of
 output and no extra collectives beyond one psum/pmax fusion group.
 
 Span tracing (:func:`span`) is the host-side half: a context manager
@@ -78,6 +87,9 @@ class Telemetry(NamedTuple):
     bytes_useful: jax.Array    # float32 — post-mask payload bytes
     residue: jax.Array         # int32 — δ-ring residue (0 elsewhere)
     widen_pressure: jax.Array  # float32 — max parked-buffer occupancy
+    reclaimed_slots: jax.Array # uint32 — lanes retired by compaction
+    reclaimed_bytes: jax.Array # float32 — static bytes those lanes held
+    frontier_lag: jax.Array    # uint32 — max(top - stable frontier)
 
 
 def zeros() -> Telemetry:
@@ -90,6 +102,9 @@ def zeros() -> Telemetry:
         bytes_useful=jnp.zeros((), jnp.float32),
         residue=jnp.zeros((), jnp.int32),
         widen_pressure=jnp.zeros((), jnp.float32),
+        reclaimed_slots=jnp.zeros((), jnp.uint32),
+        reclaimed_bytes=jnp.zeros((), jnp.float32),
+        frontier_lag=jnp.zeros((), jnp.uint32),
     )
 
 
@@ -97,7 +112,7 @@ def specs() -> Telemetry:
     """shard_map out_specs: every field is a replicated scalar."""
     from jax.sharding import PartitionSpec as P
 
-    return Telemetry(P(), P(), P(), P(), P(), P(), P())
+    return Telemetry(*(P() for _ in Telemetry._fields))
 
 
 def combine(a: Telemetry, b: Telemetry) -> Telemetry:
@@ -110,9 +125,12 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         slots_changed=a.slots_changed + b.slots_changed,
         bytes_exchanged=a.bytes_exchanged + b.bytes_exchanged,
         bytes_useful=a.bytes_useful + b.bytes_useful,
+        reclaimed_slots=a.reclaimed_slots + b.reclaimed_slots,
+        reclaimed_bytes=a.reclaimed_bytes + b.reclaimed_bytes,
         deferred_depth=b.deferred_depth,
         residue=b.residue,
         widen_pressure=b.widen_pressure,
+        frontier_lag=b.frontier_lag,
     )
 
 
@@ -259,6 +277,9 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "bytes_useful": float(tel.bytes_useful),
         "residue": int(tel.residue),
         "widen_pressure": float(tel.widen_pressure),
+        "reclaimed_slots": int(tel.reclaimed_slots),
+        "reclaimed_bytes": float(tel.reclaimed_bytes),
+        "frontier_lag": int(tel.frontier_lag),
     }
 
 
@@ -276,9 +297,14 @@ def record(kind: str, tel: Telemetry) -> None:
         f"telemetry.{kind}.bytes_exchanged", int(d["bytes_exchanged"])
     )
     metrics.count(f"telemetry.{kind}.bytes_useful", int(d["bytes_useful"]))
+    metrics.count(f"telemetry.{kind}.reclaimed_slots", d["reclaimed_slots"])
+    metrics.count(
+        f"telemetry.{kind}.reclaimed_bytes", int(d["reclaimed_bytes"])
+    )
     metrics.observe(f"telemetry.{kind}.deferred_depth", d["deferred_depth"])
     metrics.observe(f"telemetry.{kind}.residue", d["residue"])
     metrics.observe(f"telemetry.{kind}.widen_pressure", d["widen_pressure"])
+    metrics.observe(f"telemetry.{kind}.frontier_lag", d["frontier_lag"])
 
 
 # ---- span tracing ---------------------------------------------------------

@@ -111,6 +111,9 @@ SLOW_NODEIDS = (
     # depth-4 single-shot fold gate stays (test_nest_depth4)
     "test_sparse_nest3.py::test_sparse_depth3_fold_matches_oracle",
     "test_nest_depth4.py::test_depth4_delta_exchange_converges",
+    # heaviest churn-reclamation gate (also @mark.slow in-file); the
+    # three per-kind churn legs in test_reclaim.py stay tier-1
+    "test_reclaim.py::test_churn_reclaim_long_mixed",
 )
 
 

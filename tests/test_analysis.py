@@ -25,9 +25,11 @@ import pytest
 from crdt_tpu.analysis import laws, fixtures
 from crdt_tpu.analysis.jit_lint import lint_callable, lint_entry_points
 from crdt_tpu.analysis.registry import (
+    compactors,
     entry_points,
     get_merge_kind,
     merge_kinds,
+    uncompactable_kinds,
     unregistered_entry_points,
 )
 from crdt_tpu.analysis.report import errors
@@ -81,6 +83,51 @@ def test_registry_covers_all_op_kinds_from_issue():
         "mvreg", "lwwreg", "sparse_orswot", "sparse_mvmap",
         "sparse_nested_map", "vclock",
     } <= set(KIND_NAMES)
+
+
+# ---- the compaction-invariance gate (reclaim/, ISSUE 5) --------------------
+
+@pytest.mark.parametrize("name", KIND_NAMES)
+def test_registered_kind_passes_compaction_invariance(name):
+    findings = laws.check_compaction_kind(get_merge_kind(name))
+    bad = errors(findings)
+    assert not bad, "\n".join(str(f) for f in bad)
+
+
+def test_every_merge_kind_has_a_compactor():
+    """The reclaim/ coverage contract: all 12 op kinds register a
+    compaction kernel (identity for the metadata-free kinds) — an
+    unregistered compactor fails discovery here, the same total-coverage
+    contract as joins and mesh entry points."""
+    assert uncompactable_kinds() == []
+    assert {c.name for c in compactors()} == set(KIND_NAMES)
+
+
+def test_unregistered_compactor_fails_the_law_gate():
+    """A merge kind the compactor registry does not know is a FAILURE
+    row in check_compaction_kind (coverage finding), not a silent gap."""
+    bogus = laws.MergeKind(
+        name="bogus_kind_without_compactor", join=jnp.maximum,
+        states=lambda: [jnp.uint32(v) for v in (0, 1, 2)],
+    )
+    checks = {f.check for f in errors(laws.check_compaction_kind(bogus))}
+    assert "compact-coverage" in checks
+
+
+def test_compaction_law_fires_on_lossy_compactor():
+    """The committed broken fixture: a compactor that discards
+    observable state must trip compact-read-invariance (and the honest
+    twin stays clean)."""
+    good = laws.check_compaction_kind(
+        fixtures.GOOD_MAX, comp=fixtures.GOOD_COMPACTOR
+    )
+    assert not errors(good), "\n".join(str(f) for f in good)
+    checks = {
+        f.check for f in errors(laws.check_compaction_kind(
+            fixtures.GOOD_MAX, comp=fixtures.LOSSY_COMPACTOR
+        ))
+    }
+    assert "compact-read-invariance" in checks
 
 
 # ---- law engine fires on broken merges ------------------------------------

@@ -177,6 +177,80 @@ def test_reduction_order_invariance_on_device(seed):
 
 
 @given(seeds)
+@settings(max_examples=10)
+def test_straggler_pins_frontier_and_compaction_stays_safe(seed):
+    """Reclaim under partition (ISSUE 5): a partitioned/straggler
+    replica PINS the stable frontier (it never advances past the
+    straggler's knowledge), frontier-driven compaction is a no-op for
+    every unstable parked slot, and post-heal convergence is
+    bit-identical to a never-compacted run."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from crdt_tpu import reclaim
+
+    rng = random.Random(seed)
+    n = 5
+    sites, _ = _mint_streams(rng, n, 14)
+    # The straggler (site 4) is partitioned BEFORE this remove: the rm
+    # ctx cites dots it will never see pre-heal, so the clock parks on
+    # whoever applies it and stays UNSTABLE while the partition holds.
+    live = [0, 1, 2, 3]
+    for a in live:
+        for b in live:
+            if a != b:
+                sites[a].merge(sites[b].clone())
+    if sites[0].read().val:  # remove churn alongside the parked clock
+        target = sorted(sites[0].read().val)[0]
+        sites[0].apply(sites[0].rm(target, sites[0].read().derive_rm_ctx()))
+    ghost = Orswot()
+    ghost.apply(ghost.add("never", ghost.read().derive_add_ctx("zz")))
+    parked = ghost.rm("never", ghost.contains("never").derive_rm_ctx())
+    sites[0].apply(parked)  # cites actor "zz": parks everywhere
+
+    model = BatchedOrswot.from_pure(
+        sites,
+        members=Interner(MEMBERS + ["p", "q", "never"]),
+        actors=Interner([f"s{i}" for i in range(n)] + ["zz"]),
+    )
+    untouched = BatchedOrswot.from_pure(
+        [s.clone() for s in sites],
+        members=model.members.clone(), actors=model.actors.clone(),
+    )
+
+    # The straggler's stale top pins the mesh frontier lane-wise.
+    frontier = reclaim.model_frontier(model)
+    straggler_top = np.asarray(model.state.top[4])
+    assert (frontier <= straggler_top).all()
+
+    # Compaction against the pinned frontier: every parked slot is
+    # unstable (the straggler never saw those rm clocks), so none may
+    # retire — and observable reads are untouched.
+    reads_before = [model.to_pure(i).read().val for i in range(n)]
+    parked_before = int(jnp.sum(model.state.dvalid))
+    assert parked_before >= 1
+    reclaim.compact_model(model, frontier)
+    assert int(jnp.sum(model.state.dvalid)) == parked_before
+    assert [model.to_pure(i).read().val for i in range(n)] == reads_before
+
+    # Heal: full anti-entropy sweeps; the compacted mesh must land
+    # bit-identically on the never-compacted one.
+    for m in (model, untouched):
+        for _ in range(2):
+            for dst in range(n):
+                for src in range(n):
+                    if src != dst:
+                        m.merge_from(dst, src)
+    assert all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(
+            jax.tree.leaves(model.state), jax.tree.leaves(untouched.state)
+        )
+    )
+
+
+@given(seeds)
 @settings(max_examples=10, deadline=None)
 def test_sparse_map_faulty_delivery_converges(seed):
     """The sparse register map under drop/duplicate/reorder delivery:

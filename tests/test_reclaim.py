@@ -1,0 +1,546 @@
+"""Causal-stability reclamation gates (crdt_tpu/reclaim/, ISSUE 5).
+
+Five contracts pinned here:
+
+1. ``stability=False`` adds zero cost: the gossip entry's lowered HLO is
+   IDENTICAL to the pre-flag program (same discipline as
+   ``telemetry=`` — tests/test_telemetry.py).
+2. ``stability=True`` returns the mesh-wide stable frontier — the
+   per-actor min over the tops each replica ENTERED with — and the
+   converged rows stay bit-identical to the flags-off run.
+3. Compaction retires only frontier-stable parked state and never
+   changes an observable read (the per-kind invariance law runs in
+   tests/test_analysis.py; here the model-level driver +
+   checkpoint compact-on-save).
+4. ``narrow``/``narrow_span`` are exact inverses of widen (bit-identical
+   round trip) and REFUSE when occupancy does not fit; the shrink
+   hysteresis fires only after K consecutive low-water rounds, never
+   below the floor, and a widening resets the streak.
+5. The long-churn acceptance workload (adds + removes over many gossip
+   rounds, dense + sparse ORSWOT and the sparse register map, run with
+   ``stability=`` on and a ``reclaim=`` hysteresis): occupancy-driven
+   shrink fires, end-state device bytes land strictly below the
+   never-reclaimed run's, and converged observable reads are
+   bit-identical to the flags-off run.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from crdt_tpu import elastic, reclaim
+from crdt_tpu.models.orswot import BatchedOrswot
+from crdt_tpu.models.sparse_mvmap import BatchedSparseMap
+from crdt_tpu.models.sparse_orswot import BatchedSparseOrswot
+from crdt_tpu.ops import orswot as ops
+from crdt_tpu.ops import sparse_orswot as sp
+from crdt_tpu.ops.pallas_kernels import fold_auto
+from crdt_tpu.parallel import gossip_elastic, make_mesh, mesh_gossip, shard_orswot
+from crdt_tpu.parallel.collectives import ring_round
+from crdt_tpu.parallel.mesh import ELEMENT_AXIS, REPLICA_AXIS, orswot_specs
+from crdt_tpu.pure.orswot import Orswot
+from crdt_tpu.utils.metrics import metrics
+from crdt_tpu.vclock import VClock
+
+from test_map import mv_map, put
+
+P_REPLICAS = 4
+
+
+def _trees_equal(a, b) -> bool:
+    return all(
+        x.dtype == y.dtype and x.shape == y.shape and bool((x == y).all())
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _state_bytes(state) -> int:
+    return sum(x.nbytes for x in jax.tree.leaves(state))
+
+
+def _commit(model, rows) -> None:
+    lead = jax.tree.leaves(model.state)[0].shape[0]
+    model.state = jax.tree.map(lambda x: x[:lead], rows)
+
+
+# ---- 1. flags-off HLO identity --------------------------------------------
+
+def test_stability_off_hlo_identical_to_preflag_program():
+    """``stability=False`` (the default) must trace EXACTLY the
+    pre-flag gossip program — reconstructed here as the flag-free
+    shard_map closure, compared by lowered HLO text."""
+    reps = [Orswot() for _ in range(4)]
+    for i, p in enumerate(reps):
+        p.apply(p.add(f"m{i}", p.read().derive_add_ctx(f"s{i}")))
+    batched = BatchedOrswot.from_pure(reps)
+    mesh = make_mesh(P_REPLICAS, 1)
+    sharded = shard_orswot(batched.state, mesh)
+    rounds = P_REPLICAS - 1
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(orswot_specs(),),
+        out_specs=(orswot_specs(), P()),
+        check_vma=False,
+    )
+    def gossip_fn(local):
+        fold_fn = partial(fold_auto, prefer="tree")
+        folded, of = fold_fn(local)
+        for _ in range(rounds):
+            folded, of_r = ring_round(
+                folded, REPLICA_AXIS, reduce_overflow=False, join_fn=ops.join
+            )
+            of = of | of_r
+        of = lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS)) > 0
+        return jax.tree.map(lambda x: x[None], folded), of
+
+    baseline = jax.jit(gossip_fn)
+    baseline_txt = jax.jit(lambda s: baseline(s)).lower(sharded).as_text()
+    entry_txt = jax.jit(
+        lambda s: mesh_gossip(
+            s, mesh, rounds=rounds, local_fold="tree",
+            telemetry=False, stability=False,
+        )
+    ).lower(sharded).as_text()
+    assert entry_txt == baseline_txt
+
+
+# ---- 2. the frontier --------------------------------------------------------
+
+def test_stability_frontier_and_rows_match_flags_off():
+    reps = [Orswot() for _ in range(4)]
+    for i, p in enumerate(reps):
+        for j in range(i + 1):
+            p.apply(p.add(f"m{i}_{j}", p.read().derive_add_ctx(f"s{i}")))
+    mesh = make_mesh(P_REPLICAS, 1)
+
+    dense = BatchedOrswot.from_pure(reps)
+    sharded = shard_orswot(dense.state, mesh)
+    rows0, _ = mesh_gossip(sharded, mesh, local_fold="tree")
+    rows1, _, frontier = mesh_gossip(
+        sharded, mesh, local_fold="tree", stability=True
+    )
+    assert _trees_equal(rows0, rows1)
+    np.testing.assert_array_equal(
+        np.asarray(frontier), np.asarray(sharded.top).min(axis=0)
+    )
+
+    # With telemetry too: the Telemetry pytree carries frontier_lag.
+    _, _, tel, frontier2 = mesh_gossip(
+        sharded, mesh, local_fold="tree", stability=True, telemetry=True
+    )
+    np.testing.assert_array_equal(np.asarray(frontier2), np.asarray(frontier))
+    # Lag is measured on the CONVERGED tops (all equal to the join).
+    joined_top = np.asarray(rows0.top).max(axis=0)
+    assert int(tel.frontier_lag) == int(
+        (joined_top - np.asarray(frontier)).max()
+    )
+
+
+def test_host_frontier_straggler_pins_and_pads():
+    """The host fallback: a straggler's stale top pins the min; ragged
+    actor widths pad with 0 (maximally conservative)."""
+    fast = np.array([5, 7, 9], np.uint32)
+    straggler = np.array([2, 3], np.uint32)  # never saw actor 2
+    f = reclaim.host_frontier([fast, straggler])
+    np.testing.assert_array_equal(f, np.array([2, 3, 0], np.uint32))
+    assert reclaim.host_frontier([]) is None
+
+
+def test_top_of_walks_wrapper_levels():
+    from crdt_tpu.ops import lwwreg
+    from crdt_tpu.ops import map3 as map3_ops
+    from crdt_tpu.ops import sparse_nest as nest_ops
+
+    s = map3_ops.empty(2, 2, 2, 3)
+    assert reclaim.top_of(s) is s.mo.core.top
+    n = nest_ops.empty_map_orswot(2, 8, 3)
+    assert reclaim.top_of(n) is n.core.top
+    assert reclaim.top_of(lwwreg.empty()) is None  # clockless kind
+
+
+# ---- 3. compaction ---------------------------------------------------------
+
+def _covered_parked_reps(n: int = 3):
+    """Replicas whose tops all cover one parked remove (the
+    checkpoint-restore shape: a paused replica saved a slot the mesh
+    has since caught up to; live states retire such slots at the next
+    join — compaction does it eagerly). The parked slot's member has no
+    live dots, so retiring it is invariant under every future op."""
+    site = Orswot()
+    add = site.add("m", site.read().derive_add_ctx("s0"))
+    reps = []
+    for _ in range(n):
+        r = Orswot()
+        r.apply(add)
+        reps.append(r)
+    reps[0].deferred[VClock({"s0": 1})] = {"dead"}
+    return reps
+
+
+def test_compact_model_retires_stable_parked_slot():
+    reps = _covered_parked_reps()
+    model = BatchedOrswot.from_pure(reps)
+    reads_before = [model.to_pure(i).read().val for i in range(3)]
+    metrics.reset()
+    stats = reclaim.compact_model(model)
+    assert stats["reclaimed_slots"] >= 1
+    assert int(jnp.sum(model.state.dvalid)) == 0  # the slot retired
+    assert [model.to_pure(i).read().val for i in range(3)] == reads_before
+    snap = metrics.snapshot()["counters"]
+    assert snap["reclaim.reclaimed_slots"] >= 1
+    assert snap["reclaim.reclaimed_slots.orswot"] >= 1
+
+    # Post-retirement convergence equals the never-compacted run's.
+    baseline = BatchedOrswot.from_pure(
+        _covered_parked_reps(),
+        members=model.members.clone(), actors=model.actors.clone(),
+    )
+    assert model.fold() == baseline.fold()
+
+
+def test_compact_model_respects_unstable_slots():
+    """A parked slot whose clock the frontier does NOT cover (phantom
+    actor — some replica never saw it) survives compaction untouched."""
+    reps = _covered_parked_reps()
+    reps[1].deferred[VClock({"ghost": 1})] = {"m"}
+    model = BatchedOrswot.from_pure(reps)
+    reclaim.compact_model(model)
+    assert int(jnp.sum(model.state.dvalid)) == 1  # ghost slot kept
+    assert len(model.to_pure(1).deferred) == 1
+
+
+def test_checkpoint_compact_on_save(tmp_path):
+    from crdt_tpu import checkpoint
+
+    model = BatchedOrswot.from_pure(_covered_parked_reps())
+    plain, compacted = tmp_path / "plain.npz", tmp_path / "compact.npz"
+    checkpoint.save(plain, model)
+    assert int(jnp.sum(model.state.dvalid)) == 1  # save alone is pure
+    checkpoint.save(compacted, model, compact=True)
+    restored = checkpoint.load(compacted)
+    assert int(jnp.sum(restored.state.dvalid)) == 0
+    # Same oracle form either way: only retired metadata differs.
+    assert checkpoint.load(plain).fold() == restored.fold()
+
+    # Unsupported kinds save as-is and count, never raise.
+    from crdt_tpu.models import BatchedGList
+
+    metrics.reset()
+    glist = BatchedGList(2)
+    checkpoint.save(tmp_path / "glist.npz", glist, compact=True)
+    assert metrics.snapshot()["counters"][
+        "reclaim.compact_on_save_unsupported"
+    ] == 1
+
+
+# ---- 4. narrow / shrink / hysteresis ---------------------------------------
+
+def test_narrow_is_exact_inverse_of_widen():
+    reps = [Orswot() for _ in range(3)]
+    for i, p in enumerate(reps):
+        p.apply(p.add(f"m{i}", p.read().derive_add_ctx(f"s{i}")))
+
+    dense = BatchedOrswot.from_pure(reps, deferred_cap=4)
+    before = dense.state
+    wide = ops.widen(before, n_elems=8, n_actors=8, deferred_cap=8)
+    back = ops.narrow(
+        wide,
+        n_elems=before.ctr.shape[-2],
+        n_actors=before.top.shape[-1],
+        deferred_cap=4,
+    )
+    assert _trees_equal(back, before)
+
+    sparse = BatchedSparseOrswot.from_pure(reps, dot_cap=8)
+    sbefore = sparse.state
+    swide = sp.widen(sbefore, dot_cap=32, deferred_cap=8, rm_width=16)
+    sback = sp.narrow(swide, dot_cap=8, deferred_cap=4, rm_width=8)
+    assert _trees_equal(sback, sbefore)
+
+
+def test_narrow_refuses_live_occupancy():
+    reps = [Orswot()]
+    for j in range(5):
+        reps[0].apply(reps[0].add(f"m{j}", reps[0].read().derive_add_ctx("s0")))
+    sparse = BatchedSparseOrswot.from_pure(reps, dot_cap=16)
+    with pytest.raises(ValueError, match="live"):
+        sp.narrow(sparse.state, dot_cap=4)  # 5 live dots do not fit 4
+    with pytest.raises(ValueError, match="grow"):
+        sp.narrow(sparse.state, dot_cap=32)
+    # The model layer also guards the interner tables: a lane an
+    # interned name owns must keep existing.
+    dense = BatchedOrswot.from_pure(reps, n_members=8)
+    with pytest.raises(ValueError, match="interned"):
+        dense.narrow_capacity(n_members=2)  # 5 members interned
+
+
+def test_narrow_span_round_trips_and_refuses():
+    from crdt_tpu.ops import sparse_nest as nest_ops
+
+    state = nest_ops.empty_map_orswot(4, 8, 2)
+    lvl = nest_ops.level_map_orswot(4)
+    s1, _ = lvl.apply_up_add(state, 0, jnp.uint32(1), jnp.array([0, 5, -1, -1], jnp.int32))
+    wide = nest_ops.widen_span(s1, 4, 8)
+    back = nest_ops.narrow_span(wide, 8, 4)
+    assert _trees_equal(back, s1)
+    with pytest.raises(ValueError, match="offsets"):
+        # offset 5 (eid 5 = key 1, offset 1 at span 4... use span 2:
+        # eid 5 -> offset 1 fits; eid with offset >= 2 must refuse)
+        nest_ops.narrow_span(s1, 4, 1)
+
+
+def test_hysteresis_fires_after_k_rounds_and_floor_holds():
+    reps = [Orswot()]
+    reps[0].apply(reps[0].add("m", reps[0].read().derive_add_ctx("s0")))
+    model = BatchedSparseOrswot.from_pure(reps, dot_cap=64)
+    policy = elastic.ElasticPolicy(
+        low_water=0.25, shrink_rounds=3, shrink_floor=8
+    )
+    h = elastic.Hysteresis(policy)
+    assert h.observe(model) == {}
+    assert h.observe(model) == {}
+    shrunk = h.observe(model)  # third consecutive low-water round
+    assert shrunk.get("dot_cap") == 32
+    # The floor is absolute: keep observing, never below 8 lanes.
+    for _ in range(20):
+        h.observe(model)
+    assert elastic.capacities(model)["dot_cap"] == 8
+
+    # A widening resets the streak.
+    h2 = elastic.Hysteresis(policy)
+    h2.observe(model)
+    h2.observe(model)
+    elastic.widen(model, ("dot_cap",))
+    assert h2.observe(model) == {}  # streak restarted, not fired
+    assert h2.observe(model) == {}
+    assert "dot_cap" in h2.observe(model)
+
+
+def test_shrink_emits_reclaim_metrics():
+    metrics.reset()
+    reps = [Orswot()]
+    reps[0].apply(reps[0].add("m", reps[0].read().derive_add_ctx("s0")))
+    model = BatchedSparseOrswot.from_pure(reps, dot_cap=64)
+    before = _state_bytes(model.state)
+    shrunk = elastic.shrink(model, ("dot_cap",))
+    assert shrunk == {"dot_cap": 32}
+    snap = metrics.snapshot()["counters"]
+    assert snap["reclaim.shrink_events"] == 1
+    assert snap["reclaim.shrink_events.sparse_orswot"] == 1
+    assert snap["reclaim.reclaimed_bytes"] == before - _state_bytes(model.state)
+    # Axes already at occupancy/floor are skipped, not errors.
+    assert elastic.shrink(model, ("n_actors",)) == {}
+
+
+# ---- 5. the long-churn acceptance workload ---------------------------------
+
+RECLAIM_POLICY = elastic.ElasticPolicy(
+    low_water=0.25, shrink_rounds=2, shrink_floor=2
+)
+
+
+def _gossip_round(model, mesh, *, hyst=None, stability=False):
+    """One elastic ring round; reclaim runs commit + maybe shrink
+    (gossip_elastic's reclaim path), flags-off runs commit manually."""
+    out = gossip_elastic(
+        model, mesh, stability=stability, reclaim=hyst,
+        policy=RECLAIM_POLICY,
+    )
+    if hyst is None:
+        _commit(model, out[0])
+    return out
+
+
+def _assert_churn_contract(model, base, peak_caps, shrink_axis, n):
+    """The acceptance checks shared by every churn leg."""
+    caps = elastic.capacities(model)
+    assert caps[shrink_axis] < peak_caps[shrink_axis], (
+        f"occupancy-driven shrink never fired on {shrink_axis}: "
+        f"{caps} vs peak {peak_caps}"
+    )
+    assert _state_bytes(model.state) < _state_bytes(base.state)
+    for i in range(n):
+        assert model.to_pure(i) == base.to_pure(i), (
+            f"replica {i}: reclaimed run diverged from flags-off run"
+        )
+
+
+def test_churn_reclaim_sparse_orswot():
+    mesh = make_mesh(4, 2)
+    reps = [Orswot() for _ in range(4)]
+    for i, p in enumerate(reps):
+        for j in range(3):
+            p.apply(p.add(f"m{i}_{j}", p.read().derive_add_ctx(f"s{i}")))
+    model = BatchedSparseOrswot.from_pure(reps, dot_cap=4)
+    base = BatchedSparseOrswot.from_pure(
+        reps, dot_cap=4,
+        members=model.members.clone(), actors=model.actors.clone(),
+    )
+    hyst = elastic.Hysteresis(RECLAIM_POLICY)
+
+    # Add burst: the 12-dot union overflows dot_cap=4 mid-gossip; both
+    # runs widen. The reclaim run also returns the frontier — computed
+    # over the tops each replica ENTERED with (pre-gossip knowledge).
+    entering_min = np.asarray(model.state.top).min(axis=0)
+    out = _gossip_round(model, mesh, hyst=hyst, stability=True)
+    widened, frontier = out[1], out[-1]
+    assert widened.get("dot_cap", 0) >= 12
+    np.testing.assert_array_equal(np.asarray(frontier), entering_min)
+    _gossip_round(base, mesh)
+    peak = dict(elastic.capacities(model))
+
+    # Remove churn: replica 0 observes-removes every member; gossip
+    # spreads the removal, live occupancy collapses to zero.
+    p0 = model.to_pure(0)
+    for m in sorted(p0.read().val):
+        rm = p0.rm(m, p0.contains(m).derive_rm_ctx())
+        p0.apply(rm)
+        model.apply(0, rm)
+        base.apply(0, rm)
+    # Quiet rounds: the hysteresis clears (2 consecutive low-water
+    # rounds) and shrink fires; the flags-off run only ever grows.
+    for _ in range(4):
+        _gossip_round(model, mesh, hyst=hyst, stability=True)
+        _gossip_round(base, mesh)
+
+    _assert_churn_contract(model, base, peak, "dot_cap", 4)
+    assert model.to_pure(0).read().val == set()
+
+
+def test_churn_reclaim_sparse_map():
+    mesh = make_mesh(4, 2)
+    pures = []
+    for i in range(4):
+        m = mv_map()
+        for j in range(3):
+            put(m, f"s{i}", f"k{i}_{j}", i * 10 + j)
+        pures.append(m)
+    model = BatchedSparseMap.from_pure(pures, cell_cap=4)
+    base = BatchedSparseMap.from_pure(
+        pures, cell_cap=4, keys=model.keys.clone(),
+        actors=model.actors.clone(), values=model.values.clone(),
+    )
+    hyst = elastic.Hysteresis(RECLAIM_POLICY)
+
+    out = _gossip_round(model, mesh, hyst=hyst, stability=True)
+    assert out[1].get("cell_cap", 0) >= 12
+    _gossip_round(base, mesh)
+    peak = dict(elastic.capacities(model))
+
+    p0 = model.to_pure(0)
+    for k in sorted(p0.keys()):
+        rm = p0.rm(k, p0.get(k).derive_rm_ctx())
+        p0.apply(rm)
+        model.apply(0, rm)
+        base.apply(0, rm)
+    for _ in range(4):
+        _gossip_round(model, mesh, hyst=hyst, stability=True)
+        _gossip_round(base, mesh)
+
+    _assert_churn_contract(model, base, peak, "cell_cap", 4)
+
+
+def test_churn_reclaim_dense_orswot():
+    """The dense leg reclaims the PARKED buffer: phantom-clock removes
+    force a deferred_cap widening; delivering the phantom adds lets the
+    tops catch up, joins retire the slots, and the hysteresis shrinks
+    the buffer back down — reads identical to the flags-off run."""
+    mesh = make_mesh(4, 2)
+    reps = [Orswot() for _ in range(4)]
+    ghosts = []
+    for i, p in enumerate(reps):
+        p.apply(p.add(f"m{i}", p.read().derive_add_ctx(f"s{i}")))
+        for j in range(2):
+            g = Orswot()
+            add = g.add(f"gm{i}{j}", g.read().derive_add_ctx(f"g{i}{j}"))
+            g.apply(add)
+            rm = g.rm(f"gm{i}{j}", g.contains(f"gm{i}{j}").derive_rm_ctx())
+            ghosts.append(add)
+            p.apply(rm)  # ahead of p's top: parks
+    floors = dict(n_members=16, n_actors=16)
+    model = BatchedOrswot.from_pure(reps, deferred_cap=2, **floors)
+    base = BatchedOrswot.from_pure(
+        reps, deferred_cap=2,
+        members=model.members.clone(), actors=model.actors.clone(),
+        **floors,
+    )
+    hyst = elastic.Hysteresis(RECLAIM_POLICY)
+
+    out = _gossip_round(model, mesh, hyst=hyst, stability=True)
+    assert out[1].get("deferred_cap", 0) >= 8  # 8 distinct parked clocks
+    _gossip_round(base, mesh)
+    peak = dict(elastic.capacities(model))
+
+    # Deliver the phantom adds: tops catch up, parked slots retire at
+    # the next joins (and the parked removes kill the ghost members).
+    for add in ghosts:
+        model.apply(0, add)
+        base.apply(0, add)
+    for _ in range(4):
+        _gossip_round(model, mesh, hyst=hyst, stability=True)
+        _gossip_round(base, mesh)
+
+    _assert_churn_contract(model, base, peak, "deferred_cap", 4)
+    assert int(jnp.sum(model.state.dvalid)) == 0
+    assert model.to_pure(0).read().val == {f"m{i}" for i in range(4)}
+
+
+@pytest.mark.slow
+def test_churn_reclaim_long_mixed():
+    """The heavyweight churn gate (slow tier; the three per-kind legs
+    above are its faster in-tier cousins): more replicas, more rounds,
+    interleaved add/remove waves — shrink must fire at least once, the
+    end-state bytes must undercut the never-reclaimed run, and every
+    replica's converged read must match flags-off bit for bit."""
+    n = 4  # one replica per mesh rank: rows commit round-trip exactly
+    mesh = make_mesh(n, 2)
+    rng = np.random.default_rng(20260803)
+    reps = [Orswot() for _ in range(n)]
+    model = BatchedSparseOrswot.from_pure(reps, dot_cap=4, n_actors=4)
+    base = BatchedSparseOrswot.from_pure(
+        reps, dot_cap=4, n_actors=4,
+        members=model.members.clone(), actors=model.actors.clone(),
+    )
+    hyst = elastic.Hysteresis(RECLAIM_POLICY)
+    peak = {}
+    for wave in range(3):
+        # Add wave: every replica mints fresh members.
+        for i in range(n):
+            p = model.to_pure(i)
+            for k in range(4):
+                a = p.add(
+                    f"w{wave}_r{i}_{k}", p.read().derive_add_ctx(f"s{i}")
+                )
+                p.apply(a)
+                model.apply(i, a)
+                base.apply(i, a)
+        _gossip_round(model, mesh, hyst=hyst, stability=True)
+        _gossip_round(base, mesh)
+        for axis, cap in elastic.capacities(model).items():
+            peak[axis] = max(peak.get(axis, 0), cap)
+        # Remove wave: replica (wave mod n) clears a random majority.
+        i = wave % n
+        p = model.to_pure(i)
+        victims = [v for v in sorted(p.read().val) if rng.random() < 0.8]
+        for v in victims:
+            rm = p.rm(v, p.contains(v).derive_rm_ctx())
+            p.apply(rm)
+            model.apply(i, rm)
+            base.apply(i, rm)
+        for _ in range(2):
+            _gossip_round(model, mesh, hyst=hyst, stability=True)
+            _gossip_round(base, mesh)
+    for _ in range(3):  # drain: let the hysteresis clear
+        _gossip_round(model, mesh, hyst=hyst, stability=True)
+        _gossip_round(base, mesh)
+    assert elastic.capacities(model)["dot_cap"] < peak["dot_cap"]
+    assert _state_bytes(model.state) < _state_bytes(base.state)
+    for i in range(n):
+        assert model.to_pure(i) == base.to_pure(i)
