@@ -95,6 +95,34 @@ CHUNK = int(os.environ.get("BENCH_CHUNK", 512))
 R_CPU = int(os.environ.get("BENCH_CPU_REPLICAS", 4))
 ITERS = int(os.environ.get("BENCH_ITERS", 3))
 
+_CONFIGS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_CONFIGS.json"
+)
+_CONFIGS_CACHE = None
+
+
+def bench_configs() -> dict:
+    """The committed shape configs (BENCH_CONFIGS.json) — one source of
+    truth for the sparse legs and the flagship streaming leg, shared
+    with tools/run_tpu_checks.py so hardware replays run the exact
+    committed shapes. Results no longer live in this file (they go to
+    BENCH_RECORDS.json)."""
+    global _CONFIGS_CACHE
+    if _CONFIGS_CACHE is None:
+        with open(_CONFIGS_PATH) as f:
+            _CONFIGS_CACHE = json.load(f)
+    return _CONFIGS_CACHE
+
+
+def _cfg(leg: str, key: str, env: str, cpu_fallback: bool = False) -> int:
+    """One leg shape knob: env var > cpu_fallback sub-block (when the
+    leg runs on the CPU stand-in) > the committed config value."""
+    cfg = bench_configs()[leg]
+    val = cfg[key]
+    if cpu_fallback:
+        val = cfg.get("cpu_fallback", {}).get(key, val)
+    return int(os.environ.get(env, val))
+
 
 def make_arrays(r, e=None):
     """Host-side (numpy) replica states for the CPU oracle baseline."""
@@ -931,17 +959,20 @@ def bench_list():
 
 def bench_sparse():
     """Sparse leg (diagnostic, stderr): segment-encoded ORSWOT fold at a
-    universe the dense cube could never hold (default 1M elements; cost
-    scales by LIVE dots, not universe). Also times the element-sharded
-    nested (Map<K, Orswot>) mesh fold on the available devices."""
+    universe the dense cube could never hold (cost scales by LIVE dots,
+    not universe). Shape comes from BENCH_CONFIGS.json's ``sparse``
+    entry (env overrides; the CPU stand-in takes the ``cpu_fallback``
+    sub-block) — one source of truth with the flagship leg and
+    tools/run_tpu_checks.py."""
     import jax
     import jax.numpy as jnp
 
     from crdt_tpu.ops import sparse_orswot as sp
 
-    r = int(os.environ.get("BENCH_SPARSE_REPLICAS", 256))
-    cap = int(os.environ.get("BENCH_SPARSE_DOTS", 4096))
-    universe = int(os.environ.get("BENCH_SPARSE_UNIVERSE", 1_000_000))
+    cpu = os.environ.get("BENCH_CPU_FALLBACK") == "1"
+    r = _cfg("sparse", "replicas", "BENCH_SPARSE_REPLICAS", cpu)
+    cap = _cfg("sparse", "dot_cap", "BENCH_SPARSE_DOTS", cpu)
+    universe = _cfg("sparse", "universe", "BENCH_SPARSE_UNIVERSE", cpu)
     rng = np.random.default_rng(7)
 
     # Random live cells: unique (eid, actor) per replica in canonical
@@ -977,7 +1008,7 @@ def bench_sparse():
     nbytes = sum(x.nbytes for x in jax.tree.leaves(state))
     dense_bytes = r * universe * A * 4
 
-    passes = int(os.environ.get("BENCH_SPARSE_PASSES", 4))
+    passes = _cfg("sparse", "passes", "BENCH_SPARSE_PASSES", cpu)
     run = _fold_k_runner(sp.fold, sp.join, state)
     dt_k, degraded = marginal_time(run, passes, "config-sparse fold")
     dt = dt_k / passes
@@ -1009,10 +1040,11 @@ def bench_sparse_map():
 
     from crdt_tpu.ops import sparse_mvmap as smv
 
-    r = int(os.environ.get("BENCH_SMAP_REPLICAS", 256))
-    cap = int(os.environ.get("BENCH_SMAP_CELLS", 2048))
-    universe = int(os.environ.get("BENCH_SMAP_UNIVERSE", 100_000_000))
-    s_cap = 8
+    cpu = os.environ.get("BENCH_CPU_FALLBACK") == "1"
+    r = _cfg("sparse_map", "replicas", "BENCH_SMAP_REPLICAS", cpu)
+    cap = _cfg("sparse_map", "cell_cap", "BENCH_SMAP_CELLS", cpu)
+    universe = _cfg("sparse_map", "universe", "BENCH_SMAP_UNIVERSE", cpu)
+    s_cap = _cfg("sparse_map", "sibling_cap", "BENCH_SMAP_SIBLINGS", cpu)
     rng = np.random.default_rng(11)
 
     # Causally-consistent cells: unique (kid, act) per replica (dup keys
@@ -1059,7 +1091,7 @@ def bench_sparse_map():
     # actual-bytes convention on the sparse side)
     dense_bytes = r * universe * (3 * s_cap * 4 + s_cap * A * 4 + s_cap)
 
-    passes = int(os.environ.get("BENCH_SMAP_PASSES", 4))
+    passes = _cfg("sparse_map", "passes", "BENCH_SMAP_PASSES", cpu)
     run = _fold_k_runner(
         lambda st: smv.fold(st, sibling_cap=s_cap),
         lambda a, b: smv.join(a, b, sibling_cap=s_cap),
@@ -1082,6 +1114,248 @@ def bench_sparse_map():
         "timing": "relay-bound" if degraded else "marginal",
         "degraded": degraded,
         "shape": f"{r}x{cap}x{A}",
+    }
+
+
+def _flagship_population(c: int, universe: int, n_actors: int, seed: int = 13):
+    """The flagship workload's master live-dot table and per-replica
+    cut rule — a causally VALID arbitrary-N population with O(C) host
+    state, so any replica block can be generated on demand.
+
+    Construction: one global table of ``c`` live (element, actor) cells
+    sampled from ``universe``, sorted canonically by (eid, act), with
+    counter ``g = eid * A + act + 1`` — strictly increasing along the
+    lane order for every actor. Replica ``r`` holds the first
+    ``L_r ∈ [c/2, c]`` lanes (a deterministic hash of r): for each
+    actor that is a PREFIX of its counter sequence, so per-actor prefix
+    closure holds (the state is reachable by applying that actor's add
+    ops in order) and the join is a true lattice on the whole
+    population — the streamed fold is bit-identical to any co-resident
+    or oracle fold order. The converged union is exactly the full
+    table, so an accumulator at ``dot_cap == c`` never overflows.
+
+    Returns ``(gen, per_replica_bytes)`` where ``gen(global_row_ids)``
+    is a jitted device-side block generator — the stand-in for a real
+    stream source (DCN receive, host shards, checkpoint reader)."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.ops import sparse_orswot as sp
+
+    rng = np.random.default_rng(seed)
+    eids = np.sort(rng.choice(universe, size=c, replace=False).astype(np.int64))
+    acts = rng.integers(0, n_actors, c).astype(np.int32)
+    order = np.lexsort((acts, eids))
+    eids, acts = eids[order], acts[order]
+    ctrs = (eids * n_actors + acts + 1).astype(np.uint32)
+    # Per-lane running top: top of a replica holding lanes [0, L) is
+    # cummax[L-1] — one gather per block row.
+    cummax = np.zeros((c, n_actors), np.uint32)
+    run = np.zeros(n_actors, np.uint32)
+    for i in range(c):
+        run[acts[i]] = max(run[acts[i]], ctrs[i])
+        cummax[i] = run
+    m_eid = jnp.asarray(eids.astype(np.int32))
+    m_act = jnp.asarray(acts)
+    m_ctr = jnp.asarray(ctrs)
+    m_top = jnp.asarray(cummax)
+    half = c // 2
+
+    @jax.jit
+    def gen(row_ids):
+        """[B] global replica indices -> canonical SparseOrswotState
+        [B, ...] (dead tail, sorted lanes — join-ready as generated)."""
+        cut = half + (
+            row_ids.astype(jnp.uint32) * jnp.uint32(2654435761)
+        ) % jnp.uint32(max(c - half + 1, 1))
+        lanes = jnp.arange(c)
+        valid = lanes[None, :] < cut[:, None]
+        state = sp.empty(c, n_actors, batch=(row_ids.shape[0],))
+        return state._replace(
+            top=m_top[cut.astype(jnp.int32) - 1],
+            eid=jnp.where(valid, m_eid[None], -1),
+            act=jnp.where(valid, m_act[None], 0),
+            ctr=jnp.where(valid, m_ctr[None], 0),
+            valid=valid,
+        )
+
+    one = gen(jnp.arange(1))
+    per_replica = sum(x.nbytes for x in jax.tree.leaves(one))
+    return gen, per_replica
+
+
+def _flagship_bit_identity(mesh) -> dict:
+    """The flagship's correctness gate at a SUBSAMPLED shape: the same
+    population construction, small enough for (a) the co-resident
+    one-shot fold and (b) the sequential pure-oracle merge chain, both
+    compared bit-identically against the streamed fold (and the stream
+    re-run at a different block size — block-count invariance). Runs
+    before any number is reported; a streamed result that changed the
+    lattice would be a bug, not a throughput win."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.ops import sparse_orswot as sp
+    from crdt_tpu.parallel import mesh_stream_fold_sparse
+
+    sub_r, sub_c, sub_uni = 24, 64, 4096
+    actors = _cfg("flagship", "actors", "BENCH_FLAGSHIP_ACTORS")
+    gen, _ = _flagship_population(sub_c, sub_uni, actors, seed=17)
+    blocks8 = [gen(jnp.arange(i, i + 8)) for i in range(0, sub_r, 8)]
+    pop = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *blocks8)
+
+    acc, of, tel = mesh_stream_fold_sparse(
+        iter(blocks8), mesh, telemetry=True
+    )
+    assert not bool(jnp.any(of)), "flagship subsample overflowed"
+    acc4, _ = mesh_stream_fold_sparse(
+        (jax.tree.map(lambda x: x[i: i + 4], pop) for i in range(0, sub_r, 4)),
+        mesh,
+    )
+    coresident, _ = sp.fold(pop)
+    stream_ok = all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(acc), jax.tree.leaves(coresident))
+    )
+    invariant = all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(acc), jax.tree.leaves(acc4))
+    )
+
+    # Pure-oracle chain: replica dicts merged sequentially.
+    from crdt_tpu.pure.orswot import Orswot
+    from crdt_tpu.vclock import VClock
+
+    def pure_of(row) -> Orswot:
+        o = Orswot()
+        o.clock = VClock({
+            a: int(cv) for a, cv in enumerate(np.asarray(row.top)) if cv
+        })
+        eid = np.asarray(row.eid)
+        act = np.asarray(row.act)
+        ctr = np.asarray(row.ctr)
+        for s in np.nonzero(np.asarray(row.valid))[0]:
+            entry = o.entries.setdefault(int(eid[s]), VClock())
+            entry.dots[int(act[s])] = int(ctr[s])
+        return o
+
+    oracle = Orswot()
+    for i in range(sub_r):
+        oracle.merge(pure_of(jax.tree.map(lambda x: x[i], pop)))
+    oracle_ok = pure_of(acc) == oracle
+
+    return {
+        "subsample_shape": f"{sub_r}x{sub_uni}",
+        "stream_equals_coresident": stream_ok,
+        "block_count_invariant": invariant,
+        "stream_equals_pure_oracle": oracle_ok,
+        "bit_identical": stream_ok and invariant and oracle_ok,
+    }
+
+
+def bench_flagship():
+    """THE flagship leg (``--flagship`` runs it alone): merges/sec
+    across 10,240 replicas over a 1M-element universe — BASELINE's
+    literal metric of record, never before produced at shape. The
+    population streams through the mesh as replica blocks
+    (crdt_tpu/parallel/stream.py: donated accumulator aliased in
+    place, double-buffered staging), so peak device-resident replica
+    state is two blocks plus the accumulator — independent of N —
+    while the co-resident equivalent would hold the whole batch.
+
+    Shape comes from BENCH_CONFIGS.json's ``flagship`` entry
+    (tools/run_tpu_checks.py replays it verbatim on hardware; env
+    overrides, CPU stand-in takes ``cpu_fallback``). Timing is the
+    K-vs-2K marginal over whole stream passes (``marginal_time``) —
+    relay-bound fallbacks are labeled ``degraded`` and can never pass
+    as a clean chip number. Blocks are device-generated per index (a
+    real deployment would receive them over DCN/ICI; multi-GB host
+    pushes over the relay are both slow and a wedge risk — the
+    ``bench_tpu`` precedent), each block a DISTINCT replica slice of a
+    causally valid population (``_flagship_population``). Before any
+    number is reported, the same construction at a subsampled shape is
+    gated bit-identical against the co-resident fold, a different
+    block size, and the sequential pure-oracle merge chain."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.parallel import make_mesh, mesh_stream_fold_sparse
+    from crdt_tpu.utils.metrics import metrics, state_nbytes
+
+    cpu = os.environ.get("BENCH_CPU_FALLBACK") == "1"
+    r_total = _cfg("flagship", "replicas", "BENCH_FLAGSHIP_REPLICAS", cpu)
+    universe = _cfg("flagship", "universe", "BENCH_FLAGSHIP_UNIVERSE", cpu)
+    cap = _cfg("flagship", "segment_cap", "BENCH_FLAGSHIP_SEGMENT_CAP", cpu)
+    actors = _cfg("flagship", "actors", "BENCH_FLAGSHIP_ACTORS", cpu)
+    block_rows = _cfg(
+        "flagship", "block_rows", "BENCH_FLAGSHIP_BLOCK_ROWS", cpu
+    )
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_dev, 1)
+    block_rows += (-block_rows) % n_dev
+    n_blocks = max(-(-r_total // block_rows), 1)
+    r_run = n_blocks * block_rows
+
+    gate = _flagship_bit_identity(mesh)
+    assert gate["bit_identical"], f"flagship bit-identity gate failed: {gate}"
+    log(f"flagship bit-identity gate passed ({gate['subsample_shape']})")
+
+    gen, per_replica_bytes = _flagship_population(cap, universe, actors)
+
+    def blocks():
+        for b in range(n_blocks):
+            yield gen(jnp.arange(b * block_rows, (b + 1) * block_rows))
+
+    # One telemetry pass (untimed — the flag changes the step program)
+    # for the stream counters and the residency accounting.
+    acc, of, tel = mesh_stream_fold_sparse(blocks(), mesh, telemetry=True)
+    assert not bool(jnp.any(of)), "flagship stream overflowed its caps"
+    block_bytes = float(tel.stream_staged_bytes) / max(n_blocks, 1)
+    acc_bytes = state_nbytes(acc)
+    # Peak residency: the staged block, the double-buffered next block,
+    # the generator's output buffer, and the accumulator.
+    peak_resident = int(3 * block_bytes + acc_bytes)
+    coresident = r_run * per_replica_bytes
+    live = int(jnp.sum(acc.valid))
+
+    def run(k: int):
+        out = None
+        for _ in range(k):
+            out = mesh_stream_fold_sparse(blocks(), mesh)
+        return out
+
+    dt, degraded = marginal_time(run, 1, "flagship stream", iters=ITERS)
+    mps = (r_run - 1) / dt
+    metrics.observe("bench.flagship_merges_per_sec", mps)
+    log(
+        f"config-flagship: {r_run} replicas x {universe:,}-element universe "
+        f"streamed as {n_blocks} blocks of {block_rows} (cap {cap}): "
+        f"{dt*1e3:.1f} ms/stream -> {mps:,.0f} merges/s; resident "
+        f"{peak_resident/1e6:.1f} MB vs co-resident "
+        f"{coresident/1e6:.1f} MB ({coresident/max(peak_resident, 1):.1f}x); "
+        f"staged {float(tel.stream_staged_bytes)/1e6:.1f} MB, overlap hits "
+        f"{int(tel.stream_overlap_hit)}"
+        + (" [relay-bound]" if degraded else "")
+    )
+    return {
+        "config": "flagship", "metric": "orswot_merges_per_sec",
+        "value": round(mps, 1), "unit": "merges/s",
+        "shape": f"{r_total}x{universe}",
+        "replicas_run": r_run, "blocks": n_blocks,
+        "block_rows": block_rows, "segment_cap": cap, "actors": actors,
+        "live_dots": live,
+        "path": "stream",
+        "block_source": "device-generated (distinct replica slices; "
+                        "relay-safe — see bench_tpu's staging note)",
+        "staged_bytes": float(tel.stream_staged_bytes),
+        "overlap_hit": int(tel.stream_overlap_hit),
+        "peak_device_resident_bytes": peak_resident,
+        "coresident_equiv_bytes": coresident,
+        "resident_reduction": round(coresident / max(peak_resident, 1), 1),
+        "oracle_gate": gate,
+        "bit_identical": gate["bit_identical"],
+        "timing": "relay-bound" if degraded else "marginal",
+        "degraded": degraded,
     }
 
 
@@ -1161,6 +1435,13 @@ def parse_args(argv=None):
              "add/rm workload with stability= on and the shrink "
              "hysteresis) and print its record to stdout",
     )
+    ap.add_argument(
+        "--flagship",
+        action="store_true",
+        help="run ONLY the flagship replica-streaming leg (10,240 "
+             "replicas x 1M elements through parallel/stream.py, shape "
+             "from BENCH_CONFIGS.json) and print its record to stdout",
+    )
     return ap.parse_args(argv)
 
 
@@ -1168,6 +1449,24 @@ def main(argv=None):
     global R, E, CHUNK
     args = parse_args(argv)
     degraded = False
+    if args.flagship:
+        # The fast flagship-only mode: one leg, one stdout JSON line.
+        if os.environ.get("BENCH_PROBE", "1") != "0" and not tpu_reachable():
+            from crdt_tpu.utils.cpu_pin import pin_cpu
+
+            pin_cpu(virtual_devices=8)
+            os.environ["BENCH_CPU_FALLBACK"] = "1"
+        from crdt_tpu.telemetry import span
+
+        with span("bench.flagship", quick=True):
+            rec = bench_flagship()
+        rec["degraded"] = bool(
+            rec.get("degraded", False)
+            or os.environ.get("BENCH_CPU_FALLBACK") == "1"
+        )
+        log(json.dumps(rec))
+        print(json.dumps(rec))
+        return
     if args.reclaim:
         # The fast reclaim-only mode: one leg, one stdout JSON line.
         if os.environ.get("BENCH_PROBE", "1") != "0" and not tpu_reachable():
@@ -1217,10 +1516,9 @@ def main(argv=None):
             os.environ[var] = str(min(int(os.environ.get(var, cpu_cap)), cpu_cap))
     records = []
     if degraded:
-        os.environ.setdefault("BENCH_SPARSE_REPLICAS", "32")
-        os.environ.setdefault("BENCH_SPARSE_DOTS", "512")
-        os.environ.setdefault("BENCH_SMAP_REPLICAS", "32")
-        os.environ.setdefault("BENCH_SMAP_CELLS", "512")
+        # The sparse/flagship legs read their scaled CPU stand-in
+        # shapes from BENCH_CONFIGS.json's cpu_fallback blocks.
+        os.environ["BENCH_CPU_FALLBACK"] = "1"
     from crdt_tpu.telemetry import span
 
     for name, fn in [
@@ -1229,6 +1527,7 @@ def main(argv=None):
         ("list", bench_list),
         ("sparse", bench_sparse),
         ("sparse_map", bench_sparse_map),
+        ("flagship", bench_flagship),
         ("elastic", bench_elastic),
         ("comms", bench_comms),
         ("reclaim", bench_reclaim),
@@ -1326,6 +1625,19 @@ def main(argv=None):
                 "end_state_bytes_never_reclaimed", "bit_identical",
             ) if k in rc
         }
+    # The flagship streaming record rides the headline too: it IS the
+    # metric of record at the north-star shape (ROADMAP item 1) — the
+    # driver captures only the headline into BENCH_r*.json.
+    fl = next((r for r in records if r.get("config") == "flagship"), None)
+    if fl is not None:
+        headline["flagship"] = {
+            k: fl[k] for k in (
+                "value", "shape", "blocks", "block_rows", "segment_cap",
+                "staged_bytes", "overlap_hit", "peak_device_resident_bytes",
+                "coresident_equiv_bytes", "resident_reduction",
+                "bit_identical", "timing", "degraded",
+            ) if k in fl
+        }
     records.append({"config": 3, **headline})
     # Per-config JSON lines (machine-readable) on stderr + a sidecar
     # file; stdout stays EXACTLY one line — the driver's contract. A
@@ -1335,11 +1647,15 @@ def main(argv=None):
         rec["degraded"] = bool(rec.get("degraded", False) or degraded)
         log(json.dumps(rec))
     try:
+        # Per-run RESULT records go to BENCH_RECORDS.json (gitignored);
+        # BENCH_CONFIGS.json is the COMMITTED shape-config input now —
+        # clobbering it with results would destroy the shared source of
+        # truth the sparse/flagship legs and run_tpu_checks read.
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_CONFIGS.json"), "w") as f:
+                               "BENCH_RECORDS.json"), "w") as f:
             json.dump(records, f, indent=1)
     except OSError as exc:
-        log(f"could not write BENCH_CONFIGS.json: {exc!r}")
+        log(f"could not write BENCH_RECORDS.json: {exc!r}")
     if args.metrics_out:
         try:
             n = exporter.drain_jsonl(args.metrics_out, snapshot=snapshot)

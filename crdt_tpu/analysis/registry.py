@@ -120,8 +120,12 @@ _COMPACT: Dict[str, Compactor] = {}
 # Public callables in crdt_tpu.parallel matching this are mesh entry
 # points and MUST be registered (gossip_elastic/delta_gossip_elastic are
 # retry wrappers over already-registered kinds; run_delta_ring is the
-# generic engine the registered δ flavors instantiate).
-ENTRY_NAME_RE = re.compile(r"^mesh_(gossip|fold|delta_gossip)")
+# generic engine the registered δ flavors instantiate). mesh_stream*
+# covers the replica-streaming fold family (parallel/stream.py): an
+# unregistered public mesh_stream symbol fails discovery exactly like a
+# forgotten gossip/fold entry — tools/run_static_checks.py's jit-lint
+# and aliasing sections both iterate this.
+ENTRY_NAME_RE = re.compile(r"^mesh_(gossip|fold|delta_gossip|stream)")
 
 
 def register_merge(

@@ -99,6 +99,14 @@ from .sparse_shard import (
     split_nested,
     split_segments,
 )
+from .stream import (
+    StreamInterrupted,
+    iter_blocks,
+    mesh_stream_fold,
+    mesh_stream_fold_sparse,
+    mesh_stream_fold_sparse_mvmap,
+    mesh_stream_fold_sparse_sharded,
+)
 from .delta_ring import delta_gossip_elastic
 from .delta import (
     DeltaPacket,
@@ -135,6 +143,12 @@ __all__ = [
     "multihost",
     "delta_gossip_elastic",
     "gossip_elastic",
+    "StreamInterrupted",
+    "iter_blocks",
+    "mesh_stream_fold",
+    "mesh_stream_fold_sparse",
+    "mesh_stream_fold_sparse_mvmap",
+    "mesh_stream_fold_sparse_sharded",
     "DeltaPacket",
     "apply_delta",
     "dirty_between",

@@ -103,10 +103,14 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         ),
         widen_pressure=lax.pmax(tele.device_pressure(folded), both),
         # The reclaim fields are zero unless the stability path fills
-        # them in (gossip_stab_fn's _replace).
+        # them in (gossip_stab_fn's _replace); the stream fields are
+        # filled host-side by the block loop (parallel/stream.py).
         reclaimed_slots=jnp.zeros((), jnp.uint32),
         reclaimed_bytes=jnp.zeros((), jnp.float32),
         frontier_lag=jnp.zeros((), jnp.uint32),
+        stream_blocks=jnp.zeros((), jnp.uint32),
+        stream_staged_bytes=jnp.zeros((), jnp.float32),
+        stream_overlap_hit=jnp.zeros((), jnp.uint32),
     )
 
 

@@ -50,9 +50,19 @@ shipped and sync metadata per round), measured natively per round:
   ``top - stable_frontier`` (0 = fully stable mesh); a lag growing
   under steady traffic means a straggler is pinning the frontier and
   reclamation has stalled (reclaim/frontier.py).
+- ``stream_blocks`` / ``stream_staged_bytes`` / ``stream_overlap_hit``
+  — the replica-streaming fold's accounting (parallel/stream.py; the
+  registry twins are ``stream.blocks`` / ``stream.staged_bytes`` /
+  ``stream.overlap_hit``): blocks streamed through the accumulator,
+  total bytes staged into device memory for them, and stagings whose
+  upload was issued while the previous block's join was still in
+  flight (the double-buffer overlap actually landing). Filled by the
+  stream driver host-side — the per-block loop lives outside the
+  kernels — and 0 on every non-streaming entry point.
 
-Every field is a replicated scalar, so the pytree costs ten words of
-output and no extra collectives beyond one psum/pmax fusion group.
+Every field is a replicated scalar, so the whole pytree costs one word
+of output per field and no extra collectives beyond one psum/pmax
+fusion group.
 
 Span tracing (:func:`span`) is the host-side half: a context manager
 that emits structured JSONL trace events (``configure_tracing`` points
@@ -90,6 +100,9 @@ class Telemetry(NamedTuple):
     reclaimed_slots: jax.Array # uint32 — lanes retired by compaction
     reclaimed_bytes: jax.Array # float32 — static bytes those lanes held
     frontier_lag: jax.Array    # uint32 — max(top - stable frontier)
+    stream_blocks: jax.Array   # uint32 — replica blocks streamed
+    stream_staged_bytes: jax.Array # float32 — bytes staged for blocks
+    stream_overlap_hit: jax.Array  # uint32 — overlapped block uploads
 
 
 def zeros() -> Telemetry:
@@ -105,6 +118,9 @@ def zeros() -> Telemetry:
         reclaimed_slots=jnp.zeros((), jnp.uint32),
         reclaimed_bytes=jnp.zeros((), jnp.float32),
         frontier_lag=jnp.zeros((), jnp.uint32),
+        stream_blocks=jnp.zeros((), jnp.uint32),
+        stream_staged_bytes=jnp.zeros((), jnp.float32),
+        stream_overlap_hit=jnp.zeros((), jnp.uint32),
     )
 
 
@@ -127,6 +143,9 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         bytes_useful=a.bytes_useful + b.bytes_useful,
         reclaimed_slots=a.reclaimed_slots + b.reclaimed_slots,
         reclaimed_bytes=a.reclaimed_bytes + b.reclaimed_bytes,
+        stream_blocks=a.stream_blocks + b.stream_blocks,
+        stream_staged_bytes=a.stream_staged_bytes + b.stream_staged_bytes,
+        stream_overlap_hit=a.stream_overlap_hit + b.stream_overlap_hit,
         deferred_depth=b.deferred_depth,
         residue=b.residue,
         widen_pressure=b.widen_pressure,
@@ -280,6 +299,9 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "reclaimed_slots": int(tel.reclaimed_slots),
         "reclaimed_bytes": float(tel.reclaimed_bytes),
         "frontier_lag": int(tel.frontier_lag),
+        "stream_blocks": int(tel.stream_blocks),
+        "stream_staged_bytes": float(tel.stream_staged_bytes),
+        "stream_overlap_hit": int(tel.stream_overlap_hit),
     }
 
 
@@ -300,6 +322,14 @@ def record(kind: str, tel: Telemetry) -> None:
     metrics.count(f"telemetry.{kind}.reclaimed_slots", d["reclaimed_slots"])
     metrics.count(
         f"telemetry.{kind}.reclaimed_bytes", int(d["reclaimed_bytes"])
+    )
+    metrics.count(f"telemetry.{kind}.stream.blocks", d["stream_blocks"])
+    metrics.count(
+        f"telemetry.{kind}.stream.staged_bytes",
+        int(d["stream_staged_bytes"]),
+    )
+    metrics.count(
+        f"telemetry.{kind}.stream.overlap_hit", d["stream_overlap_hit"]
     )
     metrics.observe(f"telemetry.{kind}.deferred_depth", d["deferred_depth"])
     metrics.observe(f"telemetry.{kind}.residue", d["residue"])

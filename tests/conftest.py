@@ -114,6 +114,10 @@ SLOW_NODEIDS = (
     # heaviest churn-reclamation gate (also @mark.slow in-file); the
     # three per-kind churn legs in test_reclaim.py stay tier-1
     "test_reclaim.py::test_churn_reclaim_long_mixed",
+    # heaviest streaming gate (widen + reclaim + telemetry over 24
+    # replicas); block-count invariance, widen, reclaim, and counter
+    # laws each have a faster in-tier cousin in test_stream.py
+    "test_stream.py::test_stream_combined_widen_reclaim_large",
 )
 
 

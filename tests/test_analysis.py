@@ -240,6 +240,26 @@ def test_unregistered_entry_point_fails_the_gate(monkeypatch):
     assert any(k == "mesh_gossip_bogus" and not ok for k, ok, _ in results)
 
 
+def test_unregistered_stream_entry_point_fails_the_gate(monkeypatch):
+    """The replica-streaming family rides the same coverage contract: a
+    public mesh_stream* symbol that forgot to register is a FAILURE row
+    in run_static_checks' aliasing/jit-lint sections (ENTRY_NAME_RE
+    covers the stream prefix), never a silent gap."""
+    import crdt_tpu.parallel as par
+    import check_aliasing
+
+    monkeypatch.setattr(
+        par, "mesh_stream_bogus", lambda blocks, mesh: blocks, raising=False
+    )
+    assert "mesh_stream_bogus" in unregistered_entry_points()
+    monkeypatch.setattr(
+        "crdt_tpu.analysis.registry.entry_points",
+        lambda donatable=None: (),
+    )
+    results = check_aliasing.check_all()
+    assert any(k == "mesh_stream_bogus" and not ok for k, ok, _ in results)
+
+
 def test_registry_donatable_set_covers_pre_registry_gate():
     """Parity with the hardcoded 11-entry list check_aliasing.py shipped
     before the registry (plus the sparse-nested gossip it missed)."""

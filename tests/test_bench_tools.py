@@ -136,3 +136,43 @@ def test_cached_hardware_headline_parses_step_detail(tmp_path, monkeypatch):
     state["steps"]["bench_fused"]["ok"] = False
     (fake_root / "TPU_EVIDENCE_r05.json").write_text(json.dumps(state))
     assert bench.cached_hardware_headline() is None
+
+
+def test_bench_configs_contract():
+    """BENCH_CONFIGS.json is the COMMITTED shape source of truth the
+    sparse/flagship legs and tools/run_tpu_checks.py share — this pins
+    the keys those consumers read, so an edit that drops one fails here
+    instead of at replay time on hardware."""
+    cfgs = bench.bench_configs()
+    for leg, keys in (
+        ("sparse", ("replicas", "dot_cap", "universe", "passes")),
+        ("sparse_map",
+         ("replicas", "cell_cap", "universe", "sibling_cap", "passes")),
+        ("flagship",
+         ("replicas", "universe", "segment_cap", "block_rows", "actors",
+          "mesh")),
+    ):
+        assert leg in cfgs, leg
+        for key in keys:
+            assert key in cfgs[leg], f"{leg}.{key}"
+    # the flagship entry IS the metric-of-record shape — and every
+    # shape knob it declares must actually be read by bench_flagship
+    # (the replay-verbatim contract), actors included
+    assert cfgs["flagship"]["replicas"] == 10240
+    assert cfgs["flagship"]["universe"] == 1_000_000
+    assert cfgs["flagship"]["actors"] == 8
+    # the CPU stand-in must scale the replica count too, or the default
+    # no-TPU bench run streams all 10,240 replicas through ~13 passes
+    assert cfgs["flagship"]["cpu_fallback"]["replicas"] <= 2048
+    # env > cpu_fallback > committed value precedence
+    assert bench._cfg("sparse", "dot_cap", "NOPE_UNSET_ENV") == 4096
+    assert bench._cfg(
+        "sparse", "dot_cap", "NOPE_UNSET_ENV", cpu_fallback=True
+    ) == 512
+    os.environ["NOPE_SET_ENV"] = "77"
+    try:
+        assert bench._cfg(
+            "sparse", "dot_cap", "NOPE_SET_ENV", cpu_fallback=True
+        ) == 77
+    finally:
+        del os.environ["NOPE_SET_ENV"]

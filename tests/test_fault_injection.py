@@ -305,3 +305,72 @@ def test_sparse_map_faulty_delivery_converges(seed):
                 assert model.to_pure(dst) == receivers[dst]
     assert model.to_pure(0) == oracle
     assert model.fold() == oracle
+
+
+@given(seeds)
+@settings(max_examples=10, deadline=None)
+def test_stream_interrupted_resumes_bit_identical(seed):
+    """Replica-streaming fault containment (parallel/stream.py): a
+    block source that dies mid-stream must leave the accumulator as the
+    exact join of the blocks already applied — a valid, joinable
+    lattice state — and resuming from it over the remaining blocks must
+    land bit-identically on the uninterrupted fold. The failure counts
+    in the registry (``stream.interrupted``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.models import BatchedSparseOrswot
+    from crdt_tpu.ops import sparse_orswot as sp_ops
+    from crdt_tpu.parallel import (
+        StreamInterrupted,
+        iter_blocks,
+        make_mesh,
+        mesh_stream_fold_sparse,
+    )
+    from crdt_tpu.utils.metrics import metrics
+
+    rng = random.Random(seed)
+    sites, _ = _mint_streams(rng, 8, 12)
+    model = BatchedSparseOrswot.from_pure(sites, dot_cap=64, n_actors=8)
+    mesh = make_mesh(4, 1)
+    blocks = list(iter_blocks(model.state, 4))
+    die_at = rng.randrange(1, len(blocks) + 1)
+
+    def dying_source():
+        for b in blocks[:die_at]:
+            yield b
+        raise OSError("block source died mid-stream")
+
+    ref, _ = sp_ops.fold(model.state)
+    before = metrics.snapshot()["counters"].get("stream.interrupted", 0)
+    try:
+        mesh_stream_fold_sparse(dying_source(), mesh)
+    except StreamInterrupted as exc:
+        assert exc.blocks_done == die_at
+        assert isinstance(exc.cause, OSError)
+        # the accumulator is the exact join of the delivered prefix
+        prefix = jax.tree.map(
+            lambda x: x[: die_at * 4], model.state
+        )
+        expect, _ = sp_ops.fold(prefix)
+        assert all(
+            bool(jnp.array_equal(x, y))
+            for x, y in zip(jax.tree.leaves(exc.acc), jax.tree.leaves(expect))
+        )
+        # resume-from-block-k over the remaining blocks completes the
+        # fold bit-identically — TWICE from the same interrupted
+        # accumulator (a donated stream must never consume the caller's
+        # init buffers, or the second retry would read freed memory)
+        for _ in range(2):
+            acc, of = mesh_stream_fold_sparse(
+                iter(blocks[die_at:]), mesh, init=exc.acc
+            )
+            assert not bool(jnp.any(of))
+            assert all(
+                bool(jnp.array_equal(x, y))
+                for x, y in zip(jax.tree.leaves(acc), jax.tree.leaves(ref))
+            )
+    else:
+        raise AssertionError("the dying source must interrupt the stream")
+    after = metrics.snapshot()["counters"].get("stream.interrupted", 0)
+    assert after > before

@@ -1,0 +1,454 @@
+"""Replica-streaming fold driver gates (crdt_tpu/parallel/stream.py).
+
+The streamed fold's whole contract is that chunking a population into
+blocks changes NOTHING about the converged lattice: block-count
+invariance (block sizes 1, P, and N bit-identical to the co-resident
+fold and to the pure oracle), composition with elastic widen and
+causal-stability reclamation mid-stream, the unaliasable-batch repack
+fallback counter, the pipeline on/off equivalence, and the
+stream.* telemetry counters. The heaviest combined gate (widen +
+reclaim + telemetry over a larger population) lives in the curated
+``slow`` tier (tests/conftest.py SLOW_NODEIDS); every law it exercises
+has a faster in-tier cousin here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crdt_tpu import elastic
+from crdt_tpu.models.sparse_orswot import BatchedSparseOrswot
+from crdt_tpu.ops import orswot as dense_ops
+from crdt_tpu.ops import sparse_orswot as sp_ops
+from crdt_tpu.parallel import (
+    iter_blocks,
+    make_mesh,
+    mesh_fold_sparse_sharded,
+    mesh_stream_fold,
+    mesh_stream_fold_sparse,
+    mesh_stream_fold_sparse_mvmap,
+    mesh_stream_fold_sparse_sharded,
+    split_segments,
+)
+from crdt_tpu.pure.orswot import Orswot
+from crdt_tpu.utils.metrics import metrics
+
+
+P_REPLICAS = 4
+
+
+def _mesh(esize=1):
+    return make_mesh(P_REPLICAS, esize)
+
+
+def _pure_population(n=8, adds=3, removes=2, merged=True, seed=0):
+    """Causally valid pure replicas: one actor per replica (no forks),
+    optional full cross-merge, then a few observed removes."""
+    rng = np.random.default_rng(seed)
+    reps = []
+    for i in range(n):
+        o = Orswot()
+        for k in range(adds):
+            o.apply(o.add(f"m{i}_{k}", o.read().derive_add_ctx(f"s{i}")))
+        reps.append(o)
+    if merged:
+        for i in range(n):
+            for j in range(n):
+                if i != j:
+                    reps[i].merge(reps[j])
+        for i in range(removes):
+            v = sorted(reps[i].read().val)[i]
+            reps[i].apply(reps[i].rm(v, reps[i].contains(v).derive_rm_ctx()))
+    return reps
+
+
+def _sparse_model(reps, dot_cap=64):
+    return BatchedSparseOrswot.from_pure(
+        reps, dot_cap=dot_cap, n_actors=len(reps)
+    )
+
+
+def _identical(a, b):
+    return all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _oracle_fold(reps):
+    acc = Orswot()
+    for r in reps:
+        acc.merge(r)
+    return acc
+
+
+def _to_pure(model, state):
+    tmp = BatchedSparseOrswot(
+        1, state.eid.shape[-1], state.top.shape[-1],
+        state.dcl.shape[-2], state.didx.shape[-1],
+        members=model.members, actors=model.actors,
+    )
+    tmp.state = jax.tree.map(lambda x: x[None], state)
+    return tmp.to_pure(0)
+
+
+# ---- block-count invariance (the core contract) ---------------------------
+
+def test_block_count_invariance_bit_identical_and_oracle():
+    reps = _pure_population()
+    model = _sparse_model(reps)
+    mesh = _mesh()
+    ref, ref_flags = sp_ops.fold(model.state)
+    assert not bool(jnp.any(ref_flags))
+    outs = {}
+    for b in (1, P_REPLICAS, len(reps)):
+        acc, of = mesh_stream_fold_sparse(
+            iter_blocks(model.state, b), mesh
+        )
+        assert not bool(jnp.any(of))
+        assert _identical(acc, ref), f"block size {b} diverged"
+        outs[b] = acc
+    # and the pure oracle agrees with the streamed converged read
+    assert _to_pure(model, outs[1]) == _oracle_fold(reps)
+
+
+def test_dense_stream_matches_mesh_fold():
+    rng = np.random.default_rng(3)
+    r, e, a = 8, 16, 4
+    # global counter per (element, actor) cell; replicas hold subsets —
+    # causally valid, so every fold order is bit-identical
+    g = (np.arange(e)[:, None] * a + np.arange(a) + 1).astype(np.uint32)
+    hold = rng.random((r, e, a)) < 0.5
+    ctr = np.where(hold, g[None], 0).astype(np.uint32)
+    state = dense_ops.empty(e, a, 4, batch=(r,))._replace(
+        top=jnp.asarray(ctr.max(axis=1)), ctr=jnp.asarray(ctr)
+    )
+    ref, _ = dense_ops.fold(state)
+    for esize in (1, 2):
+        acc, of = mesh_stream_fold(
+            iter_blocks(state, P_REPLICAS), _mesh(esize)
+        )
+        assert not bool(jnp.any(of))
+        assert _identical(acc, ref)
+
+
+def test_sharded_stream_matches_sharded_mesh_fold():
+    reps = _pure_population(seed=5)
+    model = _sparse_model(reps)
+    mesh = _mesh(2)
+    sharded = split_segments(model.state, 2)
+    ref, _ = mesh_fold_sparse_sharded(sharded, mesh)
+    acc, of = mesh_stream_fold_sparse_sharded(
+        iter_blocks(sharded, P_REPLICAS), mesh
+    )
+    assert not bool(jnp.any(of))
+    assert _identical(acc, ref)
+
+
+def test_mvmap_stream_matches_fold():
+    from crdt_tpu.ops import sparse_mvmap as smv
+
+    rng = np.random.default_rng(9)
+    r, cap, a, uni = 8, 16, 4, 256
+    g = lambda k, ac: np.uint32(k * a + ac + 1)
+    rows = []
+    for i in range(r):
+        cells = np.argwhere(rng.random((uni, a)) < 0.01)[:cap]
+        kid = np.full(cap, -1, np.int32)
+        act = np.zeros(cap, np.int32)
+        ctr = np.zeros(cap, np.uint32)
+        val = np.zeros(cap, np.int32)
+        valid = np.zeros(cap, bool)
+        n = len(cells)
+        kid[:n] = cells[:, 0]
+        act[:n] = cells[:, 1]
+        ctr[:n] = [g(k, ac) for k, ac in cells]
+        val[:n] = [int(k) * 7 + int(ac) for k, ac in cells]
+        valid[:n] = True
+        clk = np.zeros((cap, a), np.uint32)
+        np.put_along_axis(
+            clk, act[:, None].astype(np.int64), ctr[:, None], axis=-1
+        )
+        clk[~valid] = 0
+        top = np.zeros(a, np.uint32)
+        np.maximum.at(top, act[:n], ctr[:n])
+        ck, ca, cc, cv, cclk, cvd, _ = smv._canon(
+            jnp.asarray(kid), jnp.asarray(act), jnp.asarray(ctr),
+            jnp.asarray(val), jnp.asarray(clk), jnp.asarray(valid), cap,
+        )
+        rows.append(smv.empty(cap, a)._replace(
+            top=jnp.asarray(top), kid=ck, act=ca, ctr=cc, val=cv,
+            clk=cclk, valid=cvd,
+        ))
+    state = jax.tree.map(lambda *xs: jnp.stack(xs), *rows)
+    ref, _ = smv.fold(state, sibling_cap=4)
+    acc, of = mesh_stream_fold_sparse_mvmap(
+        iter_blocks(state, P_REPLICAS), _mesh(), sibling_cap=4
+    )
+    assert not bool(jnp.any(of))
+    assert _identical(acc, ref)
+
+
+# ---- mid-stream elastic widen ---------------------------------------------
+
+def _disjoint_blocks(n_blocks=3, rows=P_REPLICAS, cap=8, n_actors=16):
+    """Blocks whose unions exceed any single block's dot_cap: block b's
+    rows mint under DISTINCT actors (no forks) on disjoint elements, so
+    the converged union is n_blocks*rows dots but each block carries at
+    most ``rows`` — the accumulator must widen mid-stream."""
+    blocks = []
+    for b in range(n_blocks):
+        rows_list = []
+        for i in range(rows):
+            actor = b * rows + i
+            st = sp_ops.empty(cap, n_actors)
+            st = st._replace(
+                top=st.top.at[actor].set(1),
+                eid=st.eid.at[0].set(1000 * b + i),
+                act=st.act.at[0].set(actor),
+                ctr=st.ctr.at[0].set(1),
+                valid=st.valid.at[0].set(True),
+            )
+            rows_list.append(st)
+        blocks.append(jax.tree.map(lambda *xs: jnp.stack(xs), *rows_list))
+    return blocks
+
+
+def test_mid_stream_widen_recovers_overflow():
+    mesh = _mesh()
+    blocks = _disjoint_blocks(n_blocks=4, cap=4)
+    # without a policy the overflow surfaces in the flags
+    _, of = mesh_stream_fold_sparse(iter(blocks), mesh)
+    assert bool(jnp.any(of)), "setup must actually overflow dot_cap"
+    before = metrics.snapshot()["counters"].get("stream.widen_retries", 0)
+    acc, of, tel = mesh_stream_fold_sparse(
+        iter(blocks), mesh, telemetry=True,
+        widen_policy=elastic.DEFAULT_POLICY,
+    )
+    after = metrics.snapshot()["counters"].get("stream.widen_retries", 0)
+    assert not bool(jnp.any(of))
+    assert after > before
+    # every minted dot survives at the widened capacity, bit-identical
+    # to a wide-born co-resident fold
+    assert int(jnp.sum(acc.valid)) == 4 * P_REPLICAS
+    wide = jax.tree.map(
+        lambda *xs: jnp.concatenate(xs, axis=0),
+        *[sp_ops.widen(b, dot_cap=acc.eid.shape[-1]) for b in blocks],
+    )
+    ref, _ = sp_ops.fold(wide)
+    assert _identical(acc, ref)
+
+
+def test_mid_stream_widen_unsupported_for_sharded():
+    reps = _pure_population(seed=7)
+    sharded = split_segments(_sparse_model(reps).state, 2)
+    with pytest.raises(TypeError):
+        mesh_stream_fold_sparse_sharded(
+            iter_blocks(sharded, P_REPLICAS), _mesh(2),
+            widen_policy=elastic.DEFAULT_POLICY,
+        )
+
+
+# ---- mid-stream reclamation -----------------------------------------------
+
+def test_mid_stream_reclaim_reads_invariant():
+    from crdt_tpu.reclaim import host_frontier
+
+    reps = _pure_population(seed=11)
+    model = _sparse_model(reps)
+    mesh = _mesh()
+    front = host_frontier([
+        np.asarray(model.state.top[i]) for i in range(len(reps))
+    ])
+    plain, _, tel_plain = mesh_stream_fold_sparse(
+        iter_blocks(model.state, P_REPLICAS), mesh, telemetry=True
+    )
+    compacted, _, tel_comp = mesh_stream_fold_sparse(
+        iter_blocks(model.state, P_REPLICAS), mesh, telemetry=True,
+        frontier=front, compact_every=1,
+    )
+    # compaction may repack lanes but can never change the observable
+    # read — the compaction-invariance law, streamed
+    assert bool(jnp.array_equal(
+        sp_ops._observe(plain), sp_ops._observe(compacted)
+    ))
+    assert _to_pure(model, compacted) == _oracle_fold(reps)
+    # the reclaim counters ride the registry namespace the host paths
+    # share (reclaim.record_reclaim)
+    snap = metrics.snapshot()["counters"]
+    assert "reclaim.reclaimed_slots.stream.sparse_stream_fold" in snap
+
+
+# ---- unaliasable-batch fallback -------------------------------------------
+
+def test_ragged_tail_block_counts_unaliasable_fallback():
+    reps = _pure_population(n=10, seed=13)  # 10 % 4 != 0 -> ragged tail
+    model = _sparse_model(reps)
+    mesh = _mesh()
+    ref, _ = sp_ops.fold(model.state)
+    before = metrics.snapshot()["counters"].get(
+        "stream.unaliasable_blocks", 0
+    )
+    acc, of = mesh_stream_fold_sparse(
+        iter_blocks(model.state, P_REPLICAS), mesh
+    )
+    after = metrics.snapshot()["counters"].get("stream.unaliasable_blocks", 0)
+    assert after > before, "ragged tail must count the repack fallback"
+    assert _identical(acc, ref)
+
+
+def test_oversized_block_refuses():
+    reps = _pure_population(seed=17)
+    model = _sparse_model(reps)
+    small = jax.tree.map(lambda x: x[:P_REPLICAS], model.state)
+    with pytest.raises(ValueError, match="re-chunk"):
+        mesh_stream_fold_sparse(
+            [small, model.state], _mesh()
+        )
+
+
+# ---- pipeline / donation / telemetry --------------------------------------
+
+def test_pipeline_off_bit_identical_and_no_overlap():
+    reps = _pure_population(seed=19)
+    model = _sparse_model(reps)
+    mesh = _mesh()
+    on, _, tel_on = mesh_stream_fold_sparse(
+        iter_blocks(model.state, P_REPLICAS), mesh, telemetry=True
+    )
+    off, _, tel_off = mesh_stream_fold_sparse(
+        iter_blocks(model.state, P_REPLICAS), mesh, telemetry=True,
+        pipeline=False,
+    )
+    assert _identical(on, off)
+    assert int(tel_off.stream_overlap_hit) == 0
+
+
+def test_donate_off_matches_and_init_survives():
+    reps = _pure_population(seed=23)
+    model = _sparse_model(reps)
+    mesh = _mesh()
+    ref, _ = sp_ops.fold(model.state)
+    init = sp_ops.empty(
+        model.state.eid.shape[-1], model.state.top.shape[-1],
+        model.state.dcl.shape[-2], model.state.didx.shape[-1],
+    )
+    init_snapshot = jax.tree.map(np.asarray, init)
+    for donate in (True, False):
+        acc, _ = mesh_stream_fold_sparse(
+            iter_blocks(model.state, P_REPLICAS), mesh, init=init,
+            donate=donate,
+        )
+        assert _identical(acc, ref)
+        # the caller's init buffers must never be consumed by donation
+        assert all(
+            bool(np.array_equal(np.asarray(x), y))
+            for x, y in zip(
+                jax.tree.leaves(init), jax.tree.leaves(init_snapshot)
+            )
+        )
+
+
+def test_stream_telemetry_counters():
+    reps = _pure_population(seed=29)
+    model = _sparse_model(reps)
+    mesh = _mesh()
+    before = metrics.snapshot()["counters"]
+    acc, of, tel = mesh_stream_fold_sparse(
+        iter_blocks(model.state, P_REPLICAS), mesh, telemetry=True
+    )
+    after = metrics.snapshot()["counters"]
+    n_blocks = len(reps) // P_REPLICAS
+    assert int(tel.stream_blocks) == n_blocks
+    assert float(tel.stream_staged_bytes) > 0
+    assert int(tel.merges) > 0
+    for name in ("stream.blocks", "stream.staged_bytes"):
+        assert after.get(name, 0) > before.get(name, 0)
+    # the telemetry-off twin returns a 2-tuple (flag traces nothing)
+    out = mesh_stream_fold_sparse(iter_blocks(model.state, P_REPLICAS), mesh)
+    assert len(out) == 2
+    # and the record round-trips through the committed export schema
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    ))
+    from check_telemetry_schema import validate_record
+
+    from crdt_tpu import exporter
+
+    assert validate_record(
+        exporter.telemetry_record("sparse_stream_fold", tel)
+    ) == []
+
+
+def test_empty_stream_with_init_is_identity():
+    reps = _pure_population(seed=31)
+    model = _sparse_model(reps)
+    folded, _ = sp_ops.fold(model.state)
+    acc, of = mesh_stream_fold_sparse([], _mesh(), init=folded)
+    assert _identical(acc, folded)
+    with pytest.raises(ValueError, match="empty"):
+        mesh_stream_fold_sparse([], _mesh())
+
+
+# ---- registry / discovery gate --------------------------------------------
+
+def test_stream_entry_points_registered():
+    """mesh_stream* is part of the registry's coverage contract: the
+    name regex must match, every public stream entry must be
+    registered, and discovery must be clean — this is what makes
+    tools/run_static_checks.py fail on an unregistered mesh_stream*
+    symbol (its jit-lint and aliasing sections iterate the registry)."""
+    from crdt_tpu.analysis.registry import (
+        ENTRY_NAME_RE,
+        registered_entry_names,
+        unregistered_entry_points,
+    )
+
+    assert ENTRY_NAME_RE.match("mesh_stream_fold_sparse")
+    names = registered_entry_names()
+    for name in (
+        "mesh_stream_fold", "mesh_stream_fold_sparse",
+        "mesh_stream_fold_sparse_mvmap", "mesh_stream_fold_sparse_sharded",
+    ):
+        assert name in names
+    assert unregistered_entry_points() == []
+
+
+# ---- the heavy combined gate (curated slow tier) --------------------------
+
+def test_stream_combined_widen_reclaim_large():
+    """Widen + reclaim + telemetry over a larger population in one
+    stream — the heaviest streaming gate (slow tier; each law has a
+    faster cousin above: invariance, widen, reclaim, counters). The
+    population is UNMERGED (every replica holds only its own mints at a
+    deliberately tight dot_cap), so the converged union exceeds any
+    single replica's capacity and the accumulator must widen on the way
+    through while the periodic compactor keeps it canonical."""
+    from crdt_tpu.reclaim import host_frontier
+
+    reps = _pure_population(n=24, adds=4, merged=False, seed=37)
+    tight = _sparse_model(reps, dot_cap=8)      # 4 live dots per replica
+    wide = _sparse_model(reps, dot_cap=128)     # holds the 96-dot union
+    mesh = _mesh()
+    front = host_frontier([
+        np.asarray(tight.state.top[i]) for i in range(len(reps))
+    ])
+    before = metrics.snapshot()["counters"].get("stream.widen_retries", 0)
+    acc, of, tel = mesh_stream_fold_sparse(
+        iter_blocks(tight.state, P_REPLICAS), mesh, telemetry=True,
+        widen_policy=elastic.DEFAULT_POLICY, frontier=front,
+        compact_every=2,
+    )
+    after = metrics.snapshot()["counters"].get("stream.widen_retries", 0)
+    assert not bool(jnp.any(of))
+    assert after > before, "the tight stream must widen mid-flight"
+    ref, ref_flags = sp_ops.fold(wide.state)
+    assert not bool(jnp.any(ref_flags))
+    # lane caps differ (acc widened from 8, ref born at 128), so the
+    # comparison is on converged READS, plus the pure-oracle chain
+    assert _to_pure(tight, acc) == _to_pure(wide, ref) == _oracle_fold(reps)
+    assert int(tel.stream_blocks) == len(reps) // P_REPLICAS

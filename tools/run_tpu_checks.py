@@ -7,8 +7,11 @@ skip — this script is how to actually exercise them on hardware):
 Runs, in order: a backend probe (fail-fast on a wedged relay, same
 mechanism as bench.py), the compiled fused-fold equality tests (plain
 orswot, Map<K, MVReg>, map_orswot + map3 nested levels), the n_passes
-streaming-equivalence A/B, the entry() compile check, and a scaled
-fused-vs-tree bench sanity."""
+streaming-equivalence A/B, the entry() compile check, a scaled
+fused-vs-tree bench sanity, the config-4/5/sparse legs, and the
+FLAGSHIP replica-streaming leg (10,240 x 1M via parallel/stream.py,
+shape replayed verbatim from BENCH_CONFIGS.json — degraded or
+non-bit-identical fails the check)."""
 
 import importlib.util
 import os
@@ -112,8 +115,11 @@ def main() -> int:
     jax.jit(fn).lower(*args).compile()
     print(f"entry() compiles                   [{time.time()-t0:.0f}s]")
 
-    mps, path, gbps, _, shape = bench.bench_tpu()
-    print(f"bench sanity: {mps:,.0f} merges/s ({path}, {gbps:.0f} GB/s, {shape})")
+    mps, path, gbps, _, shape, relay_bound = bench.bench_tpu()
+    print(
+        f"bench sanity: {mps:,.0f} merges/s ({path}, {gbps:.0f} GB/s, "
+        f"{shape}{', relay-bound' if relay_bound else ''})"
+    )
     if path != "fused":
         print("FAIL: fused path did not run on the chip")
         return 1
@@ -134,6 +140,23 @@ def main() -> int:
         f"({rec['value']:,.0f} merges/s, {rec['compression']:,.0f}x "
         f"compression)"
     )
+
+    # THE flagship: 10,240 replicas x 1M elements streamed through the
+    # mesh (parallel/stream.py), shape replayed VERBATIM from the
+    # committed BENCH_CONFIGS.json entry. The record must be clean on
+    # hardware — a relay-bound marginal here is a failed check, not a
+    # degraded-but-acceptable row.
+    t0 = time.time()
+    rec = bench.bench_flagship()
+    print(
+        f"flagship {rec['shape']} streamed    [{time.time()-t0:.0f}s] "
+        f"({rec['value']:,.0f} merges/s over {rec['blocks']} blocks, "
+        f"resident {rec['resident_reduction']}x below co-resident, "
+        f"bit-identity gate {'OK' if rec['bit_identical'] else 'FAILED'})"
+    )
+    if rec["degraded"] or not rec["bit_identical"]:
+        print("FAIL: flagship record degraded or not bit-identical")
+        return 1
 
     # In-process (libtpu is exclusive per process — a subprocess could
     # not reach the already-initialized chip).
