@@ -51,6 +51,41 @@ NOT_ASSOCIATIVE = MergeKind(
 )
 
 
+# ---- broken schedule fixtures (SEC model checker, schedules.py) ----------
+
+# A δ-mutator that isn't an inflation: delivery REPLACES instead of
+# joining, so a stale δ replayed late deflates the state — the schedule
+# checker's reorder/dup variants diverge from the in-order fold (the
+# pair laws can't see this: replacement is trivially associative).
+DELTA_NOT_INFLATION = MergeKind(
+    name="fixture_delta_not_inflation", join=lambda a, b: b,
+    states=_scalar_states, module=__name__,
+)
+
+# A non-commuting op-based apply (2s + d): every causal interleaving of
+# ops from different origins reaches a different value, so the CmRDT
+# path's causal-divergence check must fire. The join itself is an
+# honest max — only delivery-by-apply is broken.
+NON_COMMUTING_APPLY = MergeKind(
+    name="fixture_non_commuting_apply", join=jnp.maximum,
+    states=_scalar_states, module=__name__,
+    apply=lambda s, d: s * 2 + d,
+    deltas=lambda: [
+        (0, jnp.uint32(1)), (1, jnp.uint32(2)),
+        (0, jnp.uint32(3)), (2, jnp.uint32(4)),
+    ],
+)
+
+# A degenerate generator: every "state" is the same canonical point, so
+# every law and every schedule holds vacuously — the degeneracy gate
+# must fail it before it rubber-stamps a broken kind.
+DEGENERATE_GENERATOR = MergeKind(
+    name="fixture_degenerate_generator", join=jnp.maximum,
+    states=lambda: [jnp.uint32(0), jnp.uint32(0), jnp.uint32(0)],
+    module=__name__,
+)
+
+
 # ---- broken compactors (compaction-invariance fixtures) ------------------
 
 def _fixture_compact_ok(s, frontier):
@@ -130,3 +165,128 @@ def donating_aligned(n: int = 8):
     """Honest twin: output aliases the donated input — must stay clean."""
     fn = jax.jit(lambda s: s + jnp.uint32(1), donate_argnums=0)
     return fn, (jnp.zeros((n,), jnp.uint32),)
+
+
+# ---- collective-semantics fixtures (jit_lint collective checks) ----------
+#
+# Each returns (fn, args) for lint_callable(axis_sizes=dict(mesh.shape),
+# allowed_axes=...); the broken kernels compile fine — that is the
+# point: only the lint sees the wiring hazard.
+
+def _shmapped(mesh, body, out_replica=True):
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import REPLICA_AXIS
+
+    spec = P(REPLICA_AXIS) if out_replica else P()
+    fn = jax.shard_map(
+        body, mesh=mesh, in_specs=P(REPLICA_AXIS), out_specs=spec,
+        check_vma=False,
+    )
+    p = mesh.shape[REPLICA_AXIS]
+    return fn, (jnp.zeros((p, 8), jnp.uint32),)
+
+
+def collective_bad_ppermute(mesh):
+    """A ring missing one link: pairs don't cover the axis, the
+    uncovered rank receives zeros and its state silently resets."""
+    from jax import lax
+
+    from ..parallel.mesh import REPLICA_AXIS
+
+    p = mesh.shape[REPLICA_AXIS]
+    perm = [(i, (i + 1) % p) for i in range(p - 1)]  # last link dropped
+    return _shmapped(
+        mesh, lambda x: lax.ppermute(x, REPLICA_AXIS, perm)
+    )
+
+
+def collective_good_ppermute(mesh):
+    """Honest twin: the full ring bijection — must stay clean."""
+    from jax import lax
+
+    from ..parallel.mesh import REPLICA_AXIS
+
+    p = mesh.shape[REPLICA_AXIS]
+    perm = [(i, (i + 1) % p) for i in range(p)]
+    return _shmapped(
+        mesh, lambda x: lax.ppermute(x, REPLICA_AXIS, perm)
+    )
+
+
+def collective_wrong_axis(mesh):
+    """A psum over the replica axis in an entry whose registration only
+    claims the element axis — lint with allowed_axes=('element',)."""
+    from jax import lax
+
+    from ..parallel.mesh import REPLICA_AXIS
+
+    return _shmapped(
+        mesh, lambda x: lax.psum(x, REPLICA_AXIS), out_replica=False
+    )
+
+
+def collective_read_after_donation(mesh):
+    """The donated state feeds a ppermute and is then read again: under
+    donation XLA may alias the permuted output onto the input buffer,
+    so `x + y` reads overwritten data — lint with n_donated_leaves=1."""
+    from jax import lax
+
+    from ..parallel.mesh import REPLICA_AXIS
+
+    p = mesh.shape[REPLICA_AXIS]
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def body(x):
+        y = lax.ppermute(x, REPLICA_AXIS, perm)
+        return x + y
+
+    return _shmapped(mesh, body)
+
+
+def collective_read_before_donation(mesh):
+    """Honest twin: the donated state is fully consumed BEFORE the
+    collective (the ring discipline) — must stay clean."""
+    from jax import lax
+
+    from ..parallel.mesh import REPLICA_AXIS
+
+    p = mesh.shape[REPLICA_AXIS]
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def body(x):
+        y = x + jnp.uint32(1)
+        return lax.ppermute(y, REPLICA_AXIS, perm)
+
+    return _shmapped(mesh, body)
+
+
+# ---- unsound δ digest gate (the PR 3 hazard, statically) -----------------
+
+def gate_top_covered_unsound(pkt, digest):
+    """The wider gate PR 3 built and had to narrow by runtime test: it
+    masks every slot the receiver's top covers, ignoring that a context
+    lane above the row is removal knowledge a top digest can never
+    vouch for. check_orswot_gate must report gate-removal-dropped."""
+    covered = jnp.all(pkt.rows <= digest[None, :], axis=-1)
+    keep = pkt.valid & ~covered
+    return pkt._replace(
+        valid=keep,
+        rows=jnp.where(keep[:, None], pkt.rows, 0),
+        ctxs=jnp.where(keep[:, None], pkt.ctxs, 0),
+    )
+
+
+# ---- cost-budget fixtures (analysis/cost.py) ------------------------------
+
+def kernel_budget_pad(x):
+    """Budget-buster: pads an 8-lane input out to 1M lanes and keeps
+    the pad live across an elementwise op — peak_bytes explodes ~1e5×
+    over the lean twin while the I/O signature stays identical."""
+    big = jnp.pad(x, (0, 1_000_000 - x.shape[0]))
+    return jnp.sum(big * big)
+
+
+def kernel_budget_lean(x):
+    """Honest twin of the same contract (sum of squares of 8 lanes)."""
+    return jnp.sum(x * x)

@@ -69,7 +69,26 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 @dataclass(frozen=True)
 class MergeKind:
-    """One registered lattice: the unit the law engine checks."""
+    """One registered lattice: the unit the law engine checks.
+
+    ``deltas``/``apply`` are the SCHEDULE-GENERATOR hooks the bounded
+    SEC model checker (:mod:`.schedules`) consumes:
+
+    - ``deltas() -> [(origin, δ-state), ...]`` — the δ increments the
+      checker delivers under every bounded schedule (reorder /
+      duplication / drop-with-resync). Each δ must be a valid state
+      (an inflation of the identity); ``origin`` is the minting replica
+      (< schedules.MAX_REPLICAS), which orders the causal subset. When
+      absent, the checker derives δs from ``states()[1:]`` with
+      round-robin origins — sound for every CvRDT kind, since its
+      reachable states ARE shippable δ-states.
+    - ``apply(state, δ) -> state`` — op-based (CmRDT) application for
+      kinds whose ops are not delivered by join. Only causal-order-
+      respecting interleavings are required to converge for such kinds
+      (exactly-once causal delivery is the CmRDT contract). When
+      absent, delivery is the join itself and EVERY bounded schedule
+      must converge.
+    """
 
     name: str
     join: Callable[[Any, Any], Any]       # -> state | (state, flags)
@@ -77,6 +96,8 @@ class MergeKind:
     canon: Optional[Callable[[Any], Any]] = None
     big_states: Optional[Callable[[], list]] = None
     module: str = ""
+    deltas: Optional[Callable[[], list]] = None   # () -> [(origin, δ), ...]
+    apply: Optional[Callable[[Any, Any], Any]] = None
 
 
 @dataclass(frozen=True)
@@ -93,6 +114,12 @@ class EntryPoint:
       donatable entries) so the memoised jit exists; consumes ``args``.
     - ``n_donated``: leading donated args (0 = the entry never aliases
       outputs onto inputs — the fold family).
+    - ``mesh_axes``: the mesh axis names this entry's collectives are
+      allowed to touch — the collective-semantics lint
+      (:mod:`.jit_lint`) fails on any ``psum``/``ppermute``/… whose
+      axis name is outside this set (a typo'd or stale axis name
+      compiles fine under a matching mesh and silently reduces over
+      the wrong ranks under any other).
     """
 
     name: str
@@ -100,6 +127,7 @@ class EntryPoint:
     make_args: Callable[[Any], tuple]
     invoke: Callable[[Any, tuple], Any]
     n_donated: int = 0
+    mesh_axes: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -136,10 +164,12 @@ def register_merge(
     canon: Optional[Callable] = None,
     big_states: Optional[Callable[[], list]] = None,
     module: str = "",
+    deltas: Optional[Callable[[], list]] = None,
+    apply: Optional[Callable] = None,
 ) -> MergeKind:
     kind = MergeKind(
         name=name, join=join, states=states, canon=canon,
-        big_states=big_states, module=module,
+        big_states=big_states, module=module, deltas=deltas, apply=apply,
     )
     _MERGE[name] = kind
     return kind
@@ -152,10 +182,19 @@ def register_entry_point(
     make_args: Callable[[Any], tuple],
     invoke: Callable[[Any, tuple], Any],
     n_donated: int = 0,
+    mesh_axes: Optional[Tuple[str, ...]] = None,
 ) -> EntryPoint:
+    if mesh_axes is None:
+        # Default = both gate-mesh axes, resolved from the single
+        # source of truth. Lazy import: this module must stay
+        # import-light (see the module docstring), and registration is
+        # only ever called from modules that already import the mesh.
+        from ..parallel.mesh import ELEMENT_AXIS, REPLICA_AXIS
+
+        mesh_axes = (REPLICA_AXIS, ELEMENT_AXIS)
     ep = EntryPoint(
         name=name, kind=kind, make_args=make_args, invoke=invoke,
-        n_donated=n_donated,
+        n_donated=n_donated, mesh_axes=tuple(mesh_axes),
     )
     _ENTRY[name] = ep
     return ep

@@ -66,3 +66,37 @@ class SectionResult:
     @property
     def ok(self) -> bool:
         return not errors(self.findings)
+
+
+def summarize(sections: Sequence[SectionResult]) -> dict:
+    """The machine-readable runner summary (per-section pass/fail,
+    finding counts, wall-clock) CI and VERDICT rounds trend instead of
+    parsing the text output. Stable shape: top-level ``ok`` /
+    ``total_seconds`` / ``sections``; per section ``ok`` / ``seconds``
+    / ``errors`` / ``warnings`` / ``checks`` (the sorted set of firing
+    check names — empty when clean)."""
+    return {
+        "ok": all(s.ok for s in sections),
+        "total_seconds": round(sum(s.seconds for s in sections), 3),
+        "sections": {
+            s.name: {
+                "ok": s.ok,
+                "seconds": round(s.seconds, 3),
+                "errors": len(errors(s.findings)),
+                "warnings": len(s.findings) - len(errors(s.findings)),
+                "checks": sorted({f.check for f in s.findings}),
+                **({"skipped": s.skipped} if s.skipped else {}),
+            }
+            for s in sections
+        },
+    }
+
+
+def write_summary(sections: Sequence[SectionResult], path: str) -> dict:
+    import json
+
+    doc = summarize(sections)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return doc

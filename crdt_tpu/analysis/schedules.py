@@ -1,0 +1,379 @@
+"""The bounded SEC model checker — convergence under DELIVERY, not algebra.
+
+The law engine (:mod:`.laws`) proves the join is a semilattice on pairs
+and triples; nothing there verifies the property the whole framework
+actually sells: **strong eventual consistency** — replicas that receive
+the same set of δ/op messages converge, regardless of the order,
+duplication, and transient drops the network inflicts (Almeida et al.,
+"Delta State Replicated Data Types", PAPERS.md 1603.01529). This module
+model-checks that guarantee exhaustively up to a small bound, the
+small-scope discipline: real delivery bugs show up at tiny scopes.
+
+**Model.** Each registered kind contributes ≤ :data:`MAX_OPS` δ
+increments minted by ≤ :data:`MAX_REPLICAS` origins (the registered
+``deltas`` hook, or derived from the kind's reachable-state generator —
+registry.py documents the contract). A *schedule* is one replica's
+delivery history: a sequence over the δ set. The enumerated schedule
+space per kind:
+
+- **reorder** — every permutation of the δ set (≤ 4! = 24);
+- **duplication** — every permutation with one δ redelivered, both
+  immediately (network-level duplicate) and at the end (a stale replay
+  arriving after everything else);
+- **drop-with-resync** — every permutation with one δ dropped, then a
+  full in-order redelivery (the replica missed a packet and a later
+  anti-entropy round replays history). A *permanent* drop violates
+  eventual delivery, so convergence is not required and not checked.
+
+Convergence across replicas reduces to convergence across schedules:
+if every delivery history folds to the same canonical state, any
+assignment of histories to replicas converges — so the checker runs
+ALL schedules as ONE vmapped batched scan per kind (the laws.py
+pair-table discipline: a handful of compiles, not thousands of
+dispatches) and compares bit-exactly on canonical forms against the
+in-order fold.
+
+**CmRDT path.** A kind registering an op-based ``apply`` is only
+promised convergence under causal, exactly-once delivery — the checker
+runs the causal-order-respecting interleavings (per-origin op order
+preserved, no dups/drops) through ``apply`` instead. Join-delivered
+kinds get the causal subset for free (it is a subset of the reorder
+set).
+
+**Counterexamples.** A divergent schedule is greedily shrunk — every
+deletion that keeps each δ delivered at least once (eventual delivery)
+and still diverges is taken, to a fixpoint — so the reported schedule
+is irreducible, and the finding carries the divergent leaf path.
+
+Raising the bound locally::
+
+    from crdt_tpu.analysis import schedules
+    schedules.check_all_schedules(max_ops=5)   # 5! perms etc.; slower
+
+The committed gate runs at MAX_OPS=4 (≈ 312 schedules × ≤ 7 joins per
+kind) so the whole static chain stays inside its tier-1 budget.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import MergeKind, merge_kinds
+from .laws import _mismatches, _norm_join, _stack
+from .report import Finding
+
+MAX_OPS = 4
+MAX_REPLICAS = 3
+
+
+# ---- δ/op derivation ------------------------------------------------------
+
+def derive_ops(kind: MergeKind, max_ops: int = MAX_OPS) -> List[Tuple[int, Any]]:
+    """The kind's bounded op set: ``[(origin, δ-state), ...]``. Uses the
+    registered schedule generator when present; otherwise the reachable
+    states past the identity, origins assigned round-robin (sound for
+    CvRDT kinds — reachable states are shippable δ-states)."""
+    if kind.deltas is not None:
+        ops = list(kind.deltas())
+    else:
+        ops = [
+            (i % MAX_REPLICAS, s)
+            for i, s in enumerate(kind.states()[1:])
+        ]
+    return ops[:max_ops]
+
+
+# ---- schedule enumeration -------------------------------------------------
+
+def enumerate_schedules(n: int) -> List[Tuple[str, Tuple[int, ...]]]:
+    """All bounded δ-path delivery schedules over ``n`` ops:
+    ``[(label, op-index sequence), ...]``, deduplicated. Every sequence
+    delivers every op at least once (eventual delivery holds; order,
+    duplication, and drop-with-resync vary)."""
+    out: dict = {}
+
+    def add(label: str, seq: Tuple[int, ...]) -> None:
+        out.setdefault(seq, label)
+
+    perms = list(itertools.permutations(range(n)))
+    for p in perms:
+        add("reorder", p)
+        for j in range(n):
+            # A stale replay of op j after everything else…
+            add("dup-late", p + (p[j],))
+            # …and a network-level immediate duplicate.
+            add("dup-now", p[: j + 1] + (p[j],) + p[j + 1:])
+            # Replica missed op j; a later anti-entropy round replays
+            # the full history in mint order.
+            dropped = tuple(x for x in p if x != j)
+            add("drop-resync", dropped + tuple(range(n)))
+    return [(label, seq) for seq, label in out.items()]
+
+
+def causal_schedules(origins: Sequence[int]) -> List[Tuple[int, ...]]:
+    """Exactly-once interleavings respecting per-origin op order — the
+    delivery space a CmRDT ``apply`` is promised (causal delivery: op
+    k of an origin never arrives before op k-1 of the same origin)."""
+    n = len(origins)
+    seqs = []
+    for p in itertools.permutations(range(n)):
+        pos = {op: t for t, op in enumerate(p)}
+        ok = all(
+            pos[i] < pos[j]
+            for i in range(n) for j in range(i + 1, n)
+            if origins[i] == origins[j]
+        )
+        if ok:
+            seqs.append(p)
+    return seqs
+
+
+# ---- execution ------------------------------------------------------------
+
+def _state_bytes(state) -> tuple:
+    """Bit-exact fingerprint of a (canonicalized) state pytree."""
+    return tuple(
+        (np.asarray(x).tobytes(), np.asarray(x).shape, str(np.asarray(x).dtype))
+        for x in jax.tree.leaves(state)
+    )
+
+
+def _run_batched(deliver, identity, table, sch: np.ndarray):
+    """Fold ``deliver`` over every schedule row at once: one jitted
+    scan, vmapped over the [B, L] index matrix. The sentinel index
+    ``len(table)-1`` pads ragged schedules and is SKIPPED (state
+    carried through unchanged), not delivered-as-identity — a broken
+    join may not absorb the identity, and the counterexample must
+    replay identically without the padding. Returns
+    ``(finals, flags[B] bool)``."""
+    sentinel = jax.tree.leaves(table)[0].shape[0] - 1
+
+    def one(seq):
+        def step(carry, t):
+            state, flag = carry
+            i = seq[t]
+            d = jax.tree.map(lambda x: x[i], table)
+            nxt, f = deliver(state, d)
+            live = i != sentinel
+            nxt = jax.tree.map(
+                lambda a, b: jnp.where(live, a, b), nxt, state
+            )
+            return (nxt, flag | (f & live)), None
+
+        init = (identity, jnp.zeros((), bool))
+        (final, flag), _ = jax.lax.scan(
+            step, init, jnp.arange(sch.shape[1])
+        )
+        return final, flag
+
+    return jax.jit(jax.vmap(one))(jnp.asarray(sch, jnp.int32))
+
+
+def _run_one(deliver_eager, identity, deltas, seq: Sequence[int]):
+    """Host-side replay of a single schedule (counterexample shrinking
+    — a handful of eager joins on tiny states)."""
+    state = identity
+    for i in seq:
+        state, _ = deliver_eager(state, deltas[i])
+    return state
+
+
+def minimize_schedule(
+    seq: Sequence[int],
+    n_ops: int,
+    diverges,
+) -> Tuple[int, ...]:
+    """Greedy shrink: repeatedly delete any element whose removal keeps
+    every op delivered at least once AND still diverges. The result is
+    irreducible — no single deletion preserves the failure."""
+    seq = tuple(seq)
+    changed = True
+    while changed:
+        changed = False
+        for p in range(len(seq)):
+            cand = seq[:p] + seq[p + 1:]
+            if set(range(n_ops)) - set(cand):
+                continue  # would break eventual delivery
+            if diverges(cand):
+                seq = cand
+                changed = True
+                break
+    return seq
+
+
+# ---- the checker ----------------------------------------------------------
+
+def _format_schedule(label: str, seq: Sequence[int], origins) -> str:
+    steps = " ".join(f"d{i}@r{origins[i]}" for i in seq)
+    return f"[{label}] deliver {steps}"
+
+
+def check_kind_schedules(
+    kind: MergeKind,
+    ops: Optional[List[Tuple[int, Any]]] = None,
+    max_ops: int = MAX_OPS,
+) -> List[Finding]:
+    """Model-check one kind's convergence over the bounded schedule
+    space; findings carry a minimized counterexample schedule and the
+    divergent leaf path."""
+    ops = derive_ops(kind, max_ops) if ops is None else ops[:max_ops]
+    if len(ops) < 2:
+        return [Finding(
+            "schedule-domain", kind.name,
+            f"schedule generator yields {len(ops)} δ/op(s) — need >= 2 "
+            "for a non-trivial delivery space (register a `deltas` hook "
+            "or widen the state generator)",
+        )]
+    origins = [o for o, _ in ops]
+    deltas = [d for _, d in ops]
+    identity = kind.states()[0]
+    join = _norm_join(kind.join)
+    canon = jax.jit(kind.canon) if kind.canon else (lambda s: s)
+
+    def _deliver_join(state, d):
+        out, flags = join(state, d)
+        fired = (
+            jnp.zeros((), bool) if flags is None
+            else jnp.any(jnp.asarray(flags))
+        )
+        return out, fired
+
+    findings: List[Finding] = []
+    findings += _check_path(
+        kind, "sec-divergence", _deliver_join, identity, deltas, origins,
+        enumerate_schedules(len(ops)), canon,
+        # Reference: the in-order fold — what a replica that saw every
+        # δ exactly once, in mint order, holds.
+        ref_seq=tuple(range(len(ops))),
+    )
+
+    if kind.apply is not None:
+        def _deliver_apply(state, d):
+            out = kind.apply(state, d)
+            return out, jnp.zeros((), bool)
+
+        causal = [
+            ("causal", seq) for seq in causal_schedules(origins)
+        ]
+        findings += _check_path(
+            kind, "causal-divergence", _deliver_apply, identity, deltas,
+            origins, causal, canon, ref_seq=causal[0][1],
+        )
+    return findings
+
+
+def _check_path(
+    kind, check, deliver, identity, deltas, origins, labelled, canon,
+    ref_seq,
+) -> List[Finding]:
+    labels = [lb for lb, _ in labelled]
+    seqs = [sq for _, sq in labelled]
+    L = max(len(s) for s in seqs)
+    sentinel = len(deltas)                     # index of the identity row
+    table = _stack(deltas + [identity])
+    sch = np.full((len(seqs), L), sentinel, np.int32)
+    for r, s in enumerate(seqs):
+        sch[r, : len(s)] = s
+
+    finals, flags = _run_batched(deliver, identity, table, sch)
+    ref = canon(_run_one(deliver, identity, deltas, ref_seq))
+    ref_b = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (len(seqs),) + x.shape), ref
+    )
+    # Canon handles leading batch axes (the laws.py discipline — it is
+    # applied to whole pair tables there).
+    mism = _mismatches(canon(finals), ref_b)
+    if not mism:
+        if bool(np.asarray(flags).any()):
+            return [Finding(
+                "schedule-overflow", kind.name,
+                "a capacity/conflict flag fired inside the bounded "
+                "schedule space — convergence still held, but widen the "
+                "δ generator's caps so the check is not vacuous at the "
+                "margin", severity="warning",
+            )]
+        return []
+
+    flags_np = np.asarray(flags)
+    ref_bytes = _state_bytes(ref)
+
+    def diverges(seq) -> bool:
+        got = canon(_run_one(deliver, identity, deltas, seq))
+        return _state_bytes(got) != ref_bytes
+
+    findings: List[Finding] = []
+    seen_rows = set()
+    seen_paths = set()
+    for row, path in mism:
+        row = max(row, 0)
+        # One finding per DISTINCT divergent leaf path — independent
+        # divergences (one leaf broken by reorder, another by dup) each
+        # get their own minimized counterexample; further rows smearing
+        # the same leaf add no signal.
+        if row in seen_rows or path in seen_paths:
+            continue
+        seen_rows.add(row)
+        seen_paths.add(path)
+        path = path or "<root>"
+        if bool(flags_np[row]):
+            findings.append(Finding(
+                check, kind.name,
+                f"{_format_schedule(labels[row], seqs[row], origins)} "
+                f"diverged at leaf {path}, but a capacity flag fired on "
+                "this schedule — widen the δ generator's caps to make "
+                "the verdict meaningful", severity="warning",
+            ))
+            continue
+        small = minimize_schedule(seqs[row], len(deltas), diverges)
+        findings.append(Finding(
+            check, kind.name,
+            f"minimized counterexample "
+            f"{_format_schedule(labels[row], small, origins)} "
+            f"diverges from the in-order fold at leaf {path} "
+            f"(found as {_format_schedule(labels[row], seqs[row], origins)})",
+        ))
+    return findings
+
+
+def check_all_schedules(max_ops: int = MAX_OPS) -> List[Finding]:
+    out: List[Finding] = []
+    for kind in merge_kinds():
+        out.extend(generator_degeneracy(kind))
+        out.extend(check_kind_schedules(kind, max_ops=max_ops))
+    return out
+
+
+# ---- generator degeneracy (the vacuity gate) ------------------------------
+
+def generator_degeneracy(kind: MergeKind) -> List[Finding]:
+    """A degenerate small-domain generator silently vacuates BOTH the
+    law engine and the schedule checker (every law holds trivially on
+    one state). Fail loudly instead:
+
+    - empty CmRDT-reachable set (no states at all);
+    - fewer than 2 distinct canonical states (all seeds collapse to
+      one point — the laws compare a constant against itself).
+    """
+    states = kind.states()
+    if not states:
+        return [Finding(
+            "generator-degenerate", kind.name,
+            "small-domain generator yields NO states — the law engine "
+            "and schedule checker have nothing to check",
+        )]
+    canon = kind.canon or (lambda s: s)
+    distinct = {_state_bytes(canon(s)) for s in states}
+    if len(distinct) < 2:
+        return [Finding(
+            "generator-degenerate", kind.name,
+            f"small-domain generator yields {len(states)} state(s) but "
+            f"only {len(distinct)} distinct canonical point(s) — every "
+            "law holds vacuously on a one-point domain; make the "
+            "generator mint genuinely different states",
+        )]
+    return []

@@ -464,6 +464,19 @@ def _law_states_big():
     return states
 
 
+def _law_deltas():
+    """Schedule-generator hook (analysis/schedules.py): four δ-states
+    minted by three origins — two causally ordered ops at origin 0 (an
+    add, then a remove observed from it), a concurrent both-element add
+    at origin 1, and an ahead remove parked at origin 2. Exercises
+    every delivery hazard the bounded checker enumerates: the parked
+    remove must survive duplication and arbitrary reorder against the
+    adds it races."""
+    states = _law_states()
+    e, a1, _, b1, _, r2, r3 = states
+    return [(0, a1), (0, r2), (1, b1), (2, r3)]
+
+
 def _law_canon(s: OrswotState) -> OrswotState:
     """Deferred slot order depends on join operand order — compare
     content-ordered (clocks are unique among valid slots post-dedupe)."""
@@ -503,7 +516,7 @@ from ..analysis.registry import register_compactor, register_merge  # noqa: E402
 
 register_merge(
     "orswot", module=__name__, join=join, states=_law_states,
-    canon=_law_canon, big_states=_law_states_big,
+    canon=_law_canon, big_states=_law_states_big, deltas=_law_deltas,
 )
 register_compactor(
     "orswot", module=__name__, compact=compact, observe=_observe,

@@ -361,6 +361,7 @@ def _reg_delta_ep(name, kind, mk_state, n_rows, call):
         name, kind=kind, make_args=make_args,
         invoke=lambda mesh, args: call(*args, mesh),
         n_donated=2,
+        mesh_axes=(REPLICA_AXIS, ELEMENT_AXIS),
     )
 
 

@@ -118,6 +118,54 @@ SLOW_NODEIDS = (
     # replicas); block-count invariance, widen, reclaim, and counter
     # laws each have a faster in-tier cousin in test_stream.py
     "test_stream.py::test_stream_combined_widen_reclaim_large",
+    # ---- second curation round (ISSUE 7: wall-clock crept past the
+    # 870 s tier-1 budget; ROADMAP item-5 satellite). Same contract:
+    # every promotion names its faster in-tier cousin.
+    # replica-fold mesh-shape sweep: (8,1) pow2 replica-only, (4,2)
+    # gate mesh, and (3,1) non-pow2 all_gather fallback stay tier-1;
+    # the remaining element-shard permutations move here
+    "test_parallel.py::test_mesh_fold_bit_identical[mesh_shape2]",
+    "test_parallel.py::test_mesh_fold_bit_identical[mesh_shape3]",
+    "test_parallel.py::test_mesh_fold_bit_identical[mesh_shape5]",
+    # compiled-HLO aliasing sweep over every donated entry (~25 s): the
+    # registry-discovery failures stay tier-1 (test_check_aliasing /
+    # test_analysis), the jaxpr-level donation-alias lint runs in-tier,
+    # and tools/run_static_checks.py `aliasing` runs the full compiled
+    # gate on every chain invocation
+    "test_check_aliasing.py::test_every_donated_entry_point_aliases",
+    # example demos with dedicated in-tier suites: 07 (lifecycle) is
+    # covered by test_lifecycle.py, 05 (δ sync) by test_delta.py +
+    # test_zero_copy_ring.py; 01/03/04 stay (harness + multihost cousins)
+    "test_examples.py::test_example_runs[07_lifecycle_and_certificates.py]",
+    "test_examples.py::test_example_runs[05_delta_sync.py]",
+    # depth-4 replica-multiplied fold; the depth-4 op path and join
+    # gates stay tier-1 (test_nest_depth4), depth-2 folds in
+    # test_sparse_nest
+    "test_nest_depth4.py::test_depth4_fold_bit_identical_to_oracle_fold",
+    # one of three per-kind churn-reclaim legs; dense + sparse_orswot
+    # legs stay tier-1, the mixed long gate was already slow
+    "test_reclaim.py::test_churn_reclaim_sparse_map",
+    # map3 replica fold vs oracle; map3 op path (test_models_map3) and
+    # the δ drain gates (test_delta_map3) stay tier-1
+    "test_models_map3.py::test_fold_bit_identical_to_oracle_fold",
+    # one of four donated==undonated bit-identity properties; the
+    # dense, sparse-set, and δ flavors stay tier-1 (test_donation.py)
+    "test_donation.py::test_donated_sparse_map_gossip_bit_identical",
+    # lattice laws for the single heaviest kind; the other 11 kinds
+    # stay tier-1 and run_static_checks `laws` checks all 12 per chain
+    "test_analysis.py::test_registered_kind_passes_lattice_laws[sparse_nested_map]",
+    # digest-gating A/B with pipeline=False; the default-flags
+    # (pipeline=True) twin stays tier-1 (test_zero_copy_ring.py)
+    "test_zero_copy_ring.py::test_digest_gating_bit_identical_and_fewer_useful_bytes[False]",
+    # sparse-vs-dense replica fold A/B; the join-level twin
+    # (test_sparse_join_matches_dense_join), the ring-gossip A/B
+    # (test_sparse_ring_gossip_matches_dense_fold), and the model-level
+    # gate (test_sparse_model_ab_gate) stay tier-1
+    "test_sparse_orswot.py::test_sparse_fold_matches_dense_fold",
+    # sparse-map faulty-delivery convergence; the dense device-dropout
+    # gate (test_device_anti_entropy_with_dropouts_converges) and the
+    # pure drop/dup/reorder property stay tier-1
+    "test_fault_injection.py::test_sparse_map_faulty_delivery_converges",
 )
 
 

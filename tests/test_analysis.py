@@ -214,6 +214,127 @@ def test_lint_fires_on_donation_alias_loss():
     assert not lint_callable(fn, args, n_donated_leaves=1)
 
 
+# ---- collective-semantics lint (ISSUE 7) ----------------------------------
+
+def _collective_mesh():
+    from crdt_tpu.parallel import make_mesh
+
+    return make_mesh(4, 2)
+
+
+def _lint_collective(fixture, allowed=("replica", "element"), donated=0):
+    mesh = _collective_mesh()
+    fn, args = fixture(mesh)
+    return _checks(lint_callable(
+        fn, args, n_donated_leaves=donated,
+        axis_sizes=dict(mesh.shape), allowed_axes=allowed,
+    ))
+
+
+def test_lint_fires_on_partial_ppermute_ring():
+    assert "ppermute-perm" in _lint_collective(
+        fixtures.collective_bad_ppermute
+    )
+    assert not _lint_collective(fixtures.collective_good_ppermute)
+
+
+def test_lint_fires_on_unregistered_collective_axis():
+    assert "collective-axis" in _lint_collective(
+        fixtures.collective_wrong_axis, allowed=("element",)
+    )
+    # The same kernel under its true registration stays clean.
+    assert not _lint_collective(
+        fixtures.collective_wrong_axis, allowed=("replica",)
+    )
+
+
+def test_lint_fires_on_donated_read_after_collective():
+    assert "donated-read-after-collective" in _lint_collective(
+        fixtures.collective_read_after_donation, donated=1
+    )
+    assert not _lint_collective(
+        fixtures.collective_read_before_donation, donated=1
+    )
+
+
+def test_registered_entries_claim_only_real_mesh_axes():
+    """Every registered entry's mesh_axes is a non-empty subset of the
+    gate mesh's axis names — the collective-axis check is then
+    meaningful fleet-wide (lint_entry_points passes each entry's own
+    set)."""
+    from crdt_tpu.parallel.mesh import ELEMENT_AXIS, REPLICA_AXIS
+
+    for ep in entry_points():
+        assert ep.mesh_axes, ep.name
+        assert set(ep.mesh_axes) <= {REPLICA_AXIS, ELEMENT_AXIS}, ep.name
+
+
+# ---- δ digest-gate soundness (the PR 3 hazard, statically) -----------------
+
+def test_production_gates_are_removal_preserving():
+    from crdt_tpu.analysis.jit_lint import check_gates
+
+    found = check_gates()
+    assert not errors(found), "\n".join(str(f) for f in found)
+
+
+def test_gate_check_fires_on_unsound_top_covered_gate():
+    from crdt_tpu.analysis.jit_lint import check_orswot_gate
+
+    checks = _checks(check_orswot_gate(
+        fixtures.gate_top_covered_unsound, "fixture_unsound_gate"
+    ))
+    assert "gate-removal-dropped" in checks
+
+
+def test_gate_check_fires_on_keep_everything_gate():
+    from crdt_tpu.analysis.jit_lint import check_orswot_gate
+
+    checks = _checks(check_orswot_gate(
+        lambda pkt, digest: pkt, "fixture_keep_all_gate"
+    ))
+    assert checks == {"gate-mask-ineffective"}
+
+
+def test_gate_check_fires_on_drop_everything_gate():
+    from crdt_tpu.analysis.jit_lint import check_orswot_gate
+
+    checks = _checks(check_orswot_gate(
+        lambda pkt, digest: pkt._replace(
+            valid=jnp.zeros_like(pkt.valid)
+        ),
+        "fixture_drop_all_gate",
+    ))
+    assert {"gate-removal-dropped", "gate-overmask"} <= checks
+
+
+# ---- _cached_entry_fn mesh keying (ISSUE 7 satellite) ----------------------
+
+def test_cached_entry_fn_keys_on_mesh_shape():
+    """Re-linting under a different mesh must not reuse a jaxpr traced
+    for the wrong axis sizes: populate the jit cache for the same kind
+    under two mesh shapes and check the lookup resolves by shape."""
+    from crdt_tpu.analysis.jit_lint import _cached_entry_fn
+    from crdt_tpu.parallel import anti_entropy as ae, make_mesh
+
+    ep = {e.name: e for e in entry_points()}["mesh_fold_gset"]
+    mesh_a, mesh_b = make_mesh(4, 2), make_mesh(2, 4)
+    ep.invoke(mesh_a, ep.make_args(mesh_a))
+    ep.invoke(mesh_b, ep.make_args(mesh_b))
+
+    for mesh in (mesh_a, mesh_b):
+        fn = _cached_entry_fn(ep.kind, ep.n_donated, mesh)
+        assert fn is not None
+        keys = [
+            k for k, v in ae._FN_CACHE.items()
+            if v is fn and k[0] == ep.kind
+        ]
+        assert keys, "selected fn not in the cache?"
+        assert tuple(keys[0][1].shape.items()) == tuple(mesh.shape.items())
+    assert (_cached_entry_fn(ep.kind, ep.n_donated, mesh_a)
+            is not _cached_entry_fn(ep.kind, ep.n_donated, mesh_b))
+
+
 # ---- entry-point registry -------------------------------------------------
 
 def test_all_public_mesh_entry_points_registered():
@@ -383,3 +504,62 @@ def test_runner_rejects_unknown_sections():
     rsc = _load_runner()
     with pytest.raises(SystemExit):
         rsc.main(["--only", "nonsense"])
+
+
+def test_runner_knows_the_issue7_sections():
+    rsc = _load_runner()
+    assert {"schedules", "cost"} <= set(rsc.SECTIONS)
+
+
+def test_runner_json_summary_round_trip(tmp_path):
+    """The machine-readable summary (--json-out, via analysis.report):
+    per-section pass/fail, finding counts, wall-clock — CI trends this
+    instead of parsing text."""
+    import json
+
+    rsc = _load_runner()
+    out = tmp_path / "summary.json"
+    rc = rsc.main(["--only", "lint,schema", "--json-out", str(out)])
+    doc = json.loads(out.read_text())
+    assert doc["ok"] == (rc == 0)
+    assert set(doc["sections"]) == {"lint", "schema"}
+    for sec in doc["sections"].values():
+        assert {"ok", "seconds", "errors", "warnings", "checks"} <= set(sec)
+        assert sec["seconds"] >= 0
+    assert doc["total_seconds"] >= 0
+
+
+def test_low_conf_citations_are_all_audited():
+    """ISSUE 7 satellite: every [LOW-CONF] reference marker in the
+    package has a committed audit row (tools/check_reference.py) —
+    a new low-confidence guess must be audited against SURVEY.md §3
+    or this fails."""
+    import check_reference
+
+    cites = check_reference.low_conf_citations()
+    files = {c["file"] for c in cites}
+    assert {
+        "crdt_tpu/traits.py", "crdt_tpu/dot.py", "crdt_tpu/vclock.py",
+        "crdt_tpu/pure/gcounter.py", "crdt_tpu/pure/identifier.py",
+        "crdt_tpu/pure/lwwreg.py",
+    } <= files
+    unaudited = [c for c in cites if c["audit"].startswith("UNAUDITED")]
+    assert not unaudited, unaudited
+
+
+def test_report_summarize_counts_severities():
+    from crdt_tpu.analysis.report import Finding, SectionResult, summarize
+
+    sections = [SectionResult(
+        name="demo",
+        findings=[
+            Finding("a-check", "s", "boom"),
+            Finding("b-check", "s", "meh", severity="warning"),
+        ],
+        seconds=1.25,
+    )]
+    doc = summarize(sections)
+    assert doc["ok"] is False
+    sec = doc["sections"]["demo"]
+    assert (sec["errors"], sec["warnings"]) == (1, 1)
+    assert sec["checks"] == ["a-check", "b-check"]

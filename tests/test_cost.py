@@ -1,0 +1,178 @@
+"""Tier-1 gate: the static cost/residency budget pass
+(crdt_tpu.analysis.cost) and its committed table flow.
+
+Fast tier: metric sanity on hand-built programs (liveness, collective
+byte pricing through scan trip counts), the budget comparison logic on
+explicit dicts (regression / missing / stale / mesh-mismatch), the
+--write-budgets JSON round-trip, and the committed table's freshness on
+a cheap entry subset. The full-fleet check rides the slow tier (and
+tools/run_static_checks.py --only cost, where the traces are shared
+with the jit-lint)."""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from crdt_tpu.analysis import cost, fixtures
+from crdt_tpu.analysis.report import errors
+from crdt_tpu.parallel import make_mesh
+from crdt_tpu.parallel.mesh import REPLICA_AXIS
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+sys.path.insert(0, TOOLS)
+
+CHEAP_ENTRIES = ("mesh_fold_gset", "mesh_fold_clocks", "mesh_fold_lww")
+
+
+def _cost_of(fn, *args):
+    return cost.cost_of_jaxpr(jax.make_jaxpr(fn)(*args))
+
+
+# ---- metric sanity --------------------------------------------------------
+
+def test_peak_bytes_covers_inputs_and_temps():
+    x = jnp.zeros((1024,), jnp.uint32)          # 4096 B input
+    got = _cost_of(lambda x: (x + 1).sum(), x)
+    assert got["peak_bytes"] >= 4096
+    assert got["eqns"] >= 2
+
+
+def test_budget_pad_fixture_busts_the_lean_twin():
+    """The committed budget-busting fixture: same I/O contract, ~1e5×
+    the residency — the gate metric must see it."""
+    x = jnp.zeros((8,), jnp.uint32)
+    fat = _cost_of(fixtures.kernel_budget_pad, x)
+    lean = _cost_of(fixtures.kernel_budget_lean, x)
+    assert fat["peak_bytes"] > 1000 * lean["peak_bytes"]
+
+
+def test_collective_bytes_price_ring_rounds_through_scan():
+    """A fori_loop ring lowers to scan; the per-round ppermute bytes
+    must be multiplied by the trip count (the δ ring's dominant wire
+    term), and a non-collective program prices zero."""
+    mesh = make_mesh(4, 2)
+    p = 4
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def ring(x, rounds):
+        def body(x):
+            def step(_, x):
+                return lax.ppermute(x, REPLICA_AXIS, perm)
+
+            return lax.fori_loop(0, rounds, step, x)
+
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=P(REPLICA_AXIS),
+            out_specs=P(REPLICA_AXIS), check_vma=False,
+        )(x)
+
+    x = jnp.zeros((4, 64), jnp.uint32)
+    one = _cost_of(lambda x: ring(x, 1), x)
+    three = _cost_of(lambda x: ring(x, 3), x)
+    assert one["collective_bytes"] > 0
+    assert three["collective_bytes"] == 3 * one["collective_bytes"]
+    assert _cost_of(lambda x: x + 1, x)["collective_bytes"] == 0
+
+
+# ---- budget comparison logic ----------------------------------------------
+
+_GOT = {"peak_bytes": 1000, "collective_bytes": 100, "eqns": 50}
+
+
+def _check(measured, budgets):
+    return cost.check_budgets(measured=measured, budgets=budgets)
+
+
+def test_budget_within_tolerance_passes():
+    assert _check({"e": _GOT}, {"e": dict(_GOT)}) == []
+    grown = {"peak_bytes": 1099, "collective_bytes": 109, "eqns": 55}
+    assert _check({"e": grown}, {"e": dict(_GOT)}) == []
+
+
+def test_budget_regression_fails_each_metric():
+    for metric in cost.METRICS:
+        got = dict(_GOT)
+        got[metric] = int(_GOT[metric] * 1.2)
+        found = _check({"e": got}, {"e": dict(_GOT)})
+        assert [f.check for f in errors(found)] == ["cost-budget"], metric
+        assert metric in found[0].detail
+
+
+def test_missing_budget_is_an_error_and_stale_row_a_warning():
+    found = _check({"new_entry": _GOT}, {})
+    assert {f.check for f in errors(found)} == {"cost-budget-missing"}
+    found = _check({}, {"gone_entry": dict(_GOT)})
+    assert not errors(found)
+    assert {f.check for f in found} == {"cost-budget-stale"}
+
+
+def test_write_budgets_round_trip(tmp_path):
+    """--write-budgets flow: write, reload, re-check clean; the mesh
+    shape is stamped so a foreign topology refuses the comparison."""
+    path = str(tmp_path / "budgets.json")
+    measured = {"e": dict(_GOT)}
+    cost.write_budgets(path=path, measured=measured)
+    doc = cost.load_budgets(path)
+    assert doc["entries"] == measured
+    assert doc["mesh"] == {"replica": 4, "element": 2}
+    assert cost.check_budgets(
+        measured=measured, budgets=doc["entries"]
+    ) == []
+    # Same doc, wrong live topology -> refuse, not compare.
+    doc["mesh"] = {"replica": 1, "element": 1}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    found = cost.check_budgets(measured=None, path=path)
+    assert [f.check for f in found] == ["cost-mesh-mismatch"]
+
+
+def test_trace_failed_entry_is_an_error_not_a_stale_row(tmp_path, monkeypatch):
+    """A registered entry whose invoke/trace raises must surface as a
+    cost-entry-error ERROR under `--only cost` (where the jit-lint
+    section that would otherwise report it never runs) — NOT as a
+    cost-budget-stale warning advising deletion of its budget row."""
+    path = str(tmp_path / "budgets.json")
+    cost.write_budgets(path=path, measured={"broken_entry": dict(_GOT)})
+    monkeypatch.setattr(
+        cost, "entry_jaxprs",
+        lambda mesh=None, names=None: {
+            "broken_entry": (None, RuntimeError("boom"), ()),
+        },
+    )
+    found = cost.check_budgets(path=path)
+    assert [f.check for f in errors(found)] == ["cost-entry-error"]
+    assert not any(f.check == "cost-budget-stale" for f in found)
+
+
+# ---- the committed table --------------------------------------------------
+
+def test_committed_budget_table_parses_and_covers_cheap_entries():
+    doc = cost.load_budgets()
+    assert doc, "tools/cost_budgets.json missing"
+    for name in CHEAP_ENTRIES:
+        assert name in doc["entries"], name
+        assert set(cost.METRICS) <= set(doc["entries"][name])
+
+
+def test_cheap_entries_fit_their_committed_budgets():
+    """Freshness on the cheap subset every tier-1 run (the full fleet
+    rides the slow tier below + run_static_checks --only cost)."""
+    doc = cost.load_budgets()
+    measured = cost.measure_entry_points(names=CHEAP_ENTRIES)
+    assert set(measured) == set(CHEAP_ENTRIES)
+    budgets = {k: doc["entries"][k] for k in CHEAP_ENTRIES}
+    found = cost.check_budgets(measured=measured, budgets=budgets)
+    assert not errors(found), "\n".join(str(f) for f in found)
+
+
+@pytest.mark.slow
+def test_full_fleet_fits_committed_budgets():
+    found = cost.check_budgets()
+    assert not errors(found), "\n".join(str(f) for f in found)

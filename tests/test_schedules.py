@@ -1,0 +1,241 @@
+"""Tier-1 gate: the bounded SEC model checker (crdt_tpu.analysis.schedules).
+
+Three layers, mirroring test_analysis.py's discipline for the law
+engine:
+
+- every REGISTERED kind converges bit-exactly under the whole bounded
+  delivery space (reorder / duplication / drop-with-resync; causal
+  interleavings for op-based kinds);
+- every DETECTOR fires on its committed broken fixture and stays quiet
+  on the honest lattice — including a pinned MINIMALITY property of the
+  shrunk counterexample;
+- the generator-degeneracy gate: a one-point domain vacuates every law
+  and every schedule, so it must fail discovery loudly.
+"""
+
+import re
+
+import jax.numpy as jnp
+import pytest
+
+from crdt_tpu.analysis import fixtures, schedules
+from crdt_tpu.analysis.registry import (
+    MergeKind,
+    get_merge_kind,
+    merge_kinds,
+)
+from crdt_tpu.analysis.report import errors
+
+KIND_NAMES = [k.name for k in merge_kinds()]
+
+
+# ---- the convergence gate --------------------------------------------------
+#
+# Curated-slow-tier discipline (conftest.py): tier-1 runs one cheap
+# representative per family end to end; the full 12-kind sweep rides
+# the slow tier AND runs on every `tools/run_static_checks.py` chain
+# (the `schedules` section always checks all registered kinds).
+
+FAST_KINDS = [
+    "gset", "vclock",                      # scalar/clock lattices
+    "orswot", "sparse_orswot",             # dense + sparse set family
+]
+
+
+@pytest.mark.parametrize("name", FAST_KINDS)
+def test_representative_kind_converges_under_bounded_schedules(name):
+    findings = schedules.check_kind_schedules(get_merge_kind(name))
+    bad = errors(findings)
+    assert not bad, "\n".join(str(f) for f in bad)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "name", [n for n in KIND_NAMES if n not in FAST_KINDS]
+)
+def test_remaining_kinds_converge_under_bounded_schedules(name):
+    findings = schedules.check_kind_schedules(get_merge_kind(name))
+    bad = errors(findings)
+    assert not bad, "\n".join(str(f) for f in bad)
+
+
+@pytest.mark.parametrize("name", KIND_NAMES)
+def test_registered_generator_is_not_degenerate(name):
+    findings = schedules.generator_degeneracy(get_merge_kind(name))
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+def test_orswot_registered_delta_hook_is_used():
+    """The flagship kind registers an explicit schedule generator; the
+    checker must consume it (4 δs over 3 origins) rather than falling
+    back to the derived set."""
+    kind = get_merge_kind("orswot")
+    assert kind.deltas is not None
+    ops = schedules.derive_ops(kind)
+    assert len(ops) == 4
+    assert {o for o, _ in ops} == {0, 1, 2}
+
+
+# ---- schedule-space enumeration -------------------------------------------
+
+def test_schedule_space_shape():
+    """The bound is committed: every permutation appears, every
+    schedule delivers every op at least once, and the dup/drop variants
+    are present (duplication is what catches non-idempotent delivery,
+    resync-reorder is what catches non-inflationary δs)."""
+    scheds = schedules.enumerate_schedules(4)
+    seqs = {seq for _, seq in scheds}
+    labels = {label for label, _ in scheds}
+    assert {"reorder", "dup-late", "dup-now", "drop-resync"} <= labels
+    import itertools
+
+    for p in itertools.permutations(range(4)):
+        assert p in seqs
+    for _, seq in scheds:
+        assert set(seq) == {0, 1, 2, 3}
+
+
+def test_causal_schedules_respect_origin_order():
+    seqs = schedules.causal_schedules([0, 1, 0, 2])
+    # op 0 and op 2 share origin 0: 0 must always precede 2.
+    assert seqs
+    for s in seqs:
+        assert s.index(0) < s.index(2)
+    # And the interleavings are exactly-once permutations.
+    for s in seqs:
+        assert sorted(s) == [0, 1, 2, 3]
+
+
+# ---- detectors fire on the committed broken fixtures ----------------------
+
+_FIXTURE_RUNS = {}
+
+
+def _kind_findings(kind):
+    """One checker run per fixture kind for the whole module — several
+    tests read the same result (detector + minimality + replay), and
+    each run re-traces a fresh scan."""
+    if kind.name not in _FIXTURE_RUNS:
+        _FIXTURE_RUNS[kind.name] = schedules.check_kind_schedules(kind)
+    return _FIXTURE_RUNS[kind.name]
+
+
+def _checks(findings):
+    return {f.check for f in errors(findings)}
+
+
+def test_checker_clean_on_honest_lattice():
+    assert _checks(_kind_findings(fixtures.GOOD_MAX)) == set()
+
+
+def test_checker_fires_on_duplicated_delivery_of_nonidempotent_join():
+    assert "sec-divergence" in _checks(_kind_findings(fixtures.NOT_IDEMPOTENT))
+
+
+def test_checker_fires_on_noninflationary_delta():
+    assert "sec-divergence" in _checks(
+        _kind_findings(fixtures.DELTA_NOT_INFLATION)
+    )
+
+
+def test_checker_fires_on_noncommuting_apply_causal_path():
+    found = _kind_findings(fixtures.NON_COMMUTING_APPLY)
+    assert "causal-divergence" in _checks(found)
+    # The join itself is an honest max — the δ path stays clean, so the
+    # finding is attributed to the CmRDT path, not smeared.
+    assert "sec-divergence" not in _checks(found)
+
+
+def test_degeneracy_gate_fires_on_constant_generator():
+    assert _checks(
+        schedules.generator_degeneracy(fixtures.DEGENERATE_GENERATOR)
+    ) == {"generator-degenerate"}
+    assert not schedules.generator_degeneracy(fixtures.GOOD_MAX)
+
+
+def test_degeneracy_gate_fires_on_empty_generator():
+    empty = MergeKind(
+        name="fixture_empty_generator", join=jnp.maximum, states=lambda: []
+    )
+    assert _checks(schedules.generator_degeneracy(empty)) == {
+        "generator-degenerate"
+    }
+
+
+# ---- counterexample minimality --------------------------------------------
+
+def test_counterexample_is_minimized_on_known_broken_kind():
+    """Pinned minimality: for the non-idempotent join (a + b), ONLY
+    duplication diverges (reorder alone converges — addition commutes),
+    so the shrunk schedule must be exactly one redundant delivery on
+    top of the 4-op set: length 5, and irreducible (dropping the dup
+    converges; dropping anything else breaks eventual delivery)."""
+    found = errors(_kind_findings(fixtures.NOT_IDEMPOTENT))
+    assert found
+    detail = found[0].detail
+    assert "minimized counterexample" in detail
+    head = detail.split("diverges", 1)[0]
+    steps = re.findall(r"d\d+@r\d+", head)
+    assert len(steps) == 5, detail
+    # Exactly one op delivered twice, all four present.
+    ops = [s.split("@")[0] for s in steps]
+    assert len(set(ops)) == 4
+    dup = [o for o in set(ops) if ops.count(o) == 2]
+    assert len(dup) == 1
+
+
+def test_minimize_schedule_is_irreducible():
+    """Property of the shrinker itself: the result still diverges, and
+    no single further deletion that keeps coverage does."""
+    kind = fixtures.NOT_IDEMPOTENT
+    deltas = [d for _, d in schedules.derive_ops(kind)]
+    identity = kind.states()[0]
+    join = schedules._norm_join(kind.join)
+
+    def deliver(state, d):
+        out, _ = join(state, d)
+        return out, None
+
+    ref = schedules._run_one(deliver, identity, deltas, range(len(deltas)))
+    ref_b = schedules._state_bytes(ref)
+
+    def diverges(seq):
+        got = schedules._run_one(deliver, identity, deltas, seq)
+        return schedules._state_bytes(got) != ref_b
+
+    # A deliberately bloated failing schedule: three redundant dups.
+    fat = (0, 0, 1, 2, 1, 3, 3)
+    assert diverges(fat)
+    small = schedules.minimize_schedule(fat, len(deltas), diverges)
+    assert diverges(small)
+    assert len(small) == 5  # 4 ops + exactly one surviving dup
+    for p in range(len(small)):
+        cand = small[:p] + small[p + 1:]
+        if set(range(len(deltas))) - set(cand):
+            continue
+        assert not diverges(cand), (small, cand)
+
+
+def test_counterexample_replays_identically_without_padding():
+    """The batched scan SKIPS sentinel padding rather than delivering
+    the identity — a broken join need not absorb the identity, and the
+    reported schedule must reproduce eagerly exactly as found (the
+    replace-join fixture is the regression: join(s, identity) = identity
+    would wipe the state and fabricate divergence on converging rows)."""
+    kind = fixtures.DELTA_NOT_INFLATION
+    found = errors(_kind_findings(kind))
+    assert found
+    deltas = [d for _, d in schedules.derive_ops(kind)]
+    identity = kind.states()[0]
+
+    def deliver(state, d):
+        return kind.join(state, d), None
+
+    head = found[0].detail.split("diverges", 1)[0]
+    seq = [int(tok[1:].split("@")[0])
+           for tok in re.findall(r"d\d+@r\d+", head)]
+    ref = schedules._run_one(
+        deliver, identity, deltas, range(len(deltas))
+    )
+    got = schedules._run_one(deliver, identity, deltas, seq)
+    assert schedules._state_bytes(got) != schedules._state_bytes(ref)

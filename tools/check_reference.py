@@ -15,6 +15,16 @@ tool:
   (cited-but-missing / present-but-uncited) plus per-file line counts
   so a reviewer can upgrade SURVEY.md citations to file:line. Exits 1
   on any delta so CI surfaces the drift.
+
+It also inventories every ``[LOW-CONF …]`` reference marker in the
+package docstrings and records each one's AUDIT status (the committed
+:data:`_LOW_CONF_AUDIT` table — verified against SURVEY.md §3, ISSUE 7
+satellite): with the mount absent every audited marker is
+**blueprint-only** (the survey is itself low-confidence on that symbol,
+so there is nothing to upgrade against); a populated mount turns every
+low-conf marker into an upgrade work item (rc 1) alongside the module
+delta; a marker the audit table does not know is flagged *unaudited*
+so new guesses cannot slip in silently.
 """
 
 from __future__ import annotations
@@ -30,6 +40,97 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: Survey rows that are section/test globs, not src/ module files.
 _NON_MODULES = {"build.rs"}
+
+_LOW_CONF_RE = re.compile(r"\[LOW-CONF[^\]]*\]")
+
+#: The committed audit (ISSUE 7 satellite): every [LOW-CONF] citation in
+#: the package, verified against SURVEY.md §3. "consistent" = the survey
+#: row itself marks the same symbol low-confidence, so the doc caveat is
+#: faithful; "extrapolated" = the symbol does not appear in the survey's
+#: row at all — the name is a plausible reconstruction beyond what the
+#: survey attests. Either way, mount-absent status is blueprint-only;
+#: re-verify (and upgrade to file:line) when the mount is populated.
+_LOW_CONF_AUDIT = {
+    ("crdt_tpu/traits.py", "ConflictingMarker"): (
+        "consistent: SURVEY §3 row 8 itself marks the conflicting-marker "
+        "error name [LOW-CONF on error name]"
+    ),
+    ("crdt_tpu/dot.py", "OrdDot"): (
+        "consistent: SURVEY §3 row 3 itself marks OrdDot [LOW-CONF]"
+    ),
+    ("crdt_tpu/pure/lwwreg.py", "LWWOp"): (
+        "consistent: SURVEY §3 row 8 pins update(val, marker) but not "
+        "the CmRDT Op shape; §3.2 only requires the Op to exist"
+    ),
+    ("crdt_tpu/pure/identifier.py", "module"): (
+        "consistent: SURVEY §3 row 12 itself marks the representation "
+        "[LOW-CONF]; the LSEQ/Logoot-style design is the survey's"
+    ),
+    ("crdt_tpu/pure/identifier.py", "Identifier.value"): (
+        "extrapolated: SURVEY §3 row 12 lists no `value` accessor — the "
+        "name is inferred from GList's usage in row 14"
+    ),
+    ("crdt_tpu/pure/gcounter.py", "GCounter.inc_many"): (
+        "extrapolated: SURVEY §3 row 5's symbol list (inc, apply, merge, "
+        "read) has no inc_many — the name is inferred from the "
+        "contiguous-dot semantics the row describes"
+    ),
+    ("crdt_tpu/vclock.py", "VClock.clone_without"): (
+        "consistent: SURVEY §3 row 2 lists clone_without but marks the "
+        "helper names [LOW-CONF]"
+    ),
+}
+
+#: Maps a (file, line-content) match to its audit key — by the nearest
+#: enclosing symbol named in the marker line's context.
+_AUDIT_HINTS = (
+    ("validate_merge", "ConflictingMarker"),
+    ("OrdDot", "OrdDot"),
+    ("CmRDT Op for LWWReg", "LWWOp"),
+    ("Identifier::value", "Identifier.value"),
+    ("between(lo, hi)", "module"),
+    ("inc_many", "GCounter.inc_many"),
+    ("clone_without", "VClock.clone_without"),
+)
+
+
+def low_conf_citations(root: str = ROOT) -> list:
+    """Every ``[LOW-CONF …]`` marker under crdt_tpu/, each joined to its
+    committed audit row (or flagged unaudited)."""
+    out = []
+    pkg = os.path.join(root, "crdt_tpu")
+    for dirpath, dirnames, files in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                lines = f.readlines()
+            for i, line in enumerate(lines, 1):
+                m = _LOW_CONF_RE.search(line)
+                if not m:
+                    continue
+                # Context = the marker line and its two predecessors
+                # (citations wrap across docstring lines).
+                ctx = "".join(lines[max(0, i - 3):i])
+                symbol = next(
+                    (sym for hint, sym in _AUDIT_HINTS if hint in ctx),
+                    None,
+                )
+                audit = _LOW_CONF_AUDIT.get((rel, symbol))
+                out.append({
+                    "file": rel,
+                    "line": i,
+                    "marker": m.group(0),
+                    "symbol": symbol,
+                    "audit": audit or (
+                        "UNAUDITED: add a row to "
+                        "tools/check_reference.py _LOW_CONF_AUDIT"
+                    ),
+                })
+    return out
 
 
 def survey_cited_modules(survey_path: str) -> list:
@@ -65,20 +166,35 @@ def main(argv=None) -> int:
 
     src = os.path.join(args.reference, "src")
     cited = survey_cited_modules(args.survey)
+    low_conf = low_conf_citations()
     evidence = {
         "checked_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "reference": args.reference,
         "survey_cited_modules": cited,
+        "low_conf_citations": low_conf,
     }
+    unaudited = [c for c in low_conf if c["audit"].startswith("UNAUDITED")]
 
     inv = inventory(src) if os.path.isdir(src) else {}
     if not inv:
         evidence["mount"] = "absent-or-empty"
+        evidence["low_conf_status"] = (
+            "blueprint-only: the mount is absent, so every audited "
+            "[LOW-CONF] citation stays a caveat against SURVEY.md §3 "
+            "(which is itself low-confidence on these symbols) — "
+            "nothing to upgrade against"
+        )
         evidence["verdict"] = (
             "reference mount absent/empty; SURVEY.md remains the "
             "blueprint of record (SURVEY.md §0)"
         )
         rc = 0
+        if unaudited:
+            evidence["verdict"] = (
+                f"{len(unaudited)} unaudited [LOW-CONF] citation(s) — "
+                "audit them in tools/check_reference.py _LOW_CONF_AUDIT"
+            )
+            rc = 1
     else:
         missing = sorted(set(cited) - set(inv))
         uncited = sorted(set(inv) - set(cited))
@@ -88,7 +204,12 @@ def main(argv=None) -> int:
             cited_but_missing=missing,
             present_but_uncited=uncited,
         )
-        if missing or uncited:
+        evidence["low_conf_status"] = (
+            f"mount populated: {len(low_conf)} [LOW-CONF] citation(s) "
+            "are now upgrade work items — verify each against src/ and "
+            "replace the marker with a file:line citation"
+        )
+        if missing or uncited or low_conf:
             evidence["verdict"] = (
                 "inventory drift: re-verify SURVEY.md module table and "
                 "upgrade citations to file:line (SURVEY.md §0)"

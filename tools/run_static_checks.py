@@ -3,21 +3,35 @@
 
 Sections (each timed, each independently skippable):
 
-- ``lint``     — ``ruff check .`` against the committed ``ruff.toml``
+- ``lint``      — ``ruff check .`` against the committed ``ruff.toml``
   when a ruff binary/module exists; otherwise the built-in fallback
   linter (F401 unused imports, E722 bare except, E999 syntax errors —
   the highest-signal subset, honoring ``# noqa``) so the gate never
   silently vanishes on images without ruff.
-- ``schema``   — the telemetry export contract
+- ``schema``    — the telemetry export contract
   (tools/check_telemetry_schema.py) against a live registry snapshot.
-- ``laws``     — the lattice-law engine (crdt_tpu.analysis.laws) over
+- ``laws``      — the lattice-law engine (crdt_tpu.analysis.laws) over
   every registered merge kind: commutativity / associativity /
   idempotence / identity / δ-inflation, bit-exact on canonical forms.
-- ``jit-lint`` — the jaxpr walker (crdt_tpu.analysis.jit_lint) over
+- ``schedules`` — the bounded SEC model checker
+  (crdt_tpu.analysis.schedules): every registered kind converges
+  bit-exactly under every delivery schedule up to the bound (reorder,
+  duplication, drop-with-resync; causal interleavings for op-based
+  kinds), with minimized counterexamples on violation — plus the
+  generator-degeneracy gate (a one-point domain vacuates every law).
+- ``jit-lint``  — the jaxpr walker (crdt_tpu.analysis.jit_lint) over
   every registered mesh entry point: traced-branch, unstable-sort,
-  float-accum, dtype-overflow, donation-alias — plus registry
+  float-accum, dtype-overflow, donation-alias, PLUS the collective-
+  semantics checks (ppermute bijection, collective axis-name vs the
+  entry's registered mesh axes, donated-read-after-collective) and the
+  δ digest-gate removal-preservation fixtures — plus registry
   discovery (an unregistered public ``mesh_*`` entry is a failure).
-- ``aliasing`` — the compiled-HLO input_output_alias gate
+- ``cost``      — the static cost/residency budget gate
+  (crdt_tpu.analysis.cost): estimated peak live bytes / collective
+  bytes moved / eqn count per entry vs the committed
+  ``tools/cost_budgets.json``; >10% regression fails.
+  ``--write-budgets`` re-baselines the table instead of checking.
+- ``aliasing``  — the compiled-HLO input_output_alias gate
   (tools/check_aliasing.py) over every registered donating entry.
 
 CLI::
@@ -25,10 +39,16 @@ CLI::
     python tools/run_static_checks.py              # everything, rc != 0 on any error
     python tools/run_static_checks.py --only laws,jit-lint
     python tools/run_static_checks.py --skip lint
+    python tools/run_static_checks.py --json-out static_checks.json
+    python tools/run_static_checks.py --only cost --write-budgets
+
+``--json-out`` writes the machine-readable per-section summary
+(pass/fail, finding counts, wall-clock — crdt_tpu.analysis.report) so
+CI can trend the gates instead of parsing text.
 
 The jax-heavy sections share one process (and the repo's persistent XLA
 compilation cache at .jax_cache/), so a warm run of the whole suite
-stays under the 60 s budget in ISSUE 4's acceptance criteria.
+stays inside the 120 s budget in ISSUE 7's acceptance criteria.
 """
 
 from __future__ import annotations
@@ -44,7 +64,9 @@ from typing import List, Tuple
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-SECTIONS = ("lint", "schema", "laws", "jit-lint", "aliasing")
+SECTIONS = (
+    "lint", "schema", "laws", "schedules", "jit-lint", "cost", "aliasing",
+)
 
 # Directories the fallback linter walks (ruff takes its own config).
 LINT_TARGETS = ("crdt_tpu", "tools", "tests", "examples", "bench.py")
@@ -171,20 +193,35 @@ def run_schema() -> List[str]:
     return validate_snapshot(metrics.snapshot())
 
 
-# ---- section: laws / jit-lint / aliasing ---------------------------------
+# ---- sections: laws / schedules / jit-lint / cost / aliasing --------------
 
-def run_laws() -> List[str]:
+def run_laws():
     from crdt_tpu.analysis import laws
-    from crdt_tpu.analysis.report import errors
 
-    return [str(f) for f in errors(laws.check_all())]
+    return laws.check_all()
 
 
-def run_jit_lint() -> List[str]:
-    from crdt_tpu.analysis.jit_lint import lint_entry_points
-    from crdt_tpu.analysis.report import errors
+def run_schedules():
+    from crdt_tpu.analysis import schedules
 
-    return [str(f) for f in errors(lint_entry_points())]
+    return schedules.check_all_schedules()
+
+
+def run_jit_lint():
+    from crdt_tpu.analysis.jit_lint import check_gates, lint_entry_points
+
+    return lint_entry_points() + check_gates()
+
+
+def run_cost(write_budgets: bool = False):
+    from crdt_tpu.analysis import cost
+
+    if write_budgets:
+        measured = cost.write_budgets()
+        print(f"     wrote {len(measured)} entry budgets -> "
+              f"{os.path.relpath(cost.BUDGET_PATH, ROOT)}")
+        return []
+    return cost.check_budgets()
 
 
 def run_aliasing() -> List[str]:
@@ -201,15 +238,43 @@ RUNNERS = {
     "lint": run_lint,
     "schema": run_schema,
     "laws": run_laws,
+    "schedules": run_schedules,
     "jit-lint": run_jit_lint,
+    "cost": run_cost,
     "aliasing": run_aliasing,
 }
+
+_JAX_SECTIONS = ("laws", "schedules", "jit-lint", "cost", "aliasing")
+
+
+def _as_findings(section: str, result):
+    """Normalize a runner's result (Finding list or legacy string list)
+    into Findings so every section reports uniformly."""
+    from crdt_tpu.analysis.report import Finding
+
+    out = []
+    for item in result:
+        if isinstance(item, Finding):
+            out.append(item)
+        else:
+            out.append(Finding(section, section, str(item)))
+    return out
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--only", default="", help="comma-separated sections")
     ap.add_argument("--skip", default="", help="comma-separated sections")
+    ap.add_argument(
+        "--json-out", default="",
+        help="write the machine-readable per-section summary "
+        "(crdt_tpu.analysis.report) to this path",
+    )
+    ap.add_argument(
+        "--write-budgets", action="store_true",
+        help="re-baseline tools/cost_budgets.json instead of checking "
+        "(the cost section's tile_sweep --write-table flow)",
+    )
     args = ap.parse_args(argv)
 
     only = {s for s in args.only.split(",") if s}
@@ -222,16 +287,18 @@ def main(argv=None) -> int:
         if (not only or s in only) and s not in skip
     ]
 
-    if any(s in chosen for s in ("laws", "jit-lint", "aliasing")):
+    if any(s in chosen for s in _JAX_SECTIONS):
         # One CPU pin + one persistent compile cache for every jax
         # section (mirrors tests/conftest.py) — this is what keeps the
-        # warm full suite inside the 60 s budget.
-        if ("XLA_FLAGS" not in os.environ
-                and "JAX_PLATFORMS" not in os.environ):
-            os.environ["JAX_PLATFORMS"] = "cpu"
-            os.environ["XLA_FLAGS"] = (
-                "--xla_force_host_platform_device_count=8"
-            )
+        # warm full suite inside the 120 s budget. The two vars default
+        # INDEPENDENTLY: an ambient JAX_PLATFORMS=cpu (common in CI
+        # images) must not silently collapse the virtual mesh to one
+        # device — the gates would then lint/price a 1×1 program while
+        # the committed budgets and HLO pins assume the 4×2 gate mesh.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+        )
         os.environ.setdefault(
             "JAX_COMPILATION_CACHE_DIR", os.path.join(ROOT, ".jax_cache")
         )
@@ -239,21 +306,39 @@ def main(argv=None) -> int:
             "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2"
         )
 
+    from crdt_tpu.analysis.report import (
+        Finding, SectionResult, errors, write_summary,
+    )
+
     rc = 0
+    results: List[SectionResult] = []
     t_all = time.perf_counter()
     for section in chosen:
         t0 = time.perf_counter()
         try:
-            errs = RUNNERS[section]()
+            if section == "cost":
+                found = run_cost(write_budgets=args.write_budgets)
+            else:
+                found = RUNNERS[section]()
+            findings = _as_findings(section, found)
         except Exception as exc:  # a crashed section is a failed gate
-            errs = [f"section crashed: {type(exc).__name__}: {exc}"]
+            findings = [Finding(
+                "section-crash", section,
+                f"section crashed: {type(exc).__name__}: {exc}",
+            )]
         dt = time.perf_counter() - t0
-        status = "PASS" if not errs else "FAIL"
+        res = SectionResult(name=section, findings=findings, seconds=dt)
+        results.append(res)
+        bad = errors(findings)
+        status = "PASS" if not bad else "FAIL"
         print(f"{status} {section:<10} ({dt:5.1f}s)")
-        for e in errs:
-            print(f"     {e}")
-        if errs:
+        for f in findings:
+            print(f"     {f}")
+        if bad:
             rc = 1
+    if args.json_out:
+        write_summary(results, args.json_out)
+        print(f"summary -> {args.json_out}")
     print(f"{'OK' if rc == 0 else 'FAILED'} static checks "
           f"({time.perf_counter() - t_all:.1f}s)")
     return rc
