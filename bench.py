@@ -635,6 +635,151 @@ def bench_reclaim():
     }]
 
 
+def bench_chaos():
+    """Degraded-mesh fault-tolerance leg (``--chaos`` runs it alone;
+    ISSUE 8's acceptance gate): sustained injected corruption + drops
+    on the 8-rank δ ring with ONE evicted-then-rejoined rank, healed by
+    state-driven resync and asserted BIT-IDENTICAL to the fault-free
+    fixpoint before any number is reported; plus the frontier-unpinning
+    measurement — the straggler-parked reclamation scenario where the
+    pinned (pre-PR) frontier retires nothing and the membership-driven
+    eviction frontier fires. The damage absorbed (packets lost and
+    rejected while convergence survives) is the metric."""
+    import random
+
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu import reclaim
+    from crdt_tpu.faults import FaultPlan, Membership
+    from crdt_tpu.faults.scenarios import mint_streams
+    from crdt_tpu.models import BatchedOrswot
+    from crdt_tpu.parallel import make_mesh, mesh_delta_gossip, mesh_gossip
+    from crdt_tpu.parallel.delta import interval_accumulate
+    from crdt_tpu.parallel.mesh import shard_orswot
+    from crdt_tpu.pure.orswot import Orswot
+    from crdt_tpu.utils import Interner
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        log("chaos leg needs >= 2 devices for a ring; skipping")
+        return []
+    p = min(n_dev, 8)
+    runs = int(os.environ.get("BENCH_CHAOS_RUNS", 3))
+    rng = random.Random(int(os.environ.get("BENCH_CHAOS_SEED", 17)))
+    sites, _ = mint_streams(rng, p, 4 * p)
+    batched = BatchedOrswot.from_pure(
+        sites,
+        members=Interner(list(range(5))),
+        actors=Interner([f"s{i}" for i in range(p)]),
+    )
+    mesh = make_mesh(p, 1)
+    cur = shard_orswot(batched.state, mesh)
+
+    rows_ref, _ = mesh_gossip(cur, mesh, local_fold="tree")
+    ref0 = jax.tree.map(lambda x: x[0], rows_ref)
+    # A mid-ring rank on big meshes; the LAST rank on tiny ones (p - 3
+    # would go negative at p == 2 and silently evict nobody).
+    evicted_rank = p - 3 if p >= 4 else p - 1
+    plan = FaultPlan(
+        seed=int(os.environ.get("BENCH_CHAOS_SEED", 17)),
+        corrupt=0.6, drop=0.2, evicted=(evicted_rank,),
+    )
+
+    def tracking(state):
+        z = jax.tree.map(jnp.zeros_like, state)
+        d0 = jnp.zeros(state.ctr.shape[:-1], bool)
+        f0 = jnp.zeros(state.ctr.shape, state.ctr.dtype)
+        return interval_accumulate(d0, f0, z, state)
+
+    dropped = rejected = 0
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        d, f = tracking(cur)
+        out = mesh_delta_gossip(cur, d, f, mesh, local_fold="tree",
+                                faults=plan)
+        fc = out[-1]
+        dropped += int(fc.packets_dropped)
+        rejected += int(fc.packets_rejected)
+        assert int(out[3]) >= 1, "loss must void the residue certificate"
+        cur = out[0]
+    chaos_s = time.perf_counter() - t0
+    # Heal = state-driven resync; it is ALSO the evicted rank's rejoin.
+    t0 = time.perf_counter()
+    healed, _ = mesh_gossip(cur, mesh, local_fold="tree")
+    heal_s = time.perf_counter() - t0
+    identical = all(
+        all(
+            bool(jnp.array_equal(x, y))
+            for x, y in zip(
+                jax.tree.leaves(jax.tree.map(lambda v: v[i], healed)),
+                jax.tree.leaves(ref0),
+            )
+        )
+        for i in range(p)
+    )
+    assert identical, "chaos heal diverged from the fault-free fixpoint"
+
+    # Frontier unpinning: live ranks hold a parked remove their tops
+    # cover; the straggler's stale top pins the all-ranks frontier
+    # (pre-PR: nothing retires) while the membership eviction frontier
+    # lets compaction fire.
+    n = 5
+    stragglers = [Orswot() for _ in range(n)]
+    for i in range(n):
+        stragglers[i].apply(stragglers[i].add(
+            i, stragglers[i].read().derive_add_ctx(f"s{i}")
+        ))
+    ghost = Orswot()
+    ghost.apply(ghost.add("never", ghost.read().derive_add_ctx("zz")))
+    rm_op = ghost.rm("never", ghost.contains("never").derive_rm_ctx())
+    for i in range(n - 1):
+        stragglers[i].apply(rm_op)
+    model = BatchedOrswot.from_pure(
+        stragglers,
+        members=Interner(list(range(n)) + ["never"]),
+        actors=Interner([f"s{i}" for i in range(n)] + ["zz"]),
+    )
+    zz = model.actors.id_of("zz")
+    model.state = model.state._replace(
+        top=model.state.top.at[: n - 1, zz].set(1)
+    )
+    parked = int(jnp.sum(model.state.dvalid))
+    pinned = reclaim.compact_model(model, reclaim.model_frontier(model))
+    members = Membership(n, k_suspect=2)
+    members.evict(n - 1)
+    live_frontier = reclaim.host_frontier(
+        [np.asarray(model.state.top[i]) for i in members.live()]
+    )
+    unpinned = reclaim.compact_model(model, live_frontier)
+    members.rejoin(n - 1)
+    assert pinned["reclaimed_slots"] == 0
+    assert unpinned["reclaimed_slots"] >= parked
+
+    log(
+        f"config-chaos: {p}-rank δ ring x {runs} degraded runs "
+        f"(corrupt=0.6 drop=0.2, rank {evicted_rank} evicted): "
+        f"{rejected} rejected + {dropped} dropped packets absorbed in "
+        f"{chaos_s:.1f}s, healed bit-identical in {heal_s:.1f}s; "
+        f"frontier eviction retired {unpinned['reclaimed_slots']} parked "
+        f"slots the pinned frontier kept ({pinned['reclaimed_slots']})"
+    )
+    return [{
+        "config": "chaos", "metric": "packets_lost_and_healed",
+        "value": dropped + rejected, "unit": "packets",
+        "packets_rejected": rejected,
+        "packets_dropped": dropped,
+        "runs": runs,
+        "evicted_rank": evicted_rank,
+        "chaos_seconds": round(chaos_s, 3),
+        "heal_seconds": round(heal_s, 3),
+        "reclaimed_slots_pinned": pinned["reclaimed_slots"],
+        "reclaimed_slots_evicted": unpinned["reclaimed_slots"],
+        "bit_identical": identical,
+        "shape": f"{p}x{4 * p}",
+    }]
+
+
 def bench_cpu() -> float:
     from crdt_tpu.pure.orswot import Orswot
     from crdt_tpu.vclock import VClock
@@ -1436,6 +1581,14 @@ def parse_args(argv=None):
              "hysteresis) and print its record to stdout",
     )
     ap.add_argument(
+        "--chaos",
+        action="store_true",
+        help="run ONLY the degraded-mesh fault-tolerance leg (corrupted "
+             "+ dropped packets and an evicted-then-rejoined rank on "
+             "the δ ring, healed bit-identical; frontier unpinning) and "
+             "print its record to stdout",
+    )
+    ap.add_argument(
         "--flagship",
         action="store_true",
         help="run ONLY the flagship replica-streaming leg (10,240 "
@@ -1466,6 +1619,21 @@ def main(argv=None):
         )
         log(json.dumps(rec))
         print(json.dumps(rec))
+        return
+    if args.chaos:
+        # The fast chaos-only mode: one leg, one stdout JSON line.
+        if os.environ.get("BENCH_PROBE", "1") != "0" and not tpu_reachable():
+            from crdt_tpu.utils.cpu_pin import pin_cpu
+
+            pin_cpu(virtual_devices=8)
+        from crdt_tpu.telemetry import span
+
+        with span("bench.chaos", quick=True):
+            recs = bench_chaos()
+        for rec in recs:
+            log(json.dumps(rec))
+        print(json.dumps(recs[0] if recs else {"config": "chaos",
+                                               "skipped": True}))
         return
     if args.reclaim:
         # The fast reclaim-only mode: one leg, one stdout JSON line.
@@ -1531,6 +1699,7 @@ def main(argv=None):
         ("elastic", bench_elastic),
         ("comms", bench_comms),
         ("reclaim", bench_reclaim),
+        ("chaos", bench_chaos),
     ]:
         if os.environ.get(f"BENCH_{name.upper()}", "1") != "0":
             try:
@@ -1624,6 +1793,18 @@ def main(argv=None):
                 "peak_state_bytes", "end_state_bytes",
                 "end_state_bytes_never_reclaimed", "bit_identical",
             ) if k in rc
+        }
+    # The chaos leg rides the headline record too: the damage the mesh
+    # absorbs while staying bit-identical (and the frontier unpinning)
+    # is ISSUE 8's metric of record, not a diagnostic.
+    ch = next((r for r in records if r.get("config") == "chaos"), None)
+    if ch is not None:
+        headline["chaos"] = {
+            k: ch[k] for k in (
+                "value", "packets_rejected", "packets_dropped",
+                "evicted_rank", "reclaimed_slots_pinned",
+                "reclaimed_slots_evicted", "bit_identical",
+            ) if k in ch
         }
     # The flagship streaming record rides the headline too: it IS the
     # metric of record at the north-star shape (ROADMAP item 1) — the

@@ -290,3 +290,26 @@ def kernel_budget_pad(x):
 def kernel_budget_lean(x):
     """Honest twin of the same contract (sum of squares of 8 lanes)."""
     return jnp.sum(x * x)
+
+
+# ---- fault-tolerance fixtures (crdt_tpu/faults/) --------------------------
+
+def checksum_ignores_corruption(tree):
+    """Broken link-integrity twin: a constant digest that verifies
+    EVERY payload, corrupted or not — a receiver using it would join
+    wire-flipped content. ``integrity.checksum_detects`` must fail it
+    (the faults static-check section pins that the detector fires)."""
+    del tree
+    return jnp.zeros((), jnp.uint32)
+
+
+def eviction_drops_ranks(p: int, evicted=()):
+    """Broken membership twin: rebuilds the ring by OMITTING evicted
+    ranks from the permutation instead of self-looping them — no longer
+    a bijection of the full axis (evicted ranks neither send nor
+    receive), exactly the malformed ppermute the PR 7 collective lint
+    rejects. ``membership.validate_perm`` must fail it."""
+    live = [i for i in range(p) if i not in set(evicted)]
+    return sorted(
+        (live[i], live[(i + 1) % len(live)]) for i in range(len(live))
+    )

@@ -411,7 +411,11 @@ def _cached_entry_fn(kind: str, n_donated: int, mesh=None):
     donate_argnums, *extra)). The lookup keys on (kind, n_donated,
     mesh shape) — matching on (kind, donation) alone returned whichever
     mesh was invoked LAST, so re-linting under a different mesh could
-    silently reuse a jaxpr traced for the wrong axis sizes."""
+    silently reuse a jaxpr traced for the wrong axis sizes. Keys
+    carrying a FaultPlan are skipped: a faulted program is a DIFFERENT
+    program (the stream's takes an extra block-index arg), and the
+    analysis gates must always see the flags-off one."""
+    from ..faults import FaultPlan
     from ..parallel import anti_entropy as ae
 
     def mesh_matches(key_mesh) -> bool:
@@ -425,6 +429,7 @@ def _cached_entry_fn(kind: str, n_donated: int, mesh=None):
         fn for key, fn in ae._FN_CACHE.items()
         if key[0] == kind and key[3] == tuple(range(n_donated))
         and mesh_matches(key[1])
+        and not any(isinstance(x, FaultPlan) for x in key[4:])
     ]
     return hits[-1] if hits else None
 

@@ -141,9 +141,24 @@ class Compactor:
     module: str = ""
 
 
+@dataclass(frozen=True)
+class FaultSurface:
+    """One registered fault-capable mesh entry (crdt_tpu/faults/): a
+    public ``crdt_tpu.parallel`` callable that accepts a ``faults=``
+    FaultPlan. Registration is the coverage contract — the ``faults``
+    static-check section (tools/run_static_checks.py, via
+    ``crdt_tpu.faults.static_checks``) fails discovery for any
+    fault-capable public entry that forgot to register, exactly like an
+    unregistered join or mesh entry point."""
+
+    name: str
+    module: str = ""
+
+
 _MERGE: Dict[str, MergeKind] = {}
 _ENTRY: Dict[str, EntryPoint] = {}
 _COMPACT: Dict[str, Compactor] = {}
+_FAULT_SURFACES: Dict[str, FaultSurface] = {}
 
 # Public callables in crdt_tpu.parallel matching this are mesh entry
 # points and MUST be registered (gossip_elastic/delta_gossip_elastic are
@@ -216,6 +231,61 @@ def register_compactor(
     return comp
 
 
+def register_fault_surface(name: str, *, module: str = "") -> FaultSurface:
+    fs = FaultSurface(name=name, module=module)
+    _FAULT_SURFACES[name] = fs
+    return fs
+
+
+def fault_surfaces() -> Tuple[FaultSurface, ...]:
+    ensure_registered()
+    return tuple(_FAULT_SURFACES[k] for k in sorted(_FAULT_SURFACES))
+
+
+def _discover_public(match) -> set:
+    """Two-level discovery over ``crdt_tpu.parallel``: the package
+    surface AND every submodule's own definitions (by ``__module__``),
+    so a symbol that skipped the ``parallel/__init__`` re-export list
+    cannot hide from a coverage gate. ``match(name, obj)`` is the
+    predicate — ONE home for the walk, shared by the entry-point and
+    fault-surface gates so discovery-rule fixes cannot drift apart."""
+    import importlib
+    import pkgutil
+
+    import crdt_tpu.parallel as par
+
+    found = {n for n in dir(par) if match(n, getattr(par, n))}
+    for info in pkgutil.iter_modules(par.__path__):
+        mod = importlib.import_module(f"crdt_tpu.parallel.{info.name}")
+        for n in dir(mod):
+            obj = getattr(mod, n)
+            if (match(n, obj)
+                    and getattr(obj, "__module__", "") == mod.__name__):
+                found.add(n)
+    return found
+
+
+def unregistered_fault_surfaces() -> List[str]:
+    """Fault-capable public callables in ``crdt_tpu.parallel`` (a
+    ``faults`` parameter in the signature) that never called
+    :func:`register_fault_surface`. Same two-level discovery as
+    :func:`unregistered_entry_points` — so a fault-capable entry cannot
+    hide from the gate by skipping the re-export list."""
+    import inspect
+
+    ensure_registered()
+
+    def takes_faults(n, obj) -> bool:
+        if n.startswith("_") or not callable(obj):
+            return False
+        try:
+            return "faults" in inspect.signature(obj).parameters
+        except (TypeError, ValueError):
+            return False
+
+    return sorted(_discover_public(takes_faults) - set(_FAULT_SURFACES))
+
+
 def compactors() -> Tuple[Compactor, ...]:
     ensure_registered()
     return tuple(_COMPACT[k] for k in sorted(_COMPACT))
@@ -259,27 +329,14 @@ def registered_entry_names() -> Tuple[str, ...]:
 
 def unregistered_entry_points() -> List[str]:
     """Mesh-entry-shaped public callables that never registered — each
-    one fails the aliasing gate. Discovery scans the package surface
-    AND every ``crdt_tpu.parallel`` submodule's own definitions (by
-    ``__module__``), so an entry point that also skipped the
-    ``parallel/__init__`` re-export list cannot hide from the gate."""
-    import importlib
-    import pkgutil
-
-    import crdt_tpu.parallel as par
-
+    one fails the aliasing gate. :func:`_discover_public` scans the
+    package surface AND every submodule's own definitions, so an entry
+    point that also skipped the ``parallel/__init__`` re-export list
+    cannot hide from the gate."""
     ensure_registered()
-    found = {
-        n for n in dir(par)
-        if ENTRY_NAME_RE.match(n) and callable(getattr(par, n))
-    }
-    for info in pkgutil.iter_modules(par.__path__):
-        mod = importlib.import_module(f"crdt_tpu.parallel.{info.name}")
-        for n in dir(mod):
-            obj = getattr(mod, n)
-            if (ENTRY_NAME_RE.match(n) and callable(obj)
-                    and getattr(obj, "__module__", "") == mod.__name__):
-                found.add(n)
+    found = _discover_public(
+        lambda n, obj: bool(ENTRY_NAME_RE.match(n)) and callable(obj)
+    )
     return sorted(found - set(_ENTRY))
 
 

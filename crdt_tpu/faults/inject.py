@@ -1,0 +1,322 @@
+"""Seeded, jit-compatible fault injection for the device mesh.
+
+SURVEY §6.3 makes fault-injection convergence the recovery story, but
+until this module every fault lived in host-side test code while the
+mesh itself assumed perfect links and immortal ranks. A
+:class:`FaultPlan` moves the faults INTO the traced program: per-round
+× per-link drop / corrupt / delay decisions are minted from
+``jax.random`` inside the kernel (keyed on ``(seed, round, rank)``), so
+a chaos run is deterministic, replayable, and exercises the REAL
+compiled exchange — the same ppermutes, the same apply kernels — not a
+host-side simulation of them.
+
+The plan is a frozen, hashable dataclass: it rides the jit-cache key
+(``anti_entropy._cached``), and ``faults=None`` (the default) traces
+NOTHING — the flag-off program is byte-identical to the pre-flag one,
+pinned by HLO-equality tests exactly like ``telemetry=`` /
+``stability=``.
+
+Fault semantics (per inbound link, per round):
+
+- **drop** — the packet never arrives; the receiver keeps local state.
+- **corrupt** — the payload is perturbed ON THE WIRE (after the
+  sender's checksum — faults/integrity.py); the receiver's verify
+  fails and it REJECTS: same outcome as a drop, counted separately
+  (``packets_rejected``). Corrupted content is never joined.
+- **delay** — the link holds the packet one round; it arrives (and is
+  applied) on the next round, or in the ring epilogue if the loop ends
+  first. Nothing is lost, only late.
+- **dead ranks** (``dead=``) — every packet FROM those ranks drops:
+  the crash-fault a liveness tracker (faults/membership.py) detects
+  via the per-receiver miss streaks.
+- **evicted ranks** (``evicted=``) — membership's decision applied:
+  the ring permutation is rebuilt over live ranks only
+  (:func:`ring_perm` — still a true bijection of the full axis, so the
+  collective-semantics lint holds; evicted ranks self-loop), and the
+  stable-frontier ``pmin`` excludes evicted tops, UNPINNING
+  reclamation (reclaim/frontier.py's straggler-pins rule is the safe
+  default; eviction is the operator's explicit override). A rank
+  evicted while holding unique knowledge must re-enter via FULL-STATE
+  state-driven resync (Enes et al. 1803.02750) — never the δ ring —
+  because stability may have been claimed past its top while it was
+  out.
+
+Lost packets void the δ-ring residue certificate: the ring forces
+``residue >= 1`` whenever anything was dropped or rejected, so a
+faulted run can never be mistaken for a certified-converged one.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from typing import NamedTuple
+
+from ..utils.metrics import metrics
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """One degraded-mesh scenario (hashable: rides the jit-cache key).
+
+    ``drop`` / ``corrupt`` / ``delay`` are per-link per-round
+    probabilities in [0, 1]; ``seed`` keys the in-kernel draws; ``dead``
+    ranks always drop outbound packets; ``evicted`` ranks are out of
+    the ring and the frontier (see the module docstring)."""
+
+    seed: int = 0
+    drop: float = 0.0
+    corrupt: float = 0.0
+    delay: float = 0.0
+    dead: Tuple[int, ...] = ()
+    evicted: Tuple[int, ...] = ()
+
+    def __post_init__(self):
+        for name in ("drop", "corrupt", "delay"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"FaultPlan.{name}={v} not in [0, 1]")
+        object.__setattr__(self, "dead", tuple(sorted(self.dead)))
+        object.__setattr__(self, "evicted", tuple(sorted(self.evicted)))
+
+    def with_evicted(self, evicted) -> "FaultPlan":
+        return replace(self, evicted=tuple(sorted(evicted)))
+
+
+class FaultCounters(NamedTuple):
+    """Per-run fault accounting (a pytree — returned traced under an
+    outer jit, concrete otherwise). The scalar counters are mesh-wide
+    sums; ``miss_streak[P]`` is per RECEIVER: consecutive rounds at the
+    end of the run in which rank p's inbound link delivered nothing
+    (dropped or rejected) — the liveness signal
+    ``membership.Membership.observe`` maps back to sender ranks."""
+
+    packets_dropped: jax.Array   # uint32
+    packets_rejected: jax.Array  # uint32
+    packets_delayed: jax.Array   # uint32
+    miss_streak: jax.Array       # int32 [P]
+
+
+def counters_specs():
+    """shard_map out_specs for :class:`FaultCounters` (scalars
+    replicated, the streak sharded one lane per replica rank)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import REPLICA_AXIS
+
+    return FaultCounters(P(), P(), P(), P(REPLICA_AXIS))
+
+
+def combine_counters(a: FaultCounters, b: FaultCounters) -> FaultCounters:
+    """Fold two runs' counters (elastic retry attempts): the packet
+    counters add — they were real wire events — while the liveness
+    streak comes from the LATER run (it describes where the links
+    ended, not a rate)."""
+    return FaultCounters(
+        packets_dropped=a.packets_dropped + b.packets_dropped,
+        packets_rejected=a.packets_rejected + b.packets_rejected,
+        packets_delayed=a.packets_delayed + b.packets_delayed,
+        miss_streak=b.miss_streak,
+    )
+
+
+def accumulate_counters(
+    fcs: Optional[FaultCounters], counters: FaultCounters
+) -> FaultCounters:
+    """One elastic attempt's counters folded into the running total —
+    the identity-seeding form both elastic wrappers share."""
+    return counters if fcs is None else combine_counters(fcs, counters)
+
+
+def is_concrete(fc: FaultCounters) -> bool:
+    return not any(
+        isinstance(x, jax.core.Tracer) for x in jax.tree.leaves(fc)
+    )
+
+
+def record(fc: FaultCounters) -> None:
+    """Drain concrete counters into the host registry under the
+    ``faults.*`` names (a no-op under tracing, like
+    ``telemetry.record``)."""
+    if not is_concrete(fc):
+        return
+    metrics.count("faults.packets_dropped", int(fc.packets_dropped))
+    metrics.count("faults.packets_rejected", int(fc.packets_rejected))
+    metrics.count("faults.packets_delayed", int(fc.packets_delayed))
+    metrics.observe("faults.miss_streak", float(jnp.max(fc.miss_streak)))
+
+
+# ---- ring permutations over live ranks ------------------------------------
+
+def ring_perm(p: int, evicted: Tuple[int, ...] = ()) -> List[Tuple[int, int]]:
+    """The δ/gossip ring permutation rebuilt over LIVE ranks: live rank
+    i sends to the next live rank up-ring; evicted ranks self-loop.
+    Always a true bijection of the full axis (the PR 7 ppermute lint's
+    contract — ``membership.validate_perm`` is the standalone checker),
+    so eviction changes who exchanges, never the collective's shape."""
+    live = [i for i in range(p) if i not in set(evicted)]
+    pairs = [(i, i) for i in range(p) if i not in live]
+    pairs += [
+        (live[i], live[(i + 1) % len(live)]) for i in range(len(live))
+    ]
+    return sorted(pairs)
+
+
+def inv_ring_perm(
+    p: int, evicted: Tuple[int, ...] = ()
+) -> List[Tuple[int, int]]:
+    """The inverse (down-ring) permutation — the digest exchange runs
+    against the ring (delta_ring.py)."""
+    return sorted((dst, src) for src, dst in ring_perm(p, evicted))
+
+
+def sender_of(
+    p: int, evicted: Tuple[int, ...] = ()
+) -> List[int]:
+    """``sender_of[dst] = src`` under :func:`ring_perm` — the static
+    table a receiver indexes with its own rank to learn whose packets
+    arrive on its inbound link (dead-rank drops, membership mapping)."""
+    table = [0] * p
+    for src, dst in ring_perm(p, evicted):
+        table[dst] = src
+    return table
+
+
+# ---- in-kernel draws and perturbation -------------------------------------
+
+def round_faults(plan: FaultPlan, r, axis_name: str, senders):
+    """The inbound link's fault draws for mesh round ``r`` on the
+    calling device (inside shard_map): returns scalar bools
+    ``(dropped, corrupted, delayed)``. ``r`` may be a traced loop
+    index; ``senders`` is the static :func:`sender_of` table for the
+    active permutation. Mutually exclusive by priority drop > corrupt >
+    delay (one packet suffers one fate per hop)."""
+    rank = lax.axis_index(axis_name)
+    key = jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(plan.seed), jnp.uint32(r)),
+        rank,
+    )
+    u = jax.random.uniform(key, (3,))
+    dropped = u[0] < plan.drop
+    if plan.dead:
+        src = jnp.asarray(senders, jnp.int32)[rank]
+        dropped = dropped | jnp.isin(src, jnp.asarray(plan.dead, jnp.int32))
+    corrupted = (u[1] < plan.corrupt) & ~dropped
+    delayed = (u[2] < plan.delay) & ~dropped & ~corrupted
+    return dropped, corrupted, delayed
+
+
+def receive_wire(plan: FaultPlan, r, axis_name: str, senders,
+                 payload, chk_in, delay_ok: bool = False):
+    """The receiver side of one faulted link, shared by the δ ring and
+    the gossip scaffold: draw this round's fates, MASK them on evicted
+    receivers (a self-loop delivery is not a wire event — counting its
+    draws would report phantom loss and void certificates for a run
+    whose real links all delivered), corrupt the payload on the
+    simulated wire, verify the checksum lane, and derive the keep mask.
+    ``delay_ok=False`` (ring epilogue / no-delay plans) delivers a
+    would-be-delayed payload now. Returns
+    ``(payload, keep, (dropped, rejected, delayed))``."""
+    from .integrity import verify
+
+    dropped, corrupted, delayed = round_faults(plan, r, axis_name, senders)
+    if plan.evicted:
+        live = ~evicted_mask(plan, axis_name)
+        dropped = dropped & live
+        corrupted = corrupted & live
+        delayed = delayed & live
+    if not delay_ok:
+        delayed = jnp.zeros((), bool)
+    payload = corrupt_tree(payload, corrupted)
+    ok = verify(payload, chk_in)
+    rejected = ~ok & ~dropped
+    keep = ~dropped & ~rejected & ~delayed
+    return payload, keep, (dropped, rejected, delayed)
+
+
+def tick_counters(fc, fates):
+    """Fold one delivery's fates into the per-device counter carry
+    ``(dropped u32, rejected u32, delayed u32, streak i32, *rest)`` —
+    shared by both fault surfaces; trailing elements (the δ ring's
+    ``lost`` lane) pass through for the caller to update."""
+    dropped, rejected, delayed = fates
+    lostq = dropped | rejected
+    return (
+        fc[0] + dropped.astype(jnp.uint32),
+        fc[1] + rejected.astype(jnp.uint32),
+        fc[2] + delayed.astype(jnp.uint32),
+        jnp.where(lostq, fc[3] + 1, 0),  # end-of-run streak
+    ) + tuple(fc[4:])
+
+
+def block_wire(plan: FaultPlan, bix, payload):
+    """The streaming fold's upload wire (parallel/stream.py): one
+    drop/corrupt draw per block keyed ``(seed, block index)`` — same
+    priority rule as :func:`round_faults` — corruption applied after
+    the checksum, verify over what arrived. Returns ``(payload, code)``
+    with the per-device fate code 0 = ok / 1 = dropped / 2 = rejected
+    (the caller pmax-reduces it across the mesh). ``delay`` has no
+    meaning on a host-ordered block stream and is ignored."""
+    from .integrity import checksum, verify
+
+    chk = checksum(payload)
+    key = jax.random.fold_in(jax.random.PRNGKey(plan.seed), bix)
+    u = jax.random.uniform(key, (2,))
+    dropped = u[0] < plan.drop
+    corrupted = (u[1] < plan.corrupt) & ~dropped
+    payload = corrupt_tree(payload, corrupted)
+    ok = verify(payload, chk)
+    code = jnp.where(dropped, 1, jnp.where(~ok, 2, 0)).astype(jnp.int32)
+    return payload, code
+
+
+def corrupt_tree(tree, corrupted):
+    """Perturb the payload's first lane when ``corrupted`` (the
+    simulated wire flip): +1 on numeric leaves, a NOT on bools —
+    exactly the class of perturbation ``integrity.checksum`` detects
+    DETERMINISTICALLY, so a corrupted packet is always rejected, never
+    joined. No-op (bit-identical) when ``corrupted`` is False."""
+
+    def bump(leaf):
+        flat = leaf.reshape(-1)
+        if leaf.dtype == bool:
+            poked = flat.at[0].set(flat[0] ^ corrupted)
+        else:
+            poked = flat.at[0].add(corrupted.astype(leaf.dtype))
+        return poked.reshape(leaf.shape)
+
+    leaves, treedef = jax.tree.flatten(tree)
+    return jax.tree.unflatten(treedef, [bump(leaves[0])] + leaves[1:])
+
+
+def tree_select(pred, on_true, on_false):
+    """Leaf-wise ``jnp.where`` on a scalar predicate — how a receiver
+    discards a dropped/rejected delivery without tracing a branch (the
+    apply runs; its outputs are deselected)."""
+    return jax.tree.map(
+        lambda a, b: jnp.where(pred, a, b), on_true, on_false
+    )
+
+
+def evicted_mask(plan: Optional[FaultPlan], axis_name: str):
+    """Scalar bool: is the calling device an evicted rank? (False when
+    no plan or nothing evicted — callers guard with a Python ``if`` so
+    the flag-off trace stays byte-identical.)"""
+    if plan is None or not plan.evicted:
+        return jnp.zeros((), bool)
+    return jnp.isin(
+        lax.axis_index(axis_name), jnp.asarray(plan.evicted, jnp.int32)
+    )
+
+
+__all__ = [
+    "FaultCounters", "FaultPlan", "accumulate_counters", "block_wire",
+    "combine_counters", "corrupt_tree", "counters_specs",
+    "evicted_mask", "inv_ring_perm", "is_concrete", "receive_wire",
+    "record", "ring_perm", "round_faults", "sender_of",
+    "tick_counters", "tree_select",
+]

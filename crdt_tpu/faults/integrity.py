@@ -1,0 +1,135 @@
+"""In-kernel link integrity: a checksum lane on every shipped payload.
+
+The fault model (faults/inject.py) corrupts packets ON THE WIRE —
+between the sender's extract and the receiver's apply. A receiver must
+never join corrupted content (an undetected bit-flip in a dot clock is
+a lattice-soundness violation, not just wrong data), so every shipped
+pytree carries a checksum computed sender-side that travels the same
+``ppermute``; the receiver recomputes over what actually arrived and
+REJECTS on mismatch — local state kept, ``faults.packets_rejected``
+counted, and the δ machinery's state-driven resync (Almeida et al.
+1603.01529: δ anti-entropy tolerates message loss given eventual
+resync) heals the gap.
+
+The checksum is a position-weighted modular sum, not a cryptographic
+hash: lane ``i`` of each leaf is weighted by the odd constant
+``2*i + 1`` and leaf sums chain through multiplication by an odd
+(hence invertible mod 2^32) mixing constant. Oddness is the detection
+guarantee: any single-lane additive perturbation ``d`` changes the
+digest by ``d * odd * odd^k`` — nonzero mod 2^32 whenever ``d`` is
+(which covers every perturbation ``inject.corrupt_tree`` mints, and
+any odd-delta flip in general) — so detection of the injected faults
+is DETERMINISTIC, which is what lets the convergence tests assert
+bit-identity rather than "converged with high probability". All lax
+ops on static shapes: safe inside jit and shard_map, and cheap enough
+(one pass over the packet) to ride every round.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Invertible-mod-2^32 leaf chaining constant (odd; the golden-ratio
+# mixing constant, same family as threefry's).
+_MIX = 0x9E3779B1
+
+
+def _lanes_u32(leaf: jax.Array) -> jax.Array:
+    """A leaf's lanes as uint32 words, covering EVERY payload bit:
+    floats bitcast (a 64-bit leaf becomes two u32 words — a low-mantissa
+    flip must not vanish in a downcast), 8-byte integers likewise (a
+    ``counter_dtype="uint64"`` clock's high bits are payload too),
+    sub-4-byte lanes widen. No bit of the shipped content is outside
+    the digest."""
+    if leaf.dtype == jnp.bool_:
+        return leaf.reshape(-1).astype(jnp.uint32)
+    if leaf.dtype.itemsize > 4:
+        # bitcast to a SMALLER itemsize appends a minor word axis —
+        # both u32 halves of each lane enter the sum.
+        return jax.lax.bitcast_convert_type(leaf, jnp.uint32).reshape(-1)
+    if jnp.issubdtype(leaf.dtype, jnp.floating):
+        if leaf.dtype.itemsize < 4:  # f16/bf16: bitcast, then widen
+            return jax.lax.bitcast_convert_type(
+                leaf, jnp.uint16
+            ).reshape(-1).astype(jnp.uint32)
+        return jax.lax.bitcast_convert_type(leaf, jnp.uint32).reshape(-1)
+    return leaf.reshape(-1).astype(jnp.uint32)
+
+
+def checksum(tree) -> jax.Array:
+    """The uint32 digest of a shipped pytree (packet or whole state).
+    Deterministic in content AND leaf order — the sender and receiver
+    walk the same NamedTuple structure, so a match means every lane
+    arrived as sent (up to the modular-sum guarantee above)."""
+    total = jnp.zeros((), jnp.uint32)
+    for leaf in jax.tree.leaves(tree):
+        lanes = _lanes_u32(leaf)
+        w = (jnp.arange(lanes.shape[0], dtype=jnp.uint32) * 2 + 1)
+        total = total * jnp.uint32(_MIX) + jnp.sum(
+            lanes * w, dtype=jnp.uint32
+        )
+    return total
+
+
+def verify(tree, shipped_digest: jax.Array) -> jax.Array:
+    """Receiver-side check: recompute over what arrived, compare with
+    the digest that rode the wire. Returns a scalar bool (True = the
+    payload is intact and may be joined)."""
+    return checksum(tree) == shipped_digest
+
+
+def checksum_detects(fn=checksum) -> bool:
+    """The DETECTOR for checksum implementations (run by the ``faults``
+    section of tools/run_static_checks.py): mint a small multi-leaf
+    packet, perturb one lane at a time the way ``inject.corrupt_tree``
+    does, and require the digest to change every time. The broken twin
+    ``analysis.fixtures.checksum_ignores_corruption`` (a constant
+    digest) fails this — proving the gate actually fires."""
+    import numpy as np
+
+    # One leaf per _lanes_u32 branch: u32/i32 pass-through, bool widen,
+    # f32 bitcast, bf16 sub-4-byte bitcast+widen, and (when x64 dtypes
+    # exist) a uint64 leaf whose HIGH u32 word is perturbed separately —
+    # a digest that truncates 8-byte lanes to their low words must fail
+    # here, not in production.
+    sample = [
+        jnp.arange(6, dtype=jnp.uint32).reshape(2, 3),
+        jnp.array([1, 0, 3], jnp.int32),
+        jnp.array([True, False], bool),
+        jnp.array([1.5, -2.0], jnp.float32),
+        jnp.array([0.5, 3.0], jnp.bfloat16),
+    ]
+    has_x64 = bool(jax.config.jax_enable_x64)
+    if has_x64:
+        sample.append(jnp.array([5, 9], jnp.uint64))
+    sample = tuple(sample)
+    base = int(np.asarray(fn(sample)))
+    for i, leaf in enumerate(sample):
+        flat = leaf.reshape(-1)
+        bumped = (
+            flat.at[0].set(~flat[0]) if leaf.dtype == bool
+            else flat.at[0].add(1)
+        ).reshape(leaf.shape)
+        mutated = tuple(
+            bumped if j == i else x for j, x in enumerate(sample)
+        )
+        if int(np.asarray(fn(mutated))) == base:
+            return False
+    if has_x64:
+        u64 = sample[-1]
+        hi = (
+            u64.reshape(-1)
+            .at[0].add(jnp.uint64(1) << jnp.uint64(32))
+            .reshape(u64.shape)
+        )
+        mutated = tuple(
+            hi if j == len(sample) - 1 else x
+            for j, x in enumerate(sample)
+        )
+        if int(np.asarray(fn(mutated))) == base:
+            return False
+    return True
+
+
+__all__ = ["checksum", "checksum_detects", "verify"]

@@ -1,0 +1,171 @@
+"""Host-side DCN resilience: timeout + exponential backoff with jitter.
+
+The in-kernel fault machinery (inject/integrity/membership) covers the
+ICI mesh; the OTHER network — DCN between hosts, where
+``multihost.sync_list`` and ``multihost._allgather_host`` live — fails
+in host-visible ways (coordinator hiccups, a slow peer, a transient
+gloo error) and previously had zero retry/timeout/backoff: one blip
+took the whole exchange down. This module is the standard remedy,
+CRDT-flavored: because every exchange is an idempotent lattice join (or
+an idempotent op re-ingest keyed by globally-unique identifiers),
+RETRYING A WHOLE EXCHANGE IS ALWAYS SAFE — re-delivery is absorbed, so
+the policy can be aggressive without an exactly-once protocol.
+
+``with_retries`` wraps one exchange attempt; on exhaustion it raises
+:class:`DcnExchangeFailed` CARRYING THE LAST-GOOD STATE (the watermark
+/ array the caller should resume from), so a failed sync degrades to
+"retry later from here", never to lost progress. Counters:
+``faults.retries`` (re-attempts), ``faults.timeouts`` (attempts that
+hit the per-attempt deadline), ``faults.gave_up`` (exchanges abandoned).
+
+CAVEATS, stated plainly: a timed-out attempt's worker thread cannot be
+killed — it is abandoned as a daemon thread and may still complete in
+the background, holding its resources until it returns. For that
+reason the per-attempt ``timeout`` is ONLY safe around exchanges whose
+late completion cannot interleave with the retry — a plain RPC, a
+blob fetch. It is NOT safe around collectives: an abandoned attempt's
+in-flight allgather can pair with the retry's fresh allgather on peer
+processes, mispairing rounds cluster-wide — so the multihost wrappers
+(``sync_list``/``_allgather_host``) REFUSE a policy with a timeout.
+And retries of a collective exchange must be symmetric across
+processes (every process re-enters with the same policy) or the
+survivors deadlock waiting on the giver-upper — pick ``attempts``
+uniformly from config, not per-call. Symmetry of the POLICY is not
+symmetry of the FAILURE: a transient error raised on one process while
+its peers' matching collectives succeeded leaves the retrier out of
+step, and its restarted collectives can pair with the peers' later
+ones — for a multi-collective exchange that is silent corruption, not
+deadlock. ``multihost.sync_list`` therefore opens every retried
+attempt with an attempt-number lockstep check that turns the mispair
+into a loud ``DcnExchangeFailed``; wrap other multi-collective
+exchanges the same way.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from ..utils.metrics import metrics
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Backoff shape for one exchange. ``base_delay`` doubles (times
+    ``backoff``) per retry up to ``max_delay``; each sleep is scaled by
+    ``1 + U(0, jitter)`` so herds decorrelate; ``timeout`` is the
+    per-ATTEMPT deadline in seconds (None = wait forever); ``seed``
+    makes the jitter deterministic (tests)."""
+
+    attempts: int = 5
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    backoff: float = 2.0
+    jitter: float = 0.5
+    timeout: Optional[float] = None
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.attempts < 1:
+            raise ValueError("attempts must be >= 1")
+        if self.backoff < 1.0:
+            raise ValueError("backoff must be >= 1.0")
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+
+class DcnExchangeFailed(RuntimeError):
+    """A DCN exchange exhausted its retry budget. ``last_good`` is the
+    resume point the caller handed in (e.g. ``sync_list``'s watermark:
+    ops below it are already everywhere; re-sync later ``since`` it);
+    ``cause`` the final attempt's exception."""
+
+    def __init__(self, op: str, attempts: int, cause: BaseException,
+                 last_good: Any = None):
+        super().__init__(
+            f"DCN exchange '{op}' failed after {attempts} attempt(s): "
+            f"{type(cause).__name__}: {cause} — resume from last_good"
+        )
+        self.op = op
+        self.attempts = attempts
+        self.cause = cause
+        self.last_good = last_good
+
+
+class _AttemptTimeout(RuntimeError):
+    pass
+
+
+def _call_with_timeout(fn: Callable[[], Any], timeout: Optional[float],
+                       op: str) -> Any:
+    if timeout is None:
+        return fn()
+    box: dict = {}
+
+    def runner():
+        try:
+            box["result"] = fn()
+        except BaseException as exc:  # re-raised on the caller thread
+            box["error"] = exc
+
+    t = threading.Thread(
+        target=runner, name=f"dcn-{op}", daemon=True
+    )
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        # The thread is abandoned (see the module caveat) — safe only
+        # because every exchange is idempotent.
+        metrics.count("faults.timeouts")
+        raise _AttemptTimeout(
+            f"'{op}' attempt exceeded {timeout}s"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
+
+def with_retries(
+    fn: Callable[[], Any],
+    policy: Optional[RetryPolicy] = None,
+    *,
+    op: str = "dcn",
+    last_good: Any = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Any:
+    """Run one idempotent exchange under ``policy``. Returns ``fn()``'s
+    value; raises :class:`DcnExchangeFailed` (carrying ``last_good``)
+    after the final attempt. ``sleep`` is injectable for tests."""
+    policy = policy or DEFAULT_POLICY
+    rng = random.Random(policy.seed)
+    delay = policy.base_delay
+    last_exc: Optional[BaseException] = None
+    for attempt in range(policy.attempts):
+        if attempt:
+            metrics.count("faults.retries")
+            pause = min(delay, policy.max_delay)
+            pause *= 1.0 + policy.jitter * rng.random()
+            sleep(pause)
+            delay *= policy.backoff
+        try:
+            return _call_with_timeout(fn, policy.timeout, op)
+        except DcnExchangeFailed:
+            raise  # a nested wrapped exchange already gave up
+        except (KeyboardInterrupt, SystemExit):
+            raise  # an operator abort must never be retried into
+        except Exception as exc:
+            last_exc = exc
+    metrics.count("faults.gave_up")
+    assert last_exc is not None
+    raise DcnExchangeFailed(
+        op, policy.attempts, last_exc, last_good=last_good
+    ) from last_exc
+
+
+__all__ = [
+    "DEFAULT_POLICY", "DcnExchangeFailed", "RetryPolicy", "with_retries",
+]

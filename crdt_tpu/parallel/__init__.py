@@ -100,6 +100,7 @@ from .sparse_shard import (
     split_segments,
 )
 from .stream import (
+    StreamFaultReport,
     StreamInterrupted,
     iter_blocks,
     mesh_stream_fold,
@@ -143,6 +144,7 @@ __all__ = [
     "multihost",
     "delta_gossip_elastic",
     "gossip_elastic",
+    "StreamFaultReport",
     "StreamInterrupted",
     "iter_blocks",
     "mesh_stream_fold",

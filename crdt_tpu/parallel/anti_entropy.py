@@ -111,6 +111,11 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         stream_blocks=jnp.zeros((), jnp.uint32),
         stream_staged_bytes=jnp.zeros((), jnp.float32),
         stream_overlap_hit=jnp.zeros((), jnp.uint32),
+        # The fault fields are zero unless the faults= path fills them
+        # in (the entry's _replace on the counters psum — faults/).
+        faults_dropped=jnp.zeros((), jnp.uint32),
+        faults_rejected=jnp.zeros((), jnp.uint32),
+        faults_delayed=jnp.zeros((), jnp.uint32),
     )
 
 
@@ -277,6 +282,8 @@ def _mesh_gossip_lattice(
     donate: bool = False,
     stability: bool = False,
     compact_fn=None,
+    faults=None,
+    lag_threshold=None,
 ):
     """Shared scaffold for ring anti-entropy: each device folds its
     local replica block, then runs ``rounds`` unit-shift gossip rounds.
@@ -309,9 +316,42 @@ def _mesh_gossip_lattice(
     they ship out. The flag off traces exactly the flag-free program
     (same HLO-identity discipline as ``telemetry=``); with both flags
     on, the Telemetry pytree carries ``reclaimed_slots`` /
-    ``reclaimed_bytes`` / ``frontier_lag``."""
+    ``reclaimed_bytes`` / ``frontier_lag``.
+
+    ``faults=`` (a ``crdt_tpu.faults.FaultPlan``) injects seeded
+    drop/corrupt/delay faults on every ring exchange — each shipped
+    state carries a checksum lane, corrupted arrivals are REJECTED
+    (local state kept), the ring runs over the plan's LIVE ranks, and
+    with ``stability=`` on the frontier ``pmin`` EXCLUDES evicted tops
+    (the headline unpinning: a dead rank stops stalling reclamation;
+    its own row is left uncompacted — a frontier past its knowledge
+    must not retire its parked slots). A ``FaultCounters`` pytree is
+    appended as the LAST output. Unlike the δ ring, loss here is never
+    unsound — full states carry their own tops, a missed round only
+    slows convergence (run more rounds, or heal with a fault-free run).
+
+    ``lag_threshold=`` (host-side, needs ``stability=``): when the
+    run's ``frontier_lag`` reaches it, ``reclaim.frontier_stalled``
+    counts and a once-per-kind stall warning fires
+    (reclaim/frontier.py ``watch_lag`` — the operator signal that a
+    straggler is pinning the frontier and reclamation has stalled)."""
+    if lag_threshold is not None and not stability:
+        raise ValueError(
+            "lag_threshold= needs stability=True: the stall alert "
+            "watches the stable frontier, which only exists on the "
+            "stability path — without it the alert would silently "
+            "never arm"
+        )
     if rounds is None:
         rounds = mesh.shape[REPLICA_AXIS] - 1
+    faulted = faults is not None
+    delay_mode = faulted and faults.delay > 0
+    if faulted:
+        from .. import faults as flt
+
+        p = mesh.shape[REPLICA_AXIS]
+        perm = flt.ring_perm(p, faults.evicted)
+        snd_tbl = flt.sender_of(p, faults.evicted)
     argnums = _ring_donate_argnums(state, mesh, donate)
 
     def build():
@@ -332,6 +372,8 @@ def _mesh_gossip_lattice(
             out_specs.append(tele.specs())
         if stability:
             out_specs.append(P())  # the frontier, replicated
+        if faulted:
+            out_specs.append(flt.counters_specs())
 
         @partial(
             jax.shard_map,
@@ -341,17 +383,67 @@ def _mesh_gossip_lattice(
             check_vma=False,
         )
         def gossip_fn(local):
+            if faulted:
+                ev = flt.evicted_mask(faults, REPLICA_AXIS)
             if stability:
                 # Frontier over the PRE-fold input tops: the knowledge
                 # each replica ENTERED the round with — a straggler row
                 # pins it.
-                frontier = lax.pmin(
-                    jnp.min(_top(local), axis=0), REPLICA_AXIS
-                )
+                tmin = jnp.min(_top(local), axis=0)
+                if faulted and faults.evicted:
+                    # Eviction unpins: a dead rank's stale top leaves
+                    # the pmin (the membership decision — its rejoin
+                    # must be full-state resync, faults/membership.py).
+                    tmin = jnp.where(
+                        ev, jnp.asarray(jnp.iinfo(tmin.dtype).max,
+                                        tmin.dtype), tmin
+                    )
+                frontier = lax.pmin(tmin, REPLICA_AXIS)
             folded, of = fold_fn(local)
             if telemetry:
                 slots = jnp.zeros((), jnp.uint32)
-            for _ in range(rounds):
+            if faulted:
+                fc = (
+                    jnp.zeros((), jnp.uint32), jnp.zeros((), jnp.uint32),
+                    jnp.zeros((), jnp.uint32), jnp.zeros((), jnp.int32),
+                )
+                if delay_mode:
+                    held = jax.tree.map(jnp.zeros_like, folded)
+                    heldv = jnp.zeros((), bool)
+            for r in range(rounds):
+                if faulted:
+                    # The faulted exchange: checksum lane on the wire,
+                    # per-round drop/corrupt/delay draws on the inbound
+                    # link (faults.receive_wire — evicted self-loops
+                    # masked out of the accounting), rejected/dropped
+                    # deliveries deselected (full-state loss is never
+                    # unsound — see above).
+                    other, chk_in = jax.tree.map(
+                        lambda x: lax.ppermute(x, REPLICA_AXIS, perm),
+                        (folded, flt.checksum(folded)),
+                    )
+                    # The last round delivers a would-be-delayed state
+                    # now — no later round to hold it for.
+                    other, keep, fates = flt.receive_wire(
+                        faults, r, REPLICA_AXIS, snd_tbl, other, chk_in,
+                        delay_ok=delay_mode and r < rounds - 1,
+                    )
+                    base = folded
+                    if delay_mode:
+                        newh, of_h = join_fn(folded, held)
+                        folded = flt.tree_select(heldv, newh, folded)
+                        of = of | (of_h & heldv)
+                    joined, of_r = join_fn(folded, other)
+                    new = flt.tree_select(keep, joined, folded)
+                    of_r = of_r & keep
+                    if delay_mode:
+                        held = flt.tree_select(fates[2], other, held)
+                        heldv = fates[2]
+                    fc = flt.tick_counters(fc, fates)
+                    if telemetry:
+                        slots = slots + slots_of(base, new)
+                    folded, of = new, of | of_r
+                    continue
                 new, of_r = ring_round(
                     folded, REPLICA_AXIS, reduce_overflow=False,
                     join_fn=join_fn,
@@ -363,7 +455,16 @@ def _mesh_gossip_lattice(
                 freed = jnp.zeros((), jnp.uint32)
                 freed_b = jnp.zeros((), jnp.float32)
                 if compact_fn is not None:
-                    folded, freed, freed_b = compact_fn(folded, frontier)
+                    compacted, freed, freed_b = compact_fn(folded, frontier)
+                    if faulted and faults.evicted:
+                        # Never compact an evicted rank's own row: the
+                        # frontier may exceed its knowledge, and
+                        # retiring parked slots it has not applied
+                        # breaks its (resync-pending) local state.
+                        compacted = flt.tree_select(~ev, compacted, folded)
+                        freed = jnp.where(ev, 0, freed)
+                        freed_b = jnp.where(ev, 0.0, freed_b)
+                    folded = compacted
             of = lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS)) > 0
             outs = [jax.tree.map(lambda x: x[None], folded), of]
             if telemetry:
@@ -382,9 +483,25 @@ def _mesh_gossip_lattice(
                             _lag(_top(folded), frontier), REPLICA_AXIS
                         ),
                     )
+                if faulted:
+                    tel = tel._replace(
+                        faults_dropped=lax.psum(fc[0], REPLICA_AXIS),
+                        faults_rejected=lax.psum(fc[1], REPLICA_AXIS),
+                        faults_delayed=lax.psum(fc[2], REPLICA_AXIS),
+                    )
                 outs.append(tel)
             if stability:
                 outs.append(frontier)
+            if faulted:
+                # Replica-axis psum only: the fault draw is per logical
+                # link (element shards share the fate) — a both-axes sum
+                # would count device shards, not packets.
+                outs.append(flt.FaultCounters(
+                    packets_dropped=lax.psum(fc[0], REPLICA_AXIS),
+                    packets_rejected=lax.psum(fc[1], REPLICA_AXIS),
+                    packets_delayed=lax.psum(fc[2], REPLICA_AXIS),
+                    miss_streak=fc[3].reshape(1),
+                ))
             return tuple(outs)
 
         return gossip_fn
@@ -395,7 +512,7 @@ def _mesh_gossip_lattice(
     with metrics.time(f"anti_entropy.{kind}"):
         out = _cached(
             kind, state, mesh, build,
-            rounds, telemetry, stability, *cache_extra,
+            rounds, telemetry, stability, faults, *cache_extra,
             donate_argnums=argnums,
         )(state)
         jax.block_until_ready(out)  # time device work, not async dispatch
@@ -406,6 +523,17 @@ def _mesh_gossip_lattice(
     _consume(donate, state)
     if telemetry and tele.is_concrete(out[2]):
         tele.record(kind, out[2])
+    if faulted:
+        from .. import faults as flt
+
+        flt.record(out[-1])  # no-op under tracing
+    if stability and lag_threshold is not None:
+        from ..reclaim.frontier import frontier_lag, top_of, watch_lag
+
+        frontier = out[2 + (1 if telemetry else 0)]
+        lag = frontier_lag(top_of(out[0]), frontier)
+        if not isinstance(lag, jax.core.Tracer):
+            watch_lag(kind, int(lag), lag_threshold)
     return out
 
 
@@ -417,6 +545,8 @@ def mesh_gossip(
     telemetry: bool = False,
     donate: bool = False,
     stability: bool = False,
+    faults=None,
+    lag_threshold=None,
 ) -> Tuple[OrswotState, jax.Array]:
     """Ring anti-entropy for ORSWOT replica batches (see
     ``_mesh_gossip_lattice``); the device-local pre-fold dispatches like
@@ -436,6 +566,7 @@ def mesh_gossip(
         cache_extra=(local_fold,),
         telemetry=telemetry, slots_fn=ops.changed_members, donate=donate,
         stability=stability, compact_fn=ops.compact,
+        faults=faults, lag_threshold=lag_threshold,
     )
 
 
@@ -443,6 +574,8 @@ def mesh_gossip_map(
     state: MapState, mesh: Mesh, rounds: Optional[int] = None,
     telemetry: bool = False, donate: bool = False,
     stability: bool = False,
+    faults=None,
+    lag_threshold=None,
 ) -> Tuple[MapState, jax.Array]:
     """Ring anti-entropy for the composition layer: Map<K, MVReg>
     replica blocks gossiped one neighbor per round over the replica
@@ -453,6 +586,7 @@ def mesh_gossip_map(
         "map_gossip", state, mesh, map_ops.join, map_ops.fold, map_specs(),
         rounds, telemetry=telemetry, slots_fn=map_ops.changed_keys,
         donate=donate, stability=stability, compact_fn=map_ops.compact,
+        faults=faults, lag_threshold=lag_threshold,
     )
 
 
@@ -460,6 +594,8 @@ def mesh_gossip_map_orswot(
     state: MapOrswotState, mesh: Mesh, rounds: Optional[int] = None,
     telemetry: bool = False, donate: bool = False,
     stability: bool = False,
+    faults=None,
+    lag_threshold=None,
 ) -> Tuple[MapOrswotState, jax.Array]:
     """Ring anti-entropy for ``Map<K, Orswot>`` replica blocks (the
     Val-generic slab composition) over the replica axis."""
@@ -472,6 +608,7 @@ def mesh_gossip_map_orswot(
         telemetry=telemetry,
         slots_fn=lambda a, b: ops.changed_members(a.core, b.core),
         donate=donate, stability=stability, compact_fn=mo_ops.compact,
+        faults=faults, lag_threshold=lag_threshold,
     )
 
 
@@ -479,6 +616,8 @@ def mesh_gossip_nested_map(
     state: NestedMapState, mesh: Mesh, rounds: Optional[int] = None,
     telemetry: bool = False, donate: bool = False,
     stability: bool = False,
+    faults=None,
+    lag_threshold=None,
 ) -> Tuple[NestedMapState, jax.Array]:
     """Ring anti-entropy for ``Map<K1, Map<K2, MVReg>>`` replica blocks
     over the replica axis."""
@@ -491,6 +630,7 @@ def mesh_gossip_nested_map(
         telemetry=telemetry,
         slots_fn=lambda a, b: map_ops.changed_keys(a.m, b.m),
         donate=donate, stability=stability, compact_fn=nested_ops.compact,
+        faults=faults, lag_threshold=lag_threshold,
     )
 
 
@@ -848,6 +988,8 @@ def mesh_gossip_sparse_mvmap(
     states, mesh: Mesh, rounds: Optional[int] = None, sibling_cap: int = 4,
     telemetry: bool = False, donate: bool = False,
     stability: bool = False,
+    faults=None,
+    lag_threshold=None,
 ):
     """Ring anti-entropy for SPARSE ``Map<K, MVReg>`` replica batches
     over the replica axis — per-round traffic is one cell table per
@@ -866,6 +1008,7 @@ def mesh_gossip_sparse_mvmap(
         telemetry=telemetry, slots_fn=smv.changed_cells,
         element_sharded=False, donate=donate,
         stability=stability, compact_fn=smv.compact,
+        faults=faults, lag_threshold=lag_threshold,
     )
 
 
@@ -920,6 +1063,8 @@ def mesh_gossip_sparse_nested(
     states, mesh: Mesh, level, rounds: Optional[int] = None,
     telemetry: bool = False, donate: bool = False,
     stability: bool = False,
+    faults=None,
+    lag_threshold=None,
 ):
     """Ring anti-entropy for SPARSE nested-map replica batches (any
     ``SparseNestLevel`` composition) over the replica axis — per-round
@@ -936,6 +1081,7 @@ def mesh_gossip_sparse_nested(
         jax.tree.map(lambda _: P(REPLICA_AXIS), template), rounds,
         telemetry=telemetry, element_sharded=False, donate=donate,
         stability=stability, compact_fn=nest_ops.compact,
+        faults=faults, lag_threshold=lag_threshold,
     )
 
 
@@ -943,6 +1089,8 @@ def mesh_gossip_sparse(
     states, mesh: Mesh, rounds: Optional[int] = None,
     telemetry: bool = False, donate: bool = False,
     stability: bool = False,
+    faults=None,
+    lag_threshold=None,
 ):
     """Ring anti-entropy for SPARSE (segment-encoded) ORSWOT replica
     batches over the replica axis (the bounded-bandwidth mode —
@@ -960,13 +1108,14 @@ def mesh_gossip_sparse(
         telemetry=telemetry, slots_fn=sp.changed_dots,
         element_sharded=False, donate=donate,
         stability=stability, compact_fn=sp.compact,
+        faults=faults, lag_threshold=lag_threshold,
     )
 
 
 def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
                    policy=None, telemetry: bool = False,
                    donate: bool = False, stability: bool = False,
-                   reclaim=None):
+                   reclaim=None, faults=None, lag_threshold=None):
     """Ring anti-entropy with elastic capacity recovery — the
     overflow→widen→resume loop at mesh scale (elastic.py).
 
@@ -1007,7 +1156,13 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
     successful attempt it observes the model's occupancy and — once the
     low-water streak clears — narrows the implicated axes in place, so
     the model carries the reclaimed capacity into its next round
-    (administrative, like widening: apply identically on every host)."""
+    (administrative, like widening: apply identically on every host).
+
+    ``faults=`` threads a ``crdt_tpu.faults.FaultPlan`` into every
+    attempt; the LAST tuple element is then the ``FaultCounters``
+    pytree with packet counters summed across attempts.
+    ``lag_threshold=`` is the frontier-stall alert
+    (``_mesh_gossip_lattice``)."""
     from .. import elastic
     from ..models.map import BatchedMap
     from ..models.orswot import BatchedOrswot
@@ -1023,7 +1178,8 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
             return (
                 lambda: mesh_gossip(m.state, mesh, rounds,
                                     telemetry=telemetry, donate=donate,
-                                    stability=stability),
+                                    stability=stability, faults=faults,
+                                    lag_threshold=lag_threshold),
                 ("deferred_cap",),
             )
         if isinstance(m, BatchedSparseOrswot):
@@ -1031,7 +1187,9 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
                 lambda: mesh_gossip_sparse(m.state, mesh, rounds,
                                            telemetry=telemetry,
                                            donate=donate,
-                                           stability=stability),
+                                           stability=stability,
+                                           faults=faults,
+                                           lag_threshold=lag_threshold),
                 ("dot_cap", "deferred_cap"),
             )
         if isinstance(m, BatchedMap):
@@ -1039,7 +1197,9 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
                 lambda: mesh_gossip_map(m.state, mesh, rounds,
                                         telemetry=telemetry,
                                         donate=donate,
-                                        stability=stability),
+                                        stability=stability,
+                                        faults=faults,
+                                        lag_threshold=lag_threshold),
                 ("sibling_cap", "deferred_cap"),
             )
         if isinstance(m, BatchedSparseMap):
@@ -1047,7 +1207,8 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
                 lambda: mesh_gossip_sparse_mvmap(
                     m.state, mesh, rounds, sibling_cap=m.sibling_cap,
                     telemetry=telemetry, donate=donate,
-                    stability=stability,
+                    stability=stability, faults=faults,
+                    lag_threshold=lag_threshold,
                 ),
                 ("cell_cap", "deferred_cap", "sibling_cap"),
             )
@@ -1055,7 +1216,8 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
             return (
                 lambda: mesh_gossip_sparse_nested(
                     m.state, mesh, m.level, rounds, telemetry=telemetry,
-                    donate=donate, stability=stability,
+                    donate=donate, stability=stability, faults=faults,
+                    lag_threshold=lag_threshold,
                 ),
                 ("cell_cap", "deferred_cap", "sibling_cap",
                  "key_deferred_cap"),
@@ -1068,6 +1230,7 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
     widened: dict = {}
     migrations = 0
     tel = None
+    fcs = None
     while True:
         run, lanes = plan(model)
         if donate:
@@ -1075,6 +1238,11 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
         out = run()
         if donate:
             model.state = snap
+        if faults is not None:
+            from .. import faults as flt
+
+            fcs = flt.accumulate_counters(fcs, out[-1])
+            out = out[:-1]
         rows, flags = out[0], out[1]
         frontier = out[-1] if stability else None
         if telemetry:
@@ -1099,6 +1267,8 @@ def gossip_elastic(model, mesh: Mesh, rounds: Optional[int] = None,
                 ret.append(tel)
             if stability:
                 ret.append(frontier)
+            if fcs is not None:
+                ret.append(fcs)
             return tuple(ret) if len(ret) > 2 else (rows, widened)
         if migrations >= policy.max_migrations:
             raise RuntimeError(
@@ -1176,7 +1346,8 @@ def mesh_fold_map3(state, mesh: Mesh, telemetry: bool = False,
 
 def mesh_gossip_map3(
     state, mesh: Mesh, rounds: Optional[int] = None, telemetry: bool = False,
-    donate: bool = False, stability: bool = False,
+    donate: bool = False, stability: bool = False, faults=None,
+    lag_threshold=None,
 ):
     """Ring anti-entropy for ``Map<K1, Map<K2, Orswot>>`` replica blocks
     over the replica axis."""
@@ -1192,6 +1363,7 @@ def mesh_gossip_map3(
         telemetry=telemetry,
         slots_fn=lambda a, b: ops.changed_members(a.mo.core, b.mo.core),
         donate=donate, stability=stability, compact_fn=map3_ops.compact,
+        faults=faults, lag_threshold=lag_threshold,
     )
 
 
@@ -1296,3 +1468,16 @@ _reg_fold(
     ),
 )
 _reg_fold("mesh_fold_clocks", "clock_fold", _gs.mk_clocks, mesh_fold_clocks)
+
+# Fault surfaces (crdt_tpu/faults/): every gossip entry above accepts
+# faults=; registration is the coverage contract faults.static_checks
+# enforces (an unregistered fault-capable public entry fails discovery).
+from ..analysis.registry import register_fault_surface as _reg_fs  # noqa: E402
+
+for _name in (
+    "mesh_gossip", "mesh_gossip_map", "mesh_gossip_map_orswot",
+    "mesh_gossip_nested_map", "mesh_gossip_map3", "mesh_gossip_sparse",
+    "mesh_gossip_sparse_mvmap", "mesh_gossip_sparse_nested",
+    "gossip_elastic",
+):
+    _reg_fs(_name, module=__name__)

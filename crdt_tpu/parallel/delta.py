@@ -267,6 +267,7 @@ def mesh_delta_gossip(
     pipeline: bool = True,
     digest: bool = True,
     donate: bool = False,
+    faults=None,
 ):
     """Ring δ anti-entropy over the mesh: each device folds its local
     replica block (OR-folding dirty, max-folding contexts), then runs
@@ -309,7 +310,13 @@ def mesh_delta_gossip(
     Returns ``(states [P, ...], dirty [P, E], overflow, residue)`` —
     overflow is the deferred-buffer flag, as in ``mesh_gossip``;
     residue the convergence indicator above. ``telemetry=True`` appends
-    the in-kernel Telemetry pytree (telemetry.py) as a fifth element."""
+    the in-kernel Telemetry pytree (telemetry.py) as a fifth element.
+    ``faults=`` (a ``crdt_tpu.faults.FaultPlan``) injects seeded
+    drop/corrupt/delay link faults with a checksum lane on every packet
+    and appends a ``FaultCounters`` pytree LAST — lost packets force
+    ``residue >= 1`` and suppress the top closure, so degraded rows
+    stay valid partial states for state-driven resync
+    (delta_ring.run_delta_ring documents the semantics)."""
     from ..ops.pallas_kernels import fold_auto
     from .delta_ring import run_delta_ring
 
@@ -333,6 +340,7 @@ def mesh_delta_gossip(
         cache_extra=(local_fold,),
         telemetry=telemetry, slots_fn=changed_members,
         pipeline=pipeline, digest=digest, gate=gate_delta, donate=donate,
+        faults=faults,
     )
 
 
@@ -367,6 +375,7 @@ def _reg_delta_ep(name, kind, mk_state, n_rows, call):
 
 def _register():
     from ..analysis import gate_states as gs
+    from ..analysis.registry import register_fault_surface
 
     _reg_delta_ep(
         "mesh_delta_gossip", "delta_gossip", gs.mk_dense, gs.GE,
@@ -374,6 +383,7 @@ def _register():
             s, d, f, mesh, local_fold="tree", donate=True
         ),
     )
+    register_fault_surface("mesh_delta_gossip", module=__name__)
 
 
 _register()

@@ -298,6 +298,7 @@ def mesh_delta_gossip_map(
     pipeline: bool = True,
     digest: bool = True,
     donate: bool = False,
+    faults=None,
 ):
     """Ring δ anti-entropy for Map<K, MVReg> replica batches over the
     mesh — the bandwidth-bounded mode for large key universes with local
@@ -331,7 +332,7 @@ def mesh_delta_gossip_map(
         close_top=close_top,
         telemetry=telemetry, slots_fn=map_ops.changed_keys,
         pipeline=pipeline, digest=digest, gate=gate_delta_map,
-        donate=donate,
+        donate=donate, faults=faults,
     )
 
 
@@ -348,5 +349,8 @@ def _register():
         ),
     )
 
+    from ..analysis.registry import register_fault_surface
+
+    register_fault_surface("mesh_delta_gossip_map", module=__name__)
 
 _register()

@@ -63,6 +63,7 @@ def mesh_delta_gossip_map3(
     pipeline: bool = True,
     digest: bool = True,
     donate: bool = False,
+    faults=None,
 ):
     """Ring δ anti-entropy for depth-3 map replica batches (see
     delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET
@@ -92,7 +93,7 @@ def mesh_delta_gossip_map3(
         telemetry=telemetry,
         slots_fn=lambda a, b: changed_members(a.mo.core, b.mo.core),
         pipeline=pipeline, digest=digest, gate=gate_delta_m3,
-        donate=donate,
+        donate=donate, faults=faults,
     )
 
 
@@ -110,5 +111,8 @@ def _register():
         ),
     )
 
+    from ..analysis.registry import register_fault_surface
+
+    register_fault_surface("mesh_delta_gossip_map3", module=__name__)
 
 _register()

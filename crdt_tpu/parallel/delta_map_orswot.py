@@ -75,6 +75,7 @@ def mesh_delta_gossip_map_orswot(
     pipeline: bool = True,
     digest: bool = True,
     donate: bool = False,
+    faults=None,
 ):
     """Ring δ anti-entropy for Map<K, Orswot> replica batches (see
     delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET
@@ -106,7 +107,7 @@ def mesh_delta_gossip_map_orswot(
         telemetry=telemetry,
         slots_fn=lambda a, b: changed_members(a.core, b.core),
         pipeline=pipeline, digest=digest, gate=gate_delta_mo,
-        donate=donate,
+        donate=donate, faults=faults,
     )
 
 
@@ -124,5 +125,8 @@ def _register():
         ),
     )
 
+    from ..analysis.registry import register_fault_surface
+
+    register_fault_surface("mesh_delta_gossip_map_orswot", module=__name__)
 
 _register()

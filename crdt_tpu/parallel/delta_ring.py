@@ -46,9 +46,31 @@ Three orthogonal performance modes (all default-on where safe):
   ``gate_delta``). ``bytes_useful`` telemetry drops to O(changed
   lanes) while the wire shape (``bytes_exchanged``) stays static.
 
+A fourth, non-performance mode is ``faults=`` (a
+``crdt_tpu.faults.FaultPlan``, default None): seeded in-kernel fault
+injection on every inbound link — drop / corrupt / delay draws minted
+from ``jax.random`` inside the loop, an integrity checksum lane riding
+each packet (corrupted arrivals are REJECTED, never joined), dead-rank
+outbound drops, and eviction (the ring permutation rebuilt over live
+ranks — still a true bijection — with evicted tops excluded from the
+final closure). Two semantic consequences, both deliberate:
+
+- lost packets VOID the residue certificate — the ring forces
+  ``residue >= 1`` whenever anything dropped or was rejected, so a
+  degraded run can never read as certified-converged; heal by
+  state-driven resync (full-state gossip/fold over the returned rows —
+  Almeida et al. 1603.01529, Enes et al. 1803.02750) or a fault-free δ
+  re-run, and
+- the final top-closure ADOPTS the mesh top only when the run lost
+  nothing (adoption after loss would make receivers claim
+  observed-and-removed for dots they never received — the delta.py
+  inflated-context failure); lossy runs keep each device's own frozen
+  top, leaving every row a valid, joinable partial state.
+
 With every flag at its off value the traced program is byte-identical
 to the pre-flag sequential ring (pinned by HLO comparison in
-tests/test_zero_copy_ring.py, the PR-2 telemetry pattern)."""
+tests/test_zero_copy_ring.py, the PR-2 telemetry pattern; the
+``faults=None`` pin lives in tests/test_faults.py)."""
 
 from __future__ import annotations
 
@@ -86,6 +108,7 @@ def run_delta_ring(
     digest: bool = True,
     gate: Optional[Callable] = None,  # (pkt, digest_clock) -> pkt
     donate: bool = False,
+    faults=None,                      # crdt_tpu.faults.FaultPlan
 ):
     """Run the δ ring program; ``state``/``dirty``/``fctx`` must already
     be padded to the mesh. Returns ``(states [P, ...], dirty, overflow,
@@ -126,27 +149,50 @@ def run_delta_ring(
     gauges read the post-closure fold, and ``residue`` mirrors the
     fourth output. ``pipeline`` / ``digest`` / ``donate`` are the
     zero-copy modes the module docstring describes; with every flag off
-    the trace is the flag-free program."""
+    the trace is the flag-free program.
+
+    ``faults=`` (a ``crdt_tpu.faults.FaultPlan``) turns on in-kernel
+    fault injection (module docstring): the ring runs over the plan's
+    LIVE ranks, every packet carries a checksum lane, and a
+    ``faults.FaultCounters`` pytree is appended as the LAST output
+    (after the Telemetry pytree when both flags are on). Lost packets
+    force ``residue >= 1`` and suppress top adoption — the returned
+    rows are then valid partial states awaiting state-driven resync."""
     from .anti_entropy import _cached, _ring_donate_argnums, _tel_reduced
 
     p = mesh.shape[REPLICA_AXIS]
     gated = digest and gate is not None
+    faulted = faults is not None
+    delay_mode = faulted and faults.delay > 0
     # Certificate window / propagation diameter: one hop per round
     # sequentially, one hop per two rounds pipelined (module docstring).
     win = (p - 1) if not pipeline else max(2 * (p - 1) - 1, 0)
     if rounds is None:
         rounds = win
-    perm = [(i, (i + 1) % p) for i in range(p)]
-    # Digest exchange runs AGAINST the ring: device i's packets land on
-    # i+1, so i needs i+1's frozen top — ship tops one hop down-ring.
-    inv_perm = [(i, (i - 1) % p) for i in range(p)]
+    if faulted:
+        from .. import faults as flt
+
+        # The ring over LIVE ranks (evicted self-loop — still a true
+        # bijection of the axis, so the collective lint holds).
+        perm = flt.ring_perm(p, faults.evicted)
+        inv_perm = flt.inv_ring_perm(p, faults.evicted)
+        snd_tbl = flt.sender_of(p, faults.evicted)
+    else:
+        perm = [(i, (i + 1) % p) for i in range(p)]
+        # Digest exchange runs AGAINST the ring: device i's packets land
+        # on i+1, so i needs i+1's frozen top — ship tops one hop
+        # down-ring.
+        inv_perm = [(i, (i - 1) % p) for i in range(p)]
     argnums = _ring_donate_argnums(state, mesh, donate, n=2)
 
     def build():
         out_specs = (specs, P(REPLICA_AXIS, ELEMENT_AXIS), P(), P())
         if telemetry:
             out_specs = out_specs + (tele.specs(),)
+        if faulted:
+            out_specs = out_specs + (flt.counters_specs(),)
         slots_of = slots_fn or tele.generic_slots_changed
+        n_tel = 3 if telemetry else 0
 
         @partial(
             jax.shard_map,
@@ -166,11 +212,84 @@ def run_delta_ring(
             if gated:
                 rtop = lax.ppermute(top_of(folded), REPLICA_AXIS, inv_perm)
 
+            # ---- fault helpers (traced ONLY when faults is not None;
+            # the flag-off program below is byte-identical pre-flag) --
+
+            def ship(pkt):
+                """Put one packet on the wire — with the integrity
+                checksum lane riding the same ppermute when faulted."""
+                if not faulted:
+                    return jax.tree.map(
+                        lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt
+                    )
+                return jax.tree.map(
+                    lambda x: lax.ppermute(x, REPLICA_AXIS, perm),
+                    (pkt, flt.checksum(pkt)),
+                )
+
+            def receive(wire, r, final=False):
+                """Receiver side of the wire for the packet applied at
+                round ``r`` (faults.receive_wire: draws, evicted
+                self-loop masking, corruption, checksum verify).
+                ``final=True`` (ring epilogue) delivers a would-be-
+                delayed packet now — no later round to hold it for."""
+                if not faulted:
+                    return wire, None, None
+                pkt, chk_in = wire
+                return flt.receive_wire(
+                    faults, r, REPLICA_AXIS, snd_tbl, pkt, chk_in,
+                    delay_ok=delay_mode and not final,
+                )
+
+            def select_apply(applied, prior, keep):
+                """Discard a dropped/rejected/held delivery: the apply
+                ran, its outputs are deselected (no traced branch)."""
+                st2, d2, f2, of_r = applied
+                st0, d0, f0 = prior
+                return (
+                    flt.tree_select(keep, st2, st0),
+                    jnp.where(keep, d2, d0),
+                    jnp.where(keep, f2, f0),
+                    of_r & keep,
+                )
+
+            def tick(fc, fates):
+                # The shared 4-lane update plus the ring's `lost` lane
+                # (the residue-voiding quantity).
+                out = flt.tick_counters(fc, fates)
+                lostq = fates[0] | fates[1]
+                return out[:4] + (fc[4] + lostq.astype(jnp.int32),)
+
+            if faulted:
+                fc0 = (
+                    jnp.zeros((), jnp.uint32), jnp.zeros((), jnp.uint32),
+                    jnp.zeros((), jnp.uint32), jnp.zeros((), jnp.int32),
+                    jnp.zeros((), jnp.int32),
+                )
+            if delay_mode:
+                pkt_shape = jax.eval_shape(
+                    lambda s, dd, ff: extract(s, dd, ff, cap, start=0)[0],
+                    folded, d, f,
+                )
+                held0 = jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, a.dtype), pkt_shape
+                )
+
+            def deliver_held(st, d, f, of, held, heldv):
+                """The one-round-late link buffer lands (delay faults)."""
+                applied = apply_fn(st, held, d, f)
+                st, d, f, of_h = select_apply(applied, (st, d, f), heldv)
+                return st, d, f, of | of_h
+
             def round_body(r, carry):
+                if delay_mode:
+                    fc, held, heldv = carry[5 + n_tel:]
+                elif faulted:
+                    (fc,) = carry[5 + n_tel:]
                 if telemetry:
-                    st, d, f, of, starved, slots, shipped, useful = carry
+                    st, d, f, of, starved, slots, shipped, useful = carry[:8]
                 else:
-                    st, d, f, of, starved = carry
+                    st, d, f, of, starved = carry[:5]
                 pkt, d, f = extract(st, d, f, cap, start=r * cap)
                 in_window = r >= rounds - win
                 # Explicit accumulator dtype: without it jnp.sum widens
@@ -181,18 +300,37 @@ def run_delta_ring(
                 )
                 if gated:
                     pkt = gate(pkt, rtop)
-                pkt = jax.tree.map(
-                    lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt
-                )
+                pkt = ship(pkt)
                 if telemetry:
                     before = st
                     shipped = shipped + jnp.float32(tele.shipped_bytes(pkt))
-                    useful = useful + tele.packet_useful_bytes(pkt)
-                st, d, f, of_r = apply_fn(st, pkt, d, f)
+                    if faulted:
+                        useful = useful + tele.packet_useful_bytes(
+                            pkt[0]
+                        ) + jnp.float32(tele.shipped_bytes(pkt[1]))
+                    else:
+                        useful = useful + tele.packet_useful_bytes(pkt)
+                pkt, keep, fates = receive(pkt, r)
+                if delay_mode:
+                    st, d, f, of = deliver_held(st, d, f, of, held, heldv)
+                applied = apply_fn(st, pkt, d, f)
+                if faulted:
+                    st, d, f, of_r = select_apply(applied, (st, d, f), keep)
+                    fc = tick(fc, fates)
+                    if delay_mode:
+                        held = flt.tree_select(fates[2], pkt, held0)
+                        heldv = fates[2]
+                        tail = (fc, held, heldv)
+                    else:
+                        tail = (fc,)
+                else:
+                    st, d, f, of_r = applied
+                    tail = ()
                 if telemetry:
                     slots = slots + slots_of(before, st)
-                    return st, d, f, of | of_r, starved, slots, shipped, useful
-                return st, d, f, of | of_r, starved
+                    return (st, d, f, of | of_r, starved, slots, shipped,
+                            useful) + tail
+                return (st, d, f, of | of_r, starved) + tail
 
             def pipe_body(r, carry):
                 # Double-buffered round: extract round r+1's packet
@@ -200,37 +338,64 @@ def run_delta_ring(
                 # flight, THEN merge round r's in-flight packet — the
                 # send crosses the loop edge, so its DMA overlaps the
                 # merge kernels (module docstring; stale by one apply).
+                if delay_mode:
+                    fc, held, heldv = carry[6 + n_tel:]
+                elif faulted:
+                    (fc,) = carry[6 + n_tel:]
                 if telemetry:
                     st, d, f, of, starved, flight, slots, shipped, useful = (
-                        carry
+                        carry[:9]
                     )
                 else:
-                    st, d, f, of, starved, flight = carry
+                    st, d, f, of, starved, flight = carry[:6]
                 pkt, d, f = extract(st, d, f, cap, start=(r + 1) * cap)
                 starved = starved + jnp.where(
                     (r + 1) >= rounds - win, jnp.sum(d, dtype=jnp.int32), 0
                 )
                 if gated:
                     pkt = gate(pkt, rtop)
-                nxt = jax.tree.map(
-                    lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt
-                )
+                nxt = ship(pkt)
                 if telemetry:
                     before = st
                     shipped = shipped + jnp.float32(tele.shipped_bytes(nxt))
-                    useful = useful + tele.packet_useful_bytes(nxt)
-                st, d, f, of_r = apply_fn(st, flight, d, f)
+                    if faulted:
+                        useful = useful + tele.packet_useful_bytes(
+                            nxt[0]
+                        ) + jnp.float32(tele.shipped_bytes(nxt[1]))
+                    else:
+                        useful = useful + tele.packet_useful_bytes(nxt)
+                flight, keep, fates = receive(flight, r)
+                if delay_mode:
+                    st, d, f, of = deliver_held(st, d, f, of, held, heldv)
+                applied = apply_fn(st, flight, d, f)
+                if faulted:
+                    st, d, f, of_r = select_apply(applied, (st, d, f), keep)
+                    fc = tick(fc, fates)
+                    if delay_mode:
+                        held = flt.tree_select(fates[2], flight, held0)
+                        heldv = fates[2]
+                        tail = (fc, held, heldv)
+                    else:
+                        tail = (fc,)
+                else:
+                    st, d, f, of_r = applied
+                    tail = ()
                 if telemetry:
                     slots = slots + slots_of(before, st)
                     return (st, d, f, of | of_r, starved, nxt, slots,
-                            shipped, useful)
-                return st, d, f, of | of_r, starved, nxt
+                            shipped, useful) + tail
+                return (st, d, f, of | of_r, starved, nxt) + tail
 
             zeros_tel = (
                 jnp.zeros((), jnp.uint32),   # slots
                 jnp.zeros((), jnp.float32),  # shipped (wire)
                 jnp.zeros((), jnp.float32),  # useful (post-mask)
             )
+            fault_tail = ()
+            if delay_mode:
+                fault_tail = (fc0, held0, jnp.zeros((), bool))
+            elif faulted:
+                fault_tail = (fc0,)
             if pipeline and rounds > 0:
                 # Prologue: round 0's packet goes in flight pre-loop.
                 pkt, d, f = extract(folded, d, f, cap, start=0)
@@ -240,47 +405,104 @@ def run_delta_ring(
                 )
                 if gated:
                     pkt = gate(pkt, rtop)
-                flight = jax.tree.map(
-                    lambda x: lax.ppermute(x, REPLICA_AXIS, perm), pkt
-                )
+                flight = ship(pkt)
                 init = (folded, d, f, of, starved, flight)
                 if telemetry:
-                    init = init + (
-                        zeros_tel[0],
-                        zeros_tel[1] + jnp.float32(tele.shipped_bytes(flight)),
-                        zeros_tel[2] + tele.packet_useful_bytes(flight),
-                    )
+                    if faulted:
+                        init = init + (
+                            zeros_tel[0],
+                            zeros_tel[1]
+                            + jnp.float32(tele.shipped_bytes(flight)),
+                            zeros_tel[2] + tele.packet_useful_bytes(flight[0])
+                            + jnp.float32(tele.shipped_bytes(flight[1])),
+                        )
+                    else:
+                        init = init + (
+                            zeros_tel[0],
+                            zeros_tel[1]
+                            + jnp.float32(tele.shipped_bytes(flight)),
+                            zeros_tel[2] + tele.packet_useful_bytes(flight),
+                        )
+                init = init + fault_tail
                 carry = lax.fori_loop(0, rounds - 1, pipe_body, init)
                 folded, d, f, of, starved, flight = carry[:6]
+                if delay_mode:
+                    fc, held, heldv = carry[6 + n_tel:]
+                elif faulted:
+                    (fc,) = carry[6 + n_tel:]
                 # Epilogue: merge the final in-flight packet.
                 if telemetry:
                     before = folded
-                folded, d, f, of_r = apply_fn(folded, flight, d, f)
+                flight, keep, fates = receive(flight, rounds - 1, final=True)
+                if delay_mode:
+                    folded, d, f, of = deliver_held(
+                        folded, d, f, of, held, heldv
+                    )
+                applied = apply_fn(folded, flight, d, f)
+                if faulted:
+                    folded, d, f, of_r = select_apply(
+                        applied, (folded, d, f), keep
+                    )
+                    fc = tick(fc, fates)
+                else:
+                    folded, d, f, of_r = applied
                 of = of | of_r
                 if telemetry:
-                    slots, shipped, useful = carry[6:]
+                    slots, shipped, useful = carry[6:9]
                     slots = slots + slots_of(before, folded)
             else:
                 init = (folded, d, f, of, jnp.zeros((), jnp.int32))
                 if telemetry:
                     init = init + zeros_tel
+                init = init + fault_tail
                 carry = lax.fori_loop(0, rounds, round_body, init)
                 folded, d, f, of, starved = carry[:5]
                 if telemetry:
-                    slots, shipped, useful = carry[5:]
+                    slots, shipped, useful = carry[5:8]
+                if delay_mode:
+                    fc, held, heldv = carry[5 + n_tel:]
+                    # A packet still held when the loop ends arrives now
+                    # (one round late past the ring edge, not lost).
+                    folded, d, f, of = deliver_held(
+                        folded, d, f, of, held, heldv
+                    )
+                elif faulted:
+                    (fc,) = carry[5 + n_tel:]
             if telemetry and gated:
                 # The digest exchange itself rides the wire once.
                 dig = jnp.float32(tele.shipped_bytes(rtop))
                 shipped, useful = shipped + dig, useful + dig
-            top = lax.pmax(
-                lax.pmax(top_of(folded), REPLICA_AXIS), ELEMENT_AXIS
-            )
+            if faulted:
+                # Adopt the mesh top ONLY when the run lost nothing:
+                # adoption after loss makes receivers claim
+                # observed-and-removed for dots they never received (the
+                # delta.py inflated-context failure). Evicted ranks are
+                # excluded from the live pmax and never adopt.
+                own_top = top_of(folded)
+                ev = flt.evicted_mask(faults, REPLICA_AXIS)
+                top_live = lax.pmax(
+                    lax.pmax(jnp.where(ev, 0, own_top), REPLICA_AXIS),
+                    ELEMENT_AXIS,
+                )
+                lost_tot = lax.psum(fc[4], REPLICA_AXIS)
+                adopt = (lost_tot == 0) & ~ev
+                top = jnp.where(adopt, top_live, own_top)
+            else:
+                top = lax.pmax(
+                    lax.pmax(top_of(folded), REPLICA_AXIS), ELEMENT_AXIS
+                )
             folded = close_top(folded, top)
             of = (
                 lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS))
                 > 0
             )
             residue = lax.psum(starved, (REPLICA_AXIS, ELEMENT_AXIS))
+            if faulted:
+                # Lost packets void the certificate: a degraded run must
+                # never read as certified-converged (module docstring).
+                residue = jnp.maximum(
+                    residue, (lost_tot > 0).astype(jnp.int32)
+                )
             if rounds < win:
                 # A budget below the certificate window can never
                 # complete the ring's propagation; the certificate must
@@ -291,11 +513,29 @@ def run_delta_ring(
             )
             if telemetry:
                 local_rows = jax.tree.leaves(local)[0].shape[0]
-                outs = outs + (_tel_reduced(
+                tel = _tel_reduced(
                     folded, slots,
                     max(local_rows - 1, 0) + rounds, shipped,
                     (REPLICA_AXIS, ELEMENT_AXIS), residue=residue,
                     useful_per_dev=useful,
+                )
+                if faulted:
+                    tel = tel._replace(
+                        faults_dropped=lax.psum(fc[0], REPLICA_AXIS),
+                        faults_rejected=lax.psum(fc[1], REPLICA_AXIS),
+                        faults_delayed=lax.psum(fc[2], REPLICA_AXIS),
+                    )
+                outs = outs + (tel,)
+            if faulted:
+                # Packet counters psum over the REPLICA axis only: the
+                # fault draw is per logical link (element shards share
+                # the fate), so a replica-axis sum counts packets, not
+                # device shards.
+                outs = outs + (flt.FaultCounters(
+                    packets_dropped=lax.psum(fc[0], REPLICA_AXIS),
+                    packets_rejected=lax.psum(fc[1], REPLICA_AXIS),
+                    packets_delayed=lax.psum(fc[2], REPLICA_AXIS),
+                    miss_streak=fc[3].reshape(1),
                 ),)
             return outs
 
@@ -306,7 +546,7 @@ def run_delta_ring(
     with metrics.time(f"anti_entropy.{kind}"):
         out = _cached(
             kind, state, mesh, build, rounds, cap, telemetry, pipeline,
-            gated, *cache_extra, donate_argnums=argnums,
+            gated, faults, *cache_extra, donate_argnums=argnums,
         )(state, dirty, fctx)
         jax.block_until_ready(out)
     if donate:
@@ -316,9 +556,17 @@ def run_delta_ring(
         from .anti_entropy import _consume
 
         _consume(True, state, dirty)
-    _warn_residue(kind, out)
+    # A faulted run's residue is forced >= 1 BY DESIGN (lost packets
+    # void the certificate) — the budget warning would misdiagnose it
+    # and burn the once-per-kind dedupe a genuine under-budget run
+    # needs; the gauge still records, the fault counters are the signal.
+    _warn_residue(kind, out, warn=not faulted)
     if telemetry and tele.is_concrete(out[4]):
         tele.record(kind, out[4])
+    if faulted:
+        from .. import faults as flt
+
+        flt.record(out[-1])  # no-op under tracing, like tele.record
     return out
 
 
@@ -333,13 +581,15 @@ def reset_residue_warnings() -> None:
     _RESIDUE_WARNED.clear()
 
 
-def _warn_residue(kind: str, out) -> None:
+def _warn_residue(kind: str, out, warn: bool = True) -> None:
     if not isinstance(out[3], jax.core.Tracer):
         # Host-side residue accounting — skipped when the ring runs
         # under an outer jit (callers then read the returned residue).
+        # ``warn=False`` (faulted runs) records the gauge only: their
+        # residue is injected loss, not an under-budgeted ring.
         residue = int(out[3])
         metrics.observe(f"anti_entropy.{kind}.residue", float(residue))
-        if residue:
+        if residue and warn:
             # Every occurrence counts in the registry; the warning
             # itself fires once per kind per process — an under-budgeted
             # ring in a loop would otherwise emit one warning per round
@@ -376,6 +626,7 @@ def delta_gossip_elastic(
     digest: bool = True,
     donate: bool = False,
     reclaim=None,
+    faults=None,
 ):
     """δ-ring anti-entropy with elastic capacity recovery for dense
     ORSWOT replica batches (``BatchedOrswot``): the mid-round
@@ -416,7 +667,12 @@ def delta_gossip_elastic(
     tracker observes occupancy and narrows cleared axes in place (the
     δ path computes its frontier host-side —
     ``reclaim.host_frontier`` / ``reclaim.compact_model`` — since the
-    residue-certificated ring has no spare output lane for it)."""
+    residue-certificated ring has no spare output lane for it).
+
+    ``faults=`` threads a ``crdt_tpu.faults.FaultPlan`` into every
+    attempt (run_delta_ring); the LAST tuple element is then the
+    ``FaultCounters`` pytree with packet counters summed across
+    attempts (``faults.combine_counters``)."""
     from .. import elastic
     from .delta import mesh_delta_gossip
 
@@ -424,6 +680,7 @@ def delta_gossip_elastic(
     widened: dict = {}
     migrations = 0
     tel = None
+    fcs = None
     while True:
         if donate:
             snap = jax.tree.map(jnp.copy, model.state)
@@ -431,10 +688,15 @@ def delta_gossip_elastic(
         out = mesh_delta_gossip(
             model.state, dirty, fctx, mesh, rounds, cap, local_fold,
             telemetry=telemetry, pipeline=pipeline, digest=digest,
-            donate=donate,
+            donate=donate, faults=faults,
         )
         if donate:
             model.state, dirty = snap, snap_dirty
+        if faults is not None:
+            from .. import faults as flt
+
+            fcs = flt.accumulate_counters(fcs, out[-1])
+            out = out[:-1]
         if telemetry:
             tel = out[4] if tel is None else tele.combine(tel, out[4])
         if not bool(jnp.any(out[2])):
@@ -449,9 +711,12 @@ def delta_gossip_elastic(
                 # so retired slots do not pin lanes the shrink needs.
                 compact_model(model)
                 reclaim.observe(model)
+            ret = (*out[:4], widened)
             if telemetry:
-                return (*out[:4], widened, tel)
-            return (*out, widened)
+                ret = ret + (tel,)
+            if fcs is not None:
+                ret = ret + (fcs,)
+            return ret
         if migrations >= policy.max_migrations:
             raise RuntimeError(
                 f"δ ring still overflowing after {migrations} migrations "
@@ -461,3 +726,15 @@ def delta_gossip_elastic(
         metrics.count("elastic.delta_migrations")
         widened.update(elastic.widen(model, ("deferred_cap",), policy))
         migrations += 1
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+# The generic ring engine and the elastic wrapper both expose faults=
+# directly (the registered δ flavors thread through them); fault-surface
+# registration is the coverage contract crdt_tpu.faults.static_checks
+# enforces.
+
+from ..analysis.registry import register_fault_surface as _reg_fs  # noqa: E402
+
+_reg_fs("run_delta_ring", module=__name__)
+_reg_fs("delta_gossip_elastic", module=__name__)

@@ -103,23 +103,59 @@ def global_to_host(global_state):
     return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), global_state)
 
 
-def _allgather_host(arr: np.ndarray):
+def _refuse_timeout(retry, op: str) -> None:
+    """Collective exchanges cannot be safely timed out per attempt: the
+    abandoned worker thread may still issue its collectives and mispair
+    with the retry's on peer processes (faults/retry.py module
+    caveats). Fail loudly instead of corrupting rounds cluster-wide."""
+    if retry is not None and retry.timeout is not None:
+        raise ValueError(
+            f"{op}: RetryPolicy.timeout is not supported around "
+            f"collective exchanges — an abandoned timed-out attempt "
+            f"can mispair its in-flight collectives with the retry's; "
+            f"use timeout=None here"
+        )
+
+
+def _allgather_host(arr: np.ndarray, retry=None):
     """All-gather a per-process host array of possibly different lengths
     (axis 0); returns the per-process list. Lengths are exchanged first,
-    data rides one padded device all-gather."""
-    import jax
-    from jax.experimental import multihost_utils
+    data rides one padded device all-gather.
 
-    n = np.asarray([arr.shape[0]], np.int64)
-    lens = multihost_utils.process_allgather(n).reshape(-1)
-    maxlen = int(lens.max())
-    padded = np.zeros((maxlen, *arr.shape[1:]), arr.dtype)
-    padded[: arr.shape[0]] = arr
-    gathered = multihost_utils.process_allgather(padded)
-    return [gathered[p, : int(lens[p])] for p in range(len(lens))]
+    ``retry=`` (a ``crdt_tpu.faults.RetryPolicy``) wraps the exchange in
+    exponential-backoff-with-jitter retries — sound because an
+    all-gather of immutable host arrays is idempotent. Exhaustion raises
+    ``faults.DcnExchangeFailed`` carrying ``arr`` as the last-good state
+    (re-gather it later). Retries must be SYMMETRIC across processes
+    (same policy everywhere) or the survivors deadlock, and a
+    per-attempt ``timeout`` is REFUSED: a timed-out attempt's abandoned
+    thread could still issue its collectives and mispair with the
+    retry's fresh ones on peer processes (faults/retry.py documents
+    both caveats)."""
+    _refuse_timeout(retry, "_allgather_host")
+
+    def once():
+        import jax  # noqa: F401  (backend must be up for the gather)
+        from jax.experimental import multihost_utils
+
+        n = np.asarray([arr.shape[0]], np.int64)
+        lens = multihost_utils.process_allgather(n).reshape(-1)
+        maxlen = int(lens.max())
+        padded = np.zeros((maxlen, *arr.shape[1:]), arr.dtype)
+        padded[: arr.shape[0]] = arr
+        gathered = multihost_utils.process_allgather(padded)
+        return [gathered[p, : int(lens[p])] for p in range(len(lens))]
+
+    if retry is None:
+        return once()
+    from ..faults.retry import with_retries
+
+    return with_retries(
+        once, retry, op="allgather_host", last_good=arr
+    )
 
 
-def sync_list(model, since: int = 0) -> int:
+def sync_list(model, since: int = 0, retry=None) -> int:
     """Converge ``BatchedList`` identifier universes across processes
     (SURVEY.md §4.5 — the reference ships ``Op::Insert { id, val }``
     bytes to any replica; here the op log's identifier paths ride a DCN
@@ -131,9 +167,24 @@ def sync_list(model, since: int = 0) -> int:
     watermark to pass as ``since`` next round.
 
     Device state re-permutes with the growing universe; run
-    ``model.apply_trace_to_all()`` afterwards to land the new ops."""
+    ``model.apply_trace_to_all()`` afterwards to land the new ops.
+
+    ``retry=`` (a ``crdt_tpu.faults.RetryPolicy``) hardens the DCN
+    gather — the only cross-process exchange here — with
+    exponential-backoff-with-jitter retries (gathers of an immutable
+    export are idempotent; local ingestion below never retries, so a
+    flaky DCN cannot double-apply). Exhaustion raises
+    ``faults.DcnExchangeFailed`` carrying ``since`` as the last-good
+    watermark: ops below it are already everywhere — re-sync later from
+    it, nothing is lost. Same symmetric-retry and no-per-attempt-timeout
+    caveats as ``_allgather_host`` — and because this exchange is SEVEN
+    collectives, each retried attempt opens with an attempt-number
+    lockstep check, so a one-sided failure (this process erroring while
+    peers sailed on) surfaces as ``DcnExchangeFailed`` instead of
+    silently ingesting mispaired field bytes."""
     import jax
 
+    _refuse_timeout(retry, "sync_list")
     wire = dict(model.export_ops(since))
     # The gather rides device arrays; without x64 mode jax silently
     # truncates 64-bit dtypes to 32 (config.py documents the hazard), so
@@ -149,7 +200,47 @@ def sync_list(model, since: int = 0) -> int:
     wire["cctr_lo"] = cctr.astype(np.uint32)
     fields = ("kinds", "values", "counts", "cidx", "cactor",
               "cctr_hi", "cctr_lo")
-    gathered = {f: _allgather_host(np.asarray(wire[f])) for f in fields}
+
+    def gather_all():
+        return {f: _allgather_host(np.asarray(wire[f])) for f in fields}
+
+    if retry is None:
+        gathered = gather_all()
+    else:
+        from ..faults.retry import DcnExchangeFailed, with_retries
+
+        attempt_box = {"n": 0}
+
+        def gather_all_guarded():
+            # One-sided-failure guard: retrying this SEVEN-collective
+            # exchange is only safe when every process re-enters it
+            # together — a local exception while peers sailed on would
+            # pair our restarted field gathers with their LATER ones
+            # and silently ingest mispaired bytes. Each attempt opens
+            # with a tiny attempt-number all-gather: lockstep peers
+            # agree (one cheap round-trip); a desynced peer either
+            # disagrees (caught here, non-retryable) or is mid-field,
+            # where the tag's shape cannot pair cleanly (loud backend
+            # error). Either way corruption becomes failure.
+            tag = _allgather_host(
+                np.asarray([attempt_box["n"]], np.int32)
+            )
+            attempt_box["n"] += 1
+            if len({int(t[0]) for t in tag}) != 1:
+                raise DcnExchangeFailed(
+                    "sync_list", attempt_box["n"],
+                    RuntimeError(
+                        "attempt-number mismatch across processes — a "
+                        "one-sided retry desynced the collective "
+                        "sequence; re-enter sync_list on every process"
+                    ),
+                    last_good=since,
+                )
+            return gather_all()
+
+        gathered = with_retries(
+            gather_all_guarded, retry, op="sync_list", last_good=since
+        )
     me = jax.process_index()
     for p in range(jax.process_count()):
         if p == me:

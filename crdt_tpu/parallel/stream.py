@@ -87,15 +87,38 @@ from .collectives import all_reduce_lattice
 from .mesh import ELEMENT_AXIS, REPLICA_AXIS
 
 
+@dataclass
+class StreamFaultReport:
+    """One faulted stream run's accounting (``faults=`` on the stream
+    entries): which block indices (in THIS call's delivery order) were
+    lost to an injected upload drop, and which arrived corrupted and
+    were REJECTED by the in-kernel checksum verify (faults/integrity.py
+    — corrupted content is never joined). The accumulator is the exact
+    join of the non-lost blocks, so healing is a re-stream:
+    ``mesh_stream_fold*(lost_blocks, mesh, init=acc)`` with the faults
+    off (the δ-literature's eventual-resync contract)."""
+
+    dropped_blocks: list
+    rejected_blocks: list
+
+    @property
+    def lost_blocks(self) -> list:
+        return sorted(set(self.dropped_blocks) | set(self.rejected_blocks))
+
+
 class StreamInterrupted(RuntimeError):
     """A block failed to stage mid-stream. ``acc`` is the accumulator —
-    the exact lattice join of the ``blocks_done`` blocks already
-    applied, a valid joinable state — and the stream resumes from block
+    the exact lattice join of the non-lost blocks already applied, a
+    valid joinable state — and the stream resumes from block
     ``blocks_done`` via ``init=exc.acc`` on a fresh call. ``telemetry``
-    carries the partial Telemetry pytree when the run requested one."""
+    carries the partial Telemetry pytree when the run requested one;
+    ``fault_report`` the partial :class:`StreamFaultReport` when the
+    run injected faults — an interrupted faulted stream must still name
+    the blocks already lost BEFORE the interrupt, or the resume
+    contract would silently drop them from the final join."""
 
     def __init__(self, cause: BaseException, acc, blocks_done: int,
-                 telemetry=None):
+                 telemetry=None, fault_report=None):
         super().__init__(
             f"replica stream interrupted at block {blocks_done} "
             f"({type(cause).__name__}: {cause}); .acc holds the join of "
@@ -105,6 +128,7 @@ class StreamInterrupted(RuntimeError):
         self.acc = acc
         self.blocks_done = blocks_done
         self.telemetry = telemetry
+        self.fault_report = fault_report
 
 
 @dataclass(frozen=True)
@@ -280,13 +304,30 @@ def _stream_fold(
     widen_policy=None,
     frontier=None,
     compact_every: int = 0,
+    faults=None,
 ):
     """The shared scaffold: template derivation, identity padding and
     cap-matching at staging, the double-buffered dispatch loop, the
     elastic retry, periodic compaction, telemetry accumulation, and the
-    interrupt protocol. See the module docstring for semantics."""
+    interrupt protocol. See the module docstring for semantics.
+
+    ``faults=`` (a ``crdt_tpu.faults.FaultPlan``) injects seeded
+    drop/corrupt faults on the BLOCK UPLOAD — the stream's wire: the
+    staged block carries a checksum lane, the step corrupts it in-kernel
+    per a draw keyed on ``(seed, block index)``, and a failed verify
+    REJECTS the block (the accumulator keeps its pre-block value; a
+    rejected block's overflow flags are masked so the elastic retry
+    never widens for content that was not joined). ``delay`` has no
+    meaning here — block order is host-driven — and is ignored. The
+    per-block fate is read back host-side (one sync per block, the
+    faults-mode price), and a :class:`StreamFaultReport` is appended as
+    the LAST output so the caller can re-stream the lost blocks with
+    ``init=acc``. The flag-off trace is byte-identical pre-flag."""
     rsize = mesh.shape[REPLICA_AXIS]
     esize = mesh.shape[ELEMENT_AXIS]
+    faulted = faults is not None
+    if faulted:
+        from .. import faults as flt
     it = iter(blocks)
 
     def fetch():
@@ -298,7 +339,10 @@ def _stream_fold(
         raise  # caller bugs propagate as-is — _advance's contract
     except Exception as exc:
         metrics.count("stream.interrupted")
-        raise StreamInterrupted(exc, init, 0) from exc
+        raise StreamInterrupted(
+            exc, init, 0,
+            fault_report=StreamFaultReport([], []) if faulted else None,
+        ) from exc
     if first is None and init is None:
         raise ValueError("empty block stream and no init accumulator")
 
@@ -388,20 +432,26 @@ def _stream_fold(
         out_specs = [acc_specs, P()]
         if telemetry:
             out_specs.append(tele.specs())
+        if faulted:
+            out_specs.append(P())  # the block's fate code
 
-        @partial(
-            jax.shard_map,
-            mesh=mesh,
-            in_specs=(acc_specs, block_specs),
-            out_specs=tuple(out_specs),
-            check_vma=False,
-        )
-        def step_fn(acc, block):
+        def body(acc, block, bix=None):
             if plan.sharded:
                 acc_l = jax.tree.map(lambda x: x[0], acc)
                 block_l = jax.tree.map(lambda x: x[:, 0], block)
             else:
                 acc_l, block_l = acc, block
+            if faulted:
+                # The block upload is the stream's wire
+                # (faults.block_wire: drop/corrupt draw keyed on the
+                # block index, checksum verify over what arrived) — a
+                # failed verify rejects the whole block (its join is
+                # deselected below).
+                block_l, code = flt.block_wire(faults, bix, block_l)
+                code = lax.pmax(
+                    lax.pmax(code, REPLICA_AXIS), ELEMENT_AXIS
+                )
+                reject = code > 0
             folded, of_local = plan.fold_fn(block_l)
             joined, of_cross = all_reduce_lattice(
                 folded, REPLICA_AXIS, plan.join_fn, plan.fold_fn
@@ -413,6 +463,11 @@ def _stream_fold(
                 ) > 0
             ) | of_cross
             of = lax.psum(of.astype(jnp.int32), ELEMENT_AXIS) > 0
+            if faulted:
+                # A rejected block's join never lands, and its overflow
+                # flags must not drive the elastic widen retry.
+                new_acc = flt.tree_select(~reject, new_acc, acc_l)
+                of = of & ~reject
             out_acc = (
                 jax.tree.map(lambda x: x[None], new_acc) if plan.sharded
                 else new_acc
@@ -428,15 +483,41 @@ def _stream_fold(
                     tele.shipped_bytes(folded) * n_ex,
                     plan.sum_axes,
                 ))
+            if faulted:
+                outs.append(code)
             return tuple(outs)
+
+        if faulted:
+            @partial(
+                jax.shard_map,
+                mesh=mesh,
+                in_specs=(acc_specs, block_specs, P()),
+                out_specs=tuple(out_specs),
+                check_vma=False,
+            )
+            def step_fn(acc, block, bix):
+                return body(acc, block, bix)
+        else:
+            @partial(
+                jax.shard_map,
+                mesh=mesh,
+                in_specs=(acc_specs, block_specs),
+                out_specs=tuple(out_specs),
+                check_vma=False,
+            )
+            def step_fn(acc, block):
+                return body(acc, block)
 
         return step_fn
 
-    def step(acc, staged):
-        return _cached(
-            plan.kind, (acc, staged), mesh, build, telemetry,
+    def step(acc, staged, bix):
+        fn = _cached(
+            plan.kind, (acc, staged), mesh, build, telemetry, faults,
             donate_argnums=(0,) if donate else (),
-        )(acc, staged)
+        )
+        if faulted:
+            return fn(acc, staged, jnp.uint32(bix))
+        return fn(acc, staged)
 
     # ---- accumulator init --------------------------------------------
     if init is not None:
@@ -455,6 +536,16 @@ def _stream_fold(
     blocks_done = 0
     staged_bytes = 0
     overlap_hits = 0
+    dropped_blocks: list = []
+    rejected_blocks: list = []
+
+    def partial_report():
+        """The lost-so-far snapshot an interrupt must carry (lists are
+        copied: the exception's view must not mutate afterwards)."""
+        if not faulted:
+            return None
+        return StreamFaultReport(list(dropped_blocks),
+                                 list(rejected_blocks))
     frontier_arr = None
     reclaimed = (jnp.zeros((), jnp.uint32), jnp.zeros((), jnp.float32))
     if compact_every:
@@ -479,14 +570,16 @@ def _stream_fold(
     except Exception as exc:
         metrics.count("stream.interrupted")
         jax.block_until_ready(jax.tree.leaves(acc))
-        raise StreamInterrupted(exc, acc, 0, tel) from exc
+        raise StreamInterrupted(
+            exc, acc, 0, tel, fault_report=partial_report()
+        ) from exc
 
     observe_depth(f"stream.{plan.kind}", first if first is not None else acc)
     with metrics.time(f"stream.{plan.kind}"):
         while staged is not None:
             staged_bytes += tele.shipped_bytes(staged)
             if widen_policy is None:
-                out = step(acc, staged)
+                out = step(acc, staged, blocks_done)
             else:
                 # Elastic retry: snapshot the accumulator (the donated
                 # step consumes it; the join is idempotent, so
@@ -497,7 +590,7 @@ def _stream_fold(
                 attempts = 0
                 while True:
                     snap = jax.tree.map(jnp.copy, acc) if donate else acc
-                    out = step(acc, staged)
+                    out = step(acc, staged, blocks_done)
                     flags = jnp.atleast_1d(out[1])
                     if not bool(jnp.any(flags)):
                         break
@@ -532,6 +625,15 @@ def _stream_fold(
                         _widen_to(plan, staged, caps), block_sharding
                     )
                     attempts += 1
+            if faulted:
+                # One host sync per block — the faults-mode price; the
+                # fate feeds the report the caller re-streams from.
+                code = int(out[-1])
+                if code == 1:
+                    dropped_blocks.append(blocks_done)
+                elif code == 2:
+                    rejected_blocks.append(blocks_done)
+                out = out[:-1]
             acc = out[0]
             overflow = out[1] if overflow is None else overflow | out[1]
             if telemetry:
@@ -547,7 +649,9 @@ def _stream_fold(
                 # The next staging is issued while this block's join is
                 # still in flight: the upload DMA overlaps the kernels.
                 overlap_hits += 1
-            staged = _advance(fetch, stage, acc, tel, blocks_done)
+            staged = _advance(
+                fetch, stage, acc, tel, blocks_done, partial_report
+            )
         jax.block_until_ready(jax.tree.leaves(acc))
 
     if overflow is None:
@@ -562,6 +666,13 @@ def _stream_fold(
         record_reclaim(
             f"stream.{plan.kind}", int(reclaimed[0]), float(reclaimed[1])
         )
+    report = None
+    if faulted:
+        report = StreamFaultReport(dropped_blocks, rejected_blocks)
+        if dropped_blocks:
+            metrics.count("faults.packets_dropped", len(dropped_blocks))
+        if rejected_blocks:
+            metrics.count("faults.packets_rejected", len(rejected_blocks))
     if telemetry:
         tel = tel._replace(
             stream_blocks=jnp.uint32(blocks_done),
@@ -570,17 +681,28 @@ def _stream_fold(
             reclaimed_slots=tel.reclaimed_slots + reclaimed[0],
             reclaimed_bytes=tel.reclaimed_bytes + reclaimed[1],
         )
+        if faulted:
+            tel = tel._replace(
+                faults_dropped=jnp.uint32(len(dropped_blocks)),
+                faults_rejected=jnp.uint32(len(rejected_blocks)),
+            )
         if tele.is_concrete(tel):
             tele.record(plan.kind, tel)
+        if faulted:
+            return acc, overflow, tel, report
         return acc, overflow, tel
+    if faulted:
+        return acc, overflow, report
     return acc, overflow
 
 
-def _advance(fetch, stage, acc, tel, blocks_done):
+def _advance(fetch, stage, acc, tel, blocks_done, partial_report):
     """Fetch + stage the next block; a failure interrupts the stream
     with the accumulator intact (the failed block never entered a
-    step). Contract violations (ValueError from ``stage``) propagate
-    as-is — they are caller bugs, not stream faults."""
+    step) and, on a faulted run, the lost-so-far report
+    (``partial_report`` is the driver's snapshot closure). Contract
+    violations (ValueError from ``stage``) propagate as-is — they are
+    caller bugs, not stream faults."""
     try:
         nxt = fetch()
         return stage(nxt) if nxt is not None else None
@@ -589,7 +711,9 @@ def _advance(fetch, stage, acc, tel, blocks_done):
     except Exception as exc:
         metrics.count("stream.interrupted")
         jax.block_until_ready(jax.tree.leaves(acc))
-        raise StreamInterrupted(exc, acc, blocks_done, tel) from exc
+        raise StreamInterrupted(
+            exc, acc, blocks_done, tel, fault_report=partial_report()
+        ) from exc
 
 
 def _compact_acc(plan, acc, frontier_arr, reclaimed, acc_sharding):
@@ -608,7 +732,7 @@ def _compact_acc(plan, acc, frontier_arr, reclaimed, acc_sharding):
 def mesh_stream_fold_sparse(
     blocks: Iterable, mesh: Mesh, *, init=None, telemetry: bool = False,
     donate: bool = True, pipeline: bool = True, widen_policy=None,
-    frontier=None, compact_every: int = 0,
+    frontier=None, compact_every: int = 0, faults=None,
 ):
     """Stream-fold SPARSE (segment-encoded) ORSWOT replica blocks
     ``[B, ...]`` into one converged state — the flagship arbitrary-N
@@ -618,14 +742,14 @@ def mesh_stream_fold_sparse(
     return _stream_fold(
         _plan_sparse(), blocks, mesh, init=init, telemetry=telemetry,
         donate=donate, pipeline=pipeline, widen_policy=widen_policy,
-        frontier=frontier, compact_every=compact_every,
+        frontier=frontier, compact_every=compact_every, faults=faults,
     )
 
 
 def mesh_stream_fold(
     blocks: Iterable, mesh: Mesh, *, init=None, telemetry: bool = False,
     donate: bool = True, pipeline: bool = True, widen_policy=None,
-    frontier=None, compact_every: int = 0,
+    frontier=None, compact_every: int = 0, faults=None,
 ):
     """Stream-fold DENSE ORSWOT replica blocks ``[B, E, A]`` (content
     planes element-sharded over the mesh, ``mesh.orswot_specs``
@@ -633,14 +757,14 @@ def mesh_stream_fold(
     return _stream_fold(
         _plan_dense(), blocks, mesh, init=init, telemetry=telemetry,
         donate=donate, pipeline=pipeline, widen_policy=widen_policy,
-        frontier=frontier, compact_every=compact_every,
+        frontier=frontier, compact_every=compact_every, faults=faults,
     )
 
 
 def mesh_stream_fold_sparse_mvmap(
     blocks: Iterable, mesh: Mesh, *, sibling_cap: int = 4, init=None,
     telemetry: bool = False, donate: bool = True, pipeline: bool = True,
-    widen_policy=None, frontier=None, compact_every: int = 0,
+    widen_policy=None, frontier=None, compact_every: int = 0, faults=None,
 ):
     """Stream-fold SPARSE ``Map<K, MVReg>`` replica blocks
     (ops/sparse_mvmap) — the register-family arbitrary-N driver.
@@ -651,14 +775,14 @@ def mesh_stream_fold_sparse_mvmap(
         _plan_sparse_mvmap(sibling_cap), blocks, mesh, init=init,
         telemetry=telemetry, donate=donate, pipeline=pipeline,
         widen_policy=widen_policy, frontier=frontier,
-        compact_every=compact_every,
+        compact_every=compact_every, faults=faults,
     )
 
 
 def mesh_stream_fold_sparse_sharded(
     blocks: Iterable, mesh: Mesh, *, init=None, telemetry: bool = False,
     donate: bool = True, pipeline: bool = True, frontier=None,
-    compact_every: int = 0,
+    compact_every: int = 0, faults=None,
 ):
     """Stream-fold element-SHARDED sparse replica blocks ``[B, S, ...]``
     (from ``sparse_shard.split_segments``; S must equal the mesh's
@@ -670,7 +794,7 @@ def mesh_stream_fold_sparse_sharded(
     return _stream_fold(
         _plan_sparse_sharded(), blocks, mesh, init=init,
         telemetry=telemetry, donate=donate, pipeline=pipeline,
-        frontier=frontier, compact_every=compact_every,
+        frontier=frontier, compact_every=compact_every, faults=faults,
     )
 
 
@@ -760,6 +884,14 @@ def _register():
             [args[1]], mesh, init=args[0], donate=True
         ),
     )
+
+    from ..analysis.registry import register_fault_surface
+
+    for name in (
+        "mesh_stream_fold", "mesh_stream_fold_sparse",
+        "mesh_stream_fold_sparse_mvmap", "mesh_stream_fold_sparse_sharded",
+    ):
+        register_fault_surface(name, module=__name__)
 
 
 _register()

@@ -43,8 +43,10 @@ from .frontier import (
     frontier_lag,
     host_frontier,
     model_frontier,
+    reset_stall_warnings,
     stable_frontier,
     top_of,
+    watch_lag,
 )
 
 # The shrink half lives in elastic.py (it IS the inverse of widen and
@@ -61,6 +63,7 @@ def __getattr__(name):
 
 __all__ = [
     "Hysteresis", "compact_model", "compact_state", "frontier_lag",
-    "host_frontier", "model_frontier", "record_reclaim", "shrink",
-    "stable_frontier", "top_of",
+    "host_frontier", "model_frontier", "record_reclaim",
+    "reset_stall_warnings", "shrink", "stable_frontier", "top_of",
+    "watch_lag",
 ]

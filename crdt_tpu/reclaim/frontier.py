@@ -123,7 +123,50 @@ def frontier_lag(top, frontier):
     return jnp.max(jnp.maximum(t, f) - f).astype(jnp.uint32)
 
 
+# Kinds whose frontier-stall warning already fired this process —
+# repeats only count in the registry (the _warn_residue dedupe pattern,
+# parallel/delta_ring.py).
+_STALL_WARNED: set = set()
+
+
+def reset_stall_warnings() -> None:
+    """Re-arm the once-per-kind frontier-stall warning (tests; or after
+    an operator evicted the straggler and wants fresh signal)."""
+    _STALL_WARNED.clear()
+
+
+def watch_lag(kind: str, lag: int, lag_threshold) -> None:
+    """The alert the docstring above promises: ``frontier_lag`` is "the
+    stall signal", and this is what watches it. Called host-side by the
+    gossip entry points when ``lag_threshold=`` is set (needs
+    ``stability=``): a lag at or past the threshold counts
+    ``reclaim.frontier_stalled`` on EVERY occurrence — the rate an
+    operator can alert on — and warns once per kind per process (the
+    ``_warn_residue`` dedupe discipline: a stalled mesh in a gossip
+    loop must not emit one warning per round). A sustained stall means
+    some replica is pinning the frontier — investigate the straggler,
+    or evict it (crdt_tpu/faults/membership.py) to unpin."""
+    from ..utils.metrics import metrics
+
+    if lag_threshold is None or lag < lag_threshold:
+        return
+    metrics.count("reclaim.frontier_stalled")
+    if kind in _STALL_WARNED:
+        return
+    _STALL_WARNED.add(kind)
+    import warnings
+
+    warnings.warn(
+        f"{kind}: frontier_lag={lag} >= lag_threshold={lag_threshold} — "
+        f"a straggler is pinning the stable frontier and reclamation is "
+        f"stalled; investigate or evict the rank "
+        f"(crdt_tpu.faults.Membership). Warned once per kind; repeats "
+        f"count in reclaim.frontier_stalled",
+        stacklevel=3,
+    )
+
+
 __all__ = [
-    "frontier_lag", "host_frontier", "model_frontier", "stable_frontier",
-    "top_of",
+    "frontier_lag", "host_frontier", "model_frontier",
+    "reset_stall_warnings", "stable_frontier", "top_of", "watch_lag",
 ]

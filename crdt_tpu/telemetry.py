@@ -59,6 +59,13 @@ shipped and sync metadata per round), measured natively per round:
   flight (the double-buffer overlap actually landing). Filled by the
   stream driver host-side — the per-block loop lives outside the
   kernels — and 0 on every non-streaming entry point.
+- ``faults_dropped`` / ``faults_rejected`` / ``faults_delayed`` — the
+  degraded-mesh accounting (crdt_tpu/faults/; registry twins
+  ``telemetry.<kind>.faults.packets_*``): packets lost on an injected
+  link drop, packets REJECTED by the in-kernel checksum lane
+  (integrity.py — corrupted content is never joined), and packets the
+  link held one round. Populated by the ``faults=`` flag on the mesh
+  entry points, 0 elsewhere.
 
 Every field is a replicated scalar, so the whole pytree costs one word
 of output per field and no extra collectives beyond one psum/pmax
@@ -103,6 +110,9 @@ class Telemetry(NamedTuple):
     stream_blocks: jax.Array   # uint32 — replica blocks streamed
     stream_staged_bytes: jax.Array # float32 — bytes staged for blocks
     stream_overlap_hit: jax.Array  # uint32 — overlapped block uploads
+    faults_dropped: jax.Array  # uint32 — packets lost to injected drops
+    faults_rejected: jax.Array # uint32 — packets failing the checksum lane
+    faults_delayed: jax.Array  # uint32 — packets held one round by a link
 
 
 def zeros() -> Telemetry:
@@ -121,6 +131,9 @@ def zeros() -> Telemetry:
         stream_blocks=jnp.zeros((), jnp.uint32),
         stream_staged_bytes=jnp.zeros((), jnp.float32),
         stream_overlap_hit=jnp.zeros((), jnp.uint32),
+        faults_dropped=jnp.zeros((), jnp.uint32),
+        faults_rejected=jnp.zeros((), jnp.uint32),
+        faults_delayed=jnp.zeros((), jnp.uint32),
     )
 
 
@@ -146,6 +159,9 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         stream_blocks=a.stream_blocks + b.stream_blocks,
         stream_staged_bytes=a.stream_staged_bytes + b.stream_staged_bytes,
         stream_overlap_hit=a.stream_overlap_hit + b.stream_overlap_hit,
+        faults_dropped=a.faults_dropped + b.faults_dropped,
+        faults_rejected=a.faults_rejected + b.faults_rejected,
+        faults_delayed=a.faults_delayed + b.faults_delayed,
         deferred_depth=b.deferred_depth,
         residue=b.residue,
         widen_pressure=b.widen_pressure,
@@ -302,6 +318,9 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "stream_blocks": int(tel.stream_blocks),
         "stream_staged_bytes": float(tel.stream_staged_bytes),
         "stream_overlap_hit": int(tel.stream_overlap_hit),
+        "faults_dropped": int(tel.faults_dropped),
+        "faults_rejected": int(tel.faults_rejected),
+        "faults_delayed": int(tel.faults_delayed),
     }
 
 
@@ -330,6 +349,15 @@ def record(kind: str, tel: Telemetry) -> None:
     )
     metrics.count(
         f"telemetry.{kind}.stream.overlap_hit", d["stream_overlap_hit"]
+    )
+    metrics.count(
+        f"telemetry.{kind}.faults.packets_dropped", d["faults_dropped"]
+    )
+    metrics.count(
+        f"telemetry.{kind}.faults.packets_rejected", d["faults_rejected"]
+    )
+    metrics.count(
+        f"telemetry.{kind}.faults.packets_delayed", d["faults_delayed"]
     )
     metrics.observe(f"telemetry.{kind}.deferred_depth", d["deferred_depth"])
     metrics.observe(f"telemetry.{kind}.residue", d["residue"])

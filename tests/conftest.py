@@ -166,6 +166,13 @@ SLOW_NODEIDS = (
     # gate (test_device_anti_entropy_with_dropouts_converges) and the
     # pure drop/dup/reorder property stay tier-1
     "test_fault_injection.py::test_sparse_map_faulty_delivery_converges",
+    # ---- third curation round (ISSUE 8: the chaos soak must not push
+    # tier-1 past the 870 s budget). The 8-rank mixed
+    # drop/corrupt/evict/rejoin soak moves here; its 4-rank in-tier
+    # cousin (test_chaos_soak_dense_quick) runs the same machinery —
+    # eviction trigger included — on a shorter schedule, and the map-δ
+    # and sparse-stream chaos legs stay tier-1.
+    "test_chaos.py::test_chaos_soak_dense_long",
 )
 
 

@@ -1,0 +1,285 @@
+"""Randomized chaos soak (ISSUE 8 satellite): N rounds of mixed
+drop / corrupt / evict / rejoin schedules over the REAL compiled mesh
+programs, asserting bit-identical convergence to the fault-free
+fixpoint after heal.
+
+Two δ families ride the soak — the dense ORSWOT ring and the
+``Map<K, MVReg>`` ring — plus the sparse kind through the streaming
+fold's fault surface (there is no sparse δ ring; the stream IS the
+sparse family's bulk exchange). The soak's fault schedules are drawn
+from a FIXED plan set: every distinct ``FaultPlan`` is a distinct
+compiled program (the plan rides the jit-cache key by design), so an
+unbounded random draw would compile without end — the randomness lives
+in the seeded in-kernel draws each plan performs per round and rank.
+
+Heal discipline (the module under test documents why): a lossy δ run
+voids its residue certificate and skips top adoption, so the soak heals
+with one full-state state-driven sync — which is also the evicted
+rank's rejoin path — and only then compares bits.
+
+The long 8-rank soak lives in the curated slow tier
+(tests/conftest.py); its faster in-tier cousin below runs the same
+machinery at 4 ranks with a shorter schedule.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+
+from crdt_tpu.faults import FaultPlan, Membership
+from crdt_tpu.faults.scenarios import mint_streams
+from crdt_tpu.models import BatchedOrswot
+from crdt_tpu.parallel import (
+    make_mesh,
+    mesh_delta_gossip,
+    mesh_gossip,
+    shard_orswot,
+)
+from crdt_tpu.parallel.delta import interval_accumulate
+from crdt_tpu.utils import Interner
+
+
+def _trees_equal(a, b) -> bool:
+    return all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _dense_pop(n, n_ops, seed):
+    rng = random.Random(seed)
+    sites, _ = mint_streams(rng, n, n_ops)
+    return BatchedOrswot.from_pure(
+        sites,
+        members=Interner(list(range(5))),
+        actors=Interner([f"s{i}" for i in range(n)]),
+    )
+
+
+def _content_tracking(state):
+    """Full-content δ tracking from genesis: every row holding dots is
+    dirty under its own clock as context — a valid (add-only)
+    join-decomposition of the current state, which is all a chaos round
+    needs (removal back-propagation is the heal pass's job)."""
+    z = jax.tree.map(jnp.zeros_like, state)
+    d0 = jnp.zeros(state.ctr.shape[:-1], bool)
+    f0 = jnp.zeros(state.ctr.shape, state.ctr.dtype)
+    return interval_accumulate(d0, f0, z, state)
+
+
+# The FIXED plan pool (see the module docstring for why fixed): mixed
+# corruption, loss, delay, and a dead rank for the liveness tracker to
+# catch. ``dead=(2,)`` makes rank 2's outbound link silent — the
+# eviction trigger.
+PLANS = (
+    FaultPlan(seed=11, corrupt=0.5, drop=0.2),
+    FaultPlan(seed=12, drop=0.3, delay=0.3),
+    # The crash-fault plan is loss-clean otherwise: rank 2's outbound
+    # link goes silent while every other link stays healthy, so the
+    # spanning miss streak is unambiguous — under heavy corruption a
+    # fully-missed run is weather, not death (k_suspect below).
+    FaultPlan(seed=13, dead=(2,)),
+)
+
+
+def _soak_dense(n, schedule, seed):
+    """Run the mixed schedule over an n-rank dense δ ring; returns
+    (healed rows, fault-free fixpoint row, membership, total counters).
+    ``schedule`` is a list of PLANS indices; a ``"resync"`` entry runs
+    the full-state heal mid-soak and REJOINS every evicted rank (the
+    membership contract: full-state is the only sound re-entry)."""
+    batched = _dense_pop(n, n_ops=3 * n, seed=seed)
+    mesh = make_mesh(n, 1)
+    cur = shard_orswot(batched.state, mesh)
+
+    rows_ref, _ = mesh_gossip(cur, mesh, local_fold="tree")
+    ref0 = jax.tree.map(lambda x: x[0], rows_ref)
+
+    rounds = 2 * (n - 1) - 1  # the pipelined default budget
+    # Suspicion must outlast ONE fully-missed run: under heavy
+    # corruption every link misses stochastically, and a threshold a
+    # single bad run can reach would evict healthy ranks wholesale —
+    # only a link dead across CONSECUTIVE runs (the spanning streak)
+    # is a liveness signal, not weather.
+    m = Membership(n, k_suspect=rounds + 1)
+    totals = {"dropped": 0, "rejected": 0, "delayed": 0, "evictions": 0}
+    for entry in schedule:
+        if entry == "resync":
+            healed, _ = mesh_gossip(cur, mesh, local_fold="tree")
+            cur = healed
+            for r in list(m.evicted):
+                m.rejoin(r)
+            continue
+        plan = m.plan(PLANS[entry])
+        d, f = _content_tracking(cur)
+        out = mesh_delta_gossip(
+            cur, d, f, mesh, local_fold="tree", faults=plan
+        )
+        fc = out[-1]
+        totals["dropped"] += int(fc.packets_dropped)
+        totals["rejected"] += int(fc.packets_rejected)
+        totals["delayed"] += int(fc.packets_delayed)
+        before = len(m.evicted)
+        m.observe(fc, rounds=rounds, auto_evict=True)
+        totals["evictions"] += len(m.evicted) - before
+        cur = out[0]
+    healed, _ = mesh_gossip(cur, mesh, local_fold="tree")
+    return healed, ref0, m, totals
+
+
+def test_chaos_soak_dense_quick():
+    """In-tier cousin of the long soak (same machinery, 4 ranks, short
+    schedule): corruption + loss rounds, the dead-rank round trips the
+    liveness tracker into an eviction, and the final state-driven heal
+    lands every rank bit-identical to the fault-free fixpoint."""
+    healed, ref0, m, totals = _soak_dense(
+        4, schedule=[0, 2, 2, "resync", 1], seed=21
+    )
+    assert totals["rejected"] > 0 and totals["dropped"] > 0
+    assert totals["evictions"] >= 1, "the dead rank must get evicted"
+    assert m.evicted == (), "the resync must have rejoined rank 2"
+    for i in range(4):
+        assert _trees_equal(jax.tree.map(lambda x: x[i], healed), ref0), (
+            f"rank {i} diverged after the chaos soak"
+        )
+
+
+def test_chaos_soak_dense_long():
+    """The full 8-rank soak (slow tier; the quick cousin above stays
+    tier-1): every plan in the pool, two evict/rejoin cycles, and a
+    delay-heavy tail — still bit-identical to the fixpoint after heal."""
+    healed, ref0, m, totals = _soak_dense(
+        8,
+        schedule=[0, 1, 2, 2, "resync", 0, 2, 2, "resync", 1, 0],
+        seed=23,
+    )
+    assert totals["rejected"] > 0
+    assert totals["dropped"] > 0
+    assert totals["delayed"] > 0
+    assert totals["evictions"] >= 2, "two evict/rejoin cycles expected"
+    assert m.evicted == ()
+    for i in range(8):
+        assert _trees_equal(jax.tree.map(lambda x: x[i], healed), ref0), (
+            f"rank {i} diverged after the long chaos soak"
+        )
+
+
+def test_chaos_map_delta_corruption_heals_bit_identical():
+    """The Map<K, MVReg> δ flavor under sustained corruption: packets
+    reject, the certificate voids, and the full-state heal matches the
+    fault-free converged rows bit-for-bit."""
+    from crdt_tpu.models import BatchedMap
+    from crdt_tpu.parallel import (
+        mesh_delta_gossip_map,
+        mesh_gossip_map,
+        shard_map_state,
+    )
+    from test_delta_map import _interners, _site_run, _tracking
+
+    rng = random.Random(29)
+    sites, applied = _site_run(rng, n_sites=4, n_cmds=12)
+    batched = BatchedMap.from_pure(sites, **_interners())
+    mesh = make_mesh(4, 1)
+    sharded = shard_map_state(batched.state, mesh)
+    dirty, fctx = _tracking(batched, applied)
+
+    rows_ref, _ = mesh_gossip_map(sharded, mesh)
+    ref0 = jax.tree.map(lambda x: x[0], rows_ref)
+
+    out = mesh_delta_gossip_map(
+        sharded, dirty, fctx, mesh, cap=16,
+        faults=FaultPlan(seed=31, corrupt=0.7, drop=0.1),
+    )
+    fc = out[-1]
+    assert int(fc.packets_rejected) > 0
+    assert int(out[3]) >= 1, "loss must void the certificate"
+
+    healed, _ = mesh_gossip_map(out[0], mesh)
+    for i in range(4):
+        assert _trees_equal(jax.tree.map(lambda x: x[i], healed), ref0), (
+            f"map rank {i} diverged after heal"
+        )
+
+
+def test_chaos_stream_sparse_restream_heals_bit_identical():
+    """The sparse family's fault surface is the streaming fold: blocks
+    dropped or corrupted-and-rejected on upload are re-streamed from
+    the report (``init=acc`` — the eventual-resync contract) and the
+    result is bit-identical to the clean fold, across two fault
+    seeds."""
+    from crdt_tpu.models import BatchedSparseOrswot
+    from crdt_tpu.ops import sparse_orswot as sp_ops
+    from crdt_tpu.parallel import iter_blocks, mesh_stream_fold_sparse
+
+    rng = random.Random(33)
+    sites, _ = mint_streams(rng, 8, 12)
+    model = BatchedSparseOrswot.from_pure(sites, dot_cap=64, n_actors=8)
+    mesh = make_mesh(4, 1)
+    blocks = list(iter_blocks(model.state, 4))
+    ref, _ = sp_ops.fold(model.state)
+
+    lost_any = 0
+    for plan in (FaultPlan(seed=4, corrupt=0.9),
+                 FaultPlan(seed=5, drop=0.6)):
+        acc, of, report = mesh_stream_fold_sparse(
+            iter(blocks), mesh, faults=plan
+        )
+        lost_any += len(report.lost_blocks)
+        if report.lost_blocks:
+            acc, of = mesh_stream_fold_sparse(
+                iter([blocks[i] for i in report.lost_blocks]), mesh,
+                init=acc,
+            )
+        assert _trees_equal(acc, ref)
+    assert lost_any > 0, "the seeds above must actually lose blocks"
+
+
+def test_chaos_stream_interrupt_carries_partial_fault_report():
+    """An interrupted FAULTED stream must name the blocks already lost
+    before the interrupt (StreamInterrupted.fault_report) — resuming
+    with init=exc.acc alone would silently drop them from the final
+    join. Heal = resume over the remaining blocks + re-stream the
+    reported losses; bit-identical to the clean fold."""
+    from crdt_tpu.models import BatchedSparseOrswot
+    from crdt_tpu.ops import sparse_orswot as sp_ops
+    from crdt_tpu.parallel import (
+        StreamInterrupted,
+        iter_blocks,
+        mesh_stream_fold_sparse,
+    )
+
+    rng = random.Random(33)
+    sites, _ = mint_streams(rng, 8, 12)
+    model = BatchedSparseOrswot.from_pure(sites, dot_cap=64, n_actors=8)
+    mesh = make_mesh(4, 1)
+    blocks = list(iter_blocks(model.state, 2))
+    ref, _ = sp_ops.fold(model.state)
+    plan = FaultPlan(seed=4, corrupt=0.9)
+
+    # The same plan over the same block order: the clean run's report
+    # is the ground truth for what the dying run lost pre-interrupt.
+    _, _, full_report = mesh_stream_fold_sparse(
+        iter(blocks), mesh, faults=plan
+    )
+    die_at = 3
+
+    def dying():
+        for b in blocks[:die_at]:
+            yield b
+        raise OSError("source died")
+
+    try:
+        mesh_stream_fold_sparse(dying(), mesh, faults=plan)
+    except StreamInterrupted as exc:
+        assert exc.fault_report is not None
+        assert exc.fault_report.lost_blocks == [
+            i for i in full_report.lost_blocks if i < die_at
+        ]
+        acc = exc.acc
+        resume = [blocks[i] for i in range(die_at, len(blocks))]
+        resume += [blocks[i] for i in exc.fault_report.lost_blocks]
+        acc, of = mesh_stream_fold_sparse(iter(resume), mesh, init=acc)
+        assert _trees_equal(acc, ref)
+    else:
+        raise AssertionError("the dying source must interrupt the stream")

@@ -31,6 +31,30 @@ def test_every_donated_entry_point_aliases():
     assert not bad, f"entry points lost their aliasing lowering: {bad}"
 
 
+def test_faulted_run_does_not_poison_the_donating_lookup():
+    """A ``faults=`` run memoises a DIFFERENT program under the same
+    (kind, donation, mesh) — the stream's takes an extra block-index
+    arg. The gate's lookup must keep returning the flags-off program
+    (regression: check_all crashed with a shard_map in_specs arity
+    error on any entry whose faulted twin was invoked more recently)."""
+    from crdt_tpu.analysis.registry import entry_points
+    from crdt_tpu.faults import FaultPlan
+    from crdt_tpu.parallel import mesh_stream_fold_sparse
+
+    mesh = check_aliasing._mesh()
+    ep = next(e for e in entry_points(donatable=True)
+              if e.kind == "sparse_stream_fold")
+    ep.invoke(mesh, ep.make_args(mesh))  # flags-off program cached
+    args = ep.make_args(mesh)
+    mesh_stream_fold_sparse(
+        [args[1]], mesh, init=args[0],
+        faults=FaultPlan(seed=4, corrupt=0.9),
+    )  # faulted program cached LAST under the same (kind, donation)
+    fn = check_aliasing._donating_fn(ep.kind, ep.n_donated)
+    assert fn is not None
+    fn.lower(*ep.make_args(mesh))  # two-arg: the flags-off program
+
+
 def test_tile_table_override_reaches_pick_r_chunk(monkeypatch):
     from crdt_tpu.ops import pallas_kernels as pk
 

@@ -13,51 +13,16 @@ from crdt_tpu import Orswot
 from crdt_tpu.models import BatchedOrswot
 from crdt_tpu.utils import Interner
 
+# The schedule generators moved to crdt_tpu.faults.scenarios (one
+# source of truth shared with tests/test_chaos.py and bench --chaos);
+# the local names are kept so every test below reads unchanged.
+from crdt_tpu.faults.scenarios import (
+    MEMBERS,
+    faulty_delivery as _faulty_delivery,
+    mint_streams as _mint_streams,
+)
+
 from strategies import seeds
-
-MEMBERS = list(range(5))
-
-
-def _mint_streams(rng, n_sites, n_ops):
-    """Per-site op streams minted under each site's own actor (per-origin
-    causal order is the delivery contract; cross-site order is free)."""
-    sites = [Orswot() for _ in range(n_sites)]
-    streams = [[] for _ in range(n_sites)]
-    for _ in range(n_ops):
-        i = rng.randrange(n_sites)
-        s = sites[i]
-        if rng.random() < 0.7 or not s.read().val:
-            op = s.add(rng.choice(MEMBERS), s.read().derive_add_ctx(f"s{i}"))
-        else:
-            victim = rng.choice(sorted(s.read().val))
-            op = s.rm(victim, s.contains(victim).derive_rm_ctx())
-        s.apply(op)
-        streams[i].append(op)
-    return sites, streams
-
-
-def _faulty_delivery(rng, streams, r_ix):
-    """One receiver's faulty delivery schedule:
-    - DROP a suffix of each foreign stream (prefix delivery is the
-      causal contract);
-    - DUPLICATE random ops (CmRDT apply must be idempotent on dups);
-    - REORDER across sites (interleave streams arbitrarily, each
-      stream's own order preserved)."""
-    plan = []
-    for s_ix, stream in enumerate(streams):
-        if s_ix == r_ix:
-            continue
-        keep = rng.randint(0, len(stream))  # drop a suffix
-        prefix = stream[:keep]
-        dups = [op for op in prefix if rng.random() < 0.3]
-        plan.append(prefix + dups)
-    merged, cursors = [], [0] * len(plan)
-    while any(c < len(p) for c, p in zip(cursors, plan)):
-        choices = [i for i, (c, p) in enumerate(zip(cursors, plan)) if c < len(p)]
-        i = rng.choice(choices)
-        merged.append(plan[i][cursors[i]])
-        cursors[i] += 1
-    return merged
 
 
 @given(seeds)

@@ -1,0 +1,766 @@
+"""Degraded-mesh fault tolerance (crdt_tpu/faults/): in-kernel fault
+injection, link integrity, rank liveness/eviction, DCN retry.
+
+The four-piece contract:
+
+1. ``faults=None`` traces the byte-identical pre-flag program (the
+   ``telemetry=`` HLO-equality discipline) and a ZERO-RATE plan changes
+   no result bit.
+2. Corrupted packets are DETECTED by the checksum lane and rejected —
+   never joined — and lost packets void the δ-ring residue certificate;
+   state-driven resync heals bit-identically to the fault-free
+   fixpoint (the acceptance scenario: sustained corruption + one
+   evicted-then-rejoined rank on the 8-rank δ ring).
+3. Eviction unpins PR 5 reclamation: the frontier excludes the evicted
+   rank's stale top and compaction retires slots that stayed parked
+   pre-PR; the rejoin is full-state resync, bit-identical post-heal.
+4. The host-side DCN retry wrapper backs off with jitter, counts, and
+   fails into ``DcnExchangeFailed`` carrying the last-good state.
+"""
+
+import random
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crdt_tpu import Orswot, reclaim
+from crdt_tpu.faults import (
+    DcnExchangeFailed,
+    FaultCounters,
+    FaultPlan,
+    Membership,
+    RetryPolicy,
+    checksum,
+    checksum_detects,
+    ring_perm,
+    validate_perm,
+    with_retries,
+)
+from crdt_tpu.faults.scenarios import mint_streams
+from crdt_tpu.models import BatchedOrswot
+from crdt_tpu.ops import orswot as ops
+from crdt_tpu.ops.pallas_kernels import fold_auto
+from crdt_tpu.parallel import (
+    ELEMENT_AXIS,
+    REPLICA_AXIS,
+    make_mesh,
+    mesh_delta_gossip,
+    mesh_gossip,
+    orswot_specs,
+    ring_round,
+    shard_orswot,
+)
+from crdt_tpu.parallel.delta import interval_accumulate
+from crdt_tpu.utils import Interner
+from crdt_tpu.utils.metrics import metrics
+
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+P_REPLICAS = 4
+
+
+def _trees_equal(a, b) -> bool:
+    return all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _sites(n, n_ops=14, seed=3):
+    rng = random.Random(seed)
+    sites, _ = mint_streams(rng, n, n_ops)
+    return BatchedOrswot.from_pure(
+        sites,
+        members=Interner(list(range(5))),
+        actors=Interner([f"s{i}" for i in range(n)]),
+    )
+
+
+def _genesis_tracking(state):
+    z = jax.tree.map(jnp.zeros_like, state)
+    d0 = jnp.zeros(state.ctr.shape[:-1], bool)
+    f0 = jnp.zeros(state.ctr.shape, state.ctr.dtype)
+    return interval_accumulate(d0, f0, z, state)
+
+
+# ---- 1. flag-off HLO identity ---------------------------------------------
+
+def test_faults_off_hlo_identical_to_preflag_program():
+    """``faults=None`` (the default) must trace EXACTLY the pre-flag
+    gossip program — reconstructed here as the flag-free shard_map
+    closure, compared by lowered HLO text (the ``telemetry=`` /
+    ``stability=`` discipline)."""
+    batched = _sites(P_REPLICAS)
+    mesh = make_mesh(P_REPLICAS, 1)
+    sharded = shard_orswot(batched.state, mesh)
+    rounds = P_REPLICAS - 1
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(orswot_specs(),),
+        out_specs=(orswot_specs(), P()),
+        check_vma=False,
+    )
+    def gossip_fn(local):
+        fold_fn = partial(fold_auto, prefer="tree")
+        folded, of = fold_fn(local)
+        for _ in range(rounds):
+            folded, of_r = ring_round(
+                folded, REPLICA_AXIS, reduce_overflow=False, join_fn=ops.join
+            )
+            of = of | of_r
+        of = lax.psum(of.astype(jnp.int32), (REPLICA_AXIS, ELEMENT_AXIS)) > 0
+        return jax.tree.map(lambda x: x[None], folded), of
+
+    baseline = jax.jit(gossip_fn)
+    baseline_txt = jax.jit(lambda s: baseline(s)).lower(sharded).as_text()
+    entry_txt = jax.jit(
+        lambda s: mesh_gossip(
+            s, mesh, rounds=rounds, local_fold="tree", faults=None
+        )
+    ).lower(sharded).as_text()
+    assert entry_txt == baseline_txt
+
+
+def test_zero_rate_plan_changes_no_result_bit():
+    """A FaultPlan with every rate at 0 must reproduce the flag-off
+    results exactly and count nothing — the injection machinery itself
+    is bit-transparent when no fault fires."""
+    batched = _sites(P_REPLICAS)
+    mesh = make_mesh(P_REPLICAS, 1)
+    sharded = shard_orswot(batched.state, mesh)
+
+    rows0, of0 = mesh_gossip(sharded, mesh, local_fold="tree")
+    rows1, of1, fc = mesh_gossip(
+        sharded, mesh, local_fold="tree", faults=FaultPlan(seed=1)
+    )
+    assert _trees_equal(rows0, rows1)
+    assert bool(of0) == bool(of1)
+    assert int(fc.packets_dropped) == 0
+    assert int(fc.packets_rejected) == 0
+    assert int(fc.packets_delayed) == 0
+    assert int(np.asarray(fc.miss_streak).max()) == 0
+
+
+# ---- 2. link integrity ----------------------------------------------------
+
+def test_total_corruption_rejects_every_packet_keeps_local_state():
+    """corrupt=1.0: every exchange fails the checksum verify and is
+    rejected — the converged rows equal the rounds=0 (local-fold-only)
+    rows, every packet counts in ``packets_rejected``, and every
+    receiver's miss streak spans the whole run (the liveness signal)."""
+    batched = _sites(P_REPLICAS)
+    mesh = make_mesh(P_REPLICAS, 1)
+    sharded = shard_orswot(batched.state, mesh)
+    rounds = P_REPLICAS - 1
+
+    local_only, _ = mesh_gossip(sharded, mesh, rounds=0, local_fold="tree")
+    rows, _, fc = mesh_gossip(
+        sharded, mesh, local_fold="tree",
+        faults=FaultPlan(seed=2, corrupt=1.0),
+    )
+    assert _trees_equal(rows, local_only)
+    assert int(fc.packets_rejected) == P_REPLICAS * rounds
+    assert int(fc.packets_dropped) == 0
+    np.testing.assert_array_equal(
+        np.asarray(fc.miss_streak), np.full(P_REPLICAS, rounds)
+    )
+
+
+def test_checksum_detector_and_broken_twin():
+    """``integrity.checksum`` detects every single-lane perturbation
+    class the injector mints; the committed corruption-blind twin fails
+    the same detector (the faults static-check section runs both —
+    this pins the gate's teeth in-tier)."""
+    from crdt_tpu.analysis.fixtures import checksum_ignores_corruption
+
+    assert checksum_detects(checksum)
+    assert not checksum_detects(checksum_ignores_corruption)
+
+    # Float lanes hash by BITCAST, not downcast: a sign flip on a huge
+    # float32 (invisible to any value-rounding scheme) must change the
+    # digest — no bit of the payload is outside it.
+    f = jnp.asarray([1e30, 2.0], jnp.float32)
+    flipped = f.at[0].set(-f[0])
+    assert int(checksum((f,))) != int(checksum((flipped,)))
+
+
+def test_eviction_ring_stays_bijective_and_broken_twin_fails():
+    from crdt_tpu.analysis.fixtures import eviction_drops_ranks
+
+    for p, evicted in ((4, ()), (8, (3,)), (8, (0, 5)), (8, (1, 2, 3))):
+        assert validate_perm(ring_perm(p, evicted), p) == []
+    assert ring_perm(8, ()) == sorted((i, (i + 1) % 8) for i in range(8))
+    assert validate_perm(eviction_drops_ranks(8, (3,)), 8) != []
+
+
+def test_fault_static_checks_clean_and_coverage_total():
+    from crdt_tpu.analysis.registry import unregistered_fault_surfaces
+    from crdt_tpu.faults import static_checks
+
+    assert unregistered_fault_surfaces() == []
+    assert static_checks() == []
+
+
+def test_evicted_self_loop_is_not_a_wire_event():
+    """An evicted rank's self-loop delivery must not draw faults into
+    the accounting: with corrupt=1.0 and one rank evicted, exactly the
+    LIVE links reject — (p-1) per round, not p — and an eviction-only
+    plan (zero rates) on the δ ring loses NOTHING: the residue
+    certificate stays intact and the live ranks converge to the live
+    join with the top closure adopted (phantom self-loop loss would
+    have voided both)."""
+    batched = _sites(P_REPLICAS)
+    mesh = make_mesh(P_REPLICAS, 1)
+    sharded = shard_orswot(batched.state, mesh)
+    rounds = P_REPLICAS - 1
+
+    _, _, fc = mesh_gossip(
+        sharded, mesh, local_fold="tree",
+        faults=FaultPlan(seed=2, corrupt=1.0, evicted=(2,)),
+    )
+    assert int(fc.packets_rejected) == (P_REPLICAS - 1) * rounds
+    assert int(np.asarray(fc.miss_streak)[2]) == 0  # self-loop: no info
+
+    d, f = _genesis_tracking(sharded)
+    out = mesh_delta_gossip(
+        sharded, d, f, mesh, local_fold="tree",
+        faults=FaultPlan(seed=3, evicted=(2,)),
+    )
+    fc = out[-1]
+    assert int(fc.packets_dropped) == 0 and int(fc.packets_rejected) == 0
+    assert int(out[3]) == 0, (
+        "an eviction-only run loses nothing — the certificate must hold"
+    )
+
+
+def test_multihost_retry_refuses_per_attempt_timeout():
+    """A per-attempt timeout around a collective exchange is refused
+    loudly: an abandoned timed-out attempt could still issue its
+    collectives and mispair with the retry's on peer processes."""
+    from crdt_tpu.parallel import multihost
+
+    arr = np.arange(4, dtype=np.int32)
+    with pytest.raises(ValueError, match="timeout"):
+        multihost._allgather_host(
+            arr, retry=RetryPolicy(attempts=2, timeout=1.0)
+        )
+
+
+class _FakeListModel:
+    """Just enough of BatchedList for sync_list's wire protocol."""
+
+    def __init__(self):
+        self.op_handles = [object(), object()]
+
+    def export_ops(self, since):
+        return {
+            "kinds": np.zeros(2, np.int32),
+            "values": np.zeros(2, np.int32),
+            "counts": np.zeros(2, np.int64),
+            "cidx": np.zeros(2, np.int64),
+            "cactor": np.zeros(2, np.int32),
+            "cctr": np.zeros(2, np.uint64),
+        }
+
+    def ingest_remote_ops(self, remote):
+        raise AssertionError("single process: nothing remote to ingest")
+
+
+def test_sync_list_retry_opens_with_lockstep_tag(monkeypatch):
+    """The one-sided-failure guard: every retried sync_list attempt
+    opens with an attempt-number all-gather. In lockstep the tags agree
+    and the exchange proceeds (incrementing per attempt); a desynced
+    peer's disagreeing tag raises DcnExchangeFailed immediately —
+    NON-retryable, so the mispaired collective sequence is never
+    retried into."""
+    from crdt_tpu.parallel import multihost
+
+    tags_seen = []
+    state = {"fail": 1, "desync": False}
+    real = multihost._allgather_host
+
+    def fake_allgather(arr, retry=None):
+        if arr.dtype == np.int32 and arr.shape == (1,):  # the tag ride
+            tags_seen.append(int(arr[0]))
+            if state["desync"]:
+                return [np.asarray([0], np.int32),
+                        np.asarray([7], np.int32)]
+            return [np.asarray(arr)]
+        if state["fail"]:
+            state["fail"] -= 1
+            raise RuntimeError("gather blip")
+        return [np.asarray(arr)]
+
+    monkeypatch.setattr(multihost, "_allgather_host", fake_allgather)
+    policy = RetryPolicy(attempts=3, base_delay=0.0, seed=2)
+    watermark = multihost.sync_list(_FakeListModel(), retry=policy)
+    assert watermark == 2
+    assert tags_seen == [0, 1], "one tag per attempt, lockstep"
+
+    tags_seen.clear()
+    state.update(fail=0, desync=True)
+    with pytest.raises(DcnExchangeFailed, match="attempt-number") as exc:
+        multihost.sync_list(_FakeListModel(), since=5, retry=policy)
+    assert exc.value.last_good == 5
+    assert tags_seen == [0], "a desynced exchange must not be retried"
+    monkeypatch.setattr(multihost, "_allgather_host", real)
+
+
+def test_with_retries_lets_operator_abort_through():
+    """KeyboardInterrupt must surface immediately — never be retried
+    into with backoff, never be wrapped as DcnExchangeFailed."""
+    calls = {"n": 0}
+
+    def interrupted():
+        calls["n"] += 1
+        raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        with_retries(
+            interrupted, RetryPolicy(attempts=5, base_delay=0.0),
+            sleep=lambda _: None,
+        )
+    assert calls["n"] == 1
+
+
+def test_element_sharded_mesh_counts_links_not_shards():
+    """On a (2 replica × 2 element) mesh the fault draw is per LOGICAL
+    link — element shards of one rank share the fate (the draw keys on
+    the replica rank only), and the counters psum over the replica axis
+    so a rejected packet counts once, not once per device shard."""
+    batched = _sites(2, n_ops=10, seed=9)
+    mesh = make_mesh(2, 2)
+    sharded = shard_orswot(batched.state, mesh)
+    rounds = 1
+
+    local_only, _ = mesh_gossip(sharded, mesh, rounds=0, local_fold="tree")
+    rows, _, fc = mesh_gossip(
+        sharded, mesh, rounds=rounds, local_fold="tree",
+        faults=FaultPlan(seed=2, corrupt=1.0),
+    )
+    assert _trees_equal(rows, local_only)
+    assert int(fc.packets_rejected) == 2 * rounds  # links, not 4 shards
+    assert np.asarray(fc.miss_streak).shape == (2,)
+
+
+# ---- 3. the acceptance scenario: 8-rank δ ring chaos + heal ---------------
+
+def test_delta_chaos_evict_rejoin_heals_bit_identical_to_fixpoint():
+    """Sustained injected corruption (+ drops + delays) and one evicted
+    rank on the 8-rank δ ring: the run loses packets, so the residue
+    certificate is VOIDED (forced >= 1) and the top closure is
+    suppressed; the rows stay valid partial states, and one full-state
+    state-driven resync — which is also the evicted rank's REJOIN path —
+    lands every row bit-identical to the fault-free fixpoint."""
+    n = 8
+    batched = _sites(n, n_ops=24)
+    mesh = make_mesh(n, 1)
+    state = shard_orswot(batched.state, mesh)
+    d, f = _genesis_tracking(state)
+
+    rows_ref, _ = mesh_gossip(state, mesh, local_fold="tree")
+    ref0 = jax.tree.map(lambda x: x[0], rows_ref)
+
+    plan = FaultPlan(seed=42, corrupt=0.6, drop=0.2, delay=0.2, evicted=(5,))
+    rows, dirty, of, residue, fc = mesh_delta_gossip(
+        state, d, f, mesh, local_fold="tree", faults=plan
+    )
+    assert int(residue) >= 1, "lost packets must void the certificate"
+    assert int(fc.packets_rejected) > 0
+    assert int(fc.packets_dropped) > 0
+
+    healed, _ = mesh_gossip(rows, mesh, local_fold="tree")
+    for i in range(n):
+        assert _trees_equal(jax.tree.map(lambda x: x[i], healed), ref0), (
+            f"rank {i} diverged from the fault-free fixpoint after heal"
+        )
+
+
+def test_delta_delay_only_run_stays_certifiable_and_converges():
+    """Delay faults lose nothing — packets arrive a round late, the
+    certificate machinery stays honest, and with a doubled budget the
+    ring converges bit-identical to the fault-free fixpoint WITHOUT a
+    resync pass (the top closure still fires: zero packets lost)."""
+    batched = _sites(P_REPLICAS, n_ops=16, seed=5)
+    mesh = make_mesh(P_REPLICAS, 1)
+    state = shard_orswot(batched.state, mesh)
+    d, f = _genesis_tracking(state)
+
+    out_ref = mesh_delta_gossip(state, d, f, mesh, local_fold="tree",
+                                rounds=4 * (P_REPLICAS - 1))
+    out = mesh_delta_gossip(
+        state, d, f, mesh, local_fold="tree", rounds=4 * (P_REPLICAS - 1),
+        faults=FaultPlan(seed=6, delay=0.5),
+    )
+    fc = out[-1]
+    assert int(fc.packets_dropped) == 0 and int(fc.packets_rejected) == 0
+    assert int(fc.packets_delayed) > 0
+    assert _trees_equal(out[0], out_ref[0])
+    assert int(out[3]) == 0, "nothing lost: the certificate must hold"
+
+
+def test_faulted_residue_skips_the_budget_warning():
+    """A faulted run's residue is forced >= 1 BY DESIGN — it must not
+    fire the once-per-kind 'raise rounds or cap' warning (wrong remedy)
+    nor burn the dedupe a later genuine under-budget run needs."""
+    import warnings
+
+    from crdt_tpu.telemetry import reset_residue_warnings
+
+    batched = _sites(P_REPLICAS)
+    mesh = make_mesh(P_REPLICAS, 1)
+    state = shard_orswot(batched.state, mesh)
+    d, f = _genesis_tracking(state)
+
+    reset_residue_warnings()
+    with warnings.catch_warnings(record=True) as seen:
+        warnings.simplefilter("always")
+        out = mesh_delta_gossip(
+            state, d, f, mesh, local_fold="tree",
+            faults=FaultPlan(seed=2, corrupt=1.0),
+        )
+    assert int(out[3]) >= 1
+    assert not [w for w in seen if "residue" in str(w.message)]
+    # ... and an under-budgeted FAULT-FREE run afterwards still warns.
+    with warnings.catch_warnings(record=True) as seen:
+        warnings.simplefilter("always")
+        mesh_delta_gossip(state, d, f, mesh, local_fold="tree", rounds=1)
+    assert [w for w in seen if "residue" in str(w.message)]
+    reset_residue_warnings()
+
+
+# ---- 4. eviction unpins the frontier and reclamation ----------------------
+
+def _straggler_scenario():
+    """Live sites 0-3 hold a PARKED remove (clock zz:1) whose dot their
+    tops cover — the mid-protocol state a δ top-closure leaves right
+    before the next join's caught-up drop, which is exactly what the
+    PR 5 compactor retires eagerly (the pure apply path replays
+    deferred removes at once, so the state is built device-side: park
+    the rm, then lift the live tops over it). Straggler site 4 saw
+    nothing of actor zz, so its stale top pins the all-ranks frontier
+    below the slot's clock and pre-PR the slot can never retire."""
+    n = 5
+    sites = [Orswot() for _ in range(n)]
+    for i in range(n):
+        op = sites[i].add(i, sites[i].read().derive_add_ctx(f"s{i}"))
+        sites[i].apply(op)
+    ghost = Orswot()
+    add_op = ghost.add("never", ghost.read().derive_add_ctx("zz"))
+    ghost.apply(add_op)
+    rm_op = ghost.rm("never", ghost.contains("never").derive_rm_ctx())
+    for i in range(n - 1):  # the straggler (4) never sees it
+        sites[i].apply(rm_op)  # parks: cites zz's dot, top lags
+    model = BatchedOrswot.from_pure(
+        sites,
+        members=Interner(list(range(n)) + ["never"]),
+        actors=Interner([f"s{i}" for i in range(n)] + ["zz"]),
+    )
+    zz = model.actors.id_of("zz")
+    model.state = model.state._replace(
+        top=model.state.top.at[: n - 1, zz].set(1)
+    )
+    return model
+
+
+def test_eviction_unpins_frontier_and_reclaim_fires():
+    """THE headline behavioral change: pre-PR the straggler's stale top
+    pins the frontier and the parked slots never retire (the safe
+    default, pinned by test_fault_injection.py); evicting the straggler
+    advances the frontier over the live ranks only and compaction
+    retires the slots — and the rejoined straggler (full-state resync)
+    still converges bit-identical to a never-compacted mesh."""
+    model = _straggler_scenario()
+    untouched = _straggler_scenario()  # deterministic: an exact twin
+    assert _trees_equal(model.state, untouched.state)
+    zz = model.actors.id_of("zz")
+
+    # Pre-PR behavior: the all-ranks frontier is pinned by the straggler
+    # and compaction retires nothing.
+    pinned = reclaim.model_frontier(model)
+    assert pinned[zz] == 0
+    parked_before = int(jnp.sum(model.state.dvalid))
+    assert parked_before >= 4
+    reclaim.compact_model(model, pinned)
+    assert int(jnp.sum(model.state.dvalid)) == parked_before
+
+    # Eviction: the membership-driven frontier ranges over LIVE tops
+    # only — the slot's clock is now stable and compaction fires.
+    m = Membership(5, k_suspect=2)
+    m.evict(4)
+    live_tops = [np.asarray(model.state.top[i]) for i in m.live()]
+    live_frontier = reclaim.host_frontier(live_tops)
+    assert live_frontier[zz] >= 1, "eviction must unpin the zz lane"
+    freed = reclaim.compact_model(model, live_frontier)
+    assert freed["reclaimed_slots"] >= 4
+    assert int(jnp.sum(model.state.dvalid)) == 0
+
+    # The in-kernel twin: stability= frontier with faults= excludes the
+    # evicted rank's top from the pmin.
+    mesh = make_mesh(5, 1)
+    sharded = shard_orswot(untouched.state, mesh)
+    _, _, frontier_pinned = mesh_gossip(
+        sharded, mesh, local_fold="tree", stability=True
+    )
+    _, _, frontier_evicted, fc = mesh_gossip(
+        sharded, mesh, local_fold="tree", stability=True,
+        faults=m.plan(),
+    )
+    assert int(np.asarray(frontier_pinned)[zz]) == 0
+    assert int(np.asarray(frontier_evicted)[zz]) >= 1
+
+    # Rejoin: full-state resync (the Membership.rejoin contract), then
+    # the compacted mesh must land bit-identically on the untouched one.
+    m.rejoin(4)
+    for mdl in (model, untouched):
+        for _ in range(2):
+            for dst in range(5):
+                for src in range(5):
+                    if src != dst:
+                        mdl.merge_from(dst, src)
+    assert _trees_equal(model.state, untouched.state)
+
+
+def test_lag_threshold_without_stability_is_refused():
+    """``lag_threshold=`` without ``stability=True`` would silently
+    never arm (no frontier to measure the lag against) — refuse it
+    loudly, the ``_refuse_timeout`` discipline."""
+    model = _straggler_scenario()
+    mesh = make_mesh(5, 1)
+    sharded = shard_orswot(model.state, mesh)
+    with pytest.raises(ValueError, match="lag_threshold"):
+        mesh_gossip(sharded, mesh, local_fold="tree", lag_threshold=1)
+
+
+def test_frontier_lag_threshold_counts_and_warns_once():
+    """The frontier_lag alerting satellite: a straggler-pinned mesh
+    whose lag crosses ``lag_threshold=`` counts
+    ``reclaim.frontier_stalled`` on EVERY run and warns once per kind
+    (the ``_warn_residue`` dedupe pattern)."""
+    import warnings
+
+    model = _straggler_scenario()
+    mesh = make_mesh(5, 1)
+    sharded = shard_orswot(model.state, mesh)
+    reclaim.reset_stall_warnings()
+    before = metrics.snapshot()["counters"].get("reclaim.frontier_stalled", 0)
+
+    with warnings.catch_warnings(record=True) as seen:
+        warnings.simplefilter("always")
+        for _ in range(2):
+            mesh_gossip(
+                sharded, mesh, local_fold="tree", stability=True,
+                lag_threshold=1,
+            )
+    stall_warnings = [w for w in seen if "frontier_lag" in str(w.message)]
+    assert len(stall_warnings) == 1, "must warn once per kind"
+    after = metrics.snapshot()["counters"].get("reclaim.frontier_stalled", 0)
+    assert after - before == 2, "every stalled run must count"
+
+    # Below threshold: no count, no warning.
+    reclaim.reset_stall_warnings()
+    with warnings.catch_warnings(record=True) as seen:
+        warnings.simplefilter("always")
+        mesh_gossip(
+            sharded, mesh, local_fold="tree", stability=True,
+            lag_threshold=10_000,
+        )
+    assert not [w for w in seen if "frontier_lag" in str(w.message)]
+    assert metrics.snapshot()["counters"].get(
+        "reclaim.frontier_stalled", 0
+    ) == after
+
+
+# ---- 5. membership (host-side; no mesh needed) ----------------------------
+
+def _counters(streaks):
+    z = jnp.zeros((), jnp.uint32)
+    return FaultCounters(z, z, z, jnp.asarray(streaks, jnp.int32))
+
+
+def test_membership_suspect_evict_rejoin_protocol():
+    m = Membership(4, k_suspect=5)
+    # rank 2 dead: its receiver (rank 3 under the unit ring) misses all
+    # 3 rounds; everyone else delivered.
+    assert m.observe(_counters([0, 0, 0, 3]), rounds=3) == ()
+    assert m.streaks[2] == 3
+    assert m.suspects() == ()
+    # a second fully-missed run SPANS the streak past k_suspect
+    hot = m.observe(_counters([0, 0, 0, 3]), rounds=3)
+    assert m.streaks[2] == 6 and hot == (2,)
+    m.evict(2)
+    assert m.evicted == (2,) and 2 not in m.live()
+    assert validate_perm(m.ring(), 4) == []
+    # a partial streak RESETS (the link delivered mid-run)
+    m2 = Membership(4, k_suspect=5)
+    m2.observe(_counters([0, 0, 0, 3]), rounds=3)
+    m2.observe(_counters([0, 0, 0, 1]), rounds=3)
+    assert m2.streaks[2] == 1
+    # rejoin clears state; the caller contract (full-state resync) is
+    # documented, not enforceable here
+    m.rejoin(2)
+    assert m.evicted == () and m.streaks[2] == 0
+    # never evict the last live rank
+    m3 = Membership(2, k_suspect=1)
+    m3.evict(0)
+    with pytest.raises(ValueError):
+        m3.evict(1)
+
+
+def test_membership_observe_maps_streaks_through_the_live_ring():
+    # With rank 1 already evicted, the live ring is 0 -> 2 -> 3 -> 0;
+    # receiver 2's streak must charge SENDER 0.
+    m = Membership(4, k_suspect=2)
+    m.evict(1)
+    m.observe(_counters([0, 0, 4, 0]), rounds=4)
+    assert m.streaks[0] == 4
+    assert m.streaks[1] == 0  # evicted self-loop carries no info
+
+
+# ---- 6. DCN retry-with-backoff --------------------------------------------
+
+def test_with_retries_succeeds_after_transient_failures():
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient DCN blip")
+        return "ok"
+
+    before = metrics.snapshot()["counters"].get("faults.retries", 0)
+    out = with_retries(
+        flaky, RetryPolicy(attempts=5, base_delay=0.01, seed=0),
+        op="test", sleep=sleeps.append,
+    )
+    assert out == "ok" and calls["n"] == 3
+    assert len(sleeps) == 2
+    assert sleeps[1] > sleeps[0], "backoff must grow"
+    after = metrics.snapshot()["counters"].get("faults.retries", 0)
+    assert after - before == 2
+
+
+def test_with_retries_exhaustion_raises_with_last_good():
+    def dead():
+        raise ConnectionError("coordinator gone")
+
+    before = metrics.snapshot()["counters"].get("faults.gave_up", 0)
+    with pytest.raises(DcnExchangeFailed) as exc:
+        with_retries(
+            dead, RetryPolicy(attempts=3, base_delay=0.0, seed=1),
+            op="sync_list", last_good=17, sleep=lambda _: None,
+        )
+    assert exc.value.last_good == 17
+    assert exc.value.attempts == 3
+    assert isinstance(exc.value.cause, ConnectionError)
+    after = metrics.snapshot()["counters"].get("faults.gave_up", 0)
+    assert after - before == 1
+
+
+def test_with_retries_timeout_counts_and_retries():
+    import time as _time
+
+    calls = {"n": 0}
+
+    def slow_then_fast():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            _time.sleep(0.5)
+        return calls["n"]
+
+    before = metrics.snapshot()["counters"].get("faults.timeouts", 0)
+    out = with_retries(
+        slow_then_fast,
+        RetryPolicy(attempts=3, base_delay=0.0, timeout=0.05, seed=2),
+        op="test", sleep=lambda _: None,
+    )
+    assert out == 2
+    assert metrics.snapshot()["counters"]["faults.timeouts"] - before == 1
+
+
+def test_retry_jitter_is_bounded_and_capped():
+    sleeps = []
+
+    def dead():
+        raise OSError("down")
+
+    policy = RetryPolicy(
+        attempts=6, base_delay=0.1, max_delay=0.3, backoff=2.0,
+        jitter=0.5, seed=3,
+    )
+    with pytest.raises(DcnExchangeFailed):
+        with_retries(dead, policy, sleep=sleeps.append)
+    assert len(sleeps) == 5
+    raw = [0.1, 0.2, 0.3, 0.3, 0.3]  # capped at max_delay
+    for s, r in zip(sleeps, raw):
+        assert r <= s <= r * 1.5 + 1e-9, (s, r)
+
+
+def test_allgather_host_retry_wiring(monkeypatch):
+    """The multihost wrapper really routes through the retry machinery:
+    a transiently-failing gather succeeds on retry. The gather itself is
+    faked with the MULTI-host result shape (leading process axis) — a
+    single-process ``process_allgather`` degenerates to identity, which
+    is jax's shape quirk, not the wiring under test."""
+    from jax.experimental import multihost_utils
+
+    from crdt_tpu.parallel import multihost
+
+    state = {"fail": 1}
+
+    def flaky(x, *a, **kw):
+        if state["fail"]:
+            state["fail"] -= 1
+            raise RuntimeError("gather blip")
+        return np.asarray(x)[None]  # one process's worth, process-major
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", flaky)
+    arr = np.arange(6, dtype=np.int32).reshape(3, 2)
+    out = multihost._allgather_host(
+        arr, retry=RetryPolicy(attempts=3, base_delay=0.0, seed=4)
+    )
+    assert len(out) == 1
+    np.testing.assert_array_equal(out[0], arr)
+
+    state["fail"] = 10  # permanently down: exhaustion carries last_good
+    with pytest.raises(DcnExchangeFailed) as exc:
+        multihost._allgather_host(
+            arr, retry=RetryPolicy(attempts=2, base_delay=0.0, seed=5)
+        )
+    np.testing.assert_array_equal(exc.value.last_good, arr)
+
+
+# ---- 7. telemetry + schema ------------------------------------------------
+
+def test_telemetry_carries_fault_fields_and_schema_validates():
+    import os
+    import sys
+    import time
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    ))
+    import check_telemetry_schema as cts
+
+    batched = _sites(P_REPLICAS)
+    mesh = make_mesh(P_REPLICAS, 1)
+    sharded = shard_orswot(batched.state, mesh)
+    rows, of, tel, fc = mesh_gossip(
+        sharded, mesh, local_fold="tree", telemetry=True,
+        faults=FaultPlan(seed=2, corrupt=1.0),
+    )
+    assert int(tel.faults_rejected) == int(fc.packets_rejected) > 0
+    assert int(tel.faults_dropped) == 0
+
+    from crdt_tpu.telemetry import to_dict
+
+    record = {"record": "telemetry", "ts": time.time(), "kind": "t",
+              **to_dict(tel)}
+    assert cts.validate_record(record, cts.load_schema()) == []

@@ -19,6 +19,14 @@ Sections (each timed, each independently skippable):
   duplication, drop-with-resync; causal interleavings for op-based
   kinds), with minimized counterexamples on violation — plus the
   generator-degeneracy gate (a one-point domain vacuates every law).
+- ``faults``    — the degraded-mesh fault-tolerance gates
+  (crdt_tpu.faults.static_checks): fault-surface registry coverage
+  (every public entry exposing ``faults=`` must have registered —
+  crdt_tpu.analysis.registry.register_fault_surface), the checksum
+  detector (integrity.checksum must catch every injected perturbation
+  class), and the eviction-bijection gate (ring_perm stays a true
+  bijection under every eviction subset) — each with a committed broken
+  twin in analysis/fixtures.py proving the detector fires.
 - ``jit-lint``  — the jaxpr walker (crdt_tpu.analysis.jit_lint) over
   every registered mesh entry point: traced-branch, unstable-sort,
   float-accum, dtype-overflow, donation-alias, PLUS the collective-
@@ -65,7 +73,8 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 SECTIONS = (
-    "lint", "schema", "laws", "schedules", "jit-lint", "cost", "aliasing",
+    "lint", "schema", "laws", "schedules", "faults", "jit-lint", "cost",
+    "aliasing",
 )
 
 # Directories the fallback linter walks (ruff takes its own config).
@@ -207,6 +216,12 @@ def run_schedules():
     return schedules.check_all_schedules()
 
 
+def run_faults():
+    from crdt_tpu.faults import static_checks
+
+    return static_checks()
+
+
 def run_jit_lint():
     from crdt_tpu.analysis.jit_lint import check_gates, lint_entry_points
 
@@ -239,12 +254,15 @@ RUNNERS = {
     "schema": run_schema,
     "laws": run_laws,
     "schedules": run_schedules,
+    "faults": run_faults,
     "jit-lint": run_jit_lint,
     "cost": run_cost,
     "aliasing": run_aliasing,
 }
 
-_JAX_SECTIONS = ("laws", "schedules", "jit-lint", "cost", "aliasing")
+_JAX_SECTIONS = (
+    "laws", "schedules", "faults", "jit-lint", "cost", "aliasing",
+)
 
 
 def _as_findings(section: str, result):
