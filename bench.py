@@ -780,6 +780,182 @@ def bench_chaos():
     }]
 
 
+def bench_heal():
+    """Optimal-δ-synchronization leg (``--heal`` runs it alone; ISSUE
+    9's acceptance gate), two measurements on the 8-rank ring:
+
+    1. **steady state** — a low-churn hot-row workload with shared
+       REMOVALS (the knowledge class the PR 3 frozen-top digest can
+       never mask) under a capped-drain budget (backlog > cap, the
+       ROUNDS BUDGET formula's extra circuits — where re-circulated
+       forwarding traffic actually crosses a link twice): δ ring
+       digest-only vs digest+ack-window, converged states asserted
+       bit-identical, post-mask payload (``bytes_useful``) per
+       link-round reported for both — the acked rate must land
+       STRICTLY below the digest-only baseline. (A second effect rides
+       the record: masked marks retire instead of re-circulating, so
+       the acked ring certifies ``residue == 0`` at budgets where the
+       digest-only ring still starves.)
+    2. **partition heal** — replicas diverge from a certified synced
+       base, a ``FaultPlan`` drop window voids the certificate, and the
+       degraded rows heal two ways: full-state gossip (the PR 8 path;
+       its in-kernel ``bytes_exchanged`` is the cost) vs decomposition
+       resync over the pre-partition snapshot
+       (``crdt_tpu.faults.resync`` — Enes et al. 1803.02750). Both are
+       asserted bit-identical to each other before the byte ratio is
+       reported; the decomposition must ship < 25% of full-state
+       bytes."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu import faults as flt
+    from crdt_tpu.ops import orswot as ops
+    from crdt_tpu.parallel import make_mesh, mesh_delta_gossip, mesh_gossip
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        log("heal leg needs >= 2 devices for a ring; skipping")
+        return []
+    p = n_dev
+    e = int(os.environ.get("BENCH_HEAL_ELEMS", 2048))
+    a = int(os.environ.get("BENCH_HEAL_ACTORS", 8))
+    cap = int(os.environ.get("BENCH_HEAL_CAP", 32))
+    hot = int(os.environ.get("BENCH_HEAL_HOT_ROWS", 32))
+    n_rm = int(os.environ.get("BENCH_HEAL_RM_ROWS", 8))
+    mesh = make_mesh(p, 1)
+
+    # ---- 1. steady state: hot rows + shared removals, capped drain -------
+    # Base: the first half of the universe holds dot (actor0, 1)
+    # everywhere (synced). Churn: EVERY replica mints a dot on the same
+    # ``hot`` rows (popular keys churn at many replicas — overlapping
+    # marks are what makes forwarding traffic re-cross links) and all
+    # replicas saw ``n_rm`` base members removed (row ctr zeroed under
+    # a covering fctx — removal re-circulation is un-gateable by the
+    # frozen-top digest by design). Backlog (hot + n_rm) > cap forces
+    # the drain circuits the ROUNDS BUDGET formula prices.
+    base = jnp.zeros((p, e, a), jnp.uint32).at[:, : e // 2, 0].set(1)
+    state = ops.empty(e, a, deferred_cap=4, batch=(p,))
+    hot_rows = jnp.arange(hot) + e // 2
+    rm_rows = jnp.arange(n_rm)
+    actors = jnp.arange(p) % a
+    ctr = base.at[
+        jnp.arange(p)[:, None], hot_rows[None, :], actors[:, None]
+    ].set(2)
+    top = jnp.max(ctr, axis=1)
+    ctr = ctr.at[:, rm_rows, :].set(0)
+    state = state._replace(top=top, ctr=ctr)
+    dirty = (
+        jnp.zeros((p, e), bool)
+        .at[:, hot_rows].set(True)
+        .at[:, rm_rows].set(True)
+    )
+    fctx = jnp.where(dirty[..., None], ctr, 0)
+    fctx = fctx.at[:, rm_rows, 0].set(1)  # the removed dot
+    churn = float(dirty.sum() / dirty.size)
+
+    # Pipelined capped-drain budget: 2 * (P-1) * (1 + backlog/cap) - 1.
+    backlog = hot + n_rm
+    rounds_delta = 2 * (p - 1) * (1 + -(-backlog // cap)) - 1
+    outs = {}
+    for acked in (False, True):
+        outs[acked] = mesh_delta_gossip(
+            state, dirty, fctx, mesh, rounds=rounds_delta, cap=cap,
+            telemetry=True, ack_window=acked,
+        )
+    rows_off, rows_on = outs[False][0], outs[True][0]
+    steady_identical = all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(rows_off), jax.tree.leaves(rows_on))
+    )
+    assert steady_identical, "ack window changed the converged lattice"
+    assert int(outs[True][3]) == 0, "heal leg did not certify convergence"
+    residue_digest = int(outs[False][3])  # may starve where acked won't
+    tel_off, tel_on = outs[False][4], outs[True][4]
+    links = p * rounds_delta
+    useful_digest = float(tel_off.bytes_useful) / links
+    useful_acked = float(tel_on.bytes_useful) / links
+    acked_skipped = float(tel_on.bytes_acked_skipped)
+    assert useful_acked < useful_digest, (
+        "ack window did not beat the digest-only payload baseline"
+    )
+
+    # ---- 2. partition heal: drop window, then resync two ways ------------
+    synced = jnp.zeros((p, e, a), jnp.uint32).at[:, : e // 2, 0].set(1)
+    st2 = ops.empty(e, a, deferred_cap=4, batch=(p,))
+    div_rows = jnp.arange(p) + e // 2  # each rank touches ONE row
+    ctr2 = synced.at[jnp.arange(p), div_rows, actors].set(3)
+    st2 = st2._replace(top=jnp.max(ctr2, axis=1), ctr=ctr2)
+    d2 = jnp.zeros((p, e), bool).at[jnp.arange(p), div_rows].set(True)
+    f2 = jnp.where(d2[..., None], ctr2, 0)
+    since = jax.tree.map(
+        lambda x: x[0],
+        ops.empty(e, a, deferred_cap=4, batch=(p,))._replace(
+            top=jnp.max(synced, axis=1), ctr=synced
+        ),
+    )
+    plan = flt.FaultPlan(
+        seed=int(os.environ.get("BENCH_HEAL_SEED", 23)), drop=0.5
+    )
+    degraded_rows, _, _, residue, _ = mesh_delta_gossip(
+        st2, d2, f2, mesh, rounds=rounds_delta, cap=cap, faults=plan
+    )
+    assert int(residue) >= 1, "the drop window must void the certificate"
+
+    t0 = time.perf_counter()
+    healed_full, _, tel_heal = mesh_gossip(
+        degraded_rows, mesh, telemetry=True
+    )
+    full_s = time.perf_counter() - t0
+    bytes_full_gossip = float(tel_heal.bytes_exchanged)
+
+    t0 = time.perf_counter()
+    healed_dec, report = flt.resync("orswot", degraded_rows, since)
+    dec_s = time.perf_counter() - t0
+    heal_identical = all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(
+            jax.tree.leaves(healed_full), jax.tree.leaves(healed_dec)
+        )
+    )
+    assert heal_identical, (
+        "decomposition resync diverged from full-state gossip heal"
+    )
+    assert report.ratio < 0.25, (
+        f"decomposition resync shipped {report.ratio:.1%} of full state"
+    )
+
+    log(
+        f"config-heal: {p} ranks x {e} elems ({churn:.2%} churn incl. "
+        f"removals, cap {cap}): δ useful/link-round digest-only "
+        f"{useful_digest:,.0f} B vs +ack-window {useful_acked:,.0f} B "
+        f"({useful_acked / useful_digest:.1%}; {acked_skipped:,.0f} B "
+        f"masked); post-partition heal: decomposition resync shipped "
+        f"{report.bytes_shipped:,.0f} B = {report.ratio:.1%} of "
+        f"full-state ({report.bytes_full_state:,.0f} B; gossip wire "
+        f"{bytes_full_gossip:,.0f} B) in {dec_s:.2f}s vs {full_s:.2f}s, "
+        f"bit-identical both ways"
+    )
+    return [{
+        "config": "heal", "metric": "resync_bytes_ratio",
+        "value": round(report.ratio, 4), "unit": "ratio",
+        "resync_bytes_shipped": report.bytes_shipped,
+        "resync_bytes_full_state": report.bytes_full_state,
+        "resync_lanes_shipped": report.lanes_shipped,
+        "heal_bytes_full_gossip_wire": bytes_full_gossip,
+        "bytes_useful_digest_per_link_round": round(useful_digest, 1),
+        "bytes_useful_acked_per_link_round": round(useful_acked, 1),
+        "bytes_acked_skipped_total": acked_skipped,
+        "ack_vs_digest_useful_ratio": round(
+            useful_acked / useful_digest, 4
+        ),
+        "residue_digest_only": residue_digest,
+        "residue_acked": 0,
+        "rounds_delta": rounds_delta, "churn": round(churn, 4),
+        "cap": cap, "bit_identical": steady_identical and heal_identical,
+        "shape": f"{p}x{e}x{a}",
+    }]
+
+
 def bench_cpu() -> float:
     from crdt_tpu.pure.orswot import Orswot
     from crdt_tpu.vclock import VClock
@@ -1589,6 +1765,14 @@ def parse_args(argv=None):
              "print its record to stdout",
     )
     ap.add_argument(
+        "--heal",
+        action="store_true",
+        help="run ONLY the optimal-δ-sync leg (ack-window steady-state "
+             "payload vs the digest-only baseline, and partition heal "
+             "by decomposition resync vs full-state gossip, both "
+             "bit-identity gated) and print its record to stdout",
+    )
+    ap.add_argument(
         "--flagship",
         action="store_true",
         help="run ONLY the flagship replica-streaming leg (10,240 "
@@ -1619,6 +1803,21 @@ def main(argv=None):
         )
         log(json.dumps(rec))
         print(json.dumps(rec))
+        return
+    if args.heal:
+        # The fast heal-only mode: one leg, one stdout JSON line.
+        if os.environ.get("BENCH_PROBE", "1") != "0" and not tpu_reachable():
+            from crdt_tpu.utils.cpu_pin import pin_cpu
+
+            pin_cpu(virtual_devices=8)
+        from crdt_tpu.telemetry import span
+
+        with span("bench.heal", quick=True):
+            recs = bench_heal()
+        for rec in recs:
+            log(json.dumps(rec))
+        print(json.dumps(recs[0] if recs else {"config": "heal",
+                                               "skipped": True}))
         return
     if args.chaos:
         # The fast chaos-only mode: one leg, one stdout JSON line.
@@ -1700,6 +1899,7 @@ def main(argv=None):
         ("comms", bench_comms),
         ("reclaim", bench_reclaim),
         ("chaos", bench_chaos),
+        ("heal", bench_heal),
     ]:
         if os.environ.get(f"BENCH_{name.upper()}", "1") != "0":
             try:
@@ -1805,6 +2005,21 @@ def main(argv=None):
                 "evicted_rank", "reclaimed_slots_pinned",
                 "reclaimed_slots_evicted", "bit_identical",
             ) if k in ch
+        }
+    # The heal leg rides the headline record too: the optimal-δ-sync
+    # byte wins (ack window vs the digest baseline; decomposition
+    # resync vs full-state heal) are ISSUE 9's metrics of record.
+    hl = next((r for r in records if r.get("config") == "heal"), None)
+    if hl is not None:
+        headline["heal"] = {
+            k: hl[k] for k in (
+                "value", "resync_bytes_shipped",
+                "resync_bytes_full_state",
+                "bytes_useful_digest_per_link_round",
+                "bytes_useful_acked_per_link_round",
+                "ack_vs_digest_useful_ratio",
+                "bytes_acked_skipped_total", "bit_identical",
+            ) if k in hl
         }
     # The flagship streaming record rides the headline too: it IS the
     # metric of record at the north-star shape (ROADMAP item 1) — the

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import Compactor, MergeKind
+from .registry import Compactor, Decomposer, MergeKind
 
 # ---- broken merges (law-engine fixtures) ---------------------------------
 #
@@ -106,6 +106,70 @@ GOOD_COMPACTOR = Compactor(
 LOSSY_COMPACTOR = Compactor(
     name="fixture_lossy_max", compact=_fixture_compact_lossy,
     observe=lambda s: s, module=__name__,
+)
+
+
+# ---- broken decompositions (delta_opt/ decomposition-law fixtures) -------
+#
+# Both twins wrap the HONEST orswot row decomposition (the generic
+# split/unsplit pair registered at the bottom of ops/orswot.py) and
+# break exactly one law each; tests/test_delta_opt.py and the `decomp`
+# static-check section assert the matching law fires (and that the real
+# registration stays clean).
+
+def _orswot_split(s):
+    from ..ops.orswot import _decomp_split
+
+    return _decomp_split(s)
+
+
+def _orswot_unsplit(rows, res):
+    from ..ops.orswot import _decomp_unsplit
+
+    return _decomp_unsplit(rows, res)
+
+
+def _decompose_lossy(state, since):
+    """Silently drops the FIRST changed δ lane — reconstruction misses
+    that row's inflation, so decomp-reconstruction must fire."""
+    from ..delta_opt.decompose import decompose_rows, drop_lane
+
+    d = decompose_rows(state, since, _orswot_split)
+    first = jnp.argmax(d.valid)
+    dropped = drop_lane(d, first)
+    has = jnp.any(d.valid)
+    return jax.tree.map(
+        lambda a, b: jnp.where(has, a, b), dropped, d
+    )
+
+
+def _decompose_redundant(state, since):
+    """Marks EVERY row lane valid (changed or not) — dropping an
+    unchanged lane still reconstructs exactly, so decomp-irredundancy
+    must fire."""
+    from ..delta_opt.decompose import Decomposition
+
+    rows, res = _orswot_split(state)
+    n = jax.tree.leaves(rows)[0].shape[0]
+    return Decomposition(
+        lanes=rows, valid=jnp.ones((n,), bool), residual=res,
+    )
+
+
+def _reconstruct_rows(since, d):
+    from ..delta_opt.decompose import reconstruct_rows
+
+    return reconstruct_rows(since, d, _orswot_split, _orswot_unsplit)
+
+
+LOSSY_DECOMPOSER = Decomposer(
+    name="fixture_lossy_decomposer", module=__name__,
+    decompose=_decompose_lossy, reconstruct=_reconstruct_rows,
+)
+
+REDUNDANT_DECOMPOSER = Decomposer(
+    name="fixture_redundant_decomposer", module=__name__,
+    decompose=_decompose_redundant, reconstruct=_reconstruct_rows,
 )
 
 

@@ -412,9 +412,12 @@ def _cached_entry_fn(kind: str, n_donated: int, mesh=None):
     mesh shape) — matching on (kind, donation) alone returned whichever
     mesh was invoked LAST, so re-linting under a different mesh could
     silently reuse a jaxpr traced for the wrong axis sizes. Keys
-    carrying a FaultPlan are skipped: a faulted program is a DIFFERENT
-    program (the stream's takes an extra block-index arg), and the
-    analysis gates must always see the flags-off one."""
+    carrying a FaultPlan OR an AckWindowKey are skipped: a faulted or
+    acked program is a DIFFERENT program (extra args / an extra ack
+    ppermute per round), and the analysis gates must always see the
+    flags-off one — the PR 8 cache-poisoning class, pinned for the ack
+    flavor by tests/test_delta_opt.py."""
+    from ..delta_opt.ackwin import AckWindowKey
     from ..faults import FaultPlan
     from ..parallel import anti_entropy as ae
 
@@ -429,7 +432,9 @@ def _cached_entry_fn(kind: str, n_donated: int, mesh=None):
         fn for key, fn in ae._FN_CACHE.items()
         if key[0] == kind and key[3] == tuple(range(n_donated))
         and mesh_matches(key[1])
-        and not any(isinstance(x, FaultPlan) for x in key[4:])
+        and not any(
+            isinstance(x, (FaultPlan, AckWindowKey)) for x in key[4:]
+        )
     ]
     return hits[-1] if hits else None
 

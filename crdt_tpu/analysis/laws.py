@@ -187,6 +187,99 @@ def check_compaction_all() -> List[Finding]:
     return out
 
 
+# ---- the join-decomposition laws (delta_opt/) -----------------------------
+
+def check_decomposition_kind(kind: MergeKind, dec=None) -> List[Finding]:
+    """The two laws every registered join-irreducible decomposition
+    (``register_decomposition`` — crdt_tpu/delta_opt/) must satisfy,
+    bit-exact on RAW arrays over the kind's small domain paired as
+    ``(s, since) = (S[i] ∨ S[j], S[i])`` — every ``since`` is a genuine
+    lower bound of its ``s``, exactly the shape the δ resync path sees:
+
+    - **reconstruction**  ``join(decompose(s, since)) ⊔ since == s`` —
+      scattering the valid δ lanes back over ``since`` and adopting the
+      residual reproduces ``s`` exactly (a lossy decomposition ships a
+      heal that silently diverges);
+    - **irredundancy**    no valid δ lane is covered by the join of the
+      others — dropping ANY single valid lane must break
+      reconstruction (a decomposition emitting unchanged lanes is not
+      minimal, and its byte accounting overstates the divergence set).
+
+    ``dec`` overrides the registered decomposer (the broken-twin
+    fixtures pass ``fixtures.LOSSY_DECOMPOSER`` /
+    ``fixtures.REDUNDANT_DECOMPOSER`` directly)."""
+    from ..delta_opt.decompose import decompose, drop_lane, reconstruct
+    from .registry import get_decomposer
+
+    if dec is None:
+        try:
+            dec = get_decomposer(kind.name)
+        except KeyError:
+            return [Finding(
+                "decomp-coverage", kind.name,
+                "merge kind has no registered decomposition "
+                "(register_decomposition — see registry.py)",
+            )]
+    join = _norm_join(kind.join)
+    seeds = kind.states()
+    m = len(seeds)
+    S = _stack(seeds)
+    ii, jj = np.meshgrid(np.arange(m), np.arange(m), indexing="ij")
+    ii, jj = ii.ravel(), jj.ravel()
+    A, B = _take(S, ii), _take(S, jj)
+    R = jax.jit(jax.vmap(lambda a, b: join(a, b)[0]))(A, B)
+
+    D = jax.jit(jax.vmap(lambda s, o: decompose(dec, s, o)))(R, A)
+    recon = jax.jit(jax.vmap(
+        lambda o, d, lane: reconstruct(dec, o, drop_lane(d, lane)),
+        in_axes=(0, 0, None),
+    ))
+    findings: List[Finding] = []
+
+    got = jax.jit(jax.vmap(lambda o, d: reconstruct(dec, o, d)))(A, D)
+    for row, path in _mismatches(got, R):
+        i, j = int(ii[max(row, 0)]), int(jj[max(row, 0)])
+        findings.append(Finding(
+            "decomp-reconstruction", kind.name,
+            f"join(decompose(S{i} ∨ S{j}, S{i})) over S{i} does not "
+            f"reproduce the state at leaf {path} — the decomposition "
+            "is lossy",
+        ))
+        break
+
+    def _eq_rows(got_l) -> np.ndarray:
+        eq = np.ones(m * m, bool)
+        for g, w in zip(jax.tree.leaves(got_l), jax.tree.leaves(R)):
+            g, w = np.asarray(g), np.asarray(w)
+            eq &= (g.reshape(g.shape[0], -1)
+                   == w.reshape(w.shape[0], -1)).all(axis=1)
+        return eq
+
+    valid_np = np.asarray(D.valid)
+    for lane in range(valid_np.shape[-1]):
+        if not valid_np[:, lane].any():
+            continue
+        still_exact = _eq_rows(recon(A, D, lane)) & valid_np[:, lane]
+        if still_exact.any():
+            p0 = int(np.nonzero(still_exact)[0][0])
+            findings.append(Finding(
+                "decomp-irredundancy", kind.name,
+                f"δ lane {lane} of decompose(S{int(ii[p0])} ∨ "
+                f"S{int(jj[p0])}, S{int(ii[p0])}) is covered by the join "
+                "of the others (dropping it still reconstructs exactly) "
+                "— the decomposition is not irredundant",
+            ))
+            break
+    return findings
+
+
+def check_decomposition_all() -> List[Finding]:
+    out: List[Finding] = []
+    for kind in merge_kinds():
+        out.extend(check_decomposition_kind(kind))
+    return out
+
+
 def _check_domain(kind: MergeKind, seeds: list, domain: str) -> List[Finding]:
     join = _norm_join(kind.join)
     # One jitted canon per domain: it runs on 5-7 whole comparison

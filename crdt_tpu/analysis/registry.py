@@ -46,6 +46,24 @@ compactor fails tests/test_analysis.py discovery:
                                 #   retirement)
     )
 
+**Decompositions** — every merge kind additionally registers its
+join-irreducible decomposition (crdt_tpu/delta_opt/): the
+``split(state) -> (rows, residual)`` / ``unsplit`` pair the generic
+row-diff decomposition builds on. Coverage is total by contract — a
+merge kind without a decomposer fails the ``decomp`` static-check
+section and tests/test_delta_opt.py discovery:
+
+    from ..analysis.registry import register_decomposition
+
+    register_decomposition(
+        "my_kind", module=__name__,
+        split=_decomp_split,      # state -> (rows pytree, residual):
+                                  #   rows leaves share a leading lane
+                                  #   axis (the per-unit δ granularity)
+        unsplit=_decomp_unsplit,  # (rows, residual) -> state (exact
+                                  #   inverse of split)
+    )
+
 **Mesh entry points** — every public anti-entropy entry
 (``mesh_gossip*`` / ``mesh_fold*`` / ``mesh_delta_gossip*``) registers
 its jit-cache kind, an example-args builder, an invoker, and how many
@@ -142,6 +160,27 @@ class Compactor:
 
 
 @dataclass(frozen=True)
+class Decomposer:
+    """One registered join-irreducible decomposition
+    (crdt_tpu/delta_opt/): the split/unsplit pair the generic row-diff
+    decomposition builds on — ``split(state) -> (rows, residual)`` with
+    a shared leading lane axis on every row leaf, ``unsplit(rows,
+    residual) -> state`` its exact inverse. Coverage is total by
+    contract — a merge kind without a decomposer fails
+    tests/test_delta_opt.py discovery and the ``decomp`` static-check
+    section. ``decompose``/``reconstruct`` override the generic pair
+    (broken-twin fixtures use this; production kinds register
+    split/unsplit only)."""
+
+    name: str
+    split: Optional[Callable[[Any], Tuple[Any, Any]]] = None
+    unsplit: Optional[Callable[[Any, Any], Any]] = None
+    module: str = ""
+    decompose: Optional[Callable[[Any, Any], Any]] = None
+    reconstruct: Optional[Callable[[Any, Any], Any]] = None
+
+
+@dataclass(frozen=True)
 class FaultSurface:
     """One registered fault-capable mesh entry (crdt_tpu/faults/): a
     public ``crdt_tpu.parallel`` callable that accepts a ``faults=``
@@ -158,6 +197,7 @@ class FaultSurface:
 _MERGE: Dict[str, MergeKind] = {}
 _ENTRY: Dict[str, EntryPoint] = {}
 _COMPACT: Dict[str, Compactor] = {}
+_DECOMP: Dict[str, Decomposer] = {}
 _FAULT_SURFACES: Dict[str, FaultSurface] = {}
 
 # Public callables in crdt_tpu.parallel matching this are mesh entry
@@ -229,6 +269,47 @@ def register_compactor(
     )
     _COMPACT[name] = comp
     return comp
+
+
+def register_decomposition(
+    name: str,
+    *,
+    split: Optional[Callable] = None,
+    unsplit: Optional[Callable] = None,
+    module: str = "",
+    decompose: Optional[Callable] = None,
+    reconstruct: Optional[Callable] = None,
+) -> Decomposer:
+    if decompose is None and (split is None or unsplit is None):
+        raise ValueError(
+            f"register_decomposition({name!r}) needs either split+unsplit "
+            f"or an explicit decompose/reconstruct override"
+        )
+    dec = Decomposer(
+        name=name, split=split, unsplit=unsplit, module=module,
+        decompose=decompose, reconstruct=reconstruct,
+    )
+    _DECOMP[name] = dec
+    return dec
+
+
+def decomposers() -> Tuple[Decomposer, ...]:
+    ensure_registered()
+    return tuple(_DECOMP[k] for k in sorted(_DECOMP))
+
+
+def get_decomposer(name: str) -> Decomposer:
+    ensure_registered()
+    return _DECOMP[name]
+
+
+def undecomposable_kinds() -> List[str]:
+    """Merge kinds without a registered decomposition — the delta_opt/
+    coverage gap list; non-empty fails tests/test_delta_opt.py and the
+    ``decomp`` static-check section (the same total-coverage contract
+    as joins, compactors, and mesh entry points)."""
+    ensure_registered()
+    return sorted(set(_MERGE) - set(_DECOMP))
 
 
 def register_fault_surface(name: str, *, module: str = "") -> FaultSurface:

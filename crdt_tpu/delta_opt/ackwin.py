@@ -1,0 +1,219 @@
+"""Ack-window back-propagation for the δ rings (Enes et al. §4.2).
+
+The digest gate (PR 3, ``delta.gate_delta``) masks only add-only slots
+the receiver's FROZEN top covers — it needs no round-trip state, but a
+top digest can never vouch for a removal, so removal-carrying slots and
+every domain-forwarded re-circulation of already-delivered knowledge
+keep re-shipping until the round budget exhausts. The paper's fix is
+**back-propagation of acknowledged intervals**: the receiver positively
+confirms what it JOINED, and the sender never re-ships a δ the
+confirmed window covers — including removals, because an ack is
+*positive knowledge* of delivered content, not top inference (the PR 3
+wider-gate unsoundness does not arise).
+
+Ring translation (``run_delta_ring``, ``ack_window=True``): each device
+keeps a per-link **ack window** for its one down-ring link —
+
+- ``rows`` — the content planes of the last slot the peer confirmed
+  joining, per row (the sender's own shipped copy, promoted on ack:
+  per-link memory is the price of the mode, vs the digest gate's
+  stateless one-shot exchange);
+- ``ctx``  — the join of the confirmed slots' causal contexts per row
+  (monotone — contexts only grow at the sender);
+- ``ackd`` — which rows have ever been positively confirmed.
+
+Each round the receiver, after applying the inbound packet, ships one
+bool per slot back up-ring on the SAME inverse-ring channel the digest
+exchange uses (``inv_perm``); the sender promotes the confirmed slots
+of its own shipped copy into the window. Extraction then masks any slot
+whose content equals the confirmed ``rows`` AND whose context the
+confirmed ``ctx`` covers: the peer provably joined an identical-content
+slot under an equal-or-stronger context, its own row knowledge is
+monotone within the run, and it re-marked the row dirty at apply time
+(domain forwarding) — so the mark it minted keeps circulating and
+transitive delivery survives the masked redundant re-ship, exactly the
+digest-gate retirement argument with positive knowledge in place of
+tracking inference.
+
+Content equality is required, not just context coverage: a sender-side
+removal of an acked dot does NOT grow the slot context (the dot was
+already accounted), so a context-only window would mask the removal —
+the same failure class the PR 3 wider gate had. The ``rows`` plane is
+what makes removals maskable at all: once the peer confirms the
+post-removal content, the steady-state re-circulation of that removal
+masks too.
+
+Under ``faults=`` the data packet's fate decides the bits (dropped /
+rejected / held packets confirm nothing — delayed deliveries are
+conservatively never acked), and the ack lane itself rides the
+un-faulted inverse channel like the digest exchange: a lost ack only
+costs bandwidth, a forged ack could drop a needed δ, so the lane is
+kept outside the injector's blast radius by construction.
+
+The window lives in the loop carry and dies with the run — like the
+per-run ``fctx``, whose receiver-side monotonicity is exactly what the
+masking argument leans on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class AckWindowKey(NamedTuple):
+    """The jit-cache key marker for ack-window-bearing ring programs.
+
+    An acked ring is a DIFFERENT traced program (an extra ack ppermute
+    per round), so ``analysis.jit_lint._cached_entry_fn`` must skip
+    cache entries carrying this marker exactly as it skips FaultPlan
+    keys — otherwise an acked run would poison the flags-off jaxpr the
+    aliasing/cost/lint gates read (the PR 8 poisoning class, pinned by
+    tests/test_delta_opt.py)."""
+
+    on: bool = True
+
+
+class AckWindow(NamedTuple):
+    """One link's acked-interval watermark (per device, per ring run)."""
+
+    rows: Any         # confirmed content planes, [E, ...]
+    ctx: jax.Array    # [E, A] — join of confirmed slot contexts
+    ackd: jax.Array   # [E] bool — rows ever positively confirmed
+
+
+def _core(pkt):
+    """The leaf slot packet (wrapper packets nest it first — the
+    telemetry.packet_useful_bytes convention)."""
+    return pkt if hasattr(pkt, "idx") else _core(pkt[0])
+
+
+def _with_core(pkt, core):
+    if hasattr(pkt, "idx"):
+        return core
+    return pkt._replace(**{pkt._fields[0]: _with_core(pkt[0], core)})
+
+
+def _content_names(core) -> tuple:
+    """The slot content field names: every field except the slot
+    bookkeeping (``idx``/``valid``/``ctxs``) and the whole-riding parked
+    groups (``[prefix]d{cl,mask,keys,valid}``)."""
+    names = core._fields
+    parked = set()
+    for f in names:
+        if f.endswith("dvalid"):
+            pref = f[: -len("dvalid")]
+            parked |= {
+                pref + s
+                for s in ("dcl", "dmask", "dkeys", "dvalid")
+                if pref + s in names
+            }
+    return tuple(
+        f for f in names if f not in parked and f not in ("idx", "valid", "ctxs")
+    )
+
+
+def _content(core):
+    return tuple(getattr(core, f) for f in _content_names(core))
+
+
+def init_window(pkt_shape, n_rows: int) -> AckWindow:
+    """The empty window for a row universe of ``n_rows``, shaped from
+    the packet's slot planes (``pkt_shape`` from ``jax.eval_shape``)."""
+    core = _core(pkt_shape)
+    rows = jax.tree.map(
+        lambda a: jnp.zeros((n_rows,) + tuple(a.shape[1:]), a.dtype),
+        _content(core),
+    )
+    ctx = jnp.zeros((n_rows,) + tuple(core.ctxs.shape[1:]), core.ctxs.dtype)
+    return AckWindow(rows=rows, ctx=ctx, ackd=jnp.zeros((n_rows,), bool))
+
+
+def gate_window(pkt, win: AckWindow):
+    """Mask every slot the ack window covers: content identical to the
+    confirmed rows AND context covered by the confirmed ctx, on a row
+    the peer has positively acked. Masked slots are zeroed so the
+    packet stays canonical (``bytes_useful`` honest); the wire shape is
+    unchanged. Returns ``(packet, covered_mask)``."""
+    core = _core(pkt)
+    gath = lambda x: jnp.take(x, core.idx, axis=0)
+    same = None
+    for w, p in zip(
+        jax.tree.leaves(jax.tree.map(gath, win.rows)),
+        jax.tree.leaves(_content(core)),
+    ):
+        eq = jnp.all((w == p).reshape(p.shape[0], -1), axis=-1)
+        same = eq if same is None else same & eq
+    covered = (
+        core.valid
+        & gath(win.ackd)
+        & same
+        & jnp.all(core.ctxs <= gath(win.ctx), axis=-1)
+    )
+    keep = core.valid & ~covered
+    zero = lambda x: jnp.where(
+        keep.reshape((-1,) + (1,) * (x.ndim - 1)), x, jnp.zeros_like(x)
+    )
+    masked = core._replace(
+        valid=keep,
+        ctxs=jnp.where(keep[:, None], core.ctxs, 0),
+        **{
+            f: jax.tree.map(zero, getattr(core, f))
+            for f in _content_names(core)
+        },
+    )
+    return _with_core(pkt, masked), covered
+
+
+def ack_bits(pkt, keep=None) -> jax.Array:
+    """The receiver's per-slot confirmation for one applied packet:
+    slots actually joined this round (``keep`` is the faulted-run fate;
+    None = reliable delivery, every valid slot applied)."""
+    valid = _core(pkt).valid
+    return valid if keep is None else valid & keep
+
+
+def update_window(win: AckWindow, sent, bits: jax.Array) -> AckWindow:
+    """Promote the confirmed slots of the sender's own shipped copy into
+    the window (``bits`` is the peer's ack for ``sent``, back-propagated
+    one inverse hop): rows adopt the confirmed content, ctx joins the
+    confirmed context, ackd latches."""
+    core = _core(sent)
+    ok = core.valid & bits
+    idx = core.idx
+
+    def scat(w, p):
+        old = jnp.take(w, idx, axis=0)
+        sel = ok.reshape((-1,) + (1,) * (p.ndim - 1))
+        return w.at[idx].set(jnp.where(sel, p, old))
+
+    rows = jax.tree.map(scat, win.rows, _content(core))
+    old_ctx = jnp.take(win.ctx, idx, axis=0)
+    ctx = win.ctx.at[idx].set(
+        jnp.where(ok[:, None], jnp.maximum(old_ctx, core.ctxs), old_ctx)
+    )
+    ackd = win.ackd.at[idx].set(jnp.take(win.ackd, idx) | ok)
+    return AckWindow(rows=rows, ctx=ctx, ackd=ackd)
+
+
+def window_depth(win: AckWindow) -> jax.Array:
+    """Rows with a live acked watermark (the ``ack_window_depth``
+    telemetry gauge, per device — the ring pmaxes it)."""
+    return jnp.sum(win.ackd, dtype=jnp.uint32)
+
+
+def slot_bytes(pkt) -> int:
+    """STATIC per-slot byte price of one packet's maskable planes (the
+    content fields plus the ctx row — what a window-masked slot stops
+    shipping, the ``bytes_acked_skipped`` unit). Shapes are static under
+    tracing, so this is a Python int even in-kernel."""
+    core = _core(pkt)
+    c = max(core.idx.shape[0], 1)
+    per = sum(
+        (leaf.size // c) * leaf.dtype.itemsize
+        for f in _content_names(core)
+        for leaf in jax.tree.leaves(getattr(core, f))
+    )
+    return per + (core.ctxs.size // c) * core.ctxs.dtype.itemsize

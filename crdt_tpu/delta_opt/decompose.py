@@ -1,0 +1,176 @@
+"""Join-irreducible decomposition — the minimal-δ half of Enes et al.
+
+"Efficient Synchronization of State-based CRDTs" (PAPERS.md,
+arXiv 1803.02750) replaces whole-state shipping with a **minimal
+irredundant join decomposition**: split a state's inflation over a known
+lower bound ``since`` into join-irreducible δ lanes so a link ships only
+what the peer provably lacks. The TPU translation keeps static shapes:
+every kind's state is split into its **row planes** (the per-unit lanes
+of its content — dense ORSWOT element rows, map key cells, sparse
+segment lanes) and a **residual** (the top clock and the bounded parked
+buffers, which are already minimal-by-construction and ride whole), and
+a :class:`Decomposition` is the row planes masked down to the lanes that
+actually differ from ``since``:
+
+- ``lanes``   — the row-plane pytree with a leading lane axis ``L``,
+  zeroed outside ``valid`` (canonical, so byte accounting is honest);
+- ``valid``   — the changed-lane mask: lane ℓ is emitted iff its row
+  content differs from ``since``'s row ℓ (positional diff — always
+  exact, and tight whenever growth appends, which is how every sparse
+  kind canonicalizes);
+- ``residual``— the non-row planes of the source state (top clock,
+  parked-remove buffers), riding whole.
+
+``reconstruct(since, d)`` scatters the valid lanes back over ``since``'s
+rows and adopts the residual — reproducing the source state **bit-
+exactly**; recomposition against an arbitrary peer is then the kind's
+own registered join applied to the reconstruction, which is how the
+post-heal resync driver (:mod:`.heal`) stays bit-identical to full-state
+gossip while shipping only the divergence set.
+
+Two laws pin every registered decomposition (analysis/laws.py, the
+``decomp`` section of tools/run_static_checks.py):
+
+- **reconstruction**  ``join(decompose(s, since)) ⊔ since == s`` —
+  the lanes joined over ``since`` reproduce ``s`` (bit-exact on the
+  kind's canonical form);
+- **irredundancy**    no valid lane is covered by the join of the
+  others — dropping ANY single lane must break reconstruction (this
+  also enforces minimality: a lane emitted for an unchanged row would
+  drop harmlessly and fail the law).
+
+Why rows + clock-residual rather than single-dot irreducibles: the
+paper's ⊕-decomposition lives in the dot-store formalism where causal
+contexts are dot SETS. The dense/sparse encodings here compress contexts
+to per-actor prefix clocks (SURVEY §7.1), under which a single dot's
+exact causal past is unrepresentable — a clock context covering (a, c)
+implicitly covers (a, c') for c' < c, dots of OTHER rows (the
+delta.py inflated-context failure). A row plus the whole-state top is
+the finest decomposition the compressed encoding can express soundly;
+it is exactly the granularity the δ-ring packet algebra already ships.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Decomposition(NamedTuple):
+    """One state's irredundant join decomposition over ``since``."""
+
+    lanes: Any        # row-plane pytree, leading lane axis L (masked)
+    valid: jax.Array  # [L] bool — changed lanes
+    residual: Any     # non-row planes (top, parked buffers), ride whole
+
+
+def _lane_mask(valid: jax.Array, leaf: jax.Array) -> jax.Array:
+    return valid.reshape((-1,) + (1,) * (leaf.ndim - 1))
+
+
+def _changed_lanes(rows_a, rows_b) -> jax.Array:
+    """Per-lane OR of leaf-wise differences (reduced over every trailing
+    axis)."""
+    out = None
+    for a, b in zip(jax.tree.leaves(rows_a), jax.tree.leaves(rows_b)):
+        neq = jnp.any((a != b).reshape(a.shape[0], -1), axis=-1)
+        out = neq if out is None else out | neq
+    return out
+
+
+def decompose_rows(state, since, split) -> Decomposition:
+    """The generic row-diff decomposition: ``split(state)`` yields
+    ``(rows, residual)`` with a shared leading lane axis on every row
+    leaf; a lane is emitted iff it differs from ``since``'s. Pure
+    where/select on static shapes — jit/vmap/shard_map safe."""
+    rows_s, res = split(state)
+    rows_o, _ = split(since)
+    valid = _changed_lanes(rows_s, rows_o)
+    lanes = jax.tree.map(
+        lambda x: jnp.where(_lane_mask(valid, x), x, jnp.zeros_like(x)),
+        rows_s,
+    )
+    return Decomposition(lanes=lanes, valid=valid, residual=res)
+
+
+def reconstruct_rows(since, d: Decomposition, split, unsplit):
+    """Join the decomposition's lanes over ``since``: valid lanes
+    replace ``since``'s rows positionally, the residual is adopted
+    whole. For ``since <= s`` this reproduces ``s`` bit-exactly (the
+    reconstruction law)."""
+    rows_o, _ = split(since)
+    rows = jax.tree.map(
+        lambda lane, old: jnp.where(_lane_mask(d.valid, lane), lane, old),
+        d.lanes,
+        rows_o,
+    )
+    return unsplit(rows, d.residual)
+
+
+def drop_lane(d: Decomposition, lane: int) -> Decomposition:
+    """The decomposition minus one lane (the irredundancy law's probe):
+    invalidate and zero lane ``lane``."""
+    valid = d.valid.at[lane].set(False)
+    lanes = jax.tree.map(
+        lambda x: jnp.where(_lane_mask(valid, x), x, jnp.zeros_like(x)),
+        d.lanes,
+    )
+    return Decomposition(lanes=lanes, valid=valid, residual=d.residual)
+
+
+# ---- registry-facing dispatchers -----------------------------------------
+
+def _get(dec_or_kind):
+    if isinstance(dec_or_kind, str):
+        from ..analysis.registry import get_decomposer
+
+        return get_decomposer(dec_or_kind)
+    return dec_or_kind
+
+
+def decompose(dec_or_kind, state, since) -> Decomposition:
+    """Decompose ``state`` over ``since`` via a registered kind name or
+    a :class:`~crdt_tpu.analysis.registry.Decomposer` (fixtures pass
+    broken twins directly)."""
+    dec = _get(dec_or_kind)
+    if dec.decompose is not None:
+        return dec.decompose(state, since)
+    return decompose_rows(state, since, dec.split)
+
+
+def reconstruct(dec_or_kind, since, d: Decomposition):
+    dec = _get(dec_or_kind)
+    if dec.reconstruct is not None:
+        return dec.reconstruct(since, d)
+    return reconstruct_rows(since, d, dec.split, dec.unsplit)
+
+
+# ---- byte accounting ------------------------------------------------------
+
+def lane_bytes(d: Decomposition) -> int:
+    """STATIC per-lane byte count of the row planes (shapes are static
+    under tracing, so this is a Python int even in-kernel)."""
+    n = max(d.valid.shape[-1], 1)
+    return sum(
+        (leaf.size // n) * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(d.lanes)
+    )
+
+
+def residual_bytes(d: Decomposition) -> int:
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(d.residual)
+    ) + d.valid.size * d.valid.dtype.itemsize
+
+
+def decomposition_bytes(d: Decomposition) -> jax.Array:
+    """DYNAMIC shipped-payload bytes of one decomposition: valid lanes
+    priced at the static per-lane width, plus the residual and the
+    validity mask riding whole (the ``bytes_useful`` convention)."""
+    return (
+        jnp.sum(d.valid, dtype=jnp.float32) * lane_bytes(d)
+        + jnp.float32(residual_bytes(d))
+    )

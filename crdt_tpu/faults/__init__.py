@@ -23,6 +23,23 @@ Plus :mod:`.scenarios` (the shared host-side fault-schedule generators
 the test suites draw from) and :func:`static_checks` — the ``faults``
 section of tools/run_static_checks.py: fault-surface registry coverage
 and the broken-fixture detector gates.
+
+**Healing a degraded run.** A lossy ring returns every rank's rows as
+valid partial states with the certificate voided; two state-driven
+resync modes re-converge them (both land bit-identical on the
+fault-free fixpoint):
+
+- full-state gossip over the returned rows (``mesh_gossip(rows,
+  mesh)``) — no prerequisites, ships P whole states; the historical
+  path and still the REJOIN contract for an evicted rank (its
+  divergence has no usable lower bound);
+- decomposition resync (:func:`resync`, re-exported from
+  ``crdt_tpu.delta_opt.heal``) — each rank ships only its minimal
+  irredundant join decomposition over a pre-divergence snapshot
+  ``since`` (any mutually-known lower bound, e.g. the last certified
+  fixpoint), so a partition that diverged by a handful of rows heals
+  for a fraction of full-state bytes (``bench.py --heal`` measures
+  the ratio; the reconstruction law pins exactness per kind).
 """
 
 from __future__ import annotations
@@ -51,6 +68,12 @@ from .integrity import checksum, checksum_detects, verify
 from .membership import Membership, validate_perm
 from .retry import DcnExchangeFailed, RetryPolicy, with_retries
 from . import scenarios  # noqa: F401  (re-export the schedule generators)
+
+# The bandwidth-optimal heal path (module docstring): decomposition
+# resync lives in crdt_tpu/delta_opt/ (it is pure δ machinery), but the
+# operator reaches for it from here, next to the fault plans that made
+# it necessary.
+from ..delta_opt.heal import ResyncReport, resync
 
 
 def static_checks() -> List:
@@ -124,10 +147,10 @@ def static_checks() -> List:
 
 __all__ = [
     "DcnExchangeFailed", "FaultCounters", "FaultPlan", "Membership",
-    "RetryPolicy", "accumulate_counters", "block_wire", "checksum",
-    "checksum_detects", "combine_counters", "corrupt_tree",
+    "ResyncReport", "RetryPolicy", "accumulate_counters", "block_wire",
+    "checksum", "checksum_detects", "combine_counters", "corrupt_tree",
     "counters_specs", "evicted_mask", "inv_ring_perm", "receive_wire",
-    "record", "ring_perm", "round_faults", "scenarios", "sender_of",
-    "static_checks", "tick_counters", "tree_select", "validate_perm",
-    "verify", "with_retries",
+    "record", "resync", "ring_perm", "round_faults", "scenarios",
+    "sender_of", "static_checks", "tick_counters", "tree_select",
+    "validate_perm", "verify", "with_retries",
 ]

@@ -50,7 +50,23 @@ def _law_states():
     ]
 
 
-from ..analysis.registry import register_compactor, register_merge  # noqa: E402
+def _decomp_split(s: jax.Array):
+    """Decomposition granularity (delta_opt/): one δ lane per member's
+    presence bit — the G-Set's join-irreducibles ARE its singletons; no
+    residual."""
+    return (s,), ()
+
+
+def _decomp_unsplit(rows, res) -> jax.Array:
+    (present,) = rows
+    return present
+
+
+from ..analysis.registry import (  # noqa: E402
+    register_compactor,
+    register_decomposition,
+    register_merge,
+)
 from ..reclaim.compaction import _noop_compact  # noqa: E402
 
 register_merge("gset", module=__name__, join=join, states=_law_states)
@@ -59,4 +75,7 @@ register_merge("gset", module=__name__, join=join, states=_law_states)
 register_compactor(
     "gset", module=__name__, compact=_noop_compact, observe=lambda s: s,
     top_of=None,
+)
+register_decomposition(
+    "gset", module=__name__, split=_decomp_split, unsplit=_decomp_unsplit,
 )

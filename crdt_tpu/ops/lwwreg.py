@@ -110,7 +110,22 @@ def _law_states():
     return states
 
 
-from ..analysis.registry import register_compactor, register_merge  # noqa: E402
+def _decomp_split(s: LWWState):
+    """Decomposition granularity (delta_opt/): ONE lane — a register's
+    single surviving write is itself join-irreducible (max-marker select
+    cannot be split finer); no residual."""
+    return jax.tree.map(lambda x: x[None], s), ()
+
+
+def _decomp_unsplit(rows, res) -> LWWState:
+    return jax.tree.map(lambda x: x[0], rows)
+
+
+from ..analysis.registry import (  # noqa: E402
+    register_compactor,
+    register_decomposition,
+    register_merge,
+)
 from ..reclaim.compaction import _noop_compact  # noqa: E402
 
 register_merge("lwwreg", module=__name__, join=join, states=_law_states)
@@ -119,4 +134,7 @@ register_merge("lwwreg", module=__name__, join=join, states=_law_states)
 register_compactor(
     "lwwreg", module=__name__, compact=_noop_compact, observe=lambda s: s,
     top_of=None,
+)
+register_decomposition(
+    "lwwreg", module=__name__, split=_decomp_split, unsplit=_decomp_unsplit,
 )

@@ -519,7 +519,22 @@ def _observe(s: MapState):
     return (cc.val, cc.valid)
 
 
-from ..analysis.registry import register_compactor, register_merge  # noqa: E402
+def _decomp_split(s: MapState):
+    """Decomposition granularity (delta_opt/): one δ lane per key's
+    content-slot row group; top + parked keyset buffer residual."""
+    return s.child, (s.top, s.dcl, s.dkeys, s.dvalid)
+
+
+def _decomp_unsplit(rows, res) -> MapState:
+    top, dcl, dkeys, dvalid = res
+    return MapState(top=top, child=rows, dcl=dcl, dkeys=dkeys, dvalid=dvalid)
+
+
+from ..analysis.registry import (  # noqa: E402
+    register_compactor,
+    register_decomposition,
+    register_merge,
+)
 
 register_merge(
     "map", module=__name__, join=join, states=_law_states,
@@ -528,4 +543,7 @@ register_merge(
 register_compactor(
     "map", module=__name__, compact=compact, observe=_observe,
     top_of=lambda s: s.top,
+)
+register_decomposition(
+    "map", module=__name__, split=_decomp_split, unsplit=_decomp_unsplit,
 )

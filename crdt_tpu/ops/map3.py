@@ -228,7 +228,32 @@ def _observe(s: Map3State):
     return mo_ops._observe(s.mo)
 
 
-from ..analysis.registry import register_compactor, register_merge  # noqa: E402
+def _decomp_split(s: Map3State):
+    """Decomposition granularity (delta_opt/): one δ lane per flat
+    (k1, k2, member) birth-clock row; top + both parked levels residual."""
+    c = s.mo.core
+    return (c.ctr,), (
+        c.top, c.dcl, c.dmask, c.dvalid,
+        s.mo.kdcl, s.mo.kdkeys, s.mo.kdvalid,
+        s.odcl, s.odkeys, s.odvalid,
+    )
+
+
+def _decomp_unsplit(rows, res) -> Map3State:
+    (ctr,) = rows
+    top, dcl, dmask, dvalid, kdcl, kdkeys, kdvalid, odcl, odkeys, odvalid = res
+    core = mo_ops.core_ops.OrswotState(
+        top=top, ctr=ctr, dcl=dcl, dmask=dmask, dvalid=dvalid
+    )
+    mo = MapOrswotState(core=core, kdcl=kdcl, kdkeys=kdkeys, kdvalid=kdvalid)
+    return Map3State(mo=mo, odcl=odcl, odkeys=odkeys, odvalid=odvalid)
+
+
+from ..analysis.registry import (  # noqa: E402
+    register_compactor,
+    register_decomposition,
+    register_merge,
+)
 
 register_merge(
     "map3", module=__name__, join=join, states=_law_states,
@@ -237,4 +262,7 @@ register_merge(
 register_compactor(
     "map3", module=__name__, compact=compact, observe=_observe,
     top_of=lambda s: s.mo.core.top,
+)
+register_decomposition(
+    "map3", module=__name__, split=_decomp_split, unsplit=_decomp_unsplit,
 )

@@ -197,7 +197,26 @@ def _observe(s: NestedMapState):
     return core_ops._observe(s.m)
 
 
-from ..analysis.registry import register_compactor, register_merge  # noqa: E402
+def _decomp_split(s: NestedMapState):
+    """Decomposition granularity (delta_opt/): one δ lane per flat
+    (k1, k2) content-slot row group; top + both parked levels residual."""
+    return s.m.child, (
+        s.m.top, s.m.dcl, s.m.dkeys, s.m.dvalid,
+        s.odcl, s.odkeys, s.odvalid,
+    )
+
+
+def _decomp_unsplit(rows, res) -> NestedMapState:
+    top, dcl, dkeys, dvalid, odcl, odkeys, odvalid = res
+    m = MapState(top=top, child=rows, dcl=dcl, dkeys=dkeys, dvalid=dvalid)
+    return NestedMapState(m=m, odcl=odcl, odkeys=odkeys, odvalid=odvalid)
+
+
+from ..analysis.registry import (  # noqa: E402
+    register_compactor,
+    register_decomposition,
+    register_merge,
+)
 
 register_merge(
     "map_map", module=__name__, join=join, states=_law_states,
@@ -206,4 +225,7 @@ register_merge(
 register_compactor(
     "map_map", module=__name__, compact=compact, observe=_observe,
     top_of=lambda s: s.m.top,
+)
+register_decomposition(
+    "map_map", module=__name__, split=_decomp_split, unsplit=_decomp_unsplit,
 )

@@ -216,7 +216,30 @@ def _observe(s: MapOrswotState):
     return core_ops._present(s.core.ctr)
 
 
-from ..analysis.registry import register_compactor, register_merge  # noqa: E402
+def _decomp_split(s: MapOrswotState):
+    """Decomposition granularity (delta_opt/): one δ lane per flat
+    (key, member) birth-clock row of the core slab; top + both parked
+    buffers residual."""
+    c = s.core
+    return (c.ctr,), (
+        c.top, c.dcl, c.dmask, c.dvalid, s.kdcl, s.kdkeys, s.kdvalid,
+    )
+
+
+def _decomp_unsplit(rows, res) -> MapOrswotState:
+    (ctr,) = rows
+    top, dcl, dmask, dvalid, kdcl, kdkeys, kdvalid = res
+    core = core_ops.OrswotState(
+        top=top, ctr=ctr, dcl=dcl, dmask=dmask, dvalid=dvalid
+    )
+    return MapOrswotState(core=core, kdcl=kdcl, kdkeys=kdkeys, kdvalid=kdvalid)
+
+
+from ..analysis.registry import (  # noqa: E402
+    register_compactor,
+    register_decomposition,
+    register_merge,
+)
 
 register_merge(
     "map_orswot", module=__name__, join=join, states=_law_states,
@@ -225,4 +248,8 @@ register_merge(
 register_compactor(
     "map_orswot", module=__name__, compact=compact, observe=_observe,
     top_of=lambda s: s.core.top,
+)
+register_decomposition(
+    "map_orswot", module=__name__, split=_decomp_split,
+    unsplit=_decomp_unsplit,
 )

@@ -274,7 +274,22 @@ def _observe(s: MVRegState):
     return (c.val, c.valid)
 
 
-from ..analysis.registry import register_compactor, register_merge  # noqa: E402
+def _decomp_split(s: MVRegState):
+    """Decomposition granularity (delta_opt/): one δ lane per sibling
+    slot — a slot's (witness dot, clock, value) is one concurrent write,
+    the register's join-irreducible unit; no residual."""
+    return s, ()
+
+
+def _decomp_unsplit(rows, res) -> MVRegState:
+    return rows
+
+
+from ..analysis.registry import (  # noqa: E402
+    register_compactor,
+    register_decomposition,
+    register_merge,
+)
 
 register_merge(
     "mvreg", module=__name__, join=join, states=_law_states,
@@ -283,4 +298,7 @@ register_merge(
 register_compactor(
     "mvreg", module=__name__, compact=compact, observe=_observe,
     top_of=None,
+)
+register_decomposition(
+    "mvreg", module=__name__, split=_decomp_split, unsplit=_decomp_unsplit,
 )

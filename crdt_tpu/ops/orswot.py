@@ -512,7 +512,25 @@ def _observe(s: OrswotState) -> jax.Array:
     return _present(s.ctr)
 
 
-from ..analysis.registry import register_compactor, register_merge  # noqa: E402
+def _decomp_split(s: OrswotState):
+    """Join-irreducible decomposition granularity (delta_opt/): one δ
+    lane per element birth-clock row; the top clock and the bounded
+    parked-remove buffer are the residual (a clock-compressed context
+    cannot be split finer — see delta_opt.decompose)."""
+    return (s.ctr,), (s.top, s.dcl, s.dmask, s.dvalid)
+
+
+def _decomp_unsplit(rows, res) -> OrswotState:
+    (ctr,) = rows
+    top, dcl, dmask, dvalid = res
+    return OrswotState(top=top, ctr=ctr, dcl=dcl, dmask=dmask, dvalid=dvalid)
+
+
+from ..analysis.registry import (  # noqa: E402
+    register_compactor,
+    register_decomposition,
+    register_merge,
+)
 
 register_merge(
     "orswot", module=__name__, join=join, states=_law_states,
@@ -521,4 +539,7 @@ register_merge(
 register_compactor(
     "orswot", module=__name__, compact=compact, observe=_observe,
     top_of=lambda s: s.top,
+)
+register_decomposition(
+    "orswot", module=__name__, split=_decomp_split, unsplit=_decomp_unsplit,
 )

@@ -715,7 +715,29 @@ def _observe(s: SparseMVMapState):
     )
 
 
-from ..analysis.registry import register_compactor, register_merge  # noqa: E402
+def _decomp_split(s: SparseMVMapState):
+    """Decomposition granularity (delta_opt/): one δ lane per cell-table
+    lane (positional, like sparse_orswot); top + parked buffer residual."""
+    return (
+        (s.kid, s.act, s.ctr, s.val, s.clk, s.valid),
+        (s.top, s.dcl, s.kidx, s.dvalid),
+    )
+
+
+def _decomp_unsplit(rows, res) -> SparseMVMapState:
+    kid, act, ctr, val, clk, valid = rows
+    top, dcl, kidx, dvalid = res
+    return SparseMVMapState(
+        top=top, kid=kid, act=act, ctr=ctr, val=val, clk=clk, valid=valid,
+        dcl=dcl, kidx=kidx, dvalid=dvalid,
+    )
+
+
+from ..analysis.registry import (  # noqa: E402
+    register_compactor,
+    register_decomposition,
+    register_merge,
+)
 
 register_merge(
     "sparse_mvmap", module=__name__, join=join, states=_law_states,
@@ -724,4 +746,8 @@ register_merge(
 register_compactor(
     "sparse_mvmap", module=__name__, compact=compact, observe=_observe,
     top_of=lambda s: s.top,
+)
+register_decomposition(
+    "sparse_mvmap", module=__name__, split=_decomp_split,
+    unsplit=_decomp_unsplit,
 )

@@ -746,7 +746,44 @@ def _observe(s: SparseNestState):
     return _leaf_observe(leaf)
 
 
-from ..analysis.registry import register_compactor, register_merge  # noqa: E402
+def _decomp_split(s: SparseNestState):
+    """Decomposition granularity (delta_opt/): one δ lane per LEAF-slab
+    table lane (recursing through inner levels down to the ORSWOT
+    segment table or the register-map cell table); every level's parked
+    keylist buffer plus the leaf residual ride whole. The level stack is
+    encoded positionally in the residual, so ``_decomp_unsplit`` can
+    rebuild the nest without a type tag (leaf arity disambiguates the
+    two leaf slabs)."""
+    levels = []
+    core = s
+    while isinstance(core, SparseNestState):
+        levels.append((core.kcl, core.kidx, core.kdvalid))
+        core = core.core
+    if hasattr(core, "eid"):
+        from .sparse_orswot import _decomp_split as _leaf_split
+    else:
+        from .sparse_mvmap import _decomp_split as _leaf_split
+    rows, leaf_res = _leaf_split(core)
+    return rows, (tuple(levels), leaf_res)
+
+
+def _decomp_unsplit(rows, res) -> SparseNestState:
+    levels, leaf_res = res
+    if len(rows) == 4:  # (eid, act, ctr, valid) — the ORSWOT leaf
+        from .sparse_orswot import _decomp_unsplit as _leaf_unsplit
+    else:  # 6 planes — the register-map cell leaf (ops/sparse_mvmap.py)
+        from .sparse_mvmap import _decomp_unsplit as _leaf_unsplit
+    core = _leaf_unsplit(rows, leaf_res)
+    for kcl, kidx, kdvalid in reversed(levels):
+        core = SparseNestState(core=core, kcl=kcl, kidx=kidx, kdvalid=kdvalid)
+    return core
+
+
+from ..analysis.registry import (  # noqa: E402
+    register_compactor,
+    register_decomposition,
+    register_merge,
+)
 
 register_merge(
     "sparse_nested_map", module=__name__, join=_law_join,
@@ -761,4 +798,8 @@ def _top_of(s):
 register_compactor(
     "sparse_nested_map", module=__name__, compact=compact,
     observe=_observe, top_of=_top_of,
+)
+register_decomposition(
+    "sparse_nested_map", module=__name__, split=_decomp_split,
+    unsplit=_decomp_unsplit,
 )

@@ -755,7 +755,27 @@ def _observe(s: SparseOrswotState):
     return jnp.where(member == _INT32_MAX, -1, member)
 
 
-from ..analysis.registry import register_compactor, register_merge  # noqa: E402
+def _decomp_split(s: SparseOrswotState):
+    """Decomposition granularity (delta_opt/): one δ lane per segment-
+    table dot lane (positional — canonical order keeps the diff tight
+    under append-style growth); top + parked buffer residual."""
+    return (s.eid, s.act, s.ctr, s.valid), (s.top, s.dcl, s.didx, s.dvalid)
+
+
+def _decomp_unsplit(rows, res) -> SparseOrswotState:
+    eid, act, ctr, valid = rows
+    top, dcl, didx, dvalid = res
+    return SparseOrswotState(
+        top=top, eid=eid, act=act, ctr=ctr, valid=valid,
+        dcl=dcl, didx=didx, dvalid=dvalid,
+    )
+
+
+from ..analysis.registry import (  # noqa: E402
+    register_compactor,
+    register_decomposition,
+    register_merge,
+)
 
 register_merge(
     "sparse_orswot", module=__name__, join=join, states=_law_states,
@@ -764,4 +784,8 @@ register_merge(
 register_compactor(
     "sparse_orswot", module=__name__, compact=compact, observe=_observe,
     top_of=lambda s: s.top,
+)
+register_decomposition(
+    "sparse_orswot", module=__name__, split=_decomp_split,
+    unsplit=_decomp_unsplit,
 )

@@ -133,7 +133,23 @@ def _law_states():
     ]
 
 
-from ..analysis.registry import register_compactor, register_merge  # noqa: E402
+def _decomp_split(s: jax.Array):
+    """Decomposition granularity (delta_opt/): one δ lane per actor
+    counter — a clock's join-irreducibles are its per-actor dots, and
+    the lane diff ships exactly the advanced actors; no residual."""
+    return (s,), ()
+
+
+def _decomp_unsplit(rows, res) -> jax.Array:
+    (counters,) = rows
+    return counters
+
+
+from ..analysis.registry import (  # noqa: E402
+    register_compactor,
+    register_decomposition,
+    register_merge,
+)
 from ..reclaim.compaction import _noop_compact  # noqa: E402
 
 register_merge("vclock", module=__name__, join=merge, states=_law_states)
@@ -144,4 +160,7 @@ register_merge("vclock", module=__name__, join=merge, states=_law_states)
 register_compactor(
     "vclock", module=__name__, compact=_noop_compact, observe=lambda s: s,
     top_of=None,
+)
+register_decomposition(
+    "vclock", module=__name__, split=_decomp_split, unsplit=_decomp_unsplit,
 )

@@ -116,6 +116,10 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         faults_dropped=jnp.zeros((), jnp.uint32),
         faults_rejected=jnp.zeros((), jnp.uint32),
         faults_delayed=jnp.zeros((), jnp.uint32),
+        # The ack-window fields are zero unless the δ ring's
+        # ack_window= path fills them in (delta_ring's _replace).
+        bytes_acked_skipped=jnp.zeros((), jnp.float32),
+        ack_window_depth=jnp.zeros((), jnp.uint32),
     )
 
 

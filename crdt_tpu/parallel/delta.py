@@ -207,7 +207,13 @@ def gate_delta(pkt: DeltaPacket, digest: jax.Array) -> DeltaPacket:
     """Digest gate: invalidate packet slots that provably cannot change
     the receiver, judged against the receiver's digest clock (its
     frozen local-fold top, shipped once before the ring by
-    ``run_delta_ring``). A slot is redundant only when BOTH hold:
+    ``run_delta_ring``). This is the FIRST of two redundancy layers —
+    stateless top inference, no round-trip memory, fires from round 0;
+    the second is the per-link ack window (``ack_window=True``,
+    crdt_tpu/delta_opt/ackwin.py), which masks what the peer has
+    POSITIVELY confirmed joining — including the removal-carrying slots
+    this gate must always ship. A slot is redundant here only when BOTH
+    hold:
 
     - ``ctxs == rows`` lane-wise — the slot attests NO removals: every
       dot its context accounts for is live in its row. A context lane
@@ -268,6 +274,7 @@ def mesh_delta_gossip(
     digest: bool = True,
     donate: bool = False,
     faults=None,
+    ack_window=False,
 ):
     """Ring δ anti-entropy over the mesh: each device folds its local
     replica block (OR-folding dirty, max-folding contexts), then runs
@@ -316,7 +323,12 @@ def mesh_delta_gossip(
     and appends a ``FaultCounters`` pytree LAST — lost packets force
     ``residue >= 1`` and suppress the top closure, so degraded rows
     stay valid partial states for state-driven resync
-    (delta_ring.run_delta_ring documents the semantics)."""
+    (delta_ring.run_delta_ring documents the semantics).
+    ``ack_window=True`` layers the per-link acked-interval mask over
+    the digest gate — the peer's positive confirmations retire
+    re-circulated δs INCLUDING removals (crdt_tpu/delta_opt/ackwin.py;
+    converged states stay bit-identical, ``bytes_acked_skipped``
+    reports the win)."""
     from ..ops.pallas_kernels import fold_auto
     from .delta_ring import run_delta_ring
 
@@ -340,7 +352,7 @@ def mesh_delta_gossip(
         cache_extra=(local_fold,),
         telemetry=telemetry, slots_fn=changed_members,
         pipeline=pipeline, digest=digest, gate=gate_delta, donate=donate,
-        faults=faults,
+        faults=faults, ack_window=ack_window,
     )
 
 

@@ -299,6 +299,7 @@ def mesh_delta_gossip_map(
     digest: bool = True,
     donate: bool = False,
     faults=None,
+    ack_window=False,
 ):
     """Ring δ anti-entropy for Map<K, MVReg> replica batches over the
     mesh — the bandwidth-bounded mode for large key universes with local
@@ -332,7 +333,7 @@ def mesh_delta_gossip_map(
         close_top=close_top,
         telemetry=telemetry, slots_fn=map_ops.changed_keys,
         pipeline=pipeline, digest=digest, gate=gate_delta_map,
-        donate=donate, faults=faults,
+        donate=donate, faults=faults, ack_window=ack_window,
     )
 
 

@@ -64,6 +64,7 @@ def mesh_delta_gossip_map3(
     digest: bool = True,
     donate: bool = False,
     faults=None,
+    ack_window=False,
 ):
     """Ring δ anti-entropy for depth-3 map replica batches (see
     delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET
@@ -93,7 +94,7 @@ def mesh_delta_gossip_map3(
         telemetry=telemetry,
         slots_fn=lambda a, b: changed_members(a.mo.core, b.mo.core),
         pipeline=pipeline, digest=digest, gate=gate_delta_m3,
-        donate=donate, faults=faults,
+        donate=donate, faults=faults, ack_window=ack_window,
     )
 
 

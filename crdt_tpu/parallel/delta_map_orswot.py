@@ -76,6 +76,7 @@ def mesh_delta_gossip_map_orswot(
     digest: bool = True,
     donate: bool = False,
     faults=None,
+    ack_window=False,
 ):
     """Ring δ anti-entropy for Map<K, Orswot> replica batches (see
     delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET
@@ -107,7 +108,7 @@ def mesh_delta_gossip_map_orswot(
         telemetry=telemetry,
         slots_fn=lambda a, b: changed_members(a.core, b.core),
         pipeline=pipeline, digest=digest, gate=gate_delta_mo,
-        donate=donate, faults=faults,
+        donate=donate, faults=faults, ack_window=ack_window,
     )
 
 

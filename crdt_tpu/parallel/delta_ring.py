@@ -46,7 +46,25 @@ Three orthogonal performance modes (all default-on where safe):
   ``gate_delta``). ``bytes_useful`` telemetry drops to O(changed
   lanes) while the wire shape (``bytes_exchanged``) stays static.
 
-A fourth, non-performance mode is ``faults=`` (a
+- ``ack_window=True`` — **ack-window back-propagation**
+  (crdt_tpu/delta_opt/ackwin.py, Enes et al. 1803.02750 §4.2): each
+  receiver ships one bool per applied packet slot back up-ring on the
+  same inverse-ring channel the digest exchange uses; the sender
+  promotes the confirmed slots into a per-link acked-interval window
+  and masks every later δ whose content the peer has POSITIVELY
+  confirmed joining under an equal-or-stronger context — including
+  removals, which the stateless top digest can never vouch for (acks
+  are positive knowledge of delivered content, not top inference, so
+  the PR 3 wider-gate unsoundness does not arise). Layering: the
+  digest gate needs no round-trip state and fires from round 0; the
+  ack window needs per-link memory and starts paying once re-
+  circulated knowledge comes back around — together they generalize
+  ``gate_delta`` from "add-only slots under the frozen top" to
+  arbitrary covered intervals. Converged states stay bit-identical;
+  ``bytes_useful`` drops further and ``bytes_acked_skipped`` /
+  ``ack_window_depth`` report the window's win (telemetry.py).
+
+A fifth, non-performance mode is ``faults=`` (a
 ``crdt_tpu.faults.FaultPlan``, default None): seeded in-kernel fault
 injection on every inbound link — drop / corrupt / delay draws minted
 from ``jax.random`` inside the loop, an integrity checksum lane riding
@@ -83,6 +101,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import telemetry as tele
+from ..delta_opt import ackwin as _ackwin
 from ..utils.metrics import metrics, state_nbytes
 from .mesh import ELEMENT_AXIS, REPLICA_AXIS
 
@@ -109,6 +128,7 @@ def run_delta_ring(
     gate: Optional[Callable] = None,  # (pkt, digest_clock) -> pkt
     donate: bool = False,
     faults=None,                      # crdt_tpu.faults.FaultPlan
+    ack_window=False,                 # delta_opt/ackwin.py (False/None off)
 ):
     """Run the δ ring program; ``state``/``dirty``/``fctx`` must already
     be padded to the mesh. Returns ``(states [P, ...], dirty, overflow,
@@ -157,12 +177,23 @@ def run_delta_ring(
     ``faults.FaultCounters`` pytree is appended as the LAST output
     (after the Telemetry pytree when both flags are on). Lost packets
     force ``residue >= 1`` and suppress top adoption — the returned
-    rows are then valid partial states awaiting state-driven resync."""
+    rows are then valid partial states awaiting state-driven resync.
+
+    ``ack_window=True`` (module docstring; crdt_tpu/delta_opt/ackwin.py)
+    adds the per-link acked-interval window: one bool-per-slot ack
+    ppermute per round on the inverse channel, sender-side masking of
+    positively confirmed δs. Output arity is unchanged — the window
+    lives and dies in the loop carry; its win shows up in
+    ``bytes_useful`` / ``bytes_acked_skipped`` / ``ack_window_depth``
+    under ``telemetry=True`` and the ``delta_opt.acked_skipped[.kind]``
+    registry twins. Off (the default) traces the byte-identical
+    pre-flag program, like every other mode flag."""
     from .anti_entropy import _cached, _ring_donate_argnums, _tel_reduced
 
     p = mesh.shape[REPLICA_AXIS]
     gated = digest and gate is not None
     faulted = faults is not None
+    acked = bool(ack_window)
     delay_mode = faulted and faults.delay > 0
     # Certificate window / propagation diameter: one hop per round
     # sequentially, one hop per two rounds pipelined (module docstring).
@@ -266,14 +297,36 @@ def run_delta_ring(
                     jnp.zeros((), jnp.uint32), jnp.zeros((), jnp.int32),
                     jnp.zeros((), jnp.int32),
                 )
-            if delay_mode:
+            if delay_mode or acked:
                 pkt_shape = jax.eval_shape(
                     lambda s, dd, ff: extract(s, dd, ff, cap, start=0)[0],
                     folded, d, f,
                 )
+            if delay_mode:
                 held0 = jax.tree.map(
                     lambda a: jnp.zeros(a.shape, a.dtype), pkt_shape
                 )
+            if acked:
+                awin0 = _ackwin.init_window(pkt_shape, d.shape[-1])
+                slot_price = jnp.float32(_ackwin.slot_bytes(pkt_shape))
+
+                def ack_exchange(awin, sent, rcvd, keep):
+                    """Back-propagate one applied packet's per-slot
+                    confirmation one inverse hop and promote the
+                    sender's own shipped copy into its window (ackwin
+                    module docstring: bits follow the DATA packet's
+                    fate, the ack lane itself rides the un-faulted
+                    inverse channel)."""
+                    bits = _ackwin.ack_bits(rcvd, keep)
+                    bits = lax.ppermute(bits, REPLICA_AXIS, inv_perm)
+                    return _ackwin.update_window(awin, sent, bits), bits
+            # Ack carry width: window (+ sender's in-flight copy under
+            # pipelining, + the skipped-bytes scalar under telemetry).
+            pipe_on = pipeline and rounds > 0
+            n_ack = (
+                ((2 if pipe_on else 1) + (1 if telemetry else 0))
+                if acked else 0
+            )
 
             def deliver_held(st, d, f, of, held, heldv):
                 """The one-round-late link buffer lands (delay faults)."""
@@ -283,9 +336,13 @@ def run_delta_ring(
 
             def round_body(r, carry):
                 if delay_mode:
-                    fc, held, heldv = carry[5 + n_tel:]
+                    fc, held, heldv = carry[5 + n_tel + n_ack:]
                 elif faulted:
-                    (fc,) = carry[5 + n_tel:]
+                    (fc,) = carry[5 + n_tel + n_ack:]
+                if acked:
+                    awin = carry[5 + n_tel]
+                    if telemetry:
+                        skip = carry[5 + n_tel + n_ack - 1]
                 if telemetry:
                     st, d, f, of, starved, slots, shipped, useful = carry[:8]
                 else:
@@ -300,6 +357,16 @@ def run_delta_ring(
                 )
                 if gated:
                     pkt = gate(pkt, rtop)
+                if acked:
+                    # Layering: the digest gate fired first (stateless
+                    # top inference); the window masks what the peer has
+                    # POSITIVELY confirmed — including removals.
+                    pkt, covered = _ackwin.gate_window(pkt, awin)
+                    sent = pkt
+                    if telemetry:
+                        skip = skip + jnp.sum(
+                            covered, dtype=jnp.float32
+                        ) * slot_price
                 pkt = ship(pkt)
                 if telemetry:
                     before = st
@@ -326,11 +393,19 @@ def run_delta_ring(
                 else:
                     st, d, f, of_r = applied
                     tail = ()
+                if acked:
+                    awin, bits = ack_exchange(awin, sent, pkt, keep)
+                    if telemetry:
+                        ab = jnp.float32(tele.shipped_bytes(bits))
+                        shipped, useful = shipped + ab, useful + ab
+                    ack_tail = (awin, skip) if telemetry else (awin,)
+                else:
+                    ack_tail = ()
                 if telemetry:
                     slots = slots + slots_of(before, st)
                     return (st, d, f, of | of_r, starved, slots, shipped,
-                            useful) + tail
-                return (st, d, f, of | of_r, starved) + tail
+                            useful) + ack_tail + tail
+                return (st, d, f, of | of_r, starved) + ack_tail + tail
 
             def pipe_body(r, carry):
                 # Double-buffered round: extract round r+1's packet
@@ -339,9 +414,13 @@ def run_delta_ring(
                 # send crosses the loop edge, so its DMA overlaps the
                 # merge kernels (module docstring; stale by one apply).
                 if delay_mode:
-                    fc, held, heldv = carry[6 + n_tel:]
+                    fc, held, heldv = carry[6 + n_tel + n_ack:]
                 elif faulted:
-                    (fc,) = carry[6 + n_tel:]
+                    (fc,) = carry[6 + n_tel + n_ack:]
+                if acked:
+                    awin, sent = carry[6 + n_tel], carry[6 + n_tel + 1]
+                    if telemetry:
+                        skip = carry[6 + n_tel + n_ack - 1]
                 if telemetry:
                     st, d, f, of, starved, flight, slots, shipped, useful = (
                         carry[:9]
@@ -354,6 +433,12 @@ def run_delta_ring(
                 )
                 if gated:
                     pkt = gate(pkt, rtop)
+                if acked:
+                    pkt, covered = _ackwin.gate_window(pkt, awin)
+                    if telemetry:
+                        skip = skip + jnp.sum(
+                            covered, dtype=jnp.float32
+                        ) * slot_price
                 nxt = ship(pkt)
                 if telemetry:
                     before = st
@@ -380,11 +465,24 @@ def run_delta_ring(
                 else:
                     st, d, f, of_r = applied
                     tail = ()
+                if acked:
+                    # The ack is for the packet applied THIS round —
+                    # shipped LAST round, whose pre-ship copy rides the
+                    # carry (the window lags one extra round under
+                    # pipelining, like knowledge itself).
+                    awin, bits = ack_exchange(awin, sent, flight, keep)
+                    sent = pkt
+                    if telemetry:
+                        ab = jnp.float32(tele.shipped_bytes(bits))
+                        shipped, useful = shipped + ab, useful + ab
+                    ack_tail = (awin, sent, skip) if telemetry else (awin, sent)
+                else:
+                    ack_tail = ()
                 if telemetry:
                     slots = slots + slots_of(before, st)
                     return (st, d, f, of | of_r, starved, nxt, slots,
-                            shipped, useful) + tail
-                return (st, d, f, of | of_r, starved, nxt) + tail
+                            shipped, useful) + ack_tail + tail
+                return (st, d, f, of | of_r, starved, nxt) + ack_tail + tail
 
             zeros_tel = (
                 jnp.zeros((), jnp.uint32),   # slots
@@ -405,6 +503,9 @@ def run_delta_ring(
                 )
                 if gated:
                     pkt = gate(pkt, rtop)
+                # The round-0 window is empty — nothing to mask; the
+                # pre-ship copy seeds the carry as the first ackable
+                # send.
                 flight = ship(pkt)
                 init = (folded, d, f, of, starved, flight)
                 if telemetry:
@@ -423,13 +524,20 @@ def run_delta_ring(
                             + jnp.float32(tele.shipped_bytes(flight)),
                             zeros_tel[2] + tele.packet_useful_bytes(flight),
                         )
+                if acked:
+                    init = init + (
+                        (awin0, pkt, jnp.zeros((), jnp.float32))
+                        if telemetry else (awin0, pkt)
+                    )
                 init = init + fault_tail
                 carry = lax.fori_loop(0, rounds - 1, pipe_body, init)
                 folded, d, f, of, starved, flight = carry[:6]
+                if acked:
+                    awin = carry[6 + n_tel]
                 if delay_mode:
-                    fc, held, heldv = carry[6 + n_tel:]
+                    fc, held, heldv = carry[6 + n_tel + n_ack:]
                 elif faulted:
-                    (fc,) = carry[6 + n_tel:]
+                    (fc,) = carry[6 + n_tel + n_ack:]
                 # Epilogue: merge the final in-flight packet.
                 if telemetry:
                     before = folded
@@ -450,24 +558,35 @@ def run_delta_ring(
                 if telemetry:
                     slots, shipped, useful = carry[6:9]
                     slots = slots + slots_of(before, folded)
+                    if acked:
+                        skip = carry[6 + n_tel + n_ack - 1]
             else:
                 init = (folded, d, f, of, jnp.zeros((), jnp.int32))
                 if telemetry:
                     init = init + zeros_tel
+                if acked:
+                    init = init + (
+                        (awin0, jnp.zeros((), jnp.float32))
+                        if telemetry else (awin0,)
+                    )
                 init = init + fault_tail
                 carry = lax.fori_loop(0, rounds, round_body, init)
                 folded, d, f, of, starved = carry[:5]
                 if telemetry:
                     slots, shipped, useful = carry[5:8]
+                if acked:
+                    awin = carry[5 + n_tel]
+                    if telemetry:
+                        skip = carry[5 + n_tel + n_ack - 1]
                 if delay_mode:
-                    fc, held, heldv = carry[5 + n_tel:]
+                    fc, held, heldv = carry[5 + n_tel + n_ack:]
                     # A packet still held when the loop ends arrives now
                     # (one round late past the ring edge, not lost).
                     folded, d, f, of = deliver_held(
                         folded, d, f, of, held, heldv
                     )
                 elif faulted:
-                    (fc,) = carry[5 + n_tel:]
+                    (fc,) = carry[5 + n_tel + n_ack:]
             if telemetry and gated:
                 # The digest exchange itself rides the wire once.
                 dig = jnp.float32(tele.shipped_bytes(rtop))
@@ -519,6 +638,16 @@ def run_delta_ring(
                     (REPLICA_AXIS, ELEMENT_AXIS), residue=residue,
                     useful_per_dev=useful,
                 )
+                if acked:
+                    tel = tel._replace(
+                        bytes_acked_skipped=lax.psum(
+                            skip, (REPLICA_AXIS, ELEMENT_AXIS)
+                        ),
+                        ack_window_depth=lax.pmax(
+                            _ackwin.window_depth(awin),
+                            (REPLICA_AXIS, ELEMENT_AXIS),
+                        ),
+                    )
                 if faulted:
                     tel = tel._replace(
                         faults_dropped=lax.psum(fc[0], REPLICA_AXIS),
@@ -546,7 +675,8 @@ def run_delta_ring(
     with metrics.time(f"anti_entropy.{kind}"):
         out = _cached(
             kind, state, mesh, build, rounds, cap, telemetry, pipeline,
-            gated, faults, *cache_extra, donate_argnums=argnums,
+            gated, faults, _ackwin.AckWindowKey() if acked else None,
+            *cache_extra, donate_argnums=argnums,
         )(state, dirty, fctx)
         jax.block_until_ready(out)
     if donate:
@@ -561,6 +691,12 @@ def run_delta_ring(
     # and burn the once-per-kind dedupe a genuine under-budget run
     # needs; the gauge still records, the fault counters are the signal.
     _warn_residue(kind, out, warn=not faulted)
+    if acked:
+        metrics.count("delta_opt.ack_window_runs")
+        if telemetry and tele.is_concrete(out[4]):
+            skipped = int(out[4].bytes_acked_skipped)
+            metrics.count("delta_opt.acked_skipped", skipped)
+            metrics.count(f"delta_opt.acked_skipped.{kind}", skipped)
     if telemetry and tele.is_concrete(out[4]):
         tele.record(kind, out[4])
     if faulted:
@@ -627,6 +763,7 @@ def delta_gossip_elastic(
     donate: bool = False,
     reclaim=None,
     faults=None,
+    ack_window=False,
 ):
     """δ-ring anti-entropy with elastic capacity recovery for dense
     ORSWOT replica batches (``BatchedOrswot``): the mid-round
@@ -672,7 +809,11 @@ def delta_gossip_elastic(
     ``faults=`` threads a ``crdt_tpu.faults.FaultPlan`` into every
     attempt (run_delta_ring); the LAST tuple element is then the
     ``FaultCounters`` pytree with packet counters summed across
-    attempts (``faults.combine_counters``)."""
+    attempts (``faults.combine_counters``). ``ack_window=True`` threads
+    the acked-interval masking into every attempt too — each attempt
+    starts a fresh window (sound: the window is per-run positive
+    knowledge, and a rejected overflowing attempt confirmed nothing it
+    could carry over)."""
     from .. import elastic
     from .delta import mesh_delta_gossip
 
@@ -688,7 +829,7 @@ def delta_gossip_elastic(
         out = mesh_delta_gossip(
             model.state, dirty, fctx, mesh, rounds, cap, local_fold,
             telemetry=telemetry, pipeline=pipeline, digest=digest,
-            donate=donate, faults=faults,
+            donate=donate, faults=faults, ack_window=ack_window,
         )
         if donate:
             model.state, dirty = snap, snap_dirty

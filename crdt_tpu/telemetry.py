@@ -66,6 +66,15 @@ shipped and sync metadata per round), measured natively per round:
   (integrity.py — corrupted content is never joined), and packets the
   link held one round. Populated by the ``faults=`` flag on the mesh
   entry points, 0 elsewhere.
+- ``bytes_acked_skipped`` / ``ack_window_depth`` — the ack-window
+  accounting (crdt_tpu/delta_opt/ackwin.py; registry twins
+  ``delta_opt.acked_skipped[.kind]``): payload bytes the per-link
+  acked-interval window masked off the δ rings (the back-propagation
+  win ON TOP of digest gating — ``bytes_useful`` already reflects it,
+  this field attributes it), and the max per-device count of rows with
+  a live acked watermark at run end. Populated by ``ack_window=True``
+  on ``run_delta_ring`` and the ``mesh_delta_gossip*`` family, 0
+  elsewhere.
 
 Every field is a replicated scalar, so the whole pytree costs one word
 of output per field and no extra collectives beyond one psum/pmax
@@ -113,6 +122,8 @@ class Telemetry(NamedTuple):
     faults_dropped: jax.Array  # uint32 — packets lost to injected drops
     faults_rejected: jax.Array # uint32 — packets failing the checksum lane
     faults_delayed: jax.Array  # uint32 — packets held one round by a link
+    bytes_acked_skipped: jax.Array # float32 — δ bytes the ack window masked
+    ack_window_depth: jax.Array    # uint32 — max rows with a live ack mark
 
 
 def zeros() -> Telemetry:
@@ -134,6 +145,8 @@ def zeros() -> Telemetry:
         faults_dropped=jnp.zeros((), jnp.uint32),
         faults_rejected=jnp.zeros((), jnp.uint32),
         faults_delayed=jnp.zeros((), jnp.uint32),
+        bytes_acked_skipped=jnp.zeros((), jnp.float32),
+        ack_window_depth=jnp.zeros((), jnp.uint32),
     )
 
 
@@ -162,10 +175,12 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         faults_dropped=a.faults_dropped + b.faults_dropped,
         faults_rejected=a.faults_rejected + b.faults_rejected,
         faults_delayed=a.faults_delayed + b.faults_delayed,
+        bytes_acked_skipped=a.bytes_acked_skipped + b.bytes_acked_skipped,
         deferred_depth=b.deferred_depth,
         residue=b.residue,
         widen_pressure=b.widen_pressure,
         frontier_lag=b.frontier_lag,
+        ack_window_depth=b.ack_window_depth,
     )
 
 
@@ -321,6 +336,8 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "faults_dropped": int(tel.faults_dropped),
         "faults_rejected": int(tel.faults_rejected),
         "faults_delayed": int(tel.faults_delayed),
+        "bytes_acked_skipped": float(tel.bytes_acked_skipped),
+        "ack_window_depth": int(tel.ack_window_depth),
     }
 
 
@@ -358,6 +375,13 @@ def record(kind: str, tel: Telemetry) -> None:
     )
     metrics.count(
         f"telemetry.{kind}.faults.packets_delayed", d["faults_delayed"]
+    )
+    metrics.count(
+        f"telemetry.{kind}.bytes_acked_skipped",
+        int(d["bytes_acked_skipped"]),
+    )
+    metrics.observe(
+        f"telemetry.{kind}.ack_window_depth", d["ack_window_depth"]
     )
     metrics.observe(f"telemetry.{kind}.deferred_depth", d["deferred_depth"])
     metrics.observe(f"telemetry.{kind}.residue", d["residue"])

@@ -173,6 +173,18 @@ SLOW_NODEIDS = (
     # eviction trigger included — on a shorter schedule, and the map-δ
     # and sparse-stream chaos legs stay tier-1.
     "test_chaos.py::test_chaos_soak_dense_long",
+    # ---- fourth curation round (ISSUE 9: the decomposition property
+    # gates). The 5 heaviest per-kind decomposition-law params move
+    # here; the cheap representatives (orswot, sparse_orswot, gset,
+    # lwwreg, mvreg, vclock, map_orswot) stay tier-1, and
+    # tools/run_static_checks.py `decomp` runs ALL 12 kinds on every
+    # chain invocation regardless — the same split the schedule
+    # checker uses.
+    "test_delta_opt.py::test_decomposition_laws_clean[sparse_nested_map]",
+    "test_delta_opt.py::test_decomposition_laws_clean[sparse_mvmap]",
+    "test_delta_opt.py::test_decomposition_laws_clean[map]",
+    "test_delta_opt.py::test_decomposition_laws_clean[map_map]",
+    "test_delta_opt.py::test_decomposition_laws_clean[map3]",
 )
 
 

@@ -27,6 +27,15 @@ Sections (each timed, each independently skippable):
   class), and the eviction-bijection gate (ring_perm stays a true
   bijection under every eviction subset) — each with a committed broken
   twin in analysis/fixtures.py proving the detector fires.
+- ``decomp``    — the join-irreducible decomposition gates
+  (crdt_tpu.delta_opt.static_checks): registry coverage (every merge
+  kind must have registered a decomposition —
+  crdt_tpu.analysis.registry.register_decomposition, 12/12), the two
+  decomposition laws per kind (reconstruction:
+  ``join(decompose(s, since)) ⊔ since == s``; irredundancy: no δ lane
+  covered by the join of the others — analysis/laws.py), and the
+  broken-twin detectors (the lossy and non-irredundant fixtures must
+  each fire their law).
 - ``jit-lint``  — the jaxpr walker (crdt_tpu.analysis.jit_lint) over
   every registered mesh entry point: traced-branch, unstable-sort,
   float-accum, dtype-overflow, donation-alias, PLUS the collective-
@@ -73,8 +82,8 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 SECTIONS = (
-    "lint", "schema", "laws", "schedules", "faults", "jit-lint", "cost",
-    "aliasing",
+    "lint", "schema", "laws", "schedules", "faults", "decomp",
+    "jit-lint", "cost", "aliasing",
 )
 
 # Directories the fallback linter walks (ruff takes its own config).
@@ -222,6 +231,12 @@ def run_faults():
     return static_checks()
 
 
+def run_decomp():
+    from crdt_tpu.delta_opt import static_checks
+
+    return static_checks()
+
+
 def run_jit_lint():
     from crdt_tpu.analysis.jit_lint import check_gates, lint_entry_points
 
@@ -255,13 +270,15 @@ RUNNERS = {
     "laws": run_laws,
     "schedules": run_schedules,
     "faults": run_faults,
+    "decomp": run_decomp,
     "jit-lint": run_jit_lint,
     "cost": run_cost,
     "aliasing": run_aliasing,
 }
 
 _JAX_SECTIONS = (
-    "laws", "schedules", "faults", "jit-lint", "cost", "aliasing",
+    "laws", "schedules", "faults", "decomp", "jit-lint", "cost",
+    "aliasing",
 )
 
 
