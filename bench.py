@@ -956,6 +956,163 @@ def bench_heal():
     }]
 
 
+def bench_recovery():
+    """Crash-consistent durability leg (``--recovery`` runs it alone;
+    ISSUE 10's acceptance gate), one kill-and-recover story on the
+    8-rank δ ring:
+
+    1. **durable run** — δ gossip rounds with ``wal=`` (irreducible δ
+       records per round, ``on_round`` fsync), one generational
+       snapshot mid-run, more rounds after it (the suffix a recovery
+       must replay), then the process "dies" — all in-memory state is
+       discarded.
+    2. **local recovery** — a fresh WAL open (torn-tail scan) +
+       ``recover_state`` (newest valid generation + one jitted
+       scan-fold over the log suffix), TIMED, asserted bit-identical
+       to the live state at the kill.
+    3. **log-suffix rejoin** — the mesh kept converging during a real
+       kill window (an extra churn round the dead rank never saw); the
+       restarted rank rejoins by shipping the live peer's
+       decomposition over its recovered state
+       (``durability.recover.rejoin``) instead of receiving full
+       state. The decomposition must ship < 25% of full-state resync
+       bytes, and the healed state is asserted bit-identical to the
+       full-state join."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu import durability as du
+    from crdt_tpu.durability import snapshot as snap
+    from crdt_tpu.ops import orswot as ops
+    from crdt_tpu.parallel import make_mesh, mesh_delta_gossip
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        log("recovery leg needs >= 2 devices for a ring; skipping")
+        return []
+    p = n_dev
+    e = int(os.environ.get("BENCH_RECOVERY_ELEMS", 2048))
+    a = int(os.environ.get("BENCH_RECOVERY_ACTORS", 8))
+    mesh = make_mesh(p, 1)
+    root = tempfile.mkdtemp(prefix="bench-recovery-")
+    wal_dir = os.path.join(root, "wal")
+    snap_dir = os.path.join(root, "snap")
+
+    def churn(state, round_ix):
+        rows = (jnp.arange(p) + round_ix * p) % e
+        ctr = state.ctr.at[jnp.arange(p), rows, jnp.arange(p) % a].set(
+            round_ix + 1
+        )
+        st = state._replace(
+            ctr=ctr, top=jnp.maximum(state.top, jnp.max(ctr, axis=1))
+        )
+        dirty = jnp.zeros((p, e), bool).at[jnp.arange(p), rows].set(True)
+        fctx = jnp.where(dirty[..., None], ctr, 0)
+        return st, dirty, fctx
+
+    try:
+        # ---- 1. the durable run --------------------------------------
+        base = ops.empty(e, a, deferred_cap=2, batch=(p,))
+        base = base._replace(
+            ctr=base.ctr.at[:, : e // 2, 0].set(1),
+            top=base.top.at[:, 0].set(1),
+        )
+        genesis = base
+        w = du.Wal(wal_dir, fsync="on_round")
+        st, d, f = churn(base, 1)
+        out = mesh_delta_gossip(st, d, f, mesh, wal=w)
+        snap.save_state(
+            snap_dir, "orswot", out[0], wal_seq=w.last_seq, retain=2,
+        )
+        rounds_after_snapshot = int(
+            os.environ.get("BENCH_RECOVERY_SUFFIX_ROUNDS", 3)
+        )
+        for r in range(2, 2 + rounds_after_snapshot):
+            st, d, f = churn(out[0], r)
+            out = mesh_delta_gossip(st, d, f, mesh, wal=w)
+        final_at_kill = out[0]
+        wal_bytes = w.bytes_appended
+        wal_fsyncs = w.fsyncs
+        w.close()  # the kill: everything in memory is gone
+
+        # ---- 2. local recovery, timed --------------------------------
+        t0 = time.perf_counter()
+        w2 = du.Wal(wal_dir)
+        recovered, rep = du.recover_state(
+            snap_dir, w2, genesis, kind="orswot",
+        )
+        recovery_s = time.perf_counter() - t0
+        w2.close()
+        recovery_identical = all(
+            bool(jnp.array_equal(x, y))
+            for x, y in zip(
+                jax.tree.leaves(recovered), jax.tree.leaves(final_at_kill)
+            )
+        )
+        assert recovery_identical, (
+            "recovery is not bit-identical to the state at the kill"
+        )
+        assert rep.replayed_records == rounds_after_snapshot
+
+        # ---- 3. the kill window + log-suffix rejoin -------------------
+        # The mesh kept converging while the rank was down: one more
+        # churn round the dead rank never saw.
+        st, d, f = churn(final_at_kill, 2 + rounds_after_snapshot)
+        live_rows = mesh_delta_gossip(st, d, f, mesh)[0]
+        dead_rank, peer = 0, 1
+        live_peer = jax.tree.map(lambda x: x[peer], live_rows)
+        rank_state = jax.tree.map(lambda x: x[dead_rank], recovered)
+        t0 = time.perf_counter()
+        healed, rj = du.rejoin("orswot", live_peer, rank_state)
+        rejoin_s = time.perf_counter() - t0
+        from crdt_tpu.analysis.registry import get_merge_kind
+
+        full_join = get_merge_kind("orswot").join(live_peer, rank_state)
+        full_join = (
+            full_join[0] if isinstance(full_join, tuple) else full_join
+        )
+        rejoin_identical = all(
+            bool(jnp.array_equal(x, y))
+            for x, y in zip(jax.tree.leaves(healed), jax.tree.leaves(full_join))
+        )
+        assert rejoin_identical, (
+            "log-suffix rejoin diverged from the full-state join"
+        )
+        assert rj.ratio < 0.25, (
+            f"log-based rejoin shipped {rj.ratio:.1%} of full state"
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    log(
+        f"config-recovery: {p} ranks x {e} elems: WAL "
+        f"{wal_bytes:,.0f} B / {wal_fsyncs} fsyncs over "
+        f"{1 + rounds_after_snapshot} durable rounds; recovery (gen "
+        f"{rep.generation} + {rep.replayed_records}-record replay) in "
+        f"{recovery_s:.3f}s, bit-identical; log-suffix rejoin shipped "
+        f"{rj.bytes_shipped:,.0f} B = {rj.ratio:.1%} of full-state "
+        f"({rj.bytes_full_state:,.0f} B) in {rejoin_s:.3f}s, "
+        f"bit-identical"
+    )
+    return [{
+        "config": "recovery", "metric": "rejoin_bytes_ratio",
+        "value": round(rj.ratio, 4), "unit": "ratio",
+        "recovery_seconds": round(recovery_s, 4),
+        "replayed_records": rep.replayed_records,
+        "snapshot_generation": rep.generation,
+        "wal_bytes": wal_bytes, "wal_fsyncs": wal_fsyncs,
+        "rejoin_bytes_shipped": rj.bytes_shipped,
+        "rejoin_bytes_full_state": rj.bytes_full_state,
+        "rejoin_lanes_shipped": rj.lanes_shipped,
+        "rejoin_seconds": round(rejoin_s, 4),
+        "bit_identical": recovery_identical and rejoin_identical,
+        "shape": f"{p}x{e}x{a}",
+    }]
+
+
 def bench_cpu() -> float:
     from crdt_tpu.pure.orswot import Orswot
     from crdt_tpu.vclock import VClock
@@ -1773,6 +1930,14 @@ def parse_args(argv=None):
              "bit-identity gated) and print its record to stdout",
     )
     ap.add_argument(
+        "--recovery",
+        action="store_true",
+        help="run ONLY the crash-consistent durability leg (WAL'd δ "
+             "rounds + generational snapshot, kill, timed recovery "
+             "asserted bit-identical, log-suffix rejoin bytes vs "
+             "full-state resync) and print its record to stdout",
+    )
+    ap.add_argument(
         "--flagship",
         action="store_true",
         help="run ONLY the flagship replica-streaming leg (10,240 "
@@ -1803,6 +1968,21 @@ def main(argv=None):
         )
         log(json.dumps(rec))
         print(json.dumps(rec))
+        return
+    if args.recovery:
+        # The fast recovery-only mode: one leg, one stdout JSON line.
+        if os.environ.get("BENCH_PROBE", "1") != "0" and not tpu_reachable():
+            from crdt_tpu.utils.cpu_pin import pin_cpu
+
+            pin_cpu(virtual_devices=8)
+        from crdt_tpu.telemetry import span
+
+        with span("bench.recovery", quick=True):
+            recs = bench_recovery()
+        for rec in recs:
+            log(json.dumps(rec))
+        print(json.dumps(recs[0] if recs else {"config": "recovery",
+                                               "skipped": True}))
         return
     if args.heal:
         # The fast heal-only mode: one leg, one stdout JSON line.
@@ -1900,6 +2080,7 @@ def main(argv=None):
         ("reclaim", bench_reclaim),
         ("chaos", bench_chaos),
         ("heal", bench_heal),
+        ("recovery", bench_recovery),
     ]:
         if os.environ.get(f"BENCH_{name.upper()}", "1") != "0":
             try:
@@ -2020,6 +2201,18 @@ def main(argv=None):
                 "ack_vs_digest_useful_ratio",
                 "bytes_acked_skipped_total", "bit_identical",
             ) if k in hl
+        }
+    # The recovery leg rides the headline record too: recovery time and
+    # the log-rejoin-vs-full-state byte win are ISSUE 10's metrics of
+    # record.
+    rv = next((r for r in records if r.get("config") == "recovery"), None)
+    if rv is not None:
+        headline["recovery"] = {
+            k: rv[k] for k in (
+                "value", "recovery_seconds", "replayed_records",
+                "wal_bytes", "wal_fsyncs", "rejoin_bytes_shipped",
+                "rejoin_bytes_full_state", "bit_identical",
+            ) if k in rv
         }
     # The flagship streaming record rides the headline too: it IS the
     # metric of record at the north-star shape (ROADMAP item 1) — the

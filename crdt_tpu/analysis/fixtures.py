@@ -377,3 +377,53 @@ def eviction_drops_ranks(p: int, evicted=()):
     return sorted(
         (live[i], live[(i + 1) % len(live)]) for i in range(len(live))
     )
+
+
+# ---- durability fixtures (crdt_tpu/durability/) ---------------------------
+
+def wal_skips_fsync(path, **kwargs):
+    """Broken durability twin: a WAL whose fsync seam silently drops
+    the ``os.fsync`` — appends reach the OS page cache and "work" in
+    every in-process test, but a power loss eats them regardless of the
+    declared policy. ``durability.wal.fsync_honored`` must fail it (the
+    ``durability`` static-check section pins that the detector fires).
+    """
+    from ..durability.wal import Wal
+
+    class _NoFsyncWal(Wal):
+        def _fsync(self, f):  # the barrier that never happens
+            self.fsyncs += 1  # it even LIES in its own accounting
+
+    return _NoFsyncWal(path, **kwargs)
+
+
+def snapshot_load_unchecked(path, template=None):
+    """Broken durability twin: a snapshot loader that takes the newest
+    generation's payload at face value — no manifest CRC, no per-array
+    checksums — exactly the trust-whatever-bytes-read-back behavior the
+    checkpoint integrity fix removed. A corrupt newest generation loads
+    "successfully" instead of falling back.
+    ``durability.snapshot.loader_detects_corruption`` must fail it."""
+    import io
+    import json
+    import os
+
+    import numpy as np
+
+    from ..durability.snapshot import _gen_paths, generations
+
+    gen = generations(path)[-1]
+    payload_path, _ = _gen_paths(path, gen)
+    with open(payload_path, "rb") as f:
+        raw = f.read()
+    with np.load(io.BytesIO(raw)) as z:
+        meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+        arrays = {k: z[k] for k in z.files if k != "meta"}
+    del meta, os
+    if template is None:
+        return arrays
+    n = sum(1 for k in arrays if k.startswith("a_"))
+    leaves = [arrays[f"a_{i}"] for i in range(n)]
+    import jax
+
+    return jax.tree.unflatten(jax.tree.structure(template), leaves)

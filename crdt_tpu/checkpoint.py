@@ -17,6 +17,7 @@ from __future__ import annotations
 import io
 import json
 import os
+import zlib
 from typing import Union
 
 import jax
@@ -35,6 +36,56 @@ from .ops import map as map_ops
 from .ops import mvreg as mv_ops
 from .ops import orswot as orswot_ops
 from .utils import Interner
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint's stored bytes fail their recorded content checksum.
+    ``array`` names the offending array so the operator knows WHAT
+    rotted, not just that something did. Raised by :func:`load` instead
+    of silently reconstructing a model from rotten bytes; recovery is a
+    matter for the generational snapshot tier
+    (``crdt_tpu.durability.snapshot`` falls back one generation)."""
+
+    def __init__(self, path, array: str, expect: int, got=None):
+        detail = (
+            "is MISSING from the file" if got is None
+            else f"fails its content checksum (recorded {expect:#010x}, "
+                 f"stored bytes hash to {got:#010x})"
+        )
+        super().__init__(
+            f"checkpoint {os.fspath(path)!r}: array {array!r} {detail} — "
+            f"the file is corrupt; restore from an older generation "
+            f"instead of loading rotten state"
+        )
+        self.path = os.fspath(path)
+        self.array = array
+
+
+def array_checksum(v: np.ndarray) -> int:
+    """CRC-32 of one array's dtype, shape, and content bytes — the
+    per-array integrity unit ``save`` records and ``load`` verifies
+    (also the manifest unit of ``durability.snapshot``)."""
+    v = np.ascontiguousarray(v)
+    crc = zlib.crc32(f"{v.dtype.str}:{v.shape}".encode("ascii"))
+    # crc32 takes any buffer: hash the array's memory in place instead
+    # of a tobytes() copy (flagship-scale content planes are GBs).
+    return zlib.crc32(v.reshape(-1).view(np.uint8).data, crc) & 0xFFFFFFFF
+
+
+def fsync_dir(path) -> None:
+    """fsync a DIRECTORY so a just-renamed/created entry inside it is
+    durable across power loss (write-then-rename alone only orders the
+    data, not the directory entry). Best-effort on platforms whose
+    directories refuse O_RDONLY opens."""
+    flags = os.O_RDONLY | getattr(os, "O_DIRECTORY", 0)
+    try:
+        fd = os.open(os.fspath(path), flags)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def _interner_items(interner: Interner):
@@ -137,31 +188,11 @@ def _is_sparse_nested_map(model) -> bool:
     return isinstance(model, BatchedSparseNestedMap)
 
 
-def save(path: Union[str, os.PathLike], model, compact: bool = False) -> None:
-    """Checkpoint a device model to ``path`` (one .npz file).
-
-    ``compact=True`` runs causal-stability compaction against the
-    model's OWN replica rows first (``reclaim.compact_model`` — sound
-    because the checkpointed batch is the replica set the frontier is
-    computed over): retired parked slots and stale dead payload never
-    reach disk, and a model shrunk after restore starts from the
-    compacted occupancy. Models outside the compactable family (lists,
-    counters) save as-is with ``reclaim.compact_on_save_unsupported``
-    counted — compact-on-save must never make a checkpoint impossible."""
-    if compact:
-        from . import elastic
-        from .reclaim import compact_model
-        from .utils.metrics import metrics
-
-        # Only the family check may soften to a counter — a TypeError
-        # raised INSIDE a registered compaction kernel is a kernel bug
-        # and must surface, not be miscounted as "unsupported".
-        try:
-            elastic.kind_of(model)
-        except TypeError:
-            metrics.count("reclaim.compact_on_save_unsupported")
-        else:
-            compact_model(model)
+def _dump(model) -> tuple:
+    """``(meta, arrays)`` for any checkpointable model — the type
+    dispatch :func:`save` serializes and ``durability.snapshot`` layers
+    generations on. ``arrays`` values are host numpy; ``meta`` is
+    JSON-serializable."""
     if isinstance(model, BatchedOrswot):
         meta = {
             "kind": "orswot",
@@ -308,7 +339,15 @@ def save(path: Union[str, os.PathLike], model, compact: bool = False) -> None:
         }
     else:
         raise TypeError(f"cannot checkpoint {type(model).__name__}")
+    return meta, {k: np.asarray(v) for k, v in arrays.items()}
 
+
+def to_npz_bytes(meta: dict, arrays: dict) -> bytes:
+    """One .npz image of ``(meta, arrays)`` with per-array content
+    checksums recorded in the meta — the byte format ``save`` writes
+    and ``durability.snapshot`` frames into generations."""
+    meta = dict(meta)
+    meta["checksums"] = {k: array_checksum(v) for k, v in arrays.items()}
     buf = io.BytesIO()
     np.savez(
         buf,
@@ -317,20 +356,107 @@ def save(path: Union[str, os.PathLike], model, compact: bool = False) -> None:
         ),
         **arrays,
     )
-    # Write-then-rename: a crash mid-checkpoint never corrupts the last
-    # good checkpoint (the reference's bytes-on-disk story, made atomic).
+    return buf.getvalue()
+
+
+def from_npz_bytes(path, raw) -> tuple:
+    """Parse + integrity-check one .npz image: returns ``(meta,
+    arrays)`` or raises :class:`CheckpointCorrupt` naming the first
+    array whose stored bytes fail their recorded checksum. Checkpoints
+    predating the checksums load with a one-shot warning — their
+    integrity is UNKNOWN, not verified."""
+    global _WARNED_NO_CHECKSUMS
+    with np.load(io.BytesIO(raw) if isinstance(raw, bytes) else raw) as z:
+        meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+        arrays = {k: z[k] for k in z.files if k != "meta"}
+    sums = meta.get("checksums")
+    if sums is None:
+        if not _WARNED_NO_CHECKSUMS:
+            _WARNED_NO_CHECKSUMS = True
+            import warnings
+
+            warnings.warn(
+                f"checkpoint {os.fspath(path)!r} predates per-array "
+                f"content checksums — integrity NOT verified (re-save to "
+                f"upgrade). Warned once per process.",
+                stacklevel=3,
+            )
+        from .utils.metrics import metrics
+
+        metrics.count("checkpoint.loaded_unverified")
+        return meta, arrays
+    # Iterate the RECORDED set, not the stored one: a rotten file that
+    # dropped an array entirely must fail here with its name, not leak
+    # a bare KeyError out of the restore dispatch.
+    missing = sorted(set(sums) - set(arrays))
+    if missing:
+        raise CheckpointCorrupt(path, missing[0], int(sums[missing[0]]))
+    for name, v in arrays.items():
+        got = array_checksum(v)
+        expect = int(sums.get(name, -1))
+        if got != expect:
+            raise CheckpointCorrupt(path, name, expect, got)
+    return meta, arrays
+
+
+_WARNED_NO_CHECKSUMS = False
+
+
+def save(path: Union[str, os.PathLike], model, compact: bool = False) -> None:
+    """Checkpoint a device model to ``path`` (one .npz file) with
+    per-array content checksums, atomically AND durably: the tmp file
+    (and its directory) is fsynced BEFORE the rename — write-then-rename
+    without the fsync orders nothing across power loss, so a crash
+    could leave the renamed file empty.
+
+    ``compact=True`` runs causal-stability compaction against the
+    model's OWN replica rows first (``reclaim.compact_model`` — sound
+    because the checkpointed batch is the replica set the frontier is
+    computed over): retired parked slots and stale dead payload never
+    reach disk, and a model shrunk after restore starts from the
+    compacted occupancy. Models outside the compactable family (lists,
+    counters) save as-is with ``reclaim.compact_on_save_unsupported``
+    counted — compact-on-save must never make a checkpoint impossible."""
+    if compact:
+        from . import elastic
+        from .reclaim import compact_model
+        from .utils.metrics import metrics
+
+        # Only the family check may soften to a counter — a TypeError
+        # raised INSIDE a registered compaction kernel is a kernel bug
+        # and must surface, not be miscounted as "unsupported".
+        try:
+            elastic.kind_of(model)
+        except TypeError:
+            metrics.count("reclaim.compact_on_save_unsupported")
+        else:
+            compact_model(model)
+    meta, arrays = _dump(model)
+    # Write-then-fsync-then-rename: a crash mid-checkpoint never
+    # corrupts the last good checkpoint (the reference's bytes-on-disk
+    # story, made atomic and durable).
     tmp = f"{os.fspath(path)}.tmp"
     with open(tmp, "wb") as f:
-        f.write(buf.getvalue())
+        f.write(to_npz_bytes(meta, arrays))
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    fsync_dir(os.path.dirname(os.path.abspath(os.fspath(path))))
 
 
 def load(path: Union[str, os.PathLike]):
-    """Restore a device model checkpointed by ``save``."""
-    with np.load(path) as z:
-        meta = json.loads(bytes(z["meta"]).decode("utf-8"))
-        arrays = {k: z[k] for k in z.files if k != "meta"}
+    """Restore a device model checkpointed by ``save``; raises
+    :class:`CheckpointCorrupt` (naming the array) when the stored bytes
+    fail their recorded content checksums instead of silently
+    reconstructing from rotten state."""
+    with open(path, "rb") as f:
+        meta, arrays = from_npz_bytes(path, f.read())
+    return _restore(meta, arrays)
 
+
+def _restore(meta: dict, arrays: dict):
+    """Rebuild the model from a parsed ``(meta, arrays)`` image (the
+    inverse of :func:`_dump`; shared with ``durability.snapshot``)."""
     dev = lambda a: jax.device_put(a)
     if meta["kind"] == "orswot":
         state = orswot_ops.OrswotState(
@@ -531,4 +657,7 @@ def load(path: Union[str, os.PathLike]):
     raise ValueError(f"unknown checkpoint kind {meta['kind']!r}")
 
 
-__all__ = ["save", "load"]
+__all__ = [
+    "CheckpointCorrupt", "array_checksum", "fsync_dir", "from_npz_bytes",
+    "load", "save", "to_npz_bytes",
+]

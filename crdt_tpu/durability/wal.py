@@ -1,0 +1,472 @@
+"""Host-side append-only write-ahead δ-log.
+
+The δ-buffer discipline of Almeida et al. ("Delta State Replicated
+Data Types", arXiv 1603.01529) stores the inflation, not the state;
+PR 9's join-irreducible decomposition (``delta_opt.decompose``, Enes
+et al. 1803.02750) gives the minimal on-disk unit: a WAL record is the
+irredundant lane set of one state transition over the previously
+logged state (positional diff — exact regardless of lattice order, so
+replay reproduces every logged state bit-identically), not a full
+state. Snapshot + WAL-suffix replay is then the whole recovery story
+(``durability.recover``).
+
+On-disk format (little-endian), built for torn-tail detection:
+
+- a **segment** file (``wal-<n>.seg``) opens with the 8-byte magic
+  ``CRDTWAL1`` and carries a run of frames;
+- a **frame** is ``[magic u32][seq u64][length u64][crc32 u32]`` +
+  ``length`` payload bytes; ``seq`` increases by exactly 1 across the
+  whole log (segments included), ``crc32`` covers the payload;
+- the **payload** is one ``.npz`` image: a ``meta`` JSON blob
+  (``rtype`` ∈ {``delta``, ``state``, ``resume``}, the merge ``kind``,
+  batching) plus the numbered leaves of the record pytree.
+
+``open`` scans every segment in order and TRUNCATES at the first
+damage — a short frame header, a short payload, a CRC mismatch, a seq
+gap — counting ``durability.torn_tail_truncated``; frames after the
+damage (including whole later segments) are unreachable by contract: a
+WAL replay must be a contiguous prefix, and re-appending after the
+truncation point overwrites the garbage.
+
+Fsync policy (the durability/latency trade): ``fsync="every_n"``
+(default, ``every_n=1``) fsyncs the segment after every n-th append —
+crash loses at most n-1 records; ``fsync="on_round"`` fsyncs only at
+:meth:`Wal.mark_round` — the mesh-round batching mode (one barrier per
+gossip round however many records it minted; crash loses at most one
+round). Records are FLUSHED to the OS either way; fsync is the
+power-loss barrier, and :func:`fsync_honored` statically proves the
+policy's calls actually happen (the no-fsync broken twin in
+``analysis.fixtures`` proves the prover).
+
+Crashpoints (``durability.crashpoints``) bracket every I/O boundary;
+the fuzz loop kills at each and recovery must land bit-identical.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import struct
+import zlib
+from typing import Iterator, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.metrics import metrics
+from . import crashpoints as cp
+
+SEGMENT_MAGIC = b"CRDTWAL1"
+FRAME = struct.Struct("<IQQI")  # magic, seq, payload length, crc32
+FRAME_MAGIC = 0x57A1F00D
+_SEG_RE = re.compile(r"^wal-(\d{8})\.seg$")
+
+CP_PRE_APPEND = cp.register(
+    "wal.pre_append", "before any byte of the new frame is written"
+)
+CP_MID_APPEND = cp.register(
+    "wal.mid_append",
+    "frame header flushed, payload not yet written — the torn tail",
+)
+CP_POST_APPEND_PRE_FSYNC = cp.register(
+    "wal.post_append_pre_fsync",
+    "frame fully flushed to the OS, fsync barrier not yet issued",
+)
+CP_POST_FSYNC = cp.register(
+    "wal.post_fsync", "append fsynced — the record is durable"
+)
+CP_PRE_ROTATE = cp.register(
+    "wal.pre_rotate", "segment full; before the new segment exists"
+)
+CP_POST_ROTATE = cp.register(
+    "wal.post_rotate_pre_fsync_dir",
+    "new segment created and fsynced, directory entry not yet fsynced",
+)
+
+
+class WalCorrupt(RuntimeError):
+    """Damage the open-scan could not repair by truncation (unreadable
+    directory, a segment that vanished mid-scan)."""
+
+
+def _payload(meta: dict, leaves) -> bytes:
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        meta=np.frombuffer(
+            json.dumps(meta, sort_keys=True).encode("utf-8"), dtype=np.uint8
+        ),
+        **{f"a_{i}": np.asarray(x) for i, x in enumerate(leaves)},
+    )
+    return buf.getvalue()
+
+
+def _parse_payload(raw: bytes) -> Tuple[dict, list]:
+    with np.load(io.BytesIO(raw)) as z:
+        meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+        n = sum(1 for k in z.files if k.startswith("a_"))
+        leaves = [z[f"a_{i}"] for i in range(n)]
+    return meta, leaves
+
+
+class Wal:
+    """One rank's append-only write-ahead δ-log (module docstring).
+
+    ``segment_bytes`` bounds a segment's size (rotation is checked
+    before each append, so one oversized record still lands whole).
+    ``tail`` is the last logged state — the ``since`` every
+    :meth:`append_state` decomposes over; :meth:`attach` seeds it with
+    a DEVICE COPY so zero-copy (donating) mesh entries can consume
+    their input buffers without invalidating the log's diff base."""
+
+    def __init__(
+        self,
+        path,
+        *,
+        fsync: str = "every_n",
+        every_n: int = 1,
+        segment_bytes: int = 64 * 1024 * 1024,
+    ):
+        if fsync not in ("every_n", "on_round"):
+            raise ValueError(
+                f"fsync policy {fsync!r} not in ('every_n', 'on_round')"
+            )
+        if every_n < 1:
+            raise ValueError("every_n must be >= 1")
+        self.path = os.fspath(path)
+        self.fsync_policy = fsync
+        self.every_n = every_n
+        self.segment_bytes = segment_bytes
+        self.fsyncs = 0            # fsync barriers issued (telemetry)
+        self.bytes_appended = 0    # payload+frame bytes appended
+        self.torn_tails = 0        # truncations performed by open-scan
+        self._tail = None          # last logged state (device copy)
+        self._pending = 0          # appends since the last fsync
+        self._f = None
+        os.makedirs(self.path, exist_ok=True)
+        self._scan_and_open()
+
+    # ---- open / recovery scan -------------------------------------------
+
+    def _segments(self):
+        try:
+            names = os.listdir(self.path)
+        except OSError as exc:
+            raise WalCorrupt(f"cannot list WAL dir {self.path!r}: {exc}")
+        segs = sorted(
+            (int(m.group(1)), n)
+            for n in names
+            if (m := _SEG_RE.match(n))
+        )
+        return [(i, os.path.join(self.path, n)) for i, n in segs]
+
+    def _truncate(self, seg_path: str, pos: int, why: str) -> None:
+        with open(seg_path, "r+b") as f:
+            f.truncate(pos)
+            f.flush()
+            os.fsync(f.fileno())
+        self.torn_tails += 1
+        metrics.count("durability.torn_tail_truncated")
+        metrics.count(f"durability.torn_tail.{why}")
+
+    def _scan_and_open(self) -> None:
+        """Validate every segment, truncate at the first damage, drop
+        unreachable later segments, and open the last segment for
+        append (creating segment 1 on an empty dir)."""
+        self.last_seq = 0
+        segs = self._segments()
+        damaged = False
+        keep = []
+        for idx, (seg_no, seg_path) in enumerate(segs):
+            if damaged:
+                # Frames past a truncation are unreachable by the
+                # contiguous-prefix contract; drop the whole segment.
+                os.unlink(seg_path)
+                continue
+            with open(seg_path, "rb") as f:
+                head = f.read(len(SEGMENT_MAGIC))
+                if head != SEGMENT_MAGIC:
+                    self._truncate(seg_path, 0, "bad_segment_header")
+                    damaged = True
+                    if idx == 0 or head:
+                        keep.append((seg_no, seg_path))
+                    else:
+                        os.unlink(seg_path)
+                    continue
+                pos = len(SEGMENT_MAGIC)
+                while True:
+                    hdr = f.read(FRAME.size)
+                    if not hdr:
+                        break  # clean end of segment
+                    if len(hdr) < FRAME.size:
+                        self._truncate(seg_path, pos, "short_frame")
+                        damaged = True
+                        break
+                    magic, seq, length, crc = FRAME.unpack(hdr)
+                    if magic != FRAME_MAGIC or seq != self.last_seq + 1:
+                        why = ("bad_frame_magic" if magic != FRAME_MAGIC
+                               else "seq_gap")
+                        self._truncate(seg_path, pos, why)
+                        damaged = True
+                        break
+                    payload = f.read(length)
+                    if len(payload) < length:
+                        self._truncate(seg_path, pos, "short_payload")
+                        damaged = True
+                        break
+                    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                        self._truncate(seg_path, pos, "crc_mismatch")
+                        damaged = True
+                        break
+                    self.last_seq = seq
+                    pos = f.tell()
+            keep.append((seg_no, seg_path))
+        if not keep:
+            self._new_segment(1)
+        else:
+            self._seg_no, seg_path = keep[-1]
+            self._size = os.path.getsize(seg_path)
+            self._f = open(seg_path, "ab")
+            if self._size < len(SEGMENT_MAGIC):
+                # A truncated-to-zero segment (bad header) re-arms as
+                # the append target: rewrite the header so future scans
+                # accept what lands after it.
+                self._f.write(SEGMENT_MAGIC)
+                self._f.flush()
+                self._fsync(self._f)
+                self._size = len(SEGMENT_MAGIC)
+
+    def _new_segment(self, seg_no: int) -> None:
+        cp.hit(CP_PRE_ROTATE)
+        seg_path = os.path.join(self.path, f"wal-{seg_no:08d}.seg")
+        f = open(seg_path, "wb")
+        f.write(SEGMENT_MAGIC)
+        f.flush()
+        self._fsync(f)
+        cp.hit(CP_POST_ROTATE)
+        from ..checkpoint import fsync_dir
+
+        fsync_dir(self.path)
+        self._seg_no = seg_no
+        self._size = len(SEGMENT_MAGIC)
+        self._f = f
+
+    # ---- append ----------------------------------------------------------
+
+    def _fsync(self, f) -> None:
+        """The power-loss barrier — one overridable seam so the
+        fsync-policy detector (and its broken twin) can prove the calls
+        happen (module docstring)."""
+        os.fsync(f.fileno())
+        self.fsyncs += 1
+        metrics.count("durability.fsyncs")
+
+    def append(self, meta: dict, leaves) -> int:
+        """Append one record (``meta`` + pytree leaves); returns its
+        seq. Low-level — prefer :meth:`append_state` /
+        :meth:`append_resume`."""
+        if self._f is None:
+            raise WalCorrupt("WAL is closed")
+        cp.hit(CP_PRE_APPEND)
+        if self._size >= self.segment_bytes + len(SEGMENT_MAGIC):
+            old = self._f
+            old.flush()
+            self._fsync(old)
+            old.close()
+            self._new_segment(self._seg_no + 1)
+        payload = _payload(meta, leaves)
+        seq = self.last_seq + 1
+        hdr = FRAME.pack(
+            FRAME_MAGIC, seq, len(payload),
+            zlib.crc32(payload) & 0xFFFFFFFF,
+        )
+        self._f.write(hdr)
+        self._f.flush()  # the torn frame is really on disk (crash model)
+        cp.hit(CP_MID_APPEND)
+        self._f.write(payload)
+        self._f.flush()
+        cp.hit(CP_POST_APPEND_PRE_FSYNC)
+        self.last_seq = seq
+        self._pending += 1
+        n = len(hdr) + len(payload)
+        self._size += n
+        self.bytes_appended += n
+        metrics.count("durability.wal_bytes", n)
+        metrics.count("durability.wal_records")
+        if self.fsync_policy == "every_n" and self._pending >= self.every_n:
+            self._fsync(self._f)
+            self._pending = 0
+            cp.hit(CP_POST_FSYNC)
+        return seq
+
+    def mark_round(self) -> None:
+        """A mesh-round boundary: under ``fsync='on_round'`` this is
+        THE barrier (one fsync per round, however many records the
+        round minted); a no-op when nothing is pending."""
+        if self._pending and self.fsync_policy == "on_round":
+            self._fsync(self._f)
+            self._pending = 0
+            cp.hit(CP_POST_FSYNC)
+
+    # ---- δ records over the attached tail --------------------------------
+
+    @property
+    def tail(self):
+        return self._tail
+
+    def attach(self, state) -> None:
+        """Seed the diff base with a DEVICE COPY of ``state`` (safe to
+        call before a donating mesh entry consumes the original)."""
+        self._tail = jax.tree.map(jnp.copy, state)
+
+    def _same_shape(self, state) -> bool:
+        a = jax.tree.leaves(self._tail)
+        b = jax.tree.leaves(state)
+        return (
+            jax.tree.structure(self._tail) == jax.tree.structure(state)
+            and len(a) == len(b)
+            and all(
+                x.shape == y.shape and x.dtype == y.dtype
+                for x, y in zip(a, b)
+            )
+        )
+
+    def append_state(self, kind: str, state, *, batched: bool = True) -> int:
+        """Log one state transition as an irreducible δ record:
+        ``decompose(state, tail)`` for registered merge ``kind``
+        (``batched=True`` vmaps over the leading replica axis — the
+        mesh ``[P, ...]`` convention). A shape/structure change since
+        the tail (an elastic widen) falls back to a full-``state``
+        record (``durability.wal_full_state_records``) — positional
+        diffs require congruent layouts. Updates the tail."""
+        if self._tail is None:
+            raise ValueError(
+                "no diff base: call attach(state) with the pre-run state "
+                "before the first append_state"
+            )
+        if not self._same_shape(state):
+            metrics.count("durability.wal_full_state_records")
+            seq = self.append(
+                {"rtype": "state", "kind": kind, "batched": batched},
+                [np.asarray(x) for x in jax.tree.leaves(state)],
+            )
+        else:
+            from ..delta_opt.decompose import decompose
+
+            if batched:
+                d = jax.vmap(lambda s, o: decompose(kind, s, o))(
+                    state, self._tail
+                )
+            else:
+                d = decompose(kind, state, self._tail)
+            seq = self.append(
+                {"rtype": "delta", "kind": kind, "batched": batched},
+                [np.asarray(x) for x in jax.tree.leaves(d)],
+            )
+        self._tail = jax.tree.map(jnp.copy, state)
+        return seq
+
+    def append_resume(self, kind: str, acc, blocks_done: int) -> int:
+        """Persist a replica-stream resume point (``parallel.stream``):
+        the accumulator — by construction the exact join of blocks
+        ``[0, blocks_done)`` — plus the index to resume from. The
+        newest resume record wins (``recover.load_stream_resume``)."""
+        metrics.count("durability.stream_resume_records")
+        return self.append(
+            {"rtype": "resume", "kind": kind, "blocks_done": int(blocks_done)},
+            [np.asarray(x) for x in jax.tree.leaves(acc)],
+        )
+
+    # ---- read ------------------------------------------------------------
+
+    def records(self, since_seq: int = 0) -> Iterator[Tuple[int, dict, list]]:
+        """Yield ``(seq, meta, leaves)`` for every valid record with
+        ``seq > since_seq``, in order. Reads fresh handles — safe
+        against the open append handle."""
+        self.flush()
+        for _, seg_path in self._segments():
+            with open(seg_path, "rb") as f:
+                if f.read(len(SEGMENT_MAGIC)) != SEGMENT_MAGIC:
+                    return
+                while True:
+                    hdr = f.read(FRAME.size)
+                    if len(hdr) < FRAME.size:
+                        break
+                    magic, seq, length, crc = FRAME.unpack(hdr)
+                    if magic != FRAME_MAGIC:
+                        return
+                    payload = f.read(length)
+                    if (len(payload) < length
+                            or zlib.crc32(payload) & 0xFFFFFFFF != crc
+                            or seq > self.last_seq):
+                        return
+                    if seq > since_seq:
+                        meta, leaves = _parse_payload(payload)
+                        yield seq, meta, leaves
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+
+    def sync(self) -> None:
+        """Force the barrier now regardless of policy (operator
+        shutdown path)."""
+        if self._f is not None and self._pending:
+            self._f.flush()
+            self._fsync(self._f)
+            self._pending = 0
+
+    def close(self) -> None:
+        if self._f is not None:
+            self.sync()
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def fsync_honored(wal_factory, tmp_dir) -> bool:
+    """The fsync-policy detector (the ``durability`` static-check
+    section): build a WAL via ``wal_factory(dir, fsync='every_n',
+    every_n=1)`` and count REAL ``os.fsync`` calls across three
+    appends — the policy promises one barrier per append, so fewer
+    than three means the WAL's fsync seam is lying (the
+    ``analysis.fixtures.wal_skips_fsync`` broken twin must fail here).
+    The count window also covers segment creation, so the threshold is
+    a floor, not an equality."""
+    import crdt_tpu.durability.wal as _wal_mod
+
+    calls = 0
+    real = os.fsync
+
+    def counting(fd):
+        nonlocal calls
+        calls += 1
+        return real(fd)
+
+    d = os.path.join(os.fspath(tmp_dir), "fsync-probe")
+    _wal_mod.os.fsync, saved = counting, _wal_mod.os.fsync
+    try:
+        w = wal_factory(d, fsync="every_n", every_n=1)
+        base = calls
+        for i in range(3):
+            w.append({"rtype": "state", "kind": "probe"}, [np.arange(4)])
+        w.close()
+        return calls - base >= 3
+    finally:
+        _wal_mod.os.fsync = saved
+
+
+__all__ = [
+    "FRAME", "FRAME_MAGIC", "SEGMENT_MAGIC", "Wal", "WalCorrupt",
+    "fsync_honored",
+]

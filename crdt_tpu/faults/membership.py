@@ -20,10 +20,19 @@ Protocol (the chaos tests and ``bench.py --chaos`` walk it end to end):
 3. :meth:`Membership.evict` suspects (policy: automatic via
    ``auto_evict=True`` on observe, or operator-driven);
 4. a returning rank calls :meth:`Membership.rejoin` ONLY after
-   full-state state-driven resync (Enes et al. 1803.02750) — while it
-   was out, the frontier may have advanced past its top and compaction
-   may have retired parked slots it never saw, so δ re-entry from its
-   stale tracking is forbidden; a full-state join is always sound.
+   state-driven resync (Enes et al. 1803.02750) — while it was out,
+   the frontier may have advanced past its top and compaction may have
+   retired parked slots it never saw, so δ re-entry from its stale
+   tracking is forbidden. Two sound resync forms: **full-state** (the
+   original contract — always available, ships a whole state), or,
+   since ISSUE 10, **log-suffix rejoin**
+   (``crdt_tpu.durability.recover.rejoin``) for a rank that recovered
+   locally from its snapshot + write-ahead δ-log: the live peer ships
+   only its join-irreducible decomposition over the recovered state
+   (reconstruction is positionally bit-exact whatever the bound, and
+   the final join keeps recovered-but-unreplicated local content) —
+   < 25% of full-state bytes on the ``bench.py --recovery`` gate. δ
+   re-entry from stale marks remains forbidden either way.
 
 The liveness signal is receiver-measured: device p's ``miss_streak[p]``
 counts consecutive end-of-run rounds with nothing arriving on its
@@ -160,12 +169,14 @@ class Membership:
 
     def rejoin(self, rank: int) -> None:
         """Re-admit ``rank``. PRECONDITION (the caller's contract): the
-        rank's state has been replaced by full-state state-driven
-        resync against a live replica — its pre-eviction state and δ
-        tracking are STALE (the frontier may have advanced past its
-        top; compaction may have retired slots it never saw) and must
-        not re-enter the δ ring. A full-state join is always sound; δ
-        re-entry from stale marks is not."""
+        rank's state has been replaced by state-driven resync against a
+        live replica — full-state gossip, or the log-suffix form
+        (``durability.recover.rejoin``) when the rank recovered locally
+        from snapshot + WAL (module docstring item 4). Its pre-eviction
+        δ TRACKING is stale either way (the frontier may have advanced
+        past its top; compaction may have retired slots it never saw)
+        and must not re-enter the δ ring; a state join is always sound,
+        δ re-entry from stale marks is not."""
         self._check_rank(rank)
         self._evicted.discard(rank)
         self.streaks[rank] = 0

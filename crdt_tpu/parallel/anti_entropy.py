@@ -120,6 +120,15 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         # ack_window= path fills them in (delta_ring's _replace).
         bytes_acked_skipped=jnp.zeros((), jnp.float32),
         ack_window_depth=jnp.zeros((), jnp.uint32),
+        # The durability fields are filled host-side by the wal= append
+        # loop (delta_ring / stream) and the recovery driver
+        # (crdt_tpu/durability/) — never in-kernel.
+        wal_bytes=jnp.zeros((), jnp.float32),
+        wal_fsyncs=jnp.zeros((), jnp.uint32),
+        snapshots_written=jnp.zeros((), jnp.uint32),
+        replayed_records=jnp.zeros((), jnp.uint32),
+        torn_tail_truncated=jnp.zeros((), jnp.uint32),
+        recovery_rounds=jnp.zeros((), jnp.uint32),
     )
 
 

@@ -275,6 +275,7 @@ def mesh_delta_gossip(
     donate: bool = False,
     faults=None,
     ack_window=False,
+    wal=None,
 ):
     """Ring δ anti-entropy over the mesh: each device folds its local
     replica block (OR-folding dirty, max-folding contexts), then runs
@@ -328,7 +329,10 @@ def mesh_delta_gossip(
     the digest gate — the peer's positive confirmations retire
     re-circulated δs INCLUDING removals (crdt_tpu/delta_opt/ackwin.py;
     converged states stay bit-identical, ``bytes_acked_skipped``
-    reports the win)."""
+    reports the win). ``wal=`` (a ``crdt_tpu.durability.Wal``) logs the
+    run's converged rows as one irreducible δ record + round barrier —
+    crash recovery then replays snapshot + log suffix
+    (run_delta_ring documents the host-side semantics)."""
     from ..ops.pallas_kernels import fold_auto
     from .delta_ring import run_delta_ring
 
@@ -352,7 +356,7 @@ def mesh_delta_gossip(
         cache_extra=(local_fold,),
         telemetry=telemetry, slots_fn=changed_members,
         pipeline=pipeline, digest=digest, gate=gate_delta, donate=donate,
-        faults=faults, ack_window=ack_window,
+        faults=faults, ack_window=ack_window, wal=wal, wal_kind="orswot",
     )
 
 

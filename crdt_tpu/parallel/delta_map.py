@@ -300,6 +300,7 @@ def mesh_delta_gossip_map(
     donate: bool = False,
     faults=None,
     ack_window=False,
+    wal=None,
 ):
     """Ring δ anti-entropy for Map<K, MVReg> replica batches over the
     mesh — the bandwidth-bounded mode for large key universes with local
@@ -334,6 +335,7 @@ def mesh_delta_gossip_map(
         telemetry=telemetry, slots_fn=map_ops.changed_keys,
         pipeline=pipeline, digest=digest, gate=gate_delta_map,
         donate=donate, faults=faults, ack_window=ack_window,
+        wal=wal, wal_kind="map",
     )
 
 

@@ -77,6 +77,7 @@ def mesh_delta_gossip_map_orswot(
     donate: bool = False,
     faults=None,
     ack_window=False,
+    wal=None,
 ):
     """Ring δ anti-entropy for Map<K, Orswot> replica batches (see
     delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET
@@ -109,6 +110,7 @@ def mesh_delta_gossip_map_orswot(
         slots_fn=lambda a, b: changed_members(a.core, b.core),
         pipeline=pipeline, digest=digest, gate=gate_delta_mo,
         donate=donate, faults=faults, ack_window=ack_window,
+        wal=wal, wal_kind="map_orswot",
     )
 
 

@@ -129,6 +129,8 @@ def run_delta_ring(
     donate: bool = False,
     faults=None,                      # crdt_tpu.faults.FaultPlan
     ack_window=False,                 # delta_opt/ackwin.py (False/None off)
+    wal=None,                         # crdt_tpu.durability.Wal
+    wal_kind: Optional[str] = None,   # registry merge kind for δ records
 ):
     """Run the δ ring program; ``state``/``dirty``/``fctx`` must already
     be padded to the mesh. Returns ``(states [P, ...], dirty, overflow,
@@ -187,9 +189,27 @@ def run_delta_ring(
     ``bytes_useful`` / ``bytes_acked_skipped`` / ``ack_window_depth``
     under ``telemetry=True`` and the ``delta_opt.acked_skipped[.kind]``
     registry twins. Off (the default) traces the byte-identical
-    pre-flag program, like every other mode flag."""
+    pre-flag program, like every other mode flag.
+
+    ``wal=`` (a ``crdt_tpu.durability.Wal``) makes the run DURABLE,
+    host-side: the pre-run state seeds the log's diff base (a device
+    copy, so ``donate=True`` stays sound), and after the run the
+    converged rows append as ONE irreducible δ record
+    (``delta_opt.decompose`` over the previous logged state) followed
+    by a round barrier (``Wal.mark_round`` — the ``on_round`` fsync
+    policy's one-barrier-per-round point). ``wal_kind`` names the
+    registered merge kind the record decomposes under (the δ flavors
+    pass their own). A crash then recovers to the last durable round
+    via ``durability.recover`` — the traced program is UNTOUCHED (the
+    append reads the returned arrays; flag off = no trace change by
+    construction)."""
     from .anti_entropy import _cached, _ring_donate_argnums, _tel_reduced
 
+    if wal is not None and wal_kind is None:
+        raise ValueError(
+            "wal= needs wal_kind= (the registered merge kind δ records "
+            "decompose under)"
+        )
     p = mesh.shape[REPLICA_AXIS]
     gated = digest and gate is not None
     faulted = faults is not None
@@ -672,6 +692,15 @@ def run_delta_ring(
 
     metrics.count(f"anti_entropy.{kind}_rounds", rounds)
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
+    if (wal is not None and wal.tail is None
+            and not isinstance(
+                jax.tree.leaves(state)[0], jax.core.Tracer
+            )):
+        # Seed the diff base BEFORE the jitted call — donation consumes
+        # the input buffers; attach takes a device copy. Skipped under
+        # an outer jit (tracers must never leak into the log's diff
+        # base) — the append below is skipped symmetrically.
+        wal.attach(state)
     with metrics.time(f"anti_entropy.{kind}"):
         out = _cached(
             kind, state, mesh, build, rounds, cap, telemetry, pipeline,
@@ -691,6 +720,20 @@ def run_delta_ring(
     # and burn the once-per-kind dedupe a genuine under-budget run
     # needs; the gauge still records, the fault counters are the signal.
     _warn_residue(kind, out, warn=not faulted)
+    if wal is not None and not isinstance(
+        jax.tree.leaves(out[0])[0], jax.core.Tracer
+    ):
+        # Host-side durability append (skipped under an outer jit —
+        # like tele.record, the caller then owns persistence).
+        b0, f0 = wal.bytes_appended, wal.fsyncs
+        with metrics.time("durability.wal_append"):
+            wal.append_state(wal_kind, out[0])
+            wal.mark_round()
+        if telemetry and tele.is_concrete(out[4]):
+            out = out[:4] + (out[4]._replace(
+                wal_bytes=jnp.float32(wal.bytes_appended - b0),
+                wal_fsyncs=jnp.uint32(wal.fsyncs - f0),
+            ),) + out[5:]
     if acked:
         metrics.count("delta_opt.ack_window_runs")
         if telemetry and tele.is_concrete(out[4]):
@@ -764,6 +807,7 @@ def delta_gossip_elastic(
     reclaim=None,
     faults=None,
     ack_window=False,
+    wal=None,
 ):
     """δ-ring anti-entropy with elastic capacity recovery for dense
     ORSWOT replica batches (``BatchedOrswot``): the mid-round
@@ -813,10 +857,19 @@ def delta_gossip_elastic(
     the acked-interval masking into every attempt too — each attempt
     starts a fresh window (sound: the window is per-run positive
     knowledge, and a rejected overflowing attempt confirmed nothing it
-    could carry over)."""
+    could carry over).
+
+    ``wal=`` logs ONLY the committed attempt (a rejected overflowing
+    run mutated nothing, so it must not reach the log either); a
+    mid-loop widen changes the shapes, which the log absorbs as a
+    full-``state`` record (``Wal.append_state``'s fallback) — replay
+    re-anchors there, so recovery stays bit-identical across
+    migrations."""
     from .. import elastic
     from .delta import mesh_delta_gossip
 
+    if wal is not None and wal.tail is None:
+        wal.attach(model.state)
     policy = policy or elastic.DEFAULT_POLICY
     widened: dict = {}
     migrations = 0
@@ -852,6 +905,10 @@ def delta_gossip_elastic(
                 # so retired slots do not pin lanes the shrink needs.
                 compact_model(model)
                 reclaim.observe(model)
+            if wal is not None:
+                # The committed attempt is the durable transition.
+                wal.append_state("orswot", out[0])
+                wal.mark_round()
             ret = (*out[:4], widened)
             if telemetry:
                 ret = ret + (tel,)

@@ -305,6 +305,9 @@ def _stream_fold(
     frontier=None,
     compact_every: int = 0,
     faults=None,
+    wal=None,
+    wal_every: int = 0,
+    wal_base: int = 0,
 ):
     """The shared scaffold: template derivation, identity padding and
     cap-matching at staging, the double-buffered dispatch loop, the
@@ -322,12 +325,42 @@ def _stream_fold(
     per-block fate is read back host-side (one sync per block, the
     faults-mode price), and a :class:`StreamFaultReport` is appended as
     the LAST output so the caller can re-stream the lost blocks with
-    ``init=acc``. The flag-off trace is byte-identical pre-flag."""
+    ``init=acc``. The flag-off trace is byte-identical pre-flag.
+
+    ``wal=`` (a ``crdt_tpu.durability.Wal``) makes the stream's
+    interrupt contract DURABLE: every ``StreamInterrupted`` raise first
+    persists a fsynced resume record (the accumulator — the exact join
+    of blocks ``[0, k)`` — plus the resume index), and ``wal_every=k``
+    additionally persists one every k blocks, so a HARD kill (no
+    exception path at all — the flagship run's preemption case) still
+    resumes from the last persisted block via
+    ``durability.recover.load_stream_resume`` instead of restarting
+    the fold. Resume records carry the ABSOLUTE block index
+    (``wal_base + blocks_done``): a RESUMED run must pass the index it
+    resumed from as ``wal_base=`` so a second kill still points at the
+    true position in the original source, not a run-relative one. Each
+    periodic persist syncs the in-flight accumulator to host — the
+    durability price; size ``wal_every`` like a checkpoint cadence,
+    not a telemetry one. The traced program is untouched."""
     rsize = mesh.shape[REPLICA_AXIS]
     esize = mesh.shape[ELEMENT_AXIS]
     faulted = faults is not None
     if faulted:
         from .. import faults as flt
+    wal_b0 = wal.bytes_appended if wal is not None else 0
+    wal_f0 = wal.fsyncs if wal is not None else 0
+
+    def persist_resume(acc_now, done: int) -> None:
+        """One fsynced resume record (module docstring) — a resume
+        point that could vanish with the page cache is no resume
+        point. ``done`` is run-relative; the record stores the
+        ABSOLUTE source index (``wal_base + done``)."""
+        if wal is None or acc_now is None:
+            return
+        jax.block_until_ready(jax.tree.leaves(acc_now))
+        wal.append_resume(plan.kind, acc_now, wal_base + done)
+        wal.sync()
+
     it = iter(blocks)
 
     def fetch():
@@ -339,6 +372,7 @@ def _stream_fold(
         raise  # caller bugs propagate as-is — _advance's contract
     except Exception as exc:
         metrics.count("stream.interrupted")
+        persist_resume(init, 0)
         raise StreamInterrupted(
             exc, init, 0,
             fault_report=StreamFaultReport([], []) if faulted else None,
@@ -570,6 +604,7 @@ def _stream_fold(
     except Exception as exc:
         metrics.count("stream.interrupted")
         jax.block_until_ready(jax.tree.leaves(acc))
+        persist_resume(acc, 0)
         raise StreamInterrupted(
             exc, acc, 0, tel, fault_report=partial_report()
         ) from exc
@@ -643,6 +678,8 @@ def _stream_fold(
                 acc, reclaimed = _compact_acc(
                     plan, acc, frontier_arr, reclaimed, acc_sharding
                 )
+            if wal_every and blocks_done % wal_every == 0:
+                persist_resume(acc, blocks_done)
             if not pipeline:
                 jax.block_until_ready(jax.tree.leaves(acc))
             elif not _ready(acc):
@@ -650,9 +687,11 @@ def _stream_fold(
                 # still in flight: the upload DMA overlaps the kernels.
                 overlap_hits += 1
             staged = _advance(
-                fetch, stage, acc, tel, blocks_done, partial_report
+                fetch, stage, acc, tel, blocks_done, partial_report,
+                persist_resume,
             )
         jax.block_until_ready(jax.tree.leaves(acc))
+        persist_resume(acc, blocks_done)
 
     if overflow is None:
         overflow = jnp.zeros((), bool)
@@ -681,6 +720,11 @@ def _stream_fold(
             reclaimed_slots=tel.reclaimed_slots + reclaimed[0],
             reclaimed_bytes=tel.reclaimed_bytes + reclaimed[1],
         )
+        if wal is not None:
+            tel = tel._replace(
+                wal_bytes=jnp.float32(wal.bytes_appended - wal_b0),
+                wal_fsyncs=jnp.uint32(wal.fsyncs - wal_f0),
+            )
         if faulted:
             tel = tel._replace(
                 faults_dropped=jnp.uint32(len(dropped_blocks)),
@@ -696,11 +740,13 @@ def _stream_fold(
     return acc, overflow
 
 
-def _advance(fetch, stage, acc, tel, blocks_done, partial_report):
+def _advance(fetch, stage, acc, tel, blocks_done, partial_report,
+             persist_resume=lambda acc, done: None):
     """Fetch + stage the next block; a failure interrupts the stream
     with the accumulator intact (the failed block never entered a
     step) and, on a faulted run, the lost-so-far report
-    (``partial_report`` is the driver's snapshot closure). Contract
+    (``partial_report`` is the driver's snapshot closure) — persisting
+    the resume point first when the run is ``wal=``-durable. Contract
     violations (ValueError from ``stage``) propagate as-is — they are
     caller bugs, not stream faults."""
     try:
@@ -711,6 +757,7 @@ def _advance(fetch, stage, acc, tel, blocks_done, partial_report):
     except Exception as exc:
         metrics.count("stream.interrupted")
         jax.block_until_ready(jax.tree.leaves(acc))
+        persist_resume(acc, blocks_done)
         raise StreamInterrupted(
             exc, acc, blocks_done, tel, fault_report=partial_report()
         ) from exc
@@ -732,24 +779,28 @@ def _compact_acc(plan, acc, frontier_arr, reclaimed, acc_sharding):
 def mesh_stream_fold_sparse(
     blocks: Iterable, mesh: Mesh, *, init=None, telemetry: bool = False,
     donate: bool = True, pipeline: bool = True, widen_policy=None,
-    frontier=None, compact_every: int = 0, faults=None,
+    frontier=None, compact_every: int = 0, faults=None, wal=None,
+    wal_every: int = 0, wal_base: int = 0,
 ):
     """Stream-fold SPARSE (segment-encoded) ORSWOT replica blocks
     ``[B, ...]`` into one converged state — the flagship arbitrary-N
     driver (``bench.py --flagship`` runs the 10,240 x 1M shape through
     it). Returns ``(state, overflow[2[, Telemetry]])``; semantics and
-    flags per the module docstring."""
+    flags (incl. the ``wal=`` durable-resume contract) per the module
+    docstring."""
     return _stream_fold(
         _plan_sparse(), blocks, mesh, init=init, telemetry=telemetry,
         donate=donate, pipeline=pipeline, widen_policy=widen_policy,
         frontier=frontier, compact_every=compact_every, faults=faults,
+        wal=wal, wal_every=wal_every, wal_base=wal_base,
     )
 
 
 def mesh_stream_fold(
     blocks: Iterable, mesh: Mesh, *, init=None, telemetry: bool = False,
     donate: bool = True, pipeline: bool = True, widen_policy=None,
-    frontier=None, compact_every: int = 0, faults=None,
+    frontier=None, compact_every: int = 0, faults=None, wal=None,
+    wal_every: int = 0, wal_base: int = 0,
 ):
     """Stream-fold DENSE ORSWOT replica blocks ``[B, E, A]`` (content
     planes element-sharded over the mesh, ``mesh.orswot_specs``
@@ -758,6 +809,7 @@ def mesh_stream_fold(
         _plan_dense(), blocks, mesh, init=init, telemetry=telemetry,
         donate=donate, pipeline=pipeline, widen_policy=widen_policy,
         frontier=frontier, compact_every=compact_every, faults=faults,
+        wal=wal, wal_every=wal_every, wal_base=wal_base,
     )
 
 
@@ -765,6 +817,7 @@ def mesh_stream_fold_sparse_mvmap(
     blocks: Iterable, mesh: Mesh, *, sibling_cap: int = 4, init=None,
     telemetry: bool = False, donate: bool = True, pipeline: bool = True,
     widen_policy=None, frontier=None, compact_every: int = 0, faults=None,
+    wal=None, wal_every: int = 0, wal_base: int = 0,
 ):
     """Stream-fold SPARSE ``Map<K, MVReg>`` replica blocks
     (ops/sparse_mvmap) — the register-family arbitrary-N driver.
@@ -775,14 +828,16 @@ def mesh_stream_fold_sparse_mvmap(
         _plan_sparse_mvmap(sibling_cap), blocks, mesh, init=init,
         telemetry=telemetry, donate=donate, pipeline=pipeline,
         widen_policy=widen_policy, frontier=frontier,
-        compact_every=compact_every, faults=faults,
+        compact_every=compact_every, faults=faults, wal=wal,
+        wal_every=wal_every, wal_base=wal_base,
     )
 
 
 def mesh_stream_fold_sparse_sharded(
     blocks: Iterable, mesh: Mesh, *, init=None, telemetry: bool = False,
     donate: bool = True, pipeline: bool = True, frontier=None,
-    compact_every: int = 0, faults=None,
+    compact_every: int = 0, faults=None, wal=None, wal_every: int = 0,
+    wal_base: int = 0,
 ):
     """Stream-fold element-SHARDED sparse replica blocks ``[B, S, ...]``
     (from ``sparse_shard.split_segments``; S must equal the mesh's
@@ -795,6 +850,7 @@ def mesh_stream_fold_sparse_sharded(
         _plan_sparse_sharded(), blocks, mesh, init=init,
         telemetry=telemetry, donate=donate, pipeline=pipeline,
         frontier=frontier, compact_every=compact_every, faults=faults,
+        wal=wal, wal_every=wal_every, wal_base=wal_base,
     )
 
 

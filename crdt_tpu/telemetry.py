@@ -75,6 +75,16 @@ shipped and sync metadata per round), measured natively per round:
   a live acked watermark at run end. Populated by ``ack_window=True``
   on ``run_delta_ring`` and the ``mesh_delta_gossip*`` family, 0
   elsewhere.
+- ``wal_bytes`` / ``wal_fsyncs`` / ``snapshots_written`` /
+  ``replayed_records`` / ``torn_tail_truncated`` / ``recovery_rounds``
+  — the crash-consistent durability accounting (crdt_tpu/durability/;
+  registry twins ``durability.*``): δ-record payload bytes appended to
+  the write-ahead log and fsync barriers issued for them (populated
+  host-side by the ``wal=`` flag on the δ-ring entries and
+  ``mesh_stream_fold*`` — the append loop lives outside the kernels,
+  the ``stream_*`` discipline), snapshot generations committed, WAL
+  records replayed by a recovery, torn/corrupt log tails truncated on
+  open, and recovery passes completed. 0 on every non-durable run.
 
 Every field is a replicated scalar, so the whole pytree costs one word
 of output per field and no extra collectives beyond one psum/pmax
@@ -124,6 +134,12 @@ class Telemetry(NamedTuple):
     faults_delayed: jax.Array  # uint32 — packets held one round by a link
     bytes_acked_skipped: jax.Array # float32 — δ bytes the ack window masked
     ack_window_depth: jax.Array    # uint32 — max rows with a live ack mark
+    wal_bytes: jax.Array           # float32 — δ-record bytes appended to WAL
+    wal_fsyncs: jax.Array          # uint32 — fsync barriers for those appends
+    snapshots_written: jax.Array   # uint32 — snapshot generations committed
+    replayed_records: jax.Array    # uint32 — WAL records replayed on recovery
+    torn_tail_truncated: jax.Array # uint32 — torn/corrupt WAL tails truncated
+    recovery_rounds: jax.Array     # uint32 — recovery passes completed
 
 
 def zeros() -> Telemetry:
@@ -147,6 +163,12 @@ def zeros() -> Telemetry:
         faults_delayed=jnp.zeros((), jnp.uint32),
         bytes_acked_skipped=jnp.zeros((), jnp.float32),
         ack_window_depth=jnp.zeros((), jnp.uint32),
+        wal_bytes=jnp.zeros((), jnp.float32),
+        wal_fsyncs=jnp.zeros((), jnp.uint32),
+        snapshots_written=jnp.zeros((), jnp.uint32),
+        replayed_records=jnp.zeros((), jnp.uint32),
+        torn_tail_truncated=jnp.zeros((), jnp.uint32),
+        recovery_rounds=jnp.zeros((), jnp.uint32),
     )
 
 
@@ -176,6 +198,12 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         faults_rejected=a.faults_rejected + b.faults_rejected,
         faults_delayed=a.faults_delayed + b.faults_delayed,
         bytes_acked_skipped=a.bytes_acked_skipped + b.bytes_acked_skipped,
+        wal_bytes=a.wal_bytes + b.wal_bytes,
+        wal_fsyncs=a.wal_fsyncs + b.wal_fsyncs,
+        snapshots_written=a.snapshots_written + b.snapshots_written,
+        replayed_records=a.replayed_records + b.replayed_records,
+        torn_tail_truncated=a.torn_tail_truncated + b.torn_tail_truncated,
+        recovery_rounds=a.recovery_rounds + b.recovery_rounds,
         deferred_depth=b.deferred_depth,
         residue=b.residue,
         widen_pressure=b.widen_pressure,
@@ -338,6 +366,12 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "faults_delayed": int(tel.faults_delayed),
         "bytes_acked_skipped": float(tel.bytes_acked_skipped),
         "ack_window_depth": int(tel.ack_window_depth),
+        "wal_bytes": float(tel.wal_bytes),
+        "wal_fsyncs": int(tel.wal_fsyncs),
+        "snapshots_written": int(tel.snapshots_written),
+        "replayed_records": int(tel.replayed_records),
+        "torn_tail_truncated": int(tel.torn_tail_truncated),
+        "recovery_rounds": int(tel.recovery_rounds),
     }
 
 
@@ -382,6 +416,20 @@ def record(kind: str, tel: Telemetry) -> None:
     )
     metrics.observe(
         f"telemetry.{kind}.ack_window_depth", d["ack_window_depth"]
+    )
+    metrics.count(f"telemetry.{kind}.wal_bytes", int(d["wal_bytes"]))
+    metrics.count(f"telemetry.{kind}.wal_fsyncs", d["wal_fsyncs"])
+    metrics.count(
+        f"telemetry.{kind}.snapshots_written", d["snapshots_written"]
+    )
+    metrics.count(
+        f"telemetry.{kind}.replayed_records", d["replayed_records"]
+    )
+    metrics.count(
+        f"telemetry.{kind}.torn_tail_truncated", d["torn_tail_truncated"]
+    )
+    metrics.count(
+        f"telemetry.{kind}.recovery_rounds", d["recovery_rounds"]
     )
     metrics.observe(f"telemetry.{kind}.deferred_depth", d["deferred_depth"])
     metrics.observe(f"telemetry.{kind}.residue", d["residue"])

@@ -1,0 +1,610 @@
+"""Crash-consistent durability gates (crdt_tpu/durability/).
+
+The contract under test: ANY kill point in the durability I/O leaves a
+recoverable store — snapshot + WAL-suffix replay lands exactly the last
+durable record, bit-identically — and the layers above (the δ-ring
+``wal=`` wiring, the stream's durable resume, log-suffix rejoin) build
+on that without ever changing a traced program.
+
+Tiers: the crashpoint × kind fuzz matrix runs a representative DIAGONAL
+here (every crashpoint once, all 12 op kinds cycled) and the FULL
+matrix in the curated ``slow`` tier — the ISSUE 10 split.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crdt_tpu import checkpoint
+from crdt_tpu import durability as du
+from crdt_tpu.analysis.registry import (
+    decomposers,
+    get_merge_kind,
+)
+from crdt_tpu.durability import crashpoints as cp
+from crdt_tpu.durability import snapshot as snap
+from crdt_tpu.durability.wal import Wal
+from crdt_tpu.ops import orswot as ops
+from crdt_tpu.parallel import make_mesh, mesh_delta_gossip
+from crdt_tpu.utils.metrics import metrics
+
+
+def tree_eq(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        x.shape == y.shape and bool(jnp.array_equal(x, y))
+        for x, y in zip(la, lb)
+    )
+
+
+# ---- WAL framing ----------------------------------------------------------
+
+def _probe_leaves(i: int):
+    return [np.arange(16, dtype=np.uint32) * (i + 1)]
+
+
+def test_wal_append_read_roundtrip(tmp_path):
+    with Wal(tmp_path / "wal") as w:
+        for i in range(4):
+            w.append({"rtype": "state", "kind": "probe", "i": i},
+                     _probe_leaves(i))
+        got = list(w.records())
+    assert [seq for seq, _, _ in got] == [1, 2, 3, 4]
+    for seq, meta, leaves in got:
+        assert meta["i"] == seq - 1
+        assert np.array_equal(leaves[0], _probe_leaves(seq - 1)[0])
+
+
+def test_wal_torn_tail_truncated_on_open(tmp_path):
+    with Wal(tmp_path / "wal") as w:
+        for i in range(3):
+            w.append({"rtype": "state", "kind": "probe"}, _probe_leaves(i))
+        seg = os.path.join(w.path, "wal-00000001.seg")
+    # Chop mid-way into the LAST frame's payload — the torn tail.
+    size = os.path.getsize(seg)
+    with open(seg, "r+b") as f:
+        f.truncate(size - 11)
+    w2 = Wal(tmp_path / "wal")
+    assert w2.last_seq == 2
+    assert w2.torn_tails == 1
+    assert [seq for seq, _, _ in w2.records()] == [1, 2]
+    # The truncation point re-arms cleanly: appends continue at seq 3.
+    w2.append({"rtype": "state", "kind": "probe"}, _probe_leaves(9))
+    assert w2.last_seq == 3
+    w2.close()
+    w3 = Wal(tmp_path / "wal")
+    assert w3.last_seq == 3 and w3.torn_tails == 0
+    w3.close()
+
+
+def test_wal_crc_corruption_truncates(tmp_path):
+    with Wal(tmp_path / "wal") as w:
+        for i in range(3):
+            w.append({"rtype": "state", "kind": "probe"}, _probe_leaves(i))
+        seg = os.path.join(w.path, "wal-00000001.seg")
+    # Flip one byte inside the SECOND record's payload: CRC catches it
+    # and the log truncates there — record 1 survives, 2 and 3 do not
+    # (a replay past damage would not be a contiguous prefix).
+    frames = []
+    with open(seg, "rb") as f:
+        f.read(len(du.wal.SEGMENT_MAGIC))
+        for _ in range(3):
+            hdr = f.read(du.wal.FRAME.size)
+            _, _, length, _ = du.wal.FRAME.unpack(hdr)
+            frames.append((f.tell(), length))
+            f.read(length)
+    off = frames[1][0] + frames[1][1] // 2
+    with open(seg, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0x5A]))
+    w2 = Wal(tmp_path / "wal")
+    assert w2.last_seq == 1
+    assert [seq for seq, _, _ in w2.records()] == [1]
+    w2.close()
+
+
+def test_wal_segment_rotation(tmp_path):
+    with Wal(tmp_path / "wal", segment_bytes=512) as w:
+        for i in range(6):
+            w.append({"rtype": "state", "kind": "probe"}, _probe_leaves(i))
+        segs = [n for n in os.listdir(w.path) if n.endswith(".seg")]
+        assert len(segs) > 1, "tiny segment_bytes must force rotation"
+        assert [seq for seq, _, _ in w.records()] == list(range(1, 7))
+    w2 = Wal(tmp_path / "wal", segment_bytes=512)
+    assert w2.last_seq == 6
+    w2.close()
+
+
+def test_wal_fsync_policies(tmp_path):
+    with Wal(tmp_path / "a", fsync="every_n", every_n=2) as w:
+        base = w.fsyncs  # segment creation fsyncs don't count appends
+        for i in range(4):
+            w.append({"rtype": "state", "kind": "probe"}, _probe_leaves(i))
+        assert w.fsyncs - base == 2  # one barrier per two appends
+    with Wal(tmp_path / "b", fsync="on_round") as w:
+        base = w.fsyncs
+        for i in range(3):
+            w.append({"rtype": "state", "kind": "probe"}, _probe_leaves(i))
+        assert w.fsyncs == base  # no barrier until the round mark
+        w.mark_round()
+        assert w.fsyncs == base + 1
+        w.mark_round()  # nothing pending: no extra barrier
+        assert w.fsyncs == base + 1
+
+
+def test_wal_fsync_detector_and_broken_twin(tmp_path):
+    from crdt_tpu.analysis import fixtures
+
+    assert du.fsync_honored(Wal, tmp_path)
+    assert not du.fsync_honored(fixtures.wal_skips_fsync, tmp_path)
+
+
+# ---- snapshot generations -------------------------------------------------
+
+def _mini_states(n=5):
+    s = ops.empty(8, 2, deferred_cap=2, batch=(2,))
+    out = [s]
+    for i in range(1, n):
+        ctr = out[-1].ctr.at[i % 2, i % 8, i % 2].set(i)
+        out.append(out[-1]._replace(
+            ctr=ctr, top=jnp.maximum(out[-1].top, jnp.max(ctr, axis=1))
+        ))
+    return out
+
+
+def test_snapshot_retain_and_fallback(tmp_path):
+    d = tmp_path / "snap"
+    states = _mini_states()
+    for i, s in enumerate(states[1:], 1):
+        snap.save_state(d, "orswot", s, wal_seq=i, retain=2)
+    gens = snap.generations(d)
+    assert len(gens) == 2, "retain=2 must prune older generations"
+    payload, info = snap.load_newest(d, states[0])
+    assert info.wal_seq == 4 and tree_eq(payload, states[4])
+    # Corrupt the newest -> fall back one generation (longer replay).
+    before = metrics.snapshot()["counters"].get(
+        "durability.snapshot_fallback", 0
+    )
+    snap.corrupt_generation(d, gens[-1])
+    payload, info = snap.load_newest(d, states[0])
+    assert info.wal_seq == 3 and tree_eq(payload, states[3])
+    after = metrics.snapshot()["counters"]["durability.snapshot_fallback"]
+    assert after == before + 1
+    # Corrupt the survivor too -> nothing valid left.
+    snap.corrupt_generation(d, gens[-2])
+    with pytest.raises(snap.SnapshotCorrupt):
+        snap.load_newest(d, states[0])
+
+
+def test_snapshot_loader_detector_and_broken_twin():
+    from crdt_tpu.analysis import fixtures
+
+    assert snap.loader_detects_corruption(
+        lambda d, t: snap.load_newest(d, t)
+    )
+    assert not snap.loader_detects_corruption(
+        fixtures.snapshot_load_unchecked
+    )
+
+
+def _mini_model(extra=()):
+    from test_orswot import add
+
+    from crdt_tpu import Orswot
+    from crdt_tpu.models import BatchedOrswot
+    from crdt_tpu.utils import Interner
+
+    members, actors = Interner(range(8)), Interner(["A", "B"])
+    a, b = Orswot(), Orswot()
+    add(a, "A", 1)
+    add(b, "B", 2)
+    for site, member in extra:
+        add(a if site == 0 else b, "A" if site == 0 else "B", member)
+    return (
+        BatchedOrswot.from_pure([a, b], members=members, actors=actors),
+        (a, b),
+    )
+
+
+def test_snapshot_model_payload_roundtrip(tmp_path):
+    model, (a, b) = _mini_model()
+    snap.save(tmp_path / "snap", model, wal_seq=0)
+    restored, info = snap.load_newest(tmp_path / "snap")
+    assert info.payload_kind == "model"
+    assert tree_eq(restored.state, model.state)
+    assert restored.to_pure(0) == a and restored.to_pure(1) == b
+
+
+# ---- checkpoint satellites ------------------------------------------------
+
+def test_checkpoint_corrupt_raises_named(tmp_path):
+    import io
+    import json
+
+    model, _ = _mini_model()
+    path = tmp_path / "ck.npz"
+    checkpoint.save(path, model)
+    # Internally-consistent rot: perturb one array, re-serialize with
+    # the ORIGINAL meta (stale checksums) — the zip layer stays happy,
+    # only the recorded content checksums can catch it.
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+        arrays = {k: np.array(z[k]) for k in z.files if k != "meta"}
+    victim = sorted(k for k in arrays if arrays[k].size)[0]
+    flat = arrays[victim].reshape(-1)
+    flat[0] = flat[0] + 1 if flat.dtype.kind in "iuf" else 1
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        meta=np.frombuffer(
+            json.dumps(meta, sort_keys=True).encode("utf-8"), dtype=np.uint8
+        ),
+        **arrays,
+    )
+    path.write_bytes(buf.getvalue())
+    with pytest.raises(checkpoint.CheckpointCorrupt) as exc:
+        checkpoint.load(path)
+    assert exc.value.array == victim
+
+    # A DROPPED array (still listed in the recorded checksums) must
+    # also refuse with its name — not leak a KeyError out of restore.
+    arrays2 = dict(arrays)
+    arrays2.pop(victim)
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        meta=np.frombuffer(
+            json.dumps(meta, sort_keys=True).encode("utf-8"), dtype=np.uint8
+        ),
+        **arrays2,
+    )
+    path.write_bytes(buf.getvalue())
+    with pytest.raises(checkpoint.CheckpointCorrupt) as exc:
+        checkpoint.load(path)
+    assert exc.value.array == victim and "MISSING" in str(exc.value)
+
+
+def test_checkpoint_checksumless_loads_with_one_shot_warning(tmp_path):
+    import io
+    import json
+    import warnings
+
+    model, (a, b) = _mini_model()
+    path = tmp_path / "old.npz"
+    checkpoint.save(path, model)
+    # Strip the checksums — the pre-ISSUE-10 file format.
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+        arrays = {k: np.array(z[k]) for k in z.files if k != "meta"}
+    meta.pop("checksums")
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        meta=np.frombuffer(
+            json.dumps(meta, sort_keys=True).encode("utf-8"), dtype=np.uint8
+        ),
+        **arrays,
+    )
+    path.write_bytes(buf.getvalue())
+    checkpoint._WARNED_NO_CHECKSUMS = False
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        m1 = checkpoint.load(path)
+        m2 = checkpoint.load(path)
+    msgs = [w for w in caught if "checksums" in str(w.message)]
+    assert len(msgs) == 1, "the unverified-load warning must fire ONCE"
+    assert m1.to_pure(0) == a and m2.to_pure(1) == b
+
+
+# ---- recovery: δ-ring wiring ---------------------------------------------
+
+def test_delta_ring_wal_recovery_bit_identical(tmp_path):
+    mesh = make_mesh(8, 1)
+    P, E, A = 8, 32, 4
+    state = ops.empty(E, A, deferred_cap=4, batch=(P,))
+    ctr = state.ctr.at[jnp.arange(P), jnp.arange(P), jnp.arange(P) % A].set(1)
+    state = state._replace(ctr=ctr, top=jnp.max(ctr, axis=1))
+    dirty = jnp.zeros((P, E), bool).at[jnp.arange(P), jnp.arange(P)].set(True)
+    fctx = jnp.where(dirty[..., None], ctr, 0)
+    genesis = state
+
+    w = Wal(tmp_path / "wal", fsync="on_round")
+    out1 = mesh_delta_gossip(state, dirty, fctx, mesh, telemetry=True, wal=w)
+    tel = out1[4]
+    assert float(tel.wal_bytes) > 0 and int(tel.wal_fsyncs) >= 1
+    # Snapshot between the rounds: recovery must replay only round 2.
+    snap.save_state(tmp_path / "snap", "orswot", out1[0],
+                    wal_seq=w.last_seq, retain=2)
+    st2 = out1[0]
+    ctr2 = st2.ctr.at[jnp.arange(P), jnp.arange(P) + 8, 0].set(2)
+    st2 = st2._replace(
+        ctr=ctr2, top=jnp.maximum(st2.top, jnp.max(ctr2, axis=1))
+    )
+    d2 = jnp.zeros((P, E), bool).at[jnp.arange(P), jnp.arange(P) + 8].set(True)
+    f2 = jnp.where(d2[..., None], ctr2, 0)
+    final = mesh_delta_gossip(st2, d2, f2, mesh, wal=w)[0]
+    w.close()
+
+    # "Restart": recover from disk alone.
+    w2 = Wal(tmp_path / "wal")
+    got, rep = du.recover_state(
+        tmp_path / "snap", w2, genesis, kind="orswot"
+    )
+    assert rep.generation == 1 and rep.replayed_records == 1
+    assert tree_eq(got, final)
+
+    # ISSUE 10 acceptance: a SECOND generation at the final state,
+    # then corrupt it — recovery must fall back to generation 1 and
+    # replay the LONGER suffix, still landing bit-identical.
+    snap.save_state(tmp_path / "snap", "orswot", final,
+                    wal_seq=w2.last_seq, retain=2)
+    snap.corrupt_generation(
+        tmp_path / "snap", snap.generations(tmp_path / "snap")[-1]
+    )
+    got2, rep2 = du.recover_state(
+        tmp_path / "snap", w2, genesis, kind="orswot"
+    )
+    w2.close()
+    assert rep2.generation == 1 and rep2.snapshot_fallbacks == 1
+    assert rep2.replayed_records == 1  # the longer suffix re-replays
+    assert tree_eq(got2, final)
+
+
+def test_wal_widen_falls_back_to_full_state_record(tmp_path):
+    # A shape change between appends (the elastic-widen case) must log
+    # a full-state record and replay bit-identically across it.
+    s_small = ops.empty(8, 2, deferred_cap=2, batch=(2,))
+    s_small = s_small._replace(top=s_small.top.at[0, 0].set(1))
+    s_big = ops.empty(16, 2, deferred_cap=2, batch=(2,))
+    s_big = s_big._replace(top=s_big.top.at[1, 1].set(2))
+    with Wal(tmp_path / "wal") as w:
+        w.attach(s_small)
+        w.append_state("orswot", s_small._replace(
+            top=s_small.top.at[1, 0].set(3)
+        ))
+        w.append_state("orswot", s_big)  # widened: full-state fallback
+        metas = [m for _, m, _ in w.records()]
+    assert [m["rtype"] for m in metas] == ["delta", "state"]
+    w2 = Wal(tmp_path / "wal")
+    got, n, n_full = du.replay(w2, s_small, "orswot", 0)
+    w2.close()
+    assert (n, n_full) == (2, 1)
+    assert tree_eq(got, s_big)
+
+
+# ---- recovery: model flavor ----------------------------------------------
+
+def test_recover_model_snapshot_plus_suffix(tmp_path):
+    from test_orswot import add
+
+    from crdt_tpu import Orswot
+    from crdt_tpu.models import BatchedOrswot
+    from crdt_tpu.utils import Interner
+
+    members, actors = Interner(range(8)), Interner(["A", "B"])
+    a, b = Orswot(), Orswot()
+    add(a, "A", 1)
+    add(b, "B", 2)
+    mk = lambda: BatchedOrswot.from_pure(
+        [a, b], members=members, actors=actors
+    )
+    model = mk()
+    w = Wal(tmp_path / "wal")
+    w.attach(model.state)
+    snap.save(tmp_path / "snap", model, wal_seq=0)
+    # Two post-snapshot transitions, each logged as a δ record.
+    add(a, "A", 3)
+    model = mk()
+    w.append_state("orswot", model.state)
+    add(b, "B", 4)
+    model = mk()
+    w.append_state("orswot", model.state)
+    want = model.state
+    w.close()
+
+    w2 = Wal(tmp_path / "wal")
+    restored, rep = du.recover_model(tmp_path / "snap", w2)
+    w2.close()
+    assert rep.replayed_records == 2
+    assert tree_eq(restored.state, want)
+    assert restored.to_pure(0) == a and restored.to_pure(1) == b
+
+
+# ---- stream durable resume ------------------------------------------------
+
+def test_stream_wal_resume_after_interrupt(tmp_path):
+    from crdt_tpu.analysis import gate_states as gs
+    from crdt_tpu.parallel import iter_blocks, mesh_stream_fold_sparse
+    from crdt_tpu.parallel.stream import StreamInterrupted
+
+    mesh = make_mesh(8, 1)
+    pop = gs.mk_sparse(12)
+    blocks = list(iter_blocks(pop, 4))
+    want, _ = mesh_stream_fold_sparse(blocks, mesh)
+
+    def dying_source():
+        yield blocks[0]
+        yield blocks[1]
+        raise OSError("host shard went away")
+
+    w = Wal(tmp_path / "wal")
+    with pytest.raises(StreamInterrupted):
+        mesh_stream_fold_sparse(dying_source(), mesh, wal=w, wal_every=1)
+    w.close()
+
+    # "Restart": the resume point comes from DISK, not the exception.
+    w2 = Wal(tmp_path / "wal")
+    template = jax.tree.map(lambda x: x[0], pop)
+    acc, done = du.load_stream_resume(w2, template)
+    assert done == 2
+    got, _ = mesh_stream_fold_sparse(
+        blocks[done:], mesh, init=acc, wal=w2, wal_every=1, wal_base=done,
+    )
+    final = du.load_stream_resume(w2, template)
+    w2.close()
+    assert tree_eq(got, want)
+    # Resume records carry ABSOLUTE source indices: the resumed run
+    # passed wal_base=done, so a second kill would still point at the
+    # true position in the original block list.
+    assert final[1] == len(blocks)
+
+
+# ---- log-suffix rejoin ----------------------------------------------------
+
+def test_rejoin_ships_fraction_and_lands_bit_identical():
+    # Shapes where the content plane dominates: the decomposition's
+    # residual (top + parked dmask [D, E] + the valid mask) rides
+    # whole, so the ratio floor is (D+1)/(4A+D+1)-ish — A=8, D=2 puts
+    # a one-row divergence far under the 25% rejoin gate.
+    E, A = 2048, 8
+    base = jnp.zeros((E, A), jnp.uint32).at[: E // 2, 0].set(1)
+    live = ops.empty(E, A, deferred_cap=2)
+    live = live._replace(
+        ctr=base.at[E // 2, 1].set(3), top=jnp.zeros((A,), jnp.uint32)
+    )
+    live = live._replace(top=jnp.max(live.ctr, axis=0))
+    recovered = live._replace(
+        ctr=base, top=jnp.max(base, axis=0)
+    )
+    healed, rep = du.rejoin("orswot", live, recovered)
+    mk = get_merge_kind("orswot")
+    want = mk.join(live, recovered)
+    want = want[0] if isinstance(want, tuple) else want
+    assert tree_eq(healed, want)
+    assert rep.ratio < 0.25, (
+        f"one divergent row must ship a fraction, not {rep.ratio:.1%}"
+    )
+    assert rep.lanes_shipped == 1
+
+
+# ---- crashpoint fuzz ------------------------------------------------------
+
+ALL_KINDS = tuple(sorted(d.name for d in decomposers()))
+ALL_CRASHPOINTS = cp.registered()
+
+
+def _kind_states(kind: str, n: int = 6):
+    """A same-shape state sequence for ``kind`` (registry small
+    domain, cycled up to n; [0] is the identity — the genesis)."""
+    ss = get_merge_kind(kind).states()
+    return [ss[i % len(ss)] for i in range(n)]
+
+
+def _fuzz_workload(root: str, kind: str, states) -> None:
+    """The per-kind durable workload the crashpoint kills: δ records
+    over the real decomposition (rotation-forcing segments), TWO
+    snapshots with retain=1 so the prune boundary is crossed."""
+    w = Wal(
+        os.path.join(root, "wal"), fsync="every_n", every_n=1,
+        segment_bytes=512,
+    )
+    w.attach(states[0])
+    sdir = os.path.join(root, "snap")
+    for i, s in enumerate(states[1:], 1):
+        w.append_state(kind, s, batched=False)
+        if i in (2, 4):
+            snap.save_state(sdir, kind, s, wal_seq=w.last_seq, retain=1)
+    w.close()
+
+
+def _fuzz_recover(root: str, kind: str, states):
+    """Recover and return ``(got, want)`` — want is the state of the
+    last DURABLE record (seq indexes the transition list)."""
+    w = Wal(os.path.join(root, "wal"))
+    try:
+        got, _ = du.recover_state(
+            os.path.join(root, "snap"), w, states[0], kind=kind,
+            default=states[0],
+        )
+        return got, states[w.last_seq]
+    finally:
+        w.close()
+
+
+def _fuzz_one(tmp_path, kind: str, point: str) -> None:
+    states = _kind_states(kind)
+    root = str(tmp_path / f"{kind}-{point.replace('.', '-')}")
+    os.makedirs(root)
+    failures = cp.fuzz(
+        lambda name: _fuzz_workload(root, kind, states),
+        lambda: _fuzz_recover(root, kind, states),
+        tree_eq,
+        names=(point,),
+    )
+    assert not failures, f"kind {kind}: {failures}"
+
+
+@pytest.mark.parametrize(
+    "point,kind",
+    [
+        (point, ALL_KINDS[i % len(ALL_KINDS)])
+        for i, point in enumerate(ALL_CRASHPOINTS)
+    ],
+    ids=[
+        f"{point}-{ALL_KINDS[i % len(ALL_KINDS)]}"
+        for i, point in enumerate(ALL_CRASHPOINTS)
+    ],
+)
+def test_crashpoint_fuzz_diagonal(tmp_path, point, kind):
+    """Tier-1: every crashpoint once, kinds cycled (the representative
+    diagonal; the full crashpoint × kind matrix is the slow-tier
+    cousin below)."""
+    _fuzz_one(tmp_path, kind, point)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ALL_KINDS)
+def test_crashpoint_fuzz_full_matrix(tmp_path, kind):
+    """Slow tier: the FULL crashpoint sweep for every registered kind
+    (faster in-tier cousin: test_crashpoint_fuzz_diagonal)."""
+    for point in ALL_CRASHPOINTS:
+        _fuzz_one(tmp_path, kind, point)
+
+
+def test_all_twelve_kinds_covered_across_tiers():
+    """The ISSUE 10 acceptance bookkeeping: the diagonal + full matrix
+    together cover all 12 registered kinds, and the diagonal alone
+    already cycles through every kind (15 crashpoints >= 12 kinds)."""
+    assert len(ALL_KINDS) == 12
+    diag_kinds = {
+        ALL_KINDS[i % len(ALL_KINDS)]
+        for i in range(len(ALL_CRASHPOINTS))
+    }
+    assert diag_kinds == set(ALL_KINDS)
+
+
+def test_durability_static_checks_clean():
+    assert du.static_checks() == []
+
+
+def test_telemetry_durability_fields_roundtrip(tmp_path):
+    import sys
+
+    from crdt_tpu import exporter, telemetry as tele
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(root, "tools"))
+    import check_telemetry_schema as cts
+
+    t = tele.zeros()._replace(
+        wal_bytes=jnp.float32(1234.0),
+        wal_fsyncs=jnp.uint32(3),
+        snapshots_written=jnp.uint32(1),
+        replayed_records=jnp.uint32(7),
+        torn_tail_truncated=jnp.uint32(1),
+        recovery_rounds=jnp.uint32(2),
+    )
+    d = tele.to_dict(t)
+    assert d["wal_bytes"] == 1234.0 and d["replayed_records"] == 7
+    # combine() adds the durability throughput counters.
+    both = tele.to_dict(tele.combine(t, t))
+    assert both["wal_fsyncs"] == 6 and both["recovery_rounds"] == 4
+    # The exporter's telemetry record validates against the schema.
+    out = tmp_path / "tel.jsonl"
+    exporter.drain_jsonl(str(out), telemetry={"durability_test": t})
+    assert cts.validate_jsonl(str(out)) == []

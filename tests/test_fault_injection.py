@@ -339,3 +339,81 @@ def test_stream_interrupted_resumes_bit_identical(seed):
         raise AssertionError("the dying source must interrupt the stream")
     after = metrics.snapshot()["counters"].get("stream.interrupted", 0)
     assert after > before
+
+
+@given(seeds)
+@settings(max_examples=10, deadline=None)
+def test_retry_exhaustion_resumes_from_last_good_and_converges(seed):
+    """DCN retry exhaustion end to end (crdt_tpu/faults/retry.py, the
+    ISSUE 10 satellite): a watermarked cross-site op exchange rides
+    ``with_retries``; the transport dies hard enough to exhaust the
+    whole budget, the raised ``DcnExchangeFailed`` CARRIES the
+    last-good watermark (ops below it are already on both sides), and
+    a later resync resuming FROM that carried state converges
+    bit-identical to the failure-free run."""
+    import pytest
+
+    from crdt_tpu.faults import DcnExchangeFailed, RetryPolicy, with_retries
+
+    rng = random.Random(seed)
+    sites, streams = _mint_streams(rng, 2, 12)
+    a, b = sites
+    sa, sb = streams
+    hi = max(len(sa), len(sb))
+
+    # Failure-free oracle: full cross-delivery on clones.
+    oa, ob = a.clone(), b.clone()
+    for op in sb:
+        oa.apply(op)
+    for op in sa:
+        ob.apply(op)
+    oa.merge(ob.clone())
+    ob.merge(oa.clone())
+    assert oa.read().val == ob.read().val
+
+    # The watermark advances per DELIVERED index — exactly what
+    # sync_list carries: ops below it are already everywhere, and
+    # re-shipping them anyway would be absorbed (idempotent apply).
+    state = {"watermark": 0}
+    die_at = rng.randrange(0, hi)
+
+    def exchange(transport):
+        for i in range(state["watermark"], hi):
+            batch = []
+            if i < len(sa):
+                batch.append((b, sa[i]))
+            if i < len(sb):
+                batch.append((a, sb[i]))
+            transport(i)
+            for site, op in batch:
+                site.apply(op)
+            state["watermark"] = i + 1
+        return state["watermark"]
+
+    def flaky(i):
+        if i >= die_at:
+            raise ConnectionError("DCN link down")
+
+    sleeps = []
+    policy = RetryPolicy(attempts=3, base_delay=0.0, jitter=0.0, seed=seed)
+    with pytest.raises(DcnExchangeFailed) as excinfo:
+        with_retries(
+            lambda: exchange(flaky), policy, op="op-sync",
+            last_good=state, sleep=sleeps.append,
+        )
+    exc = excinfo.value
+    assert exc.attempts == 3 and len(sleeps) == 2
+    assert isinstance(exc.cause, ConnectionError)
+    carried = exc.last_good["watermark"]
+    assert carried == die_at  # everything before the outage stuck
+
+    # "Later": the outage heals; resume from the CARRIED state, not
+    # from scratch — the exchange ships only the suffix.
+    shipped = []
+    done = with_retries(
+        lambda: exchange(shipped.append), policy, op="op-sync",
+        last_good=state,
+    )
+    assert done == hi
+    assert shipped == list(range(carried, hi))  # suffix-only resync
+    assert a.read().val == b.read().val == oa.read().val

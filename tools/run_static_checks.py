@@ -27,6 +27,16 @@ Sections (each timed, each independently skippable):
   class), and the eviction-bijection gate (ring_perm stays a true
   bijection under every eviction subset) — each with a committed broken
   twin in analysis/fixtures.py proving the detector fires.
+- ``durability``— the crash-consistent durability gates
+  (crdt_tpu.durability.static_checks): crashpoint registry coverage
+  (every registered durability I/O boundary must be crossed by the
+  canonical workload), the kill-then-recover contract at EVERY
+  crashpoint (recovery lands the last durable record bit-identically),
+  and the broken-twin detector gates — the no-fsync WAL
+  (``analysis.fixtures.wal_skips_fsync``) must fail the fsync-policy
+  detector and the checksum-ignoring snapshot loader
+  (``fixtures.snapshot_load_unchecked``) must fail the corruption
+  detector.
 - ``decomp``    — the join-irreducible decomposition gates
   (crdt_tpu.delta_opt.static_checks): registry coverage (every merge
   kind must have registered a decomposition —
@@ -83,7 +93,7 @@ sys.path.insert(0, ROOT)
 
 SECTIONS = (
     "lint", "schema", "laws", "schedules", "faults", "decomp",
-    "jit-lint", "cost", "aliasing",
+    "durability", "jit-lint", "cost", "aliasing",
 )
 
 # Directories the fallback linter walks (ruff takes its own config).
@@ -237,6 +247,12 @@ def run_decomp():
     return static_checks()
 
 
+def run_durability():
+    from crdt_tpu.durability import static_checks
+
+    return static_checks()
+
+
 def run_jit_lint():
     from crdt_tpu.analysis.jit_lint import check_gates, lint_entry_points
 
@@ -271,14 +287,15 @@ RUNNERS = {
     "schedules": run_schedules,
     "faults": run_faults,
     "decomp": run_decomp,
+    "durability": run_durability,
     "jit-lint": run_jit_lint,
     "cost": run_cost,
     "aliasing": run_aliasing,
 }
 
 _JAX_SECTIONS = (
-    "laws", "schedules", "faults", "decomp", "jit-lint", "cost",
-    "aliasing",
+    "laws", "schedules", "faults", "decomp", "durability", "jit-lint",
+    "cost", "aliasing",
 )
 
 
