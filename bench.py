@@ -1113,6 +1113,200 @@ def bench_recovery():
     }]
 
 
+def bench_scaleout():
+    """Elastic mesh scale-out leg (``--scaleout`` runs it alone; ISSUE
+    11's acceptance gate), one resize trajectory on the 8-rank axis:
+
+    1. **plateau** — the mesh serves a δ-gossip workload on P-2 live
+       ranks (the other two parked — newcomer self-loops), sustained
+       replica-join throughput TIMED over warmed runs, every converged
+       read asserted bit-identical to the fixed-width oracle.
+    2. **scale-out** — a traffic spike drives the Autoscaler's folded
+       pressure to 1.0; after the debounce clears it recommends admits,
+       and both parked ranks JOIN live: bootstrapped by decomposition
+       lanes (cold, from ⊥), ring re-traced under a bumped generation.
+       Sustained merges/s is re-measured on the widened mesh and must
+       RISE over the pre-admit plateau; reads stay bit-identical.
+    3. **warm-start gate** — a separate snapshot-based bootstrap (the
+       PR 10 tier as the causal lower bound) must ship < 25% of
+       full-state bytes — the log-suffix path, measured, asserted.
+    4. **scale-in** — quiet traffic debounces a drain vote; the drained
+       rank flushes, its drain-complete certificate must hold
+       (residue == 0, nothing lost, zero unacked out-lanes), the row
+       parks, and the narrowed mesh still reads bit-identical.
+
+    The damage-free capacity trajectory (merges/s before/after, the
+    bootstrap byte ratios, the certificate) is the metric."""
+    import random
+
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu import elastic, telemetry as tele
+    from crdt_tpu.faults.scenarios import genesis_tracking, mint_streams
+    from crdt_tpu.models import BatchedOrswot
+    from crdt_tpu.ops import orswot as ops
+    from crdt_tpu.parallel import make_mesh, mesh_delta_gossip, mesh_gossip
+    from crdt_tpu.parallel.mesh import shard_orswot
+    from crdt_tpu.scaleout import Autoscaler, ScaleoutMesh, bootstrap, park_row
+    from crdt_tpu.utils import Interner
+
+    n_dev = len(jax.devices())
+    if n_dev < 4:
+        log("scaleout leg needs >= 4 devices; skipping")
+        return []
+    p = min(n_dev, 8)
+    runs = int(os.environ.get("BENCH_SCALEOUT_RUNS", 4))
+    seed = int(os.environ.get("BENCH_SCALEOUT_SEED", 23))
+    rng = random.Random(seed)
+    live0 = p - 2
+    sites, _ = mint_streams(rng, live0, 6 * p)
+    batched = BatchedOrswot.from_pure(
+        sites,
+        members=Interner(list(range(5))),
+        actors=Interner([f"s{i}" for i in range(p)]),
+    )
+    mesh = make_mesh(p, 1)
+    cur = shard_orswot(batched.state, mesh)
+    sm = ScaleoutMesh(p, live=range(live0))
+    policy = elastic.ElasticPolicy(
+        low_water=0.2, shrink_rounds=2, high_water=0.8, widen_rounds=2
+    )
+    autoscaler = Autoscaler(sm, policy, min_live=2)
+    fix = jax.tree.map(
+        lambda x: x[0], mesh_gossip(cur, mesh, local_fold="tree")[0]
+    )
+
+    tracking = genesis_tracking
+
+    def identical(rows) -> bool:
+        return all(
+            all(
+                bool(jnp.array_equal(x, y))
+                for x, y in zip(
+                    jax.tree.leaves(jax.tree.map(lambda v: v[i], rows)),
+                    jax.tree.leaves(fix),
+                )
+            )
+            for i in sm.live()
+        )
+
+    # One ring round-trip per run; replica joins applied by LIVE ranks
+    # only (parked self-loop applies are deselected no-ops), so the
+    # honest sustained rate is live_ranks x ring rounds per wall
+    # second — the quantity more chips must raise.
+    rounds = 2 * (p - 1) - 1  # the pipelined certificate window
+
+    def measure(state):
+        plan = sm.plan()
+        d, f = tracking(state)  # warmup: compile this membership's ring
+        warm = mesh_delta_gossip(state, d, f, mesh, local_fold="tree",
+                                 faults=plan)
+        jax.block_until_ready(jax.tree.leaves(warm[0]))
+        state, res = warm[0], int(warm[3])
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            d, f = tracking(state)
+            out = mesh_delta_gossip(state, d, f, mesh, local_fold="tree",
+                                    faults=plan)
+            state, res = out[0], int(out[3])
+        jax.block_until_ready(jax.tree.leaves(state))
+        dt = time.perf_counter() - t0
+        joins = len(sm.live()) * rounds * runs
+        return state, res, joins / dt, dt
+
+    # 1. plateau at P-2.
+    cur, res_pre, rate_pre, pre_s = measure(cur)
+    assert res_pre == 0, "plateau must certify"
+    assert identical(cur), "plateau reads diverged from the oracle"
+
+    # 2. spike -> debounced admits -> widened mesh.
+    admits = 0
+    boot_reports = []
+    while sm.parked:
+        dec = autoscaler.observe(load=1.0)
+        if dec is None:
+            continue
+        assert dec.action == "admit"
+        cur, rep = sm.admit(1, kind="orswot", rows=cur)
+        boot_reports.extend(rep.bootstraps)
+        admits += 1
+    cur, res_post, rate_post, post_s = measure(cur)
+    assert res_post == 0, "widened mesh must certify"
+    assert identical(cur), "post-admit reads diverged from the oracle"
+    gain = rate_post / rate_pre if rate_pre else 0.0
+    assert rate_post > rate_pre, (
+        f"admit must raise sustained merges/s "
+        f"({rate_pre:.0f} -> {rate_post:.0f})"
+    )
+
+    # 3. warm-start byte gate: snapshot base ships only the log suffix.
+    e_w, a_w = 512, 8
+    empty_w = ops.empty(e_w, a_w, 2)
+    snap_base = empty_w._replace(
+        ctr=empty_w.ctr.at[: e_w // 3, 0].set(1)
+    )
+    live_w = snap_base._replace(
+        ctr=snap_base.ctr.at[: e_w // 25, 1].set(2),
+        top=snap_base.top.at[0].set(1).at[1].set(2),
+    )
+    _, warm_rep = bootstrap("orswot", live_w, base=snap_base)
+    assert warm_rep.ratio < 0.25, (
+        f"warm bootstrap shipped {warm_rep.ratio:.1%} of full-state bytes"
+    )
+
+    # 4. quiet -> debounced drain -> certified scale-in.
+    dec = None
+    while dec is None:
+        dec = autoscaler.observe(load=0.0)
+    assert dec.action == "drain"
+    d, f = tracking(cur)
+    flush = mesh_delta_gossip(cur, d, f, mesh, local_fold="tree",
+                              faults=sm.plan())
+    cert = sm.drain(dec.rank, kind="orswot", rows=flush[0],
+                    residue=int(flush[3]))
+    cur = park_row(flush[0], dec.rank)
+    cur, res_in, rate_in, _ = measure(cur)
+    assert res_in == 0 and identical(cur), (
+        "post-drain reads diverged from the oracle"
+    )
+
+    tel = sm.annotate(tele.zeros())
+    tele.record("scaleout", tel)
+    cold_ratio = (
+        sum(r.ratio for r in boot_reports) / len(boot_reports)
+        if boot_reports else 0.0
+    )
+    log(
+        f"config-scaleout: {p}-rank axis {live0}->{p}->{p - 1} live: "
+        f"sustained {rate_pre:.0f} -> {rate_post:.0f} joins/s "
+        f"({gain:.2f}x) across the admit, warm bootstrap "
+        f"{warm_rep.ratio:.1%} of full-state bytes (cold {cold_ratio:.1%}), "
+        f"drain rank {dec.rank} certified (residue {cert.residue}, "
+        f"unacked {cert.lanes_unacked}) at generation {sm.generation}; "
+        f"reads bit-identical in both directions"
+    )
+    return [{
+        "config": "scaleout", "metric": "scaleout_merge_rate_gain",
+        "value": round(gain, 3), "unit": "x",
+        "merges_per_s_pre_admit": round(rate_pre, 1),
+        "merges_per_s_post_admit": round(rate_post, 1),
+        "merges_per_s_post_drain": round(rate_in, 1),
+        "live_ranks_trajectory": [live0, p, p - 1],
+        "admits": admits, "drains": 1,
+        "bootstrap_cold_ratio": round(cold_ratio, 4),
+        "bootstrap_warm_ratio": round(warm_rep.ratio, 4),
+        "bootstrap_bytes": round(sm.bootstrap_bytes, 1),
+        "drain_residue": cert.residue,
+        "drain_lanes_unacked": cert.lanes_unacked,
+        "drain_packets_lost": cert.packets_lost,
+        "generation": sm.generation,
+        "bit_identical": True,
+        "runs": runs,
+        "shape": f"{p}x{cur.ctr.shape[-2]}",
+    }]
+
+
 def bench_cpu() -> float:
     from crdt_tpu.pure.orswot import Orswot
     from crdt_tpu.vclock import VClock
@@ -1938,6 +2132,14 @@ def parse_args(argv=None):
              "full-state resync) and print its record to stdout",
     )
     ap.add_argument(
+        "--scaleout",
+        action="store_true",
+        help="run ONLY the elastic mesh scale-out leg (mid-run admit "
+             "raising sustained merges/s, warm-start bootstrap bytes, "
+             "certified drain, bit-identical to the fixed-width oracle "
+             "in both directions) and print its record to stdout",
+    )
+    ap.add_argument(
         "--flagship",
         action="store_true",
         help="run ONLY the flagship replica-streaming leg (10,240 "
@@ -1968,6 +2170,21 @@ def main(argv=None):
         )
         log(json.dumps(rec))
         print(json.dumps(rec))
+        return
+    if args.scaleout:
+        # The fast scaleout-only mode: one leg, one stdout JSON line.
+        if os.environ.get("BENCH_PROBE", "1") != "0" and not tpu_reachable():
+            from crdt_tpu.utils.cpu_pin import pin_cpu
+
+            pin_cpu(virtual_devices=8)
+        from crdt_tpu.telemetry import span
+
+        with span("bench.scaleout", quick=True):
+            recs = bench_scaleout()
+        for rec in recs:
+            log(json.dumps(rec))
+        print(json.dumps(recs[0] if recs else {"config": "scaleout",
+                                               "skipped": True}))
         return
     if args.recovery:
         # The fast recovery-only mode: one leg, one stdout JSON line.
@@ -2081,6 +2298,7 @@ def main(argv=None):
         ("chaos", bench_chaos),
         ("heal", bench_heal),
         ("recovery", bench_recovery),
+        ("scaleout", bench_scaleout),
     ]:
         if os.environ.get(f"BENCH_{name.upper()}", "1") != "0":
             try:
@@ -2213,6 +2431,21 @@ def main(argv=None):
                 "wal_bytes", "wal_fsyncs", "rejoin_bytes_shipped",
                 "rejoin_bytes_full_state", "bit_identical",
             ) if k in rv
+        }
+    # The scaleout leg rides the headline record too: the mid-run
+    # capacity trajectory (merges/s across the admit, the bootstrap
+    # byte ratios, the drain certificate) is ISSUE 11's metric of
+    # record, not a diagnostic.
+    sc = next((r for r in records if r.get("config") == "scaleout"), None)
+    if sc is not None:
+        headline["scaleout"] = {
+            k: sc[k] for k in (
+                "value", "merges_per_s_pre_admit",
+                "merges_per_s_post_admit", "merges_per_s_post_drain",
+                "live_ranks_trajectory", "bootstrap_cold_ratio",
+                "bootstrap_warm_ratio", "drain_residue",
+                "drain_lanes_unacked", "generation", "bit_identical",
+            ) if k in sc
         }
     # The flagship streaming record rides the headline too: it IS the
     # metric of record at the north-star shape (ROADMAP item 1) — the

@@ -427,3 +427,36 @@ def snapshot_load_unchecked(path, template=None):
     import jax
 
     return jax.tree.unflatten(jax.tree.structure(template), leaves)
+
+
+# ---- scale-out fixtures (crdt_tpu/scaleout/) ------------------------------
+
+def bootstrap_skips_checksum(kind, live, **kwargs):
+    """Broken scale-out twin: a newcomer bootstrap that trusts the wire
+    — checksum-rejected segments are JOINED instead of re-shipped, so a
+    wire-flipped lane reaches the newcomer's state. Exactly the
+    corruption class ``faults.integrity`` exists to stop, applied at
+    the one surface (bootstrap) that ships more bytes per event than
+    any ring round. ``scaleout.bootstrap_rejects_corruption`` must fail
+    it (the ``scaleout`` static-check section pins that the detector
+    fires)."""
+    from ..scaleout.bootstrap import bootstrap
+
+    kwargs["verify_checksums"] = False
+    return bootstrap(kind, live, **kwargs)
+
+
+def drain_ignores_unacked(kind, rank, rows, residue, counters=None, **kw):
+    """Broken scale-out twin: a drain certifier that zeroes the
+    unacked-out-lane count — it issues the drain-complete certificate
+    on residue alone, so a rank holding content no survivor has yet
+    confirmed "gracefully" leaves and strands it. The exact failure
+    graceful drain exists to prevent (vs eviction, which accepts it as
+    the price of a crash). ``scaleout.drain_refuses_unflushed`` must
+    fail it."""
+    from dataclasses import replace as _replace
+
+    from ..scaleout.mesh_scale import certify_drain
+
+    cert = certify_drain(kind, rank, rows, residue, counters, **kw)
+    return _replace(cert, lanes_unacked=0)

@@ -181,6 +181,22 @@ class Decomposer:
 
 
 @dataclass(frozen=True)
+class ScaleoutSurface:
+    """One registered elastic-membership surface (crdt_tpu/scaleout/):
+    a public operational symbol of the scaleout package — the
+    membership controller, the bootstrap shipper, the drain certifier,
+    the autoscaler, their detectors. Registration is the coverage
+    contract — the ``scaleout`` static-check section
+    (tools/run_static_checks.py, via ``crdt_tpu.scaleout.static_checks``)
+    fails discovery for any public scaleout symbol that forgot to
+    register, exactly like an unregistered join, mesh entry point, or
+    fault surface."""
+
+    name: str
+    module: str = ""
+
+
+@dataclass(frozen=True)
 class FaultSurface:
     """One registered fault-capable mesh entry (crdt_tpu/faults/): a
     public ``crdt_tpu.parallel`` callable that accepts a ``faults=``
@@ -199,6 +215,7 @@ _ENTRY: Dict[str, EntryPoint] = {}
 _COMPACT: Dict[str, Compactor] = {}
 _DECOMP: Dict[str, Decomposer] = {}
 _FAULT_SURFACES: Dict[str, FaultSurface] = {}
+_SCALEOUT_SURFACES: Dict[str, ScaleoutSurface] = {}
 
 # Public callables in crdt_tpu.parallel matching this are mesh entry
 # points and MUST be registered (gossip_elastic/delta_gossip_elastic are
@@ -316,6 +333,59 @@ def register_fault_surface(name: str, *, module: str = "") -> FaultSurface:
     fs = FaultSurface(name=name, module=module)
     _FAULT_SURFACES[name] = fs
     return fs
+
+
+def register_scaleout_surface(
+    name: str, *, module: str = ""
+) -> ScaleoutSurface:
+    ss = ScaleoutSurface(name=name, module=module)
+    _SCALEOUT_SURFACES[name] = ss
+    return ss
+
+
+def scaleout_surfaces() -> Tuple[ScaleoutSurface, ...]:
+    import crdt_tpu.scaleout  # noqa: F401  (registrations import-time)
+
+    return tuple(
+        _SCALEOUT_SURFACES[k] for k in sorted(_SCALEOUT_SURFACES)
+    )
+
+
+def unregistered_scaleout_surfaces() -> List[str]:
+    """Public OPERATIONAL symbols of ``crdt_tpu.scaleout`` that never
+    called :func:`register_scaleout_surface` — the discovery gate of
+    the ``scaleout`` static-check section. Same two-level walk as the
+    entry-point/fault gates (package surface + every submodule's own
+    definitions), so a symbol that skipped the ``__init__`` re-export
+    list cannot hide. Pure data carriers are exempt: NamedTuple
+    reports, frozen dataclass certificates, and exception types are
+    results, not surfaces."""
+    import dataclasses
+    import importlib
+    import inspect
+    import pkgutil
+
+    import crdt_tpu.scaleout as so
+
+    def is_surface(n: str, obj) -> bool:
+        if n.startswith("_") or not callable(obj):
+            return False
+        if inspect.isclass(obj):
+            if issubclass(obj, BaseException):
+                return False
+            if hasattr(obj, "_fields") or dataclasses.is_dataclass(obj):
+                return False
+        return getattr(obj, "__module__", "").startswith("crdt_tpu.scaleout")
+
+    found = {n for n in dir(so) if is_surface(n, getattr(so, n))}
+    for info in pkgutil.iter_modules(so.__path__):
+        mod = importlib.import_module(f"crdt_tpu.scaleout.{info.name}")
+        for n in dir(mod):
+            obj = getattr(mod, n)
+            if (is_surface(n, obj)
+                    and getattr(obj, "__module__", "") == mod.__name__):
+                found.add(n)
+    return sorted(found - set(_SCALEOUT_SURFACES))
 
 
 def fault_surfaces() -> Tuple[FaultSurface, ...]:

@@ -81,13 +81,23 @@ class ElasticPolicy:
     widen/shrink cannot thrash: :class:`Hysteresis` shrinks an axis
     only after its occupancy sat below ``low_water`` for
     ``shrink_rounds`` CONSECUTIVE observations, never below
-    ``shrink_floor`` lanes, and any widening resets the streak."""
+    ``shrink_floor`` lanes, and any widening resets the streak.
+
+    The widen half (``high_water`` / ``widen_rounds``, ISSUE 11) makes
+    the debounce SYMMETRIC for policy drivers that decide in both
+    directions (``Hysteresis.vote`` — the scaleout Autoscaler's
+    admit/drain governor): a pressure signal must sit at or above
+    ``high_water`` for ``widen_rounds`` consecutive observations before
+    a widen-direction decision fires. The original shrink-only fields
+    keep their exact semantics — ``observe`` is unchanged."""
 
     factor: float = 2.0
     max_migrations: int = 4
     low_water: float = 0.25
     shrink_rounds: int = 4
     shrink_floor: int = 8
+    high_water: float = 0.85
+    widen_rounds: int = 2
 
 
 DEFAULT_POLICY = ElasticPolicy()
@@ -385,20 +395,32 @@ def shrink(
 
 
 class Hysteresis:
-    """The shrink governor (reclaim/): call :meth:`observe` once per
-    gossip round and it narrows an axis only after occupancy sat below
-    ``policy.low_water`` for ``policy.shrink_rounds`` CONSECUTIVE
-    rounds — a single quiet round after a burst reclaims nothing, and a
-    widening (capacity grew between observations) resets every streak,
-    so the widen loop and the shrink loop cannot chase each other.
-    Composes with ``gossip_elastic``/``delta_gossip_elastic`` via their
-    ``reclaim=`` parameter the same way widening composes via overflow
-    recovery."""
+    """The symmetric widen/shrink governor.
+
+    The shrink half (reclaim/, the original contract): call
+    :meth:`observe` once per gossip round and it narrows an axis only
+    after occupancy sat below ``policy.low_water`` for
+    ``policy.shrink_rounds`` CONSECUTIVE rounds — a single quiet round
+    after a burst reclaims nothing, and a widening (capacity grew
+    between observations) resets every streak, so the widen loop and
+    the shrink loop cannot chase each other. Composes with
+    ``gossip_elastic``/``delta_gossip_elastic`` via their ``reclaim=``
+    parameter the same way widening composes via overflow recovery.
+
+    The widen half (ISSUE 11): :meth:`vote` is the direction-symmetric
+    debouncer over an arbitrary named pressure signal in [0, 1] —
+    ``high_water``/``widen_rounds`` gate the widen direction exactly as
+    ``low_water``/``shrink_rounds`` gate shrink. The scaleout
+    Autoscaler (crdt_tpu/scaleout/autoscaler.py) keys admit/drain
+    decisions on it; ``observe`` keeps its original shrink-only
+    behavior bit-for-bit (pinned by tests/test_elastic.py)."""
 
     def __init__(self, policy: ElasticPolicy = DEFAULT_POLICY):
         self.policy = policy
         self._streak: Dict[str, int] = {}
         self._caps: Dict[str, int] = {}
+        self._hot: Dict[str, int] = {}
+        self._cold: Dict[str, int] = {}
 
     def observe(
         self, model, policy: Optional[ElasticPolicy] = None
@@ -432,6 +454,44 @@ class Hysteresis:
             self._streak[axis] = 0
             self._caps[axis] = capacities(model)[axis]
         return shrunk
+
+    def vote(
+        self,
+        name: str,
+        pressure: float,
+        policy: Optional[ElasticPolicy] = None,
+    ) -> Optional[str]:
+        """One debounced decision on a named pressure signal in [0, 1]:
+        returns ``"widen"`` after ``pressure >= high_water`` held for
+        ``widen_rounds`` CONSECUTIVE calls, ``"shrink"`` after
+        ``pressure < low_water`` held for ``shrink_rounds``, else
+        ``None``. A mid-band or opposite-direction observation resets
+        BOTH streaks, and a fired vote resets its own — the debounce
+        re-arms, so a driver acting on the vote (the Autoscaler's
+        admit/drain) is never retriggered within the same debounce
+        window, while a plateau that PERSISTS past another full window
+        fires again (the driver absorbed one capacity move and the
+        pressure still stands — more moves are warranted). Signals are
+        independent per ``name`` (one governor can debounce several
+        meshes/axes)."""
+        policy = policy or self.policy
+        if not 0.0 <= pressure <= 1.0:
+            raise ValueError(f"pressure {pressure} not in [0, 1]")
+        hot = self._hot.get(name, 0)
+        cold = self._cold.get(name, 0)
+        if pressure >= policy.high_water:
+            hot, cold = hot + 1, 0
+        elif pressure < policy.low_water:
+            hot, cold = 0, cold + 1
+        else:
+            hot = cold = 0
+        decision = None
+        if hot >= policy.widen_rounds:
+            decision, hot = "widen", 0
+        elif cold >= policy.shrink_rounds:
+            decision, cold = "shrink", 0
+        self._hot[name], self._cold[name] = hot, cold
+        return decision
 
 
 def axes_for(model, exc: BaseException) -> Tuple[str, ...]:

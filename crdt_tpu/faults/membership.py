@@ -23,16 +23,31 @@ Protocol (the chaos tests and ``bench.py --chaos`` walk it end to end):
    state-driven resync (Enes et al. 1803.02750) — while it was out,
    the frontier may have advanced past its top and compaction may have
    retired parked slots it never saw, so δ re-entry from its stale
-   tracking is forbidden. Two sound resync forms: **full-state** (the
-   original contract — always available, ships a whole state), or,
-   since ISSUE 10, **log-suffix rejoin**
-   (``crdt_tpu.durability.recover.rejoin``) for a rank that recovered
-   locally from its snapshot + write-ahead δ-log: the live peer ships
-   only its join-irreducible decomposition over the recovered state
-   (reconstruction is positionally bit-exact whatever the bound, and
-   the final join keeps recovered-but-unreplicated local content) —
-   < 25% of full-state bytes on the ``bench.py --recovery`` gate. δ
-   re-entry from stale marks remains forbidden either way.
+   tracking is forbidden. THREE sound re-entry paths:
+
+   - **full-state resync** (the original contract — always available):
+     the rank's state is replaced wholesale by full-state gossip/fold
+     over a live replica; ships a whole state, needs no local
+     artifacts.
+   - **log-suffix rejoin** (ISSUE 10,
+     ``crdt_tpu.durability.recover.rejoin``) for a rank that recovered
+     locally from its snapshot + write-ahead δ-log: the live peer
+     ships only its join-irreducible decomposition over the recovered
+     state (reconstruction is positionally bit-exact whatever the
+     bound, and the final join keeps recovered-but-unreplicated local
+     content) — < 25% of full-state bytes on the ``bench.py
+     --recovery`` gate.
+   - **bootstrap-from-⊥** (ISSUE 11, ``crdt_tpu.scaleout.bootstrap``):
+     the rank re-enters as a NEW member through the scale-out admit
+     path — its causal lower bound is ⊥ (or a PR 10 snapshot as the
+     warm base, which again ships only the log suffix), the wire
+     carries segmented, integrity-checked ``decompose(live, base)``
+     lanes, and its pre-eviction identity (tracking, marks, window
+     state) is simply abandoned. This is the right exit when the
+     rank's local artifacts are gone or untrusted; membership-wise it
+     is ``ScaleoutMesh.admit``, not ``rejoin``.
+
+   δ re-entry from stale marks remains forbidden on every path.
 
 The liveness signal is receiver-measured: device p's ``miss_streak[p]``
 counts consecutive end-of-run rounds with nothing arriving on its

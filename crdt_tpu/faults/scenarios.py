@@ -45,6 +45,23 @@ def mint_streams(rng: random.Random, n_sites: int, n_ops: int,
     return sites, streams
 
 
+def genesis_tracking(state):
+    """δ-tracking (dirty, fctx) for a dense ORSWOT batch whose replicas
+    were last mutually synced at GENESIS — every live row marked dirty
+    with its own dots as context (``interval_accumulate`` from the
+    all-zero state). The bootstrap every chaos/scale-out scenario run
+    starts from; lived as per-file closure copies until ISSUE 11."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.delta import interval_accumulate
+
+    zero = jax.tree.map(jnp.zeros_like, state)
+    dirty = jnp.zeros(state.ctr.shape[:-1], bool)
+    fctx = jnp.zeros(state.ctr.shape, state.ctr.dtype)
+    return interval_accumulate(dirty, fctx, zero, state)
+
+
 def faulty_delivery(rng: random.Random, streams: List[list],
                     r_ix: int) -> list:
     """One receiver's faulty delivery schedule:
@@ -73,4 +90,6 @@ def faulty_delivery(rng: random.Random, streams: List[list],
     return merged
 
 
-__all__ = ["MEMBERS", "faulty_delivery", "mint_streams"]
+__all__ = [
+    "MEMBERS", "faulty_delivery", "genesis_tracking", "mint_streams",
+]

@@ -129,6 +129,13 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         replayed_records=jnp.zeros((), jnp.uint32),
         torn_tail_truncated=jnp.zeros((), jnp.uint32),
         recovery_rounds=jnp.zeros((), jnp.uint32),
+        # The scale-out fields are filled host-side by the membership
+        # controller (crdt_tpu/scaleout/ ScaleoutMesh.annotate) — never
+        # in-kernel.
+        live_ranks=jnp.zeros((), jnp.uint32),
+        scaleout_admits=jnp.zeros((), jnp.uint32),
+        scaleout_drains=jnp.zeros((), jnp.uint32),
+        bootstrap_bytes=jnp.zeros((), jnp.float32),
     )
 
 

@@ -1,0 +1,191 @@
+"""crdt_tpu.scaleout — elastic mesh scale-out (ISSUE 11).
+
+PR 8 let the mesh shrink under failure (suspicion → eviction) and
+PR 10 let a recovered rank come back; this package makes mesh shape an
+OPERATOR DECISION under traffic: live rank join, graceful drain, and
+policy-driven resizing. Three cooperating pieces (see each module's
+docstring):
+
+- :mod:`.mesh_scale` — the membership controller:
+  :class:`ScaleoutMesh` tracks the live set over a fixed physical axis
+  (parked ranks self-loop — ``inject.ring_perm``'s evicted self-loops
+  generalized to newcomers), rebuilds the ring under a **generation
+  stamp** on every transition (each generation is its own traced
+  program — the composed FaultPlan rides the jit-cache key), and
+  enforces the **drain-complete certificate**: ``residue == 0`` AND
+  nothing lost AND no out-lane unacked, measured by join-irreducible
+  decomposition against every survivor (:func:`certify_drain`). A
+  refused drain leaves the rank live.
+- :mod:`.bootstrap` — newcomer bootstrap: ship
+  ``decompose(live, ⊥-or-snapshot)`` divergence lanes (the PR 9/10
+  rejoin path generalized to empty bases; a PR 10 snapshot is the
+  warm-start base that ships only the log suffix), segmented over an
+  optionally faulted wire — dropped segments re-ship, checksum-rejected
+  segments never join — landing the live state bit-exactly.
+- :mod:`.autoscaler` — the policy half: fold ``widen_pressure``,
+  ``frontier_lag``, streaming overlap misses, and DCN retries into one
+  load signal and debounce it through ``elastic.Hysteresis.vote``
+  (the symmetric widen/shrink governor) into admit/drain
+  recommendations.
+
+Plus :func:`static_checks` — the ``scaleout`` section of
+tools/run_static_checks.py: surface-registry coverage, the
+generation/bijection walk, and the broken-twin detector gates (the
+corrupt-blind bootstrap and the unacked-blind drain certifier in
+``analysis.fixtures`` must each be caught).
+
+Flags-off contract: a full-membership ``ScaleoutMesh`` composes to NO
+fault plan (``plan()`` → ``None``), so a mesh that never scales traces
+byte-identical pre-flag programs — the ``telemetry=`` / ``faults=``
+discipline, pinned in tests/test_scaleout.py.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .autoscaler import AutoscaleDecision, Autoscaler
+from .bootstrap import (
+    BootstrapFailed,
+    BootstrapReport,
+    bootstrap,
+    bootstrap_rejects_corruption,
+)
+from .mesh_scale import (
+    AdmitReport,
+    DrainCertificate,
+    DrainRefused,
+    RingGeneration,
+    ScaleoutMesh,
+    certify_drain,
+    drain_refuses_unflushed,
+    park_row,
+)
+
+
+def static_checks() -> List:
+    """The ``scaleout`` static-check section (Finding list, empty =
+    clean):
+
+    1. **surface coverage** — every public operational symbol of this
+       package must have called
+       ``analysis.registry.register_scaleout_surface``; an
+       unregistered surface fails discovery (the same
+       registration-is-the-coverage-contract rule as joins / entries /
+       fault surfaces).
+    2. **generation/bijection walk** — a canonical membership
+       trajectory (partial start → admit ×2 → drain) must keep every
+       rebuilt ring a true bijection of the full axis, strictly
+       increase the generation at every transition, and compose to NO
+       fault plan at full membership (the flags-off contract).
+    3. **broken twins fire** — the corrupt-blind bootstrap twin
+       (``analysis.fixtures.bootstrap_skips_checksum``) must FAIL
+       :func:`bootstrap_rejects_corruption`, and the unacked-blind
+       drain certifier twin (``fixtures.drain_ignores_unacked``) must
+       FAIL :func:`drain_refuses_unflushed` — proving both detectors
+       have teeth.
+    """
+    from ..analysis import fixtures
+    from ..analysis.registry import unregistered_scaleout_surfaces
+    from ..analysis.report import Finding
+    from ..faults.membership import validate_perm
+
+    findings: List[Finding] = []
+
+    for name in unregistered_scaleout_surfaces():
+        findings.append(Finding(
+            "scaleout-surface-coverage", name,
+            "public scaleout symbol never called "
+            "register_scaleout_surface — the scaleout gate cannot see it",
+        ))
+
+    # 2. generation/bijection walk.
+    sm = ScaleoutMesh(8, live=range(5))
+    if sm.plan() is None:
+        findings.append(Finding(
+            "scaleout-generation", "ScaleoutMesh.plan",
+            "partial membership must compose a fault plan (parked ranks "
+            "must self-loop), got None",
+        ))
+    seen = [sm.generation]
+
+    def check_ring():
+        errs = validate_perm(list(sm.ring().perm), sm.n_ranks)
+        for e in errs:
+            findings.append(Finding(
+                "scaleout-generation", f"generation {sm.generation}", e,
+            ))
+
+    try:
+        check_ring()
+        for _ in range(2):
+            sm.admit(1)
+            seen.append(sm.generation)
+            check_ring()
+        # Membership-only park (the drain transition minus the flush —
+        # the certificate path itself is gated by the broken-twin
+        # checks below and tests/test_scaleout.py).
+        sm._live.discard(6)
+        sm._bump()
+        seen.append(sm.generation)
+        check_ring()
+    except Exception as exc:
+        findings.append(Finding(
+            "scaleout-generation", "membership-walk",
+            f"canonical admit/drain walk crashed: "
+            f"{type(exc).__name__}: {exc}",
+        ))
+    if seen != sorted(set(seen)):
+        findings.append(Finding(
+            "scaleout-generation", "generation-stamp",
+            f"generations must strictly increase per transition, got "
+            f"{seen}",
+        ))
+    full = ScaleoutMesh(4)
+    if full.plan() is not None:
+        findings.append(Finding(
+            "scaleout-generation", "flags-off",
+            "full membership must compose NO fault plan (the pre-flag "
+            "byte-identity contract)",
+        ))
+
+    # 3. broken twins.
+    if not bootstrap_rejects_corruption(bootstrap):
+        findings.append(Finding(
+            "bootstrap-integrity", "bootstrap",
+            "the honest bootstrap failed to land bit-identical with "
+            "rejections over a corrupt wire — lost or joined a bad lane",
+        ))
+    if bootstrap_rejects_corruption(fixtures.bootstrap_skips_checksum):
+        findings.append(Finding(
+            "broken-fixture-missed", "bootstrap_skips_checksum",
+            "the corrupt-blind bootstrap twin PASSED the corruption "
+            "detector — the bootstrap integrity gate is not actually "
+            "firing",
+        ))
+    if not drain_refuses_unflushed(certify_drain):
+        findings.append(Finding(
+            "drain-certificate", "certify_drain",
+            "the honest certifier issued a drain certificate while a "
+            "survivor still lacked drained content",
+        ))
+    if drain_refuses_unflushed(fixtures.drain_ignores_unacked):
+        findings.append(Finding(
+            "broken-fixture-missed", "drain_ignores_unacked",
+            "the unacked-blind drain certifier twin PASSED the refusal "
+            "detector — the drain gate is not actually firing",
+        ))
+    return findings
+
+
+from ..analysis.registry import register_scaleout_surface as _reg_so  # noqa: E402
+
+_reg_so("static_checks", module=__name__)
+
+__all__ = [
+    "AdmitReport", "AutoscaleDecision", "Autoscaler", "BootstrapFailed",
+    "BootstrapReport", "DrainCertificate", "DrainRefused",
+    "RingGeneration", "ScaleoutMesh", "bootstrap",
+    "bootstrap_rejects_corruption", "certify_drain",
+    "drain_refuses_unflushed", "park_row", "static_checks",
+]

@@ -1,0 +1,476 @@
+"""Live mesh resizing: generation-stamped membership over a fixed axis.
+
+Mesh width is frozen at trace time — the devices ARE the axis — so
+"grow the ring" cannot mean growing the physical axis mid-program.
+What CAN change live is the ring's *logical membership*: PR 8's
+eviction already rebuilds the permutation over a subset of the axis
+with the excluded ranks self-looping (``faults.inject.ring_perm`` — a
+true bijection of the full axis, so every trace re-use and the PR 7
+collective lint hold). This module generalizes that mechanism from
+"failure exit" to "elastic membership": a PARKED rank (not yet
+admitted, or gracefully drained) is ring-wise identical to an evicted
+one — it self-loops, its (join-identity) rows contribute nothing, and
+its top is excluded from the closure and the reclamation frontier.
+Scale-out is then a pure membership transition:
+
+- :meth:`ScaleoutMesh.admit` — pick parked ranks, **bootstrap** each
+  newcomer by shipping ``decompose(live, ⊥-or-snapshot)`` divergence
+  lanes (:mod:`.bootstrap` — the PR 9/10 rejoin path generalized to
+  empty bases; a snapshot from the PR 10 tier is the warm-start base
+  that ships only the log suffix), write the bootstrapped state into
+  the newcomer's absolute row of the ``[P, ...]`` batch (the stream
+  driver's absolute-block-index convention — rows are addressed by
+  axis position, never by live offset), and rebuild the ring over the
+  widened live set under a bumped **generation** stamp.
+- :meth:`ScaleoutMesh.drain` — the graceful inverse of eviction: the
+  operator stops routing ops to the rank, runs one flush ring over the
+  current membership, and the rank leaves ONLY under a
+  :class:`DrainCertificate` — ``residue == 0`` (the δ-ring convergence
+  certificate: every mark walked all live devices) AND zero packets
+  lost AND zero unacked out-lanes (no live peer lacks any of the
+  drained rank's row content — checked by join-irreducible
+  decomposition against every survivor, the ack-window's positive-
+  knowledge test made end-of-life explicit). A partition, an
+  under-budgeted flush, or an unflushed δ window REFUSES the
+  certificate (:class:`DrainRefused`) and the rank stays live — drain
+  never voids convergence certificates and never strands content.
+
+Every membership transition re-traces the ring family for free: the
+composed :class:`~crdt_tpu.faults.inject.FaultPlan` (whose ``evicted``
+set carries the parked ranks) rides the jit-cache key, so generation g
+and generation g+1 are different compiled programs over the same
+physical axis. The **generation stamp** makes that explicit and
+auditable: every rebuild yields a :class:`RingGeneration` validated by
+``membership.validate_perm``, certificates and reports carry the
+generation they were issued under, and a stale certificate (issued
+under an older generation) is refused by :meth:`ScaleoutMesh.drain`.
+
+Flags-off contract: a full-membership controller composes to NO fault
+plan at all (``plan()`` returns ``base`` unchanged — ``None`` when no
+base), so a mesh that never scales traces the byte-identical pre-flag
+program, pinned the same way ``telemetry=`` / ``faults=`` are.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..faults.inject import FaultPlan, inv_ring_perm, ring_perm
+from ..faults.membership import validate_perm
+from ..utils.metrics import metrics
+
+from .bootstrap import BootstrapReport, bootstrap
+
+
+class RingGeneration(NamedTuple):
+    """One generation-stamped ring rebuild: the live set and its
+    (validated, bijective) up/down-ring permutations at generation
+    ``gen``. Stamps certificates and reports so an operator can audit
+    which mesh shape issued them."""
+
+    gen: int
+    live: Tuple[int, ...]
+    perm: Tuple[Tuple[int, int], ...]
+    inv_perm: Tuple[Tuple[int, int], ...]
+
+
+class AdmitReport(NamedTuple):
+    """One :meth:`ScaleoutMesh.admit` event's accounting."""
+
+    ranks: Tuple[int, ...]            # ranks admitted this event
+    generation: int                   # generation AFTER the rebuild
+    bootstraps: Tuple[BootstrapReport, ...]
+    bytes_shipped: float              # total bootstrap wire bytes
+
+
+@dataclass(frozen=True)
+class DrainCertificate:
+    """The drain-complete certificate (ISSUE 11): what
+    :func:`certify_drain` measured on the flush run. ``ok()`` is the
+    gate :meth:`ScaleoutMesh.drain` enforces — residue 0 (the ring's
+    own convergence certificate held), nothing lost on the wire, and
+    no out-lane left unacked (every live survivor provably holds every
+    row the drained rank holds)."""
+
+    generation: int
+    rank: int
+    residue: int
+    packets_lost: int
+    lanes_unacked: int
+
+    def ok(self) -> bool:
+        return (
+            self.residue == 0
+            and self.packets_lost == 0
+            and self.lanes_unacked == 0
+        )
+
+
+class DrainRefused(RuntimeError):
+    """A drain whose certificate did not hold — the rank STAYS LIVE.
+    Carries the refused certificate as ``.certificate``."""
+
+    def __init__(self, cert: DrainCertificate, why: str):
+        super().__init__(
+            f"drain of rank {cert.rank} refused at generation "
+            f"{cert.generation}: {why} ({cert})"
+        )
+        self.certificate = cert
+
+
+def certify_drain(
+    kind: str,
+    rank: int,
+    rows,
+    residue,
+    counters=None,
+    *,
+    generation: int = 0,
+    live: Optional[Sequence[int]] = None,
+) -> DrainCertificate:
+    """Measure the drain-complete certificate for ``rank`` from one
+    flush run's outputs: ``rows`` is the ring's returned ``[P, ...]``
+    batch, ``residue`` its convergence indicator, ``counters`` the
+    ``FaultCounters`` when the flush ran faulted (``None`` = reliable
+    links, nothing lost by construction). ``lanes_unacked`` is the
+    positive-knowledge check: the drained rank's row content
+    decomposed over EVERY live survivor (``delta_opt.decompose`` —
+    changed lanes are content some peer still lacks), maxed across
+    survivors, plus a residual mismatch flag folded in (a diverged top
+    or parked buffer is also unacked knowledge).
+
+    ``live`` defaults to EVERY rank of the batch — sound only on a
+    fully-live mesh. When any rank is parked, pass the live set
+    (``ScaleoutMesh.drain`` does): a parked rank's join-identity row
+    would otherwise read as a survivor that lacks everything and
+    spuriously refuse the drain (refusal is the safe direction, but
+    the certificate would be wrong about WHY). Always RETURNS the
+    certificate — refusing is the caller's move (``DrainCertificate.ok``
+    / :meth:`ScaleoutMesh.drain`), so tests and operators can inspect
+    why a drain was refused."""
+    from ..analysis.registry import get_decomposer
+    from ..delta_opt.decompose import decompose
+
+    residue = int(residue)
+    lost = 0
+    if counters is not None:
+        lost = int(counters.packets_dropped) + int(counters.packets_rejected)
+    p = jax.tree.leaves(rows)[0].shape[0]
+    live = tuple(live) if live is not None else tuple(range(p))
+    mine = jax.tree.map(lambda x: x[rank], rows)
+    dec = get_decomposer(kind)
+    unacked = 0
+    for peer in live:
+        if peer == rank:
+            continue
+        theirs = jax.tree.map(lambda x: x[peer], rows)
+        d = decompose(kind, mine, theirs)
+        lanes = int(jnp.sum(d.valid))
+        # The peer's residual baseline: straight from the registered
+        # split when there is one (a full second decomposition would
+        # only be run to discard its lanes); the split-less override
+        # path (broken-twin fixtures) falls back to self-decomposition.
+        res_theirs = (
+            dec.split(theirs)[1] if dec.split is not None
+            else decompose(kind, theirs, theirs).residual
+        )
+        res_mismatch = int(any(
+            not bool(jnp.array_equal(a, b))
+            for a, b in zip(
+                jax.tree.leaves(d.residual), jax.tree.leaves(res_theirs),
+            )
+        ))
+        unacked = max(unacked, lanes + res_mismatch)
+    return DrainCertificate(
+        generation=generation, rank=rank, residue=residue,
+        packets_lost=lost, lanes_unacked=unacked,
+    )
+
+
+def park_row(rows, rank: int):
+    """Zero rank ``rank``'s row of a ``[P, ...]`` batch back to the
+    join identity (the padding convention — ``mesh.pad_replicas`` seeds
+    exactly these rows): the parked slot a future admit bootstraps
+    into. Called AFTER a drain certificate — the content is already
+    replicated on every survivor (that is what the certificate proves),
+    so zeroing the drained rank's absolute row strands nothing."""
+    return jax.tree.map(lambda x: x.at[rank].set(jnp.zeros_like(x[rank])), rows)
+
+
+class ScaleoutMesh:
+    """Host-side elastic-membership controller for one replica mesh
+    axis of physical width ``n_ranks`` (the module docstring's
+    contract). Tracks the live set, the generation counter, and the
+    scale-out telemetry totals (:meth:`annotate`)."""
+
+    def __init__(self, n_ranks: int, live: Optional[Sequence[int]] = None):
+        if n_ranks < 1:
+            raise ValueError("n_ranks must be >= 1")
+        self.n_ranks = n_ranks
+        live_set = set(range(n_ranks)) if live is None else set(live)
+        if not live_set:
+            raise ValueError("at least one rank must start live")
+        for r in live_set:
+            if not 0 <= r < n_ranks:
+                raise ValueError(f"rank {r} outside [0, {n_ranks})")
+        self._live = live_set
+        self._generation = 0
+        self.admits = 0
+        self.drains = 0
+        self.bootstrap_bytes = 0.0
+        metrics.observe("scaleout.live_ranks", float(len(self._live)))
+
+    # ---- state ------------------------------------------------------------
+
+    @property
+    def generation(self) -> int:
+        return self._generation
+
+    def live(self) -> Tuple[int, ...]:
+        return tuple(sorted(self._live))
+
+    @property
+    def parked(self) -> Tuple[int, ...]:
+        return tuple(
+            r for r in range(self.n_ranks) if r not in self._live
+        )
+
+    def plan(self, base: Optional[FaultPlan] = None) -> Optional[FaultPlan]:
+        """The fault plan the next ring run should compose under: the
+        parked ranks ride the ``evicted`` set (newcomer self-loops —
+        the evicted self-loop generalized), UNIONED with any ranks the
+        base plan already evicts — a PR 8 membership eviction composed
+        under scale-out must stay evicted, not silently re-enter the
+        ring. FULL membership with no base returns ``None`` — the
+        flags-off path must trace the byte-identical pre-flag program
+        (module docstring)."""
+        if base is None and not self.parked:
+            return None
+        base = base or FaultPlan()
+        return base.with_evicted(set(self.parked) | set(base.evicted))
+
+    def ring(self) -> RingGeneration:
+        """The current generation-stamped ring rebuild, validated as a
+        true bijection of the full axis at construction (a broken
+        rebuild must fail HERE, not as a silent mis-wired collective).
+        """
+        perm = ring_perm(self.n_ranks, self.parked)
+        errs = validate_perm(perm, self.n_ranks)
+        if errs:
+            raise ValueError(
+                f"generation {self._generation} ring rebuild is not a "
+                f"bijection: {'; '.join(errs)}"
+            )
+        return RingGeneration(
+            gen=self._generation,
+            live=self.live(),
+            perm=tuple(perm),
+            inv_perm=tuple(inv_ring_perm(self.n_ranks, self.parked)),
+        )
+
+    def _bump(self) -> None:
+        self._generation += 1
+        metrics.observe("scaleout.generation", float(self._generation))
+        metrics.observe("scaleout.live_ranks", float(len(self._live)))
+
+    # ---- transitions ------------------------------------------------------
+
+    def admit(
+        self,
+        k: int = 1,
+        *,
+        kind: Optional[str] = None,
+        rows=None,
+        base=None,
+        faults: Optional[FaultPlan] = None,
+        ranks: Optional[Sequence[int]] = None,
+        segment_cap: int = 64,
+        max_attempts: int = 64,
+    ):
+        """Admit ``k`` parked ranks (or the explicit ``ranks``) and
+        re-trace the ring over the widened live set at generation+1.
+
+        With ``rows`` (the current converged ``[P, ...]`` batch) and
+        ``kind`` given, every newcomer is BOOTSTRAPPED first: the first
+        live rank's row is the shipping peer, ``base`` the causal lower
+        bound (``None`` = ⊥, the cold-start path; a PR 10 snapshot
+        state = the warm start that ships only the log suffix), and
+        ``faults`` an optional wire plan the bootstrap lanes cross
+        (dropped/rejected segments re-ship — :func:`.bootstrap`). The
+        bootstrapped state lands at the newcomer's ABSOLUTE row index.
+        Without ``rows`` the transition is membership-only (the caller
+        owns state placement). Returns ``(rows, AdmitReport)``."""
+        if ranks is None:
+            avail = self.parked
+            if len(avail) < k:
+                raise ValueError(
+                    f"cannot admit {k}: only {len(avail)} parked ranks "
+                    f"on a {self.n_ranks}-rank axis"
+                )
+            ranks = avail[:k]
+        else:
+            ranks = tuple(ranks)
+            for r in ranks:
+                if not 0 <= r < self.n_ranks:
+                    raise ValueError(
+                        f"rank {r} outside [0, {self.n_ranks})"
+                    )
+                if r in self._live:
+                    raise ValueError(f"rank {r} is already live")
+        reports: List[BootstrapReport] = []
+        shipped = 0.0
+        if rows is not None:
+            if kind is None:
+                raise ValueError("admit with rows= needs kind=")
+            src = self.live()[0]
+            peer = jax.tree.map(lambda x: x[src], rows)
+            for r in ranks:
+                state, rep = bootstrap(
+                    kind, peer, base=base, faults=faults,
+                    segment_cap=segment_cap, max_attempts=max_attempts,
+                )
+                rows = jax.tree.map(
+                    lambda x, s: x.at[r].set(s.astype(x.dtype)), rows, state
+                )
+                reports.append(rep)
+                shipped += rep.bytes_shipped
+        self._live.update(ranks)
+        self._bump()
+        self.ring()  # validate the rebuilt permutation eagerly
+        self.admits += len(ranks)
+        self.bootstrap_bytes += shipped
+        metrics.count("scaleout.admits", len(ranks))
+        metrics.count("scaleout.bootstrap_bytes", int(shipped))
+        return rows, AdmitReport(
+            ranks=tuple(ranks), generation=self._generation,
+            bootstraps=tuple(reports), bytes_shipped=shipped,
+        )
+
+    def drain(
+        self,
+        rank: int,
+        *,
+        certificate: Optional[DrainCertificate] = None,
+        kind: Optional[str] = None,
+        rows=None,
+        residue=None,
+        counters=None,
+        certify=certify_drain,
+    ) -> DrainCertificate:
+        """Gracefully remove ``rank`` from the live set — ONLY under a
+        holding drain-complete certificate. Pass either a pre-computed
+        ``certificate`` (from :func:`certify_drain` on the flush run's
+        outputs) or the flush outputs themselves (``kind`` + ``rows`` +
+        ``residue`` [+ ``counters``]) and the certificate is measured
+        here. Refusal (:class:`DrainRefused`) leaves membership AND
+        generation untouched: the rank keeps serving, the operator
+        re-flushes and retries. A certificate stamped by an older
+        generation is stale and refused — membership changed since it
+        was measured. On success the rank parks (self-loop, excluded
+        from closure and frontier — reclamation unpinned exactly as
+        eviction unpins it) and the generation bumps."""
+        if rank not in self._live:
+            raise ValueError(f"rank {rank} is not live")
+        if len(self._live) <= 1:
+            raise ValueError(
+                f"draining rank {rank} would leave an empty mesh"
+            )
+        if certificate is None:
+            if kind is None or rows is None or residue is None:
+                raise ValueError(
+                    "drain needs certificate= or (kind=, rows=, residue=)"
+                )
+            certificate = certify(
+                kind, rank, rows, residue, counters,
+                generation=self._generation, live=self.live(),
+            )
+        if certificate.rank != rank:
+            raise ValueError(
+                f"certificate is for rank {certificate.rank}, not {rank}"
+            )
+        if certificate.generation != self._generation:
+            raise DrainRefused(
+                certificate,
+                f"stale certificate: issued at generation "
+                f"{certificate.generation}, mesh is at {self._generation}",
+            )
+        if not certificate.ok():
+            why = []
+            if certificate.residue:
+                why.append(
+                    f"residue {certificate.residue} > 0 — the flush ring "
+                    f"is not certified converged"
+                )
+            if certificate.packets_lost:
+                why.append(
+                    f"{certificate.packets_lost} packets lost on the "
+                    f"flush wire"
+                )
+            if certificate.lanes_unacked:
+                why.append(
+                    f"{certificate.lanes_unacked} out-lanes unacked — a "
+                    f"survivor still lacks drained content"
+                )
+            raise DrainRefused(certificate, "; ".join(why))
+        self._live.discard(rank)
+        self._bump()
+        self.ring()
+        self.drains += 1
+        metrics.count("scaleout.drains")
+        return certificate
+
+    # ---- telemetry --------------------------------------------------------
+
+    def annotate(self, tel):
+        """Fill the scale-out fields of a Telemetry pytree with this
+        controller's running totals (host-side, the ``stream_*``/
+        ``wal_*`` discipline — telemetry.py module docstring)."""
+        return tel._replace(
+            live_ranks=jnp.uint32(len(self._live)),
+            scaleout_admits=jnp.uint32(self.admits),
+            scaleout_drains=jnp.uint32(self.drains),
+            bootstrap_bytes=jnp.float32(self.bootstrap_bytes),
+        )
+
+
+def drain_refuses_unflushed(certify_fn) -> bool:
+    """Detector behind the ``scaleout`` static-check section: a sound
+    certifier must REFUSE a drain whose rank still holds content some
+    survivor lacks. Builds a 2-rank orswot batch where rank 1 holds one
+    extra live row (an unacked out-lane by construction) and asks
+    ``certify_fn`` for rank 1's certificate with a deceptive
+    ``residue=0``: returns True iff the certificate does NOT hold. The
+    committed broken twin (``analysis.fixtures.drain_ignores_unacked``)
+    zeroes the unacked count and must FAIL here — proving the gate
+    fires."""
+    from ..analysis.registry import get_merge_kind
+
+    states = get_merge_kind("orswot").states()
+    base, ahead = states[0], states[-1]
+    rows = jax.tree.map(
+        lambda a, b: jnp.stack([a, b.astype(a.dtype)]), base, ahead
+    )
+    cert = certify_fn("orswot", 1, rows, 0, None, generation=0, live=(0, 1))
+    return not cert.ok()
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) ---------------------
+# Every public scaleout surface registers — the coverage contract the
+# ``scaleout`` static-check section enforces (an unregistered public
+# symbol fails run_static_checks discovery, the faults/entry-point rule).
+
+from ..analysis.registry import register_scaleout_surface as _reg_so  # noqa: E402
+
+_reg_so("ScaleoutMesh", module=__name__)
+_reg_so("certify_drain", module=__name__)
+_reg_so("park_row", module=__name__)
+_reg_so("drain_refuses_unflushed", module=__name__)
+
+__all__ = [
+    "AdmitReport", "DrainCertificate", "DrainRefused", "RingGeneration",
+    "ScaleoutMesh", "certify_drain", "drain_refuses_unflushed", "park_row",
+]

@@ -85,6 +85,16 @@ shipped and sync metadata per round), measured natively per round:
   the ``stream_*`` discipline), snapshot generations committed, WAL
   records replayed by a recovery, torn/corrupt log tails truncated on
   open, and recovery passes completed. 0 on every non-durable run.
+- ``live_ranks`` / ``scaleout_admits`` / ``scaleout_drains`` /
+  ``bootstrap_bytes`` — the elastic mesh scale-out accounting
+  (crdt_tpu/scaleout/; registry twins ``scaleout.admits`` /
+  ``scaleout.drains`` / ``scaleout.bootstrap_bytes``): admitted ranks
+  on the replica axis (a gauge — the mesh's current serving width),
+  live rank joins completed, graceful drains whose drain-complete
+  certificate was issued, and newcomer-bootstrap wire bytes (including
+  fault re-ships). Filled host-side by ``ScaleoutMesh.annotate`` — the
+  membership loop lives outside the kernels, the ``stream_*``/``wal_*``
+  discipline — and 0 on every fixed-width run.
 
 Every field is a replicated scalar, so the whole pytree costs one word
 of output per field and no extra collectives beyond one psum/pmax
@@ -140,6 +150,10 @@ class Telemetry(NamedTuple):
     replayed_records: jax.Array    # uint32 — WAL records replayed on recovery
     torn_tail_truncated: jax.Array # uint32 — torn/corrupt WAL tails truncated
     recovery_rounds: jax.Array     # uint32 — recovery passes completed
+    live_ranks: jax.Array          # uint32 — admitted ranks on the mesh axis
+    scaleout_admits: jax.Array     # uint32 — live rank joins completed
+    scaleout_drains: jax.Array     # uint32 — graceful drains certified
+    bootstrap_bytes: jax.Array     # float32 — newcomer bootstrap wire bytes
 
 
 def zeros() -> Telemetry:
@@ -169,6 +183,10 @@ def zeros() -> Telemetry:
         replayed_records=jnp.zeros((), jnp.uint32),
         torn_tail_truncated=jnp.zeros((), jnp.uint32),
         recovery_rounds=jnp.zeros((), jnp.uint32),
+        live_ranks=jnp.zeros((), jnp.uint32),
+        scaleout_admits=jnp.zeros((), jnp.uint32),
+        scaleout_drains=jnp.zeros((), jnp.uint32),
+        bootstrap_bytes=jnp.zeros((), jnp.float32),
     )
 
 
@@ -204,11 +222,15 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         replayed_records=a.replayed_records + b.replayed_records,
         torn_tail_truncated=a.torn_tail_truncated + b.torn_tail_truncated,
         recovery_rounds=a.recovery_rounds + b.recovery_rounds,
+        scaleout_admits=a.scaleout_admits + b.scaleout_admits,
+        scaleout_drains=a.scaleout_drains + b.scaleout_drains,
+        bootstrap_bytes=a.bootstrap_bytes + b.bootstrap_bytes,
         deferred_depth=b.deferred_depth,
         residue=b.residue,
         widen_pressure=b.widen_pressure,
         frontier_lag=b.frontier_lag,
         ack_window_depth=b.ack_window_depth,
+        live_ranks=b.live_ranks,
     )
 
 
@@ -372,6 +394,10 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "replayed_records": int(tel.replayed_records),
         "torn_tail_truncated": int(tel.torn_tail_truncated),
         "recovery_rounds": int(tel.recovery_rounds),
+        "live_ranks": int(tel.live_ranks),
+        "scaleout_admits": int(tel.scaleout_admits),
+        "scaleout_drains": int(tel.scaleout_drains),
+        "bootstrap_bytes": float(tel.bootstrap_bytes),
     }
 
 
@@ -431,6 +457,17 @@ def record(kind: str, tel: Telemetry) -> None:
     metrics.count(
         f"telemetry.{kind}.recovery_rounds", d["recovery_rounds"]
     )
+    metrics.count(
+        f"telemetry.{kind}.scaleout.admits", d["scaleout_admits"]
+    )
+    metrics.count(
+        f"telemetry.{kind}.scaleout.drains", d["scaleout_drains"]
+    )
+    metrics.count(
+        f"telemetry.{kind}.scaleout.bootstrap_bytes",
+        int(d["bootstrap_bytes"]),
+    )
+    metrics.observe(f"telemetry.{kind}.live_ranks", d["live_ranks"])
     metrics.observe(f"telemetry.{kind}.deferred_depth", d["deferred_depth"])
     metrics.observe(f"telemetry.{kind}.residue", d["residue"])
     metrics.observe(f"telemetry.{kind}.widen_pressure", d["widen_pressure"])

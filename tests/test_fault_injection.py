@@ -417,3 +417,115 @@ def test_retry_exhaustion_resumes_from_last_good_and_converges(seed):
     assert done == hi
     assert shipped == list(range(carried, hi))  # suffix-only resync
     assert a.read().val == b.read().val == oa.read().val
+
+
+# ---- scale-out × faults composition (crdt_tpu/scaleout/, ISSUE 11) --------
+
+def _scaleout_population(n_live, n_ranks, n_ops, seed):
+    from crdt_tpu.faults.scenarios import genesis_tracking, mint_streams
+
+    rng = random.Random(seed)
+    sites, _ = mint_streams(rng, n_live, n_ops)
+    batched = BatchedOrswot.from_pure(
+        sites,
+        members=Interner(MEMBERS),
+        actors=Interner([f"s{i}" for i in range(n_ranks)]),
+    )
+    return batched, genesis_tracking
+
+
+def test_newcomer_bootstrap_under_fault_window_joins_bit_identical():
+    """The ISSUE 11 composition gate: a newcomer admitted THROUGH a
+    drop/corrupt window must (a) re-ship every lost bootstrap segment,
+    (b) never join a checksum-rejected one, and (c) end bit-identical
+    to the fault-free fixpoint once the widened ring converges."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.faults import FaultPlan
+    from crdt_tpu.parallel import make_mesh, mesh_delta_gossip, mesh_gossip
+    from crdt_tpu.parallel.mesh import shard_orswot
+    from crdt_tpu.scaleout import ScaleoutMesh
+
+    p = 4
+    batched, tracking = _scaleout_population(p - 1, p, 30, seed=41)
+    mesh = make_mesh(p, 1)
+    cur = shard_orswot(batched.state, mesh)
+    sm = ScaleoutMesh(p, live=range(p - 1))
+
+    d, f = tracking(cur)
+    out = mesh_delta_gossip(cur, d, f, mesh, local_fold="tree",
+                            faults=sm.plan())
+    rows = out[0]
+    assert int(out[3]) == 0
+    fix = jax.tree.map(lambda x: x[0],
+                       mesh_gossip(cur, mesh, local_fold="tree")[0])
+
+    window = FaultPlan(seed=43, drop=0.3, corrupt=0.3)
+    rows, rep = sm.admit(1, kind="orswot", rows=rows, faults=window,
+                         segment_cap=1, max_attempts=400)
+    boot = rep.bootstraps[0]
+    # Lost lanes re-shipped, rejected lanes never joined — and the
+    # landed row is the exact fixpoint regardless.
+    assert boot.reshipped == boot.dropped + boot.rejected
+    assert boot.dropped + boot.rejected > 0, "the window never fired"
+    newcomer = jax.tree.map(lambda x: x[p - 1], rows)
+    assert all(
+        bool(jnp.array_equal(a, b))
+        for a, b in zip(jax.tree.leaves(newcomer), jax.tree.leaves(fix))
+    )
+
+    d2, f2 = tracking(rows)
+    out2 = mesh_delta_gossip(rows, d2, f2, mesh, local_fold="tree")
+    assert int(out2[3]) == 0
+    for i in range(p):
+        row = jax.tree.map(lambda x: x[i], out2[0])
+        assert all(
+            bool(jnp.array_equal(a, b))
+            for a, b in zip(jax.tree.leaves(row), jax.tree.leaves(fix))
+        ), f"rank {i} diverged from the fault-free fixpoint"
+
+
+def test_drain_during_partition_refuses_certificate():
+    """Drain must refuse while the mesh is degraded: a flush run under
+    a partition-grade drop plan loses packets, the residue certificate
+    is voided (forced >= 1), and the drain-complete certificate CANNOT
+    issue — the rank stays live, membership and generation untouched.
+    After the partition heals, one clean flush certifies and the same
+    drain succeeds."""
+    import pytest
+
+    from crdt_tpu.faults import FaultPlan
+    from crdt_tpu.parallel import make_mesh, mesh_delta_gossip
+    from crdt_tpu.parallel.mesh import shard_orswot
+    from crdt_tpu.scaleout import DrainRefused, ScaleoutMesh
+
+    p = 4
+    batched, tracking = _scaleout_population(p, p, 24, seed=47)
+    mesh = make_mesh(p, 1)
+    cur = shard_orswot(batched.state, mesh)
+    sm = ScaleoutMesh(p)
+
+    partition = sm.plan(FaultPlan(seed=53, drop=0.6))
+    d, f = tracking(cur)
+    out = mesh_delta_gossip(cur, d, f, mesh, local_fold="tree",
+                            faults=partition)
+    rows, residue, fc = out[0], int(out[3]), out[-1]
+    assert residue >= 1, "loss must void the residue certificate"
+
+    with pytest.raises(DrainRefused) as refusal:
+        sm.drain(p - 1, kind="orswot", rows=rows, residue=residue,
+                 counters=fc)
+    cert = refusal.value.certificate
+    assert cert.residue >= 1 and cert.packets_lost > 0
+    assert sm.live() == tuple(range(p)), "a refused drain must stay live"
+    assert sm.generation == 0
+
+    # Heal: a clean flush over the returned partial states certifies,
+    # and the SAME drain now completes.
+    d2, f2 = tracking(rows)
+    out2 = mesh_delta_gossip(rows, d2, f2, mesh, local_fold="tree")
+    cert2 = sm.drain(p - 1, kind="orswot", rows=out2[0],
+                     residue=int(out2[3]))
+    assert cert2.ok()
+    assert sm.live() == tuple(range(p - 1))

@@ -1,0 +1,548 @@
+"""Elastic mesh scale-out (crdt_tpu/scaleout/): live rank join,
+graceful drain, and policy-driven resizing (ISSUE 11).
+
+The package contract under test:
+
+1. Flags-off: a full-membership ScaleoutMesh composes NO fault plan —
+   the mesh that never scales traces the byte-identical pre-flag
+   program (the ``faults=None`` HLO pin in tests/test_faults.py is the
+   byte-level half; here we pin that full membership actually takes
+   that path).
+2. Admit: newcomers bootstrap from ⊥ (cold) or a PR 10 snapshot (warm
+   — only the log suffix ships, < 25% of full-state bytes) and land
+   the live fixpoint BIT-EXACTLY; every ring rebuild is a validated
+   bijection under a strictly-increasing generation stamp.
+3. Drain: the graceful inverse of eviction leaves ONLY under the
+   drain-complete certificate (residue == 0, nothing lost, no out-lane
+   unacked); refusals — unflushed content, stale generation — leave
+   membership untouched.
+4. Policy: the Autoscaler debounces a folded pressure signal through
+   the symmetric ``Hysteresis.vote`` — decisions fire after sustained
+   excursions only, in both directions.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from crdt_tpu import elastic, telemetry
+from crdt_tpu.analysis import fixtures
+from crdt_tpu.analysis.registry import (
+    get_merge_kind,
+    scaleout_surfaces,
+    unregistered_scaleout_surfaces,
+)
+from crdt_tpu.faults import FaultPlan
+from crdt_tpu.faults.membership import validate_perm
+from crdt_tpu.faults.scenarios import genesis_tracking, mint_streams
+from crdt_tpu.models import BatchedOrswot
+from crdt_tpu.parallel import make_mesh, mesh_delta_gossip, mesh_gossip
+from crdt_tpu.parallel.mesh import shard_orswot
+from crdt_tpu.scaleout import (
+    Autoscaler,
+    BootstrapReport,
+    DrainRefused,
+    ScaleoutMesh,
+    bootstrap,
+    bootstrap_rejects_corruption,
+    certify_drain,
+    drain_refuses_unflushed,
+    park_row,
+    static_checks,
+)
+from crdt_tpu.utils import Interner
+from crdt_tpu.utils.metrics import metrics
+
+
+def _trees_equal(a, b) -> bool:
+    return all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+_genesis_tracking = genesis_tracking
+
+
+def _population(n_live: int, n_ranks: int, n_ops: int = 18, seed: int = 7):
+    """``n_live`` minted pure sites batched and padded to the
+    ``n_ranks`` axis (pad rows are join identities — the parked slots).
+    """
+    rng = random.Random(seed)
+    sites, _ = mint_streams(rng, n_live, n_ops)
+    batched = BatchedOrswot.from_pure(
+        sites,
+        members=Interner(list(range(5))),
+        actors=Interner([f"s{i}" for i in range(n_ranks)]),
+    )
+    return sites, batched
+
+
+def _row(rows, i):
+    return jax.tree.map(lambda x: x[i], rows)
+
+
+# ---- 1. flags-off / membership mechanics ----------------------------------
+
+def test_full_membership_composes_no_fault_plan():
+    """The flags-off contract: a mesh that never scales must hand the
+    ring ``faults=None`` — the byte-identical pre-flag program (whose
+    HLO pin lives in tests/test_faults.py). Partial membership composes
+    the parked set onto the (optional) base plan, preserving its
+    rates."""
+    assert ScaleoutMesh(8).plan() is None
+    sm = ScaleoutMesh(8, live=range(6))
+    plan = sm.plan()
+    assert plan is not None and plan.evicted == (6, 7)
+    base = FaultPlan(seed=9, drop=0.25, corrupt=0.5)
+    composed = sm.plan(base)
+    assert composed.evicted == (6, 7)
+    assert composed.drop == 0.25 and composed.corrupt == 0.5
+    # A base plan carrying a PR 8 membership EVICTION composes by
+    # union — the evicted rank must not silently re-enter the ring
+    # just because scale-out also manages the evicted set.
+    both = sm.plan(FaultPlan(seed=9, drop=0.25, evicted=(3,)))
+    assert both.evicted == (3, 6, 7)
+    # A full-membership mesh still honors an explicit base plan.
+    assert ScaleoutMesh(4).plan(base) == base
+    assert ScaleoutMesh(4).plan(
+        FaultPlan(evicted=(1,))
+    ).evicted == (1,)
+
+
+def test_ring_generation_stamps_and_stays_bijective():
+    sm = ScaleoutMesh(8, live=range(4))
+    gens = [sm.ring().gen]
+    for _ in range(3):
+        sm.admit(1)
+        ring = sm.ring()
+        assert not validate_perm(list(ring.perm), sm.n_ranks)
+        assert ring.live == sm.live()
+        gens.append(ring.gen)
+    assert gens == sorted(set(gens)), "generations must strictly increase"
+    assert sm.live() == (0, 1, 2, 3, 4, 5, 6)
+
+
+def test_admit_refuses_when_nothing_parked():
+    sm = ScaleoutMesh(2)
+    with pytest.raises(ValueError, match="only 0 parked"):
+        sm.admit(1)
+    with pytest.raises(ValueError, match="already live"):
+        ScaleoutMesh(4, live=range(2)).admit(ranks=(1,))
+    # A phantom rank outside the physical axis must be refused — JAX
+    # gathers clamp out-of-bounds indices silently, so a range error
+    # here would otherwise surface as certificates computed against
+    # the WRONG rank's row.
+    with pytest.raises(ValueError, match="outside"):
+        ScaleoutMesh(4, live=range(2)).admit(ranks=(4,))
+
+
+# ---- 2. admit + bootstrap --------------------------------------------------
+
+def test_admit_bootstraps_newcomer_from_bottom_bit_identical():
+    """The quick scale-out cycle (the in-tier cousin of the 8-rank
+    chaos soak): a 4-rank axis serving on 3 ranks admits the parked
+    rank — the newcomer bootstraps from ⊥ via decomposition lanes and
+    its row lands the live fixpoint bit-exactly; the widened ring then
+    certifies (residue 0) with every row bit-identical to the
+    fixed-width oracle."""
+    p = 4
+    sites, batched = _population(p - 1, p)
+    mesh = make_mesh(p, 1)
+    cur = shard_orswot(batched.state, mesh)
+    sm = ScaleoutMesh(p, live=range(p - 1))
+
+    d, f = _genesis_tracking(cur)
+    out = mesh_delta_gossip(cur, d, f, mesh, local_fold="tree",
+                            faults=sm.plan())
+    rows, residue = out[0], int(out[3])
+    assert residue == 0
+
+    oracle_rows, _ = mesh_gossip(cur, mesh, local_fold="tree")
+    fix = _row(oracle_rows, 0)
+
+    rows, rep = sm.admit(1, kind="orswot", rows=rows)
+    assert rep.ranks == (p - 1,)
+    assert rep.generation == 1
+    assert isinstance(rep.bootstraps[0], BootstrapReport)
+    assert _trees_equal(_row(rows, p - 1), fix), "newcomer != fixpoint"
+
+    # The widened mesh is flag-off again and converges everywhere.
+    assert sm.plan() is None
+    d2, f2 = _genesis_tracking(rows)
+    out2 = mesh_delta_gossip(rows, d2, f2, mesh, local_fold="tree")
+    assert int(out2[3]) == 0
+    for i in range(p):
+        assert _trees_equal(_row(out2[0], i), fix), i
+
+
+def test_admit_warm_start_ships_log_suffix_under_quarter():
+    """The warm-start acceptance gate: with a PR 10 snapshot base the
+    newcomer ships only ``decompose(live, snapshot)`` — the log suffix
+    — at < 25% of full-state bytes, and still lands the live state
+    bit-exactly."""
+    from crdt_tpu.ops import orswot as ops
+
+    e, a, dcap = 512, 8, 2
+    state = ops.empty(e, a, dcap)
+    # The snapshot-era state: a third of the universe live.
+    ctr = state.ctr.at[: e // 3, 0].set(1)
+    snap = state._replace(ctr=ctr)
+    # The live peer advanced past the snapshot on ~4% of the rows.
+    live = snap._replace(
+        ctr=snap.ctr.at[: e // 25, 1].set(2),
+        top=snap.top.at[0].set(1).at[1].set(2),
+    )
+    got, rep = bootstrap("orswot", live, base=snap)
+    assert _trees_equal(got, live)
+    assert rep.ratio < 0.25, (
+        f"warm bootstrap shipped {rep.ratio:.1%} of full-state bytes"
+    )
+    # The cold path from ⊥ ships everything — the ratio quantifies the
+    # snapshot tier's win rather than hiding it.
+    _, cold = bootstrap("orswot", live)
+    assert cold.bytes_payload > rep.bytes_payload
+
+
+def test_admit_warm_start_from_snapshot_tier(tmp_path):
+    """End to end through the PR 10 tier: the warm base comes off disk
+    via ``snapshot.save_state``/``load_newest`` — a rejoining-as-new
+    rank restores its snapshot locally and the wire carries only the
+    suffix."""
+    from crdt_tpu.durability import snapshot as snap
+    from crdt_tpu.ops import orswot as ops
+
+    e, a, dcap = 256, 8, 2
+    base = ops.empty(e, a, dcap)._replace(
+        ctr=ops.empty(e, a, dcap).ctr.at[: e // 2, 0].set(1)
+    )
+    snap.save_state(str(tmp_path), "orswot", base, wal_seq=0)
+    live = base._replace(
+        ctr=base.ctr.at[: e // 20, 1].set(3),
+        top=base.top.at[0].set(1).at[1].set(3),
+    )
+    restored, _ = snap.load_newest(str(tmp_path), base)
+    got, rep = bootstrap("orswot", live, base=restored)
+    assert _trees_equal(got, live)
+    assert rep.ratio < 0.25
+
+
+def test_bootstrap_reships_dropped_and_rejects_corrupt_lanes():
+    """Scale-out × faults: a drop/corrupt window on the bootstrap wire
+    re-ships lost segments and never joins checksum-rejected ones —
+    the newcomer still lands bit-identical (the composition suite in
+    tests/test_fault_injection.py runs this against the full ring)."""
+    from crdt_tpu.ops import orswot as ops
+
+    e, a = 16, 4
+    empty = ops.empty(e, a, 2)
+    live = empty._replace(
+        ctr=empty.ctr.at[:, 0].set(jnp.arange(1, e + 1, dtype=jnp.uint32)),
+        top=empty.top.at[0].set(e),
+    )
+    plan = FaultPlan(seed=11, drop=0.35, corrupt=0.35)
+    got, rep = bootstrap("orswot", live, faults=plan, segment_cap=1,
+                         max_attempts=400)
+    assert _trees_equal(got, live)
+    assert rep.lanes == e
+    assert rep.dropped + rep.rejected > 0, "the window never fired"
+    assert rep.reshipped == rep.dropped + rep.rejected
+    assert rep.bytes_shipped > rep.bytes_payload  # re-ships cost wire bytes
+
+
+def test_bootstrap_detector_and_broken_twin():
+    assert bootstrap_rejects_corruption(bootstrap)
+    assert not bootstrap_rejects_corruption(
+        fixtures.bootstrap_skips_checksum
+    )
+
+
+# ---- 3. drain --------------------------------------------------------------
+
+def test_drain_cycle_certified_and_survivors_serve():
+    """Graceful scale-in: flush, certify (residue 0, nothing lost, no
+    unacked out-lane), drain, park — and the narrowed mesh still reads
+    bit-identical to the fixed-width oracle."""
+    p = 4
+    sites, batched = _population(p, p, n_ops=24, seed=13)
+    mesh = make_mesh(p, 1)
+    cur = shard_orswot(batched.state, mesh)
+    sm = ScaleoutMesh(p)
+
+    d, f = _genesis_tracking(cur)
+    out = mesh_delta_gossip(cur, d, f, mesh, local_fold="tree")
+    rows, residue = out[0], int(out[3])
+    assert residue == 0
+    fix = _row(mesh_gossip(cur, mesh, local_fold="tree")[0], 0)
+
+    cert = sm.drain(p - 1, kind="orswot", rows=rows, residue=residue)
+    assert cert.ok() and cert.generation == 0
+    assert sm.live() == tuple(range(p - 1))
+    assert sm.generation == 1
+
+    rows = park_row(rows, p - 1)
+    assert all(
+        bool(jnp.all(x == 0)) for x in jax.tree.leaves(_row(rows, p - 1))
+    )
+    d2, f2 = _genesis_tracking(rows)
+    out2 = mesh_delta_gossip(rows, d2, f2, mesh, local_fold="tree",
+                             faults=sm.plan())
+    assert int(out2[3]) == 0
+    for i in sm.live():
+        assert _trees_equal(_row(out2[0], i), fix), i
+
+
+def test_drain_refuses_unflushed_content_and_stays_live():
+    """A rank still holding content a survivor lacks must NOT leave:
+    the certificate counts the unacked out-lanes and drain refuses,
+    leaving membership and generation untouched."""
+    base = get_merge_kind("orswot").states()[0]
+    ahead = get_merge_kind("orswot").states()[-1]
+    rows = jax.tree.map(
+        lambda a, b: jnp.stack([a, b.astype(a.dtype)]), base, ahead
+    )
+    sm = ScaleoutMesh(2)
+    with pytest.raises(DrainRefused, match="unacked"):
+        sm.drain(1, kind="orswot", rows=rows, residue=0)
+    assert sm.live() == (0, 1)
+    assert sm.generation == 0
+
+
+def test_drain_refuses_stale_certificate():
+    """A certificate measured under an older generation is stale —
+    membership changed since the flush it describes."""
+    p = 4
+    _, batched = _population(p, p, n_ops=12, seed=5)
+    mesh = make_mesh(p, 1)
+    cur = shard_orswot(batched.state, mesh)
+    d, f = _genesis_tracking(cur)
+    out = mesh_delta_gossip(cur, d, f, mesh, local_fold="tree")
+    sm = ScaleoutMesh(p, live=range(p - 1))
+    cert = certify_drain(
+        "orswot", 1, out[0], int(out[3]),
+        generation=sm.generation, live=sm.live(),
+    )
+    sm.admit(1)  # membership moved on: the certificate is now stale
+    with pytest.raises(DrainRefused, match="stale"):
+        sm.drain(1, certificate=cert)
+    assert 1 in sm.live()
+
+
+def test_drain_never_empties_the_mesh():
+    sm = ScaleoutMesh(2, live=(0,))
+    with pytest.raises(ValueError, match="empty mesh"):
+        sm.drain(0, certificate=None)
+
+
+def test_drain_detector_and_broken_twin():
+    assert drain_refuses_unflushed(certify_drain)
+    assert not drain_refuses_unflushed(fixtures.drain_ignores_unacked)
+
+
+# ---- 4. policy: symmetric hysteresis + autoscaler --------------------------
+
+def test_hysteresis_vote_debounces_both_directions():
+    """The symmetric governor (ISSUE 11 satellite): widen fires only
+    after ``widen_rounds`` consecutive hot observations, shrink only
+    after ``shrink_rounds`` cold ones, a mid-band reading resets both
+    streaks, and a fired vote resets its own — one decision per
+    sustained excursion."""
+    pol = elastic.ElasticPolicy(
+        low_water=0.2, shrink_rounds=3, high_water=0.8, widen_rounds=2
+    )
+    h = elastic.Hysteresis(pol)
+    assert h.vote("mesh", 0.9) is None          # one hot round: no vote
+    assert h.vote("mesh", 0.9) == "widen"       # sustained: fire
+    assert h.vote("mesh", 0.9) is None          # streak consumed
+    assert h.vote("mesh", 0.5) is None          # mid-band: resets
+    assert h.vote("mesh", 0.1) is None
+    assert h.vote("mesh", 0.1) is None
+    assert h.vote("mesh", 0.1) == "shrink"      # third cold round
+    assert h.vote("mesh", 0.1) is None          # consumed
+    # A spike mid-cool resets the cold streak (no thrash).
+    h2 = elastic.Hysteresis(pol)
+    for p_ in (0.1, 0.1, 0.9, 0.1, 0.1):
+        assert h2.vote("m2", p_) is None
+    assert h2.vote("m2", 0.1) == "shrink"
+    # Signals are independent per name.
+    h3 = elastic.Hysteresis(pol)
+    assert h3.vote("a", 0.9) is None
+    assert h3.vote("b", 0.9) is None
+    assert h3.vote("a", 0.9) == "widen"
+    with pytest.raises(ValueError):
+        h3.vote("a", 1.5)
+
+
+def test_elastic_policy_keeps_shrink_half_positionally():
+    """The old ElasticPolicy fields stay the shrink half, in place —
+    positional constructions from pre-ISSUE-11 code must mean the same
+    thing (the widen half appends with defaults)."""
+    pol = elastic.ElasticPolicy(2.0, 4, 0.25, 4, 8)
+    assert (pol.factor, pol.max_migrations) == (2.0, 4)
+    assert (pol.low_water, pol.shrink_rounds, pol.shrink_floor) == (
+        0.25, 4, 8
+    )
+    assert pol.high_water == 0.85 and pol.widen_rounds == 2
+
+
+def test_autoscaler_debounced_admit_then_drain():
+    sm = ScaleoutMesh(4, live=range(3))
+    pol = elastic.ElasticPolicy(
+        low_water=0.2, shrink_rounds=2, high_water=0.8, widen_rounds=2
+    )
+    asc = Autoscaler(sm, pol, min_live=2)
+    assert asc.observe(pressure=0.95) is None
+    dec = asc.observe(pressure=0.95)
+    assert dec is not None and dec.action == "admit" and dec.rank == 3
+    assert dec.generation == sm.generation
+    sm.admit(ranks=(dec.rank,))
+    # Quiet traffic: the drain side debounces the same way.
+    assert asc.observe(pressure=0.0) is None
+    dec2 = asc.observe(pressure=0.0)
+    assert dec2 is not None and dec2.action == "drain"
+    assert dec2.rank == 3, "the newest-admitted rank drains first"
+
+
+def test_autoscaler_refuses_impossible_moves():
+    pol = elastic.ElasticPolicy(
+        low_water=0.2, shrink_rounds=1, high_water=0.8, widen_rounds=1
+    )
+    full = Autoscaler(ScaleoutMesh(2), pol)
+    assert full.observe(pressure=1.0) is None       # nothing parked
+    floor = Autoscaler(ScaleoutMesh(2), pol, min_live=2)
+    assert floor.observe(pressure=0.0) is None      # at min_live
+
+
+def test_autoscaler_folds_telemetry_signals():
+    sm = ScaleoutMesh(4, live=range(3))
+    asc = Autoscaler(sm, lag_ref=10, retry_ref=4)
+    tel = telemetry.zeros()
+    assert asc.pressure(tel) == 0.0
+    hot = tel._replace(widen_pressure=jnp.float32(0.9))
+    assert asc.pressure(hot) == pytest.approx(0.9)
+    lagged = tel._replace(frontier_lag=jnp.uint32(5))
+    assert asc.pressure(lagged) == pytest.approx(0.5)
+    missing = tel._replace(
+        stream_blocks=jnp.uint32(10), stream_overlap_hit=jnp.uint32(4)
+    )
+    assert asc.pressure(missing) == pytest.approx(0.6)
+    assert asc.pressure(tel, retries=2) == pytest.approx(0.5)
+    assert asc.pressure(tel, load=0.7) == pytest.approx(0.7)
+    assert asc.pressure(hot, load=2.0) == 1.0  # clamped
+
+
+# ---- 5. telemetry + registry + static checks -------------------------------
+
+def test_scaleout_telemetry_fields_record_and_validate():
+    metrics.reset()
+    sm = ScaleoutMesh(4, live=range(3))
+    sm.admit(1)
+    tel = sm.annotate(telemetry.zeros())
+    assert int(tel.live_ranks) == 4
+    assert int(tel.scaleout_admits) == 1
+    d = telemetry.to_dict(tel)
+    assert {"live_ranks", "scaleout_admits", "scaleout_drains",
+            "bootstrap_bytes"} <= set(d)
+    telemetry.record("scaleout_test", tel)
+    snap = metrics.snapshot()
+    assert snap["counters"]["telemetry.scaleout_test.scaleout.admits"] == 1
+    assert snap["counters"]["scaleout.admits"] == 1
+    assert "scaleout.live_ranks" in snap["gauges"]
+    # The exporter record validates against the committed schema.
+    import sys
+    sys.path.insert(
+        0, str(__import__("pathlib").Path(__file__).parent.parent / "tools")
+    )
+    import check_telemetry_schema as cts
+    from crdt_tpu import exporter
+
+    assert cts.validate_record(exporter.telemetry_record("x", tel)) == []
+
+
+def test_combine_folds_scaleout_counters_and_gauges():
+    a = telemetry.zeros()._replace(
+        scaleout_admits=jnp.uint32(1), bootstrap_bytes=jnp.float32(100.0),
+        live_ranks=jnp.uint32(3),
+    )
+    b = telemetry.zeros()._replace(
+        scaleout_admits=jnp.uint32(2), scaleout_drains=jnp.uint32(1),
+        bootstrap_bytes=jnp.float32(50.0), live_ranks=jnp.uint32(5),
+    )
+    c = telemetry.combine(a, b)
+    assert int(c.scaleout_admits) == 3 and int(c.scaleout_drains) == 1
+    assert float(c.bootstrap_bytes) == 150.0
+    assert int(c.live_ranks) == 5, "gauge: the LATER run's value"
+
+
+def test_every_scaleout_surface_registered():
+    assert unregistered_scaleout_surfaces() == []
+    names = {s.name for s in scaleout_surfaces()}
+    assert {"ScaleoutMesh", "bootstrap", "certify_drain", "Autoscaler"} <= names
+
+
+def test_scaleout_static_checks_clean():
+    assert static_checks() == []
+
+
+# ---- 6. the 8-rank soak (slow tier; quick cousins above) -------------------
+
+def test_scaleout_soak_under_chaos_8rank():
+    """The full elastic trajectory on the 8-rank axis under injected
+    corruption: serve at 5/8, absorb faulted traffic, admit 2 (one
+    cold, one through a faulted bootstrap wire), serve at 7/8, drain
+    one — every converged read bit-identical to the fixed-width oracle
+    of the same population. SLOW tier: the in-tier cousins are
+    test_admit_bootstraps_newcomer_from_bottom_bit_identical and
+    test_drain_cycle_certified_and_survivors_serve (4-rank, same
+    machinery including the certificate path)."""
+    p = 8
+    sites, batched = _population(5, p, n_ops=40, seed=29)
+    mesh = make_mesh(p, 1)
+    cur = shard_orswot(batched.state, mesh)
+    sm = ScaleoutMesh(p, live=range(5))
+    fix = _row(mesh_gossip(cur, mesh, local_fold="tree")[0], 0)
+
+    # Faulted traffic at 5/8: corruption is absorbed (rejected, never
+    # joined), the residue certificate is voided by loss, and one
+    # clean flush re-certifies.
+    plan = sm.plan(FaultPlan(seed=31, corrupt=0.5))
+    d, f = _genesis_tracking(cur)
+    out = mesh_delta_gossip(cur, d, f, mesh, local_fold="tree", faults=plan)
+    rows = out[0]
+    d, f = _genesis_tracking(rows)
+    out = mesh_delta_gossip(rows, d, f, mesh, local_fold="tree",
+                            faults=sm.plan())
+    rows, residue = out[0], int(out[3])
+    assert residue == 0
+    for i in sm.live():
+        assert _trees_equal(_row(rows, i), fix), i
+
+    # Admit two: one clean, one across a lossy bootstrap wire.
+    rows, rep1 = sm.admit(1, kind="orswot", rows=rows)
+    rows, rep2 = sm.admit(
+        1, kind="orswot", rows=rows,
+        faults=FaultPlan(seed=37, drop=0.3, corrupt=0.3),
+        segment_cap=2, max_attempts=400,
+    )
+    assert rep2.bootstraps[0].reshipped >= 0
+    d, f = _genesis_tracking(rows)
+    out = mesh_delta_gossip(rows, d, f, mesh, local_fold="tree",
+                            faults=sm.plan())
+    rows, residue = out[0], int(out[3])
+    assert residue == 0
+    for i in sm.live():
+        assert _trees_equal(_row(rows, i), fix), i
+
+    # Drain the newest rank under the certificate and keep serving.
+    cert = sm.drain(6, kind="orswot", rows=rows, residue=residue)
+    assert cert.ok()
+    rows = park_row(rows, 6)
+    d, f = _genesis_tracking(rows)
+    out = mesh_delta_gossip(rows, d, f, mesh, local_fold="tree",
+                            faults=sm.plan())
+    assert int(out[3]) == 0
+    for i in sm.live():
+        assert _trees_equal(_row(out[0], i), fix), i
+    assert sm.generation == 3

@@ -46,6 +46,17 @@ Sections (each timed, each independently skippable):
   covered by the join of the others — analysis/laws.py), and the
   broken-twin detectors (the lossy and non-irredundant fixtures must
   each fire their law).
+- ``scaleout`` — the elastic mesh scale-out gates
+  (crdt_tpu.scaleout.static_checks): scaleout-surface registry
+  coverage (every public operational symbol must have registered —
+  crdt_tpu.analysis.registry.register_scaleout_surface), the
+  generation/bijection membership walk (every admit/drain ring rebuild
+  stays a true bijection, generations strictly increase, full
+  membership composes NO fault plan), and the broken-twin detector
+  gates — the corrupt-blind bootstrap
+  (``analysis.fixtures.bootstrap_skips_checksum``) must fail the
+  corruption detector and the unacked-blind drain certifier
+  (``fixtures.drain_ignores_unacked``) must fail the refusal detector.
 - ``jit-lint``  — the jaxpr walker (crdt_tpu.analysis.jit_lint) over
   every registered mesh entry point: traced-branch, unstable-sort,
   float-accum, dtype-overflow, donation-alias, PLUS the collective-
@@ -93,7 +104,7 @@ sys.path.insert(0, ROOT)
 
 SECTIONS = (
     "lint", "schema", "laws", "schedules", "faults", "decomp",
-    "durability", "jit-lint", "cost", "aliasing",
+    "durability", "scaleout", "jit-lint", "cost", "aliasing",
 )
 
 # Directories the fallback linter walks (ruff takes its own config).
@@ -253,6 +264,12 @@ def run_durability():
     return static_checks()
 
 
+def run_scaleout():
+    from crdt_tpu.scaleout import static_checks
+
+    return static_checks()
+
+
 def run_jit_lint():
     from crdt_tpu.analysis.jit_lint import check_gates, lint_entry_points
 
@@ -288,14 +305,15 @@ RUNNERS = {
     "faults": run_faults,
     "decomp": run_decomp,
     "durability": run_durability,
+    "scaleout": run_scaleout,
     "jit-lint": run_jit_lint,
     "cost": run_cost,
     "aliasing": run_aliasing,
 }
 
 _JAX_SECTIONS = (
-    "laws", "schedules", "faults", "decomp", "durability", "jit-lint",
-    "cost", "aliasing",
+    "laws", "schedules", "faults", "decomp", "durability", "scaleout",
+    "jit-lint", "cost", "aliasing",
 )
 
 
