@@ -124,6 +124,75 @@ def _cfg(leg: str, key: str, env: str, cpu_fallback: bool = False) -> int:
     return int(os.environ.get(env, val))
 
 
+def _flight_start(capacity: int = 8192):
+    """Install a fresh flight recorder for one bench leg (obs/
+    recorder.py) and remember both the previous recorder and the
+    registry counter baseline, so the postmortem cross-check can
+    attribute exactly this leg's counters."""
+    from crdt_tpu import obs
+    from crdt_tpu.utils.metrics import metrics
+
+    base = metrics.snapshot()
+    rec = obs.FlightRecorder(capacity=capacity)
+    prev = obs.install(rec)
+    return rec, prev, base
+
+
+def _flight_finish(name: str, rec, prev, base) -> dict:
+    """Dump the leg's flight artifact (gitignored
+    ``BENCH_FLIGHT_<name>.jsonl``), replay it through
+    tools/obs_report.py against the LIVE registry counters accrued
+    since ``base``, ASSERT the bit-exact cross-check and a clean
+    invariant audit (the ISSUE 12 acceptance gate), and return the
+    record fields: dump path + folded p50/p95/p99 histogram summaries
+    (the p99 riding the headline BENCH record)."""
+    import sys
+
+    from crdt_tpu import obs
+    from crdt_tpu.utils.metrics import metrics
+
+    dump_path = os.path.abspath(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     f"BENCH_FLIGHT_{name}.jsonl")
+    )
+    rec.dump(dump_path, reason=f"bench-{name}")
+    obs.install(prev)
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"
+    )
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import obs_report
+
+    live = metrics.snapshot().get("counters", {})
+    base_c = base.get("counters", {})
+    since = {"counters": {
+        k: v - base_c.get(k, 0) for k, v in live.items()
+    }}
+    report = obs_report.build_report(dump_path, snapshot=since)
+    assert report["ok"], (
+        f"flight dump failed the postmortem gate: "
+        f"parse={report['parse_errors'][:2]} "
+        f"mismatches={report['counter_mismatches'][:3]} "
+        f"audit={[f for f in report['audit'] if f['severity'] == 'error'][:2]}"
+    )
+    hist = {
+        key: {
+            "count": s["count"],
+            "p50": round(s["p50"], 3),
+            "p95": round(s["p95"], 3),
+            "p99": round(s["p99"], 3),
+        }
+        for key, s in sorted(report["histograms"].items())
+    }
+    return {
+        "flight_dump": dump_path,
+        "flight_ok": True,
+        "flight_events": report["events"],
+        "hist": hist,
+    }
+
+
 def make_arrays(r, e=None):
     """Host-side (numpy) replica states for the CPU oracle baseline."""
     e = E if e is None else e
@@ -692,69 +761,93 @@ def bench_chaos():
         f0 = jnp.zeros(state.ctr.shape, state.ctr.dtype)
         return interval_accumulate(d0, f0, z, state)
 
+    # The whole soak runs under a flight recorder: telemetry events per
+    # dispatch (with the in-kernel histograms), fault counters,
+    # membership transitions — dumped and replayed through
+    # tools/obs_report.py before any number is reported. The finally
+    # below keeps the process-global recorder from leaking past a
+    # failed assert (re-installing prev after _flight_finish already
+    # did is a harmless same-value store).
+    from crdt_tpu import obs as _obs
+
+    rec, prev_rec, snap_base = _flight_start()
     dropped = rejected = 0
     t0 = time.perf_counter()
-    for _ in range(runs):
-        d, f = tracking(cur)
-        out = mesh_delta_gossip(cur, d, f, mesh, local_fold="tree",
-                                faults=plan)
-        fc = out[-1]
-        dropped += int(fc.packets_dropped)
-        rejected += int(fc.packets_rejected)
-        assert int(out[3]) >= 1, "loss must void the residue certificate"
-        cur = out[0]
+    try:
+        for _ in range(runs):
+            d, f = tracking(cur)
+            out = mesh_delta_gossip(cur, d, f, mesh, local_fold="tree",
+                                    faults=plan, telemetry=True)
+            fc = out[-1]
+            dropped += int(fc.packets_dropped)
+            rejected += int(fc.packets_rejected)
+            assert int(out[3]) >= 1, "loss must void the residue certificate"
+            cur = out[0]
+            rec.snapshot_delta()
+    except BaseException:
+        _obs.install(prev_rec)
+        raise
     chaos_s = time.perf_counter() - t0
-    # Heal = state-driven resync; it is ALSO the evicted rank's rejoin.
-    t0 = time.perf_counter()
-    healed, _ = mesh_gossip(cur, mesh, local_fold="tree")
-    heal_s = time.perf_counter() - t0
-    identical = all(
-        all(
-            bool(jnp.array_equal(x, y))
-            for x, y in zip(
-                jax.tree.leaves(jax.tree.map(lambda v: v[i], healed)),
-                jax.tree.leaves(ref0),
+    try:
+        # Heal = state-driven resync; it is ALSO the evicted rank's rejoin.
+        t0 = time.perf_counter()
+        healed, _ = mesh_gossip(cur, mesh, local_fold="tree")
+        heal_s = time.perf_counter() - t0
+        identical = all(
+            all(
+                bool(jnp.array_equal(x, y))
+                for x, y in zip(
+                    jax.tree.leaves(jax.tree.map(lambda v: v[i], healed)),
+                    jax.tree.leaves(ref0),
+                )
             )
+            for i in range(p)
         )
-        for i in range(p)
-    )
-    assert identical, "chaos heal diverged from the fault-free fixpoint"
+        assert identical, "chaos heal diverged from the fault-free fixpoint"
 
-    # Frontier unpinning: live ranks hold a parked remove their tops
-    # cover; the straggler's stale top pins the all-ranks frontier
-    # (pre-PR: nothing retires) while the membership eviction frontier
-    # lets compaction fire.
-    n = 5
-    stragglers = [Orswot() for _ in range(n)]
-    for i in range(n):
-        stragglers[i].apply(stragglers[i].add(
-            i, stragglers[i].read().derive_add_ctx(f"s{i}")
-        ))
-    ghost = Orswot()
-    ghost.apply(ghost.add("never", ghost.read().derive_add_ctx("zz")))
-    rm_op = ghost.rm("never", ghost.contains("never").derive_rm_ctx())
-    for i in range(n - 1):
-        stragglers[i].apply(rm_op)
-    model = BatchedOrswot.from_pure(
-        stragglers,
-        members=Interner(list(range(n)) + ["never"]),
-        actors=Interner([f"s{i}" for i in range(n)] + ["zz"]),
-    )
-    zz = model.actors.id_of("zz")
-    model.state = model.state._replace(
-        top=model.state.top.at[: n - 1, zz].set(1)
-    )
-    parked = int(jnp.sum(model.state.dvalid))
-    pinned = reclaim.compact_model(model, reclaim.model_frontier(model))
-    members = Membership(n, k_suspect=2)
-    members.evict(n - 1)
-    live_frontier = reclaim.host_frontier(
-        [np.asarray(model.state.top[i]) for i in members.live()]
-    )
-    unpinned = reclaim.compact_model(model, live_frontier)
-    members.rejoin(n - 1)
-    assert pinned["reclaimed_slots"] == 0
-    assert unpinned["reclaimed_slots"] >= parked
+        # Frontier unpinning: live ranks hold a parked remove their tops
+        # cover; the straggler's stale top pins the all-ranks frontier
+        # (pre-PR: nothing retires) while the membership eviction frontier
+        # lets compaction fire.
+        n = 5
+        stragglers = [Orswot() for _ in range(n)]
+        for i in range(n):
+            stragglers[i].apply(stragglers[i].add(
+                i, stragglers[i].read().derive_add_ctx(f"s{i}")
+            ))
+        ghost = Orswot()
+        ghost.apply(ghost.add("never", ghost.read().derive_add_ctx("zz")))
+        rm_op = ghost.rm("never", ghost.contains("never").derive_rm_ctx())
+        for i in range(n - 1):
+            stragglers[i].apply(rm_op)
+        model = BatchedOrswot.from_pure(
+            stragglers,
+            members=Interner(list(range(n)) + ["never"]),
+            actors=Interner([f"s{i}" for i in range(n)] + ["zz"]),
+        )
+        zz = model.actors.id_of("zz")
+        model.state = model.state._replace(
+            top=model.state.top.at[: n - 1, zz].set(1)
+        )
+        parked = int(jnp.sum(model.state.dvalid))
+        pinned = reclaim.compact_model(model, reclaim.model_frontier(model))
+        members = Membership(n, k_suspect=2)
+        members.evict(n - 1)
+        live_frontier = reclaim.host_frontier(
+            [np.asarray(model.state.top[i]) for i in members.live()]
+        )
+        unpinned = reclaim.compact_model(model, live_frontier)
+        members.rejoin(n - 1)
+        assert pinned["reclaimed_slots"] == 0
+        assert unpinned["reclaimed_slots"] >= parked
+
+        flight = _flight_finish("chaos", rec, prev_rec, snap_base)
+    except BaseException:
+        _obs.install(prev_rec)
+        raise
+    p99_us = flight["hist"].get(
+        "delta_gossip.dispatch_us", {}
+    ).get("p99", 0.0)
 
     log(
         f"config-chaos: {p}-rank δ ring x {runs} degraded runs "
@@ -762,7 +855,9 @@ def bench_chaos():
         f"{rejected} rejected + {dropped} dropped packets absorbed in "
         f"{chaos_s:.1f}s, healed bit-identical in {heal_s:.1f}s; "
         f"frontier eviction retired {unpinned['reclaimed_slots']} parked "
-        f"slots the pinned frontier kept ({pinned['reclaimed_slots']})"
+        f"slots the pinned frontier kept ({pinned['reclaimed_slots']}); "
+        f"flight dump replayed bit-exact ({flight['flight_events']} "
+        f"events), dispatch p99 {p99_us:,.0f} µs"
     )
     return [{
         "config": "chaos", "metric": "packets_lost_and_healed",
@@ -776,7 +871,9 @@ def bench_chaos():
         "reclaimed_slots_pinned": pinned["reclaimed_slots"],
         "reclaimed_slots_evicted": unpinned["reclaimed_slots"],
         "bit_identical": identical,
+        "dispatch_p99_us": p99_us,
         "shape": f"{p}x{4 * p}",
+        **flight,
     }]
 
 
@@ -1013,8 +1110,12 @@ def bench_recovery():
         fctx = jnp.where(dirty[..., None], ctr, 0)
         return st, dirty, fctx
 
+    rec, prev_rec, snap_base = _flight_start()
     try:
         # ---- 1. the durable run --------------------------------------
+        # (telemetry= on so the WAL watermarks, fsyncs, snapshot
+        # commit, and recovery interleave with per-dispatch telemetry
+        # events on the flight recorder's timeline.)
         base = ops.empty(e, a, deferred_cap=2, batch=(p,))
         base = base._replace(
             ctr=base.ctr.at[:, : e // 2, 0].set(1),
@@ -1023,7 +1124,7 @@ def bench_recovery():
         genesis = base
         w = du.Wal(wal_dir, fsync="on_round")
         st, d, f = churn(base, 1)
-        out = mesh_delta_gossip(st, d, f, mesh, wal=w)
+        out = mesh_delta_gossip(st, d, f, mesh, wal=w, telemetry=True)
         snap.save_state(
             snap_dir, "orswot", out[0], wal_seq=w.last_seq, retain=2,
         )
@@ -1032,7 +1133,7 @@ def bench_recovery():
         )
         for r in range(2, 2 + rounds_after_snapshot):
             st, d, f = churn(out[0], r)
-            out = mesh_delta_gossip(st, d, f, mesh, wal=w)
+            out = mesh_delta_gossip(st, d, f, mesh, wal=w, telemetry=True)
         final_at_kill = out[0]
         wal_bytes = w.bytes_appended
         wal_fsyncs = w.fsyncs
@@ -1084,6 +1185,12 @@ def bench_recovery():
         assert rj.ratio < 0.25, (
             f"log-based rejoin shipped {rj.ratio:.1%} of full state"
         )
+        flight = _flight_finish("recovery", rec, prev_rec, snap_base)
+    except BaseException:
+        from crdt_tpu import obs as _obs
+
+        _obs.install(prev_rec)
+        raise
     finally:
         shutil.rmtree(root, ignore_errors=True)
 
@@ -1110,6 +1217,7 @@ def bench_recovery():
         "rejoin_seconds": round(rejoin_s, 4),
         "bit_identical": recovery_identical and rejoin_identical,
         "shape": f"{p}x{e}x{a}",
+        **flight,
     }]
 
 
@@ -1197,82 +1305,112 @@ def bench_scaleout():
     # second — the quantity more chips must raise.
     rounds = 2 * (p - 1) - 1  # the pipelined certificate window
 
-    def measure(state):
-        plan = sm.plan()
-        d, f = tracking(state)  # warmup: compile this membership's ring
-        warm = mesh_delta_gossip(state, d, f, mesh, local_fold="tree",
-                                 faults=plan)
-        jax.block_until_ready(jax.tree.leaves(warm[0]))
-        state, res = warm[0], int(warm[3])
-        t0 = time.perf_counter()
-        for _ in range(runs):
+    # The whole trajectory records to a flight recorder: generation
+    # changes, admits, votes, the drain certificate — plus telemetry
+    # events (with in-kernel histograms) from the measured runs.
+    rec, prev_rec, base_counters = _flight_start()
+    from crdt_tpu import obs as _obs
+
+    try:
+
+        def measure(state):
+            # The timed loop stays UN-instrumented (telemetry host drains
+            # would flatten the rate comparison); one telemetry'd
+            # observation dispatch follows per phase, below.
+            plan = sm.plan()
+            d, f = tracking(state)  # warmup: compile this membership's ring
+            warm = mesh_delta_gossip(state, d, f, mesh, local_fold="tree",
+                                     faults=plan)
+            jax.block_until_ready(jax.tree.leaves(warm[0]))
+            state, res = warm[0], int(warm[3])
+            t0 = time.perf_counter()
+            for _ in range(runs):
+                d, f = tracking(state)
+                out = mesh_delta_gossip(state, d, f, mesh, local_fold="tree",
+                                        faults=plan)
+                state, res = out[0], int(out[3])
+            jax.block_until_ready(jax.tree.leaves(state))
+            dt = time.perf_counter() - t0
+            joins = len(sm.live()) * rounds * runs
+            return state, res, joins / dt, dt
+
+        def observe_tel(state):
+            # One OFF-the-clock telemetry'd dispatch per phase: the flight
+            # recorder gets a per-phase telemetry event (with the in-kernel
+            # histograms) and a snapshot delta, the timed numbers stay
+            # honest. Joins are idempotent — the converged state is
+            # bit-unchanged.
             d, f = tracking(state)
             out = mesh_delta_gossip(state, d, f, mesh, local_fold="tree",
-                                    faults=plan)
-            state, res = out[0], int(out[3])
-        jax.block_until_ready(jax.tree.leaves(state))
-        dt = time.perf_counter() - t0
-        joins = len(sm.live()) * rounds * runs
-        return state, res, joins / dt, dt
+                                    faults=sm.plan(), telemetry=True)
+            rec.snapshot_delta()
+            return out[0]
 
-    # 1. plateau at P-2.
-    cur, res_pre, rate_pre, pre_s = measure(cur)
-    assert res_pre == 0, "plateau must certify"
-    assert identical(cur), "plateau reads diverged from the oracle"
+        # 1. plateau at P-2.
+        cur, res_pre, rate_pre, pre_s = measure(cur)
+        assert res_pre == 0, "plateau must certify"
+        assert identical(cur), "plateau reads diverged from the oracle"
+        cur = observe_tel(cur)
 
-    # 2. spike -> debounced admits -> widened mesh.
-    admits = 0
-    boot_reports = []
-    while sm.parked:
-        dec = autoscaler.observe(load=1.0)
-        if dec is None:
-            continue
-        assert dec.action == "admit"
-        cur, rep = sm.admit(1, kind="orswot", rows=cur)
-        boot_reports.extend(rep.bootstraps)
-        admits += 1
-    cur, res_post, rate_post, post_s = measure(cur)
-    assert res_post == 0, "widened mesh must certify"
-    assert identical(cur), "post-admit reads diverged from the oracle"
-    gain = rate_post / rate_pre if rate_pre else 0.0
-    assert rate_post > rate_pre, (
-        f"admit must raise sustained merges/s "
-        f"({rate_pre:.0f} -> {rate_post:.0f})"
-    )
+        # 2. spike -> debounced admits -> widened mesh.
+        admits = 0
+        boot_reports = []
+        while sm.parked:
+            dec = autoscaler.observe(load=1.0)
+            if dec is None:
+                continue
+            assert dec.action == "admit"
+            cur, rep = sm.admit(1, kind="orswot", rows=cur)
+            boot_reports.extend(rep.bootstraps)
+            admits += 1
+        cur, res_post, rate_post, post_s = measure(cur)
+        assert res_post == 0, "widened mesh must certify"
+        assert identical(cur), "post-admit reads diverged from the oracle"
+        cur = observe_tel(cur)
+        gain = rate_post / rate_pre if rate_pre else 0.0
+        assert rate_post > rate_pre, (
+            f"admit must raise sustained merges/s "
+            f"({rate_pre:.0f} -> {rate_post:.0f})"
+        )
 
-    # 3. warm-start byte gate: snapshot base ships only the log suffix.
-    e_w, a_w = 512, 8
-    empty_w = ops.empty(e_w, a_w, 2)
-    snap_base = empty_w._replace(
-        ctr=empty_w.ctr.at[: e_w // 3, 0].set(1)
-    )
-    live_w = snap_base._replace(
-        ctr=snap_base.ctr.at[: e_w // 25, 1].set(2),
-        top=snap_base.top.at[0].set(1).at[1].set(2),
-    )
-    _, warm_rep = bootstrap("orswot", live_w, base=snap_base)
-    assert warm_rep.ratio < 0.25, (
-        f"warm bootstrap shipped {warm_rep.ratio:.1%} of full-state bytes"
-    )
+        # 3. warm-start byte gate: snapshot base ships only the log suffix.
+        e_w, a_w = 512, 8
+        empty_w = ops.empty(e_w, a_w, 2)
+        snap_base = empty_w._replace(
+            ctr=empty_w.ctr.at[: e_w // 3, 0].set(1)
+        )
+        live_w = snap_base._replace(
+            ctr=snap_base.ctr.at[: e_w // 25, 1].set(2),
+            top=snap_base.top.at[0].set(1).at[1].set(2),
+        )
+        _, warm_rep = bootstrap("orswot", live_w, base=snap_base)
+        assert warm_rep.ratio < 0.25, (
+            f"warm bootstrap shipped {warm_rep.ratio:.1%} of full-state bytes"
+        )
 
-    # 4. quiet -> debounced drain -> certified scale-in.
-    dec = None
-    while dec is None:
-        dec = autoscaler.observe(load=0.0)
-    assert dec.action == "drain"
-    d, f = tracking(cur)
-    flush = mesh_delta_gossip(cur, d, f, mesh, local_fold="tree",
-                              faults=sm.plan())
-    cert = sm.drain(dec.rank, kind="orswot", rows=flush[0],
-                    residue=int(flush[3]))
-    cur = park_row(flush[0], dec.rank)
-    cur, res_in, rate_in, _ = measure(cur)
-    assert res_in == 0 and identical(cur), (
-        "post-drain reads diverged from the oracle"
-    )
+        # 4. quiet -> debounced drain -> certified scale-in.
+        dec = None
+        while dec is None:
+            dec = autoscaler.observe(load=0.0)
+        assert dec.action == "drain"
+        d, f = tracking(cur)
+        flush = mesh_delta_gossip(cur, d, f, mesh, local_fold="tree",
+                                  faults=sm.plan())
+        cert = sm.drain(dec.rank, kind="orswot", rows=flush[0],
+                        residue=int(flush[3]))
+        cur = park_row(flush[0], dec.rank)
+        cur, res_in, rate_in, _ = measure(cur)
+        assert res_in == 0 and identical(cur), (
+            "post-drain reads diverged from the oracle"
+        )
+        cur = observe_tel(cur)
 
-    tel = sm.annotate(tele.zeros())
-    tele.record("scaleout", tel)
+        tel = sm.annotate(tele.zeros())
+        tele.record("scaleout", tel)
+        flight = _flight_finish("scaleout", rec, prev_rec, base_counters)
+    except BaseException:
+        _obs.install(prev_rec)
+        raise
     cold_ratio = (
         sum(r.ratio for r in boot_reports) / len(boot_reports)
         if boot_reports else 0.0
@@ -1284,7 +1422,8 @@ def bench_scaleout():
         f"{warm_rep.ratio:.1%} of full-state bytes (cold {cold_ratio:.1%}), "
         f"drain rank {dec.rank} certified (residue {cert.residue}, "
         f"unacked {cert.lanes_unacked}) at generation {sm.generation}; "
-        f"reads bit-identical in both directions"
+        f"reads bit-identical in both directions; flight dump replayed "
+        f"bit-exact ({flight['flight_events']} events)"
     )
     return [{
         "config": "scaleout", "metric": "scaleout_merge_rate_gain",
@@ -1304,6 +1443,7 @@ def bench_scaleout():
         "bit_identical": True,
         "runs": runs,
         "shape": f"{p}x{cur.ctr.shape[-2]}",
+        **flight,
     }]
 
 

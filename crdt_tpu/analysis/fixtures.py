@@ -460,3 +460,49 @@ def drain_ignores_unacked(kind, rank, rows, residue, counters=None, **kw):
 
     cert = certify_drain(kind, rank, rows, residue, counters, **kw)
     return _replace(cert, lanes_unacked=0)
+
+
+# ---- observability twins (crdt_tpu/obs/) ----------------------------------
+
+def recorder_drops_events(capacity: int = 8, **kwargs):
+    """Broken observability twin: a flight recorder whose ring
+    SILENTLY discards every third event and never counts a drop — the
+    postmortem reads as complete while the events nearest the failure
+    are gone, the exact blindness a flight recorder exists to prevent.
+    ``obs.recorder_conformant`` must fail it (the ``obs`` static-check
+    section pins that the detector fires)."""
+    from ..obs.recorder import FlightRecorder
+
+    class _Lossy(FlightRecorder):
+        def __init__(self):
+            super().__init__(capacity=capacity, **kwargs)
+            self._n = 0
+
+        def record(self, etype, **fields):
+            self._n += 1
+            if self._n % 3 == 0:
+                return None  # silently gone — and dropped never moves
+            return super().record(etype, **fields)
+
+    return _Lossy()
+
+
+def histogram_miscounts(h, value):
+    """Broken observability twin: a histogram observe that buckets by
+    FLOATING log2 with a truncating floor — exact powers of two land
+    one bucket LOW (2.0 reads as [1, 2) instead of [2, 4)), so every
+    boundary-heavy distribution (byte counts, round counts) skews a
+    full bucket at exactly the values it sees most.
+    ``obs.histogram_conformant`` must fail it."""
+    import jax.numpy as jnp
+
+    from ..obs import hist as _h
+
+    v = jnp.maximum(jnp.asarray(value).astype(jnp.float32), 0.0)
+    idx = jnp.clip(
+        jnp.floor(jnp.log2(jnp.maximum(v, 1.0))).astype(jnp.int32),
+        0, _h.NBUCKETS - 1,
+    )
+    return _h.Hist(
+        counts=h.counts.at[idx].add(jnp.uint32(1)), total=h.total + v,
+    )

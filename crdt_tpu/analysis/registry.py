@@ -210,12 +210,38 @@ class FaultSurface:
     module: str = ""
 
 
+@dataclass(frozen=True)
+class ObsEvent:
+    """One registered flight-recorder event type (crdt_tpu/obs/): the
+    schema a ``FlightRecorder.dump`` header carries so the artifact is
+    self-describing. Registration is the coverage contract — the
+    ``obs`` static-check section (tools/run_static_checks.py, via
+    ``crdt_tpu.obs.static_checks``) AST-scans every ``emit("...")``
+    site under ``crdt_tpu/`` and fails discovery for any literal event
+    type without a registered schema, exactly like an unregistered
+    join or mesh entry point. Register NEXT TO the emit site:
+
+        from ..analysis.registry import register_obs_event
+
+        register_obs_event(
+            "rank_evicted", subsystem="faults.membership",
+            fields=("rank",), module=__name__,
+        )
+    """
+
+    name: str
+    subsystem: str
+    fields: Tuple[str, ...] = ()
+    module: str = ""
+
+
 _MERGE: Dict[str, MergeKind] = {}
 _ENTRY: Dict[str, EntryPoint] = {}
 _COMPACT: Dict[str, Compactor] = {}
 _DECOMP: Dict[str, Decomposer] = {}
 _FAULT_SURFACES: Dict[str, FaultSurface] = {}
 _SCALEOUT_SURFACES: Dict[str, ScaleoutSurface] = {}
+_OBS_EVENTS: Dict[str, ObsEvent] = {}
 
 # Public callables in crdt_tpu.parallel matching this are mesh entry
 # points and MUST be registered (gossip_elastic/delta_gossip_elastic are
@@ -386,6 +412,122 @@ def unregistered_scaleout_surfaces() -> List[str]:
                     and getattr(obj, "__module__", "") == mod.__name__):
                 found.add(n)
     return sorted(found - set(_SCALEOUT_SURFACES))
+
+
+def register_obs_event(
+    name: str, *, subsystem: str, fields: Tuple[str, ...] = (),
+    module: str = "",
+) -> ObsEvent:
+    ev = ObsEvent(
+        name=name, subsystem=subsystem, fields=tuple(fields), module=module,
+    )
+    _OBS_EVENTS[name] = ev
+    return ev
+
+
+def obs_events() -> Tuple[ObsEvent, ...]:
+    _import_obs_emitters()
+    return tuple(_OBS_EVENTS[k] for k in sorted(_OBS_EVENTS))
+
+
+def get_obs_event(name: str) -> ObsEvent:
+    _import_obs_emitters()
+    return _OBS_EVENTS[name]
+
+
+_EMIT_SCAN_MEMO: Optional[List[Tuple[str, str, str]]] = None
+
+
+def _scan_emit_sites() -> List[Tuple[str, str, str]]:
+    """AST-walk every module under ``crdt_tpu/`` for flight-recorder
+    emit sites — calls named ``emit`` (bare or attribute, e.g.
+    ``obs.emit``) whose first argument is a string literal. Returns
+    ``(event_type, 'relpath:lineno', dotted_module)`` rows. Literal
+    scanning IS the contract: an event type minted from a runtime
+    string cannot be schema'd in a dump header, so it should not
+    exist. Memoised for the process — source files cannot change
+    mid-run, and every ``FlightRecorder.dump`` (including the
+    auto-dumps on recovery/failure boundaries) reads the registry
+    through this walk."""
+    global _EMIT_SCAN_MEMO
+    if _EMIT_SCAN_MEMO is not None:
+        return _EMIT_SCAN_MEMO
+    import ast
+    import os
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rows: List[Tuple[str, str, str]] = []
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, os.path.dirname(pkg_root))
+            try:
+                with open(path) as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except (OSError, SyntaxError):
+                continue
+            mod = rel[:-3].replace(os.sep, ".")
+            if mod.endswith(".__init__"):
+                mod = mod[: -len(".__init__")]
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                fname = (
+                    node.func.id if isinstance(node.func, ast.Name)
+                    else node.func.attr
+                    if isinstance(node.func, ast.Attribute) else ""
+                )
+                if fname != "emit":
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    rows.append((arg.value, f"{rel}:{node.lineno}", mod))
+    _EMIT_SCAN_MEMO = rows
+    return rows
+
+
+_OBS_EMITTERS_IMPORTED = False
+
+
+def _import_obs_emitters() -> None:
+    """Import every module containing an emit site (plus the recorder,
+    which owns the telemetry/auto_dump types) so their import-time
+    registrations have run before a coverage diff or a dump header
+    reads the table. Once per process — registration is import-time,
+    so a second pass can discover nothing new."""
+    global _OBS_EMITTERS_IMPORTED
+    if _OBS_EMITTERS_IMPORTED:
+        return
+    import importlib
+
+    for _, _, mod in _scan_emit_sites():
+        try:
+            importlib.import_module(mod)
+        except ImportError:
+            pass  # the coverage diff will name the orphan site anyway
+    importlib.import_module("crdt_tpu.obs.recorder")
+    _OBS_EMITTERS_IMPORTED = True
+
+
+def unregistered_obs_events() -> List[Tuple[str, str]]:
+    """``(event_type, site)`` for every literal flight-recorder emit
+    site under ``crdt_tpu/`` whose event type never called
+    :func:`register_obs_event` — the discovery gate of the ``obs``
+    static-check section. An event-emitting subsystem without a
+    registered schema fails here, the same
+    registration-is-the-coverage-contract rule as joins, compactors,
+    entry points, and fault/scaleout surfaces."""
+    _import_obs_emitters()
+    return sorted(
+        (etype, where)
+        for etype, where, _ in _scan_emit_sites()
+        if etype not in _OBS_EVENTS
+    )
 
 
 def fault_surfaces() -> Tuple[FaultSurface, ...]:

@@ -200,7 +200,10 @@ def update_window(win: AckWindow, sent, bits: jax.Array) -> AckWindow:
 
 def window_depth(win: AckWindow) -> jax.Array:
     """Rows with a live acked watermark (the ``ack_window_depth``
-    telemetry gauge, per device — the ring pmaxes it)."""
+    telemetry gauge, per device — the ring pmaxes the final value and
+    ALSO observes it per round into the ``hist_ack_depth`` in-kernel
+    histogram, crdt_tpu/obs/hist.py, so the window's fill curve across
+    a run is visible, not just where it ended)."""
     return jnp.sum(win.ackd, dtype=jnp.uint32)
 
 

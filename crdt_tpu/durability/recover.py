@@ -41,10 +41,25 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import numpy as np
 
+from .. import obs
 from ..utils.metrics import metrics, state_nbytes
 from . import snapshot as snap
 from .snapshot import SnapshotCorrupt
 from .wal import Wal
+
+
+def _record_recovery(report: "RecoveryReport") -> None:
+    """A completed recovery is a postmortem boundary BY DEFINITION —
+    something died to need one. Record the event and auto-dump the
+    flight artifact (obs/recorder.py; a no-op when no recorder is
+    installed)."""
+    obs.emit(
+        "recovery", generation=report.generation,
+        wal_seq_start=report.wal_seq_start,
+        replayed=report.replayed_records,
+        fallbacks=report.snapshot_fallbacks,
+    )
+    obs.auto_dump("recovery", generation=report.generation)
 
 
 class RecoveryReport(NamedTuple):
@@ -196,7 +211,7 @@ def recover_state(
         fallbacks = len(snap.generations(snap_dir))
     state, n_replayed, n_full = replay(wal, state, kind, since)
     metrics.count("durability.recovery_rounds")
-    return state, RecoveryReport(
+    report = RecoveryReport(
         generation=gen,
         wal_seq_start=since,
         replayed_records=n_replayed,
@@ -204,6 +219,8 @@ def recover_state(
         snapshot_fallbacks=fallbacks,
         seconds=time.perf_counter() - t0,
     )
+    _record_recovery(report)
+    return state, report
 
 
 def recover_model(snap_dir, wal: Wal, kind: Optional[str] = None):
@@ -222,7 +239,7 @@ def recover_model(snap_dir, wal: Wal, kind: Optional[str] = None):
     state, n_replayed, n_full = replay(wal, model.state, kind, info.wal_seq)
     model.state = state
     metrics.count("durability.recovery_rounds")
-    return model, RecoveryReport(
+    report = RecoveryReport(
         generation=info.gen,
         wal_seq_start=info.wal_seq,
         replayed_records=n_replayed,
@@ -230,6 +247,8 @@ def recover_model(snap_dir, wal: Wal, kind: Optional[str] = None):
         snapshot_fallbacks=fallbacks,
         seconds=time.perf_counter() - t0,
     )
+    _record_recovery(report)
+    return model, report
 
 
 def load_stream_resume(wal: Wal, template):
@@ -281,6 +300,13 @@ def rejoin(kind: str, live_state, recovered_state):
         bytes_full_state=full,
         ratio=shipped / full if full else 0.0,
     )
+
+
+from ..analysis.registry import register_obs_event as _reg_ev  # noqa: E402
+
+_reg_ev("recovery", subsystem="durability.recover",
+        fields=("generation", "wal_seq_start", "replayed", "fallbacks"),
+        module=__name__)
 
 
 __all__ = [

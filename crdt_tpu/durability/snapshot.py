@@ -51,6 +51,7 @@ from ..checkpoint import (
     _dump,
     _restore,
 )
+from .. import obs
 from ..utils.metrics import metrics
 from . import crashpoints as cp
 
@@ -158,6 +159,8 @@ def _write_payload_and_manifest(
     os.replace(tmp_manifest, manifest_path)  # THE commit point
     fsync_dir(d)
     metrics.count("durability.snapshots_written")
+    obs.emit("snapshot_commit", gen=gen,
+             wal_seq=manifest.get("wal_seq", 0))
     cp.hit(CP_POST_COMMIT_PRE_PRUNE)
 
     gens = generations(path)
@@ -299,6 +302,7 @@ def load_newest(path, template=None):
             raise  # caller bugs (missing template) are not corruption
         except Exception as exc:
             metrics.count("durability.snapshot_fallback")
+            obs.emit("snapshot_fallback", gen=gen)
             last_err = exc
     raise SnapshotCorrupt(
         f"no valid generation in {os.fspath(path)!r} "
@@ -363,6 +367,14 @@ def loader_detects_corruption(load_fn) -> bool:
         except Exception:
             return True
         return False
+
+
+from ..analysis.registry import register_obs_event as _reg_ev  # noqa: E402
+
+_reg_ev("snapshot_commit", subsystem="durability.snapshot",
+        fields=("gen", "wal_seq"), module=__name__)
+_reg_ev("snapshot_fallback", subsystem="durability.snapshot",
+        fields=("gen",), module=__name__)
 
 
 __all__ = [

@@ -56,6 +56,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..utils.metrics import metrics
 from . import crashpoints as cp
 
@@ -171,6 +172,7 @@ class Wal:
         self.torn_tails += 1
         metrics.count("durability.torn_tail_truncated")
         metrics.count(f"durability.torn_tail.{why}")
+        obs.emit("wal_torn_tail", why=why, at=pos)
 
     def _scan_and_open(self) -> None:
         """Validate every segment, truncate at the first damage, drop
@@ -259,10 +261,17 @@ class Wal:
     def _fsync(self, f) -> None:
         """The power-loss barrier — one overridable seam so the
         fsync-policy detector (and its broken twin) can prove the calls
-        happen (module docstring)."""
+        happen (module docstring). Each barrier advances the DURABLE
+        watermark: records up to ``last_seq`` now survive power loss —
+        the ``durability.wal.watermark`` gauge and the ``wal_fsync``
+        flight event both carry it (exporter.health reads the gauge;
+        tools/obs_report.py lines the events up against losses)."""
         os.fsync(f.fileno())
         self.fsyncs += 1
         metrics.count("durability.fsyncs")
+        metrics.observe("durability.wal.watermark", float(self.last_seq))
+        obs.emit("wal_fsync", watermark=self.last_seq,
+                 bytes=self.bytes_appended)
 
     def append(self, meta: dict, leaves) -> int:
         """Append one record (``meta`` + pytree leaves); returns its
@@ -464,6 +473,14 @@ def fsync_honored(wal_factory, tmp_dir) -> bool:
         return calls - base >= 3
     finally:
         _wal_mod.os.fsync = saved
+
+
+from ..analysis.registry import register_obs_event as _reg_ev  # noqa: E402
+
+_reg_ev("wal_fsync", subsystem="durability.wal",
+        fields=("watermark", "bytes"), module=__name__)
+_reg_ev("wal_torn_tail", subsystem="durability.wal",
+        fields=("why", "at"), module=__name__)
 
 
 __all__ = [

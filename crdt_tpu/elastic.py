@@ -491,6 +491,11 @@ class Hysteresis:
         elif cold >= policy.shrink_rounds:
             decision, cold = "shrink", 0
         self._hot[name], self._cold[name] = hot, cold
+        if decision is not None:
+            from . import obs
+
+            obs.emit("elastic_vote", name=name, decision=decision,
+                     pressure=float(pressure))
         return decision
 
 
@@ -603,6 +608,12 @@ def migrate(
         return widen(model, axes, policy, **explicit)
     record_headroom(model)
     return {}
+
+
+from .analysis.registry import register_obs_event as _reg_ev  # noqa: E402
+
+_reg_ev("elastic_vote", subsystem="elastic",
+        fields=("name", "decision", "pressure"), module=__name__)
 
 
 __all__ = [

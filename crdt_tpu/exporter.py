@@ -5,20 +5,32 @@ Three producers feed one drain:
 - the host registry (``utils.metrics.metrics`` — counters/gauges,
   including the ``elastic.<kind>.headroom.<axis>`` pressure gauges),
 - concrete :class:`crdt_tpu.telemetry.Telemetry` pytrees returned by
-  the mesh entry points (``telemetry=True``),
+  the mesh entry points (``telemetry=True``) — scalar counters AND the
+  ``hist_*`` in-kernel histogram subtrees (crdt_tpu/obs/hist.py),
 - span trace events buffered by ``telemetry.span``.
 
-Two sinks:
+Three sinks:
 
 - :func:`prometheus_text` — the Prometheus text exposition format
   (``# TYPE``-annotated; dotted metric names sanitized to underscores,
-  gauge min/max/sum/count exploded into suffixed series) for scrape
-  endpoints or textfile collectors;
+  gauge min/max/sum/count exploded into suffixed series, histogram
+  fields rendered as conformant cumulative ``_bucket{le=...}`` /
+  ``_sum`` / ``_count`` series) for scrape endpoints or textfile
+  collectors;
 - :func:`drain_jsonl` — append-only JSONL, one self-describing record
   per line (``{"record": "snapshot"|"telemetry"|"span", "ts": ...}``),
   the trajectory format ``bench.py --metrics-out`` writes and
   ``tools/check_telemetry_schema.py`` validates (committed schema:
-  ``tools/telemetry_schema.json`` — drift fails tier-1).
+  ``tools/telemetry_schema.json`` — drift fails tier-1);
+- :func:`health` — one at-a-glance JSON snapshot (live_ranks,
+  generation, frontier_lag, residue, last durable WAL watermark, the
+  loud-failure counters, the flight recorder's correlation key) — the
+  ``/healthz`` shape.
+
+The flight recorder's postmortem artifact is its own sink
+(``crdt_tpu.obs.FlightRecorder.dump`` — rendered and audited by
+``tools/obs_report.py``); its records validate through the same
+committed schema.
 """
 
 from __future__ import annotations
@@ -28,7 +40,9 @@ import re
 import time
 from typing import Any, Dict, Iterable, Optional
 
-from .telemetry import Telemetry, drain_events, is_concrete, to_dict
+from .telemetry import (
+    HIST_FIELDS, Telemetry, drain_events, is_concrete, to_dict,
+)
 from .utils.metrics import metrics
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
@@ -78,11 +92,39 @@ def prometheus_text(
         if not tels:
             break
         pname = f"crdt_tpu_telemetry_{sanitize(field)}"
+        if field in HIST_FIELDS:
+            # Conformant Prometheus histogram exposition: CUMULATIVE
+            # `le`-labeled buckets ending at +Inf (whose sample equals
+            # `_count`), an exact `_sum`, one TYPE block per metric.
+            lines.append(f"# TYPE {pname} histogram")
+            for kind, d in tels.items():
+                label = json.dumps(kind)
+                h = d[field]
+                cum = 0
+                for edge, c in zip(h["edges"] + ["+Inf"], h["counts"]):
+                    cum += c
+                    le = json.dumps(_le(edge))
+                    lines.append(
+                        f"{pname}_bucket{{kind={label},le={le}}} {cum}"
+                    )
+                lines.append(f"{pname}_sum{{kind={label}}} {h['total']}")
+                lines.append(f"{pname}_count{{kind={label}}} {cum}")
+            continue
         lines.append(f"# TYPE {pname} gauge")
         for kind, d in tels.items():
             label = json.dumps(kind)  # quote + escape
             lines.append(f"{pname}{{kind={label}}} {d[field]}")
     return "\n".join(lines) + "\n"
+
+
+def _le(edge) -> str:
+    """Prometheus `le` label text for one bucket upper edge: integral
+    edges print without a trailing ``.0`` (the canonical exposition
+    form), the unbounded bucket is the literal ``+Inf``."""
+    if edge == "+Inf":
+        return "+Inf"
+    f = float(edge)
+    return str(int(f)) if f == int(f) else repr(f)
 
 
 def write_prometheus(path: str, **kw) -> None:
@@ -138,7 +180,68 @@ def drain_jsonl(
     return written
 
 
+def health(snapshot: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """One at-a-glance mesh health snapshot (the ``/healthz`` shape),
+    derived from the live registry (or an explicit snapshot) plus the
+    installed flight recorder:
+
+    - ``live_ranks`` / ``generation`` — the scale-out gauges (PR 11;
+      ``live_ranks`` falls back to the max per-kind telemetry gauge
+      when no ScaleoutMesh ever ran);
+    - ``frontier_lag`` / ``residue`` — worst last-observed value over
+      every per-kind telemetry gauge (0 = certified-stable mesh);
+    - ``last_durable_watermark`` — the newest fsynced WAL seq
+      (``durability.wal.watermark``; -1 = nothing durable yet);
+    - ``faults_gave_up`` / ``snapshot_fallbacks`` — the loud-failure
+      counters worth paging on;
+    - ``flight`` — the recorder's correlation key + buffered/dropped
+      event counts (null when none is installed).
+
+    Everything is plain JSON — serve it, log it, or diff it in an
+    incident channel."""
+    from .obs import get_recorder
+
+    snap = metrics.snapshot() if snapshot is None else snapshot
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+
+    def last(name: str, default: float = 0.0) -> float:
+        g = gauges.get(name)
+        return g["last"] if g else default
+
+    def worst(suffix: str) -> float:
+        vals = [
+            g["last"] for name, g in gauges.items()
+            if name.endswith(suffix)
+        ]
+        return max(vals) if vals else 0.0
+
+    live = last("scaleout.live_ranks", -1.0)
+    if live < 0:
+        live = worst(".live_ranks")
+    rec = get_recorder()
+    return {
+        "ts": time.time(),
+        "live_ranks": int(live),
+        "generation": int(last("scaleout.generation")),
+        "frontier_lag": int(worst(".frontier_lag")),
+        "residue": int(worst(".residue")),
+        "last_durable_watermark": int(
+            last("durability.wal.watermark", -1.0)
+        ),
+        "faults_gave_up": int(counters.get("faults.gave_up", 0)),
+        "snapshot_fallbacks": int(
+            counters.get("durability.snapshot_fallback", 0)
+        ),
+        "flight": None if rec is None else {
+            "key": list(rec.key()),
+            "events": len(rec),
+            "dropped": rec.dropped,
+        },
+    }
+
+
 __all__ = [
-    "drain_jsonl", "prometheus_text", "sanitize", "snapshot_record",
-    "telemetry_record", "write_prometheus",
+    "drain_jsonl", "health", "prometheus_text", "sanitize",
+    "snapshot_record", "telemetry_record", "write_prometheus",
 ]

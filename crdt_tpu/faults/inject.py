@@ -141,13 +141,23 @@ def is_concrete(fc: FaultCounters) -> bool:
 def record(fc: FaultCounters) -> None:
     """Drain concrete counters into the host registry under the
     ``faults.*`` names (a no-op under tracing, like
-    ``telemetry.record``)."""
+    ``telemetry.record``), and emit one ``fault_counters`` flight event
+    when a recorder is installed — the per-round drop/reject/delay
+    entry on the postmortem timeline."""
     if not is_concrete(fc):
         return
-    metrics.count("faults.packets_dropped", int(fc.packets_dropped))
-    metrics.count("faults.packets_rejected", int(fc.packets_rejected))
-    metrics.count("faults.packets_delayed", int(fc.packets_delayed))
+    dropped = int(fc.packets_dropped)
+    rejected = int(fc.packets_rejected)
+    delayed = int(fc.packets_delayed)
+    metrics.count("faults.packets_dropped", dropped)
+    metrics.count("faults.packets_rejected", rejected)
+    metrics.count("faults.packets_delayed", delayed)
     metrics.observe("faults.miss_streak", float(jnp.max(fc.miss_streak)))
+    if dropped or rejected or delayed:
+        from .. import obs
+
+        obs.emit("fault_counters", dropped=dropped, rejected=rejected,
+                 delayed=delayed)
 
 
 # ---- ring permutations over live ranks ------------------------------------
@@ -311,6 +321,12 @@ def evicted_mask(plan: Optional[FaultPlan], axis_name: str):
     return jnp.isin(
         lax.axis_index(axis_name), jnp.asarray(plan.evicted, jnp.int32)
     )
+
+
+from ..analysis.registry import register_obs_event as _reg_ev  # noqa: E402
+
+_reg_ev("fault_counters", subsystem="faults",
+        fields=("dropped", "rejected", "delayed"), module=__name__)
 
 
 __all__ = [

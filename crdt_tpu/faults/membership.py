@@ -63,6 +63,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import obs
 from ..utils.metrics import metrics
 from .inject import FaultPlan, ring_perm, sender_of
 
@@ -160,6 +161,7 @@ class Membership:
         hot = self.suspects()
         for r in hot:
             metrics.count("faults.rank_suspected")
+            obs.emit("rank_suspected", suspect=r, streak=self.streaks[r])
         if auto_evict:
             for r in hot:
                 self.evict(r)
@@ -181,6 +183,8 @@ class Membership:
             )
         self._evicted.add(rank)
         metrics.count("faults.rank_evicted")
+        obs.emit("rank_evicted", evicted=rank,
+                 live=self.n_ranks - len(self._evicted))
 
     def rejoin(self, rank: int) -> None:
         """Re-admit ``rank``. PRECONDITION (the caller's contract): the
@@ -196,6 +200,8 @@ class Membership:
         self._evicted.discard(rank)
         self.streaks[rank] = 0
         metrics.count("faults.rank_rejoined")
+        obs.emit("rank_rejoined", rejoined=rank,
+                 live=self.n_ranks - len(self._evicted))
 
     def _check_rank(self, rank: int) -> None:
         if not 0 <= rank < self.n_ranks:
@@ -206,6 +212,18 @@ class Membership:
     def ring(self) -> List[Tuple[int, int]]:
         """The current live-rank ring permutation (a true bijection)."""
         return ring_perm(self.n_ranks, self.evicted)
+
+
+# Flight-recorder event schemas for the membership transitions
+# (registration is the coverage contract — obs/recorder.py).
+from ..analysis.registry import register_obs_event as _reg_ev  # noqa: E402
+
+_reg_ev("rank_suspected", subsystem="faults.membership",
+        fields=("suspect", "streak"), module=__name__)
+_reg_ev("rank_evicted", subsystem="faults.membership",
+        fields=("evicted", "live"), module=__name__)
+_reg_ev("rank_rejoined", subsystem="faults.membership",
+        fields=("rejoined", "live"), module=__name__)
 
 
 __all__ = ["Membership", "validate_perm"]

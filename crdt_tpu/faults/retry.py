@@ -49,6 +49,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
+from .. import obs
 from ..utils.metrics import metrics
 
 
@@ -147,6 +148,8 @@ def with_retries(
     for attempt in range(policy.attempts):
         if attempt:
             metrics.count("faults.retries")
+            obs.emit("dcn_retry", op=op, attempt=attempt,
+                     error=type(last_exc).__name__)
             pause = min(delay, policy.max_delay)
             pause *= 1.0 + policy.jitter * rng.random()
             sleep(pause)
@@ -161,9 +164,23 @@ def with_retries(
             last_exc = exc
     metrics.count("faults.gave_up")
     assert last_exc is not None
+    # The postmortem boundary: record the exhaustion and write the
+    # flight-recorder artifact BEFORE raising (obs/recorder.py —
+    # auto_dump never masks the exception it documents).
+    obs.emit("dcn_exchange_failed", op=op, attempts=policy.attempts,
+             error=type(last_exc).__name__)
+    obs.auto_dump("dcn_exchange_failed", op=op)
     raise DcnExchangeFailed(
         op, policy.attempts, last_exc, last_good=last_good
     ) from last_exc
+
+
+from ..analysis.registry import register_obs_event as _reg_ev  # noqa: E402
+
+_reg_ev("dcn_retry", subsystem="faults.retry",
+        fields=("op", "attempt", "error"), module=__name__)
+_reg_ev("dcn_exchange_failed", subsystem="faults.retry",
+        fields=("op", "attempts", "error"), module=__name__)
 
 
 __all__ = [

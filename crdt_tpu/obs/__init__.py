@@ -1,0 +1,149 @@
+"""crdt_tpu.obs — the postmortem-grade observability plane.
+
+Three layers on top of the PR 2 counters/gauges/spans:
+
+- :mod:`crdt_tpu.obs.hist` — in-kernel log2 histograms (lax-only, so
+  they ride the ``telemetry=`` Telemetry sidecar through jit and
+  shard_map): per-round residue backlog, per-round post-mask payload
+  bytes, per-round ack-window depth, and host-timed per-dispatch
+  wall-clock, each summarized to p50/p95/p99 through the registry and
+  the exporter.
+- :mod:`crdt_tpu.obs.recorder` — the flight recorder: a bounded
+  host-side ring of per-round structured events sharing one monotonic
+  ``(generation, round, rank)`` correlation key with
+  ``telemetry.span``, dumped as a self-describing JSONL artifact
+  (auto-invoked on ``DrainRefused`` / ``DcnExchangeFailed`` /
+  ``StreamFaultReport`` / recovery).
+- ``tools/obs_report.py`` — renders a dump into an incident report
+  (timeline, histogram summaries, invariant audit) and cross-checks
+  its folded counters bit-exactly against the live registry.
+
+:func:`static_checks` is the ``obs`` section of
+``tools/run_static_checks.py`` — event-type registry coverage plus the
+recorder/histogram conformance detectors and their broken twins.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from . import hist
+from .recorder import (
+    FlightRecorder,
+    advance_round,
+    auto_dump,
+    configure_auto_dump,
+    current_key,
+    dump_dir,
+    emit,
+    get_recorder,
+    install,
+    recorder_conformant,
+)
+
+
+def histogram_conformant(observe_fn) -> bool:
+    """The ``obs`` static-check detector for the in-kernel histogram:
+    jit-fold a fixed sample (zeros, sub-1 fractions, exact bucket
+    boundaries, a top-bucket outlier) through ``observe_fn`` and
+    compare counts bit-exactly to the host reference (one count per
+    observation, each in the unique bucket its edge comparisons pick)
+    plus total conservation. The committed broken twin
+    (``analysis.fixtures.histogram_miscounts``) shifts boundary values
+    one bucket down and must FAIL here."""
+    import jax
+    import numpy as np
+
+    sample = [0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 1023.0, 1024.0,
+              float(2 ** 20), float(2 ** 40), 7.0]
+
+    def fold(values):
+        h = hist.zeros()
+        for v in values:
+            h = observe_fn(h, v)
+        return h
+
+    try:
+        out = jax.jit(fold)(tuple(sample))
+        counts = np.asarray(out.counts)
+        total = float(out.total)
+    except Exception:
+        return False
+    want = np.zeros(hist.NBUCKETS, dtype=np.uint32)
+    for v in sample:
+        # Right-closed buckets: a boundary value counts under its own
+        # inclusive `le` edge (the Prometheus contract — hist.py).
+        idx = sum(v > e for e in hist.EDGES)
+        want[idx] += 1
+    if counts.shape != want.shape or not np.array_equal(counts, want):
+        return False
+    if int(counts.sum()) != len(sample):
+        return False
+    return total == float(np.float32(np.sum(np.float32(sample))))
+
+
+def static_checks() -> List:
+    """The ``obs`` static-check section (Finding list, empty = clean):
+
+    1. **event-type coverage** — every literal event type at an
+       ``emit("...")`` site anywhere under ``crdt_tpu/`` must have a
+       registered schema (``analysis.registry.register_obs_event``);
+       an event-emitting subsystem without one fails discovery, the
+       same registration-is-the-coverage-contract rule as joins /
+       entries / fault surfaces.
+    2. **recorder conformance** — :class:`FlightRecorder` must keep
+       the newest ``capacity`` events in order and count every drop;
+       the broken twin (``analysis.fixtures.recorder_drops_events``)
+       must FAIL the detector.
+    3. **histogram conformance** — ``hist.observe`` folded under jit
+       must match the host bucket reference bit-exactly; the broken
+       twin (``fixtures.histogram_miscounts``) must FAIL it.
+    """
+    from ..analysis import fixtures
+    from ..analysis.registry import unregistered_obs_events
+    from ..analysis.report import Finding
+
+    findings: List[Finding] = []
+
+    for name, where in unregistered_obs_events():
+        findings.append(Finding(
+            "obs-event-coverage", name,
+            f"event type emitted at {where} has no registered schema "
+            "(register_obs_event) — the flight recorder cannot "
+            "describe it in a dump header",
+        ))
+
+    if not recorder_conformant(FlightRecorder):
+        findings.append(Finding(
+            "obs-recorder-conformance", "FlightRecorder",
+            "the flight recorder lost, reordered, or failed to count "
+            "events (ring conformance probe)",
+        ))
+    if recorder_conformant(fixtures.recorder_drops_events):
+        findings.append(Finding(
+            "obs-recorder-conformance", "fixtures.recorder_drops_events",
+            "the event-dropping broken twin PASSED the recorder "
+            "conformance detector — the detector has no teeth",
+        ))
+
+    if not histogram_conformant(hist.observe):
+        findings.append(Finding(
+            "obs-histogram-conformance", "hist.observe",
+            "the in-kernel histogram miscounts the fixed sample "
+            "(bucket reference mismatch under jit)",
+        ))
+    if histogram_conformant(fixtures.histogram_miscounts):
+        findings.append(Finding(
+            "obs-histogram-conformance", "fixtures.histogram_miscounts",
+            "the boundary-shifting broken twin PASSED the histogram "
+            "conformance detector — the detector has no teeth",
+        ))
+    return findings
+
+
+__all__ = [
+    "FlightRecorder", "advance_round", "auto_dump", "configure_auto_dump",
+    "current_key", "dump_dir", "emit", "get_recorder", "hist",
+    "histogram_conformant", "install", "recorder_conformant",
+    "static_checks",
+]

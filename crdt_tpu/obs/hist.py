@@ -1,0 +1,168 @@
+"""In-kernel fixed-bucket (log2) histograms — distributions that
+survive jit/shard_map.
+
+The registry's gauges (utils/metrics.py) keep last/min/max/sum/n — no
+shape of the distribution, so a p99 apply latency (the ROADMAP item-1
+serving gate) is unmeasurable. This module is the lax-only primitive
+that fixes it: a :class:`Hist` is one ``uint32[NBUCKETS]`` counter
+plane plus a float32 running total, observed with pure ``jnp`` ops on
+static shapes, so it rides the :class:`crdt_tpu.telemetry.Telemetry`
+sidecar through jit and shard_map exactly like the scalar counters,
+psums across the mesh like them, and folds across runs with
+``telemetry.combine``.
+
+Buckets are powers of two with INCLUSIVE upper edges ``EDGES = (1, 2,
+4, ..., 2**(NBUCKETS-2))``: bucket 0 holds values in ``[0, 1]``,
+bucket ``i`` holds ``(2**(i-1), 2**i]``, and the last bucket is
+unbounded (the Prometheus ``+Inf`` bucket). Right-closed buckets are
+the Prometheus ``le`` contract — a sample exactly equal to an edge
+counts under that edge's ``le`` label — so the exporter's
+``_bucket{le=...}`` exposition is conformant without relabeling. The
+bucket index is computed by EXACT comparison against the edge vector —
+no ``log2`` rounding at the boundaries, so the host replay of an
+in-kernel fold is bit-identical (the ``histogram_miscounts`` broken
+twin in analysis/fixtures.py proves the conformance detector notices
+anything less).
+
+Units are the observer's contract, chosen so log2 buckets resolve the
+interesting range: the δ ring observes per-round backlog ROWS and
+payload BYTES; host dispatch timing observes MICROSECONDS (a sub-µs
+dispatch is bucket 0; 2**30 µs ≈ 18 min caps the top bucket).
+
+Quantile summaries (:func:`summary` — p50/p95/p99 by linear
+interpolation within the covering bucket) are host-side; the exporter
+renders the same counts as Prometheus ``_bucket``/``_sum``/``_count``
+exposition and ``tools/obs_report.py`` folds dumped counts bit-exactly
+against the live registry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+
+NBUCKETS = 32
+
+# Finite upper edges (NBUCKETS - 1 of them); the last bucket is +Inf.
+EDGES = tuple(float(2 ** i) for i in range(NBUCKETS - 1))
+
+
+class Hist(NamedTuple):
+    """One log2 histogram: a counter plane + the exact running total
+    of observed values (so Prometheus ``_sum`` is exact, not a
+    bucket-midpoint estimate)."""
+
+    counts: jax.Array  # uint32[NBUCKETS]
+    total: jax.Array   # float32 — sum of observed values
+
+
+def zeros() -> Hist:
+    """The accumulation identity."""
+    return Hist(
+        counts=jnp.zeros((NBUCKETS,), jnp.uint32),
+        total=jnp.zeros((), jnp.float32),
+    )
+
+
+def bucket_index(value) -> jax.Array:
+    """The bucket covering ``value`` (scalar, int32): exact edge
+    comparisons — ``sum(value > edge)`` — never a floating log2, so
+    boundary values land deterministically and on the Prometheus
+    ``le`` side (2.0 is in (1, 2], counted under ``le="2"``).
+    Negative values clamp into bucket 0."""
+    v = jnp.asarray(value).astype(jnp.float32)
+    e = jnp.asarray(EDGES, jnp.float32)
+    return jnp.sum(v > e, dtype=jnp.int32)
+
+
+def observe(h: Hist, value) -> Hist:
+    """Count one observation (lax-only: one scatter-add on a static
+    shape — safe inside jit, shard_map, and ``lax.fori_loop``
+    carries)."""
+    v = jnp.maximum(jnp.asarray(value).astype(jnp.float32), 0.0)
+    return Hist(
+        counts=h.counts.at[bucket_index(v)].add(jnp.uint32(1)),
+        total=h.total + v,
+    )
+
+
+def merge(a: Hist, b: Hist) -> Hist:
+    """Fold two histograms (counts and totals both add — the
+    ``telemetry.combine`` discipline for distribution fields)."""
+    return Hist(counts=a.counts + b.counts, total=a.total + b.total)
+
+
+def psum(h: Hist, axes) -> Hist:
+    """Mesh-reduce a per-device histogram into a replicated one
+    (inside shard_map) — counts and total both psum, like the scalar
+    throughput counters."""
+    from jax import lax
+
+    return Hist(counts=lax.psum(h.counts, axes), total=lax.psum(h.total, axes))
+
+
+def is_hist_field(name: str) -> bool:
+    """The Telemetry field-naming contract: ``hist_*`` fields carry a
+    :class:`Hist` subtree (telemetry.py / exporter.py / the schema all
+    key on this prefix)."""
+    return name.startswith("hist_")
+
+
+def to_dict(h: Hist) -> Dict[str, Any]:
+    """The self-describing JSONL form (tools/telemetry_schema.json
+    ``histogram`` kind): finite bucket edges + counts (one longer —
+    the trailing count is the unbounded bucket) + the exact total."""
+    return {
+        "edges": list(EDGES),
+        "counts": [int(c) for c in h.counts],
+        "total": float(h.total),
+    }
+
+
+def quantile(counts: Sequence[int], q: float,
+             edges: Sequence[float] = EDGES) -> float:
+    """Estimate the q-quantile (0 < q <= 1) from folded bucket counts:
+    find the covering bucket by cumulative rank, interpolate linearly
+    inside it. The unbounded top bucket reports twice its lower edge
+    (there is no upper edge to interpolate toward). 0.0 on an empty
+    histogram."""
+    n = int(sum(counts))
+    if n <= 0:
+        return 0.0
+    target = q * n
+    cum = 0
+    for i, c in enumerate(counts):
+        prev = cum
+        cum += int(c)
+        if cum >= target and c:
+            lo = 0.0 if i == 0 else float(edges[i - 1])
+            hi = float(edges[i]) if i < len(edges) else 2.0 * float(edges[-1])
+            frac = (target - prev) / c
+            return lo + frac * (hi - lo)
+    return float(edges[-1]) * 2.0
+
+
+def summary(d: Dict[str, Any]) -> Dict[str, float]:
+    """p50/p95/p99 + count/total/mean from one :func:`to_dict` payload
+    — the shape the registry gauges and the BENCH records carry."""
+    counts = d["counts"]
+    edges = d.get("edges", EDGES)
+    n = int(sum(counts))
+    total = float(d.get("total", 0.0))
+    return {
+        "count": n,
+        "total": total,
+        "mean": (total / n) if n else 0.0,
+        "p50": quantile(counts, 0.50, edges),
+        "p95": quantile(counts, 0.95, edges),
+        "p99": quantile(counts, 0.99, edges),
+    }
+
+
+__all__ = [
+    "EDGES", "Hist", "NBUCKETS", "bucket_index", "is_hist_field",
+    "merge", "observe", "psum", "quantile", "summary", "to_dict",
+    "zeros",
+]

@@ -18,6 +18,7 @@ collective program, which costs seconds per anti-entropy round.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Optional, Tuple
 
@@ -58,6 +59,7 @@ from .mesh import (
     pad_replicas,
     pad_replicas_map,
 )
+from ..obs import hist as _hist
 from ..utils.metrics import metrics, observe_depth, state_nbytes
 from .. import telemetry as tele
 
@@ -136,6 +138,14 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         scaleout_admits=jnp.zeros((), jnp.uint32),
         scaleout_drains=jnp.zeros((), jnp.uint32),
         bootstrap_bytes=jnp.zeros((), jnp.float32),
+        # The in-kernel histograms are zero unless the δ ring's loop
+        # carry fills them in (delta_ring's _replace);
+        # hist_dispatch_us is filled host-side (telemetry.time_dispatch
+        # at the entry wrappers — never in-kernel).
+        hist_residue=_hist.zeros(),
+        hist_useful_bytes=_hist.zeros(),
+        hist_ack_depth=_hist.zeros(),
+        hist_dispatch_us=_hist.zeros(),
     )
 
 
@@ -275,6 +285,7 @@ def mesh_fold(
     )
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
     observe_depth("anti_entropy.orswot_fold", state)
+    t0 = time.perf_counter()
     with metrics.time("anti_entropy.fold"):
         out = _cached(
             "orswot_fold", state, mesh,
@@ -283,6 +294,9 @@ def mesh_fold(
         jax.block_until_ready(out)  # time device work, not async dispatch
     _consume(donate, state, orig)
     if telemetry and tele.is_concrete(out[2]):
+        out = out[:2] + (tele.time_dispatch(
+            out[2], time.perf_counter() - t0
+        ),)
         tele.record("orswot_fold", out[2])
     return out
 
@@ -529,6 +543,7 @@ def _mesh_gossip_lattice(
     metrics.count(f"anti_entropy.{kind}_rounds", rounds)
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
     observe_depth(f"anti_entropy.{kind}", state)
+    t0 = time.perf_counter()
     with metrics.time(f"anti_entropy.{kind}"):
         out = _cached(
             kind, state, mesh, build,
@@ -542,6 +557,9 @@ def _mesh_gossip_lattice(
     # the committed copy, not the caller's array).
     _consume(donate, state)
     if telemetry and tele.is_concrete(out[2]):
+        out = out[:2] + (tele.time_dispatch(
+            out[2], time.perf_counter() - t0
+        ),) + out[3:]
         tele.record(kind, out[2])
     if faulted:
         from .. import faults as flt
@@ -735,6 +753,7 @@ def _mesh_fold_lattice(
     )
     metrics.observe("anti_entropy.state_bytes", state_nbytes(state))
     observe_depth(f"anti_entropy.{kind}", state)
+    t0 = time.perf_counter()
     with metrics.time(f"anti_entropy.{kind}"):
         out = _cached(
             kind, state, mesh, build_tel if telemetry else build, telemetry
@@ -742,6 +761,9 @@ def _mesh_fold_lattice(
         jax.block_until_ready(out)  # time device work, not async dispatch
     _consume(donate, state)
     if telemetry and tele.is_concrete(out[2]):
+        out = out[:2] + (tele.time_dispatch(
+            out[2], time.perf_counter() - t0
+        ),)
         tele.record(kind, out[2])
     return out
 

@@ -92,6 +92,7 @@ tests/test_zero_copy_ring.py, the PR-2 telemetry pattern; the
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Callable, Optional
 
@@ -102,6 +103,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import telemetry as tele
 from ..delta_opt import ackwin as _ackwin
+from ..obs import hist as _hist
 from ..utils.metrics import metrics, state_nbytes
 from .mesh import ELEMENT_AXIS, REPLICA_AXIS
 
@@ -243,7 +245,10 @@ def run_delta_ring(
         if faulted:
             out_specs = out_specs + (flt.counters_specs(),)
         slots_of = slots_fn or tele.generic_slots_changed
-        n_tel = 3 if telemetry else 0
+        # Telemetry loop-carry width: slots, shipped, useful, plus the
+        # two in-kernel histograms (per-round backlog and per-round
+        # useful bytes — obs/hist.py Hist subtrees riding the carry).
+        n_tel = 5 if telemetry else 0
 
         @partial(
             jax.shard_map,
@@ -341,10 +346,11 @@ def run_delta_ring(
                     bits = lax.ppermute(bits, REPLICA_AXIS, inv_perm)
                     return _ackwin.update_window(awin, sent, bits), bits
             # Ack carry width: window (+ sender's in-flight copy under
-            # pipelining, + the skipped-bytes scalar under telemetry).
+            # pipelining, + the skipped-bytes scalar and the per-round
+            # window-depth histogram under telemetry).
             pipe_on = pipeline and rounds > 0
             n_ack = (
-                ((2 if pipe_on else 1) + (1 if telemetry else 0))
+                ((2 if pipe_on else 1) + (2 if telemetry else 0))
                 if acked else 0
             )
 
@@ -362,9 +368,12 @@ def run_delta_ring(
                 if acked:
                     awin = carry[5 + n_tel]
                     if telemetry:
-                        skip = carry[5 + n_tel + n_ack - 1]
+                        skip = carry[5 + n_tel + n_ack - 2]
+                        hack = carry[5 + n_tel + n_ack - 1]
                 if telemetry:
-                    st, d, f, of, starved, slots, shipped, useful = carry[:8]
+                    (st, d, f, of, starved, slots, shipped, useful,
+                     hresid, huseful) = carry[:10]
+                    u0 = useful
                 else:
                     st, d, f, of, starved = carry[:5]
                 pkt, d, f = extract(st, d, f, cap, start=r * cap)
@@ -372,9 +381,14 @@ def run_delta_ring(
                 # Explicit accumulator dtype: without it jnp.sum widens
                 # int32 -> int64 under x64 mode (counter_dtype="uint64")
                 # and the fori_loop carry type changes mid-loop.
-                starved = starved + jnp.where(
-                    in_window, jnp.sum(d, dtype=jnp.int32), 0
-                )
+                backlog = jnp.sum(d, dtype=jnp.int32)
+                starved = starved + jnp.where(in_window, backlog, 0)
+                if telemetry:
+                    # Per-round residue-quantity distribution: the rows
+                    # still dirty right after the extract ARE the
+                    # round's unshipped backlog (observed EVERY round —
+                    # the drain curve, not just the certificate window).
+                    hresid = _hist.observe(hresid, backlog)
                 if gated:
                     pkt = gate(pkt, rtop)
                 if acked:
@@ -418,13 +432,17 @@ def run_delta_ring(
                     if telemetry:
                         ab = jnp.float32(tele.shipped_bytes(bits))
                         shipped, useful = shipped + ab, useful + ab
-                    ack_tail = (awin, skip) if telemetry else (awin,)
+                        hack = _hist.observe(
+                            hack, _ackwin.window_depth(awin)
+                        )
+                    ack_tail = (awin, skip, hack) if telemetry else (awin,)
                 else:
                     ack_tail = ()
                 if telemetry:
                     slots = slots + slots_of(before, st)
+                    huseful = _hist.observe(huseful, useful - u0)
                     return (st, d, f, of | of_r, starved, slots, shipped,
-                            useful) + ack_tail + tail
+                            useful, hresid, huseful) + ack_tail + tail
                 return (st, d, f, of | of_r, starved) + ack_tail + tail
 
             def pipe_body(r, carry):
@@ -440,17 +458,21 @@ def run_delta_ring(
                 if acked:
                     awin, sent = carry[6 + n_tel], carry[6 + n_tel + 1]
                     if telemetry:
-                        skip = carry[6 + n_tel + n_ack - 1]
+                        skip = carry[6 + n_tel + n_ack - 2]
+                        hack = carry[6 + n_tel + n_ack - 1]
                 if telemetry:
-                    st, d, f, of, starved, flight, slots, shipped, useful = (
-                        carry[:9]
-                    )
+                    (st, d, f, of, starved, flight, slots, shipped,
+                     useful, hresid, huseful) = carry[:11]
+                    u0 = useful
                 else:
                     st, d, f, of, starved, flight = carry[:6]
                 pkt, d, f = extract(st, d, f, cap, start=(r + 1) * cap)
+                backlog = jnp.sum(d, dtype=jnp.int32)
                 starved = starved + jnp.where(
-                    (r + 1) >= rounds - win, jnp.sum(d, dtype=jnp.int32), 0
+                    (r + 1) >= rounds - win, backlog, 0
                 )
+                if telemetry:
+                    hresid = _hist.observe(hresid, backlog)
                 if gated:
                     pkt = gate(pkt, rtop)
                 if acked:
@@ -495,13 +517,20 @@ def run_delta_ring(
                     if telemetry:
                         ab = jnp.float32(tele.shipped_bytes(bits))
                         shipped, useful = shipped + ab, useful + ab
-                    ack_tail = (awin, sent, skip) if telemetry else (awin, sent)
+                        hack = _hist.observe(
+                            hack, _ackwin.window_depth(awin)
+                        )
+                    ack_tail = (
+                        (awin, sent, skip, hack) if telemetry
+                        else (awin, sent)
+                    )
                 else:
                     ack_tail = ()
                 if telemetry:
                     slots = slots + slots_of(before, st)
+                    huseful = _hist.observe(huseful, useful - u0)
                     return (st, d, f, of | of_r, starved, nxt, slots,
-                            shipped, useful) + ack_tail + tail
+                            shipped, useful, hresid, huseful) + ack_tail + tail
                 return (st, d, f, of | of_r, starved, nxt) + ack_tail + tail
 
             zeros_tel = (
@@ -517,9 +546,9 @@ def run_delta_ring(
             if pipeline and rounds > 0:
                 # Prologue: round 0's packet goes in flight pre-loop.
                 pkt, d, f = extract(folded, d, f, cap, start=0)
+                backlog0 = jnp.sum(d, dtype=jnp.int32)
                 starved = jnp.where(
-                    jnp.asarray(0 >= rounds - win),
-                    jnp.sum(d, dtype=jnp.int32), 0,
+                    jnp.asarray(0 >= rounds - win), backlog0, 0,
                 )
                 if gated:
                     pkt = gate(pkt, rtop)
@@ -530,23 +559,24 @@ def run_delta_ring(
                 init = (folded, d, f, of, starved, flight)
                 if telemetry:
                     if faulted:
-                        init = init + (
-                            zeros_tel[0],
-                            zeros_tel[1]
-                            + jnp.float32(tele.shipped_bytes(flight)),
-                            zeros_tel[2] + tele.packet_useful_bytes(flight[0])
-                            + jnp.float32(tele.shipped_bytes(flight[1])),
+                        useful0 = (
+                            tele.packet_useful_bytes(flight[0])
+                            + jnp.float32(tele.shipped_bytes(flight[1]))
                         )
                     else:
-                        init = init + (
-                            zeros_tel[0],
-                            zeros_tel[1]
-                            + jnp.float32(tele.shipped_bytes(flight)),
-                            zeros_tel[2] + tele.packet_useful_bytes(flight),
-                        )
+                        useful0 = tele.packet_useful_bytes(flight)
+                    init = init + (
+                        zeros_tel[0],
+                        zeros_tel[1]
+                        + jnp.float32(tele.shipped_bytes(flight)),
+                        zeros_tel[2] + useful0,
+                        _hist.observe(_hist.zeros(), backlog0),
+                        _hist.observe(_hist.zeros(), useful0),
+                    )
                 if acked:
                     init = init + (
-                        (awin0, pkt, jnp.zeros((), jnp.float32))
+                        (awin0, pkt, jnp.zeros((), jnp.float32),
+                         _hist.zeros())
                         if telemetry else (awin0, pkt)
                     )
                 init = init + fault_tail
@@ -576,28 +606,30 @@ def run_delta_ring(
                     folded, d, f, of_r = applied
                 of = of | of_r
                 if telemetry:
-                    slots, shipped, useful = carry[6:9]
+                    slots, shipped, useful, hresid, huseful = carry[6:11]
                     slots = slots + slots_of(before, folded)
                     if acked:
-                        skip = carry[6 + n_tel + n_ack - 1]
+                        skip = carry[6 + n_tel + n_ack - 2]
+                        hack = carry[6 + n_tel + n_ack - 1]
             else:
                 init = (folded, d, f, of, jnp.zeros((), jnp.int32))
                 if telemetry:
-                    init = init + zeros_tel
+                    init = init + zeros_tel + (_hist.zeros(), _hist.zeros())
                 if acked:
                     init = init + (
-                        (awin0, jnp.zeros((), jnp.float32))
+                        (awin0, jnp.zeros((), jnp.float32), _hist.zeros())
                         if telemetry else (awin0,)
                     )
                 init = init + fault_tail
                 carry = lax.fori_loop(0, rounds, round_body, init)
                 folded, d, f, of, starved = carry[:5]
                 if telemetry:
-                    slots, shipped, useful = carry[5:8]
+                    slots, shipped, useful, hresid, huseful = carry[5:10]
                 if acked:
                     awin = carry[5 + n_tel]
                     if telemetry:
-                        skip = carry[5 + n_tel + n_ack - 1]
+                        skip = carry[5 + n_tel + n_ack - 2]
+                        hack = carry[5 + n_tel + n_ack - 1]
                 if delay_mode:
                     fc, held, heldv = carry[5 + n_tel + n_ack:]
                     # A packet still held when the loop ends arrives now
@@ -658,6 +690,17 @@ def run_delta_ring(
                     (REPLICA_AXIS, ELEMENT_AXIS), residue=residue,
                     useful_per_dev=useful,
                 )
+                # The in-kernel distributions: per-(round, device)
+                # samples psum into one mesh-wide histogram, like the
+                # scalar throughput counters (obs/hist.py).
+                tel = tel._replace(
+                    hist_residue=_hist.psum(
+                        hresid, (REPLICA_AXIS, ELEMENT_AXIS)
+                    ),
+                    hist_useful_bytes=_hist.psum(
+                        huseful, (REPLICA_AXIS, ELEMENT_AXIS)
+                    ),
+                )
                 if acked:
                     tel = tel._replace(
                         bytes_acked_skipped=lax.psum(
@@ -666,6 +709,9 @@ def run_delta_ring(
                         ack_window_depth=lax.pmax(
                             _ackwin.window_depth(awin),
                             (REPLICA_AXIS, ELEMENT_AXIS),
+                        ),
+                        hist_ack_depth=_hist.psum(
+                            hack, (REPLICA_AXIS, ELEMENT_AXIS)
                         ),
                     )
                 if faulted:
@@ -701,6 +747,7 @@ def run_delta_ring(
         # an outer jit (tracers must never leak into the log's diff
         # base) — the append below is skipped symmetrically.
         wal.attach(state)
+    t0 = time.perf_counter()
     with metrics.time(f"anti_entropy.{kind}"):
         out = _cached(
             kind, state, mesh, build, rounds, cap, telemetry, pipeline,
@@ -708,6 +755,10 @@ def run_delta_ring(
             *cache_extra, donate_argnums=argnums,
         )(state, dirty, fctx)
         jax.block_until_ready(out)
+    if telemetry and tele.is_concrete(out[4]):
+        out = out[:4] + (tele.time_dispatch(
+            out[4], time.perf_counter() - t0
+        ),) + out[5:]
     if donate:
         # Free whatever the donation did not consume in place: the
         # unaliasable fallback, and originals implicitly resharded onto

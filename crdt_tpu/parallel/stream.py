@@ -712,6 +712,22 @@ def _stream_fold(
             metrics.count("faults.packets_dropped", len(dropped_blocks))
         if rejected_blocks:
             metrics.count("faults.packets_rejected", len(rejected_blocks))
+        if dropped_blocks or rejected_blocks:
+            # A non-empty fault report is a postmortem boundary: the
+            # caller must re-stream these blocks — record which, and
+            # write the flight artifact (obs/recorder.py no-ops both
+            # when no recorder is installed).
+            from .. import obs
+
+            obs.emit(
+                "stream_fault_report",
+                dropped=list(dropped_blocks),
+                rejected=list(rejected_blocks),
+            )
+            obs.auto_dump(
+                "stream_fault_report",
+                dropped=len(dropped_blocks), rejected=len(rejected_blocks),
+            )
     if telemetry:
         tel = tel._replace(
             stream_blocks=jnp.uint32(blocks_done),
@@ -948,6 +964,13 @@ def _register():
         "mesh_stream_fold_sparse_mvmap", "mesh_stream_fold_sparse_sharded",
     ):
         register_fault_surface(name, module=__name__)
+
+    from ..analysis.registry import register_obs_event
+
+    register_obs_event(
+        "stream_fault_report", subsystem="parallel.stream",
+        fields=("dropped", "rejected"), module=__name__,
+    )
 
 
 _register()

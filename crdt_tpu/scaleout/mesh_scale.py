@@ -59,6 +59,7 @@ from typing import List, NamedTuple, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..faults.inject import FaultPlan, inv_ring_perm, ring_perm
 from ..faults.membership import validate_perm
 from ..utils.metrics import metrics
@@ -276,6 +277,14 @@ class ScaleoutMesh:
         self._generation += 1
         metrics.observe("scaleout.generation", float(self._generation))
         metrics.observe("scaleout.live_ranks", float(len(self._live)))
+        # Every ring rebuild is a correlation-key transition: the
+        # installed flight recorder (if any) adopts the new generation,
+        # so spans and subsystem events after this line carry it.
+        rec = obs.get_recorder()
+        if rec is not None:
+            rec.set_generation(self._generation)
+        obs.emit("generation", generation=self._generation,
+                 live=len(self._live))
 
     # ---- transitions ------------------------------------------------------
 
@@ -345,6 +354,9 @@ class ScaleoutMesh:
         self.bootstrap_bytes += shipped
         metrics.count("scaleout.admits", len(ranks))
         metrics.count("scaleout.bootstrap_bytes", int(shipped))
+        obs.emit("scaleout_admit", ranks=list(ranks),
+                 generation=self._generation,
+                 bootstrap_bytes=float(shipped))
         return rows, AdmitReport(
             ranks=tuple(ranks), generation=self._generation,
             bootstraps=tuple(reports), bytes_shipped=shipped,
@@ -393,6 +405,7 @@ class ScaleoutMesh:
                 f"certificate is for rank {certificate.rank}, not {rank}"
             )
         if certificate.generation != self._generation:
+            self._refuse(certificate, "stale certificate")
             raise DrainRefused(
                 certificate,
                 f"stale certificate: issued at generation "
@@ -415,13 +428,33 @@ class ScaleoutMesh:
                     f"{certificate.lanes_unacked} out-lanes unacked — a "
                     f"survivor still lacks drained content"
                 )
+            self._refuse(certificate, "; ".join(why))
             raise DrainRefused(certificate, "; ".join(why))
         self._live.discard(rank)
         self._bump()
         self.ring()
         self.drains += 1
         metrics.count("scaleout.drains")
+        obs.emit("scaleout_drain", rank=rank,
+                 generation=self._generation,
+                 residue=certificate.residue)
         return certificate
+
+    @staticmethod
+    def _refuse(certificate: DrainCertificate, why: str) -> None:
+        """The drain-refusal postmortem boundary: record the refused
+        certificate and auto-dump the flight artifact BEFORE the
+        ``DrainRefused`` raise (obs/recorder.py — both no-ops when no
+        recorder is installed, and a dump failure never masks the
+        refusal itself)."""
+        obs.emit(
+            "drain_refused", rank=certificate.rank,
+            generation=certificate.generation, why=why,
+            residue=certificate.residue,
+            packets_lost=certificate.packets_lost,
+            lanes_unacked=certificate.lanes_unacked,
+        )
+        obs.auto_dump("drain_refused", rank=certificate.rank)
 
     # ---- telemetry --------------------------------------------------------
 
@@ -469,6 +502,19 @@ _reg_so("ScaleoutMesh", module=__name__)
 _reg_so("certify_drain", module=__name__)
 _reg_so("park_row", module=__name__)
 _reg_so("drain_refuses_unflushed", module=__name__)
+
+from ..analysis.registry import register_obs_event as _reg_ev  # noqa: E402
+
+_reg_ev("generation", subsystem="scaleout",
+        fields=("generation", "live"), module=__name__)
+_reg_ev("scaleout_admit", subsystem="scaleout",
+        fields=("ranks", "generation", "bootstrap_bytes"), module=__name__)
+_reg_ev("scaleout_drain", subsystem="scaleout",
+        fields=("rank", "generation", "residue"), module=__name__)
+_reg_ev("drain_refused", subsystem="scaleout",
+        fields=("rank", "generation", "why", "residue", "packets_lost",
+                "lanes_unacked"),
+        module=__name__)
 
 __all__ = [
     "AdmitReport", "DrainCertificate", "DrainRefused", "RingGeneration",

@@ -96,9 +96,26 @@ shipped and sync metadata per round), measured natively per round:
   membership loop lives outside the kernels, the ``stream_*``/``wal_*``
   discipline — and 0 on every fixed-width run.
 
-Every field is a replicated scalar, so the whole pytree costs one word
-of output per field and no extra collectives beyond one psum/pmax
-fusion group.
+- ``hist_residue`` / ``hist_useful_bytes`` / ``hist_ack_depth`` /
+  ``hist_dispatch_us`` — the in-kernel DISTRIBUTIONS
+  (crdt_tpu/obs/hist.py :class:`~crdt_tpu.obs.hist.Hist` subtrees:
+  log2 bucket counts + exact total; registry summary twins
+  ``telemetry.<kind>.hist.<name>.p50/p95/p99`` plus per-bucket
+  counters): per-round per-device unshipped-backlog rows (the residue
+  quantity, observed EVERY ring round inside the loop carry),
+  per-round post-mask payload bytes (digest + ack-window gating's
+  round-shape, not just its total), per-round ack-window depth
+  (``ack_window=True`` only), and host-timed per-dispatch wall-clock
+  in MICROSECONDS (filled at the host boundary by
+  :func:`time_dispatch` — the ``stream_*``/``wal_*`` discipline;
+  includes compile time on a cold jit cache). The first three
+  accumulate lax-only in the δ-ring loop, so they survive jit and
+  shard_map and psum across the mesh like every scalar counter;
+  non-δ entry points leave them empty.
+
+Every non-histogram field is a replicated scalar, so the whole pytree
+costs one word of output per field (plus one 32-lane counter plane per
+histogram) and no extra collectives beyond one psum/pmax fusion group.
 
 Span tracing (:func:`span`) is the host-side half: a context manager
 that emits structured JSONL trace events (``configure_tracing`` points
@@ -120,6 +137,7 @@ from typing import Any, Dict, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from .obs import hist as obs_hist
 from .utils.metrics import metrics
 
 
@@ -154,6 +172,10 @@ class Telemetry(NamedTuple):
     scaleout_admits: jax.Array     # uint32 — live rank joins completed
     scaleout_drains: jax.Array     # uint32 — graceful drains certified
     bootstrap_bytes: jax.Array     # float32 — newcomer bootstrap wire bytes
+    hist_residue: obs_hist.Hist    # per-round unshipped-backlog rows
+    hist_useful_bytes: obs_hist.Hist  # per-round post-mask payload bytes
+    hist_ack_depth: obs_hist.Hist  # per-round ack-window depth
+    hist_dispatch_us: obs_hist.Hist   # host-timed dispatch wall-clock (µs)
 
 
 def zeros() -> Telemetry:
@@ -187,14 +209,24 @@ def zeros() -> Telemetry:
         scaleout_admits=jnp.zeros((), jnp.uint32),
         scaleout_drains=jnp.zeros((), jnp.uint32),
         bootstrap_bytes=jnp.zeros((), jnp.float32),
+        hist_residue=obs_hist.zeros(),
+        hist_useful_bytes=obs_hist.zeros(),
+        hist_ack_depth=obs_hist.zeros(),
+        hist_dispatch_us=obs_hist.zeros(),
     )
 
 
 def specs() -> Telemetry:
-    """shard_map out_specs: every field is a replicated scalar."""
+    """shard_map out_specs: every field is replicated — scalars and
+    the ``hist_*`` counter planes alike (the Hist subtrees mirror
+    their structure so no pytree-prefix resolution is needed)."""
     from jax.sharding import PartitionSpec as P
 
-    return Telemetry(*(P() for _ in Telemetry._fields))
+    return Telemetry(*(
+        obs_hist.Hist(counts=P(), total=P())
+        if obs_hist.is_hist_field(f) else P()
+        for f in Telemetry._fields
+    ))
 
 
 def combine(a: Telemetry, b: Telemetry) -> Telemetry:
@@ -225,6 +257,14 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         scaleout_admits=a.scaleout_admits + b.scaleout_admits,
         scaleout_drains=a.scaleout_drains + b.scaleout_drains,
         bootstrap_bytes=a.bootstrap_bytes + b.bootstrap_bytes,
+        hist_residue=obs_hist.merge(a.hist_residue, b.hist_residue),
+        hist_useful_bytes=obs_hist.merge(
+            a.hist_useful_bytes, b.hist_useful_bytes
+        ),
+        hist_ack_depth=obs_hist.merge(a.hist_ack_depth, b.hist_ack_depth),
+        hist_dispatch_us=obs_hist.merge(
+            a.hist_dispatch_us, b.hist_dispatch_us
+        ),
         deferred_depth=b.deferred_depth,
         residue=b.residue,
         widen_pressure=b.widen_pressure,
@@ -398,80 +438,135 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "scaleout_admits": int(tel.scaleout_admits),
         "scaleout_drains": int(tel.scaleout_drains),
         "bootstrap_bytes": float(tel.bootstrap_bytes),
+        "hist_residue": obs_hist.to_dict(tel.hist_residue),
+        "hist_useful_bytes": obs_hist.to_dict(tel.hist_useful_bytes),
+        "hist_ack_depth": obs_hist.to_dict(tel.hist_ack_depth),
+        "hist_dispatch_us": obs_hist.to_dict(tel.hist_dispatch_us),
     }
+
+
+# Telemetry fields carrying a Hist subtree (self-describing serialized
+# form; the exporter renders these as Prometheus histogram exposition,
+# the schema validates them as the `histogram` kind).
+HIST_FIELDS = tuple(
+    f for f in Telemetry._fields if obs_hist.is_hist_field(f)
+)
+
+
+def time_dispatch(tel: Telemetry, seconds: float) -> Telemetry:
+    """Fold one host-timed dispatch wall-clock into
+    ``hist_dispatch_us`` (MICROSECONDS — log2 buckets resolve the
+    µs..minutes range; a p99 over many dispatches is the ROADMAP
+    serving-gate quantity). Host-side, concrete Telemetry only (the
+    ``stream_*``/``wal_*`` fill discipline): under an outer jit the
+    pytree is traced, host timing is meaningless, and the input is
+    returned untouched."""
+    if not is_concrete(tel):
+        return tel
+    return tel._replace(
+        hist_dispatch_us=obs_hist.observe(
+            tel.hist_dispatch_us, seconds * 1e6
+        )
+    )
+
+
+def counter_increments(kind: str, d: Dict[str, Any]) -> Dict[str, int]:
+    """The registry COUNTER increments one recorded Telemetry dict
+    (:func:`to_dict`) produces — THE single source of truth shared by
+    :func:`record` (which applies them) and ``tools/obs_report.py``
+    (which re-folds a flight dump's ``telemetry`` events through this
+    exact mapping and compares the result bit-exactly against the live
+    registry — a drift here would break that audit, never fork the two
+    sides). Gauge observations (depth/residue/pressure/lag and the
+    histogram quantile summaries) are NOT counters and live in
+    :func:`record` only."""
+    inc = {
+        f"telemetry.{kind}.merges": d["merges"],
+        f"telemetry.{kind}.slots_changed": d["slots_changed"],
+        f"telemetry.{kind}.bytes_exchanged": int(d["bytes_exchanged"]),
+        f"telemetry.{kind}.bytes_useful": int(d["bytes_useful"]),
+        f"telemetry.{kind}.reclaimed_slots": d["reclaimed_slots"],
+        f"telemetry.{kind}.reclaimed_bytes": int(d["reclaimed_bytes"]),
+        f"telemetry.{kind}.stream.blocks": d["stream_blocks"],
+        f"telemetry.{kind}.stream.staged_bytes": int(
+            d["stream_staged_bytes"]
+        ),
+        f"telemetry.{kind}.stream.overlap_hit": d["stream_overlap_hit"],
+        f"telemetry.{kind}.faults.packets_dropped": d["faults_dropped"],
+        f"telemetry.{kind}.faults.packets_rejected": d["faults_rejected"],
+        f"telemetry.{kind}.faults.packets_delayed": d["faults_delayed"],
+        f"telemetry.{kind}.bytes_acked_skipped": int(
+            d["bytes_acked_skipped"]
+        ),
+        f"telemetry.{kind}.wal_bytes": int(d["wal_bytes"]),
+        f"telemetry.{kind}.wal_fsyncs": d["wal_fsyncs"],
+        f"telemetry.{kind}.snapshots_written": d["snapshots_written"],
+        f"telemetry.{kind}.replayed_records": d["replayed_records"],
+        f"telemetry.{kind}.torn_tail_truncated": d["torn_tail_truncated"],
+        f"telemetry.{kind}.recovery_rounds": d["recovery_rounds"],
+        f"telemetry.{kind}.scaleout.admits": d["scaleout_admits"],
+        f"telemetry.{kind}.scaleout.drains": d["scaleout_drains"],
+        f"telemetry.{kind}.scaleout.bootstrap_bytes": int(
+            d["bootstrap_bytes"]
+        ),
+    }
+    # Histogram per-bucket counters fold bit-exactly across runs —
+    # exactly what tools/obs_report.py cross-checks a dump against.
+    for field in HIST_FIELDS:
+        hd = d[field]
+        n = sum(hd["counts"])
+        if not n:
+            continue
+        base = f"telemetry.{kind}.hist.{field[len('hist_'):]}"
+        inc[f"{base}.count"] = n
+        for i, c in enumerate(hd["counts"]):
+            if c:
+                inc[f"{base}.bucket{i:02d}"] = c
+    return inc
 
 
 def record(kind: str, tel: Telemetry) -> None:
     """Drain a concrete Telemetry into the host registry under
-    ``telemetry.<kind>.*`` (counters for the monotone fields, gauges
-    for the final-state ones). A no-op under tracing — the caller then
-    owns the returned pytree (that is the whole point of it)."""
+    ``telemetry.<kind>.*`` (counters for the monotone fields — the
+    :func:`counter_increments` mapping — gauges for the final-state
+    ones and the histogram p50/p95/p99 summaries). A no-op under
+    tracing — the caller then owns the returned pytree (that is the
+    whole point of it). With a flight recorder installed
+    (crdt_tpu/obs/), each call additionally advances the correlation
+    key's round coordinate and records one ``telemetry`` event
+    carrying the full dict — the per-round timeline entry
+    ``tools/obs_report.py`` re-folds."""
     if not is_concrete(tel):
         return
     d = to_dict(tel)
-    metrics.count(f"telemetry.{kind}.merges", d["merges"])
-    metrics.count(f"telemetry.{kind}.slots_changed", d["slots_changed"])
-    metrics.count(
-        f"telemetry.{kind}.bytes_exchanged", int(d["bytes_exchanged"])
-    )
-    metrics.count(f"telemetry.{kind}.bytes_useful", int(d["bytes_useful"]))
-    metrics.count(f"telemetry.{kind}.reclaimed_slots", d["reclaimed_slots"])
-    metrics.count(
-        f"telemetry.{kind}.reclaimed_bytes", int(d["reclaimed_bytes"])
-    )
-    metrics.count(f"telemetry.{kind}.stream.blocks", d["stream_blocks"])
-    metrics.count(
-        f"telemetry.{kind}.stream.staged_bytes",
-        int(d["stream_staged_bytes"]),
-    )
-    metrics.count(
-        f"telemetry.{kind}.stream.overlap_hit", d["stream_overlap_hit"]
-    )
-    metrics.count(
-        f"telemetry.{kind}.faults.packets_dropped", d["faults_dropped"]
-    )
-    metrics.count(
-        f"telemetry.{kind}.faults.packets_rejected", d["faults_rejected"]
-    )
-    metrics.count(
-        f"telemetry.{kind}.faults.packets_delayed", d["faults_delayed"]
-    )
-    metrics.count(
-        f"telemetry.{kind}.bytes_acked_skipped",
-        int(d["bytes_acked_skipped"]),
-    )
+    for name, n in counter_increments(kind, d).items():
+        metrics.count(name, n)
     metrics.observe(
         f"telemetry.{kind}.ack_window_depth", d["ack_window_depth"]
-    )
-    metrics.count(f"telemetry.{kind}.wal_bytes", int(d["wal_bytes"]))
-    metrics.count(f"telemetry.{kind}.wal_fsyncs", d["wal_fsyncs"])
-    metrics.count(
-        f"telemetry.{kind}.snapshots_written", d["snapshots_written"]
-    )
-    metrics.count(
-        f"telemetry.{kind}.replayed_records", d["replayed_records"]
-    )
-    metrics.count(
-        f"telemetry.{kind}.torn_tail_truncated", d["torn_tail_truncated"]
-    )
-    metrics.count(
-        f"telemetry.{kind}.recovery_rounds", d["recovery_rounds"]
-    )
-    metrics.count(
-        f"telemetry.{kind}.scaleout.admits", d["scaleout_admits"]
-    )
-    metrics.count(
-        f"telemetry.{kind}.scaleout.drains", d["scaleout_drains"]
-    )
-    metrics.count(
-        f"telemetry.{kind}.scaleout.bootstrap_bytes",
-        int(d["bootstrap_bytes"]),
     )
     metrics.observe(f"telemetry.{kind}.live_ranks", d["live_ranks"])
     metrics.observe(f"telemetry.{kind}.deferred_depth", d["deferred_depth"])
     metrics.observe(f"telemetry.{kind}.residue", d["residue"])
     metrics.observe(f"telemetry.{kind}.widen_pressure", d["widen_pressure"])
     metrics.observe(f"telemetry.{kind}.frontier_lag", d["frontier_lag"])
+    for field in HIST_FIELDS:
+        hd = d[field]
+        if not sum(hd["counts"]):
+            continue
+        base = f"telemetry.{kind}.hist.{field[len('hist_'):]}"
+        s = obs_hist.summary(hd)
+        for q in ("p50", "p95", "p99"):
+            metrics.observe(f"{base}.{q}", s[q])
+    from .obs import recorder as _rec
+
+    if _rec.get_recorder() is not None:
+        # Emit FIRST, advance AFTER: the telemetry drain is the last
+        # event of its dispatch, so everything the dispatch emitted
+        # earlier (WAL fsyncs, fault counters) shares its round
+        # coordinate — advancing first would split one dispatch across
+        # two rounds on the postmortem timeline.
+        _rec.emit("telemetry", kind=kind, **d)
+        _rec.advance_round()
 
 
 # ---- span tracing ---------------------------------------------------------
@@ -499,6 +594,16 @@ def drain_events() -> list:
 
 
 def _emit(event: Dict[str, Any]) -> None:
+    # Stamp the flight recorder's (generation, round, rank) correlation
+    # key when one is installed, so spans and flight events line up on
+    # one timeline (obs/recorder.py module docstring).
+    from .obs import recorder as _rec
+
+    k = _rec.current_key()
+    if k is not None:
+        event.setdefault("gen", k[0])
+        event.setdefault("round", k[1])
+        event.setdefault("rank", k[2])
     with _trace_lock:
         _trace_events.append(event)
         del _trace_events[:-_MAX_BUFFERED_EVENTS]
@@ -522,6 +627,15 @@ def span(name: str, **attrs):
     spans line up with XProf device timelines. Also feeds the registry
     timer histogram (``<name>_seconds`` gauge) so snapshot-only
     consumers see span durations too. Attrs must be JSON-serializable.
+
+    When a flight recorder is installed (``crdt_tpu.obs.install`` —
+    obs/recorder.py), every span event additionally carries the
+    recorder's monotonic ``(generation, round, rank)`` correlation key
+    as ``gen``/``round``/``rank`` fields, so spans interleave with the
+    recorder's per-round subsystem events (fault draws, membership
+    decisions, WAL watermarks, scale-out votes) on ONE timeline in a
+    ``FlightRecorder.dump()`` postmortem artifact and in
+    ``tools/obs_report.py``'s rendering of it.
     """
     stack = getattr(_local, "stack", None)
     if stack is None:
@@ -566,9 +680,10 @@ def reset_residue_warnings() -> None:
 
 
 __all__ = [
-    "Telemetry", "combine", "configure_tracing", "device_depth",
-    "device_pressure", "drain_events", "generic_slots_changed",
-    "is_concrete", "packet_useful_bytes", "record",
-    "reset_residue_warnings", "shipped_bytes",
-    "span", "specs", "to_dict", "zeros",
+    "HIST_FIELDS", "Telemetry", "combine", "configure_tracing",
+    "counter_increments",
+    "device_depth", "device_pressure", "drain_events",
+    "generic_slots_changed", "is_concrete", "packet_useful_bytes",
+    "record", "reset_residue_warnings", "shipped_bytes",
+    "span", "specs", "time_dispatch", "to_dict", "zeros",
 ]

@@ -68,6 +68,54 @@ def test_schema_rejects_drift(tmp_path):
     assert cts.main([str(ok)]) == 0
 
 
+def test_schema_validates_and_rejects_histogram_shapes():
+    """The ISSUE 12 satellite: the ``histogram`` kind (bucket-edges
+    array + counts one longer + exact total) validates the honest
+    ``hist_*`` fields and REJECTS every malformation class."""
+    good = exporter.telemetry_record("k", telemetry.zeros())
+    assert cts.validate_record(good) == []
+
+    def broken(**patch):
+        rec = exporter.telemetry_record("k", telemetry.zeros())
+        h = dict(rec["hist_residue"])
+        h.update(patch)
+        rec["hist_residue"] = patch.get("_whole", h)
+        return cts.validate_record(rec)
+
+    # Counts/edges length mismatch (the quantile-skewing class).
+    errs = broken(counts=[0] * 3)
+    assert any("counts" in e for e in errs)
+    # Non-ascending edges.
+    assert any("ascending" in e for e in broken(edges=[4.0, 2.0, 1.0]))
+    # Negative / non-int counts.
+    n = len(good["hist_residue"]["counts"])
+    assert broken(counts=[-1] + [0] * (n - 1))
+    assert broken(counts=["0"] * n)
+    # Non-finite total, and a histogram that is not an object at all.
+    assert any("total" in e for e in broken(total=float("inf")))
+    assert broken(_whole="not-a-histogram")
+    # A missing histogram field is drift like any other missing field.
+    rec = exporter.telemetry_record("k", telemetry.zeros())
+    del rec["hist_useful_bytes"]
+    assert any("hist_useful_bytes" in e for e in cts.validate_record(rec))
+
+
+def test_schema_validates_flight_records(tmp_path):
+    """Flight-recorder dumps validate line-by-line through the same
+    committed schema (the ``flight`` / ``flight_header`` records)."""
+    from crdt_tpu import obs
+
+    rec = obs.FlightRecorder(capacity=8)
+    rec.record("probe", seq=1)
+    path = str(tmp_path / "dump.jsonl")
+    rec.dump(path, reason="schema-test")
+    assert cts.validate_jsonl(path) == []
+    # A key-less flight event is drift.
+    assert cts.validate_record(
+        {"record": "flight", "ts": 1.0, "type": "probe"}
+    )
+
+
 def test_prometheus_text_exposition():
     m = Metrics()
     m.count("anti_entropy.merges", 7)

@@ -67,6 +67,17 @@ def _check(value: Any, kind: str, where: str, schema: dict) -> List[str]:
     elif kind == "object":
         if not isinstance(value, dict):
             errs.append(f"{where}: expected object, got {type(value).__name__}")
+    elif kind == "histogram":
+        errs += _check_histogram(value, where)
+    elif kind.startswith("array:"):
+        inner = kind.split(":", 1)[1]
+        if not isinstance(value, list):
+            errs.append(
+                f"{where}: expected array, got {type(value).__name__}"
+            )
+        else:
+            for i, v in enumerate(value):
+                errs += _check(v, inner, f"{where}[{i}]", schema)
     elif kind == "gauge":
         if not isinstance(value, dict):
             errs.append(f"{where}: expected gauge object, got {value!r}")
@@ -87,6 +98,43 @@ def _check(value: Any, kind: str, where: str, schema: dict) -> List[str]:
                 errs += _check(v, inner, f"{where}[{k!r}]", schema)
     else:  # schema bug, not data bug — still surface it
         errs.append(f"{where}: unknown schema kind {kind!r}")
+    return errs
+
+
+def _check_histogram(value: Any, where: str) -> List[str]:
+    """Structural validation of one serialized histogram (the
+    ``hist_*`` Telemetry fields — crdt_tpu/obs/hist.py ``to_dict``
+    form): strictly-ascending finite edges, non-negative int counts
+    EXACTLY one longer than the edges (the trailing count is the
+    unbounded +Inf bucket), finite total. Stricter than the generic
+    ``array:`` kinds because a shape-valid histogram with mismatched
+    lengths silently mis-renders every quantile downstream."""
+    errs: List[str] = []
+    if not isinstance(value, dict):
+        return [f"{where}: expected histogram object, got "
+                f"{type(value).__name__}"]
+    edges = value.get("edges")
+    counts = value.get("counts")
+    total = value.get("total")
+    if not isinstance(edges, list) or not all(
+        _is_number(e) for e in edges
+    ):
+        errs.append(f"{where}.edges: expected array of finite numbers")
+    elif any(b <= a for a, b in zip(edges, edges[1:])):
+        errs.append(f"{where}.edges: must be strictly ascending")
+    if not isinstance(counts, list) or not all(
+        _is_int(c) and c >= 0 for c in counts
+    ):
+        errs.append(
+            f"{where}.counts: expected array of non-negative ints"
+        )
+    elif isinstance(edges, list) and len(counts) != len(edges) + 1:
+        errs.append(
+            f"{where}.counts: expected {len(edges) + 1} buckets "
+            f"(len(edges) + 1, the last unbounded), got {len(counts)}"
+        )
+    if not _is_number(total):
+        errs.append(f"{where}.total: expected finite number")
     return errs
 
 

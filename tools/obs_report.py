@@ -1,0 +1,385 @@
+#!/usr/bin/env python
+"""Render a flight-recorder dump into a postmortem incident report.
+
+A ``crdt_tpu.obs.FlightRecorder.dump()`` artifact is a self-describing
+JSONL file: one ``flight_header`` line (format version + registered
+event-type schemas), the buffered events (each stamped with the
+``(generation, round, rank)`` correlation key), and a final registry
+``snapshot``. This tool turns one into something a human on call can
+act on:
+
+- **timeline** — the events in order, keyed ``gen/round/rank``, so
+  device rounds and host I/O (WAL fsyncs, snapshot commits, membership
+  transitions, scale-out votes) read as one story;
+- **histogram summaries** — the ``hist_*`` distributions folded across
+  every ``telemetry`` event (p50/p95/p99 per kind: apply latency,
+  per-round payload bytes, residue backlog, ack-window depth);
+- **invariant audit** — cross-event contract checks: a ``telemetry``
+  event claiming ``residue == 0`` while the same run lost/rejected
+  packets (the PR 8 loss-voids-certificate contract), a frontier lag
+  that never decreases across the dump (a straggler pinning
+  reclamation), drain refusals with unacked out-lanes, and
+  ``telemetry_delta`` sums exceeding the final snapshot (a rewound
+  counter);
+- **counter cross-check** — the dump's ``telemetry`` events re-folded
+  through ``crdt_tpu.telemetry.counter_increments`` (THE one mapping
+  ``telemetry.record`` itself applies) and compared BIT-EXACTLY
+  against a registry snapshot — the dump's embedded final snapshot by
+  default, or a caller-provided live one (``build_report(path,
+  snapshot=metrics.snapshot())`` — what bench legs and
+  tests/test_obs.py do). A mismatch means the artifact does not
+  faithfully narrate the run it claims to.
+
+CLI::
+
+    python tools/obs_report.py flight-....jsonl [--json-out report.json]
+
+exits non-zero on parse errors, counter mismatches, or audit
+violations. Importable surface: ``load_dump`` / ``fold_counters`` /
+``fold_histograms`` / ``audit`` / ``cross_check`` / ``build_report`` /
+``render_text``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(_HERE)
+for p in (ROOT, _HERE):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+from check_telemetry_schema import validate_record  # noqa: E402
+
+
+def load_dump(path: str) -> Dict[str, Any]:
+    """Parse + schema-validate one dump. Returns ``{"header", "events",
+    "snapshot", "spans", "errors"}`` — ``errors`` non-empty means the
+    artifact is damaged (every line is still read; a postmortem tool
+    must salvage what it can)."""
+    header = None
+    events: List[dict] = []
+    spans: List[dict] = []
+    snapshot = None
+    errors: List[str] = []
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError as exc:
+        return {"header": None, "events": [], "spans": [],
+                "snapshot": None, "errors": [f"unreadable dump: {exc}"]}
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError as exc:
+            errors.append(f"line {i}: not JSON ({exc})")
+            continue
+        rtype = rec.get("record") if isinstance(rec, dict) else None
+        if rtype in ("flight_header", "flight", "snapshot"):
+            for e in validate_record(rec):
+                errors.append(f"line {i}: {e}")
+        if rtype == "flight_header":
+            if header is not None:
+                errors.append(f"line {i}: duplicate flight_header")
+            header = rec
+        elif rtype == "flight":
+            events.append(rec)
+        elif rtype == "span":
+            spans.append(rec)
+        elif rtype == "snapshot":
+            snapshot = rec  # the LAST snapshot is the final one
+        else:
+            errors.append(f"line {i}: unknown record {rtype!r}")
+    if header is None:
+        errors.append("no flight_header record — not a flight dump")
+    elif header.get("events") != len(events):
+        errors.append(
+            f"header claims {header.get('events')} events, dump carries "
+            f"{len(events)}"
+        )
+    if snapshot is None:
+        errors.append("no final snapshot record — cross-check impossible")
+    return {"header": header, "events": events, "spans": spans,
+            "snapshot": snapshot, "errors": errors}
+
+
+def fold_counters(events: List[dict]) -> Dict[str, int]:
+    """Re-fold every ``telemetry`` event through the ONE
+    record-to-counter mapping (``telemetry.counter_increments``) —
+    what the live registry must bit-exactly agree with."""
+    from crdt_tpu.telemetry import counter_increments
+
+    folded: Dict[str, int] = defaultdict(int)
+    for ev in events:
+        if ev.get("type") != "telemetry":
+            continue
+        try:
+            inc = counter_increments(ev["kind"], ev)
+        except (KeyError, TypeError):
+            # A telemetry event missing fields cannot fold — the
+            # cross-check then reports the registry counters it failed
+            # to reproduce, which is the right loud failure.
+            continue
+        for name, n in inc.items():
+            folded[name] += n
+    return dict(folded)
+
+
+def cross_check(
+    folded: Dict[str, int], snapshot: Optional[dict],
+) -> List[str]:
+    """Bit-exact mismatches between the re-folded dump counters and a
+    registry snapshot (empty = the artifact faithfully narrates the
+    registry). Sound when the registry was reset when recording
+    started — the bench legs and the acceptance test do exactly that."""
+    if snapshot is None:
+        return ["no snapshot to cross-check against"]
+    counters = snapshot.get("counters", {})
+    out = []
+    for name in sorted(folded):
+        want, got = folded[name], counters.get(name, 0)
+        if want != got:
+            out.append(
+                f"{name}: dump folds to {want}, registry holds {got}"
+            )
+    return out
+
+
+def fold_histograms(events: List[dict]) -> Dict[str, Dict[str, Any]]:
+    """Fold the ``hist_*`` fields across every ``telemetry`` event:
+    ``{"<kind>.<name>": summary}`` with p50/p95/p99/count/total/mean
+    (crdt_tpu.obs.hist.summary) plus the folded counts."""
+    from crdt_tpu.obs import hist as obs_hist
+    from crdt_tpu.telemetry import HIST_FIELDS
+
+    acc: Dict[str, dict] = {}
+    for ev in events:
+        if ev.get("type") != "telemetry":
+            continue
+        for field in HIST_FIELDS:
+            hd = ev.get(field)
+            if not isinstance(hd, dict) or not sum(hd.get("counts", [])):
+                continue
+            key = f"{ev['kind']}.{field[len('hist_'):]}"
+            slot = acc.setdefault(key, {
+                "edges": hd["edges"],
+                "counts": [0] * len(hd["counts"]),
+                "total": 0.0,
+            })
+            slot["counts"] = [
+                a + b for a, b in zip(slot["counts"], hd["counts"])
+            ]
+            slot["total"] += hd["total"]
+    return {
+        k: {**obs_hist.summary(v), "counts": v["counts"]}
+        for k, v in acc.items()
+    }
+
+
+def audit(dump: Dict[str, Any]) -> List[Dict[str, str]]:
+    """Cross-event invariant findings (``severity`` "error" fails the
+    report; "warning" is advisory)."""
+    findings: List[Dict[str, str]] = []
+    events = dump["events"]
+
+    # 1. Residue certificate vs losses: PR 8's contract is that a lost
+    # or rejected packet forces residue >= 1 — a dispatch claiming
+    # both a certificate AND losses is narrating the impossible.
+    for ev in events:
+        if ev.get("type") != "telemetry":
+            continue
+        lost = ev.get("faults_dropped", 0) + ev.get("faults_rejected", 0)
+        if lost > 0 and ev.get("residue", 0) == 0:
+            findings.append({
+                "check": "residue-certificate-vs-losses",
+                "severity": "error",
+                "detail": (
+                    f"round {ev.get('round')}: kind {ev.get('kind')!r} "
+                    f"lost/rejected {lost} packets yet reads residue == 0 "
+                    f"— loss must void the certificate"
+                ),
+            })
+
+    # 2. Frontier-lag stall: a lag that is positive and never
+    # decreases across the dump means a straggler pinned reclamation
+    # the whole recorded window.
+    lags: Dict[str, List[int]] = defaultdict(list)
+    for ev in events:
+        if ev.get("type") == "telemetry":
+            lags[ev["kind"]].append(ev.get("frontier_lag", 0))
+    for kind, seq in lags.items():
+        if len(seq) >= 3 and seq[0] > 0 and all(
+            b >= a for a, b in zip(seq, seq[1:])
+        ):
+            findings.append({
+                "check": "frontier-lag-stall",
+                "severity": "warning",
+                "detail": (
+                    f"kind {kind!r}: frontier lag never decreased across "
+                    f"{len(seq)} recorded rounds ({seq[0]} -> {seq[-1]}) "
+                    f"— a straggler is pinning reclamation"
+                ),
+            })
+
+    # 3. Unacked out-lanes: every refused drain in the window, with
+    # why — the graceful-exit contract's refusals are the story.
+    for ev in events:
+        if ev.get("type") == "drain_refused":
+            findings.append({
+                "check": "drain-refused",
+                "severity": "warning",
+                "detail": (
+                    f"round {ev.get('round')}: drain of rank "
+                    f"{ev.get('rank')} refused at generation "
+                    f"{ev.get('gen')} — {ev.get('why', '?')} "
+                    f"(residue {ev.get('residue')}, lost "
+                    f"{ev.get('packets_lost')}, unacked "
+                    f"{ev.get('lanes_unacked')})"
+                ),
+            })
+
+    # 4. Delta monotonicity: telemetry_delta sums can never exceed the
+    # final snapshot (counters are monotone); more means a counter was
+    # reset mid-flight or the dump mixes processes.
+    snapshot = dump.get("snapshot") or {}
+    final = snapshot.get("counters", {})
+    sums: Dict[str, int] = defaultdict(int)
+    for ev in events:
+        if ev.get("type") == "telemetry_delta":
+            for k, v in (ev.get("counters") or {}).items():
+                sums[k] += v
+    for k, v in sorted(sums.items()):
+        if v > final.get(k, 0):
+            findings.append({
+                "check": "delta-exceeds-final",
+                "severity": "error",
+                "detail": (
+                    f"{k}: snapshot deltas sum to {v} but the final "
+                    f"snapshot holds {final.get(k, 0)} — a counter "
+                    f"rewound mid-recording"
+                ),
+            })
+    return findings
+
+
+def build_report(
+    path: str, snapshot: Optional[dict] = None,
+) -> Dict[str, Any]:
+    """The full machine-readable report. ``snapshot`` overrides the
+    dump's embedded final snapshot as the cross-check target (pass the
+    LIVE ``metrics.snapshot()`` to prove the dump reproduces the live
+    registry — the ISSUE 12 acceptance flow)."""
+    dump = load_dump(path)
+    folded = fold_counters(dump["events"])
+    target = snapshot if snapshot is not None else dump["snapshot"]
+    mismatches = cross_check(folded, target)
+    findings = audit(dump)
+    hard = [f for f in findings if f["severity"] == "error"]
+    return {
+        "path": path,
+        "ok": not dump["errors"] and not mismatches and not hard,
+        "parse_errors": dump["errors"],
+        "counter_mismatches": mismatches,
+        "audit": findings,
+        "histograms": fold_histograms(dump["events"]),
+        "events": len(dump["events"]),
+        "dropped": (dump["header"] or {}).get("dropped", 0),
+        "reason": (dump["header"] or {}).get("reason", ""),
+        "folded_counters": folded,
+    }
+
+
+def _brief(ev: dict) -> str:
+    skip = {"record", "type", "ts", "gen", "round", "rank"}
+    parts = []
+    for k, v in ev.items():
+        if k in skip:
+            continue
+        if isinstance(v, dict):
+            v = f"<{len(v)} keys>"
+        elif isinstance(v, list):
+            v = f"<{len(v)} items>"
+        parts.append(f"{k}={v}")
+        if len(parts) >= 5:
+            parts.append("...")
+            break
+    return " ".join(parts)
+
+
+def render_text(report: Dict[str, Any], dump: Optional[dict] = None,
+                max_events: int = 60) -> str:
+    """The human-readable incident report."""
+    lines = [
+        f"flight dump: {report['path']}",
+        f"reason: {report['reason'] or 'manual'} | events: "
+        f"{report['events']} (dropped {report['dropped']})",
+        f"verdict: {'OK' if report['ok'] else 'VIOLATIONS FOUND'}",
+    ]
+    if report["parse_errors"]:
+        lines.append("\nparse errors:")
+        lines += [f"  ! {e}" for e in report["parse_errors"]]
+    if dump is None:
+        dump = load_dump(report["path"])
+    lines.append("\ntimeline (gen/round/rank):")
+    events = dump["events"]
+    shown = events[-max_events:]
+    if len(events) > len(shown):
+        lines.append(f"  ... {len(events) - len(shown)} earlier events")
+    for ev in shown:
+        key = f"g{ev.get('gen', '?')}/r{ev.get('round', '?')}/" \
+              f"k{ev.get('rank', '?')}"
+        lines.append(f"  [{key:>12}] {ev.get('type', '?'):<22} {_brief(ev)}")
+    if report["histograms"]:
+        lines.append("\nhistogram summaries:")
+        for key, s in sorted(report["histograms"].items()):
+            lines.append(
+                f"  {key}: n={s['count']} mean={s['mean']:.1f} "
+                f"p50={s['p50']:.1f} p95={s['p95']:.1f} p99={s['p99']:.1f}"
+            )
+    if report["audit"]:
+        lines.append("\ninvariant audit:")
+        for f in report["audit"]:
+            lines.append(
+                f"  [{f['severity'].upper()}] {f['check']}: {f['detail']}"
+            )
+    else:
+        lines.append("\ninvariant audit: clean")
+    if report["counter_mismatches"]:
+        lines.append("\ncounter cross-check: FAILED")
+        lines += [f"  ! {m}" for m in report["counter_mismatches"]]
+    else:
+        lines.append(
+            f"\ncounter cross-check: bit-exact "
+            f"({len(report['folded_counters'])} counters)"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dump", help="flight-recorder JSONL artifact")
+    ap.add_argument(
+        "--json-out", default="",
+        help="also write the machine-readable report here",
+    )
+    args = ap.parse_args(argv)
+    report = build_report(args.dump)
+    print(render_text(report), end="")
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+        print(f"report -> {args.json_out}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

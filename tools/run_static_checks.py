@@ -46,6 +46,17 @@ Sections (each timed, each independently skippable):
   covered by the join of the others — analysis/laws.py), and the
   broken-twin detectors (the lossy and non-irredundant fixtures must
   each fire their law).
+- ``obs``      — the observability-plane gates
+  (crdt_tpu.obs.static_checks): flight-recorder event-type coverage
+  (every literal ``emit("...")`` site under ``crdt_tpu/`` must have a
+  registered schema — crdt_tpu.analysis.registry.register_obs_event —
+  so an event-emitting subsystem cannot ship events a dump header
+  cannot describe), the recorder ring-conformance detector (newest
+  ``capacity`` events kept in order, every drop counted), and the
+  in-kernel histogram conformance detector (jit-folded bucket counts
+  bit-exact vs the host reference) — each with a committed broken twin
+  in analysis/fixtures.py (``recorder_drops_events``,
+  ``histogram_miscounts``) proving the detector fires.
 - ``scaleout`` — the elastic mesh scale-out gates
   (crdt_tpu.scaleout.static_checks): scaleout-surface registry
   coverage (every public operational symbol must have registered —
@@ -104,7 +115,7 @@ sys.path.insert(0, ROOT)
 
 SECTIONS = (
     "lint", "schema", "laws", "schedules", "faults", "decomp",
-    "durability", "scaleout", "jit-lint", "cost", "aliasing",
+    "durability", "scaleout", "obs", "jit-lint", "cost", "aliasing",
 )
 
 # Directories the fallback linter walks (ruff takes its own config).
@@ -270,6 +281,12 @@ def run_scaleout():
     return static_checks()
 
 
+def run_obs():
+    from crdt_tpu.obs import static_checks
+
+    return static_checks()
+
+
 def run_jit_lint():
     from crdt_tpu.analysis.jit_lint import check_gates, lint_entry_points
 
@@ -306,6 +323,7 @@ RUNNERS = {
     "decomp": run_decomp,
     "durability": run_durability,
     "scaleout": run_scaleout,
+    "obs": run_obs,
     "jit-lint": run_jit_lint,
     "cost": run_cost,
     "aliasing": run_aliasing,
@@ -313,7 +331,7 @@ RUNNERS = {
 
 _JAX_SECTIONS = (
     "laws", "schedules", "faults", "decomp", "durability", "scaleout",
-    "jit-lint", "cost", "aliasing",
+    "obs", "jit-lint", "cost", "aliasing",
 )
 
 

@@ -141,6 +141,32 @@ def main() -> int:
         f"compression)"
     )
 
+    # Observability plane: the chaos leg runs under a flight recorder
+    # (bench.py installs one, dumps, and asserts the bit-exact replay
+    # itself); here the artifact must additionally PARSE as a valid
+    # self-describing dump through tools/obs_report.py — a dump that
+    # cannot be loaded postmortem is a failed check even if the leg's
+    # numbers were fine.
+    t0 = time.time()
+    chaos_recs = bench.bench_chaos()
+    if chaos_recs:
+        sys.path.insert(0, os.path.join(ROOT, "tools"))
+        from obs_report import build_report
+
+        chaos = chaos_recs[0]
+        flight_report = build_report(chaos["flight_dump"])
+        if flight_report["parse_errors"]:
+            print(
+                f"FAIL: chaos flight dump does not parse: "
+                f"{flight_report['parse_errors'][:3]}"
+            )
+            return 1
+        print(
+            f"chaos flight dump parsed           [{time.time()-t0:.0f}s] "
+            f"({flight_report['events']} events, dispatch p99 "
+            f"{chaos.get('dispatch_p99_us', 0):,.0f} us)"
+        )
+
     # THE flagship: 10,240 replicas x 1M elements streamed through the
     # mesh (parallel/stream.py), shape replayed VERBATIM from the
     # committed BENCH_CONFIGS.json entry. The record must be clean on
