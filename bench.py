@@ -506,35 +506,83 @@ def bench_comms():
     assert int(outs[True][3]) == 0, "comms leg did not certify convergence"
     tel_off, tel_on = outs[False][4], outs[True][4]
 
+    # The fused-wire story (PR 14): the default runs above ARE fused —
+    # pin them bit-identical to the UNFUSED (layered, PR 12-era wire)
+    # oracle, then run the acked config both ways so the packed wire
+    # bytes can be compared against PR 9's acked-useful bytes.
+    out_unfused = mesh_delta_gossip(
+        state, dirty, fctx, mesh, rounds=rounds_delta, cap=cap,
+        telemetry=True, fused=False,
+    )
+    fused_identical = all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(
+            jax.tree.leaves(rows_on), jax.tree.leaves(out_unfused[0])
+        )
+    )
+    assert fused_identical, "the fused wire changed the converged lattice"
+    # fused=False: the acked-useful baseline must be the number the
+    # ACTUAL PR 9 program produced (the fused ack lane is priced as a
+    # bitmap, so its bytes_useful is not the same quantity).
+    tel_acked = mesh_delta_gossip(
+        state, dirty, fctx, mesh, rounds=rounds_delta, cap=cap,
+        telemetry=True, ack_window=True, fused=False,
+    )[4]
+    tel_layered = out_unfused[4]
+
     # Per-link-round byte rates make the three modes comparable across
     # their different round budgets.
     links_full = p * rounds_full
     links_delta = p * rounds_delta
     full_rate = float(tel_full.bytes_exchanged) / links_full
     wire_rate = float(tel_on.bytes_exchanged) / links_delta
+    wire_rate_layered = float(tel_layered.bytes_exchanged) / links_delta
+    packed_rate = float(tel_on.wire_packed_bytes) / links_delta
+    acked_useful_rate = float(tel_acked.bytes_useful) / links_delta
     useful_rate = float(tel_on.bytes_useful) / links_delta
     useful_rate_off = float(tel_off.bytes_useful) / links_delta
     ratio = wire_rate / full_rate
+    fused_ratio = wire_rate / wire_rate_layered
+    # The ISSUE 14 acceptance relation: the packed wire (what a
+    # zero-suppressing transport carries) sits strictly below PR 9's
+    # acked-useful bytes — the previous best payload number.
+    assert packed_rate < acked_useful_rate, (
+        packed_rate, acked_useful_rate
+    )
     log(
         f"config-comms: {p} ranks x {e} elems ({churn:.2%} churn, cap "
         f"{cap}): full-state {full_rate:,.0f} B/link-round; δ wire "
-        f"{wire_rate:,.0f} ({ratio:.1%} of full); δ useful gated "
-        f"{useful_rate:,.0f} vs ungated {useful_rate_off:,.0f}; "
-        f"converged states bit-identical"
+        f"fused {wire_rate:,.0f} ({ratio:.1%} of full, {fused_ratio:.1%}"
+        f" of the layered wire's {wire_rate_layered:,.0f}); packed "
+        f"{packed_rate:,.0f} < acked-useful {acked_useful_rate:,.0f}; "
+        f"δ useful gated {useful_rate:,.0f} vs ungated "
+        f"{useful_rate_off:,.0f}; converged states bit-identical "
+        f"(digest on/off AND fused vs layered)"
     )
     return [{
         "config": "comms", "metric": "delta_wire_vs_full_ratio",
         "value": round(ratio, 4), "unit": "ratio",
         "bytes_full_per_link_round": round(full_rate, 1),
         "bytes_delta_wire_per_link_round": round(wire_rate, 1),
+        "bytes_delta_wire_layered_per_link_round":
+            round(wire_rate_layered, 1),
+        "bytes_delta_packed_per_link_round": round(packed_rate, 1),
+        "bytes_delta_acked_useful_per_link_round":
+            round(acked_useful_rate, 1),
         "bytes_delta_useful_per_link_round": round(useful_rate, 1),
         "bytes_delta_useful_ungated_per_link_round":
             round(useful_rate_off, 1),
         "bytes_exchanged_full_total": float(tel_full.bytes_exchanged),
         "bytes_exchanged_delta_total": float(tel_on.bytes_exchanged),
         "bytes_useful_delta_total": float(tel_on.bytes_useful),
+        "wire_packed_bytes_total": float(tel_on.wire_packed_bytes),
+        # Derived from the run, not asserted by fiat: a silent fallback
+        # to the layered wire reports wire_packed_bytes == 0.
+        "fused": bool(float(tel_on.wire_packed_bytes) > 0),
+        "fused_wire_vs_layered": round(fused_ratio, 4),
         "rounds_full": rounds_full, "rounds_delta": rounds_delta,
-        "churn": round(churn, 4), "cap": cap, "bit_identical": identical,
+        "churn": round(churn, 4), "cap": cap,
+        "bit_identical": identical and fused_identical,
         "shape": f"{p}x{e}x{a}",
     }]
 
@@ -772,6 +820,8 @@ def bench_chaos():
 
     rec, prev_rec, snap_base = _flight_start()
     dropped = rejected = 0
+    wire_packed = 0.0
+    cur0 = cur
     t0 = time.perf_counter()
     try:
         for _ in range(runs):
@@ -781,6 +831,7 @@ def bench_chaos():
             fc = out[-1]
             dropped += int(fc.packets_dropped)
             rejected += int(fc.packets_rejected)
+            wire_packed += float(out[4].wire_packed_bytes)
             assert int(out[3]) >= 1, "loss must void the residue certificate"
             cur = out[0]
             rec.snapshot_delta()
@@ -788,6 +839,32 @@ def bench_chaos():
         _obs.install(prev_rec)
         raise
     chaos_s = time.perf_counter() - t0
+    try:
+        # The fused wire must absorb the SAME damage to the SAME
+        # degraded state: replay the soak over the layered (PR 12-era)
+        # wire and pin the mid-degraded rows bit-identical — a stronger
+        # statement than post-heal equality, since the checksum/drop
+        # fates themselves must line up packet for packet. (Inside the
+        # recorder guard: a divergence here must not leak the
+        # process-global recorder past the failed assert.)
+        cur_unfused = cur0
+        for _ in range(runs):
+            d, f = tracking(cur_unfused)
+            cur_unfused = mesh_delta_gossip(
+                cur_unfused, d, f, mesh, local_fold="tree", faults=plan,
+                fused=False,
+            )[0]
+        fused_identical = all(
+            bool(jnp.array_equal(x, y))
+            for x, y in zip(
+                jax.tree.leaves(cur), jax.tree.leaves(cur_unfused)
+            )
+        )
+        assert fused_identical, \
+            "fused chaos soak diverged from the layered oracle"
+    except BaseException:
+        _obs.install(prev_rec)
+        raise
     try:
         # Heal = state-driven resync; it is ALSO the evicted rank's rejoin.
         t0 = time.perf_counter()
@@ -871,6 +948,11 @@ def bench_chaos():
         "reclaimed_slots_pinned": pinned["reclaimed_slots"],
         "reclaimed_slots_evicted": unpinned["reclaimed_slots"],
         "bit_identical": identical,
+        # Derived from the run (a silent layered fallback reports zero
+        # packed bytes), so the run_tpu_checks gate stays falsifiable.
+        "fused": bool(wire_packed > 0),
+        "fused_vs_layered_identical": fused_identical,
+        "wire_packed_bytes_total": round(wire_packed, 1),
         "dispatch_p99_us": p99_us,
         "shape": f"{p}x{4 * p}",
         **flight,

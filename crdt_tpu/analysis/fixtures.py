@@ -341,6 +341,33 @@ def gate_top_covered_unsound(pkt, digest):
     )
 
 
+# ---- broken fused-wire twins (parallel/wire_checks.py) --------------------
+
+def fused_mask_drops_removals(pkt):
+    """The PR 3 wider-gate unsoundness REBUILT inside the fused wire
+    kernel: a know function that returns the slot CONTEXTS instead of
+    the content knowledge makes every slot read as add-only
+    (``ctxs == know`` trivially), so the in-kernel digest verdict
+    masks removal-carrying slots the receiver's top can never vouch
+    for. ``wire_checks.check_fused_gate`` must report
+    wire-removal-dropped for this twin — proving the fused gate
+    detector has teeth."""
+    from ..delta_opt.ackwin import _core
+
+    return _core(pkt).ctxs
+
+
+def bitmap_truncates_lanes(bits):
+    """A bit-packer that silently drops the last bitmap word — the
+    bool-plane truncation bug class the wire round-trip detector
+    (``wire_checks.check_bitmaps``) exists to catch: presence masks
+    shorter than the packet's bool lanes turn valid slots invisible on
+    the wire."""
+    from ..ops.wire_kernels import pack_bits
+
+    return pack_bits(bits)[:-1]
+
+
 # ---- cost-budget fixtures (analysis/cost.py) ------------------------------
 
 def kernel_budget_pad(x):

@@ -420,6 +420,7 @@ def _cached_entry_fn(kind: str, n_donated: int, mesh=None):
     from ..delta_opt.ackwin import AckWindowKey
     from ..faults import FaultPlan
     from ..parallel import anti_entropy as ae
+    from ..parallel.wire import WireKey
 
     def mesh_matches(key_mesh) -> bool:
         if mesh is None:
@@ -433,7 +434,12 @@ def _cached_entry_fn(kind: str, n_donated: int, mesh=None):
         if key[0] == kind and key[3] == tuple(range(n_donated))
         and mesh_matches(key[1])
         and not any(
-            isinstance(x, (FaultPlan, AckWindowKey)) for x in key[4:]
+            # A faulted / acked / fused-OFF run is a DIFFERENT traced
+            # program; reading it back here would poison the gates'
+            # view of the default entry (the PR 8/9 class — WireKey is
+            # the fused-wire pin, tests/test_wire.py).
+            isinstance(x, (FaultPlan, AckWindowKey, WireKey))
+            for x in key[4:]
         )
     ]
     return hits[-1] if hits else None

@@ -197,6 +197,21 @@ class ScaleoutSurface:
 
 
 @dataclass(frozen=True)
+class WireSurface:
+    """One registered fused-wire kernel instantiation
+    (crdt_tpu/parallel/wire.py over crdt_tpu/ops/wire_kernels.py): a δ
+    ring kind whose packets ship through the bit-packed wire format.
+    Registration is the coverage contract — the ``wire`` static-check
+    section (tools/run_static_checks.py, via
+    ``crdt_tpu.parallel.wire_checks.static_checks``) fails discovery
+    for any δ ring kind without a registered wire surface, exactly
+    like an unregistered join, entry point, or fault surface."""
+
+    name: str
+    module: str = ""
+
+
+@dataclass(frozen=True)
 class FaultSurface:
     """One registered fault-capable mesh entry (crdt_tpu/faults/): a
     public ``crdt_tpu.parallel`` callable that accepts a ``faults=``
@@ -240,6 +255,7 @@ _ENTRY: Dict[str, EntryPoint] = {}
 _COMPACT: Dict[str, Compactor] = {}
 _DECOMP: Dict[str, Decomposer] = {}
 _FAULT_SURFACES: Dict[str, FaultSurface] = {}
+_WIRE_SURFACES: Dict[str, WireSurface] = {}
 _SCALEOUT_SURFACES: Dict[str, ScaleoutSurface] = {}
 _OBS_EVENTS: Dict[str, ObsEvent] = {}
 
@@ -359,6 +375,36 @@ def register_fault_surface(name: str, *, module: str = "") -> FaultSurface:
     fs = FaultSurface(name=name, module=module)
     _FAULT_SURFACES[name] = fs
     return fs
+
+
+def register_wire_surface(name: str, *, module: str = "") -> WireSurface:
+    ws = WireSurface(name=name, module=module)
+    _WIRE_SURFACES[name] = ws
+    return ws
+
+
+def wire_surfaces() -> Tuple[WireSurface, ...]:
+    import crdt_tpu.parallel.wire  # noqa: F401  (registrations import-time)
+
+    return tuple(_WIRE_SURFACES[k] for k in sorted(_WIRE_SURFACES))
+
+
+def unwired_delta_kinds() -> List[str]:
+    """δ ring kinds (registered entry points whose jit-cache kind ends
+    in ``delta_gossip`` — the ``run_delta_ring`` family) without a
+    registered wire surface: the coverage gap list of the ``wire``
+    static-check section. A new δ flavor that never wired its packets
+    through the fused codec fails discovery here — the layered legacy
+    path is a compatibility pin, not a place for new flavors to
+    live."""
+    ensure_registered()
+    import crdt_tpu.parallel.wire  # noqa: F401  (registrations import-time)
+
+    delta_kinds = {
+        ep.kind for ep in _ENTRY.values()
+        if ep.kind.endswith("delta_gossip")
+    }
+    return sorted(delta_kinds - set(_WIRE_SURFACES))
 
 
 def register_scaleout_surface(

@@ -276,18 +276,29 @@ def _tile_table() -> dict:
     return _TILE_TABLE
 
 
-def _pick_r_chunk(r: int, a: int, tile_e: int, r_chunk: Optional[int]) -> int:
+def _pick_r_chunk(
+    r: int, a: int, tile_e: int, r_chunk: Optional[int],
+    family: str = "fold",
+) -> int:
     if r_chunk is None:
-        # A committed sweep result for this (actor count, tile_e) wins
-        # over the VMEM-budget heuristic; both still get clamped to the
-        # batch and rounded to the halving tree's power of two below.
-        # A malformed entry (missing/non-numeric r_chunk) degrades to
-        # the heuristic — the table is an override, never a requirement —
-        # but counts in the registry so a fat-fingered sweep table is an
-        # operator signal, not silence (tests/test_analysis.py pins it).
+        # A committed sweep result for this (kernel family, actor
+        # count, tile_e) wins over the VMEM-budget heuristic; both
+        # still get clamped to the batch and rounded to the halving
+        # tree's power of two below. Entries are keyed by ``family``
+        # ("fold" when absent — the pre-wire table form) so a sweep of
+        # the fused WIRE kernel (ops/wire_kernels.py) can never be
+        # silently reused by the fold kernels, or vice versa: the two
+        # families stream different shapes through VMEM and a tile
+        # optimal for one is folklore for the other. A malformed entry
+        # (missing/non-numeric r_chunk) degrades to the heuristic —
+        # the table is an override, never a requirement — but counts
+        # in the registry so a fat-fingered sweep table is an operator
+        # signal, not silence (tests/test_analysis.py pins it).
         for entry in _tile_table().get("entries", ()):
             try:
-                if entry.get("a") == a and entry.get("tile_e") == tile_e:
+                if (entry.get("family", "fold") == family
+                        and entry.get("a") == a
+                        and entry.get("tile_e") == tile_e):
                     r_chunk = int(entry["r_chunk"])
                     break
             except (AttributeError, KeyError, TypeError, ValueError):

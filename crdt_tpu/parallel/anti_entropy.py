@@ -138,6 +138,9 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         scaleout_admits=jnp.zeros((), jnp.uint32),
         scaleout_drains=jnp.zeros((), jnp.uint32),
         bootstrap_bytes=jnp.zeros((), jnp.float32),
+        # The packed-wire fields are zero unless the δ ring's fused=
+        # path fills them in (delta_ring's _replace).
+        wire_packed_bytes=jnp.zeros((), jnp.float32),
         # The in-kernel histograms are zero unless the δ ring's loop
         # carry fills them in (delta_ring's _replace);
         # hist_dispatch_us is filled host-side (telemetry.time_dispatch
@@ -145,6 +148,7 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         hist_residue=_hist.zeros(),
         hist_useful_bytes=_hist.zeros(),
         hist_ack_depth=_hist.zeros(),
+        hist_packed_bytes=_hist.zeros(),
         hist_dispatch_us=_hist.zeros(),
     )
 

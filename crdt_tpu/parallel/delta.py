@@ -276,6 +276,7 @@ def mesh_delta_gossip(
     faults=None,
     ack_window=False,
     wal=None,
+    fused: bool = True,
 ):
     """Ring δ anti-entropy over the mesh: each device folds its local
     replica block (OR-folding dirty, max-folding contexts), then runs
@@ -332,7 +333,12 @@ def mesh_delta_gossip(
     reports the win). ``wal=`` (a ``crdt_tpu.durability.Wal``) logs the
     run's converged rows as one irreducible δ record + round barrier —
     crash recovery then replays snapshot + log suffix
-    (run_delta_ring documents the host-side semantics)."""
+    (run_delta_ring documents the host-side semantics).
+    ``fused=True`` (default) ships every packet through the one-pass
+    fused wire kernel and bit-packed format (parallel/wire.py —
+    converged states bit-identical, collective bytes roughly halved);
+    ``fused=False`` traces the byte-identical layered pre-flag
+    program (run_delta_ring documents the contract)."""
     from ..ops.pallas_kernels import fold_auto
     from .delta_ring import run_delta_ring
 
@@ -356,7 +362,7 @@ def mesh_delta_gossip(
         cache_extra=(local_fold,),
         telemetry=telemetry, slots_fn=changed_members,
         pipeline=pipeline, digest=digest, gate=gate_delta, donate=donate,
-        faults=faults, ack_window=ack_window, wal=wal, wal_kind="orswot",
+        faults=faults, ack_window=ack_window, wal=wal, wal_kind="orswot", fused=fused,
     )
 
 

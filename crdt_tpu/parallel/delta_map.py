@@ -301,6 +301,7 @@ def mesh_delta_gossip_map(
     faults=None,
     ack_window=False,
     wal=None,
+    fused: bool = True,
 ):
     """Ring δ anti-entropy for Map<K, MVReg> replica batches over the
     mesh — the bandwidth-bounded mode for large key universes with local
@@ -335,7 +336,7 @@ def mesh_delta_gossip_map(
         telemetry=telemetry, slots_fn=map_ops.changed_keys,
         pipeline=pipeline, digest=digest, gate=gate_delta_map,
         donate=donate, faults=faults, ack_window=ack_window,
-        wal=wal, wal_kind="map",
+        wal=wal, wal_kind="map", fused=fused,
     )
 
 

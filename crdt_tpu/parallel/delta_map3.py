@@ -66,6 +66,7 @@ def mesh_delta_gossip_map3(
     faults=None,
     ack_window=False,
     wal=None,
+    fused: bool = True,
 ):
     """Ring δ anti-entropy for depth-3 map replica batches (see
     delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET
@@ -96,7 +97,7 @@ def mesh_delta_gossip_map3(
         slots_fn=lambda a, b: changed_members(a.mo.core, b.mo.core),
         pipeline=pipeline, digest=digest, gate=gate_delta_m3,
         donate=donate, faults=faults, ack_window=ack_window,
-        wal=wal, wal_kind="map3",
+        wal=wal, wal_kind="map3", fused=fused,
     )
 
 

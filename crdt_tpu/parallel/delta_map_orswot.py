@@ -78,6 +78,7 @@ def mesh_delta_gossip_map_orswot(
     faults=None,
     ack_window=False,
     wal=None,
+    fused: bool = True,
 ):
     """Ring δ anti-entropy for Map<K, Orswot> replica batches (see
     delta.mesh_delta_gossip for semantics and the ROUNDS BUDGET
@@ -110,7 +111,7 @@ def mesh_delta_gossip_map_orswot(
         slots_fn=lambda a, b: changed_members(a.core, b.core),
         pipeline=pipeline, digest=digest, gate=gate_delta_mo,
         donate=donate, faults=faults, ack_window=ack_window,
-        wal=wal, wal_kind="map_orswot",
+        wal=wal, wal_kind="map_orswot", fused=fused,
     )
 
 

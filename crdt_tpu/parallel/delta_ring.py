@@ -64,7 +64,21 @@ Three orthogonal performance modes (all default-on where safe):
   ``bytes_useful`` drops further and ``bytes_acked_skipped`` /
   ``ack_window_depth`` report the window's win (telemetry.py).
 
-A fifth, non-performance mode is ``faults=`` (a
+- ``fused=True`` — **one fused wire pass + bit-packed format**
+  (crdt_tpu/parallel/wire.py over the Pallas kernel in
+  crdt_tpu/ops/wire_kernels.py): the whole send side of a round —
+  digest gate ∧ ack mask ∧ watermark encode ∧ checksum ∧ byte counts —
+  executes as a single read of the packet lanes, and the packet ships
+  as the all-u32 packed wire tree (bool planes as bitmaps, ids as u16
+  pairs, clock lanes as biased-u16 deltas against the link watermark)
+  instead of its in-memory pytree. Converged states are bit-identical
+  to the layered path; slots outside the encoding window defer into
+  the residue certificate and unencodable parked removes count as
+  wire loss (wire.py documents the narrow-window soundness contract).
+  ``fused=False`` traces the byte-identical layered (PR 12-era)
+  program.
+
+A sixth, non-performance mode is ``faults=`` (a
 ``crdt_tpu.faults.FaultPlan``, default None): seeded in-kernel fault
 injection on every inbound link — drop / corrupt / delay draws minted
 from ``jax.random`` inside the loop, an integrity checksum lane riding
@@ -104,7 +118,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .. import telemetry as tele
 from ..delta_opt import ackwin as _ackwin
 from ..obs import hist as _hist
+from ..ops import wire_kernels as _wk
 from ..utils.metrics import metrics, state_nbytes
+from . import wire as _wire
 from .mesh import ELEMENT_AXIS, REPLICA_AXIS
 
 
@@ -133,6 +149,7 @@ def run_delta_ring(
     ack_window=False,                 # delta_opt/ackwin.py (False/None off)
     wal=None,                         # crdt_tpu.durability.Wal
     wal_kind: Optional[str] = None,   # registry merge kind for δ records
+    fused: bool = True,               # parallel/wire.py fused wire path
 ):
     """Run the δ ring program; ``state``/``dirty``/``fctx`` must already
     be padded to the mesh. Returns ``(states [P, ...], dirty, overflow,
@@ -193,6 +210,23 @@ def run_delta_ring(
     registry twins. Off (the default) traces the byte-identical
     pre-flag program, like every other mode flag.
 
+    ``fused=True`` (the default) routes every packet through the ONE
+    fused wire pass (crdt_tpu/parallel/wire.py over the Pallas kernel
+    in crdt_tpu/ops/wire_kernels.py): digest gate ∧ ack mask ∧
+    watermark encode ∧ checksum ∧ byte counts in a single read of the
+    packet lanes, shipped as the bit-packed all-u32 wire tree (bool
+    planes as bitmaps, ids as u16 pairs, clock lanes as biased-u16
+    deltas against the link watermark). Converged states are
+    bit-identical to the layered path; slots outside the encoding
+    window DEFER (re-marked dirty before the round's backlog count, so
+    the residue certificate prices them) and an unencodable parked
+    remove counts as wire loss (residue forced ≥ 1, top adoption
+    suppressed — wire.py documents the soundness contract).
+    ``fused=False`` traces the byte-identical PR 12-era layered
+    program (HLO-pinned in tests/test_wire.py) and marks its jit-cache
+    entry with ``wire.WireKey`` so the analysis gates keep reading the
+    default program.
+
     ``wal=`` (a ``crdt_tpu.durability.Wal``) makes the run DURABLE,
     host-side: the pre-run state seeds the log's diff base (a device
     copy, so ``donate=True`` stays sound), and after the run the
@@ -216,6 +250,10 @@ def run_delta_ring(
     gated = digest and gate is not None
     faulted = faults is not None
     acked = bool(ack_window)
+    # The fused wire path needs the flavor's registered codec (its
+    # know function — parallel/wire.py); kinds without one (a future
+    # flavor mid-bringup) fall back to the layered wire.
+    fused_on = bool(fused) and kind in _wire.WIRE_SURFACES
     delay_mode = faulted and faults.delay > 0
     # Certificate window / propagation diameter: one hop per round
     # sequentially, one hop per two rounds pipelined (module docstring).
@@ -247,8 +285,10 @@ def run_delta_ring(
         slots_of = slots_fn or tele.generic_slots_changed
         # Telemetry loop-carry width: slots, shipped, useful, plus the
         # two in-kernel histograms (per-round backlog and per-round
-        # useful bytes — obs/hist.py Hist subtrees riding the carry).
-        n_tel = 5 if telemetry else 0
+        # useful bytes — obs/hist.py Hist subtrees riding the carry);
+        # the fused wire adds the packed-bytes scalar and its
+        # histogram (wire_packed_bytes / hist_packed_bytes).
+        n_tel = (7 if fused_on else 5) if telemetry else 0
 
         @partial(
             jax.shard_map,
@@ -322,7 +362,7 @@ def run_delta_ring(
                     jnp.zeros((), jnp.uint32), jnp.zeros((), jnp.int32),
                     jnp.zeros((), jnp.int32),
                 )
-            if delay_mode or acked:
+            if delay_mode or acked or fused_on:
                 pkt_shape = jax.eval_shape(
                     lambda s, dd, ff: extract(s, dd, ff, cap, start=0)[0],
                     folded, d, f,
@@ -341,8 +381,21 @@ def run_delta_ring(
                     sender's own shipped copy into its window (ackwin
                     module docstring: bits follow the DATA packet's
                     fate, the ack lane itself rides the un-faulted
-                    inverse channel)."""
+                    inverse channel). Under the fused wire the bits
+                    ship as a u32 bitmap (8× the bool lane density);
+                    the receiver's bits also promote its watermark
+                    MIRROR (wire.py) before they leave."""
                     bits = _ackwin.ack_bits(rcvd, keep)
+                    if fused_on:
+                        bw = _wk.pack_bits(bits)
+                        bw = lax.ppermute(bw, REPLICA_AXIS, inv_perm)
+                        return (
+                            _ackwin.update_window(
+                                awin, sent,
+                                _wk.unpack_bits(bw, bits.shape[0]),
+                            ),
+                            bw,
+                        )
                     bits = lax.ppermute(bits, REPLICA_AXIS, inv_perm)
                     return _ackwin.update_window(awin, sent, bits), bits
             # Ack carry width: window (+ sender's in-flight copy under
@@ -353,6 +406,51 @@ def run_delta_ring(
                 ((2 if pipe_on else 1) + (2 if telemetry else 0))
                 if acked else 0
             )
+            # Fused-wire carry width: the parked narrow-loss counter,
+            # plus the receiver's ack-watermark mirror (and its lagged
+            # copy under pipelining — wire.py's lockstep discipline).
+            if fused_on:
+                wcodec = _wire.WireCodec(
+                    pkt_shape, d.shape[-1], _wire.WIRE_SURFACES[kind],
+                    gated=gated, acked=acked,
+                )
+                mctx0 = jnp.zeros(
+                    (d.shape[-1], wcodec.a), wcodec.ct
+                )
+                n_wire = 1 + (
+                    (2 if pipe_on else 1) if acked else 0
+                )
+
+                def pack_ship(pkt, awin):
+                    """The fused send: ONE kernel pass (gate ∧ mask ∧
+                    encode ∧ checksum ∧ count), then the ppermute of
+                    the packed wire — with the kernel's checksum as
+                    the integrity lane when faulted."""
+                    w, aux = wcodec.pack(
+                        pkt,
+                        rtop=rtop if gated else None,
+                        win=awin if acked else None,
+                    )
+                    if faulted:
+                        wired = jax.tree.map(
+                            lambda x: lax.ppermute(x, REPLICA_AXIS, perm),
+                            (w, aux.checksum),
+                        )
+                    else:
+                        wired = jax.tree.map(
+                            lambda x: lax.ppermute(x, REPLICA_AXIS, perm),
+                            w,
+                        )
+                    return wired, aux
+
+                def unpack_in(w, st, mctx):
+                    return wcodec.unpack(
+                        w,
+                        own_top=top_of(st) if gated else None,
+                        mirror_ctx=mctx if acked else None,
+                    )
+            else:
+                n_wire = 0
 
             def deliver_held(st, d, f, of, held, heldv):
                 """The one-round-late link buffer lands (delay faults)."""
@@ -362,21 +460,40 @@ def run_delta_ring(
 
             def round_body(r, carry):
                 if delay_mode:
-                    fc, held, heldv = carry[5 + n_tel + n_ack:]
+                    fc, held, heldv = carry[5 + n_tel + n_ack + n_wire:]
                 elif faulted:
-                    (fc,) = carry[5 + n_tel + n_ack:]
+                    (fc,) = carry[5 + n_tel + n_ack + n_wire:]
                 if acked:
                     awin = carry[5 + n_tel]
                     if telemetry:
                         skip = carry[5 + n_tel + n_ack - 2]
                         hack = carry[5 + n_tel + n_ack - 1]
+                if fused_on:
+                    woff = 5 + n_tel + n_ack
+                    if acked:
+                        mctx = carry[woff]
+                    nlost = carry[woff + n_wire - 1]
                 if telemetry:
                     (st, d, f, of, starved, slots, shipped, useful,
                      hresid, huseful) = carry[:10]
+                    if fused_on:
+                        wpacked, hpacked = carry[10], carry[11]
                     u0 = useful
                 else:
                     st, d, f, of, starved = carry[:5]
                 pkt, d, f = extract(st, d, f, cap, start=r * cap)
+                if fused_on:
+                    # The fused send: one kernel pass replaces the
+                    # gate/ack/checksum/count layers. Deferred slots
+                    # re-mark dirty BEFORE the backlog count so the
+                    # residue certificate prices them (wire.py).
+                    wired, waux = pack_ship(
+                        pkt, awin if acked else None
+                    )
+                    d = _wire.remark_deferred(
+                        d, _wire.core_idx(pkt), waux.defer
+                    )
+                    nlost = nlost + waux.parked_lost
                 in_window = r >= rounds - win
                 # Explicit accumulator dtype: without it jnp.sum widens
                 # int32 -> int64 under x64 mode (counter_dtype="uint64")
@@ -389,29 +506,59 @@ def run_delta_ring(
                     # round's unshipped backlog (observed EVERY round —
                     # the drain curve, not just the certificate window).
                     hresid = _hist.observe(hresid, backlog)
-                if gated:
-                    pkt = gate(pkt, rtop)
-                if acked:
-                    # Layering: the digest gate fired first (stateless
-                    # top inference); the window masks what the peer has
-                    # POSITIVELY confirmed — including removals.
-                    pkt, covered = _ackwin.gate_window(pkt, awin)
-                    sent = pkt
+                if fused_on:
+                    if acked:
+                        sent = wcodec.mask(pkt, waux.keep)
+                        if telemetry:
+                            skip = skip + jnp.sum(
+                                waux.covered, dtype=jnp.float32
+                            ) * slot_price
                     if telemetry:
-                        skip = skip + jnp.sum(
-                            covered, dtype=jnp.float32
-                        ) * slot_price
-                pkt = ship(pkt)
-                if telemetry:
-                    before = st
-                    shipped = shipped + jnp.float32(tele.shipped_bytes(pkt))
-                    if faulted:
-                        useful = useful + tele.packet_useful_bytes(
-                            pkt[0]
-                        ) + jnp.float32(tele.shipped_bytes(pkt[1]))
-                    else:
-                        useful = useful + tele.packet_useful_bytes(pkt)
+                        before = st
+                        shipped = shipped + jnp.float32(
+                            tele.shipped_bytes(wired)
+                        )
+                        useful = useful + wcodec.useful_bytes(
+                            pkt, waux.keep
+                        ) + jnp.float32(4.0 if faulted else 0.0)
+                        wpacked = wpacked + 4.0 * (
+                            waux.packed_words
+                            + jnp.uint32(1 if faulted else 0)
+                        ).astype(jnp.float32)
+                    pkt = wired
+                else:
+                    if gated:
+                        pkt = gate(pkt, rtop)
+                    if acked:
+                        # Layering: the digest gate fired first
+                        # (stateless top inference); the window masks
+                        # what the peer has POSITIVELY confirmed —
+                        # including removals.
+                        pkt, covered = _ackwin.gate_window(pkt, awin)
+                        sent = pkt
+                        if telemetry:
+                            skip = skip + jnp.sum(
+                                covered, dtype=jnp.float32
+                            ) * slot_price
+                    pkt = ship(pkt)
+                    if telemetry:
+                        before = st
+                        shipped = shipped + jnp.float32(
+                            tele.shipped_bytes(pkt)
+                        )
+                        if faulted:
+                            useful = useful + tele.packet_useful_bytes(
+                                pkt[0]
+                            ) + jnp.float32(tele.shipped_bytes(pkt[1]))
+                        else:
+                            useful = useful + tele.packet_useful_bytes(pkt)
                 pkt, keep, fates = receive(pkt, r)
+                if fused_on:
+                    # Decode with the receiver's copy of the watermark
+                    # (own frozen top + the ack mirror — sequential
+                    # schedule: the mirror BEFORE this round's
+                    # promotion matches the sender's encode state).
+                    pkt = unpack_in(pkt, st, mctx if acked else None)
                 if delay_mode:
                     st, d, f, of = deliver_held(st, d, f, of, held, heldv)
                 applied = apply_fn(st, pkt, d, f)
@@ -428,22 +575,43 @@ def run_delta_ring(
                     st, d, f, of_r = applied
                     tail = ()
                 if acked:
+                    if fused_on:
+                        mctx = _wire.mirror_promote(
+                            mctx, pkt, _ackwin.ack_bits(pkt, keep),
+                            jnp.ones((), bool),
+                        )
                     awin, bits = ack_exchange(awin, sent, pkt, keep)
                     if telemetry:
                         ab = jnp.float32(tele.shipped_bytes(bits))
                         shipped, useful = shipped + ab, useful + ab
+                        if fused_on:
+                            wpacked = wpacked + 4.0 * jnp.sum(
+                                (bits != 0).astype(jnp.uint32),
+                                dtype=jnp.uint32,
+                            ).astype(jnp.float32)
                         hack = _hist.observe(
                             hack, _ackwin.window_depth(awin)
                         )
                     ack_tail = (awin, skip, hack) if telemetry else (awin,)
                 else:
                     ack_tail = ()
+                if fused_on:
+                    wire_tail = ((mctx,) if acked else ()) + (nlost,)
+                else:
+                    wire_tail = ()
                 if telemetry:
                     slots = slots + slots_of(before, st)
                     huseful = _hist.observe(huseful, useful - u0)
-                    return (st, d, f, of | of_r, starved, slots, shipped,
-                            useful, hresid, huseful) + ack_tail + tail
-                return (st, d, f, of | of_r, starved) + ack_tail + tail
+                    tel_mid = (slots, shipped, useful, hresid, huseful)
+                    if fused_on:
+                        hpacked = _hist.observe(
+                            hpacked, wpacked - carry[10]
+                        )
+                        tel_mid = tel_mid + (wpacked, hpacked)
+                    return ((st, d, f, of | of_r, starved) + tel_mid
+                            + ack_tail + wire_tail + tail)
+                return ((st, d, f, of | of_r, starved) + ack_tail
+                        + wire_tail + tail)
 
             def pipe_body(r, carry):
                 # Double-buffered round: extract round r+1's packet
@@ -452,46 +620,91 @@ def run_delta_ring(
                 # send crosses the loop edge, so its DMA overlaps the
                 # merge kernels (module docstring; stale by one apply).
                 if delay_mode:
-                    fc, held, heldv = carry[6 + n_tel + n_ack:]
+                    fc, held, heldv = carry[6 + n_tel + n_ack + n_wire:]
                 elif faulted:
-                    (fc,) = carry[6 + n_tel + n_ack:]
+                    (fc,) = carry[6 + n_tel + n_ack + n_wire:]
                 if acked:
                     awin, sent = carry[6 + n_tel], carry[6 + n_tel + 1]
                     if telemetry:
                         skip = carry[6 + n_tel + n_ack - 2]
                         hack = carry[6 + n_tel + n_ack - 1]
+                if fused_on:
+                    woff = 6 + n_tel + n_ack
+                    if acked:
+                        mctx, mctx_prev = carry[woff], carry[woff + 1]
+                    nlost = carry[woff + n_wire - 1]
                 if telemetry:
                     (st, d, f, of, starved, flight, slots, shipped,
                      useful, hresid, huseful) = carry[:11]
+                    if fused_on:
+                        wpacked, hpacked = carry[11], carry[12]
                     u0 = useful
                 else:
                     st, d, f, of, starved, flight = carry[:6]
                 pkt, d, f = extract(st, d, f, cap, start=(r + 1) * cap)
+                if fused_on:
+                    # Encode against the CURRENT window state — the
+                    # receiver decodes with its one-promotion-lagged
+                    # mirror, matching this exact state (wire.py's
+                    # pipelined lockstep discipline).
+                    wired, waux = pack_ship(
+                        pkt, awin if acked else None
+                    )
+                    d = _wire.remark_deferred(
+                        d, _wire.core_idx(pkt), waux.defer
+                    )
+                    nlost = nlost + waux.parked_lost
                 backlog = jnp.sum(d, dtype=jnp.int32)
                 starved = starved + jnp.where(
                     (r + 1) >= rounds - win, backlog, 0
                 )
                 if telemetry:
                     hresid = _hist.observe(hresid, backlog)
-                if gated:
-                    pkt = gate(pkt, rtop)
-                if acked:
-                    pkt, covered = _ackwin.gate_window(pkt, awin)
+                if fused_on:
+                    if acked:
+                        if telemetry:
+                            skip = skip + jnp.sum(
+                                waux.covered, dtype=jnp.float32
+                            ) * slot_price
                     if telemetry:
-                        skip = skip + jnp.sum(
-                            covered, dtype=jnp.float32
-                        ) * slot_price
-                nxt = ship(pkt)
-                if telemetry:
-                    before = st
-                    shipped = shipped + jnp.float32(tele.shipped_bytes(nxt))
-                    if faulted:
-                        useful = useful + tele.packet_useful_bytes(
-                            nxt[0]
-                        ) + jnp.float32(tele.shipped_bytes(nxt[1]))
-                    else:
-                        useful = useful + tele.packet_useful_bytes(nxt)
+                        before = st
+                        shipped = shipped + jnp.float32(
+                            tele.shipped_bytes(wired)
+                        )
+                        useful = useful + wcodec.useful_bytes(
+                            pkt, waux.keep
+                        ) + jnp.float32(4.0 if faulted else 0.0)
+                        wpacked = wpacked + 4.0 * (
+                            waux.packed_words
+                            + jnp.uint32(1 if faulted else 0)
+                        ).astype(jnp.float32)
+                    nxt = wired
+                else:
+                    if gated:
+                        pkt = gate(pkt, rtop)
+                    if acked:
+                        pkt, covered = _ackwin.gate_window(pkt, awin)
+                        if telemetry:
+                            skip = skip + jnp.sum(
+                                covered, dtype=jnp.float32
+                            ) * slot_price
+                    nxt = ship(pkt)
+                    if telemetry:
+                        before = st
+                        shipped = shipped + jnp.float32(
+                            tele.shipped_bytes(nxt)
+                        )
+                        if faulted:
+                            useful = useful + tele.packet_useful_bytes(
+                                nxt[0]
+                            ) + jnp.float32(tele.shipped_bytes(nxt[1]))
+                        else:
+                            useful = useful + tele.packet_useful_bytes(nxt)
                 flight, keep, fates = receive(flight, r)
+                if fused_on:
+                    flight = unpack_in(
+                        flight, st, mctx_prev if acked else None
+                    )
                 if delay_mode:
                     st, d, f, of = deliver_held(st, d, f, of, held, heldv)
                 applied = apply_fn(st, flight, d, f)
@@ -512,11 +725,24 @@ def run_delta_ring(
                     # shipped LAST round, whose pre-ship copy rides the
                     # carry (the window lags one extra round under
                     # pipelining, like knowledge itself).
+                    if fused_on:
+                        mctx_prev, mctx = mctx, _wire.mirror_promote(
+                            mctx, flight,
+                            _ackwin.ack_bits(flight, keep),
+                            jnp.ones((), bool),
+                        )
                     awin, bits = ack_exchange(awin, sent, flight, keep)
-                    sent = pkt
+                    sent = (
+                        wcodec.mask(pkt, waux.keep) if fused_on else pkt
+                    )
                     if telemetry:
                         ab = jnp.float32(tele.shipped_bytes(bits))
                         shipped, useful = shipped + ab, useful + ab
+                        if fused_on:
+                            wpacked = wpacked + 4.0 * jnp.sum(
+                                (bits != 0).astype(jnp.uint32),
+                                dtype=jnp.uint32,
+                            ).astype(jnp.float32)
                         hack = _hist.observe(
                             hack, _ackwin.window_depth(awin)
                         )
@@ -526,12 +752,25 @@ def run_delta_ring(
                     )
                 else:
                     ack_tail = ()
+                if fused_on:
+                    wire_tail = (
+                        ((mctx, mctx_prev) if acked else ()) + (nlost,)
+                    )
+                else:
+                    wire_tail = ()
                 if telemetry:
                     slots = slots + slots_of(before, st)
                     huseful = _hist.observe(huseful, useful - u0)
-                    return (st, d, f, of | of_r, starved, nxt, slots,
-                            shipped, useful, hresid, huseful) + ack_tail + tail
-                return (st, d, f, of | of_r, starved, nxt) + ack_tail + tail
+                    tel_mid = (slots, shipped, useful, hresid, huseful)
+                    if fused_on:
+                        hpacked = _hist.observe(
+                            hpacked, wpacked - carry[11]
+                        )
+                        tel_mid = tel_mid + (wpacked, hpacked)
+                    return ((st, d, f, of | of_r, starved, nxt) + tel_mid
+                            + ack_tail + wire_tail + tail)
+                return ((st, d, f, of | of_r, starved, nxt) + ack_tail
+                        + wire_tail + tail)
 
             zeros_tel = (
                 jnp.zeros((), jnp.uint32),   # slots
@@ -546,19 +785,40 @@ def run_delta_ring(
             if pipeline and rounds > 0:
                 # Prologue: round 0's packet goes in flight pre-loop.
                 pkt, d, f = extract(folded, d, f, cap, start=0)
+                if fused_on:
+                    # The round-0 window is empty, so the watermark is
+                    # the digest alone — the receiver's round-0 mirror
+                    # matches by construction.
+                    wired0, waux0 = pack_ship(
+                        pkt, awin0 if acked else None
+                    )
+                    d = _wire.remark_deferred(
+                        d, _wire.core_idx(pkt), waux0.defer
+                    )
                 backlog0 = jnp.sum(d, dtype=jnp.int32)
                 starved = jnp.where(
                     jnp.asarray(0 >= rounds - win), backlog0, 0,
                 )
-                if gated:
-                    pkt = gate(pkt, rtop)
-                # The round-0 window is empty — nothing to mask; the
-                # pre-ship copy seeds the carry as the first ackable
-                # send.
-                flight = ship(pkt)
+                if fused_on:
+                    flight = wired0
+                else:
+                    if gated:
+                        pkt = gate(pkt, rtop)
+                    # The round-0 window is empty — nothing to mask; the
+                    # pre-ship copy seeds the carry as the first ackable
+                    # send.
+                    flight = ship(pkt)
                 init = (folded, d, f, of, starved, flight)
                 if telemetry:
-                    if faulted:
+                    if fused_on:
+                        useful0 = wcodec.useful_bytes(
+                            pkt, waux0.keep
+                        ) + jnp.float32(4.0 if faulted else 0.0)
+                        wpacked0 = 4.0 * (
+                            waux0.packed_words
+                            + jnp.uint32(1 if faulted else 0)
+                        ).astype(jnp.float32)
+                    elif faulted:
                         useful0 = (
                             tele.packet_useful_bytes(flight[0])
                             + jnp.float32(tele.shipped_bytes(flight[1]))
@@ -573,25 +833,47 @@ def run_delta_ring(
                         _hist.observe(_hist.zeros(), backlog0),
                         _hist.observe(_hist.zeros(), useful0),
                     )
+                    if fused_on:
+                        init = init + (
+                            wpacked0,
+                            _hist.observe(_hist.zeros(), wpacked0),
+                        )
                 if acked:
+                    sent0 = (
+                        wcodec.mask(pkt, waux0.keep) if fused_on else pkt
+                    )
                     init = init + (
-                        (awin0, pkt, jnp.zeros((), jnp.float32),
+                        (awin0, sent0, jnp.zeros((), jnp.float32),
                          _hist.zeros())
-                        if telemetry else (awin0, pkt)
+                        if telemetry else (awin0, sent0)
+                    )
+                if fused_on:
+                    init = init + (
+                        ((mctx0, mctx0) if acked else ())
+                        + (waux0.parked_lost,)
                     )
                 init = init + fault_tail
                 carry = lax.fori_loop(0, rounds - 1, pipe_body, init)
                 folded, d, f, of, starved, flight = carry[:6]
                 if acked:
                     awin = carry[6 + n_tel]
+                if fused_on:
+                    woff = 6 + n_tel + n_ack
+                    if acked:
+                        mctx_prev = carry[woff + 1]
+                    nlost = carry[woff + n_wire - 1]
                 if delay_mode:
-                    fc, held, heldv = carry[6 + n_tel + n_ack:]
+                    fc, held, heldv = carry[6 + n_tel + n_ack + n_wire:]
                 elif faulted:
-                    (fc,) = carry[6 + n_tel + n_ack:]
+                    (fc,) = carry[6 + n_tel + n_ack + n_wire:]
                 # Epilogue: merge the final in-flight packet.
                 if telemetry:
                     before = folded
                 flight, keep, fates = receive(flight, rounds - 1, final=True)
+                if fused_on:
+                    flight = unpack_in(
+                        flight, folded, mctx_prev if acked else None
+                    )
                 if delay_mode:
                     folded, d, f, of = deliver_held(
                         folded, d, f, of, held, heldv
@@ -607,6 +889,8 @@ def run_delta_ring(
                 of = of | of_r
                 if telemetry:
                     slots, shipped, useful, hresid, huseful = carry[6:11]
+                    if fused_on:
+                        wpacked, hpacked = carry[11], carry[12]
                     slots = slots + slots_of(before, folded)
                     if acked:
                         skip = carry[6 + n_tel + n_ack - 2]
@@ -615,34 +899,55 @@ def run_delta_ring(
                 init = (folded, d, f, of, jnp.zeros((), jnp.int32))
                 if telemetry:
                     init = init + zeros_tel + (_hist.zeros(), _hist.zeros())
+                    if fused_on:
+                        init = init + (
+                            jnp.zeros((), jnp.float32), _hist.zeros()
+                        )
                 if acked:
                     init = init + (
                         (awin0, jnp.zeros((), jnp.float32), _hist.zeros())
                         if telemetry else (awin0,)
+                    )
+                if fused_on:
+                    init = init + (
+                        ((mctx0,) if acked else ())
+                        + (jnp.zeros((), jnp.int32),)
                     )
                 init = init + fault_tail
                 carry = lax.fori_loop(0, rounds, round_body, init)
                 folded, d, f, of, starved = carry[:5]
                 if telemetry:
                     slots, shipped, useful, hresid, huseful = carry[5:10]
+                    if fused_on:
+                        wpacked, hpacked = carry[10], carry[11]
                 if acked:
                     awin = carry[5 + n_tel]
                     if telemetry:
                         skip = carry[5 + n_tel + n_ack - 2]
                         hack = carry[5 + n_tel + n_ack - 1]
+                if fused_on:
+                    nlost = carry[5 + n_tel + n_ack + n_wire - 1]
                 if delay_mode:
-                    fc, held, heldv = carry[5 + n_tel + n_ack:]
+                    fc, held, heldv = carry[5 + n_tel + n_ack + n_wire:]
                     # A packet still held when the loop ends arrives now
                     # (one round late past the ring edge, not lost).
                     folded, d, f, of = deliver_held(
                         folded, d, f, of, held, heldv
                     )
                 elif faulted:
-                    (fc,) = carry[5 + n_tel + n_ack:]
+                    (fc,) = carry[5 + n_tel + n_ack + n_wire:]
             if telemetry and gated:
                 # The digest exchange itself rides the wire once.
                 dig = jnp.float32(tele.shipped_bytes(rtop))
                 shipped, useful = shipped + dig, useful + dig
+            if fused_on:
+                # Unencodable parked removes never reached the wire:
+                # count them as loss mesh-wide (wire.py's narrow-window
+                # contract — residue forced below, adoption gated
+                # here).
+                nlost_tot = lax.psum(
+                    nlost, (REPLICA_AXIS, ELEMENT_AXIS)
+                )
             if faulted:
                 # Adopt the mesh top ONLY when the run lost nothing:
                 # adoption after loss makes receivers claim
@@ -657,7 +962,18 @@ def run_delta_ring(
                 )
                 lost_tot = lax.psum(fc[4], REPLICA_AXIS)
                 adopt = (lost_tot == 0) & ~ev
+                if fused_on:
+                    adopt = adopt & (nlost_tot == 0)
                 top = jnp.where(adopt, top_live, own_top)
+            elif fused_on:
+                # Same adoption guard for narrow-lost parked removes on
+                # a fault-free ring; with nothing lost this selects the
+                # mesh top bit-identically to the unconditional path.
+                own_top = top_of(folded)
+                top_live = lax.pmax(
+                    lax.pmax(own_top, REPLICA_AXIS), ELEMENT_AXIS
+                )
+                top = jnp.where(nlost_tot == 0, top_live, own_top)
             else:
                 top = lax.pmax(
                     lax.pmax(top_of(folded), REPLICA_AXIS), ELEMENT_AXIS
@@ -673,6 +989,13 @@ def run_delta_ring(
                 # never read as certified-converged (module docstring).
                 residue = jnp.maximum(
                     residue, (lost_tot > 0).astype(jnp.int32)
+                )
+            if fused_on:
+                # Narrow-lost parked removes are wire loss too
+                # (wire.py): the certificate must not be issuable when
+                # removal knowledge never shipped.
+                residue = jnp.maximum(
+                    residue, (nlost_tot > 0).astype(jnp.int32)
                 )
             if rounds < win:
                 # A budget below the certificate window can never
@@ -701,6 +1024,15 @@ def run_delta_ring(
                         huseful, (REPLICA_AXIS, ELEMENT_AXIS)
                     ),
                 )
+                if fused_on:
+                    tel = tel._replace(
+                        wire_packed_bytes=lax.psum(
+                            wpacked, (REPLICA_AXIS, ELEMENT_AXIS)
+                        ),
+                        hist_packed_bytes=_hist.psum(
+                            hpacked, (REPLICA_AXIS, ELEMENT_AXIS)
+                        ),
+                    )
                 if acked:
                     tel = tel._replace(
                         bytes_acked_skipped=lax.psum(
@@ -752,6 +1084,10 @@ def run_delta_ring(
         out = _cached(
             kind, state, mesh, build, rounds, cap, telemetry, pipeline,
             gated, faults, _ackwin.AckWindowKey() if acked else None,
+            # A fused=False run is the LEGACY program: mark its cache
+            # entry so the analysis gates keep reading the default
+            # (fused) trace — the FaultPlan/AckWindowKey discipline.
+            None if fused_on else _wire.WireKey(),
             *cache_extra, donate_argnums=argnums,
         )(state, dirty, fctx)
         jax.block_until_ready(out)
@@ -791,6 +1127,14 @@ def run_delta_ring(
             skipped = int(out[4].bytes_acked_skipped)
             metrics.count("delta_opt.acked_skipped", skipped)
             metrics.count(f"delta_opt.acked_skipped.{kind}", skipped)
+    if fused_on:
+        metrics.count("wire.fused_runs")
+        if telemetry and tele.is_concrete(out[4]):
+            # The registry twins of the in-kernel packed-bytes counter
+            # (tools/telemetry_schema.json `wire_packed_bytes`).
+            pb = int(out[4].wire_packed_bytes)
+            metrics.count("wire.packed_bytes", pb)
+            metrics.count(f"wire.packed_bytes.{kind}", pb)
     if telemetry and tele.is_concrete(out[4]):
         tele.record(kind, out[4])
     if faulted:
@@ -859,6 +1203,7 @@ def delta_gossip_elastic(
     faults=None,
     ack_window=False,
     wal=None,
+    fused: bool = True,
 ):
     """δ-ring anti-entropy with elastic capacity recovery for dense
     ORSWOT replica batches (``BatchedOrswot``): the mid-round
@@ -934,6 +1279,7 @@ def delta_gossip_elastic(
             model.state, dirty, fctx, mesh, rounds, cap, local_fold,
             telemetry=telemetry, pipeline=pipeline, digest=digest,
             donate=donate, faults=faults, ack_window=ack_window,
+            fused=fused,
         )
         if donate:
             model.state, dirty = snap, snap_dirty

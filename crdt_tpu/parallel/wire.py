@@ -1,0 +1,695 @@
+"""The bit-packed δ wire format + the fused send path.
+
+PR 12's δ ring shipped every packet as its in-memory pytree: bool
+presence planes at one BYTE per lane, slot indices as i32, and every
+clock plane at full counter width — then made five separate
+elementwise passes over those planes (digest gate, ack mask, checksum,
+fault walk, telemetry counts) before the ``ppermute``. This module
+replaces both halves of that: :class:`WireCodec` lowers a flavor's
+``DeltaPacket``-family pytree onto a compact all-u32 wire tree in ONE
+fused pass (:func:`crdt_tpu.ops.wire_kernels.wire_pack` — gate ∧ mask
+∧ encode ∧ checksum ∧ count in a single read of the lanes), and the
+receiver inverts it with one plain-lax pass XLA fuses into the apply.
+
+Wire layout (``WirePacket`` — every leaf u32, leaf order static):
+
+    slots  [C, Ws]   clock lanes of the slot planes, delta-encoded
+                     against the link watermark as biased u16 pairs
+                     (two lanes per word, half-split pairing)
+    parked [ΣD, Wp]  parked-remove clock lanes, same encoding against
+                     the digest watermark
+    ids    [⌈ni/2⌉]  slot indices + actor ids as u16 pairs (their
+                     static bounds — E, A ≤ 2^16 — prove the
+                     narrowing lossless; wider universes ship raw)
+    raws   [nr]      unbounded non-clock lanes (map payload ids),
+                     bitcast
+    bits   [⌈nb/32⌉] EVERY bool plane of the packet — slot validity,
+                     content masks, parked dmask/dkeys/dvalid — as one
+                     u32 bitmap (8× the bool planes' wire density)
+
+**Watermark encoding.** A clock lane ships as
+``(value - base) + 32768`` in u16 — exact for values within ±32 Ki of
+``base`` — where ``base`` is the link's acked watermark
+(``delta_opt/ackwin.py`` window ctx, mirrored receiver-side, see
+below) joined with the receiver's frozen digest top when ``digest=``
+is on, and zero with both off. Both ends derive the base from
+knowledge they provably share, so the round-trip is bit-exact.
+
+**Soundness of the narrow window.** A slot whose lanes fall outside
+the ±32 Ki window is DEFERRED: it ships invalid, the ring re-marks its
+row dirty BEFORE the round's backlog count, and the residue
+certificate counts the starvation — an unencodable slot can therefore
+never be silently lost, it only keeps the run uncertified (the same
+one-sided-indicator contract as a too-small ``cap``). A parked-remove
+slot that cannot encode is stricter: removal knowledge must never go
+quietly missing (the PR 3 wider-gate lesson), so the sender counts it
+as WIRE LOSS — residue is forced ≥ 1 and the final top-closure
+adoption is suppressed exactly as for a lossy faulted link
+(``delta_ring.py``). In steady state clocks cluster within the window
+of their link watermark, so deferral is the exception the certificate
+prices, not the path.
+
+**Receiver-side ack mirror.** The sender's ack window
+(``ackwin.AckWindow``) is promoted from bits the RECEIVER itself
+computed and shipped, so the receiver can maintain a bit-identical
+mirror of the window's ctx plane from its own applies
+(:func:`mirror_promote`) — under ``pipeline=True`` the mirror decodes
+one promotion LATE (the sender encodes round r+1's packet before
+absorbing round r's acks), so the ring carries the previous mirror
+alongside the current one. That lockstep is what lets the acked
+watermark serve as the delta-encoding base in both directions.
+
+The checksum lane (``faults=``) is computed over the PACKED wire —
+:func:`wire_checksum` chains the kernel's in-pass partials with the
+small host-side leaves to a digest bit-equal to
+``faults.integrity.checksum`` of the wire tree, so the receiver
+verifies with the stock integrity path and detection semantics are
+unchanged.
+
+``fused=False`` on the δ entries bypasses this module entirely and
+traces the byte-identical PR 12-era program (HLO-pinned in
+tests/test_wire.py); :class:`WireKey` marks fused-off jit-cache
+entries so the analysis gates never read a stale program (the PR 8/9
+cache-poisoning class).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..delta_opt.ackwin import AckWindow, _content_names, _core
+from ..ops import wire_kernels as wk
+
+_MIX = 0x9E3779B1  # integrity.checksum's leaf-chaining constant
+_U16_SPAN = 65536
+
+
+class WireKey(NamedTuple):
+    """The jit-cache marker for FUSED-OFF ring programs: a fused=False
+    run traces the legacy layered wire, which must never be the
+    program the analysis gates (aliasing/cost/jit-lint) read back for
+    the default entry — ``analysis.jit_lint._cached_entry_fn`` skips
+    cache entries carrying this marker exactly as it skips FaultPlan /
+    AckWindowKey keys (the PR 8/9 poisoning class, pinned by
+    tests/test_wire.py)."""
+
+    fused: bool = False
+
+
+class WirePacket(NamedTuple):
+    """The all-u32 wire tree (module docstring layout). Fields hold
+    tuples so flavors without a plane class contribute no leaf; the
+    first leaf is always the slot clock matrix — the fault injector's
+    perturbation target, covered by the checksum lane like every
+    other leaf."""
+
+    slots: Tuple[jax.Array, ...]
+    parked: Tuple[jax.Array, ...]
+    ids: Tuple[jax.Array, ...]
+    raws: Tuple[jax.Array, ...]
+    bits: Tuple[jax.Array, ...]
+
+
+class WireAux(NamedTuple):
+    """Sender-side byproducts of one fused pack (all derived in the
+    kernel's single read of the lanes)."""
+
+    keep: jax.Array         # [C] bool — slots on the wire
+    defer: jax.Array        # [C] bool — narrow-deferred (re-mark dirty)
+    covered: jax.Array      # [C] bool — ack verdicts (skip-byte unit)
+    parked_lost: jax.Array  # i32 — unencodable parked slots (residue)
+    packed_words: jax.Array # u32 — nonzero wire words (packed bytes)
+    checksum: jax.Array     # u32 — integrity digest of the wire tree
+
+
+# Leaf classes, decided by field name + shape — the packet conventions
+# every delta flavor shares (delta.py DeltaPacket, delta_map.py
+# MapDeltaPacket, the nested_delta wrappers).
+(_CLOCK, _CTX, _PDCL, _ID, _RAW, _SLOTVALID, _CBOOL, _PVALID,
+ _PBOOL) = range(9)
+
+_PARKED_SUFFIXES = ("dcl", "dmask", "dkeys", "dvalid")
+
+
+def _classify(name: str, shape, dtype) -> int:
+    if name == "idx" or name == "wact":
+        return _ID
+    if name == "ctxs":
+        return _CTX
+    if name.endswith("dcl"):
+        return _PDCL
+    if name.endswith("dvalid"):
+        return _PVALID
+    if name.endswith("dmask") or name.endswith("dkeys"):
+        return _PBOOL
+    if dtype == jnp.bool_:
+        return _SLOTVALID if len(shape) == 1 else _CBOOL
+    if name in ("rows", "wctr", "clk"):
+        return _CLOCK
+    return _RAW
+
+
+def _named_leaves(tree, out=None):
+    """Depth-first (NamedTuple field order — jax's flatten order) list
+    of ``(field name, leaf)``: the static walk both ends share."""
+    if out is None:
+        out = []
+    for f in tree._fields:
+        child = getattr(tree, f)
+        if hasattr(child, "_fields"):
+            _named_leaves(child, out)
+        else:
+            out.append((f, child))
+    return out
+
+
+class _Rec(NamedTuple):
+    """One packet leaf's static plan row."""
+
+    i: int          # flat leaf index
+    name: str
+    cls: int
+    shape: Tuple[int, ...]
+    dtype: object
+
+
+class WireCodec:
+    """The static pack/unpack plan for one flavor's packet template.
+
+    Built INSIDE the traced ring from ``jax.eval_shape`` of the
+    flavor's extract — every decision is shape/dtype/name-static, so
+    sender and receiver derive the identical plan. ``know_fn`` maps
+    the packet to its per-slot knowledge clock ``[C, A]`` (the
+    digest-gate subject: dense rows, map ``_key_knowledge``)."""
+
+    def __init__(self, template, n_rows: int, know_fn: Callable,
+                 gated: bool, acked: bool,
+                 interpret: Optional[bool] = None):
+        self.treedef = jax.tree.structure(template)
+        self.n_rows = n_rows
+        self.know_fn = know_fn
+        self.gated = gated
+        self.acked = acked
+        self.interpret = interpret
+        core = _core(template)
+        self.c = core.idx.shape[0]
+        self.a = core.ctxs.shape[-1]
+        self.ct = core.ctxs.dtype
+        self.content_names = _content_names(core)
+
+        named = _named_leaves(template)
+        assert len(named) == len(jax.tree.leaves(template))
+        self.records: List[_Rec] = [
+            _Rec(i, name, _classify(name, tuple(leaf.shape), leaf.dtype),
+                 tuple(leaf.shape), leaf.dtype)
+            for i, (name, leaf) in enumerate(named)
+        ]
+
+        def size(r):
+            n = 1
+            for s in r.shape:
+                n *= s
+            return n
+
+        self._size = size
+        by_cls = lambda *cls: [r for r in self.records if r.cls in cls]
+        self.clock_recs = by_cls(_CLOCK)
+        self.ctx_rec = by_cls(_CTX)[0]
+        self.id_recs = by_cls(_ID)
+        self.raw_recs = by_cls(_RAW)
+        self.bool_recs = by_cls(_SLOTVALID, _CBOOL, _PVALID, _PBOOL)
+        self.parked_recs = by_cls(_PDCL)
+        self.pvalid_recs = by_cls(_PVALID)
+
+        # Slot clock matrix columns: content planes in walk order, the
+        # ctx plane LAST (the kernel's [ctx_lo, ctx_hi) range).
+        cols = 0
+        self.clock_cols: List[Tuple[int, int]] = []
+        for r in self.clock_recs:
+            n = size(r) // self.c
+            self.clock_cols.append((cols, cols + n))
+            cols += n
+        self.ctx_lo, self.ctx_hi = cols, cols + self.a
+        self.lc = cols + self.a
+
+        # Parked groups: (prefix, D, row offset in the concatenated
+        # parked matrix) in walk order — ``dcl``-suffixed leaves and
+        # their ``dvalid`` masks pair by prefix.
+        self.pd = 0
+        self.pgroup_row = {}
+        for r in self.parked_recs:
+            pref = r.name[: -len("dcl")]
+            self.pgroup_row[pref] = self.pd
+            self.pd += r.shape[0]
+
+        self.n_bits = sum(size(r) for r in self.bool_recs)
+        # u16 ids need their static bounds proven: slot indices by the
+        # row universe, actor ids by the clock width. A wider universe
+        # ships ids raw — the narrowing is a proof, not a hope.
+        self.narrow_ids = (n_rows <= _U16_SPAN and self.a <= _U16_SPAN)
+        self.slot_spec = wk.WireLaneSpec(
+            lc=self.lc, ctx_lo=self.ctx_lo, ctx_hi=self.ctx_hi,
+            gated=gated, acked=acked,
+        )
+        self.parked_spec = wk.WireLaneSpec(lc=self.a, parked=True)
+
+        # Static byte prices replicating telemetry.packet_useful_bytes'
+        # group arithmetic, so the fused path reports the identical
+        # bytes_useful quantity without materializing the gated packet.
+        parked_cls = (_PDCL, _PVALID, _PBOOL)
+        self.slot_price = sum(
+            (size(r) // self.c) * jnp.dtype(r.dtype).itemsize
+            for r in self.records if r.cls not in parked_cls
+        )
+        self.parked_prices = {}
+        for pref, _row in self.pgroup_row.items():
+            group = [
+                r for r in self.records
+                if r.name in tuple(pref + s for s in _PARKED_SUFFIXES)
+            ]
+            d = group[0].shape[0]
+            self.parked_prices[pref] = (d, sum(
+                (size(r) // d) * jnp.dtype(r.dtype).itemsize
+                for r in group
+            ))
+
+    # ---- shared base/watermark derivation --------------------------------
+
+    def _slot_base(self, idx, rtop, mctx):
+        """The per-slot watermark ``[C, A]``: acked-window ctx (when
+        on) joined with the digest top (when gated), zero otherwise —
+        knowledge both ends provably share."""
+        base = jnp.zeros((idx.shape[0], self.a), self.ct)
+        if self.gated and rtop is not None:
+            base = jnp.maximum(base, rtop[None, :].astype(self.ct))
+        if self.acked and mctx is not None:
+            base = jnp.maximum(
+                base, jnp.take(mctx, idx, axis=0).astype(self.ct)
+            )
+        return base
+
+    def _base_matrix(self, basemat, wact2):
+        """Per-lane bases in the slot matrix's column layout:
+        ``basemat [C, A]`` broadcast per A-minor plane, gathered at
+        the actor id for witness-counter lanes, zero for anything
+        else — a deterministic rule both ends compute."""
+        bases = []
+        for r, (lo, hi) in zip(self.clock_recs, self.clock_cols):
+            n = hi - lo
+            if r.name == "wctr" and wact2 is not None:
+                bases.append(jnp.take_along_axis(
+                    basemat, wact2.astype(jnp.int32), axis=-1
+                ))
+            elif n == self.a:
+                bases.append(basemat)
+            elif n % self.a == 0:
+                bases.append(jnp.tile(basemat, (1, n // self.a)))
+            else:
+                bases.append(jnp.zeros((self.c, n), self.ct))
+        bases.append(basemat)  # ctx columns
+        return jnp.concatenate(bases, axis=-1)
+
+    def _parked_base(self, rtop):
+        if self.gated and rtop is not None:
+            return jnp.broadcast_to(
+                rtop[None, :].astype(self.ct), (self.pd, self.a)
+            )
+        return jnp.zeros((self.pd, self.a), self.ct)
+
+    # ---- sender ----------------------------------------------------------
+
+    def pack(self, pkt, rtop=None, win: Optional[AckWindow] = None,
+             win_ctx=None) -> Tuple[WirePacket, WireAux]:
+        """One fused pass from the flavor packet to the wire tree.
+        ``rtop`` is the receiver's frozen digest top (``digest=``),
+        ``win`` the link's ack window (``ack_window=``) whose ctx
+        plane doubles as the encode watermark (``win_ctx`` overrides
+        it where the pipelined schedule needs the lagged state)."""
+        leaves = jax.tree.leaves(pkt)
+        core = _core(pkt)
+        idx = core.idx
+        wact2 = None
+        for r in self.id_recs:
+            if r.name == "wact":
+                wact2 = leaves[r.i].reshape(self.c, -1)
+        mctx = (win.ctx if win_ctx is None else win_ctx) if (
+            win is not None
+        ) else None
+        basemat = self._slot_base(idx, rtop, mctx)
+        clocks = jnp.concatenate(
+            [leaves[r.i].reshape(self.c, hi - lo)
+             for r, (lo, hi) in zip(self.clock_recs, self.clock_cols)]
+            + [leaves[self.ctx_rec.i].reshape(self.c, self.a)],
+            axis=-1,
+        ).astype(self.ct)
+        base = self._base_matrix(basemat, wact2)
+
+        know = dig = winc = ack_ok = None
+        if self.gated:
+            know = self.know_fn(pkt).astype(self.ct)
+            dig = jnp.broadcast_to(
+                rtop[None, :].astype(self.ct), (self.c, self.a)
+            )
+        if self.acked:
+            winc, same_rest = self._win_matrix(win, idx, leaves)
+            ack_ok = jnp.take(win.ackd, idx) & same_rest
+        out = wk.wire_pack(
+            self.slot_spec, clocks, base, core.valid,
+            know=know, dig=dig, winc=winc, ack_ok=ack_ok,
+            interpret=self.interpret,
+        )
+
+        # Parked clock planes: one fused pass over the concatenated
+        # levels against the digest watermark; an unencodable VALID
+        # slot is wire loss (module docstring).
+        pcl = jnp.concatenate([
+            leaves[r.i].reshape(-1, self.a) for r in self.parked_recs
+        ]).astype(self.ct)
+        pvalid = jnp.concatenate([leaves[r.i] for r in self.pvalid_recs])
+        pout = wk.wire_pack(
+            self.parked_spec, pcl, self._parked_base(rtop), pvalid,
+            interpret=self.interpret,
+        )
+        pvalid_wire = pvalid & ~pout.defer
+
+        # ids / raws / bools — tiny planes, XLA fuses them around the
+        # kernel calls.
+        def slotmask(flat):
+            return jnp.where(
+                jnp.repeat(out.keep, flat.shape[0] // self.c), flat,
+                jnp.zeros_like(flat),
+            )
+
+        ids = []
+        for r in self.id_recs:
+            flat = leaves[r.i].reshape(-1)
+            # Masked/invalid slots ship ZERO id lanes too (the packed
+            # wire stays mostly-zero on quiet workloads); the receiver
+            # reconstructs distinct no-op filler indices for them
+            # (:func:`fill_invalid_idx` — provably no-op scatter
+            # targets, so converged states stay bit-identical).
+            flat = slotmask(flat)
+            ids.append(
+                wk.pack_u16_pairs(flat) if self.narrow_ids
+                else flat.astype(jnp.uint32)
+            )
+        raws = [
+            jax.lax.bitcast_convert_type(
+                slotmask(leaves[r.i].reshape(-1)), jnp.uint32
+            )
+            for r in self.raw_recs
+        ]
+        bools = []
+        for r in self.bool_recs:
+            b = leaves[r.i]
+            if r.cls == _SLOTVALID:
+                b = out.keep
+            elif r.cls == _CBOOL:
+                b = slotmask(b.reshape(-1))
+            elif r.cls == _PVALID:
+                lo = self.pgroup_row[r.name[: -len("dvalid")]]
+                b = pvalid_wire[lo:lo + r.shape[0]]
+            else:  # _PBOOL: zero rows whose parked slot left the wire
+                pref = (r.name[: -len("dmask")]
+                        if r.name.endswith("dmask")
+                        else r.name[: -len("dkeys")])
+                lo = self.pgroup_row[pref]
+                sel = pvalid_wire[lo:lo + r.shape[0]]
+                b = (b & sel.reshape((r.shape[0],) + (1,) * (b.ndim - 1))
+                     ).reshape(-1)
+            bools.append(b.reshape(-1))
+        bits = wk.pack_bits(jnp.concatenate(bools))
+
+        wire = WirePacket(
+            slots=(out.words,),
+            parked=(pout.words,),
+            ids=tuple(ids),
+            raws=tuple(raws),
+            bits=(bits,),
+        )
+        nnz = out.nnz + pout.nnz
+        for x in list(ids) + raws + [bits]:
+            nnz = nnz + jnp.sum(
+                (x != 0).astype(jnp.uint32), dtype=jnp.uint32
+            )
+        aux = WireAux(
+            keep=out.keep, defer=out.defer, covered=out.covered,
+            parked_lost=jnp.sum(
+                (pvalid & pout.defer).astype(jnp.int32), dtype=jnp.int32
+            ),
+            packed_words=nnz,
+            checksum=wire_checksum(wire, {0: out.chk, 1: pout.chk}),
+        )
+        return wire, aux
+
+    def _win_matrix(self, win: AckWindow, idx, leaves):
+        """The ack comparison inputs: the window's confirmed content
+        planes gathered at ``idx`` in the clock columns + its ctx in
+        the ctx columns (the in-kernel half of ``gate_window``'s
+        verdict), and the one-bool-per-slot equality of the NON-clock
+        content lanes (ids, payload, content bools — tiny, compared
+        here)."""
+        core_t = _core(jax.tree.unflatten(
+            self.treedef,
+            [jax.ShapeDtypeStruct(r.shape, r.dtype) for r in self.records],
+        ))
+        by_name = {}
+        for f, rows_tree in zip(self.content_names, win.rows):
+            node = getattr(core_t, f)
+            if hasattr(node, "_fields"):
+                for (n, _), v in zip(
+                    _named_leaves(node), jax.tree.leaves(rows_tree)
+                ):
+                    by_name.setdefault(n, []).append(v)
+            else:
+                by_name.setdefault(f, []).append(rows_tree)
+        gath = lambda v: jnp.take(v, idx, axis=0)
+        cols = [
+            gath(by_name[r.name].pop(0)).reshape(self.c, hi - lo)
+            for r, (lo, hi) in zip(self.clock_recs, self.clock_cols)
+        ]
+        cols.append(gath(win.ctx))
+        winc = jnp.concatenate(cols, axis=-1).astype(self.ct)
+        same = jnp.ones((self.c,), bool)
+        for r in self.records:
+            vals = by_name.get(r.name)
+            if not vals or r.cls in (_CLOCK, _CTX):
+                continue
+            w = gath(vals.pop(0)).reshape(self.c, -1)
+            p = leaves[r.i].reshape(self.c, -1)
+            same = same & jnp.all(w == p, axis=-1)
+        return winc, same
+
+    # ---- receiver --------------------------------------------------------
+
+    def unpack(self, wire: WirePacket, own_top=None, mirror_ctx=None):
+        """Invert :meth:`pack` with the receiver's copy of the
+        watermark: its OWN frozen top (≡ the digest the sender held)
+        and its ack-window mirror ctx (≡ the sender's window at
+        encode time — module docstring lag discipline). Returns the
+        flavor packet, bit-identical to the sender's gated/masked
+        packet."""
+        leaves = [None] * len(self.records)
+        # bools first — slot validity selects the clock decode AND the
+        # invalid-slot index reconstruction.
+        bit_flat = wk.unpack_bits(wire.bits[0], self.n_bits)
+        off = 0
+        keep = None
+        pvalid_parts = []
+        for r in self.bool_recs:
+            n = self._size(r)
+            b = bit_flat[off:off + n].reshape(r.shape)
+            off += n
+            leaves[r.i] = b
+            if r.cls == _SLOTVALID:
+                keep = b
+            if r.cls == _PVALID:
+                pvalid_parts.append(b)
+        # ids next — clock bases may gather at actor ids; invalid
+        # slots' indices (shipped zero) become distinct no-op fillers.
+        wact2 = None
+        for k, r in enumerate(self.id_recs):
+            w = wire.ids[k]
+            flat = (
+                wk.unpack_u16_pairs(w, self._size(r), r.dtype)
+                if self.narrow_ids else w.astype(r.dtype)
+            )
+            leaves[r.i] = flat.reshape(r.shape)
+            if r.name == "idx":
+                leaves[r.i] = fill_invalid_idx(
+                    leaves[r.i], keep, self.n_rows
+                )
+            if r.name == "wact":
+                wact2 = leaves[r.i].reshape(self.c, -1)
+        # raws.
+        for k, r in enumerate(self.raw_recs):
+            leaves[r.i] = jax.lax.bitcast_convert_type(
+                wire.raws[k], r.dtype
+            ).reshape(r.shape)
+        # slot clocks under the shared watermark.
+        idx = leaves[self.id_recs[0].i]  # idx walks first by convention
+        basemat = self._slot_base(idx, own_top, mirror_ctx)
+        base = self._base_matrix(basemat, wact2)
+        dec = wk.wire_unpack(
+            self.slot_spec, wire.slots[0], base, keep, self.ct
+        )
+        for r, (lo, hi) in zip(self.clock_recs, self.clock_cols):
+            leaves[r.i] = dec[:, lo:hi].reshape(r.shape).astype(r.dtype)
+        rc = self.ctx_rec
+        leaves[rc.i] = dec[:, self.ctx_lo:self.ctx_hi].reshape(
+            rc.shape
+        ).astype(rc.dtype)
+        # parked clocks.
+        pdec = wk.wire_unpack(
+            self.parked_spec, wire.parked[0], self._parked_base(own_top),
+            jnp.concatenate(pvalid_parts), self.ct,
+        )
+        lo = 0
+        for r in self.parked_recs:
+            d = r.shape[0]
+            leaves[r.i] = pdec[lo:lo + d].reshape(r.shape).astype(r.dtype)
+            lo += d
+        return jax.tree.unflatten(self.treedef, leaves)
+
+    # ---- sender-side bookkeeping ----------------------------------------
+
+    def mask(self, pkt, keep):
+        """The sender's gated packet (content zeroed where the fused
+        pass masked or deferred) — the ack window's ``sent``
+        bookkeeping copy, NOT a wire pass."""
+        leaves = list(jax.tree.leaves(pkt))
+        for r in self.records:
+            if r.cls in (_PDCL, _PVALID, _PBOOL) or r.name == "idx":
+                continue
+            if r.cls == _SLOTVALID:
+                leaves[r.i] = keep
+                continue
+            sel = keep.reshape((self.c,) + (1,) * (len(r.shape) - 1))
+            leaves[r.i] = jnp.where(
+                sel, leaves[r.i], jnp.zeros_like(leaves[r.i])
+            )
+        return jax.tree.unflatten(self.treedef, leaves)
+
+    def useful_bytes(self, pkt, keep) -> jax.Array:
+        """``telemetry.packet_useful_bytes`` of the gated packet,
+        computed from the keep mask and the static prices (identical
+        float32 arithmetic — the fused path's DATA-PACKET
+        ``bytes_useful`` stays bit-comparable with the layered
+        path's; acked runs additionally count their ack lane at its
+        own wire price, bitmap here vs bool plane there, so
+        whole-run totals differ by the lane-format delta)."""
+        leaves = jax.tree.leaves(pkt)
+        total = jnp.sum(keep, dtype=jnp.float32) * self.slot_price
+        for r in self.pvalid_recs:
+            _, price = self.parked_prices[r.name[: -len("dvalid")]]
+            total = total + jnp.sum(leaves[r.i], dtype=jnp.float32) * price
+        return total
+
+
+def wire_checksum(wire: WirePacket, partials) -> jax.Array:
+    """``faults.integrity.checksum`` of the wire tree, with the kernel
+    in-pass partials standing in for the big leaves (``partials`` maps
+    leaf index -> precomputed position-weighted sum): same leaf walk,
+    same odd-constant chaining, bit-equal by construction — the
+    receiver verifies with the stock integrity lane
+    (tests/test_wire.py pins the equality)."""
+    total = jnp.zeros((), jnp.uint32)
+    for i, leaf in enumerate(jax.tree.leaves(wire)):
+        part = partials.get(i)
+        if part is None:
+            part = wk.leaf_checksum(leaf)
+        total = total * jnp.uint32(_MIX) + part
+    return total
+
+
+def fill_invalid_idx(idx, keep, e: int):
+    """Distinct no-op scatter targets for the invalid slots whose
+    indices shipped as zeros: the first free (un-kept) element
+    positions, ascending. An invalid slot's whole apply path is a
+    no-op at ANY row (its rows write the gathered receiver values
+    back), so only DISTINCTNESS matters — duplicate scatter indices
+    with different values would make the apply's writes
+    order-dependent. Deterministic on both ends by construction."""
+    taken = jnp.zeros((e,), jnp.int32).at[idx].add(
+        keep.astype(jnp.int32)
+    ) > 0
+    free = jnp.argsort(taken, stable=True).astype(idx.dtype)  # free first
+    rank = jnp.cumsum(~keep) - 1
+    return jnp.where(keep, idx, free[rank])
+
+
+def core_idx(pkt):
+    """The leaf slot packet's element indices (wrapper packets nest —
+    the ackwin walk convention)."""
+    return _core(pkt).idx
+
+
+def remark_deferred(dirty, idx, defer):
+    """Re-mark narrow-deferred slots dirty (they never reached the
+    wire); the ring runs this BEFORE the round's backlog count so a
+    perpetually deferred slot keeps the residue certificate honest."""
+    return dirty.at[idx].set(jnp.take(dirty, idx) | defer)
+
+
+def mirror_promote(mctx, pkt, bits, keep):
+    """The receiver-side twin of ``ackwin.update_window``'s ctx
+    promotion, driven by knowledge the receiver provably holds: the
+    packet it just applied and the ack bits it itself computed. Keeps
+    the mirror bit-identical to the sender's window ctx — the encode
+    watermark's other half."""
+    core = _core(pkt)
+    ok = core.valid & bits & keep
+    old = jnp.take(mctx, core.idx, axis=0)
+    return mctx.at[core.idx].set(
+        jnp.where(ok[:, None], jnp.maximum(old, core.ctxs), old)
+    )
+
+
+# ---- flavor know functions -------------------------------------------------
+
+def know_dense(pkt):
+    """delta.gate_delta's subject: the slot's live dot rows (shared by
+    the orswot-core nested flavors, whose gates lift the dense one)."""
+    return _core(pkt).rows
+
+
+def know_map(pkt):
+    """delta_map.gate_delta_map's subject: the witness-dot knowledge
+    of the shipped content slots."""
+    from .delta_map import _key_knowledge
+
+    return _key_knowledge(_core(pkt).child)
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) ----------------------
+# One fused wire kernel FAMILY, one registered surface per δ flavor
+# instantiation — the coverage contract the `wire` section of
+# tools/run_static_checks.py enforces (a δ ring kind without a
+# registered wire surface fails discovery there).
+
+WIRE_SURFACES = {
+    "delta_gossip": know_dense,
+    "map_delta_gossip": know_map,
+    "map_orswot_delta_gossip": know_dense,
+    "map3_delta_gossip": know_dense,
+}
+
+
+def _register():
+    from ..analysis.registry import register_wire_surface
+
+    for kind in WIRE_SURFACES:
+        register_wire_surface(kind, module=__name__)
+
+
+_register()
+
+
+__all__ = [
+    "WIRE_SURFACES", "WireAux", "WireCodec", "WireKey", "WirePacket",
+    "know_dense", "know_map", "mirror_promote", "remark_deferred",
+    "wire_checksum",
+]

@@ -1,0 +1,238 @@
+"""The ``wire`` static-check section: the fused wire path's gates.
+
+Three detectors, each with a committed broken twin in
+``analysis/fixtures.py`` proving it fires (the repo's
+registration-is-the-coverage-contract discipline, applied to the wire):
+
+1. **surface coverage** — every δ ring kind must have a registered
+   wire surface (``analysis.registry.register_wire_surface`` — the
+   codec know-function table in :mod:`.wire`); a new flavor that never
+   wired its packets through the fused codec fails discovery here.
+2. **fused gate soundness** — the in-kernel digest verdict, proven on
+   the SAME committed three-slot fixture the layered ``gate_delta``
+   detector uses (``jit_lint.check_orswot_gate``): the
+   removal-carrying covered slot must SHIP (a top digest can never
+   vouch for a removal — the PR 3 wider-gate lesson), the covered
+   add-only slot must MASK, the uncovered slot must SHIP. The broken
+   twin ``fixtures.fused_mask_drops_removals`` (the wider gate rebuilt
+   as a know function) must fail this.
+3. **wire round-trip** — pack → unpack must land the gated packet
+   bit-identically (bitmaps, u16-pair ids, watermark-encoded clock
+   lanes) and the kernel's in-pass checksum must equal
+   ``faults.integrity.checksum`` of the wire tree; the bitmap
+   truncation twin ``fixtures.bitmap_truncates_lanes`` must fail the
+   bitmap property.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..analysis.report import Finding
+
+
+def _fixture_packet():
+    """The committed three-slot dense fixture (jit_lint's gate
+    geometry): slot 0 removal-carrying but top-covered, slot 1
+    add-only covered, slot 2 uncovered — plus one VALID parked remove
+    so the parked lanes exercise the wire too."""
+    from ..ops.orswot import DTYPE
+    from ..parallel.delta import DeltaPacket
+
+    pkt = DeltaPacket(
+        idx=jnp.arange(3, dtype=jnp.int32),
+        rows=jnp.array([[1, 0], [1, 0], [7, 0]], DTYPE),
+        ctxs=jnp.array([[2, 0], [1, 0], [7, 0]], DTYPE),
+        valid=jnp.ones((3,), bool),
+        dcl=jnp.array([[3, 1], [0, 0]], DTYPE),
+        dmask=jnp.array(
+            [[True, False, True, False], [False] * 4], bool
+        ),
+        dvalid=jnp.array([True, False]),
+    )
+    return pkt, jnp.array([5, 5], DTYPE)
+
+
+def _codec(pkt, know_fn, gated=True):
+    from . import wire
+
+    return wire.WireCodec(
+        jax.eval_shape(lambda: pkt), 4, know_fn,
+        gated=gated, acked=False, interpret=True,
+    )
+
+
+def check_fused_gate(know_fn=None, label="wire.fused_gate"
+                     ) -> List[Finding]:
+    """Detector 2: the fused kernel's keep verdicts on the committed
+    fixture (expected [ship, mask, ship])."""
+    from . import wire
+
+    pkt, digest = _fixture_packet()
+    codec = _codec(pkt, know_fn or wire.know_dense)
+    _, aux = codec.pack(pkt, rtop=digest)
+    keep = [bool(k) for k in aux.keep]
+    findings: List[Finding] = []
+    if not keep[0]:
+        findings.append(Finding(
+            "wire-removal-dropped", label,
+            "the fused gate masked a REMOVAL-CARRYING covered slot "
+            "(ctx above rows under a covering top) — a top digest can "
+            "never vouch for a removal; receivers would keep dead "
+            "members live (the PR 3 wider-gate unsoundness, inside "
+            "the kernel)",
+        ))
+    if keep[1]:
+        findings.append(Finding(
+            "wire-gate-dead", label,
+            "a digest-covered add-only slot was NOT masked — the "
+            "fused gate never strips redundant payload, so the wire "
+            "pass is dead weight",
+        ))
+    if not keep[2]:
+        findings.append(Finding(
+            "wire-novelty-dropped", label,
+            "an UNCOVERED slot was masked — novel content never "
+            "reaches the wire and the ring cannot converge",
+        ))
+    return findings
+
+
+def check_roundtrip(label="wire.roundtrip") -> List[Finding]:
+    """Detector 3: pack → unpack bit-identity against the layered
+    gate's output, and kernel-checksum parity with the stock
+    integrity lane."""
+    import numpy as np
+
+    from ..faults.integrity import checksum
+    from ..parallel.delta import gate_delta
+    from . import wire
+
+    pkt, digest = _fixture_packet()
+    codec = _codec(pkt, wire.know_dense)
+    w, aux = codec.pack(pkt, rtop=digest)
+    dec = codec.unpack(w, own_top=digest)
+    ref = gate_delta(pkt, digest)
+    findings: List[Finding] = []
+    keep = np.asarray(aux.keep)
+    for (name, a), b in zip(
+        wire._named_leaves(ref), jax.tree.leaves(dec)
+    ):
+        a, b = np.asarray(a), np.asarray(b)
+        if name in ("dcl", "dmask"):
+            dv = np.asarray(pkt.dvalid)
+            a = np.where(
+                dv.reshape((-1,) + (1,) * (a.ndim - 1)), a, 0
+            )
+        if name == "idx":
+            # Masked slots ship zero indices; the receiver fills
+            # DISTINCT no-op targets — equality holds on kept slots,
+            # distinctness over all (wire.fill_invalid_idx).
+            if len(set(b.tolist())) != b.shape[0]:
+                findings.append(Finding(
+                    "wire-roundtrip", label,
+                    "reconstructed slot indices collide — duplicate "
+                    "scatter targets make the apply order-dependent",
+                ))
+            a, b = a[keep], b[keep]
+        if not np.array_equal(a, b):
+            findings.append(Finding(
+                "wire-roundtrip", label,
+                f"decoded plane {name!r} differs from the gated "
+                "packet — the wire format does not round-trip and "
+                "converged states would diverge from the layered "
+                "oracle",
+            ))
+    if int(aux.checksum) != int(checksum(w)):
+        findings.append(Finding(
+            "wire-checksum-drift", label,
+            "the kernel's in-pass checksum differs from "
+            "integrity.checksum of the wire tree — receivers would "
+            "reject every intact packet (or accept corrupt ones)",
+        ))
+    return findings
+
+
+def check_bitmaps(packer=None, label="wire.bitmaps") -> List[Finding]:
+    """The bitmap pack/unpack property at awkward widths (word
+    boundaries ± 1); ``packer`` is the injection seam the broken twin
+    ``fixtures.bitmap_truncates_lanes`` fails through."""
+    import numpy as np
+
+    from ..ops import wire_kernels as wk
+
+    packer = packer or wk.pack_bits
+    rng = np.random.RandomState(7)
+    findings: List[Finding] = []
+    for n in (1, 31, 32, 33, 63, 64, 65, 200):
+        bits = jnp.array(rng.rand(n) > 0.5)
+        try:
+            back = wk.unpack_bits(packer(bits), n)
+            ok = bool(jnp.all(back == bits))
+        except Exception:
+            ok = False
+        if not ok:
+            findings.append(Finding(
+                "wire-bitmap-truncated", label,
+                f"a {n}-bool plane does not survive the bitmap "
+                "round-trip — presence masks shorter than the packet's "
+                "bool lanes turn valid slots invisible on the wire",
+            ))
+            break
+    return findings
+
+
+def static_checks() -> List[Finding]:
+    """The ``wire`` section (Finding list, empty = clean): coverage +
+    fused-gate soundness + wire round-trip, each detector proven
+    firing by its committed broken twin."""
+    from ..analysis import fixtures
+    from ..analysis.registry import unwired_delta_kinds
+
+    findings: List[Finding] = [
+        Finding(
+            "wire-coverage", kind,
+            "δ ring kind has no registered wire surface — register "
+            "its codec know function in parallel/wire.py "
+            "(analysis.registry.register_wire_surface)",
+        )
+        for kind in unwired_delta_kinds()
+    ]
+    findings += check_fused_gate()
+    findings += check_roundtrip()
+    findings += check_bitmaps()
+
+    # Broken twins must fire — a detector that passes its committed
+    # twin has no teeth.
+    broken = check_fused_gate(
+        know_fn=fixtures.fused_mask_drops_removals,
+        label="fixtures.fused_mask_drops_removals",
+    )
+    if not any(f.check == "wire-removal-dropped" for f in broken):
+        findings.append(Finding(
+            "broken-fixture-missed", "fused_mask_drops_removals",
+            "the wider-gate-as-know-function twin PASSED the fused "
+            "gate detector — the removal-preservation gate is not "
+            "actually firing",
+        ))
+    broken = check_bitmaps(
+        packer=fixtures.bitmap_truncates_lanes,
+        label="fixtures.bitmap_truncates_lanes",
+    )
+    if not any(f.check == "wire-bitmap-truncated" for f in broken):
+        findings.append(Finding(
+            "broken-fixture-missed", "bitmap_truncates_lanes",
+            "the word-dropping bit-packer twin PASSED the bitmap "
+            "round-trip detector — the truncation gate is not "
+            "actually firing",
+        ))
+    return findings
+
+
+__all__ = [
+    "check_bitmaps", "check_fused_gate", "check_roundtrip",
+    "static_checks",
+]

@@ -96,8 +96,18 @@ shipped and sync metadata per round), measured natively per round:
   membership loop lives outside the kernels, the ``stream_*``/``wal_*``
   discipline — and 0 on every fixed-width run.
 
+- ``wire_packed_bytes`` — the fused wire path's POST-PACKING byte
+  count (crdt_tpu/parallel/wire.py; registry twins
+  ``wire.packed_bytes[.kind]``): nonzero u32 words actually occupied
+  on the bit-packed wire (bitmaps + u16-pair ids + watermark-encoded
+  clock lanes), the bytes a zero-suppressing transport would carry —
+  reported NEXT to ``bytes_exchanged`` (the static wire shape) and
+  ``bytes_useful`` (the post-mask raw payload) so the packing win is
+  attributable. 0 on every ``fused=False`` or non-δ run.
+
 - ``hist_residue`` / ``hist_useful_bytes`` / ``hist_ack_depth`` /
-  ``hist_dispatch_us`` — the in-kernel DISTRIBUTIONS
+  ``hist_packed_bytes`` / ``hist_dispatch_us`` — the in-kernel
+  DISTRIBUTIONS
   (crdt_tpu/obs/hist.py :class:`~crdt_tpu.obs.hist.Hist` subtrees:
   log2 bucket counts + exact total; registry summary twins
   ``telemetry.<kind>.hist.<name>.p50/p95/p99`` plus per-bucket
@@ -172,9 +182,11 @@ class Telemetry(NamedTuple):
     scaleout_admits: jax.Array     # uint32 — live rank joins completed
     scaleout_drains: jax.Array     # uint32 — graceful drains certified
     bootstrap_bytes: jax.Array     # float32 — newcomer bootstrap wire bytes
+    wire_packed_bytes: jax.Array   # float32 — post-packing bytes on the wire
     hist_residue: obs_hist.Hist    # per-round unshipped-backlog rows
     hist_useful_bytes: obs_hist.Hist  # per-round post-mask payload bytes
     hist_ack_depth: obs_hist.Hist  # per-round ack-window depth
+    hist_packed_bytes: obs_hist.Hist  # per-round post-packing wire bytes
     hist_dispatch_us: obs_hist.Hist   # host-timed dispatch wall-clock (µs)
 
 
@@ -209,9 +221,11 @@ def zeros() -> Telemetry:
         scaleout_admits=jnp.zeros((), jnp.uint32),
         scaleout_drains=jnp.zeros((), jnp.uint32),
         bootstrap_bytes=jnp.zeros((), jnp.float32),
+        wire_packed_bytes=jnp.zeros((), jnp.float32),
         hist_residue=obs_hist.zeros(),
         hist_useful_bytes=obs_hist.zeros(),
         hist_ack_depth=obs_hist.zeros(),
+        hist_packed_bytes=obs_hist.zeros(),
         hist_dispatch_us=obs_hist.zeros(),
     )
 
@@ -257,11 +271,15 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         scaleout_admits=a.scaleout_admits + b.scaleout_admits,
         scaleout_drains=a.scaleout_drains + b.scaleout_drains,
         bootstrap_bytes=a.bootstrap_bytes + b.bootstrap_bytes,
+        wire_packed_bytes=a.wire_packed_bytes + b.wire_packed_bytes,
         hist_residue=obs_hist.merge(a.hist_residue, b.hist_residue),
         hist_useful_bytes=obs_hist.merge(
             a.hist_useful_bytes, b.hist_useful_bytes
         ),
         hist_ack_depth=obs_hist.merge(a.hist_ack_depth, b.hist_ack_depth),
+        hist_packed_bytes=obs_hist.merge(
+            a.hist_packed_bytes, b.hist_packed_bytes
+        ),
         hist_dispatch_us=obs_hist.merge(
             a.hist_dispatch_us, b.hist_dispatch_us
         ),
@@ -438,9 +456,11 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "scaleout_admits": int(tel.scaleout_admits),
         "scaleout_drains": int(tel.scaleout_drains),
         "bootstrap_bytes": float(tel.bootstrap_bytes),
+        "wire_packed_bytes": float(tel.wire_packed_bytes),
         "hist_residue": obs_hist.to_dict(tel.hist_residue),
         "hist_useful_bytes": obs_hist.to_dict(tel.hist_useful_bytes),
         "hist_ack_depth": obs_hist.to_dict(tel.hist_ack_depth),
+        "hist_packed_bytes": obs_hist.to_dict(tel.hist_packed_bytes),
         "hist_dispatch_us": obs_hist.to_dict(tel.hist_dispatch_us),
     }
 
@@ -508,6 +528,9 @@ def counter_increments(kind: str, d: Dict[str, Any]) -> Dict[str, int]:
         f"telemetry.{kind}.scaleout.drains": d["scaleout_drains"],
         f"telemetry.{kind}.scaleout.bootstrap_bytes": int(
             d["bootstrap_bytes"]
+        ),
+        f"telemetry.{kind}.wire.packed_bytes": int(
+            d["wire_packed_bytes"]
         ),
     }
     # Histogram per-bucket counters fold bit-exactly across runs —

@@ -265,6 +265,49 @@ SLOW_NODEIDS = (
 )
 
 
+# ---- address-space guard (map-count cliff on long single-process runs) ----
+# Every compiled XLA:CPU executable holds a handful of anonymous
+# mappings for its code pages; a full tier-1 run accumulates tens of
+# thousands of executables in one process, and once the kernel's
+# vm.max_map_count (default 65530) is exhausted the NEXT mmap inside
+# backend_compile dies as a SIGSEGV — the suite crashes mid-run at
+# whatever innocent test happens to cross the line, with no Python
+# traceback naming the real cause (found live in PR 14: the fused-wire
+# A/B suites pushed the count over the cliff at ~64 980 maps, killing a
+# plain shard_orswot device_put in test_telemetry). Dropping the jit
+# caches releases the executables' mappings (verified: 300 executables
+# ≈ 1 800 maps, fully reclaimed by jax.clear_caches()); the persistent
+# XLA compilation cache above makes the recompiles cheap disk loads, so
+# the guard costs nothing until it actually fires — and firing beats a
+# segfault every time.
+_MAP_GUARD_EVERY = 25       # tests between /proc/self/maps checks
+_MAP_GUARD_LIMIT = 45_000   # clear well before the 65 530 kernel cliff
+_map_guard_tick = 0
+
+
+def _map_count() -> int:
+    try:
+        with open("/proc/self/maps", "rb") as f:
+            return sum(1 for _ in f)
+    except OSError:  # non-Linux: no /proc, and no known cliff either
+        return 0
+
+
+def pytest_runtest_teardown(item, nextitem):
+    global _map_guard_tick
+    _map_guard_tick += 1
+    if _map_guard_tick % _MAP_GUARD_EVERY:
+        return
+    if _map_count() < _MAP_GUARD_LIMIT:
+        return
+    import gc
+
+    import jax
+
+    jax.clear_caches()
+    gc.collect()
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "smoke: one fast A/B gate per CRDT family (~1 min subset)"

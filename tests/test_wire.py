@@ -1,0 +1,383 @@
+"""The fused wire path (parallel/wire.py + ops/wire_kernels.py):
+fused == layered bit-identity per δ flavor and mode, the bit-packed
+format's round-trip properties, the flags-off HLO contract, and the
+jit-cache non-poisoning regression (the PR 8/9 class).
+
+The heavyweight fused-vs-layered ring A/Bs deliberately reuse the
+flavor suites' oracle workloads (test_delta / test_delta_map / ...) so
+the comparison runs on genuinely diverged replicas, not synthetic
+fixtures."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from crdt_tpu.delta_opt import ackwin
+from crdt_tpu.faults import FaultPlan
+from crdt_tpu.models.orswot import BatchedOrswot
+from crdt_tpu.ops import wire_kernels as wk
+from crdt_tpu.parallel import (
+    make_mesh,
+    mesh_delta_gossip,
+    mesh_fold,
+    shard_orswot,
+    wire,
+)
+from crdt_tpu.utils.metrics import metrics
+
+from test_delta import _rand_states, _rows_equal, _tracking
+
+MEMBERS = ["a", "b", "c", "d"]
+
+
+def _trees_equal(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _dense_workload(seed, p=4):
+    rng = random.Random(seed)
+    states, applied = _rand_states(rng, 8, MEMBERS)
+    batched = BatchedOrswot.from_pure(states)
+    mesh = make_mesh(p, 8 // p)
+    sharded = shard_orswot(batched.state, mesh)
+    dirty, fctx = _tracking(batched, applied)
+    return mesh, sharded, dirty, fctx
+
+
+# ---- 1. wire-format round-trip properties ---------------------------------
+
+@pytest.mark.parametrize("n", [1, 31, 32, 33, 64, 65, 200])
+def test_bitmap_roundtrip(n):
+    """u32 bitmaps invert exactly at word boundaries ± 1 — the
+    presence/ack masks' wire form."""
+    rng = np.random.RandomState(n)
+    bits = jnp.array(rng.rand(n) > 0.5)
+    assert bool(jnp.all(wk.unpack_bits(wk.pack_bits(bits), n) == bits))
+
+
+@pytest.mark.parametrize("n", [1, 2, 7, 8, 64])
+def test_u16_pair_roundtrip(n):
+    """Half-split u16 pairs invert exactly for in-bound id lanes."""
+    rng = np.random.RandomState(n)
+    vals = jnp.array(rng.randint(0, 65536, (n,)), jnp.int32)
+    back = wk.unpack_u16_pairs(wk.pack_u16_pairs(vals), n, jnp.int32)
+    assert bool(jnp.all(back == vals))
+
+
+def test_watermark_encode_roundtrip_and_defer():
+    """Clock lanes reconstruct exactly against a NONZERO watermark —
+    including lanes BELOW it (the biased window's negative half) —
+    and a slot outside ±32 Ki defers instead of shipping garbage."""
+    a, c = 4, 6
+    spec = wk.WireLaneSpec(lc=2 * a, ctx_lo=a, ctx_hi=2 * a)
+    rng = np.random.RandomState(0)
+    base_row = np.array([50_000, 3, 70_000, 0], np.uint32)
+    rows = (base_row[None, :]
+            + rng.randint(0, 20, (c, a))).astype(np.uint32)
+    rows[1, 2] = 70_000 - 30_000   # below base but inside the window
+    rows[2, 0] = 5                 # 49 995 below base: OUTSIDE -> defer
+    rows[4, 1] = 3 + 40_000        # above base, outside -> defer
+    clocks = jnp.asarray(np.concatenate([rows, rows + 1], axis=-1))
+    base = jnp.asarray(np.tile(base_row, (c, 2)))
+    valid = jnp.ones((c,), bool)
+    out = wk.wire_pack(spec, clocks, base, valid, interpret=True)
+    dec = wk.wire_unpack(spec, out.words, base, out.keep, jnp.uint32)
+    # lanes below base (underflow-clamped to 0 vs base 50_000/70_000)
+    # are outside the window -> those slots defer; in-window slots
+    # round-trip bit-exactly.
+    kept = np.asarray(out.keep)
+    assert bool(np.any(kept)) and bool(np.any(np.asarray(out.defer)))
+    assert np.array_equal(
+        np.asarray(dec)[kept], np.asarray(clocks)[kept]
+    )
+    assert not np.any(np.asarray(dec)[~kept])
+
+
+def test_kernel_checksum_equals_integrity_leaf_sum():
+    """The kernel's in-pass checksum partial is bit-equal to
+    ``integrity._lanes_u32``'s position-weighted sum of the shipped
+    leaf — the parity ``wire_checksum`` chains on."""
+    spec = wk.WireLaneSpec(lc=4)
+    rng = np.random.RandomState(3)
+    clocks = jnp.asarray(rng.randint(0, 100, (5, 4)), jnp.uint32)
+    out = wk.wire_pack(
+        spec, clocks, jnp.zeros_like(clocks), jnp.ones((5,), bool),
+        interpret=True,
+    )
+    assert int(out.chk) == int(wk.leaf_checksum(out.words))
+    assert int(out.nnz) == int(np.count_nonzero(np.asarray(out.words)))
+
+
+def test_wire_static_checks_clean_and_twins_fire():
+    """The ``wire`` static-check section: clean on the shipped codec,
+    and both committed broken twins (the in-kernel wider gate, the
+    bitmap truncator) fire their detectors."""
+    from crdt_tpu.analysis import fixtures
+    from crdt_tpu.parallel import wire_checks
+
+    assert wire_checks.static_checks() == []
+    broken = wire_checks.check_fused_gate(
+        know_fn=fixtures.fused_mask_drops_removals
+    )
+    assert any(f.check == "wire-removal-dropped" for f in broken)
+    broken = wire_checks.check_bitmaps(
+        packer=fixtures.bitmap_truncates_lanes
+    )
+    assert any(f.check == "wire-bitmap-truncated" for f in broken)
+
+
+# ---- 2. fused == layered ring bit-identity (dense, every mode) ------------
+
+@pytest.mark.parametrize("pipeline", [True, False])
+@pytest.mark.parametrize(
+    "mode", ["plain", "faults", "acked", "faults_acked"]
+)
+def test_fused_ring_bit_identical_dense(pipeline, mode):
+    """The acceptance quad on the dense flavor: fused and layered
+    rings land bit-identical converged states (and residue) under
+    pipeline on/off × faults on/off × ack-window on/off."""
+    mesh, sharded, dirty, fctx = _dense_workload(11)
+    kw = {}
+    if "faults" in mode:
+        kw["faults"] = FaultPlan(seed=5, drop=0.15, corrupt=0.1,
+                                 delay=0.1)
+    if "acked" in mode:
+        kw["ack_window"] = True
+    outs = [
+        mesh_delta_gossip(
+            sharded, dirty, fctx, mesh, rounds=14, cap=64,
+            local_fold="tree", pipeline=pipeline, fused=fused, **kw
+        )
+        for fused in (False, True)
+    ]
+    assert _trees_equal(outs[0][0], outs[1][0])
+    assert int(outs[0][3]) == int(outs[1][3])
+    if "faults" in mode:
+        fc0, fc1 = outs[0][-1], outs[1][-1]
+        assert int(fc0.packets_dropped) == int(fc1.packets_dropped)
+        assert int(fc0.packets_rejected) == int(fc1.packets_rejected)
+        assert int(fc0.packets_delayed) == int(fc1.packets_delayed)
+    if mode == "plain" and pipeline:
+        folded, _ = mesh_fold(sharded, mesh)
+        _rows_equal(outs[1][0], folded)
+
+
+def test_fused_wire_bytes_below_layered():
+    """The byte story, in one place: the packed wire's static bytes
+    (``bytes_exchanged``) drop well below the layered wire's, and the
+    dynamic packed count (``wire_packed_bytes``) sits below PR 9's
+    acked-useful bytes — the ISSUE 14 acceptance relation."""
+    mesh, sharded, dirty, fctx = _dense_workload(13)
+    t0 = mesh_delta_gossip(
+        sharded, dirty, fctx, mesh, rounds=14, cap=64,
+        local_fold="tree", telemetry=True, ack_window=True, fused=False,
+    )[4]
+    t1 = mesh_delta_gossip(
+        sharded, dirty, fctx, mesh, rounds=14, cap=64,
+        local_fold="tree", telemetry=True, ack_window=True, fused=True,
+    )[4]
+    assert float(t1.bytes_exchanged) < 0.7 * float(t0.bytes_exchanged)
+    assert 0 < float(t1.wire_packed_bytes) < float(t0.bytes_useful)
+    assert sum(int(c) for c in t1.hist_packed_bytes.counts) > 0
+    # Layered runs report no packed bytes — the field is fused-only.
+    assert float(t0.wire_packed_bytes) == 0.0
+
+
+def test_fused_registry_twins_recorded():
+    """``wire.packed_bytes[.kind]`` drains from the telemetry pytree
+    on a concrete fused run (the PR 2 registry-twin discipline)."""
+    mesh, sharded, dirty, fctx = _dense_workload(5)
+    before = metrics.snapshot()["counters"].get("wire.packed_bytes", 0)
+    _, _, _, _, t = mesh_delta_gossip(
+        sharded, dirty, fctx, mesh, rounds=10, cap=64,
+        local_fold="tree", telemetry=True,
+    )
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("wire.packed_bytes", 0) - before == int(
+        float(t.wire_packed_bytes)
+    )
+    assert "wire.packed_bytes.delta_gossip" in counters
+    assert counters.get("wire.fused_runs", 0) >= 1
+
+
+# ---- 3. flags-off HLO contract + cache non-poisoning ----------------------
+
+def test_fused_flag_hlo_contract():
+    """``fused=True`` IS the default program; ``fused=False`` lowers a
+    DIFFERENT (legacy) one. The full all-flags-off reconstruction pin
+    — fused=False + pipeline=False + digest=False == the hand-built
+    pre-flag sequential ring — lives in tests/test_zero_copy_ring.py;
+    this pins the flag wiring itself."""
+    mesh, sharded, dirty, fctx = _dense_workload(2)
+
+    def low(**kw):
+        return jax.jit(
+            lambda s, d, f: mesh_delta_gossip(
+                s, d, f, mesh, rounds=3, cap=8, local_fold="tree", **kw
+            )
+        ).lower(sharded, dirty, fctx).as_text()
+
+    default_txt = low()
+    assert low(fused=True) == default_txt
+    assert low(fused=False) != default_txt
+
+
+def test_fused_off_run_does_not_poison_flags_off_lookup():
+    """Regression (the PR 8/9 jit-cache poisoning class): a
+    fused=False run memoises the LEGACY program under the same (kind,
+    donation, mesh) key family; ``analysis._cached_entry_fn`` must
+    keep returning the default (fused) program the
+    aliasing/cost/lint gates read — WireKey rides the cache key and
+    is skipped like FaultPlan / AckWindowKey."""
+    from crdt_tpu.analysis.jit_lint import _cached_entry_fn
+    from crdt_tpu.analysis.registry import entry_points
+
+    mesh = make_mesh(4, 2)
+    ep = next(
+        e for e in entry_points(donatable=True)
+        if e.kind == "delta_gossip"
+    )
+    ep.invoke(mesh, ep.make_args(mesh))  # default (fused) program
+    fn_before = _cached_entry_fn(ep.kind, ep.n_donated, mesh)
+    assert fn_before is not None
+    s, d, f = ep.make_args(mesh)
+    mesh_delta_gossip(
+        s, d, f, mesh, local_fold="tree", donate=True, fused=False
+    )  # legacy program cached LAST under the same key family
+    fn_after = _cached_entry_fn(ep.kind, ep.n_donated, mesh)
+    assert fn_after is fn_before  # the WireKey entry was skipped
+
+
+def test_elastic_wrapper_forwards_fused():
+    """delta_gossip_elastic threads fused= into every attempt;
+    converged rows stay bit-identical either way."""
+    from crdt_tpu.parallel.delta_ring import delta_gossip_elastic
+
+    rng = random.Random(23)
+    states, applied = _rand_states(rng, 8, MEMBERS)
+    mesh = make_mesh(4, 2)
+    b0 = BatchedOrswot.from_pure(states)
+    dirty, fctx = _tracking(b0, applied)
+    out0 = delta_gossip_elastic(
+        b0, dirty, fctx, mesh, rounds=12, cap=64, fused=False
+    )
+    b1 = BatchedOrswot.from_pure(states)
+    out1 = delta_gossip_elastic(b1, dirty, fctx, mesh, rounds=12, cap=64)
+    assert _trees_equal(out0[0], out1[0])
+    assert out0[4] == out1[4] == {}
+
+
+# ---- 4. fused == layered for the composed flavors -------------------------
+
+def test_fused_ring_bit_identical_map():
+    """The map flavor (slot-table packets: clk/wctr watermark lanes,
+    wact id lanes, val raw lanes, child.valid content bools)."""
+    import test_delta_map as tdm
+    from crdt_tpu.models import BatchedMap
+    from crdt_tpu.parallel import mesh_delta_gossip_map, shard_map_state
+
+    rng = random.Random(4)
+    sites, applied = tdm._site_run(rng)
+    batched = BatchedMap.from_pure(sites, **tdm._interners())
+    dirty, fctx = tdm._tracking(batched, applied)
+    mesh = make_mesh(4, 2)
+    sharded = shard_map_state(batched.state, mesh)
+    outs = [
+        mesh_delta_gossip_map(
+            sharded, dirty, fctx, mesh, rounds=14, cap=64, fused=fused
+        )
+        for fused in (False, True)
+    ]
+    assert _trees_equal(outs[0][0], outs[1][0])
+    assert int(outs[0][3]) == int(outs[1][3])
+
+
+def test_fused_ring_bit_identical_map_orswot():
+    """The nested Map<K, Orswot> flavor (wrapper packet: core dense
+    lanes + the outer parked keyset buffer on the parked wire)."""
+    import test_delta_map_orswot as tmo
+    from crdt_tpu.models import BatchedMapOrswot
+    from crdt_tpu.parallel import (
+        mesh_delta_gossip_map_orswot,
+        shard_map_orswot,
+    )
+
+    rng = random.Random(6)
+    sites, applied = tmo._site_run(rng)
+    batched = BatchedMapOrswot.from_pure(sites, **tmo._interners())
+    dirty, fctx = tmo._tracking(batched, applied)
+    mesh = make_mesh(4, 2)
+    sharded = shard_map_orswot(batched.state, mesh)
+    outs = [
+        mesh_delta_gossip_map_orswot(
+            sharded, dirty, fctx, mesh, rounds=14, cap=64, fused=fused
+        )
+        for fused in (False, True)
+    ]
+    assert _trees_equal(outs[0][0], outs[1][0])
+    assert int(outs[0][3]) == int(outs[1][3])
+
+
+@pytest.mark.slow
+def test_fused_ring_bit_identical_map3():
+    """The depth-3 flavor (two wrapper levels' parked buffers on the
+    concatenated parked wire). Slow tier; the map_orswot A/B above is
+    its in-tier cousin (same wrapper machinery, one level less)."""
+    import test_delta_map3 as tm3
+    from crdt_tpu.models import BatchedMap3
+    from crdt_tpu.parallel import mesh_delta_gossip_map3, shard_map3
+
+    rng = random.Random(8)
+    sites, applied = tm3._site_run(rng)
+    batched = BatchedMap3.from_pure(sites, **tm3._interners())
+    dirty, fctx = tm3._tracking(batched, applied)
+    mesh = make_mesh(4, 2)
+    sharded = shard_map3(batched.state, mesh)
+    outs = [
+        mesh_delta_gossip_map3(
+            sharded, dirty, fctx, mesh, rounds=14, cap=64, fused=fused
+        )
+        for fused in (False, True)
+    ]
+    assert _trees_equal(outs[0][0], outs[1][0])
+    assert int(outs[0][3]) == int(outs[1][3])
+
+
+# ---- 5. ack-mirror lockstep (the watermark's other half) ------------------
+
+def test_mirror_matches_window_ctx():
+    """The receiver-side mirror promotion reproduces the sender's
+    window ctx plane bit-exactly from knowledge the receiver holds
+    (the decode-base lockstep wire.py documents)."""
+    rng = np.random.RandomState(1)
+    C, A, D, E = 5, 4, 3, 8
+    from crdt_tpu.parallel.delta import DeltaPacket
+
+    def mk():
+        rows = rng.randint(0, 6, (C, A)).astype(np.uint32)
+        return DeltaPacket(
+            idx=jnp.array(rng.choice(E, C, replace=False), jnp.int32),
+            rows=jnp.array(rows),
+            ctxs=jnp.array(rows + rng.randint(0, 2, (C, A)).astype(
+                np.uint32)),
+            valid=jnp.array(rng.rand(C) > 0.3),
+            dcl=jnp.zeros((D, A), jnp.uint32),
+            dmask=jnp.zeros((D, E), bool),
+            dvalid=jnp.zeros((D,), bool),
+        )
+
+    win = ackwin.init_window(jax.eval_shape(mk), E)
+    mctx = jnp.zeros((E, A), jnp.uint32)
+    for _ in range(4):
+        pkt = mk()
+        bits = ackwin.ack_bits(pkt)
+        win = ackwin.update_window(win, pkt, bits)
+        mctx = wire.mirror_promote(mctx, pkt, bits, jnp.ones((), bool))
+    assert np.array_equal(np.asarray(mctx), np.asarray(win.ctx))

@@ -126,7 +126,7 @@ def test_flags_off_hlo_identical_to_sequential_ring():
     entry_txt = jax.jit(
         lambda s, d, f: mesh_delta_gossip(
             s, d, f, mesh, rounds=rounds, cap=cap, local_fold="tree",
-            pipeline=False, digest=False,
+            pipeline=False, digest=False, fused=False,
         )
     ).lower(sharded, dirty, fctx).as_text()
     assert entry_txt == baseline_txt

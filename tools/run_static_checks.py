@@ -46,6 +46,16 @@ Sections (each timed, each independently skippable):
   covered by the join of the others — analysis/laws.py), and the
   broken-twin detectors (the lossy and non-irredundant fixtures must
   each fire their law).
+- ``wire``     — the fused δ wire gates
+  (crdt_tpu.parallel.wire_checks): wire-surface registry coverage
+  (every δ ring kind must have a registered codec know function —
+  crdt_tpu.analysis.registry.register_wire_surface), the fused-gate
+  removal-preservation detector on the committed three-slot fixture
+  (the PR 3 wider-gate unsoundness rebuilt IN-KERNEL by
+  ``analysis.fixtures.fused_mask_drops_removals`` must fire it), and
+  the wire round-trip + checksum-parity + bitmap detectors (the
+  word-dropping ``fixtures.bitmap_truncates_lanes`` twin must fire
+  the truncation gate).
 - ``obs``      — the observability-plane gates
   (crdt_tpu.obs.static_checks): flight-recorder event-type coverage
   (every literal ``emit("...")`` site under ``crdt_tpu/`` must have a
@@ -115,7 +125,8 @@ sys.path.insert(0, ROOT)
 
 SECTIONS = (
     "lint", "schema", "laws", "schedules", "faults", "decomp",
-    "durability", "scaleout", "obs", "jit-lint", "cost", "aliasing",
+    "durability", "scaleout", "obs", "wire", "jit-lint", "cost",
+    "aliasing",
 )
 
 # Directories the fallback linter walks (ruff takes its own config).
@@ -287,6 +298,12 @@ def run_obs():
     return static_checks()
 
 
+def run_wire():
+    from crdt_tpu.parallel.wire_checks import static_checks
+
+    return static_checks()
+
+
 def run_jit_lint():
     from crdt_tpu.analysis.jit_lint import check_gates, lint_entry_points
 
@@ -324,6 +341,7 @@ RUNNERS = {
     "durability": run_durability,
     "scaleout": run_scaleout,
     "obs": run_obs,
+    "wire": run_wire,
     "jit-lint": run_jit_lint,
     "cost": run_cost,
     "aliasing": run_aliasing,
@@ -331,7 +349,7 @@ RUNNERS = {
 
 _JAX_SECTIONS = (
     "laws", "schedules", "faults", "decomp", "durability", "scaleout",
-    "obs", "jit-lint", "cost", "aliasing",
+    "obs", "wire", "jit-lint", "cost", "aliasing",
 )
 
 

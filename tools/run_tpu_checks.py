@@ -166,6 +166,24 @@ def main() -> int:
             f"({flight_report['events']} events, dispatch p99 "
             f"{chaos.get('dispatch_p99_us', 0):,.0f} us)"
         )
+        # The chaos leg now runs over the FUSED wire (PR 14); the leg
+        # itself replays the soak on the layered oracle and asserts
+        # the degraded states bit-identical — a record that reports
+        # otherwise (or that silently fell back to the layered path)
+        # is a failed check on real hardware too.
+        if not (chaos.get("fused") and chaos.get(
+            "fused_vs_layered_identical"
+        ) and chaos.get("bit_identical")):
+            print("FAIL: chaos leg not fused-bit-identical "
+                  f"(fused={chaos.get('fused')}, vs_layered="
+                  f"{chaos.get('fused_vs_layered_identical')}, healed="
+                  f"{chaos.get('bit_identical')})")
+            return 1
+        print(
+            "chaos fused wire bit-identical     "
+            f"(packed {chaos.get('wire_packed_bytes_total', 0):,.0f} B "
+            "on the wire)"
+        )
 
     # THE flagship: 10,240 replicas x 1M elements streamed through the
     # mesh (parallel/stream.py), shape replayed VERBATIM from the
