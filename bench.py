@@ -1529,6 +1529,236 @@ def bench_scaleout():
     }]
 
 
+def bench_serve():
+    """Multi-tenant serving front door leg (``--serve`` runs it alone;
+    ISSUE 15's acceptance gate, ROADMAP item 1): a ≥1M-tenant live
+    population served from a 4×-smaller device-resident lane pool
+    through the tenant-packed superblock —
+
+    1. **churn window, timed** — cycles of per-tenant op streams (a
+       rotating hot set + a uniform tail over the whole population)
+       through the ingest queue's coalesced slab applies
+       (``mesh_serve_apply``), per-dispatch wall-clock riding
+       ``hist_dispatch_us`` (the p99 apply latency of record).
+    2. **evict→restore inside the window** — mid-window, a cohort of
+       the coldest dirty tenants moves to the PR 10 snapshot tier
+       (persist-then-clear, lanes freed), then a re-touch slice of the
+       cohort restores from disk on its next op — the cold-tenant
+       cycle the acceptance gate demands.
+    3. **oracle bit-identity** — a sampled subset of touched tenants
+       (re-touched evictees included) replays its FULL op history
+       through the per-tenant sequential oracle and must match the
+       served row bit-exactly.
+
+    The SAME committed shape runs on the CPU stand-in mesh — the gate
+    is ≥1M live tenants THERE, so there is no cpu_fallback downscale.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from crdt_tpu import telemetry as tele
+    from crdt_tpu.obs import hist as obs_hist
+    from crdt_tpu.ops import superblock as sb_ops
+    from crdt_tpu.parallel import make_mesh
+    from crdt_tpu.serve import Evictor, IngestQueue, Superblock
+
+    cfg = bench_configs()["serve"]
+
+    def knob(key, env):
+        return int(os.environ.get(env, cfg[key]))
+
+    tenants = knob("tenants", "BENCH_SERVE_TENANTS")
+    lanes = knob("lanes", "BENCH_SERVE_LANES")
+    slab_lanes = knob("slab_lanes", "BENCH_SERVE_SLAB_LANES")
+    slab_depth = knob("slab_depth", "BENCH_SERVE_SLAB_DEPTH")
+    cycles = knob("cycles", "BENCH_SERVE_CYCLES")
+    ops_per_cycle = knob("ops_per_cycle", "BENCH_SERVE_OPS_PER_CYCLE")
+    hot_set = knob("hot_set", "BENCH_SERVE_HOT_SET")
+    hot_shift = cfg["hot_shift"]
+    evict_cohort = knob("evict_cohort", "BENCH_SERVE_EVICT_COHORT")
+    retouch = cfg["retouch"]
+    oracle_sample = cfg["oracle_sample"]
+    p = min(cfg["mesh"][0], len(jax.devices()))
+    mesh = make_mesh(p, 1)
+    caps = dict(
+        n_elems=cfg["elems"], n_actors=cfg["actors"],
+        deferred_cap=cfg["deferred_cap"],
+    )
+    e, a = caps["n_elems"], caps["n_actors"]
+
+    sb = Superblock(tenants, mesh, kind="orswot", caps=caps, n_lanes=lanes)
+    root = tempfile.mkdtemp(prefix="bench-serve-")
+    ev = Evictor(sb, root, pressure_batch=256)
+    q = IngestQueue(
+        sb, lanes=slab_lanes, depth=slab_depth, max_pending=1 << 20,
+        evictor=ev,
+    )
+    rng = np.random.default_rng(151)
+    next_ctr = np.zeros(tenants, np.uint32)
+    history: dict = {}  # tenant -> [(kind, actor, ctr, clock, member)]
+
+    def submit_cycle(cycle, n_ops):
+        off = (cycle * hot_shift) % max(tenants - hot_set, 1)
+        hot = rng.integers(off, off + hot_set, n_ops)
+        uni = rng.integers(0, tenants, n_ops)
+        ts = np.where(rng.random(n_ops) < 0.85, hot, uni)
+        is_add = rng.random(n_ops) < 0.85
+        masks = rng.random((n_ops, e)) < 0.4
+        for i in range(n_ops):
+            t = int(ts[i])
+            act = t % a
+            m = masks[i]
+            if is_add[i] or next_ctr[t] == 0:
+                c = int(next_ctr[t]) + 1
+                next_ctr[t] = c
+                q.add(t, act, c, m)
+                history.setdefault(t, []).append(
+                    (sb_ops.ADD, act, c, None, m)
+                )
+            else:
+                # Covered remove (clock at the tenant's applied top):
+                # kills dots now, parks nothing — the serving steady
+                # state never trips the deferred bound.
+                clock = np.zeros(a, np.uint32)
+                clock[act] = next_ctr[t]
+                q.rm(t, clock, m)
+                history.setdefault(t, []).append(
+                    (sb_ops.RM, 0, 0, clock, m)
+                )
+        return n_ops
+
+    rec, prev_rec, snap_base = _flight_start()
+    try:
+        # Warmup (compiles the apply + telemetry programs; its ops are
+        # real and stay in the oracle histories — only the TIMING is
+        # excluded from the measured window).
+        submit_cycle(0, 256)
+        q.drain(telemetry=True)
+
+        tel = None
+        total_ops = 0
+        n_evicted = 0
+        restored_in_window = 0
+        retouch_set = []
+        t0 = time.perf_counter()
+        for cycle in range(1, cycles + 1):
+            submit_cycle(cycle, ops_per_cycle)
+            rep, t = q.drain(telemetry=True)
+            total_ops += rep.ops_applied
+            if t is not None:
+                tel = t if tel is None else tele.combine(tel, t)
+                tele.record("serve", t)
+            if cycle == cycles // 2:
+                # The cold-tenant cycle, inside the measured window:
+                # evict the coldest dirty cohort, then re-touch a slice
+                # so it restores from disk on its next op.
+                cold = ev.select_cold(evict_cohort)
+                n_evicted = ev.evict(cold)
+                retouch_set = cold[:retouch]
+                for t_ in retouch_set:
+                    act = t_ % a
+                    c = int(next_ctr[t_]) + 1
+                    next_ctr[t_] = c
+                    m = rng.random(e) < 0.4
+                    q.add(t_, act, c, m)
+                    history.setdefault(t_, []).append(
+                        (sb_ops.ADD, act, c, None, m)
+                    )
+                rep2, t2 = q.drain(telemetry=True)
+                total_ops += rep2.ops_applied
+                restored_in_window = rep2.restored
+                if t2 is not None:
+                    tel = tele.combine(tel, t2)
+                    tele.record("serve", t2)
+        window_s = time.perf_counter() - t0
+        d = tele.to_dict(tel)
+        disp = obs_hist.summary(d["hist_dispatch_us"])
+        # The flight artifact covers the MEASURED window: finish (and
+        # bit-exact-cross-check) it before the oracle phase, whose
+        # verification restores page cold tenants in bulk and would
+        # roll the ring past the window's telemetry events.
+        flight = _flight_finish("serve", rec, prev_rec, snap_base)
+
+        # Oracle bit-identity on a sampled subset (re-touched evictees
+        # first — they crossed the durable tier inside the window).
+        touched = np.asarray(sorted(history))
+        sample = list(retouch_set[: oracle_sample // 3])
+        rest = rng.choice(
+            touched, min(oracle_sample - len(sample), len(touched)),
+            replace=False,
+        )
+        sample += [int(x) for x in rest if int(x) not in set(sample)]
+        tk = sb.tk
+        mismatches = 0
+        for t_ in sample:
+            ev.restore(t_)
+            want = sb_ops.sequential_oracle(
+                tk, tk.empty(**sb.caps), history[t_]
+            )
+            got = sb.row(t_)
+            if not all(
+                bool(np.array_equal(np.asarray(x), np.asarray(y)))
+                for x, y in zip(
+                    jax.tree.leaves(got), jax.tree.leaves(want)
+                )
+            ):
+                mismatches += 1
+        bit_identical = mismatches == 0
+        assert bit_identical, (
+            f"{mismatches}/{len(sample)} sampled tenants diverged from "
+            f"the per-tenant sequential oracle"
+        )
+        assert tenants >= 1_000_000, (
+            f"serve leg ran only {tenants} tenants — the gate is 1M+"
+        )
+        assert n_evicted >= 1 and restored_in_window >= 1, (
+            "no cold-tenant evict→restore cycle in the measured window"
+        )
+    except BaseException:
+        from crdt_tpu import obs as _obs
+
+        _obs.install(prev_rec)
+        raise
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    ratio = lanes / tenants
+    log(
+        f"config-serve: {tenants:,} live tenants on {lanes:,} lanes "
+        f"({ratio:.0%} resident, {sb.nbytes() / 1e6:.0f} MB superblock): "
+        f"{total_ops:,} ops in {window_s:.2f}s = "
+        f"{total_ops / window_s:,.0f} ops/s sustained; dispatch p50 "
+        f"{disp['p50']:,.0f} us / p99 {disp['p99']:,.0f} us; evicted "
+        f"{n_evicted} cold tenants, {restored_in_window} restored from "
+        f"disk in-window; {len(sample)} tenants oracle-checked "
+        f"bit-identical; coalesced {d['ingest_coalesced_ops']:,} ops"
+    )
+    return [{
+        "config": "serve", "metric": "serve_ops_per_sec",
+        "value": round(total_ops / window_s, 1), "unit": "ops/s",
+        "tenants": tenants, "lanes": lanes,
+        "live_tenants": d["live_tenants"],
+        "evicted_tenants": d["evicted_tenants"],
+        "dispatch_p50_us": round(disp["p50"], 1),
+        "dispatch_p99_us": round(disp["p99"], 1),
+        "ops_applied": total_ops,
+        "window_seconds": round(window_s, 3),
+        "ingest_coalesced_ops": d["ingest_coalesced_ops"],
+        "peak_resident_bytes": sb.nbytes(),
+        "all_resident_equiv_bytes": sb.row_nbytes() * tenants,
+        "resident_ratio": round(ratio, 4),
+        "evict_cohort": n_evicted,
+        "evict_restored_in_window": restored_in_window,
+        "widen_events": sb.widen_events,
+        "oracle_sampled": len(sample),
+        "bit_identical": bit_identical,
+        "shape": f"{tenants}x{e}x{a}@{lanes}lanes",
+        **flight,
+    }]
+
+
 def bench_cpu() -> float:
     from crdt_tpu.pure.orswot import Orswot
     from crdt_tpu.vclock import VClock
@@ -2362,6 +2592,15 @@ def parse_args(argv=None):
              "in both directions) and print its record to stdout",
     )
     ap.add_argument(
+        "--serve",
+        action="store_true",
+        help="run ONLY the multi-tenant serving leg (1M+ live tenants "
+             "with churn through the tenant-packed superblock: "
+             "sustained ops/s, p99 apply latency, cold-tenant "
+             "evict/restore, sequential-oracle bit-identity) and print "
+             "its record to stdout",
+    )
+    ap.add_argument(
         "--flagship",
         action="store_true",
         help="run ONLY the flagship replica-streaming leg (10,240 "
@@ -2392,6 +2631,26 @@ def main(argv=None):
         )
         log(json.dumps(rec))
         print(json.dumps(rec))
+        return
+    if args.serve:
+        # The fast serve-only mode: one leg, one stdout JSON line.
+        if os.environ.get("BENCH_PROBE", "1") != "0" and not tpu_reachable():
+            from crdt_tpu.utils.cpu_pin import pin_cpu
+
+            pin_cpu(virtual_devices=8)
+            os.environ["BENCH_CPU_FALLBACK"] = "1"
+        from crdt_tpu.telemetry import span
+
+        with span("bench.serve", quick=True):
+            recs = bench_serve()
+        for rec in recs:
+            rec["degraded"] = bool(
+                rec.get("degraded", False)
+                or os.environ.get("BENCH_CPU_FALLBACK") == "1"
+            )
+            log(json.dumps(rec))
+        print(json.dumps(recs[0] if recs else {"config": "serve",
+                                               "skipped": True}))
         return
     if args.scaleout:
         # The fast scaleout-only mode: one leg, one stdout JSON line.
@@ -2521,6 +2780,7 @@ def main(argv=None):
         ("heal", bench_heal),
         ("recovery", bench_recovery),
         ("scaleout", bench_scaleout),
+        ("serve", bench_serve),
     ]:
         if os.environ.get(f"BENCH_{name.upper()}", "1") != "0":
             try:
@@ -2668,6 +2928,19 @@ def main(argv=None):
                 "bootstrap_warm_ratio", "drain_residue",
                 "drain_lanes_unacked", "generation", "bit_identical",
             ) if k in sc
+        }
+    # The serve leg rides the headline record too: sustained ops/s and
+    # p99 apply latency at 1M+ live tenants (with the evict/restore
+    # cycle and the oracle gate) is ISSUE 15's metric of record.
+    sv = next((r for r in records if r.get("config") == "serve"), None)
+    if sv is not None:
+        headline["serve"] = {
+            k: sv[k] for k in (
+                "value", "tenants", "lanes", "dispatch_p50_us",
+                "dispatch_p99_us", "ingest_coalesced_ops",
+                "resident_ratio", "evict_cohort",
+                "evict_restored_in_window", "bit_identical",
+            ) if k in sv
         }
     # The flagship streaming record rides the headline too: it IS the
     # metric of record at the north-star shape (ROADMAP item 1) — the

@@ -489,6 +489,19 @@ def drain_ignores_unacked(kind, rank, rows, residue, counters=None, **kw):
     return _replace(cert, lanes_unacked=0)
 
 
+# ---- serving-tier twins (crdt_tpu/serve/) ---------------------------------
+
+def evictor_drops_dirt(evictor, tenants):
+    """Broken serving twin: an evictor that clears a tenant's device
+    lane WITHOUT persisting its dirty row first — the durable tier
+    keeps a stale record, so the next touch restores yesterday's cart.
+    Exactly the write-ordering bug (clear-before-commit) the
+    persist-THEN-clear discipline in ``serve.evict.Evictor`` exists to
+    prevent. ``serve.evictor_preserves_dirt`` must fail it (the
+    ``serve`` static-check section pins that the detector fires)."""
+    return evictor.evict(tenants, _persist_dirty=False)
+
+
 # ---- observability twins (crdt_tpu/obs/) ----------------------------------
 
 def recorder_drops_events(capacity: int = 8, **kwargs):

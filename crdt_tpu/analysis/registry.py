@@ -197,6 +197,21 @@ class ScaleoutSurface:
 
 
 @dataclass(frozen=True)
+class ServeSurface:
+    """One registered multi-tenant serving surface (crdt_tpu/serve/):
+    a public operational symbol of the serve package — the superblock
+    container, the ingest queue, the evictor, the tenant shard map,
+    their detectors. Registration is the coverage contract — the
+    ``serve`` static-check section (tools/run_static_checks.py, via
+    ``crdt_tpu.serve.static_checks``) fails discovery for any public
+    serve symbol that forgot to register, exactly like an unregistered
+    join, mesh entry point, or fault/scaleout surface."""
+
+    name: str
+    module: str = ""
+
+
+@dataclass(frozen=True)
 class WireSurface:
     """One registered fused-wire kernel instantiation
     (crdt_tpu/parallel/wire.py over crdt_tpu/ops/wire_kernels.py): a δ
@@ -257,6 +272,7 @@ _DECOMP: Dict[str, Decomposer] = {}
 _FAULT_SURFACES: Dict[str, FaultSurface] = {}
 _WIRE_SURFACES: Dict[str, WireSurface] = {}
 _SCALEOUT_SURFACES: Dict[str, ScaleoutSurface] = {}
+_SERVE_SURFACES: Dict[str, ServeSurface] = {}
 _OBS_EVENTS: Dict[str, ObsEvent] = {}
 
 # Public callables in crdt_tpu.parallel matching this are mesh entry
@@ -267,7 +283,9 @@ _OBS_EVENTS: Dict[str, ObsEvent] = {}
 # unregistered public mesh_stream symbol fails discovery exactly like a
 # forgotten gossip/fold entry — tools/run_static_checks.py's jit-lint
 # and aliasing sections both iterate this.
-ENTRY_NAME_RE = re.compile(r"^mesh_(gossip|fold|delta_gossip|stream)")
+# mesh_serve covers the tenant-packed serving dispatch family
+# (parallel/serve_apply.py — ISSUE 15).
+ENTRY_NAME_RE = re.compile(r"^mesh_(gossip|fold|delta_gossip|stream|serve)")
 
 
 def register_merge(
@@ -423,21 +441,21 @@ def scaleout_surfaces() -> Tuple[ScaleoutSurface, ...]:
     )
 
 
-def unregistered_scaleout_surfaces() -> List[str]:
-    """Public OPERATIONAL symbols of ``crdt_tpu.scaleout`` that never
-    called :func:`register_scaleout_surface` — the discovery gate of
-    the ``scaleout`` static-check section. Same two-level walk as the
-    entry-point/fault gates (package surface + every submodule's own
-    definitions), so a symbol that skipped the ``__init__`` re-export
-    list cannot hide. Pure data carriers are exempt: NamedTuple
-    reports, frozen dataclass certificates, and exception types are
-    results, not surfaces."""
+def _unregistered_package_surfaces(pkg_name: str, registered) -> List[str]:
+    """Public OPERATIONAL symbols of one package that never registered
+    — the shared discovery walk behind the scaleout AND serve surface
+    gates (one home, so the data-carrier exemption rules cannot
+    drift). Two levels, like the entry-point/fault gates: the package
+    surface plus every submodule's own definitions, so a symbol that
+    skipped the ``__init__`` re-export list cannot hide. Pure data
+    carriers are exempt: NamedTuple reports, frozen dataclass
+    certificates, and exception types are results, not surfaces."""
     import dataclasses
     import importlib
     import inspect
     import pkgutil
 
-    import crdt_tpu.scaleout as so
+    pkg = importlib.import_module(pkg_name)
 
     def is_surface(n: str, obj) -> bool:
         if n.startswith("_") or not callable(obj):
@@ -447,17 +465,49 @@ def unregistered_scaleout_surfaces() -> List[str]:
                 return False
             if hasattr(obj, "_fields") or dataclasses.is_dataclass(obj):
                 return False
-        return getattr(obj, "__module__", "").startswith("crdt_tpu.scaleout")
+        return getattr(obj, "__module__", "").startswith(pkg_name)
 
-    found = {n for n in dir(so) if is_surface(n, getattr(so, n))}
-    for info in pkgutil.iter_modules(so.__path__):
-        mod = importlib.import_module(f"crdt_tpu.scaleout.{info.name}")
+    found = {n for n in dir(pkg) if is_surface(n, getattr(pkg, n))}
+    for info in pkgutil.iter_modules(pkg.__path__):
+        mod = importlib.import_module(f"{pkg_name}.{info.name}")
         for n in dir(mod):
             obj = getattr(mod, n)
             if (is_surface(n, obj)
                     and getattr(obj, "__module__", "") == mod.__name__):
                 found.add(n)
-    return sorted(found - set(_SCALEOUT_SURFACES))
+    return sorted(found - set(registered))
+
+
+def unregistered_scaleout_surfaces() -> List[str]:
+    """Public operational ``crdt_tpu.scaleout`` symbols that never
+    called :func:`register_scaleout_surface` — the discovery gate of
+    the ``scaleout`` static-check section
+    (:func:`_unregistered_package_surfaces` is the walk)."""
+    return _unregistered_package_surfaces(
+        "crdt_tpu.scaleout", _SCALEOUT_SURFACES
+    )
+
+
+def register_serve_surface(name: str, *, module: str = "") -> ServeSurface:
+    sv = ServeSurface(name=name, module=module)
+    _SERVE_SURFACES[name] = sv
+    return sv
+
+
+def serve_surfaces() -> Tuple[ServeSurface, ...]:
+    import crdt_tpu.serve  # noqa: F401  (registrations import-time)
+
+    return tuple(_SERVE_SURFACES[k] for k in sorted(_SERVE_SURFACES))
+
+
+def unregistered_serve_surfaces() -> List[str]:
+    """Public operational ``crdt_tpu.serve`` symbols that never called
+    :func:`register_serve_surface` — the discovery gate of the
+    ``serve`` static-check section
+    (:func:`_unregistered_package_surfaces` is the walk)."""
+    return _unregistered_package_surfaces(
+        "crdt_tpu.serve", _SERVE_SURFACES
+    )
 
 
 def register_obs_event(

@@ -76,7 +76,12 @@ def _probe_states(n: int = 6):
 def _probe_workload(root: str, states) -> None:
     """The canonical micro-workload — crosses EVERY registered
     crashpoint when run uninterrupted: tiny segments force WAL
-    rotation, retain=1 with repeated saves forces pruning."""
+    rotation, retain=1 with repeated saves forces pruning, and one
+    serving-tier tenant persist/restore crosses the ``serve.evict.*``
+    / ``serve.restore.*`` boundaries (crdt_tpu/serve/evict.py — the
+    evict write-ordering the fuzz loop must be able to kill inside).
+    The serve tail never touches the main wal/snap dirs, so
+    ``_probe_recover``'s last-durable-record contract is unchanged."""
     import os
 
     import jax
@@ -96,6 +101,10 @@ def _probe_workload(root: str, states) -> None:
                 sdir, "probe", s, wal_seq=w.last_seq, retain=1,
             )
     w.close()
+    from ..serve.evict import persist_tenant, restore_tenant
+
+    persist_tenant(os.path.join(root, "serve"), "probe", 0, states[-1])
+    restore_tenant(os.path.join(root, "serve"), "probe", 0, states[0])
 
 
 def _probe_recover(root: str, states):

@@ -109,6 +109,7 @@ from .stream import (
     mesh_stream_fold_sparse_sharded,
 )
 from .delta_ring import delta_gossip_elastic
+from .serve_apply import mesh_serve_apply
 from .delta import (
     DeltaPacket,
     apply_delta,
@@ -151,6 +152,7 @@ __all__ = [
     "mesh_stream_fold_sparse",
     "mesh_stream_fold_sparse_mvmap",
     "mesh_stream_fold_sparse_sharded",
+    "mesh_serve_apply",
     "DeltaPacket",
     "apply_delta",
     "dirty_between",

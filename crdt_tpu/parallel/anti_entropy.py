@@ -141,6 +141,12 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         # The packed-wire fields are zero unless the δ ring's fused=
         # path fills them in (delta_ring's _replace).
         wire_packed_bytes=jnp.zeros((), jnp.float32),
+        # The serving-tier fields are filled host-side by the serve
+        # layer (crdt_tpu/serve/ Superblock.annotate /
+        # IngestQueue.annotate) — never in-kernel.
+        live_tenants=jnp.zeros((), jnp.uint32),
+        evicted_tenants=jnp.zeros((), jnp.uint32),
+        ingest_coalesced_ops=jnp.zeros((), jnp.uint32),
         # The in-kernel histograms are zero unless the δ ring's loop
         # carry fills them in (delta_ring's _replace);
         # hist_dispatch_us is filled host-side (telemetry.time_dispatch
@@ -150,6 +156,7 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         hist_ack_depth=_hist.zeros(),
         hist_packed_bytes=_hist.zeros(),
         hist_dispatch_us=_hist.zeros(),
+        hist_ingest_batch=_hist.zeros(),
     )
 
 

@@ -143,7 +143,12 @@ def _allgather_host(arr: np.ndarray, retry=None):
         maxlen = int(lens.max())
         padded = np.zeros((maxlen, *arr.shape[1:]), arr.dtype)
         padded[: arr.shape[0]] = arr
-        gathered = multihost_utils.process_allgather(padded)
+        gathered = np.asarray(multihost_utils.process_allgather(padded))
+        if gathered.ndim == padded.ndim:
+            # Single-process process_allgather returns the input WITHOUT
+            # the leading process axis (jax shape quirk) — normalize so
+            # the degenerate serve-tier self-gather slices correctly.
+            gathered = gathered[None]
         return [gathered[p, : int(lens[p])] for p in range(len(lens))]
 
     if retry is None:
@@ -153,6 +158,64 @@ def _allgather_host(arr: np.ndarray, retry=None):
     return with_retries(
         once, retry, op="allgather_host", last_good=arr
     )
+
+
+def sync_tenant_rows(wire: dict, retry=None):
+    """All-gather per-host serving-tier wire dicts (uniform string
+    field names, numpy array values — the tenant-shard anti-entropy
+    exchange of crdt_tpu/serve/shard.py: each host exports packed
+    tenant rows, every host receives every export and joins the rows
+    it OWNS). Returns the per-process list of wire dicts, this
+    process's own included.
+
+    ``retry=`` hardens the DCN gathers exactly like :func:`sync_list`
+    (idempotent gathers of immutable exports; symmetric-policy and
+    no-per-attempt-timeout caveats apply) — and because this is a
+    MULTI-collective exchange (one gather pair per field), each retried
+    attempt opens with the same attempt-number lockstep check, so a
+    one-sided transient failure surfaces as ``DcnExchangeFailed``
+    instead of mispairing field bytes."""
+    import jax
+
+    _refuse_timeout(retry, "sync_tenant_rows")
+    fields = sorted(wire)
+
+    def gather_all():
+        return {f: _allgather_host(np.asarray(wire[f])) for f in fields}
+
+    if retry is None:
+        gathered = gather_all()
+    else:
+        from ..faults.retry import DcnExchangeFailed, with_retries
+
+        attempt_box = {"n": 0}
+
+        def gather_all_guarded():
+            tag = _allgather_host(
+                np.asarray([attempt_box["n"]], np.int32)
+            )
+            attempt_box["n"] += 1
+            if len({int(t[0]) for t in tag}) != 1:
+                raise DcnExchangeFailed(
+                    "sync_tenant_rows", attempt_box["n"],
+                    RuntimeError(
+                        "attempt-number mismatch across processes — a "
+                        "one-sided retry desynced the collective "
+                        "sequence; re-enter sync_tenant_rows on every "
+                        "process"
+                    ),
+                    last_good=wire,
+                )
+            return gather_all()
+
+        gathered = with_retries(
+            gather_all_guarded, retry, op="sync_tenant_rows",
+            last_good=wire,
+        )
+    return [
+        {f: gathered[f][p] for f in fields}
+        for p in range(jax.process_count())
+    ]
 
 
 def sync_list(model, since: int = 0, retry=None) -> int:

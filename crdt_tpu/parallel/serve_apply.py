@@ -1,0 +1,176 @@
+"""``mesh_serve_apply`` — the tenant-packed serving dispatch (ISSUE 15).
+
+One jitted shard_map applies a whole coalesced :class:`OpSlab` to a
+tenant superblock: the tenant axis shards over the REPLICA mesh axis
+(tenants are independent — zero cross-tenant collectives), each device
+gathers its touched rows, runs the S-step vmapped op scan
+(ops/superblock.py), and scatters the rows back IN PLACE on the donated
+buffer (the PR 3 zero-copy discipline; ``tools/check_aliasing.py``
+covers this entry through the registry like every other donating one).
+
+Index convention: ``idx[B] int32`` carries LOCAL row indices — lane
+block ``[r·B/P, (r+1)·B/P)`` belongs to mesh rank ``r`` and its values
+index that rank's local tenant rows ``[0, T/P)``; ``-1`` lanes are
+empty (their slots are NOOP and their scatter drops). The host-side
+ingest queue (crdt_tpu/serve/ingest.py) owns this layout and the
+at-most-one-lane-per-tenant contract that makes the scatter
+conflict-free.
+
+``telemetry=`` follows the house rules: off traces the byte-identical
+flag-free program; on returns a :class:`~crdt_tpu.telemetry.Telemetry`
+sidecar (slots changed by the applied ops psum'd over the replica axis,
+slab wire bytes over all devices, deferred-depth / widen-pressure
+gauges over the TOUCHED rows — the serving-tier gauges
+``live_tenants`` / ``evicted_tenants`` / ``ingest_coalesced_ops`` /
+``hist_ingest_batch`` are filled host-side by the serve layer, the
+``stream_*``/``wal_*`` discipline).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import telemetry as tele
+from ..ops import superblock as sb_ops
+from .anti_entropy import _cached
+from .mesh import ELEMENT_AXIS, REPLICA_AXIS
+
+
+def _validate(state, slab: sb_ops.OpSlab, idx, p: int) -> None:
+    t = jax.tree.leaves(state)[0].shape[0]
+    b = slab.kind.shape[0]
+    if t % p:
+        raise ValueError(
+            f"{t} tenant rows do not divide the {p}-way replica axis"
+        )
+    if b % p or idx.shape[0] != b:
+        raise ValueError(
+            f"slab lanes ({b}) and idx ({idx.shape[0]}) must match and "
+            f"divide the {p}-way replica axis"
+        )
+
+
+def mesh_serve_apply(
+    state,
+    slab: sb_ops.OpSlab,
+    idx,
+    mesh: Mesh,
+    *,
+    kind: str = "orswot",
+    donate: bool = False,
+    telemetry: bool = False,
+):
+    """Apply one coalesced op slab to a tenant superblock, sharded over
+    the replica mesh axis. Returns ``(state, overflow[B])`` — or
+    ``(state, overflow, Telemetry)`` with ``telemetry=True``.
+    ``overflow`` flags tenants whose bounded buffers could not take an
+    op (deferred parking / sparse dot capacity): the serve layer's
+    widen-before-retry signal (crdt_tpu/serve/superblock.py)."""
+    tk = sb_ops.tenant_kind(kind)
+    p = mesh.shape[REPLICA_AXIS]
+    _validate(state, slab, idx, p)
+    idx = jnp.asarray(idx, jnp.int32)
+    slot_bytes = tele.shipped_bytes(slab) // max(
+        slab.kind.shape[0] * slab.kind.shape[1], 1
+    )
+
+    def build():
+        def body(state, slab, idx):
+            tl = jax.tree.leaves(state)[0].shape[0]
+            safe = jnp.clip(idx, 0, tl - 1)
+            rows = jax.tree.map(lambda x: x[safe], state)
+            new_rows, of = sb_ops.apply_slab_rows(tk, rows, slab)
+            valid = idx >= 0
+            scatter = jnp.where(valid, idx, tl)
+            out = jax.tree.map(
+                lambda x, r: x.at[scatter].set(r, mode="drop"),
+                state, new_rows,
+            )
+            of = of & valid
+            if not telemetry:
+                return out, of
+            both = (REPLICA_AXIS, ELEMENT_AXIS)
+            n_ops = jnp.sum(slab.kind != sb_ops.NOOP, dtype=jnp.float32)
+            tel = tele.zeros()._replace(
+                slots_changed=lax.psum(
+                    tk.changed(rows, new_rows), REPLICA_AXIS
+                ),
+                # The slab is the serving tier's wire: every device
+                # (element-axis copies included) physically receives
+                # its staged shard per dispatch.
+                bytes_exchanged=lax.psum(
+                    jnp.float32(tele.shipped_bytes(slab)), both
+                ),
+                bytes_useful=lax.psum(n_ops * slot_bytes, both),
+                deferred_depth=lax.pmax(tele.device_depth(new_rows), both),
+                widen_pressure=lax.pmax(
+                    tele.device_pressure(new_rows), both
+                ),
+            )
+            return out, of, tel
+
+        row_spec = P(REPLICA_AXIS)
+        out_state = jax.tree.map(lambda _: row_spec, state)
+        out_specs = (out_state, row_spec) + (
+            (tele.specs(),) if telemetry else ()
+        )
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(out_state, jax.tree.map(lambda _: row_spec, slab),
+                      row_spec),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+
+    fn = _cached(
+        "serve_apply", (state, slab, idx), mesh, build, kind, telemetry,
+        donate_argnums=(0,) if donate else (),
+    )
+    t0 = time.perf_counter()
+    out = fn(state, slab, idx)
+    if telemetry:
+        jax.block_until_ready(out)
+        state, of, tel = out
+        tel = tele.time_dispatch(tel, time.perf_counter() - t0)
+        return state, of, tel
+    return out
+
+
+# ---- static-analysis registration (crdt_tpu.analysis) --------------------
+
+def _example(mesh: Mesh, kind: str = "orswot"):
+    p = mesh.shape[REPLICA_AXIS]
+    caps = dict(n_elems=4, n_actors=2, deferred_cap=2)
+    tk = sb_ops.tenant_kind(kind)
+    t, b, s = p * 4, p * 2, 2
+    state = tk.empty(**caps, batch=(t,))
+    slab = sb_ops.empty_slab(tk, caps, b, s)
+    import numpy as np
+
+    idx = jnp.asarray(np.tile(np.arange(b // p, dtype=np.int32), p))
+    return state, slab, idx
+
+
+def _register() -> None:
+    from ..analysis.registry import register_entry_point
+
+    register_entry_point(
+        "mesh_serve_apply",
+        kind="serve_apply",
+        make_args=_example,
+        invoke=lambda mesh, args: mesh_serve_apply(
+            args[0], args[1], args[2], mesh, donate=True
+        ),
+        n_donated=1,
+    )
+
+
+_register()
+
+__all__ = ["mesh_serve_apply"]

@@ -1,0 +1,210 @@
+"""crdt_tpu.serve — the tenant-packed serving front door (ISSUE 15).
+
+Everything below this package batches replicas of ONE object; this
+package serves MILLIONS of independent small objects (per-user carts,
+presence sets, doc cursors) from one mesh — ROADMAP item 1. Four
+cooperating pieces (see each module's docstring):
+
+- :mod:`.superblock` — :class:`Superblock`: T independent tenant CRDTs
+  of a registered kind in ONE device-resident pytree (tenant axis
+  prepended, sharded over the replica mesh axis), with per-tenant
+  elastic capacity (overflow→widen→retry rolls back ONLY overflowed
+  tenants; ``Hysteresis.vote`` governs proactive widen/shrink) over
+  the ``mesh_serve_apply`` dispatch (parallel/serve_apply.py +
+  ops/superblock.py — one coalesced batch per dispatch).
+- :mod:`.ingest` — :class:`IngestQueue`: the host-side front door
+  coalescing per-tenant op streams into batched applies (the
+  ``models/list.py`` streamed-ingestion prototype generalized), with
+  loss-free bounded backpressure and per-tenant order preserved —
+  which is why the coalesced path is bit-identical to the per-tenant
+  sequential oracle.
+- :mod:`.evict` — :class:`Evictor`: cold tenants move to the PR 10
+  generational snapshot tier (persist-THEN-clear, crashpoint-
+  bracketed) and re-warm on next touch; :func:`recover_tenants` is the
+  tier's crash-recovery driver.
+- :mod:`.shard` — :class:`TenantShardMap` + :func:`sync_tenant_shards`:
+  per-host tenant shards by rendezvous hash (failover on membership
+  eviction remaps ONLY the dead host's tenants), DCN anti-entropy
+  under ``retry=`` joining handoff rows lattice-safely.
+
+Plus :func:`static_checks` — the ``serve`` section of
+tools/run_static_checks.py: surface-registry coverage, the
+coalesced==sequential micro A/B, the pack/unpack round-trip, the
+rendezvous minimal-remap property, and the broken-twin detector gate
+(the dirt-dropping evictor in ``analysis.fixtures`` must be caught).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .evict import (
+    Evictor,
+    evictor_preserves_dirt,
+    persist_tenant,
+    recover_tenants,
+    restore_tenant,
+    tenant_dir,
+)
+from .ingest import (
+    AddOp,
+    FlushReport,
+    IngestBackpressure,
+    IngestQueue,
+    RmOp,
+)
+from .shard import (
+    ShardSyncReport,
+    TenantShardMap,
+    export_rows,
+    ingest_rows,
+    sync_tenant_shards,
+)
+from .superblock import CapacityOverflow, Superblock
+
+
+def static_checks() -> List:
+    """The ``serve`` static-check section (Finding list, empty =
+    clean):
+
+    1. **surface coverage** — every public operational symbol of this
+       package must have called
+       ``analysis.registry.register_serve_surface`` (the
+       registration-is-the-coverage-contract rule).
+    2. **coalesced == sequential** — a micro ingest (two tenants, mixed
+       add/rm streams) through the coalesced slab apply must land
+       bit-identical to the per-tenant sequential oracle, and
+       pack/unpack must round-trip.
+    3. **rendezvous minimal remap** — failing over one host must remap
+       ONLY that host's tenants.
+    4. **broken twin fires** — the dirt-dropping evictor twin
+       (``analysis.fixtures.evictor_drops_dirt``) must FAIL
+       :func:`evictor_preserves_dirt`; the honest evictor must pass.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..analysis import fixtures
+    from ..analysis.registry import unregistered_serve_surfaces
+    from ..analysis.report import Finding
+    from ..ops import superblock as sb_ops
+    from ..parallel import make_mesh
+
+    findings: List[Finding] = []
+
+    for name in unregistered_serve_surfaces():
+        findings.append(Finding(
+            "serve-surface-coverage", name,
+            "public serve symbol never called register_serve_surface — "
+            "the serve gate cannot see it",
+        ))
+
+    # 2. coalesced == sequential micro A/B + pack/unpack round-trip.
+    try:
+        mesh = make_mesh(1, 1)
+        caps = dict(n_elems=4, n_actors=2, deferred_cap=2)
+        sb = Superblock(2, mesh, kind="orswot", caps=caps)
+        q = IngestQueue(sb, lanes=2, depth=4)
+        m = lambda *on: np.isin(np.arange(4), on)  # noqa: E731
+        streams = {
+            0: [(sb_ops.ADD, 0, 1, None, m(0, 1)),
+                (sb_ops.RM, 0, 0, np.asarray([1, 0], np.uint32), m(0)),
+                (sb_ops.ADD, 1, 1, None, m(2))],
+            1: [(sb_ops.ADD, 1, 1, None, m(3)),
+                (sb_ops.RM, 0, 0, np.asarray([0, 2], np.uint32), m(3))],
+        }
+        for t, ops_l in streams.items():
+            for k, actor, ctr, clock, member in ops_l:
+                if k == sb_ops.ADD:
+                    q.add(t, actor, ctr, member)
+                else:
+                    q.rm(t, clock, member)
+        q.drain()
+        tk = sb.tk
+        for t, ops_l in streams.items():
+            want = sb_ops.sequential_oracle(tk, tk.empty(**caps), ops_l)
+            got = sb_ops.unpack(sb.state, t)
+            if not all(
+                bool(jnp.array_equal(x, y))
+                for x, y in zip(jax.tree.leaves(got), jax.tree.leaves(want))
+            ):
+                findings.append(Finding(
+                    "serve-coalesce-oracle", f"tenant {t}",
+                    "coalesced ingest diverged from the per-tenant "
+                    "sequential oracle",
+                ))
+        rows = [sb_ops.unpack(sb.state, t) for t in (0, 1)]
+        rt = sb_ops.pack(rows)
+        if not all(
+            bool(jnp.array_equal(x, y))
+            for x, y in zip(
+                jax.tree.leaves(rt),
+                jax.tree.leaves(sb_ops.pack(
+                    [sb_ops.unpack(rt, 0), sb_ops.unpack(rt, 1)]
+                )),
+            )
+        ):
+            findings.append(Finding(
+                "serve-pack-roundtrip", "pack/unpack",
+                "pack(unpack) is not the identity",
+            ))
+    except Exception as exc:
+        findings.append(Finding(
+            "serve-coalesce-oracle", "micro-workload",
+            f"coalesced micro A/B crashed: {type(exc).__name__}: {exc}",
+        ))
+
+    # 3. rendezvous minimal remap.
+    sm = TenantShardMap(4)
+    before = {t: sm.owner(t) for t in range(64)}
+    sm.fail_over(2)
+    for t, h in before.items():
+        now = sm.owner(t)
+        if h != 2 and now != h:
+            findings.append(Finding(
+                "serve-shard-remap", f"tenant {t}",
+                f"failover of host 2 remapped tenant owned by host {h} "
+                f"to {now} — rendezvous minimality broken",
+            ))
+        if h == 2 and now == 2:
+            findings.append(Finding(
+                "serve-shard-remap", f"tenant {t}",
+                "failed-over host still owns a tenant",
+            ))
+
+    # 4. broken twin.
+    if not evictor_preserves_dirt(lambda ev, ts: ev.evict(ts)):
+        findings.append(Finding(
+            "evict-durability", "Evictor.evict",
+            "the honest evictor lost dirty tenant state across an "
+            "evict/restore cycle",
+        ))
+    if evictor_preserves_dirt(fixtures.evictor_drops_dirt):
+        findings.append(Finding(
+            "broken-fixture-missed", "evictor_drops_dirt",
+            "the dirt-dropping evictor twin PASSED the preservation "
+            "detector — the serve durability gate is not actually "
+            "firing",
+        ))
+    return findings
+
+
+from ..analysis.registry import register_serve_surface as _reg  # noqa: E402
+
+for _name in (
+    "Superblock", "IngestQueue", "Evictor", "TenantShardMap",
+    "evictor_preserves_dirt", "persist_tenant", "recover_tenants",
+    "restore_tenant", "tenant_dir", "export_rows", "ingest_rows",
+    "sync_tenant_shards", "static_checks",
+):
+    _reg(_name, module=__name__)
+
+__all__ = [
+    "AddOp", "CapacityOverflow", "Evictor", "FlushReport",
+    "IngestBackpressure", "IngestQueue", "RmOp", "ShardSyncReport",
+    "Superblock", "TenantShardMap", "evictor_preserves_dirt",
+    "export_rows", "ingest_rows", "persist_tenant", "recover_tenants",
+    "restore_tenant", "static_checks", "sync_tenant_shards",
+    "tenant_dir",
+]

@@ -1,0 +1,347 @@
+"""Cold-tenant eviction to the durable tier + restore-on-next-touch
+(ISSUE 15; the PR 10 snapshot machinery at tenant granularity).
+
+At 1M+ live sessions most tenants are COLD most of the time; their
+device lanes are working-set the hot tenants want. The
+:class:`Evictor` moves cold tenants to the PR 10 generational snapshot
+tier and re-warms them on their next touch:
+
+- **evict** — a dirty tenant's row is PERSISTED FIRST
+  (``durability.snapshot.save_state`` per tenant directory: atomic
+  payload→fsync→rename, manifest commit LAST, retain-K), then its lane
+  resets to the join identity. The order is the whole durability
+  argument: the lane clears only after the durable record commits, so
+  a kill anywhere in between recovers the tenant bit-identical to its
+  last durable record — the ``serve.evict.*`` crashpoints bracket
+  exactly these boundaries and ride the PR 10 fuzz loop
+  (tests/test_serve.py + the ``durability`` static-check section).
+- **restore** — the next touch loads the newest valid generation
+  (corrupt generations fall back — the PR 10 loader) back into the
+  lane. The ingest queue calls this automatically
+  (crdt_tpu/serve/ingest.py), making eviction invisible to
+  correctness.
+- **cold selection** — a recency clock over ``note_touch`` picks the
+  longest-untouched resident tenants (:meth:`select_cold`).
+- **recovery** — :func:`recover_tenants` is the serving tier's
+  recovery driver: every tenant directory under the root loads its
+  last durable record (tenants never persisted recover as ⊥).
+
+The detector :func:`evictor_preserves_dirt` is the serve section's
+broken-twin gate: an evictor that skips persisting dirty rows (the
+``analysis.fixtures.evictor_drops_dirt`` twin flips the
+``_persist_dirty`` seam) restores stale state and MUST fail it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..durability import crashpoints, snapshot
+from ..utils.metrics import metrics
+from .superblock import Superblock
+
+CP_PRE_PERSIST = crashpoints.register(
+    "serve.evict.pre_persist",
+    "about to persist an evicting tenant's row (nothing durable yet — "
+    "a kill here recovers the tenant's PREVIOUS durable record)",
+)
+CP_POST_PERSIST = crashpoints.register(
+    "serve.evict.post_persist_pre_clear",
+    "tenant row committed to the durable tier, device lane not yet "
+    "cleared (the mid-evict boundary: a kill here must recover the "
+    "just-committed record)",
+)
+CP_RESTORE = crashpoints.register(
+    "serve.restore.post_load",
+    "evicted tenant's durable record loaded, lane not yet re-warmed "
+    "(a kill here re-restores from the same record — restore is "
+    "idempotent)",
+)
+
+
+def tenant_dir(root: str, tenant: int) -> str:
+    """One tenant's snapshot directory (two-level fanout so a million
+    tenant dirs never share one directory listing)."""
+    return os.path.join(root, f"{tenant >> 10:05x}", f"t{tenant:08d}")
+
+
+def persist_tenant(root: str, kind: str, tenant: int, row, *,
+                   retain: int = 2) -> int:
+    """Commit one tenant's row to its durable directory (the
+    crashpoint-bracketed write path — shared by the evictor and the
+    durability static-check probe workload)."""
+    crashpoints.hit(CP_PRE_PERSIST)
+    gen = snapshot.save_state(
+        tenant_dir(root, tenant), kind, row, retain=retain,
+    )
+    crashpoints.hit(CP_POST_PERSIST)
+    return gen
+
+
+def restore_tenant(root: str, kind: str, tenant: int, template):
+    """One tenant's last durable record (⊥ template when the tenant
+    was never persisted). Crossed by restore-on-touch AND recovery."""
+    tdir = tenant_dir(root, tenant)
+    if not os.path.isdir(tdir):
+        row = template
+    else:
+        row, _gen = snapshot.load_newest(tdir, template)
+    crashpoints.hit(CP_RESTORE)
+    return row
+
+
+class Evictor:
+    """Cold-tenant eviction/restore over one superblock's lanes."""
+
+    def __init__(self, superblock: Superblock, root: str, *,
+                 retain: int = 2, pressure_batch: int = 64):
+        self.sb = superblock
+        self.root = root
+        self.retain = retain
+        # Lanes to free per LanePressure event: evicting one at a time
+        # would pay one persist+clear round-trip per admitted tenant
+        # under a rotating working set.
+        self.pressure_batch = pressure_batch
+        self.clock = 0
+        self.last_touch = np.zeros(superblock.n_tenants, np.int64)
+        os.makedirs(root, exist_ok=True)
+
+    # ---- recency --------------------------------------------------------
+    def note_touch(self, tenant: int) -> None:
+        self.clock += 1
+        self.last_touch[tenant] = self.clock
+
+    def select_cold(self, k: int, exclude=()) -> List[int]:
+        """The k longest-untouched RESIDENT tenants. ``exclude`` pins
+        tenants that must not be selected — the ingest queue pins the
+        tenants already placed in the slab it is building, so a
+        mid-flush pressure eviction can never free (and re-issue) a
+        device lane the in-flight slab is about to scatter into."""
+        resident = np.sort(self.sb.resident_tenants())
+        if len(resident) == 0:
+            return []
+        if exclude:
+            ex = set(exclude)
+            resident = np.asarray(
+                [t for t in resident if int(t) not in ex], np.int64
+            )
+            if len(resident) == 0:
+                return []
+        order = resident[np.argsort(self.last_touch[resident],
+                                    kind="stable")]
+        return [int(t) for t in order[:k]]
+
+    # ---- evict ----------------------------------------------------------
+    def persist(self, tenants: Sequence[int]) -> int:
+        """Flush dirty tenants' rows to the durable tier (no lane
+        change). Returns rows written."""
+        n = 0
+        for t in tenants:
+            if not self.sb.dirty[t]:
+                continue
+            persist_tenant(
+                self.root, self.sb.kind, t, self.sb.row(t),
+                retain=self.retain,
+            )
+            self.sb.dirty[t] = False
+            n += 1
+        metrics.count("serve.evict.persisted", n)
+        return n
+
+    def evict(self, tenants: Sequence[int], *,
+              _persist_dirty: bool = True) -> int:
+        """Move tenants to the durable tier, reset their lanes to ⊥
+        (one batched scatter), and FREE the lanes for other tenants.
+        ``_persist_dirty`` is the broken-twin seam
+        (``analysis.fixtures.evictor_drops_dirt`` flips it): the honest
+        evictor ALWAYS persists dirt before clearing — the order that
+        makes a mid-evict kill recoverable."""
+        from ..obs import recorder as _rec
+
+        lanes = []
+        for t in tenants:
+            if not self.sb.is_resident(t):
+                continue
+            if _persist_dirty and self.sb.dirty[t]:
+                self.persist([t])
+            self.sb.dirty[t] = False
+            self.sb.was_evicted[t] = True
+            lanes.append(self.sb.release_lane(t))
+            _rec.emit("tenant_evicted", tenant=int(t))
+        self.sb.clear_lanes(lanes)
+        metrics.count("serve.evict.evictions", len(lanes))
+        return len(lanes)
+
+    # ---- restore --------------------------------------------------------
+    def restore(self, tenant: int, _exclude=()) -> bool:
+        """Make a tenant resident: a first ADMISSION takes a ⊥ lane
+        (no durable record exists — free), an EVICTED tenant re-warms
+        from its last durable record. Under lane pressure, evicts the
+        ``pressure_batch`` coldest residents first (serving-tier
+        paging; ``_exclude`` pins slab-in-flight tenants — see
+        :meth:`select_cold`). Returns True only for a durable-tier
+        restore (the quantity the ingest FlushReport counts)."""
+        from ..obs import recorder as _rec
+
+        if self.sb.is_resident(tenant):
+            return False
+        if self.sb.free_lanes == 0:
+            self.evict(
+                self.select_cold(self.pressure_batch, exclude=_exclude)
+            )
+        if not self.sb.was_evicted[tenant]:
+            # First admission, not a restore: a never-evicted tenant
+            # has no durable record and its freed lane is already ⊥ —
+            # allocate and stop. No device write, no flight event (a
+            # million admissions would flood the recorder ring).
+            self.sb.ensure_resident(tenant)
+            metrics.count("serve.evict.admissions")
+            return False
+        row = restore_tenant(
+            self.root, self.sb.kind, tenant, self.sb.empty_row()
+        )
+        row = self._fit_capacity(row)
+        self.sb.write_row(tenant, row)
+        self.sb.was_evicted[tenant] = False
+        self.sb.dirty[tenant] = False
+        metrics.count("serve.evict.restores")
+        _rec.emit("tenant_restored", tenant=int(tenant))
+        return True
+
+    def _fit_capacity(self, row):
+        """Fit a restored row to the superblock's current layout. The
+        superblock may have WIDENED while the tenant slept (widen the
+        row up — per-kind widen is bit-exact) or NARROWED (the row's
+        content is sacred: RE-WIDEN the whole superblock to cover it —
+        a row with live lanes cannot narrow, and per-kind ``widen``
+        refuses shrink directions outright)."""
+        rcaps = self.sb.tk.caps_of(row)
+        grow_sb = {
+            k: v for k, v in rcaps.items() if v > self.sb.caps.get(k, 0)
+        }
+        if grow_sb:
+            self.sb.widen_capacity(**grow_sb)
+        if any(self.sb.caps[k] > rcaps[k] for k in rcaps):
+            return self.sb.tk.widen(row, **self.sb.caps)
+        return row
+
+
+def _durable_tenants(root: str):
+    """Tenant ids with a durable directory, by WALKING the two-level
+    fanout (one scandir per existing bucket) — probing every id of a
+    million-tenant population with isdir stats would put minutes of
+    syscalls on the recovery path."""
+    try:
+        buckets = sorted(
+            (e for e in os.scandir(root) if e.is_dir()),
+            key=lambda e: e.name,
+        )
+    except OSError:
+        return
+    for bucket in buckets:
+        for e in sorted(os.scandir(bucket.path), key=lambda e: e.name):
+            if e.is_dir() and e.name.startswith("t"):
+                try:
+                    yield int(e.name[1:])
+                except ValueError:
+                    continue
+
+
+def recover_tenants(
+    root: str, superblock: Superblock,
+    tenants: Optional[Sequence[int]] = None,
+) -> Dict[int, object]:
+    """The serving tier's recovery driver: load every tenant's last
+    durable record from ``root`` (after a crash, the device state is
+    gone — the durable tier IS the serving state of record). Returns
+    ``{tenant: row}`` for every tenant with a durable record; callers
+    scatter them back via ``Superblock.write_row``. Tenants without a
+    record recover as ⊥ (they were never persisted — their acks never
+    promised durability)."""
+    out: Dict[int, object] = {}
+    it = _durable_tenants(root) if tenants is None else tenants
+    for t in it:
+        tdir = tenant_dir(root, int(t))
+        if not os.path.isdir(tdir):
+            continue
+        if not snapshot.generations(tdir):
+            continue
+        row, _gen = snapshot.load_newest(tdir, superblock.empty_row())
+        out[int(t)] = row
+    metrics.count("serve.evict.recovered_tenants", len(out))
+    return out
+
+
+def evictor_preserves_dirt(evict_fn) -> bool:
+    """THE serve broken-twin detector: evict a DIRTY tenant through
+    ``evict_fn(evictor, tenants)``, restore it, and require the
+    restored row bit-identical to the pre-evict row. The honest
+    :meth:`Evictor.evict` persists dirt before clearing and passes;
+    the ``analysis.fixtures.evictor_drops_dirt`` twin clears the lane
+    on a stale durable record and MUST fail (the ``serve``
+    static-check section pins both directions)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel import make_mesh
+
+    mesh = make_mesh(1, 1)
+    sb = Superblock(
+        2, mesh, kind="orswot",
+        caps=dict(n_elems=4, n_actors=2, deferred_cap=2),
+    )
+    root = tempfile.mkdtemp(prefix="serve-evict-gate-")
+    try:
+        ev = Evictor(sb, root)
+        # Round 1: persist a clean-ish state so the durable tier holds
+        # a STALE record the broken twin will happily restore.
+        mask = np.zeros(4, bool)
+        mask[0] = True
+        row, _ = sb.tk.apply_add(
+            sb.empty_row(), jnp.int32(0), jnp.uint32(1), jnp.asarray(mask)
+        )
+        sb.write_row(0, row)
+        sb.dirty[0] = True
+        ev.persist([0])
+        # Round 2: new dirt on top — the state the evictor must not lose.
+        mask2 = np.zeros(4, bool)
+        mask2[2] = True
+        row2, _ = sb.tk.apply_add(row, jnp.int32(0), jnp.uint32(2),
+                                  jnp.asarray(mask2))
+        sb.write_row(0, row2)
+        sb.dirty[0] = True
+        want = sb.row(0)
+        evict_fn(ev, [0])
+        if sb.is_resident(0):
+            return False  # did not even evict
+        ev.restore(0)
+        got = sb.row(0)
+        return all(
+            bool(jnp.array_equal(x, y))
+            for x, y in zip(jax.tree.leaves(got), jax.tree.leaves(want))
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+from ..analysis.registry import register_obs_event as _reg_ev  # noqa: E402
+
+_reg_ev(
+    "tenant_evicted", subsystem="serve.evict", fields=("tenant",),
+    module=__name__,
+)
+_reg_ev(
+    "tenant_restored", subsystem="serve.evict", fields=("tenant",),
+    module=__name__,
+)
+
+__all__ = [
+    "Evictor", "evictor_preserves_dirt", "persist_tenant",
+    "recover_tenants", "restore_tenant", "tenant_dir",
+]

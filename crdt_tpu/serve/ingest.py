@@ -1,0 +1,333 @@
+"""Host-side ingest queue — per-tenant op streams coalesced into
+batched device applies (ISSUE 15; the streamed-list ingestion of
+``models/list.py`` generalized to the tenant-packed superblock).
+
+Serving traffic arrives as millions of tiny per-tenant ops; dispatching
+each alone would drown the device in launch overhead. The queue
+buffers ops per tenant and, per :meth:`IngestQueue.flush`, packs them
+into one :class:`~crdt_tpu.ops.superblock.OpSlab`:
+
+- **lane layout** — each mesh rank owns a contiguous lane block and
+  only tenants SHARDED to that rank fill it (local row indices — the
+  ``mesh_serve_apply`` contract), so the device-side gather/scatter
+  never crosses ranks;
+- **coalescing** — a tenant with several queued ops occupies ONE lane,
+  its ops in submission order along the slot axis. The
+  ``ingest_coalesced_ops`` telemetry counter counts exactly the ops
+  that shared a lane with a predecessor — every one of them is a
+  device dispatch the queue amortized away. ``hist_ingest_batch``
+  records the per-flush applied-op batch size (the amortization
+  distribution the bench reports);
+- **order** — per-tenant submission order is preserved across lanes,
+  slots, and flush boundaries, which is why the coalesced path is
+  bit-identical to the per-tenant sequential oracle (the slab scan
+  applies slots in order; overflow-deferred ops stay queued IN FRONT);
+- **backpressure** — the queue is bounded (``max_pending``):
+  :meth:`submit` raises :class:`IngestBackpressure` when the bound is
+  hit (callers flush and retry — the overflow behavior
+  tests/test_serve.py pins). A flush that cannot place every hot
+  tenant (more hot tenants on one rank than its lane block) leaves the
+  remainder queued for the next flush — visible in the returned
+  :class:`FlushReport`;
+- **restore-on-touch** — submitting to an EVICTED tenant asks the
+  attached evictor (crdt_tpu/serve/evict.py) to restore the lane from
+  the durable tier BEFORE the op applies, making eviction invisible to
+  correctness (only to latency).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry as tele
+from ..obs import hist as obs_hist
+from ..ops import superblock as sb_ops
+from ..utils.metrics import metrics
+from .superblock import Superblock
+
+
+class IngestBackpressure(RuntimeError):
+    """The bounded ingest queue is full — flush before submitting more
+    (the serving tier's loss-free overflow behavior: ops are refused
+    LOUDLY at the front door, never dropped after acceptance)."""
+
+
+class AddOp(NamedTuple):
+    actor: int
+    counter: int
+    member: np.ndarray  # the kind's member descriptor (mask / id list)
+
+
+class RmOp(NamedTuple):
+    clock: np.ndarray   # [A] uint32
+    member: np.ndarray
+
+
+class FlushReport(NamedTuple):
+    ops_applied: int        # ops that landed on device this flush
+    lanes_used: int         # slab lanes occupied
+    coalesced: int          # ops that shared a lane with a predecessor
+    pending_after: int      # ops still queued (rank-block overspill)
+    restored: int           # evicted tenants re-warmed before applying
+    dispatches: int         # device dispatches issued (1 + widen retries)
+
+
+class IngestQueue:
+    """Bounded per-tenant op buffer + slab builder over one
+    :class:`~crdt_tpu.serve.superblock.Superblock`."""
+
+    def __init__(
+        self,
+        superblock: Superblock,
+        *,
+        lanes: int = 256,
+        depth: int = 4,
+        max_pending: int = 1 << 16,
+        evictor=None,
+    ):
+        if lanes % superblock.p:
+            raise ValueError(
+                f"{lanes} lanes do not divide the {superblock.p}-way "
+                f"replica axis"
+            )
+        self.sb = superblock
+        self.lanes = lanes
+        self.depth = depth
+        self.max_pending = max_pending
+        self.evictor = evictor
+        # tenant -> deque of ops, insertion-ordered so flushes drain
+        # the longest-waiting tenants first (FIFO fairness).
+        self.pending: "OrderedDict[int, deque]" = OrderedDict()
+        self.n_pending = 0
+        self.total_ops = 0
+        self.total_coalesced = 0
+        self.hist_batch = obs_hist.zeros()
+
+    # ---- submission -----------------------------------------------------
+    def submit(self, tenant: int, op) -> None:
+        """Queue one op (:class:`AddOp` / :class:`RmOp`) for a tenant.
+        Raises :class:`IngestBackpressure` at the bound."""
+        if not 0 <= tenant < self.sb.n_tenants:
+            raise ValueError(f"tenant {tenant} out of range")
+        if self.n_pending >= self.max_pending:
+            metrics.count("serve.ingest.backpressure")
+            raise IngestBackpressure(
+                f"{self.n_pending} ops pending >= max_pending="
+                f"{self.max_pending}; flush() first"
+            )
+        self.pending.setdefault(tenant, deque()).append(op)
+        self.n_pending += 1
+        if self.evictor is not None:
+            self.evictor.note_touch(tenant)
+
+    def add(self, tenant: int, actor: int, counter: int, member) -> None:
+        self.submit(tenant, AddOp(actor, counter, np.asarray(member)))
+
+    def rm(self, tenant: int, clock, member) -> None:
+        self.submit(
+            tenant, RmOp(np.asarray(clock, np.uint32), np.asarray(member))
+        )
+
+    # ---- the flush ------------------------------------------------------
+    def flush(self, *, telemetry: bool = False):
+        """Coalesce queued ops into one slab and apply it. Returns
+        ``(FlushReport, Telemetry-or-None)``. Loops are the caller's
+        job: one flush issues ONE coalesced dispatch (plus widen
+        retries), leaving rank-block overspill queued."""
+        p, bl = self.sb.p, self.lanes // self.sb.p
+        lpr = self.sb.lanes_per_rank
+        caps = self.sb.caps
+        a = caps["n_actors"]
+        mshape, mdtype, mfill = self.sb.tk.member_plane(caps)
+
+        kind = np.zeros((self.lanes, self.depth), np.uint8)
+        actor = np.zeros((self.lanes, self.depth), np.int32)
+        ctr = np.zeros((self.lanes, self.depth), np.uint32)
+        clock = np.zeros((self.lanes, self.depth, a), np.uint32)
+        member = np.full((self.lanes, self.depth, *mshape), mfill, mdtype)
+        idx = np.full(self.lanes, -1, np.int32)
+        tenants = np.full(self.lanes, -1, np.int64)
+
+        lanes_free = [bl] * p
+        lane_next = [r * bl for r in range(p)]
+        restored = 0
+        applied = 0
+        coalesced = 0
+        picked = []
+        placed = set()
+        taken = []  # (tenant, popped ops) — the requeue ledger
+        try:
+            for t in list(self.pending):
+                # Residency first (a tenant's mesh rank is a property
+                # of its LANE): evicted/new tenants re-warm through
+                # the evictor (durable record + lane-pressure paging —
+                # placed tenants are PINNED so paging cannot free a
+                # lane this slab already targets), or take a ⊥ lane
+                # when no evictor is attached.
+                if not self.sb.is_resident(t):
+                    if self.evictor is not None:
+                        if self.evictor.restore(t, _exclude=placed):
+                            restored += 1
+                    else:
+                        self.sb.ensure_resident(t)
+                dev_lane = self.sb.lane_of[t]
+                r = int(dev_lane) // lpr
+                if lanes_free[r] == 0:
+                    continue
+                lane = lane_next[r]
+                lane_next[r] += 1
+                lanes_free[r] -= 1
+                q = self.pending[t]
+                take = min(len(q), self.depth)
+                ops_l = [q.popleft() for _ in range(take)]
+                taken.append((t, ops_l))
+                for s, op in enumerate(ops_l):
+                    if isinstance(op, AddOp):
+                        kind[lane, s] = sb_ops.ADD
+                        actor[lane, s] = op.actor
+                        ctr[lane, s] = op.counter
+                        member[lane, s] = self._member(
+                            op.member, mshape, mfill
+                        )
+                    else:
+                        kind[lane, s] = sb_ops.RM
+                        clock[lane, s] = op.clock
+                        member[lane, s] = self._member(
+                            op.member, mshape, mfill
+                        )
+                applied += take
+                coalesced += take - 1
+                idx[lane] = int(dev_lane) % lpr
+                tenants[lane] = t
+                placed.add(t)
+                if not q:
+                    picked.append(t)
+                if all(f == 0 for f in lanes_free):
+                    break
+            if applied == 0:
+                report = FlushReport(0, 0, 0, self.n_pending, restored, 0)
+                return report, (tele.zeros() if telemetry else None)
+
+            slab = sb_ops.OpSlab(
+                kind=jnp.asarray(kind), actor=jnp.asarray(actor),
+                ctr=jnp.asarray(ctr), clock=jnp.asarray(clock),
+                member=jnp.asarray(member),
+            )
+            widens_before = self.sb.widen_events
+            tel = self.sb.apply(
+                slab, jnp.asarray(idx), tenants, telemetry=telemetry,
+            )
+        except BaseException as exc:
+            # The loss-free contract survives failure: every accepted
+            # op that did NOT land goes back to the FRONT of its
+            # tenant's queue in original order. A CapacityOverflow
+            # names exactly the tenants whose rows were rolled back
+            # (everyone else's ops DID apply — re-queueing those would
+            # double-apply); any earlier failure (e.g. LanePressure
+            # while building) applied nothing, so everything returns.
+            lost = getattr(exc, "tenants", None)
+            requeued = 0
+            for t, ops_l in taken:
+                if lost is not None and t not in lost:
+                    continue
+                dq = self.pending.setdefault(t, deque())
+                for op in reversed(ops_l):
+                    dq.appendleft(op)
+                requeued += len(ops_l)
+            # Ops that DID land leave the pending count; drained
+            # tenants that kept nothing leave the map (an empty deque
+            # would waste a slab lane next flush).
+            self.n_pending -= applied - requeued
+            for t in picked:
+                if t in self.pending and not self.pending[t]:
+                    del self.pending[t]
+            raise
+        for t in picked:
+            del self.pending[t]
+        self.n_pending -= applied
+        dispatches = 1 + (self.sb.widen_events - widens_before)
+        self.total_ops += applied
+        self.total_coalesced += coalesced
+        self.hist_batch = obs_hist.observe(self.hist_batch, applied)
+        metrics.count("serve.ingest.flushes")
+        metrics.count("serve.ingest.ops", applied)
+        metrics.count("serve.ingest.coalesced_ops", coalesced)
+        if tel is not None:
+            tel = self.annotate(tel, coalesced=coalesced, batch=applied)
+        lanes_used = int((idx >= 0).sum())
+        from ..obs import recorder as _rec
+
+        _rec.emit(
+            "ingest_flush", lanes=lanes_used, ops=applied,
+            coalesced=coalesced, restored=restored,
+            pending_after=self.n_pending,
+        )
+        report = FlushReport(
+            applied, lanes_used, coalesced, self.n_pending, restored,
+            dispatches,
+        )
+        return report, tel
+
+    def _member(self, m: np.ndarray, mshape, mfill):
+        out = np.full(mshape, mfill, np.asarray(m).dtype)
+        m = np.asarray(m)
+        if m.shape == tuple(mshape):
+            return m
+        out[: m.shape[0]] = m
+        return out
+
+    def drain(self, *, telemetry: bool = False):
+        """Flush until the queue is empty; returns the combined
+        ``(FlushReport, Telemetry-or-None)`` totals."""
+        tot = FlushReport(0, 0, 0, 0, 0, 0)
+        tel = None
+        while self.n_pending:
+            rep, t = self.flush(telemetry=telemetry)
+            if rep.ops_applied == 0 and rep.restored == 0:
+                break  # nothing placeable (should not happen)
+            tot = FlushReport(
+                tot.ops_applied + rep.ops_applied,
+                max(tot.lanes_used, rep.lanes_used),
+                tot.coalesced + rep.coalesced,
+                rep.pending_after,
+                tot.restored + rep.restored,
+                tot.dispatches + rep.dispatches,
+            )
+            if t is not None:
+                tel = t if tel is None else tele.combine(tel, t)
+        return tot, tel
+
+    def annotate(
+        self, tel: tele.Telemetry, *, coalesced: int, batch: int
+    ) -> tele.Telemetry:
+        """Fill the host-owned ingest telemetry for ONE flush (the
+        ``stream_*`` fill discipline — per-record increments so
+        ``telemetry.combine`` folds flushes exactly): the flush's
+        coalesced-op count and one batch-size observation, plus the
+        superblock's residency gauges."""
+        if not tele.is_concrete(tel):
+            return tel
+        tel = tel._replace(
+            ingest_coalesced_ops=jnp.uint32(coalesced),
+            hist_ingest_batch=obs_hist.observe(
+                obs_hist.zeros(), batch
+            ),
+        )
+        return self.sb.annotate(tel)
+
+
+from ..analysis.registry import register_obs_event as _reg_ev  # noqa: E402
+
+_reg_ev(
+    "ingest_flush", subsystem="serve.ingest",
+    fields=("lanes", "ops", "coalesced", "restored", "pending_after"),
+    module=__name__,
+)
+
+__all__ = [
+    "AddOp", "FlushReport", "IngestBackpressure", "IngestQueue", "RmOp",
+]

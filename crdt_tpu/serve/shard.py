@@ -1,0 +1,209 @@
+"""Per-host tenant shards + DCN anti-entropy (ISSUE 15's multi-host
+leg; ``parallel/multihost.py`` + examples/04 extended to the serving
+tier).
+
+One mesh serves one host's tenant shard; a fleet of hosts serves the
+full tenant population. Two pieces:
+
+- :class:`TenantShardMap` — RENDEZVOUS-hashed ownership
+  (highest-random-weight: every (tenant, host) pair gets a
+  deterministic weight; the live host with the max weight owns the
+  tenant). Rendezvous is what makes **failover minimal**: when
+  membership evicts a host (the PR 8 suspicion/eviction machinery at
+  host granularity — ``fail_over``), ONLY the dead host's tenants
+  remap, every other assignment is untouched. The new owner re-warms
+  each inherited tenant from the SHARED durable tier on its next touch
+  (crdt_tpu/serve/evict.py restore-on-touch) — failover is eviction
+  plus restore, no new machinery.
+- :func:`sync_tenant_shards` — the DCN anti-entropy round: each host
+  exports its resident rows for tenants it NO LONGER owns (or a
+  chosen handoff set), every host gathers every export
+  (``multihost.sync_tenant_rows`` under ``retry=`` — the PR 8
+  exponential-backoff DCN hardening with the multi-collective
+  lockstep guard), and JOINS the rows it owns into its superblock.
+  Joining (not overwriting) is the CRDT guarantee that makes handoff
+  racy-traffic-safe: a row restored from the durable tier and a
+  fresher row shipped by the old owner converge to their lattice join
+  regardless of arrival order.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.metrics import metrics
+from .superblock import Superblock
+
+
+def _weight(tenant: int, host: int) -> int:
+    """Deterministic (tenant, host) rendezvous weight — a splitmix64
+    round over the packed pair (stable across processes and runs; no
+    Python hash randomization)."""
+    z = (
+        (tenant & 0xFFFFFFFF) << 32 | (host & 0xFFFFFFFF)
+    ) + 0x9E3779B97F4A7C15
+    z &= 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return z ^ (z >> 31)
+
+
+class TenantShardMap:
+    """Rendezvous-hashed tenant→host ownership over a live host set."""
+
+    def __init__(self, n_hosts: int, live: Optional[Iterable[int]] = None):
+        if n_hosts < 1:
+            raise ValueError("need at least one host")
+        self.n_hosts = n_hosts
+        self.live = set(range(n_hosts) if live is None else live)
+        if not self.live <= set(range(n_hosts)):
+            raise ValueError(f"live hosts {self.live} exceed {n_hosts}")
+        if not self.live:
+            raise ValueError("no live hosts")
+
+    def owner(self, tenant: int) -> int:
+        return max(self.live, key=lambda h: _weight(tenant, h))
+
+    def owned(self, host: int, tenants: Sequence[int]) -> List[int]:
+        return [t for t in tenants if self.owner(t) == host]
+
+    def fail_over(self, host: int) -> None:
+        """Membership evicted a host (PR 8's decision, host-granular):
+        its tenants remap to survivors by rendezvous; everyone else's
+        assignment is untouched. The new owners re-warm inherited
+        tenants from the shared durable tier on next touch."""
+        if host not in self.live:
+            return
+        if len(self.live) == 1:
+            raise ValueError("cannot fail over the last live host")
+        self.live.discard(host)
+        metrics.count("serve.shard.failovers")
+
+    def admit(self, host: int) -> None:
+        if not 0 <= host < self.n_hosts:
+            raise ValueError(f"host {host} out of range")
+        self.live.add(host)
+
+
+class ShardSyncReport(NamedTuple):
+    tenants_shipped: int   # rows this host exported
+    tenants_joined: int    # received rows joined into owned lanes
+    bytes_shipped: int     # wire bytes this host exported
+
+
+def export_rows(sb: Superblock, tenants: Sequence[int]) -> Dict[str, np.ndarray]:
+    """Pack tenant rows into the flat numpy wire dict
+    ``multihost.sync_tenant_rows`` gathers: ``tenants[K]`` plus one
+    stacked plane per state leaf (``leaf_00``...)."""
+    tenants = [int(t) for t in tenants]
+    wire: Dict[str, np.ndarray] = {
+        "tenants": np.asarray(tenants, np.int64)
+    }
+    rows = [sb.row(t) for t in tenants]
+    template = sb.empty_row()
+    leaves_t = jax.tree.leaves(template)
+    for i in range(len(leaves_t)):
+        if rows:
+            wire[f"leaf_{i:02d}"] = np.stack(
+                [jax.tree.leaves(r)[i] for r in rows]
+            )
+        else:
+            lt = np.asarray(leaves_t[i])
+            wire[f"leaf_{i:02d}"] = np.zeros((0, *lt.shape), lt.dtype)
+    return wire
+
+
+def ingest_rows(
+    sb: Superblock, shard_map: TenantShardMap, host: int,
+    wire: Dict[str, np.ndarray], *, evictor=None,
+) -> int:
+    """Join received rows for tenants THIS host owns into the
+    superblock (lattice join per row — handoff-safe under races).
+    Returns rows joined.
+
+    An EVICTED tenant must re-warm through ``evictor`` first so the
+    handoff row joins its durable record — joining against ⊥ and
+    marking the lane dirty would let the next persist overwrite the
+    durable state with the handoff row alone (silent loss). Without an
+    evictor the case is REFUSED loudly rather than lossily absorbed."""
+    tenants = wire["tenants"]
+    if len(tenants) == 0:
+        return 0
+    template = sb.empty_row()
+    treedef = jax.tree.structure(template)
+    n = 0
+    for k, t in enumerate(tenants):
+        t = int(t)
+        if shard_map.owner(t) != host:
+            continue
+        if not sb.is_resident(t):
+            if evictor is not None:
+                evictor.restore(t)
+            elif sb.was_evicted[t]:
+                raise ValueError(
+                    f"tenant {t} is evicted — pass evictor= so its "
+                    f"durable record joins the handoff row (joining "
+                    f"against ⊥ would lose it at the next persist)"
+                )
+        row = jax.tree.unflatten(
+            treedef,
+            [jnp.asarray(wire[f"leaf_{i:02d}"][k])
+             for i in range(treedef.num_leaves)],
+        )
+        mine = (
+            jax.tree.map(jnp.asarray, sb.row(t))
+            if sb.is_resident(t) else sb.empty_row()
+        )
+        joined = sb.tk.join(mine, row)
+        joined = joined[0] if isinstance(joined, tuple) else joined
+        sb.write_row(t, joined)
+        sb.dirty[t] = True
+        n += 1
+    return n
+
+
+def sync_tenant_shards(
+    sb: Superblock,
+    shard_map: TenantShardMap,
+    host: int,
+    handoff: Sequence[int],
+    retry=None,
+    evictor=None,
+) -> ShardSyncReport:
+    """One DCN anti-entropy round for the serving tier: export
+    ``handoff`` rows (typically tenants this host holds but no longer
+    owns — post-failover, post-rebalance), gather every host's export
+    over DCN under ``retry=``, and join what this host owns. Single-
+    process runs degenerate to a self-gather (the same code path the
+    two-process example drives — examples/04_multihost_dcn.py)."""
+    from ..parallel import multihost
+
+    wire = export_rows(sb, handoff)
+    bytes_shipped = sum(a.nbytes for a in wire.values())
+    gathered = multihost.sync_tenant_rows(wire, retry=retry)
+    joined = 0
+    import jax as _jax
+
+    me = _jax.process_index()
+    for p, remote in enumerate(gathered):
+        if p == me and len(gathered) > 1:
+            continue  # own export: nothing new to join
+        joined += ingest_rows(
+            sb, shard_map, host, remote, evictor=evictor
+        )
+    metrics.count("serve.shard.rows_shipped", len(handoff))
+    metrics.count("serve.shard.rows_joined", joined)
+    return ShardSyncReport(
+        tenants_shipped=len(handoff), tenants_joined=joined,
+        bytes_shipped=bytes_shipped,
+    )
+
+
+__all__ = [
+    "ShardSyncReport", "TenantShardMap", "export_rows", "ingest_rows",
+    "sync_tenant_shards",
+]

@@ -105,6 +105,20 @@ shipped and sync metadata per round), measured natively per round:
   ``bytes_useful`` (the post-mask raw payload) so the packing win is
   attributable. 0 on every ``fused=False`` or non-δ run.
 
+- ``live_tenants`` / ``evicted_tenants`` / ``ingest_coalesced_ops`` /
+  ``hist_ingest_batch`` — the multi-tenant serving accounting
+  (crdt_tpu/serve/; registry twins
+  ``telemetry.<kind>.serve.ingest_coalesced_ops`` plus
+  ``live_tenants``/``evicted_tenants`` gauges): the SERVED tenant
+  population (every session the front door answers for — device
+  residency may be far smaller under the lane indirection; the
+  resident count rides the ``serve.*`` registry counters) and tenants
+  currently parked in the durable tier (gauges, filled host-side by
+  ``Superblock.annotate``), ops that shared an ingest slab lane with a
+  predecessor (each one a device dispatch the coalescing queue
+  amortized away), and the per-flush applied-batch-size distribution
+  (``IngestQueue.annotate`` — the ``stream_*``/``wal_*`` host-side
+  fill discipline; 0/empty on every non-serving run).
 - ``hist_residue`` / ``hist_useful_bytes`` / ``hist_ack_depth`` /
   ``hist_packed_bytes`` / ``hist_dispatch_us`` — the in-kernel
   DISTRIBUTIONS
@@ -183,11 +197,15 @@ class Telemetry(NamedTuple):
     scaleout_drains: jax.Array     # uint32 — graceful drains certified
     bootstrap_bytes: jax.Array     # float32 — newcomer bootstrap wire bytes
     wire_packed_bytes: jax.Array   # float32 — post-packing bytes on the wire
+    live_tenants: jax.Array        # uint32 — served tenant population
+    evicted_tenants: jax.Array     # uint32 — tenants parked in the durable tier
+    ingest_coalesced_ops: jax.Array  # uint32 — ops that shared a slab lane
     hist_residue: obs_hist.Hist    # per-round unshipped-backlog rows
     hist_useful_bytes: obs_hist.Hist  # per-round post-mask payload bytes
     hist_ack_depth: obs_hist.Hist  # per-round ack-window depth
     hist_packed_bytes: obs_hist.Hist  # per-round post-packing wire bytes
     hist_dispatch_us: obs_hist.Hist   # host-timed dispatch wall-clock (µs)
+    hist_ingest_batch: obs_hist.Hist  # per-flush coalesced-batch op count
 
 
 def zeros() -> Telemetry:
@@ -222,11 +240,15 @@ def zeros() -> Telemetry:
         scaleout_drains=jnp.zeros((), jnp.uint32),
         bootstrap_bytes=jnp.zeros((), jnp.float32),
         wire_packed_bytes=jnp.zeros((), jnp.float32),
+        live_tenants=jnp.zeros((), jnp.uint32),
+        evicted_tenants=jnp.zeros((), jnp.uint32),
+        ingest_coalesced_ops=jnp.zeros((), jnp.uint32),
         hist_residue=obs_hist.zeros(),
         hist_useful_bytes=obs_hist.zeros(),
         hist_ack_depth=obs_hist.zeros(),
         hist_packed_bytes=obs_hist.zeros(),
         hist_dispatch_us=obs_hist.zeros(),
+        hist_ingest_batch=obs_hist.zeros(),
     )
 
 
@@ -272,6 +294,9 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         scaleout_drains=a.scaleout_drains + b.scaleout_drains,
         bootstrap_bytes=a.bootstrap_bytes + b.bootstrap_bytes,
         wire_packed_bytes=a.wire_packed_bytes + b.wire_packed_bytes,
+        ingest_coalesced_ops=(
+            a.ingest_coalesced_ops + b.ingest_coalesced_ops
+        ),
         hist_residue=obs_hist.merge(a.hist_residue, b.hist_residue),
         hist_useful_bytes=obs_hist.merge(
             a.hist_useful_bytes, b.hist_useful_bytes
@@ -283,12 +308,17 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         hist_dispatch_us=obs_hist.merge(
             a.hist_dispatch_us, b.hist_dispatch_us
         ),
+        hist_ingest_batch=obs_hist.merge(
+            a.hist_ingest_batch, b.hist_ingest_batch
+        ),
         deferred_depth=b.deferred_depth,
         residue=b.residue,
         widen_pressure=b.widen_pressure,
         frontier_lag=b.frontier_lag,
         ack_window_depth=b.ack_window_depth,
         live_ranks=b.live_ranks,
+        live_tenants=b.live_tenants,
+        evicted_tenants=b.evicted_tenants,
     )
 
 
@@ -457,11 +487,15 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "scaleout_drains": int(tel.scaleout_drains),
         "bootstrap_bytes": float(tel.bootstrap_bytes),
         "wire_packed_bytes": float(tel.wire_packed_bytes),
+        "live_tenants": int(tel.live_tenants),
+        "evicted_tenants": int(tel.evicted_tenants),
+        "ingest_coalesced_ops": int(tel.ingest_coalesced_ops),
         "hist_residue": obs_hist.to_dict(tel.hist_residue),
         "hist_useful_bytes": obs_hist.to_dict(tel.hist_useful_bytes),
         "hist_ack_depth": obs_hist.to_dict(tel.hist_ack_depth),
         "hist_packed_bytes": obs_hist.to_dict(tel.hist_packed_bytes),
         "hist_dispatch_us": obs_hist.to_dict(tel.hist_dispatch_us),
+        "hist_ingest_batch": obs_hist.to_dict(tel.hist_ingest_batch),
     }
 
 
@@ -532,6 +566,9 @@ def counter_increments(kind: str, d: Dict[str, Any]) -> Dict[str, int]:
         f"telemetry.{kind}.wire.packed_bytes": int(
             d["wire_packed_bytes"]
         ),
+        f"telemetry.{kind}.serve.ingest_coalesced_ops": d[
+            "ingest_coalesced_ops"
+        ],
     }
     # Histogram per-bucket counters fold bit-exactly across runs —
     # exactly what tools/obs_report.py cross-checks a dump against.
@@ -568,6 +605,10 @@ def record(kind: str, tel: Telemetry) -> None:
         f"telemetry.{kind}.ack_window_depth", d["ack_window_depth"]
     )
     metrics.observe(f"telemetry.{kind}.live_ranks", d["live_ranks"])
+    metrics.observe(f"telemetry.{kind}.live_tenants", d["live_tenants"])
+    metrics.observe(
+        f"telemetry.{kind}.evicted_tenants", d["evicted_tenants"]
+    )
     metrics.observe(f"telemetry.{kind}.deferred_depth", d["deferred_depth"])
     metrics.observe(f"telemetry.{kind}.residue", d["residue"])
     metrics.observe(f"telemetry.{kind}.widen_pressure", d["widen_pressure"])

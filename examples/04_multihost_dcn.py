@@ -68,6 +68,48 @@ members = int((np.asarray(result.ctr) > 0).any(-1).sum())
 union = int((ctr > 0).any((0, 2)).sum())
 assert members == union
 print(f"process {pid}: converged set has {members}/{union} members", flush=True)
+
+# ---- per-host tenant shards + DCN anti-entropy (crdt_tpu/serve/) ----
+# Each host serves ITS OWN tenant shard on a LOCAL mesh (tenants are
+# independent — only handoff rows ever cross DCN, and they ride
+# sync_tenant_rows under retry=). Host 0 also holds a stale row for a
+# tenant host 1 owns (pre-failover residency); one sync round hands it
+# off and both hosts' reads converge to the lattice join.
+from crdt_tpu.faults import RetryPolicy
+from crdt_tpu.parallel.mesh import make_mesh
+from crdt_tpu.serve import IngestQueue, Superblock, TenantShardMap
+
+lmesh = make_mesh(4, 1, devices=jax.local_devices())
+caps = dict(n_elems=8, n_actors=2, deferred_cap=2)
+sb = Superblock(8, lmesh, kind="orswot", caps=caps)
+smap = TenantShardMap(2)
+q = IngestQueue(sb, lanes=4, depth=2)
+mask = lambda *on: np.isin(np.arange(8), on)
+for t in smap.owned(pid, range(8)):
+    q.add(t, pid, 1, mask(t % 8))
+foreign = next(t for t in range(8) if smap.owner(t) != pid)
+q.add(foreign, pid, 1, mask(7 - (foreign % 8)))  # stale foreign residue
+q.drain()
+
+from crdt_tpu.serve import sync_tenant_shards
+
+rep = sync_tenant_shards(
+    sb, smap, pid, handoff=[foreign], retry=RetryPolicy(attempts=3),
+)
+# The peer's foreign tenant is owned by THIS host (two hosts: not-peer
+# == me), so each host joins exactly one handed-off row, and its read
+# is the lattice join of both contributions.
+peer_foreign = next(t for t in range(8) if smap.owner(t) != 1 - pid)
+assert rep.tenants_shipped == 1 and rep.tenants_joined == 1, rep
+want_members = {peer_foreign % 8, 7 - (peer_foreign % 8)}
+got_members = set(np.where(np.asarray(sb.read(peer_foreign)))[0])
+assert got_members == want_members, (got_members, want_members)
+print(
+    f"process {pid}: shard owns {len(smap.owned(pid, range(8)))} "
+    f"tenants, handed off {rep.tenants_shipped}, joined "
+    f"{rep.tenants_joined} over DCN; handoff read converged",
+    flush=True,
+)
 """
 
 

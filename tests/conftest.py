@@ -262,6 +262,19 @@ SLOW_NODEIDS = (
     # runs the same host-recompute machinery in-tier, and the sparse
     # gossip path keeps its convergence gates in test_sparse_orswot.py
     "test_telemetry.py::test_jitted_sparse_gossip_telemetry_matches_host_recompute",
+    # ---- seventh curation round (ISSUE 15: the serving front door).
+    # Same contract: every promotion names its faster in-tier cousin.
+    # sparse coalesced-vs-sequential A/B (~2.5 s): the dense param
+    # stays tier-1 and the `serve` static-check section runs a
+    # coalesced==sequential micro A/B on every chain invocation
+    "test_serve.py::test_coalesced_apply_matches_sequential_oracle[sparse_orswot-caps1]",
+    # mid-evict kills at the two SNAPSHOT-owned boundaries: the three
+    # serve.* crashpoint params stay tier-1, and the `durability`
+    # static-check section kill-and-recovers at EVERY snapshot
+    # boundary (the serve persist/restore crossings included) per
+    # chain invocation
+    "test_serve.py::test_mid_evict_crash_recovers_last_durable_record[snapshot.pre_rename-False]",
+    "test_serve.py::test_mid_evict_crash_recovers_last_durable_record[snapshot.post_commit_pre_prune-True]",
 )
 
 

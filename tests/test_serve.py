@@ -1,0 +1,712 @@
+"""Tenant-packed serving front door (crdt_tpu/serve/ +
+ops/superblock.py + parallel/serve_apply.py — ISSUE 15).
+
+The layer contract under test:
+
+1. Superblock: ``pack``/``unpack`` round-trip bit-exactly, and the
+   coalesced slab apply (one ``mesh_serve_apply`` dispatch over many
+   tenants × sequential op slots) is BIT-IDENTICAL to the per-tenant
+   sequential oracle — for the dense AND the sparse kind, across
+   multi-flush ingest schedules, lane paging, and the elastic
+   overflow→widen→retry path (which must equal a wide-born run).
+2. Ingest: per-tenant submission order is preserved, coalescing is
+   counted, the bounded queue raises :class:`IngestBackpressure`
+   LOUDLY (loss-free overflow), and rank-block overspill stays queued.
+3. Evict/restore: a cold tenant moves to the PR 10 snapshot tier and
+   restores bit-identically on next touch — including under a
+   MID-EVICT kill at any serve/snapshot crashpoint, where recovery
+   lands exactly the last durable record (``crashpoints.fuzz`` is the
+   engine, the PR 10 discipline).
+4. Shards: rendezvous ownership is deterministic and minimally
+   remapped on failover; the DCN row sync joins handoff rows
+   lattice-safely (single-process degenerate gather).
+5. Telemetry: ``live_tenants`` / ``evicted_tenants`` /
+   ``ingest_coalesced_ops`` / ``hist_ingest_batch`` flow through the
+   pytree → dict → committed schema, and ``combine`` folds flush
+   records exactly.
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crdt_tpu import telemetry as tele
+from crdt_tpu.analysis import fixtures
+from crdt_tpu.analysis.registry import (
+    registered_entry_names,
+    serve_surfaces,
+    unregistered_serve_surfaces,
+)
+from crdt_tpu.durability import crashpoints
+from crdt_tpu.ops import superblock as sb_ops
+from crdt_tpu.parallel import make_mesh, mesh_serve_apply
+from crdt_tpu.serve import (
+    Evictor,
+    IngestBackpressure,
+    IngestQueue,
+    Superblock,
+    TenantShardMap,
+    evictor_preserves_dirt,
+    recover_tenants,
+    static_checks,
+    sync_tenant_shards,
+)
+
+DENSE_CAPS = dict(n_elems=8, n_actors=2, deferred_cap=2)
+SPARSE_CAPS = dict(dot_cap=12, n_actors=2, deferred_cap=2, rm_width=4)
+
+
+def _trees_equal(a, b) -> bool:
+    return all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _mask(*on, e=8):
+    return np.isin(np.arange(e), on)
+
+
+def _eids(*on, w=4):
+    out = np.full(w, -1, np.int32)
+    out[: len(on)] = on
+    return out
+
+
+def _rand_streams(kind, caps, n_tenants, n_ops, seed):
+    """Per-tenant op streams (causally valid: per-actor counters
+    increase, rm clocks observed at the submit site → covered or
+    slightly ahead)."""
+    rng = np.random.default_rng(seed)
+    a = caps["n_actors"]
+    streams = {t: [] for t in range(n_tenants)}
+    next_ctr = np.zeros((n_tenants, a), np.int64)
+    for _ in range(n_ops):
+        t = int(rng.integers(n_tenants))
+        act = int(rng.integers(a))
+        if kind == "orswot":
+            member = rng.random(caps["n_elems"]) < 0.4
+        else:
+            k = int(rng.integers(1, caps["rm_width"] + 1))
+            member = np.full(caps["rm_width"], -1, np.int32)
+            member[:k] = rng.choice(16, k, replace=False)
+        if rng.random() < 0.75 or not streams[t]:
+            next_ctr[t, act] += 1
+            streams[t].append(
+                (sb_ops.ADD, act, int(next_ctr[t, act]), None, member)
+            )
+        else:
+            clock = next_ctr[t].astype(np.uint32)
+            if rng.random() < 0.2:
+                clock = clock.copy()
+                clock[act] += 1  # ahead → parks (exercises deferral)
+            streams[t].append((sb_ops.RM, 0, 0, clock, member))
+    return {t: ops for t, ops in streams.items() if ops}
+
+
+def _submit(q, streams):
+    for t, ops_l in streams.items():
+        for k, actor, ctr, clock, member in ops_l:
+            if k == sb_ops.ADD:
+                q.add(t, actor, ctr, member)
+            else:
+                q.rm(t, clock, member)
+
+
+def _oracle_check(sb, streams, caps=None):
+    # Oracle at the superblock's CURRENT caps: an overflow-triggered
+    # widen migrates every tenant bit-exactly (the wide-born property),
+    # so the reference replays at the final layout.
+    caps = sb.caps if caps is None else caps
+    for t, ops_l in streams.items():
+        want = sb_ops.sequential_oracle(sb.tk, sb.tk.empty(**caps), ops_l)
+        assert _trees_equal(sb.row(t), want), (
+            f"tenant {t} diverged from its sequential oracle"
+        )
+
+
+# ---- 1. superblock: pack/unpack + coalesced == sequential ---------------
+
+@pytest.mark.parametrize("kind,caps", [
+    ("orswot", DENSE_CAPS), ("sparse_orswot", SPARSE_CAPS),
+])
+def test_pack_unpack_round_trip(kind, caps):
+    tk = sb_ops.tenant_kind(kind)
+    streams = _rand_streams(kind, caps, 5, 30, seed=11)
+    rows = [
+        sb_ops.sequential_oracle(tk, tk.empty(**caps), ops_l)
+        for ops_l in streams.values()
+    ]
+    packed = sb_ops.pack(rows)
+    for i, row in enumerate(rows):
+        assert _trees_equal(sb_ops.unpack(packed, i), row)
+    # pack responds to shape drift loudly
+    with pytest.raises(ValueError):
+        sb_ops.pack([rows[0], tk.widen(rows[1], deferred_cap=4)])
+    with pytest.raises(ValueError):
+        sb_ops.pack([])
+
+
+@pytest.mark.parametrize("kind,caps", [
+    ("orswot", DENSE_CAPS), ("sparse_orswot", SPARSE_CAPS),
+])
+def test_coalesced_apply_matches_sequential_oracle(kind, caps):
+    """The headline bit-identity: many tenants' op streams through the
+    coalesced multi-flush ingest path == each tenant's sequential
+    oracle, dense and sparse."""
+    mesh = make_mesh(4, 2)
+    sb = Superblock(16, mesh, kind=kind, caps=dict(caps))
+    q = IngestQueue(sb, lanes=8, depth=3)
+    streams = _rand_streams(kind, caps, 16, 120, seed=23)
+    _submit(q, streams)
+    rep, _ = q.drain()
+    assert rep.ops_applied == sum(len(v) for v in streams.values())
+    _oracle_check(sb, streams)
+
+
+def test_serve_apply_overflow_widen_retry_matches_wide_born():
+    """Deferred-cap overflow rolls back ONLY the overflowed tenants,
+    widens, retries — landing bit-identical to a wide-born superblock
+    fed the same streams."""
+    mesh = make_mesh(2, 1)
+    caps = dict(n_elems=8, n_actors=2, deferred_cap=1)
+    streams = {}
+    # Tenant 0: two DISTINCT ahead rm clocks → needs 2 parked slots →
+    # overflows deferred_cap=1. Tenant 1: plain adds (must not replay).
+    streams[0] = [
+        (sb_ops.ADD, 0, 1, None, _mask(0)),
+        (sb_ops.RM, 0, 0, np.asarray([2, 0], np.uint32), _mask(1)),
+        (sb_ops.RM, 0, 0, np.asarray([0, 3], np.uint32), _mask(2)),
+    ]
+    streams[1] = [(sb_ops.ADD, 1, 1, None, _mask(3, 4))]
+
+    sb = Superblock(4, mesh, kind="orswot", caps=dict(caps))
+    q = IngestQueue(sb, lanes=2, depth=3)
+    _submit(q, streams)
+    q.drain()
+    assert sb.widen_events >= 1 and sb.caps["deferred_cap"] > 1
+
+    wide = Superblock(
+        4, mesh, kind="orswot",
+        caps=dict(caps, deferred_cap=sb.caps["deferred_cap"]),
+    )
+    qw = IngestQueue(wide, lanes=2, depth=3)
+    _submit(qw, streams)
+    qw.drain()
+    for t in streams:
+        assert _trees_equal(sb.row(t), wide.row(t)), (
+            f"elastic path diverged from wide-born for tenant {t}"
+        )
+
+
+def test_lane_paging_preserves_oracle_identity():
+    """A population larger than the lane pool pages through
+    evict/restore and still lands every tenant on its sequential
+    oracle (the serving tier's working-set story)."""
+    mesh = make_mesh(2, 1)
+    caps = DENSE_CAPS
+    sb = Superblock(24, mesh, kind="orswot", caps=dict(caps), n_lanes=8)
+    root = tempfile.mkdtemp(prefix="serve-paging-")
+    try:
+        ev = Evictor(sb, root, pressure_batch=3)
+        q = IngestQueue(sb, lanes=4, depth=2, evictor=ev)
+        streams = _rand_streams("orswot", caps, 24, 90, seed=31)
+        # Interleave submission so the working set rotates.
+        for t, ops_l in sorted(streams.items()):
+            for k, actor, ctr, clock, member in ops_l:
+                if k == sb_ops.ADD:
+                    q.add(t, actor, ctr, member)
+                else:
+                    q.rm(t, clock, member)
+            if t % 3 == 2:
+                q.drain()
+        q.drain()
+        assert int((sb.was_evicted).sum()) > 0, "no paging happened"
+        for t, ops_l in streams.items():
+            ev.restore(t)
+            want = sb_ops.sequential_oracle(
+                sb.tk, sb.tk.empty(**sb.caps), ops_l
+            )
+            assert _trees_equal(sb.row(t), want)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def test_compact_tenants_preserves_reads():
+    """Per-tenant compaction (the PR 5 kernels lifted over the tenant
+    axis) retires frontier-stable parked slots without changing the
+    observable read."""
+    tk = sb_ops.tenant_kind("orswot")
+    caps = DENSE_CAPS
+    streams = _rand_streams("orswot", caps, 6, 40, seed=41)
+    rows = [
+        sb_ops.sequential_oracle(tk, tk.empty(**caps), ops_l)
+        for ops_l in streams.values()
+    ]
+    block = sb_ops.pack(rows)
+    frontier = block.top  # single-replica tenants: own top == frontier
+    out, freed, freed_b = sb_ops.compact_tenants(tk, block, frontier)
+    for i in range(len(rows)):
+        assert bool(jnp.array_equal(
+            tk.observe(sb_ops.unpack(out, i)),
+            tk.observe(sb_ops.unpack(block, i)),
+        ))
+    assert int(freed) >= 0 and float(freed_b) >= 0.0
+
+
+# ---- 2. ingest: order, coalescing, backpressure -------------------------
+
+def test_ingest_backpressure_raises_and_preserves_ops():
+    mesh = make_mesh(1, 1)
+    sb = Superblock(4, mesh, kind="orswot", caps=dict(DENSE_CAPS))
+    q = IngestQueue(sb, lanes=2, depth=2, max_pending=3)
+    for i in range(3):
+        q.add(0, 0, i + 1, _mask(i))
+    with pytest.raises(IngestBackpressure):
+        q.add(1, 0, 1, _mask(0))
+    assert q.n_pending == 3  # the refused op was NOT half-accepted
+    q.drain()
+    assert q.n_pending == 0
+    q.add(1, 0, 1, _mask(0))  # drained queue accepts again
+
+
+def test_ingest_rank_overspill_stays_queued_and_applies_in_order():
+    """More hot tenants on one rank than its lane block: the overspill
+    stays queued across flushes and per-tenant order survives."""
+    mesh = make_mesh(2, 1)
+    caps = DENSE_CAPS
+    sb = Superblock(8, mesh, kind="orswot", caps=dict(caps))
+    q = IngestQueue(sb, lanes=2, depth=2)  # 1 lane per rank per flush
+    streams = _rand_streams("orswot", caps, 8, 48, seed=53)
+    _submit(q, streams)
+    rep1, _ = q.flush()
+    assert rep1.pending_after > 0  # overspill is visible
+    rep, _ = q.drain()
+    assert rep.pending_after == 0
+    _oracle_check(sb, streams)
+
+
+def test_ingest_coalescing_counter_and_batch_hist():
+    mesh = make_mesh(1, 1)
+    sb = Superblock(2, mesh, kind="orswot", caps=dict(DENSE_CAPS))
+    q = IngestQueue(sb, lanes=1, depth=4)
+    for c in range(1, 5):
+        q.add(0, 0, c, _mask(c % 8))
+    rep, t = q.flush(telemetry=True)
+    # 4 ops, one lane: 3 of them shared the lane with a predecessor.
+    assert rep.ops_applied == 4 and rep.coalesced == 3
+    d = tele.to_dict(t)
+    assert d["ingest_coalesced_ops"] == 3
+    assert sum(d["hist_ingest_batch"]["counts"]) == 1  # one flush obs
+    assert d["live_tenants"] == 2 and d["evicted_tenants"] == 0
+
+
+def test_flush_telemetry_combines_and_validates_against_schema():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools",
+    ))
+    from check_telemetry_schema import validate_record
+
+    from crdt_tpu.exporter import telemetry_record
+
+    mesh = make_mesh(2, 1)
+    sb = Superblock(8, mesh, kind="orswot", caps=dict(DENSE_CAPS))
+    q = IngestQueue(sb, lanes=4, depth=2)
+    streams = _rand_streams("orswot", DENSE_CAPS, 8, 40, seed=61)
+    _submit(q, streams)
+    rep, tel = q.drain(telemetry=True)
+    assert tel is not None and rep.dispatches >= 1
+    d = tele.to_dict(tel)
+    assert sum(d["hist_ingest_batch"]["counts"]) >= 1
+    assert sum(d["hist_dispatch_us"]["counts"]) == rep.dispatches
+    assert validate_record(telemetry_record("serve_test", tel)) == []
+
+
+# ---- 3. evict / restore / crash recovery --------------------------------
+
+def _dirty_tenant_fixture(root):
+    """A 2-generation durable history for tenant 0: persisted v1, then
+    fresh dirt v2 — the states a mid-evict kill must discriminate."""
+    mesh = make_mesh(1, 1)
+    sb = Superblock(
+        2, mesh, kind="orswot",
+        caps=dict(n_elems=4, n_actors=2, deferred_cap=2),
+    )
+    ev = Evictor(sb, root)
+    row1, _ = sb.tk.apply_add(
+        sb.empty_row(), jnp.int32(0), jnp.uint32(1),
+        jnp.asarray(_mask(0, e=4)),
+    )
+    sb.write_row(0, row1)
+    sb.dirty[0] = True
+    ev.persist([0])  # durable v1
+    row2, _ = sb.tk.apply_add(
+        row1, jnp.int32(0), jnp.uint32(2), jnp.asarray(_mask(2, e=4))
+    )
+    sb.write_row(0, row2)
+    sb.dirty[0] = True  # dirt v2, not yet durable
+    return sb, ev, row1, row2
+
+
+def test_evict_touch_restore_bit_identical():
+    root = tempfile.mkdtemp(prefix="serve-evict-")
+    try:
+        sb, ev, _row1, row2 = _dirty_tenant_fixture(root)
+        assert ev.evict([0]) == 1
+        assert not sb.is_resident(0)
+        assert ev.restore(0)  # the touch
+        assert _trees_equal(sb.row(0), row2)
+        assert not ev.restore(0)  # idempotent on resident
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+SERVE_CRASHPOINTS = (
+    # want_v2: did the kill land BEFORE or AFTER the dirt committed?
+    ("serve.evict.pre_persist", False),
+    ("serve.evict.post_persist_pre_clear", True),
+    ("snapshot.pre_rename", False),
+    ("snapshot.pre_manifest_rename", False),  # manifest IS the commit
+    ("snapshot.post_commit_pre_prune", True),
+)
+
+
+@pytest.mark.parametrize("cp_name,want_v2", SERVE_CRASHPOINTS)
+def test_mid_evict_crash_recovers_last_durable_record(cp_name, want_v2):
+    """A kill at any durability boundary inside the evict path
+    recovers the tenant bit-identical to its LAST DURABLE record —
+    v1 before the manifest commit, v2 after (the PR 10 contract at
+    tenant granularity)."""
+    root = tempfile.mkdtemp(prefix="serve-crash-")
+    try:
+        sb, ev, row1, row2 = _dirty_tenant_fixture(root)
+        with crashpoints.armed(cp_name):
+            with pytest.raises(crashpoints.SimulatedCrash):
+                ev.evict([0])
+        # The process died: device state is gone. Recovery reads ONLY
+        # the durable tier.
+        mesh = make_mesh(1, 1)
+        sb2 = Superblock(
+            2, mesh, kind="orswot",
+            caps=dict(n_elems=4, n_actors=2, deferred_cap=2),
+        )
+        rows = recover_tenants(root, sb2)
+        got = rows.get(0, sb2.empty_row())
+        want = row2 if want_v2 else row1
+        assert _trees_equal(got, want), (
+            f"kill at {cp_name}: recovery is not the last durable record"
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def test_serve_crashpoint_fuzz_loop():
+    """The PR 10 fuzz engine over the serve-owned crashpoints: kill at
+    each, recover from the durable tier alone, compare against the
+    tracked last-durable-record expectation."""
+    box = {}
+    dirs = []
+
+    def crash_run(name):
+        box["root"] = tempfile.mkdtemp(prefix="serve-fuzz-")
+        dirs.append(box["root"])
+        mesh = make_mesh(1, 1)
+        sb = Superblock(
+            2, mesh, kind="orswot",
+            caps=dict(n_elems=4, n_actors=2, deferred_cap=2),
+        )
+        ev = Evictor(sb, box["root"])
+        # Expectation rows land in the box BEFORE any crashpoint can
+        # fire (an armed point may kill the very first persist).
+        row1, _ = sb.tk.apply_add(
+            sb.empty_row(), jnp.int32(0), jnp.uint32(1),
+            jnp.asarray(_mask(0, e=4)),
+        )
+        row2, _ = sb.tk.apply_add(
+            row1, jnp.int32(0), jnp.uint32(2), jnp.asarray(_mask(2, e=4))
+        )
+        box["v1"], box["v2"] = row1, row2
+        sb.write_row(0, row1)
+        sb.dirty[0] = True
+        ev.persist([0])  # durable v1
+        sb.write_row(0, row2)
+        sb.dirty[0] = True
+        ev.evict([0])    # durable v2, lane cleared + freed
+        ev.restore(0)    # crosses serve.restore.post_load
+
+    def recov():
+        mesh = make_mesh(1, 1)
+        sb2 = Superblock(
+            2, mesh, kind="orswot",
+            caps=dict(n_elems=4, n_actors=2, deferred_cap=2),
+        )
+        rows = recover_tenants(box["root"], sb2)
+        got = rows.get(0, sb2.empty_row())
+        # The last DURABLE record is whatever generation count is ON
+        # DISK: 0 committed → ⊥, 1 → v1, 2+ → v2.
+        from crdt_tpu.durability import snapshot
+        from crdt_tpu.serve.evict import tenant_dir
+
+        gens = snapshot.generations(tenant_dir(box["root"], 0))
+        want = (
+            box["v2"] if len(gens) >= 2
+            else box["v1"] if len(gens) == 1
+            else sb2.empty_row()
+        )
+        return got, want
+
+    def equal(a, b):
+        return _trees_equal(a, b)
+
+    names = (
+        "serve.evict.pre_persist",
+        "serve.evict.post_persist_pre_clear",
+        "serve.restore.post_load",
+    )
+    failures = crashpoints.fuzz(crash_run, recov, equal, names=names)
+    for d in dirs:
+        shutil.rmtree(d, ignore_errors=True)
+    assert not failures, failures
+
+
+def test_evictor_detector_and_broken_twin():
+    assert evictor_preserves_dirt(lambda ev, ts: ev.evict(ts))
+    assert not evictor_preserves_dirt(fixtures.evictor_drops_dirt)
+
+
+def test_restore_widens_rows_persisted_under_narrower_caps():
+    """A tenant evicted before a capacity widen restores into the
+    wider layout bit-exactly (the per-kind widen is exact on ⊥-padded
+    lanes)."""
+    root = tempfile.mkdtemp(prefix="serve-widen-restore-")
+    try:
+        sb, ev, _row1, row2 = _dirty_tenant_fixture(root)
+        ev.evict([0])
+        sb.widen_capacity(deferred_cap=4, n_elems=8)
+        ev.restore(0)
+        want = sb.tk.widen(row2, deferred_cap=4, n_elems=8)
+        assert _trees_equal(sb.row(0), want)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def test_overflow_double_widen_retry_matches_wide_born():
+    """TWO widen migrations in one apply (the rollback base must track
+    the widened layout, or the second retry's scatter mixes pre-widen
+    rows into the widened state)."""
+    mesh = make_mesh(1, 1)
+    caps = dict(n_elems=8, n_actors=2, deferred_cap=1)
+    # Three DISTINCT ahead rm clocks → three parked slots: cap 1 → 2
+    # (still short) → 4. Factor-2 policy needs two migrations.
+    streams = {0: [
+        (sb_ops.RM, 0, 0, np.asarray([1, 0], np.uint32), _mask(1)),
+        (sb_ops.RM, 0, 0, np.asarray([0, 1], np.uint32), _mask(2)),
+        (sb_ops.RM, 0, 0, np.asarray([2, 0], np.uint32), _mask(3)),
+    ], 1: [(sb_ops.ADD, 0, 1, None, _mask(0))]}
+    sb = Superblock(2, mesh, kind="orswot", caps=dict(caps))
+    q = IngestQueue(sb, lanes=2, depth=3)
+    _submit(q, streams)
+    q.drain()
+    assert sb.widen_events == 2 and sb.caps["deferred_cap"] == 4
+    wide = Superblock(
+        2, mesh, kind="orswot", caps=dict(caps, deferred_cap=4)
+    )
+    qw = IngestQueue(wide, lanes=2, depth=3)
+    _submit(qw, streams)
+    qw.drain()
+    for t in streams:
+        assert _trees_equal(sb.row(t), wide.row(t))
+
+
+def test_capacity_overflow_is_loss_free_and_rolls_back():
+    """An exhausted widen budget (CapacityOverflow) re-queues EXACTLY
+    the overflowed tenants' ops (everyone else's applied), rolls their
+    rows back, and keeps the pending count consistent — the loss-free
+    front-door contract under failure."""
+    from crdt_tpu.elastic import ElasticPolicy
+    from crdt_tpu.serve import CapacityOverflow
+
+    mesh = make_mesh(1, 1)
+    caps = dict(n_elems=8, n_actors=2, deferred_cap=1)
+    sb = Superblock(
+        4, mesh, kind="orswot", caps=dict(caps),
+        policy=ElasticPolicy(max_migrations=0),
+    )
+    q = IngestQueue(sb, lanes=2, depth=2)
+    streams = {0: [
+        (sb_ops.RM, 0, 0, np.asarray([1, 0], np.uint32), _mask(1)),
+        (sb_ops.RM, 0, 0, np.asarray([0, 1], np.uint32), _mask(2)),
+    ], 1: [(sb_ops.ADD, 0, 1, None, _mask(0))]}
+    _submit(q, streams)
+    with pytest.raises(CapacityOverflow) as exc:
+        q.drain()
+    assert exc.value.tenants == (0,)
+    # Tenant 1's op landed; tenant 0 rolled back to ⊥ with its ops
+    # back in the queue (front, original order); counts agree.
+    assert _trees_equal(sb.row(1), sb_ops.sequential_oracle(
+        sb.tk, sb.tk.empty(**sb.caps), streams[1]
+    ))
+    assert _trees_equal(sb.row(0), sb.empty_row())
+    assert q.n_pending == 2 and len(q.pending[0]) == 2
+    # A capacity fix drains the requeued ops to the oracle state.
+    sb.widen_capacity(deferred_cap=2)
+    q.drain()
+    _oracle_check(sb, streams)
+
+
+def test_restore_after_shrink_rewidens_superblock():
+    """A tenant evicted under WIDER caps restores after a shrink: the
+    superblock re-widens to cover the row (content is sacred), and the
+    row lands bit-identical."""
+    root = tempfile.mkdtemp(prefix="serve-shrink-restore-")
+    try:
+        mesh = make_mesh(1, 1)
+        caps = dict(n_elems=4, n_actors=2, deferred_cap=4)
+        sb = Superblock(2, mesh, kind="orswot", caps=dict(caps))
+        ev = Evictor(sb, root)
+        row, _ = sb.tk.apply_add(
+            sb.empty_row(), jnp.int32(0), jnp.uint32(1),
+            jnp.asarray(_mask(0, e=4)),
+        )
+        sb.write_row(0, row)
+        sb.dirty[0] = True
+        ev.evict([0])
+        assert sb.narrow_capacity(deferred_cap=2)
+        ev.restore(0)
+        assert sb.caps["deferred_cap"] == 4  # re-widened to fit
+        assert _trees_equal(sb.row(0), row)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+# ---- 4. tenant shards ----------------------------------------------------
+
+def test_shard_map_deterministic_and_minimal_remap():
+    a, b = TenantShardMap(8), TenantShardMap(8)
+    owners = {t: a.owner(t) for t in range(256)}
+    assert owners == {t: b.owner(t) for t in range(256)}
+    a.fail_over(3)
+    for t, h in owners.items():
+        if h != 3:
+            assert a.owner(t) == h  # untouched
+        else:
+            assert a.owner(t) != 3  # remapped off the dead host
+    with pytest.raises(ValueError):
+        TenantShardMap(1).fail_over(0)  # never the last host
+
+
+def test_sync_tenant_shards_joins_handoff_rows():
+    """Single-process DCN round (degenerate self-gather): handoff rows
+    for owned tenants JOIN into the superblock — the lattice join, so
+    a stale resident row and a fresher shipped row converge."""
+    mesh = make_mesh(1, 1)
+    caps = DENSE_CAPS
+    sb = Superblock(8, mesh, kind="orswot", caps=dict(caps))
+    smap = TenantShardMap(1)
+    q = IngestQueue(sb, lanes=1, depth=2)
+    q.add(3, 0, 1, _mask(0))
+    q.drain()
+    # A "remote" row for tenant 3 with a concurrent add under actor 1.
+    remote, _ = sb.tk.apply_add(
+        sb.empty_row(), jnp.int32(1), jnp.uint32(1), jnp.asarray(_mask(5))
+    )
+    from crdt_tpu.serve import export_rows, ingest_rows
+
+    sb2 = Superblock(8, mesh, kind="orswot", caps=dict(caps))
+    sb2.write_row(3, remote)
+    wire = export_rows(sb2, [3])
+    joined = ingest_rows(sb, smap, 0, wire)
+    assert joined == 1
+    members = set(np.where(np.asarray(sb.read(3)))[0])
+    assert members == {0, 5}
+    # The full exchange path (self-gather) also lands clean.
+    rep = sync_tenant_shards(sb, smap, 0, handoff=[3])
+    assert rep.tenants_shipped == 1
+    assert set(np.where(np.asarray(sb.read(3)))[0]) == {0, 5}
+
+
+def test_handoff_to_evicted_tenant_joins_durable_record():
+    """A handoff row for an EVICTED tenant must join its durable
+    record, not ⊥ — with an evictor the record restores first; without
+    one the case is refused loudly (silently joining ⊥ would let the
+    next persist destroy the durable state)."""
+    from crdt_tpu.serve import export_rows, ingest_rows
+
+    root = tempfile.mkdtemp(prefix="serve-handoff-evicted-")
+    try:
+        mesh = make_mesh(1, 1)
+        caps = DENSE_CAPS
+        sb = Superblock(8, mesh, kind="orswot", caps=dict(caps))
+        ev = Evictor(sb, root)
+        smap = TenantShardMap(1)
+        # Durable state {0} for tenant 3, then evict it.
+        row, _ = sb.tk.apply_add(
+            sb.empty_row(), jnp.int32(0), jnp.uint32(1),
+            jnp.asarray(_mask(0)),
+        )
+        sb.write_row(3, row)
+        sb.dirty[3] = True
+        ev.evict([3])
+        # A peer ships a concurrent {5} row for tenant 3.
+        remote, _ = sb.tk.apply_add(
+            sb.empty_row(), jnp.int32(1), jnp.uint32(1),
+            jnp.asarray(_mask(5)),
+        )
+        donor = Superblock(8, mesh, kind="orswot", caps=dict(caps))
+        donor.write_row(3, remote)
+        wire = export_rows(donor, [3])
+        with pytest.raises(ValueError):
+            ingest_rows(sb, smap, 0, wire)  # no evictor: refused
+        assert ingest_rows(sb, smap, 0, wire, evictor=ev) == 1
+        assert set(np.where(np.asarray(sb.read(3)))[0]) == {0, 5}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+# ---- 5. registry / static-check coverage --------------------------------
+
+def test_serve_surfaces_registered_and_entry_point_known():
+    assert unregistered_serve_surfaces() == []
+    assert {s.name for s in serve_surfaces()} >= {
+        "Superblock", "IngestQueue", "Evictor", "TenantShardMap",
+        "static_checks",
+    }
+    assert "mesh_serve_apply" in registered_entry_names()
+
+
+def test_serve_static_checks_clean():
+    assert static_checks() == []
+
+
+def test_mesh_serve_apply_donated_matches_undonated():
+    """The PR 3 donation contract on the serve dispatch: donate=True
+    consumes its input and lands bit-identical to the copying path."""
+    from crdt_tpu.parallel.serve_apply import _example
+
+    mesh = make_mesh(2, 1)
+    state, slab, idx = _example(mesh)
+    k = np.zeros(slab.kind.shape, np.uint8)
+    m = np.zeros(slab.member.shape, bool)
+    k[0, 0] = sb_ops.ADD
+    m[0, 0, 1] = True
+    ctr = np.zeros(slab.ctr.shape, np.uint32)
+    ctr[0, 0] = 1
+    slab = slab._replace(
+        kind=jnp.asarray(k), ctr=jnp.asarray(ctr), member=jnp.asarray(m)
+    )
+    out_copy, of_copy = mesh_serve_apply(
+        state, slab, idx, mesh, donate=False
+    )
+    state2, _, _ = _example(mesh)
+    out_don, of_don = mesh_serve_apply(
+        state2, slab, idx, mesh, donate=True
+    )
+    assert _trees_equal(out_copy, out_don)
+    assert bool(jnp.array_equal(of_copy, of_don))

@@ -78,6 +78,15 @@ Sections (each timed, each independently skippable):
   (``analysis.fixtures.bootstrap_skips_checksum``) must fail the
   corruption detector and the unacked-blind drain certifier
   (``fixtures.drain_ignores_unacked``) must fail the refusal detector.
+- ``serve``    — the multi-tenant serving gates
+  (crdt_tpu.serve.static_checks): serve-surface registry coverage
+  (every public operational symbol must have registered —
+  crdt_tpu.analysis.registry.register_serve_surface), the
+  coalesced==sequential-oracle micro A/B + pack/unpack round-trip,
+  the rendezvous minimal-remap failover property, and the broken-twin
+  detector gate — the dirt-dropping evictor
+  (``analysis.fixtures.evictor_drops_dirt``) must fail the
+  evict/restore preservation detector.
 - ``jit-lint``  — the jaxpr walker (crdt_tpu.analysis.jit_lint) over
   every registered mesh entry point: traced-branch, unstable-sort,
   float-accum, dtype-overflow, donation-alias, PLUS the collective-
@@ -125,8 +134,8 @@ sys.path.insert(0, ROOT)
 
 SECTIONS = (
     "lint", "schema", "laws", "schedules", "faults", "decomp",
-    "durability", "scaleout", "obs", "wire", "jit-lint", "cost",
-    "aliasing",
+    "durability", "scaleout", "obs", "wire", "serve", "jit-lint",
+    "cost", "aliasing",
 )
 
 # Directories the fallback linter walks (ruff takes its own config).
@@ -304,6 +313,12 @@ def run_wire():
     return static_checks()
 
 
+def run_serve():
+    from crdt_tpu.serve import static_checks
+
+    return static_checks()
+
+
 def run_jit_lint():
     from crdt_tpu.analysis.jit_lint import check_gates, lint_entry_points
 
@@ -342,6 +357,7 @@ RUNNERS = {
     "scaleout": run_scaleout,
     "obs": run_obs,
     "wire": run_wire,
+    "serve": run_serve,
     "jit-lint": run_jit_lint,
     "cost": run_cost,
     "aliasing": run_aliasing,
@@ -349,7 +365,7 @@ RUNNERS = {
 
 _JAX_SECTIONS = (
     "laws", "schedules", "faults", "decomp", "durability", "scaleout",
-    "obs", "wire", "jit-lint", "cost", "aliasing",
+    "obs", "wire", "serve", "jit-lint", "cost", "aliasing",
 )
 
 

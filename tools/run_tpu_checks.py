@@ -8,10 +8,13 @@ Runs, in order: a backend probe (fail-fast on a wedged relay, same
 mechanism as bench.py), the compiled fused-fold equality tests (plain
 orswot, Map<K, MVReg>, map_orswot + map3 nested levels), the n_passes
 streaming-equivalence A/B, the entry() compile check, a scaled
-fused-vs-tree bench sanity, the config-4/5/sparse legs, and the
-FLAGSHIP replica-streaming leg (10,240 x 1M via parallel/stream.py,
-shape replayed verbatim from BENCH_CONFIGS.json — degraded or
-non-bit-identical fails the check)."""
+fused-vs-tree bench sanity, the config-4/5/sparse legs, the FLAGSHIP
+replica-streaming leg (10,240 x 1M via parallel/stream.py, shape
+replayed verbatim from BENCH_CONFIGS.json — degraded or
+non-bit-identical fails the check), and the SERVE multi-tenant leg
+(1M+ live tenants through the tenant-packed superblock, same verbatim-
+replay rule — degraded, non-bit-identical, or missing its in-window
+evict→restore cycle fails the check)."""
 
 import importlib.util
 import os
@@ -201,6 +204,30 @@ def main() -> int:
     if rec["degraded"] or not rec["bit_identical"]:
         print("FAIL: flagship record degraded or not bit-identical")
         return 1
+
+    # The serving front door: 1M+ live tenants through the tenant-packed
+    # superblock, shape replayed VERBATIM from the committed
+    # BENCH_CONFIGS.json serve entry. The leg itself asserts the
+    # per-tenant sequential-oracle bit-identity and the in-window
+    # evict→restore cycle; here a degraded or non-bit-identical record
+    # is a failed check on real hardware.
+    t0 = time.time()
+    serve_recs = bench.bench_serve()
+    if serve_recs:
+        srv = serve_recs[0]
+        print(
+            f"serve {srv['tenants']:,} tenants ran  [{time.time()-t0:.0f}s] "
+            f"({srv['value']:,.0f} ops/s, dispatch p99 "
+            f"{srv['dispatch_p99_us']:,.0f} us, "
+            f"{srv['evict_restored_in_window']} evict→restore cycles, "
+            f"bit-identity gate {'OK' if srv['bit_identical'] else 'FAILED'})"
+        )
+        if srv.get("degraded") or not srv["bit_identical"]:
+            print("FAIL: serve record degraded or not bit-identical")
+            return 1
+        if srv["tenants"] < 1_000_000 or srv["evict_restored_in_window"] < 1:
+            print("FAIL: serve leg below the 1M-tenant / evict-restore gate")
+            return 1
 
     # In-process (libtpu is exclusive per process — a subprocess could
     # not reach the already-initialized chip).
