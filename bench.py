@@ -1759,6 +1759,286 @@ def bench_serve():
     }]
 
 
+def bench_fanout():
+    """δ-subscription fan-out egress leg (``--fanout`` runs it alone;
+    ISSUE 16's acceptance gate): ≥1M subscribers registered over the
+    churning 1M-tenant serve superblock, converged updates pushed back
+    out as cohort δ payloads —
+
+    1. **push window, timed** — cycles of hot-set writes through the
+       ingest queue, then one ``FanoutPlane.push`` per cycle: lagging/
+       dirty subscribers bucket into (tenant, acked watermark)
+       cohorts, pack into ``mesh_fanout_push`` dispatches (the PR 14
+       fused wire kernel over B·E client lanes), and the per-delivery
+       byte price rides ``delta_push_bytes`` / ``hist_push_bytes``.
+    2. **degradation + churn inside the window** — killed subscribers
+       never ack, so the ack window forces snapshot+suffix resyncs
+       (``resync_fallbacks``); subscriber churn re-subscribes fresh
+       ⊥-watermark clients mid-stream; an evicted cohort of SUBSCRIBED
+       tenants re-warms through the evictor on the next push.
+    3. **bit-identity** — sampled live client replicas (including
+       subscribers sharing tenants with dead ones — split watermark
+       buckets) plus one revived dead subscriber must land
+       bit-identical to their served rows, and EVERY subscriber's
+       acked watermark must converge to its tenant's served version.
+
+    The SAME committed shape runs on the CPU stand-in mesh — the gate
+    is ≥1M live subscribers THERE, and the δ price must beat the
+    full-state push ≥10×.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from crdt_tpu import telemetry as tele
+    from crdt_tpu.fanout import ClientReplica, FanoutPlane
+    from crdt_tpu.obs import hist as obs_hist
+    from crdt_tpu.parallel import make_mesh
+    from crdt_tpu.serve import Evictor, IngestQueue, Superblock
+
+    cfg = bench_configs()["fanout"]
+
+    def knob(key, env):
+        return int(os.environ.get(env, cfg[key]))
+
+    tenants = knob("tenants", "BENCH_FANOUT_TENANTS")
+    lanes = knob("lanes", "BENCH_FANOUT_LANES")
+    subscribers = knob("subscribers", "BENCH_FANOUT_SUBSCRIBERS")
+    cycles = knob("cycles", "BENCH_FANOUT_CYCLES")
+    ops_per_cycle = knob("ops_per_cycle", "BENCH_FANOUT_OPS_PER_CYCLE")
+    hot_set = knob("hot_set", "BENCH_FANOUT_HOT_SET")
+    dispatch_lanes = knob("dispatch_lanes", "BENCH_FANOUT_DISPATCH_LANES")
+    hot_shift = cfg["hot_shift"]
+    window_cap = cfg["window_cap"]
+    churn = knob("churn", "BENCH_FANOUT_CHURN")
+    kill_subscribers = cfg["kill_subscribers"]
+    client_sample = cfg["client_sample"]
+    evict_cohort = cfg["evict_cohort"]
+    p = min(cfg["mesh"][0], len(jax.devices()))
+    mesh = make_mesh(p, 1)
+    caps = dict(
+        n_elems=cfg["elems"], n_actors=cfg["actors"],
+        deferred_cap=cfg["deferred_cap"],
+    )
+    e, a = caps["n_elems"], caps["n_actors"]
+
+    sb = Superblock(tenants, mesh, kind="orswot", caps=caps, n_lanes=lanes)
+    root = tempfile.mkdtemp(prefix="bench-fanout-")
+    ev = Evictor(sb, root, pressure_batch=256)
+    q = IngestQueue(
+        sb, lanes=cfg["slab_lanes"], depth=cfg["slab_depth"],
+        max_pending=1 << 20, evictor=ev,
+    )
+    plane = FanoutPlane(
+        sb, evictor=ev, window_cap=window_cap,
+        dispatch_lanes=dispatch_lanes, capacity=subscribers,
+    )
+    # Subscriber i watches tenant i (every tenant covered); the pinned
+    # head tenants are touched EVERY cycle so the sampled replicas and
+    # the killed subscribers actually see traffic.
+    plane.subscribe(np.arange(subscribers, dtype=np.int64) % tenants)
+    pinned = client_sample + kill_subscribers
+    clients = {
+        s: ClientReplica("orswot", sb.empty_row())
+        for s in range(client_sample)
+    }
+    killed = np.arange(client_sample, pinned)
+    dead_sub = client_sample  # the one we revive and verify at the end
+    dead_client = ClientReplica("orswot", sb.empty_row())
+
+    rng = np.random.default_rng(163)
+    next_ctr = np.zeros(tenants, np.uint32)
+
+    def submit_cycle(cycle, n_ops):
+        off = (cycle * hot_shift) % max(tenants - hot_set, 1)
+        hot = rng.integers(off, off + hot_set, n_ops)
+        uni = rng.integers(0, tenants, n_ops)
+        ts = np.where(rng.random(n_ops) < 0.85, hot, uni)
+        ts[:pinned] = np.arange(pinned)  # the pinned head, every cycle
+        # ~6 touched elements per op regardless of row width — the op
+        # sparsity is the workload's, the row width is the tenant's.
+        masks = rng.random((n_ops, e)) < (6.0 / e)
+        for i in range(n_ops):
+            t = int(ts[i])
+            c = int(next_ctr[t]) + 1
+            next_ctr[t] = c
+            q.add(t, t % a, c, masks[i])
+        return np.unique(ts)
+
+    def deliver_and_ack(rep, revive=False):
+        """Simulate delivery: sampled replicas apply for real, every
+        other delivery is assumed received; acks promote everyone
+        except the killed set (until ``revive``)."""
+        n = 0
+        for cp in rep.pushes:
+            for s in cp.members:
+                s = int(s)
+                if s in clients:
+                    clients[s].apply_wire(cp.wire, cp.to_ver)
+                elif revive and s == dead_sub:
+                    dead_client.apply_wire(cp.wire, cp.to_ver)
+            n += len(cp.members)
+        for rs in rep.resyncs:
+            for s in rs.members:
+                s = int(s)
+                if s in clients:
+                    clients[s].adopt(rs.state, rs.to_ver)
+                elif revive and s == dead_sub:
+                    dead_client.adopt(rs.state, rs.to_ver)
+            n += len(rs.members)
+        for c in clients.values():
+            c.ack()
+        if revive:
+            dead_client.ack()
+        members = [cp.members for cp in rep.pushes + rep.resyncs]
+        if members:
+            allm = np.concatenate(members)
+            if not revive:  # the killed set never acks in the window
+                allm = allm[~np.isin(allm, killed)]
+            plane.ack(allm)
+        return n
+
+    rec, prev_rec, snap_base = _flight_start()
+    try:
+        # Warmup: compiles the slab apply + the fan-out dispatch (its
+        # ops and pushes are real; only the TIMING is excluded).
+        touched = submit_cycle(0, 512)
+        q.drain()
+        plane.note_dirty(touched)
+        deliver_and_ack(plane.push(telemetry=True))
+
+        tel = None
+        push_s = 0.0
+        deliveries = 0
+        delta_deliveries = 0
+        n_evicted = 0
+        rewarmed = False
+        for cycle in range(1, cycles + 1):
+            touched = submit_cycle(cycle, ops_per_cycle)
+            q.drain()
+            plane.note_dirty(touched)
+            if cycle == cycles // 2:
+                # Evict SUBSCRIBED (and sampled!) tenants mid-window:
+                # the next push must re-warm them through the evictor.
+                n_evicted = ev.evict(list(range(evict_cohort)))
+            t0 = time.perf_counter()
+            rep = plane.push(telemetry=True)
+            push_s += time.perf_counter() - t0
+            if cycle == cycles // 2:
+                rewarmed = all(
+                    sb.is_resident(t) for t in range(evict_cohort)
+                )
+            t = rep.telemetry
+            tel = t if tel is None else tele.combine(tel, t)
+            tele.record("fanout", t)
+            deliveries += rep.subscribers
+            delta_deliveries += sum(len(cp.members) for cp in rep.pushes)
+            deliver_and_ack(rep)
+            if churn:
+                # Subscriber churn: a random slice (outside the pinned
+                # head) leaves; as many fresh ⊥-watermark clients join
+                # on random tenants — hot landings re-sync organically.
+                drop = rng.integers(pinned, subscribers, churn)
+                plane.unsubscribe(np.unique(drop))
+                plane.subscribe(rng.integers(0, tenants, len(np.unique(drop))))
+        d = tele.to_dict(tel)
+        push_hist = obs_hist.summary(d["hist_push_bytes"])
+        flight = _flight_finish("fanout", rec, prev_rec, snap_base)
+
+        # Verification: revive the dead subscriber (its catch-up MUST
+        # come as a snapshot+suffix resync — its watermark fell out of
+        # the ack window long ago), then converge to quiescence.
+        for _ in range(window_cap + 2):
+            rep = plane.push()
+            if rep.cohorts == 0 and not rep.resyncs:
+                break
+            deliver_and_ack(rep, revive=True)
+        st = plane.sub_tenant[:plane._top]
+        alive = st >= 0
+        watermarks_current = bool(np.all(
+            plane.sub_ver[:plane._top][alive]
+            == plane.ver[np.where(alive, st, 0)][alive]
+        ))
+        mismatches = sum(
+            0 if c.equals(sb.row(s)) else 1 for s, c in clients.items()
+        )
+        if not dead_client.equals(sb.row(dead_sub)):
+            mismatches += 1
+        bit_identical = mismatches == 0 and watermarks_current
+        assert bit_identical, (
+            f"{mismatches} sampled client replicas diverged "
+            f"(watermarks_current={watermarks_current})"
+        )
+        assert plane.n_live >= 1_000_000, (
+            f"fanout leg served only {plane.n_live} subscribers — the "
+            f"gate is 1M+"
+        )
+        assert int(d["resync_fallbacks"]) >= 1 and plane.resyncs_total >= 1, (
+            "no dead-subscriber snapshot+suffix resync in the window"
+        )
+        assert n_evicted >= 1 and rewarmed, (
+            "no subscribed-tenant evict→re-warm cycle in the window"
+        )
+        row_b = sb.row_nbytes()
+        bytes_per_delta = d["delta_push_bytes"] / max(delta_deliveries, 1)
+        total_bytes = d["delta_push_bytes"] + d["bootstrap_bytes"]
+        ratio_delta = row_b / max(bytes_per_delta, 1e-9)
+        ratio_overall = deliveries * row_b / max(total_bytes, 1e-9)
+        assert ratio_overall >= 10, (
+            f"δ fan-out moved 1/{ratio_overall:.1f} of the full-state "
+            f"push — the gate is ≥10× (deliveries={deliveries} "
+            f"delta_deliveries={delta_deliveries} "
+            f"delta_bytes={d['delta_push_bytes']:.0f} "
+            f"resync_bytes={d['bootstrap_bytes']:.0f} "
+            f"resyncs={int(d['resync_fallbacks'])} row_b={row_b})"
+        )
+    except BaseException:
+        from crdt_tpu import obs as _obs
+
+        _obs.install(prev_rec)
+        raise
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    log(
+        f"config-fanout: {plane.n_live:,} live subscribers over "
+        f"{tenants:,} tenants ({lanes:,} lanes): {deliveries:,} δ "
+        f"deliveries in {push_s:.2f}s = {deliveries / push_s:,.0f} "
+        f"δ-pushes/s; {bytes_per_delta:,.0f} B/subscriber vs "
+        f"{row_b:,} B full row = {ratio_delta:.1f}× (overall "
+        f"{ratio_overall:.1f}× incl. {int(d['resync_fallbacks'])} "
+        f"resyncs); push p50 {push_hist['p50']:,.0f} B / p99 "
+        f"{push_hist['p99']:,.0f} B; {int(d['cohorts_per_dispatch']):,} "
+        f"cohorts dispatched; {n_evicted} subscribed tenants evicted "
+        f"and re-warmed; {len(clients) + 1} client replicas "
+        f"bit-identical"
+    )
+    return [{
+        "config": "fanout", "metric": "fanout_delta_pushes_per_sec",
+        "value": round(deliveries / push_s, 1), "unit": "deltas/s",
+        "subscribers": plane.n_live, "tenants": tenants, "lanes": lanes,
+        "deliveries": deliveries,
+        "delta_deliveries": delta_deliveries,
+        "bytes_per_subscriber": round(bytes_per_delta, 1),
+        "full_row_bytes": row_b,
+        "delta_vs_full_ratio": round(ratio_delta, 2),
+        "overall_vs_full_ratio": round(ratio_overall, 2),
+        "push_bytes_p50": round(push_hist["p50"], 1),
+        "push_bytes_p99": round(push_hist["p99"], 1),
+        "cohorts_dispatched": int(d["cohorts_per_dispatch"]),
+        "resync_fallbacks": int(d["resync_fallbacks"]),
+        "subscribers_live": int(d["subscribers_live"]),
+        "evicted_rewarmed": n_evicted,
+        "window_seconds": round(push_s, 3),
+        "subscriber_churn": churn * cycles,
+        "clients_verified": len(clients) + 1,
+        "bit_identical": bit_identical,
+        "shape": f"{subscribers}subs@{tenants}x{e}x{a}@{lanes}lanes",
+        **flight,
+    }]
+
+
 def bench_cpu() -> float:
     from crdt_tpu.pure.orswot import Orswot
     from crdt_tpu.vclock import VClock
@@ -2601,6 +2881,15 @@ def parse_args(argv=None):
              "its record to stdout",
     )
     ap.add_argument(
+        "--fanout",
+        action="store_true",
+        help="run ONLY the δ-subscription fan-out leg (1M+ subscribers "
+             "over the churning superblock: cohort δ pushes/s, bytes "
+             "per subscriber vs full-state push, dead-subscriber "
+             "resync, client bit-identity) and print its record to "
+             "stdout",
+    )
+    ap.add_argument(
         "--flagship",
         action="store_true",
         help="run ONLY the flagship replica-streaming leg (10,240 "
@@ -2650,6 +2939,26 @@ def main(argv=None):
             )
             log(json.dumps(rec))
         print(json.dumps(recs[0] if recs else {"config": "serve",
+                                               "skipped": True}))
+        return
+    if args.fanout:
+        # The fast fanout-only mode: one leg, one stdout JSON line.
+        if os.environ.get("BENCH_PROBE", "1") != "0" and not tpu_reachable():
+            from crdt_tpu.utils.cpu_pin import pin_cpu
+
+            pin_cpu(virtual_devices=8)
+            os.environ["BENCH_CPU_FALLBACK"] = "1"
+        from crdt_tpu.telemetry import span
+
+        with span("bench.fanout", quick=True):
+            recs = bench_fanout()
+        for rec in recs:
+            rec["degraded"] = bool(
+                rec.get("degraded", False)
+                or os.environ.get("BENCH_CPU_FALLBACK") == "1"
+            )
+            log(json.dumps(rec))
+        print(json.dumps(recs[0] if recs else {"config": "fanout",
                                                "skipped": True}))
         return
     if args.scaleout:
@@ -2781,6 +3090,7 @@ def main(argv=None):
         ("recovery", bench_recovery),
         ("scaleout", bench_scaleout),
         ("serve", bench_serve),
+        ("fanout", bench_fanout),
     ]:
         if os.environ.get(f"BENCH_{name.upper()}", "1") != "0":
             try:
@@ -2941,6 +3251,21 @@ def main(argv=None):
                 "resident_ratio", "evict_cohort",
                 "evict_restored_in_window", "bit_identical",
             ) if k in sv
+        }
+    # The fanout leg rides the headline record too: δ-pushes/s and
+    # bytes/subscriber vs the full-state push at 1M+ live subscribers
+    # (with the resync fallbacks and the client-replica bit-identity
+    # gate) is ISSUE 16's metric of record.
+    fo = next((r for r in records if r.get("config") == "fanout"), None)
+    if fo is not None:
+        headline["fanout"] = {
+            k: fo[k] for k in (
+                "value", "subscribers", "tenants",
+                "bytes_per_subscriber", "full_row_bytes",
+                "delta_vs_full_ratio", "overall_vs_full_ratio",
+                "resync_fallbacks", "cohorts_dispatched",
+                "bit_identical",
+            ) if k in fo
         }
     # The flagship streaming record rides the headline too: it IS the
     # metric of record at the north-star shape (ROADMAP item 1) — the

@@ -502,6 +502,22 @@ def evictor_drops_dirt(evictor, tenants):
     return evictor.evict(tenants, _persist_dirty=False)
 
 
+# ---- fan-out twins (crdt_tpu/fanout/) -------------------------------------
+
+def fanout_skips_watermark_bucket(plane):
+    """Broken fan-out twin: a pusher that skips the ⊥-watermark cohort
+    bucket — subscribers still acked at version 0 (fresh joins, slow
+    clients) simply never receive a δ, while the dirty-tenant fast
+    path keeps everyone else converged, so the starvation is invisible
+    to aggregate throughput. Exactly the cohort-selection bug
+    (bucketing by CURRENT version instead of by each subscriber's
+    acked watermark) the per-watermark cohort formation in
+    ``fanout.plane.FanoutPlane.push`` exists to prevent.
+    ``fanout.fanout_covers_cohorts`` must fail it (the ``fanout``
+    static-check section pins that the detector fires)."""
+    return plane.push(_skip_versions=(0,))
+
+
 # ---- observability twins (crdt_tpu/obs/) ----------------------------------
 
 def recorder_drops_events(capacity: int = 8, **kwargs):

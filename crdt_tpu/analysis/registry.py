@@ -212,6 +212,21 @@ class ServeSurface:
 
 
 @dataclass(frozen=True)
+class FanoutSurface:
+    """One registered δ-subscription fan-out surface (crdt_tpu/fanout/):
+    a public operational symbol of the fanout package — the
+    subscription plane, the cohort push driver, their detectors.
+    Registration is the coverage contract — the ``fanout`` static-check
+    section (tools/run_static_checks.py, via
+    ``crdt_tpu.fanout.static_checks``) fails discovery for any public
+    fanout symbol that forgot to register, exactly like an unregistered
+    join, mesh entry point, or fault/scaleout/serve surface."""
+
+    name: str
+    module: str = ""
+
+
+@dataclass(frozen=True)
 class WireSurface:
     """One registered fused-wire kernel instantiation
     (crdt_tpu/parallel/wire.py over crdt_tpu/ops/wire_kernels.py): a δ
@@ -273,6 +288,7 @@ _FAULT_SURFACES: Dict[str, FaultSurface] = {}
 _WIRE_SURFACES: Dict[str, WireSurface] = {}
 _SCALEOUT_SURFACES: Dict[str, ScaleoutSurface] = {}
 _SERVE_SURFACES: Dict[str, ServeSurface] = {}
+_FANOUT_SURFACES: Dict[str, FanoutSurface] = {}
 _OBS_EVENTS: Dict[str, ObsEvent] = {}
 
 # Public callables in crdt_tpu.parallel matching this are mesh entry
@@ -285,7 +301,11 @@ _OBS_EVENTS: Dict[str, ObsEvent] = {}
 # and aliasing sections both iterate this.
 # mesh_serve covers the tenant-packed serving dispatch family
 # (parallel/serve_apply.py — ISSUE 15).
-ENTRY_NAME_RE = re.compile(r"^mesh_(gossip|fold|delta_gossip|stream|serve)")
+# mesh_fanout covers the δ-subscription fan-out family
+# (parallel/fanout_push.py — ISSUE 16).
+ENTRY_NAME_RE = re.compile(
+    r"^mesh_(gossip|fold|delta_gossip|stream|serve|fanout)"
+)
 
 
 def register_merge(
@@ -507,6 +527,28 @@ def unregistered_serve_surfaces() -> List[str]:
     (:func:`_unregistered_package_surfaces` is the walk)."""
     return _unregistered_package_surfaces(
         "crdt_tpu.serve", _SERVE_SURFACES
+    )
+
+
+def register_fanout_surface(name: str, *, module: str = "") -> FanoutSurface:
+    fo = FanoutSurface(name=name, module=module)
+    _FANOUT_SURFACES[name] = fo
+    return fo
+
+
+def fanout_surfaces() -> Tuple[FanoutSurface, ...]:
+    import crdt_tpu.fanout  # noqa: F401  (registrations import-time)
+
+    return tuple(_FANOUT_SURFACES[k] for k in sorted(_FANOUT_SURFACES))
+
+
+def unregistered_fanout_surfaces() -> List[str]:
+    """Public operational ``crdt_tpu.fanout`` symbols that never called
+    :func:`register_fanout_surface` — the discovery gate of the
+    ``fanout`` static-check section
+    (:func:`_unregistered_package_surfaces` is the walk)."""
+    return _unregistered_package_surfaces(
+        "crdt_tpu.fanout", _FANOUT_SURFACES
     )
 
 

@@ -76,11 +76,14 @@ def _probe_states(n: int = 6):
 def _probe_workload(root: str, states) -> None:
     """The canonical micro-workload — crosses EVERY registered
     crashpoint when run uninterrupted: tiny segments force WAL
-    rotation, retain=1 with repeated saves forces pruning, and one
+    rotation, retain=1 with repeated saves forces pruning, one
     serving-tier tenant persist/restore crosses the ``serve.evict.*``
     / ``serve.restore.*`` boundaries (crdt_tpu/serve/evict.py — the
-    evict write-ordering the fuzz loop must be able to kill inside).
-    The serve tail never touches the main wal/snap dirs, so
+    evict write-ordering the fuzz loop must be able to kill inside),
+    and one fan-out subscribe→push→ack round crosses the
+    ``fanout.ack.*`` boundaries (crdt_tpu/fanout/plane.py — promote
+    and resync, the subscription state the fuzz loop kills inside).
+    The serve and fanout tails never touch the main wal/snap dirs, so
     ``_probe_recover``'s last-durable-record contract is unchanged."""
     import os
 
@@ -105,6 +108,24 @@ def _probe_workload(root: str, states) -> None:
 
     persist_tenant(os.path.join(root, "serve"), "probe", 0, states[-1])
     restore_tenant(os.path.join(root, "serve"), "probe", 0, states[0])
+    # The fan-out tail: window_cap=0 degrades the one dirty ⊥-watermark
+    # subscriber straight to resync (fanout.ack.pre_resync — no wire
+    # dispatch to compile), then the genuine ack promotes its watermark
+    # (fanout.ack.pre_promote / post_promote). Host-side registry state
+    # only — nothing durable, the recovery contract is untouched.
+    from ..fanout import FanoutPlane
+    from ..parallel import make_mesh
+    from ..serve import Superblock
+
+    sb = Superblock(
+        1, make_mesh(1, 1), kind="orswot",
+        caps=dict(n_elems=4, n_actors=2, deferred_cap=2),
+    )
+    plane = FanoutPlane(sb, window_cap=0, dispatch_lanes=1)
+    ids = plane.subscribe([0])
+    plane.note_dirty([0])
+    plane.push()
+    plane.ack(ids)
 
 
 def _probe_recover(root: str, states):

@@ -1,0 +1,194 @@
+"""crdt_tpu.fanout — the δ-subscription fan-out plane (ISSUE 16).
+
+The serving tier (crdt_tpu/serve/) gets writes IN at device speed;
+this package pushes converged updates back OUT to a million thin
+clients. Three cooperating pieces (see each module's docstring):
+
+- :mod:`.plane` — :class:`FanoutPlane`: the subscription registry
+  (clients register ``(tenant, acked watermark)`` interests) and push
+  driver. Subscribers sharing an acked watermark form a COHORT — one
+  join-irreducible decomposition and one wire payload serve them all —
+  and cohorts pack into ``mesh_fanout_push`` dispatches riding the
+  superblock's tenant→lane indirection (so the registry survives
+  eviction/re-warm). Watermarks promote ONLY on positive ack
+  (delta_opt/ackwin.py semantics host-side); out-of-window subscribers
+  degrade to the PR 10/11 snapshot+suffix bootstrap, never unbounded
+  buffering.
+- :mod:`crdt_tpu.ops.fanout_kernels` / ``parallel/fanout_push.py`` —
+  the device half: the PR 14 fused wire kernel generalized from P ring
+  links to B·E client lanes (one ``wire_pack`` launch per dispatch,
+  biased-u16 delta vs the acked base, bit-packed residual bitmaps).
+- :mod:`.client` — :class:`ClientReplica`: the thin-client receive
+  half; its acked ``base`` equals the encoder's base bit-exactly by
+  promote-on-ack, which is what makes the wire decode sound and the
+  replay property (client ≡ served tenant at every acked watermark)
+  hold.
+
+Plus :func:`static_checks` — the ``fanout`` section of
+tools/run_static_checks.py: surface-registry coverage, the
+encode/decode round-trip + push/replay micro A/B, and the broken-twin
+gate (the watermark-bucket-skipping pusher in ``analysis.fixtures``
+must be caught by :func:`plane.fanout_covers_cohorts`).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .client import ClientReplica
+from .plane import (
+    CohortPush,
+    CohortResync,
+    FanoutPlane,
+    PushReport,
+    fanout_covers_cohorts,
+)
+
+
+def static_checks() -> List:
+    """The ``fanout`` static-check section (Finding list, empty =
+    clean):
+
+    1. **surface coverage** — every public operational symbol of this
+       package must have called
+       ``analysis.registry.register_fanout_surface`` (the
+       registration-is-the-coverage-contract rule).
+    2. **push/replay micro A/B** — a two-subscriber workload with split
+       acked watermarks must land BOTH client replicas bit-identical
+       to the served tenant (one cohort per watermark bucket), and the
+       cohort wire encode/decode must round-trip the decomposition
+       bit-exactly.
+    3. **broken twin fires** — the bucket-skipping pusher twin
+       (``analysis.fixtures.fanout_skips_watermark_bucket``) must FAIL
+       :func:`plane.fanout_covers_cohorts`; the honest
+       :meth:`FanoutPlane.push` must pass.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..analysis import fixtures
+    from ..analysis.registry import (
+        get_decomposer,
+        unregistered_fanout_surfaces,
+    )
+    from ..analysis.report import Finding
+    from ..ops import superblock as sb_ops
+    from ..ops.fanout_kernels import (
+        cohort_deltas,
+        cohort_wire_decode,
+        cohort_wire_encode,
+    )
+
+    findings: List[Finding] = []
+
+    for name in unregistered_fanout_surfaces():
+        findings.append(Finding(
+            "fanout-surface-coverage", name,
+            "public fanout symbol never called register_fanout_surface "
+            "— the fanout gate cannot see it",
+        ))
+
+    # 2. encode/decode round-trip on a micro cohort batch.
+    try:
+        caps = dict(n_elems=4, n_actors=2, deferred_cap=2)
+        tk = sb_ops.tenant_kind("orswot")
+        m = lambda *on: jnp.asarray(np.isin(np.arange(4), on))  # noqa: E731
+        live = tk.empty(**caps)
+        live, _ = tk.apply_add(live, jnp.int32(0), jnp.uint32(1), m(0, 1))
+        live, _ = tk.apply_add(live, jnp.int32(1), jnp.uint32(1), m(2))
+        base = tk.empty(**caps)
+        base, _ = tk.apply_add(base, jnp.int32(0), jnp.uint32(1), m(0, 1))
+        rows = jax.tree.map(lambda a, b: jnp.stack([a, b]), live, base)
+        bases = jax.tree.map(lambda b: jnp.stack([b, b]), base)
+        d = cohort_deltas("orswot", rows, bases)
+        base_lanes, base_res = get_decomposer("orswot").split(bases)
+        wire = cohort_wire_encode(d, jax.tree.leaves(base_lanes)[0])
+        rt = cohort_wire_decode(
+            wire, jax.tree.leaves(base_lanes)[0], base_res
+        )
+        ok = (
+            bool(jnp.array_equal(d.valid, rt.valid))
+            and all(
+                bool(jnp.array_equal(
+                    jnp.where(
+                        d.valid.reshape(
+                            d.valid.shape + (1,) * (x.ndim - 2)
+                        ),
+                        x, jnp.zeros_like(x),
+                    ),
+                    jnp.where(
+                        d.valid.reshape(
+                            d.valid.shape + (1,) * (y.ndim - 2)
+                        ),
+                        y, jnp.zeros_like(y),
+                    ),
+                ))
+                for x, y in zip(
+                    jax.tree.leaves(d.lanes), jax.tree.leaves(rt.lanes)
+                )
+            )
+            and all(
+                bool(jnp.array_equal(x, y))
+                for x, y in zip(
+                    jax.tree.leaves(d.residual),
+                    jax.tree.leaves(rt.residual),
+                )
+            )
+        )
+        if not ok:
+            findings.append(Finding(
+                "fanout-wire-roundtrip", "cohort_wire_encode",
+                "cohort wire decode is not the bit-exact inverse of "
+                "encode on the micro batch",
+            ))
+        # Changed lanes must partition into keep ∪ defer exactly.
+        if not bool(jnp.array_equal(wire.keep | wire.defer, d.valid)):
+            findings.append(Finding(
+                "fanout-wire-roundtrip", "keep/defer",
+                "keep ∪ defer does not cover the changed-lane mask — "
+                "some δ lanes would never ship",
+            ))
+    except Exception as exc:
+        findings.append(Finding(
+            "fanout-wire-roundtrip", "micro-batch",
+            f"cohort wire micro A/B crashed: {type(exc).__name__}: "
+            f"{exc}",
+        ))
+
+    # 3. push/replay property + broken twin, both directions.
+    try:
+        if not fanout_covers_cohorts(lambda plane: plane.push()):
+            findings.append(Finding(
+                "fanout-cohort-coverage", "FanoutPlane.push",
+                "the honest pusher left a client replica diverged from "
+                "the served tenant across split watermark buckets",
+            ))
+        if fanout_covers_cohorts(fixtures.fanout_skips_watermark_bucket):
+            findings.append(Finding(
+                "broken-fixture-missed", "fanout_skips_watermark_bucket",
+                "the bucket-skipping pusher twin PASSED the cohort "
+                "coverage detector — the fanout gate is not actually "
+                "firing",
+            ))
+    except Exception as exc:
+        findings.append(Finding(
+            "fanout-cohort-coverage", "detector",
+            f"cohort coverage detector crashed: {type(exc).__name__}: "
+            f"{exc}",
+        ))
+    return findings
+
+
+from ..analysis.registry import register_fanout_surface as _reg  # noqa: E402
+
+for _name in (
+    "FanoutPlane", "ClientReplica", "fanout_covers_cohorts",
+    "static_checks",
+):
+    _reg(_name, module=__name__)
+
+__all__ = [
+    "ClientReplica", "CohortPush", "CohortResync", "FanoutPlane",
+    "PushReport", "fanout_covers_cohorts", "static_checks",
+]

@@ -1,0 +1,92 @@
+"""The thin-client replica — the receive half of the fan-out plane.
+
+A :class:`ClientReplica` is what one of the million subscribers runs:
+its CRDT row plus the two-version discipline that makes the wire
+decode exact. ``base`` is the state at the client's ACKED watermark —
+bit-identical to the snapshot the plane stored when it shipped that
+version, which is the promote-on-ack invariant
+(crdt_tpu/fanout/plane.py) — and ``state`` is the latest APPLIED
+payload, possibly ahead of ``base`` while the ack is in flight. Every
+δ payload decodes against ``base`` (the encoder's base for this
+cohort, by construction), so applying is idempotent and re-shipped
+payloads after a lost ack land on the same decode base instead of a
+drifted one. :meth:`ack` promotes ``base`` to the applied state — call
+it exactly when the ack is handed to the plane.
+
+Plain lax on the receive path (``wire_unpack`` convention: decode
+fuses with reconstruct; the fused kernel earns its keep on the send
+side). Host-friendly: leaves may be numpy throughout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..delta_opt.decompose import Decomposition, reconstruct
+from ..ops.fanout_kernels import CohortWire, cohort_wire_decode
+
+
+class ClientReplica:
+    """One subscriber's replica: ``state`` (latest applied), ``base``
+    (acked watermark state), ``ver`` (acked version) and ``pend`` (the
+    last applied-but-unacked version). Start it from the tenant kind's
+    empty row — version 0 is ⊥ everywhere in the plane."""
+
+    def __init__(self, kind: str, empty_row):
+        self.kind = kind
+        self.base = empty_row
+        self.state = empty_row
+        self.ver = 0
+        self.pend = 0
+
+    def _split_base(self):
+        from ..analysis.registry import get_decomposer
+
+        rows = jax.tree.map(lambda x: jnp.asarray(x)[None], self.base)
+        return get_decomposer(self.kind).split(rows)
+
+    def apply_wire(self, wire: CohortWire, to_ver: int) -> None:
+        """Apply one cohort payload (leading batch axis 1 — the
+        ``wire_lane`` slice the plane hands out). Decodes against the
+        acked ``base``, never the possibly-ahead ``state``, so a
+        re-shipped payload after a lost ack is harmless."""
+        lanes, res = self._split_base()
+        base_ctr = jax.tree.leaves(lanes)[0]
+        d = cohort_wire_decode(wire, base_ctr, res)
+        d1 = Decomposition(
+            lanes=jax.tree.map(lambda x: x[0], d.lanes),
+            valid=d.valid[0],
+            residual=jax.tree.map(lambda x: x[0], d.residual),
+        )
+        self.state = reconstruct(self.kind, self.base, d1)
+        self.pend = int(to_ver)
+
+    def adopt(self, state, to_ver: int) -> None:
+        """The snapshot+suffix resync landing (bootstrap path): adopt
+        the shipped state wholesale — it is bit-identical to the served
+        row by the bootstrap contract."""
+        self.state = state
+        self.pend = int(to_ver)
+
+    def ack(self) -> None:
+        """Promote the acked watermark to the applied state — call
+        exactly when the ack is handed to ``FanoutPlane.ack`` (the two
+        promotions are the one protocol step, split across the wire)."""
+        self.base = self.state
+        self.ver = self.pend
+
+    def equals(self, row) -> bool:
+        """Bit-exact leaf-wise comparison against a served row (the
+        fan-out property: a subscriber replaying its δ stream from the
+        acked watermark IS the served tenant)."""
+        mine = jax.tree.leaves(self.state)
+        theirs = jax.tree.leaves(row)
+        return len(mine) == len(theirs) and all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(mine, theirs)
+        )
+
+
+__all__ = ["ClientReplica"]

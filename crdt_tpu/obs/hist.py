@@ -88,6 +88,27 @@ def observe(h: Hist, value) -> Hist:
     )
 
 
+def observe_vec(h: Hist, values, mask=None) -> Hist:
+    """Count a whole vector of observations in ONE scatter-add (the
+    fan-out dispatch's per-cohort push-bytes path — B lanes per call,
+    so a per-lane ``observe`` loop would unroll B scatters into the
+    traced program). ``mask`` selects which lanes count (False lanes
+    contribute nothing — the empty-dispatch-lane convention). Bucket
+    indices use the same exact edge comparisons as
+    :func:`bucket_index`, so a host replay folds bit-identically."""
+    v = jnp.maximum(jnp.asarray(values).astype(jnp.float32), 0.0)
+    m = (
+        jnp.ones(v.shape, bool) if mask is None
+        else jnp.asarray(mask, bool)
+    )
+    e = jnp.asarray(EDGES, jnp.float32)
+    idx = jnp.sum(v[:, None] > e[None, :], axis=-1, dtype=jnp.int32)
+    return Hist(
+        counts=h.counts.at[idx].add(m.astype(jnp.uint32)),
+        total=h.total + jnp.sum(jnp.where(m, v, 0.0)),
+    )
+
+
 def merge(a: Hist, b: Hist) -> Hist:
     """Fold two histograms (counts and totals both add — the
     ``telemetry.combine`` discipline for distribution fields)."""
@@ -163,6 +184,7 @@ def summary(d: Dict[str, Any]) -> Dict[str, float]:
 
 __all__ = [
     "EDGES", "Hist", "NBUCKETS", "bucket_index", "is_hist_field",
-    "merge", "observe", "psum", "quantile", "summary", "to_dict",
+    "merge", "observe", "observe_vec", "psum", "quantile", "summary",
+    "to_dict",
     "zeros",
 ]

@@ -1,0 +1,224 @@
+"""Cohort δ fan-out kernels — the PR 14 fused wire format generalized
+from P ring links to millions of client links (ISSUE 16 tentpole).
+
+The subscription plane (crdt_tpu/fanout/) buckets subscribers by acked
+watermark so ONE join-irreducible decomposition serves a whole cohort:
+a dispatch gathers B superblock rows next to their B cohort base rows
+(each base is the bit-exact state the cohort's clients positively
+acked) and
+
+- :func:`cohort_deltas` vmaps the registered decomposition
+  (delta_opt/decompose.py) over the batch — one traced program, B
+  independent ``decompose(live, acked_base)`` lanes;
+- :func:`cohort_wire_encode` runs the WHOLE batch's δ clock lanes
+  through a SINGLE :func:`~crdt_tpu.ops.wire_kernels.wire_pack` call —
+  the ``[B, E, A]`` element birth-clock planes flatten to ``B·E`` wire
+  rows of ``A`` columns, so the fused Pallas pass (biased-u16 delta vs
+  the acked base, two lanes per u32 word, checksum + packed-word count
+  in the same read) prices ONE kernel launch per dispatch instead of
+  one per link, which is the whole reason a 1M-subscriber fan-out can
+  run at device speed;
+- rows outside the u16 window DEFER to a raw-lane fallback (``raw``
+  carries them verbatim — a fan-out client has no ring to re-mark
+  dirty, so unencodable rows ship wide instead of starving);
+- the residual planes (top clock + bounded parked buffers) ride whole
+  per cohort, bool planes bit-packed 8× by
+  :func:`~crdt_tpu.ops.wire_kernels.pack_bits`.
+
+:func:`cohort_wire_decode` inverts the wire bit-exactly against the
+client's OWN state (which equals the acked base by the plane's
+promote-on-ack invariant — delta_opt/ackwin.py semantics host-side),
+and ``reconstruct(kind, client_state, d)`` then lands the client
+replica bit-identical to the served tenant row (the fanout property
+tests/test_fanout.py pins, including across churn and resync).
+
+:func:`cohort_push_bytes` is the honest per-cohort wire price (the
+``delta_push_bytes`` / ``hist_push_bytes`` telemetry unit): kept rows
+at the packed width, deferred rows at the raw width, plus the two
+row bitmaps and the packed residual.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..delta_opt.decompose import Decomposition, decompose
+from .wire_kernels import (
+    WireLaneSpec,
+    pack_bits,
+    unpack_bits,
+    wire_pack,
+)
+
+
+class CohortWire(NamedTuple):
+    """One dispatch's packed fan-out payload: B cohorts, E δ lanes per
+    cohort, A clock columns per lane (``W = (A + 1) // 2`` packed
+    words). ``keep`` / ``defer`` partition ``valid`` (a changed lane
+    either fits the biased-u16 window or ships raw); ``residual`` is
+    the per-cohort residual pytree with bool planes bit-packed.
+    ``nnz`` / ``chk`` are the fused kernel's packed-word count and
+    integrity-checksum partial, mesh-folded per dispatch."""
+
+    words: jax.Array    # [B, E, W] u32 — packed biased-u16 δ lanes
+    keep: jax.Array     # [B, E] bool — lanes on the packed wire
+    defer: jax.Array    # [B, E] bool — changed lanes shipping raw
+    valid: jax.Array    # [B, E] bool — the decomposition's lane mask
+    raw: jax.Array      # [B, E, A] — deferred lanes verbatim, else 0
+    residual: Any       # per-cohort residual, bool planes bit-packed
+    nnz: jax.Array      # u32 — nonzero packed words
+    chk: jax.Array      # u32 — checksum partial over the packed words
+
+
+def cohort_deltas(kind: str, rows, bases) -> Decomposition:
+    """B independent ``decompose(live_row, acked_base)`` lanes in one
+    vmapped pass (leading batch axis on every leaf of ``rows`` /
+    ``bases``). Pure where/select on static shapes — safe inside the
+    ``mesh_fanout_push`` shard_map body."""
+    return jax.vmap(lambda r, b: decompose(kind, r, b))(rows, bases)
+
+
+def _ctr_plane(d: Decomposition) -> jax.Array:
+    lanes = jax.tree.leaves(d.lanes)
+    if len(lanes) != 1 or lanes[0].ndim != 3:
+        raise ValueError(
+            "cohort wire encode needs a single [B, E, A] clock row "
+            f"plane (dense orswot-family decomposition), got "
+            f"{[tuple(x.shape) for x in lanes]}"
+        )
+    return lanes[0]
+
+
+def _pack_residual(res):
+    """Bool residual planes as per-cohort little-endian bitmaps (the
+    ``pack_bits`` wire form, 8× over byte-per-bool); other planes ride
+    unchanged."""
+    return jax.tree.map(
+        lambda x: jax.vmap(pack_bits)(x.reshape(x.shape[0], -1))
+        if x.dtype == jnp.bool_ else x,
+        res,
+    )
+
+
+def _unpack_residual(packed, like):
+    """Invert :func:`_pack_residual` given any pytree with the
+    original residual's shapes/dtypes (the client's own split residual
+    works — shapes are capacity-static)."""
+    def un(p, l):
+        if l.dtype != jnp.bool_:
+            return p
+        n = math.prod(l.shape[1:]) if len(l.shape) > 1 else 1
+        flat = jax.vmap(lambda w: unpack_bits(w, n))(p)
+        return flat.reshape(l.shape)
+
+    return jax.tree.map(un, packed, like)
+
+
+def cohort_wire_encode(
+    d: Decomposition,
+    base_ctr: jax.Array,
+    interpret: Optional[bool] = None,
+) -> CohortWire:
+    """Encode one dispatch's stacked decomposition against the cohort
+    bases' clock plane ``base_ctr [B, E, A]`` — ONE fused
+    :func:`wire_pack` pass over all ``B·E`` δ lanes (module
+    docstring). Backend dispatch follows the wire kernel: compiled on
+    TPU, the Pallas interpreter elsewhere (bit-identical)."""
+    ctr = _ctr_plane(d)
+    b, e, a = ctr.shape
+    spec = WireLaneSpec(lc=a)
+    out = wire_pack(
+        spec,
+        ctr.reshape(b * e, a),
+        base_ctr.reshape(b * e, a),
+        d.valid.reshape(b * e),
+        interpret=interpret,
+    )
+    keep = out.keep.reshape(b, e)
+    defer = out.defer.reshape(b, e)
+    return CohortWire(
+        words=out.words.reshape(b, e, spec.w),
+        keep=keep,
+        defer=defer,
+        valid=d.valid,
+        raw=jnp.where(defer[..., None], ctr, jnp.zeros_like(ctr)),
+        residual=_pack_residual(d.residual),
+        nnz=out.nnz,
+        chk=out.chk,
+    )
+
+
+def cohort_wire_decode(
+    wire: CohortWire, base_ctr: jax.Array, res_like
+) -> Decomposition:
+    """Invert :func:`cohort_wire_encode` bit-exactly: kept lanes
+    decode ``base + (enc16 - BIAS)`` against the client's own clock
+    plane (== the acked base, the plane's promote-on-ack invariant),
+    deferred lanes adopt the raw fallback, bool residual planes
+    unpack against ``res_like`` (any pytree with the residual's
+    shapes/dtypes). Plain lax — the receive side fuses with the
+    client's reconstruct, the kernel earns its keep on send
+    (wire_kernels.wire_unpack's convention)."""
+    from .wire_kernels import wire_unpack
+
+    b, e, a = base_ctr.shape
+    spec = WireLaneSpec(lc=a)
+    dec = wire_unpack(
+        spec,
+        wire.words.reshape(b * e, spec.w),
+        base_ctr.reshape(b * e, a),
+        wire.keep.reshape(b * e),
+        base_ctr.dtype,
+    ).reshape(b, e, a)
+    ctr = jnp.where(wire.defer[..., None], wire.raw, dec)
+    return Decomposition(
+        lanes=(ctr,),
+        valid=wire.valid,
+        residual=_unpack_residual(wire.residual, res_like),
+    )
+
+
+def cohort_push_bytes(wire: CohortWire) -> jax.Array:
+    """The per-cohort wire price ``[B] f32`` (``delta_push_bytes`` /
+    ``hist_push_bytes`` unit): packed words for kept lanes, raw lanes
+    for deferred ones, plus the static framing — the keep/defer
+    bitmaps and the (bit-packed) residual riding whole."""
+    b, e, w = wire.words.shape
+    a = wire.raw.shape[-1]
+    framing = 2 * ((e + 31) // 32) * 4 + sum(
+        (leaf.size // b) * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(wire.residual)
+    )
+    return (
+        jnp.sum(wire.keep, axis=-1, dtype=jnp.float32) * (4 * w)
+        + jnp.sum(wire.defer, axis=-1, dtype=jnp.float32)
+        * (a * wire.raw.dtype.itemsize)
+        + jnp.float32(framing)
+    )
+
+
+def wire_lane(wire: CohortWire, b: int) -> CohortWire:
+    """One cohort's slice of a dispatch wire (leading batch axis kept
+    at 1 — the shape :func:`cohort_wire_decode` expects): what the
+    plane hands every subscriber of cohort ``b``."""
+    sl = lambda x: x[b:b + 1]  # noqa: E731
+    return CohortWire(
+        words=sl(wire.words),
+        keep=sl(wire.keep),
+        defer=sl(wire.defer),
+        valid=sl(wire.valid),
+        raw=sl(wire.raw),
+        residual=jax.tree.map(sl, wire.residual),
+        nnz=wire.nnz,
+        chk=wire.chk,
+    )
+
+
+__all__ = [
+    "CohortWire", "cohort_deltas", "cohort_push_bytes",
+    "cohort_wire_decode", "cohort_wire_encode", "wire_lane",
+]

@@ -109,6 +109,7 @@ from .stream import (
     mesh_stream_fold_sparse_sharded,
 )
 from .delta_ring import delta_gossip_elastic
+from .fanout_push import mesh_fanout_push
 from .serve_apply import mesh_serve_apply
 from .delta import (
     DeltaPacket,
@@ -152,6 +153,7 @@ __all__ = [
     "mesh_stream_fold_sparse",
     "mesh_stream_fold_sparse_mvmap",
     "mesh_stream_fold_sparse_sharded",
+    "mesh_fanout_push",
     "mesh_serve_apply",
     "DeltaPacket",
     "apply_delta",

@@ -147,6 +147,13 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         live_tenants=jnp.zeros((), jnp.uint32),
         evicted_tenants=jnp.zeros((), jnp.uint32),
         ingest_coalesced_ops=jnp.zeros((), jnp.uint32),
+        # The fan-out fields are filled by the subscription plane
+        # (crdt_tpu/fanout/ FanoutPlane.annotate + mesh_fanout_push's
+        # telemetry body) — never on the anti-entropy paths.
+        subscribers_live=jnp.zeros((), jnp.uint32),
+        cohorts_per_dispatch=jnp.zeros((), jnp.uint32),
+        delta_push_bytes=jnp.zeros((), jnp.float32),
+        resync_fallbacks=jnp.zeros((), jnp.uint32),
         # The in-kernel histograms are zero unless the δ ring's loop
         # carry fills them in (delta_ring's _replace);
         # hist_dispatch_us is filled host-side (telemetry.time_dispatch
@@ -157,6 +164,7 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         hist_packed_bytes=_hist.zeros(),
         hist_dispatch_us=_hist.zeros(),
         hist_ingest_batch=_hist.zeros(),
+        hist_push_bytes=_hist.zeros(),
     )
 
 

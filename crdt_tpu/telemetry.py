@@ -119,6 +119,20 @@ shipped and sync metadata per round), measured natively per round:
   amortized away), and the per-flush applied-batch-size distribution
   (``IngestQueue.annotate`` — the ``stream_*``/``wal_*`` host-side
   fill discipline; 0/empty on every non-serving run).
+- ``subscribers_live`` / ``cohorts_per_dispatch`` /
+  ``delta_push_bytes`` / ``resync_fallbacks`` / ``hist_push_bytes`` —
+  the δ-subscription fan-out accounting (crdt_tpu/fanout/; registry
+  twins ``telemetry.<kind>.fanout.*`` plus a ``subscribers_live``
+  gauge): live registered subscribers (a gauge, filled host-side by
+  ``FanoutPlane.annotate``), watermark cohorts decomposed per push
+  dispatch (each one a shared δ-decompose amortized over its whole
+  cohort), δ payload bytes actually pushed to subscribers (post
+  zero-suppression — the bytes a thin client's wire carries), pushes
+  that degraded to the snapshot+suffix bootstrap resync instead of a
+  δ (slow/dead subscribers — scaleout/bootstrap.py), and the
+  per-cohort push-bytes distribution (in-kernel, riding the
+  ``mesh_fanout_push`` telemetry branch). 0/empty on every
+  non-fan-out run.
 - ``hist_residue`` / ``hist_useful_bytes`` / ``hist_ack_depth`` /
   ``hist_packed_bytes`` / ``hist_dispatch_us`` — the in-kernel
   DISTRIBUTIONS
@@ -200,12 +214,17 @@ class Telemetry(NamedTuple):
     live_tenants: jax.Array        # uint32 — served tenant population
     evicted_tenants: jax.Array     # uint32 — tenants parked in the durable tier
     ingest_coalesced_ops: jax.Array  # uint32 — ops that shared a slab lane
+    subscribers_live: jax.Array      # uint32 — live registered subscribers
+    cohorts_per_dispatch: jax.Array  # uint32 — watermark cohorts decomposed
+    delta_push_bytes: jax.Array      # float32 — δ bytes pushed to subscribers
+    resync_fallbacks: jax.Array      # uint32 — pushes degraded to bootstrap
     hist_residue: obs_hist.Hist    # per-round unshipped-backlog rows
     hist_useful_bytes: obs_hist.Hist  # per-round post-mask payload bytes
     hist_ack_depth: obs_hist.Hist  # per-round ack-window depth
     hist_packed_bytes: obs_hist.Hist  # per-round post-packing wire bytes
     hist_dispatch_us: obs_hist.Hist   # host-timed dispatch wall-clock (µs)
     hist_ingest_batch: obs_hist.Hist  # per-flush coalesced-batch op count
+    hist_push_bytes: obs_hist.Hist    # per-cohort δ push payload bytes
 
 
 def zeros() -> Telemetry:
@@ -243,12 +262,17 @@ def zeros() -> Telemetry:
         live_tenants=jnp.zeros((), jnp.uint32),
         evicted_tenants=jnp.zeros((), jnp.uint32),
         ingest_coalesced_ops=jnp.zeros((), jnp.uint32),
+        subscribers_live=jnp.zeros((), jnp.uint32),
+        cohorts_per_dispatch=jnp.zeros((), jnp.uint32),
+        delta_push_bytes=jnp.zeros((), jnp.float32),
+        resync_fallbacks=jnp.zeros((), jnp.uint32),
         hist_residue=obs_hist.zeros(),
         hist_useful_bytes=obs_hist.zeros(),
         hist_ack_depth=obs_hist.zeros(),
         hist_packed_bytes=obs_hist.zeros(),
         hist_dispatch_us=obs_hist.zeros(),
         hist_ingest_batch=obs_hist.zeros(),
+        hist_push_bytes=obs_hist.zeros(),
     )
 
 
@@ -297,6 +321,11 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         ingest_coalesced_ops=(
             a.ingest_coalesced_ops + b.ingest_coalesced_ops
         ),
+        cohorts_per_dispatch=(
+            a.cohorts_per_dispatch + b.cohorts_per_dispatch
+        ),
+        delta_push_bytes=a.delta_push_bytes + b.delta_push_bytes,
+        resync_fallbacks=a.resync_fallbacks + b.resync_fallbacks,
         hist_residue=obs_hist.merge(a.hist_residue, b.hist_residue),
         hist_useful_bytes=obs_hist.merge(
             a.hist_useful_bytes, b.hist_useful_bytes
@@ -311,6 +340,9 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         hist_ingest_batch=obs_hist.merge(
             a.hist_ingest_batch, b.hist_ingest_batch
         ),
+        hist_push_bytes=obs_hist.merge(
+            a.hist_push_bytes, b.hist_push_bytes
+        ),
         deferred_depth=b.deferred_depth,
         residue=b.residue,
         widen_pressure=b.widen_pressure,
@@ -319,6 +351,7 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         live_ranks=b.live_ranks,
         live_tenants=b.live_tenants,
         evicted_tenants=b.evicted_tenants,
+        subscribers_live=b.subscribers_live,
     )
 
 
@@ -490,12 +523,17 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "live_tenants": int(tel.live_tenants),
         "evicted_tenants": int(tel.evicted_tenants),
         "ingest_coalesced_ops": int(tel.ingest_coalesced_ops),
+        "subscribers_live": int(tel.subscribers_live),
+        "cohorts_per_dispatch": int(tel.cohorts_per_dispatch),
+        "delta_push_bytes": float(tel.delta_push_bytes),
+        "resync_fallbacks": int(tel.resync_fallbacks),
         "hist_residue": obs_hist.to_dict(tel.hist_residue),
         "hist_useful_bytes": obs_hist.to_dict(tel.hist_useful_bytes),
         "hist_ack_depth": obs_hist.to_dict(tel.hist_ack_depth),
         "hist_packed_bytes": obs_hist.to_dict(tel.hist_packed_bytes),
         "hist_dispatch_us": obs_hist.to_dict(tel.hist_dispatch_us),
         "hist_ingest_batch": obs_hist.to_dict(tel.hist_ingest_batch),
+        "hist_push_bytes": obs_hist.to_dict(tel.hist_push_bytes),
     }
 
 
@@ -569,6 +607,15 @@ def counter_increments(kind: str, d: Dict[str, Any]) -> Dict[str, int]:
         f"telemetry.{kind}.serve.ingest_coalesced_ops": d[
             "ingest_coalesced_ops"
         ],
+        f"telemetry.{kind}.fanout.cohorts_per_dispatch": d[
+            "cohorts_per_dispatch"
+        ],
+        f"telemetry.{kind}.fanout.delta_push_bytes": int(
+            d["delta_push_bytes"]
+        ),
+        f"telemetry.{kind}.fanout.resync_fallbacks": d[
+            "resync_fallbacks"
+        ],
     }
     # Histogram per-bucket counters fold bit-exactly across runs —
     # exactly what tools/obs_report.py cross-checks a dump against.
@@ -608,6 +655,9 @@ def record(kind: str, tel: Telemetry) -> None:
     metrics.observe(f"telemetry.{kind}.live_tenants", d["live_tenants"])
     metrics.observe(
         f"telemetry.{kind}.evicted_tenants", d["evicted_tenants"]
+    )
+    metrics.observe(
+        f"telemetry.{kind}.subscribers_live", d["subscribers_live"]
     )
     metrics.observe(f"telemetry.{kind}.deferred_depth", d["deferred_depth"])
     metrics.observe(f"telemetry.{kind}.residue", d["residue"])

@@ -87,6 +87,15 @@ Sections (each timed, each independently skippable):
   detector gate — the dirt-dropping evictor
   (``analysis.fixtures.evictor_drops_dirt``) must fail the
   evict/restore preservation detector.
+- ``fanout``   — the δ-subscription fan-out gates
+  (crdt_tpu.fanout.static_checks): fanout-surface registry coverage
+  (every public operational symbol must have registered —
+  crdt_tpu.analysis.registry.register_fanout_surface), the cohort
+  wire encode/decode bit-exact round-trip + keep∪defer partition,
+  the split-watermark push/replay property, and the broken-twin
+  detector gate — the watermark-bucket-skipping pusher
+  (``analysis.fixtures.fanout_skips_watermark_bucket``) must fail the
+  cohort coverage detector.
 - ``jit-lint``  — the jaxpr walker (crdt_tpu.analysis.jit_lint) over
   every registered mesh entry point: traced-branch, unstable-sort,
   float-accum, dtype-overflow, donation-alias, PLUS the collective-
@@ -134,8 +143,8 @@ sys.path.insert(0, ROOT)
 
 SECTIONS = (
     "lint", "schema", "laws", "schedules", "faults", "decomp",
-    "durability", "scaleout", "obs", "wire", "serve", "jit-lint",
-    "cost", "aliasing",
+    "durability", "scaleout", "obs", "wire", "serve", "fanout",
+    "jit-lint", "cost", "aliasing",
 )
 
 # Directories the fallback linter walks (ruff takes its own config).
@@ -319,6 +328,12 @@ def run_serve():
     return static_checks()
 
 
+def run_fanout():
+    from crdt_tpu.fanout import static_checks
+
+    return static_checks()
+
+
 def run_jit_lint():
     from crdt_tpu.analysis.jit_lint import check_gates, lint_entry_points
 
@@ -358,6 +373,7 @@ RUNNERS = {
     "obs": run_obs,
     "wire": run_wire,
     "serve": run_serve,
+    "fanout": run_fanout,
     "jit-lint": run_jit_lint,
     "cost": run_cost,
     "aliasing": run_aliasing,
@@ -365,7 +381,7 @@ RUNNERS = {
 
 _JAX_SECTIONS = (
     "laws", "schedules", "faults", "decomp", "durability", "scaleout",
-    "obs", "wire", "serve", "jit-lint", "cost", "aliasing",
+    "obs", "wire", "serve", "fanout", "jit-lint", "cost", "aliasing",
 )
 
 

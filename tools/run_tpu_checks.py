@@ -11,10 +11,14 @@ streaming-equivalence A/B, the entry() compile check, a scaled
 fused-vs-tree bench sanity, the config-4/5/sparse legs, the FLAGSHIP
 replica-streaming leg (10,240 x 1M via parallel/stream.py, shape
 replayed verbatim from BENCH_CONFIGS.json — degraded or
-non-bit-identical fails the check), and the SERVE multi-tenant leg
+non-bit-identical fails the check), the SERVE multi-tenant leg
 (1M+ live tenants through the tenant-packed superblock, same verbatim-
 replay rule — degraded, non-bit-identical, or missing its in-window
-evict→restore cycle fails the check)."""
+evict→restore cycle fails the check), and the FANOUT δ-subscription
+leg (1M+ subscribers pushed cohort δ payloads over the churning
+superblock, same verbatim-replay rule — degraded, non-bit-identical,
+below the 1M-subscriber / ≥10× δ-vs-full-state gates, or missing its
+dead-subscriber resync fails the check)."""
 
 import importlib.util
 import os
@@ -227,6 +231,37 @@ def main() -> int:
             return 1
         if srv["tenants"] < 1_000_000 or srv["evict_restored_in_window"] < 1:
             print("FAIL: serve leg below the 1M-tenant / evict-restore gate")
+            return 1
+
+    # The fan-out egress: 1M+ subscribers pushed cohort δ payloads over
+    # the churning superblock, shape replayed VERBATIM from the
+    # committed BENCH_CONFIGS.json fanout entry. The leg itself asserts
+    # the client-replica bit-identity (sampled live replicas + one
+    # revived dead subscriber), the in-window evict→re-warm cycle, and
+    # the ≥10× δ-vs-full-state byte gate; here a degraded or
+    # non-bit-identical record — or one below the 1M-subscriber /
+    # ratio / resync-fallback floors — is a failed check on hardware.
+    t0 = time.time()
+    fanout_recs = bench.bench_fanout()
+    if fanout_recs:
+        fo = fanout_recs[0]
+        print(
+            f"fanout {fo['subscribers']:,} subscribers ran  "
+            f"[{time.time()-t0:.0f}s] ({fo['value']:,.0f} δ-pushes/s, "
+            f"{fo['bytes_per_subscriber']:,.0f} B/subscriber vs "
+            f"{fo['full_row_bytes']:,} B full row = "
+            f"{fo['overall_vs_full_ratio']}x overall, "
+            f"{fo['resync_fallbacks']} resync fallbacks, bit-identity "
+            f"gate {'OK' if fo['bit_identical'] else 'FAILED'})"
+        )
+        if fo.get("degraded") or not fo["bit_identical"]:
+            print("FAIL: fanout record degraded or not bit-identical")
+            return 1
+        if (fo["subscribers"] < 1_000_000
+                or fo["overall_vs_full_ratio"] < 10
+                or fo["resync_fallbacks"] < 1):
+            print("FAIL: fanout leg below the 1M-subscriber / 10x-δ / "
+                  "resync-fallback gate")
             return 1
 
     # In-process (libtpu is exclusive per process — a subprocess could
