@@ -208,22 +208,43 @@ class FanoutPlane:
         whenever deliveries can be lost, so a client that missed the
         latest ship promotes the server only to what it actually
         holds; ``None`` trusts the last shipped version (in-order
-        synchronous transport). Idempotent across the ``fanout.ack.*``
+        synchronous transport). Promotion is monotonic: a reordered
+        stale ack is clamped to the current watermark and a claim
+        above the last shipped version is clamped down to it.
+        Idempotent across the ``fanout.ack.*``
         crashpoints: a kill between promote and clear re-acks to the
         SAME version, and an un-promoted kill leaves the pending mark
         for the re-ack."""
         ids = np.atleast_1d(np.asarray(ids, np.int64))
         crashpoints.hit(CP_ACK_PRE)
         pend = self.sub_pend[ids]
-        v = (
-            pend if versions is None
-            else np.atleast_1d(np.asarray(versions, np.int64))
-        )
+        if versions is None:
+            v = pend
+        else:
+            v = np.broadcast_to(
+                np.asarray(versions, np.int64), ids.shape
+            )
         ok = (pend >= 0) & (self.sub_tenant[ids] >= 0) & (v >= 0)
         sel = ids[ok]
-        self.sub_ver[sel] = v[ok]
+        # Lossy transports reorder and duplicate acks: clamp each
+        # promotion to [current watermark, last shipped version]. A
+        # stale ack arriving after a newer one must never regress
+        # sub_ver below the base the client actually decodes with (the
+        # next push would encode against an older snapshot and the
+        # client would silently reconstruct wrong state), and a claim
+        # above pend names a version this plane never shipped. max
+        # keeps the promote idempotent across the fanout.ack.*
+        # crashpoints.
+        self.sub_ver[sel] = np.maximum(
+            self.sub_ver[sel], np.minimum(v[ok], pend[ok])
+        )
         crashpoints.hit(CP_ACK_POST)
-        self.sub_pend[sel] = -1
+        # An ack BELOW the pending ship confirms an older payload only:
+        # the latest ship is still outstanding, so keep its pend mark —
+        # clearing it would gate out the real ack when a duplicate of
+        # an old one sneaks in first (lag-driven re-bucketing still
+        # covers the subscriber either way).
+        self.sub_pend[sel[v[ok] >= pend[ok]]] = -1
 
     def note_dirty(self, tenants) -> None:
         """Mark tenants changed since their last push (the ingest
@@ -269,7 +290,15 @@ class FanoutPlane:
         cycle, then host slices."""
         if len(tenants) == 0:
             return
-        lanes = jnp.asarray(self.sb.lane_of[tenants], jnp.int32)
+        lanes_host = np.asarray(self.sb.lane_of[tenants])
+        if np.any(lanes_host < 0):
+            lost = tenants[lanes_host < 0]
+            raise RuntimeError(
+                f"tenants {lost.tolist()} lost residency mid-cycle — a "
+                f"-1 lane would gather a wrapped index (another "
+                f"tenant's row) as the shipped base snapshot"
+            )
+        lanes = jnp.asarray(lanes_host, jnp.int32)
         host = jax.tree.map(
             np.asarray, sb_ops.gather_rows(self.sb.state, lanes)
         )
@@ -284,13 +313,23 @@ class FanoutPlane:
             for v in [v for v in vers if v < floor]:
                 del vers[v]
 
-    def _ensure_resident(self, tenant: int) -> None:
-        if self.sb.lane_of[tenant] >= 0:
-            return
+    def _ensure_resident(self, tenant: int, _exclude=()) -> None:
+        """Warm one tenant's lane before the cycle reads it. ``_exclude``
+        pins the cycle's whole pushed-tenant set (the ingest slab's
+        ``restore(t, _exclude=placed)`` discipline): a lane-pressure
+        eviction inside ``restore`` must never free a lane some OTHER
+        cohort of this same cycle is about to snapshot or dispatch
+        from. A push is also a touch — refreshing recency keeps
+        fan-out-restored tenants off the next pressure batch's cold
+        list (they would otherwise keep a stale ``last_touch`` and
+        thrash restore→evict→restore)."""
+        if self.sb.lane_of[tenant] < 0:
+            if self.ev is not None:
+                self.ev.restore(int(tenant), _exclude=_exclude)
+            else:
+                self.sb.ensure_resident(int(tenant))
         if self.ev is not None:
-            self.ev.restore(int(tenant))
-        else:
-            self.sb.ensure_resident(int(tenant))
+            self.ev.note_touch(int(tenant))
 
     # ---- the push cycle --------------------------------------------------
     def push(
@@ -331,53 +370,86 @@ class FanoutPlane:
         t_s = st[ids]
         v_s = self.sub_ver[:top][ids]
         pushed_tenants = np.unique(t_s)
-        for t in pushed_tenants:
-            self._ensure_resident(int(t))
-        bumped = pushed_tenants[dirty[pushed_tenants]]
-        self._snapshot(bumped)
-        self.dirt[bumped] = False
 
-        # Cohorts: subscribers sharing (tenant, acked version).
-        code = t_s * (int(self.ver.max()) + 2) + v_s
-        order = np.argsort(code, kind="stable")
-        ids, t_s, v_s = ids[order], t_s[order], v_s[order]
-        _, starts, counts = np.unique(
-            code[order], return_index=True, return_counts=True
-        )
-
-        wire_cohorts: List[tuple] = []
+        # Residency is the cycle's working-set bound: a chunk's tenants
+        # must hold their lanes from the batched snapshot gather through
+        # the lane-indexed dispatch, so a push over MORE tenants than
+        # the lane pool proceeds in pool-sized chunks. Each chunk pins
+        # ONLY its own tenants against the restores' lane-pressure
+        # evictions (the ingest slab's ``restore(t, _exclude=placed)``
+        # discipline — without the pin a mid-cycle eviction hands an
+        # already-warmed cohort's lane to another tenant and its row
+        # ships as the wrong δ base); a later chunk is free to page an
+        # earlier chunk's lanes out, because that chunk already shipped.
+        pushes: List[CohortPush] = []
         resyncs: List[CohortResync] = []
+        tel = None
+        n_cohorts = 0
+        n_subs = 0
         n_resync_subs = 0
         resync_bytes = 0.0
-        for s, c in zip(starts, counts):
-            t, v = int(t_s[s]), int(v_s[s])
-            members = ids[s:s + c]
-            target = int(self.ver[t])
-            if v == target:
-                continue  # already current (dirty push raced an ack)
-            if v in _skip_versions:
-                continue  # the broken-twin seam — never taken honestly
-            base = self._base_row(t, v)
-            if (target - v > self.window_cap) or base is None:
-                crashpoints.hit(CP_RESYNC_PRE)
-                from ..scaleout.bootstrap import bootstrap
+        chunk_cap = max(self.sb.n_lanes, 1)
+        for lo in range(0, len(pushed_tenants), chunk_cap):
+            chunk = pushed_tenants[lo:lo + chunk_cap]
+            pinned = set(map(int, chunk))
+            for t in chunk:
+                self._ensure_resident(int(t), _exclude=pinned)
+            bumped = chunk[dirty[chunk]]
+            self._snapshot(bumped)
+            self.dirt[bumped] = False
 
-                state, rep = bootstrap(self.kind, self.sb.row(t), base=base)
-                resyncs.append(CohortResync(
-                    tenant=t, to_ver=target,
-                    state=jax.tree.map(np.asarray, state), report=rep,
-                    members=members,
-                ))
-                self.sub_pend[members] = target
-                n_resync_subs += len(members)
-                resync_bytes += rep.bytes_shipped * len(members)
-                _rec.emit(
-                    "subscriber_resync", tenant=t, subscribers=len(members)
+            # Cohorts: subscribers sharing (tenant, acked version).
+            in_chunk = np.isin(t_s, chunk)
+            c_ids, c_t, c_v = ids[in_chunk], t_s[in_chunk], v_s[in_chunk]
+            code = c_t * (int(self.ver.max()) + 2) + c_v
+            order = np.argsort(code, kind="stable")
+            c_ids, c_t, c_v = c_ids[order], c_t[order], c_v[order]
+            _, starts, counts = np.unique(
+                code[order], return_index=True, return_counts=True
+            )
+
+            wire_cohorts: List[tuple] = []
+            for s, c in zip(starts, counts):
+                t, v = int(c_t[s]), int(c_v[s])
+                members = c_ids[s:s + c]
+                target = int(self.ver[t])
+                if v == target:
+                    continue  # already current (dirty push raced an ack)
+                if v in _skip_versions:
+                    continue  # the broken-twin seam — never taken honestly
+                base = self._base_row(t, v)
+                if (target - v > self.window_cap) or base is None:
+                    crashpoints.hit(CP_RESYNC_PRE)
+                    from ..scaleout.bootstrap import bootstrap
+
+                    state, rep = bootstrap(
+                        self.kind, self.sb.row(t), base=base
+                    )
+                    resyncs.append(CohortResync(
+                        tenant=t, to_ver=target,
+                        state=jax.tree.map(np.asarray, state), report=rep,
+                        members=members,
+                    ))
+                    self.sub_pend[members] = target
+                    n_resync_subs += len(members)
+                    resync_bytes += rep.bytes_shipped * len(members)
+                    _rec.emit(
+                        "subscriber_resync", tenant=t,
+                        subscribers=len(members),
+                    )
+                else:
+                    wire_cohorts.append((t, v, target, members, base))
+
+            chunk_pushes, chunk_tel = self._dispatch(wire_cohorts, telemetry)
+            pushes.extend(chunk_pushes)
+            n_cohorts += len(wire_cohorts)
+            n_subs += int(sum(len(m) for *_x, m, _b in wire_cohorts))
+            if chunk_tel is not None:
+                tel = (
+                    chunk_tel if tel is None
+                    else tele.combine(tel, chunk_tel)
                 )
-            else:
-                wire_cohorts.append((t, v, target, members, base))
 
-        pushes, tel = self._dispatch(wire_cohorts, telemetry)
         self.resyncs_total += n_resync_subs
         if telemetry:
             tel = tele.zeros() if tel is None else tel
@@ -389,9 +461,8 @@ class FanoutPlane:
                     tel.bootstrap_bytes + jnp.float32(resync_bytes)
                 ),
             ))
-        n_subs = int(sum(len(m) for *_x, m, _b in wire_cohorts))
         return PushReport(
-            pushes=pushes, resyncs=resyncs, cohorts=len(wire_cohorts),
+            pushes=pushes, resyncs=resyncs, cohorts=n_cohorts,
             subscribers=n_subs + n_resync_subs, telemetry=tel,
         )
 
@@ -408,6 +479,12 @@ class FanoutPlane:
         per_rank: List[List[tuple]] = [[] for _ in range(self.p)]
         for co in cohorts:
             lane = int(self.sb.lane_of[co[0]])
+            if lane < 0:
+                raise RuntimeError(
+                    f"tenant {co[0]} lost residency mid-cycle — a -1 "
+                    f"lane would dispatch another rank's row as this "
+                    f"cohort's delta base"
+                )
             per_rank[lane // self.sb.lanes_per_rank].append((lane, co))
         n_disp = max(
             (len(r) + lpr_disp - 1) // lpr_disp for r in per_rank

@@ -279,6 +279,98 @@ def test_subscription_survives_eviction_and_rewarm():
         shutil.rmtree(root, ignore_errors=True)
 
 
+def test_push_pressure_cannot_evict_mid_cycle():
+    """Re-warming one cohort's tenant under FULL lanes must never page
+    out a tenant this same cycle already warmed: the pressure batch is
+    as wide as the lane pool, so without the mid-cycle pin the restore
+    would free (and hand to another tenant) a lane the cycle is about
+    to snapshot and dispatch from — shipping another tenant's row as
+    this cohort's δ base."""
+    root = tempfile.mkdtemp(prefix="fanout-pressure-")
+    try:
+        mesh = make_mesh(1, 1)
+        sb = Superblock(3, mesh, kind="orswot", caps=CAPS, n_lanes=2)
+        ev = Evictor(sb, root, pressure_batch=2)
+        plane = FanoutPlane(sb, evictor=ev, window_cap=4,
+                            dispatch_lanes=2)
+        ids = plane.subscribe([0, 1])
+        clients = {
+            int(i): ClientReplica("orswot", sb.empty_row()) for i in ids
+        }
+        # t1 gets content, then is paged out (durable record on disk).
+        _touch(sb, plane, 1, [(0, 1, _mask(1, 2))])
+        assert ev.evict([1]) == 1
+        # t0 and the unsubscribed filler t2 fill both lanes.
+        _touch(sb, plane, 0, [(0, 1, _mask(0, 3))])
+        sb.ensure_resident(2)
+        assert sb.free_lanes == 0
+        rep = plane.push()
+        assert rep.cohorts == 2
+        assert sb.is_resident(0) and sb.is_resident(1)
+        assert not sb.is_resident(2)  # only the filler paid the pressure
+        _deliver(rep, clients)
+        _ack_all(plane, clients)
+        for i, t in zip(ids, (0, 1)):
+            assert clients[int(i)].equals(sb.row(t))
+        # A fan-out restore is a touch: t1's recency is fresh, so the
+        # next pressure batch does not immediately re-evict it.
+        assert int(ev.last_touch[1]) == ev.clock
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def test_stale_duplicate_ack_cannot_regress_watermark():
+    """Lossy transports reorder and duplicate acks: a stale ack must
+    neither regress the watermark below the client's decode base nor
+    clear the pending mark of a newer still-outstanding ship (which
+    would gate out the genuine ack behind it)."""
+    mesh = make_mesh(1, 1)
+    sb = Superblock(2, mesh, kind="orswot", caps=CAPS)
+    plane = FanoutPlane(sb, window_cap=4, dispatch_lanes=2)
+    (s,) = plane.subscribe([0])
+    clients = {int(s): ClientReplica("orswot", sb.empty_row())}
+    c = clients[int(s)]
+    _touch(sb, plane, 0, [(0, 1, _mask(0))])
+    _deliver(plane.push(), clients)
+    c.ack()
+    plane.ack([s], versions=[c.ver])  # genuine v1 ack
+    assert int(plane.sub_ver[int(s)]) == 1
+    _touch(sb, plane, 0, [(0, 2, _mask(4))])
+    _deliver(plane.push(), clients)
+    c.ack()  # the client's decode base is now v2
+    # Reordered duplicates of the old acks land first…
+    plane.ack([s], versions=[0])
+    assert int(plane.sub_ver[int(s)]) == 1   # no regress below v1
+    plane.ack([s], versions=[1])
+    assert int(plane.sub_ver[int(s)]) == 1
+    assert int(plane.sub_pend[int(s)]) == 2  # v2 ship still pending
+    # …then the genuine v2 ack must still promote.
+    plane.ack([s], versions=[c.ver])
+    assert int(plane.sub_ver[int(s)]) == 2
+    assert int(plane.sub_pend[int(s)]) == -1
+    # The δ stream continues bit-exact from the promoted base.
+    _touch(sb, plane, 0, [(1, 1, _mask(6))])
+    _converge(plane, clients)
+    assert c.equals(sb.row(0))
+
+
+def test_ack_scalar_versions_broadcasts():
+    mesh = make_mesh(1, 1)
+    sb = Superblock(2, mesh, kind="orswot", caps=CAPS)
+    plane = FanoutPlane(sb, window_cap=4, dispatch_lanes=2)
+    ids = plane.subscribe([0, 0])
+    clients = {
+        int(i): ClientReplica("orswot", sb.empty_row()) for i in ids
+    }
+    _touch(sb, plane, 0, [(0, 1, _mask(0, 1))])
+    _deliver(plane.push(), clients)
+    for c in clients.values():
+        c.ack()
+    plane.ack(ids, versions=1)  # one scalar fans out to every id
+    assert all(int(plane.sub_ver[int(i)]) == 1 for i in ids)
+    assert all(int(plane.sub_pend[int(i)]) == -1 for i in ids)
+
+
 # ---- 3. crashpoint fuzz ---------------------------------------------------
 
 FANOUT_CRASHPOINTS = (
