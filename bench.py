@@ -138,14 +138,17 @@ def _flight_start(capacity: int = 8192):
     return rec, prev, base
 
 
-def _flight_finish(name: str, rec, prev, base) -> dict:
+def _flight_finish(name: str, rec, prev, base, slo: bool = False) -> dict:
     """Dump the leg's flight artifact (gitignored
     ``BENCH_FLIGHT_<name>.jsonl``), replay it through
     tools/obs_report.py against the LIVE registry counters accrued
     since ``base``, ASSERT the bit-exact cross-check and a clean
     invariant audit (the ISSUE 12 acceptance gate), and return the
     record fields: dump path + folded p50/p95/p99 histogram summaries
-    (the p99 riding the headline BENCH record)."""
+    (the p99 riding the headline BENCH record). ``slo`` additionally
+    replays the op-journey trace events bit-exactly (``obs_report
+    --slo``) and ASSERTS the replay actually ran — a dump that dropped
+    trace events from the ring would only skip, not prove."""
     import sys
 
     from crdt_tpu import obs
@@ -169,13 +172,25 @@ def _flight_finish(name: str, rec, prev, base) -> dict:
     since = {"counters": {
         k: v - base_c.get(k, 0) for k, v in live.items()
     }}
-    report = obs_report.build_report(dump_path, snapshot=since)
+    report = obs_report.build_report(dump_path, snapshot=since, slo=slo)
     assert report["ok"], (
         f"flight dump failed the postmortem gate: "
         f"parse={report['parse_errors'][:2]} "
         f"mismatches={report['counter_mismatches'][:3]} "
         f"audit={[f for f in report['audit'] if f['severity'] == 'error'][:2]}"
+        + (f" replay={report['slo']['mismatches'][:2]}" if slo else "")
     )
+    extra = {}
+    if slo:
+        rp = report["slo"]
+        assert rp["skipped"] is None, (
+            f"trace replay skipped — not a bit-exact proof: "
+            f"{rp['skipped']}"
+        )
+        extra = {
+            "trace_replay_ok": rp["ok"],
+            "trace_replayed": rp["traces_completed"],
+        }
     hist = {
         key: {
             "count": s["count"],
@@ -190,6 +205,7 @@ def _flight_finish(name: str, rec, prev, base) -> dict:
         "flight_ok": True,
         "flight_events": report["events"],
         "hist": hist,
+        **extra,
     }
 
 
@@ -1559,7 +1575,9 @@ def bench_serve():
     import jax
 
     from crdt_tpu import telemetry as tele
+    from crdt_tpu.fanout import FanoutPlane
     from crdt_tpu.obs import hist as obs_hist
+    from crdt_tpu.obs import trace as obs_trace
     from crdt_tpu.ops import superblock as sb_ops
     from crdt_tpu.parallel import make_mesh
     from crdt_tpu.serve import Evictor, IngestQueue, Superblock
@@ -1580,6 +1598,7 @@ def bench_serve():
     evict_cohort = knob("evict_cohort", "BENCH_SERVE_EVICT_COHORT")
     retouch = cfg["retouch"]
     oracle_sample = cfg["oracle_sample"]
+    trace_sample = knob("trace_sample", "BENCH_SERVE_TRACE_SAMPLE")
     p = min(cfg["mesh"][0], len(jax.devices()))
     mesh = make_mesh(p, 1)
     caps = dict(
@@ -1595,6 +1614,17 @@ def bench_serve():
         sb, lanes=slab_lanes, depth=slab_depth, max_pending=1 << 20,
         evictor=ev,
     )
+    # The trace-completion plane (ISSUE 17): freshness is
+    # submit→client-ack, so every SAMPLED tenant gets one thin
+    # subscriber and the touched traced tenants' δs are pushed + acked
+    # at each cycle's end — sampled journeys complete inside the
+    # measured window instead of dying at dispatch.
+    traced = np.nonzero(obs_trace.sampled_mask(tenants, trace_sample))[0]
+    fan = FanoutPlane(
+        sb, evictor=ev, window_cap=4, dispatch_lanes=256,
+        capacity=max(len(traced), 1),
+    )
+    sub_ids = fan.subscribe(traced)
     rng = np.random.default_rng(151)
     next_ctr = np.zeros(tenants, np.uint32)
     history: dict = {}  # tenant -> [(kind, actor, ctr, clock, member)]
@@ -1627,15 +1657,35 @@ def bench_serve():
                 history.setdefault(t, []).append(
                     (sb_ops.RM, 0, 0, clock, m)
                 )
-        return n_ops
+        return np.unique(ts)
 
-    rec, prev_rec, snap_base = _flight_start()
+    def touch_one(t_):
+        """One explicit add (retouch / warmup seeding) that stays in
+        the oracle history like every other op."""
+        act = t_ % a
+        c = int(next_ctr[t_]) + 1
+        next_ctr[t_] = c
+        m = rng.random(e) < 0.4
+        q.add(t_, act, c, m)
+        history.setdefault(t_, []).append((sb_ops.ADD, act, c, None, m))
+
+    tr = prev_tr = None
+    rec, prev_rec, snap_base = _flight_start(capacity=32768)
     try:
-        # Warmup (compiles the apply + telemetry programs; its ops are
-        # real and stay in the oracle histories — only the TIMING is
-        # excluded from the measured window).
+        # Warmup (compiles the apply + telemetry programs AND the
+        # trace-completion fan-out dispatch; its ops are real and stay
+        # in the oracle histories — only the TIMING is excluded from
+        # the measured window).
         submit_cycle(0, 256)
+        touch_one(int(traced[0]))  # a dirty traced tenant → push compiles
         q.drain(telemetry=True)
+        fan.push(tenants=traced[:1])
+        fan.ack(sub_ids)
+
+        # The tracer installs AFTER warmup: every sampled journey it
+        # mints belongs to the measured window.
+        tr = obs_trace.Tracer(sample=trace_sample)
+        prev_tr = obs_trace.install_tracer(tr)
 
         tel = None
         total_ops = 0
@@ -1647,9 +1697,6 @@ def bench_serve():
             submit_cycle(cycle, ops_per_cycle)
             rep, t = q.drain(telemetry=True)
             total_ops += rep.ops_applied
-            if t is not None:
-                tel = t if tel is None else tele.combine(tel, t)
-                tele.record("serve", t)
             if cycle == cycles // 2:
                 # The cold-tenant cycle, inside the measured window:
                 # evict the coldest dirty cohort, then re-touch a slice
@@ -1658,28 +1705,41 @@ def bench_serve():
                 n_evicted = ev.evict(cold)
                 retouch_set = cold[:retouch]
                 for t_ in retouch_set:
-                    act = t_ % a
-                    c = int(next_ctr[t_]) + 1
-                    next_ctr[t_] = c
-                    m = rng.random(e) < 0.4
-                    q.add(t_, act, c, m)
-                    history.setdefault(t_, []).append(
-                        (sb_ops.ADD, act, c, None, m)
-                    )
+                    touch_one(t_)
                 rep2, t2 = q.drain(telemetry=True)
                 total_ops += rep2.ops_applied
                 restored_in_window = rep2.restored
                 if t2 is not None:
-                    tel = tele.combine(tel, t2)
+                    tel = t2 if tel is None else tele.combine(tel, t2)
                     tele.record("serve", t2)
+            # Close the cycle's sampled journeys: push every tenant
+            # with an open trace (all sampled, all subscribed) and ack
+            # its subscriber — freshness is submit→client-ack.
+            open_t = list(tr.open_traces())
+            if open_t:
+                fan.push(tenants=open_t)
+                fan.ack(sub_ids)
+            if t is not None:
+                # Annotate AFTER the acks so the record carries the
+                # cycle's own trace-latency histogram increments.
+                t = tr.annotate(t)
+                tel = t if tel is None else tele.combine(tel, t)
+                tele.record("serve", t)
         window_s = time.perf_counter() - t0
+        fresh = obs_hist.summary(tr.freshness_dict())
+        skew = obs_trace.skew_report(evictor=ev, queue=q, tracer=tr, k=8)
+        traces_minted, traces_completed = tr.minted, tr.completed
+        obs_trace.install_tracer(prev_tr)
+        assert traces_completed >= 1, (
+            "no sampled op journey completed inside the measured window"
+        )
         d = tele.to_dict(tel)
         disp = obs_hist.summary(d["hist_dispatch_us"])
         # The flight artifact covers the MEASURED window: finish (and
         # bit-exact-cross-check) it before the oracle phase, whose
         # verification restores page cold tenants in bulk and would
         # roll the ring past the window's telemetry events.
-        flight = _flight_finish("serve", rec, prev_rec, snap_base)
+        flight = _flight_finish("serve", rec, prev_rec, snap_base, slo=True)
 
         # Oracle bit-identity on a sampled subset (re-touched evictees
         # first — they crossed the durable tier inside the window).
@@ -1719,6 +1779,8 @@ def bench_serve():
     except BaseException:
         from crdt_tpu import obs as _obs
 
+        if tr is not None and obs_trace.get_tracer() is tr:
+            obs_trace.install_tracer(prev_tr)
         _obs.install(prev_rec)
         raise
     finally:
@@ -1733,7 +1795,10 @@ def bench_serve():
         f"{disp['p50']:,.0f} us / p99 {disp['p99']:,.0f} us; evicted "
         f"{n_evicted} cold tenants, {restored_in_window} restored from "
         f"disk in-window; {len(sample)} tenants oracle-checked "
-        f"bit-identical; coalesced {d['ingest_coalesced_ops']:,} ops"
+        f"bit-identical; coalesced {d['ingest_coalesced_ops']:,} ops; "
+        f"freshness p50 {fresh['p50']:,.0f} us / p95 "
+        f"{fresh['p95']:,.0f} us / p99 {fresh['p99']:,.0f} us over "
+        f"{traces_completed} traced journeys (1/{trace_sample} tenants)"
     )
     return [{
         "config": "serve", "metric": "serve_ops_per_sec",
@@ -1754,6 +1819,13 @@ def bench_serve():
         "widen_events": sb.widen_events,
         "oracle_sampled": len(sample),
         "bit_identical": bit_identical,
+        "freshness_p50_us": round(fresh["p50"], 1),
+        "freshness_p95_us": round(fresh["p95"], 1),
+        "freshness_p99_us": round(fresh["p99"], 1),
+        "traces_minted": traces_minted,
+        "traces_completed": traces_completed,
+        "trace_sample": trace_sample,
+        "hot_tenants": skew["tenants"],
         "shape": f"{tenants}x{e}x{a}@{lanes}lanes",
         **flight,
     }]
@@ -1794,6 +1866,7 @@ def bench_fanout():
     from crdt_tpu import telemetry as tele
     from crdt_tpu.fanout import ClientReplica, FanoutPlane
     from crdt_tpu.obs import hist as obs_hist
+    from crdt_tpu.obs import trace as obs_trace
     from crdt_tpu.parallel import make_mesh
     from crdt_tpu.serve import Evictor, IngestQueue, Superblock
 
@@ -1815,6 +1888,7 @@ def bench_fanout():
     kill_subscribers = cfg["kill_subscribers"]
     client_sample = cfg["client_sample"]
     evict_cohort = cfg["evict_cohort"]
+    trace_sample = knob("trace_sample", "BENCH_FANOUT_TRACE_SAMPLE")
     p = min(cfg["mesh"][0], len(jax.devices()))
     mesh = make_mesh(p, 1)
     caps = dict(
@@ -1899,14 +1973,25 @@ def bench_fanout():
             plane.ack(allm)
         return n
 
-    rec, prev_rec, snap_base = _flight_start()
+    # Warmup: compiles the slab apply + the fan-out dispatch (its ops
+    # and pushes are real; only the TIMING is excluded). It runs BEFORE
+    # the flight window: the artifact narrates the measured window, and
+    # the audit's cohort-conservation check demands every ring
+    # fanout_push ride a recorded telemetry — the warmup's never is.
+    touched = submit_cycle(0, 512)
+    q.drain()
+    plane.note_dirty(touched)
+    deliver_and_ack(plane.push(telemetry=True))
+
+    tr = prev_tr = None
+    rec, prev_rec, snap_base = _flight_start(capacity=32768)
     try:
-        # Warmup: compiles the slab apply + the fan-out dispatch (its
-        # ops and pushes are real; only the TIMING is excluded).
-        touched = submit_cycle(0, 512)
-        q.drain()
-        plane.note_dirty(touched)
-        deliver_and_ack(plane.push(telemetry=True))
+        # The tracer installs AFTER warmup: every sampled journey it
+        # mints belongs to the measured window. The plane's own
+        # per-cycle push→ack loop completes the journeys — no extra
+        # machinery, freshness falls out of the leg's real traffic.
+        tr = obs_trace.Tracer(sample=trace_sample)
+        prev_tr = obs_trace.install_tracer(tr)
 
         tel = None
         push_s = 0.0
@@ -1929,12 +2014,14 @@ def bench_fanout():
                 rewarmed = all(
                     sb.is_resident(t) for t in range(evict_cohort)
                 )
-            t = rep.telemetry
-            tel = t if tel is None else tele.combine(tel, t)
-            tele.record("fanout", t)
             deliveries += rep.subscribers
             delta_deliveries += sum(len(cp.members) for cp in rep.pushes)
             deliver_and_ack(rep)
+            # Annotate AFTER the acks so the record carries the cycle's
+            # own trace-latency histogram increments.
+            t = tr.annotate(rep.telemetry)
+            tel = t if tel is None else tele.combine(tel, t)
+            tele.record("fanout", t)
             if churn:
                 # Subscriber churn: a random slice (outside the pinned
                 # head) leaves; as many fresh ⊥-watermark clients join
@@ -1942,9 +2029,17 @@ def bench_fanout():
                 drop = rng.integers(pinned, subscribers, churn)
                 plane.unsubscribe(np.unique(drop))
                 plane.subscribe(rng.integers(0, tenants, len(np.unique(drop))))
+        fresh = obs_hist.summary(tr.freshness_dict())
+        skew = obs_trace.skew_report(evictor=ev, queue=q, tracer=tr, k=8)
+        traces_minted, traces_completed = tr.minted, tr.completed
+        obs_trace.install_tracer(prev_tr)
+        assert traces_completed >= 1, (
+            "no sampled op journey completed inside the push window"
+        )
         d = tele.to_dict(tel)
         push_hist = obs_hist.summary(d["hist_push_bytes"])
-        flight = _flight_finish("fanout", rec, prev_rec, snap_base)
+        flight = _flight_finish("fanout", rec, prev_rec, snap_base,
+                                slo=True)
 
         # Verification: revive the dead subscriber (its catch-up MUST
         # come as a snapshot+suffix resync — its watermark fell out of
@@ -1996,6 +2091,8 @@ def bench_fanout():
     except BaseException:
         from crdt_tpu import obs as _obs
 
+        if tr is not None and obs_trace.get_tracer() is tr:
+            obs_trace.install_tracer(prev_tr)
         _obs.install(prev_rec)
         raise
     finally:
@@ -2012,7 +2109,9 @@ def bench_fanout():
         f"{push_hist['p99']:,.0f} B; {int(d['cohorts_per_dispatch']):,} "
         f"cohorts dispatched; {n_evicted} subscribed tenants evicted "
         f"and re-warmed; {len(clients) + 1} client replicas "
-        f"bit-identical"
+        f"bit-identical; freshness p50 {fresh['p50']:,.0f} us / p95 "
+        f"{fresh['p95']:,.0f} us / p99 {fresh['p99']:,.0f} us over "
+        f"{traces_completed} traced journeys (1/{trace_sample} tenants)"
     )
     return [{
         "config": "fanout", "metric": "fanout_delta_pushes_per_sec",
@@ -2034,6 +2133,13 @@ def bench_fanout():
         "subscriber_churn": churn * cycles,
         "clients_verified": len(clients) + 1,
         "bit_identical": bit_identical,
+        "freshness_p50_us": round(fresh["p50"], 1),
+        "freshness_p95_us": round(fresh["p95"], 1),
+        "freshness_p99_us": round(fresh["p99"], 1),
+        "traces_minted": traces_minted,
+        "traces_completed": traces_completed,
+        "trace_sample": trace_sample,
+        "hot_tenants": skew["tenants"],
         "shape": f"{subscribers}subs@{tenants}x{e}x{a}@{lanes}lanes",
         **flight,
     }]

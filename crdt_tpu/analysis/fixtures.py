@@ -562,3 +562,41 @@ def histogram_miscounts(h, value):
     return _h.Hist(
         counts=h.counts.at[idx].add(jnp.uint32(1)), total=h.total + v,
     )
+
+
+def tracer_skips_stage(**kwargs):
+    """Broken trace-plane twin: a tracer that silently drops every
+    ``durable`` stamp — completed journeys still report freshness, but
+    the dispatch→durable leg reads as instantaneous and the SLO
+    waterfall hides exactly the fsync stalls the durability histogram
+    exists to expose. ``obs.trace.tracer_conformant`` must fail it
+    (completed traces miss a chain stage) — the ``slo`` static-check
+    section pins that the detector fires."""
+    from ..obs.trace import Tracer
+
+    class _SkipsDurable(Tracer):
+        def stamp(self, stage, **fields):
+            if stage == "durable":
+                return None  # silently gone — the leg never existed
+            return super().stamp(stage, **fields)
+
+    return _SkipsDurable(**kwargs)
+
+
+def tracer_clock_regresses(**kwargs):
+    """Broken trace-plane twin: a tracer whose stamp clock runs
+    BACKWARDS (a naive wall-clock source straddling an NTP step) — the
+    per-stage deltas go negative and every derived latency histogram
+    is garbage at exactly the moments worth debugging.
+    ``obs.trace.tracer_conformant`` must fail it (non-monotonic stamp
+    times, negative freshness)."""
+    from ..obs.trace import Tracer
+
+    ticks = [10_000_000_000]
+
+    def backwards():
+        ticks[0] -= 1000
+        return ticks[0]
+
+    kwargs.pop("clock_ns", None)  # discard the honest injected clock
+    return Tracer(clock_ns=backwards, **kwargs)

@@ -280,6 +280,24 @@ class ObsEvent:
     module: str = ""
 
 
+@dataclass(frozen=True)
+class TraceStage:
+    """One registered op-journey trace stage (crdt_tpu/obs/trace.py):
+    the schema behind every ``stamp("...")`` site in the serving
+    pipeline. Registration is the coverage contract — the ``slo``
+    static-check section AST-scans every literal ``stamp("...")`` call
+    under ``crdt_tpu/`` and fails discovery for any stage name without
+    a registration, exactly like an unregistered flight-recorder event
+    type. ``chain`` stages form the submit→ack completion chain (in
+    ``order``); non-chain stages (evict/restore) are boundary markers
+    the invariant audit reads but completion never waits on."""
+
+    name: str
+    order: int
+    chain: bool = True
+    module: str = ""
+
+
 _MERGE: Dict[str, MergeKind] = {}
 _ENTRY: Dict[str, EntryPoint] = {}
 _COMPACT: Dict[str, Compactor] = {}
@@ -290,6 +308,7 @@ _SCALEOUT_SURFACES: Dict[str, ScaleoutSurface] = {}
 _SERVE_SURFACES: Dict[str, ServeSurface] = {}
 _FANOUT_SURFACES: Dict[str, FanoutSurface] = {}
 _OBS_EVENTS: Dict[str, ObsEvent] = {}
+_TRACE_STAGES: Dict[str, TraceStage] = {}
 
 # Public callables in crdt_tpu.parallel matching this are mesh entry
 # points and MUST be registered (gossip_elastic/delta_gossip_elastic are
@@ -665,6 +684,93 @@ def unregistered_obs_events() -> List[Tuple[str, str]]:
         (etype, where)
         for etype, where, _ in _scan_emit_sites()
         if etype not in _OBS_EVENTS
+    )
+
+
+def register_trace_stage(
+    name: str, *, order: int, chain: bool = True, module: str = "",
+) -> TraceStage:
+    st = TraceStage(name=name, order=order, chain=chain, module=module)
+    _TRACE_STAGES[name] = st
+    return st
+
+
+def trace_stages() -> Tuple[TraceStage, ...]:
+    """Every registered trace stage, in chain order (crdt_tpu/obs/
+    trace.py registers all of them at import — ONE home, so a stamp
+    site cannot invent a stage the SLO derivations do not know)."""
+    import importlib
+
+    importlib.import_module("crdt_tpu.obs.trace")
+    return tuple(
+        sorted(_TRACE_STAGES.values(), key=lambda s: (s.order, s.name))
+    )
+
+
+_STAMP_SCAN_MEMO: Optional[List[Tuple[str, str, str]]] = None
+
+
+def _scan_stamp_sites() -> List[Tuple[str, str, str]]:
+    """AST-walk every module under ``crdt_tpu/`` for trace-stamp sites
+    — calls named ``stamp`` (bare or attribute, e.g. ``trace.stamp``)
+    whose first argument is a string literal. Returns
+    ``(stage, 'relpath:lineno', dotted_module)`` rows; the same
+    literal-scanning contract (and memoisation) as
+    :func:`_scan_emit_sites`: a stage minted from a runtime string
+    cannot be derived into an SLO latency, so it should not exist."""
+    global _STAMP_SCAN_MEMO
+    if _STAMP_SCAN_MEMO is not None:
+        return _STAMP_SCAN_MEMO
+    import ast
+    import os
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rows: List[Tuple[str, str, str]] = []
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, os.path.dirname(pkg_root))
+            try:
+                with open(path) as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except (OSError, SyntaxError):
+                continue
+            mod = rel[:-3].replace(os.sep, ".")
+            if mod.endswith(".__init__"):
+                mod = mod[: -len(".__init__")]
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                fname = (
+                    node.func.id if isinstance(node.func, ast.Name)
+                    else node.func.attr
+                    if isinstance(node.func, ast.Attribute) else ""
+                )
+                if fname != "stamp":
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    rows.append((arg.value, f"{rel}:{node.lineno}", mod))
+    _STAMP_SCAN_MEMO = rows
+    return rows
+
+
+def unregistered_trace_stages() -> List[Tuple[str, str]]:
+    """``(stage, site)`` for every literal trace-stamp site under
+    ``crdt_tpu/`` whose stage name never called
+    :func:`register_trace_stage` — the discovery gate of the ``slo``
+    static-check section (registration-is-the-coverage-contract, the
+    :func:`unregistered_obs_events` rule for the trace plane)."""
+    trace_stages()  # import-time registrations (crdt_tpu.obs.trace)
+    return sorted(
+        (stage, where)
+        for stage, where, _ in _scan_stamp_sites()
+        if stage not in _TRACE_STAGES
     )
 
 

@@ -1,0 +1,226 @@
+"""The committed end-to-end freshness SLO gate.
+
+The trace plane (crdt_tpu/obs/trace.py) turns the serving pipeline
+into numbers — per-stage latency histograms plus the headline
+submit→client-ack freshness distribution. Numbers drift silently
+unless something compares them against a committed baseline, so this
+pass drives ONE canonical serve+fanout workload (8 tenants, 3
+submit→drain→persist→push→ack rounds, an eviction mid-run, every
+tenant sampled) under a FAKE deterministic stamp clock (1000 ns per
+stamp, injected — wall time never enters), measures the trace plane's
+output, and compares it against ``tools/slo_budgets.json``:
+
+- **counts** (``minted`` / ``completed`` / ``requeued``) must match
+  the committed values EXACTLY — the workload is deterministic, so any
+  drift means a hook site moved (a stage stopped stamping, a requeue
+  path changed) and must be re-baselined consciously, not absorbed;
+- **latency quantiles** (per-stage p99s, freshness p50/p95/p99 — in
+  synthetic-clock µs, i.e. stamp counts) fail the gate when they
+  regress more than ``tol`` (10%) over budget: a new stamp inserted
+  into a leg, a stage reordering, or an extra flush round shows up
+  here immediately.
+
+Intentional changes re-baseline explicitly::
+
+    python tools/run_static_checks.py --only slo                  # the gate
+    python tools/run_static_checks.py --only slo --write-budgets  # re-baseline
+
+(the committed-table flow of ``cost_budgets.json`` — the reviewer sees
+the new SLO numbers in the diff, not a silently slower pipeline three
+PRs later).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from .report import Finding
+
+SLO_BUDGET_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "tools", "slo_budgets.json",
+)
+
+COUNT_METRICS = ("minted", "completed", "requeued")
+LATENCY_METRICS = (
+    "freshness_p50_us", "freshness_p95_us", "freshness_p99_us",
+    "queue_wait_p99_us", "dispatch_gap_p99_us", "durable_lag_p99_us",
+    "push_lag_p99_us", "ack_lag_p99_us",
+)
+TOL = 0.10
+
+
+def measure_slo() -> Dict[str, Dict[str, float]]:
+    """Run the canonical workload and return
+    ``{"serve_fanout": {metric: value}}`` — fully deterministic (fake
+    stamp clock, fixed op schedule, every tenant sampled)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from ..fanout.plane import FanoutPlane
+    from ..obs import hist as obs_hist
+    from ..obs import trace as obs_trace
+    from ..parallel import make_mesh
+    from ..serve.evict import Evictor
+    from ..serve.ingest import IngestQueue
+    from ..serve.superblock import Superblock
+
+    ticks = [0]
+
+    def clock():
+        ticks[0] += 1000  # 1 µs per stamp — latencies count stamps
+        return ticks[0]
+
+    mesh = make_mesh(1, 1)
+    sb = Superblock(
+        8, mesh, kind="orswot",
+        caps=dict(n_elems=4, n_actors=2, deferred_cap=2),
+    )
+    root = tempfile.mkdtemp(prefix="slo-gate-")
+    tr = obs_trace.Tracer(sample=1, clock_ns=clock)
+    prev = obs_trace.install_tracer(tr)
+    try:
+        ev = Evictor(sb, root)
+        q = IngestQueue(sb, lanes=4, depth=2, evictor=ev)
+        plane = FanoutPlane(sb, evictor=ev, window_cap=4, dispatch_lanes=4)
+        ids = plane.subscribe(list(range(8)))
+        m = lambda *on: np.isin(np.arange(4), on)  # noqa: E731
+        for rnd in range(3):
+            for t in range(8):
+                q.add(t, actor=t % 2, counter=rnd + 1, member=m(rnd))
+            q.drain()
+            ev.persist(list(range(8)))
+            if rnd == 1:
+                # Mid-run eviction: the boundary stamps (evict/restore)
+                # must ride open traces without perturbing completion.
+                ev.evict([0])
+            plane.push(tenants=list(range(8)))
+            plane.ack(ids)
+
+        met: Dict[str, float] = {
+            "minted": float(tr.minted),
+            "completed": float(tr.completed),
+            "requeued": float(tr.requeued),
+        }
+        fs = obs_hist.summary(tr.freshness_dict())
+        for qn in ("p50", "p95", "p99"):
+            met[f"freshness_{qn}_us"] = round(float(fs[qn]), 3)
+        hists = tr.drain_hists()
+        for lname, _a, _b in obs_trace.LATENCIES:
+            if lname == "freshness_us":
+                continue  # covered by the headline quantiles above
+            s = obs_hist.summary(obs_hist.to_dict(hists[f"hist_{lname}"]))
+            met[f"{lname[:-3]}_p99_us"] = round(float(s["p99"]), 3)
+        return {"serve_fanout": met}
+    finally:
+        obs_trace.install_tracer(prev)
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def load_budgets(path: str = SLO_BUDGET_PATH) -> dict:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def write_budgets(path: str = SLO_BUDGET_PATH,
+                  measured: Optional[dict] = None) -> dict:
+    """Re-baseline: run the canonical workload and commit the table."""
+    measured = measure_slo() if measured is None else measured
+    doc = {
+        "comment": (
+            "Committed end-to-end freshness SLO baseline "
+            "(crdt_tpu/analysis/slo.py): trace counts and per-stage "
+            "latency quantiles of the canonical serve+fanout workload "
+            "under the deterministic 1000ns-per-stamp clock. Counts "
+            "must match exactly; quantiles fail the gate on >10% "
+            "regression. Regenerate EXPLICITLY after an intentional "
+            "pipeline change: python tools/run_static_checks.py "
+            "--only slo --write-budgets"
+        ),
+        "entries": {k: measured[k] for k in sorted(measured)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return measured
+
+
+def check_budgets(
+    measured: Optional[dict] = None,
+    budgets: Optional[dict] = None,
+    path: str = SLO_BUDGET_PATH,
+    tol: float = TOL,
+) -> List[Finding]:
+    """Compare the measured SLO metrics against the committed table:
+    count drift (exact mismatch) and >tol latency regression are
+    errors, as is a workload entry with no committed budget. Stale
+    budget rows warn (table hygiene must not mask real failures)."""
+    if budgets is None:
+        budgets = load_budgets(path).get("entries", {})
+    if measured is None:
+        measured = measure_slo()
+    findings: List[Finding] = []
+    for name in sorted(measured):
+        got = measured[name]
+        want = budgets.get(name)
+        if want is None:
+            findings.append(Finding(
+                "slo-budget-missing", name,
+                "workload has no committed SLO budget — baseline it: "
+                "python tools/run_static_checks.py --only slo "
+                "--write-budgets",
+            ))
+            continue
+        for metric in COUNT_METRICS:
+            if metric not in want:
+                findings.append(Finding(
+                    "slo-budget-missing", name,
+                    f"committed budget lacks the {metric!r} count — "
+                    "regenerate with --write-budgets",
+                ))
+                continue
+            g, w = int(got[metric]), int(want[metric])
+            if g != w:
+                findings.append(Finding(
+                    "slo-count-drift", name,
+                    f"{metric} drifted: measured {g} != committed {w} "
+                    "— the deterministic workload changed its trace "
+                    "accounting (a stamp site moved?); if intentional, "
+                    "re-baseline with --write-budgets",
+                ))
+        for metric in LATENCY_METRICS:
+            if metric not in want:
+                findings.append(Finding(
+                    "slo-budget-missing", name,
+                    f"committed budget lacks the {metric!r} quantile — "
+                    "regenerate with --write-budgets",
+                ))
+                continue
+            g, w = float(got[metric]), float(want[metric])
+            if g > w * (1.0 + tol):
+                pct = (g / w - 1.0) * 100 if w else float("inf")
+                findings.append(Finding(
+                    "slo-budget", name,
+                    f"{metric} regressed {pct:.1f}% over budget "
+                    f"({g} vs {w}, tol {tol:.0%}) — if intentional, "
+                    "re-baseline with --write-budgets",
+                ))
+    for name in sorted(set(budgets) - set(measured)):
+        findings.append(Finding(
+            "slo-budget-stale", name,
+            "committed SLO budget row has no measured workload — drop "
+            "it with --write-budgets", severity="warning",
+        ))
+    return findings
+
+
+__all__ = [
+    "COUNT_METRICS", "LATENCY_METRICS", "SLO_BUDGET_PATH", "TOL",
+    "check_budgets", "load_budgets", "measure_slo", "write_budgets",
+]

@@ -57,6 +57,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..obs import trace as obs_trace
 from ..utils.metrics import metrics
 from . import crashpoints as cp
 
@@ -270,6 +271,10 @@ class Wal:
         self.fsyncs += 1
         metrics.count("durability.fsyncs")
         metrics.observe("durability.wal.watermark", float(self.last_seq))
+        # Group commit IS the durable point for every dispatched op in
+        # the round — stamp all dispatched-not-yet-durable traces at
+        # once (no tenant scope: the barrier covers the whole batch).
+        obs_trace.stamp("durable")
         obs.emit("wal_fsync", watermark=self.last_seq,
                  bytes=self.bytes_appended)
 

@@ -194,6 +194,11 @@ def health(snapshot: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
       (``durability.wal.watermark``; -1 = nothing durable yet);
     - ``faults_gave_up`` / ``snapshot_fallbacks`` — the loud-failure
       counters worth paging on;
+    - ``serving`` — the serving-tier vitals: served tenant population
+      and live subscribers (worst per-kind telemetry gauge), ingest
+      backpressure refusals, fan-out resync fallbacks, and the newest
+      end-to-end freshness p99 (µs; -1 until a sampled trace completes
+      — crdt_tpu/obs/trace.py);
     - ``flight`` — the recorder's correlation key + buffered/dropped
       event counts (null when none is installed).
 
@@ -233,6 +238,20 @@ def health(snapshot: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         "snapshot_fallbacks": int(
             counters.get("durability.snapshot_fallback", 0)
         ),
+        "serving": {
+            "live_tenants": int(worst(".live_tenants")),
+            "subscribers_live": int(worst(".subscribers_live")),
+            "ingest_backpressure": int(
+                counters.get("serve.ingest.backpressure", 0)
+            ),
+            "resync_fallbacks": int(sum(
+                v for name, v in counters.items()
+                if name.endswith(".fanout.resync_fallbacks")
+            )),
+            "freshness_p99_us": float(
+                last("obs.trace.freshness_p99_us", -1.0)
+            ),
+        },
         "flight": None if rec is None else {
             "key": list(rec.key()),
             "events": len(rec),

@@ -55,6 +55,7 @@ import numpy as np
 from .. import telemetry as tele
 from ..durability import crashpoints
 from ..obs import recorder as _rec
+from ..obs import trace as obs_trace
 from ..ops import superblock as sb_ops
 from ..ops.fanout_kernels import CohortWire, wire_lane
 from ..parallel.fanout_push import mesh_fanout_push
@@ -245,6 +246,17 @@ class FanoutPlane:
         # an old one sneaks in first (lag-driven re-bucketing still
         # covers the subscriber either way).
         self.sub_pend[sel[v[ok] >= pend[ok]]] = -1
+        # Close the freshness loop: each promoted tenant's highest
+        # acked watermark completes every open trace pushed at or
+        # below it (the submit→client-ack headline metric).
+        if obs_trace.get_tracer() is not None and len(sel):
+            t_sel = self.sub_tenant[sel]
+            v_sel = self.sub_ver[sel]
+            for t in np.unique(t_sel):
+                obs_trace.stamp(
+                    "ack", tenant=int(t),
+                    version=int(v_sel[t_sel == t].max()),
+                )
 
     def note_dirty(self, tenants) -> None:
         """Mark tenants changed since their last push (the ingest
@@ -433,6 +445,7 @@ class FanoutPlane:
                     self.sub_pend[members] = target
                     n_resync_subs += len(members)
                     resync_bytes += rep.bytes_shipped * len(members)
+                    obs_trace.stamp("push", tenant=t, version=target)
                     _rec.emit(
                         "subscriber_resync", tenant=t,
                         subscribers=len(members),
@@ -523,6 +536,7 @@ class FanoutPlane:
                     wire=wire_lane(wire, dl), members=members,
                 ))
                 self.sub_pend[members] = target
+                obs_trace.stamp("push", tenant=t, version=target)
             _rec.emit(
                 "fanout_push", cohorts=len(slots),
                 subscribers=int(wts.sum()),

@@ -1,6 +1,6 @@
 """crdt_tpu.obs — the postmortem-grade observability plane.
 
-Three layers on top of the PR 2 counters/gauges/spans:
+Four layers on top of the PR 2 counters/gauges/spans:
 
 - :mod:`crdt_tpu.obs.hist` — in-kernel log2 histograms (lax-only, so
   they ride the ``telemetry=`` Telemetry sidecar through jit and
@@ -14,6 +14,13 @@ Three layers on top of the PR 2 counters/gauges/spans:
   ``telemetry.span``, dumped as a self-describing JSONL artifact
   (auto-invoked on ``DrainRefused`` / ``DcnExchangeFailed`` /
   ``StreamFaultReport`` / recovery).
+- :mod:`crdt_tpu.obs.trace` — sampled op-journey tracing + the
+  per-tenant SLO plane: trace ids minted at ``IngestQueue.submit``
+  ride coalescing, dispatch, WAL group-commit, evict/restore, fan-out
+  push and promote-on-ack; completed journeys fold into per-stage
+  latency histograms and the headline submit→client-ack freshness
+  distribution (``Tracer.annotate`` fills the ``hist_*_us`` Telemetry
+  fields; ``skew_report`` is the hot-tenant attribution view).
 - ``tools/obs_report.py`` — renders a dump into an incident report
   (timeline, histogram summaries, invariant audit) and cross-checks
   its folded counters bit-exactly against the live registry.
@@ -39,6 +46,14 @@ from .recorder import (
     get_recorder,
     install,
     recorder_conformant,
+)
+from . import trace  # noqa: E402  (after recorder: trace stamps emit into it)
+from .trace import (
+    Tracer,
+    get_tracer,
+    install_tracer,
+    skew_report,
+    tracer_conformant,
 )
 
 
@@ -142,8 +157,9 @@ def static_checks() -> List:
 
 
 __all__ = [
-    "FlightRecorder", "advance_round", "auto_dump", "configure_auto_dump",
-    "current_key", "dump_dir", "emit", "get_recorder", "hist",
-    "histogram_conformant", "install", "recorder_conformant",
-    "static_checks",
+    "FlightRecorder", "Tracer", "advance_round", "auto_dump",
+    "configure_auto_dump", "current_key", "dump_dir", "emit",
+    "get_recorder", "get_tracer", "hist", "histogram_conformant",
+    "install", "install_tracer", "recorder_conformant", "skew_report",
+    "static_checks", "trace", "tracer_conformant",
 ]

@@ -34,10 +34,15 @@ auto-invoked at the failure boundaries (``DrainRefused``,
 :func:`auto_dump` — so the artifact exists precisely when someone
 will need it.
 
-The ring drops OLDEST events when full and counts every drop
-(``dropped`` / the ``obs.events_dropped`` registry counter): a
-postmortem wants the events closest to the failure, and a silent drop
-is itself a bug class (the ``recorder_drops_events`` broken twin in
+The ring drops OLDEST events when full and counts every drop — both
+in total (``dropped`` / the ``obs.events_dropped`` registry counter)
+and PER EVENT TYPE (``dropped_by_type`` / the
+``obs.events_dropped.<etype>`` registry twins, carried in every dump
+header): under an 8k-event serve flood the postmortem question is not
+"how many events were lost" but "WHICH KIND was lost" — a header
+saying 5k ``trace_stage`` drops but zero ``tenant_evicted`` drops
+means the eviction timeline is still trustworthy. A silent drop is
+itself a bug class (the ``recorder_drops_events`` broken twin in
 analysis/fixtures.py proves the conformance detector fires).
 
 No recorder is installed by default — every ``emit`` is then a cheap
@@ -50,7 +55,7 @@ import json
 import os
 import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..utils.metrics import metrics
 
@@ -72,6 +77,7 @@ class FlightRecorder:
         self._events: List[dict] = []
         self._clock = clock
         self.dropped = 0
+        self.dropped_by_type: Dict[str, int] = {}
         self._generation = 0
         self._round = 0
         self._rank = int(rank)
@@ -121,12 +127,14 @@ class FlightRecorder:
     def record(self, etype: str, **fields) -> dict:
         """Append one structured event, stamped ``(gen, round, rank)``
         and wall-clock. Returns the event dict. Oldest events drop
-        when the ring is full (counted — never silent)."""
+        when the ring is full (counted — never silent, and broken out
+        PER EVENT TYPE so a postmortem can tell WHAT was lost)."""
         event = {
             "record": "flight",
             "type": str(etype),
             "ts": self._clock(),
         }
+        lost: List[str] = []
         with self._lock:
             event["gen"] = self._generation
             event["round"] = self._round
@@ -135,9 +143,18 @@ class FlightRecorder:
             self._events.append(event)
             over = len(self._events) - self.capacity
             if over > 0:
+                lost = [e.get("type", "?") for e in self._events[:over]]
                 del self._events[:over]
                 self.dropped += over
+                for t in lost:
+                    self.dropped_by_type[t] = (
+                        self.dropped_by_type.get(t, 0) + 1
+                    )
         metrics.count("obs.events")
+        if lost:
+            metrics.count("obs.events_dropped", len(lost))
+            for t in lost:
+                metrics.count(f"obs.events_dropped.{t}")
         return event
 
     def snapshot_delta(self) -> dict:
@@ -198,6 +215,7 @@ class FlightRecorder:
                 "capacity": self.capacity,
                 "events": len(events),
                 "dropped": self.dropped,
+                "dropped_by_type": dict(self.dropped_by_type),
                 "reason": reason,
                 "key": [self._generation, self._round, self._rank],
                 "event_types": {
@@ -322,7 +340,8 @@ def auto_dump(reason: str, **fields) -> Optional[str]:
 def recorder_conformant(recorder_cls) -> bool:
     """The ``obs`` static-check detector: a recorder class is
     conformant iff a ring of capacity C fed K > C events keeps exactly
-    the LAST C in order and counts the K - C drops. The committed
+    the LAST C in order and counts the K - C drops — in total AND per
+    event type (the postmortem what-was-lost contract). The committed
     broken twin (``analysis.fixtures.recorder_drops_events``) silently
     discards events and must FAIL here — proving the detector fires."""
     cap, k = 8, 21
@@ -338,6 +357,9 @@ def recorder_conformant(recorder_cls) -> bool:
     if [e.get("seq") for e in evs] != list(range(k - cap, k)):
         return False
     if rec.dropped != k - cap:
+        return False
+    by_type = getattr(rec, "dropped_by_type", None)
+    if by_type != {"probe": k - cap}:
         return False
     return True
 

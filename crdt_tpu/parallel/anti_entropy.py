@@ -165,6 +165,14 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         hist_dispatch_us=_hist.zeros(),
         hist_ingest_batch=_hist.zeros(),
         hist_push_bytes=_hist.zeros(),
+        # The trace-plane stage/freshness hists are filled host-side by
+        # obs.trace.Tracer.annotate — never in-kernel.
+        hist_queue_wait_us=_hist.zeros(),
+        hist_dispatch_gap_us=_hist.zeros(),
+        hist_durable_lag_us=_hist.zeros(),
+        hist_push_lag_us=_hist.zeros(),
+        hist_ack_lag_us=_hist.zeros(),
+        hist_freshness_us=_hist.zeros(),
     )
 
 

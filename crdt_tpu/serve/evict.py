@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..durability import crashpoints, snapshot
+from ..obs import trace as obs_trace
 from ..utils.metrics import metrics
 from .superblock import Superblock
 
@@ -107,12 +108,18 @@ class Evictor:
         self.pressure_batch = pressure_batch
         self.clock = 0
         self.last_touch = np.zeros(superblock.n_tenants, np.int64)
+        # Cumulative per-tenant touches — the hot-tenant skew
+        # attribution signal (crdt_tpu/obs/trace.py skew_report ranks
+        # by it; last_touch alone cannot distinguish "touched once
+        # recently" from "hammered all session").
+        self.touch_count = np.zeros(superblock.n_tenants, np.int64)
         os.makedirs(root, exist_ok=True)
 
     # ---- recency --------------------------------------------------------
     def note_touch(self, tenant: int) -> None:
         self.clock += 1
         self.last_touch[tenant] = self.clock
+        self.touch_count[tenant] += 1
 
     def select_cold(self, k: int, exclude=()) -> List[int]:
         """The k longest-untouched RESIDENT tenants. ``exclude`` pins
@@ -147,6 +154,7 @@ class Evictor:
                 retain=self.retain,
             )
             self.sb.dirty[t] = False
+            obs_trace.stamp("durable", tenant=int(t))
             n += 1
         metrics.count("serve.evict.persisted", n)
         return n
@@ -170,6 +178,7 @@ class Evictor:
             self.sb.dirty[t] = False
             self.sb.was_evicted[t] = True
             lanes.append(self.sb.release_lane(t))
+            obs_trace.stamp("evict", tenant=int(t))
             _rec.emit("tenant_evicted", tenant=int(t))
         self.sb.clear_lanes(lanes)
         metrics.count("serve.evict.evictions", len(lanes))
@@ -208,6 +217,7 @@ class Evictor:
         self.sb.was_evicted[tenant] = False
         self.sb.dirty[tenant] = False
         metrics.count("serve.evict.restores")
+        obs_trace.stamp("restore", tenant=int(tenant))
         _rec.emit("tenant_restored", tenant=int(tenant))
         return True
 

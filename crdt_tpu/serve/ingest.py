@@ -45,6 +45,7 @@ import numpy as np
 
 from .. import telemetry as tele
 from ..obs import hist as obs_hist
+from ..obs import trace as obs_trace
 from ..ops import superblock as sb_ops
 from ..utils.metrics import metrics
 from .superblock import Superblock
@@ -121,6 +122,7 @@ class IngestQueue:
             )
         self.pending.setdefault(tenant, deque()).append(op)
         self.n_pending += 1
+        obs_trace.stamp("submit", tenant=tenant)
         if self.evictor is not None:
             self.evictor.note_touch(tenant)
 
@@ -185,6 +187,7 @@ class IngestQueue:
                 take = min(len(q), self.depth)
                 ops_l = [q.popleft() for _ in range(take)]
                 taken.append((t, ops_l))
+                obs_trace.stamp("coalesce", tenant=t, count=take)
                 for s, op in enumerate(ops_l):
                     if isinstance(op, AddOp):
                         kind[lane, s] = sb_ops.ADD
@@ -231,13 +234,25 @@ class IngestQueue:
             # while building) applied nothing, so everything returns.
             lost = getattr(exc, "tenants", None)
             requeued = 0
+            rolled = []
+            landed = []
             for t, ops_l in taken:
                 if lost is not None and t not in lost:
+                    landed.append(t)
                     continue
                 dq = self.pending.setdefault(t, deque())
                 for op in reversed(ops_l):
                     dq.appendleft(op)
                 requeued += len(ops_l)
+                rolled.append(t)
+            # Trace the split the requeue ledger just made concrete:
+            # landed tenants' ops DID reach the device (their traces
+            # advance to `dispatch`); rolled-back tenants' traces fall
+            # back to submit-only so the next flush re-coalesces them.
+            if landed:
+                obs_trace.stamp("dispatch", tenants=landed)
+            if rolled:
+                obs_trace.requeue(rolled)
             # Ops that DID land leave the pending count; drained
             # tenants that kept nothing leave the map (an empty deque
             # would waste a slab lane next flush).
@@ -249,6 +264,7 @@ class IngestQueue:
         for t in picked:
             del self.pending[t]
         self.n_pending -= applied
+        obs_trace.stamp("dispatch", tenants=[t for t, _ in taken])
         dispatches = 1 + (self.sb.widen_events - widens_before)
         self.total_ops += applied
         self.total_coalesced += coalesced

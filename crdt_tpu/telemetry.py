@@ -225,6 +225,14 @@ class Telemetry(NamedTuple):
     hist_dispatch_us: obs_hist.Hist   # host-timed dispatch wall-clock (µs)
     hist_ingest_batch: obs_hist.Hist  # per-flush coalesced-batch op count
     hist_push_bytes: obs_hist.Hist    # per-cohort δ push payload bytes
+    # Trace-plane stage latencies (crdt_tpu/obs/trace.py — host-filled
+    # per completed sampled trace via Tracer.annotate):
+    hist_queue_wait_us: obs_hist.Hist    # submit → coalesce
+    hist_dispatch_gap_us: obs_hist.Hist  # coalesce → dispatch
+    hist_durable_lag_us: obs_hist.Hist   # dispatch → durable (WAL/persist)
+    hist_push_lag_us: obs_hist.Hist      # dispatch → fan-out push
+    hist_ack_lag_us: obs_hist.Hist       # push → client ack
+    hist_freshness_us: obs_hist.Hist     # submit → client ack (end-to-end)
 
 
 def zeros() -> Telemetry:
@@ -273,6 +281,12 @@ def zeros() -> Telemetry:
         hist_dispatch_us=obs_hist.zeros(),
         hist_ingest_batch=obs_hist.zeros(),
         hist_push_bytes=obs_hist.zeros(),
+        hist_queue_wait_us=obs_hist.zeros(),
+        hist_dispatch_gap_us=obs_hist.zeros(),
+        hist_durable_lag_us=obs_hist.zeros(),
+        hist_push_lag_us=obs_hist.zeros(),
+        hist_ack_lag_us=obs_hist.zeros(),
+        hist_freshness_us=obs_hist.zeros(),
     )
 
 
@@ -342,6 +356,24 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         ),
         hist_push_bytes=obs_hist.merge(
             a.hist_push_bytes, b.hist_push_bytes
+        ),
+        hist_queue_wait_us=obs_hist.merge(
+            a.hist_queue_wait_us, b.hist_queue_wait_us
+        ),
+        hist_dispatch_gap_us=obs_hist.merge(
+            a.hist_dispatch_gap_us, b.hist_dispatch_gap_us
+        ),
+        hist_durable_lag_us=obs_hist.merge(
+            a.hist_durable_lag_us, b.hist_durable_lag_us
+        ),
+        hist_push_lag_us=obs_hist.merge(
+            a.hist_push_lag_us, b.hist_push_lag_us
+        ),
+        hist_ack_lag_us=obs_hist.merge(
+            a.hist_ack_lag_us, b.hist_ack_lag_us
+        ),
+        hist_freshness_us=obs_hist.merge(
+            a.hist_freshness_us, b.hist_freshness_us
         ),
         deferred_depth=b.deferred_depth,
         residue=b.residue,
@@ -534,6 +566,12 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "hist_dispatch_us": obs_hist.to_dict(tel.hist_dispatch_us),
         "hist_ingest_batch": obs_hist.to_dict(tel.hist_ingest_batch),
         "hist_push_bytes": obs_hist.to_dict(tel.hist_push_bytes),
+        "hist_queue_wait_us": obs_hist.to_dict(tel.hist_queue_wait_us),
+        "hist_dispatch_gap_us": obs_hist.to_dict(tel.hist_dispatch_gap_us),
+        "hist_durable_lag_us": obs_hist.to_dict(tel.hist_durable_lag_us),
+        "hist_push_lag_us": obs_hist.to_dict(tel.hist_push_lag_us),
+        "hist_ack_lag_us": obs_hist.to_dict(tel.hist_ack_lag_us),
+        "hist_freshness_us": obs_hist.to_dict(tel.hist_freshness_us),
     }
 
 

@@ -1,0 +1,490 @@
+"""End-to-end freshness tracing + the per-tenant SLO plane (ISSUE 17,
+crdt_tpu/obs/trace.py + crdt_tpu/analysis/slo.py + obs_report --slo):
+
+- composition against the REAL serving pipeline: sampled journeys
+  complete submit→ack through ingest/evict/fan-out, a mid-flush
+  CapacityOverflow rolls traces back losslessly and they re-complete,
+  eviction boundary stamps ride open traces, and the snapshot+suffix
+  resync fallback still completes its journeys — with monotonic stamp
+  times, no orphans, and no double-completion throughout;
+- the sampling-off path is BYTE-IDENTICAL: the lowered serve dispatch
+  HLO with a tracer installed equals the untraced program (the trace
+  plane is host-side by construction, and stays that way);
+- the flight recorder's per-event-type drop accounting (the serving
+  audits' stand-down signal) survives the dump header round-trip;
+- ``obs_report --slo`` replays trace events bit-exactly and FAILS
+  LOUDLY on tampered latencies, dispatch-while-evicted, and fan-out
+  cohort-conservation violations (non-zero exit);
+- ``exporter.health()`` carries the serving vitals;
+- the committed ``tools/slo_budgets.json`` gate: the canonical
+  workload is deterministic, matches the committed table, and drifted
+  counts / regressed quantiles / stale rows are flagged.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from crdt_tpu import exporter, obs, telemetry as tele
+from crdt_tpu.analysis import fixtures, slo
+from crdt_tpu.analysis.registry import trace_stages, unregistered_trace_stages
+from crdt_tpu.fanout import FanoutPlane
+from crdt_tpu.obs import hist as obs_hist
+from crdt_tpu.obs import trace
+from crdt_tpu.parallel import make_mesh, mesh_serve_apply
+from crdt_tpu.serve import Evictor, IngestQueue, Superblock
+from crdt_tpu.utils.metrics import metrics
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import obs_report  # noqa: E402
+
+CAPS = dict(n_elems=4, n_actors=2, deferred_cap=2)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_planes():
+    """Every test starts with no installed recorder OR tracer and
+    cannot leak either into the rest of the suite."""
+    prev_rec = obs.install(None)
+    prev_tr = trace.install_tracer(None)
+    yield
+    obs.install(prev_rec)
+    trace.install_tracer(prev_tr)
+
+
+def _ticker():
+    ticks = [0]
+
+    def clock():
+        ticks[0] += 1000  # 1 µs per stamp — latencies count stamps
+        return ticks[0]
+
+    return clock
+
+
+def _mask(*on, e=4):
+    return np.isin(np.arange(e), on)
+
+
+def _pipeline(root, n=4, caps=None, window_cap=4, **sb_kw):
+    mesh = make_mesh(1, 1)
+    sb = Superblock(
+        n, mesh, kind="orswot", caps=dict(caps or CAPS), **sb_kw
+    )
+    ev = Evictor(sb, str(root))
+    q = IngestQueue(sb, lanes=2, depth=2, evictor=ev)
+    plane = FanoutPlane(
+        sb, evictor=ev, window_cap=window_cap, dispatch_lanes=2
+    )
+    ids = plane.subscribe(list(range(n)))
+    return sb, ev, q, plane, ids
+
+
+# ---- composition against the real pipeline ---------------------------------
+
+def test_journeys_complete_with_boundary_stamps(tmp_path):
+    """Every sampled journey completes submit→ack through the real
+    ingest → persist → evict/restore → push → ack pipeline; the
+    evicted tenant's open trace carries both boundary stamps; stamp
+    times are monotonic, latencies bit-equal derive_latencies, and the
+    live freshness p99 gauge is fed."""
+    metrics.reset()
+    sb, ev, q, plane, ids = _pipeline(tmp_path)
+    tr = trace.Tracer(sample=1, clock_ns=_ticker())
+    trace.install_tracer(tr)
+    for rnd in range(2):
+        for t in range(4):
+            q.add(t, t % 2, rnd + 1, _mask(rnd))
+        q.drain()
+        ev.persist(list(range(4)))
+        if rnd == 1:
+            ev.evict([2])  # tenant 2 has an OPEN trace right now
+        plane.push(tenants=list(range(4)))
+        plane.ack(ids)
+    assert (tr.minted, tr.completed, tr.n_open) == (8, 8, 0)
+    seen = set()
+    evicted_stamps = None
+    for rec in tr.recent:
+        assert rec["trace"] not in seen  # no double-completion
+        seen.add(rec["trace"])
+        stamps = rec["stamps"]
+        times = [t for _s, t in stamps]
+        assert times == sorted(times)
+        assert set(trace.CHAIN_STAGES) <= {s for s, _t in stamps}
+        assert rec["lat"] == trace.derive_latencies(stamps)
+        assert rec["lat"]["freshness_us"] >= 0
+        if rec["tenant"] == 2 and "evict" in {s for s, _ in stamps}:
+            evicted_stamps = [s for s, _ in stamps]
+    # The evicted tenant's in-flight journey crossed the tier boundary
+    # and back (the push re-warms through the evictor) — both marks.
+    assert evicted_stamps is not None and "restore" in evicted_stamps
+    fd = tr.freshness_dict()
+    assert sum(fd["counts"]) == 8
+    g = metrics.snapshot()["gauges"]["obs.trace.freshness_p99_us"]
+    assert g["last"] > 0
+
+
+def test_capacity_overflow_rolls_traces_back_and_recompletes(tmp_path):
+    """A mid-flush CapacityOverflow mirrors the ingest queue's
+    loss-free contract on the trace plane: the rolled tenant's traces
+    truncate to their submit stamp (requeued counted), the landed
+    tenant's journey keeps its dispatch, and after the capacity fix
+    every journey re-coalesces and completes exactly once."""
+    from crdt_tpu.elastic import ElasticPolicy
+    from crdt_tpu.serve import CapacityOverflow
+
+    caps = dict(n_elems=8, n_actors=2, deferred_cap=1)
+    sb, ev, q, plane, ids = _pipeline(
+        tmp_path, n=4, caps=caps, policy=ElasticPolicy(max_migrations=0),
+    )
+    tr = trace.Tracer(sample=1, clock_ns=_ticker())
+    trace.install_tracer(tr)
+    q.rm(0, np.asarray([1, 0], np.uint32), _mask(1, e=8))
+    q.rm(0, np.asarray([0, 1], np.uint32), _mask(2, e=8))
+    q.add(1, 0, 1, _mask(0, e=8))
+    with pytest.raises(CapacityOverflow) as exc:
+        q.drain()
+    assert exc.value.tenants == (0,)
+    assert (tr.minted, tr.requeued, tr.completed) == (3, 2, 0)
+    open_t = tr.open_traces()
+    # Rolled traces are back at their submit stamp; the landed
+    # tenant's journey dispatched.
+    assert all(
+        [s for s, _t in st] == ["submit"] for _tid, st in open_t[0]
+    )
+    assert any(
+        "dispatch" in [s for s, _t in st] for _tid, st in open_t[1]
+    )
+    sb.widen_capacity(deferred_cap=2)
+    q.drain()
+    plane.push(tenants=[0, 1])
+    plane.ack(ids)
+    assert (tr.completed, tr.n_open) == (3, 0)
+    tids = [rec["trace"] for rec in tr.recent]
+    assert len(tids) == len(set(tids))
+
+
+def test_resync_fallback_completes_traces(tmp_path):
+    """A subscriber that falls out of the ack window catches up via
+    snapshot+suffix resync — and the resync still stamps ``push``, so
+    the journeys it carries complete on the late ack."""
+    sb, ev, q, plane, ids = _pipeline(tmp_path, n=2, window_cap=1)
+    tr = trace.Tracer(sample=1, clock_ns=_ticker())
+    trace.install_tracer(tr)
+    for rnd in range(3):  # never ack: the watermark falls behind
+        q.add(0, 0, rnd + 1, _mask(rnd % 2))
+        q.drain()
+        plane.push(tenants=[0])
+    assert plane.resyncs_total >= 1
+    assert tr.completed == 0 and tr.n_open == 3
+    plane.ack(ids)
+    assert (tr.completed, tr.n_open) == (3, 0)
+
+
+def test_stamps_are_noops_uninstalled_and_sampling_is_deterministic():
+    trace.stamp("submit", tenant=0)  # no tracer installed: no-op
+    assert trace.requeue([0]) == 0
+    mask = trace.sampled_mask(4096, 64)
+    assert mask.dtype == bool and mask[0]  # tenant 0 always samples
+    for t in (0, 1, 63, 64, 1000, 4095):
+        assert mask[t] == trace.sampled(t, 64)
+    assert trace.sampled_mask(16, 1).all()
+    with pytest.raises(ValueError):
+        trace.Tracer(sample=1).stamp("no-such-stage", tenant=0)
+
+
+def test_sampling_off_serve_dispatch_hlo_byte_identical():
+    """The HLO pin: installing a tracer changes NOTHING about the
+    lowered serve dispatch — the trace plane is host-side stamps
+    around the program, never logic inside it."""
+    from crdt_tpu.parallel.serve_apply import _example
+
+    mesh = make_mesh(1, 1)
+    state, slab, idx = _example(mesh)
+
+    def lowered():
+        return jax.jit(
+            lambda s, sl, i: mesh_serve_apply(s, sl, i, mesh)
+        ).lower(state, slab, idx).as_text()
+
+    base = lowered()
+    trace.install_tracer(trace.Tracer(sample=1))
+    assert lowered() == base
+
+
+# ---- registry coverage + the committed broken twins ------------------------
+
+def test_trace_stage_registry_covers_every_stamp_site():
+    assert unregistered_trace_stages() == []
+    names = {s.name for s in trace_stages()}
+    assert names == set(trace.CHAIN_STAGES) | set(trace.BOUNDARY_STAGES)
+
+
+def test_tracer_conformance_and_twins_fire():
+    assert trace.tracer_conformant(trace.Tracer)
+    assert not trace.tracer_conformant(fixtures.tracer_skips_stage)
+    assert not trace.tracer_conformant(fixtures.tracer_clock_regresses)
+
+
+# ---- skew attribution + serving vitals -------------------------------------
+
+def test_skew_report_attributes_hot_tenants(tmp_path):
+    sb, ev, q, plane, ids = _pipeline(tmp_path)
+    tr = trace.Tracer(sample=1, clock_ns=_ticker())
+    trace.install_tracer(tr)
+    for c in range(5):  # tenant 2 is 5× hotter than the rest
+        q.add(2, 0, c + 1, _mask(c % 4))
+        q.drain()
+    q.add(1, 1, 1, _mask(0))
+    q.drain()
+    plane.push(tenants=[1, 2])
+    plane.ack(ids)
+    rep = trace.skew_report(evictor=ev, queue=q, tracer=tr, k=3)
+    assert rep["by"] == "touches"
+    rows = rep["tenants"]
+    assert rows and rows[0]["tenant"] == 2
+    assert rows[0]["touches"] >= 5
+    assert rows[0]["freshness_count"] >= 1
+    assert rows[0]["freshness_p99_us"] >= 0
+    # No evictor: falls back to queue-depth ranking.
+    q.add(3, 1, 1, _mask(1))
+    rep2 = trace.skew_report(queue=q, tracer=tr, k=2)
+    assert rep2["by"] == "queue_depth"
+    assert rep2["tenants"][0]["tenant"] == 3
+
+
+def test_exporter_health_serving_vitals(tmp_path):
+    from crdt_tpu.serve import IngestBackpressure
+
+    metrics.reset()
+    base = exporter.health()["serving"]
+    assert base == {
+        "live_tenants": 0, "subscribers_live": 0,
+        "ingest_backpressure": 0, "resync_fallbacks": 0,
+        "freshness_p99_us": -1.0,
+    }
+    sb, ev, q, plane, ids = _pipeline(tmp_path)
+    tr = trace.Tracer(sample=1, clock_ns=_ticker())
+    trace.install_tracer(tr)
+    q.add(0, 0, 1, _mask(0))
+    _rep, t = q.drain(telemetry=True)
+    tele.record("serve", t)
+    prep = plane.push(tenants=[0], telemetry=True)
+    tele.record("fanout", prep.telemetry)
+    plane.ack(ids)
+    tiny = IngestQueue(sb, lanes=2, depth=2, max_pending=1)
+    tiny.add(1, 0, 1, _mask(0))
+    with pytest.raises(IngestBackpressure):
+        tiny.add(2, 0, 1, _mask(0))
+    h = exporter.health()["serving"]
+    assert h["live_tenants"] >= 1
+    assert h["subscribers_live"] == 4
+    assert h["ingest_backpressure"] == 1
+    assert h["freshness_p99_us"] > 0
+
+
+# ---- recorder per-type drop accounting -------------------------------------
+
+def test_recorder_per_type_drop_accounting_survives_dump(tmp_path):
+    rec = obs.FlightRecorder(capacity=4)
+    for i in range(6):
+        rec.record("alpha", seq=i)
+    for i in range(3):
+        rec.record("beta", seq=i)
+    assert rec.dropped == 5
+    assert rec.dropped_by_type == {"alpha": 5}
+    path = str(tmp_path / "dump.jsonl")
+    rec.dump(path, reason="test")
+    with open(path) as f:
+        header = json.loads(f.readline())
+    assert header["dropped_by_type"] == {"alpha": 5}
+    assert sum(header["dropped_by_type"].values()) == header["dropped"]
+
+
+# ---- obs_report --slo: bit-exact replay + tamper probes --------------------
+
+def _traced_dump(tmp_path, name="dump.jsonl"):
+    """One real traced serve+fanout window dumped to a flight artifact
+    (telemetry recorded, so the cohort-conservation audit engages)."""
+    metrics.reset()
+    rec = obs.FlightRecorder(capacity=512)
+    obs.install(rec)
+    sb, ev, q, plane, ids = _pipeline(tmp_path)
+    tr = trace.Tracer(sample=1, clock_ns=_ticker())
+    trace.install_tracer(tr)
+    for rnd in range(2):
+        for t in range(4):
+            q.add(t, t % 2, rnd + 1, _mask(rnd))
+        q.drain()
+        ev.persist(list(range(4)))
+        prep = plane.push(tenants=list(range(4)), telemetry=True)
+        tele.record("fanout", tr.annotate(prep.telemetry))
+        plane.ack(ids)
+    trace.install_tracer(None)
+    path = str(tmp_path / name)
+    rec.dump(path, reason="test")
+    obs.install(None)
+    return path, tr
+
+
+def _tamper(path, match, mutate):
+    lines = open(path).read().splitlines()
+    for i, line in enumerate(lines):
+        ev = json.loads(line)
+        if match(ev):
+            mutate(ev)
+            lines[i] = json.dumps(ev)
+            break
+    else:
+        raise AssertionError(f"no event matched in {path}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def test_trace_replay_bit_exact_then_tamper_fails_loudly(tmp_path):
+    path, tr = _traced_dump(tmp_path)
+    report = obs_report.build_report(path, slo=True)
+    assert report["ok"], (report["audit"], report["slo"]["mismatches"])
+    rp = report["slo"]
+    assert rp["skipped"] is None
+    assert rp["traces_completed"] == tr.completed == 8
+    assert rp["freshness"]["count"] == 8
+    assert set(rp["stage_waterfall"]) >= {"queue_wait_us", "ack_lag_us"}
+    assert obs_report.main([path, "--slo"]) == 0
+    # Tampered latency: the recorded lat no longer equals
+    # derive_latencies(stamps) — replay fails, CLI exits non-zero.
+    _tamper(
+        path, lambda ev: ev.get("type") == "trace_complete",
+        lambda ev: ev["lat"].update(
+            freshness_us=ev["lat"]["freshness_us"] + 1
+        ),
+    )
+    report2 = obs_report.build_report(path, slo=True)
+    assert not report2["ok"] and report2["slo"]["mismatches"]
+    assert obs_report.main([path, "--slo"]) == 1
+
+
+def test_audit_fanout_cohort_conservation_tamper(tmp_path):
+    path, _tr = _traced_dump(tmp_path)
+    assert obs_report.build_report(path)["ok"]
+    _tamper(
+        path, lambda ev: ev.get("type") == "fanout_push",
+        lambda ev: ev.update(cohorts=ev["cohorts"] + 1),
+    )
+    report = obs_report.build_report(path)
+    assert not report["ok"]
+    assert any(
+        f["check"] == "fanout-cohort-conservation"
+        and f["severity"] == "error" for f in report["audit"]
+    )
+    assert obs_report.main([path]) == 1
+
+
+def test_audit_dispatch_while_evicted_synthetic():
+    dump = {
+        "header": {"dropped": 0, "dropped_by_type": {}},
+        "snapshot": None,
+        "events": [
+            {"type": "tenant_evicted", "tenant": 7},
+            {"type": "trace_stage", "stage": "dispatch", "trace": 0,
+             "tenant": 7, "t_ns": 1},
+        ],
+    }
+
+    def hits(d):
+        return [
+            f for f in obs_report.audit(d)
+            if f["check"] == "dispatch-while-evicted"
+        ]
+
+    assert hits(dump) and hits(dump)[0]["severity"] == "error"
+    # A restore BEFORE the dispatch makes the same stamp legal.
+    dump["events"].insert(1, {"type": "tenant_restored", "tenant": 7})
+    assert not hits(dump)
+    # Dropped boundary events: the audit stands down rather than
+    # misnarrate a window it cannot see.
+    dump["events"].pop(1)
+    dump["header"] = {"dropped": 2, "dropped_by_type": {"trace_stage": 2}}
+    assert not hits(dump)
+
+
+def test_trace_replay_stands_down_on_dropped_trace_events():
+    replay = obs_report.trace_replay({
+        "header": {"dropped": 1, "dropped_by_type": {"trace_stage": 1}},
+        "events": [],
+    })
+    assert replay["ok"] and replay["skipped"] is not None
+
+
+# ---- the committed SLO budget gate -----------------------------------------
+
+def test_slo_budget_gate_deterministic_and_green():
+    m1 = slo.measure_slo()
+    assert m1 == slo.measure_slo()  # fake clock: fully deterministic
+    assert slo.check_budgets(measured=m1) == []
+
+
+def test_slo_budget_gate_detects_drift_and_staleness():
+    m = slo.measure_slo()
+    ent = slo.load_budgets()["entries"]
+
+    def tampered(**over):
+        bad = {k: dict(v) for k, v in ent.items()}
+        bad["serve_fanout"].update(over)
+        return bad
+
+    checks = {
+        f.check for f in slo.check_budgets(
+            measured=m, budgets=tampered(minted=ent["serve_fanout"]["minted"] + 1),
+        )
+    }
+    assert "slo-count-drift" in checks
+    checks = {
+        f.check for f in slo.check_budgets(
+            measured=m,
+            budgets=tampered(
+                freshness_p99_us=ent["serve_fanout"]["freshness_p99_us"] / 2
+            ),
+        )
+    }
+    assert "slo-budget" in checks
+    stale = {k: dict(v) for k, v in ent.items()}
+    stale["ghost_workload"] = dict(ent["serve_fanout"])
+    fs = slo.check_budgets(measured=m, budgets=stale)
+    assert any(
+        f.check == "slo-budget-stale" and f.severity == "warning"
+        for f in fs
+    )
+    assert slo.check_budgets(measured=m, budgets={}) != []  # missing
+
+
+# ---- telemetry ride-along ---------------------------------------------------
+
+def test_annotate_fills_trace_hists_and_combine_folds(tmp_path):
+    sb, ev, q, plane, ids = _pipeline(tmp_path)
+    tr = trace.Tracer(sample=1, clock_ns=_ticker())
+    trace.install_tracer(tr)
+    tels = []
+    for rnd in range(2):
+        q.add(0, 0, rnd + 1, _mask(rnd))
+        _rep, t = q.drain(telemetry=True)
+        plane.push(tenants=[0])
+        plane.ack(ids)
+        tels.append(tr.annotate(t))
+    d0 = tele.to_dict(tels[0])
+    assert sum(d0["hist_freshness_us"]["counts"]) == 1
+    folded = tele.to_dict(tele.combine(*tels))
+    # The per-record-increment discipline: the fold carries exactly
+    # the union of both records' completions.
+    assert sum(folded["hist_freshness_us"]["counts"]) == 2
+    assert folded["hist_freshness_us"]["total"] == (
+        d0["hist_freshness_us"]["total"]
+        + tele.to_dict(tels[1])["hist_freshness_us"]["total"]
+    )
+    s = obs_hist.summary(folded["hist_queue_wait_us"])
+    assert s["count"] == 2 and s["p99"] >= 0

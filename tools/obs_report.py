@@ -30,13 +30,21 @@ act on:
   tests/test_obs.py do). A mismatch means the artifact does not
   faithfully narrate the run it claims to.
 
+- **trace replay** (``--slo``) — the op-journey trace events
+  (``trace_stage`` / ``trace_requeue`` / ``trace_complete`` —
+  crdt_tpu/obs/trace.py) replayed bit-exactly: every completed
+  journey's recorded stamps must equal the stamps its events narrate
+  and its latencies must equal ``derive_latencies`` of them, then the
+  stage waterfall and submit→client-ack freshness quantiles render.
+
 CLI::
 
-    python tools/obs_report.py flight-....jsonl [--json-out report.json]
+    python tools/obs_report.py flight-....jsonl [--slo] [--json-out report.json]
 
-exits non-zero on parse errors, counter mismatches, or audit
-violations. Importable surface: ``load_dump`` / ``fold_counters`` /
-``fold_histograms`` / ``audit`` / ``cross_check`` / ``build_report`` /
+exits non-zero on parse errors, counter mismatches, audit violations,
+or (under ``--slo``) replay mismatches. Importable surface:
+``load_dump`` / ``fold_counters`` / ``fold_histograms`` / ``audit`` /
+``cross_check`` / ``trace_replay`` / ``build_report`` /
 ``render_text``.
 """
 
@@ -268,25 +276,196 @@ def audit(dump: Dict[str, Any]) -> List[Dict[str, str]]:
                     f"rewound mid-recording"
                 ),
             })
+
+    # Serving/fan-out audits gate on the ring's per-type drop
+    # accounting: a dropped boundary event would make either check
+    # misnarrate, so both stand down (loudly, via skipped=) when the
+    # events they reason over were evicted from the ring.
+    header = dump.get("header") or {}
+    by_type = header.get("dropped_by_type")
+
+    def _dropped(*etypes) -> bool:
+        if by_type is None:  # pre-accounting dump: only the total exists
+            return bool(header.get("dropped", 0))
+        return any(by_type.get(t, 0) for t in etypes)
+
+    # 5. Eviction discipline: a dispatch trace-stamp touching a tenant
+    # BETWEEN its tenant_evicted and tenant_restored events means the
+    # serving tier applied ops to a lane it had already released — the
+    # restore-on-touch contract (crdt_tpu/serve/evict.py) broken.
+    if not _dropped("trace_stage", "tenant_evicted", "tenant_restored"):
+        evicted: Dict[Any, bool] = {}
+        for ev in events:
+            et = ev.get("type")
+            if et == "tenant_evicted":
+                evicted[ev.get("tenant")] = True
+            elif et == "tenant_restored":
+                evicted[ev.get("tenant")] = False
+            elif (et == "trace_stage" and ev.get("stage") == "dispatch"
+                    and evicted.get(ev.get("tenant"))):
+                findings.append({
+                    "check": "dispatch-while-evicted",
+                    "severity": "error",
+                    "detail": (
+                        f"round {ev.get('round')}: dispatch stamped on "
+                        f"tenant {ev.get('tenant')} between its "
+                        f"tenant_evicted and tenant_restored events — "
+                        f"ops applied to a released lane"
+                    ),
+                })
+
+    # 6. Fan-out cohort conservation: every fanout_push event's cohort
+    # count and the folded telemetry cohorts_per_dispatch counter
+    # narrate the same dispatches — their sums must agree whenever the
+    # dump carries both signals (a mismatch means one of them was
+    # tampered with or a dispatch went unrecorded).
+    pushes = [ev for ev in events if ev.get("type") == "fanout_push"]
+    tel_cohorts = [
+        int(ev.get("cohorts_per_dispatch", 0)) for ev in events
+        if ev.get("type") == "telemetry" and "cohorts_per_dispatch" in ev
+    ]
+    if pushes and any(tel_cohorts) and not _dropped(
+        "fanout_push", "telemetry"
+    ):
+        got = sum(int(ev.get("cohorts", 0)) for ev in pushes)
+        want = sum(tel_cohorts)
+        if got != want:
+            findings.append({
+                "check": "fanout-cohort-conservation",
+                "severity": "error",
+                "detail": (
+                    f"fanout_push events narrate {got} cohorts but the "
+                    f"folded telemetry cohorts_per_dispatch holds "
+                    f"{want} — the dump's push story disagrees with "
+                    f"its telemetry"
+                ),
+            })
     return findings
 
 
+def _rank_quantile(vals: List[int], q: float) -> float:
+    """Nearest-rank quantile over EXACT values (the replay holds the
+    real latencies, not bucket counts — no interpolation needed)."""
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    idx = min(len(s) - 1, max(0, int(q * len(s) + 0.999999) - 1))
+    return float(s[idx])
+
+
+def trace_replay(dump: Dict[str, Any]) -> Dict[str, Any]:
+    """Replay the trace plane's events bit-exactly: rebuild every
+    sampled op journey from its ``trace_stage`` stamps (a
+    ``trace_requeue`` rolls the journey back to its submit stamp —
+    exactly what ``Tracer.requeue`` does to the live trace), then
+    require each ``trace_complete`` event's recorded stamps to equal
+    the replayed ones and its recorded latencies to equal
+    ``derive_latencies`` of those stamps (THE one mapping the live
+    tracer applies). Also rejects double-completion and post-completion
+    stamps. Returns ``{"ok", "mismatches", "traces_completed",
+    "stage_waterfall", "freshness", "skipped"}`` — ``skipped`` non-None
+    means trace events were dropped from the ring and a bit-exact
+    replay would misnarrate (not a failure, but not a proof either)."""
+    from crdt_tpu.obs.trace import derive_latencies
+
+    out: Dict[str, Any] = {
+        "ok": True, "mismatches": [], "traces_completed": 0,
+        "stage_waterfall": {}, "freshness": None, "skipped": None,
+    }
+    header = dump.get("header") or {}
+    by_type = header.get("dropped_by_type")
+    if by_type is None:
+        lost = int(header.get("dropped", 0))
+    else:
+        lost = sum(
+            int(by_type.get(t, 0))
+            for t in ("trace_stage", "trace_requeue", "trace_complete")
+        )
+    if lost:
+        out["skipped"] = (
+            f"{lost} trace events dropped from the ring — a bit-exact "
+            f"replay would misnarrate; raise the recorder capacity or "
+            f"the trace sampling modulus"
+        )
+        return out
+
+    stamps: Dict[Any, List[list]] = defaultdict(list)
+    completed: Dict[Any, dict] = {}
+    mism = out["mismatches"]
+    for ev in dump["events"]:
+        et = ev.get("type")
+        tid = ev.get("trace")
+        if et == "trace_stage":
+            if tid in completed:
+                mism.append(
+                    f"trace {tid}: stage {ev.get('stage')!r} stamped "
+                    f"AFTER trace_complete — a completed journey moved"
+                )
+                continue
+            stamps[tid].append([ev.get("stage"), int(ev.get("t_ns", 0))])
+        elif et == "trace_requeue":
+            stamps[tid] = stamps[tid][:1]
+        elif et == "trace_complete":
+            if tid in completed:
+                mism.append(f"trace {tid}: completed twice")
+                continue
+            completed[tid] = ev
+            got = stamps.get(tid, [])
+            want = [[s, int(t)] for s, t in (ev.get("stamps") or [])]
+            if got != want:
+                mism.append(
+                    f"trace {tid}: replayed stamps {got} != recorded "
+                    f"stamps {want}"
+                )
+            lat = derive_latencies(want)
+            rec_lat = {
+                k: int(v) for k, v in (ev.get("lat") or {}).items()
+            }
+            if rec_lat != lat:
+                mism.append(
+                    f"trace {tid}: recorded latencies {rec_lat} != "
+                    f"derive_latencies(stamps) {lat}"
+                )
+    out["traces_completed"] = len(completed)
+    legs: Dict[str, List[int]] = defaultdict(list)
+    for ev in completed.values():
+        for k, v in (ev.get("lat") or {}).items():
+            legs[k].append(int(v))
+    for k, vals in sorted(legs.items()):
+        s = {
+            "count": len(vals),
+            "p50": _rank_quantile(vals, 0.50),
+            "p95": _rank_quantile(vals, 0.95),
+            "p99": _rank_quantile(vals, 0.99),
+        }
+        if k == "freshness_us":
+            out["freshness"] = s
+        else:
+            out["stage_waterfall"][k] = s
+    out["ok"] = not mism
+    return out
+
+
 def build_report(
-    path: str, snapshot: Optional[dict] = None,
+    path: str, snapshot: Optional[dict] = None, slo: bool = False,
 ) -> Dict[str, Any]:
     """The full machine-readable report. ``snapshot`` overrides the
     dump's embedded final snapshot as the cross-check target (pass the
     LIVE ``metrics.snapshot()`` to prove the dump reproduces the live
-    registry — the ISSUE 12 acceptance flow)."""
+    registry — the ISSUE 12 acceptance flow). ``slo`` adds the trace
+    replay (:func:`trace_replay`) under ``report["slo"]`` and folds its
+    verdict into ``ok``."""
     dump = load_dump(path)
     folded = fold_counters(dump["events"])
     target = snapshot if snapshot is not None else dump["snapshot"]
     mismatches = cross_check(folded, target)
     findings = audit(dump)
     hard = [f for f in findings if f["severity"] == "error"]
-    return {
+    replay = trace_replay(dump) if slo else None
+    report = {
         "path": path,
-        "ok": not dump["errors"] and not mismatches and not hard,
+        "ok": (not dump["errors"] and not mismatches and not hard
+               and (replay is None or replay["ok"])),
         "parse_errors": dump["errors"],
         "counter_mismatches": mismatches,
         "audit": findings,
@@ -296,6 +475,9 @@ def build_report(
         "reason": (dump["header"] or {}).get("reason", ""),
         "folded_counters": folded,
     }
+    if replay is not None:
+        report["slo"] = replay
+    return report
 
 
 def _brief(ev: dict) -> str:
@@ -361,6 +543,32 @@ def render_text(report: Dict[str, Any], dump: Optional[dict] = None,
             f"\ncounter cross-check: bit-exact "
             f"({len(report['folded_counters'])} counters)"
         )
+    if "slo" in report:
+        rp = report["slo"]
+        if rp["skipped"]:
+            lines.append(f"\ntrace replay: SKIPPED — {rp['skipped']}")
+        elif rp["mismatches"]:
+            lines.append("\ntrace replay: FAILED")
+            lines += [f"  ! {m}" for m in rp["mismatches"]]
+        else:
+            lines.append(
+                f"\ntrace replay: bit-exact "
+                f"({rp['traces_completed']} journeys)"
+            )
+            if rp["stage_waterfall"]:
+                lines.append("stage waterfall (us):")
+                for k, s in rp["stage_waterfall"].items():
+                    lines.append(
+                        f"  {k:<18} n={s['count']:<6} p50={s['p50']:.0f} "
+                        f"p95={s['p95']:.0f} p99={s['p99']:.0f}"
+                    )
+            if rp["freshness"]:
+                s = rp["freshness"]
+                lines.append(
+                    f"freshness (submit->client-ack, us): "
+                    f"n={s['count']} p50={s['p50']:.0f} "
+                    f"p95={s['p95']:.0f} p99={s['p99']:.0f}"
+                )
     return "\n".join(lines) + "\n"
 
 
@@ -371,8 +579,13 @@ def main(argv=None) -> int:
         "--json-out", default="",
         help="also write the machine-readable report here",
     )
+    ap.add_argument(
+        "--slo", action="store_true",
+        help="replay the trace-plane events bit-exactly and render the "
+             "stage waterfall + end-to-end freshness quantiles",
+    )
     args = ap.parse_args(argv)
-    report = build_report(args.dump)
+    report = build_report(args.dump, slo=args.slo)
     print(render_text(report), end="")
     if args.json_out:
         with open(args.json_out, "w") as f:

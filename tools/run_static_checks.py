@@ -108,6 +108,18 @@ Sections (each timed, each independently skippable):
   bytes moved / eqn count per entry vs the committed
   ``tools/cost_budgets.json``; >10% regression fails.
   ``--write-budgets`` re-baselines the table instead of checking.
+- ``slo``       — the trace-plane/SLO gates (crdt_tpu.obs.trace +
+  crdt_tpu.analysis.slo): trace-stage registry coverage (every literal
+  ``stamp("...")`` site under ``crdt_tpu/`` must have registered —
+  crdt_tpu.analysis.registry.register_trace_stage), the tracer
+  conformance detector (canonical journey completes, stamps monotonic,
+  latencies bit-equal to ``derive_latencies``) with its two committed
+  broken twins (``analysis.fixtures.tracer_skips_stage``,
+  ``fixtures.tracer_clock_regresses``) proving it fires, and the
+  committed ``tools/slo_budgets.json`` freshness regression gate over
+  the deterministic canonical serve+fanout workload (counts exact,
+  latency quantiles >10% regression fails; ``--write-budgets``
+  re-baselines).
 - ``aliasing``  — the compiled-HLO input_output_alias gate
   (tools/check_aliasing.py) over every registered donating entry.
 
@@ -144,7 +156,7 @@ sys.path.insert(0, ROOT)
 SECTIONS = (
     "lint", "schema", "laws", "schedules", "faults", "decomp",
     "durability", "scaleout", "obs", "wire", "serve", "fanout",
-    "jit-lint", "cost", "aliasing",
+    "jit-lint", "cost", "slo", "aliasing",
 )
 
 # Directories the fallback linter walks (ruff takes its own config).
@@ -351,6 +363,51 @@ def run_cost(write_budgets: bool = False):
     return cost.check_budgets()
 
 
+def run_slo(write_budgets: bool = False):
+    """The trace-plane/SLO section: stamp-site registry coverage
+    (every literal ``stamp("...")`` stage under crdt_tpu/ must be
+    registered), tracer conformance with both committed broken twins
+    proven to fire, and the committed ``tools/slo_budgets.json``
+    freshness regression gate."""
+    from crdt_tpu.analysis import fixtures, slo
+    from crdt_tpu.analysis.registry import unregistered_trace_stages
+    from crdt_tpu.analysis.report import Finding
+    from crdt_tpu.obs import trace
+
+    findings = []
+    for name, where in unregistered_trace_stages():
+        findings.append(Finding(
+            "slo-stage-coverage", name,
+            f"trace stage stamped at {where} has no registration "
+            "(register_trace_stage) — the SLO waterfall cannot place "
+            "the leg it bounds",
+        ))
+    if not trace.tracer_conformant(trace.Tracer):
+        findings.append(Finding(
+            "slo-tracer-conformance", "Tracer",
+            "the tracer orphaned, double-completed, or mis-derived a "
+            "canonical two-tenant journey (conformance probe)",
+        ))
+    if trace.tracer_conformant(fixtures.tracer_skips_stage):
+        findings.append(Finding(
+            "slo-tracer-conformance", "fixtures.tracer_skips_stage",
+            "the durable-stamp-dropping broken twin PASSED the tracer "
+            "conformance detector — the detector has no teeth",
+        ))
+    if trace.tracer_conformant(fixtures.tracer_clock_regresses):
+        findings.append(Finding(
+            "slo-tracer-conformance", "fixtures.tracer_clock_regresses",
+            "the regressing-clock broken twin PASSED the tracer "
+            "conformance detector — the detector has no teeth",
+        ))
+    if write_budgets:
+        measured = slo.write_budgets()
+        print(f"     wrote {len(measured)} SLO baselines -> "
+              f"{os.path.relpath(slo.SLO_BUDGET_PATH, ROOT)}")
+        return findings
+    return findings + slo.check_budgets()
+
+
 def run_aliasing() -> List[str]:
     import check_aliasing
 
@@ -376,12 +433,14 @@ RUNNERS = {
     "fanout": run_fanout,
     "jit-lint": run_jit_lint,
     "cost": run_cost,
+    "slo": run_slo,
     "aliasing": run_aliasing,
 }
 
 _JAX_SECTIONS = (
     "laws", "schedules", "faults", "decomp", "durability", "scaleout",
-    "obs", "wire", "serve", "fanout", "jit-lint", "cost", "aliasing",
+    "obs", "wire", "serve", "fanout", "jit-lint", "cost", "slo",
+    "aliasing",
 )
 
 
@@ -410,8 +469,9 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--write-budgets", action="store_true",
-        help="re-baseline tools/cost_budgets.json instead of checking "
-        "(the cost section's tile_sweep --write-table flow)",
+        help="re-baseline tools/cost_budgets.json and "
+        "tools/slo_budgets.json instead of checking (the cost/slo "
+        "sections' tile_sweep --write-table flow)",
     )
     args = ap.parse_args(argv)
 
@@ -456,6 +516,8 @@ def main(argv=None) -> int:
         try:
             if section == "cost":
                 found = run_cost(write_budgets=args.write_budgets)
+            elif section == "slo":
+                found = run_slo(write_budgets=args.write_budgets)
             else:
                 found = RUNNERS[section]()
             findings = _as_findings(section, found)
