@@ -1831,6 +1831,390 @@ def bench_serve():
     }]
 
 
+def bench_serve_zipf():
+    """Pipelined always-on serving leg (rides ``--serve``; ISSUE 18's
+    acceptance gate): a zipf-popularity op stream through the
+    WAL-logged pipelined :class:`ServeLoop` —
+
+    1. **serial baseline, timed** — the SAME pregenerated op schedule
+       first runs through PR 15's serial flush loop (assemble → WAL →
+       dispatch → wait, one round at a time, its own WAL dir), then
+       through the pipelined loop (slab N+1 assembles + WAL-commits
+       while slab N's scatter is in flight, cold persists on the
+       background drain) — the ops/s ratio is the pipelining win and
+       ``overlap_hit`` counts the rounds host work genuinely hid
+       device time.
+    2. **hot-shard skew event** — the middle third of the window
+       multiplies one shard's draw popularity by ``skew_factor`` (10×);
+       after its first skewed cycle the evictor's touch stats drive
+       ``serve.shard.rebalance`` (placement overrides, minimal-move),
+       and the record reports p99 dispatch latency before/during/after
+       plus the max/mean host-load ratio at skew onset and
+       post-rebalance.
+    3. **kill-anywhere durability** — after the window a FRESH
+       superblock recovers from the snapshot tier + serve-WAL replay
+       (the same bit-identical apply path) and every sampled tenant
+       must match the served row bit-exactly — zero acked ops lost.
+       The pipelined rows are also checked against the serial
+       baseline's AND the per-tenant sequential oracle.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from crdt_tpu import telemetry as tele
+    from crdt_tpu.obs import hist as obs_hist
+    from crdt_tpu.ops import superblock as sb_ops
+    from crdt_tpu.parallel import make_mesh
+    from crdt_tpu.serve import (
+        Evictor,
+        IngestQueue,
+        ServeLoop,
+        ServeWal,
+        Superblock,
+        TenantShardMap,
+        host_loads,
+        rebalance,
+        recover_serve,
+    )
+
+    cfg = bench_configs()["serve"]
+
+    def knob(key, env):
+        return int(os.environ.get(env, cfg[key]))
+
+    tenants = knob("zipf_tenants", "BENCH_SERVE_ZIPF_TENANTS")
+    lanes = knob("zipf_lanes", "BENCH_SERVE_ZIPF_LANES")
+    slab_lanes = knob("zipf_slab_lanes", "BENCH_SERVE_ZIPF_SLAB_LANES")
+    slab_depth = knob("zipf_slab_depth", "BENCH_SERVE_ZIPF_SLAB_DEPTH")
+    cycles = knob("zipf_cycles", "BENCH_SERVE_ZIPF_CYCLES")
+    ops_per_cycle = knob(
+        "zipf_ops_per_cycle", "BENCH_SERVE_ZIPF_OPS_PER_CYCLE"
+    )
+    alpha = float(os.environ.get(
+        "BENCH_SERVE_ZIPF_ALPHA", cfg["zipf_alpha"]
+    ))
+    skew_factor = float(cfg["zipf_skew_factor"])
+    hosts = int(cfg["zipf_hosts"])
+    oracle_sample = int(cfg["zipf_oracle_sample"])
+    persist_ahead = knob(
+        "zipf_persist_ahead", "BENCH_SERVE_ZIPF_PERSIST_AHEAD"
+    )
+    rebalance_top = int(cfg["zipf_rebalance_top"])
+    p = min(cfg["mesh"][0], len(jax.devices()))
+    mesh = make_mesh(p, 1)
+    caps = dict(
+        n_elems=cfg["elems"], n_actors=cfg["actors"],
+        deferred_cap=cfg["deferred_cap"],
+    )
+    e, a = caps["n_elems"], caps["n_actors"]
+    rng = np.random.default_rng(181)
+
+    # Zipf popularity over a shuffled rank order, plus the skewed
+    # variant: the hottest tenant's OWN shard gets skew_factor× draw
+    # weight for the middle third of the window.
+    shard = TenantShardMap(hosts)
+    ranks = rng.permutation(tenants).astype(np.float64)
+    base_w = 1.0 / (ranks + 1.0) ** alpha
+    owner0 = np.asarray([shard.owner(t) for t in range(tenants)])
+    hot_host = int(owner0[int(np.argmin(ranks))])
+    skew_w = base_w * np.where(owner0 == hot_host, skew_factor, 1.0)
+    p_base = base_w / base_w.sum()
+    p_skew = skew_w / skew_w.sum()
+
+    # Pregenerate the FULL op schedule (warmup cycle 0 + the window) so
+    # the serial baseline and the pipelined loop apply bit-identical
+    # streams; the oracle history falls out of the same pass.
+    next_ctr = np.zeros(tenants, np.uint32)
+    history: dict = {}
+    third = max(cycles // 3, 1)
+    during = range(third + 1, 2 * third + 1)
+
+    def gen_cycle(n_ops, pv):
+        ts = rng.choice(tenants, size=n_ops, p=pv)
+        adds = rng.random(n_ops) < 0.85
+        masks = rng.random((n_ops, e)) < 0.4
+        ops = []
+        for i in range(n_ops):
+            t = int(ts[i])
+            act = t % a
+            m = masks[i]
+            if adds[i] or next_ctr[t] == 0:
+                c = int(next_ctr[t]) + 1
+                next_ctr[t] = c
+                op = (t, sb_ops.ADD, act, c, None, m)
+            else:
+                clock = np.zeros(a, np.uint32)
+                clock[act] = next_ctr[t]
+                op = (t, sb_ops.RM, 0, 0, clock, m)
+            ops.append(op)
+            history.setdefault(t, []).append(op[1:])
+        return ops
+
+    schedule = [gen_cycle(256, p_base)]  # cycle 0 = compile warmup
+    for cycle in range(1, cycles + 1):
+        schedule.append(gen_cycle(
+            ops_per_cycle, p_skew if cycle in during else p_base
+        ))
+
+    def submit(q, ops):
+        for t, k, act, c, clock, m in ops:
+            if k == sb_ops.ADD:
+                q.add(t, act, c, m)
+            else:
+                q.rm(t, clock, m)
+
+    root = tempfile.mkdtemp(prefix="bench-serve-zipf-")
+    rec, prev_rec, snap_base = _flight_start(capacity=16384)
+    try:
+        # ---- serial baseline: PR 15's flush loop, WAL and all -------
+        sb_s = Superblock(
+            tenants, mesh, kind="orswot", caps=caps, n_lanes=lanes,
+        )
+        ev_s = Evictor(sb_s, os.path.join(root, "tier_serial"))
+        wal_s = ServeWal(os.path.join(root, "wal_serial"))
+        q_s = IngestQueue(
+            sb_s, lanes=slab_lanes, depth=slab_depth,
+            max_pending=1 << 20, evictor=ev_s, wal=wal_s,
+        )
+        submit(q_s, schedule[0])
+        q_s.drain()  # compile outside the timed window
+        t0 = time.perf_counter()
+        for cycle in range(1, cycles + 1):
+            submit(q_s, schedule[cycle])
+            q_s.drain(telemetry=True)
+        serial_s = time.perf_counter() - t0
+        wal_s.close()
+
+        # ---- the pipelined loop over the same schedule --------------
+        sb = Superblock(
+            tenants, mesh, kind="orswot", caps=caps, n_lanes=lanes,
+        )
+        ev = Evictor(sb, os.path.join(root, "tier"))
+        swal = ServeWal(os.path.join(root, "wal"))
+        q = IngestQueue(
+            sb, lanes=slab_lanes, depth=slab_depth,
+            max_pending=1 << 20, evictor=ev, wal=swal,
+        )
+        loop = ServeLoop(q, persist_ahead=persist_ahead)
+        submit(q, schedule[0])
+        loop.drain()  # warmup: compile + settle the pipeline
+        phase_tel = {"before": None, "during": None, "after": None}
+        moves = 0
+        load_ratio_onset = load_ratio_after = 0.0
+        total_ops = 0
+        dispatches = 0
+        t0 = time.perf_counter()
+        for cycle in range(1, cycles + 1):
+            phase = ("before" if cycle <= third else
+                     "during" if cycle in during else "after")
+            submit(q, schedule[cycle])
+            # Keep stepping while THIS cycle's ops are placeable; the
+            # in-flight slab rides across the cycle boundary — the
+            # always-on pipeline never drains between cycles.
+            while q.n_pending:
+                before_p = q.n_pending
+                rep, t = loop.step(telemetry=True)
+                if rep is not None:
+                    total_ops += rep.ops_applied
+                    dispatches += rep.dispatches
+                if t is not None:
+                    phase_tel[phase] = (
+                        t if phase_tel[phase] is None
+                        else tele.combine(phase_tel[phase], t)
+                    )
+                    tele.record("serve", t)
+                if q.n_pending >= before_p and loop.inflight is None:
+                    break  # nothing placeable (cannot happen; guard)
+            if cycle == third + 1:
+                # First skewed cycle done: the evictor's touch stats
+                # ARE the heat signal — plan + land the overrides.
+                tc = ev.touch_count
+                top = np.argsort(tc)[-rebalance_top:]
+                wts = {int(t_): float(tc[t_]) for t_ in top if tc[t_]}
+                if wts:
+                    lb = host_loads(shard, list(wts), wts)
+                    mean = sum(lb.values()) / max(len(lb), 1)
+                    load_ratio_onset = max(lb.values()) / max(mean, 1e-9)
+                    plan = rebalance(
+                        shard, list(wts), wts, threshold=1.25,
+                    )
+                    moves = len(plan)
+                    loop.note_rebalance(moves)
+                    la = host_loads(shard, list(wts), wts)
+                    load_ratio_after = (
+                        max(la.values()) / max(mean, 1e-9)
+                    )
+        rep, t = loop.flush_inflight(telemetry=True)
+        if rep is not None:
+            total_ops += rep.ops_applied
+            dispatches += rep.dispatches
+        if t is not None:
+            phase = "after"
+            phase_tel[phase] = (
+                t if phase_tel[phase] is None
+                else tele.combine(phase_tel[phase], t)
+            )
+            tele.record("serve", t)
+        window_s = time.perf_counter() - t0
+        wal_bytes = swal.bytes_appended
+        wal_fsyncs = swal.fsyncs
+        overlap_hits = loop.overlap_hits
+        bg_persists = loop.persister.persisted if loop.persister else 0
+        swal.sync()
+
+        p99 = {}
+        for ph, t in phase_tel.items():
+            d = tele.to_dict(t) if t is not None else None
+            p99[ph] = (
+                obs_hist.summary(d["hist_dispatch_us"])["p99"]
+                if d else 0.0
+            )
+        tel_all = None
+        for t in phase_tel.values():
+            if t is not None:
+                tel_all = t if tel_all is None else tele.combine(tel_all, t)
+        d_all = tele.to_dict(tel_all)
+
+        # The flight artifact covers the measured window; finish (and
+        # bit-exact-cross-check) before the oracle/recovery phases
+        # restore tenants in bulk.
+        flight = _flight_finish("serve_zipf", rec, prev_rec, snap_base)
+
+        # ---- oracle + serial-equivalence + recovery bit-identity ----
+        touched = np.asarray(sorted(history))
+        hot_sample = touched[np.argsort(ranks[touched])][:oracle_sample // 2]
+        rest = rng.choice(
+            touched, min(oracle_sample, len(touched)), replace=False,
+        )
+        sample = sorted({int(x) for x in hot_sample} | {
+            int(x) for x in rest
+        })[:oracle_sample]
+        tk = sb.tk
+        oracle_mm = serial_mm = 0
+        for t_ in sample:
+            ev.restore(t_)
+            ev_s.restore(t_)
+            got = sb.row(t_)
+            want = sb_ops.sequential_oracle(
+                tk, tk.empty(**sb.caps), history[t_]
+            )
+            base = sb_s.row(t_)
+            leaves = lambda s: [np.asarray(x) for x in jax.tree.leaves(s)]  # noqa: E731
+            if not all(
+                np.array_equal(x, y)
+                for x, y in zip(leaves(got), leaves(want))
+            ):
+                oracle_mm += 1
+            if not all(
+                np.array_equal(x, y)
+                for x, y in zip(leaves(got), leaves(base))
+            ):
+                serial_mm += 1
+        assert oracle_mm == 0, (
+            f"{oracle_mm}/{len(sample)} sampled tenants diverged from "
+            f"the per-tenant sequential oracle under the pipelined loop"
+        )
+        assert serial_mm == 0, (
+            f"{serial_mm}/{len(sample)} sampled tenants diverged "
+            f"between the pipelined loop and the serial baseline"
+        )
+
+        # Kill-anywhere recovery: a FRESH superblock + snapshot tier +
+        # serve-WAL replay must land every sampled row bit-identically
+        # — the zero-acked-op-loss gate of record.
+        swal.close()
+        sb_r = Superblock(
+            tenants, mesh, kind="orswot", caps=caps, n_lanes=lanes,
+        )
+        ev_r = Evictor(sb_r, os.path.join(root, "tier"))
+        q_r = IngestQueue(
+            sb_r, lanes=slab_lanes, depth=slab_depth,
+            max_pending=1 << 20, evictor=ev_r,
+        )
+        with ServeWal(os.path.join(root, "wal")) as swal_r:
+            replayed = recover_serve(
+                os.path.join(root, "tier"), q_r, swal_r,
+            )
+        recov_mm = 0
+        for t_ in sample:
+            ev.restore(t_)
+            ev_r.restore(t_)
+            leaves = lambda s: [np.asarray(x) for x in jax.tree.leaves(s)]  # noqa: E731
+            if not all(
+                np.array_equal(x, y)
+                for x, y in zip(leaves(sb.row(t_)), leaves(sb_r.row(t_)))
+            ):
+                recov_mm += 1
+        assert recov_mm == 0, (
+            f"{recov_mm}/{len(sample)} sampled tenants lost acked ops "
+            f"across the kill/recover boundary — the WAL-before-"
+            f"dispatch contract is broken"
+        )
+        bit_identical = oracle_mm == serial_mm == recov_mm == 0
+    except BaseException:
+        from crdt_tpu import obs as _obs
+
+        _obs.install(prev_rec)
+        raise
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    serial_ops = total_ops / max(serial_s, 1e-9)
+    pipe_ops = total_ops / max(window_s, 1e-9)
+    overlap_ratio = overlap_hits / max(dispatches, 1)
+    skew_ratio = p99["during"] / max(p99["before"], 1e-9)
+    log(
+        f"config-serve_zipf: zipf(α={alpha}) over {tenants:,} tenants "
+        f"on {lanes:,} lanes, {skew_factor:.0f}× hot-shard skew "
+        f"mid-window: {total_ops:,} ops pipelined in {window_s:.2f}s = "
+        f"{pipe_ops:,.0f} ops/s (serial baseline {serial_ops:,.0f} "
+        f"ops/s, {pipe_ops / max(serial_ops, 1e-9):.2f}×); overlap hit "
+        f"{overlap_hits}/{dispatches} dispatches ({overlap_ratio:.0%});"
+        f" WAL {wal_bytes:,} bytes / {wal_fsyncs} fsyncs; dispatch p99 "
+        f"{p99['before']:,.0f}/{p99['during']:,.0f}/{p99['after']:,.0f}"
+        f" us before/during/after skew; {moves} rebalance moves "
+        f"(load ratio {load_ratio_onset:.2f}→{load_ratio_after:.2f}); "
+        f"{bg_persists} background persists; {replayed.ops:,} ops "
+        f"replayed on recovery; {len(sample)} tenants oracle+serial+"
+        f"recovery bit-identical"
+    )
+    return [{
+        "config": "serve_zipf", "metric": "serve_zipf_ops_per_sec",
+        "value": round(pipe_ops, 1), "unit": "ops/s",
+        "tenants": tenants, "lanes": lanes,
+        "zipf_alpha": alpha, "skew_factor": skew_factor,
+        "hosts": hosts, "hot_host": hot_host,
+        "ops_applied": total_ops,
+        "window_seconds": round(window_s, 3),
+        "serial_ops_per_sec": round(serial_ops, 1),
+        "pipeline_speedup": round(pipe_ops / max(serial_ops, 1e-9), 3),
+        "dispatches": dispatches,
+        "overlap_hits": overlap_hits,
+        "overlap_hit_ratio": round(overlap_ratio, 4),
+        "serve_wal_bytes": int(wal_bytes),
+        "serve_wal_fsyncs": int(wal_fsyncs),
+        "background_persists": bg_persists,
+        "dispatch_p99_before_us": round(p99["before"], 1),
+        "dispatch_p99_during_us": round(p99["during"], 1),
+        "dispatch_p99_after_us": round(p99["after"], 1),
+        "skew_p99_ratio": round(skew_ratio, 3),
+        "rebalance_moves": moves,
+        "skew_load_ratio_onset": round(load_ratio_onset, 3),
+        "skew_load_ratio_rebalanced": round(load_ratio_after, 3),
+        "ingest_coalesced_ops": d_all["ingest_coalesced_ops"],
+        "replayed_records": replayed.records,
+        "replayed_ops": replayed.ops,
+        "oracle_sampled": len(sample),
+        "bit_identical": bit_identical,
+        "recovered_bit_identical": recov_mm == 0,
+        "acked_ops_lost": recov_mm,
+        "shape": f"{tenants}x{e}x{a}@{lanes}lanes",
+        **flight,
+    }]
+
+
 def bench_fanout():
     """δ-subscription fan-out egress leg (``--fanout`` runs it alone;
     ISSUE 16's acceptance gate): ≥1M subscribers registered over the
@@ -3038,6 +3422,8 @@ def main(argv=None):
 
         with span("bench.serve", quick=True):
             recs = bench_serve()
+        with span("bench.serve_zipf", quick=True):
+            recs += bench_serve_zipf()
         for rec in recs:
             rec["degraded"] = bool(
                 rec.get("degraded", False)
@@ -3196,6 +3582,7 @@ def main(argv=None):
         ("recovery", bench_recovery),
         ("scaleout", bench_scaleout),
         ("serve", bench_serve),
+        ("serve_zipf", bench_serve_zipf),
         ("fanout", bench_fanout),
     ]:
         if os.environ.get(f"BENCH_{name.upper()}", "1") != "0":
@@ -3357,6 +3744,24 @@ def main(argv=None):
                 "resident_ratio", "evict_cohort",
                 "evict_restored_in_window", "bit_identical",
             ) if k in sv
+        }
+    # The pipelined zipf serving leg rides the headline too: sustained
+    # ops/s vs the serial baseline, overlap-hit ratio, WAL volume, the
+    # skew p99 trajectory, and zero-acked-op-loss recovery is ISSUE
+    # 18's metric of record.
+    sz = next(
+        (r for r in records if r.get("config") == "serve_zipf"), None,
+    )
+    if sz is not None:
+        headline["serve_zipf"] = {
+            k: sz[k] for k in (
+                "value", "serial_ops_per_sec", "pipeline_speedup",
+                "overlap_hit_ratio", "serve_wal_bytes",
+                "serve_wal_fsyncs", "dispatch_p99_before_us",
+                "dispatch_p99_during_us", "dispatch_p99_after_us",
+                "skew_p99_ratio", "rebalance_moves", "acked_ops_lost",
+                "bit_identical",
+            ) if k in sz
         }
     # The fanout leg rides the headline record too: δ-pushes/s and
     # bytes/subscriber vs the full-state push at 1M+ live subscribers

@@ -491,6 +491,25 @@ def drain_ignores_unacked(kind, rank, rows, residue, counters=None, **kw):
 
 # ---- serving-tier twins (crdt_tpu/serve/) ---------------------------------
 
+def serve_dispatch_before_wal(queue, built):
+    """Broken serving twin (ISSUE 18): a flush that issues the device
+    dispatch BEFORE the slab's WAL record is group-committed — every op
+    acked in the window between scatter and fsync is lost by a kill,
+    exactly the log-before-dispatch ordering bug the dirty-tenant WAL
+    exists to prevent. Never executed: ``serve.wal.wal_order_violations``
+    AST-scans the source, and the ``pipeline`` static-check section pins
+    that the detector fires on this twin while the honest
+    ``IngestQueue.flush`` / ``ServeLoop.step`` pass."""
+    pending = queue.sb.apply_async(  # dispatch first — the bug
+        built.slab, built.idx, built.tenants
+    )
+    seq = queue.wal.log_slab(  # durable only AFTER the scatter is off
+        built.kind, built.actor, built.ctr, built.clock, built.member,
+        built.tenants,
+    )
+    return pending, seq
+
+
 def evictor_drops_dirt(evictor, tenants):
     """Broken serving twin: an evictor that clears a tenant's device
     lane WITHOUT persisting its dirty row first — the durable tier

@@ -80,14 +80,18 @@ def _probe_workload(root: str, states) -> None:
     serving-tier tenant persist/restore crosses the ``serve.evict.*``
     / ``serve.restore.*`` boundaries (crdt_tpu/serve/evict.py — the
     evict write-ordering the fuzz loop must be able to kill inside),
-    and one fan-out subscribe→push→ack round crosses the
+    one fan-out subscribe→push→ack round crosses the
     ``fanout.ack.*`` boundaries (crdt_tpu/fanout/plane.py — promote
-    and resync, the subscription state the fuzz loop kills inside).
+    and resync, the subscription state the fuzz loop kills inside),
+    and one WAL-logged pipelined flush + background persist drain
+    crosses the ``serve.wal.*`` / ``serve.dispatch.*`` /
+    ``serve.persist.*`` boundaries (crdt_tpu/serve/wal.py + loop.py).
     The serve and fanout tails never touch the main wal/snap dirs, so
     ``_probe_recover``'s last-durable-record contract is unchanged."""
     import os
 
     import jax
+    import numpy as np
 
     w = Wal(
         os.path.join(root, "wal"), fsync="every_n", every_n=1,
@@ -126,6 +130,28 @@ def _probe_workload(root: str, states) -> None:
     plane.note_dirty([0])
     plane.push()
     plane.ack(ids)
+    # The pipelined-serving tail (ISSUE 18): one WAL-logged flush
+    # crosses serve.wal.pre_log / serve.wal.post_log_pre_dispatch /
+    # serve.dispatch.post_scatter_pre_ack, then one background persist
+    # drain crosses serve.persist.background_drain. Writes only under
+    # root/serve* (its own ServeWal dir + evictor tier), so
+    # ``_probe_recover``'s last-durable-record contract over root/wal +
+    # root/snap is untouched.
+    from ..serve import (
+        BackgroundPersister, Evictor, IngestQueue, ServeWal,
+    )
+
+    swal = ServeWal(os.path.join(root, "serve_wal"))
+    try:
+        ev = Evictor(sb, os.path.join(root, "serve_evict"))
+        q = IngestQueue(sb, lanes=1, depth=2, evictor=ev, wal=swal)
+        q.add(0, 0, 1, np.isin(np.arange(4), [0]))
+        q.drain()
+        bp = BackgroundPersister(ev, batch=1)
+        bp.enqueue([0])
+        bp.drain()
+    finally:
+        swal.close()
 
 
 def _probe_recover(root: str, states):

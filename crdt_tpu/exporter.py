@@ -196,9 +196,11 @@ def health(snapshot: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
       counters worth paging on;
     - ``serving`` — the serving-tier vitals: served tenant population
       and live subscribers (worst per-kind telemetry gauge), ingest
-      backpressure refusals, fan-out resync fallbacks, and the newest
-      end-to-end freshness p99 (µs; -1 until a sampled trace completes
-      — crdt_tpu/obs/trace.py);
+      backpressure refusals, fan-out resync fallbacks, the pipelined
+      loop's durability and overlap totals (serve-WAL bytes, overlap
+      hits, rebalance moves — ISSUE 18), and the newest end-to-end
+      freshness p99 (µs; -1 until a sampled trace completes —
+      crdt_tpu/obs/trace.py);
     - ``flight`` — the recorder's correlation key + buffered/dropped
       event counts (null when none is installed).
 
@@ -247,6 +249,18 @@ def health(snapshot: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
             "resync_fallbacks": int(sum(
                 v for name, v in counters.items()
                 if name.endswith(".fanout.resync_fallbacks")
+            )),
+            "serve_wal_bytes": int(sum(
+                v for name, v in counters.items()
+                if name.endswith(".serve.wal_bytes")
+            )),
+            "overlap_hits": int(sum(
+                v for name, v in counters.items()
+                if name.endswith(".serve.overlap_hit")
+            )),
+            "rebalance_moves": int(sum(
+                v for name, v in counters.items()
+                if name.endswith(".serve.rebalance_moves")
             )),
             "freshness_p99_us": float(
                 last("obs.trace.freshness_p99_us", -1.0)

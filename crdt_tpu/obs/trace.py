@@ -141,15 +141,21 @@ def derive_latencies(stamps: Sequence) -> Dict[str, int]:
 
 class _Trace:
     """One sampled op journey: the stamp list plus the pushed version
-    the completing ack must cover."""
+    the completing ack must cover. ``wal_seq`` is the durable record
+    id the op's slab group-committed under (crdt_tpu/serve/wal.py) —
+    set ONCE at the first ``durable`` stamp and preserved across
+    requeues, so a WAL'd op that rolls back on CapacityOverflow
+    re-dispatches under the SAME durable id its log record already
+    carries (replay and trace ids agree after recovery)."""
 
-    __slots__ = ("tid", "tenant", "stamps", "push_ver")
+    __slots__ = ("tid", "tenant", "stamps", "push_ver", "wal_seq")
 
     def __init__(self, tid: int, tenant: int):
         self.tid = tid
         self.tenant = tenant
         self.stamps: List[list] = []
         self.push_ver: Optional[int] = None
+        self.wal_seq: Optional[int] = None
 
     def has(self, stage: str) -> bool:
         return any(s == stage for s, _t in self.stamps)
@@ -192,7 +198,7 @@ class Tracer:
 
     # ---- stamping --------------------------------------------------------
     def stamp(self, stage: str, *, tenant=None, tenants=None,
-              version=None, count=None, **_fields) -> None:
+              version=None, count=None, seq=None, **_fields) -> None:
         """Record one pipeline boundary crossing. ``tenant``/
         ``tenants`` scope the stamp (None on ``durable`` = every
         dispatched trace — the WAL group-commit fsync covers the whole
@@ -200,7 +206,9 @@ class Tracer:
         flush takes at most ``depth`` ops per tenant, so only that
         many waiting traces coalesce); ``version`` is the fan-out
         plane's shipped (``push``) or promoted (``ack``) watermark
-        version."""
+        version; ``seq`` (``durable`` only) is the serve-WAL record id
+        the stamped ops group-committed under — recorded once per
+        trace and sticky across requeues."""
         t_ns = int(self.clock_ns())
         with self._lock:
             if stage == "submit":
@@ -209,7 +217,7 @@ class Tracer:
                 scope = tenants if tenants is not None else (
                     [tenant] if tenant is not None else None
                 )
-                self._chain(stage, scope, t_ns, count)
+                self._chain(stage, scope, t_ns, count, seq)
             elif stage == "push":
                 self._push(int(tenant), int(version), t_ns)
             elif stage == "ack":
@@ -219,10 +227,18 @@ class Tracer:
             else:
                 raise ValueError(f"unknown trace stage {stage!r}")
 
-    def requeue(self, tenants) -> int:
+    def requeue(self, tenants, seq=None) -> int:
         """Roll coalesced-but-undispatched traces back to their submit
         stamp (the ingest queue's loss-free re-queue, mirrored: the
-        op's next flush re-coalesces it). Returns traces rolled."""
+        op's next flush re-coalesces it). Returns traces rolled.
+
+        ``seq`` is the durable WAL record id of the slab the op was
+        rolled OUT of (the dirty-tenant WAL logs before dispatch, so a
+        CapacityOverflow requeue can follow a successful group
+        commit): the rolled trace RECORDS it — sticky, first seq wins
+        — instead of losing it with the stamps, so the op's eventual
+        re-dispatch completes under the id its durable record already
+        carries and recovery replay agrees with the trace plane."""
         n = 0
         with self._lock:
             for ten in tenants:
@@ -231,11 +247,14 @@ class Tracer:
                         continue
                     tr.stamps[:] = tr.stamps[:1]
                     tr.push_ver = None
+                    if seq is not None and tr.wal_seq is None:
+                        tr.wal_seq = int(seq)
                     n += 1
                     self.requeued += 1
                     metrics.count("obs.trace.requeued")
                     _rec.emit(
                         "trace_requeue", trace=tr.tid, tenant=tr.tenant,
+                        wal_seq=tr.wal_seq,
                     )
         return n
 
@@ -259,7 +278,7 @@ class Tracer:
         self._stamp_one(tr, "submit", t_ns)
 
     def _chain(self, stage: str, tenants, t_ns: int,
-               count: Optional[int] = None) -> None:
+               count: Optional[int] = None, seq=None) -> None:
         prev = {"coalesce": "submit", "dispatch": "coalesce",
                 "durable": "dispatch"}[stage]
         scope = (
@@ -273,6 +292,9 @@ class Tracer:
                     break
                 if tr.has(stage) or not tr.has(prev):
                     continue
+                if (stage == "durable" and seq is not None
+                        and tr.wal_seq is None):
+                    tr.wal_seq = int(seq)
                 self._stamp_one(tr, stage, t_ns)
                 left -= 1
 
@@ -327,11 +349,12 @@ class Tracer:
         rec = {
             "trace": tr.tid, "tenant": tr.tenant,
             "stamps": [list(s) for s in tr.stamps], "lat": dict(lat),
+            "wal_seq": tr.wal_seq,
         }
         self.recent.append(rec)
         _rec.emit(
             "trace_complete", trace=tr.tid, tenant=tr.tenant,
-            stamps=rec["stamps"], lat=rec["lat"],
+            stamps=rec["stamps"], lat=rec["lat"], wal_seq=tr.wal_seq,
         )
 
     # ---- accounting ------------------------------------------------------
@@ -434,13 +457,14 @@ def stamp(stage: str, **fields) -> None:
     tr.stamp(stage, **fields)
 
 
-def requeue(tenants) -> int:
+def requeue(tenants, seq=None) -> int:
     """Module-level :meth:`Tracer.requeue` (no-op uninstalled) — the
-    ingest flush's loss-free exception path calls this."""
+    ingest flush's loss-free exception path calls this, passing the
+    rolled slab's durable WAL seq when one was group-committed."""
     tr = _tracer
     if tr is None:
         return 0
-    return tr.requeue(tenants)
+    return tr.requeue(tenants, seq=seq)
 
 
 # ---- hot-tenant skew attribution -------------------------------------------
@@ -564,11 +588,12 @@ register_obs_event(
 )
 register_obs_event(
     "trace_complete", subsystem="obs.trace",
-    fields=("trace", "tenant", "stamps", "lat"), module=__name__,
+    fields=("trace", "tenant", "stamps", "lat", "wal_seq"),
+    module=__name__,
 )
 register_obs_event(
     "trace_requeue", subsystem="obs.trace",
-    fields=("trace", "tenant"), module=__name__,
+    fields=("trace", "tenant", "wal_seq"), module=__name__,
 )
 
 

@@ -147,6 +147,9 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         live_tenants=jnp.zeros((), jnp.uint32),
         evicted_tenants=jnp.zeros((), jnp.uint32),
         ingest_coalesced_ops=jnp.zeros((), jnp.uint32),
+        serve_wal_bytes=jnp.zeros((), jnp.float32),
+        serve_overlap_hit=jnp.zeros((), jnp.uint32),
+        rebalance_moves=jnp.zeros((), jnp.uint32),
         # The fan-out fields are filled by the subscription plane
         # (crdt_tpu/fanout/ FanoutPlane.annotate + mesh_fanout_push's
         # telemetry body) — never on the anti-entropy paths.
@@ -173,6 +176,9 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         hist_push_lag_us=_hist.zeros(),
         hist_ack_lag_us=_hist.zeros(),
         hist_freshness_us=_hist.zeros(),
+        # hist_persist_us is filled host-side by the serve layer's
+        # BackgroundPersister (crdt_tpu/serve/loop.py) — never in-kernel.
+        hist_persist_us=_hist.zeros(),
     )
 
 

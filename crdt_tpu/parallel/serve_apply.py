@@ -64,13 +64,21 @@ def mesh_serve_apply(
     kind: str = "orswot",
     donate: bool = False,
     telemetry: bool = False,
+    sync: bool = True,
 ):
     """Apply one coalesced op slab to a tenant superblock, sharded over
     the replica mesh axis. Returns ``(state, overflow[B])`` — or
     ``(state, overflow, Telemetry)`` with ``telemetry=True``.
     ``overflow`` flags tenants whose bounded buffers could not take an
     op (deferred parking / sparse dot capacity): the serve layer's
-    widen-before-retry signal (crdt_tpu/serve/superblock.py)."""
+    widen-before-retry signal (crdt_tpu/serve/superblock.py).
+
+    ``sync=False`` skips the telemetry path's block-until-ready + host
+    dispatch timing and returns the in-flight arrays immediately — the
+    pipelined serving loop's issue half (crdt_tpu/serve/loop.py owns
+    the completion wait and folds ``hist_dispatch_us`` itself; the
+    compiled program is the SAME either way — ``sync`` is host-side
+    post-processing only, never part of the jit cache key)."""
     tk = sb_ops.tenant_kind(kind)
     p = mesh.shape[REPLICA_AXIS]
     _validate(state, slab, idx, p)
@@ -135,6 +143,8 @@ def mesh_serve_apply(
     t0 = time.perf_counter()
     out = fn(state, slab, idx)
     if telemetry:
+        if not sync:
+            return out
         jax.block_until_ready(out)
         state, of, tel = out
         tel = tele.time_dispatch(tel, time.perf_counter() - t0)

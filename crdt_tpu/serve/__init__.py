@@ -25,7 +25,17 @@ cooperating pieces (see each module's docstring):
 - :mod:`.shard` — :class:`TenantShardMap` + :func:`sync_tenant_shards`:
   per-host tenant shards by rendezvous hash (failover on membership
   eviction remaps ONLY the dead host's tenants), DCN anti-entropy
-  under ``retry=`` joining handoff rows lattice-safely.
+  under ``retry=`` joining handoff rows lattice-safely; ISSUE 18 adds
+  :func:`rebalance_plan`/:func:`apply_rebalance` — skew-aware
+  minimal-move overrides driven by evictor touch stats.
+- :mod:`.wal` — :class:`ServeWal` (ISSUE 18): the dirty-tenant WAL —
+  every coalesced slab is logged and group-commit fsynced BEFORE its
+  dispatch, so replay (= re-ingest through the same bit-identical
+  apply path) recovers every acked op after a kill anywhere.
+- :mod:`.loop` — :class:`ServeLoop` (ISSUE 18): the pipelined round —
+  slab N+1 assembles + WAL-commits while slab N's scatter is in
+  flight; :class:`BackgroundPersister` drains cold-tenant persists
+  off the dispatch latency path.
 
 Plus :func:`static_checks` — the ``serve`` section of
 tools/run_static_checks.py: surface-registry coverage, the
@@ -53,14 +63,28 @@ from .ingest import (
     IngestQueue,
     RmOp,
 )
+from .loop import BackgroundPersister, ServeLoop
 from .shard import (
+    RebalanceMove,
     ShardSyncReport,
     TenantShardMap,
+    apply_rebalance,
     export_rows,
+    host_loads,
     ingest_rows,
+    rebalance,
+    rebalance_plan,
     sync_tenant_shards,
 )
 from .superblock import CapacityOverflow, Superblock
+from .wal import (
+    ReplayReport,
+    ServeWal,
+    recover_serve,
+    replay_into,
+    wal_order_violations,
+    wal_precedes_dispatch,
+)
 
 
 def static_checks() -> List:
@@ -197,14 +221,22 @@ for _name in (
     "evictor_preserves_dirt", "persist_tenant", "recover_tenants",
     "restore_tenant", "tenant_dir", "export_rows", "ingest_rows",
     "sync_tenant_shards", "static_checks",
+    "PendingApply", "ServeWal", "replay_into", "recover_serve",
+    "wal_precedes_dispatch", "wal_order_violations",
+    "ServeLoop", "BackgroundPersister",
+    "host_loads", "rebalance_plan", "apply_rebalance", "rebalance",
 ):
     _reg(_name, module=__name__)
 
 __all__ = [
-    "AddOp", "CapacityOverflow", "Evictor", "FlushReport",
-    "IngestBackpressure", "IngestQueue", "RmOp", "ShardSyncReport",
-    "Superblock", "TenantShardMap", "evictor_preserves_dirt",
-    "export_rows", "ingest_rows", "persist_tenant", "recover_tenants",
-    "restore_tenant", "static_checks", "sync_tenant_shards",
-    "tenant_dir",
+    "AddOp", "BackgroundPersister", "CapacityOverflow", "Evictor",
+    "FlushReport", "IngestBackpressure", "IngestQueue",
+    "RebalanceMove", "ReplayReport", "RmOp", "ServeLoop", "ServeWal",
+    "ShardSyncReport", "Superblock", "TenantShardMap",
+    "apply_rebalance", "evictor_preserves_dirt", "export_rows",
+    "host_loads", "ingest_rows", "persist_tenant", "rebalance",
+    "rebalance_plan", "recover_serve", "recover_tenants",
+    "replay_into", "restore_tenant", "static_checks",
+    "sync_tenant_shards", "tenant_dir", "wal_order_violations",
+    "wal_precedes_dispatch",
 ]

@@ -44,11 +44,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry as tele
+from ..durability import crashpoints
 from ..obs import hist as obs_hist
 from ..obs import trace as obs_trace
 from ..ops import superblock as sb_ops
 from ..utils.metrics import metrics
 from .superblock import Superblock
+from .wal import CP_POST_DISPATCH_PRE_ACK, CP_POST_LOG_PRE_DISPATCH
 
 
 class IngestBackpressure(RuntimeError):
@@ -77,6 +79,26 @@ class FlushReport(NamedTuple):
     dispatches: int         # device dispatches issued (1 + widen retries)
 
 
+class _Built(NamedTuple):
+    """One assembled-but-not-yet-dispatched coalesced slab — the unit
+    the pipelined loop WAL-logs and issues while the previous dispatch
+    is still in flight (host numpy planes; the jnp conversion happens
+    at :meth:`IngestQueue._issue`)."""
+
+    kind: np.ndarray
+    actor: np.ndarray
+    ctr: np.ndarray
+    clock: np.ndarray
+    member: np.ndarray
+    idx: np.ndarray
+    tenants: np.ndarray
+    applied: int
+    coalesced: int
+    restored: int
+    picked: list    # tenants whose deque fully drained into the slab
+    taken: list     # (tenant, popped ops) — the requeue ledger
+
+
 class IngestQueue:
     """Bounded per-tenant op buffer + slab builder over one
     :class:`~crdt_tpu.serve.superblock.Superblock`."""
@@ -89,6 +111,7 @@ class IngestQueue:
         depth: int = 4,
         max_pending: int = 1 << 16,
         evictor=None,
+        wal=None,
     ):
         if lanes % superblock.p:
             raise ValueError(
@@ -100,6 +123,14 @@ class IngestQueue:
         self.depth = depth
         self.max_pending = max_pending
         self.evictor = evictor
+        # The dirty-tenant WAL (crdt_tpu/serve/wal.py, ISSUE 18): when
+        # attached, every assembled slab is group-committed BEFORE its
+        # dispatch issues — the flush's ack point moves from "scatter
+        # returned" to "fsync returned", and kill-anywhere recovery
+        # replays the suffix through an identical queue.
+        self.wal = wal
+        self.last_wal_seq = None
+        self._last_wal_bytes = 0
         # tenant -> deque of ops, insertion-ordered so flushes drain
         # the longest-waiting tenants first (FIFO fairness).
         self.pending: "OrderedDict[int, deque]" = OrderedDict()
@@ -134,12 +165,43 @@ class IngestQueue:
             tenant, RmOp(np.asarray(clock, np.uint32), np.asarray(member))
         )
 
-    # ---- the flush ------------------------------------------------------
+    # ---- the flush (assemble → log → issue → finish) --------------------
     def flush(self, *, telemetry: bool = False):
         """Coalesce queued ops into one slab and apply it. Returns
         ``(FlushReport, Telemetry-or-None)``. Loops are the caller's
         job: one flush issues ONE coalesced dispatch (plus widen
-        retries), leaving rank-block overspill queued."""
+        retries), leaving rank-block overspill queued.
+
+        The body is the serial composition of the four pipeline
+        stages — WAL append strictly BEFORE dispatch issue (the
+        ``pipeline`` static-check section AST-gates this ordering);
+        the pipelined serving loop (crdt_tpu/serve/loop.py) calls the
+        same four stages but finishes dispatch N only after assembling
+        and logging slab N+1."""
+        built = self._assemble()
+        if built.applied == 0:
+            report = FlushReport(
+                0, 0, 0, self.n_pending, built.restored, 0
+            )
+            return report, (tele.zeros() if telemetry else None)
+        try:
+            seq = self._log(built)
+            pending = self._issue(built, telemetry=telemetry)
+        except BaseException as exc:
+            self._unwind(built, exc)
+            raise
+        return self._finish(built, pending, seq, telemetry=telemetry)
+
+    def _assemble(self, pin=()):
+        """Stage 1: pack queued ops into host slab planes (residency
+        restores included). Pops ops into the ``taken`` ledger; any
+        failure mid-assembly (e.g. :class:`LanePressure` while paging)
+        requeues every popped op in original order — nothing was
+        logged or dispatched yet, so nothing is lost or acked.
+        ``pin`` names tenants a pressure eviction must NOT free while
+        this slab assembles — the pipelined loop pins the IN-FLIGHT
+        slab's tenants, or an overflow rollback after the eviction
+        could scatter a stale pre-row into a reallocated lane."""
         p, bl = self.sb.p, self.lanes // self.sb.p
         lpr = self.sb.lanes_per_rank
         caps = self.sb.caps
@@ -160,10 +222,16 @@ class IngestQueue:
         applied = 0
         coalesced = 0
         picked = []
-        placed = set()
+        placed = set(int(t) for t in pin)
         taken = []  # (tenant, popped ops) — the requeue ledger
         try:
             for t in list(self.pending):
+                # A drained-but-not-yet-settled tenant (picked by the
+                # IN-FLIGHT slab; its entry is deleted at finish time)
+                # has nothing to take — skipping it keeps the lane for
+                # a tenant with real ops.
+                if not self.pending[t]:
+                    continue
                 # Residency first (a tenant's mesh rank is a property
                 # of its LANE): evicted/new tenants re-warm through
                 # the evictor (durable record + lane-pressure paging —
@@ -211,61 +279,135 @@ class IngestQueue:
                     picked.append(t)
                 if all(f == 0 for f in lanes_free):
                     break
-            if applied == 0:
-                report = FlushReport(0, 0, 0, self.n_pending, restored, 0)
-                return report, (tele.zeros() if telemetry else None)
-
-            slab = sb_ops.OpSlab(
-                kind=jnp.asarray(kind), actor=jnp.asarray(actor),
-                ctr=jnp.asarray(ctr), clock=jnp.asarray(clock),
-                member=jnp.asarray(member),
-            )
-            widens_before = self.sb.widen_events
-            tel = self.sb.apply(
-                slab, jnp.asarray(idx), tenants, telemetry=telemetry,
-            )
-        except BaseException as exc:
-            # The loss-free contract survives failure: every accepted
-            # op that did NOT land goes back to the FRONT of its
-            # tenant's queue in original order. A CapacityOverflow
-            # names exactly the tenants whose rows were rolled back
-            # (everyone else's ops DID apply — re-queueing those would
-            # double-apply); any earlier failure (e.g. LanePressure
-            # while building) applied nothing, so everything returns.
-            lost = getattr(exc, "tenants", None)
-            requeued = 0
-            rolled = []
-            landed = []
+        except BaseException:
+            # Assembly failed: nothing logged, nothing dispatched —
+            # every popped op returns to the FRONT of its queue in
+            # original order and the traces roll back to submit-only.
             for t, ops_l in taken:
-                if lost is not None and t not in lost:
-                    landed.append(t)
-                    continue
                 dq = self.pending.setdefault(t, deque())
                 for op in reversed(ops_l):
                     dq.appendleft(op)
-                requeued += len(ops_l)
-                rolled.append(t)
-            # Trace the split the requeue ledger just made concrete:
-            # landed tenants' ops DID reach the device (their traces
-            # advance to `dispatch`); rolled-back tenants' traces fall
-            # back to submit-only so the next flush re-coalesces them.
-            if landed:
-                obs_trace.stamp("dispatch", tenants=landed)
-            if rolled:
-                obs_trace.requeue(rolled)
-            # Ops that DID land leave the pending count; drained
-            # tenants that kept nothing leave the map (an empty deque
-            # would waste a slab lane next flush).
-            self.n_pending -= applied - requeued
-            for t in picked:
-                if t in self.pending and not self.pending[t]:
-                    del self.pending[t]
+            if taken:
+                obs_trace.requeue([t for t, _ in taken])
             raise
-        for t in picked:
-            del self.pending[t]
+        return _Built(
+            kind, actor, ctr, clock, member, idx, tenants,
+            applied, coalesced, restored, picked, taken,
+        )
+
+    def _log(self, built: "_Built"):
+        """Stage 2: group-commit the assembled slab to the dirty-tenant
+        WAL (one fsync per dispatch). The fsync returning IS the ack —
+        from here a kill anywhere (the mid-dispatch crashpoint fires
+        between this and the scatter) must recover every op this slab
+        carries. No-op (returns None) when no WAL is attached."""
+        if self.wal is None:
+            return None
+        before = self.wal.bytes_appended
+        seq = self.wal.log_slab(
+            built.kind, built.actor, built.ctr, built.clock,
+            built.member, built.tenants,
+        )
+        self._last_wal_bytes = self.wal.bytes_appended - before
+        self.last_wal_seq = seq
+        crashpoints.hit(CP_POST_LOG_PRE_DISPATCH)
+        return seq
+
+    def _issue(self, built: "_Built", *, telemetry: bool = False):
+        """Stage 3: launch the coalesced dispatch without waiting for
+        it (``Superblock.apply_async``)."""
+        slab = sb_ops.OpSlab(
+            kind=jnp.asarray(built.kind), actor=jnp.asarray(built.actor),
+            ctr=jnp.asarray(built.ctr), clock=jnp.asarray(built.clock),
+            member=jnp.asarray(built.member),
+        )
+        self._widens_before = self.sb.widen_events
+        return self.sb.apply_async(
+            slab, jnp.asarray(built.idx), built.tenants,
+            telemetry=telemetry,
+        )
+
+    def _unwind(self, built: "_Built", exc, requeue_seq=None) -> None:
+        """The loss-free contract survives failure: every accepted op
+        that did NOT land goes back to the FRONT of its tenant's queue
+        in original order. A CapacityOverflow names exactly the tenants
+        whose rows were rolled back (everyone else's ops DID apply —
+        re-queueing those would double-apply); any earlier failure
+        applied nothing, so everything returns. ``requeue_seq`` is the
+        slab's durable WAL seq (when it was logged before the failure):
+        rolled-back traces KEEP it, so the op's re-dispatch reuses the
+        id its durable record already carries and replay/trace ids
+        agree after recovery."""
+        lost = getattr(exc, "tenants", None)
+        requeued = 0
+        rolled = []
+        landed = []
+        for t, ops_l in built.taken:
+            if lost is not None and t not in lost:
+                landed.append(t)
+                continue
+            dq = self.pending.setdefault(t, deque())
+            for op in reversed(ops_l):
+                dq.appendleft(op)
+            requeued += len(ops_l)
+            rolled.append(t)
+        # Trace the split the requeue ledger just made concrete: landed
+        # tenants' ops DID reach the device (their traces advance to
+        # `dispatch`, and to `durable` when the slab was WAL'd);
+        # rolled-back tenants' traces fall back to submit-only — but
+        # keep their durable seq — so the next flush re-coalesces them.
+        if landed:
+            obs_trace.stamp("dispatch", tenants=landed)
+            if requeue_seq is not None:
+                obs_trace.stamp(
+                    "durable", tenants=landed, seq=requeue_seq
+                )
+        if rolled:
+            obs_trace.requeue(rolled, seq=requeue_seq)
+        # Ops that DID land leave the pending count; drained tenants
+        # that kept nothing leave the map (an empty deque would waste
+        # a slab lane next flush).
+        self.n_pending -= built.applied - requeued
+        for t in built.picked:
+            if t in self.pending and not self.pending[t]:
+                del self.pending[t]
+
+    def _finish(
+        self, built: "_Built", pending, seq, *,
+        telemetry: bool = False, on_fail=None,
+    ):
+        """Stage 4: complete the in-flight dispatch (overflow→widen→
+        retry inside ``Superblock.finish``), settle the queue ledger,
+        and place the dispatch/durable trace stamps. Failure unwinds
+        through :meth:`_unwind` with the slab's WAL seq so re-queued
+        ops keep their durable id; ``on_fail`` runs FIRST — the
+        pipelined loop uses it to requeue the already-assembled NEXT
+        slab's ops ahead of this slab's rolled ones (appendleft order:
+        last pushed lands first, so per-tenant FIFO needs round N+1
+        requeued before round N)."""
+        try:
+            tel = self.sb.finish(pending)
+        except BaseException as exc:
+            if on_fail is not None:
+                on_fail(exc)
+            self._unwind(built, exc, requeue_seq=seq)
+            raise
+        crashpoints.hit(CP_POST_DISPATCH_PRE_ACK)
+        # `picked` means fully-drained AT ASSEMBLY time; under the
+        # pipelined loop the deque may have refilled since (new
+        # submissions, or the NEXT slab's assembly already popped from
+        # it) — only a still-empty entry leaves the map.
+        for t in built.picked:
+            dq = self.pending.get(t)
+            if dq is not None and not dq:
+                del self.pending[t]
+        applied, coalesced = built.applied, built.coalesced
         self.n_pending -= applied
-        obs_trace.stamp("dispatch", tenants=[t for t, _ in taken])
-        dispatches = 1 + (self.sb.widen_events - widens_before)
+        done = [t for t, _ in built.taken]
+        obs_trace.stamp("dispatch", tenants=done)
+        if seq is not None:
+            obs_trace.stamp("durable", tenants=done, seq=seq)
+        dispatches = 1 + (self.sb.widen_events - self._widens_before)
         self.total_ops += applied
         self.total_coalesced += coalesced
         self.hist_batch = obs_hist.observe(self.hist_batch, applied)
@@ -274,17 +416,17 @@ class IngestQueue:
         metrics.count("serve.ingest.coalesced_ops", coalesced)
         if tel is not None:
             tel = self.annotate(tel, coalesced=coalesced, batch=applied)
-        lanes_used = int((idx >= 0).sum())
+        lanes_used = int((built.idx >= 0).sum())
         from ..obs import recorder as _rec
 
         _rec.emit(
             "ingest_flush", lanes=lanes_used, ops=applied,
-            coalesced=coalesced, restored=restored,
+            coalesced=coalesced, restored=built.restored,
             pending_after=self.n_pending,
         )
         report = FlushReport(
-            applied, lanes_used, coalesced, self.n_pending, restored,
-            dispatches,
+            applied, lanes_used, coalesced, self.n_pending,
+            built.restored, dispatches,
         )
         return report, tel
 
@@ -323,16 +465,19 @@ class IngestQueue:
         """Fill the host-owned ingest telemetry for ONE flush (the
         ``stream_*`` fill discipline — per-record increments so
         ``telemetry.combine`` folds flushes exactly): the flush's
-        coalesced-op count and one batch-size observation, plus the
+        coalesced-op count and one batch-size observation, the WAL
+        bytes its group commit appended (0 without a WAL), plus the
         superblock's residency gauges."""
         if not tele.is_concrete(tel):
             return tel
         tel = tel._replace(
             ingest_coalesced_ops=jnp.uint32(coalesced),
+            serve_wal_bytes=jnp.float32(self._last_wal_bytes),
             hist_ingest_batch=obs_hist.observe(
                 obs_hist.zeros(), batch
             ),
         )
+        self._last_wal_bytes = 0
         return self.sb.annotate(tel)
 
 

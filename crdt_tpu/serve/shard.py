@@ -25,6 +25,22 @@ full tenant population. Two pieces:
   racy-traffic-safe: a row restored from the durable tier and a
   fresher row shipped by the old owner converge to their lattice join
   regardless of arrival order.
+- :func:`rebalance_plan` / :func:`apply_rebalance` (ISSUE 18) —
+  skew-aware placement on top of rendezvous. Real traffic is zipf:
+  rendezvous balances tenant COUNTS, but a handful of hot tenants can
+  pin one host at 10× the mean LOAD. The planner takes the per-tenant
+  touch stats the evictor already keeps (``Evictor.touch_count`` —
+  the same signal ``obs/trace.skew_report`` renders), computes
+  per-host load, and greedily moves the hottest tenants OFF hosts
+  above ``threshold × mean`` until every host fits — the MINIMAL-move
+  property the ``pipeline`` static-check section gates: only
+  overloaded hosts ever shed, an already-balanced fleet plans zero
+  moves. Moves land as explicit ``overrides`` consulted before the
+  rendezvous hash (so everything un-overridden keeps its stable
+  assignment), and the row handoff rides the existing lattice-safe
+  :func:`sync_tenant_shards` join. ``fail_over`` drops any override
+  pointing at the dead host — those tenants fall back to rendezvous
+  among the survivors, keeping failover minimal too.
 """
 
 from __future__ import annotations
@@ -64,8 +80,15 @@ class TenantShardMap:
             raise ValueError(f"live hosts {self.live} exceed {n_hosts}")
         if not self.live:
             raise ValueError("no live hosts")
+        # Skew-driven placement overrides (tenant → host), consulted
+        # BEFORE the rendezvous hash: everything un-overridden keeps
+        # its stable assignment (apply_rebalance writes these).
+        self.overrides: Dict[int, int] = {}
 
     def owner(self, tenant: int) -> int:
+        o = self.overrides.get(int(tenant))
+        if o is not None and o in self.live:
+            return o
         return max(self.live, key=lambda h: _weight(tenant, h))
 
     def owned(self, host: int, tenants: Sequence[int]) -> List[int]:
@@ -74,13 +97,18 @@ class TenantShardMap:
     def fail_over(self, host: int) -> None:
         """Membership evicted a host (PR 8's decision, host-granular):
         its tenants remap to survivors by rendezvous; everyone else's
-        assignment is untouched. The new owners re-warm inherited
-        tenants from the shared durable tier on next touch."""
+        assignment is untouched. Overrides POINTING at the dead host
+        are dropped — those tenants fall back to rendezvous among the
+        survivors (same minimal-remap property as the hash itself).
+        The new owners re-warm inherited tenants from the shared
+        durable tier on next touch."""
         if host not in self.live:
             return
         if len(self.live) == 1:
             raise ValueError("cannot fail over the last live host")
         self.live.discard(host)
+        for t in [t for t, h in self.overrides.items() if h == host]:
+            del self.overrides[t]
         metrics.count("serve.shard.failovers")
 
     def admit(self, host: int) -> None:
@@ -93,6 +121,125 @@ class ShardSyncReport(NamedTuple):
     tenants_shipped: int   # rows this host exported
     tenants_joined: int    # received rows joined into owned lanes
     bytes_shipped: int     # wire bytes this host exported
+
+
+class RebalanceMove(NamedTuple):
+    tenant: int
+    src: int     # overloaded host shedding the tenant
+    dst: int     # least-loaded live host at plan time
+    load: float  # the tenant's touch weight that moves with it
+
+
+def host_loads(
+    shard_map: TenantShardMap, tenants: Sequence[int], weights,
+) -> Dict[int, float]:
+    """Per-live-host LOAD (sum of touch weights of owned tenants) —
+    the quantity rendezvous cannot see and zipf traffic skews."""
+    loads = {h: 0.0 for h in shard_map.live}
+    for t in tenants:
+        loads[shard_map.owner(t)] += float(weights[int(t)])
+    return loads
+
+
+def rebalance_plan(
+    shard_map: TenantShardMap,
+    tenants: Sequence[int],
+    weights,
+    *,
+    threshold: float = 1.5,
+    max_moves: Optional[int] = None,
+) -> List[RebalanceMove]:
+    """Greedy minimal-move plan: while some host carries more than
+    ``threshold × mean`` load, move its hottest tenant to the
+    least-loaded live host — but only while the move actually shrinks
+    the gap (a tenant hotter than the imbalance would just relocate the
+    hotspot). ``weights`` is indexable by tenant id (the evictor's
+    ``touch_count`` array, or any per-tenant heat signal). MINIMAL
+    means: an already-balanced fleet plans ZERO moves, and every
+    planned move sheds from a host that was above threshold at the
+    moment of the move — the property the ``pipeline`` static-check
+    section verifies on synthetic zipf load."""
+    if len(shard_map.live) < 2:
+        return []
+    loads = host_loads(shard_map, tenants, weights)
+    by_host: Dict[int, List[int]] = {h: [] for h in shard_map.live}
+    for t in tenants:
+        by_host[shard_map.owner(t)].append(int(t))
+    for h in by_host:
+        by_host[h].sort(key=lambda t: float(weights[t]), reverse=True)
+    mean = sum(loads.values()) / max(len(loads), 1)
+    if mean <= 0:
+        return []
+    plan: List[RebalanceMove] = []
+    limit = max_moves if max_moves is not None else len(tenants)
+    while len(plan) < limit:
+        src = max(loads, key=loads.get)
+        dst = min(loads, key=loads.get)
+        if loads[src] <= threshold * mean or src == dst:
+            break
+        moved = False
+        for i, t in enumerate(by_host[src]):
+            w = float(weights[t])
+            # The move must shrink the src/dst gap, or the hotspot
+            # just changes address.
+            if loads[dst] + w < loads[src]:
+                plan.append(RebalanceMove(t, src, dst, w))
+                loads[src] -= w
+                loads[dst] += w
+                by_host[src].pop(i)
+                by_host[dst].append(t)
+                moved = True
+                break
+        if not moved:
+            break  # nothing movable improves the imbalance
+    return plan
+
+
+def apply_rebalance(
+    shard_map: TenantShardMap, plan: Sequence[RebalanceMove],
+) -> int:
+    """Land a plan as placement overrides (the handoff of the actual
+    rows rides :func:`sync_tenant_shards` — export the moved tenants
+    on their OLD owners, everyone joins what they now own). Returns
+    moves applied; each is one ``rebalance_moves`` telemetry count and
+    one ``shard_rebalance`` flight event."""
+    from .. import obs
+
+    n = 0
+    for mv in plan:
+        if mv.dst not in shard_map.live:
+            continue
+        shard_map.overrides[int(mv.tenant)] = int(mv.dst)
+        n += 1
+    if n:
+        metrics.count("serve.shard.rebalance_moves", n)
+        obs.emit(
+            "shard_rebalance", moves=n,
+            tenants=[int(m.tenant) for m in plan][:32],
+            srcs=[int(m.src) for m in plan][:32],
+            dsts=[int(m.dst) for m in plan][:32],
+        )
+    return n
+
+
+def rebalance(
+    shard_map: TenantShardMap,
+    tenants: Sequence[int],
+    weights,
+    *,
+    threshold: float = 1.5,
+    max_moves: Optional[int] = None,
+) -> List[RebalanceMove]:
+    """Plan + apply in one call (the serving loop's periodic hook:
+    ``weights`` is usually ``evictor.touch_count``). Returns the
+    applied plan so the caller can hand the moved rows off and count
+    the moves into its Telemetry (``ServeLoop.note_rebalance``)."""
+    plan = rebalance_plan(
+        shard_map, tenants, weights,
+        threshold=threshold, max_moves=max_moves,
+    )
+    apply_rebalance(shard_map, plan)
+    return plan
 
 
 def export_rows(sb: Superblock, tenants: Sequence[int]) -> Dict[str, np.ndarray]:
@@ -203,7 +350,16 @@ def sync_tenant_shards(
     )
 
 
+from ..analysis.registry import register_obs_event as _reg_ev  # noqa: E402
+
+_reg_ev(
+    "shard_rebalance", subsystem="serve.shard",
+    fields=("moves", "tenants", "srcs", "dsts"),
+    module=__name__,
+)
+
 __all__ = [
-    "ShardSyncReport", "TenantShardMap", "export_rows", "ingest_rows",
-    "sync_tenant_shards",
+    "RebalanceMove", "ShardSyncReport", "TenantShardMap",
+    "apply_rebalance", "export_rows", "host_loads", "ingest_rows",
+    "rebalance", "rebalance_plan", "sync_tenant_shards",
 ]

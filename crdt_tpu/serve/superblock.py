@@ -36,6 +36,7 @@ kernel (a no-op, never a data loss).
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Dict, Optional
 
@@ -66,6 +67,49 @@ class LanePressure(RuntimeError):
     """No free device lane for a tenant that needs one — evict a cold
     tenant first (the evictor does this automatically:
     crdt_tpu/serve/evict.py restore-under-pressure)."""
+
+
+class PendingApply:
+    """One in-flight coalesced dispatch: the issued (not yet
+    overflow-checked) ``mesh_serve_apply`` plus everything
+    :meth:`Superblock.finish` needs to run the overflow→widen→retry
+    loop — the rollback base (``pre``), the slab/idx for retries, and
+    the issue timestamp the host dispatch timing folds from. Minted by
+    :meth:`Superblock.apply_async`; the pipelined serving loop
+    (crdt_tpu/serve/loop.py) assembles + WAL-logs the NEXT slab while
+    one of these is in flight."""
+
+    __slots__ = ("slab", "idx_local", "tenants", "valid", "glanes",
+                 "pre", "of", "tel", "telemetry", "donate", "t0")
+
+    def __init__(self, slab, idx_local, tenants, valid, glanes, pre,
+                 of, tel, telemetry, donate, t0):
+        self.slab = slab
+        self.idx_local = idx_local
+        self.tenants = tenants
+        self.valid = valid
+        self.glanes = glanes
+        self.pre = pre
+        self.of = of
+        self.tel = tel
+        self.telemetry = telemetry
+        self.donate = donate
+        self.t0 = t0
+
+    def ready(self) -> bool:
+        """Best-effort 'has the scatter landed' probe (the
+        ``parallel/stream.py`` ``_ready`` discipline — feeds the
+        ``serve_overlap_hit`` counter only, never correctness)."""
+        import jax
+
+        leaf = jax.tree.leaves(self.of)[0]
+        fn = getattr(leaf, "is_ready", None)
+        if not callable(fn):
+            return True
+        try:
+            return bool(fn())
+        except Exception:
+            return True
 
 
 class Superblock:
@@ -219,7 +263,30 @@ class Superblock:
         be resident). Returns the Telemetry sidecar (or None).
         Overflow rolls back ONLY the overflowed tenants, widens the
         superblock, and retries their lanes — bounded by
-        ``policy.max_migrations``."""
+        ``policy.max_migrations``. ``apply`` == ``finish(apply_async())``
+        — the split is the pipelined serving loop's seam
+        (crdt_tpu/serve/loop.py overlaps next-slab assembly + WAL
+        append with the in-flight scatter)."""
+        return self.finish(self.apply_async(
+            slab, idx_local, tenants, telemetry=telemetry, donate=donate,
+        ))
+
+    def apply_async(
+        self,
+        slab: sb_ops.OpSlab,
+        idx_local,
+        tenants,
+        *,
+        telemetry: bool = False,
+        donate: bool = True,
+    ) -> PendingApply:
+        """Issue one coalesced dispatch WITHOUT waiting for it: gather
+        the rollback base, launch ``mesh_serve_apply``, and return the
+        :class:`PendingApply` handle :meth:`finish` completes. The
+        superblock's device state advances to the in-flight output
+        immediately (JAX async dispatch) — but no NEW dispatch may be
+        issued and no overflow decision exists until :meth:`finish`
+        runs (a widen retry changes every lane's shape)."""
         tenants = np.asarray(tenants)
         valid = tenants >= 0
         # Pre-rows of touched tenants: the rollback base that keeps the
@@ -228,18 +295,51 @@ class Superblock:
         glanes = np.where(valid, self.lane_of[np.where(valid, tenants, 0)], 0)
         gidx = jnp.asarray(glanes, jnp.int32)
         pre = sb_ops.gather_rows(self.state, gidx)
+        t0 = time.perf_counter()
+        out = mesh_serve_apply(
+            self.state, slab, idx_local, self.mesh, kind=self.kind,
+            donate=donate, telemetry=telemetry, sync=False,
+        )
+        if telemetry:
+            self.state, of, t_raw = out
+        else:
+            self.state, of = out
+            t_raw = None
+        return PendingApply(
+            slab, idx_local, tenants, valid, glanes, pre, of, t_raw,
+            telemetry, donate, t0,
+        )
+
+    def finish(self, p: PendingApply):
+        """Complete an in-flight dispatch: wait for its overflow flags,
+        run the overflow→widen→retry loop (identical to the serial
+        :meth:`apply` — the retries themselves are issued and waited
+        inline), mark applied tenants dirty, and return the combined
+        Telemetry (or None). The host dispatch timing
+        (``hist_dispatch_us``) measures issue→completion, so an
+        overlapped dispatch's histogram entry covers exactly the
+        wall-clock a serial caller would have blocked for."""
+        slab, idx_local = p.slab, p.idx_local
+        tenants, valid, glanes, pre = p.tenants, p.valid, p.glanes, p.pre
         tel = None
+        of, t_raw, t0 = p.of, p.tel, p.t0
         for attempt in range(self.policy.max_migrations + 1):
-            out = mesh_serve_apply(
-                self.state, slab, idx_local, self.mesh, kind=self.kind,
-                donate=donate, telemetry=telemetry,
-            )
-            if telemetry:
-                self.state, of, t = out
+            if attempt:
+                t0 = time.perf_counter()
+                out = mesh_serve_apply(
+                    self.state, slab, idx_local, self.mesh,
+                    kind=self.kind, donate=p.donate,
+                    telemetry=p.telemetry, sync=False,
+                )
+                if p.telemetry:
+                    self.state, of, t_raw = out
+                else:
+                    self.state, of = out
+            if p.telemetry:
+                jax.block_until_ready((self.state, of, t_raw))
+                t = tele.time_dispatch(t_raw, time.perf_counter() - t0)
                 tel = t if tel is None else tele.combine(tel, t)
-                self.last_pressure = float(tel.widen_pressure)
-            else:
-                self.state, of = out
+                self.last_pressure = float(t.widen_pressure)
             of_host = np.asarray(of) & valid
             if not of_host.any():
                 break
@@ -402,4 +502,6 @@ class Superblock:
         )
 
 
-__all__ = ["CapacityOverflow", "LanePressure", "Superblock"]
+__all__ = [
+    "CapacityOverflow", "LanePressure", "PendingApply", "Superblock",
+]

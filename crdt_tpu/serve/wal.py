@@ -1,0 +1,322 @@
+"""The serving tier's dirty-tenant write-ahead log (ISSUE 18
+tentpole): every coalesced OpSlab is logged BEFORE its device dispatch,
+so kill-anywhere recovery loses zero acked ops.
+
+PR 15 made the serving tier durable only at persist/evict boundaries —
+a host crash lost every op applied since a tenant last went cold. This
+module extends the :class:`~crdt_tpu.durability.wal.Wal` framing over
+the ingest path (δ-mutation logging, Almeida et al. 1410.2803: log the
+join-irreducible op lanes, never rows):
+
+- **log-before-dispatch** — :meth:`ServeWal.log_slab` appends ONE
+  record per coalesced slab (only the occupied lanes — a 4096-lane
+  slab with 40 hot tenants logs 40 lanes) and group-commits it with
+  ONE fsync per dispatch (``fsync='on_round'`` + ``mark_round``). The
+  fsync returning is the serving tier's ack point: an op is promised
+  durable exactly when its slab's group commit lands, BEFORE the
+  scatter — which is why a kill anywhere after the ack (mid-dispatch,
+  pre-ack, mid-background-persist) recovers it.
+- **replay = re-ingest** — :func:`recover_serve` loads every tenant's
+  last durable snapshot (crdt_tpu/serve/evict.py ``recover_tenants``)
+  and re-submits the WAL suffix through a fresh
+  :class:`~crdt_tpu.serve.ingest.IngestQueue` — the SAME bit-identical
+  ``mesh_serve_apply`` path that applied the ops the first time.
+  Per-tenant submission order is preserved by construction (records
+  replay in seq order, lanes preserve slot order), and op re-application
+  onto a snapshot that already contains a prefix is idempotent (CRDT
+  join semantics: a dot already present adds nothing, a covered remove
+  removes nothing new) — so replay lands bit-identical to the
+  pre-crash rows whatever the snapshot/WAL overlap.
+- **crashpoints** — the new log/dispatch/ack boundaries register
+  below (including the MID-DISPATCH point between the group commit and
+  the scatter) and ride the PR 10 fuzz engine: the durability
+  static-check section's probe workload crosses every one of them, and
+  tests/test_serve.py kills at each and asserts recovery bit-identical
+  with zero acked-op loss.
+
+:func:`wal_precedes_dispatch` is the ordering detector behind the
+``pipeline`` static-check section: an AST scan proving no dispatch
+site precedes its WAL append/mark_round (the
+``analysis.fixtures.serve_dispatch_before_wal`` broken twin must FAIL
+it).
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from ..durability import crashpoints
+from ..durability.wal import Wal
+from ..utils.metrics import metrics
+
+CP_PRE_LOG = crashpoints.register(
+    "serve.wal.pre_log",
+    "about to append a coalesced slab to the serve WAL (nothing acked "
+    "yet — a kill here loses only unacked ops; recovery is the "
+    "previous durable record)",
+)
+CP_POST_LOG_PRE_DISPATCH = crashpoints.register(
+    "serve.wal.post_log_pre_dispatch",
+    "slab group-committed to the serve WAL, device scatter NOT yet "
+    "issued (THE mid-dispatch boundary: the ops are acked-durable, so "
+    "recovery MUST replay this slab — zero acked-op loss)",
+)
+CP_POST_DISPATCH_PRE_ACK = crashpoints.register(
+    "serve.dispatch.post_scatter_pre_ack",
+    "scatter issued against the WAL'd slab, dispatch/durable trace "
+    "stamps not yet placed (device state dies with the process — "
+    "recovery replays the same slab from the WAL suffix)",
+)
+CP_BG_PERSIST = crashpoints.register(
+    "serve.persist.background_drain",
+    "inside the background persist drain, between tenant rows (a kill "
+    "mid-drain leaves a partial persist generation set — every tenant "
+    "recovers its last durable record + WAL suffix, acked-op loss "
+    "stays zero)",
+)
+
+# WAL record leaf order for one compacted slab (meta rtype "slab"):
+# tenants[K], kind[K,S], actor[K,S], ctr[K,S], clock[K,S,A],
+# member[K,S,...] — K = occupied lanes only.
+_SLAB_RTYPE = "slab"
+
+
+class ReplayReport(NamedTuple):
+    records: int    # slab records re-ingested
+    ops: int        # individual ops re-submitted
+    tenants: int    # distinct tenants touched by the replay
+
+
+class ServeWal:
+    """Group-committed slab log over one :class:`Wal` directory
+    (``fsync='on_round'`` — :meth:`log_slab` appends AND commits, one
+    fsync barrier per coalesced dispatch however many lanes the slab
+    carries)."""
+
+    def __init__(self, path, *, segment_bytes: int = 64 * 1024 * 1024):
+        self.wal = Wal(
+            path, fsync="on_round", segment_bytes=segment_bytes,
+        )
+
+    @property
+    def last_seq(self) -> int:
+        return self.wal.last_seq
+
+    @property
+    def bytes_appended(self) -> int:
+        return self.wal.bytes_appended
+
+    @property
+    def fsyncs(self) -> int:
+        return self.wal.fsyncs
+
+    def log_slab(self, kind_arr, actor, ctr, clock, member, tenants) -> int:
+        """Append one coalesced slab (occupied lanes only) and
+        group-commit it — the serving tier's ack barrier. Returns the
+        record's seq (the durable id requeued traces must reuse —
+        crdt_tpu/obs/trace.py)."""
+        from .. import obs
+
+        crashpoints.hit(CP_PRE_LOG)
+        used = np.nonzero(np.asarray(tenants) >= 0)[0]
+        leaves = [
+            np.ascontiguousarray(np.asarray(tenants)[used]),
+            np.ascontiguousarray(np.asarray(kind_arr)[used]),
+            np.ascontiguousarray(np.asarray(actor)[used]),
+            np.ascontiguousarray(np.asarray(ctr)[used]),
+            np.ascontiguousarray(np.asarray(clock)[used]),
+            np.ascontiguousarray(np.asarray(member)[used]),
+        ]
+        n_ops = int((leaves[1] != 0).sum())
+        seq = self.wal.append(
+            {"rtype": _SLAB_RTYPE, "lanes": int(len(used)),
+             "ops": n_ops},
+            leaves,
+        )
+        self.wal.mark_round()  # THE group commit: one fsync per dispatch
+        metrics.count("serve.wal.slabs")
+        metrics.count("serve.wal.ops", n_ops)
+        obs.emit(
+            "serve_wal_round", seq=seq, lanes=int(len(used)), ops=n_ops,
+            bytes=self.wal.bytes_appended,
+        )
+        return seq
+
+    def records(self, since_seq: int = 0):
+        """Slab records ``(seq, lanes-leaves)`` after ``since_seq`` —
+        non-slab records in a shared directory are skipped."""
+        for seq, meta, leaves in self.wal.records(since_seq):
+            if meta.get("rtype") == _SLAB_RTYPE:
+                yield seq, leaves
+
+    def sync(self) -> None:
+        self.wal.sync()
+
+    def close(self) -> None:
+        self.wal.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def replay_into(queue, serve_wal: ServeWal, *,
+                since_seq: int = 0) -> ReplayReport:
+    """Re-ingest the WAL suffix through ``queue`` — the same
+    bit-identical coalesce→``mesh_serve_apply`` path that applied the
+    ops pre-crash. One drain per record keeps per-tenant submission
+    order exact across slab boundaries."""
+    from ..ops import superblock as sb_ops
+    from .ingest import AddOp, RmOp
+
+    records = ops = 0
+    touched = set()
+    for _seq, leaves in serve_wal.records(since_seq):
+        tenants, kind_arr, actor, ctr, clock, member = leaves
+        for k in range(len(tenants)):
+            t = int(tenants[k])
+            for s in range(kind_arr.shape[1]):
+                op_kind = int(kind_arr[k, s])
+                if op_kind == sb_ops.NOOP:
+                    continue
+                if op_kind == sb_ops.ADD:
+                    queue.submit(
+                        t, AddOp(int(actor[k, s]), int(ctr[k, s]),
+                                 np.asarray(member[k, s])),
+                    )
+                else:
+                    queue.submit(
+                        t, RmOp(np.asarray(clock[k, s], np.uint32),
+                                np.asarray(member[k, s])),
+                    )
+                ops += 1
+                touched.add(t)
+        queue.drain()
+        records += 1
+    metrics.count("serve.wal.replayed_records", records)
+    metrics.count("serve.wal.replayed_ops", ops)
+    return ReplayReport(records, ops, len(touched))
+
+
+def recover_serve(snap_root: str, queue,
+                  serve_wal: Optional[ServeWal] = None,
+                  *, since_seq: int = 0) -> ReplayReport:
+    """The serving tier's kill-anywhere recovery driver: load every
+    tenant's last durable snapshot into ``queue``'s superblock
+    (crdt_tpu/serve/evict.py), then replay the WAL suffix through the
+    queue. The snapshot tier and the WAL may overlap (a background
+    persist may have landed ops the WAL also holds) — op re-application
+    is idempotent, so the overlap is harmless and the result is
+    bit-identical to the last acked state."""
+    import os
+
+    from .evict import _durable_tenants, recover_tenants
+
+    sb = queue.sb
+    ev = getattr(queue, "evictor", None)
+    if ev is not None and (
+        os.path.abspath(getattr(ev, "root", "")) ==
+        os.path.abspath(snap_root)
+    ):
+        # The queue pages against the SAME durable tier we are
+        # recovering from: mark every persisted tenant evicted-with-
+        # record and let restore-on-touch load it — the resident set
+        # stays bounded by the lane pool however many tenants the tier
+        # holds (an eager write_row of all of them would deadlock on
+        # LanePressure the moment records outnumber lanes).
+        n = 0
+        for t in _durable_tenants(snap_root):
+            if not sb.is_resident(int(t)):
+                sb.was_evicted[int(t)] = True
+                n += 1
+    else:
+        rows = recover_tenants(snap_root, sb)
+        for t, row in rows.items():
+            sb.write_row(t, row)
+            sb.dirty[t] = False
+            sb.was_evicted[t] = False
+        n = len(rows)
+    if serve_wal is None:
+        return ReplayReport(0, 0, n)
+    rep = replay_into(queue, serve_wal, since_seq=since_seq)
+    return rep
+
+
+# ---- the WAL-before-dispatch ordering detector ---------------------------
+
+_WAL_CALLS = frozenset({"log_slab", "mark_round", "append_slab", "_log"})
+_DISPATCH_CALLS = frozenset({
+    "apply_async", "mesh_serve_apply", "dispatch_slab", "_issue",
+})
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def wal_order_violations(obj) -> list:
+    """AST-scan ``obj`` (a function, class, or module) for functions
+    that both WAL-log a slab and dispatch it, and return a violation
+    string per function whose FIRST dispatch site precedes its FIRST
+    WAL call — the ordering that would ack ops the log never saw.
+    Empty list = every logging dispatcher logs first."""
+    try:
+        src = textwrap.dedent(inspect.getsource(obj))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError) as exc:
+        return [f"{getattr(obj, '__name__', obj)}: unscannable ({exc})"]
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        wal_lines = []
+        dispatch_lines = []
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = _call_name(sub)
+                if name in _WAL_CALLS:
+                    wal_lines.append(sub.lineno)
+                elif name in _DISPATCH_CALLS:
+                    dispatch_lines.append(sub.lineno)
+        if wal_lines and dispatch_lines and (
+            min(dispatch_lines) < min(wal_lines)
+        ):
+            out.append(
+                f"{node.name}: dispatch at line {min(dispatch_lines)} "
+                f"precedes its WAL append at line {min(wal_lines)} — "
+                f"an op could be acked that the log never saw"
+            )
+    return out
+
+
+def wal_precedes_dispatch(obj) -> bool:
+    """True iff ``obj`` contains no WAL-ordering violation — the
+    ``pipeline`` static-check gate (the honest ingest flush must pass;
+    ``analysis.fixtures.serve_dispatch_before_wal`` must fail)."""
+    return not wal_order_violations(obj)
+
+
+from ..analysis.registry import register_obs_event as _reg_ev  # noqa: E402
+
+_reg_ev(
+    "serve_wal_round", subsystem="serve.wal",
+    fields=("seq", "lanes", "ops", "bytes"),
+    module=__name__,
+)
+
+__all__ = [
+    "ReplayReport", "ServeWal", "recover_serve", "replay_into",
+    "wal_order_violations", "wal_precedes_dispatch",
+]

@@ -133,6 +133,20 @@ shipped and sync metadata per round), measured natively per round:
   per-cohort push-bytes distribution (in-kernel, riding the
   ``mesh_fanout_push`` telemetry branch). 0/empty on every
   non-fan-out run.
+- ``serve_wal_bytes`` / ``serve_overlap_hit`` / ``rebalance_moves`` /
+  ``hist_persist_us`` — the pipelined serving-loop accounting
+  (crdt_tpu/serve/wal.py, loop.py, shard.py; registry twins
+  ``telemetry.<kind>.serve.wal_bytes`` / ``.serve.overlap_hit`` /
+  ``.serve.rebalance_moves``): dirty-tenant WAL bytes group-committed
+  ahead of the dispatches (the durability cost of the
+  log-before-dispatch ack), pipelined rounds whose slab assembly + WAL
+  append genuinely hid in-flight device time (the serving twin of
+  ``stream_overlap_hit``), skew-driven shard-map override moves
+  applied by ``apply_rebalance``, and the per-row background-persist
+  wall-clock distribution (``BackgroundPersister`` — the persists the
+  pipeline moved OFF the dispatch latency path). Filled host-side by
+  ``IngestQueue.annotate`` / ``ServeLoop.annotate``; 0/empty on every
+  non-serving run.
 - ``hist_residue`` / ``hist_useful_bytes`` / ``hist_ack_depth`` /
   ``hist_packed_bytes`` / ``hist_dispatch_us`` — the in-kernel
   DISTRIBUTIONS
@@ -218,6 +232,9 @@ class Telemetry(NamedTuple):
     cohorts_per_dispatch: jax.Array  # uint32 — watermark cohorts decomposed
     delta_push_bytes: jax.Array      # float32 — δ bytes pushed to subscribers
     resync_fallbacks: jax.Array      # uint32 — pushes degraded to bootstrap
+    serve_wal_bytes: jax.Array       # float32 — dirty-tenant WAL bytes appended
+    serve_overlap_hit: jax.Array     # uint32 — pipelined rounds that hid device time
+    rebalance_moves: jax.Array       # uint32 — skew-driven shard-map moves
     hist_residue: obs_hist.Hist    # per-round unshipped-backlog rows
     hist_useful_bytes: obs_hist.Hist  # per-round post-mask payload bytes
     hist_ack_depth: obs_hist.Hist  # per-round ack-window depth
@@ -225,6 +242,7 @@ class Telemetry(NamedTuple):
     hist_dispatch_us: obs_hist.Hist   # host-timed dispatch wall-clock (µs)
     hist_ingest_batch: obs_hist.Hist  # per-flush coalesced-batch op count
     hist_push_bytes: obs_hist.Hist    # per-cohort δ push payload bytes
+    hist_persist_us: obs_hist.Hist    # per-row background persist wall-clock (µs)
     # Trace-plane stage latencies (crdt_tpu/obs/trace.py — host-filled
     # per completed sampled trace via Tracer.annotate):
     hist_queue_wait_us: obs_hist.Hist    # submit → coalesce
@@ -274,6 +292,9 @@ def zeros() -> Telemetry:
         cohorts_per_dispatch=jnp.zeros((), jnp.uint32),
         delta_push_bytes=jnp.zeros((), jnp.float32),
         resync_fallbacks=jnp.zeros((), jnp.uint32),
+        serve_wal_bytes=jnp.zeros((), jnp.float32),
+        serve_overlap_hit=jnp.zeros((), jnp.uint32),
+        rebalance_moves=jnp.zeros((), jnp.uint32),
         hist_residue=obs_hist.zeros(),
         hist_useful_bytes=obs_hist.zeros(),
         hist_ack_depth=obs_hist.zeros(),
@@ -281,6 +302,7 @@ def zeros() -> Telemetry:
         hist_dispatch_us=obs_hist.zeros(),
         hist_ingest_batch=obs_hist.zeros(),
         hist_push_bytes=obs_hist.zeros(),
+        hist_persist_us=obs_hist.zeros(),
         hist_queue_wait_us=obs_hist.zeros(),
         hist_dispatch_gap_us=obs_hist.zeros(),
         hist_durable_lag_us=obs_hist.zeros(),
@@ -340,6 +362,9 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         ),
         delta_push_bytes=a.delta_push_bytes + b.delta_push_bytes,
         resync_fallbacks=a.resync_fallbacks + b.resync_fallbacks,
+        serve_wal_bytes=a.serve_wal_bytes + b.serve_wal_bytes,
+        serve_overlap_hit=a.serve_overlap_hit + b.serve_overlap_hit,
+        rebalance_moves=a.rebalance_moves + b.rebalance_moves,
         hist_residue=obs_hist.merge(a.hist_residue, b.hist_residue),
         hist_useful_bytes=obs_hist.merge(
             a.hist_useful_bytes, b.hist_useful_bytes
@@ -356,6 +381,9 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         ),
         hist_push_bytes=obs_hist.merge(
             a.hist_push_bytes, b.hist_push_bytes
+        ),
+        hist_persist_us=obs_hist.merge(
+            a.hist_persist_us, b.hist_persist_us
         ),
         hist_queue_wait_us=obs_hist.merge(
             a.hist_queue_wait_us, b.hist_queue_wait_us
@@ -559,6 +587,9 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "cohorts_per_dispatch": int(tel.cohorts_per_dispatch),
         "delta_push_bytes": float(tel.delta_push_bytes),
         "resync_fallbacks": int(tel.resync_fallbacks),
+        "serve_wal_bytes": float(tel.serve_wal_bytes),
+        "serve_overlap_hit": int(tel.serve_overlap_hit),
+        "rebalance_moves": int(tel.rebalance_moves),
         "hist_residue": obs_hist.to_dict(tel.hist_residue),
         "hist_useful_bytes": obs_hist.to_dict(tel.hist_useful_bytes),
         "hist_ack_depth": obs_hist.to_dict(tel.hist_ack_depth),
@@ -566,6 +597,7 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "hist_dispatch_us": obs_hist.to_dict(tel.hist_dispatch_us),
         "hist_ingest_batch": obs_hist.to_dict(tel.hist_ingest_batch),
         "hist_push_bytes": obs_hist.to_dict(tel.hist_push_bytes),
+        "hist_persist_us": obs_hist.to_dict(tel.hist_persist_us),
         "hist_queue_wait_us": obs_hist.to_dict(tel.hist_queue_wait_us),
         "hist_dispatch_gap_us": obs_hist.to_dict(tel.hist_dispatch_gap_us),
         "hist_durable_lag_us": obs_hist.to_dict(tel.hist_durable_lag_us),
@@ -654,6 +686,9 @@ def counter_increments(kind: str, d: Dict[str, Any]) -> Dict[str, int]:
         f"telemetry.{kind}.fanout.resync_fallbacks": d[
             "resync_fallbacks"
         ],
+        f"telemetry.{kind}.serve.wal_bytes": int(d["serve_wal_bytes"]),
+        f"telemetry.{kind}.serve.overlap_hit": d["serve_overlap_hit"],
+        f"telemetry.{kind}.serve.rebalance_moves": d["rebalance_moves"],
     }
     # Histogram per-bucket counters fold bit-exactly across runs —
     # exactly what tools/obs_report.py cross-checks a dump against.

@@ -47,15 +47,23 @@ from crdt_tpu.durability import crashpoints
 from crdt_tpu.ops import superblock as sb_ops
 from crdt_tpu.parallel import make_mesh, mesh_serve_apply
 from crdt_tpu.serve import (
+    BackgroundPersister,
     Evictor,
     IngestBackpressure,
     IngestQueue,
+    ServeLoop,
+    ServeWal,
     Superblock,
     TenantShardMap,
+    apply_rebalance,
     evictor_preserves_dirt,
+    host_loads,
+    rebalance_plan,
+    recover_serve,
     recover_tenants,
     static_checks,
     sync_tenant_shards,
+    wal_precedes_dispatch,
 )
 
 DENSE_CAPS = dict(n_elems=8, n_actors=2, deferred_cap=2)
@@ -710,3 +718,353 @@ def test_mesh_serve_apply_donated_matches_undonated():
     )
     assert _trees_equal(out_copy, out_don)
     assert bool(jnp.array_equal(of_copy, of_don))
+
+
+# ---- 6. ISSUE 18: dirty-tenant WAL + pipelined loop + rebalancing --------
+
+def _row_np(sb, t):
+    """A host-side copy of tenant t's row (restore-on-demand — reading
+    a later tenant may page this one back out)."""
+    return jax.tree.map(np.asarray, sb.row(t))
+
+
+def _restore_if_cold(sb, ev, t):
+    if not sb.is_resident(t):
+        assert ev.restore(t)
+
+
+def _wal_op_streams(swal, caps):
+    """Ground truth from the durable log alone: per-tenant op streams
+    re-extracted from the WAL records, in record/lane/slot order — the
+    same decode replay_into performs."""
+    per_t = {}
+    n_ops = 0
+    for _seq, leaves in swal.records(0):
+        tenants_a, kind_a, actor_a, ctr_a, clock_a, member_a = leaves
+        for k in range(len(tenants_a)):
+            t = int(tenants_a[k])
+            for s in range(kind_a.shape[1]):
+                op = int(kind_a[k, s])
+                if op == sb_ops.NOOP:
+                    continue
+                n_ops += 1
+                if op == sb_ops.ADD:
+                    per_t.setdefault(t, []).append((
+                        sb_ops.ADD, int(actor_a[k, s]), int(ctr_a[k, s]),
+                        None, np.asarray(member_a[k, s]),
+                    ))
+                else:
+                    per_t.setdefault(t, []).append((
+                        sb_ops.RM, 0, 0,
+                        np.asarray(clock_a[k, s], np.uint32),
+                        np.asarray(member_a[k, s]),
+                    ))
+    return per_t, n_ops
+
+
+def test_wal_order_detector_and_broken_twin():
+    """The pipeline static-check gate's detector: the honest flush and
+    the pipelined loop log before dispatching; the committed broken
+    twin dispatches first and MUST be caught."""
+    assert wal_precedes_dispatch(IngestQueue)
+    assert wal_precedes_dispatch(ServeLoop)
+    assert not wal_precedes_dispatch(fixtures.serve_dispatch_before_wal)
+
+
+def test_serve_wal_replay_reingests_bit_identical():
+    """Log-before-dispatch + replay-equals-re-ingest: a WAL'd multi-
+    flush run under lane paging recovers in a fresh superblock
+    bit-identical to the original rows AND to the sequential oracle,
+    with one group-commit fsync per dispatch."""
+    root = tempfile.mkdtemp(prefix="serve-wal-replay-")
+    try:
+        mesh = make_mesh(1, 1)
+        caps = DENSE_CAPS
+        streams = _rand_streams("orswot", caps, 10, 120, seed=7)
+        n_ops = sum(len(v) for v in streams.values())
+        sb = Superblock(16, mesh, kind="orswot", caps=dict(caps))
+        ev = Evictor(sb, os.path.join(root, "tier"))
+        with ServeWal(os.path.join(root, "wal")) as swal:
+            q = IngestQueue(sb, lanes=4, depth=4, evictor=ev, wal=swal)
+            _submit(q, streams)
+            rep, _ = q.drain()
+            assert rep.ops_applied == n_ops
+            assert swal.fsyncs >= rep.dispatches  # one commit per slab
+            assert swal.bytes_appended > 0
+        want = {}
+        for t in streams:
+            _restore_if_cold(sb, ev, t)
+            want[t] = _row_np(sb, t)
+        # A fresh process: recover the tier + replay the WAL suffix.
+        sb2 = Superblock(16, mesh, kind="orswot", caps=dict(caps))
+        ev2 = Evictor(sb2, os.path.join(root, "tier"))
+        q2 = IngestQueue(sb2, lanes=4, depth=4, evictor=ev2)
+        with ServeWal(os.path.join(root, "wal")) as swal2:
+            rrep = recover_serve(os.path.join(root, "tier"), q2, swal2)
+        assert rrep.ops == n_ops  # every acked op replayed
+        for t in streams:
+            _restore_if_cold(sb2, ev2, t)
+            got = _row_np(sb2, t)
+            assert _trees_equal(got, want[t]), (
+                f"tenant {t} recovered differently from the pre-crash row"
+            )
+            oracle = sb_ops.sequential_oracle(
+                sb2.tk, sb2.tk.empty(**sb2.caps), streams[t]
+            )
+            assert _trees_equal(got, oracle), (
+                f"tenant {t} recovered off its sequential oracle"
+            )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def test_serve_wal_crashpoint_fuzz_zero_acked_op_loss():
+    """Kill the WAL'd pipelined loop at each ISSUE 18 crashpoint —
+    including MID-DISPATCH, between the group commit and the scatter —
+    and require recovery to land exactly the oracle of the durable
+    log's op streams, with every acked op present (zero acked-op
+    loss: ops from completed drains can never outnumber the log)."""
+    caps = dict(n_elems=4, n_actors=2, deferred_cap=2)
+    box = {}
+    dirs = []
+
+    def crash_run(name):
+        box["root"] = tempfile.mkdtemp(prefix="serve-wal-fuzz-")
+        dirs.append(box["root"])
+        box["acked"] = 0
+        mesh = make_mesh(1, 1)
+        sb = Superblock(4, mesh, kind="orswot", caps=dict(caps))
+        ev = Evictor(sb, os.path.join(box["root"], "tier"))
+        swal = ServeWal(os.path.join(box["root"], "wal"))
+        try:
+            q = IngestQueue(sb, lanes=2, depth=2, evictor=ev, wal=swal)
+            loop = ServeLoop(q, persist_ahead=1, persist_batch=1)
+            items = list(
+                _rand_streams("orswot", caps, 4, 24, seed=11).items()
+            )
+            for chunk in (dict(items[:2]), dict(items[2:])):
+                _submit(q, chunk)
+                loop.drain()
+                # drain returned → these ops are acked-durable.
+                box["acked"] += sum(len(v) for v in chunk.values())
+            # Force a background-drain crossing whatever persist_ahead
+            # already did (some resident tenant is still dirty here).
+            loop.persister.enqueue(range(4))
+            loop.persister.drain(budget=4)
+        finally:
+            swal.close()
+
+    def recov():
+        mesh = make_mesh(1, 1)
+        sb2 = Superblock(4, mesh, kind="orswot", caps=dict(caps))
+        ev2 = Evictor(sb2, os.path.join(box["root"], "tier"))
+        q2 = IngestQueue(sb2, lanes=2, depth=2, evictor=ev2)
+        with ServeWal(os.path.join(box["root"], "wal")) as sw:
+            recover_serve(os.path.join(box["root"], "tier"), q2, sw)
+            per_t, wal_ops = _wal_op_streams(sw, caps)
+        got = {"acked_ok": box["acked"] <= wal_ops}
+        want = {"acked_ok": True}
+        for t, ops_l in per_t.items():
+            _restore_if_cold(sb2, ev2, t)
+            got[t] = _row_np(sb2, t)
+            want[t] = jax.tree.map(np.asarray, sb_ops.sequential_oracle(
+                sb2.tk, sb2.tk.empty(**sb2.caps), ops_l
+            ))
+        return got, want
+
+    def equal(a, b):
+        if set(a) != set(b) or a["acked_ok"] != b["acked_ok"]:
+            return False
+        return all(
+            _trees_equal(a[k], b[k]) for k in a if k != "acked_ok"
+        )
+
+    names = (
+        "serve.wal.pre_log",
+        "serve.wal.post_log_pre_dispatch",
+        "serve.dispatch.post_scatter_pre_ack",
+        "serve.persist.background_drain",
+    )
+    failures = crashpoints.fuzz(crash_run, recov, equal, names=names)
+    for d in dirs:
+        shutil.rmtree(d, ignore_errors=True)
+    assert not failures, failures
+
+
+@pytest.mark.parametrize("kind,caps", [
+    ("orswot", DENSE_CAPS), ("sparse_orswot", SPARSE_CAPS),
+])
+def test_serve_loop_pipelined_matches_serial(kind, caps):
+    """The overlap changes WHEN work happens, never WHAT lands: the
+    pipelined loop (WAL + background persists + lane paging) ends
+    bit-identical to the per-tenant sequential oracle — the same
+    contract the serial flush already carries."""
+    root = tempfile.mkdtemp(prefix="serve-loop-pipe-")
+    try:
+        mesh = make_mesh(1, 1)
+        streams = _rand_streams(kind, caps, 12, 150, seed=5)
+        n_ops = sum(len(v) for v in streams.values())
+        sb = Superblock(16, mesh, kind=kind, caps=dict(caps))
+        ev = Evictor(sb, os.path.join(root, "tier"))
+        with ServeWal(os.path.join(root, "wal")) as swal:
+            q = IngestQueue(sb, lanes=4, depth=3, evictor=ev, wal=swal)
+            loop = ServeLoop(q, persist_ahead=2, persist_batch=2)
+            _submit(q, streams)
+            rep, _ = loop.drain()
+            assert loop.inflight is None
+            assert rep.ops_applied == n_ops
+            assert rep.dispatches >= 2  # genuinely pipelined rounds
+            assert swal.fsyncs >= rep.dispatches
+        for t in streams:
+            _restore_if_cold(sb, ev, t)
+            oracle = sb_ops.sequential_oracle(
+                sb.tk, sb.tk.empty(**sb.caps), streams[t]
+            )
+            assert _trees_equal(sb.row(t), oracle), (
+                f"tenant {t} diverged under the pipelined loop"
+            )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def test_background_persister_persists_without_freeing():
+    """The persist-ahead contract: drain persists dirty residents
+    (clearing dirt, never freeing the lane), dedups its queue, drops
+    stale entries for free, and a later eviction of the now-clean
+    tenant skips the persist entirely (no second generation)."""
+    from crdt_tpu.durability import snapshot
+    from crdt_tpu.serve.evict import tenant_dir
+
+    root = tempfile.mkdtemp(prefix="serve-bg-persist-")
+    try:
+        mesh = make_mesh(1, 1)
+        sb = Superblock(4, mesh, kind="orswot", caps=dict(DENSE_CAPS))
+        ev = Evictor(sb, root)
+        bp = BackgroundPersister(ev, batch=8)
+        row, _ = sb.tk.apply_add(
+            sb.empty_row(), jnp.int32(0), jnp.uint32(1),
+            jnp.asarray(_mask(0)),
+        )
+        sb.write_row(0, row)
+        sb.dirty[0] = True
+        assert bp.enqueue([0, 0]) == 1          # dedup
+        assert bp.drain() == 1
+        assert sb.is_resident(0)                 # never frees the lane
+        assert not sb.dirty[0]                   # persist clears dirt
+        assert len(snapshot.generations(tenant_dir(root, 0))) == 1
+        h = bp.take_hist()
+        assert int(np.asarray(h.counts).sum()) == 1  # timed into the hist
+        assert int(np.asarray(bp.take_hist().counts).sum()) == 0  # delta
+        assert bp.enqueue([0]) == 1
+        assert bp.drain() == 0                   # clean → stale, free
+        ev.evict([0])                            # finds it clean:
+        assert len(snapshot.generations(tenant_dir(root, 0))) == 1
+        assert ev.restore(0)
+        assert _trees_equal(sb.row(0), row)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def test_rebalance_minimal_moves_and_override_handoff():
+    """Skew-aware placement: every planned move sheds from an
+    over-threshold host into strict headroom (minimal-move), a
+    balanced fleet plans nothing, overrides steer ``owner()``, the
+    handoff joins the row on the NEW owner only, and ``fail_over``
+    drops overrides pointing at the dead host."""
+    from crdt_tpu.serve import export_rows, ingest_rows
+
+    smap = TenantShardMap(4)
+    tenants = list(range(64))
+    hot = smap.owner(0)
+    weights = {
+        t: (100.0 if smap.owner(t) == hot else 1.0) for t in tenants
+    }
+    loads0 = host_loads(smap, tenants, weights)
+    mean = sum(loads0.values()) / len(loads0)
+    plan = rebalance_plan(smap, tenants, weights, threshold=1.2)
+    assert plan, "a 100x hot host must trigger moves"
+    sim = dict(loads0)
+    for mv in plan:
+        assert sim[mv.src] > 1.2 * mean          # only over-threshold sheds
+        assert sim[mv.dst] + mv.load < sim[mv.src]  # strict improvement
+        sim[mv.src] -= mv.load
+        sim[mv.dst] += mv.load
+    assert max(sim.values()) < max(loads0.values())
+    # An already-balanced fleet plans ZERO moves (uniform weights).
+    assert rebalance_plan(
+        smap, tenants, {t: 1.0 for t in tenants}, threshold=1.5
+    ) == []
+    assert apply_rebalance(smap, plan) == len(plan)
+    for mv in plan:
+        assert smap.owner(mv.tenant) == mv.dst   # override consulted
+    loads1 = host_loads(smap, tenants, weights)
+    assert max(loads1.values()) < max(loads0.values())
+    # The handoff: old owner exports, the NEW owner joins what the
+    # override says it now owns; the old owner refuses it.
+    mesh = make_mesh(1, 1)
+    sm2 = TenantShardMap(2)
+    t = next(t for t in range(16) if sm2.owner(t) == 0)
+    sb_old = Superblock(16, mesh, kind="orswot", caps=dict(DENSE_CAPS))
+    sb_new = Superblock(16, mesh, kind="orswot", caps=dict(DENSE_CAPS))
+    row, _ = sb_old.tk.apply_add(
+        sb_old.empty_row(), jnp.int32(0), jnp.uint32(1),
+        jnp.asarray(_mask(1)),
+    )
+    sb_old.write_row(t, row)
+    sm2.overrides[t] = 1                         # the rebalance move
+    wire = export_rows(sb_old, [t])
+    assert ingest_rows(sb_new, sm2, 1, wire) == 1
+    assert _trees_equal(sb_new.row(t), row)
+    sb_other = Superblock(16, mesh, kind="orswot", caps=dict(DENSE_CAPS))
+    assert ingest_rows(sb_other, sm2, 0, wire) == 0
+    # Failover clears overrides aimed at the dead host.
+    dead = plan[0].dst
+    smap.fail_over(dead)
+    assert all(h != dead for h in smap.overrides.values())
+    assert smap.owner(plan[0].tenant) != dead
+
+
+def test_serve_loop_telemetry_serving_fields_flow():
+    """The new serving fields ride the one telemetry spine: WAL bytes
+    land on the drained record, overlap hits / rebalance moves fill as
+    per-record DELTAS (combine-exact), the background persist latency
+    folds into ``hist_persist_us``, and ``counter_increments`` exposes
+    all three under ``telemetry.<kind>.serve.*``."""
+    root = tempfile.mkdtemp(prefix="serve-loop-tel-")
+    try:
+        mesh = make_mesh(1, 1)
+        streams = _rand_streams("orswot", DENSE_CAPS, 6, 60, seed=3)
+        sb = Superblock(8, mesh, kind="orswot", caps=dict(DENSE_CAPS))
+        ev = Evictor(sb, os.path.join(root, "tier"))
+        with ServeWal(os.path.join(root, "wal")) as swal:
+            q = IngestQueue(sb, lanes=4, depth=3, evictor=ev, wal=swal)
+            loop = ServeLoop(q, persist_ahead=2, persist_batch=2)
+            _submit(q, streams)
+            _rep, tel = loop.drain(telemetry=True)
+            assert tel is not None
+            d = tele.to_dict(tel)
+            assert d["serve_wal_bytes"] > 0
+            assert d["serve_overlap_hit"] >= 0
+            assert d["rebalance_moves"] == 0
+            # Deltas: note moves, persist one dirty tenant, annotate.
+            loop.note_rebalance(3)
+            resident_dirty = [
+                t for t in range(8)
+                if sb.is_resident(t) and sb.dirty[t]
+            ]
+            assert resident_dirty  # the drain leaves dirt behind
+            loop.persister.enqueue(resident_dirty[:1])
+            assert loop.persister.drain() == 1
+            t2 = loop.annotate(tele.zeros())
+            assert int(t2.rebalance_moves) == 3
+            assert int(np.asarray(t2.hist_persist_us.counts).sum()) >= 1
+            t3 = loop.annotate(tele.zeros())
+            assert int(t3.rebalance_moves) == 0  # delta consumed
+            ci = tele.counter_increments("serve", tele.to_dict(
+                tele.combine(tel, t2)
+            ))
+            assert ci["telemetry.serve.serve.wal_bytes"] > 0
+            assert ci["telemetry.serve.serve.rebalance_moves"] == 3
+            assert "telemetry.serve.serve.overlap_hit" in ci
+    finally:
+        shutil.rmtree(root, ignore_errors=True)

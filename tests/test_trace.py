@@ -169,6 +169,47 @@ def test_capacity_overflow_rolls_traces_back_and_recompletes(tmp_path):
     assert len(tids) == len(set(tids))
 
 
+def test_requeue_preserves_durable_wal_seq():
+    """ISSUE 18 regression: an op rolled OUT of a slab whose WAL
+    record already group-committed must keep that durable id — the
+    requeue records the seq (sticky, FIRST seq wins across repeated
+    rolls), the eventual completion carries it, and the
+    ``trace_requeue`` / ``trace_complete`` events expose it so
+    obs_report's acked-op audit can match acks to durable records."""
+    rec = obs.FlightRecorder(capacity=64)
+    obs.install(rec)
+    tr = trace.Tracer(sample=1, clock_ns=_ticker())
+    trace.install_tracer(tr)
+    tr.stamp("submit", tenant=0)
+    tr.stamp("coalesce", tenants=[0])
+    assert tr.requeue([0], seq=7) == 1   # rolled after the group commit
+    ((_tid, stamps),) = tr.open_traces()[0]
+    assert [s for s, _t in stamps] == ["submit"]  # back to submit-only
+    tr.stamp("coalesce", tenants=[0])
+    assert tr.requeue([0], seq=9) == 1   # a LATER slab's seq never wins
+    tr.stamp("coalesce", tenants=[0])
+    tr.stamp("dispatch", tenants=[0])
+    tr.stamp("durable", tenants=[0], seq=11)  # nor the re-dispatch's
+    tr.stamp("push", tenant=0, version=1)
+    tr.stamp("ack", tenant=0, version=1)
+    assert (tr.completed, tr.requeued) == (1, 2)
+    done = list(tr.recent)[-1]
+    assert done["wal_seq"] == 7
+    evs = rec.events()
+    requeues = [e for e in evs if e["type"] == "trace_requeue"]
+    assert [e["wal_seq"] for e in requeues] == [7, 7]
+    completes = [e for e in evs if e["type"] == "trace_complete"]
+    assert completes and completes[-1]["wal_seq"] == 7
+    # A trace that never rolled takes the durable stamp's own seq.
+    tr.stamp("submit", tenant=1)
+    tr.stamp("coalesce", tenants=[1])
+    tr.stamp("dispatch", tenants=[1])
+    tr.stamp("durable", tenants=[1], seq=11)
+    tr.stamp("push", tenant=1, version=1)
+    tr.stamp("ack", tenant=1, version=1)
+    assert list(tr.recent)[-1]["wal_seq"] == 11
+
+
 def test_resync_fallback_completes_traces(tmp_path):
     """A subscriber that falls out of the ack window catches up via
     snapshot+suffix resync — and the resync still stamps ``push``, so
@@ -266,6 +307,7 @@ def test_exporter_health_serving_vitals(tmp_path):
     assert base == {
         "live_tenants": 0, "subscribers_live": 0,
         "ingest_backpressure": 0, "resync_fallbacks": 0,
+        "serve_wal_bytes": 0, "overlap_hits": 0, "rebalance_moves": 0,
         "freshness_p99_us": -1.0,
     }
     sb, ev, q, plane, ids = _pipeline(tmp_path)

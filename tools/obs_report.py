@@ -20,7 +20,10 @@ act on:
   that never decreases across the dump (a straggler pinning
   reclamation), drain refusals with unacked out-lanes, and
   ``telemetry_delta`` sums exceeding the final snapshot (a rewound
-  counter);
+  counter), and — when a dirty-tenant serve WAL was active
+  (``serve_wal_round`` events) — any client-acked trace completing
+  WITHOUT a durable WAL seq at or below the newest logged round
+  (acked-op-without-durable-record, the ISSUE 18 loss window);
 - **counter cross-check** — the dump's ``telemetry`` events re-folded
   through ``crdt_tpu.telemetry.counter_increments`` (THE one mapping
   ``telemetry.record`` itself applies) and compared BIT-EXACTLY
@@ -334,12 +337,51 @@ def audit(dump: Dict[str, Any]) -> List[Dict[str, str]]:
                 "check": "fanout-cohort-conservation",
                 "severity": "error",
                 "detail": (
-                    f"fanout_push events narrate {got} cohorts but the "
+                    f"fanout-push events narrate {got} cohorts but the "
                     f"folded telemetry cohorts_per_dispatch holds "
                     f"{want} — the dump's push story disagrees with "
                     f"its telemetry"
                 ),
             })
+
+    # 7. Acked-op-without-durable-record (ISSUE 18): when the dump
+    # shows an active dirty-tenant serve WAL (serve_wal_round events),
+    # every completed — i.e. client-ACKED — trace must carry the
+    # wal_seq of the group-commit round that made its op durable, and
+    # that seq must be at or below the newest logged round. An acked
+    # trace with no wal_seq means the ack outran the fsync — exactly
+    # the loss window the WAL-before-dispatch ordering exists to close.
+    wal_rounds = [ev for ev in events if ev.get("type") == "serve_wal_round"]
+    if wal_rounds and not _dropped(
+        "trace_complete", "serve_wal_round", "wal_fsync"
+    ):
+        watermark = max(int(ev.get("seq", -1)) for ev in wal_rounds)
+        for ev in events:
+            if ev.get("type") != "trace_complete":
+                continue
+            seq = ev.get("wal_seq")
+            if seq is None:
+                findings.append({
+                    "check": "acked-op-without-durable-record",
+                    "severity": "error",
+                    "detail": (
+                        f"round {ev.get('round')}: trace "
+                        f"{ev.get('trace')!r} (tenant {ev.get('tenant')}) "
+                        f"completed its ack with NO serve-WAL seq while "
+                        f"the WAL was active — the ack outran the fsync"
+                    ),
+                })
+            elif int(seq) > watermark:
+                findings.append({
+                    "check": "acked-op-without-durable-record",
+                    "severity": "error",
+                    "detail": (
+                        f"round {ev.get('round')}: trace "
+                        f"{ev.get('trace')!r} claims WAL seq {seq} but "
+                        f"the newest logged round is {watermark} — the "
+                        f"durable record it cites does not exist"
+                    ),
+                })
     return findings
 
 

@@ -96,6 +96,15 @@ Sections (each timed, each independently skippable):
   detector gate — the watermark-bucket-skipping pusher
   (``analysis.fixtures.fanout_skips_watermark_bucket``) must fail the
   cohort coverage detector.
+- ``pipeline`` — the pipelined-serving-loop gates (ISSUE 18): the
+  WAL-before-dispatch ordering scan
+  (``crdt_tpu.serve.wal.wal_precedes_dispatch`` — an AST walk proving
+  every function mixing WAL and dispatch calls logs FIRST) over the
+  honest ``IngestQueue``/``ServeLoop``, its committed broken twin
+  (``analysis.fixtures.serve_dispatch_before_wal``) proven to fire,
+  and the skew-aware rebalance minimal-move property (balanced fleet
+  → zero moves; every move sheds from an over-threshold host and
+  strictly shrinks the gap) on a synthetic zipf load.
 - ``jit-lint``  — the jaxpr walker (crdt_tpu.analysis.jit_lint) over
   every registered mesh entry point: traced-branch, unstable-sort,
   float-accum, dtype-overflow, donation-alias, PLUS the collective-
@@ -156,7 +165,7 @@ sys.path.insert(0, ROOT)
 SECTIONS = (
     "lint", "schema", "laws", "schedules", "faults", "decomp",
     "durability", "scaleout", "obs", "wire", "serve", "fanout",
-    "jit-lint", "cost", "slo", "aliasing",
+    "pipeline", "jit-lint", "cost", "slo", "aliasing",
 )
 
 # Directories the fallback linter walks (ruff takes its own config).
@@ -346,6 +355,81 @@ def run_fanout():
     return static_checks()
 
 
+def run_pipeline():
+    """The pipelined-serving-loop section (ISSUE 18): the
+    WAL-before-dispatch ordering gate (the AST scan
+    ``serve.wal.wal_precedes_dispatch`` must pass the honest
+    ``IngestQueue``/``ServeLoop`` and FAIL the committed broken twin
+    ``analysis.fixtures.serve_dispatch_before_wal``) plus the
+    skew-aware rebalance minimal-move property on a synthetic zipf
+    load (balanced fleet plans zero moves; every planned move sheds
+    from an over-threshold host and strictly shrinks the src/dst gap).
+    """
+    from crdt_tpu.analysis import fixtures
+    from crdt_tpu.analysis.report import Finding
+    from crdt_tpu.serve import (
+        IngestQueue, ServeLoop, TenantShardMap, host_loads,
+        rebalance_plan, wal_precedes_dispatch,
+    )
+
+    findings = []
+
+    # 1. WAL-before-dispatch ordering: honest code passes the scan...
+    for obj in (IngestQueue, ServeLoop):
+        if not wal_precedes_dispatch(obj):
+            findings.append(Finding(
+                "pipeline-wal-order", obj.__name__,
+                "a dispatch call precedes the slab's WAL append — an "
+                "acked op can be lost in the scatter→fsync window",
+            ))
+    # ...and the committed broken twin must fire it.
+    if wal_precedes_dispatch(fixtures.serve_dispatch_before_wal):
+        findings.append(Finding(
+            "broken-fixture-missed", "serve_dispatch_before_wal",
+            "the dispatch-before-WAL broken twin PASSED the ordering "
+            "scan — the pipeline durability gate is not actually "
+            "firing",
+        ))
+
+    # 2. Rebalance minimal-move property on a synthetic zipf load:
+    # 64 tenants, zipf-ish weights, rendezvous placement over 4 hosts.
+    sm = TenantShardMap(4)
+    tenants = list(range(64))
+    weights = {t: 1.0 / (t + 1) ** 1.0 for t in tenants}  # zipf α=1
+    loads0 = host_loads(sm, tenants, weights)
+    mean = sum(loads0.values()) / len(loads0)
+    plan = rebalance_plan(sm, tenants, weights, threshold=1.5)
+    loads = dict(loads0)
+    for mv in plan:
+        if loads[mv.src] <= 1.5 * mean:
+            findings.append(Finding(
+                "pipeline-rebalance-minimal", f"tenant {mv.tenant}",
+                f"move sheds from host {mv.src} whose load "
+                f"{loads[mv.src]:.3f} is already under threshold — "
+                "not a minimal-move plan",
+            ))
+        if loads[mv.dst] + mv.load >= loads[mv.src]:
+            findings.append(Finding(
+                "pipeline-rebalance-minimal", f"tenant {mv.tenant}",
+                "move does not strictly shrink the src/dst gap",
+            ))
+        loads[mv.src] -= mv.load
+        loads[mv.dst] += mv.load
+    # A balanced fleet (uniform weights) must plan ZERO moves... unless
+    # rendezvous itself landed it lopsided, in which case every move
+    # still obeys the shed-from-hot rule checked above.
+    flat = {t: 1.0 for t in tenants}
+    lf = host_loads(sm, tenants, flat)
+    if max(lf.values()) <= 1.5 * (sum(lf.values()) / len(lf)):
+        if rebalance_plan(sm, tenants, flat, threshold=1.5):
+            findings.append(Finding(
+                "pipeline-rebalance-minimal", "uniform load",
+                "a balanced fleet planned moves — the planner churns "
+                "placements it cannot improve",
+            ))
+    return findings
+
+
 def run_jit_lint():
     from crdt_tpu.analysis.jit_lint import check_gates, lint_entry_points
 
@@ -431,6 +515,7 @@ RUNNERS = {
     "wire": run_wire,
     "serve": run_serve,
     "fanout": run_fanout,
+    "pipeline": run_pipeline,
     "jit-lint": run_jit_lint,
     "cost": run_cost,
     "slo": run_slo,
@@ -439,8 +524,8 @@ RUNNERS = {
 
 _JAX_SECTIONS = (
     "laws", "schedules", "faults", "decomp", "durability", "scaleout",
-    "obs", "wire", "serve", "fanout", "jit-lint", "cost", "slo",
-    "aliasing",
+    "obs", "wire", "serve", "fanout", "pipeline", "jit-lint", "cost",
+    "slo", "aliasing",
 )
 
 

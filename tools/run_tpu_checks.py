@@ -14,7 +14,12 @@ replayed verbatim from BENCH_CONFIGS.json — degraded or
 non-bit-identical fails the check), the SERVE multi-tenant leg
 (1M+ live tenants through the tenant-packed superblock, same verbatim-
 replay rule — degraded, non-bit-identical, or missing its in-window
-evict→restore cycle fails the check), and the FANOUT δ-subscription
+evict→restore cycle fails the check), the SERVE_ZIPF pipelined
+always-on leg (zipf popularity through the WAL-logged pipelined
+ServeLoop with the 10× hot-shard skew event, same verbatim-replay
+rule — degraded, non-bit-identical, any acked op lost across
+kill/recover, a pipeline that never overlapped, or a during-skew p99
+above 1.5× pre-skew fails the check), and the FANOUT δ-subscription
 leg (1M+ subscribers pushed cohort δ payloads over the churning
 superblock, same verbatim-replay rule — degraded, non-bit-identical,
 below the 1M-subscriber / ≥10× δ-vs-full-state gates, or missing its
@@ -231,6 +236,47 @@ def main() -> int:
             return 1
         if srv["tenants"] < 1_000_000 or srv["evict_restored_in_window"] < 1:
             print("FAIL: serve leg below the 1M-tenant / evict-restore gate")
+            return 1
+
+    # The pipelined always-on zipf leg (ISSUE 18), shape replayed
+    # VERBATIM from the committed BENCH_CONFIGS.json serve entry's
+    # zipf_* knobs. The leg itself asserts oracle + serial-equivalence
+    # + kill/recover bit-identity; here a degraded record, any acked op
+    # lost across recovery, a pipeline that never overlapped, or a
+    # during-skew p99 blown past 1.5× the pre-skew p99 (the rebalance
+    # failed to absorb the hot shard) is a failed check on hardware.
+    t0 = time.time()
+    zipf_recs = bench.bench_serve_zipf()
+    if zipf_recs:
+        sz = zipf_recs[0]
+        print(
+            f"serve_zipf ran  [{time.time()-t0:.0f}s] "
+            f"({sz['value']:,.0f} ops/s pipelined vs "
+            f"{sz['serial_ops_per_sec']:,.0f} serial = "
+            f"{sz['pipeline_speedup']}x, overlap "
+            f"{sz['overlap_hit_ratio']:.0%}, WAL "
+            f"{sz['serve_wal_bytes']:,} B / {sz['serve_wal_fsyncs']} "
+            f"fsyncs, p99 {sz['dispatch_p99_before_us']:,.0f}/"
+            f"{sz['dispatch_p99_during_us']:,.0f}/"
+            f"{sz['dispatch_p99_after_us']:,.0f} us, "
+            f"{sz['rebalance_moves']} rebalance moves, "
+            f"recovery gate "
+            f"{'OK' if sz['recovered_bit_identical'] else 'FAILED'})"
+        )
+        if sz.get("degraded") or not sz["bit_identical"]:
+            print("FAIL: serve_zipf record degraded or not bit-identical")
+            return 1
+        if sz["acked_ops_lost"] or not sz["recovered_bit_identical"]:
+            print("FAIL: serve_zipf lost acked ops across kill/recover")
+            return 1
+        if sz["overlap_hits"] < 1:
+            print("FAIL: serve_zipf pipeline never overlapped host work "
+                  "with an in-flight dispatch")
+            return 1
+        if sz["skew_p99_ratio"] > 1.5:
+            print("FAIL: serve_zipf during-skew dispatch p99 exceeds "
+                  "1.5x the pre-skew p99 — rebalancing did not absorb "
+                  "the hot shard")
             return 1
 
     # The fan-out egress: 1M+ subscribers pushed cohort δ payloads over
