@@ -619,3 +619,139 @@ def tracer_clock_regresses(**kwargs):
 
     kwargs.pop("clock_ns", None)  # discard the honest injected clock
     return Tracer(clock_ns=backwards, **kwargs)
+
+
+# ---- host-concurrency twins (crdt_tpu/analysis/concur + interleave) --------
+
+class UnorderedWalLoop:
+    """Broken concurrency twin: a pipelined serving loop that ISSUES
+    the device dispatch before group-committing the slab's WAL record
+    — the stacked-PR descendant of ``serve_dispatch_before_wal``,
+    restated as a loop so the generalized
+    ``concur.call_order_violations`` (the ``wal_precedes_dispatch``
+    HB contract, first entry of ``concur.HB_CONTRACTS``) proves the
+    ordering over the whole method body. Never executed."""
+
+    def step(self, q, built):
+        pend = q._issue(built)        # dispatch first — the bug
+        seq = q._log(built)           # durable only after the scatter
+        return q._finish(built, pend, seq)
+
+
+class PersistFreesLanes:
+    """Broken concurrency twin: a background persister whose drain
+    ALSO frees the persisted tenants' lanes — lane-table writes from
+    the persist thread with no ordering contract against the driver's
+    assemble/issue path. The effect layer classifies ``lane_of`` /
+    ``_free`` writes here under the ``persist`` logical thread, the
+    driver writes them too, and NO ``HB_CONTRACTS`` entry orders that
+    pair — ``concur.uncovered_conflicts`` must report both sites
+    (invoked with ``extra=(PersistFreesLanes,)`` and
+    ``extra_threads={"PersistFreesLanes": ("persist",)}``)."""
+
+    def drain(self):
+        for t in list(self._queue):
+            self.evictor.persist([t])
+            lane = int(self.sb.lane_of[t])
+            self.sb.lane_of[t] = -1        # the bug: lane-table writes
+            self.sb.tenant_of[lane] = -1   # off-thread, unordered
+            self.sb._free.append(lane)     # against the driver's picks
+            self.persisted += 1
+
+
+def regressing_ack_promoter_cls():
+    """Broken concurrency twin: a fan-out plane whose ack promotion
+    TRUSTS the claimed version — no clamp to
+    ``[current watermark, last shipped]`` — so a reordered stale ack
+    regresses the subscriber's watermark (re-shipping δs the client
+    already holds) and an overclaim promotes past what was ever
+    shipped (starving the client of the gap forever).
+    ``concur.ack_window_probe`` (the ``ack_clamped_to_window``
+    contract) must fail it. Lazy factory: importing this module stays
+    jax-free."""
+    from ..fanout.plane import FanoutPlane
+
+    class _RegressingAckPlane(FanoutPlane):
+        def ack(self, ids, versions=None):
+            import numpy as np
+
+            ids = np.atleast_1d(np.asarray(ids, np.int64))
+            v = (self.sub_pend[ids] if versions is None
+                 else np.broadcast_to(np.asarray(versions, np.int64),
+                                      ids.shape))
+            self.sub_ver[ids] = v          # the bug: no clamp
+            self.sub_pend[ids] = -1
+
+    return _RegressingAckPlane
+
+
+class RogueCounterMutator:
+    """Broken concurrency twin: a host-surface class mutating a
+    self-attribute (``rogue_counter``) outside ``__init__`` that NO
+    ``register_shared_field`` call covers — the registration-is-the-
+    coverage-contract gate
+    (``effects.unregistered_shared_mutations(extra=(...,))``) must
+    name ``RogueCounterMutator.rogue_counter`` and the mutating site.
+    Never executed."""
+
+    def __init__(self):
+        self.rogue_counter = 0
+
+    def bump(self):
+        self.rogue_counter += 1   # the unregistered shared write
+
+
+def racy_fanout_world():
+    """Broken concurrency twin: the PR 16 lane-eviction race, rebuilt
+    as an explorable world. ``_RacyPlane`` restores pushed tenants
+    WITHOUT the ``_exclude`` pin and — where the honest plane raises
+    loudly on a mid-cycle residency loss — silently WRAPS the -1 lane
+    to the last lane (the pre-fix behavior), so a snapshot or dispatch
+    after a preempting eviction gathers ANOTHER tenant's row as the
+    shipped δ base. The interleaving explorer
+    (``interleave.explore``) must produce a counterexample within 2
+    preemptions: one switch from ``push.warm`` to the eviction task is
+    enough to ship tenant 1's row to tenant 0's subscribers, and the
+    final client states diverge bit-wise from the serial oracle."""
+    from .interleave import fanout_world
+
+    def _racy_plane_cls():
+        from ..fanout.plane import FanoutPlane
+
+        class _RacyPlane(FanoutPlane):
+            def _ensure_resident(self, tenant, _exclude=()):
+                super()._ensure_resident(tenant)  # pin dropped — bug
+
+            def _wrap_lost(self, tenants):
+                import numpy as np
+
+                lanes = self.sb.lane_of
+                healed = [int(t) for t in np.atleast_1d(tenants)
+                          if int(lanes[int(t)]) < 0]
+                for t in healed:
+                    # pre-fix behavior: the -1 lane silently wraps to
+                    # the last lane — another tenant's row
+                    lanes[t] = self.sb.n_lanes - 1
+                return healed
+
+            def _snapshot(self, tenants):
+                lanes = self.sb.lane_of
+                healed = self._wrap_lost(tenants)
+                try:
+                    return super()._snapshot(tenants)
+                finally:
+                    for t in healed:
+                        lanes[t] = -1
+
+            def _dispatch(self, cohorts, telemetry):
+                lanes = self.sb.lane_of
+                healed = self._wrap_lost([co[0] for co in cohorts])
+                try:
+                    return super()._dispatch(cohorts, telemetry)
+                finally:
+                    for t in healed:
+                        lanes[t] = -1
+
+        return _RacyPlane
+
+    return fanout_world(plane_cls=_racy_plane_cls(), evict_pushed=True)

@@ -281,6 +281,52 @@ class ObsEvent:
 
 
 @dataclass(frozen=True)
+class SharedField:
+    """One registered host-side shared-state field (crdt_tpu/analysis/
+    effects.py): a mutable attribute of a serving-runtime object that
+    more than one logical task may touch — the lane table, the free
+    pool, the dirty flags, the WAL seq, the ack windows. Registration
+    is the coverage contract of the ``concurrency`` static-check
+    section: the effect-inference pass AST-scans every method of the
+    host serving surface and a mutated-but-unregistered field fails
+    discovery, exactly like an unregistered join, entry point, or
+    flight-recorder event. Register at the BOTTOM of the owning
+    module:
+
+        from ..analysis.registry import register_shared_field
+
+        register_shared_field(
+            "lane_of", owner="Superblock", module=__name__,
+            kind="tenant→lane indirection table",
+        )
+
+    ``guard`` declares an always-on ordering mechanism:
+    ``"lock:<attr>"`` means every access runs under the named lock
+    (the obs tracer's ``_lock`` discipline) — conflicts on such a
+    field need no happens-before contract."""
+
+    name: str
+    owner: str
+    kind: str
+    module: str = ""
+    guard: str = ""
+
+
+@dataclass(frozen=True)
+class EffectSource:
+    """One registered host execution context that runs crdt_tpu code
+    concurrently with the driver loop — a daemon thread, a background
+    drain. The ``concurrency`` static-check section lints every
+    ``threading.Thread`` creation site under ``crdt_tpu/`` against
+    this registry: an unregistered spawner fails discovery (a thread
+    nobody declared is a thread whose effects nobody analyzed)."""
+
+    name: str
+    module: str = ""
+    description: str = ""
+
+
+@dataclass(frozen=True)
 class TraceStage:
     """One registered op-journey trace stage (crdt_tpu/obs/trace.py):
     the schema behind every ``stamp("...")`` site in the serving
@@ -309,6 +355,8 @@ _SERVE_SURFACES: Dict[str, ServeSurface] = {}
 _FANOUT_SURFACES: Dict[str, FanoutSurface] = {}
 _OBS_EVENTS: Dict[str, ObsEvent] = {}
 _TRACE_STAGES: Dict[str, TraceStage] = {}
+_SHARED_FIELDS: Dict[Tuple[str, str], SharedField] = {}
+_EFFECT_SOURCES: Dict[str, EffectSource] = {}
 
 # Public callables in crdt_tpu.parallel matching this are mesh entry
 # points and MUST be registered (gossip_elastic/delta_gossip_elastic are
@@ -772,6 +820,66 @@ def unregistered_trace_stages() -> List[Tuple[str, str]]:
         for stage, where, _ in _scan_stamp_sites()
         if stage not in _TRACE_STAGES
     )
+
+
+def register_shared_field(
+    name: str, *, owner: str, kind: str, module: str = "", guard: str = "",
+) -> SharedField:
+    sf = SharedField(
+        name=name, owner=owner, kind=kind, module=module, guard=guard,
+    )
+    _SHARED_FIELDS[(owner, name)] = sf
+    return sf
+
+
+def register_effect_source(
+    name: str, *, module: str = "", description: str = "",
+) -> EffectSource:
+    src = EffectSource(name=name, module=module, description=description)
+    _EFFECT_SOURCES[name] = src
+    return src
+
+
+_HOST_SURFACE_IMPORTED = False
+
+
+def _import_host_surface() -> None:
+    """Import every host serving-surface module (the survey list lives
+    in ``crdt_tpu.analysis.effects`` — ONE home, shared with the AST
+    pass) so their bottom-of-module ``register_shared_field`` /
+    ``register_effect_source`` calls have run before a coverage diff
+    reads the tables. Once per process, same as
+    :func:`_import_obs_emitters`."""
+    global _HOST_SURFACE_IMPORTED
+    if _HOST_SURFACE_IMPORTED:
+        return
+    import importlib
+
+    effects = importlib.import_module("crdt_tpu.analysis.effects")
+    for mod in effects.HOST_SURFACE_MODULES:
+        importlib.import_module(mod)
+    _HOST_SURFACE_IMPORTED = True
+
+
+def shared_fields() -> Tuple[SharedField, ...]:
+    """Every registered host shared-state field, sorted (owner, name).
+    Each host-surface module registers its own fields at the bottom —
+    importing the surface first makes 'iterate the registry'
+    deterministic regardless of what the caller already imported."""
+    _import_host_surface()
+    return tuple(_SHARED_FIELDS[k] for k in sorted(_SHARED_FIELDS))
+
+
+def get_shared_field(owner: str, name: str) -> SharedField:
+    _import_host_surface()
+    return _SHARED_FIELDS[(owner, name)]
+
+
+def effect_sources() -> Tuple[EffectSource, ...]:
+    """Every registered concurrent host execution context (daemon
+    threads and background drains), sorted by name."""
+    _import_host_surface()
+    return tuple(_EFFECT_SOURCES[k] for k in sorted(_EFFECT_SOURCES))
 
 
 def fault_surfaces() -> Tuple[FaultSurface, ...]:

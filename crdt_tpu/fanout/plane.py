@@ -42,6 +42,19 @@ tenant eviction/restore cycles).
 broken-twin gate: a pusher that skips a watermark bucket (the
 ``analysis.fixtures.fanout_skips_watermark_bucket`` twin flips the
 ``_skip_versions`` seam) starves that cohort forever and MUST fail it.
+
+Two of the prose invariants above are declared happens-before
+contracts in ``analysis.concur.HB_CONTRACTS``:
+``pin_precedes_gather_dispatch`` (a push chunk pins its whole tenant
+set via ``_ensure_resident(_exclude=pinned)`` before warming, and
+``_snapshot``/``_dispatch`` refuse a lane that lost residency
+mid-cycle — the PR 16 lane-eviction race, rebuilt as the explorable
+``analysis.fixtures.racy_fanout_world`` twin) and
+``ack_clamped_to_window`` (promotion clamps to [watermark, shipped];
+``analysis.fixtures.regressing_ack_promoter_cls`` must fail the
+probe). ``analysis.interleave.fanout_world`` replays one push cycle
+against client acks and a concurrent eviction under every
+≤2-preemption schedule (the ``concurrency`` static-check section).
 """
 
 from __future__ import annotations
@@ -53,6 +66,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry as tele
+from ..analysis.interleave import boundary
 from ..durability import crashpoints
 from ..obs import recorder as _rec
 from ..obs import trace as obs_trace
@@ -216,6 +230,7 @@ class FanoutPlane:
         crashpoints: a kill between promote and clear re-acks to the
         SAME version, and an un-promoted kill leaves the pending mark
         for the re-ack."""
+        boundary("ack.promote")
         ids = np.atleast_1d(np.asarray(ids, np.int64))
         crashpoints.hit(CP_ACK_PRE)
         pend = self.sub_pend[ids]
@@ -406,8 +421,10 @@ class FanoutPlane:
             pinned = set(map(int, chunk))
             for t in chunk:
                 self._ensure_resident(int(t), _exclude=pinned)
+            boundary("push.warm")
             bumped = chunk[dirty[chunk]]
             self._snapshot(bumped)
+            boundary("push.snapshot")
             self.dirt[bumped] = False
 
             # Cohorts: subscribers sharing (tenant, acked version).
@@ -484,6 +501,7 @@ class FanoutPlane:
         ``mesh_fanout_push`` calls: each cohort lands in the lane block
         of the mesh rank owning its tenant's superblock lane (the
         serve_apply index convention)."""
+        boundary("push.dispatch")
         pushes: List[CohortPush] = []
         tel = None
         if not cohorts:
@@ -621,6 +639,29 @@ _reg_ev(
     "subscriber_resync", subsystem="fanout",
     fields=("tenant", "subscribers"), module=__name__,
 )
+
+from ..analysis.registry import register_shared_field as _reg_sf  # noqa: E402
+
+_reg_sf("ver", owner="FanoutPlane", module=__name__,
+        kind="per-tenant shipped-version counters")
+_reg_sf("dirt", owner="FanoutPlane", module=__name__,
+        kind="per-tenant dirty-since-push flags")
+_reg_sf("_bases", owner="FanoutPlane", module=__name__,
+        kind="retained δ bases keyed (tenant, version)")
+_reg_sf("sub_tenant", owner="FanoutPlane", module=__name__,
+        kind="subscriber→tenant interest table")
+_reg_sf("sub_ver", owner="FanoutPlane", module=__name__,
+        kind="per-subscriber acked watermark")
+_reg_sf("sub_pend", owner="FanoutPlane", module=__name__,
+        kind="per-subscriber shipped-pending version")
+_reg_sf("_top", owner="FanoutPlane", module=__name__,
+        kind="high-water subscriber id")
+_reg_sf("_free_ids", owner="FanoutPlane", module=__name__,
+        kind="recycled subscriber-id pool")
+_reg_sf("resyncs_total", owner="FanoutPlane", module=__name__,
+        kind="lifetime forced-resync counter")
+_reg_sf("_empty", owner="FanoutPlane", module=__name__,
+        kind="cached host empty-row template")
 
 __all__ = [
     "CohortPush", "CohortResync", "FanoutPlane", "PushReport",

@@ -183,6 +183,18 @@ _reg_ev("dcn_exchange_failed", subsystem="faults.retry",
         fields=("op", "attempts", "error"), module=__name__)
 
 
+from ..analysis.registry import register_effect_source as _reg_src  # noqa: E402
+
+# The per-attempt timeout watchdog thread (_call_with_timeout) is the
+# only thread crdt_tpu spawns; the concurrency section's thread lint
+# requires every threading.Thread site to live in a registered effect
+# source's module — daemon, named, and declared here.
+_reg_src(
+    "retry.dcn_watchdog", module=__name__,
+    description="daemon thread bounding one DCN exchange attempt; "
+    "touches no registered shared field (result lands in a local box)",
+)
+
 __all__ = [
     "DEFAULT_POLICY", "DcnExchangeFailed", "RetryPolicy", "with_retries",
 ]

@@ -597,6 +597,26 @@ register_obs_event(
 )
 
 
+from ..analysis.registry import register_shared_field as _reg_sf  # noqa: E402
+
+# Every Tracer field is touched under ``_lock`` (stamp/requeue run on
+# whatever thread observed the op) — guard declaration means conflicts
+# on these need no happens-before contract in analysis/concur.py.
+for _f, _kind in (
+    ("_open", "open per-op trace table"),
+    ("_next_tid", "next trace id"),
+    ("minted", "lifetime minted-trace counter"),
+    ("completed", "lifetime completed-trace counter"),
+    ("requeued", "lifetime requeued-trace counter"),
+    ("recent", "completed-trace ring"),
+    ("_inc", "per-window completed increment"),
+    ("_fresh_cum", "cumulative freshness sum"),
+    ("_fresh_total", "cumulative freshness count"),
+    ("_tenant_fresh", "per-tenant freshness accumulators"),
+):
+    _reg_sf(_f, owner="Tracer", module=__name__, kind=_kind,
+            guard="lock:_lock")
+
 __all__ = [
     "BOUNDARY_STAGES", "CHAIN_STAGES", "LATENCIES", "TRACE_HIST_FIELDS",
     "Tracer", "derive_latencies", "get_tracer", "install_tracer",

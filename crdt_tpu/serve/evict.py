@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..analysis.interleave import boundary
 from ..durability import crashpoints, snapshot
 from ..obs import trace as obs_trace
 from ..utils.metrics import metrics
@@ -145,6 +146,7 @@ class Evictor:
     def persist(self, tenants: Sequence[int]) -> int:
         """Flush dirty tenants' rows to the durable tier (no lane
         change). Returns rows written."""
+        boundary("evict.persist")
         n = 0
         for t in tenants:
             if not self.sb.dirty[t]:
@@ -180,6 +182,7 @@ class Evictor:
             lanes.append(self.sb.release_lane(t))
             obs_trace.stamp("evict", tenant=int(t))
             _rec.emit("tenant_evicted", tenant=int(t))
+        boundary("evict.clear")
         self.sb.clear_lanes(lanes)
         metrics.count("serve.evict.evictions", len(lanes))
         return len(lanes)
@@ -197,6 +200,7 @@ class Evictor:
 
         if self.sb.is_resident(tenant):
             return False
+        boundary("evict.pick")
         if self.sb.free_lanes == 0:
             self.evict(
                 self.select_cold(self.pressure_batch, exclude=_exclude)
@@ -350,6 +354,15 @@ _reg_ev(
     "tenant_restored", subsystem="serve.evict", fields=("tenant",),
     module=__name__,
 )
+
+from ..analysis.registry import register_shared_field as _reg_sf  # noqa: E402
+
+_reg_sf("clock", owner="Evictor", module=__name__,
+        kind="logical touch clock")
+_reg_sf("last_touch", owner="Evictor", module=__name__,
+        kind="per-tenant last-touch stamps (coldness order)")
+_reg_sf("touch_count", owner="Evictor", module=__name__,
+        kind="per-tenant touch totals (skew stats)")
 
 __all__ = [
     "Evictor", "evictor_preserves_dirt", "persist_tenant",

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry as tele
+from ..analysis.interleave import boundary
 from ..durability import crashpoints
 from ..obs import hist as obs_hist
 from ..obs import trace as obs_trace
@@ -311,11 +312,13 @@ class IngestQueue:
         self._last_wal_bytes = self.wal.bytes_appended - before
         self.last_wal_seq = seq
         crashpoints.hit(CP_POST_LOG_PRE_DISPATCH)
+        boundary("wal.group_commit")
         return seq
 
     def _issue(self, built: "_Built", *, telemetry: bool = False):
         """Stage 3: launch the coalesced dispatch without waiting for
         it (``Superblock.apply_async``)."""
+        boundary("dispatch.issue")
         slab = sb_ops.OpSlab(
             kind=jnp.asarray(built.kind), actor=jnp.asarray(built.actor),
             ctr=jnp.asarray(built.ctr), clock=jnp.asarray(built.clock),
@@ -385,6 +388,7 @@ class IngestQueue:
         slab's ops ahead of this slab's rolled ones (appendleft order:
         last pushed lands first, so per-tenant FIFO needs round N+1
         requeued before round N)."""
+        boundary("dispatch.finish")
         try:
             tel = self.sb.finish(pending)
         except BaseException as exc:
@@ -488,6 +492,25 @@ _reg_ev(
     fields=("lanes", "ops", "coalesced", "restored", "pending_after"),
     module=__name__,
 )
+
+from ..analysis.registry import register_shared_field as _reg_sf  # noqa: E402
+
+_reg_sf("pending", owner="IngestQueue", module=__name__,
+        kind="per-tenant queued-op deques")
+_reg_sf("n_pending", owner="IngestQueue", module=__name__,
+        kind="total queued-op count (backpressure gauge)")
+_reg_sf("last_wal_seq", owner="IngestQueue", module=__name__,
+        kind="seq of the newest group-committed slab")
+_reg_sf("_last_wal_bytes", owner="IngestQueue", module=__name__,
+        kind="bytes of the newest WAL record (telemetry)")
+_reg_sf("_widens_before", owner="IngestQueue", module=__name__,
+        kind="widen-event watermark captured at issue time")
+_reg_sf("total_ops", owner="IngestQueue", module=__name__,
+        kind="lifetime applied-op counter")
+_reg_sf("total_coalesced", owner="IngestQueue", module=__name__,
+        kind="lifetime coalesced-op counter")
+_reg_sf("hist_batch", owner="IngestQueue", module=__name__,
+        kind="ops-per-slab log2 histogram")
 
 __all__ = [
     "AddOp", "FlushReport", "IngestBackpressure", "IngestQueue", "RmOp",

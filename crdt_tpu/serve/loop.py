@@ -34,6 +34,18 @@ discipline applied to serving:
   drain only persists; lanes are only ever freed by the evictor's own
   ordered path. Each row persist is timed into ``hist_persist_us``
   and crossed by the ``serve.persist.background_drain`` crashpoint.
+
+The prose invariants above are DECLARED, not just narrated:
+``analysis.concur.HB_CONTRACTS`` carries them as checkable
+happens-before edges — ``wal_commit_precedes_dispatch`` (the
+group-commit ≺ scatter order), ``persist_in_settled_window``
+(finish(N) ≺ drain ≺ issue(N+1)), and ``requeue_preserves_durable_seq``
+(the failure-ordering rollback keeps the first WAL seq). The
+``concurrency`` static-check section proves each edge on every chain
+invocation, and ``analysis.interleave.serve_world`` replays this loop
+against the background persister and a pressure admission under every
+≤2-preemption schedule, bit-identical to the serial oracle
+(tests/test_concur.py).
 """
 
 from __future__ import annotations
@@ -45,6 +57,7 @@ from typing import Optional
 import jax.numpy as jnp
 
 from .. import telemetry as tele
+from ..analysis.interleave import boundary
 from ..durability import crashpoints
 from ..obs import hist as obs_hist
 from ..utils.metrics import metrics
@@ -196,6 +209,7 @@ class ServeLoop:
         # finish(N) and issue(N+1): no dispatch is in flight, so a
         # row read here can neither block on an unfinished scatter
         # nor capture an overflowed value a rollback would retract.
+        boundary("persist.window")
         if self.persister is not None:
             if self.persist_ahead:
                 self.persister.enqueue_cold(
@@ -288,5 +302,28 @@ class ServeLoop:
             )
         return tel
 
+
+from ..analysis.registry import register_shared_field as _reg_sf  # noqa: E402
+
+_reg_sf("_queue", owner="BackgroundPersister", module=__name__,
+        kind="cold-tenant persist queue (deque)")
+_reg_sf("_queued", owner="BackgroundPersister", module=__name__,
+        kind="membership set mirroring the persist queue")
+_reg_sf("persisted", owner="BackgroundPersister", module=__name__,
+        kind="lifetime background-persist counter")
+_reg_sf("hist", owner="BackgroundPersister", module=__name__,
+        kind="persist-latency log2 histogram")
+_reg_sf("inflight", owner="ServeLoop", module=__name__,
+        kind="in-flight slab ring (depth 1)")
+_reg_sf("steps", owner="ServeLoop", module=__name__,
+        kind="pipeline step counter")
+_reg_sf("overlap_hits", owner="ServeLoop", module=__name__,
+        kind="assemble-overlapped-with-flight counter")
+_reg_sf("rebalance_moves", owner="ServeLoop", module=__name__,
+        kind="lifetime shard-rebalance move counter")
+_reg_sf("_annotated_overlap", owner="ServeLoop", module=__name__,
+        kind="telemetry watermark for overlap_hits")
+_reg_sf("_annotated_moves", owner="ServeLoop", module=__name__,
+        kind="telemetry watermark for rebalance_moves")
 
 __all__ = ["BackgroundPersister", "ServeLoop"]

@@ -502,6 +502,30 @@ class Superblock:
         )
 
 
+from ..analysis.registry import register_shared_field as _reg_sf  # noqa: E402
+
+# Shared-state coverage contract for the ``concurrency`` static-check
+# section (analysis/effects.py): every field mutated outside __init__
+# registers here, or discovery fails.
+_reg_sf("state", owner="Superblock", module=__name__,
+        kind="packed per-lane device state")
+_reg_sf("caps", owner="Superblock", module=__name__,
+        kind="per-kind capacity caps (widen/narrow)")
+_reg_sf("lane_of", owner="Superblock", module=__name__,
+        kind="tenant→lane indirection table")
+_reg_sf("tenant_of", owner="Superblock", module=__name__,
+        kind="lane→tenant back-pointer table")
+_reg_sf("_free", owner="Superblock", module=__name__,
+        kind="free-lane pool (deque)")
+_reg_sf("dirty", owner="Superblock", module=__name__,
+        kind="per-tenant dirty-since-persist flags")
+_reg_sf("was_evicted", owner="Superblock", module=__name__,
+        kind="per-tenant evicted-at-least-once flags")
+_reg_sf("widen_events", owner="Superblock", module=__name__,
+        kind="capacity-widen event counter")
+_reg_sf("last_pressure", owner="Superblock", module=__name__,
+        kind="smoothed lane-pressure telemetry")
+
 __all__ = [
     "CapacityOverflow", "LanePressure", "PendingApply", "Superblock",
 ]

@@ -34,8 +34,9 @@ join-irreducible op lanes, never rows):
   tests/test_serve.py kills at each and asserts recovery bit-identical
   with zero acked-op loss.
 
-:func:`wal_precedes_dispatch` is the ordering detector behind the
-``pipeline`` static-check section: an AST scan proving no dispatch
+:func:`wal_precedes_dispatch` is the first migrated happens-before
+contract of the ``concurrency`` static-check section
+(``analysis.concur.HB_CONTRACTS``): an AST scan proving no dispatch
 site precedes its WAL append/mark_round (the
 ``analysis.fixtures.serve_dispatch_before_wal`` broken twin must FAIL
 it).
@@ -43,9 +44,6 @@ it).
 
 from __future__ import annotations
 
-import ast
-import inspect
-import textwrap
 from typing import NamedTuple, Optional
 
 import numpy as np
@@ -257,54 +255,30 @@ _DISPATCH_CALLS = frozenset({
 })
 
 
-def _call_name(node: ast.Call) -> str:
-    f = node.func
-    if isinstance(f, ast.Name):
-        return f.id
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    return ""
-
-
 def wal_order_violations(obj) -> list:
     """AST-scan ``obj`` (a function, class, or module) for functions
     that both WAL-log a slab and dispatch it, and return a violation
     string per function whose FIRST dispatch site precedes its FIRST
     WAL call — the ordering that would ack ops the log never saw.
-    Empty list = every logging dispatcher logs first."""
-    try:
-        src = textwrap.dedent(inspect.getsource(obj))
-        tree = ast.parse(src)
-    except (OSError, TypeError, SyntaxError) as exc:
-        return [f"{getattr(obj, '__name__', obj)}: unscannable ({exc})"]
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        wal_lines = []
-        dispatch_lines = []
-        for sub in ast.walk(node):
-            if isinstance(sub, ast.Call):
-                name = _call_name(sub)
-                if name in _WAL_CALLS:
-                    wal_lines.append(sub.lineno)
-                elif name in _DISPATCH_CALLS:
-                    dispatch_lines.append(sub.lineno)
-        if wal_lines and dispatch_lines and (
-            min(dispatch_lines) < min(wal_lines)
-        ):
-            out.append(
-                f"{node.name}: dispatch at line {min(dispatch_lines)} "
-                f"precedes its WAL append at line {min(wal_lines)} — "
-                f"an op could be acked that the log never saw"
-            )
-    return out
+    Empty list = every logging dispatcher logs first. The walk itself
+    lives in ``analysis.concur.call_order_violations`` (this detector
+    is the first migrated ``HB_CONTRACTS`` entry,
+    ``wal_precedes_dispatch`` — checked by the ``concurrency``
+    static-check section, not the ``pipeline`` one)."""
+    from ..analysis.concur import call_order_violations
+
+    return [
+        f"{v} — an op could be acked that the log never saw"
+        for v in call_order_violations(obj, _WAL_CALLS, _DISPATCH_CALLS)
+    ]
 
 
 def wal_precedes_dispatch(obj) -> bool:
-    """True iff ``obj`` contains no WAL-ordering violation — the
-    ``pipeline`` static-check gate (the honest ingest flush must pass;
-    ``analysis.fixtures.serve_dispatch_before_wal`` must fail)."""
+    """True iff ``obj`` contains no WAL-ordering violation (the honest
+    ingest flush must pass;
+    ``analysis.fixtures.serve_dispatch_before_wal`` must fail) —
+    pinned by the ``concurrency`` static-check section's
+    ``wal_precedes_dispatch`` HB contract."""
     return not wal_order_violations(obj)
 
 
@@ -315,6 +289,11 @@ _reg_ev(
     fields=("seq", "lanes", "ops", "bytes"),
     module=__name__,
 )
+
+from ..analysis.registry import register_shared_field as _reg_sf  # noqa: E402
+
+_reg_sf("wal", owner="ServeWal", module=__name__,
+        kind="underlying segment writer (durable seq + group commit)")
 
 __all__ = [
     "ReplayReport", "ServeWal", "recover_serve", "replay_into",

@@ -275,6 +275,15 @@ SLOW_NODEIDS = (
     # chain invocation
     "test_serve.py::test_mid_evict_crash_recovers_last_durable_record[snapshot.pre_rename-False]",
     "test_serve.py::test_mid_evict_crash_recovers_last_durable_record[snapshot.post_commit_pre_prune-True]",
+    # ---- eighth curation round (ISSUE 19: the interleaving explorer).
+    # Same contract: every promotion names its faster in-tier cousin.
+    # full 2-preemption serve matrix, 2 tenants × 3 ops, both kinds
+    # (also @mark.slow in-file): the 1-preemption closures in
+    # test_concur.py stay tier-1 and the `concurrency` static-check
+    # section explores the dense serve world + the full fanout world
+    # on every chain invocation
+    "test_concur.py::test_explorer_serve_full_matrix[orswot]",
+    "test_concur.py::test_explorer_serve_full_matrix[sparse_orswot]",
 )
 
 

@@ -1,0 +1,337 @@
+"""Host-concurrency analysis plane (ISSUE 19): effect-inference
+coverage, the declared happens-before contracts, and the deterministic
+interleaving explorer.
+
+What is pinned here, and why it is sufficient:
+
+- the registration-is-the-coverage-contract gate is TOTAL — every
+  shared-state mutation on the host serving surface is declared via
+  ``register_shared_field``, and a planted unregistered mutator fails
+  discovery (the coverage twin);
+- every ``HB_CONTRACTS`` edge holds on the honest code AND each
+  committed broken twin fires its detector — a contract whose detector
+  cannot fail is prose, not a check;
+- the interleaving explorer is bit-identical to the serial oracle on
+  EVERY bounded-preemption schedule of the serve (dense and sparse)
+  and fanout worlds, deterministic across runs, and reproduces the
+  committed PR 16 lane-eviction race within 2 preemptions with a
+  shrunk (preemption-minimal) counterexample schedule.
+
+The heaviest serve matrix (2 tenants × 3 ops per kind, full
+2-preemption closure) is @mark.slow; its in-tier cousins are the
+1-preemption closures below plus the ``concurrency`` static-check
+section, which explores the dense serve world and the full fanout
+world on every chain invocation.
+"""
+
+import pytest
+
+from crdt_tpu.analysis import concur, effects, fixtures
+from crdt_tpu.analysis import interleave as il
+from crdt_tpu.analysis import registry
+
+
+# ---- effect inference + the coverage contract -----------------------------
+
+def test_shared_field_coverage_is_total():
+    """Every mutated shared field on the host surface is registered —
+    the discovery gate that makes the conflict checker's universe
+    complete."""
+    assert effects.unregistered_shared_mutations() == []
+    # The registry actually covers the serving surface (spot checks on
+    # fields the contracts depend on).
+    names = {(f.owner, f.name) for f in registry.shared_fields()}
+    for key in [
+        ("Superblock", "lane_of"), ("Superblock", "dirty"),
+        ("IngestQueue", "pending"), ("BackgroundPersister", "_queue"),
+        ("FanoutPlane", "sub_ver"), ("Evictor", "last_touch"),
+        ("Tracer", "_open"),
+    ]:
+        assert key in names, f"{key} missing from the shared-field registry"
+
+
+def test_unregistered_mutator_fails_discovery():
+    """The planted twin: a self-attribute mutated outside __init__
+    with no registration must be named, with its mutating site."""
+    out = effects.unregistered_shared_mutations(
+        extra=(fixtures.RogueCounterMutator,)
+    )
+    assert len(out) == 1
+    field, site = out[0]
+    assert field == "RogueCounterMutator.rogue_counter"
+    assert "fixtures.py" in site
+
+
+def test_effect_rows_classify_reads_and_writes():
+    rows = effects.infer_effects()
+    by = {(e.owner, e.method, e.field, e.mode) for e in rows}
+    # The WAL commit writes the seq ledger; the ack promoter writes the
+    # watermark; the persister drain reads its queue.
+    assert ("IngestQueue", "_log", "last_wal_seq", "write") in by
+    assert ("FanoutPlane", "ack", "sub_ver", "write") in by
+    assert any(
+        o == "BackgroundPersister" and f == "_queue" and m == "read"
+        for (o, _, f, m) in by
+    )
+
+
+def test_tracer_fields_are_lock_guarded():
+    """The Tracer is the one multi-thread-touched surface guarded by a
+    lock, not a contract — the registry must say so."""
+    f = registry.get_shared_field("Tracer", "_open")
+    assert f.guard == "lock:_lock"
+
+
+# ---- happens-before contracts ---------------------------------------------
+
+def test_hb_contracts_hold_on_honest_code():
+    assert concur.check_hb_contracts() == []
+
+
+def test_hb_contract_table_is_declared():
+    """The table IS the spec: seven named contracts, prose rule plus
+    executable check, WAL≺dispatch migrated in as the first entry."""
+    names = [c.name for c in concur.HB_CONTRACTS]
+    assert names[0] == "wal_commit_precedes_dispatch"
+    assert set(names) == {
+        "wal_commit_precedes_dispatch", "persist_in_settled_window",
+        "persist_precedes_clear", "pin_precedes_gather_dispatch",
+        "ack_clamped_to_window", "requeue_preserves_durable_seq",
+        "touch_precedes_pressure_pick",
+    }
+    for c in concur.HB_CONTRACTS:
+        assert c.rule and c.kind in ("order", "guard", "probe")
+        assert c.fields, f"{c.name} declares no shared fields"
+
+
+def test_wal_order_twins_fire():
+    """Both committed dispatch-before-WAL twins fail the migrated
+    detector; the honest pipeline passes it."""
+    from crdt_tpu.serve.ingest import IngestQueue
+    from crdt_tpu.serve.loop import ServeLoop
+    from crdt_tpu.serve.wal import wal_precedes_dispatch
+
+    assert wal_precedes_dispatch(IngestQueue)
+    assert wal_precedes_dispatch(ServeLoop)
+    assert not wal_precedes_dispatch(fixtures.serve_dispatch_before_wal)
+    assert concur.call_order_violations(
+        fixtures.UnorderedWalLoop, ("_log",), ("_issue",)
+    )
+
+
+def test_persist_frees_lanes_twin_reports_both_sites():
+    """An off-thread lane-table write with no ordering contract is an
+    uncovered conflict, reported with BOTH code sites and the field."""
+    out = concur.uncovered_conflicts(
+        extra=(fixtures.PersistFreesLanes,),
+        extra_threads={"PersistFreesLanes": ("persist",)},
+    )
+    assert out, "the persist-thread lane write passed the conflict gate"
+    lane = [v for v in out if "'lane_of'" in v]
+    assert lane, f"lane_of conflict not reported: {out}"
+    assert "PersistFreesLanes.drain" in lane[0]
+    assert "Superblock" in lane[0]
+    assert "fixtures.py" in lane[0] and "superblock.py" in lane[0]
+
+
+def test_honest_code_has_no_uncovered_conflicts():
+    assert concur.uncovered_conflicts() == []
+
+
+def test_ack_clamp_probe_and_twin():
+    from crdt_tpu.fanout.plane import FanoutPlane
+
+    assert concur.ack_window_probe(FanoutPlane) == []
+    out = concur.ack_window_probe(fixtures.regressing_ack_promoter_cls())
+    assert any("regressed" in v for v in out)
+    assert any("clamp" in v for v in out)
+
+
+def test_requeue_seq_probe_and_twin():
+    from crdt_tpu.obs.trace import Tracer
+
+    assert concur.requeue_seq_probe(Tracer) == []
+
+    class _ReMintingTracer(Tracer):
+        def requeue(self, tenants, seq=None):
+            return super().requeue(tenants, seq=None)  # drops the seq
+
+    out = concur.requeue_seq_probe(_ReMintingTracer)
+    assert any("wal_seq" in v for v in out)
+
+
+# ---- host lints -----------------------------------------------------------
+
+def test_retry_timeout_never_reaches_a_collective():
+    assert concur.retry_timeout_collective_violations() == []
+
+
+class _TimedCollectiveTwin:
+    """Broken lint twin: a per-attempt timeout around an exchange that
+    reaches a multihost collective (never executed — the retry lint
+    AST-scans it)."""
+
+    def once(self):
+        return self._allgather_host([1])
+
+    def exchange(self):
+        from crdt_tpu.faults.retry import RetryPolicy, with_retries
+
+        return with_retries(self.once, RetryPolicy(timeout=1.0))
+
+
+def test_retry_timeout_lint_fires_on_twin():
+    out = concur.retry_timeout_collective_violations(
+        objs=(_TimedCollectiveTwin,)
+    )
+    assert out and "desynchronize" in out[0]
+    assert "'once'" in out[0]
+
+
+def test_every_thread_is_daemon_named_and_declared():
+    assert concur.thread_lint_violations() == []
+
+
+def test_thread_lint_fires_on_undeclared_thread():
+    twin = (
+        "import threading\n"
+        "t = threading.Thread(target=print)\n"
+        "t.start()\n"
+    )
+    out = concur.thread_lint_violations(
+        extra_sources=((twin, "rogue/mod.py"),)
+    )
+    assert any("daemon" in v for v in out)
+    assert any("without a name" in v for v in out)
+    assert any("never registered" in v for v in out)
+
+
+# ---- the interleaving explorer --------------------------------------------
+
+@pytest.mark.parametrize("kind", ["orswot", "sparse_orswot"])
+def test_explorer_serve_bit_identity_one_preemption(kind):
+    """Every 1-preemption schedule of the serve world (WAL'd pipelined
+    drain × background persist × pressure admission) ends bit-identical
+    to the serial oracle, with all invariants holding. The full
+    2-preemption closure is the @mark.slow matrix below; the
+    ``concurrency`` static-check section re-runs the dense closure on
+    every chain invocation."""
+    r = il.explore(lambda: il.serve_world(kind), preemptions=1)
+    assert r.ok, r.counterexample
+    assert r.events > 0
+    assert r.schedules == 1 + r.events * 2  # serial + E events × 2 offsets
+
+
+def test_explorer_fanout_bit_identity_one_preemption():
+    r = il.explore(il.fanout_world, preemptions=1)
+    assert r.ok, r.counterexample
+    assert r.events > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ["orswot", "sparse_orswot"])
+def test_explorer_serve_full_matrix(kind):
+    """The heaviest committed matrix: 2 serving tenants × 3 ops each,
+    full 2-preemption closure, both kinds. In-tier cousins: the
+    1-preemption closures above and the ``concurrency`` static-check
+    section's dense serve + full fanout explorations."""
+    r = il.explore(
+        lambda: il.serve_world(kind, ops_per_tenant=3, serve_tenants=2),
+        preemptions=2,
+    )
+    assert r.ok, r.counterexample
+    assert r.schedules > 100  # genuinely exhaustive, not a smoke walk
+
+
+def test_racy_fixture_reproduces_in_two_preemptions():
+    """The rebuilt PR 16 lane-eviction race: a mid-push eviction makes
+    the pre-fix plane gather another tenant's row as the shipped δ
+    base. The explorer must find it within the 2-preemption bound and
+    shrink the schedule to the minimal switch count."""
+    r = il.explore(fixtures.racy_fanout_world, preemptions=2)
+    assert not r.ok, "the lane-eviction race twin passed every schedule"
+    cx = r.counterexample
+    assert 1 <= len(cx.schedule) <= 2
+    assert any("diverged" in reason for reason in cx.reasons)
+    # The shrunk schedule pins the race window: the switch happens at
+    # the very first boundary of the push cycle (post-warm).
+    assert cx.schedule[0][0] == 0
+
+
+def test_explorer_deterministic_across_runs():
+    """Same world, same bound → the same counterexample, event count,
+    and schedule census, twice. No wall clock, no randomness: the
+    schedule IS the reproduction recipe."""
+    r1 = il.explore(fixtures.racy_fanout_world, preemptions=2)
+    r2 = il.explore(fixtures.racy_fanout_world, preemptions=2)
+    assert r1.schedules == r2.schedules
+    assert r1.counterexample.schedule == r2.counterexample.schedule
+    assert r1.counterexample.trace == r2.counterexample.trace
+    assert r1.counterexample.reasons == r2.counterexample.reasons
+
+
+def test_boundary_is_inert_outside_a_run():
+    assert il.boundary("not.a.real.label") is None
+
+
+# ---- telemetry + flight-recorder wiring -----------------------------------
+
+def test_schedules_explored_counter():
+    from crdt_tpu.utils.metrics import metrics
+
+    before = metrics.snapshot()["counters"].get(
+        "analysis.concur.schedules_explored", 0
+    )
+    r = il.explore(il.fanout_world, preemptions=1)
+    assert r.ok
+    after = metrics.snapshot()["counters"][
+        "analysis.concur.schedules_explored"
+    ]
+    assert after >= before + r.schedules
+
+
+def test_counterexample_event_registered_and_dumped(tmp_path):
+    """Explorer failures are postmortem artifacts: the
+    ``concur_counterexample`` event type is registered at the emit
+    site, lands in the flight recorder, and auto-dumps."""
+    ev = registry.get_obs_event("concur_counterexample")
+    assert ev.subsystem == "analysis.concur"
+    assert set(ev.fields) >= {"world", "schedule", "reasons"}
+
+    from crdt_tpu import obs
+
+    rec = obs.FlightRecorder()
+    prev = obs.install(rec)
+    obs.configure_auto_dump(str(tmp_path))
+    try:
+        r = il.explore(fixtures.racy_fanout_world, preemptions=2)
+        assert not r.ok
+        found = [
+            e for e in rec.events()
+            if e["type"] == "concur_counterexample"
+        ]
+        assert found, "no concur_counterexample event recorded"
+        assert found[-1]["world"] == "fanout/orswot"
+        assert found[-1]["schedule"] == [list(p) for p in
+                                         r.counterexample.schedule]
+        dumps = list(tmp_path.iterdir())
+        assert dumps, "a counterexample did not auto-dump"
+    finally:
+        obs.configure_auto_dump(None)
+        obs.install(prev)
+
+
+def test_metrics_hb_violation_counter():
+    """The conflict checker reports through metrics: the honest
+    surface adds zero, the twin invocation adds its violation count."""
+    from crdt_tpu.utils.metrics import metrics
+
+    concur.uncovered_conflicts()
+    base = metrics.snapshot()["counters"].get("concur.hb_violations", 0)
+    n = len(concur.uncovered_conflicts(
+        extra=(fixtures.PersistFreesLanes,),
+        extra_threads={"PersistFreesLanes": ("persist",)},
+    ))
+    assert n > 0
+    after = metrics.snapshot()["counters"]["concur.hb_violations"]
+    assert after == base + n

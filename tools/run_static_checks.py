@@ -97,14 +97,26 @@ Sections (each timed, each independently skippable):
   (``analysis.fixtures.fanout_skips_watermark_bucket``) must fail the
   cohort coverage detector.
 - ``pipeline`` — the pipelined-serving-loop gates (ISSUE 18): the
-  WAL-before-dispatch ordering scan
-  (``crdt_tpu.serve.wal.wal_precedes_dispatch`` — an AST walk proving
-  every function mixing WAL and dispatch calls logs FIRST) over the
-  honest ``IngestQueue``/``ServeLoop``, its committed broken twin
-  (``analysis.fixtures.serve_dispatch_before_wal``) proven to fire,
-  and the skew-aware rebalance minimal-move property (balanced fleet
-  → zero moves; every move sheds from an over-threshold host and
-  strictly shrinks the gap) on a synthetic zipf load.
+  skew-aware rebalance minimal-move property (balanced fleet → zero
+  moves; every move sheds from an over-threshold host and strictly
+  shrinks the gap) on a synthetic zipf load.
+- ``concurrency`` — the host-concurrency analysis plane (ISSUE 19):
+  effect inference over the serving surface with TOTAL shared-field
+  coverage (crdt_tpu.analysis.effects — a mutated-but-unregistered
+  field fails discovery), the declared happens-before contracts
+  (crdt_tpu.analysis.concur.HB_CONTRACTS — WAL≺dispatch, now migrated
+  here from ``pipeline``; the settled persist window; persist≺clear;
+  pin≺gather…dispatch; the ack clamp; requeue seq preservation;
+  touch≺pick), the cross-thread conflict gate (every conflicting
+  effect pair on a shared field ordered by a contract or lock guard),
+  the retry-timeout-reaches-collective and thread-discipline lints,
+  and the deterministic interleaving explorer
+  (crdt_tpu.analysis.interleave — every ≤2-preemption schedule of the
+  serve and fanout worlds bit-identical to the serial oracle). Five
+  committed broken twins (``UnorderedWalLoop``, ``PersistFreesLanes``,
+  ``regressing_ack_promoter_cls``, ``RogueCounterMutator``,
+  ``racy_fanout_world`` — the rebuilt PR 16 lane-eviction race) are
+  each proven to fire.
 - ``jit-lint``  — the jaxpr walker (crdt_tpu.analysis.jit_lint) over
   every registered mesh entry point: traced-branch, unstable-sort,
   float-accum, dtype-overflow, donation-alias, PLUS the collective-
@@ -165,7 +177,7 @@ sys.path.insert(0, ROOT)
 SECTIONS = (
     "lint", "schema", "laws", "schedules", "faults", "decomp",
     "durability", "scaleout", "obs", "wire", "serve", "fanout",
-    "pipeline", "jit-lint", "cost", "slo", "aliasing",
+    "pipeline", "concurrency", "jit-lint", "cost", "slo", "aliasing",
 )
 
 # Directories the fallback linter walks (ruff takes its own config).
@@ -356,42 +368,21 @@ def run_fanout():
 
 
 def run_pipeline():
-    """The pipelined-serving-loop section (ISSUE 18): the
-    WAL-before-dispatch ordering gate (the AST scan
-    ``serve.wal.wal_precedes_dispatch`` must pass the honest
-    ``IngestQueue``/``ServeLoop`` and FAIL the committed broken twin
-    ``analysis.fixtures.serve_dispatch_before_wal``) plus the
-    skew-aware rebalance minimal-move property on a synthetic zipf
-    load (balanced fleet plans zero moves; every planned move sheds
-    from an over-threshold host and strictly shrinks the src/dst gap).
+    """The pipelined-serving-loop section (ISSUE 18): the skew-aware
+    rebalance minimal-move property on a synthetic zipf load (balanced
+    fleet plans zero moves; every planned move sheds from an
+    over-threshold host and strictly shrinks the src/dst gap). The
+    WAL-before-dispatch ordering gate that used to live here is now
+    the first ``HB_CONTRACTS`` entry of the ``concurrency`` section.
     """
-    from crdt_tpu.analysis import fixtures
     from crdt_tpu.analysis.report import Finding
     from crdt_tpu.serve import (
-        IngestQueue, ServeLoop, TenantShardMap, host_loads,
-        rebalance_plan, wal_precedes_dispatch,
+        TenantShardMap, host_loads, rebalance_plan,
     )
 
     findings = []
 
-    # 1. WAL-before-dispatch ordering: honest code passes the scan...
-    for obj in (IngestQueue, ServeLoop):
-        if not wal_precedes_dispatch(obj):
-            findings.append(Finding(
-                "pipeline-wal-order", obj.__name__,
-                "a dispatch call precedes the slab's WAL append — an "
-                "acked op can be lost in the scatter→fsync window",
-            ))
-    # ...and the committed broken twin must fire it.
-    if wal_precedes_dispatch(fixtures.serve_dispatch_before_wal):
-        findings.append(Finding(
-            "broken-fixture-missed", "serve_dispatch_before_wal",
-            "the dispatch-before-WAL broken twin PASSED the ordering "
-            "scan — the pipeline durability gate is not actually "
-            "firing",
-        ))
-
-    # 2. Rebalance minimal-move property on a synthetic zipf load:
+    # Rebalance minimal-move property on a synthetic zipf load:
     # 64 tenants, zipf-ish weights, rendezvous placement over 4 hosts.
     sm = TenantShardMap(4)
     tenants = list(range(64))
@@ -427,6 +418,113 @@ def run_pipeline():
                 "a balanced fleet planned moves — the planner churns "
                 "placements it cannot improve",
             ))
+    return findings
+
+
+def run_concurrency():
+    """The host-concurrency section (ISSUE 19 tentpole): effect
+    inference over the serving surface with total shared-field
+    coverage (a mutated-but-unregistered field fails discovery), the
+    ``analysis.concur.HB_CONTRACTS`` checker (every declared
+    happens-before edge proven executable — WAL≺dispatch, the settled
+    persist window, persist≺clear, pin≺gather…dispatch, the ack
+    clamp, requeue seq preservation, touch≺pick), the cross-thread
+    conflict gate (every conflicting effect pair on a shared field
+    ordered by a contract or lock guard), the retry-timeout and
+    thread-discipline lints, and the deterministic interleaving
+    explorer: bit-identity to the serial oracle on every
+    ≤2-preemption schedule of the serve and fanout worlds. Each
+    committed broken twin must fire its detector; the rebuilt PR 16
+    lane-eviction race must yield a counterexample."""
+    from crdt_tpu.analysis import concur, effects, fixtures, interleave
+    from crdt_tpu.analysis.report import Finding
+
+    findings = []
+
+    # 1. Coverage: every shared-state mutation on the host surface is
+    # registered...
+    for field, site in effects.unregistered_shared_mutations():
+        findings.append(Finding(
+            "concurrency-coverage", field,
+            f"shared-state mutation at {site} has no "
+            "register_shared_field declaration — its cross-thread "
+            "conflicts are invisible to the HB checker",
+        ))
+    # ...and the unregistered-mutator twin must fail discovery.
+    if not effects.unregistered_shared_mutations(
+        extra=(fixtures.RogueCounterMutator,)
+    ):
+        findings.append(Finding(
+            "broken-fixture-missed", "RogueCounterMutator",
+            "an unregistered shared-field mutation PASSED discovery — "
+            "the coverage contract is not actually total",
+        ))
+
+    # 2. Declared happens-before contracts, each an executable proof.
+    for cname, viol in concur.check_hb_contracts():
+        findings.append(Finding("concurrency-hb", cname, viol))
+    # Broken twins per contract family: ordering, ack clamp.
+    if not concur.call_order_violations(
+        fixtures.UnorderedWalLoop, ("_log",), ("_issue",)
+    ):
+        findings.append(Finding(
+            "broken-fixture-missed", "UnorderedWalLoop",
+            "the dispatch-before-WAL loop twin PASSED the generalized "
+            "call-order scan",
+        ))
+    if not concur.ack_window_probe(fixtures.regressing_ack_promoter_cls()):
+        findings.append(Finding(
+            "broken-fixture-missed", "regressing_ack_promoter",
+            "an unclamped ack promotion PASSED the ack-window probe",
+        ))
+
+    # 3. Conflict gate: every cross-thread conflicting effect pair on
+    # a shared field is ordered...
+    for viol in concur.uncovered_conflicts():
+        findings.append(Finding("concurrency-conflict", "effects", viol))
+    # ...and the off-thread lane-freeing twin must be reported.
+    if not concur.uncovered_conflicts(
+        extra=(fixtures.PersistFreesLanes,),
+        extra_threads={"PersistFreesLanes": ("persist",)},
+    ):
+        findings.append(Finding(
+            "broken-fixture-missed", "PersistFreesLanes",
+            "a persist-thread lane-table write with no ordering "
+            "contract PASSED the conflict gate",
+        ))
+
+    # 4. Host lints: no timed retry may reach a collective; every
+    # thread is daemon, named, and a registered effect source.
+    for viol in concur.retry_timeout_collective_violations():
+        findings.append(Finding("concurrency-retry", "retry", viol))
+    for viol in concur.thread_lint_violations():
+        findings.append(Finding("concurrency-thread", "threads", viol))
+
+    # 5. The interleaving explorer: serve world (dense; the sparse
+    # kind and the heavier matrices run in tests/test_concur.py) and
+    # fanout world, all ≤2-preemption schedules bit-identical to the
+    # serial oracle.
+    for mk, preempt in (
+        (lambda: interleave.serve_world("orswot"), 1),
+        (interleave.fanout_world, 2),
+    ):
+        r = interleave.explore(mk, preemptions=preempt)
+        if not r.ok:
+            cx = r.counterexample
+            findings.append(Finding(
+                "concurrency-interleave", r.world,
+                f"schedule {list(cx.schedule)} diverged: "
+                + "; ".join(cx.reasons[:2]),
+            ))
+    # The rebuilt PR 16 lane-eviction race must produce a
+    # counterexample within 2 preemptions.
+    r = interleave.explore(fixtures.racy_fanout_world, preemptions=2)
+    if r.ok:
+        findings.append(Finding(
+            "broken-fixture-missed", "racy_fanout_world",
+            "the lane-eviction-race twin PASSED every explored "
+            "schedule — the explorer is not catching the PR 16 race",
+        ))
     return findings
 
 
@@ -516,6 +614,7 @@ RUNNERS = {
     "serve": run_serve,
     "fanout": run_fanout,
     "pipeline": run_pipeline,
+    "concurrency": run_concurrency,
     "jit-lint": run_jit_lint,
     "cost": run_cost,
     "slo": run_slo,
@@ -524,8 +623,8 @@ RUNNERS = {
 
 _JAX_SECTIONS = (
     "laws", "schedules", "faults", "decomp", "durability", "scaleout",
-    "obs", "wire", "serve", "fanout", "pipeline", "jit-lint", "cost",
-    "slo", "aliasing",
+    "obs", "wire", "serve", "fanout", "pipeline", "concurrency",
+    "jit-lint", "cost", "slo", "aliasing",
 )
 
 

@@ -83,11 +83,46 @@ def npasses_streaming_ab() -> bool:
     return True
 
 
+def static_summary_covers_concurrency() -> bool:
+    """The chip run rides on the host-concurrency gates having run:
+    the ``concurrency`` section must be wired into the static-check
+    chain, and any committed/CI summary JSON (``static_checks.json``,
+    or ``$STATIC_CHECKS_SUMMARY``) must contain its entry — a summary
+    that predates the section means the serving runtime on this chip
+    was never interleaving-checked."""
+    import json
+
+    import run_static_checks as rsc
+
+    if "concurrency" not in rsc.SECTIONS or "concurrency" not in rsc.RUNNERS:
+        print("FAIL: 'concurrency' section missing from the static-check "
+              "chain (tools/run_static_checks.py)")
+        return False
+    path = os.environ.get(
+        "STATIC_CHECKS_SUMMARY", os.path.join(ROOT, "static_checks.json")
+    )
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if "concurrency" not in doc.get("sections", {}):
+            print(f"FAIL: static-check summary {path} has no "
+                  "'concurrency' section — rerun "
+                  "tools/run_static_checks.py --json-out before the "
+                  "chip checks")
+            return False
+    return True
+
+
 def main() -> int:
     # bench.py reads the BENCH_* env into module globals at import time,
     # so the scaled sanity shape must be set BEFORE the import.
     os.environ.setdefault("BENCH_REPLICAS", "2048")
     os.environ.setdefault("BENCH_ELEMS", "16384")
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    if not static_summary_covers_concurrency():
+        return 1
+
     import bench
 
     if not bench.tpu_reachable():
