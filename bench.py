@@ -2529,6 +2529,395 @@ def bench_fanout():
     }]
 
 
+def bench_geo():
+    """Geo-federation leg (``--geo`` runs it alone; ISSUE 20's
+    acceptance gate): a mesh-of-meshes — each region one full serving
+    stack (superblock + evictor + WAL-attached ingest queue + fan-out
+    interest) federated by rendezvous tenant homing —
+
+    1. **federated traffic window** — per-cycle adds submitted from
+       round-robin ORIGIN regions, routed to each tenant's home queue
+       (the ack stays the home region's ServeWal group commit), then
+       one full cross-region anti-entropy sweep: join-irreducible δ
+       lanes over checksum-guarded, retry-wrapped links, mirrors fed
+       only where a region holds local interest (partial replication).
+    2. **region kill MID-TRAFFIC** — at ``kill_cycle`` the region dies
+       with the cycle's ops still pending in its queue (unacked — they
+       are legitimately lost); its home shards re-home onto the
+       survivors from the durable tier (snapshot rows + WAL-suffix
+       replay) plus peer divergence lanes, generation bumped, every
+       touching ack window reset to ⊥.
+    3. **gates, asserted here** — every checked tenant's home row
+       bit-identical to the per-tenant SEQUENTIAL oracle over exactly
+       its acked ops (zero acked-op loss, the re-homed cohort checked
+       first); every surviving interest mirror bit-identical to its
+       home row; cross-region wire bytes ≤ 25% of full-state
+       mirroring; per-region resident lanes bounded by the
+       home-written ∪ local-interest set — and the federation's total
+       residency strictly below written-tenants × regions (partial
+       replication proven, not asserted).
+
+    Causal-watermark reads ride the window (stale reads are LABELED —
+    the certificate soundness itself is the ``federation`` static-check
+    section's gate); the ``watermark_lag_p99`` on the record comes from
+    the same histogram the exporter's ``federation`` block surfaces.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from crdt_tpu import telemetry as tele
+    from crdt_tpu.fanout import FanoutPlane
+    from crdt_tpu.geo import (
+        Federation,
+        RegionPlane,
+        exchange_all,
+        fail_over_region,
+        read_local,
+    )
+    from crdt_tpu.obs import hist as obs_hist
+    from crdt_tpu.ops import superblock as sb_ops
+    from crdt_tpu.parallel import make_mesh
+    from crdt_tpu.serve import Evictor, IngestQueue, Superblock
+    from crdt_tpu.serve.wal import ServeWal
+
+    cfg = bench_configs()["geo"]
+
+    def knob(key, env):
+        return int(os.environ.get(env, cfg[key]))
+
+    regions = knob("regions", "BENCH_GEO_REGIONS")
+    tenants = knob("tenants", "BENCH_GEO_TENANTS")
+    lanes = knob("lanes", "BENCH_GEO_LANES")
+    cycles = knob("cycles", "BENCH_GEO_CYCLES")
+    ops_per_cycle = knob("ops_per_cycle", "BENCH_GEO_OPS_PER_CYCLE")
+    hot_set = knob("hot_set", "BENCH_GEO_HOT_SET")
+    subscribers = knob("subscribers", "BENCH_GEO_SUBSCRIBERS")
+    kill_cycle = knob("kill_cycle", "BENCH_GEO_KILL_CYCLE")
+    oracle_sample = cfg["oracle_sample"]
+    hot_shift = cfg["hot_shift"]
+    evict_cohort = cfg["evict_cohort"]
+    assert regions >= 2 and 2 <= kill_cycle <= cycles
+
+    p = min(cfg["mesh"][0], len(jax.devices()))
+    mesh = make_mesh(p, 1)
+    caps = dict(
+        n_elems=cfg["elems"], n_actors=cfg["actors"],
+        deferred_cap=cfg["deferred_cap"],
+    )
+    e, a = caps["n_elems"], caps["n_actors"]
+
+    rng = np.random.default_rng(211)
+    roots = []
+    planes = {}
+    for r in range(regions):
+        sb = Superblock(tenants, mesh, kind="orswot", caps=caps,
+                        n_lanes=lanes)
+        root = tempfile.mkdtemp(prefix=f"bench-geo-r{r}-")
+        roots.append(root)
+        ev = Evictor(sb, root, pressure_batch=64)
+        wal = ServeWal(os.path.join(root, "serve.wal"))
+        q = IngestQueue(
+            sb, lanes=cfg["slab_lanes"], depth=cfg["slab_depth"],
+            max_pending=1 << 18, evictor=ev, wal=wal,
+        )
+        fan = FanoutPlane(sb, evictor=ev, capacity=max(subscribers, 64))
+        planes[r] = RegionPlane(r, sb, q, evictor=ev, wal=wal,
+                                fanout=fan)
+    fed = Federation(planes)
+    # Region-local subscribers: each region watches a random tenant
+    # slice — the fan-out half of the partial-replication interest.
+    for r in range(regions):
+        planes[r].fanout.subscribe(
+            rng.integers(0, tenants, max(subscribers // regions, 1))
+        )
+
+    dead = regions - 1
+    pre_home = np.asarray([fed.rmap.home(t) for t in range(tenants)])
+    next_ctr = np.zeros(tenants, np.uint32)
+    history = {}  # tenant -> ACKED ops only (sequential-oracle form)
+
+    def submit_cycle(cycle, n_ops, live):
+        """One cycle's adds from round-robin origin regions. Returns
+        the TENTATIVE (home, tenant, oracle-op) ledger — entries move
+        into ``history`` only when the home drain (the WAL group
+        commit, i.e. the ack) returns."""
+        off = (cycle * hot_shift) % max(tenants - hot_set, 1)
+        hot = rng.integers(off, off + hot_set, n_ops)
+        uni = rng.integers(0, tenants, n_ops)
+        ts = np.where(rng.random(n_ops) < 0.6, hot, uni)
+        masks = rng.random((n_ops, e)) < (4.0 / e)
+        tent = []
+        for i in range(n_ops):
+            t = int(ts[i])
+            act = t % a
+            c = int(next_ctr[t]) + 1
+            next_ctr[t] = c
+            home = fed.add(int(live[i % len(live)]), t, actor=act,
+                           counter=c, member=masks[i])
+            tent.append((home, t, (sb_ops.ADD, act, c, None, masks[i])))
+        return tent
+
+    def drain_live(tel):
+        for p_ in fed.planes.values():
+            if not p_.alive:
+                continue
+            _rep, t_ = p_.queue.drain(telemetry=True)
+            if t_ is not None:
+                tel = t_ if tel is None else tele.combine(tel, t_)
+        return tel
+
+    def ack(tent):
+        for _home, t, op in tent:
+            history.setdefault(t, []).append(op)
+
+    def roweq(x, y):
+        return all(
+            bool(np.array_equal(np.asarray(u), np.asarray(v)))
+            for u, v in zip(jax.tree.leaves(x), jax.tree.leaves(y))
+        )
+
+    failover_rep = None
+    kill_s = 0.0
+    ops_lost_unacked = 0
+    n_spilled = 0
+    reads = stale_reads = 0
+    rec, prev_rec, snap_base = _flight_start(capacity=16384)
+    try:
+        # Warmup: compiles the slab apply + the decompose/reconstruct
+        # exchange path; its ops are real and stay in the oracle
+        # histories — only the TIMING is excluded.
+        tel = None
+        tent = submit_cycle(0, 64, list(range(regions)))
+        tel = drain_live(tel)
+        ack(tent)
+        exchange_all(fed)
+
+        exchanged_tenants = 0
+        t_start = time.perf_counter()
+        for cycle in range(1, cycles + 1):
+            live = sorted(
+                r for r, p_ in fed.planes.items() if p_.alive
+            )
+            tent = submit_cycle(cycle, ops_per_cycle, live)
+            if cycle == kill_cycle:
+                # Region kill MID-TRAFFIC: this cycle's ops are still
+                # pending, un-drained. The dead region's share was
+                # never WAL-committed — unacked, legitimately lost;
+                # everything ever ACKED must survive the re-homing.
+                lost = [x for x in tent if x[0] == dead]
+                tent = [x for x in tent if x[0] != dead]
+                ops_lost_unacked = len(lost)
+                t0 = time.perf_counter()
+                failover_rep = fail_over_region(fed, dead)
+                kill_s = time.perf_counter() - t0
+            # A pre-sweep mirror read: lag is visible and LABELED.
+            if history:
+                t_r = int(rng.choice(np.asarray(sorted(history))))
+                home = fed.rmap.home(t_r)
+                others = [r for r, p_ in fed.planes.items()
+                          if p_.alive and r != home]
+                if others:
+                    _, cert = read_local(
+                        fed, int(rng.choice(others)), t_r
+                    )
+                    reads += 1
+                    stale_reads += 0 if cert.fresh else 1
+            tel = drain_live(tel)
+            ack(tent)
+            if cycle == kill_cycle - 1:
+                # Spill a cohort of the soon-dead region's home tenants
+                # to its durable tier: the failover must recover REAL
+                # snapshot rows (plus the WAL suffix replayed
+                # idempotently over them), not just replay the log.
+                cohort = [t for t in sorted(history)
+                          if int(pre_home[t]) == dead][:evict_cohort]
+                n_spilled = fed.planes[dead].evictor.evict(cohort)
+            for xr in exchange_all(fed):
+                exchanged_tenants += xr.tenants_shipped
+        window_s = time.perf_counter() - t_start
+
+        # Quiesce: nothing pending, every interest mirror caught up.
+        tel = drain_live(tel)
+        for _ in range(2):
+            for xr in exchange_all(fed):
+                exchanged_tenants += xr.tenants_shipped
+        total_ops = sum(len(v) for v in history.values())
+
+        # One telemetry record for the whole leg: the federation
+        # gauges/counters annotated onto the combined drain telemetry
+        # (pytree → schema → exporter → flight recorder).
+        assert tel is not None
+        t_rec = fed.annotate(jax.tree.map(np.asarray, tel))
+        tele.record("geo", t_rec)
+        d = tele.to_dict(t_rec)
+        wm = obs_hist.summary(d["hist_geo_watermark_lag"])
+        flight = _flight_finish("geo", rec, prev_rec, snap_base)
+
+        # ---- gates ----------------------------------------------------
+        live = sorted(r for r, p_ in fed.planes.items() if p_.alive)
+        written = sorted(history)
+        rehomed_written = [
+            t for t in written if int(pre_home[t]) == dead
+        ]
+        sample = list(rehomed_written[:oracle_sample])
+        others = [t for t in written if t not in set(sample)]
+        if others:
+            pick = rng.choice(
+                len(others),
+                min(max(oracle_sample - len(sample), 16), len(others)),
+                replace=False,
+            )
+            sample += [others[i] for i in pick]
+
+        tk = fed.plane(live[0]).sb.tk
+        oracle_mm = recovered_mm = 0
+        acked_ops_lost = 0
+        for t in sample:
+            hp = fed.plane(fed.rmap.home(t))
+            if not hp.sb.is_resident(t) and hp.evictor is not None:
+                hp.evictor.restore(t)
+            want = sb_ops.sequential_oracle(
+                tk, tk.empty(**hp.sb.caps), history[t]
+            )
+            if not roweq(hp.sb.row(t), want):
+                oracle_mm += 1
+                if int(pre_home[t]) == dead:
+                    recovered_mm += 1
+                    acked_ops_lost += len(history[t])
+        recovered_bit_identical = recovered_mm == 0
+
+        mirror_mm = mirrors_checked = 0
+        for r in live:
+            pl = fed.plane(r)
+            interest = pl.interest_tenants()
+            for t in sample:
+                home = fed.rmap.home(t)
+                if r == home or t not in interest:
+                    continue
+                mirrors_checked += 1
+                if not pl.sb.is_resident(t) or not roweq(
+                    pl.sb.row(t), fed.plane(home).sb.row(t)
+                ):
+                    mirror_mm += 1
+        bit_identical = (
+            oracle_mm == 0 and mirror_mm == 0 and mirrors_checked >= 1
+        )
+        assert recovered_bit_identical and acked_ops_lost == 0, (
+            f"region-kill failover lost acked ops: {recovered_mm} "
+            f"re-homed tenants diverged from their acked-op oracle"
+        )
+        assert bit_identical, (
+            f"{oracle_mm} home rows diverged from the sequential "
+            f"oracle, {mirror_mm}/{mirrors_checked} interest mirrors "
+            f"diverged from their home rows"
+        )
+        assert failover_rep is not None and fed.failovers >= 1
+        assert n_spilled >= 1 and failover_rep.rows_recovered >= 1, (
+            "the failover never touched the durable snapshot tier — "
+            f"{n_spilled} rows spilled, "
+            f"{failover_rep.rows_recovered} recovered"
+        )
+
+        wire_pct = 100.0 * fed.exchange_bytes / max(
+            fed.full_mirror_bytes, 1.0
+        )
+        assert fed.full_mirror_bytes > 0 and wire_pct <= 25.0, (
+            f"cross-region δ lanes moved {wire_pct:.1f}% of what "
+            f"full-state mirroring would ship — the gate is ≤25%"
+        )
+
+        # Partial replication: resident lanes per region bounded by
+        # home-written ∪ local-interest (∪ the re-homed cohort — the
+        # failover's ⊥-cleared mirrors keep their lane), and the
+        # federation total strictly below written × regions.
+        rehomed_all = {
+            t for t in range(tenants) if int(pre_home[t]) == dead
+        }
+        resident = {}
+        resident_bound_ok = True
+        for r in live:
+            pl = fed.plane(r)
+            allowed = set(pl.interest_tenants())
+            allowed |= {t for t in written if fed.rmap.home(t) == r}
+            if failover_rep is not None:
+                allowed |= rehomed_all
+            resident[r] = pl.resident_lanes()
+            if resident[r] > len(allowed):
+                resident_bound_ok = False
+        total_resident = sum(resident.values())
+        naive_resident = len(written) * len(live)
+        assert resident_bound_ok and total_resident < naive_resident, (
+            f"partial replication violated: resident={resident}, "
+            f"{total_resident} total vs {naive_resident} for full "
+            f"mirroring of {len(written)} written tenants"
+        )
+    except BaseException:
+        from crdt_tpu import obs as _obs
+
+        _obs.install(prev_rec)
+        raise
+    finally:
+        for r, root in zip(range(regions), roots):
+            planes[r].wal.close()
+            shutil.rmtree(root, ignore_errors=True)
+
+    log(
+        f"config-geo: {len(live)}/{regions} regions x {tenants:,} "
+        f"tenants: {total_ops:,} acked ops in {window_s:.2f}s "
+        f"({total_ops / window_s:,.0f} ops/s incl. a "
+        f"{kill_s * 1e3:.0f}ms region-kill failover re-homing "
+        f"{failover_rep.tenants_rehomed} tenants, "
+        f"{failover_rep.rows_recovered} snapshot rows + "
+        f"{failover_rep.ops_replayed} WAL ops, zero acked ops lost, "
+        f"{ops_lost_unacked} in-flight unacked dropped); "
+        f"{fed.exchange_bytes:,.0f} B cross-region δ vs "
+        f"{fed.full_mirror_bytes:,.0f} B full-mirror = "
+        f"{wire_pct:.1f}%; residency {resident} of {len(written)} "
+        f"written ({total_resident} total vs {naive_resident} naive); "
+        f"{stale_reads}/{reads} window reads labeled stale, watermark "
+        f"lag p99 {wm['p99']:.1f}; {len(sample)} tenants "
+        f"oracle-checked, {mirrors_checked} mirrors bit-identical"
+    )
+    return [{
+        "config": "geo", "metric": "geo_acked_ops_per_sec",
+        "value": round(total_ops / window_s, 1), "unit": "ops/s",
+        "regions": regions, "regions_live": len(live),
+        "tenants": tenants, "lanes": lanes,
+        "acked_ops": total_ops,
+        "exchanges": int(fed.exchanges),
+        "exchanged_tenants": exchanged_tenants,
+        "exchange_bytes": round(fed.exchange_bytes, 1),
+        "full_mirror_bytes": round(fed.full_mirror_bytes, 1),
+        "wire_vs_mirror_pct": round(wire_pct, 2),
+        "failovers": int(fed.failovers),
+        "failover_ms": round(kill_s * 1e3, 1),
+        "tenants_rehomed": failover_rep.tenants_rehomed,
+        "rows_spilled": n_spilled,
+        "rows_recovered": failover_rep.rows_recovered,
+        "ops_replayed": failover_rep.ops_replayed,
+        "divergence_lanes": failover_rep.divergence_lanes,
+        "mirrors_adopted": failover_rep.mirrors_adopted,
+        "acked_ops_lost": acked_ops_lost,
+        "unacked_ops_dropped": ops_lost_unacked,
+        "recovered_bit_identical": recovered_bit_identical,
+        "bit_identical": bit_identical,
+        "oracle_sampled": len(sample),
+        "mirrors_checked": mirrors_checked,
+        "resident_lanes": {str(r): n for r, n in resident.items()},
+        "total_resident": total_resident,
+        "naive_resident": naive_resident,
+        "resident_bound_ok": resident_bound_ok,
+        "written_tenants": len(written),
+        "reads": reads, "stale_reads_labeled": stale_reads,
+        "watermark_lag_p99": round(wm["p99"], 2),
+        "window_seconds": round(window_s, 3),
+        "shape": f"{regions}regions@{tenants}x{e}x{a}@{lanes}lanes",
+        **flight,
+    }]
+
+
 def bench_cpu() -> float:
     from crdt_tpu.pure.orswot import Orswot
     from crdt_tpu.vclock import VClock
@@ -3380,6 +3769,15 @@ def parse_args(argv=None):
              "stdout",
     )
     ap.add_argument(
+        "--geo",
+        action="store_true",
+        help="run ONLY the geo-federation leg (multi-region mesh-of-"
+             "meshes: δ anti-entropy over checksum-guarded links, a "
+             "mid-traffic region-kill failover with zero acked-op "
+             "loss, causal-watermark local reads, partial-replication "
+             "residency) and print its record to stdout",
+    )
+    ap.add_argument(
         "--flagship",
         action="store_true",
         help="run ONLY the flagship replica-streaming leg (10,240 "
@@ -3451,6 +3849,26 @@ def main(argv=None):
             )
             log(json.dumps(rec))
         print(json.dumps(recs[0] if recs else {"config": "fanout",
+                                               "skipped": True}))
+        return
+    if args.geo:
+        # The fast geo-only mode: one leg, one stdout JSON line.
+        if os.environ.get("BENCH_PROBE", "1") != "0" and not tpu_reachable():
+            from crdt_tpu.utils.cpu_pin import pin_cpu
+
+            pin_cpu(virtual_devices=8)
+            os.environ["BENCH_CPU_FALLBACK"] = "1"
+        from crdt_tpu.telemetry import span
+
+        with span("bench.geo", quick=True):
+            recs = bench_geo()
+        for rec in recs:
+            rec["degraded"] = bool(
+                rec.get("degraded", False)
+                or os.environ.get("BENCH_CPU_FALLBACK") == "1"
+            )
+            log(json.dumps(rec))
+        print(json.dumps(recs[0] if recs else {"config": "geo",
                                                "skipped": True}))
         return
     if args.scaleout:

@@ -537,6 +537,29 @@ def fanout_skips_watermark_bucket(plane):
     return plane.push(_skip_versions=(0,))
 
 
+# ---- geo-federation twins (crdt_tpu/geo/) ---------------------------------
+
+def region_serves_unwatermarked_read(fed, region, tenant):
+    """Broken geo twin: a region-local read path that serves whatever
+    the mirror holds while claiming ``fresh`` unconditionally — the
+    certificate says lag 0 whether or not the home→here link's acked
+    watermark ever caught the home version, so a stale mirror is
+    silently presented as the state of record. Exactly the
+    freshness-laundering bug the causal-watermark certificates in
+    ``geo.reads.read_local`` exist to prevent.
+    ``geo.reads.watermark_reads_sound`` must fail it (the
+    ``federation`` static-check section pins that the detector
+    fires)."""
+    from ..geo.reads import ReadCertificate, read_local
+
+    value, cert = read_local(fed, region, tenant)
+    return value, ReadCertificate(
+        tenant=cert.tenant, region=cert.region, home=cert.home,
+        fresh=True, watermark=cert.home_version,
+        home_version=cert.home_version, lag=0,
+    )
+
+
 # ---- observability twins (crdt_tpu/obs/) ----------------------------------
 
 def recorder_drops_events(capacity: int = 8, **kwargs):

@@ -227,6 +227,22 @@ class FanoutSurface:
 
 
 @dataclass(frozen=True)
+class GeoSurface:
+    """One registered geo-federation surface (crdt_tpu/geo/): a public
+    operational symbol of the geo package — the region plane, the
+    cross-region anti-entropy link, the watermark-read certificate
+    path, the failover driver, their detectors. Registration is the
+    coverage contract — the ``federation`` static-check section
+    (tools/run_static_checks.py, via ``crdt_tpu.geo.static_checks``)
+    fails discovery for any public geo symbol that forgot to register,
+    exactly like an unregistered join, mesh entry point, or
+    fault/scaleout/serve/fanout surface."""
+
+    name: str
+    module: str = ""
+
+
+@dataclass(frozen=True)
 class WireSurface:
     """One registered fused-wire kernel instantiation
     (crdt_tpu/parallel/wire.py over crdt_tpu/ops/wire_kernels.py): a δ
@@ -353,6 +369,7 @@ _WIRE_SURFACES: Dict[str, WireSurface] = {}
 _SCALEOUT_SURFACES: Dict[str, ScaleoutSurface] = {}
 _SERVE_SURFACES: Dict[str, ServeSurface] = {}
 _FANOUT_SURFACES: Dict[str, FanoutSurface] = {}
+_GEO_SURFACES: Dict[str, GeoSurface] = {}
 _OBS_EVENTS: Dict[str, ObsEvent] = {}
 _TRACE_STAGES: Dict[str, TraceStage] = {}
 _SHARED_FIELDS: Dict[Tuple[str, str], SharedField] = {}
@@ -616,6 +633,28 @@ def unregistered_fanout_surfaces() -> List[str]:
     (:func:`_unregistered_package_surfaces` is the walk)."""
     return _unregistered_package_surfaces(
         "crdt_tpu.fanout", _FANOUT_SURFACES
+    )
+
+
+def register_geo_surface(name: str, *, module: str = "") -> GeoSurface:
+    gs = GeoSurface(name=name, module=module)
+    _GEO_SURFACES[name] = gs
+    return gs
+
+
+def geo_surfaces() -> Tuple[GeoSurface, ...]:
+    import crdt_tpu.geo  # noqa: F401  (registrations import-time)
+
+    return tuple(_GEO_SURFACES[k] for k in sorted(_GEO_SURFACES))
+
+
+def unregistered_geo_surfaces() -> List[str]:
+    """Public operational ``crdt_tpu.geo`` symbols that never called
+    :func:`register_geo_surface` — the discovery gate of the
+    ``federation`` static-check section
+    (:func:`_unregistered_package_surfaces` is the walk)."""
+    return _unregistered_package_surfaces(
+        "crdt_tpu.geo", _GEO_SURFACES
     )
 
 

@@ -180,6 +180,42 @@ def drain_jsonl(
     return written
 
 
+def _federation_block(counters, gauges, worst) -> Dict[str, Any]:
+    """The geo-federation vitals (crdt_tpu/geo/, ISSUE 20): every
+    field is the ``-1`` sentinel until the FIRST cross-region exchange
+    lands — a dashboard can tell "single-mesh deployment" apart from
+    "federated but silent" at a glance."""
+    exchanges = int(counters.get("geo.exchanges", 0)) or int(sum(
+        v for name, v in counters.items()
+        if name.endswith(".geo.exchanges")
+    ))
+    if exchanges <= 0:
+        return {
+            "regions_live": -1,
+            "home_tenants": -1,
+            "cross_region_bytes": -1,
+            "watermark_lag_p99": -1.0,
+            "failovers": -1,
+        }
+    bytes_ = int(counters.get("geo.exchange_bytes", 0)) or int(sum(
+        v for name, v in counters.items()
+        if name.endswith(".geo.exchange_bytes")
+    ))
+    lag_vals = [
+        g["last"] for name, g in gauges.items()
+        if name.endswith(".hist.geo_watermark_lag.p99")
+    ]
+    return {
+        "regions_live": int(worst(".regions_live")),
+        "home_tenants": int(worst(".geo_home_tenants")),
+        "cross_region_bytes": bytes_,
+        "watermark_lag_p99": (
+            float(max(lag_vals)) if lag_vals else -1.0
+        ),
+        "failovers": int(counters.get("geo.failovers", 0)),
+    }
+
+
 def health(snapshot: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """One at-a-glance mesh health snapshot (the ``/healthz`` shape),
     derived from the live registry (or an explicit snapshot) plus the
@@ -201,6 +237,10 @@ def health(snapshot: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
       hits, rebalance moves — ISSUE 18), and the newest end-to-end
       freshness p99 (µs; -1 until a sampled trace completes —
       crdt_tpu/obs/trace.py);
+    - ``federation`` — the geo-federation vitals (ISSUE 20): live
+      regions, home-tenant count, cross-region δ wire bytes, the
+      worst per-read mirror watermark-lag p99, and region failovers —
+      every field ``-1`` until the first cross-region exchange lands;
     - ``flight`` — the recorder's correlation key + buffered/dropped
       event counts (null when none is installed).
 
@@ -266,6 +306,7 @@ def health(snapshot: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
                 last("obs.trace.freshness_p99_us", -1.0)
             ),
         },
+        "federation": _federation_block(counters, gauges, worst),
         "flight": None if rec is None else {
             "key": list(rec.key()),
             "events": len(rec),

@@ -46,8 +46,26 @@ Protocol (the chaos tests and ``bench.py --chaos`` walk it end to end):
      state) is simply abandoned. This is the right exit when the
      rank's local artifacts are gone or untrusted; membership-wise it
      is ``ScaleoutMesh.admit``, not ``rejoin``.
+   - **inter-mesh re-homing** (ISSUE 20, ``crdt_tpu.geo.failover
+     .fail_over_region``) — the FOURTH contract, one level up: here
+     the evicted member is a whole REGION (one mesh), and what
+     re-enters is not the region but its HOME TENANT SHARDS, re-homed
+     onto the surviving regions by minimal rendezvous remap. Each new
+     home rebuilds a tenant from the dead region's durable tier
+     (snapshot rows + the ServeWal suffix replayed through its own
+     ingest queue — acks were gated on that WAL's group commit, so a
+     complete tier recovers every acked op) plus peer-region
+     divergence lanes (surviving mirrors, δ-decomposed against the
+     recovery; adopted wholesale only in the sole-survivor case).
+     Membership-wise it is ``FederationMembership.evict`` — a
+     generation bump that refuses every pre-failover packet — and
+     every ack window touching a re-homed tenant resets to ⊥ with its
+     surviving mirrors cleared, so the next cross-region exchange
+     re-ships full state against ⊥.
 
-   δ re-entry from stale marks remains forbidden on every path.
+   δ re-entry from stale marks remains forbidden on every path —
+   intra-mesh (rank tracking, ack marks) and inter-mesh (geo link
+   acked bases) alike.
 
 The liveness signal is receiver-measured: device p's ``miss_streak[p]``
 counts consecutive end-of-run rounds with nothing arriving on its

@@ -1,0 +1,221 @@
+"""crdt_tpu.geo — the geo-federation plane (ISSUE 20, ROADMAP item 3).
+
+One mesh is one failure domain; this package federates N of them into
+a mesh of meshes (the SURVEY's inter-DC state/δ anti-entropy tier).
+Four cooperating pieces (see each module's docstring):
+
+- :mod:`.region` — :class:`RegionMap` (rendezvous tenant→region
+  homing, minimal remap on region loss), :class:`FederationMembership`
+  (generation-stamped, scaleout/mesh_scale.py discipline),
+  :class:`RegionPlane` (one region's serve stack + local-interest
+  signals) and :class:`Federation` (home-routed writes whose ack point
+  stays the home region's ServeWal group commit). PARTIAL REPLICATION
+  is the scale unlock: a region materializes only home ∪
+  local-interest tenants (fan-out subscriptions + recent local
+  writes), so tenant population × regions never multiplies device
+  memory.
+- :mod:`.antientropy` — per-link δ shipping: join-irreducible
+  decomposition over the link's acked base (PR 9 ackwin semantics
+  host-side — promote on positive ack, monotone watermarks), under
+  retry + lockstep rounds + generation stamps + a checksum digest (a
+  corrupt inter-region packet never joins).
+- :mod:`.reads` — :class:`ReadCertificate` causal-watermark local
+  reads: a mirror read is served locally WITH its explicit freshness
+  bound; stale is labeled, never guessed fresh.
+- :mod:`.failover` — region-kill re-homing from the durable tier plus
+  peer divergence lanes: the FOURTH rejoin contract
+  (faults/membership.py), zero acked-op loss.
+
+Plus :func:`static_checks` — the ``federation`` section of
+tools/run_static_checks.py: surface-registry coverage, the two-region
+convergence/integrity micro A/B, and the broken-twin gate (the
+always-fresh read path in ``analysis.fixtures`` must be caught by
+:func:`reads.watermark_reads_sound`).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .antientropy import (
+    ExchangeReport,
+    GeoLink,
+    GeoLockstepError,
+    GeoPacket,
+    apply_packet,
+    build_packet,
+    exchange,
+    exchange_all,
+    link_for,
+)
+from .failover import FailoverReport, fail_over_region
+from .reads import ReadCertificate, read_local, watermark_reads_sound
+from .region import (
+    Federation,
+    FederationMembership,
+    GeoGenerationError,
+    RegionMap,
+    RegionPlane,
+)
+
+
+def static_checks() -> List:
+    """The ``federation`` static-check section (Finding list, empty =
+    clean):
+
+    1. **surface coverage** — every public operational symbol of this
+       package must have called
+       ``analysis.registry.register_geo_surface`` (the
+       registration-is-the-coverage-contract rule).
+    2. **two-region convergence micro A/B** — disjoint home writes,
+       one anti-entropy sweep: every mirror must land bit-identical
+       to its home row, δ wire bytes must undercut the full-state
+       mirroring baseline, and a corrupted packet must be REJECTED by
+       the checksum lane (then healed by the retry re-ship) — never
+       joined.
+    3. **broken twin fires** — the always-fresh read path twin
+       (``analysis.fixtures.region_serves_unwatermarked_read``) must
+       FAIL :func:`reads.watermark_reads_sound`; the honest
+       :func:`reads.read_local` must pass.
+    """
+    import jax
+    import numpy as np
+
+    from ..analysis import fixtures
+    from ..analysis.registry import unregistered_geo_surfaces
+    from ..analysis.report import Finding
+    from .reads import _micro_federation
+
+    findings: List[Finding] = []
+
+    for name in unregistered_geo_surfaces():
+        findings.append(Finding(
+            "geo-surface-coverage", name,
+            "public geo symbol never called register_geo_surface — "
+            "the federation gate cannot see it",
+        ))
+
+    # 2. two-region convergence + δ economy + integrity rejection.
+    try:
+        fed = _micro_federation()
+        t0 = next(
+            t for t in range(fed.n_tenants) if fed.rmap.home(t) == 0
+        )
+        t1 = next(
+            t for t in range(fed.n_tenants) if fed.rmap.home(t) == 1
+        )
+        m = lambda *on: np.isin(np.arange(4), on)  # noqa: E731
+        # Written THROUGH the opposite region — both mirrors gain
+        # local-write interest.
+        fed.add(1, t0, actor=0, counter=1, member=m(0, 1))
+        fed.add(0, t1, actor=1, counter=1, member=m(2))
+        fed.drain_all()
+        reps = exchange_all(fed)
+        delta_b = sum(r.bytes_delta for r in reps)
+        full_b = sum(r.bytes_full_mirror for r in reps)
+        for tenant, home in ((t0, 0), (t1, 1)):
+            mirror_region = 1 - home
+            want = fed.plane(home).sb.row(tenant)
+            got = fed.plane(mirror_region).sb.row(tenant)
+            if not all(
+                np.array_equal(a, b)
+                for a, b in zip(jax.tree.leaves(got),
+                                jax.tree.leaves(want))
+            ):
+                findings.append(Finding(
+                    "geo-convergence", f"tenant {tenant}",
+                    "mirror is not bit-identical to the home row "
+                    "after one anti-entropy sweep",
+                ))
+        if not (0.0 < delta_b < full_b):
+            findings.append(Finding(
+                "geo-convergence", "delta-economy",
+                f"δ wire bytes {delta_b} do not undercut the "
+                f"full-state mirroring baseline {full_b}",
+            ))
+        # Integrity: flip one residual byte in flight — the checksum
+        # lane must reject it (never joins) and the retry must heal
+        # with the clean re-ship.
+        fed.add(1, t0, actor=0, counter=2, member=m(3))
+        fed.drain_all()
+        calls = {"n": 0}
+
+        def corrupt_once(pkt):
+            calls["n"] += 1
+            if calls["n"] > 1:
+                return pkt
+            d0 = pkt.deltas[0]
+            bad = d0._replace(residual=jax.tree.map(
+                lambda x: x + np.asarray(1, x.dtype).reshape(
+                    (1,) * x.ndim
+                ),
+                d0.residual,
+            ))
+            return pkt._replace(deltas=(bad,) + pkt.deltas[1:])
+
+        rep = exchange(fed, 0, 1, transport=corrupt_once)
+        if rep.rejected < 1:
+            findings.append(Finding(
+                "geo-integrity", "checksum-lane",
+                "a corrupted inter-region packet was not rejected by "
+                "the checksum lane",
+            ))
+        want = fed.plane(0).sb.row(t0)
+        got = fed.plane(1).sb.row(t0)
+        if not all(
+            np.array_equal(a, b)
+            for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want))
+        ):
+            findings.append(Finding(
+                "geo-integrity", f"tenant {t0}",
+                "mirror diverged from home after the corrupt-packet "
+                "retry heal",
+            ))
+    except Exception as exc:
+        findings.append(Finding(
+            "geo-convergence", "micro-federation",
+            f"two-region micro A/B crashed: {type(exc).__name__}: "
+            f"{exc}",
+        ))
+
+    # 3. watermark detector + broken twin, both directions.
+    try:
+        if not watermark_reads_sound(read_local):
+            findings.append(Finding(
+                "geo-watermark", "read_local",
+                "the honest watermark-certified read path failed the "
+                "freshness-labeling detector",
+            ))
+        if watermark_reads_sound(fixtures.region_serves_unwatermarked_read):
+            findings.append(Finding(
+                "broken-fixture-missed", "region_serves_unwatermarked_read",
+                "the always-fresh read twin PASSED the watermark "
+                "detector — the federation gate is not actually "
+                "firing",
+            ))
+    except Exception as exc:
+        findings.append(Finding(
+            "geo-watermark", "detector",
+            f"watermark detector crashed: {type(exc).__name__}: {exc}",
+        ))
+    return findings
+
+
+from ..analysis.registry import register_geo_surface as _reg  # noqa: E402
+
+for _name in (
+    "RegionMap", "FederationMembership", "RegionPlane", "Federation",
+    "GeoLink", "link_for", "build_packet", "apply_packet", "exchange",
+    "exchange_all", "read_local", "watermark_reads_sound",
+    "fail_over_region", "static_checks",
+):
+    _reg(_name, module=__name__)
+
+__all__ = [
+    "ExchangeReport", "FailoverReport", "Federation",
+    "FederationMembership", "GeoGenerationError", "GeoLink",
+    "GeoLockstepError", "GeoPacket", "ReadCertificate", "RegionMap",
+    "RegionPlane", "apply_packet", "build_packet", "exchange",
+    "exchange_all", "fail_over_region", "link_for", "read_local",
+    "static_checks", "watermark_reads_sound",
+]

@@ -1,0 +1,348 @@
+"""Cross-region anti-entropy — δ lanes over retry-wrapped DCN links.
+
+The inter-region cadence is the SURVEY's state/δ-based anti-entropy
+between data centers: slower than the intra-mesh δ ring, affordable
+because a link ships only the join-irreducible decomposition of what
+the peer provably lacks (delta_opt/decompose.py, Enes et al.). One
+:class:`GeoLink` per directed (home → mirror) region pair carries the
+PR 9 ``ackwin`` semantics re-instantiated host-side:
+
+- the sender keeps its own **shipped copy** per tenant and promotes it
+  to the link's acked base ONLY on positive ack — the receiver's
+  mirror therefore equals the sender's acked base bit-exactly, which
+  is what makes positional δ reconstruction
+  (``reconstruct(kind, mirror, d)``) reproduce the home row
+  bit-exactly on arrival;
+- promotion is MONOTONIC (a late duplicate ack can never regress the
+  watermark), and the acked version per tenant IS the causal
+  watermark geo/reads.py certifies local reads against.
+
+Transport discipline is the faults-package stack unchanged: every
+exchange runs under :func:`~crdt_tpu.faults.retry.with_retries`
+(exponential backoff + the lockstep guard — both ends count rounds,
+a mispaired round fails LOUDLY instead of joining mispaired lanes),
+the packet stamps the federation generation
+(:class:`~crdt_tpu.geo.region.FederationMembership` refuses stale
+stamps), and the payload rides under a
+:func:`~crdt_tpu.faults.integrity.checksum` digest — a corrupt
+inter-region packet is rejected before any join and the retry wrapper
+re-ships it (never joins, at-worst heals a round later).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..delta_opt.decompose import (
+    Decomposition,
+    decompose,
+    decomposition_bytes,
+    reconstruct,
+)
+from ..faults import integrity
+from ..faults.retry import RetryPolicy, with_retries
+from ..utils.metrics import metrics
+from .region import Federation
+
+
+class GeoLockstepError(RuntimeError):
+    """The two ends of a geo link disagree on the exchange round —
+    a mispaired packet would join lanes against the wrong base, so the
+    exchange fails loudly instead (the faults/retry.py lockstep
+    discipline at federation granularity)."""
+
+
+class _CorruptPacket(RuntimeError):
+    """Receiver-side integrity rejection — raised INSIDE the retried
+    exchange so :func:`~crdt_tpu.faults.retry.with_retries` re-ships
+    the packet; the corrupt payload itself never joined."""
+
+
+class GeoPacket(NamedTuple):
+    """One anti-entropy shipment: per-tenant δ decompositions over the
+    link's acked bases, under a federation-generation stamp, a
+    lockstep round, and a whole-payload checksum digest."""
+
+    src: int
+    dst: int
+    generation: int
+    round: int
+    tenants: Tuple[int, ...]
+    versions: Tuple[int, ...]   # home version each δ brings the mirror to
+    deltas: Tuple[Decomposition, ...]
+    digest: np.ndarray          # integrity.checksum over the payload
+
+
+class ExchangeReport(NamedTuple):
+    src: int
+    dst: int
+    tenants_shipped: int
+    bytes_delta: float          # δ-lane wire bytes actually shipped
+    bytes_full_mirror: float    # what full-state mirroring would have cost
+    rejected: int               # integrity rejections healed by retry
+    round: int
+
+
+class GeoLink:
+    """Directed per-(src→dst) link state: the host-side ack window."""
+
+    def __init__(self, src: int, dst: int):
+        self.src = int(src)
+        self.dst = int(dst)
+        # tenant -> the sender's shipped copy promoted on positive ack;
+        # equals the receiver's mirror bit-exactly (ackwin semantics).
+        self.acked_base: Dict[int, object] = {}
+        self.acked_ver: Dict[int, int] = {}
+        self.round_acked = 0
+        self.integrity_rejects = 0
+
+    def watermark(self, tenant: int) -> int:
+        return self.acked_ver.get(int(tenant), 0)
+
+    def confirm(self, tenant: int, version: int, shipped_row) -> None:
+        """Promote on positive ack — monotonic: a duplicate or
+        reordered ack below the current watermark is a no-op."""
+        t = int(tenant)
+        if version <= self.acked_ver.get(t, 0):
+            return
+        self.acked_ver[t] = int(version)
+        self.acked_base[t] = shipped_row
+
+    def reset(self, tenants) -> None:
+        """Forget the ack window for ``tenants`` — the ⊥ re-entry
+        (geo/failover.py): δ re-entry from stale acked bases is
+        forbidden, the next exchange re-ships full state."""
+        for t in tenants:
+            self.acked_ver.pop(int(t), None)
+            self.acked_base.pop(int(t), None)
+
+
+def link_for(fed: Federation, src: int, dst: int) -> GeoLink:
+    key = (int(src), int(dst))
+    lk = fed.links.get(key)
+    if lk is None:
+        lk = GeoLink(src, dst)
+        fed.links[key] = lk
+    return lk
+
+
+def _payload(tenants, versions, deltas, src, dst, generation, round_):
+    """The digest-covered view of a packet: header ints ride as one
+    array so a flipped tenant id or round is as detectable as a
+    flipped lane byte."""
+    hdr = np.asarray(
+        [src, dst, generation, round_] + list(tenants) + list(versions),
+        np.int64,
+    )
+    return (hdr, tuple(deltas))
+
+
+def _tree_shapes_match(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        x.shape == y.shape and x.dtype == y.dtype
+        for x, y in zip(la, lb)
+    )
+
+
+def _materialized_row(plane, tenant: int):
+    """The receiver's (or sender's) current host row for a tenant:
+    resident lane, else restore-on-touch from the durable tier, else
+    ⊥. Returns ``None`` only when restore fails outright."""
+    sb = plane.sb
+    t = int(tenant)
+    if not sb.is_resident(t):
+        if plane.evictor is not None and sb.was_evicted[t]:
+            plane.evictor.restore(t)
+    if sb.is_resident(t):
+        return sb.row(t)
+    return jax.tree.map(np.asarray, sb.empty_row())
+
+
+def build_packet(
+    fed: Federation, src: int, dst: int, *,
+    max_tenants: Optional[int] = None,
+) -> Tuple[Optional[GeoPacket], Dict[int, object], float, float]:
+    """Assemble one src→dst shipment: src-homed tenants in dst's
+    local-interest set whose home version has advanced past the
+    link's acked watermark. Returns ``(packet-or-None, shipped
+    copies, δ bytes, full-mirror baseline bytes)``; the shipped
+    copies are retained sender-side for promote-on-ack."""
+    src_plane = fed.plane(src)
+    dst_plane = fed.plane(dst)
+    link = link_for(fed, src, dst)
+    interest = dst_plane.interest_tenants()
+    queue = src_plane.queue
+
+    cands: List[int] = []
+    for t in sorted(interest):
+        if fed.rmap.home(t) != src:
+            continue
+        applied = int(fed.versions[t]) - len(queue.pending.get(t, ()))
+        if applied > link.watermark(t):
+            cands.append(t)
+        if max_tenants is not None and len(cands) >= max_tenants:
+            break
+    if not cands:
+        return None, {}, 0.0, 0.0
+
+    tenants, versions, deltas = [], [], []
+    shipped: Dict[int, object] = {}
+    bytes_delta = 0.0
+    bytes_full = 0.0
+    for t in cands:
+        row = _materialized_row(src_plane, t)
+        since = link.acked_base.get(t)
+        if since is None or not _tree_shapes_match(since, row):
+            if since is not None:
+                metrics.count("geo.resyncs")
+            since = jax.tree.map(np.asarray, src_plane.sb.empty_row())
+        d = decompose(fed.kind, row, since)
+        applied = int(fed.versions[t]) - len(queue.pending.get(t, ()))
+        tenants.append(int(t))
+        versions.append(applied)
+        deltas.append(d)
+        shipped[int(t)] = row
+        bytes_delta += float(decomposition_bytes(d))
+        bytes_full += float(src_plane.sb.row_nbytes())
+
+    round_ = link.round_acked + 1
+    digest = integrity.checksum(_payload(
+        tenants, versions, deltas, src, dst,
+        fed.membership.generation, round_,
+    ))
+    pkt = GeoPacket(
+        src=int(src), dst=int(dst),
+        generation=fed.membership.generation, round=round_,
+        tenants=tuple(tenants), versions=tuple(versions),
+        deltas=tuple(deltas), digest=np.asarray(digest),
+    )
+    return pkt, shipped, bytes_delta, bytes_full
+
+
+def apply_packet(fed: Federation, pkt: GeoPacket) -> List[Tuple[int, int]]:
+    """Receiver side: refuse stale generations, hold the lockstep
+    round, verify the checksum BEFORE any join, then reconstruct each
+    δ over the local mirror (bit-exact by the ack-window invariant)
+    and land it. Returns the positive acks ``[(tenant, version)]``."""
+    fed.membership.require(pkt.generation, op="exchange")
+    plane = fed.plane(pkt.dst)
+
+    last = plane.rounds_applied.get(pkt.src, 0)
+    if pkt.round not in (last, last + 1):
+        raise GeoLockstepError(
+            f"geo link {pkt.src}->{pkt.dst} shipped round {pkt.round} "
+            f"but the receiver last applied {last} — mispaired "
+            f"exchange; refusing to join"
+        )
+
+    if not bool(integrity.verify(
+        _payload(pkt.tenants, pkt.versions, pkt.deltas,
+                 pkt.src, pkt.dst, pkt.generation, pkt.round),
+        pkt.digest,
+    )):
+        link = link_for(fed, pkt.src, pkt.dst)
+        link.integrity_rejects += 1
+        metrics.count("geo.integrity_rejects")
+        raise _CorruptPacket(
+            f"geo packet {pkt.src}->{pkt.dst} round {pkt.round} failed "
+            f"its checksum — rejected before join"
+        )
+
+    acks: List[Tuple[int, int]] = []
+    for t, ver, d in zip(pkt.tenants, pkt.versions, pkt.deltas):
+        mirror = _materialized_row(plane, t)
+        rec = reconstruct(fed.kind, mirror, d)
+        plane.sb.write_row(int(t), jax.tree.map(np.asarray, rec))
+        acks.append((int(t), int(ver)))
+    plane.rounds_applied[pkt.src] = pkt.round
+    return acks
+
+
+def exchange(
+    fed: Federation, src: int, dst: int, *,
+    policy: Optional[RetryPolicy] = None,
+    transport: Optional[Callable[[GeoPacket], GeoPacket]] = None,
+    max_tenants: Optional[int] = None,
+) -> ExchangeReport:
+    """One retry-wrapped src→dst anti-entropy round. ``transport``
+    (identity by default) is the DCN seam — fault-injection tests
+    wrap it to drop, delay, or corrupt packets; every failure mode
+    lands in :func:`~crdt_tpu.faults.retry.with_retries`' ledger with
+    ``last_good`` = the link's last fully-acked round."""
+    from .. import obs
+
+    link = link_for(fed, src, dst)
+    pkt, shipped, bytes_delta, bytes_full = build_packet(
+        fed, src, dst, max_tenants=max_tenants,
+    )
+    if pkt is None:
+        return ExchangeReport(src, dst, 0, 0.0, 0.0, 0, link.round_acked)
+
+    send = transport or (lambda p: p)
+    rejects_before = link.integrity_rejects
+    pol = policy or RetryPolicy(attempts=3, base_delay=0.0, jitter=0.0)
+
+    def _one_exchange():
+        return apply_packet(fed, send(pkt))
+
+    acks = with_retries(
+        _one_exchange, pol,
+        op=f"geo.exchange.{src}->{dst}", last_good=link.round_acked,
+    )
+    for t, ver in acks:
+        link.confirm(t, ver, shipped[t])
+    link.round_acked = pkt.round
+
+    rejected = link.integrity_rejects - rejects_before
+    fed.exchanges += 1
+    fed.exchange_bytes += bytes_delta
+    fed.full_mirror_bytes += bytes_full
+    metrics.count("geo.exchanges")
+    metrics.count("geo.exchange_bytes", int(bytes_delta))
+    obs.emit(
+        "geo_exchange", src=int(src), dst=int(dst),
+        tenants=len(pkt.tenants), bytes=int(bytes_delta),
+        rejected=int(rejected), round=int(pkt.round),
+    )
+    return ExchangeReport(
+        src, dst, len(pkt.tenants), bytes_delta, bytes_full,
+        rejected, pkt.round,
+    )
+
+
+def exchange_all(
+    fed: Federation, *,
+    policy: Optional[RetryPolicy] = None,
+    transport: Optional[Callable[[GeoPacket], GeoPacket]] = None,
+    max_tenants: Optional[int] = None,
+) -> List[ExchangeReport]:
+    """One full federation anti-entropy sweep: every live home region
+    feeds every OTHER live region's interest set."""
+    reports: List[ExchangeReport] = []
+    live = sorted(
+        r for r, p in fed.planes.items() if p.alive
+    )
+    for src in live:
+        for dst in live:
+            if src == dst:
+                continue
+            reports.append(exchange(
+                fed, src, dst, policy=policy, transport=transport,
+                max_tenants=max_tenants,
+            ))
+    return reports
+
+
+# ---- observability registration (crdt_tpu.analysis) -----------------------
+
+from ..analysis.registry import register_obs_event as _reg_ev  # noqa: E402
+
+_reg_ev(
+    "geo_exchange", subsystem="geo",
+    fields=("src", "dst", "tenants", "bytes", "rejected", "round"),
+    module=__name__,
+)

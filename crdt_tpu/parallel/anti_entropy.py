@@ -157,6 +157,16 @@ def _tel_reduced(folded, slots, merges_per_dev, bytes_per_dev,
         cohorts_per_dispatch=jnp.zeros((), jnp.uint32),
         delta_push_bytes=jnp.zeros((), jnp.float32),
         resync_fallbacks=jnp.zeros((), jnp.uint32),
+        # The geo-federation fields are filled host-side by the
+        # federation front door (crdt_tpu/geo/ Federation.annotate) —
+        # never in-kernel.
+        regions_live=jnp.zeros((), jnp.uint32),
+        geo_home_tenants=jnp.zeros((), jnp.uint32),
+        geo_exchanges=jnp.zeros((), jnp.uint32),
+        geo_exchange_bytes=jnp.zeros((), jnp.float32),
+        geo_full_mirror_bytes=jnp.zeros((), jnp.float32),
+        geo_failovers=jnp.zeros((), jnp.uint32),
+        hist_geo_watermark_lag=_hist.zeros(),
         # The in-kernel histograms are zero unless the δ ring's loop
         # carry fills them in (delta_ring's _replace);
         # hist_dispatch_us is filled host-side (telemetry.time_dispatch

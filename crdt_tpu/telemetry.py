@@ -147,6 +147,20 @@ shipped and sync metadata per round), measured natively per round:
   pipeline moved OFF the dispatch latency path). Filled host-side by
   ``IngestQueue.annotate`` / ``ServeLoop.annotate``; 0/empty on every
   non-serving run.
+- ``regions_live`` / ``geo_home_tenants`` / ``geo_exchanges`` /
+  ``geo_exchange_bytes`` / ``geo_full_mirror_bytes`` /
+  ``geo_failovers`` / ``hist_geo_watermark_lag`` — the geo-federation
+  accounting (crdt_tpu/geo/; registry twins
+  ``telemetry.<kind>.geo.*`` plus ``regions_live``/
+  ``geo_home_tenants`` gauges): live federation regions and tenants
+  homed across them (gauges, filled host-side by
+  ``Federation.annotate``), cross-region anti-entropy rounds
+  completed, the δ-lane wire bytes those rounds actually shipped NEXT
+  to the full-state mirroring baseline they undercut (the
+  partial-replication economics, attributable per run), region-kill
+  re-homings completed, and the per-read mirror watermark-lag
+  distribution (geo/reads.py certificates — how stale local reads
+  actually ran). 0/empty on every non-federated run.
 - ``hist_residue`` / ``hist_useful_bytes`` / ``hist_ack_depth`` /
   ``hist_packed_bytes`` / ``hist_dispatch_us`` — the in-kernel
   DISTRIBUTIONS
@@ -235,6 +249,12 @@ class Telemetry(NamedTuple):
     serve_wal_bytes: jax.Array       # float32 — dirty-tenant WAL bytes appended
     serve_overlap_hit: jax.Array     # uint32 — pipelined rounds that hid device time
     rebalance_moves: jax.Array       # uint32 — skew-driven shard-map moves
+    regions_live: jax.Array          # uint32 — live federation regions
+    geo_home_tenants: jax.Array      # uint32 — tenants homed across live regions
+    geo_exchanges: jax.Array         # uint32 — cross-region anti-entropy rounds
+    geo_exchange_bytes: jax.Array    # float32 — δ bytes shipped cross-region
+    geo_full_mirror_bytes: jax.Array # float32 — full-state mirroring baseline
+    geo_failovers: jax.Array         # uint32 — region-kill re-homings
     hist_residue: obs_hist.Hist    # per-round unshipped-backlog rows
     hist_useful_bytes: obs_hist.Hist  # per-round post-mask payload bytes
     hist_ack_depth: obs_hist.Hist  # per-round ack-window depth
@@ -251,6 +271,7 @@ class Telemetry(NamedTuple):
     hist_push_lag_us: obs_hist.Hist      # dispatch → fan-out push
     hist_ack_lag_us: obs_hist.Hist       # push → client ack
     hist_freshness_us: obs_hist.Hist     # submit → client ack (end-to-end)
+    hist_geo_watermark_lag: obs_hist.Hist  # per-read mirror watermark lag
 
 
 def zeros() -> Telemetry:
@@ -295,6 +316,12 @@ def zeros() -> Telemetry:
         serve_wal_bytes=jnp.zeros((), jnp.float32),
         serve_overlap_hit=jnp.zeros((), jnp.uint32),
         rebalance_moves=jnp.zeros((), jnp.uint32),
+        regions_live=jnp.zeros((), jnp.uint32),
+        geo_home_tenants=jnp.zeros((), jnp.uint32),
+        geo_exchanges=jnp.zeros((), jnp.uint32),
+        geo_exchange_bytes=jnp.zeros((), jnp.float32),
+        geo_full_mirror_bytes=jnp.zeros((), jnp.float32),
+        geo_failovers=jnp.zeros((), jnp.uint32),
         hist_residue=obs_hist.zeros(),
         hist_useful_bytes=obs_hist.zeros(),
         hist_ack_depth=obs_hist.zeros(),
@@ -309,6 +336,7 @@ def zeros() -> Telemetry:
         hist_push_lag_us=obs_hist.zeros(),
         hist_ack_lag_us=obs_hist.zeros(),
         hist_freshness_us=obs_hist.zeros(),
+        hist_geo_watermark_lag=obs_hist.zeros(),
     )
 
 
@@ -365,6 +393,12 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         serve_wal_bytes=a.serve_wal_bytes + b.serve_wal_bytes,
         serve_overlap_hit=a.serve_overlap_hit + b.serve_overlap_hit,
         rebalance_moves=a.rebalance_moves + b.rebalance_moves,
+        geo_exchanges=a.geo_exchanges + b.geo_exchanges,
+        geo_exchange_bytes=a.geo_exchange_bytes + b.geo_exchange_bytes,
+        geo_full_mirror_bytes=(
+            a.geo_full_mirror_bytes + b.geo_full_mirror_bytes
+        ),
+        geo_failovers=a.geo_failovers + b.geo_failovers,
         hist_residue=obs_hist.merge(a.hist_residue, b.hist_residue),
         hist_useful_bytes=obs_hist.merge(
             a.hist_useful_bytes, b.hist_useful_bytes
@@ -403,6 +437,9 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         hist_freshness_us=obs_hist.merge(
             a.hist_freshness_us, b.hist_freshness_us
         ),
+        hist_geo_watermark_lag=obs_hist.merge(
+            a.hist_geo_watermark_lag, b.hist_geo_watermark_lag
+        ),
         deferred_depth=b.deferred_depth,
         residue=b.residue,
         widen_pressure=b.widen_pressure,
@@ -412,6 +449,8 @@ def combine(a: Telemetry, b: Telemetry) -> Telemetry:
         live_tenants=b.live_tenants,
         evicted_tenants=b.evicted_tenants,
         subscribers_live=b.subscribers_live,
+        regions_live=b.regions_live,
+        geo_home_tenants=b.geo_home_tenants,
     )
 
 
@@ -590,6 +629,12 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "serve_wal_bytes": float(tel.serve_wal_bytes),
         "serve_overlap_hit": int(tel.serve_overlap_hit),
         "rebalance_moves": int(tel.rebalance_moves),
+        "regions_live": int(tel.regions_live),
+        "geo_home_tenants": int(tel.geo_home_tenants),
+        "geo_exchanges": int(tel.geo_exchanges),
+        "geo_exchange_bytes": float(tel.geo_exchange_bytes),
+        "geo_full_mirror_bytes": float(tel.geo_full_mirror_bytes),
+        "geo_failovers": int(tel.geo_failovers),
         "hist_residue": obs_hist.to_dict(tel.hist_residue),
         "hist_useful_bytes": obs_hist.to_dict(tel.hist_useful_bytes),
         "hist_ack_depth": obs_hist.to_dict(tel.hist_ack_depth),
@@ -604,6 +649,9 @@ def to_dict(tel: Telemetry) -> Dict[str, Any]:
         "hist_push_lag_us": obs_hist.to_dict(tel.hist_push_lag_us),
         "hist_ack_lag_us": obs_hist.to_dict(tel.hist_ack_lag_us),
         "hist_freshness_us": obs_hist.to_dict(tel.hist_freshness_us),
+        "hist_geo_watermark_lag": obs_hist.to_dict(
+            tel.hist_geo_watermark_lag
+        ),
     }
 
 
@@ -689,6 +737,14 @@ def counter_increments(kind: str, d: Dict[str, Any]) -> Dict[str, int]:
         f"telemetry.{kind}.serve.wal_bytes": int(d["serve_wal_bytes"]),
         f"telemetry.{kind}.serve.overlap_hit": d["serve_overlap_hit"],
         f"telemetry.{kind}.serve.rebalance_moves": d["rebalance_moves"],
+        f"telemetry.{kind}.geo.exchanges": d["geo_exchanges"],
+        f"telemetry.{kind}.geo.exchange_bytes": int(
+            d["geo_exchange_bytes"]
+        ),
+        f"telemetry.{kind}.geo.full_mirror_bytes": int(
+            d["geo_full_mirror_bytes"]
+        ),
+        f"telemetry.{kind}.geo.failovers": d["geo_failovers"],
     }
     # Histogram per-bucket counters fold bit-exactly across runs —
     # exactly what tools/obs_report.py cross-checks a dump against.
@@ -731,6 +787,10 @@ def record(kind: str, tel: Telemetry) -> None:
     )
     metrics.observe(
         f"telemetry.{kind}.subscribers_live", d["subscribers_live"]
+    )
+    metrics.observe(f"telemetry.{kind}.regions_live", d["regions_live"])
+    metrics.observe(
+        f"telemetry.{kind}.geo_home_tenants", d["geo_home_tenants"]
     )
     metrics.observe(f"telemetry.{kind}.deferred_depth", d["deferred_depth"])
     metrics.observe(f"telemetry.{kind}.residue", d["residue"])

@@ -1,0 +1,308 @@
+"""Geo-federation plane tests (ISSUE 20).
+
+The contract under test, layer by layer:
+
+1. **Homing** — rendezvous tenant→region homing is deterministic,
+   region loss is a MINIMAL remap (only the dead region's tenants
+   move), and the last live region cannot be failed over.
+2. **Membership** — the federation generation bumps on evict/admit and
+   a stale-stamped packet is refused loudly
+   (``GeoGenerationError`` — the mesh_scale stale-certificate
+   discipline at federation granularity).
+3. **Anti-entropy** — cross-region δ lanes converge mirrors
+   bit-identically to their home rows; a corrupt inter-region packet
+   NEVER joins (checksum rejection healed by the retry wrapper).
+4. **Reads** — a non-home read before anti-entropy is LABELED stale
+   (never silently fresh), watermarks are monotone, and the committed
+   broken twin (``fixtures.region_serves_unwatermarked_read``) fails
+   the ``watermark_reads_sound`` detector.
+5. **Failover** (the headline) — killing a region MID-TRAFFIC
+   re-homes its shards from the durable tier (snapshot rows + WAL
+   suffix); every recovered tenant is bit-identical to the per-tenant
+   sequential oracle over exactly its ACKED ops — zero acked-op loss,
+   while in-flight unacked ops are legitimately dropped.
+"""
+
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from crdt_tpu.analysis import fixtures
+from crdt_tpu.geo import (
+    Federation,
+    GeoGenerationError,
+    RegionMap,
+    RegionPlane,
+    apply_packet,
+    build_packet,
+    exchange,
+    exchange_all,
+    fail_over_region,
+    read_local,
+    static_checks,
+    watermark_reads_sound,
+)
+from crdt_tpu.geo.reads import _micro_federation
+from crdt_tpu.ops import superblock as sb_ops
+from crdt_tpu.parallel import make_mesh
+from crdt_tpu.serve import Evictor, IngestQueue, Superblock
+from crdt_tpu.serve.wal import ServeWal
+
+CAPS = dict(n_elems=8, n_actors=2, deferred_cap=2)
+N_TENANTS = 16
+
+
+def _m(*on):
+    return np.isin(np.arange(CAPS["n_elems"]), on)
+
+
+def _m4(*on):
+    # _micro_federation (geo/reads.py) runs 4-element rows.
+    return np.isin(np.arange(4), on)
+
+
+def _rows_equal(a, b):
+    return all(
+        bool(np.array_equal(np.asarray(x), np.asarray(y)))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _durable_federation(tmp_path, regions=3):
+    """A federation where every region has the full durable tier
+    (evictor snapshot root + WAL-attached ingest queue) — the shape
+    the failover contract needs."""
+    mesh = make_mesh(1, 1)
+    planes = {}
+    for r in range(regions):
+        sb = Superblock(N_TENANTS, mesh, kind="orswot", caps=CAPS)
+        root = str(tmp_path / f"region-{r}")
+        os.makedirs(root, exist_ok=True)
+        ev = Evictor(sb, root)
+        wal = ServeWal(os.path.join(root, "serve.wal"))
+        q = IngestQueue(sb, lanes=N_TENANTS, depth=2, evictor=ev,
+                        wal=wal)
+        planes[r] = RegionPlane(r, sb, q, evictor=ev, wal=wal)
+    return Federation(planes)
+
+
+# ---- homing + membership --------------------------------------------------
+
+
+def test_rendezvous_homing_minimal_remap():
+    rmap = RegionMap(3)
+    before = {t: rmap.home(t) for t in range(256)}
+    assert before == {t: rmap.home(t) for t in range(256)}  # stable
+    assert set(before.values()) == {0, 1, 2}  # every region holds some
+    rmap.fail_over(1)
+    after = {t: rmap.home(t) for t in range(256)}
+    for t, h in before.items():
+        if h != 1:
+            assert after[t] == h, "a surviving assignment moved"
+        else:
+            assert after[t] in (0, 2)
+
+
+def test_last_region_cannot_fail_over():
+    rmap = RegionMap(2)
+    rmap.fail_over(0)
+    with pytest.raises(ValueError):
+        rmap.fail_over(1)
+
+
+def test_stale_generation_packet_refused():
+    fed = _micro_federation()
+    t = next(t for t in range(fed.n_tenants) if fed.rmap.home(t) == 0)
+    fed.add(1, t, actor=0, counter=1, member=_m4(0, 1))
+    fed.drain_all()
+    pkt, _shipped, _db, _fb = build_packet(fed, 0, 1)
+    assert pkt is not None
+    fed.membership.admit(1)  # any membership change bumps the stamp
+    with pytest.raises(GeoGenerationError):
+        apply_packet(fed, pkt)
+
+
+# ---- anti-entropy ---------------------------------------------------------
+
+
+def test_exchange_converges_mirror_bit_identical():
+    fed = _micro_federation()
+    ts = [t for t in range(fed.n_tenants) if fed.rmap.home(t) == 0][:2]
+    for i, t in enumerate(ts):
+        fed.add(1, t, actor=0, counter=1, member=_m4(i, i + 1))
+        fed.add(1, t, actor=1, counter=1, member=_m4(3))
+    fed.drain_all()
+    reps = exchange_all(fed)
+    shipped = sum(r.tenants_shipped for r in reps)
+    assert shipped >= len(ts)
+    for t in ts:
+        assert _rows_equal(
+            fed.plane(1).sb.row(t), fed.plane(0).sb.row(t)
+        )
+    # δ lanes beat full-state mirroring even on the first (vs-⊥) ship.
+    assert 0.0 < fed.exchange_bytes < fed.full_mirror_bytes
+
+
+def test_corrupt_packet_never_joins():
+    fed = _micro_federation()
+    t = next(t for t in range(fed.n_tenants) if fed.rmap.home(t) == 0)
+    fed.add(1, t, actor=0, counter=2, member=_m4(0, 2))
+    fed.drain_all()
+    flips = {"n": 0}
+
+    def corrupt_once(pkt):
+        if flips["n"]:
+            return pkt
+        flips["n"] += 1
+        bad = jax.tree.map(
+            lambda x: np.asarray(x) + 1, pkt.deltas[0].residual
+        )
+        deltas = (pkt.deltas[0]._replace(residual=bad),) + pkt.deltas[1:]
+        return pkt._replace(deltas=deltas)  # digest now stale → reject
+
+    rep = exchange(fed, 0, 1, transport=corrupt_once)
+    assert rep.rejected >= 1, "the corrupt shipment was not rejected"
+    assert _rows_equal(fed.plane(1).sb.row(t), fed.plane(0).sb.row(t)), (
+        "retry did not heal the link after the integrity rejection"
+    )
+
+
+# ---- watermark-certificate reads ------------------------------------------
+
+
+def test_stale_local_read_is_labeled_stale():
+    fed = _micro_federation()
+    t = next(t for t in range(fed.n_tenants) if fed.rmap.home(t) == 0)
+    fed.add(1, t, actor=0, counter=1, member=_m4(0, 1))
+    fed.drain_all()
+
+    _v0, c0 = read_local(fed, 1, t)
+    assert not c0.fresh and c0.lag > 0, (
+        "a pre-anti-entropy mirror read must be LABELED stale"
+    )
+    exchange_all(fed)
+    v1, c1 = read_local(fed, 1, t)
+    assert c1.fresh and c1.lag == 0
+    assert c1.watermark >= c0.watermark, "watermark regressed"
+    home_v, home_c = read_local(fed, 0, t)
+    assert home_c.fresh, "a home-region read is fresh by definition"
+    assert _rows_equal(v1, home_v)
+
+
+def test_watermark_detector_and_broken_twin():
+    assert watermark_reads_sound(read_local)
+    assert not watermark_reads_sound(
+        fixtures.region_serves_unwatermarked_read
+    ), "the committed always-fresh twin must FAIL the detector"
+
+
+def test_geo_static_checks_clean():
+    assert static_checks() == []
+
+
+# ---- region-kill failover -------------------------------------------------
+
+
+def test_region_kill_failover_zero_acked_loss(tmp_path):
+    fed = _durable_federation(tmp_path, regions=3)
+    dead = 2
+    pre_home = {t: fed.rmap.home(t) for t in range(N_TENANTS)}
+    history = {}  # tenant -> ACKED ops (sequential-oracle form)
+    ctr = np.zeros(N_TENANTS, np.uint32)
+
+    def add(origin, t):
+        act = t % CAPS["n_actors"]
+        c = int(ctr[t]) + 1
+        ctr[t] = c
+        m = _m(t % 8, (t + c) % 8)
+        fed.add(origin, t, actor=act, counter=c, member=m)
+        return (sb_ops.ADD, act, c, None, m)
+
+    # Phase 1: every tenant written from a rotating origin, acked
+    # (drained through its home WAL), mirrors fed by anti-entropy.
+    tent = [(t, add(t % 3, t)) for t in range(N_TENANTS)]
+    tent += [(t, add((t + 1) % 3, t)) for t in range(0, N_TENANTS, 2)]
+    fed.drain_all()
+    for t, op in tent:
+        history.setdefault(t, []).append(op)
+    exchange_all(fed)
+
+    # Spill part of the dead region's home set to its durable tier so
+    # the failover recovers snapshot rows AND replays the WAL suffix
+    # idempotently over them.
+    dead_home = [t for t in range(N_TENANTS) if pre_home[t] == dead]
+    assert dead_home, "rendezvous left region 2 empty — shape too small"
+    spilled = fed.planes[dead].evictor.evict(dead_home[: len(dead_home) // 2 + 1])
+    assert spilled >= 1
+
+    # Phase 2: kill MID-TRAFFIC — these ops are pending, NOT drained:
+    # the dead region's share was never WAL-committed (unacked → lost);
+    # the survivors' share drains after the failover and stays acked.
+    tent = [(t, add(t % 3, t)) for t in range(N_TENANTS)]
+    lost = [(t, op) for t, op in tent if pre_home[t] == dead]
+    kept = [(t, op) for t, op in tent if pre_home[t] != dead]
+    assert lost, "no in-flight ops at the dead region — weak test"
+
+    rep = fail_over_region(fed, dead)
+    assert rep.tenants_rehomed == len(dead_home)
+    assert rep.rows_recovered >= 1, "snapshot tier never touched"
+    assert rep.ops_replayed >= 1, "WAL suffix never replayed"
+    fed.drain_all()
+    for t, op in kept:
+        history.setdefault(t, []).append(op)
+
+    # Phase 3: post-failover traffic lands at the NEW homes.
+    tent = [(t, add(t % 2, t)) for t in range(N_TENANTS)]
+    fed.drain_all()
+    for t, op in tent:
+        history.setdefault(t, []).append(op)
+    exchange_all(fed)
+    exchange_all(fed)
+
+    # Zero acked-op loss: every tenant's home row is bit-identical to
+    # the sequential oracle over exactly its ACKED ops — in particular
+    # every re-homed tenant recovered from snapshot + WAL.
+    tk = fed.plane(0).sb.tk
+    for t in range(N_TENANTS):
+        home = fed.rmap.home(t)
+        assert home != dead
+        want = sb_ops.sequential_oracle(
+            tk, tk.empty(**CAPS), history[t]
+        )
+        hp = fed.plane(home)
+        if not hp.sb.is_resident(t):
+            hp.evictor.restore(t)
+        assert _rows_equal(hp.sb.row(t), want), (
+            f"tenant {t} (pre-kill home {pre_home[t]}) diverged from "
+            f"its acked-op oracle"
+        )
+
+    # Mirrors at surviving regions converge to the new home rows.
+    checked = 0
+    for r in (0, 1):
+        pl = fed.plane(r)
+        for t in sorted(pl.interest_tenants()):
+            home = fed.rmap.home(t)
+            if home == r or not pl.sb.is_resident(t):
+                continue
+            assert _rows_equal(
+                pl.sb.row(t), fed.plane(home).sb.row(t)
+            )
+            checked += 1
+    assert checked >= 1
+    assert fed.failovers == 1
+    # Membership refuses pre-failover stamps.
+    with pytest.raises(KeyError):
+        fed.plane(dead)
+
+
+def test_failover_requires_surviving_region(tmp_path):
+    fed = _durable_federation(tmp_path, regions=2)
+    fail_over_region(fed, 1)
+    with pytest.raises(ValueError):
+        fail_over_region(fed, 0)

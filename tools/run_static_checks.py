@@ -96,6 +96,20 @@ Sections (each timed, each independently skippable):
   detector gate — the watermark-bucket-skipping pusher
   (``analysis.fixtures.fanout_skips_watermark_bucket``) must fail the
   cohort coverage detector.
+- ``federation`` — the geo-federation gates (ISSUE 20,
+  crdt_tpu.geo.static_checks): geo-surface registry coverage (every
+  public operational symbol must have registered —
+  crdt_tpu.analysis.registry.register_geo_surface), the two-region
+  convergence micro A/B (mirrors bit-identical to home rows after one
+  anti-entropy sweep, δ wire bytes strictly under the full-state
+  mirroring baseline, a corrupted packet rejected by the checksum
+  lane then healed by the retry re-ship), the watermark-monotonicity
+  detector (``crdt_tpu.geo.reads.watermark_reads_sound`` — stale
+  local reads labeled stale, certificates monotone, caught-up mirrors
+  bit-equal to home), and the broken-twin detector gate — the
+  always-fresh read path
+  (``analysis.fixtures.region_serves_unwatermarked_read``) must fail
+  the watermark detector.
 - ``pipeline`` — the pipelined-serving-loop gates (ISSUE 18): the
   skew-aware rebalance minimal-move property (balanced fleet → zero
   moves; every move sheds from an over-threshold host and strictly
@@ -177,7 +191,8 @@ sys.path.insert(0, ROOT)
 SECTIONS = (
     "lint", "schema", "laws", "schedules", "faults", "decomp",
     "durability", "scaleout", "obs", "wire", "serve", "fanout",
-    "pipeline", "concurrency", "jit-lint", "cost", "slo", "aliasing",
+    "federation", "pipeline", "concurrency", "jit-lint", "cost",
+    "slo", "aliasing",
 )
 
 # Directories the fallback linter walks (ruff takes its own config).
@@ -363,6 +378,12 @@ def run_serve():
 
 def run_fanout():
     from crdt_tpu.fanout import static_checks
+
+    return static_checks()
+
+
+def run_federation():
+    from crdt_tpu.geo import static_checks
 
     return static_checks()
 
@@ -613,6 +634,7 @@ RUNNERS = {
     "wire": run_wire,
     "serve": run_serve,
     "fanout": run_fanout,
+    "federation": run_federation,
     "pipeline": run_pipeline,
     "concurrency": run_concurrency,
     "jit-lint": run_jit_lint,
@@ -623,8 +645,8 @@ RUNNERS = {
 
 _JAX_SECTIONS = (
     "laws", "schedules", "faults", "decomp", "durability", "scaleout",
-    "obs", "wire", "serve", "fanout", "pipeline", "concurrency",
-    "jit-lint", "cost", "slo", "aliasing",
+    "obs", "wire", "serve", "fanout", "federation", "pipeline",
+    "concurrency", "jit-lint", "cost", "slo", "aliasing",
 )
 
 

@@ -84,32 +84,37 @@ def npasses_streaming_ab() -> bool:
 
 
 def static_summary_covers_concurrency() -> bool:
-    """The chip run rides on the host-concurrency gates having run:
-    the ``concurrency`` section must be wired into the static-check
-    chain, and any committed/CI summary JSON (``static_checks.json``,
-    or ``$STATIC_CHECKS_SUMMARY``) must contain its entry — a summary
-    that predates the section means the serving runtime on this chip
-    was never interleaving-checked."""
+    """The chip run rides on the host-side gates having run: the
+    ``concurrency`` section (host-interleaving soundness) and the
+    ``federation`` section (geo surface coverage + watermark-read
+    monotonicity) must be wired into the static-check chain, and any
+    committed/CI summary JSON (``static_checks.json``, or
+    ``$STATIC_CHECKS_SUMMARY``) must contain their entries — a summary
+    that predates a section means the serving runtime on this chip was
+    never checked for it."""
     import json
 
     import run_static_checks as rsc
 
-    if "concurrency" not in rsc.SECTIONS or "concurrency" not in rsc.RUNNERS:
-        print("FAIL: 'concurrency' section missing from the static-check "
-              "chain (tools/run_static_checks.py)")
-        return False
+    required = ("concurrency", "federation")
+    for section in required:
+        if section not in rsc.SECTIONS or section not in rsc.RUNNERS:
+            print(f"FAIL: '{section}' section missing from the "
+                  "static-check chain (tools/run_static_checks.py)")
+            return False
     path = os.environ.get(
         "STATIC_CHECKS_SUMMARY", os.path.join(ROOT, "static_checks.json")
     )
     if os.path.exists(path):
         with open(path, encoding="utf-8") as f:
             doc = json.load(f)
-        if "concurrency" not in doc.get("sections", {}):
-            print(f"FAIL: static-check summary {path} has no "
-                  "'concurrency' section — rerun "
-                  "tools/run_static_checks.py --json-out before the "
-                  "chip checks")
-            return False
+        for section in required:
+            if section not in doc.get("sections", {}):
+                print(f"FAIL: static-check summary {path} has no "
+                      f"'{section}' section — rerun "
+                      "tools/run_static_checks.py --json-out before the "
+                      "chip checks")
+                return False
     return True
 
 
@@ -343,6 +348,44 @@ def main() -> int:
                 or fo["resync_fallbacks"] < 1):
             print("FAIL: fanout leg below the 1M-subscriber / 10x-δ / "
                   "resync-fallback gate")
+            return 1
+
+    # The geo-federation plane: a multi-region mesh-of-meshes replayed
+    # VERBATIM from the committed BENCH_CONFIGS.json geo entry — δ
+    # anti-entropy over checksum-guarded inter-region links, a
+    # mid-traffic region kill re-homed from the durable tier, and
+    # causal-watermark local reads. The leg itself asserts the
+    # single-mesh-oracle bit-identity, the zero-acked-op-loss gate,
+    # the ≤25% cross-region-bytes-vs-full-mirroring gate, and the
+    # partial-replication residency bound; here a degraded or failing
+    # record is a failed check on hardware.
+    t0 = time.time()
+    geo_recs = bench.bench_geo()
+    if geo_recs:
+        g = geo_recs[0]
+        print(
+            f"geo {g['regions']} regions x {g['tenants']:,} tenants "
+            f"ran  [{time.time()-t0:.0f}s] ({g['exchange_bytes']:,.0f} B "
+            f"cross-region vs {g['full_mirror_bytes']:,.0f} B "
+            f"full-mirror = {g['wire_vs_mirror_pct']:.1f}%, "
+            f"{g['failovers']} failover(s), {g['acked_ops_lost']} acked "
+            f"ops lost, bit-identity gate "
+            f"{'OK' if g['bit_identical'] else 'FAILED'})"
+        )
+        if g.get("degraded") or not g["bit_identical"]:
+            print("FAIL: geo record degraded or not bit-identical to "
+                  "the single-mesh oracle")
+            return 1
+        if g["acked_ops_lost"] or not g["recovered_bit_identical"]:
+            print("FAIL: geo region-kill failover lost acked ops")
+            return 1
+        if g["wire_vs_mirror_pct"] > 25:
+            print("FAIL: cross-region δ bytes exceed 25% of full-state "
+                  "mirroring")
+            return 1
+        if not g["resident_bound_ok"]:
+            print("FAIL: partial replication violated — a region's "
+                  "resident lanes exceed its home+interest tenant set")
             return 1
 
     # In-process (libtpu is exclusive per process — a subprocess could
